@@ -1,0 +1,19 @@
+from paddlebox_tpu.config import flags
+from paddlebox_tpu.config.configs import (
+    TableConfig,
+    SparseOptimizerConfig,
+    DataFeedConfig,
+    TrainerConfig,
+    CheckpointConfig,
+    MeshConfig,
+)
+
+__all__ = [
+    "flags",
+    "TableConfig",
+    "SparseOptimizerConfig",
+    "DataFeedConfig",
+    "TrainerConfig",
+    "CheckpointConfig",
+    "MeshConfig",
+]

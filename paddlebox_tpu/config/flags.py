@@ -1,0 +1,114 @@
+"""Process-level flag registry with environment override.
+
+TPU-native analog of the reference's gflags tier (PADDLE_DEFINE_EXPORTED_* in
+paddle/fluid/platform/flags.cc; box-cluster flags at flags.cc:946-975). Flags
+are declared in code with a typed default and can be overridden by environment
+variables named ``PBTPU_<FLAG_NAME>`` (mirroring the ``FLAGS_*`` env convention).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict
+
+_REGISTRY: Dict[str, "_Flag"] = {}
+_LOCK = threading.Lock()
+
+_ENV_PREFIX = "PBTPU_"
+
+
+class _Flag:
+    __slots__ = ("name", "default", "value", "help", "parser", "from_env")
+
+    def __init__(self, name: str, default: Any, help: str, parser: Callable[[str], Any]):
+        self.name = name
+        self.default = default
+        self.help = help
+        self.parser = parser
+        env_name = _ENV_PREFIX + name.upper()
+        env = os.environ.get(env_name)
+        if env is not None:
+            try:
+                self.value = parser(env)
+            except ValueError as e:
+                raise ValueError(
+                    f"invalid value {env!r} for flag {name!r} "
+                    f"(from env {env_name}): {e}") from e
+            self.from_env = True
+        else:
+            self.value = default
+            self.from_env = False
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _parser_for(default: Any) -> Callable[[str], Any]:
+    if isinstance(default, bool):
+        return _parse_bool
+    if isinstance(default, int):
+        return int
+    if isinstance(default, float):
+        return float
+    return str
+
+
+def define_flag(name: str, default: Any, help: str = "") -> None:
+    with _LOCK:
+        if name in _REGISTRY:
+            raise ValueError(f"flag {name!r} already defined")
+        _REGISTRY[name] = _Flag(name, default, help, _parser_for(default))
+
+
+def get_flag(name: str) -> Any:
+    return _REGISTRY[name].value
+
+
+def set_flag(name: str, value: Any) -> None:
+    flag = _REGISTRY[name]
+    if not isinstance(value, type(flag.default)) and flag.default is not None:
+        value = flag.parser(str(value))
+    flag.value = value
+
+
+def all_flags() -> Dict[str, Any]:
+    return {k: f.value for k, f in sorted(_REGISTRY.items())}
+
+
+# ---------------------------------------------------------------------------
+# Core flag set (parity with the box-cluster flag block, flags.cc:946-975, plus
+# worker flags boxps_worker.cc:41-54, re-expressed for the TPU runtime).
+# ---------------------------------------------------------------------------
+
+define_flag("enable_pullpush_dedup_keys", True,
+            "dedup feasign keys inside pull/push (DedupKeysAndFillIdx analog)")
+define_flag("record_pool_max_size", 2_000_000,
+            "max retained SlotRecord objects in the slab pool")
+define_flag("slotrecord_extend_dim", 0,
+            "extra float dims appended to each slot record")
+define_flag("dataset_shuffle_thread_num", 10,
+            "threads for cross-host instance shuffle")
+define_flag("dataset_merge_thread_num", 10,
+            "threads merging shuffled instances + registering pass keys")
+define_flag("dataset_disable_shuffle", False,
+            "skip the cross-host instance shuffle stage")
+define_flag("dataset_disable_polling", False,
+            "disable file polling in dataset readers")
+define_flag("auc_runner_mode", False,
+            "AUC-runner replay mode (slots-shuffle evaluation)")
+define_flag("check_nan_inf", False,
+            "after each batch, check outputs for NaN/Inf and dump on trip")
+define_flag("padbox_max_batch_keys", 0,
+            "static per-batch key capacity; 0 = derive from feed config")
+define_flag("sparse_table_load_factor", 0.75,
+            "host hash table resize load factor")
+define_flag("enable_sparse_push_barrier", False,
+            "block until async sparse push of previous step completes")
+define_flag("dump_file_max_bytes", 2 << 30,
+            "rotation size for debug dump files (2GB like dump writers)")
+define_flag("feed_pass_thread_num", 8,
+            "threads registering keys during feed pass (ref default 30)")
+define_flag("profile_per_op", False,
+            "accumulate per-op timing in the train loop (TrainFilesWithProfiler)")

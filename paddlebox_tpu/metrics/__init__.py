@@ -1,0 +1,3 @@
+from paddlebox_tpu.metrics.auc import BasicAucCalculator, MetricMsg, MetricRegistry
+
+__all__ = ["BasicAucCalculator", "MetricMsg", "MetricRegistry"]

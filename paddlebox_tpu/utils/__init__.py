@@ -1,0 +1,14 @@
+from paddlebox_tpu.utils.timer import Timer, TimerScope
+from paddlebox_tpu.utils.stats import StatRegistry, stat_add, stat_get, stat_reset
+from paddlebox_tpu.utils.channel import Channel, ChannelClosed
+
+__all__ = [
+    "Timer",
+    "TimerScope",
+    "StatRegistry",
+    "stat_add",
+    "stat_get",
+    "stat_reset",
+    "Channel",
+    "ChannelClosed",
+]

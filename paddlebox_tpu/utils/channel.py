@@ -1,0 +1,101 @@
+"""Bounded MPMC channel.
+
+Analog of framework::Channel (paddle/fluid/framework/channel.h): the blocking
+multi-producer/multi-consumer queue that stitches together the reference's
+read → shuffle → merge dataset pipeline stages. Supports batched read/write
+and close-with-drain semantics like ChannelObject.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Generic, Iterable, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class Channel(Generic[T]):
+    def __init__(self, capacity: int = 0) -> None:
+        # capacity 0 = unbounded (like default ChannelObject)
+        self._capacity = capacity
+        self._deque: collections.deque = collections.deque()
+        self._mutex = threading.Lock()
+        self._not_empty = threading.Condition(self._mutex)
+        self._not_full = threading.Condition(self._mutex)
+        self._closed = False
+
+    # -- producer side -----------------------------------------------------
+    def put(self, item: T) -> None:
+        with self._mutex:
+            if self._closed:
+                raise ChannelClosed("put on closed channel")
+            while self._capacity and len(self._deque) >= self._capacity:
+                self._not_full.wait()
+                if self._closed:
+                    raise ChannelClosed("put on closed channel")
+            self._deque.append(item)
+            self._not_empty.notify()
+
+    def put_many(self, items: Iterable[T]) -> None:
+        for it in items:
+            self.put(it)
+
+    def close(self) -> None:
+        with self._mutex:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+
+    # -- consumer side -----------------------------------------------------
+    def get(self, timeout: Optional[float] = None) -> T:
+        """Blocking pop; raises ChannelClosed when closed and drained."""
+        with self._mutex:
+            while not self._deque:
+                if self._closed:
+                    raise ChannelClosed("channel closed and drained")
+                if not self._not_empty.wait(timeout):
+                    raise TimeoutError("channel get timed out")
+            item = self._deque.popleft()
+            self._not_full.notify()
+            return item
+
+    def get_many(self, max_items: int) -> List[T]:
+        """Pop up to max_items (at least 1 unless closed+empty → ChannelClosed)."""
+        out: List[T] = []
+        with self._mutex:
+            while not self._deque:
+                if self._closed:
+                    raise ChannelClosed("channel closed and drained")
+                self._not_empty.wait()
+            while self._deque and len(out) < max_items:
+                out.append(self._deque.popleft())
+            self._not_full.notify_all()
+        return out
+
+    def drain(self) -> List[T]:
+        """Non-blocking: pop everything currently buffered."""
+        with self._mutex:
+            out = list(self._deque)
+            self._deque.clear()
+            self._not_full.notify_all()
+            return out
+
+    def __iter__(self):
+        while True:
+            try:
+                yield self.get()
+            except ChannelClosed:
+                return
+
+    def __len__(self) -> int:
+        with self._mutex:
+            return len(self._deque)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed

@@ -1,0 +1,65 @@
+"""Named int64 stat registry.
+
+Analog of platform::Monitor / StatRegistry (paddle/fluid/platform/monitor.h:80)
+and the STAT_INT_ADD macro (monitor.h:137) used for e.g. device memory stats.
+Thread-safe; exported to the python API directly (no pybind needed here).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class StatRegistry:
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._stats: Dict[str, int] = {}
+
+    @classmethod
+    def instance(cls) -> "StatRegistry":
+        if cls._instance is None:
+            with cls._instance_lock:
+                if cls._instance is None:
+                    cls._instance = cls()
+        return cls._instance
+
+    def add(self, name: str, value: int) -> int:
+        with self._lock:
+            cur = self._stats.get(name, 0) + int(value)
+            self._stats[name] = cur
+            return cur
+
+    def set(self, name: str, value: int) -> None:
+        with self._lock:
+            self._stats[name] = int(value)
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._stats.get(name, 0)
+
+    def reset(self, name: str = None) -> None:
+        with self._lock:
+            if name is None:
+                self._stats.clear()
+            else:
+                self._stats.pop(name, None)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._stats)
+
+
+def stat_add(name: str, value: int = 1) -> int:
+    return StatRegistry.instance().add(name, value)
+
+
+def stat_get(name: str) -> int:
+    return StatRegistry.instance().get(name)
+
+
+def stat_reset(name: str = None) -> None:
+    StatRegistry.instance().reset(name)

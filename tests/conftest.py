@@ -1,0 +1,12 @@
+"""Test env: force CPU backend with 8 virtual devices so multi-chip sharding
+paths (mesh/pjit/shard_map/all_to_all) are exercised without TPU hardware —
+the multi-host-sim test tier called for by SURVEY.md §4."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")

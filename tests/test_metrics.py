@@ -1,0 +1,231 @@
+"""AUC calculator parity vs a direct numpy oracle.
+
+Mirrors the role of the reference's metric correctness reliance: the bucketed
+streaming AUC must converge to exact pairwise AUC as table_size grows, and
+the side stats (mae/rmse/ctrs) must match closed forms.
+"""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.metrics import BasicAucCalculator, MetricRegistry
+
+
+def exact_auc(pred, label):
+    """O(n^2)-free exact AUC via rank statistic with tie correction."""
+    pred = np.asarray(pred, dtype=np.float64)
+    label = np.asarray(label)
+    pos = pred[label == 1]
+    neg = pred[label == 0]
+    if len(pos) == 0 or len(neg) == 0:
+        return -0.5
+    # count pairs pos > neg plus half ties
+    wins = 0.0
+    for p in pos:
+        wins += np.sum(p > neg) + 0.5 * np.sum(p == neg)
+    return wins / (len(pos) * len(neg))
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+def test_auc_matches_exact_when_buckets_resolve(rng):
+    n = 4000
+    # predictions quantized to bucket grid so bucketing is lossless
+    table = 1 << 14
+    pred = rng.randint(0, table, n).astype(np.float64) / table
+    prob = 0.2 + 0.6 * pred
+    label = (rng.rand(n) < prob).astype(np.int64)
+
+    calc = BasicAucCalculator()
+    calc.init(table)
+    # stream in chunks like per-batch AddAucMonitor
+    for i in range(0, n, 256):
+        calc.add_data(pred[i:i + 256], label[i:i + 256])
+    calc.compute()
+
+    np.testing.assert_allclose(calc.auc(), exact_auc(pred, label), atol=1e-9)
+    np.testing.assert_allclose(calc.mae(), np.abs(pred - label).mean(), atol=1e-12)
+    np.testing.assert_allclose(
+        calc.rmse(), np.sqrt(((pred - label) ** 2).mean()), atol=1e-12)
+    np.testing.assert_allclose(calc.actual_ctr(), label.mean(), atol=1e-12)
+    np.testing.assert_allclose(calc.predicted_ctr(), pred.mean(), atol=1e-12)
+    assert calc.size() == n
+
+
+def test_auc_all_one_class():
+    calc = BasicAucCalculator()
+    calc.init(1024)
+    calc.add_data(np.array([0.1, 0.9]), np.array([1, 1]))
+    calc.compute()
+    assert calc.auc() == -0.5  # reference sentinel for degenerate data
+
+
+def test_auc_mask(rng):
+    calc = BasicAucCalculator()
+    calc.init(1 << 12)
+    pred = np.array([0.9, 0.1, 0.5, 0.7])
+    label = np.array([1, 0, 1, 0])
+    mask = np.array([1, 1, 0, 0])
+    calc.add_data(pred, label, mask=mask)
+    calc.compute()
+    assert calc.size() == 2
+    np.testing.assert_allclose(calc.auc(), 1.0)
+
+
+def test_allreduce_hook_merges_workers(rng):
+    """Simulate 2 workers; allreduce hook must reproduce single-worker AUC."""
+    table = 1 << 12
+    pred = rng.randint(0, table, 1000).astype(np.float64) / table
+    label = (rng.rand(1000) < 0.3).astype(np.int64)
+
+    whole = BasicAucCalculator()
+    whole.init(table)
+    whole.add_data(pred, label)
+    whole.compute()
+
+    w0, w1 = BasicAucCalculator(), BasicAucCalculator()
+    w0.init(table)
+    w1.init(table)
+    w0.add_data(pred[:500], label[:500])
+    w1.add_data(pred[500:], label[500:])
+
+    # fake 2-node allreduce: sum both workers' contributions
+    other = {"t": None}
+
+    def fake_allreduce_factory(mine, theirs):
+        def f(arr):
+            if arr.ndim == 2:
+                return mine._table + theirs._table
+            return np.array([
+                mine._local_abserr + theirs._local_abserr,
+                mine._local_sqrerr + theirs._local_sqrerr,
+                mine._local_pred + theirs._local_pred,
+            ])
+        return f
+
+    w0.compute(fake_allreduce_factory(w0, w1))
+    np.testing.assert_allclose(w0.auc(), whole.auc(), atol=1e-12)
+    np.testing.assert_allclose(w0.mae(), whole.mae(), atol=1e-12)
+
+
+def test_wuauc_per_user(rng):
+    calc = BasicAucCalculator()
+    calc.init(1 << 12)
+    # user 1: perfect ranking; user 2: inverted
+    uid = np.array([1, 1, 1, 1, 2, 2, 2, 2], dtype=np.uint64)
+    pred = np.array([0.9, 0.8, 0.2, 0.1, 0.1, 0.2, 0.8, 0.9])
+    label = np.array([1, 1, 0, 0, 1, 1, 0, 0])
+    calc.add_uid_data(pred, label, uid)
+    calc.compute_wuauc()
+    assert calc.user_cnt() == 2
+    np.testing.assert_allclose(calc.uauc(), 0.5)   # mean(1.0, 0.0)
+    np.testing.assert_allclose(calc.wuauc(), 0.5)  # equal ins weights
+
+
+def test_wuauc_tie_handling():
+    calc = BasicAucCalculator()
+    calc.init(1 << 12)
+    uid = np.array([7, 7, 7, 7], dtype=np.uint64)
+    pred = np.array([0.5, 0.5, 0.5, 0.5])
+    label = np.array([1, 0, 1, 0])
+    calc.add_uid_data(pred, label, uid)
+    calc.compute_wuauc()
+    np.testing.assert_allclose(calc.uauc(), 0.5, atol=1e-6)  # all ties → 0.5
+
+
+def test_nan_inf_counter():
+    calc = BasicAucCalculator()
+    calc.init(16)
+    calc.add_nan_inf_data(np.array([1.0, np.nan, np.inf, 0.5]))
+    calc.compute_nan_inf()
+    np.testing.assert_allclose(calc.nan_inf_rate(), 0.5)
+
+
+def test_metric_registry_phases():
+    reg = MetricRegistry()
+    reg.init_metric("join_auc", "label", "pred", metric_phase=1, table_size=1 << 10)
+    reg.init_metric("update_auc", "label", "pred", metric_phase=0, table_size=1 << 10)
+    reg.phase = 1
+    tensors = {
+        "pred": np.array([0.9, 0.1]),
+        "label": np.array([1, 0]),
+    }
+    reg.add_batch(tensors)
+    msg = reg.get_metric_msg("join_auc")
+    assert msg["auc"] == 1.0
+    assert msg["size"] == 2.0
+
+
+def test_bucket_error_smoke(rng):
+    """bucket_error is small for calibrated predictions, larger when biased."""
+    table = 1 << 10
+    n = 200_000
+    pred = rng.rand(n)
+    label = (rng.rand(n) < pred).astype(np.int64)  # perfectly calibrated
+    calib = BasicAucCalculator()
+    calib.init(table)
+    calib.add_data(pred, label)
+    calib.compute()
+
+    biased = BasicAucCalculator()
+    biased.init(table)
+    biased.add_data(np.clip(pred * 0.5, 0, 1), label)  # under-predicts
+    biased.compute()
+
+    assert calib.bucket_error() < 0.1
+    assert biased.bucket_error() > calib.bucket_error()
+
+
+def test_bucket_error_sparse_matches_dense_oracle(rng):
+    """sparse span-cascade scan == literal metrics.cc:345-380 transcription."""
+    n = 1 << 12
+    calc = BasicAucCalculator(n)
+    for trial in range(5):
+        neg = np.zeros(n)
+        pos = np.zeros(n)
+        # sparse clusters with long empty runs between them
+        idx = rng.choice(n, size=rng.randint(1, 200), replace=False)
+        neg[idx] = rng.randint(0, 50, idx.size)
+        pos[idx] = rng.randint(0, 10, idx.size)
+        got = calc._calculate_bucket_error(neg, pos)
+        want = calc._calculate_bucket_error_dense(neg, pos)
+        np.testing.assert_allclose(got, want, rtol=0, atol=0)
+
+
+def test_wuauc_uid_above_2_53_not_merged():
+    calc = BasicAucCalculator(1 << 10)
+    base = np.uint64(1) << np.uint64(60)
+    uid = np.array([base, base, base + np.uint64(1), base + np.uint64(1)],
+                   dtype=np.uint64)
+    pred = np.array([0.9, 0.1, 0.2, 0.8])
+    label = np.array([1, 0, 1, 0])
+    calc.add_uid_data(pred, label, uid)
+    calc.compute_wuauc()
+    assert calc.user_cnt() == 2  # float64 storage would merge them into 1
+    np.testing.assert_allclose(calc.uauc(), 0.5)
+
+
+def test_nan_inf_metric_kind():
+    reg = MetricRegistry()
+    reg.init_metric("guard", "label", "pred", table_size=16, kind="nan_inf")
+    reg.add_batch({"pred": np.array([1.0, np.nan, np.inf, 0.5]),
+                   "label": np.array([0, 0, 0, 0])})
+    msg = reg.get_metric_msg("guard")
+    assert msg == {"nan_inf_rate": 0.5}
+
+
+def test_continue_metric_kind():
+    reg = MetricRegistry()
+    reg.init_metric("q", "label", "pred", table_size=16, kind="continue")
+    pred = np.array([1.0, 2.0, 3.0])
+    label = np.array([1.5, 2.5, 2.0])
+    reg.add_batch({"pred": pred, "label": label})
+    msg = reg.get_metric_msg("q")
+    np.testing.assert_allclose(msg["mae"], np.abs(pred - label).mean())
+    np.testing.assert_allclose(msg["rmse"], np.sqrt(((pred - label) ** 2).mean()))
+    np.testing.assert_allclose(msg["predicted_value"], pred.mean())
+    np.testing.assert_allclose(msg["actual_value"], label.mean())
+    assert msg["size"] == 3.0

@@ -1,0 +1,72 @@
+import threading
+import time
+
+import pytest
+
+from paddlebox_tpu.config import flags
+from paddlebox_tpu.utils import Channel, ChannelClosed, StatRegistry, Timer, TimerScope
+
+
+def test_flags_defaults_and_set():
+    assert flags.get_flag("enable_pullpush_dedup_keys") is True
+    assert flags.get_flag("record_pool_max_size") == 2_000_000
+    flags.set_flag("record_pool_max_size", 123)
+    assert flags.get_flag("record_pool_max_size") == 123
+    flags.set_flag("record_pool_max_size", 2_000_000)
+    with pytest.raises(KeyError):
+        flags.get_flag("nonexistent_flag")
+
+
+def test_flag_redefine_rejected():
+    with pytest.raises(ValueError):
+        flags.define_flag("enable_pullpush_dedup_keys", False)
+
+
+def test_timer_accumulates():
+    t = Timer()
+    with TimerScope(t):
+        time.sleep(0.01)
+    with TimerScope(t):
+        time.sleep(0.01)
+    assert t.count == 2
+    assert 0.015 < t.elapsed_sec() < 1.0
+
+
+def test_stats():
+    reg = StatRegistry.instance()
+    reg.reset()
+    reg.add("STAT_gpu0_mem", 100)
+    reg.add("STAT_gpu0_mem", -30)
+    assert reg.get("STAT_gpu0_mem") == 70
+    assert reg.snapshot() == {"STAT_gpu0_mem": 70}
+
+
+def test_channel_mpmc_and_close():
+    ch = Channel(capacity=4)
+    results = []
+
+    def consumer():
+        for item in ch:
+            results.append(item)
+
+    threads = [threading.Thread(target=consumer) for _ in range(3)]
+    for th in threads:
+        th.start()
+    for i in range(100):
+        ch.put(i)
+    ch.close()
+    for th in threads:
+        th.join()
+    assert sorted(results) == list(range(100))
+    with pytest.raises(ChannelClosed):
+        ch.put(1)
+    with pytest.raises(ChannelClosed):
+        ch.get()
+
+
+def test_channel_get_many():
+    ch = Channel()
+    ch.put_many(range(10))
+    got = ch.get_many(4)
+    assert got == [0, 1, 2, 3]
+    assert len(ch) == 6
