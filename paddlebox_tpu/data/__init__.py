@@ -1,0 +1,14 @@
+from paddlebox_tpu.data.slot_record import SlotRecord
+from paddlebox_tpu.data.parser import MultiSlotParser
+from paddlebox_tpu.data.packer import PackedBatch, BatchPacker
+from paddlebox_tpu.data.dataset import BoxDataset
+from paddlebox_tpu.data.generator import write_synthetic_ctr_files
+
+__all__ = [
+    "SlotRecord",
+    "MultiSlotParser",
+    "PackedBatch",
+    "BatchPacker",
+    "BoxDataset",
+    "write_synthetic_ctr_files",
+]

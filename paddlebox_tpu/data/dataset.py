@@ -1,0 +1,200 @@
+"""Pass-scoped in-memory dataset with threaded load pipeline.
+
+TPU-native PadBoxSlotDataset (paddle/fluid/framework/data_set.h:438-566,
+data_set.cc:2217-2817): a pass's files are read by N threads into a channel,
+optionally shuffled across hosts (data/shuffle.py transport), merged while
+registering every feasign with the table's feed-pass agent (MergeInsKeys →
+AddKeys, data_set.cc:2291-2347), then split into equalized per-worker batch
+ranges for training (PrepareTrain, data_set.cc:2775-2817).
+
+The preload/wait split mirrors BoxHelper::PreLoadIntoMemory/WaitFeedPassDone
+(box_wrapper.h:1131-1172) so pass N+1 loads while pass N trains.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.config import flags
+from paddlebox_tpu.config.configs import DataFeedConfig
+from paddlebox_tpu.data.packer import BatchPacker, PackedBatch
+from paddlebox_tpu.data.parser import MultiSlotParser
+from paddlebox_tpu.data.slot_record import SlotRecord
+from paddlebox_tpu.utils.channel import Channel, ChannelClosed
+from paddlebox_tpu.utils.stats import stat_add
+from paddlebox_tpu.utils.timer import Timer
+
+# add_keys_fn(keys: np.ndarray) registers pass keys (PSAgent AddKeys analog)
+AddKeysFn = Callable[[np.ndarray], None]
+
+
+class BoxDataset:
+    def __init__(self, feed: DataFeedConfig, read_threads: int = 4,
+                 parser: Optional[MultiSlotParser] = None,
+                 shuffler=None) -> None:
+        self.feed = feed
+        self.read_threads = read_threads
+        self.parser = parser or MultiSlotParser(feed)
+        self.packer = BatchPacker(feed)
+        self.shuffler = shuffler  # cross-host instance shuffle transport
+        self._files: List[str] = []
+        self._records: List[SlotRecord] = []
+        self._preload_threads: List[threading.Thread] = []
+        self._merge_thread: Optional[threading.Thread] = None
+        self._channel: Optional[Channel] = None
+        self._add_keys_fn: Optional[AddKeysFn] = None
+        self._load_error: Optional[BaseException] = None
+        self.timers = {n: Timer() for n in ("read", "merge", "shuffle")}
+
+    # ------------------------------------------------------------ file list
+    def set_filelist(self, files: Sequence[str]) -> None:
+        self._files = list(files)
+
+    def my_shard_files(self, rank: int, world: int) -> List[str]:
+        """Per-rank file split (data_set.cc:1961-1973)."""
+        return [f for i, f in enumerate(self._files) if i % world == rank]
+
+    # ----------------------------------------------------------- load paths
+    def load_into_memory(self, add_keys_fn: Optional[AddKeysFn] = None) -> None:
+        self.preload_into_memory(add_keys_fn)
+        self.wait_preload_done()
+
+    def preload_into_memory(self,
+                            add_keys_fn: Optional[AddKeysFn] = None) -> None:
+        """Spawn read+merge threads; returns immediately
+        (PreLoadIntoMemory, data_set.cc:2217-2261)."""
+        if self._preload_threads:
+            raise RuntimeError("preload already running")
+        self._records = []
+        self._add_keys_fn = add_keys_fn
+        self._load_error = None
+        self._channel = Channel(capacity=64)
+        files = list(self._files)
+        lock = threading.Lock()
+        cursor = {"i": 0}
+
+        def read_worker():
+            t = self.timers["read"]
+            try:
+                while True:
+                    with lock:
+                        if cursor["i"] >= len(files):
+                            return
+                        path = files[cursor["i"]]
+                        cursor["i"] += 1
+                    t.start()
+                    batch: List[SlotRecord] = []
+                    for rec in self.parser.parse_file(path):
+                        batch.append(rec)
+                        if len(batch) >= 512:
+                            self._put_records(batch)
+                            batch = []
+                    if batch:
+                        self._put_records(batch)
+                    t.pause()
+            except BaseException as e:  # surfaced in wait_preload_done
+                self._load_error = e
+
+        def merge_worker():
+            """MergeInsKeys (data_set.cc:2291-2347): drain channel, register
+            keys with the feed-pass agent, append to the pass memory."""
+            t = self.timers["merge"]
+            try:
+                while True:
+                    try:
+                        recs = self._channel.get_many(256)
+                    except ChannelClosed:
+                        return
+                    t.start()
+                    if self._add_keys_fn is not None:
+                        keys = [r.all_keys() for r in recs]
+                        keys = [k for k in keys if k.size]
+                        if keys:
+                            self._add_keys_fn(np.concatenate(keys))
+                    self._records.extend(recs)
+                    stat_add("dataset_ins_merged", len(recs))
+                    t.pause()
+            except BaseException as e:
+                self._load_error = e
+
+        readers = [threading.Thread(target=read_worker, daemon=True)
+                   for _ in range(max(1, self.read_threads))]
+        for th in readers:
+            th.start()
+        self._preload_threads = readers
+        self._merge_thread = threading.Thread(target=merge_worker, daemon=True)
+        self._merge_thread.start()
+
+    def _put_records(self, recs: List[SlotRecord]) -> None:
+        """Route through cross-host shuffle when configured
+        (ShuffleData, data_set.cc:2438-2545)."""
+        if self.shuffler is not None and not flags.get_flag(
+                "dataset_disable_shuffle"):
+            self.shuffler.scatter(recs, self._channel)
+        else:
+            self._channel.put_many(recs)
+
+    def wait_preload_done(self) -> None:
+        """WaitFeedPassDone half: join readers, drain merge
+        (data_set.cc:2262)."""
+        for th in self._preload_threads:
+            th.join()
+        if self.shuffler is not None:
+            self.shuffler.flush(self._channel)
+        self._channel.close()
+        if self._merge_thread is not None:
+            self._merge_thread.join()
+        self._preload_threads = []
+        self._merge_thread = None
+        if self._load_error is not None:
+            raise RuntimeError("dataset load failed") from self._load_error
+
+    # -------------------------------------------------------------- train prep
+    def local_shuffle(self, seed: Optional[int] = None) -> None:
+        rng = np.random.RandomState(seed)
+        rng.shuffle(self._records)
+
+    @property
+    def records(self) -> List[SlotRecord]:
+        return self._records
+
+    def __len__(self) -> int:
+        return len(self._records)
+
+    def release_memory(self) -> None:
+        self._records = []
+
+    def split_batches(self, num_workers: int,
+                      equalize: Optional[Callable[[int], int]] = None
+                      ) -> List[List[PackedBatch]]:
+        """Equalized per-worker batch split (compute_paddlebox_thread_batch,
+        data_set.cc:2690-2755): every worker gets the SAME number of batches
+        so lockstep collectives never deadlock; short workers wrap around.
+
+        equalize: optional allreduce-max over hosts of the local batch count
+        (MPI allreduce analog); receives local count, returns global max.
+        """
+        bs = self.feed.batch_size
+        n = len(self._records)
+        per_worker = (n + num_workers - 1) // num_workers
+        local_batches = (per_worker + bs - 1) // bs if n else 0
+        target = equalize(local_batches) if equalize else local_batches
+        out: List[List[PackedBatch]] = []
+        for w in range(num_workers):
+            lo = w * per_worker
+            hi = min(lo + per_worker, n)
+            recs = self._records[lo:hi]
+            batches: List[PackedBatch] = []
+            for b in range(target):
+                chunk = recs[b * bs:(b + 1) * bs]
+                if not chunk and recs:
+                    # wrap around to equalize step counts
+                    chunk = recs[:bs]
+                if not chunk:
+                    chunk = self._records[:bs]
+                batches.append(self.packer.pack(chunk))
+            out.append(batches)
+        return out

@@ -1,0 +1,132 @@
+"""Static-shape batch packer.
+
+Analog of MiniBatchGpuPack (paddle/fluid/framework/data_feed.h:519-680 +
+data_feed.cu:1210-1388): the reference concatenates a batch's CSR slot values
+into pinned buffers, H2Ds them and scatters into per-slot LoD tensors. The
+TPU redesign flattens every sparse key of the batch into ONE fixed-capacity
+key vector plus a segment id per key (instance*num_slots + slot) — XLA gets
+fully static shapes and the model side pools with one segment-sum
+(ops/seqpool.py) instead of per-slot LoD tensors.
+
+Capacity overflow policy: keys beyond per-slot max_len are dropped (counted
+in stats), mirroring the reference's used-slot truncation behavior.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.config.configs import DataFeedConfig
+from paddlebox_tpu.data.slot_record import SlotRecord
+from paddlebox_tpu.utils.stats import stat_add
+
+
+@dataclasses.dataclass
+class PackedBatch:
+    """One static-shaped device-ready batch."""
+
+    keys: np.ndarray        # [KCAP] uint64, padding = 0
+    slots: np.ndarray       # [KCAP] int32 slot index per key
+    segments: np.ndarray    # [KCAP] int32 = ins*num_slots + slot
+    valid: np.ndarray       # [KCAP] bool
+    labels: np.ndarray      # [B] int32 (padded instances = 0)
+    ins_valid: np.ndarray   # [B] bool — False for padded instances
+    dense: Optional[np.ndarray]  # [B, dense_dim] float32 or None
+    n_ins: int              # real instances in the batch
+    # join-phase extras
+    rank_offset: Optional[np.ndarray] = None  # [B, 2*max_rank+1] int32
+    qvalues: Optional[np.ndarray] = None      # [B] float32
+
+    @property
+    def batch_size(self) -> int:
+        return self.labels.shape[0]
+
+
+class BatchPacker:
+    def __init__(self, feed: DataFeedConfig, max_rank: int = 3) -> None:
+        self.feed = feed
+        self.sparse_slots = feed.used_sparse_slots()
+        self.dense_slots = feed.used_dense_slots()
+        self.num_slots = len(self.sparse_slots)
+        self.dense_dim = sum(s.dim for s in self.dense_slots)
+        self.batch_size = feed.batch_size
+        self.kcap = feed.key_capacity()
+        self.max_rank = max_rank
+
+    def pack(self, records: Sequence[SlotRecord],
+             with_rank_offset: bool = False) -> PackedBatch:
+        B = self.batch_size
+        n = min(len(records), B)
+        keys = np.zeros(self.kcap, dtype=np.uint64)
+        slots = np.zeros(self.kcap, dtype=np.int32)
+        segments = np.zeros(self.kcap, dtype=np.int32)
+        valid = np.zeros(self.kcap, dtype=bool)
+        labels = np.zeros(B, dtype=np.int32)
+        ins_valid = np.zeros(B, dtype=bool)
+        dense = (np.zeros((B, self.dense_dim), dtype=np.float32)
+                 if self.dense_dim else None)
+        qvalues = np.zeros(B, dtype=np.float32)
+
+        w = 0
+        dropped = 0
+        for i in range(n):
+            rec = records[i]
+            labels[i] = rec.label
+            ins_valid[i] = True
+            qvalues[i] = rec.qvalue
+            for si, slot_cfg in enumerate(self.sparse_slots):
+                vals = rec.uint64_slots.get(si)
+                if vals is None or vals.size == 0:
+                    continue
+                take = min(vals.size, slot_cfg.max_len, self.kcap - w)
+                dropped += vals.size - take
+                if take <= 0:
+                    continue
+                keys[w:w + take] = vals[:take]
+                slots[w:w + take] = si
+                segments[w:w + take] = i * self.num_slots + si
+                valid[w:w + take] = True
+                w += take
+            if dense is not None:
+                off = 0
+                for fi, slot_cfg in enumerate(self.dense_slots):
+                    vals = rec.float_slots.get(fi)
+                    d = slot_cfg.dim
+                    if vals is not None:
+                        m = min(vals.size, d)
+                        dense[i, off:off + m] = vals[:m]
+                    off += d
+        if dropped:
+            stat_add("packer_keys_dropped", dropped)
+        # padding key slots point at segment 0 but are masked by valid=False
+        batch = PackedBatch(keys=keys, slots=slots, segments=segments,
+                            valid=valid, labels=labels, ins_valid=ins_valid,
+                            dense=dense, n_ins=n, qvalues=qvalues)
+        if with_rank_offset:
+            batch.rank_offset = self._build_rank_offset(records[:n], B)
+        return batch
+
+    def _build_rank_offset(self, records: Sequence[SlotRecord],
+                           B: int) -> np.ndarray:
+        """pv rank matrix (CopyRankOffsetKernel analog, data_feed.cu:1319):
+        col 0 = own rank; then (rank_of_peer, row_of_peer) pairs for each of
+        max_rank ad positions within the same pv (grouped by ins_id)."""
+        mr = self.max_rank
+        out = -np.ones((B, 2 * mr + 1), dtype=np.int32)
+        by_pv = {}
+        for row, rec in enumerate(records):
+            by_pv.setdefault(rec.ins_id, []).append(row)
+        for row, rec in enumerate(records):
+            out[row, 0] = rec.rank
+            if rec.rank <= 0 or rec.rank > mr:
+                continue
+            for peer in by_pv.get(rec.ins_id, []):
+                prank = records[peer].rank
+                if peer == row or prank <= 0 or prank > mr:
+                    continue
+                out[row, 2 * (prank - 1) + 1] = prank
+                out[row, 2 * (prank - 1) + 2] = peer
+        return out

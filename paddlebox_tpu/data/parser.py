@@ -1,0 +1,68 @@
+"""MultiSlot text parser.
+
+Parses the reference's MultiSlot instance format (data_feed.cc
+MultiSlotDataFeed/SlotRecordInMemoryDataFeed text path): one instance per
+line, slots in feed-config order, each encoded as
+    <count> <v_1> ... <v_count>
+with uint64 feasigns for sparse slots and floats for dense slots. The slot
+named "click" (or the first float slot flagged as label) doubles as the
+label. A C++ fast path (native/slot_parser.cc) implements the same contract;
+this module is the pure-Python reference implementation and fallback.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from paddlebox_tpu.config.configs import DataFeedConfig
+from paddlebox_tpu.data.slot_record import SlotRecord
+
+
+class MultiSlotParser:
+    def __init__(self, feed: DataFeedConfig, label_slot: str = "click") -> None:
+        self.feed = feed
+        self.label_slot = label_slot
+        self._slots = [s for s in feed.slots if s.is_used]
+        self._all_slots = list(feed.slots)
+
+    def parse_line(self, line: str) -> Optional[SlotRecord]:
+        toks = line.split()
+        if not toks:
+            return None
+        rec = SlotRecord()
+        pos = 0
+        u_idx = 0
+        f_idx = 0
+        try:
+            for slot in self._all_slots:
+                n = int(toks[pos])
+                pos += 1
+                vals = toks[pos:pos + n]
+                if len(vals) != n:
+                    raise ValueError(f"slot {slot.name}: expected {n} values")
+                pos += n
+                if not slot.is_used and slot.name != self.label_slot:
+                    continue
+                if slot.type == "uint64":
+                    arr = np.array([int(v) for v in vals], dtype=np.uint64)
+                    rec.uint64_slots[u_idx] = arr
+                    u_idx += 1
+                else:
+                    arr = np.array([float(v) for v in vals], dtype=np.float32)
+                    if slot.name == self.label_slot and n >= 1:
+                        rec.label = int(arr[0])
+                    if slot.is_used:
+                        rec.float_slots[f_idx] = arr
+                        f_idx += 1
+        except (ValueError, IndexError):
+            return None  # malformed line dropped, like the reference parser
+        return rec
+
+    def parse_file(self, path: str) -> Iterator[SlotRecord]:
+        with open(path, "r") as f:
+            for line in f:
+                rec = self.parse_line(line)
+                if rec is not None:
+                    yield rec

@@ -1,0 +1,49 @@
+"""Slot-record instance model.
+
+Analog of SlotRecordObject/SlotValues (paddle/fluid/framework/data_feed.h:
+97-470): one training instance = label + per-slot uint64 feasign lists +
+per-slot float features, stored compactly. The reference pools these in a
+slab allocator (SlotObjPool) to dodge malloc churn; in Python the pooling
+burden falls on the columnar batch path (records are short-lived and the
+C++ parser emits columnar arrays directly), so this class stays a plain
+__slots__ struct.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class SlotRecord:
+    __slots__ = ("label", "uint64_slots", "float_slots", "ins_id", "rank",
+                 "cmatch", "qvalue")
+
+    def __init__(self, label: int = 0,
+                 uint64_slots: Optional[Dict[int, np.ndarray]] = None,
+                 float_slots: Optional[Dict[int, np.ndarray]] = None,
+                 ins_id: str = "", rank: int = 0, cmatch: int = 0,
+                 qvalue: float = 0.0) -> None:
+        self.label = label
+        # slot index (position in feed config) → values
+        self.uint64_slots = uint64_slots or {}
+        self.float_slots = float_slots or {}
+        self.ins_id = ins_id
+        self.rank = rank      # pv join-phase rank position
+        self.cmatch = cmatch  # channel-match tag for cmatch-rank metrics
+        self.qvalue = qvalue  # PCOC q-value
+
+    def all_keys(self) -> np.ndarray:
+        if not self.uint64_slots:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate(list(self.uint64_slots.values()))
+
+    def shuffle_hash(self) -> int:
+        """Stable hash for cross-host instance shuffle routing
+        (general_shuffle_func analog, data_set.cc:2420-2436)."""
+        keys = self.all_keys()
+        if keys.size == 0:
+            return self.label
+        # cheap order-independent mix
+        return int(np.bitwise_xor.reduce(keys) % np.uint64(0x7FFFFFFF))

@@ -1,0 +1,13 @@
+from paddlebox_tpu.embedding.accessor import ValueLayout, PushLayout
+from paddlebox_tpu.embedding.optimizers import apply_push, make_push_fn
+from paddlebox_tpu.embedding.pass_table import PassTable
+from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
+
+__all__ = [
+    "ValueLayout",
+    "PushLayout",
+    "apply_push",
+    "make_push_fn",
+    "PassTable",
+    "HostEmbeddingStore",
+]

@@ -1,0 +1,170 @@
+"""Feature-value layout and lifecycle rules (the "accessor").
+
+TPU-native re-expression of CommonFeatureValueAccessor
+(paddle/fluid/framework/fleet/heter_ps/feature_value.h:42-283) and the CTR
+lifecycle rules of CtrCommonAccessor (paddle/fluid/distributed/ps/table/
+ctr_accessor.cc) — the best open spec of what libbox_ps.so stores per feature.
+
+Unlike the reference's per-feature variable-length byte blobs addressed by
+pointer, the TPU layout is a fixed-width row in a dense [capacity, width]
+float32 slab (struct-of-rows): XLA wants static shapes, and the per-pass
+working set is exactly the feed-pass key set, so rows are addressed by dense
+pass-local ids (SURVEY.md §7 "the pass table IS dense").
+
+Row columns:
+    [slot, show, click, delta_score, unseen_days, mf_size,
+     embed_w, embed_state...,
+     embedx_w[D], embedx_state...]
+
+State widths depend on the optimizer (optimizer.cuh.h):
+    adagrad:     embed_state=1 (g2sum),          embedx_state=1 (shared g2sum)
+    adam:        embed_state=4 (m,v,b1p,b2p),    embedx_state=2D+2
+    adam_shared: embed_state=4,                  embedx_state=4
+    naive:       embed_state=0,                  embedx_state=0
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import numpy as np
+
+from paddlebox_tpu.config.configs import SparseOptimizerConfig, TableConfig
+
+# fixed header columns
+SLOT = 0
+SHOW = 1
+CLICK = 2
+DELTA_SCORE = 3
+UNSEEN_DAYS = 4
+MF_SIZE = 5
+EMBED_W = 6
+_HEADER = 7  # embed_state starts here
+
+
+def _state_widths(optimizer: str, embedx_dim: int) -> Tuple[int, int]:
+    if optimizer == "adagrad":
+        return 1, 1
+    if optimizer == "adam":
+        return 4, 2 * embedx_dim + 2
+    if optimizer == "adam_shared":
+        return 4, 4
+    if optimizer == "naive":
+        return 0, 0
+    raise ValueError(f"unknown sparse optimizer {optimizer!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class ValueLayout:
+    """Column map for one table; hashable so jitted fns can close over it."""
+
+    embedx_dim: int
+    optimizer: str = "adagrad"
+
+    @property
+    def embed_state_dim(self) -> int:
+        return _state_widths(self.optimizer, self.embedx_dim)[0]
+
+    @property
+    def embedx_state_dim(self) -> int:
+        return _state_widths(self.optimizer, self.embedx_dim)[1]
+
+    @property
+    def embed_state(self) -> int:  # start col of embed optimizer state
+        return _HEADER
+
+    @property
+    def embedx_w(self) -> int:
+        return _HEADER + self.embed_state_dim
+
+    @property
+    def embedx_state(self) -> int:
+        return self.embedx_w + self.embedx_dim
+
+    @property
+    def width(self) -> int:
+        return self.embedx_state + self.embedx_state_dim
+
+    # pull view: [show, click, embed_w, embedx_w...]  (CVM columns first, the
+    # order PullCopy emits — box_wrapper.cu:75-120)
+    @property
+    def pull_dim(self) -> int:
+        return 3 + self.embedx_dim
+
+    def new_rows(self, n: int, rng: np.random.RandomState,
+                 conf: SparseOptimizerConfig) -> np.ndarray:
+        """Fresh feature init (mirrors accessor create: embed_w uniform in
+        ±initial_range, embedx deferred until mf threshold)."""
+        rows = np.zeros((n, self.width), dtype=np.float32)
+        if conf.initial_range:
+            rows[:, EMBED_W] = rng.uniform(
+                -conf.initial_range, conf.initial_range, n)
+        if self.optimizer in ("adam", "adam_shared"):
+            # beta pow columns start at 1.0*beta on first use; the reference
+            # initializes them at creation via update_lr's multiply; store the
+            # decay rates directly (optimizer.cuh.h:286-289 analog)
+            es = self.embed_state
+            rows[:, es + 2] = conf.beta1_decay_rate
+            rows[:, es + 3] = conf.beta2_decay_rate
+            xs = self.embedx_state
+            if self.optimizer == "adam":
+                rows[:, xs + 2 * self.embedx_dim] = conf.beta1_decay_rate
+                rows[:, xs + 2 * self.embedx_dim + 1] = conf.beta2_decay_rate
+            else:
+                rows[:, xs + 2] = conf.beta1_decay_rate
+                rows[:, xs + 3] = conf.beta2_decay_rate
+        return rows
+
+    # ----------------------------------------------------------- lifecycle
+    def show_click_score(self, show, click, conf: SparseOptimizerConfig):
+        """CtrCommonAccessor::ShowClickScore: nonclk_coeff*(show-click) +
+        clk_coeff*click."""
+        return conf.nonclk_coeff * (show - click) + conf.clk_coeff * click
+
+    def shrink_mask(self, values: np.ndarray, table: TableConfig) -> np.ndarray:
+        """Day-cadence decay + delete decision (ctr_accessor.cc:63-79).
+
+        Mutates show/click in place (time decay) and returns a bool mask of
+        rows to DELETE."""
+        conf = table.optimizer
+        values[:, SHOW] *= table.show_click_decay_rate
+        values[:, CLICK] *= table.show_click_decay_rate
+        score = self.show_click_score(values[:, SHOW], values[:, CLICK], conf)
+        return ((score < table.delete_threshold)
+                | (values[:, UNSEEN_DAYS] > table.delete_after_unseen_days))
+
+    def update_stat_after_save(self, values: np.ndarray, table: TableConfig,
+                               param: int) -> None:
+        """UpdateStatAfterSave (ctr_accessor.cc:101-128): param 1 = clear
+        delta score of rows covered by a delta save; 3 = age unseen_days."""
+        conf = table.optimizer
+        if param == 1:
+            score = self.show_click_score(values[:, SHOW], values[:, CLICK], conf)
+            covered = ((score >= table.base_threshold)
+                       & (values[:, DELTA_SCORE] >= table.delta_threshold)
+                       & (values[:, UNSEEN_DAYS] <= table.delta_keep_days))
+            values[covered, DELTA_SCORE] = 0.0
+        elif param == 3:
+            values[:, UNSEEN_DAYS] += 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class PushLayout:
+    """Per-key gradient row: [slot, show, click, embed_g, embedx_g[D]]
+    (CommonPushValue, feature_value.h:176-…)."""
+
+    embedx_dim: int
+
+    SLOT = 0
+    SHOW = 1
+    CLICK = 2
+    EMBED_G = 3
+
+    @property
+    def embedx_g(self) -> int:
+        return 4
+
+    @property
+    def width(self) -> int:
+        return 4 + self.embedx_dim

@@ -1,0 +1,236 @@
+"""Host-DRAM embedding store: the full (beyond-HBM) tier of the table.
+
+Role of the closed BoxPS host/SSD tiers and of the open MemorySparseTable
+(paddle/fluid/distributed/ps/table/memory_sparse_table.cc): holds every
+feature ever seen; each pass's working set is looked up (creating missing
+features) into a dense slab for the device, and written back at end of pass.
+Python+numpy implementation first; the C++ native store (native/host_store.cc)
+slots in behind the same interface (see use_native flag).
+
+Also implements the SSD spill tier contract (SSDSparseTable analog): least
+recently seen rows beyond a DRAM budget are spilled to per-shard files and
+faulted back on lookup (LoadSSD2Mem analog: load_spilled()).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.config.configs import TableConfig
+from paddlebox_tpu.embedding.accessor import ValueLayout, UNSEEN_DAYS
+from paddlebox_tpu.utils.stats import stat_add
+
+_GROW = 1 << 16
+
+
+class HostEmbeddingStore:
+    """key (uint64 feasign) → fixed-width float32 row.
+
+    Storage = one growable [cap, width] array + key→row index + free list,
+    so whole-pass lookups/writebacks are vectorized numpy, not per-key loops.
+    """
+
+    def __init__(self, layout: ValueLayout, table: TableConfig,
+                 seed: int = 0) -> None:
+        self.layout = layout
+        self.table = table
+        self._rng = np.random.RandomState(seed)
+        self._index: Dict[int, int] = {}
+        self._values = np.zeros((_GROW, layout.width), dtype=np.float32)
+        self._free: List[int] = list(range(_GROW - 1, -1, -1))
+        self._lock = threading.RLock()
+        # SSD spill tier
+        self._spill_dir = table.ssd_dir
+        self._spilled: Dict[int, Tuple[str, int]] = {}  # key -> (file, offset row)
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    # ------------------------------------------------------------- internal
+    def _grow(self, need: int) -> None:
+        old = self._values.shape[0]
+        new = old
+        while new - old + len(self._free) < need:
+            new += max(_GROW, old // 2)
+        if new > old:
+            self._values = np.vstack(
+                [self._values,
+                 np.zeros((new - old, self.layout.width), np.float32)])
+            self._free.extend(range(new - 1, old - 1, -1))
+
+    # ------------------------------------------------------------------ api
+    def lookup_or_create(self, keys: np.ndarray) -> np.ndarray:
+        """Vectorized fetch of rows for unique uint64 keys, creating missing
+        features with accessor init (feed-pass promote, BuildPull analog)."""
+        keys = np.asarray(keys, dtype=np.uint64)
+        with self._lock:
+            rows = np.empty(keys.size, dtype=np.int64)
+            missing: List[int] = []
+            idx = self._index
+            for i, k in enumerate(keys.tolist()):
+                r = idx.get(k, -1)
+                rows[i] = r
+                if r < 0:
+                    missing.append(i)
+            if missing:
+                # fault back any spilled keys first
+                if self._spilled:
+                    still_missing = []
+                    for i in missing:
+                        k = int(keys[i])
+                        if k in self._spilled:
+                            rows[i] = self._fault_in(k)
+                        else:
+                            still_missing.append(i)
+                    missing = still_missing
+            if missing:
+                self._grow(len(missing))
+                init = self.layout.new_rows(len(missing), self._rng,
+                                            self.table.optimizer)
+                for j, i in enumerate(missing):
+                    r = self._free.pop()
+                    idx[int(keys[i])] = r
+                    self._values[r] = init[j]
+                    rows[i] = r
+                stat_add("sparse_keys_created", len(missing))
+            return self._values[rows].copy()
+
+    def write_back(self, keys: np.ndarray, values: np.ndarray) -> None:
+        """End-of-pass HBM→host dump (EndPass / dump_to_cpu analog)."""
+        keys = np.asarray(keys, dtype=np.uint64)
+        with self._lock:
+            rows = np.fromiter((self._index[int(k)] for k in keys.tolist()),
+                               dtype=np.int64, count=keys.size)
+            self._values[rows] = values
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        """Inference-mode fetch: missing keys read as zero rows (SetTestMode
+        pulls don't create features)."""
+        keys = np.asarray(keys, dtype=np.uint64)
+        out = np.zeros((keys.size, self.layout.width), dtype=np.float32)
+        with self._lock:
+            for i, k in enumerate(keys.tolist()):
+                r = self._index.get(k, -1)
+                if r >= 0:
+                    out[i] = self._values[r]
+                elif k in self._spilled:
+                    out[i] = self._values[self._fault_in(k)]
+        return out
+
+    # ------------------------------------------------------------ lifecycle
+    def shrink(self) -> int:
+        """ShrinkTable: decay show/click and delete dead features
+        (ctr_accessor.cc:63-79 via layout.shrink_mask). Returns deletions."""
+        with self._lock:
+            if not self._index:
+                return 0
+            keys = np.fromiter(self._index.keys(), dtype=np.uint64,
+                               count=len(self._index))
+            rows = np.fromiter(self._index.values(), dtype=np.int64,
+                               count=len(self._index))
+            view = self._values[rows]
+            mask = self.layout.shrink_mask(view, self.table)
+            self._values[rows] = view  # decay writeback
+            dead = np.nonzero(mask)[0]
+            for i in dead.tolist():
+                r = self._index.pop(int(keys[i]))
+                self._values[r] = 0.0
+                self._free.append(r)
+            stat_add("sparse_keys_shrunk", int(dead.size))
+            return int(dead.size)
+
+    def age_unseen_days(self) -> None:
+        with self._lock:
+            rows = np.fromiter(self._index.values(), dtype=np.int64,
+                               count=len(self._index))
+            if rows.size:
+                self._values[rows, UNSEEN_DAYS] += 1.0
+
+    # ----------------------------------------------------------- SSD tier
+    def spill(self, max_resident: int) -> int:
+        """Spill oldest-unseen rows beyond max_resident to the SSD tier
+        (SSDSparseTable / CheckNeedLimitMem+ShrinkResource analog)."""
+        if not self._spill_dir:
+            return 0
+        with self._lock:
+            excess = len(self._index) - max_resident
+            if excess <= 0:
+                return 0
+            os.makedirs(self._spill_dir, exist_ok=True)
+            keys = np.fromiter(self._index.keys(), dtype=np.uint64,
+                               count=len(self._index))
+            rows = np.fromiter(self._index.values(), dtype=np.int64,
+                               count=len(self._index))
+            unseen = self._values[rows, UNSEEN_DAYS]
+            order = np.argsort(-unseen, kind="stable")[:excess]
+            fname = os.path.join(
+                self._spill_dir, f"spill_{len(self._spilled):08d}.npy")
+            block = self._values[rows[order]]
+            np.save(fname, block)
+            for off, i in enumerate(order.tolist()):
+                k = int(keys[i])
+                r = self._index.pop(k)
+                self._spilled[k] = (fname, off)
+                self._values[r] = 0.0
+                self._free.append(r)
+            stat_add("sparse_keys_spilled", excess)
+            return excess
+
+    def _fault_in(self, key: int) -> int:
+        fname, off = self._spilled.pop(key)
+        row_data = np.load(fname, mmap_mode="r")[off]
+        self._grow(1)
+        r = self._free.pop()
+        self._values[r] = row_data
+        self._index[key] = r
+        stat_add("sparse_keys_faulted_in", 1)
+        return r
+
+    def load_spilled(self) -> int:
+        """LoadSSD2Mem(day): promote every spilled row back to DRAM."""
+        n = 0
+        for k in list(self._spilled.keys()):
+            self._fault_in(k)
+            n += 1
+        return n
+
+    # ---------------------------------------------------------- checkpoint
+    def state_items(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(keys, values) of all resident features, for checkpointing."""
+        with self._lock:
+            keys = np.fromiter(self._index.keys(), dtype=np.uint64,
+                               count=len(self._index))
+            rows = np.fromiter(self._index.values(), dtype=np.int64,
+                               count=len(self._index))
+            return keys, self._values[rows].copy()
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        keys, values = self.state_items()
+        with open(path, "wb") as f:
+            pickle.dump({"keys": keys, "values": values,
+                         "embedx_dim": self.layout.embedx_dim,
+                         "optimizer": self.layout.optimizer}, f,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+    def load(self, path: str) -> None:
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        if blob["embedx_dim"] != self.layout.embedx_dim or \
+                blob["optimizer"] != self.layout.optimizer:
+            raise ValueError("checkpoint layout mismatch")
+        with self._lock:
+            self._index.clear()
+            self._free = list(range(self._values.shape[0] - 1, -1, -1))
+            self._values[:] = 0.0
+            keys, values = blob["keys"], blob["values"]
+            self._grow(keys.size)
+            for i, k in enumerate(keys.tolist()):
+                r = self._free.pop()
+                self._index[k] = r
+                self._values[r] = values[i]

@@ -1,0 +1,231 @@
+"""Pass-lifecycle sparse table: the TPU-native BoxPS core.
+
+Re-design of the reconstructed boxps::BoxPSBase contract (SURVEY.md, every
+call site in box_wrapper.{h,cc}) around XLA's static-shape model:
+
+  BeginFeedPass/AddKeys/EndFeedPass  → collect the pass's key set, assign
+        DENSE pass-local ids (sorted-unique + searchsorted, replacing the
+        device hash table: the feed pass gives the exact working set, so the
+        pass table IS dense — the insight behind BeginFeedPass)
+  BeginPass  → promote host rows → device HBM slab  [capacity, width]
+  PullSparse → gather rows by id (keys pre-translated to ids at pack time,
+        so DedupKeysAndFillIdx becomes a host-side searchsorted)
+  PushSparse → per-batch id-dedup (jnp.unique, static size) → segment-sum
+        gradient merge → in-table optimizer → scatter rows back
+  EndPass    → slab → host write-back (+ optional delta save hook)
+
+The last slab row (capacity-1) is a reserved trash row addressed by padding
+ids; its values never reach the host store.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.config.configs import TableConfig
+from paddlebox_tpu.embedding import accessor as acc
+from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
+from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
+from paddlebox_tpu.embedding.optimizers import apply_push
+from paddlebox_tpu.utils.timer import Timer
+
+
+@functools.partial(jax.jit, static_argnames=("layout",))
+def _pull_kernel(slab: jnp.ndarray, ids: jnp.ndarray,
+                 layout: ValueLayout) -> jnp.ndarray:
+    """Gather pull view [show, click, embed_w, embedx...] per key
+    (PullCopy semantics, box_wrapper.cu:75-120). Padding ids hit the trash
+    row; callers mask by segment validity downstream."""
+    rows = slab[ids]
+    D = layout.embedx_dim
+    xw0 = layout.embedx_w
+    return jnp.concatenate([
+        rows[:, acc.SHOW:acc.SHOW + 1],
+        rows[:, acc.CLICK:acc.CLICK + 1],
+        rows[:, acc.EMBED_W:acc.EMBED_W + 1],
+        rows[:, xw0:xw0 + D],
+    ], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("layout", "conf"))
+def _push_kernel(slab: jnp.ndarray, ids: jnp.ndarray, grads: jnp.ndarray,
+                 prng: jax.Array, layout: ValueLayout, conf) -> jnp.ndarray:
+    """jit wrapper over the dedup-merge-optimize-scatter push."""
+    from paddlebox_tpu.embedding.optimizers import push_sparse_dedup
+    return push_sparse_dedup(slab, ids, grads, prng, layout, conf)
+
+
+class PassTable:
+    """Single-shard (one-device or host-replicated) sparse table with the
+    BoxPS pass lifecycle. The pod-sharded variant composes these per shard
+    (parallel/sharded table)."""
+
+    def __init__(self, table: TableConfig, seed: int = 0,
+                 store: Optional[HostEmbeddingStore] = None) -> None:
+        self.config = table
+        self.layout = ValueLayout(table.embedx_dim, table.optimizer.optimizer)
+        self.push_layout = PushLayout(table.embedx_dim)
+        self.store = store or HostEmbeddingStore(self.layout, table, seed)
+        self.capacity = table.pass_capacity
+        self._feed_keys: list = []
+        self._pass_keys: Optional[np.ndarray] = None  # sorted unique
+        self._slab: Optional[jnp.ndarray] = None
+        self._in_feed_pass = False
+        self._in_pass = False
+        self._test_mode = False
+        self._prng = jax.random.PRNGKey(seed)
+        self.timers = {name: Timer() for name in
+                       ("feed", "build", "pull", "push", "end")}
+
+    # ------------------------------------------------------- pass lifecycle
+    def begin_feed_pass(self) -> None:
+        """BeginFeedPass (box_wrapper.cc:129): open key registration."""
+        if self._in_feed_pass:
+            raise RuntimeError("feed pass already open")
+        self._feed_keys = []
+        self._in_feed_pass = True
+
+    def add_keys(self, keys: np.ndarray) -> None:
+        """PSAgentBase::AddKeys (box_wrapper.h:1218): register feasigns seen
+        in the incoming pass. Thread-safe append (list.append is atomic)."""
+        if not self._in_feed_pass:
+            raise RuntimeError("add_keys outside feed pass")
+        self._feed_keys.append(np.asarray(keys, dtype=np.uint64))
+
+    def end_feed_pass(self) -> None:
+        """EndFeedPass (box_wrapper.cc:153): freeze the pass key set and
+        assign dense ids 0..n-1 (sorted order)."""
+        if not self._in_feed_pass:
+            raise RuntimeError("end_feed_pass without begin_feed_pass")
+        with_timer = self.timers["feed"]
+        with_timer.start()
+        if self._feed_keys:
+            all_keys = np.concatenate(self._feed_keys)
+            self._pass_keys = np.unique(all_keys)  # sorted unique
+        else:
+            self._pass_keys = np.empty(0, dtype=np.uint64)
+        if self._pass_keys.size > self.capacity - 1:
+            raise RuntimeError(
+                f"pass working set {self._pass_keys.size} exceeds table "
+                f"pass_capacity {self.capacity} (raise TableConfig.pass_capacity)")
+        self._feed_keys = []
+        self._in_feed_pass = False
+        with_timer.pause()
+
+    def begin_pass(self) -> None:
+        """BeginPass (box_wrapper.cc:171): promote the working set into the
+        device slab."""
+        if self._in_pass:
+            raise RuntimeError("pass already open")
+        if self._pass_keys is None:
+            raise RuntimeError("begin_pass before feed pass completed")
+        t = self.timers["build"]
+        t.start()
+        n = self._pass_keys.size
+        host_rows = (self.store.lookup(self._pass_keys) if self._test_mode
+                     else self.store.lookup_or_create(self._pass_keys))
+        slab = np.zeros((self.capacity, self.layout.width), dtype=np.float32)
+        if n:
+            slab[:n] = host_rows
+        self._slab = jnp.asarray(slab)
+        self._in_pass = True
+        t.pause()
+
+    def end_pass(self) -> None:
+        """EndPass (box_wrapper.cc:188): write the slab back to the host
+        store and drop the HBM working set."""
+        if not self._in_pass:
+            raise RuntimeError("end_pass without begin_pass")
+        t = self.timers["end"]
+        t.start()
+        n = self._pass_keys.size
+        if n and not self._test_mode:
+            host = np.asarray(self._slab[:n])
+            self.store.write_back(self._pass_keys, host)
+        self._slab = None
+        self._in_pass = False
+        t.pause()
+
+    def set_test_mode(self, test: bool) -> None:
+        """SetTestMode (box_wrapper.cc:183): inference pulls — no feature
+        creation, no write-back."""
+        self._test_mode = test
+
+    # ------------------------------------------------------------- id space
+    @property
+    def pass_size(self) -> int:
+        return 0 if self._pass_keys is None else int(self._pass_keys.size)
+
+    @property
+    def padding_id(self) -> int:
+        return self.capacity - 1
+
+    def lookup_ids(self, keys: np.ndarray) -> np.ndarray:
+        """Translate feasign keys → dense pass-local ids (host-side analog of
+        DedupKeysAndFillIdx: sorted-unique key set + searchsorted)."""
+        keys = np.asarray(keys, dtype=np.uint64)
+        if self._pass_keys is None:
+            raise RuntimeError("no active pass key set")
+        ids = np.searchsorted(self._pass_keys, keys)
+        ids = np.minimum(ids, max(self._pass_keys.size - 1, 0))
+        if self._pass_keys.size:
+            hit = self._pass_keys[ids] == keys
+        else:
+            hit = np.zeros(keys.shape, bool)
+        if not hit.all():
+            missing = keys[~hit][:5]
+            raise KeyError(
+                f"keys not registered in feed pass (first few: {missing})")
+        return ids.astype(np.int32)
+
+    # ------------------------------------------------------------ pull/push
+    def pull(self, ids: jnp.ndarray) -> jnp.ndarray:
+        """PullSparseGPU analog: per-key pull view [K, 3+D]."""
+        if not self._in_pass:
+            raise RuntimeError("pull outside pass")
+        t = self.timers["pull"]
+        t.start()
+        out = _pull_kernel(self._slab, ids, self.layout)
+        t.pause()
+        return out
+
+    def push(self, ids: jnp.ndarray, grads: jnp.ndarray) -> None:
+        """PushSparseGPU analog: merged grads through the in-table optimizer."""
+        if not self._in_pass:
+            raise RuntimeError("push outside pass")
+        if self._test_mode:
+            return
+        t = self.timers["push"]
+        t.start()
+        self._prng, sub = jax.random.split(self._prng)
+        self._slab = _push_kernel(self._slab, ids, grads, sub,
+                                  self.layout, self.config.optimizer)
+        t.pause()
+
+    # raw access for fused train steps that thread the slab functionally
+    @property
+    def slab(self) -> jnp.ndarray:
+        return self._slab
+
+    def set_slab(self, slab: jnp.ndarray) -> None:
+        self._slab = slab
+
+    def next_prng(self) -> jax.Array:
+        self._prng, sub = jax.random.split(self._prng)
+        return sub
+
+    # ------------------------------------------------------------ lifecycle
+    def shrink_table(self) -> int:
+        """ShrinkTable (box_wrapper.h:627): decay + delete on the host tier."""
+        return self.store.shrink()
+
+    def save(self, path: str) -> None:
+        self.store.save(path)
+
+    def load(self, path: str) -> None:
+        self.store.load(path)

@@ -1,0 +1,19 @@
+from paddlebox_tpu.models.layers import mlp_init, mlp_apply
+from paddlebox_tpu.models.ctr_dnn import CtrDnn
+from paddlebox_tpu.models.deepfm import DeepFM
+from paddlebox_tpu.models.wide_deep import WideDeep
+from paddlebox_tpu.models.dlrm import DLRM
+from paddlebox_tpu.models.mmoe import MMoE
+from paddlebox_tpu.models.esmm import ESMM
+
+MODEL_ZOO = {
+    "ctr_dnn": CtrDnn,
+    "deepfm": DeepFM,
+    "wide_deep": WideDeep,
+    "dlrm": DLRM,
+    "mmoe": MMoE,
+    "esmm": ESMM,
+}
+
+__all__ = ["mlp_init", "mlp_apply", "CtrDnn", "DeepFM", "WideDeep", "DLRM",
+           "MMoE", "ESMM", "MODEL_ZOO"]

@@ -1,0 +1,38 @@
+"""Model protocol shared by the CTR zoo.
+
+Every model is a stateless pair (init, apply):
+    init(rng) -> params pytree
+    apply(params, pooled, dense) -> logits
+        pooled: [B, num_slots, slot_dim] fused seqpool+CVM output
+                (slot_dim = 3+embedx_dim with CVM columns)
+        dense:  [B, dense_dim] float32 or None
+        logits: [B] (single task) or dict[str, [B]] (multi-task)
+Multi-task models also expose task_names.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Logits = Union[jnp.ndarray, Dict[str, jnp.ndarray]]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """Static shape info every model needs at init time."""
+
+    num_slots: int
+    slot_dim: int          # per-slot pooled width (3+embedx_dim with CVM)
+    dense_dim: int = 0
+
+    @property
+    def sparse_in(self) -> int:
+        return self.num_slots * self.slot_dim
+
+    @property
+    def total_in(self) -> int:
+        return self.sparse_in + self.dense_dim

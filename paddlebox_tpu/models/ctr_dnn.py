@@ -1,0 +1,37 @@
+"""CTR-DNN: the canonical slot-embedding → seqpool+CVM → MLP ranking model.
+
+Baseline config 1 of BASELINE.json; structurally the model built by the
+reference's test_boxps.py graph (emb via _pull_box_sparse → sum-pool → cvm →
+fc stack → sigmoid, python/paddle/fluid/tests/unittests/test_boxps.py:87-103
+and ctr_dataset_reader-style examples)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.models.layers import mlp_apply, mlp_init
+
+
+class CtrDnn:
+    name = "ctr_dnn"
+    task_names = ("ctr",)
+
+    def __init__(self, spec: ModelSpec,
+                 hidden: Sequence[int] = (512, 256, 128)) -> None:
+        self.spec = spec
+        self.hidden = tuple(hidden)
+
+    def init(self, rng: jax.Array) -> Dict:
+        dims = [self.spec.total_in, *self.hidden, 1]
+        return mlp_init(rng, dims, "dnn")
+
+    def apply(self, params: Dict, pooled: jnp.ndarray,
+              dense: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        x = pooled.reshape(pooled.shape[0], -1)
+        if dense is not None:
+            x = jnp.concatenate([x, dense], axis=-1)
+        return mlp_apply(params, x, "dnn")[:, 0]

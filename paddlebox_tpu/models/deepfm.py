@@ -1,0 +1,53 @@
+"""DeepFM: factorization machine + deep tower over shared slot embeddings.
+
+BASELINE.json config 2 (DeepFM on Criteo-TB). The FM second-order term uses
+the standard (sum^2 - sum-of-squares)/2 identity over per-slot embedx
+vectors; first-order comes from the pooled embed_w column. All-matmul —
+MXU-friendly."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.models.layers import mlp_apply, mlp_init
+
+
+class DeepFM:
+    name = "deepfm"
+    task_names = ("ctr",)
+
+    def __init__(self, spec: ModelSpec,
+                 hidden: Sequence[int] = (400, 400, 400),
+                 embedx_dim: int = None) -> None:
+        self.spec = spec
+        self.hidden = tuple(hidden)
+        # pooled slot layout: [log_show, log_ctr, embed_w, embedx...(D)]
+        self.embedx_dim = (embedx_dim if embedx_dim is not None
+                           else spec.slot_dim - 3)
+
+    def init(self, rng: jax.Array) -> Dict:
+        k1, k2 = jax.random.split(rng)
+        params = mlp_init(k1, [self.spec.total_in, *self.hidden, 1], "deep")
+        params["fm_out_w"] = (jax.random.normal(k2, (3,)) * 0.1).astype(
+            jnp.float32)
+        params["fm_out_b"] = jnp.zeros((), jnp.float32)
+        return params
+
+    def apply(self, params: Dict, pooled: jnp.ndarray,
+              dense: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        B = pooled.shape[0]
+        D = self.embedx_dim
+        first_order = pooled[:, :, 2].sum(axis=1)          # Σ slot embed_w
+        v = pooled[:, :, 3:3 + D]                          # [B, S, D]
+        sum_v = v.sum(axis=1)
+        fm2 = 0.5 * (sum_v * sum_v - (v * v).sum(axis=1)).sum(axis=-1)
+        x = pooled.reshape(B, -1)
+        if dense is not None:
+            x = jnp.concatenate([x, dense], axis=-1)
+        deep = mlp_apply(params, x, "deep")[:, 0]
+        stack = jnp.stack([first_order, fm2, deep], axis=-1)
+        return stack @ params["fm_out_w"] + params["fm_out_b"]

@@ -1,0 +1,53 @@
+"""DLRM (MLPerf-rec shape): bottom MLP on dense features, pairwise dot
+feature interactions between dense output and per-slot embedx vectors,
+top MLP on [bottom, interactions]."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.models.layers import mlp_apply, mlp_init
+
+
+class DLRM:
+    name = "dlrm"
+    task_names = ("ctr",)
+
+    def __init__(self, spec: ModelSpec,
+                 bottom: Sequence[int] = (128, 64),
+                 top: Sequence[int] = (256, 128)) -> None:
+        self.spec = spec
+        self.embedx_dim = spec.slot_dim - 3
+        self.bottom = tuple(bottom) + (self.embedx_dim,)
+        self.top = tuple(top)
+
+    def init(self, rng: jax.Array) -> Dict:
+        k1, k2 = jax.random.split(rng)
+        params = {}
+        if self.spec.dense_dim:
+            params.update(mlp_init(
+                k1, [self.spec.dense_dim, *self.bottom], "bot"))
+        S = self.spec.num_slots + (1 if self.spec.dense_dim else 0)
+        n_inter = S * (S - 1) // 2
+        top_in = n_inter + (self.embedx_dim if self.spec.dense_dim else 0)
+        params.update(mlp_init(k2, [top_in, *self.top, 1], "top"))
+        return params
+
+    def apply(self, params: Dict, pooled: jnp.ndarray,
+              dense: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        B = pooled.shape[0]
+        feats = pooled[:, :, 3:]                      # [B, S, D]
+        if dense is not None and self.spec.dense_dim:
+            bot = mlp_apply(params, dense, "bot", final_act=True)  # [B, D]
+            feats = jnp.concatenate([feats, bot[:, None, :]], axis=1)
+        inter = jnp.einsum("bsd,btd->bst", feats, feats)  # [B, S, S]
+        S = feats.shape[1]
+        iu, ju = jnp.triu_indices(S, k=1)
+        x = inter[:, iu, ju]                          # [B, S(S-1)/2]
+        if dense is not None and self.spec.dense_dim:
+            x = jnp.concatenate([x, bot], axis=-1)
+        return mlp_apply(params, x, "top")[:, 0]

@@ -1,0 +1,43 @@
+"""ESMM: entire-space multi-task CTR+CVR model (BASELINE.json config 4).
+
+Two towers over shared embeddings; pCTCVR = pCTR * pCVR trains the CVR tower
+on the full impression space. apply returns logits for 'ctr' and 'cvr'; the
+trainer composes pctcvr = sigmoid(ctr_logit)*sigmoid(cvr_logit) for its
+metric/loss (ESMM loss = BCE(ctr, click) + BCE(ctcvr, pay))."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.models.layers import mlp_apply, mlp_init
+
+
+class ESMM:
+    name = "esmm"
+    task_names = ("ctr", "cvr")
+
+    def __init__(self, spec: ModelSpec,
+                 tower: Sequence[int] = (256, 128, 64)) -> None:
+        self.spec = spec
+        self.tower = tuple(tower)
+
+    def init(self, rng: jax.Array) -> Dict:
+        k1, k2 = jax.random.split(rng)
+        params = {}
+        params.update(mlp_init(k1, [self.spec.total_in, *self.tower, 1], "ctr"))
+        params.update(mlp_init(k2, [self.spec.total_in, *self.tower, 1], "cvr"))
+        return params
+
+    def apply(self, params: Dict, pooled: jnp.ndarray,
+              dense: Optional[jnp.ndarray] = None) -> Dict[str, jnp.ndarray]:
+        x = pooled.reshape(pooled.shape[0], -1)
+        if dense is not None:
+            x = jnp.concatenate([x, dense], axis=-1)
+        return {
+            "ctr": mlp_apply(params, x, "ctr")[:, 0],
+            "cvr": mlp_apply(params, x, "cvr")[:, 0],
+        }

@@ -1,0 +1,43 @@
+"""Functional dense building blocks.
+
+Plain param-pytree functions (no flax dependency in the hot path): params are
+dicts of jnp arrays, so pjit sharding rules and the ZeRO-1 partitioner
+(parallel/sharding.py) can address every leaf by name. Matmul-heavy by
+design — everything lowers onto the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def mlp_init(rng: jax.Array, dims: Sequence[int], name: str = "mlp") -> Dict:
+    """He-init MLP params: dims = [in, h1, ..., out]."""
+    params = {}
+    keys = jax.random.split(rng, len(dims) - 1)
+    for i, (din, dout) in enumerate(zip(dims[:-1], dims[1:])):
+        params[f"{name}_w{i}"] = (jax.random.normal(keys[i], (din, dout))
+                                  * jnp.sqrt(2.0 / din)).astype(jnp.float32)
+        params[f"{name}_b{i}"] = jnp.zeros((dout,), jnp.float32)
+    return params
+
+
+def mlp_apply(params: Dict, x: jnp.ndarray, name: str = "mlp",
+              act: Callable = jax.nn.relu, final_act: bool = False) -> jnp.ndarray:
+    i = 0
+    while f"{name}_w{i}" in params:
+        x = x @ params[f"{name}_w{i}"] + params[f"{name}_b{i}"]
+        if final_act or f"{name}_w{i+1}" in params:
+            x = act(x)
+        i += 1
+    return x
+
+
+def num_layers(params: Dict, name: str = "mlp") -> int:
+    i = 0
+    while f"{name}_w{i}" in params:
+        i += 1
+    return i

@@ -1,0 +1,60 @@
+"""MMoE: multi-gate mixture-of-experts multi-task ranking (BASELINE.json
+config 4). Experts share the pooled slot embeddings; per-task softmax gates
+mix expert outputs into task towers. Expert matmuls are batched with einsum
+so XLA maps them onto the MXU as one big contraction."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.models.layers import mlp_apply, mlp_init
+
+
+class MMoE:
+    name = "mmoe"
+
+    def __init__(self, spec: ModelSpec, num_experts: int = 4,
+                 expert_dim: int = 64,
+                 tasks: Tuple[str, ...] = ("ctr", "cvr"),
+                 tower: Sequence[int] = (32,)) -> None:
+        self.spec = spec
+        self.num_experts = num_experts
+        self.expert_dim = expert_dim
+        self.task_names = tasks
+        self.tower = tuple(tower)
+
+    def init(self, rng: jax.Array) -> Dict:
+        keys = jax.random.split(rng, 2 + len(self.task_names))
+        din = self.spec.total_in
+        E, H = self.num_experts, self.expert_dim
+        params = {
+            "expert_w": (jax.random.normal(keys[0], (E, din, H))
+                         * jnp.sqrt(2.0 / din)).astype(jnp.float32),
+            "expert_b": jnp.zeros((E, H), jnp.float32),
+            "gate_w": (jax.random.normal(keys[1], (len(self.task_names), din, E))
+                       * 0.01).astype(jnp.float32),
+        }
+        for i, t in enumerate(self.task_names):
+            params.update(mlp_init(keys[2 + i], [H, *self.tower, 1],
+                                   f"tower_{t}"))
+        return params
+
+    def apply(self, params: Dict, pooled: jnp.ndarray,
+              dense: Optional[jnp.ndarray] = None) -> Dict[str, jnp.ndarray]:
+        x = pooled.reshape(pooled.shape[0], -1)
+        if dense is not None:
+            x = jnp.concatenate([x, dense], axis=-1)
+        experts = jax.nn.relu(
+            jnp.einsum("bi,eih->beh", x, params["expert_w"])
+            + params["expert_b"])                          # [B, E, H]
+        gates = jax.nn.softmax(
+            jnp.einsum("bi,tie->bte", x, params["gate_w"]), axis=-1)
+        mixed = jnp.einsum("bte,beh->bth", gates, experts)  # [B, T, H]
+        out = {}
+        for i, t in enumerate(self.task_names):
+            out[t] = mlp_apply(params, mixed[:, i], f"tower_{t}")[:, 0]
+        return out

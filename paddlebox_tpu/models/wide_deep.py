@@ -1,0 +1,46 @@
+"""Wide&Deep: linear (wide) head over pooled slot stats + deep MLP tower.
+
+BASELINE.json config 3 companion; the wide part consumes the CVM + embed_w
+columns per slot (the memorization path), the deep part the full pooled
+embedding."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.models.layers import mlp_apply, mlp_init
+
+
+class WideDeep:
+    name = "wide_deep"
+    task_names = ("ctr",)
+
+    def __init__(self, spec: ModelSpec,
+                 hidden: Sequence[int] = (256, 128, 64)) -> None:
+        self.spec = spec
+        self.hidden = tuple(hidden)
+
+    def init(self, rng: jax.Array) -> Dict:
+        k1, k2 = jax.random.split(rng)
+        params = mlp_init(k1, [self.spec.total_in, *self.hidden, 1], "deep")
+        wide_in = self.spec.num_slots * 3 + self.spec.dense_dim
+        params["wide_w"] = (jax.random.normal(k2, (wide_in, 1))
+                            * 0.01).astype(jnp.float32)
+        params["wide_b"] = jnp.zeros((1,), jnp.float32)
+        return params
+
+    def apply(self, params: Dict, pooled: jnp.ndarray,
+              dense: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        B = pooled.shape[0]
+        wide_x = pooled[:, :, :3].reshape(B, -1)
+        deep_x = pooled.reshape(B, -1)
+        if dense is not None:
+            wide_x = jnp.concatenate([wide_x, dense], axis=-1)
+            deep_x = jnp.concatenate([deep_x, dense], axis=-1)
+        wide = (wide_x @ params["wide_w"] + params["wide_b"])[:, 0]
+        deep = mlp_apply(params, deep_x, "deep")[:, 0]
+        return wide + deep

@@ -1,0 +1,17 @@
+from paddlebox_tpu.ops.sparse import (
+    pull_sparse,
+    build_push_grads,
+    pull_sparse_differentiable,
+)
+from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm, cvm_transform
+from paddlebox_tpu.ops.data_norm import data_norm, data_norm_summary_update
+
+__all__ = [
+    "pull_sparse",
+    "build_push_grads",
+    "pull_sparse_differentiable",
+    "fused_seqpool_cvm",
+    "cvm_transform",
+    "data_norm",
+    "data_norm_summary_update",
+]

@@ -1,0 +1,84 @@
+"""Streaming feature normalization (data_norm).
+
+TPU-native data_norm_op (paddle/fluid/operators/data_norm_op.cc): normalizes
+each feature column by running summary statistics (BatchSize/BatchSum/
+BatchSquareSum), the "summary" params that BoxPSWorker syncs with the
+DenseDataNormal mode (boxps_worker.cc:89-95, 389-391).
+
+Forward (data_norm_op.cc:327-355):
+    mean  = batch_sum / batch_size
+    scale = sqrt(batch_size / batch_square_sum)
+    y     = (x - mean) * scale
+slot_dim > 0 adds the show-skip rule: within each slot_dim block, instances
+whose first column (show) is ~0 emit zeros.
+
+Summary update: the reference routes summary grads through the optimizer with
+a decay (summary_decay_rate); data_norm_summary_update applies the same
+running-sums rule functionally.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+_MIN_PRECISION = 1e-7
+
+
+class DataNormState(NamedTuple):
+    """Per-column summary params; init mirrors the reference's defaults
+    (batch_size=1e4, square_sum=1e4·eps-ish kept simple as ones)."""
+
+    batch_size: jnp.ndarray
+    batch_sum: jnp.ndarray
+    batch_square_sum: jnp.ndarray
+
+    @classmethod
+    def init(cls, dim: int, init_batch_size: float = 1e4) -> "DataNormState":
+        return cls(
+            batch_size=jnp.full((dim,), init_batch_size, jnp.float32),
+            batch_sum=jnp.zeros((dim,), jnp.float32),
+            batch_square_sum=jnp.full((dim,), init_batch_size, jnp.float32),
+        )
+
+
+def data_norm(x: jnp.ndarray, state: DataNormState,
+              slot_dim: int = 0) -> jnp.ndarray:
+    """x: [N, C] → normalized y: [N, C]."""
+    mean = state.batch_sum / state.batch_size
+    scale = jnp.sqrt(state.batch_size / state.batch_square_sum)
+    y = (x - mean) * scale
+    if slot_dim > 0:
+        C = x.shape[-1]
+        shows = x[:, 0::slot_dim]  # first col of each slot block
+        block_alive = jnp.abs(shows) >= _MIN_PRECISION  # [N, C/slot_dim]
+        alive = jnp.repeat(block_alive, slot_dim, axis=1)[:, :C]
+        y = jnp.where(alive, y, 0.0)
+    return y
+
+
+def data_norm_summary_update(state: DataNormState, x: jnp.ndarray,
+                             decay: float = 0.9999999,
+                             slot_dim: int = 0) -> DataNormState:
+    """Accumulate this batch into the running summaries with decay
+    (summary_decay_rate semantics). With slot_dim, dead blocks (show≈0)
+    contribute nothing, matching the show-skip rule."""
+    mean = state.batch_sum / state.batch_size
+    sq = (x - mean) ** 2
+    if slot_dim > 0:
+        C = x.shape[-1]
+        shows = x[:, 0::slot_dim]
+        block_alive = jnp.abs(shows) >= _MIN_PRECISION
+        alive = jnp.repeat(block_alive, slot_dim, axis=1)[:, :C]
+        cnt = alive.sum(axis=0).astype(jnp.float32)
+        xs = jnp.where(alive, x, 0.0)
+        sq = jnp.where(alive, sq, 0.0)
+    else:
+        cnt = jnp.full((x.shape[-1],), float(x.shape[0]), jnp.float32)
+        xs = x
+    return DataNormState(
+        batch_size=state.batch_size * decay + cnt,
+        batch_sum=state.batch_sum * decay + xs.sum(axis=0),
+        batch_square_sum=state.batch_square_sum * decay + sq.sum(axis=0),
+    )

@@ -1,0 +1,49 @@
+"""Fused per-slot sequence pooling + CVM transform.
+
+TPU-native fused_seqpool_cvm (paddle/fluid/operators/fused/
+fused_seqpool_cvm_op.*): the reference fuses "sum-pool each slot's
+variable-length key list, then handle the CVM (show/click) columns" across
+all slots in one CUDA kernel — the main dense-side fusion in CTR models.
+Here the same fusion is one XLA segment-sum over the flattened key axis
+followed by the CVM log transform; XLA fuses the rest into the surrounding
+matmuls. The batch packer pre-computes segment ids (instance*num_slots+slot),
+which replaces the LoD machinery with static shapes.
+
+CVM columns follow cvm_op.h: y0 = log(show+1), y1 = log(click+1) - y0.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cvm_transform(pooled: jnp.ndarray, use_cvm: bool = True) -> jnp.ndarray:
+    """pooled: [..., 2+E] with cols [show, click, emb...] → CVM columns
+    (cvm_op.h semantics). use_cvm=False drops the two counter columns
+    (CVMOpKernel's else-branch keeps dims-2)."""
+    show = pooled[..., 0:1]
+    click = pooled[..., 1:2]
+    rest = pooled[..., 2:]
+    if not use_cvm:
+        return rest
+    log_show = jnp.log(show + 1.0)
+    log_ctr = jnp.log(click + 1.0) - log_show
+    return jnp.concatenate([log_show, log_ctr, rest], axis=-1)
+
+
+def fused_seqpool_cvm(emb: jnp.ndarray, segments: jnp.ndarray,
+                      valid: jnp.ndarray, batch_size: int, num_slots: int,
+                      use_cvm: bool = True,
+                      pad_empty_zero: bool = True) -> jnp.ndarray:
+    """emb: [K, 2+E] per-key pull view; segments: [K] = ins*num_slots+slot;
+    valid: [K] bool. Returns [batch, num_slots, out_dim] where out_dim is
+    2+E with CVM or E without.
+
+    Empty slots pool to zero (need_filter/padding_value=0 behavior of the
+    reference kernel)."""
+    masked = jnp.where(valid[:, None], emb, 0.0)
+    pooled = jax.ops.segment_sum(
+        masked, segments, num_segments=batch_size * num_slots)
+    pooled = pooled.reshape(batch_size, num_slots, emb.shape[-1])
+    return cvm_transform(pooled, use_cvm)

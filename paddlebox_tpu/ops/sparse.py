@@ -1,0 +1,90 @@
+"""Differentiable sparse pull/push ops.
+
+TPU-native pull_box_sparse / push_box_sparse
+(paddle/fluid/operators/pull_box_sparse_op.{cc,h,cu}): the forward is a row
+gather from the pass slab producing the per-key pull view
+[show, click, embed_w, embedx...]; the backward is NOT a dense slab gradient
+but a push-gradient construction (the grad-op-maker wires push as the
+backward, pull_box_sparse_op.cc:128-141).
+
+Two integration styles:
+  * explicit (recommended, mirrors the reference worker loop): the train step
+    calls pull_sparse(), differentiates the dense model w.r.t. the pulled
+    embeddings, then builds push grads with build_push_grads() and applies
+    them via the table's push kernel. Keeps the slab out of autodiff.
+  * full-graph: pull_sparse_differentiable() is a custom_vjp whose cotangent
+    w.r.t. the slab is a scatter-add — lets jax.grad flow end-to-end when a
+    model wants that (costs a dense slab-shaped cotangent).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.embedding import accessor as acc
+from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
+
+
+def pull_sparse(slab: jnp.ndarray, ids: jnp.ndarray,
+                layout: ValueLayout) -> jnp.ndarray:
+    """Gather per-key pull view [K, 3+D]: show, click, embed_w, embedx."""
+    rows = slab[ids]
+    D = layout.embedx_dim
+    xw0 = layout.embedx_w
+    return jnp.concatenate([
+        rows[:, acc.SHOW:acc.SHOW + 1],
+        rows[:, acc.CLICK:acc.CLICK + 1],
+        rows[:, acc.EMBED_W:acc.EMBED_W + 1],
+        rows[:, xw0:xw0 + D],
+    ], axis=1)
+
+
+def build_push_grads(d_emb: jnp.ndarray, slots: jnp.ndarray,
+                     clicks: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Per-key push rows [K, 4+D] from the model's embedding cotangent.
+
+    d_emb:  [K, 3+D] cotangent of the pull view (cols 0/1 — show/click CVM
+            inputs — are dropped, as PushCopy skips the cvm offset,
+            box_wrapper.cu:344-…)
+    slots:  [K] slot id per key
+    clicks: [K] the instance label each key occurrence belongs to
+    valid:  [K] bool — False for padding key slots
+    g_show is 1 per occurrence; the table's push kernel segment-sums
+    duplicates so a key seen in k instances gets g_show=k (PushMergeCopy).
+    """
+    v = valid.astype(d_emb.dtype)[:, None]
+    return jnp.concatenate([
+        slots.astype(d_emb.dtype)[:, None],
+        v,                                     # show = 1 per occurrence
+        clicks.astype(d_emb.dtype)[:, None] * v,
+        d_emb[:, 2:] * v,                      # embed_g + embedx_g
+    ], axis=1)
+
+
+# ---------------------------------------------------------------- full graph
+import functools
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def pull_sparse_differentiable(slab, ids, layout: ValueLayout):
+    return pull_sparse(slab, ids, layout)
+
+
+def _pull_fwd(slab, ids, layout):
+    return pull_sparse(slab, ids, layout), (ids, slab.shape)
+
+
+def _pull_bwd(layout, res, d_out):
+    ids, slab_shape = res
+    D = layout.embedx_dim
+    d_slab = jnp.zeros(slab_shape, d_out.dtype)
+    # scatter-add only the trainable columns; show/click cotangents dropped
+    d_slab = d_slab.at[ids, acc.EMBED_W].add(d_out[:, 2])
+    xw0 = layout.embedx_w
+    d_slab = d_slab.at[jnp.expand_dims(ids, 1),
+                       jnp.arange(xw0, xw0 + D)[None, :]].add(d_out[:, 3:])
+    return d_slab, None
+
+
+pull_sparse_differentiable.defvjp(_pull_fwd, _pull_bwd)

@@ -1,0 +1,4 @@
+from paddlebox_tpu.train.trainer import BoxTrainer, TrainStepFns
+from paddlebox_tpu.train.checkpoint import CheckpointManager
+
+__all__ = ["BoxTrainer", "TrainStepFns", "CheckpointManager"]

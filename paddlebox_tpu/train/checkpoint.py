@@ -1,0 +1,130 @@
+"""Two-tier, pass-cadenced checkpointing.
+
+SaveBase/SaveDelta semantics (box_wrapper.cc:1286-1318; pybind
+box_helper_py.cc:81-90): a **batch model** is the full training state
+(sparse store incl. optimizer stats + dense params + dense opt state) used
+for resume, and an **xbox model** is the inference/serving view (per key:
+embed_w + embedx only). save_delta writes just the features whose
+delta_score crossed delta_threshold since the last save, then clears their
+delta scores (UpdateStatAfterSave param=1, ctr_accessor.cc:101-125).
+Dense params are saved with the batch model (the reference uses standard
+fluid persistable saves; here one pickle of the jax pytree).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.config.configs import CheckpointConfig, TableConfig
+from paddlebox_tpu.embedding import accessor as acc
+from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
+from paddlebox_tpu.embedding.pass_table import PassTable
+
+
+class CheckpointManager:
+    def __init__(self, cfg: CheckpointConfig, table: PassTable) -> None:
+        self.cfg = cfg
+        self.table = table
+        self._save_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ batch tier
+    def save_base(self, params: Any, opt_state: Any, day: str,
+                  extra: Optional[Dict] = None) -> Tuple[str, str]:
+        """Full save → (batch_path, xbox_path)."""
+        self.wait()
+        batch_dir = os.path.join(self.cfg.batch_model_dir, day)
+        xbox_dir = os.path.join(self.cfg.xbox_model_dir, day)
+        os.makedirs(batch_dir, exist_ok=True)
+        os.makedirs(xbox_dir, exist_ok=True)
+
+        def do_save():
+            self.table.store.save(os.path.join(batch_dir, "sparse.pkl"))
+            with open(os.path.join(batch_dir, "dense.pkl"), "wb") as f:
+                pickle.dump({"params": params, "opt_state": opt_state,
+                             "extra": extra or {}}, f)
+            self._write_xbox(xbox_dir, base=True)
+            # a base save covers everything: clear delta scores + age days
+            keys, values = self.table.store.state_items()
+            self.table.layout.update_stat_after_save(values, self.table.config, 1)
+            self.table.layout.update_stat_after_save(values, self.table.config, 3)
+            if keys.size:
+                self.table.store.write_back(keys, values)
+            with open(os.path.join(batch_dir, "DONE"), "w") as f:
+                f.write(str(time.time()))
+
+        if self.cfg.async_save:
+            self._save_thread = threading.Thread(target=do_save, daemon=True)
+            self._save_thread.start()
+        else:
+            do_save()
+        return batch_dir, xbox_dir
+
+    def save_delta(self, day: str, delta_id: int) -> str:
+        """Incremental serving save of features with delta_score >=
+        delta_threshold (SaveDelta, box_wrapper.cc:1309)."""
+        self.wait()
+        xbox_dir = os.path.join(self.cfg.xbox_model_dir, day,
+                                f"delta-{delta_id}")
+        os.makedirs(xbox_dir, exist_ok=True)
+
+        def do_save():
+            self._write_xbox(xbox_dir, base=False)
+
+        if self.cfg.async_save:
+            self._save_thread = threading.Thread(target=do_save, daemon=True)
+            self._save_thread.start()
+        else:
+            do_save()
+        return xbox_dir
+
+    def _write_xbox(self, xbox_dir: str, base: bool) -> None:
+        """Serving view: key → [embed_w, embedx...] for created features."""
+        layout = self.table.layout
+        tcfg = self.table.config
+        keys, values = self.table.store.state_items()
+        if keys.size:
+            if base:
+                keep = np.ones(keys.size, bool)
+            else:
+                keep = values[:, acc.DELTA_SCORE] >= tcfg.delta_threshold
+            keys_out = keys[keep]
+            vals = values[keep]
+            D = layout.embedx_dim
+            emb = np.concatenate([
+                vals[:, acc.EMBED_W:acc.EMBED_W + 1],
+                vals[:, layout.embedx_w:layout.embedx_w + D],
+            ], axis=1)
+            if not base:
+                # clearing covered rows' delta (UpdateStatAfterSave param=1)
+                layout.update_stat_after_save(values, tcfg, 1)
+                self.table.store.write_back(keys, values)
+        else:
+            keys_out = keys
+            emb = np.empty((0, 1 + layout.embedx_dim), np.float32)
+        with open(os.path.join(xbox_dir, "embedding.pkl"), "wb") as f:
+            pickle.dump({"keys": keys_out, "embedding": emb}, f)
+        with open(os.path.join(xbox_dir, "DONE"), "w") as f:
+            f.write(str(time.time()))
+
+    # ---------------------------------------------------------------- resume
+    def load_base(self, day: str) -> Tuple[Any, Any, Dict]:
+        """Resume from a batch model (initialize_gpu_and_load_model analog,
+        box_wrapper.cc:1201)."""
+        batch_dir = os.path.join(self.cfg.batch_model_dir, day)
+        if not os.path.exists(os.path.join(batch_dir, "DONE")):
+            raise FileNotFoundError(f"no completed checkpoint at {batch_dir}")
+        self.table.store.load(os.path.join(batch_dir, "sparse.pkl"))
+        with open(os.path.join(batch_dir, "dense.pkl"), "rb") as f:
+            blob = pickle.load(f)
+        return blob["params"], blob["opt_state"], blob["extra"]
+
+    def wait(self) -> None:
+        if self._save_thread is not None:
+            self._save_thread.join()
+            self._save_thread = None

@@ -1,12 +1,20 @@
 """Test env: force CPU backend with 8 virtual devices so multi-chip sharding
 paths (mesh/pjit/shard_map/all_to_all) are exercised without TPU hardware —
-the multi-host-sim test tier called for by SURVEY.md §4."""
+the multi-host-sim test tier called for by SURVEY.md §4.
+
+Gotcha: the ambient axon sitecustomize calls
+jax.config.update("jax_platforms", "axon,cpu") at interpreter start, which
+overrides the JAX_PLATFORMS env var — so we must update the config again
+here, before any backend is initialized."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

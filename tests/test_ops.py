@@ -1,0 +1,114 @@
+"""Op-level unit tests vs numpy oracles (OpTest-style, SURVEY.md §4)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.ops import (cvm_transform, data_norm,
+                               data_norm_summary_update, fused_seqpool_cvm,
+                               pull_sparse, pull_sparse_differentiable)
+from paddlebox_tpu.ops.data_norm import DataNormState
+from paddlebox_tpu.embedding.accessor import ValueLayout
+from paddlebox_tpu.embedding import accessor as acc
+
+D = 4
+LAYOUT = ValueLayout(D, "adagrad")
+
+
+def test_cvm_transform_matches_cvm_op():
+    pooled = jnp.asarray(np.array([[3.0, 1.0, 0.5, 0.2],
+                                   [0.0, 0.0, 1.0, -1.0]], np.float32))
+    y = np.asarray(cvm_transform(pooled, use_cvm=True))
+    np.testing.assert_allclose(y[:, 0], np.log(pooled[:, 0] + 1), rtol=1e-6)
+    np.testing.assert_allclose(
+        y[:, 1], np.log(pooled[:, 1] + 1) - np.log(pooled[:, 0] + 1), rtol=1e-6)
+    np.testing.assert_allclose(y[:, 2:], pooled[:, 2:])
+    y2 = np.asarray(cvm_transform(pooled, use_cvm=False))
+    np.testing.assert_allclose(y2, pooled[:, 2:])
+
+
+def test_fused_seqpool_cvm_pools_per_segment():
+    B, S, E = 2, 3, 2 + 3  # show, click, 3 emb dims
+    # 4 keys: ins0/slot0 ×2, ins0/slot2, ins1/slot1; one padding
+    emb = jnp.asarray(np.arange(5 * E, dtype=np.float32).reshape(5, E))
+    segments = jnp.asarray(np.array([0, 0, 2, 4, 0], np.int32))
+    valid = jnp.asarray(np.array([1, 1, 1, 1, 0], bool))
+    out = np.asarray(fused_seqpool_cvm(emb, segments, valid, B, S,
+                                       use_cvm=False))
+    assert out.shape == (B, S, 3)
+    np.testing.assert_allclose(out[0, 0], emb[0, 2:] + emb[1, 2:])
+    np.testing.assert_allclose(out[0, 2], emb[2, 2:])
+    np.testing.assert_allclose(out[1, 1], emb[3, 2:])
+    np.testing.assert_allclose(out[0, 1], 0.0)  # empty slot pools to zero
+    # padding key (valid=0, segment 0) must NOT pollute segment 0
+    emb_bad = emb.at[4].set(999.0)
+    out2 = np.asarray(fused_seqpool_cvm(emb_bad, segments, valid, B, S,
+                                        use_cvm=False))
+    np.testing.assert_allclose(out2[0, 0], out[0, 0])
+
+
+def test_data_norm_forward_oracle():
+    N, C = 8, 6
+    rng = np.random.RandomState(0)
+    x = rng.randn(N, C).astype(np.float32)
+    st = DataNormState(
+        batch_size=jnp.asarray(rng.rand(C).astype(np.float32) + 1),
+        batch_sum=jnp.asarray(rng.randn(C).astype(np.float32)),
+        batch_square_sum=jnp.asarray(rng.rand(C).astype(np.float32) + 1))
+    y = np.asarray(data_norm(jnp.asarray(x), st))
+    mean = np.asarray(st.batch_sum) / np.asarray(st.batch_size)
+    scale = np.sqrt(np.asarray(st.batch_size) / np.asarray(st.batch_square_sum))
+    np.testing.assert_allclose(y, (x - mean) * scale, rtol=1e-5)
+
+
+def test_data_norm_slot_dim_show_skip():
+    # 2 slots × slot_dim 3; instance 1's slot 0 has show=0 → zeros
+    x = np.ones((2, 6), np.float32)
+    x[1, 0] = 0.0
+    st = DataNormState.init(6)
+    y = np.asarray(data_norm(jnp.asarray(x), st, slot_dim=3))
+    assert (y[1, :3] == 0).all()
+    assert (y[0] != 0).any()
+
+
+def test_data_norm_summary_update_accumulates():
+    st = DataNormState.init(2, init_batch_size=10.0)
+    x = jnp.asarray(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    st2 = data_norm_summary_update(st, x, decay=1.0)
+    np.testing.assert_allclose(np.asarray(st2.batch_size), [12.0, 12.0])
+    np.testing.assert_allclose(np.asarray(st2.batch_sum), [4.0, 6.0])
+
+
+def test_pull_sparse_differentiable_scatter_add():
+    cap = 16
+    slab = jnp.asarray(np.random.RandomState(0).rand(
+        cap, LAYOUT.width).astype(np.float32))
+    ids = jnp.asarray(np.array([3, 3, 7], np.int32))
+
+    def loss(slab):
+        emb = pull_sparse_differentiable(slab, ids, LAYOUT)
+        return (emb[:, 2] ** 2).sum() + emb[:, 3:].sum()
+
+    g = jax.grad(loss)(slab)
+    g = np.asarray(g)
+    # embed_w grad: duplicate id 3 accumulates 2*w each = 2 rows of 2w
+    np.testing.assert_allclose(g[3, acc.EMBED_W],
+                               2 * 2 * slab[3, acc.EMBED_W], rtol=1e-5)
+    np.testing.assert_allclose(g[7, acc.EMBED_W],
+                               2 * slab[7, acc.EMBED_W], rtol=1e-5)
+    xw0 = LAYOUT.embedx_w
+    np.testing.assert_allclose(g[3, xw0:xw0 + D], 2.0)  # dup id → 2×1
+    np.testing.assert_allclose(g[7, xw0:xw0 + D], 1.0)
+    # untouched rows zero grad; show/click columns never receive grads
+    assert g[0].sum() == 0
+    assert g[3, acc.SHOW] == 0 and g[3, acc.CLICK] == 0
+
+
+def test_pull_matches_differentiable_forward():
+    cap = 8
+    slab = jnp.asarray(np.random.RandomState(1).rand(
+        cap, LAYOUT.width).astype(np.float32))
+    ids = jnp.asarray(np.array([0, 5], np.int32))
+    np.testing.assert_array_equal(
+        np.asarray(pull_sparse(slab, ids, LAYOUT)),
+        np.asarray(pull_sparse_differentiable(slab, ids, LAYOUT)))
