@@ -1,0 +1,116 @@
+"""Benchmark: fused sparse train-step throughput (examples/sec) on one chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}. The
+reference publishes no measured numbers (BASELINE.md), so vs_baseline is
+measured against this repo's own recorded first baseline (BENCH_SELF_BASELINE
+below) — >1.0 means faster than the first recorded round.
+
+Workload: DeepFM over 32 sparse slots, batch 1024, ~12 keys/instance,
+1M-row pass slab — the single-chip analog of the BoxPS hot loop
+(pull → seqpool+CVM → fwd/bwd → dense adam → dedup push with in-table
+adagrad). Steady-state steps after compile+warmup.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+# examples/sec recorded on the round-1 chip (v5e via axon); update when the
+# workload definition changes, never for code speedups.
+BENCH_SELF_BASELINE = float(os.environ.get("PBTPU_BENCH_BASELINE", "0") or 0)
+
+D = 8
+NUM_SLOTS = 32
+BATCH = 1024
+MAX_LEN = 4
+PASS_CAP = 1 << 20
+STEPS = 30
+WARMUP = 5
+
+
+def make_batch(rng, feed):
+    from paddlebox_tpu.data.packer import BatchPacker
+    from paddlebox_tpu.data.slot_record import SlotRecord
+
+    packer = BatchPacker(feed)
+    recs = []
+    for _ in range(feed.batch_size):
+        slots = {}
+        for si in range(NUM_SLOTS):
+            n = rng.randint(1, MAX_LEN + 1)
+            feas = (rng.randint(0, 1 << 22, n).astype(np.uint64)
+                    * np.uint64(NUM_SLOTS) + np.uint64(si))
+            slots[si] = feas
+        recs.append(SlotRecord(label=int(rng.rand() < 0.25),
+                               uint64_slots=slots))
+    return packer.pack(recs)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
+                                              TableConfig, TrainerConfig)
+    from paddlebox_tpu.data.generator import default_feed_config
+    from paddlebox_tpu.models.base import ModelSpec
+    from paddlebox_tpu.models.deepfm import DeepFM
+    from paddlebox_tpu.train.trainer import BoxTrainer
+
+    feed = default_feed_config(num_slots=NUM_SLOTS, batch_size=BATCH,
+                               max_len=MAX_LEN)
+    table_cfg = TableConfig(
+        embedx_dim=D, pass_capacity=PASS_CAP,
+        optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                        mf_initial_range=1e-3))
+    spec = ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + D)
+    model = DeepFM(spec, hidden=(512, 256, 128))
+    trainer = BoxTrainer(model, table_cfg, feed,
+                         TrainerConfig(dense_lr=1e-3), seed=0)
+
+    rng = np.random.RandomState(0)
+    n_batches = 8
+    batches = [make_batch(rng, feed) for _ in range(n_batches)]
+
+    trainer.table.begin_feed_pass()
+    for b in batches:
+        trainer.table.add_keys(b.keys[b.valid])
+    trainer.table.end_feed_pass()
+    trainer.table.begin_pass()
+
+    dev_batches = []
+    for b in batches:
+        ids = trainer.table.lookup_ids(b.keys, b.valid)
+        dev_batches.append(trainer.device_batch(b, ids))
+
+    def one_step(i):
+        nonlocal_state["slab"], trainer.params, trainer.opt_state, loss, _ = \
+            trainer.fns.step(nonlocal_state["slab"], trainer.params,
+                             trainer.opt_state, dev_batches[i % n_batches],
+                             trainer.table.next_prng())
+        return loss
+
+    nonlocal_state = {"slab": trainer.table.slab}
+    for i in range(WARMUP):
+        loss = one_step(i)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        loss = one_step(i)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    eps = STEPS * BATCH / dt
+
+    vs = eps / BENCH_SELF_BASELINE if BENCH_SELF_BASELINE > 0 else 1.0
+    print(json.dumps({
+        "metric": "deepfm_sparse_train_examples_per_sec_per_chip",
+        "value": round(eps, 1),
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -119,6 +119,14 @@ class BoxDataset:
                     t.pause()
             except BaseException as e:
                 self._load_error = e
+                # keep draining so blocked readers can finish instead of
+                # deadlocking on the bounded channel; error surfaces in
+                # wait_preload_done
+                try:
+                    while True:
+                        self._channel.get_many(256)
+                except ChannelClosed:
+                    pass
 
         readers = [threading.Thread(target=read_worker, daemon=True)
                    for _ in range(max(1, self.read_threads))]

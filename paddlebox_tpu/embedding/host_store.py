@@ -47,6 +47,7 @@ class HostEmbeddingStore:
         # SSD spill tier
         self._spill_dir = table.ssd_dir
         self._spilled: Dict[int, Tuple[str, int]] = {}  # key -> (file, offset row)
+        self._spill_seq = 0  # monotonic file id (len(_spilled) can shrink)
 
     def __len__(self) -> int:
         return len(self._index)
@@ -169,7 +170,8 @@ class HostEmbeddingStore:
             unseen = self._values[rows, UNSEEN_DAYS]
             order = np.argsort(-unseen, kind="stable")[:excess]
             fname = os.path.join(
-                self._spill_dir, f"spill_{len(self._spilled):08d}.npy")
+                self._spill_dir, f"spill_{self._spill_seq:08d}.npy")
+            self._spill_seq += 1
             block = self._values[rows[order]]
             np.save(fname, block)
             for off, i in enumerate(order.tolist()):
@@ -226,6 +228,7 @@ class HostEmbeddingStore:
             raise ValueError("checkpoint layout mismatch")
         with self._lock:
             self._index.clear()
+            self._spilled.clear()  # stale spill entries must not resurrect
             self._free = list(range(self._values.shape[0] - 1, -1, -1))
             self._values[:] = 0.0
             keys, values = blob["keys"], blob["values"]

@@ -41,15 +41,8 @@ def _pull_kernel(slab: jnp.ndarray, ids: jnp.ndarray,
     """Gather pull view [show, click, embed_w, embedx...] per key
     (PullCopy semantics, box_wrapper.cu:75-120). Padding ids hit the trash
     row; callers mask by segment validity downstream."""
-    rows = slab[ids]
-    D = layout.embedx_dim
-    xw0 = layout.embedx_w
-    return jnp.concatenate([
-        rows[:, acc.SHOW:acc.SHOW + 1],
-        rows[:, acc.CLICK:acc.CLICK + 1],
-        rows[:, acc.EMBED_W:acc.EMBED_W + 1],
-        rows[:, xw0:xw0 + D],
-    ], axis=1)
+    from paddlebox_tpu.ops.sparse import pull_sparse  # lazy: avoids cycle
+    return pull_sparse(slab, ids, layout)
 
 
 @functools.partial(jax.jit, static_argnames=("layout", "conf"))
@@ -165,9 +158,11 @@ class PassTable:
     def padding_id(self) -> int:
         return self.capacity - 1
 
-    def lookup_ids(self, keys: np.ndarray) -> np.ndarray:
+    def lookup_ids(self, keys: np.ndarray,
+                   valid: Optional[np.ndarray] = None) -> np.ndarray:
         """Translate feasign keys → dense pass-local ids (host-side analog of
-        DedupKeysAndFillIdx: sorted-unique key set + searchsorted)."""
+        DedupKeysAndFillIdx: sorted-unique key set + searchsorted). Positions
+        where ``valid`` is False (packer padding) map to the trash row."""
         keys = np.asarray(keys, dtype=np.uint64)
         if self._pass_keys is None:
             raise RuntimeError("no active pass key set")
@@ -177,6 +172,9 @@ class PassTable:
             hit = self._pass_keys[ids] == keys
         else:
             hit = np.zeros(keys.shape, bool)
+        if valid is not None:
+            ids = np.where(valid, ids, self.padding_id)
+            hit = hit | ~valid
         if not hit.all():
             missing = keys[~hit][:5]
             raise KeyError(

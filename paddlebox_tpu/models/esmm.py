@@ -1,9 +1,11 @@
 """ESMM: entire-space multi-task CTR+CVR model (BASELINE.json config 4).
 
-Two towers over shared embeddings; pCTCVR = pCTR * pCVR trains the CVR tower
-on the full impression space. apply returns logits for 'ctr' and 'cvr'; the
-trainer composes pctcvr = sigmoid(ctr_logit)*sigmoid(cvr_logit) for its
-metric/loss (ESMM loss = BCE(ctr, click) + BCE(ctcvr, pay))."""
+Two towers over shared embeddings. apply returns logits for 'ctr' and 'cvr';
+loss_mode="esmm" makes the trainer compose pCTCVR = pCTR·pCVR and train
+BCE(click, pCTR) + BCE(conversion, pCTCVR) over the whole impression space
+(train/trainer.py:_multi_task_loss). The batch's labels_cvr field carries
+the conversion/pay label (defaults to click when the data has only one
+label)."""
 
 from __future__ import annotations
 
@@ -19,6 +21,7 @@ from paddlebox_tpu.models.layers import mlp_apply, mlp_init
 class ESMM:
     name = "esmm"
     task_names = ("ctr", "cvr")
+    loss_mode = "esmm"
 
     def __init__(self, spec: ModelSpec,
                  tower: Sequence[int] = (256, 128, 64)) -> None:

@@ -1,0 +1,11 @@
+from paddlebox_tpu.parallel.mesh import make_mesh, device_mesh_1d
+from paddlebox_tpu.parallel.sharded_table import ShardedPassTable, ShardedBatchIndex
+from paddlebox_tpu.parallel.sharded_trainer import ShardedBoxTrainer
+
+__all__ = [
+    "make_mesh",
+    "device_mesh_1d",
+    "ShardedPassTable",
+    "ShardedBatchIndex",
+    "ShardedBoxTrainer",
+]

@@ -1,0 +1,48 @@
+"""Device mesh construction.
+
+The TPU-native replacement for the reference's device/comm topology plumbing
+(HeterPsResource per-GPU stream grids, NCCLCommContext ring ids): one
+jax.sharding.Mesh names the axes and XLA lays collectives onto ICI.
+
+The BoxPS topology is 1D: every device holds a table shard AND trains a
+data shard (boxps_trainer.cc one-worker-per-GPU + key-mod table sharding).
+device_mesh_1d reproduces that; make_mesh builds the general (data, model,
+pipeline) meshes for the wider parallelism surface (§2.8).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from paddlebox_tpu.config.configs import MeshConfig
+
+# the 1D axis that is both data- and table-shard-parallel, like BoxPS
+BOX_AXIS = "dp"
+
+
+def device_mesh_1d(n_devices: Optional[int] = None,
+                   axis: str = BOX_AXIS) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def make_mesh(cfg: MeshConfig) -> Mesh:
+    devs = np.array(jax.devices())
+    sizes = []
+    names = []
+    for name, size in zip(("data", "model", "pipeline"),
+                          (cfg.data, cfg.model, cfg.pipeline)):
+        if size > 1 or name in cfg.axis_names:
+            sizes.append(size)
+            names.append(name)
+    need = int(np.prod(sizes)) if sizes else 1
+    if need > devs.size:
+        raise ValueError(f"mesh needs {need} devices, have {devs.size}")
+    return Mesh(devs[:need].reshape(sizes), tuple(names))

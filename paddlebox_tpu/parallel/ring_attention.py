@@ -1,0 +1,119 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has no sequence parallelism (SURVEY.md §2.8/§5.7 — a CTR
+framework pools slots instead of attending over tokens), but this framework
+treats long-context as first-class: if attention models join the zoo (e.g.
+behavior-sequence rank models), these primitives slot into the same 1D mesh
+axis the sparse table shards over.
+
+ring_attention: K/V blocks rotate around the ICI ring via ppermute while
+each device keeps its Q shard, accumulating an online-softmax (flash-style
+m/l/o state) — sequence length scales linearly with devices and memory
+stays O(T_local). Differentiable (scan+ppermute transpose cleanly).
+
+ulysses_attention: all_to_all re-shards [B, T/P, H, Dh] → [B, T, H/P, Dh]
+so each device runs full-sequence attention on a head slice, then a2a back
+(head-parallel attention; one a2a pair instead of P-1 ring hops — better
+when heads ≥ devices and the a2a fits ICI).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _block_attn_update(q, k, v, m, l, o, k_pos, q_pos, causal, scale):
+    """One flash-attention accumulation step against a K/V block.
+
+    q: [B, Tq, H, Dh]; k/v: [B, Tk, H, Dh]; m/l: [B, H, Tq]; o like q.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        mask = q_pos[None, None, :, None] >= k_pos[None, None, None, :]
+        s = jnp.where(mask, s, -jnp.inf)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # guard fully-masked rows (m_new = -inf): exp(-inf - -inf) → nan
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    corr = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
+    corr = jnp.where(jnp.isfinite(corr), corr, 0.0)
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = o * corr[..., None].swapaxes(1, 2) + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v)
+    return m_new, l_new, o_new
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None) -> jnp.ndarray:
+    """Blockwise ring attention over a sequence-sharded axis.
+
+    q, k, v: [B, T_local, H, Dh] per device (call inside shard_map).
+    Returns [B, T_local, H, Dh].
+    """
+    P = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, T, H, Dh = q.shape
+    scale = scale if scale is not None else 1.0 / (Dh ** 0.5)
+    q_pos = idx * T + jnp.arange(T)
+
+    # pvary: the scan carry becomes device-varying (k_pos depends on
+    # axis_index), so the initial constants must carry the same vma type
+    m0 = jax.lax.pvary(jnp.full((B, H, T), -jnp.inf, q.dtype), (axis_name,))
+    l0 = jax.lax.pvary(jnp.zeros((B, H, T), q.dtype), (axis_name,))
+    o0 = jnp.zeros_like(q)
+    perm = [(i, (i + 1) % P) for i in range(P)]
+
+    def body(carry, step):
+        kb, vb, m, l, o = carry
+        src = (idx - step) % P  # which device's block we now hold
+        k_pos = src * T + jnp.arange(T)
+        m, l, o = _block_attn_update(q, kb, vb, m, l, o, k_pos, q_pos,
+                                     causal, scale)
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return (kb, vb, m, l, o), None
+
+    (kb, vb, m, l, o), _ = jax.lax.scan(
+        body, (k, v, m0, l0, o0), jnp.arange(P))
+    l_safe = jnp.where(l > 0, l, 1.0)
+    return o / l_safe[..., None].swapaxes(1, 2)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      axis_name: str, causal: bool = False,
+                      scale: Optional[float] = None) -> jnp.ndarray:
+    """All-to-all (DeepSpeed-Ulysses style) sequence→head re-sharding.
+
+    q, k, v: [B, T_local, H, Dh] with H divisible by the axis size.
+    """
+    P = jax.lax.axis_size(axis_name)
+    B, T, H, Dh = q.shape
+    if H % P:
+        raise ValueError(f"heads {H} not divisible by axis size {P}")
+
+    def seq2head(x):  # [B, T, H, Dh] → [B, T*P, H/P, Dh]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def head2seq(x):  # inverse
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)
+    Tg = qg.shape[1]
+    scale = scale if scale is not None else 1.0 / (Dh ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qg, kg) * scale
+    if causal:
+        pos = jnp.arange(Tg)
+        s = jnp.where(pos[None, None, :, None] >= pos[None, None, None, :],
+                      s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, vg)
+    return head2seq(out)

@@ -1,0 +1,193 @@
+"""Pod-sharded pass table: the multi-chip BoxPS/HeterComm engine on ICI.
+
+Re-design of HeterComm (paddle/fluid/framework/fleet/heter_ps/heter_comm_inl.h)
+for the TPU: the reference shards its hash table by ``key % num_devices``
+(split_input_to_shard, inl:1117) and moves key/value traffic over explicit
+p2p copies (walk_to_dest/walk_to_src, inl:273,1296-1445). Here:
+
+  * each mesh device owns one dense per-pass shard slab [shard_cap, width]
+    (the feed pass gives the exact key set per shard — same dense-slab
+    trick as the single-chip PassTable);
+  * the host packer pre-buckets each batch's keys by destination shard into
+    fixed [num_shards, bucket_cap] local-id buckets + a restore index
+    (the DedupKeysAndFillIdx analog, host-side);
+  * pull = all_to_all(id buckets) → local gather → all_to_all(values) →
+    restore; push = scatter-merge grads into buckets → all_to_all →
+    local dedup + in-table optimizer. The two all_to_alls ARE
+    walk_to_dest/walk_to_src, riding ICI as XLA collectives.
+
+Everything device-side is static-shaped and lives inside ONE shard_map'd
+train step (parallel/sharded_trainer.py), so XLA overlaps the a2a with the
+dense compute where profitable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.config.configs import TableConfig
+from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
+from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
+from paddlebox_tpu.utils.stats import stat_add
+
+
+@dataclasses.dataclass
+class ShardedBatchIndex:
+    """Host-built routing for one batch's keys (static shapes).
+
+    buckets:  [P, KB] int32 — per-destination-shard LOCAL ids (dedup'd per
+              batch); padding slots hold shard_cap-1 (the trash row)
+    restore:  [K] int32 — flattened bucket slot (s*KB + j) for each of the
+              batch's K key positions (occurrences of the same key share a
+              slot); invalid key positions point at slot 0 and must be
+              masked by the batch's `valid`
+    overflow: keys dropped because a shard bucket filled up
+    """
+
+    buckets: np.ndarray
+    restore: np.ndarray
+    overflow: int
+
+
+class ShardedPassTable:
+    """Host-side orchestration of P shard slabs with the BoxPS pass cadence.
+
+    Device arrays are produced per pass as a stacked [P, shard_cap, width]
+    global array to be sharded over the mesh axis; the device compute lives
+    in sharded_trainer's shard_map step.
+    """
+
+    def __init__(self, table: TableConfig, num_shards: int,
+                 bucket_cap: int, seed: int = 0) -> None:
+        self.config = table
+        self.layout = ValueLayout(table.embedx_dim, table.optimizer.optimizer)
+        self.push_layout = PushLayout(table.embedx_dim)
+        self.num_shards = num_shards
+        self.bucket_cap = bucket_cap
+        if table.pass_capacity % num_shards:
+            raise ValueError("pass_capacity must divide evenly into shards")
+        self.shard_cap = table.pass_capacity // num_shards
+        self.stores = [HostEmbeddingStore(self.layout, table, seed + s)
+                       for s in range(num_shards)]
+        self._feed_keys: List[np.ndarray] = []
+        self._shard_keys: Optional[List[np.ndarray]] = None  # sorted unique per shard
+        self._in_feed_pass = False
+        self._test_mode = False
+
+    # ------------------------------------------------------- pass lifecycle
+    def begin_feed_pass(self) -> None:
+        if self._in_feed_pass:
+            raise RuntimeError("feed pass already open")
+        self._feed_keys = []
+        self._in_feed_pass = True
+
+    def add_keys(self, keys: np.ndarray) -> None:
+        if not self._in_feed_pass:
+            raise RuntimeError("add_keys outside feed pass")
+        self._feed_keys.append(np.asarray(keys, dtype=np.uint64))
+
+    def end_feed_pass(self) -> None:
+        if not self._in_feed_pass:
+            raise RuntimeError("end_feed_pass without begin_feed_pass")
+        allk = (np.unique(np.concatenate(self._feed_keys))
+                if self._feed_keys else np.empty(0, np.uint64))
+        P = np.uint64(self.num_shards)
+        self._shard_keys = []
+        for s in range(self.num_shards):
+            ks = allk[allk % P == np.uint64(s)]  # sorted (allk sorted)
+            if ks.size > self.shard_cap - 1:
+                raise RuntimeError(
+                    f"shard {s} working set {ks.size} exceeds shard capacity "
+                    f"{self.shard_cap} (raise TableConfig.pass_capacity)")
+            self._shard_keys.append(ks)
+        self._feed_keys = []
+        self._in_feed_pass = False
+
+    def build_slabs(self) -> np.ndarray:
+        """BeginPass: promote all shards' working sets → [P, C, W] host array
+        (caller device_puts it with the mesh sharding)."""
+        if self._shard_keys is None:
+            raise RuntimeError("build_slabs before feed pass completed")
+        P, C, W = self.num_shards, self.shard_cap, self.layout.width
+        slabs = np.zeros((P, C, W), dtype=np.float32)
+        for s, ks in enumerate(self._shard_keys):
+            if ks.size:
+                rows = (self.stores[s].lookup(ks) if self._test_mode
+                        else self.stores[s].lookup_or_create(ks))
+                slabs[s, :ks.size] = rows
+        return slabs
+
+    def write_back(self, slabs: np.ndarray) -> None:
+        """EndPass: [P, C, W] host array → shard stores."""
+        if self._test_mode:
+            return
+        for s, ks in enumerate(self._shard_keys or []):
+            if ks.size:
+                self.stores[s].write_back(ks, slabs[s, :ks.size])
+
+    def set_test_mode(self, test: bool) -> None:
+        self._test_mode = test
+
+    @property
+    def pass_size(self) -> int:
+        return sum(k.size for k in self._shard_keys or [])
+
+    # ---------------------------------------------------------- batch index
+    def bucketize(self, keys: np.ndarray, valid: np.ndarray) -> ShardedBatchIndex:
+        """Route one batch's keys: shard = key % P (split_input_to_shard,
+        heter_comm_inl.h:1117), local id by searchsorted in the shard's
+        sorted pass key list, batch-level dedup into bucket slots."""
+        if self._shard_keys is None:
+            raise RuntimeError("no active pass key set")
+        P, KB = self.num_shards, self.bucket_cap
+        trash = self.shard_cap - 1
+        buckets = np.full((P, KB), trash, dtype=np.int32)
+        restore = np.zeros(keys.shape[0], dtype=np.int32)
+        fill = np.zeros(P, dtype=np.int64)
+        # per-batch dedup: map key → assigned slot
+        slot_of: dict = {}
+        overflow = 0
+        kv = keys.tolist()
+        sv = (keys % np.uint64(P)).tolist()
+        for i in range(keys.shape[0]):
+            if not valid[i]:
+                continue
+            k = kv[i]
+            slot = slot_of.get(k)
+            if slot is None:
+                s = sv[i]
+                if fill[s] >= KB:
+                    overflow += 1
+                    valid[i] = False
+                    continue
+                sk = self._shard_keys[s]
+                pos = np.searchsorted(sk, k)
+                if pos >= sk.size or sk[pos] != k:
+                    raise KeyError(f"key {k} not registered in feed pass")
+                j = int(fill[s])
+                buckets[s, j] = pos
+                fill[s] += 1
+                slot = s * KB + j
+                slot_of[k] = slot
+            restore[i] = slot
+        if overflow:
+            stat_add("sharded_bucket_overflow", overflow)
+        return ShardedBatchIndex(buckets=buckets, restore=restore,
+                                 overflow=overflow)
+
+    # ------------------------------------------------------------ lifecycle
+    def shrink_table(self) -> int:
+        return sum(st.shrink() for st in self.stores)
+
+    def save(self, path_prefix: str) -> None:
+        for s, st in enumerate(self.stores):
+            st.save(f"{path_prefix}.shard{s:03d}")
+
+    def load(self, path_prefix: str) -> None:
+        for s, st in enumerate(self.stores):
+            st.load(f"{path_prefix}.shard{s:03d}")

@@ -1,0 +1,233 @@
+"""Multi-chip trainer: ONE shard_map'd step fusing the whole BoxPS hot loop.
+
+The device program per step (the TPU re-design of BoxPSWorker::TrainFiles +
+HeterComm pull/push + NCCL dense allreduce):
+
+    a2a(id buckets)        ← walk_to_dest (heter_comm_inl.h:273)
+    local slab gather      ← HashTable::get
+    a2a(values)            ← walk_to_src (inl:1296-1445)
+    restore → seqpool+CVM → model fwd/bwd (MXU)
+    psum(dense grads)      ← c_allreduce_sum / SyncParam NCCL
+    optax dense update (replicated, deterministic)
+    scatter grads → a2a    ← push walk_to_dest
+    local dedup + in-table optimizer ← HashTable::update(sgd)
+
+Batches are data-parallel over the same 1D axis that shards the table
+(BoxPS's one-worker-per-GPU + key-mod-sharding topology). All shapes are
+static; XLA overlaps the collectives with dense compute.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddlebox_tpu.config.configs import (DataFeedConfig, TableConfig,
+                                          TrainerConfig)
+from paddlebox_tpu.data.dataset import BoxDataset
+from paddlebox_tpu.data.packer import PackedBatch
+from paddlebox_tpu.embedding.optimizers import push_sparse_dedup
+from paddlebox_tpu.metrics.auc import MetricRegistry
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
+from paddlebox_tpu.ops.sparse import build_push_grads, pull_sparse
+from paddlebox_tpu.parallel.mesh import BOX_AXIS, device_mesh_1d
+from paddlebox_tpu.parallel.sharded_table import (ShardedBatchIndex,
+                                                  ShardedPassTable)
+from paddlebox_tpu.train.trainer import (_multi_task_loss,
+                                         make_dense_optimizer)
+from paddlebox_tpu.utils.timer import Timer
+
+
+class ShardedBoxTrainer:
+    def __init__(self, model, table_cfg: TableConfig, feed: DataFeedConfig,
+                 trainer_cfg: Optional[TrainerConfig] = None,
+                 mesh: Optional[Mesh] = None, bucket_cap: Optional[int] = None,
+                 seed: int = 0, use_cvm: bool = True) -> None:
+        self.model = model
+        self.cfg = trainer_cfg or TrainerConfig()
+        self.feed = feed
+        self.mesh = mesh or device_mesh_1d()
+        self.P = self.mesh.devices.size
+        self.axis = self.mesh.axis_names[0]
+        kcap = feed.key_capacity()
+        # bucket slack over the uniform K/P expectation (hash imbalance)
+        self.bucket_cap = bucket_cap or max(16, (2 * kcap) // self.P)
+        self.table = ShardedPassTable(table_cfg, self.P, self.bucket_cap,
+                                      seed=seed)
+        self.metrics = MetricRegistry()
+        self.dense_opt = make_dense_optimizer(self.cfg)
+        rng = jax.random.PRNGKey(seed)
+        self.params = model.init(rng)
+        self.opt_state = self.dense_opt.init(self.params)
+        self.num_slots = len(feed.used_sparse_slots())
+        self.use_cvm = use_cvm
+        self.multi_task = len(getattr(model, "task_names", ("ctr",))) > 1
+        self._slabs: Optional[jax.Array] = None
+        self._prng = jax.random.PRNGKey(seed + 17)
+        self._shuffle_rng = np.random.RandomState(seed + 1)
+        self.timers = {n: Timer() for n in ("step", "pass", "build")}
+        self._step = self._build_step()
+
+    # ------------------------------------------------------------ jit step
+    def _build_step(self):
+        model = self.model
+        layout = self.table.layout
+        conf = self.table.config.optimizer
+        B = self.feed.batch_size
+        S = self.num_slots
+        use_cvm = self.use_cvm
+        multi_task = self.multi_task
+        axis = self.axis
+
+        def shard_step(slab, params, opt_state, batch, prng):
+            # per-device views: slab [1, C, W]; batch leaves [1, ...]
+            slab = slab[0]
+            batch = jax.tree.map(lambda x: x[0], batch)
+            prng = jax.random.fold_in(prng, jax.lax.axis_index(axis))
+            buckets = batch["buckets"]                       # [P, KB]
+            KB = buckets.shape[1]
+            Pn = buckets.shape[0]
+
+            # ---- pull: a2a ids → local gather → a2a values → restore
+            req = jax.lax.all_to_all(buckets, axis, 0, 0, tiled=True)
+            vals = pull_sparse(slab, req.reshape(-1), layout)  # [P*KB, Dp]
+            resp = jax.lax.all_to_all(
+                vals.reshape(Pn, KB, -1), axis, 0, 0, tiled=True)
+            emb = resp.reshape(Pn * KB, -1)[batch["restore"]]  # [K, Dp]
+
+            def loss_fn(params, emb):
+                pooled = fused_seqpool_cvm(
+                    emb, batch["segments"], batch["valid"], B, S, use_cvm)
+                logits = model.apply(params, pooled, batch.get("dense"))
+                ins_valid = batch["ins_valid"]
+                if multi_task:
+                    labels = {t: batch["labels_" + t] for t in model.task_names}
+                    loss, preds = _multi_task_loss(
+                        logits, labels, ins_valid,
+                        getattr(model, "loss_mode", "sum"))
+                else:
+                    lab = batch["labels"].astype(jnp.float32)
+                    bce = optax.sigmoid_binary_cross_entropy(logits, lab)
+                    denom = jnp.maximum(ins_valid.sum(), 1.0)
+                    loss = jnp.where(ins_valid, bce, 0.0).sum() / denom
+                    preds = {"ctr": jax.nn.sigmoid(logits)}
+                return loss, preds
+
+            grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)
+            (loss, preds), (dparams, demb) = grad_fn(params, emb)
+
+            # ---- dense sync: data-parallel allreduce (SyncParam/NCCL)
+            dparams = jax.lax.pmean(dparams, axis)
+            loss = jax.lax.pmean(loss, axis)
+            updates, opt_state = self.dense_opt.update(dparams, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+
+            # ---- push: per-key grads → bucket merge → a2a → local update
+            label_src = (batch["labels_" + model.task_names[0]] if multi_task
+                         else batch["labels"])
+            clicks = label_src[batch["segments"] // S]
+            pg = build_push_grads(demb, batch["slots"], clicks, batch["valid"])
+            bucket_g = jnp.zeros((Pn * KB, pg.shape[1]), pg.dtype
+                                 ).at[batch["restore"]].add(
+                jnp.where(batch["valid"][:, None], pg, 0.0))
+            recv_g = jax.lax.all_to_all(
+                bucket_g.reshape(Pn, KB, -1), axis, 0, 0, tiled=True)
+            slab = push_sparse_dedup(slab, req.reshape(-1),
+                                     recv_g.reshape(Pn * KB, -1), prng,
+                                     layout, conf)
+            return slab[None], params, opt_state, loss, preds
+
+        spec_sh = P(self.axis)
+        spec_rep = P()
+        # prefix specs: spec_sh applies to every leaf of the batch dict /
+        # preds dict
+        fn = jax.shard_map(
+            shard_step, mesh=self.mesh,
+            in_specs=(spec_sh, spec_rep, spec_rep, spec_sh, spec_rep),
+            out_specs=(spec_sh, spec_rep, spec_rep, spec_rep, spec_sh))
+        return jax.jit(fn)
+
+    # -------------------------------------------------------------- batches
+    def shard_batches(self, per_worker: List[List[PackedBatch]]
+                      ) -> List[Dict[str, jax.Array]]:
+        """Stack each step's P per-worker batches into [P, ...] device
+        arrays with the mesh sharding + the table routing index."""
+        steps = []
+        n_steps = len(per_worker[0])
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        for i in range(n_steps):
+            stacked: Dict[str, List[np.ndarray]] = {}
+            for w in range(self.P):
+                b = per_worker[w][i]
+                valid = b.valid.copy()
+                idx = self.table.bucketize(b.keys, valid)
+                leaves = {
+                    "buckets": idx.buckets, "restore": idx.restore,
+                    "slots": b.slots, "segments": b.segments, "valid": valid,
+                    "ins_valid": b.ins_valid, "labels": b.labels,
+                }
+                if b.dense is not None:
+                    leaves["dense"] = b.dense
+                if self.multi_task:
+                    for t in self.model.task_names:
+                        leaves["labels_" + t] = b.labels
+                for k, v in leaves.items():
+                    stacked.setdefault(k, []).append(v)
+            dev = {k: jax.device_put(np.stack(v), sharding)
+                   for k, v in stacked.items()}
+            steps.append(dev)
+        return steps
+
+    # ---------------------------------------------------------- pass cadence
+    def train_pass(self, dataset: BoxDataset,
+                   preloaded: bool = False) -> Dict[str, float]:
+        t_pass = self.timers["pass"]
+        t_pass.start()
+        if not preloaded:
+            self.table.begin_feed_pass()
+            dataset.load_into_memory(add_keys_fn=self.table.add_keys)
+            self.table.end_feed_pass()
+        self.timers["build"].start()
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        self._slabs = jax.device_put(self.table.build_slabs(), sharding)
+        self.timers["build"].pause()
+        dataset.local_shuffle(self._shuffle_rng.randint(1 << 31))
+        per_worker = dataset.split_batches(num_workers=self.P)
+        losses = []
+        raw_steps = list(zip(*per_worker)) if per_worker[0] else []
+        dev_batches = self.shard_batches(per_worker)
+        for i, batch in enumerate(dev_batches):
+            self.timers["step"].start()
+            self._prng, sub = jax.random.split(self._prng)
+            (self._slabs, self.params, self.opt_state, loss,
+             preds) = self._step(self._slabs, self.params, self.opt_state,
+                                 batch, sub)
+            self.timers["step"].pause()
+            losses.append(float(loss))
+            self._add_metrics(preds, raw_steps[i])
+        self.table.write_back(np.asarray(self._slabs))
+        self._slabs = None
+        t_pass.pause()
+        return {"loss": float(np.mean(losses)) if losses else 0.0,
+                "batches": len(dev_batches), "instances": len(dataset)}
+
+    def _add_metrics(self, preds, step_batches: Tuple[PackedBatch, ...]) -> None:
+        if not self.metrics.metric_names():
+            return
+        main = list(preds)[0]
+        arr = np.asarray(preds[main])       # [P, B] (sharded out spec)
+        labels = np.stack([b.labels for b in step_batches])
+        mask = np.stack([b.ins_valid for b in step_batches])
+        tensors = {"pred": arr.reshape(-1), "label": labels.reshape(-1),
+                   "mask": mask.reshape(-1)}
+        for t, p in preds.items():
+            tensors["pred_" + t] = np.asarray(p).reshape(-1)
+        self.metrics.add_batch(tensors)

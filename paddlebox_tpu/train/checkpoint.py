@@ -36,25 +36,35 @@ class CheckpointManager:
     # ------------------------------------------------------------ batch tier
     def save_base(self, params: Any, opt_state: Any, day: str,
                   extra: Optional[Dict] = None) -> Tuple[str, str]:
-        """Full save → (batch_path, xbox_path)."""
+        """Full save → (batch_path, xbox_path).
+
+        Snapshotting AND the post-save stat mutation (clear delta, age days)
+        happen synchronously so a concurrent next pass can't race the store;
+        only the file writes go to the async thread."""
         self.wait()
         batch_dir = os.path.join(self.cfg.batch_model_dir, day)
         xbox_dir = os.path.join(self.cfg.xbox_model_dir, day)
         os.makedirs(batch_dir, exist_ok=True)
         os.makedirs(xbox_dir, exist_ok=True)
 
+        keys, values = self.table.store.state_items()  # snapshot (copy)
+        xbox_blob = self._xbox_view(keys, values, base=True)
+        sparse_blob = {"keys": keys, "values": values.copy(),
+                       "embedx_dim": self.table.layout.embedx_dim,
+                       "optimizer": self.table.layout.optimizer}
+        # base save covers everything: clear delta scores + age days, now
+        self.table.layout.update_stat_after_save(values, self.table.config, 1)
+        self.table.layout.update_stat_after_save(values, self.table.config, 3)
+        if keys.size:
+            self.table.store.write_back(keys, values)
+
         def do_save():
-            self.table.store.save(os.path.join(batch_dir, "sparse.pkl"))
+            with open(os.path.join(batch_dir, "sparse.pkl"), "wb") as f:
+                pickle.dump(sparse_blob, f, protocol=pickle.HIGHEST_PROTOCOL)
             with open(os.path.join(batch_dir, "dense.pkl"), "wb") as f:
                 pickle.dump({"params": params, "opt_state": opt_state,
                              "extra": extra or {}}, f)
-            self._write_xbox(xbox_dir, base=True)
-            # a base save covers everything: clear delta scores + age days
-            keys, values = self.table.store.state_items()
-            self.table.layout.update_stat_after_save(values, self.table.config, 1)
-            self.table.layout.update_stat_after_save(values, self.table.config, 3)
-            if keys.size:
-                self.table.store.write_back(keys, values)
+            self._write_xbox(xbox_dir, xbox_blob)
             with open(os.path.join(batch_dir, "DONE"), "w") as f:
                 f.write(str(time.time()))
 
@@ -72,9 +82,15 @@ class CheckpointManager:
         xbox_dir = os.path.join(self.cfg.xbox_model_dir, day,
                                 f"delta-{delta_id}")
         os.makedirs(xbox_dir, exist_ok=True)
+        keys, values = self.table.store.state_items()
+        blob = self._xbox_view(keys, values, base=False)
+        # clear covered rows' delta (UpdateStatAfterSave param=1) — sync
+        self.table.layout.update_stat_after_save(values, self.table.config, 1)
+        if keys.size:
+            self.table.store.write_back(keys, values)
 
         def do_save():
-            self._write_xbox(xbox_dir, base=False)
+            self._write_xbox(xbox_dir, blob)
 
         if self.cfg.async_save:
             self._save_thread = threading.Thread(target=do_save, daemon=True)
@@ -83,11 +99,11 @@ class CheckpointManager:
             do_save()
         return xbox_dir
 
-    def _write_xbox(self, xbox_dir: str, base: bool) -> None:
-        """Serving view: key → [embed_w, embedx...] for created features."""
+    def _xbox_view(self, keys: np.ndarray, values: np.ndarray,
+                   base: bool) -> Dict:
+        """Serving view: key → [embed_w, embedx...] for covered features."""
         layout = self.table.layout
         tcfg = self.table.config
-        keys, values = self.table.store.state_items()
         if keys.size:
             if base:
                 keep = np.ones(keys.size, bool)
@@ -100,15 +116,15 @@ class CheckpointManager:
                 vals[:, acc.EMBED_W:acc.EMBED_W + 1],
                 vals[:, layout.embedx_w:layout.embedx_w + D],
             ], axis=1)
-            if not base:
-                # clearing covered rows' delta (UpdateStatAfterSave param=1)
-                layout.update_stat_after_save(values, tcfg, 1)
-                self.table.store.write_back(keys, values)
         else:
             keys_out = keys
             emb = np.empty((0, 1 + layout.embedx_dim), np.float32)
+        return {"keys": keys_out, "embedding": emb}
+
+    @staticmethod
+    def _write_xbox(xbox_dir: str, blob: Dict) -> None:
         with open(os.path.join(xbox_dir, "embedding.pkl"), "wb") as f:
-            pickle.dump({"keys": keys_out, "embedding": emb}, f)
+            pickle.dump(blob, f)
         with open(os.path.join(xbox_dir, "DONE"), "w") as f:
             f.write(str(time.time()))
 

@@ -59,17 +59,32 @@ def make_dense_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
     raise ValueError(cfg.dense_optimizer)
 
 
-def _multi_task_loss(logits, labels_dict, ins_valid):
-    """Masked mean BCE summed over tasks; ESMM's ctcvr composition is done by
-    the model-specific label routing in the trainer."""
+def _multi_task_loss(logits, labels_dict, ins_valid, loss_mode: str = "sum"):
+    """Masked mean BCE over tasks.
+
+    loss_mode="sum": independent per-task BCE (MMoE-style).
+    loss_mode="esmm": entire-space loss — BCE(click, pCTR) +
+        BCE(conversion, pCTCVR) with pCTCVR = pCTR·pCVR, so the cvr tower
+        trains over all impressions; labels_cvr carries the conversion/pay
+        label (defaults to click when the data has no second label)."""
     denom = jnp.maximum(ins_valid.sum(), 1.0)
+    preds = {t: jax.nn.sigmoid(lg) for t, lg in logits.items()}
+    if loss_mode == "esmm":
+        pctr = preds["ctr"]
+        pctcvr = jnp.clip(pctr * preds["cvr"], 1e-7, 1.0 - 1e-7)
+        click = labels_dict["ctr"].astype(jnp.float32)
+        conv = labels_dict["cvr"].astype(jnp.float32)
+        bce_ctr = optax.sigmoid_binary_cross_entropy(logits["ctr"], click)
+        bce_ctcvr = -(conv * jnp.log(pctcvr)
+                      + (1.0 - conv) * jnp.log1p(-pctcvr))
+        total = (jnp.where(ins_valid, bce_ctr + bce_ctcvr, 0.0).sum() / denom)
+        preds = dict(preds, ctcvr=pctcvr)
+        return total, preds
     total = 0.0
-    preds = {}
     for task, lg in logits.items():
         lab = labels_dict[task].astype(jnp.float32)
         bce = optax.sigmoid_binary_cross_entropy(lg, lab)
         total = total + jnp.where(ins_valid, bce, 0.0).sum() / denom
-        preds[task] = jax.nn.sigmoid(lg)
     return total, preds
 
 
@@ -88,7 +103,9 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         ins_valid = batch["ins_valid"]
         if multi_task:
             labels = {t: batch["labels_" + t] for t in model.task_names}
-            loss, preds = _multi_task_loss(logits, labels, ins_valid)
+            loss, preds = _multi_task_loss(
+                logits, labels, ins_valid,
+                getattr(model, "loss_mode", "sum"))
             main_pred = preds[model.task_names[0]]
         else:
             lab = batch["labels"].astype(jnp.float32)
@@ -152,6 +169,7 @@ class BoxTrainer:
             feed.batch_size, self.num_slots, use_cvm)
         self.timers = {n: Timer() for n in ("step", "pass")}
         self._step_count = 0
+        self._shuffle_rng = np.random.RandomState(seed + 1)
         self.multi_task = len(getattr(model, "task_names", ("ctr",))) > 1
 
     # ---------------------------------------------------------- batch utils
@@ -185,12 +203,11 @@ class BoxTrainer:
             dataset.load_into_memory(add_keys_fn=self.table.add_keys)
             self.table.end_feed_pass()
         self.table.begin_pass()
-        dataset.local_shuffle()
+        dataset.local_shuffle(self._shuffle_rng.randint(1 << 31))
         worker_batches = dataset.split_batches(num_workers=1)
         losses = []
         for b in worker_batches[0]:
-            ids = self.table.lookup_ids(b.keys)
-            ids = np.where(b.valid, ids, self.table.padding_id).astype(np.int32)
+            ids = self.table.lookup_ids(b.keys, b.valid)
             batch = self.device_batch(b, ids)
             self.timers["step"].start()
             slab, self.params, self.opt_state, loss, preds = self.fns.step(
@@ -233,8 +250,7 @@ class BoxTrainer:
         self.table.begin_pass()
         preds_all, labels_all = [], []
         for b in dataset.split_batches(num_workers=1)[0]:
-            ids = self.table.lookup_ids(b.keys)
-            ids = np.where(b.valid, ids, self.table.padding_id).astype(np.int32)
+            ids = self.table.lookup_ids(b.keys, b.valid)
             batch = self.device_batch(b, ids)
             preds = self.fns.eval_step(self.table.slab, self.params, batch)
             main = np.asarray(preds[list(preds)[0]])
@@ -242,4 +258,6 @@ class BoxTrainer:
             labels_all.append(b.labels[b.ins_valid])
         self.table.end_pass()
         self.table.set_test_mode(False)
+        if not preds_all:
+            return np.empty(0, np.float32), np.empty(0, np.int32)
         return np.concatenate(preds_all), np.concatenate(labels_all)

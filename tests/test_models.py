@@ -61,3 +61,22 @@ def test_multi_task_models(cls, inputs):
 def test_zoo_registry():
     assert set(MODEL_ZOO) == {"ctr_dnn", "deepfm", "wide_deep", "dlrm",
                               "mmoe", "esmm"}
+
+
+def test_esmm_entire_space_loss():
+    """loss_mode='esmm' composes pCTCVR = pCTR*pCVR (entire-space loss)."""
+    import jax.numpy as jnp
+    from paddlebox_tpu.train.trainer import _multi_task_loss
+
+    logits = {"ctr": jnp.array([0.5, -1.0]), "cvr": jnp.array([0.2, 0.3])}
+    labels = {"ctr": jnp.array([1, 0]), "cvr": jnp.array([1, 0])}
+    ins_valid = jnp.array([True, True])
+    loss, preds = _multi_task_loss(logits, labels, ins_valid, "esmm")
+    assert set(preds) == {"ctr", "cvr", "ctcvr"}
+    np.testing.assert_allclose(
+        np.asarray(preds["ctcvr"]),
+        np.asarray(preds["ctr"]) * np.asarray(preds["cvr"]), rtol=1e-6)
+    assert np.isfinite(float(loss))
+    # independent-sum mode differs from entire-space mode
+    loss_sum, _ = _multi_task_loss(logits, labels, ins_valid, "sum")
+    assert abs(float(loss) - float(loss_sum)) > 1e-6
