@@ -1,0 +1,157 @@
+"""Columnar record blocks: the zero-object data path.
+
+The reference keeps per-instance SlotRecord objects pooled in a slab
+allocator (SlotObjPool, data_feed.h:305) to dodge allocation churn. The
+TPU-native pipeline goes further: the native parser emits whole files as
+flat columnar arrays (keys + per-key slot/record ids, labels, dense), and
+batches are packed by pure numpy slicing — no per-record Python objects
+anywhere on the hot path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.config.configs import DataFeedConfig
+from paddlebox_tpu.data.packer import PackedBatch
+from paddlebox_tpu.utils.stats import stat_add
+
+
+@dataclasses.dataclass
+class ColumnarBlock:
+    """A set of records in struct-of-arrays form. Keys of record r live at
+    keys[rec_offsets[r]:rec_offsets[r+1]] ordered by slot."""
+
+    keys: np.ndarray        # [K] uint64
+    key_slot: np.ndarray    # [K] int32
+    labels: np.ndarray      # [N] int32
+    rec_offsets: np.ndarray  # [N+1] int64
+    dense: Optional[np.ndarray] = None  # [N, dense_dim] float32
+
+    @property
+    def n_recs(self) -> int:
+        return self.labels.shape[0]
+
+    @property
+    def n_keys(self) -> int:
+        return self.keys.shape[0]
+
+    @staticmethod
+    def from_key_rec(keys, key_slot, key_rec, labels, dense=None
+                     ) -> "ColumnarBlock":
+        """From parser output where key_rec[i] is each key's record index
+        (keys already grouped by record)."""
+        n = labels.shape[0]
+        counts = np.bincount(key_rec, minlength=n) if keys.size else \
+            np.zeros(n, np.int64)
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        return ColumnarBlock(keys=keys, key_slot=key_slot, labels=labels,
+                             rec_offsets=offsets, dense=dense)
+
+    @staticmethod
+    def concat(blocks: Sequence["ColumnarBlock"]) -> "ColumnarBlock":
+        blocks = [b for b in blocks if b.n_recs]
+        if not blocks:
+            return ColumnarBlock(np.empty(0, np.uint64), np.empty(0, np.int32),
+                                 np.empty(0, np.int32),
+                                 np.zeros(1, np.int64), None)
+        keys = np.concatenate([b.keys for b in blocks])
+        key_slot = np.concatenate([b.key_slot for b in blocks])
+        labels = np.concatenate([b.labels for b in blocks])
+        offs = [blocks[0].rec_offsets]
+        shift = blocks[0].rec_offsets[-1]
+        for b in blocks[1:]:
+            offs.append(b.rec_offsets[1:] + shift)
+            shift += b.rec_offsets[-1]
+        rec_offsets = np.concatenate(offs)
+        dense = None
+        if blocks[0].dense is not None:
+            dense = np.concatenate([b.dense for b in blocks])
+        return ColumnarBlock(keys, key_slot, labels, rec_offsets, dense)
+
+
+def pack_columnar(block: ColumnarBlock, rec_idx: np.ndarray,
+                  feed: DataFeedConfig, kcap: int, num_slots: int,
+                  max_lens: np.ndarray) -> PackedBatch:
+    """Pack selected records into one static-shaped batch, fully vectorized.
+
+    rec_idx: record indices for this batch (≤ batch_size).
+    Truncates each (record, slot) run to the slot's max_len and the batch to
+    kcap keys, counting drops (packer contract parity).
+    """
+    B = feed.batch_size
+    n = min(rec_idx.shape[0], B)
+    rec_idx = rec_idx[:n]
+    starts = block.rec_offsets[rec_idx]
+    ends = block.rec_offsets[rec_idx + 1]
+    counts = (ends - starts).astype(np.int64)
+    total = int(counts.sum())
+
+    labels = np.zeros(B, dtype=np.int32)
+    labels[:n] = block.labels[rec_idx]
+    ins_valid = np.zeros(B, dtype=bool)
+    ins_valid[:n] = True
+    dense = None
+    if block.dense is not None:
+        dense = np.zeros((B, block.dense.shape[1]), np.float32)
+        dense[:n] = block.dense[rec_idx]
+    qvalues = np.zeros(B, dtype=np.float32)
+
+    keys = np.zeros(kcap, dtype=np.uint64)
+    slots = np.zeros(kcap, dtype=np.int32)
+    segments = np.zeros(kcap, dtype=np.int32)
+    valid = np.zeros(kcap, dtype=bool)
+
+    if total:
+        # gather each batch record's key run: flat index expansion
+        flat = np.repeat(starts, counts) + _run_aranges(counts)
+        bkeys = block.keys[flat]
+        bslots = block.key_slot[flat]
+        brec = np.repeat(np.arange(n, dtype=np.int64), counts)
+        # per-(record, slot) ordinal for max_len truncation
+        group = brec * num_slots + bslots
+        ordinal = _group_cumcount(group)
+        keep = ordinal < max_lens[bslots]
+        dropped = int((~keep).sum())
+        bkeys, bslots, brec = bkeys[keep], bslots[keep], brec[keep]
+        w = bkeys.shape[0]
+        if w > kcap:
+            dropped += w - kcap
+            bkeys, bslots, brec = bkeys[:kcap], bslots[:kcap], brec[:kcap]
+            w = kcap
+        if dropped:
+            stat_add("packer_keys_dropped", dropped)
+        keys[:w] = bkeys
+        slots[:w] = bslots
+        segments[:w] = (brec * num_slots + bslots).astype(np.int32)
+        valid[:w] = True
+
+    return PackedBatch(keys=keys, slots=slots, segments=segments, valid=valid,
+                       labels=labels, ins_valid=ins_valid, dense=dense,
+                       n_ins=n, qvalues=qvalues)
+
+
+def _run_aranges(counts: np.ndarray) -> np.ndarray:
+    """[0..c0), [0..c1), ... concatenated (vectorized)."""
+    total = int(counts.sum())
+    if total == 0:
+        return np.empty(0, np.int64)
+    ends = np.cumsum(counts)
+    idx = np.arange(total, dtype=np.int64)
+    return idx - np.repeat(ends - counts, counts)
+
+
+def _group_cumcount(group: np.ndarray) -> np.ndarray:
+    """Ordinal of each element within its (already contiguous) group."""
+    if group.size == 0:
+        return np.empty(0, np.int64)
+    change = np.empty(group.size, dtype=bool)
+    change[0] = True
+    np.not_equal(group[1:], group[:-1], out=change[1:])
+    starts = np.nonzero(change)[0]
+    idx = np.arange(group.size, dtype=np.int64)
+    return idx - np.repeat(starts, np.diff(np.append(starts, group.size)))

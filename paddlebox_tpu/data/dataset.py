@@ -34,7 +34,7 @@ AddKeysFn = Callable[[np.ndarray], None]
 class BoxDataset:
     def __init__(self, feed: DataFeedConfig, read_threads: int = 4,
                  parser: Optional[MultiSlotParser] = None,
-                 shuffler=None) -> None:
+                 shuffler=None, columnar: Optional[bool] = None) -> None:
         self.feed = feed
         self.read_threads = read_threads
         self.parser = parser or MultiSlotParser(feed)
@@ -48,6 +48,23 @@ class BoxDataset:
         self._add_keys_fn: Optional[AddKeysFn] = None
         self._load_error: Optional[BaseException] = None
         self.timers = {n: Timer() for n in ("read", "merge", "shuffle")}
+        # columnar fast path: native C++ parser → struct-of-arrays blocks,
+        # numpy-only batch packing (no per-record Python objects). Default:
+        # on when the native lib builds and no cross-host shuffler is
+        # attached (the shuffle transport routes SlotRecord objects).
+        self._native_parser = None
+        if columnar is None:
+            columnar = shuffler is None
+        if columnar:
+            try:
+                from paddlebox_tpu.data.native_parser import \
+                    NativeMultiSlotParser
+                self._native_parser = NativeMultiSlotParser(feed)
+            except (RuntimeError, ImportError):
+                self._native_parser = None
+        self.columnar = self._native_parser is not None
+        self._block = None          # merged ColumnarBlock
+        self._perm: Optional[np.ndarray] = None  # shuffle permutation
 
     # ------------------------------------------------------------ file list
     def set_filelist(self, files: Sequence[str]) -> None:
@@ -69,6 +86,8 @@ class BoxDataset:
         if self._preload_threads:
             raise RuntimeError("preload already running")
         self._records = []
+        self._block = None
+        self._perm = None
         self._add_keys_fn = add_keys_fn
         self._load_error = None
         self._channel = Channel(capacity=64)
@@ -86,14 +105,18 @@ class BoxDataset:
                         path = files[cursor["i"]]
                         cursor["i"] += 1
                     t.start()
-                    batch: List[SlotRecord] = []
-                    for rec in self.parser.parse_file(path):
-                        batch.append(rec)
-                        if len(batch) >= 512:
+                    if self.columnar:
+                        block = self._native_parser.parse_file_columnar(path)
+                        self._channel.put(block)
+                    else:
+                        batch: List[SlotRecord] = []
+                        for rec in self.parser.parse_file(path):
+                            batch.append(rec)
+                            if len(batch) >= 512:
+                                self._put_records(batch)
+                                batch = []
+                        if batch:
                             self._put_records(batch)
-                            batch = []
-                    if batch:
-                        self._put_records(batch)
                     t.pause()
             except BaseException as e:  # surfaced in wait_preload_done
                 self._load_error = e
@@ -101,22 +124,35 @@ class BoxDataset:
         def merge_worker():
             """MergeInsKeys (data_set.cc:2291-2347): drain channel, register
             keys with the feed-pass agent, append to the pass memory."""
+            from paddlebox_tpu.data.columnar import ColumnarBlock
             t = self.timers["merge"]
+            blocks = []
             try:
                 while True:
                     try:
-                        recs = self._channel.get_many(256)
+                        items = self._channel.get_many(256)
                     except ChannelClosed:
-                        return
+                        break
                     t.start()
-                    if self._add_keys_fn is not None:
-                        keys = [r.all_keys() for r in recs]
-                        keys = [k for k in keys if k.size]
-                        if keys:
-                            self._add_keys_fn(np.concatenate(keys))
-                    self._records.extend(recs)
-                    stat_add("dataset_ins_merged", len(recs))
+                    if self.columnar:
+                        for block in items:
+                            if self._add_keys_fn is not None and block.n_keys:
+                                self._add_keys_fn(block.keys)
+                            blocks.append(block)
+                            stat_add("dataset_ins_merged", block.n_recs)
+                    else:
+                        recs = items
+                        if self._add_keys_fn is not None:
+                            keys = [r.all_keys() for r in recs]
+                            keys = [k for k in keys if k.size]
+                            if keys:
+                                self._add_keys_fn(np.concatenate(keys))
+                        self._records.extend(recs)
+                        stat_add("dataset_ins_merged", len(recs))
                     t.pause()
+                if self.columnar:
+                    self._block = ColumnarBlock.concat(blocks)
+                return
             except BaseException as e:
                 self._load_error = e
                 # keep draining so blocked readers can finish instead of
@@ -163,17 +199,38 @@ class BoxDataset:
     # -------------------------------------------------------------- train prep
     def local_shuffle(self, seed: Optional[int] = None) -> None:
         rng = np.random.RandomState(seed)
-        rng.shuffle(self._records)
+        if self.columnar:
+            if self._block is not None and self._block.n_recs:
+                self._perm = rng.permutation(self._block.n_recs)
+        else:
+            rng.shuffle(self._records)
 
     @property
     def records(self) -> List[SlotRecord]:
         return self._records
 
+    @property
+    def block(self):
+        return self._block
+
+    def all_keys(self) -> np.ndarray:
+        """Every feasign in the loaded pass (for test-mode feed passes)."""
+        if self.columnar:
+            return (self._block.keys if self._block is not None
+                    else np.empty(0, np.uint64))
+        if not self._records:
+            return np.empty(0, np.uint64)
+        return np.concatenate([r.all_keys() for r in self._records])
+
     def __len__(self) -> int:
+        if self.columnar:
+            return self._block.n_recs if self._block is not None else 0
         return len(self._records)
 
     def release_memory(self) -> None:
         self._records = []
+        self._block = None
+        self._perm = None
 
     def split_batches(self, num_workers: int,
                       equalize: Optional[Callable[[int], int]] = None
@@ -186,10 +243,13 @@ class BoxDataset:
         (MPI allreduce analog); receives local count, returns global max.
         """
         bs = self.feed.batch_size
-        n = len(self._records)
+        n = len(self)
         per_worker = (n + num_workers - 1) // num_workers
         local_batches = (per_worker + bs - 1) // bs if n else 0
         target = equalize(local_batches) if equalize else local_batches
+        if self.columnar:
+            return self._split_batches_columnar(num_workers, per_worker,
+                                                target)
         out: List[List[PackedBatch]] = []
         for w in range(num_workers):
             lo = w * per_worker
@@ -204,5 +264,33 @@ class BoxDataset:
                 if not chunk:
                     chunk = self._records[:bs]
                 batches.append(self.packer.pack(chunk))
+            out.append(batches)
+        return out
+
+    def _split_batches_columnar(self, num_workers: int, per_worker: int,
+                                target: int) -> List[List[PackedBatch]]:
+        from paddlebox_tpu.data.columnar import pack_columnar
+        bs = self.feed.batch_size
+        n = len(self)
+        perm = (self._perm if self._perm is not None
+                else np.arange(n, dtype=np.int64))
+        sparse_slots = self.feed.used_sparse_slots()
+        max_lens = np.array([s.max_len for s in sparse_slots], np.int64)
+        kcap = self.feed.key_capacity()
+        num_slots = len(sparse_slots)
+        out: List[List[PackedBatch]] = []
+        for w in range(num_workers):
+            lo = w * per_worker
+            hi = min(lo + per_worker, n)
+            recs = perm[lo:hi]
+            batches: List[PackedBatch] = []
+            for b in range(target):
+                chunk = recs[b * bs:(b + 1) * bs]
+                if chunk.size == 0 and recs.size:
+                    chunk = recs[:bs]
+                if chunk.size == 0:
+                    chunk = perm[:bs]
+                batches.append(pack_columnar(self._block, chunk, self.feed,
+                                             kcap, num_slots, max_lens))
             out.append(batches)
         return out

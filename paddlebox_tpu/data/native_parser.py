@@ -1,0 +1,77 @@
+"""ctypes wrapper over the native MultiSlot parser → ColumnarBlock."""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from paddlebox_tpu.config.configs import DataFeedConfig
+from paddlebox_tpu.data.columnar import ColumnarBlock
+from paddlebox_tpu.native import get_lib
+from paddlebox_tpu.utils.stats import stat_add
+
+
+class NativeMultiSlotParser:
+    """Same format contract as data.parser.MultiSlotParser, columnar output.
+
+    Raises RuntimeError at construction when the native lib is unavailable —
+    callers fall back to the Python parser.
+    """
+
+    def __init__(self, feed: DataFeedConfig, label_slot: str = "click") -> None:
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self.feed = feed
+        slots = list(feed.slots)
+        self._slot_types = np.array(
+            [0 if s.type == "uint64" else 1 for s in slots], np.int32)
+        self._used = np.array([1 if s.is_used else 0 for s in slots], np.int32)
+        self._dense_dims = np.array([s.dim for s in slots], np.int32)
+        label_idx = -1
+        for i, s in enumerate(slots):
+            if s.name == label_slot:
+                label_idx = i
+        self._label_idx = label_idx
+
+    def parse_file_columnar(self, path: str) -> ColumnarBlock:
+        lib = self._lib
+        c = ctypes
+        handle = lib.psr_parse_file(
+            path.encode(),
+            self._slot_types.ctypes.data_as(c.POINTER(c.c_int32)),
+            self._used.ctypes.data_as(c.POINTER(c.c_int32)),
+            self._dense_dims.ctypes.data_as(c.POINTER(c.c_int32)),
+            c.c_int32(self._slot_types.size), c.c_int32(self._label_idx))
+        if not handle:
+            raise FileNotFoundError(path)
+        try:
+            n_keys = lib.psr_n_keys(handle)
+            n_recs = lib.psr_n_recs(handle)
+            n_bad = lib.psr_n_bad(handle)
+            dense_dim = lib.psr_dense_dim(handle)
+            if n_bad:
+                stat_add("parser_bad_lines", int(n_bad))
+
+            def arr(ptr, n, dt):
+                if n == 0 or not ptr:
+                    return np.empty(0, dt)
+                return np.ctypeslib.as_array(ptr, shape=(n,)).astype(dt,
+                                                                     copy=True)
+
+            keys = arr(lib.psr_keys(handle), n_keys, np.uint64)
+            key_slot = arr(lib.psr_key_slot(handle), n_keys, np.int32)
+            key_rec = arr(lib.psr_key_rec(handle), n_keys, np.int64)
+            labels = arr(lib.psr_labels(handle), n_recs, np.int32)
+            dense = None
+            if dense_dim and n_recs:
+                dense = np.ctypeslib.as_array(
+                    lib.psr_dense(handle),
+                    shape=(n_recs, dense_dim)).astype(np.float32, copy=True)
+            return ColumnarBlock.from_key_rec(keys, key_slot, key_rec,
+                                             labels, dense)
+        finally:
+            lib.psr_free(handle)

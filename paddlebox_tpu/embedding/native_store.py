@@ -1,0 +1,178 @@
+"""Native-backed host embedding store (C++ open-addressing table + arena).
+
+Same public API as HostEmbeddingStore, delegating the hot paths (bulk
+lookup/create/gather/scatter, erase) to native/host_store.cc via ctypes —
+the per-key Python dict loop becomes a single C call per pass. The SSD
+spill tier stays on the Python store (make_host_store routes tables with
+ssd_dir there); DRAM-resident tables take this path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+from typing import Tuple
+
+import numpy as np
+
+from paddlebox_tpu.config.configs import TableConfig
+from paddlebox_tpu.embedding.accessor import ValueLayout, UNSEEN_DAYS
+from paddlebox_tpu.utils.stats import stat_add
+
+_U64P = ctypes.POINTER(ctypes.c_uint64)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_F32P = ctypes.POINTER(ctypes.c_float)
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _p(a: np.ndarray, ptr_t):
+    return a.ctypes.data_as(ptr_t)
+
+
+class NativeHostEmbeddingStore:
+    def __init__(self, layout: ValueLayout, table: TableConfig,
+                 seed: int = 0) -> None:
+        from paddlebox_tpu.native import get_lib
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self.layout = layout
+        self.table = table
+        self._rng = np.random.RandomState(seed)
+        self._h = lib.hs_create(layout.width, 0.75)
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.hs_destroy(h)
+            self._h = None
+
+    def __len__(self) -> int:
+        return int(self._lib.hs_size(self._h))
+
+    # ------------------------------------------------------------------ api
+    def _rows_of(self, keys: np.ndarray, create: bool
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        n = keys.size
+        rows = np.empty(n, np.int64)
+        if create:
+            created = np.empty(n, np.uint8)
+            self._lib.hs_lookup_or_create(self._h, _p(keys, _U64P), n,
+                                          _p(rows, _I64P), _p(created, _U8P))
+            return rows, created.astype(bool)
+        self._lib.hs_lookup(self._h, _p(keys, _U64P), n, _p(rows, _I64P))
+        return rows, np.zeros(n, bool)
+
+    def lookup_or_create(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        rows, created = self._rows_of(keys, create=True)
+        out = np.empty((keys.size, self.layout.width), np.float32)
+        self._lib.hs_gather(self._h, _p(rows, _I64P), keys.size,
+                            _p(out, _F32P))
+        n_new = int(created.sum())
+        if n_new:
+            init = self.layout.new_rows(n_new, self._rng,
+                                        self.table.optimizer)
+            out[created] = init
+            # persist the init back so the arena matches what we returned
+            new_rows = np.ascontiguousarray(rows[created])
+            self._lib.hs_scatter(self._h, _p(new_rows, _I64P), n_new,
+                                 _p(np.ascontiguousarray(init), _F32P))
+            stat_add("sparse_keys_created", n_new)
+        return out
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        rows, _ = self._rows_of(keys, create=False)
+        out = np.empty((keys.size, self.layout.width), np.float32)
+        self._lib.hs_gather(self._h, _p(rows, _I64P), keys.size,
+                            _p(out, _F32P))
+        return out
+
+    def write_back(self, keys: np.ndarray, values: np.ndarray) -> None:
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        rows, _ = self._rows_of(keys, create=False)
+        if (rows < 0).any():
+            raise KeyError("write_back of unknown key")
+        vals = np.ascontiguousarray(values, dtype=np.float32)
+        self._lib.hs_scatter(self._h, _p(rows, _I64P), keys.size,
+                             _p(vals, _F32P))
+
+    # ------------------------------------------------------------ lifecycle
+    def shrink(self) -> int:
+        keys, values = self.state_items()
+        if not keys.size:
+            return 0
+        mask = self.layout.shrink_mask(values, self.table)
+        self.write_back(keys, values)  # decay writeback
+        dead = np.ascontiguousarray(keys[mask])
+        if dead.size:
+            self._lib.hs_erase(self._h, _p(dead, _U64P), dead.size)
+            stat_add("sparse_keys_shrunk", int(dead.size))
+        return int(dead.size)
+
+    def age_unseen_days(self) -> None:
+        keys, values = self.state_items()
+        if keys.size:
+            values[:, UNSEEN_DAYS] += 1.0
+            self.write_back(keys, values)
+
+    # SSD tier: not on the native path (make_host_store routes ssd tables
+    # to the Python store)
+    def spill(self, max_resident: int) -> int:
+        return 0
+
+    def load_spilled(self) -> int:
+        return 0
+
+    # ---------------------------------------------------------- checkpoint
+    def state_items(self) -> Tuple[np.ndarray, np.ndarray]:
+        n = len(self)
+        keys = np.empty(n, np.uint64)
+        rows = np.empty(n, np.int64)
+        if n:
+            self._lib.hs_items(self._h, _p(keys, _U64P), _p(rows, _I64P))
+        values = np.empty((n, self.layout.width), np.float32)
+        if n:
+            self._lib.hs_gather(self._h, _p(rows, _I64P), n,
+                                _p(values, _F32P))
+        return keys, values
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        keys, values = self.state_items()
+        with open(path, "wb") as f:
+            pickle.dump({"keys": keys, "values": values,
+                         "embedx_dim": self.layout.embedx_dim,
+                         "optimizer": self.layout.optimizer}, f,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+    def load(self, path: str) -> None:
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        if blob["embedx_dim"] != self.layout.embedx_dim or \
+                blob["optimizer"] != self.layout.optimizer:
+            raise ValueError("checkpoint layout mismatch")
+        self._lib.hs_destroy(self._h)
+        self._h = self._lib.hs_create(self.layout.width, 0.75)
+        keys = np.ascontiguousarray(blob["keys"], np.uint64)
+        if keys.size:
+            rows, _ = self._rows_of(keys, create=True)
+            vals = np.ascontiguousarray(blob["values"], np.float32)
+            self._lib.hs_scatter(self._h, _p(rows, _I64P), keys.size,
+                                 _p(vals, _F32P))
+
+
+def make_host_store(layout: ValueLayout, table: TableConfig, seed: int = 0):
+    """Native store unless the table needs the SSD tier or the native lib
+    is unavailable."""
+    from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
+    if table.ssd_dir is None:
+        try:
+            return NativeHostEmbeddingStore(layout, table, seed)
+        except RuntimeError:
+            pass
+    return HostEmbeddingStore(layout, table, seed)

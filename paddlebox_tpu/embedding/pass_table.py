@@ -31,6 +31,7 @@ from paddlebox_tpu.config.configs import TableConfig
 from paddlebox_tpu.embedding import accessor as acc
 from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
 from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
+from paddlebox_tpu.embedding.native_store import make_host_store
 from paddlebox_tpu.embedding.optimizers import apply_push
 from paddlebox_tpu.utils.timer import Timer
 
@@ -63,7 +64,7 @@ class PassTable:
         self.config = table
         self.layout = ValueLayout(table.embedx_dim, table.optimizer.optimizer)
         self.push_layout = PushLayout(table.embedx_dim)
-        self.store = store or HostEmbeddingStore(self.layout, table, seed)
+        self.store = store or make_host_store(self.layout, table, seed)
         self.capacity = table.pass_capacity
         self._feed_keys: list = []
         self._pass_keys: Optional[np.ndarray] = None  # sorted unique

@@ -1,0 +1,11 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+Build-on-first-import with g++ (no pybind11 in the image); cached under
+native/_build keyed by source mtime. Falls back cleanly: callers check
+`available()` and use the pure-Python implementations when compilation is
+impossible (e.g. no compiler).
+"""
+
+from paddlebox_tpu.native.build import available, get_lib
+
+__all__ = ["available", "get_lib"]

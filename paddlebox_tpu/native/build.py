@@ -1,0 +1,108 @@
+"""Lazy g++ build + ctypes loader for the native components."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_DIR, "_build")
+_SOURCES = ["slot_parser.cc", "host_store.cc"]
+_LIB_NAME = "libpbtpu_native.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_failed = False
+
+
+def _needs_build(so_path: str) -> bool:
+    if not os.path.exists(so_path):
+        return True
+    so_m = os.path.getmtime(so_path)
+    return any(os.path.getmtime(os.path.join(_DIR, s)) > so_m
+               for s in _SOURCES)
+
+
+def _build() -> str:
+    os.makedirs(_BUILD, exist_ok=True)
+    so_path = os.path.join(_BUILD, _LIB_NAME)
+    if _needs_build(so_path):
+        srcs = [os.path.join(_DIR, s) for s in _SOURCES]
+        cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+               "-std=c++17", "-o", so_path + ".tmp", *srcs]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(so_path + ".tmp", so_path)
+    return so_path
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    P = c.POINTER
+    # slot parser
+    lib.psr_parse_file.restype = c.c_void_p
+    lib.psr_parse_file.argtypes = [c.c_char_p, P(c.c_int32), P(c.c_int32),
+                                   P(c.c_int32), c.c_int32, c.c_int32]
+    for name, res in [("psr_n_keys", c.c_int64), ("psr_n_recs", c.c_int64),
+                      ("psr_n_bad", c.c_int64), ("psr_dense_dim", c.c_int32),
+                      ("psr_keys", P(c.c_uint64)),
+                      ("psr_key_slot", P(c.c_int32)),
+                      ("psr_key_rec", P(c.c_int64)),
+                      ("psr_labels", P(c.c_int32)),
+                      ("psr_dense", P(c.c_float))]:
+        fn = getattr(lib, name)
+        fn.restype = res
+        fn.argtypes = [c.c_void_p]
+    lib.psr_free.restype = None
+    lib.psr_free.argtypes = [c.c_void_p]
+    # host store
+    lib.hs_create.restype = c.c_void_p
+    lib.hs_create.argtypes = [c.c_int32, c.c_double]
+    lib.hs_destroy.restype = None
+    lib.hs_destroy.argtypes = [c.c_void_p]
+    lib.hs_size.restype = c.c_uint64
+    lib.hs_size.argtypes = [c.c_void_p]
+    lib.hs_width.restype = c.c_int32
+    lib.hs_width.argtypes = [c.c_void_p]
+    lib.hs_lookup.restype = None
+    lib.hs_lookup.argtypes = [c.c_void_p, P(c.c_uint64), c.c_int64,
+                              P(c.c_int64)]
+    lib.hs_lookup_or_create.restype = None
+    lib.hs_lookup_or_create.argtypes = [c.c_void_p, P(c.c_uint64), c.c_int64,
+                                        P(c.c_int64), P(c.c_uint8)]
+    lib.hs_gather.restype = None
+    lib.hs_gather.argtypes = [c.c_void_p, P(c.c_int64), c.c_int64,
+                              P(c.c_float)]
+    lib.hs_scatter.restype = None
+    lib.hs_scatter.argtypes = [c.c_void_p, P(c.c_int64), c.c_int64,
+                               P(c.c_float)]
+    lib.hs_erase.restype = c.c_int64
+    lib.hs_erase.argtypes = [c.c_void_p, P(c.c_uint64), c.c_int64]
+    lib.hs_items.restype = c.c_int64
+    lib.hs_items.argtypes = [c.c_void_p, P(c.c_uint64), P(c.c_int64)]
+    lib.hs_arena.restype = P(c.c_float)
+    lib.hs_arena.argtypes = [c.c_void_p]
+    lib.hs_arena_rows.restype = c.c_int64
+    lib.hs_arena_rows.argtypes = [c.c_void_p]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _failed
+    if _lib is not None or _failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        try:
+            _lib = _bind(ctypes.CDLL(_build()))
+        except Exception:
+            _failed = True
+            _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None

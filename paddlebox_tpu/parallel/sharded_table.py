@@ -32,7 +32,7 @@ import numpy as np
 
 from paddlebox_tpu.config.configs import TableConfig
 from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
-from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
+from paddlebox_tpu.embedding.native_store import make_host_store
 from paddlebox_tpu.utils.stats import stat_add
 
 
@@ -72,7 +72,7 @@ class ShardedPassTable:
         if table.pass_capacity % num_shards:
             raise ValueError("pass_capacity must divide evenly into shards")
         self.shard_cap = table.pass_capacity // num_shards
-        self.stores = [HostEmbeddingStore(self.layout, table, seed + s)
+        self.stores = [make_host_store(self.layout, table, seed + s)
                        for s in range(num_shards)]
         self._feed_keys: List[np.ndarray] = []
         self._shard_keys: Optional[List[np.ndarray]] = None  # sorted unique per shard

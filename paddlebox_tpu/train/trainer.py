@@ -243,9 +243,7 @@ class BoxTrainer:
         """Test-mode inference over a loaded dataset (SetTestMode pulls)."""
         self.table.set_test_mode(True)
         self.table.begin_feed_pass()
-        self.table.add_keys(np.concatenate(
-            [r.all_keys() for r in dataset.records]) if len(dataset) else
-            np.empty(0, np.uint64))
+        self.table.add_keys(dataset.all_keys())
         self.table.end_feed_pass()
         self.table.begin_pass()
         preds_all, labels_all = [], []

@@ -49,7 +49,7 @@ def test_e2e_auc_lift(data):
                                 mask_var="mask")
 
     for epoch in range(6):
-        ds = BoxDataset(feed, read_threads=2)
+        ds = BoxDataset(feed, read_threads=1)
         ds.set_filelist(files)
         stats = trainer.train_pass(ds)
         assert stats["instances"] == 2400
@@ -62,7 +62,7 @@ def test_e2e_auc_lift(data):
     assert msg["size"] == 6 * 2400
 
     # fresh-eval AUC must beat 0.65 after training
-    ds = BoxDataset(feed, read_threads=2)
+    ds = BoxDataset(feed, read_threads=1)
     ds.set_filelist(files)
     trainer.table.begin_feed_pass()
     ds.load_into_memory(add_keys_fn=trainer.table.add_keys)
@@ -77,7 +77,7 @@ def test_e2e_auc_lift(data):
 def test_checkpoint_resume(data, tmp_path):
     files, feed = data
     trainer = make_trainer(feed)
-    ds = BoxDataset(feed, read_threads=2)
+    ds = BoxDataset(feed, read_threads=1)
     ds.set_filelist(files[:1])
     trainer.train_pass(ds)
 
