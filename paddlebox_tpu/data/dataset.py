@@ -55,6 +55,15 @@ class BoxDataset:
         self._native_parser = None
         if columnar is None:
             columnar = shuffler is None
+        if columnar and shuffler is not None:
+            # the shuffle transport routes SlotRecord objects; columnar
+            # blocks would bypass scatter and break the merge channel —
+            # downgrade to the record path
+            columnar = False
+        if columnar and feed.rank_offset:
+            # pv rank-offset matrices are built from per-record pv fields
+            # (search_id/rank/cmatch) which the columnar blocks don't carry
+            columnar = False
         if columnar:
             try:
                 from paddlebox_tpu.data.native_parser import \

@@ -57,7 +57,9 @@ class BatchPacker:
         self.max_rank = max_rank
 
     def pack(self, records: Sequence[SlotRecord],
-             with_rank_offset: bool = False) -> PackedBatch:
+             with_rank_offset: Optional[bool] = None) -> PackedBatch:
+        if with_rank_offset is None:
+            with_rank_offset = self.feed.rank_offset
         B = self.batch_size
         n = min(len(records), B)
         keys = np.zeros(self.kcap, dtype=np.uint64)
@@ -111,22 +113,27 @@ class BatchPacker:
 
     def _build_rank_offset(self, records: Sequence[SlotRecord],
                            B: int) -> np.ndarray:
-        """pv rank matrix (CopyRankOffsetKernel analog, data_feed.cu:1319):
-        col 0 = own rank; then (rank_of_peer, row_of_peer) pairs for each of
-        max_rank ad positions within the same pv (grouped by ins_id)."""
+        """pv rank matrix with CopyRankOffsetKernel parity
+        (data_feed.cu:1319-1369): col 0 = own effective rank (cmatch must be
+        a join channel and 0 < rank <= max_rank, else -1); then
+        (rank_of_peer, row_of_peer) pairs indexed by the peer's rank, peers
+        including the instance itself, grouped by search_id."""
+        from paddlebox_tpu.data.pv import _JOIN_CMATCH
         mr = self.max_rank
         out = -np.ones((B, 2 * mr + 1), dtype=np.int32)
-        by_pv = {}
+        by_pv: dict = {}
+        eff = []
         for row, rec in enumerate(records):
-            by_pv.setdefault(rec.ins_id, []).append(row)
+            by_pv.setdefault(rec.search_id, []).append(row)
+            eff.append(rec.rank if (rec.cmatch in _JOIN_CMATCH
+                                    and 0 < rec.rank <= mr) else -1)
         for row, rec in enumerate(records):
-            out[row, 0] = rec.rank
-            if rec.rank <= 0 or rec.rank > mr:
+            out[row, 0] = eff[row]
+            if eff[row] <= 0:
                 continue
-            for peer in by_pv.get(rec.ins_id, []):
-                prank = records[peer].rank
-                if peer == row or prank <= 0 or prank > mr:
-                    continue
-                out[row, 2 * (prank - 1) + 1] = prank
-                out[row, 2 * (prank - 1) + 2] = peer
+            for peer in by_pv[rec.search_id]:
+                if eff[peer] > 0:
+                    m = eff[peer] - 1
+                    out[row, 2 * m + 1] = records[peer].rank
+                    out[row, 2 * m + 2] = peer
         return out

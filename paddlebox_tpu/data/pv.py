@@ -1,0 +1,91 @@
+"""pv (search-session) instance grouping + rank-offset feed.
+
+TPU-native PadBoxSlotDataset::PreprocessInstance (data_set.cc:2646-2686) and
+SlotPaddleBoxDataFeed::CopyRankOffset / CopyRankOffsetKernel
+(data_feed.cu:1319-1385): join-phase models group the batch's ad instances by
+search session (pv) and feed a per-instance rank-offset matrix that tells
+rank_attention which peer ads share the pv and where they sit in the batch.
+
+The rank-offset row format consumed by ops/rank_attention.py:
+    col 0:      this ad's rank (1..max_rank) or -1 if invalid
+    col 2m+1:   rank of the peer with rank m+1 in the same pv (or -1)
+    col 2m+2:   batch row of that peer (or -1)
+A rank participates only when its cmatch tag is 222/223 and
+0 < rank <= max_rank (the join-phase ad channels, data_feed.cu:1331-1335).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.data.slot_record import SlotRecord
+
+_JOIN_CMATCH = (222, 223)
+
+
+def preprocess_instance(records: Sequence[SlotRecord],
+                        merge_by_sid: bool = True) -> List[List[int]]:
+    """Group record indices into pv instances (PreprocessInstance,
+    data_set.cc:2646): sort by search_id, one pv per distinct search_id
+    (or one pv per record when merge_by_sid is False)."""
+    order = sorted(range(len(records)), key=lambda i: records[i].search_id)
+    if not merge_by_sid:
+        return [[i] for i in order]
+    pvs: List[List[int]] = []
+    last_sid = None
+    for i in order:
+        sid = records[i].search_id
+        if last_sid is None or sid != last_sid:
+            pvs.append([i])
+            last_sid = sid
+        else:
+            pvs[-1].append(i)
+    return pvs
+
+
+def build_rank_offset(ranks: np.ndarray, cmatchs: np.ndarray,
+                      pv_offsets: np.ndarray, max_rank: int = 3) -> np.ndarray:
+    """CopyRankOffsetKernel (data_feed.cu:1319-1369) on host.
+
+    ranks/cmatchs: [N] per-ad (batch order, pvs contiguous);
+    pv_offsets: [P+1] CSR offsets of pvs into the ad axis.
+    Returns [N, 1+2*max_rank] int32, -1 filled.
+    """
+    n = int(ranks.shape[0])
+    cols = 2 * max_rank + 1
+    mat = np.full((n, cols), -1, dtype=np.int32)
+    eff = np.where(
+        np.isin(cmatchs, _JOIN_CMATCH) & (ranks > 0) & (ranks <= max_rank),
+        ranks, -1).astype(np.int32)
+    for p in range(len(pv_offsets) - 1):
+        lo, hi = int(pv_offsets[p]), int(pv_offsets[p + 1])
+        mat[lo:hi, 0] = eff[lo:hi]
+        members = [(int(eff[k]), k) for k in range(lo, hi) if eff[k] > 0]
+        for j in range(lo, hi):
+            if eff[j] <= 0:
+                continue
+            for fast_rank, k in members:
+                m = fast_rank - 1
+                mat[j, 2 * m + 1] = ranks[k]
+                mat[j, 2 * m + 2] = k
+    return mat
+
+
+def pack_pv_batch(records: Sequence[SlotRecord], pvs: List[List[int]],
+                  max_rank: int = 3) -> Tuple[List[int], np.ndarray]:
+    """Order a batch's records pv-contiguously and build its rank-offset
+    matrix (the join-phase feed path, data_feed.cc:3217-3238).
+
+    Returns (record order, rank_offset [N, 1+2*max_rank])."""
+    order: List[int] = []
+    pv_offsets = [0]
+    for pv in pvs:
+        order.extend(pv)
+        pv_offsets.append(len(order))
+    ranks = np.array([records[i].rank for i in order], np.int32)
+    cmatchs = np.array([records[i].cmatch for i in order], np.int32)
+    mat = build_rank_offset(ranks, cmatchs,
+                            np.asarray(pv_offsets, np.int64), max_rank)
+    return order, mat

@@ -57,10 +57,23 @@ def _state_widths(optimizer: str, embedx_dim: int) -> Tuple[int, int]:
 
 @dataclasses.dataclass(frozen=True)
 class ValueLayout:
-    """Column map for one table; hashable so jitted fns can close over it."""
+    """Column map for one table; hashable so jitted fns can close over it.
+
+    expand_dim > 0 adds an expand-embedding block (the NN-cross features of
+    pull_box_extended_sparse, operators/pull_box_extended_sparse_op.*;
+    GetInsEx(embedx_dim, expand_embed_dim) in box_wrapper.h:650): columns
+    [expand_w[E], expand_g2sum] after the embedx state, updated with the
+    shared-g2sum adagrad rule. Only adagrad/naive tables support expand.
+    """
 
     embedx_dim: int
     optimizer: str = "adagrad"
+    expand_dim: int = 0
+
+    def __post_init__(self):
+        if self.expand_dim and self.optimizer not in ("adagrad", "naive"):
+            raise ValueError(
+                "expand_dim requires adagrad/naive sparse optimizer")
 
     @property
     def embed_state_dim(self) -> int:
@@ -83,8 +96,20 @@ class ValueLayout:
         return self.embedx_w + self.embedx_dim
 
     @property
-    def width(self) -> int:
+    def expand_w(self) -> int:
         return self.embedx_state + self.embedx_state_dim
+
+    @property
+    def expand_state_dim(self) -> int:
+        return 1 if (self.expand_dim and self.optimizer == "adagrad") else 0
+
+    @property
+    def expand_state(self) -> int:
+        return self.expand_w + self.expand_dim
+
+    @property
+    def width(self) -> int:
+        return self.expand_state + self.expand_state_dim
 
     # pull view: [show, click, embed_w, embedx_w...]  (CVM columns first, the
     # order PullCopy emits — box_wrapper.cu:75-120)
@@ -151,10 +176,12 @@ class ValueLayout:
 
 @dataclasses.dataclass(frozen=True)
 class PushLayout:
-    """Per-key gradient row: [slot, show, click, embed_g, embedx_g[D]]
-    (CommonPushValue, feature_value.h:176-…)."""
+    """Per-key gradient row: [slot, show, click, embed_g, embedx_g[D],
+    expand_g[E]] (CommonPushValue, feature_value.h:176-…; the expand grads are
+    the push_box_extended_sparse backward inputs)."""
 
     embedx_dim: int
+    expand_dim: int = 0
 
     SLOT = 0
     SHOW = 1
@@ -166,5 +193,9 @@ class PushLayout:
         return 4
 
     @property
-    def width(self) -> int:
+    def expand_g(self) -> int:
         return 4 + self.embedx_dim
+
+    @property
+    def width(self) -> int:
+        return 4 + self.embedx_dim + self.expand_dim

@@ -63,7 +63,7 @@ def apply_push(values: jnp.ndarray, grads: jnp.ndarray, prng: jax.Array,
     prng:   key for lazy embedx init
     Returns updated rows; rows with g_show == 0 are passed through untouched.
     """
-    push = PushLayout(layout.embedx_dim)
+    push = PushLayout(layout.embedx_dim, layout.expand_dim)
     D = layout.embedx_dim
     g_show = grads[:, push.SHOW:push.SHOW + 1]
     g_click = grads[:, push.CLICK:push.CLICK + 1]
@@ -176,6 +176,31 @@ def apply_push(values: jnp.ndarray, grads: jnp.ndarray, prng: jax.Array,
             jnp.where(has_mf & active, newstate, oldstate))
     out = out.at[:, acc.MF_SIZE:acc.MF_SIZE + 1].set(
         jnp.where(create, float(D), mf_size))
+
+    # expand-embedding block (pull_box_extended_sparse backward): shares the
+    # embedx lazy-creation gate, shared-g2sum adagrad or naive update
+    E = layout.expand_dim
+    if E:
+        ew0 = layout.expand_w
+        expand = values[:, ew0:ew0 + E]
+        eg = grads[:, push.expand_g:push.expand_g + E]
+        if layout.optimizer == "adagrad":
+            es2 = layout.expand_state
+            newe, newe_g2 = _adagrad_step(
+                expand, values[:, es2:es2 + 1], eg, scale,
+                jnp.full_like(w, conf.mf_learning_rate),
+                conf.mf_initial_g2sum, conf.mf_min_bound, conf.mf_max_bound)
+            out = out.at[:, es2:es2 + 1].set(
+                jnp.where(has_mf & active, newe_g2, values[:, es2:es2 + 1]))
+        else:  # naive
+            newe = jnp.clip(expand + conf.mf_learning_rate * (eg / scale),
+                            conf.mf_min_bound, conf.mf_max_bound)
+        fresh_e = jax.random.uniform(
+            jax.random.fold_in(prng, 1), expand.shape, expand.dtype,
+            0.0, conf.mf_initial_range)
+        out = out.at[:, ew0:ew0 + E].set(
+            jnp.where(create, fresh_e,
+                      jnp.where(has_mf & active, newe, expand)))
 
     # padding / zero-show rows pass through untouched
     return jnp.where(active, out, values)

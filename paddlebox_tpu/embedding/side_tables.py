@@ -1,0 +1,105 @@
+"""Auxiliary device-replicated side tables.
+
+TPU-native GpuReplicaCache (box_wrapper.h:62-121) and InputTable
+(box_wrapper.h:123-180): small append-only embedding tables that the data
+pipeline fills on the host and every device reads fully replicated — used for
+replica-cached quantized embeddings (`pull_cache_value` op) and for
+string-keyed auxiliary input rows (`lookup_input` op / InputTableDataFeed).
+
+Where the reference cudaMemcpys one copy per GPU (ToHBM, box_wrapper.h:83),
+here one jnp array is replicated by the mesh sharding (P() spec) and lookup
+is a plain gather that XLA fuses into the consumer.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class ReplicaCache:
+    """Append rows on host during feed; freeze to a device array for the
+    pass (GpuReplicaCache: AddItems → ToHBM → PullCacheValue)."""
+
+    def __init__(self, dim: int) -> None:
+        self.dim = dim
+        self._rows: List[np.ndarray] = []
+        self._lock = threading.Lock()
+        self._device: Optional[jnp.ndarray] = None
+
+    def add_items(self, emb: np.ndarray) -> int:
+        """Append one row; returns its index (AddItems, box_wrapper.h:73)."""
+        emb = np.asarray(emb, np.float32).reshape(-1)
+        if emb.size != self.dim:
+            raise ValueError(f"expected dim {self.dim}, got {emb.size}")
+        with self._lock:
+            self._rows.append(emb)
+            self._device = None  # invalidate the frozen copy
+            return len(self._rows) - 1
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def to_device(self) -> jnp.ndarray:
+        """Freeze → [n, dim] device array (ToHBM analog; callers device_put
+        with a replicated sharding on a mesh)."""
+        with self._lock:
+            host = (np.stack(self._rows) if self._rows
+                    else np.zeros((1, self.dim), np.float32))
+        self._device = jnp.asarray(host)
+        return self._device
+
+    def pull(self, idx: jnp.ndarray) -> jnp.ndarray:
+        """pull_cache_value op: gather cached rows by index."""
+        if self._device is None:
+            self.to_device()
+        return self._device[idx]
+
+
+class InputTable:
+    """String key → aux feature row; misses map to the zero row at offset 0
+    (InputTable, box_wrapper.h:123-180: AddIndexData/GetIndexOffset/
+    LookupInput)."""
+
+    def __init__(self, dim: int) -> None:
+        self.dim = dim
+        self._offsets: Dict[str, int] = {}
+        self._rows: List[np.ndarray] = []
+        self._lock = threading.Lock()
+        self._device: Optional[jnp.ndarray] = None
+        self.miss = 0
+        self.add_index_data("-", np.zeros(dim, np.float32))
+
+    def add_index_data(self, key: str, vec: np.ndarray) -> None:
+        vec = np.asarray(vec, np.float32).reshape(-1)
+        if vec.size != self.dim:
+            raise ValueError(f"expected dim {self.dim}, got {vec.size}")
+        with self._lock:
+            self._offsets[key] = len(self._rows)
+            self._rows.append(vec)
+            self._device = None
+
+    def get_index_offset(self, key: str) -> int:
+        off = self._offsets.get(key)
+        if off is None:
+            self.miss += 1
+            return 0
+        return off
+
+    def size(self) -> int:
+        return len(self._rows)
+
+    def to_device(self) -> jnp.ndarray:
+        with self._lock:
+            host = np.stack(self._rows)
+        self._device = jnp.asarray(host)
+        return self._device
+
+    def lookup_input(self, offsets: jnp.ndarray) -> jnp.ndarray:
+        """lookup_input op: gather rows by pre-translated offsets."""
+        if self._device is None:
+            self.to_device()
+        return self._device[offsets]

@@ -5,6 +5,7 @@ from paddlebox_tpu.models.wide_deep import WideDeep
 from paddlebox_tpu.models.dlrm import DLRM
 from paddlebox_tpu.models.mmoe import MMoE
 from paddlebox_tpu.models.esmm import ESMM
+from paddlebox_tpu.models.join_pv import JoinPvDnn
 
 MODEL_ZOO = {
     "ctr_dnn": CtrDnn,
@@ -13,7 +14,8 @@ MODEL_ZOO = {
     "dlrm": DLRM,
     "mmoe": MMoE,
     "esmm": ESMM,
+    "join_pv_dnn": JoinPvDnn,
 }
 
 __all__ = ["mlp_init", "mlp_apply", "CtrDnn", "DeepFM", "WideDeep", "DLRM",
-           "MMoE", "ESMM", "MODEL_ZOO"]
+           "MMoE", "ESMM", "JoinPvDnn", "MODEL_ZOO"]

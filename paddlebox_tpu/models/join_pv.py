@@ -1,0 +1,59 @@
+"""Join-phase pv ranking model: rank_attention over session peers + MLP.
+
+The model family the reference's rank_attention/batch_fc ops exist for
+(operators/rank_attention_op.*, batch_fc_op.*): each ad instance attends over
+the other ads in its pv (search session) through a per-(rank, peer-rank)
+parameter block, and the attention output joins the pooled slot features in
+the ranking MLP. Batches must be packed pv-contiguously with a rank-offset
+matrix (data/pv.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.models.layers import mlp_apply, mlp_init
+from paddlebox_tpu.ops.rank_attention import rank_attention
+
+
+class JoinPvDnn:
+    name = "join_pv_dnn"
+    task_names = ("ctr",)
+
+    def __init__(self, spec: ModelSpec, max_rank: int = 3,
+                 att_dim: int = 64,
+                 hidden: Sequence[int] = (512, 256, 128)) -> None:
+        self.spec = spec
+        self.max_rank = max_rank
+        self.att_dim = att_dim
+        self.hidden = tuple(hidden)
+
+    def init(self, rng: jax.Array) -> Dict:
+        r_mlp, r_att = jax.random.split(rng)
+        F = self.spec.sparse_in
+        params = mlp_init(
+            r_mlp, [F + self.att_dim + self.spec.dense_dim, *self.hidden, 1],
+            "dnn")
+        params["rank_param"] = (jax.random.normal(
+            r_att, (self.max_rank * self.max_rank * F, self.att_dim))
+            * jnp.sqrt(1.0 / F)).astype(jnp.float32)
+        return params
+
+    def apply(self, params: Dict, pooled: jnp.ndarray,
+              dense: Optional[jnp.ndarray] = None,
+              rank_offset: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        x = pooled.reshape(pooled.shape[0], -1)
+        if rank_offset is None:
+            # update-phase fallback: no pv context → zero attention
+            att = jnp.zeros((x.shape[0], self.att_dim), x.dtype)
+        else:
+            att, _ = rank_attention(x, rank_offset, params["rank_param"],
+                                    self.max_rank)
+        feats = [x, att]
+        if dense is not None:
+            feats.append(dense)
+        return mlp_apply(params, jnp.concatenate(feats, axis=-1), "dnn")[:, 0]

@@ -31,10 +31,14 @@ def _build() -> str:
     so_path = os.path.join(_BUILD, _LIB_NAME)
     if _needs_build(so_path):
         srcs = [os.path.join(_DIR, s) for s in _SOURCES]
-        cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-               "-std=c++17", "-o", so_path + ".tmp", *srcs]
+        # portable codegen (no -march=native: the .so may outlive the host
+        # that compiled it); per-process tmp name so concurrent first-import
+        # builds can't clobber each other's output before os.replace
+        tmp = f"{so_path}.{os.getpid()}.tmp"
+        cmd = ["g++", "-O3", "-shared", "-fPIC",
+               "-std=c++17", "-o", tmp, *srcs]
         subprocess.run(cmd, check=True, capture_output=True)
-        os.replace(so_path + ".tmp", so_path)
+        os.replace(tmp, so_path)
     return so_path
 
 

@@ -140,11 +140,8 @@ ParsedFile* psr_parse_file(const char* path, const int32_t* slot_types,
         if (used[s]) d_off += dense_dims[s];
       }
     }
-    // trailing garbage on the line is malformed
-    if (ok) {
-      q = skip_ws(q, line_end);
-      if (q < line_end) ok = false;
-    }
+    // trailing extras (e.g. appended ins_id columns) are ignored, matching
+    // the Python MultiSlotParser's behavior
     if (!ok) {
       ++n_bad;
       continue;

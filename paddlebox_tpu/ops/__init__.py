@@ -2,16 +2,37 @@ from paddlebox_tpu.ops.sparse import (
     pull_sparse,
     build_push_grads,
     pull_sparse_differentiable,
+    pull_sparse_extended,
+    build_push_grads_extended,
 )
-from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm, cvm_transform
-from paddlebox_tpu.ops.data_norm import data_norm, data_norm_summary_update
+from paddlebox_tpu.ops.seqpool import (
+    fused_seqpool_cvm,
+    fused_seqpool_cvm_with_conv,
+    cvm_transform,
+    cvm_conv_transform,
+)
+from paddlebox_tpu.ops.data_norm import (
+    data_norm,
+    data_norm_summary_update,
+    masked_data_norm,
+    masked_data_norm_stat_update,
+)
+from paddlebox_tpu.ops.rank_attention import rank_attention, batch_fc
 
 __all__ = [
     "pull_sparse",
     "build_push_grads",
     "pull_sparse_differentiable",
+    "pull_sparse_extended",
+    "build_push_grads_extended",
     "fused_seqpool_cvm",
+    "fused_seqpool_cvm_with_conv",
     "cvm_transform",
+    "cvm_conv_transform",
     "data_norm",
     "data_norm_summary_update",
+    "masked_data_norm",
+    "masked_data_norm_stat_update",
+    "rank_attention",
+    "batch_fc",
 ]

@@ -58,6 +58,44 @@ def data_norm(x: jnp.ndarray, state: DataNormState,
     return y
 
 
+def masked_data_norm(x: jnp.ndarray, mask: jnp.ndarray,
+                     state: DataNormState) -> jnp.ndarray:
+    """masked_data_norm_op (operators/masked_data_norm_op.cu:39-51): rows with
+    mask True are normalized, rows with mask False emit zeros."""
+    mean = state.batch_sum / state.batch_size
+    scale = jnp.sqrt(state.batch_size / state.batch_square_sum)
+    mask = mask.reshape(-1).astype(bool)
+    return jnp.where(mask[:, None], (x - mean) * scale, 0.0)
+
+
+def masked_data_norm_stat_update(state: DataNormState, x: jnp.ndarray,
+                                 mask: jnp.ndarray,
+                                 decay: float = 0.9999999,
+                                 squared_sum_epsilon: float = 1e-4
+                                 ) -> DataNormState:
+    """KernelMaskedDataNormBPStat + KernelUpdateParam
+    (masked_data_norm_op.cu:81-131): per-column stats over masked rows only,
+    normalized to batch_size=1; empty batches skip the decay entirely."""
+    mask = mask.reshape(-1).astype(bool)
+    mean = state.batch_sum / state.batch_size
+    n = mask.sum()
+    cnt = jnp.maximum(n, 1).astype(jnp.float32)
+    xs = jnp.where(mask[:, None], x, 0.0)
+    sq = jnp.where(mask[:, None], (x - mean) ** 2, 0.0)
+    d_size = jnp.where(n > 0, 1.0, 0.0)
+    d_sum = xs.sum(axis=0) / cnt
+    d_sq = sq.sum(axis=0) / cnt + squared_sum_epsilon
+    keep = n > 0
+    return DataNormState(
+        batch_size=jnp.where(keep, state.batch_size * decay + d_size,
+                             state.batch_size),
+        batch_sum=jnp.where(keep, state.batch_sum * decay + d_sum,
+                            state.batch_sum),
+        batch_square_sum=jnp.where(keep, state.batch_square_sum * decay + d_sq,
+                                   state.batch_square_sum),
+    )
+
+
 def data_norm_summary_update(state: DataNormState, x: jnp.ndarray,
                              decay: float = 0.9999999,
                              slot_dim: int = 0) -> DataNormState:

@@ -1,0 +1,68 @@
+"""Join-phase pv ops: rank_attention and batch_fc.
+
+TPU-native rank_attention_op (paddle/fluid/operators/rank_attention_op.cc,
+rank_attention.cu.h) and batch_fc_op (operators/batch_fc_op.{cc,cu,h}) — the
+position/rank attention and per-slot batched FC used in join-phase pv
+(search-session) models.
+
+The reference implements forward as two expand kernels
+(expand_input_by_rank_kernel, expand_rank_attention_param_kernel) feeding a
+batched GEMM, with three hand-written gradient merge kernels. Here both ops
+are pure gather + einsum, so XLA autodiff derives the merges and the batched
+GEMM tiles straight onto the MXU.
+
+rank_offset row format (built by the rank-offset feed, data_feed.cu:1319):
+    col 0:        this instance's rank within its pv, 1-based (<=0 invalid)
+    col 2k+1:     rank of the k-th peer ad in the same pv (1-based, 0 absent)
+    col 2k+2:     row index of that peer instance in the batch (-1 absent)
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rank_attention(x: jnp.ndarray, rank_offset: jnp.ndarray,
+                   rank_param: jnp.ndarray, max_rank: int = 3
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x: [N, F]; rank_offset: [N, 1+2*max_rank] int32;
+    rank_param: [max_rank*max_rank*F, out_dim].
+
+    Returns (out [N, out_dim], ins_rank [N, 1]).
+
+    Per instance i with rank r=rank_offset[i,0] and peers k with rank f_k and
+    batch row idx_k: out[i] = Σ_k x[idx_k] @ P[(r-1)*max_rank + (f_k-1)] where
+    P is rank_param viewed [max_rank², F, out_dim]
+    (expand_rank_attention_param_kernel, rank_attention.cu.h:67-95).
+    Invalid (r<=0 or f_k<=0) contributions are zero.
+    """
+    N, F = x.shape
+    out_dim = rank_param.shape[1]
+    pview = rank_param.reshape(max_rank * max_rank, F, out_dim)
+
+    ins_rank = rank_offset[:, 0].astype(jnp.int32)            # [N] 1-based
+    ks = jnp.arange(max_rank)
+    peer_rank = rank_offset[:, 2 * ks + 1].astype(jnp.int32)  # [N, R]
+    peer_idx = rank_offset[:, 2 * ks + 2].astype(jnp.int32)   # [N, R]
+
+    valid = (ins_rank[:, None] > 0) & (peer_rank > 0)         # [N, R]
+    safe_idx = jnp.clip(peer_idx, 0, N - 1)
+    # input_help[i, k] = X[peer_idx_k] (expand_input_by_rank_kernel)
+    input_help = jnp.where(valid[:, :, None], x[safe_idx], 0.0)  # [N, R, F]
+
+    sel = (ins_rank[:, None] - 1) * max_rank + (peer_rank - 1)   # [N, R]
+    sel = jnp.clip(sel, 0, max_rank * max_rank - 1)
+    param_help = jnp.where(valid[:, :, None, None], pview[sel], 0.0)
+
+    out = jnp.einsum("nkf,nkfo->no", input_help, param_help)
+    return out, ins_rank[:, None].astype(x.dtype)
+
+
+def batch_fc(x: jnp.ndarray, w: jnp.ndarray, bias: jnp.ndarray) -> jnp.ndarray:
+    """Per-slot batched FC (batch_fc_op.cu): x [S, N, in], w [S, in, out],
+    bias [S, out] → [S, N, out]. One bmm on the MXU + broadcast bias
+    (the reference's blas.BatchedGEMM + add_bias_kernel)."""
+    return jnp.einsum("sni,sio->sno", x, w) + bias[:, None, :]

@@ -47,3 +47,46 @@ def fused_seqpool_cvm(emb: jnp.ndarray, segments: jnp.ndarray,
         masked, segments, num_segments=batch_size * num_slots)
     pooled = pooled.reshape(batch_size, num_slots, emb.shape[-1])
     return cvm_transform(pooled, use_cvm)
+
+
+def cvm_conv_transform(pooled: jnp.ndarray, use_cvm: bool = True,
+                       show_filter: bool = False) -> jnp.ndarray:
+    """Conv variant (fused_seqpool_cvm_with_conv_op.cu FusedCVMWithConvKernel*):
+    counter cols are [show, click, conv]; output cols
+    [log(show+1), log(click+1), log(conv+1)-log(click+1), emb...].
+    show_filter drops the show column (KernelWithOutShow)."""
+    show = pooled[..., 0:1]
+    click = pooled[..., 1:2]
+    conv = pooled[..., 2:3]
+    rest = pooled[..., 3:]
+    if not use_cvm:
+        return rest
+    log_show = jnp.log(show + 1.0)
+    log_click = jnp.log(click + 1.0)
+    log_convr = jnp.log(conv + 1.0) - log_click
+    cols = ([log_click, log_convr] if show_filter
+            else [log_show, log_click, log_convr])
+    return jnp.concatenate(cols + [rest], axis=-1)
+
+
+def fused_seqpool_cvm_with_conv(
+        emb: jnp.ndarray, segments: jnp.ndarray, valid: jnp.ndarray,
+        batch_size: int, num_slots: int, use_cvm: bool = True,
+        need_filter: bool = False, show_coeff: float = 0.2,
+        clk_coeff: float = 1.0, threshold: float = 0.96,
+        show_filter: bool = False) -> jnp.ndarray:
+    """fused_seqpool_cvm_with_conv_op: pull view is [show, click, conv, emb...]
+    per key. need_filter drops keys whose show/click score
+    (show-click)*show_coeff + click*clk_coeff falls under threshold before
+    pooling (FusedSeqpoolWithConvKernelFilter, with_conv_op.cu:58-88)."""
+    keep = valid
+    if need_filter:
+        show = emb[:, 0]
+        click = emb[:, 1]
+        keep = keep & ((show - click) * show_coeff + click * clk_coeff
+                       >= threshold)
+    masked = jnp.where(keep[:, None], emb, 0.0)
+    pooled = jax.ops.segment_sum(
+        masked, segments, num_segments=batch_size * num_slots)
+    pooled = pooled.reshape(batch_size, num_slots, emb.shape[-1])
+    return cvm_conv_transform(pooled, use_cvm, show_filter)

@@ -62,6 +62,39 @@ def build_push_grads(d_emb: jnp.ndarray, slots: jnp.ndarray,
     ], axis=1)
 
 
+def pull_sparse_extended(slab: jnp.ndarray, ids: jnp.ndarray,
+                         layout: ValueLayout):
+    """pull_box_extended_sparse (operators/pull_box_extended_sparse_op.*):
+    dual-output lookup — the base pull view [K, 3+D] plus the expand
+    (NN-cross) embedding [K, E]. Requires layout.expand_dim > 0."""
+    if not layout.expand_dim:
+        raise ValueError("layout has no expand block (expand_dim == 0)")
+    rows = slab[ids]
+    ew0 = layout.expand_w
+    base = jnp.concatenate([
+        rows[:, acc.SHOW:acc.SHOW + 1],
+        rows[:, acc.CLICK:acc.CLICK + 1],
+        rows[:, acc.EMBED_W:acc.EMBED_W + 1],
+        rows[:, layout.embedx_w:layout.embedx_w + layout.embedx_dim],
+    ], axis=1)
+    return base, rows[:, ew0:ew0 + layout.expand_dim]
+
+
+def build_push_grads_extended(d_emb: jnp.ndarray, d_expand: jnp.ndarray,
+                              slots: jnp.ndarray, clicks: jnp.ndarray,
+                              valid: jnp.ndarray) -> jnp.ndarray:
+    """Push rows [K, 4+D+E] including the expand-block gradient
+    (push_box_extended_sparse backward)."""
+    v = valid.astype(d_emb.dtype)[:, None]
+    return jnp.concatenate([
+        slots.astype(d_emb.dtype)[:, None],
+        v,
+        clicks.astype(d_emb.dtype)[:, None] * v,
+        d_emb[:, 2:] * v,
+        d_expand * v,
+    ], axis=1)
+
+
 # ---------------------------------------------------------------- full graph
 import functools
 

@@ -85,6 +85,8 @@ class ShardedBoxTrainer:
         use_cvm = self.use_cvm
         multi_task = self.multi_task
         axis = self.axis
+        from paddlebox_tpu.train.trainer import model_accepts_rank_offset
+        wants_rank_offset = model_accepts_rank_offset(model)
 
         def shard_step(slab, params, opt_state, batch, prng):
             # per-device views: slab [1, C, W]; batch leaves [1, ...]
@@ -105,7 +107,11 @@ class ShardedBoxTrainer:
             def loss_fn(params, emb):
                 pooled = fused_seqpool_cvm(
                     emb, batch["segments"], batch["valid"], B, S, use_cvm)
-                logits = model.apply(params, pooled, batch.get("dense"))
+                if wants_rank_offset and "rank_offset" in batch:
+                    logits = model.apply(params, pooled, batch.get("dense"),
+                                         rank_offset=batch["rank_offset"])
+                else:
+                    logits = model.apply(params, pooled, batch.get("dense"))
                 ins_valid = batch["ins_valid"]
                 if multi_task:
                     labels = {t: batch["labels_" + t] for t in model.task_names}
@@ -176,6 +182,8 @@ class ShardedBoxTrainer:
                 }
                 if b.dense is not None:
                     leaves["dense"] = b.dense
+                if b.rank_offset is not None:
+                    leaves["rank_offset"] = b.rank_offset
                 if self.multi_task:
                     for t in self.model.task_names:
                         leaves["labels_" + t] = b.labels

@@ -88,18 +88,32 @@ def _multi_task_loss(logits, labels_dict, ins_valid, loss_mode: str = "sum"):
     return total, preds
 
 
+def model_accepts_rank_offset(model) -> bool:
+    """Join-phase models take the pv rank matrix as a keyword arg."""
+    import inspect
+    try:
+        return "rank_offset" in inspect.signature(model.apply).parameters
+    except (TypeError, ValueError):
+        return False
+
+
 def make_train_step(model, layout: ValueLayout, table: TableConfig,
                     dense_opt: optax.GradientTransformation,
                     batch_size: int, num_slots: int,
                     use_cvm: bool = True) -> TrainStepFns:
     conf = table.optimizer
     multi_task = len(getattr(model, "task_names", ("ctr",))) > 1
+    wants_rank_offset = model_accepts_rank_offset(model)
 
     def forward(params, emb, batch, dn_extra):
         pooled = fused_seqpool_cvm(
             emb, batch["segments"], batch["valid"], batch_size, num_slots,
             use_cvm=use_cvm)
-        logits = model.apply(params, pooled, batch.get("dense"))
+        if wants_rank_offset and "rank_offset" in batch:
+            logits = model.apply(params, pooled, batch.get("dense"),
+                                 rank_offset=batch["rank_offset"])
+        else:
+            logits = model.apply(params, pooled, batch.get("dense"))
         ins_valid = batch["ins_valid"]
         if multi_task:
             labels = {t: batch["labels_" + t] for t in model.task_names}
@@ -185,6 +199,8 @@ class BoxTrainer:
         }
         if b.dense is not None:
             out["dense"] = jnp.asarray(b.dense)
+        if b.rank_offset is not None:
+            out["rank_offset"] = jnp.asarray(b.rank_offset)
         if self.multi_task:
             # single-label data trains every task on the same label unless
             # the dataset packed task labels (labels_<task> fields)

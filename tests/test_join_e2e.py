@@ -1,0 +1,78 @@
+"""End-to-end join-phase training: pv batches with rank_offset reach the
+model and train rank_param (the wiring the reference drives through
+SlotPaddleBoxDataFeed's rank-offset feed + rank_attention op)."""
+
+import numpy as np
+import jax
+
+from paddlebox_tpu.config.configs import (DataFeedConfig, SlotConfig,
+                                          SparseOptimizerConfig, TableConfig,
+                                          TrainerConfig)
+from paddlebox_tpu.data.dataset import BoxDataset
+from paddlebox_tpu.data.packer import BatchPacker
+from paddlebox_tpu.data.slot_record import SlotRecord
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.models.join_pv import JoinPvDnn
+from paddlebox_tpu.train.trainer import BoxTrainer
+
+D = 4
+NUM_SLOTS = 3
+B = 16
+
+
+def _feed():
+    slots = tuple(SlotConfig(name=f"s{i}", type="uint64", max_len=3)
+                  for i in range(NUM_SLOTS))
+    return DataFeedConfig(slots=slots, batch_size=B, rank_offset=True)
+
+
+def _records(n=48, seed=0):
+    rng = np.random.RandomState(seed)
+    recs = []
+    for i in range(n):
+        slots = {si: rng.randint(1, 4000, rng.randint(1, 3)).astype(np.uint64)
+                 for si in range(NUM_SLOTS)}
+        recs.append(SlotRecord(
+            label=int(rng.rand() < 0.3), uint64_slots=slots,
+            search_id=i // 3,                # 3 ads per pv
+            rank=(i % 3) + 1, cmatch=222))
+    return recs
+
+
+def test_packer_emits_rank_offset_from_feed_config():
+    feed = _feed()
+    packer = BatchPacker(feed)
+    b = packer.pack(_records(B))
+    assert b.rank_offset is not None
+    assert b.rank_offset.shape == (B, 2 * packer.max_rank + 1)
+    # ads of pv 0 (rows 0,1,2) are mutual peers including self
+    assert b.rank_offset[0, 0] == 1
+    assert b.rank_offset[0, 2] == 0    # rank-1 peer is row 0 itself
+    assert b.rank_offset[0, 4] == 1    # rank-2 peer is row 1
+
+
+def test_join_pv_trains_rank_param_e2e(tmp_path):
+    feed = _feed()
+    table_cfg = TableConfig(embedx_dim=D, pass_capacity=1 << 12,
+                            optimizer=SparseOptimizerConfig())
+    spec = ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + D)
+    model = JoinPvDnn(spec, max_rank=3, att_dim=8, hidden=(16,))
+    trainer = BoxTrainer(model, table_cfg, feed,
+                         TrainerConfig(dense_lr=0.1), seed=0)
+
+    files = []
+    recs = _records()
+    path = tmp_path / "pv_data.txt"
+    # write via the dataset's record path: bypass file parsing by injecting
+    # records directly (the parser path is covered by data tests)
+    ds = BoxDataset(feed, read_threads=1)
+    ds._records = recs
+    trainer.table.begin_feed_pass()
+    trainer.table.add_keys(np.concatenate([r.all_keys() for r in recs]))
+    trainer.table.end_feed_pass()
+
+    before = np.asarray(trainer.params["rank_param"]).copy()
+    stats = trainer.train_pass(ds, preloaded=True)
+    after = np.asarray(trainer.params["rank_param"])
+    assert stats["batches"] >= 1
+    assert not np.allclose(before, after), "rank_param must receive updates"

@@ -60,7 +60,7 @@ def test_multi_task_models(cls, inputs):
 
 def test_zoo_registry():
     assert set(MODEL_ZOO) == {"ctr_dnn", "deepfm", "wide_deep", "dlrm",
-                              "mmoe", "esmm"}
+                              "mmoe", "esmm", "join_pv_dnn"}
 
 
 def test_esmm_entire_space_loss():

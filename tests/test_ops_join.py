@@ -1,0 +1,247 @@
+"""Join-phase / variant ops vs literal numpy oracles of the CUDA kernels:
+rank_attention, batch_fc, fused_seqpool_cvm_with_conv, masked_data_norm,
+extended (expand) sparse pull/push."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.embedding import accessor as acc
+from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
+from paddlebox_tpu.embedding.optimizers import push_sparse_dedup
+from paddlebox_tpu.config.configs import SparseOptimizerConfig
+from paddlebox_tpu.ops import (batch_fc, build_push_grads_extended,
+                               fused_seqpool_cvm_with_conv, masked_data_norm,
+                               masked_data_norm_stat_update,
+                               pull_sparse_extended, rank_attention)
+from paddlebox_tpu.ops.data_norm import DataNormState
+
+
+# ------------------------------------------------------------ rank_attention
+def _rank_attention_oracle(x, rank_offset, rank_param, max_rank):
+    """Literal transcription of expand_input_by_rank_kernel +
+    expand_rank_attention_param_kernel + GEMM (rank_attention.cu.h:28-111)."""
+    N, F = x.shape
+    out_dim = rank_param.shape[1]
+    block_row = max_rank * F
+    input_help = np.zeros((N, block_row), x.dtype)
+    param_help = np.zeros((N * block_row, out_dim), x.dtype)
+    ins_rank = np.zeros((N, 1), x.dtype)
+    for row in range(N):
+        ins_rank[row] = rank_offset[row, 0]
+        for col in range(block_row):
+            k = col // F
+            faster = rank_offset[row, 2 * k + 1] - 1
+            if rank_offset[row, 0] - 1 < 0 or faster < 0:
+                continue
+            index = rank_offset[row, 2 * k + 2]
+            input_help[row, col] = x[index, col % F]
+    for prow in range(N * block_row):
+        ins_idx = prow // block_row
+        start_offset = prow % block_row
+        k = start_offset // F
+        k_offset = start_offset % F
+        lower = rank_offset[ins_idx, 0] - 1
+        faster = rank_offset[ins_idx, 2 * k + 1] - 1
+        if lower < 0 or faster < 0:
+            continue
+        start = lower * max_rank + faster
+        for oc in range(out_dim):
+            param_help[prow, oc] = rank_param[
+                start * F + k_offset, oc]
+    out = np.zeros((N, out_dim), x.dtype)
+    for i in range(N):
+        out[i] = input_help[i] @ param_help[i * block_row:(i + 1) * block_row]
+    return out, ins_rank
+
+
+def test_rank_attention_matches_cuda_oracle():
+    rng = np.random.RandomState(0)
+    N, F, R, out_dim = 5, 3, 2, 4
+    x = rng.randn(N, F).astype(np.float32)
+    # pv structure: ins 0,1 one pv (ranks 1,2); ins 2 alone; 3,4 one pv
+    rank_offset = np.array([
+        # rank, (peer_rank, peer_idx) * R
+        [1, 1, 0, 2, 1],
+        [2, 1, 0, 2, 1],
+        [1, 1, 2, 0, -1],   # single-ad pv: only itself
+        [2, 1, 4, 2, 3],
+        [1, 1, 4, 2, 3],
+    ], np.int32)
+    rank_param = rng.randn(R * R * F, out_dim).astype(np.float32)
+    out, ins_rank = rank_attention(
+        jnp.asarray(x), jnp.asarray(rank_offset), jnp.asarray(rank_param),
+        max_rank=R)
+    ref_out, ref_rank = _rank_attention_oracle(x, rank_offset, rank_param, R)
+    np.testing.assert_allclose(np.asarray(out), ref_out, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ins_rank), ref_rank)
+
+
+def test_rank_attention_invalid_rows_zero():
+    x = np.ones((2, 2), np.float32)
+    rank_offset = np.array([[0, 0, -1, 0, -1],
+                            [1, 1, 1, 0, -1]], np.int32)
+    param = np.ones((2 * 2 * 2, 3), np.float32)
+    out, _ = rank_attention(jnp.asarray(x), jnp.asarray(rank_offset),
+                            jnp.asarray(param), max_rank=2)
+    np.testing.assert_allclose(np.asarray(out)[0], 0.0)
+    assert np.asarray(out)[1].sum() != 0
+
+
+def test_rank_attention_is_differentiable():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(4, 3).astype(np.float32))
+    ro = jnp.asarray(np.array([[1, 1, 0, 2, 1], [2, 1, 0, 2, 1],
+                               [1, 1, 2, 0, -1], [1, 1, 3, 0, -1]], np.int32))
+    param = jnp.asarray(rng.randn(2 * 2 * 3, 2).astype(np.float32))
+
+    def loss(param, x):
+        out, _ = rank_attention(x, ro, param, max_rank=2)
+        return (out ** 2).sum()
+
+    gp, gx = jax.grad(loss, argnums=(0, 1))(param, x)
+    assert np.isfinite(np.asarray(gp)).all() and np.asarray(gp).any()
+    assert np.isfinite(np.asarray(gx)).all() and np.asarray(gx).any()
+
+
+# ------------------------------------------------------------------ batch_fc
+def test_batch_fc_oracle():
+    rng = np.random.RandomState(2)
+    S, N, din, dout = 3, 4, 5, 2
+    x = rng.randn(S, N, din).astype(np.float32)
+    w = rng.randn(S, din, dout).astype(np.float32)
+    b = rng.randn(S, dout).astype(np.float32)
+    out = np.asarray(batch_fc(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b)))
+    for s in range(S):
+        np.testing.assert_allclose(out[s], x[s] @ w[s] + b[s], rtol=1e-5)
+
+
+# ------------------------------------------------- fused_seqpool_cvm_with_conv
+def test_seqpool_with_conv_cvm_columns():
+    B, S = 1, 1
+    # two keys, cols [show, click, conv, e0]
+    emb = jnp.asarray(np.array([[2.0, 1.0, 1.0, 0.5],
+                                [1.0, 0.0, 1.0, 0.25]], np.float32))
+    seg = jnp.asarray(np.array([0, 0], np.int32))
+    valid = jnp.asarray(np.array([1, 1], bool))
+    out = np.asarray(fused_seqpool_cvm_with_conv(emb, seg, valid, B, S))
+    show, click, conv = 3.0, 1.0, 2.0
+    np.testing.assert_allclose(out[0, 0, 0], np.log(show + 1), rtol=1e-6)
+    np.testing.assert_allclose(out[0, 0, 1], np.log(click + 1), rtol=1e-6)
+    np.testing.assert_allclose(out[0, 0, 2],
+                               np.log(conv + 1) - np.log(click + 1), rtol=1e-6)
+    np.testing.assert_allclose(out[0, 0, 3], 0.75)
+    # show_filter drops the show column
+    out2 = np.asarray(fused_seqpool_cvm_with_conv(emb, seg, valid, B, S,
+                                                  show_filter=True))
+    assert out2.shape[-1] == out.shape[-1] - 1
+    np.testing.assert_allclose(out2[0, 0, 0], np.log(click + 1), rtol=1e-6)
+
+
+def test_seqpool_with_conv_need_filter():
+    B, S = 1, 1
+    # key 1 fails the show/click score threshold and is dropped
+    emb = jnp.asarray(np.array([[5.0, 1.0, 0.0, 1.0],
+                                [1.0, 0.0, 0.0, 100.0]], np.float32))
+    seg = jnp.asarray(np.array([0, 0], np.int32))
+    valid = jnp.asarray(np.array([1, 1], bool))
+    out = np.asarray(fused_seqpool_cvm_with_conv(
+        emb, seg, valid, B, S, use_cvm=False, need_filter=True,
+        show_coeff=0.2, clk_coeff=1.0, threshold=0.96))
+    # key0 score = (5-1)*0.2 + 1 = 1.8 >= 0.96 kept; key1 = 0.2 < 0.96 dropped
+    np.testing.assert_allclose(out[0, 0, 0], 1.0)
+
+
+# ------------------------------------------------------------ masked_data_norm
+def test_masked_data_norm_forward_and_stats():
+    rng = np.random.RandomState(3)
+    N, C = 6, 4
+    x = rng.randn(N, C).astype(np.float32)
+    mask = np.array([1, 0, 1, 1, 0, 1], bool)
+    st = DataNormState(
+        batch_size=jnp.asarray(rng.rand(C).astype(np.float32) + 1),
+        batch_sum=jnp.asarray(rng.randn(C).astype(np.float32)),
+        batch_square_sum=jnp.asarray(rng.rand(C).astype(np.float32) + 1))
+    y = np.asarray(masked_data_norm(jnp.asarray(x), jnp.asarray(mask), st))
+    mean = np.asarray(st.batch_sum) / np.asarray(st.batch_size)
+    scale = np.sqrt(np.asarray(st.batch_size) /
+                    np.asarray(st.batch_square_sum))
+    np.testing.assert_allclose(y[mask], (x[mask] - mean) * scale, rtol=1e-5)
+    np.testing.assert_allclose(y[~mask], 0.0)
+
+    # stat update: per-column means over masked rows, batch_size decays + 1
+    decay = 0.5
+    eps = 1e-4
+    st2 = masked_data_norm_stat_update(st, jnp.asarray(x), jnp.asarray(mask),
+                                       decay=decay, squared_sum_epsilon=eps)
+    m = mask.sum()
+    np.testing.assert_allclose(np.asarray(st2.batch_size),
+                               np.asarray(st.batch_size) * decay + 1.0,
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(st2.batch_sum),
+                               np.asarray(st.batch_sum) * decay
+                               + x[mask].sum(0) / m, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(st2.batch_square_sum),
+        np.asarray(st.batch_square_sum) * decay
+        + ((x[mask] - mean) ** 2).sum(0) / m + eps, rtol=1e-5)
+
+
+def test_masked_data_norm_empty_mask_skips_decay():
+    st = DataNormState.init(3)
+    x = jnp.asarray(np.ones((2, 3), np.float32))
+    mask = jnp.asarray(np.zeros(2, bool))
+    st2 = masked_data_norm_stat_update(st, x, mask, decay=0.5)
+    np.testing.assert_allclose(np.asarray(st2.batch_size),
+                               np.asarray(st.batch_size))
+
+
+# --------------------------------------------------------- extended pull/push
+def test_extended_layout_columns():
+    lay = ValueLayout(4, "adagrad", expand_dim=3)
+    base = ValueLayout(4, "adagrad")
+    assert lay.width == base.width + 3 + 1  # expand_w[3] + g2sum
+    assert lay.expand_w == base.width
+    push = PushLayout(4, 3)
+    assert push.width == 4 + 4 + 3
+
+
+def test_extended_pull_and_push_updates_expand_block():
+    D, E = 2, 3
+    lay = ValueLayout(D, "adagrad", expand_dim=E)
+    conf = SparseOptimizerConfig(mf_create_thresholds=0.0)
+    cap = 8
+    rng = np.random.RandomState(4)
+    slab = np.zeros((cap, lay.width), np.float32)
+    slab[:, acc.MF_SIZE] = D  # embedx exists → updates, not creation
+    slab[:, lay.expand_w:lay.expand_w + E] = rng.rand(cap, E)
+    slab_j = jnp.asarray(slab)
+    ids = jnp.asarray(np.array([1, 2, 1], np.int32))
+
+    base, expand = pull_sparse_extended(slab_j, ids, lay)
+    assert base.shape == (3, 3 + D) and expand.shape == (3, E)
+    np.testing.assert_allclose(np.asarray(expand)[0],
+                               slab[1, lay.expand_w:lay.expand_w + E])
+
+    d_emb = jnp.asarray(rng.randn(3, 3 + D).astype(np.float32))
+    d_exp = jnp.asarray(rng.randn(3, E).astype(np.float32))
+    slots = jnp.asarray(np.zeros(3, np.float32))
+    clicks = jnp.asarray(np.array([1, 0, 1], np.float32))
+    valid = jnp.asarray(np.ones(3, bool))
+    pg = build_push_grads_extended(d_emb, d_exp, slots, clicks, valid)
+    assert pg.shape == (3, 4 + D + E)
+
+    new_slab = np.asarray(push_sparse_dedup(
+        slab_j, ids, pg, jax.random.PRNGKey(0), lay, conf))
+    # expand block of pushed rows changed; untouched rows unchanged
+    assert not np.allclose(new_slab[1, lay.expand_w:lay.expand_w + E],
+                           slab[1, lay.expand_w:lay.expand_w + E])
+    np.testing.assert_allclose(new_slab[5], slab[5])
+    # g2sum state advanced for pushed rows
+    assert new_slab[1, lay.expand_state] > 0
+
+
+def test_extended_requires_adagrad_or_naive():
+    import pytest
+    with pytest.raises(ValueError):
+        ValueLayout(4, "adam", expand_dim=2)
