@@ -86,13 +86,15 @@ def main():
         dev_batches.append(trainer.device_batch(b, ids))
 
     def one_step(i):
-        nonlocal_state["slab"], trainer.params, trainer.opt_state, loss, _ = \
+        (nonlocal_state["slab"], trainer.params, trainer.opt_state, loss, _,
+         nonlocal_state["prng"]) = \
             trainer.fns.step(nonlocal_state["slab"], trainer.params,
                              trainer.opt_state, dev_batches[i % n_batches],
-                             trainer.table.next_prng())
+                             nonlocal_state["prng"])
         return loss
 
-    nonlocal_state = {"slab": trainer.table.slab}
+    nonlocal_state = {"slab": trainer.table.slab,
+                      "prng": trainer.table.next_prng()}
     for i in range(WARMUP):
         loss = one_step(i)
     jax.block_until_ready(loss)

@@ -92,6 +92,7 @@ class ShardedBoxTrainer:
             # per-device views: slab [1, C, W]; batch leaves [1, ...]
             slab = slab[0]
             batch = jax.tree.map(lambda x: x[0], batch)
+            prng, next_prng = jax.random.split(prng)
             prng = jax.random.fold_in(prng, jax.lax.axis_index(axis))
             buckets = batch["buckets"]                       # [P, KB]
             KB = buckets.shape[1]
@@ -149,7 +150,7 @@ class ShardedBoxTrainer:
             slab = push_sparse_dedup(slab, req.reshape(-1),
                                      recv_g.reshape(Pn * KB, -1), prng,
                                      layout, conf)
-            return slab[None], params, opt_state, loss, preds
+            return slab[None], params, opt_state, loss, preds, next_prng
 
         spec_sh = P(self.axis)
         spec_rep = P()
@@ -158,7 +159,8 @@ class ShardedBoxTrainer:
         fn = jax.shard_map(
             shard_step, mesh=self.mesh,
             in_specs=(spec_sh, spec_rep, spec_rep, spec_sh, spec_rep),
-            out_specs=(spec_sh, spec_rep, spec_rep, spec_rep, spec_sh))
+            out_specs=(spec_sh, spec_rep, spec_rep, spec_rep, spec_sh,
+                       spec_rep))
         return jax.jit(fn)
 
     # -------------------------------------------------------------- batches
@@ -214,10 +216,9 @@ class ShardedBoxTrainer:
         dev_batches = self.shard_batches(per_worker)
         for i, batch in enumerate(dev_batches):
             self.timers["step"].start()
-            self._prng, sub = jax.random.split(self._prng)
-            (self._slabs, self.params, self.opt_state, loss,
-             preds) = self._step(self._slabs, self.params, self.opt_state,
-                                 batch, sub)
+            (self._slabs, self.params, self.opt_state, loss, preds,
+             self._prng) = self._step(self._slabs, self.params,
+                                      self.opt_state, batch, self._prng)
             self.timers["step"].pause()
             losses.append(float(loss))
             self._add_metrics(preds, raw_steps[i])
