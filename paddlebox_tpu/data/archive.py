@@ -1,0 +1,92 @@
+"""Binary archive disk spill for pass data.
+
+Analog of the reference's pass disk-spill path: `PreLoadIntoDisk` /
+`DumpIntoDisk` / `LoadIntoDiskedFile` (data_set.cc:2090-2215) writing
+`BinaryArchive`-serialized SlotRecord batches (framework/archive.h) to
+rotating shard files, so a pass larger than host RAM streams from local
+disk. Files are self-describing (block magic + length) and are accepted
+transparently by `BoxDataset` read workers in place of text inputs.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, List, Sequence
+
+from paddlebox_tpu.config import flags
+from paddlebox_tpu.data.shuffle import deserialize_records, serialize_records
+from paddlebox_tpu.data.slot_record import SlotRecord
+
+_BLOCK_MAGIC = 0x50425841  # "PBXA"
+_BLOCK_HDR = struct.Struct("<II")  # magic, payload_len
+
+
+def is_archive(path: str) -> bool:
+    try:
+        with open(path, "rb") as f:
+            head = f.read(4)
+    except OSError:
+        return False
+    return (len(head) == 4
+            and struct.unpack("<I", head)[0] == _BLOCK_MAGIC)
+
+
+class BinaryArchiveWriter:
+    """Rotating-shard archive writer (BinaryArchiveWriter,
+    data_set.cc:2090; rotation cap mirrors the dump subsystem's 2GB files,
+    boxps_trainer.cc:112-163)."""
+
+    def __init__(self, prefix: str, max_bytes: int = 0):
+        self.prefix = prefix
+        self.max_bytes = max_bytes or flags.get_flag("dump_file_max_bytes")
+        self._file = None
+        self._file_bytes = 0
+        self._file_idx = 0
+        self.files: List[str] = []
+        os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
+
+    def _rotate(self) -> None:
+        if self._file is not None:
+            self._file.close()
+        path = "%s-%05d.bin" % (self.prefix, self._file_idx)
+        self._file_idx += 1
+        self._file = open(path, "wb")
+        self._file_bytes = 0
+        self.files.append(path)
+
+    def write_records(self, recs: Sequence[SlotRecord]) -> None:
+        if not recs:
+            return
+        payload = serialize_records(recs)
+        if self._file is None or (
+                self._file_bytes
+                and self._file_bytes + len(payload) > self.max_bytes):
+            self._rotate()
+        self._file.write(_BLOCK_HDR.pack(_BLOCK_MAGIC, len(payload)))
+        self._file.write(payload)
+        self._file_bytes += _BLOCK_HDR.size + len(payload)
+
+    def close(self) -> List[str]:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        return self.files
+
+
+def read_archive(path: str) -> Iterator[List[SlotRecord]]:
+    """Yield record batches from one archive shard."""
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(_BLOCK_HDR.size)
+            if not hdr:
+                return
+            if len(hdr) < _BLOCK_HDR.size:
+                raise IOError("truncated archive block header in " + path)
+            magic, length = _BLOCK_HDR.unpack(hdr)
+            if magic != _BLOCK_MAGIC:
+                raise IOError("bad archive magic 0x%x in %s" % (magic, path))
+            payload = f.read(length)
+            if len(payload) < length:
+                raise IOError("truncated archive block in " + path)
+            yield deserialize_records(payload)

@@ -72,6 +72,9 @@ class BoxDataset:
             except (RuntimeError, ImportError):
                 self._native_parser = None
         self.columnar = self._native_parser is not None
+        self._load_columnar = self.columnar  # per-load effective mode
+        self._disk_writer = None    # BinaryArchiveWriter when spilling
+        self.disk_files: List[str] = []
         self._block = None          # merged ColumnarBlock
         self._perm: Optional[np.ndarray] = None  # shuffle permutation
 
@@ -101,6 +104,16 @@ class BoxDataset:
         self._load_error = None
         self._channel = Channel(capacity=64)
         files = list(self._files)
+        from paddlebox_tpu.data.archive import is_archive, read_archive
+        # per-load state is captured in locals so a failed later call can't
+        # flip an in-flight load's mode mid-pass
+        disk_writer = self._disk_writer
+        archive_files = {f for f in files if is_archive(f)}  # one sniff each
+        # archive inputs and disk spill stream SlotRecords, not columnar
+        # blocks — downgrade this load to the record path when either is
+        # in play (the archive codec round-trips full records)
+        self._load_columnar = use_columnar = (
+            self.columnar and disk_writer is None and not archive_files)
         lock = threading.Lock()
         cursor = {"i": 0}
 
@@ -114,9 +127,12 @@ class BoxDataset:
                         path = files[cursor["i"]]
                         cursor["i"] += 1
                     t.start()
-                    if self.columnar:
+                    if use_columnar:
                         block = self._native_parser.parse_file_columnar(path)
                         self._channel.put(block)
+                    elif path in archive_files:
+                        for recs in read_archive(path):
+                            self._put_records(recs)
                     else:
                         batch: List[SlotRecord] = []
                         for rec in self.parser.parse_file(path):
@@ -143,12 +159,18 @@ class BoxDataset:
                     except ChannelClosed:
                         break
                     t.start()
-                    if self.columnar:
+                    if use_columnar:
                         for block in items:
                             if self._add_keys_fn is not None and block.n_keys:
                                 self._add_keys_fn(block.keys)
                             blocks.append(block)
                             stat_add("dataset_ins_merged", block.n_recs)
+                    elif disk_writer is not None:
+                        # disk spill: keys are registered when the archives
+                        # are loaded back, not at dump time (PreLoadIntoDisk,
+                        # data_set.cc:2090-2215)
+                        disk_writer.write_records(items)
+                        stat_add("dataset_ins_spilled", len(items))
                     else:
                         recs = items
                         if self._add_keys_fn is not None:
@@ -159,7 +181,7 @@ class BoxDataset:
                         self._records.extend(recs)
                         stat_add("dataset_ins_merged", len(recs))
                     t.pause()
-                if self.columnar:
+                if use_columnar:
                     self._block = ColumnarBlock.concat(blocks)
                 return
             except BaseException as e:
@@ -202,13 +224,39 @@ class BoxDataset:
             self._merge_thread.join()
         self._preload_threads = []
         self._merge_thread = None
+        if self._disk_writer is not None:
+            self.disk_files = self._disk_writer.close()
+            self._disk_writer = None
         if self._load_error is not None:
             raise RuntimeError("dataset load failed") from self._load_error
+
+    # -------------------------------------------------------------- disk spill
+    def preload_into_disk(self, out_prefix: str,
+                          max_bytes: int = 0) -> None:
+        """Read (+cross-host shuffle) the pass and spill it to rotating
+        binary archive shards instead of RAM (PreLoadIntoDisk/DumpIntoDisk,
+        data_set.cc:2090-2215). Resulting shard paths land in
+        `self.disk_files` after wait_preload_done(); feed them back via
+        set_filelist + load_into_memory to train from the spill."""
+        from paddlebox_tpu.data.archive import BinaryArchiveWriter
+        if self._preload_threads:
+            raise RuntimeError("preload already running")
+        self._disk_writer = BinaryArchiveWriter(out_prefix, max_bytes)
+        self.disk_files = []
+        try:
+            self.preload_into_memory(None)
+        except BaseException:
+            self._disk_writer = None
+            raise
+
+    def load_into_disk(self, out_prefix: str, max_bytes: int = 0) -> None:
+        self.preload_into_disk(out_prefix, max_bytes)
+        self.wait_preload_done()
 
     # -------------------------------------------------------------- train prep
     def local_shuffle(self, seed: Optional[int] = None) -> None:
         rng = np.random.RandomState(seed)
-        if self.columnar:
+        if self._load_columnar:
             if self._block is not None and self._block.n_recs:
                 self._perm = rng.permutation(self._block.n_recs)
         else:
@@ -224,7 +272,7 @@ class BoxDataset:
 
     def all_keys(self) -> np.ndarray:
         """Every feasign in the loaded pass (for test-mode feed passes)."""
-        if self.columnar:
+        if self._load_columnar:
             return (self._block.keys if self._block is not None
                     else np.empty(0, np.uint64))
         if not self._records:
@@ -232,7 +280,7 @@ class BoxDataset:
         return np.concatenate([r.all_keys() for r in self._records])
 
     def __len__(self) -> int:
-        if self.columnar:
+        if self._load_columnar:
             return self._block.n_recs if self._block is not None else 0
         return len(self._records)
 
@@ -256,7 +304,7 @@ class BoxDataset:
         per_worker = (n + num_workers - 1) // num_workers
         local_batches = (per_worker + bs - 1) // bs if n else 0
         target = equalize(local_batches) if equalize else local_batches
-        if self.columnar:
+        if self._load_columnar:
             return self._split_batches_columnar(num_workers, per_worker,
                                                 target)
         out: List[List[PackedBatch]] = []

@@ -1,0 +1,336 @@
+"""Cross-host instance shuffle transport.
+
+TPU-native analog of the reference's pass-load shuffle
+(`PadBoxSlotDataset::ShuffleData` / `ReceiveSuffleData`, data_set.cc:
+2438-2602, riding `boxps::PaddleShuffler::send_message_callback`,
+data_set.cc:2485): while read threads parse a pass's files, every instance
+is routed to `hash(ins) % world` (general_shuffle_func, data_set.cc:
+2420-2436). Local instances flow straight into the merge channel; remote
+ones are serialized into batches and sent point-to-point; received batches
+are deserialized into the same merge channel. The pass is complete when
+every peer has signalled done (wait_message_done analog).
+
+Two transports share the protocol:
+  * `LocalShuffleGroup` — N in-process ranks wired by queues; the
+    single-process fake for tests (the PsLocalClient pattern,
+    distributed/ps/service/ps_local_client.h).
+  * `TcpShuffler` — length-prefixed framed messages over TCP sockets
+    between hosts (DCN); the PaddleShuffler analog.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.data.slot_record import SlotRecord
+from paddlebox_tpu.utils.stats import stat_add
+
+_REC_MAGIC = 0x50425852  # "PBXR"
+
+# ---------------------------------------------------------------------------
+# SlotRecord binary serialization (BinaryArchive analog, framework/archive.h;
+# SlotRecord serialize for shuffle: data_feed.h:2254-2314)
+# ---------------------------------------------------------------------------
+
+
+def serialize_records(recs: Sequence[SlotRecord]) -> bytes:
+    """Compact batch codec: header + per-record scalar block + CSR slot data."""
+    parts: List[bytes] = [struct.pack("<II", _REC_MAGIC, len(recs))]
+    for r in recs:
+        ins_id = r.ins_id.encode("utf-8")
+        u64_items = sorted(r.uint64_slots.items())
+        f32_items = sorted(r.float_slots.items())
+        parts.append(struct.pack(
+            "<iiHHQfH", r.label, r.rank, r.cmatch & 0xFFFF, len(ins_id),
+            r.search_id, r.qvalue, len(u64_items)))
+        parts.append(ins_id)
+        for slot, vals in u64_items:
+            v = np.ascontiguousarray(vals, dtype=np.uint64)
+            parts.append(struct.pack("<HI", slot, v.size))
+            parts.append(v.tobytes())
+        parts.append(struct.pack("<H", len(f32_items)))
+        for slot, vals in f32_items:
+            v = np.ascontiguousarray(vals, dtype=np.float32)
+            parts.append(struct.pack("<HI", slot, v.size))
+            parts.append(v.tobytes())
+    return b"".join(parts)
+
+
+def deserialize_records(buf: bytes) -> List[SlotRecord]:
+    magic, n = struct.unpack_from("<II", buf, 0)
+    if magic != _REC_MAGIC:
+        raise ValueError("bad shuffle record magic 0x%x" % magic)
+    off = 8
+    out: List[SlotRecord] = []
+    for _ in range(n):
+        (label, rank, cmatch, id_len, search_id, qvalue,
+         n_u64) = struct.unpack_from("<iiHHQfH", buf, off)
+        off += struct.calcsize("<iiHHQfH")
+        ins_id = buf[off:off + id_len].decode("utf-8")
+        off += id_len
+        u64_slots: Dict[int, np.ndarray] = {}
+        for _ in range(n_u64):
+            slot, cnt = struct.unpack_from("<HI", buf, off)
+            off += 6
+            u64_slots[slot] = np.frombuffer(
+                buf, dtype=np.uint64, count=cnt, offset=off).copy()
+            off += 8 * cnt
+        (n_f32,) = struct.unpack_from("<H", buf, off)
+        off += 2
+        float_slots: Dict[int, np.ndarray] = {}
+        for _ in range(n_f32):
+            slot, cnt = struct.unpack_from("<HI", buf, off)
+            off += 6
+            float_slots[slot] = np.frombuffer(
+                buf, dtype=np.float32, count=cnt, offset=off).copy()
+            off += 4 * cnt
+        out.append(SlotRecord(label=label, uint64_slots=u64_slots,
+                              float_slots=float_slots, ins_id=ins_id,
+                              rank=rank, cmatch=cmatch, qvalue=qvalue,
+                              search_id=search_id))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Transport base: routing + buffering + done barrier
+# ---------------------------------------------------------------------------
+
+
+class ShufflerBase:
+    """Shared scatter/flush logic; subclasses provide _send/_send_done."""
+
+    def __init__(self, rank: int, world: int, batch_records: int = 512):
+        self.rank = rank
+        self.world = world
+        self.batch_records = batch_records
+        self._out: List[List[SlotRecord]] = [[] for _ in range(world)]
+        self._out_lock = threading.Lock()
+        self._inbox: List[SlotRecord] = []
+        self._inbox_lock = threading.Lock()
+        self._done_from: set = set()
+        self._done_cv = threading.Condition()
+
+    # -- subclass transport hooks ------------------------------------------
+    def _send(self, dest: int, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def _send_done(self, dest: int) -> None:
+        raise NotImplementedError
+
+    # -- receive side (called by transport threads) ------------------------
+    def _deliver(self, payload: bytes) -> None:
+        recs = deserialize_records(payload)
+        with self._inbox_lock:
+            self._inbox.extend(recs)
+        stat_add("shuffle_ins_received", len(recs))
+
+    def _peer_done(self, src: int) -> None:
+        with self._done_cv:
+            self._done_from.add(src)
+            self._done_cv.notify_all()
+
+    # -- dataset-facing API -------------------------------------------------
+    def scatter(self, recs: Sequence[SlotRecord], channel) -> None:
+        """Route records: locals to `channel`, remotes to peer buffers
+        (ShuffleData, data_set.cc:2438-2545)."""
+        local: List[SlotRecord] = []
+        to_send: List[Tuple[int, bytes]] = []
+        with self._out_lock:
+            for r in recs:
+                dest = r.shuffle_hash() % self.world
+                if dest == self.rank:
+                    local.append(r)
+                else:
+                    buf = self._out[dest]
+                    buf.append(r)
+                    if len(buf) >= self.batch_records:
+                        to_send.append((dest, serialize_records(buf)))
+                        self._out[dest] = []
+        for dest, payload in to_send:
+            self._send(dest, payload)
+            stat_add("shuffle_batches_sent", 1)
+        if local:
+            channel.put_many(local)
+        self._drain_inbox(channel)
+
+    def _drain_inbox(self, channel) -> None:
+        with self._inbox_lock:
+            got, self._inbox = self._inbox, []
+        if got:
+            channel.put_many(got)
+
+    def flush(self, channel, timeout: float = 120.0) -> None:
+        """Send remainders + done marker, then block until every peer is
+        done and forward everything received (wait_message_done analog)."""
+        with self._out_lock:
+            pending = [(d, serialize_records(buf))
+                       for d, buf in enumerate(self._out) if buf]
+            self._out = [[] for _ in range(self.world)]
+        for dest, payload in pending:
+            self._send(dest, payload)
+        for dest in range(self.world):
+            if dest != self.rank:
+                self._send_done(dest)
+        with self._done_cv:
+            ok = self._done_cv.wait_for(
+                lambda: len(self._done_from) >= self.world - 1, timeout)
+        if not ok:
+            raise TimeoutError(
+                "shuffle flush: %d/%d peers done" %
+                (len(self._done_from), self.world - 1))
+        self._drain_inbox(channel)
+        with self._done_cv:
+            self._done_from.clear()
+
+    def close(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# In-process fake: N ranks in one process
+# ---------------------------------------------------------------------------
+
+
+class _InProcShuffler(ShufflerBase):
+    def __init__(self, rank: int, world: int, group: "LocalShuffleGroup",
+                 batch_records: int = 512):
+        super().__init__(rank, world, batch_records)
+        self._group = group
+
+    def _send(self, dest: int, payload: bytes) -> None:
+        # serialize/deserialize anyway so the codec is exercised
+        self._group.members[dest]._deliver(payload)
+
+    def _send_done(self, dest: int) -> None:
+        self._group.members[dest]._peer_done(self.rank)
+
+
+class LocalShuffleGroup:
+    """world in-process shuffler endpoints sharing memory — the
+    single-process multi-rank fake for deterministic tests."""
+
+    def __init__(self, world: int, batch_records: int = 512):
+        self.members = [_InProcShuffler(r, world, self, batch_records)
+                        for r in range(world)]
+
+    def __getitem__(self, rank: int) -> _InProcShuffler:
+        return self.members[rank]
+
+
+# ---------------------------------------------------------------------------
+# TCP transport (PaddleShuffler analog)
+# ---------------------------------------------------------------------------
+
+_MSG_DATA = 0
+_MSG_DONE = 1
+_HDR = struct.Struct("<III")  # type, src_rank, payload_len
+
+
+class TcpShuffler(ShufflerBase):
+    """Framed point-to-point shuffle over TCP between hosts.
+
+    endpoints[i] = (host, port) of rank i's listener. Connections are
+    opened lazily on first send; the listener accepts any number of peer
+    connections and demuxes by the src_rank field in each frame.
+    """
+
+    def __init__(self, rank: int, world: int,
+                 endpoints: Sequence[Tuple[str, int]],
+                 batch_records: int = 512):
+        super().__init__(rank, world, batch_records)
+        self.endpoints = list(endpoints)
+        self._conns: Dict[int, socket.socket] = {}
+        self._conn_locks: Dict[int, threading.Lock] = {}
+        self._conn_open_lock = threading.Lock()
+        self._stop = threading.Event()
+        host, port = self.endpoints[rank]
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, port))
+        self._server.listen(world)
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._server.getsockname()[1]
+
+    # -- receive path -------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._recv_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _recv_loop(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                hdr = self._recv_exact(conn, _HDR.size)
+                if hdr is None:
+                    return
+                mtype, src, length = _HDR.unpack(hdr)
+                payload = (self._recv_exact(conn, length) if length
+                           else b"")
+                if length and payload is None:
+                    return
+                if mtype == _MSG_DATA:
+                    self._deliver(payload)
+                elif mtype == _MSG_DONE:
+                    self._peer_done(src)
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    # -- send path ----------------------------------------------------------
+    def _conn_to(self, dest: int) -> Tuple[socket.socket, threading.Lock]:
+        with self._conn_open_lock:
+            if dest not in self._conns:
+                s = socket.create_connection(self.endpoints[dest],
+                                             timeout=60.0)
+                s.settimeout(None)
+                self._conns[dest] = s
+                self._conn_locks[dest] = threading.Lock()
+            return self._conns[dest], self._conn_locks[dest]
+
+    def _send_frame(self, dest: int, mtype: int, payload: bytes) -> None:
+        conn, lock = self._conn_to(dest)
+        frame = _HDR.pack(mtype, self.rank, len(payload)) + payload
+        with lock:
+            conn.sendall(frame)
+
+    def _send(self, dest: int, payload: bytes) -> None:
+        self._send_frame(dest, _MSG_DATA, payload)
+
+    def _send_done(self, dest: int) -> None:
+        self._send_frame(dest, _MSG_DONE, b"")
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        for s in self._conns.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._conns.clear()
