@@ -108,12 +108,16 @@ class BoxDataset:
         # per-load state is captured in locals so a failed later call can't
         # flip an in-flight load's mode mid-pass
         disk_writer = self._disk_writer
-        archive_files = {f for f in files if is_archive(f)}  # one sniff each
         # archive inputs and disk spill stream SlotRecords, not columnar
-        # blocks — downgrade this load to the record path when either is
-        # in play (the archive codec round-trips full records)
-        self._load_columnar = use_columnar = (
-            self.columnar and disk_writer is None and not archive_files)
+        # blocks — downgrade this load to the record path when either is in
+        # play (the archive codec round-trips full records). The eager sniff
+        # sweep only runs when columnar is actually a candidate; the record
+        # path sniffs lazily per file inside the read workers.
+        if self.columnar and disk_writer is None:
+            use_columnar = not any(is_archive(f) for f in files)
+        else:
+            use_columnar = False
+        self._load_columnar = use_columnar
         lock = threading.Lock()
         cursor = {"i": 0}
 
@@ -130,7 +134,7 @@ class BoxDataset:
                     if use_columnar:
                         block = self._native_parser.parse_file_columnar(path)
                         self._channel.put(block)
-                    elif path in archive_files:
+                    elif is_archive(path):
                         for recs in read_archive(path):
                             self._put_records(recs)
                     else:
@@ -217,16 +221,27 @@ class BoxDataset:
         (data_set.cc:2262)."""
         for th in self._preload_threads:
             th.join()
-        if self.shuffler is not None:
-            self.shuffler.flush(self._channel)
-        self._channel.close()
-        if self._merge_thread is not None:
-            self._merge_thread.join()
-        self._preload_threads = []
-        self._merge_thread = None
-        if self._disk_writer is not None:
-            self.disk_files = self._disk_writer.close()
-            self._disk_writer = None
+        flush_error: Optional[BaseException] = None
+        try:
+            if self.shuffler is not None:
+                self.shuffler.flush(self._channel)
+        except BaseException as e:
+            # a dead peer must not leave the merge thread blocked on a
+            # never-closed channel and the dataset stuck in "preload
+            # already running"
+            flush_error = e
+        finally:
+            self._channel.close()
+            if self._merge_thread is not None:
+                self._merge_thread.join()
+            self._preload_threads = []
+            self._merge_thread = None
+            if self._disk_writer is not None:
+                self.disk_files = self._disk_writer.close()
+                self._disk_writer = None
+        if flush_error is not None:
+            raise RuntimeError(
+                "cross-host shuffle flush failed") from flush_error
         if self._load_error is not None:
             raise RuntimeError("dataset load failed") from self._load_error
 

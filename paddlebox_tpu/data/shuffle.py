@@ -110,9 +110,12 @@ class ShufflerBase:
         self.batch_records = batch_records
         self._out: List[List[SlotRecord]] = [[] for _ in range(world)]
         self._out_lock = threading.Lock()
-        self._inbox: List[SlotRecord] = []
+        # pass epoch: frames are tagged so a fast peer's next-pass records
+        # can't leak into this rank's still-draining current pass
+        self.epoch = 0
+        self._inbox: Dict[int, List[SlotRecord]] = {}
         self._inbox_lock = threading.Lock()
-        self._done_from: set = set()
+        self._done_from: Dict[int, set] = {}
         self._done_cv = threading.Condition()
 
     # -- subclass transport hooks ------------------------------------------
@@ -123,15 +126,15 @@ class ShufflerBase:
         raise NotImplementedError
 
     # -- receive side (called by transport threads) ------------------------
-    def _deliver(self, payload: bytes) -> None:
+    def _deliver(self, payload: bytes, epoch: int) -> None:
         recs = deserialize_records(payload)
         with self._inbox_lock:
-            self._inbox.extend(recs)
+            self._inbox.setdefault(epoch, []).extend(recs)
         stat_add("shuffle_ins_received", len(recs))
 
-    def _peer_done(self, src: int) -> None:
+    def _peer_done(self, src: int, epoch: int) -> None:
         with self._done_cv:
-            self._done_from.add(src)
+            self._done_from.setdefault(epoch, set()).add(src)
             self._done_cv.notify_all()
 
     # -- dataset-facing API -------------------------------------------------
@@ -160,13 +163,16 @@ class ShufflerBase:
 
     def _drain_inbox(self, channel) -> None:
         with self._inbox_lock:
-            got, self._inbox = self._inbox, []
+            got = self._inbox.pop(self.epoch, [])
         if got:
             channel.put_many(got)
 
     def flush(self, channel, timeout: float = 120.0) -> None:
         """Send remainders + done marker, then block until every peer is
-        done and forward everything received (wait_message_done analog)."""
+        done with THIS epoch and forward everything received for it
+        (wait_message_done analog). Frames a fast peer already sent for its
+        next pass stay parked under the next epoch."""
+        epoch = self.epoch
         with self._out_lock:
             pending = [(d, serialize_records(buf))
                        for d, buf in enumerate(self._out) if buf]
@@ -178,14 +184,16 @@ class ShufflerBase:
                 self._send_done(dest)
         with self._done_cv:
             ok = self._done_cv.wait_for(
-                lambda: len(self._done_from) >= self.world - 1, timeout)
+                lambda: len(self._done_from.get(epoch, ()))
+                >= self.world - 1, timeout)
+            n_done = len(self._done_from.get(epoch, ()))
         if not ok:
             raise TimeoutError(
-                "shuffle flush: %d/%d peers done" %
-                (len(self._done_from), self.world - 1))
+                "shuffle flush: %d/%d peers done" % (n_done, self.world - 1))
         self._drain_inbox(channel)
         with self._done_cv:
-            self._done_from.clear()
+            self._done_from.pop(epoch, None)
+        self.epoch = epoch + 1
 
     def close(self) -> None:
         pass
@@ -204,10 +212,10 @@ class _InProcShuffler(ShufflerBase):
 
     def _send(self, dest: int, payload: bytes) -> None:
         # serialize/deserialize anyway so the codec is exercised
-        self._group.members[dest]._deliver(payload)
+        self._group.members[dest]._deliver(payload, self.epoch)
 
     def _send_done(self, dest: int) -> None:
-        self._group.members[dest]._peer_done(self.rank)
+        self._group.members[dest]._peer_done(self.rank, self.epoch)
 
 
 class LocalShuffleGroup:
@@ -228,7 +236,7 @@ class LocalShuffleGroup:
 
 _MSG_DATA = 0
 _MSG_DONE = 1
-_HDR = struct.Struct("<III")  # type, src_rank, payload_len
+_HDR = struct.Struct("<IIII")  # type, src_rank, epoch, payload_len
 
 
 class TcpShuffler(ShufflerBase):
@@ -245,8 +253,9 @@ class TcpShuffler(ShufflerBase):
         super().__init__(rank, world, batch_records)
         self.endpoints = list(endpoints)
         self._conns: Dict[int, socket.socket] = {}
-        self._conn_locks: Dict[int, threading.Lock] = {}
-        self._conn_open_lock = threading.Lock()
+        # per-destination locks: a slow/unreachable peer must not serialize
+        # sends to healthy peers
+        self._dest_locks = [threading.Lock() for _ in range(world)]
         self._stop = threading.Event()
         host, port = self.endpoints[rank]
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -277,15 +286,15 @@ class TcpShuffler(ShufflerBase):
                 hdr = self._recv_exact(conn, _HDR.size)
                 if hdr is None:
                     return
-                mtype, src, length = _HDR.unpack(hdr)
+                mtype, src, epoch, length = _HDR.unpack(hdr)
                 payload = (self._recv_exact(conn, length) if length
                            else b"")
                 if length and payload is None:
                     return
                 if mtype == _MSG_DATA:
-                    self._deliver(payload)
+                    self._deliver(payload, epoch)
                 elif mtype == _MSG_DONE:
-                    self._peer_done(src)
+                    self._peer_done(src, epoch)
         finally:
             conn.close()
 
@@ -300,20 +309,15 @@ class TcpShuffler(ShufflerBase):
         return buf
 
     # -- send path ----------------------------------------------------------
-    def _conn_to(self, dest: int) -> Tuple[socket.socket, threading.Lock]:
-        with self._conn_open_lock:
-            if dest not in self._conns:
-                s = socket.create_connection(self.endpoints[dest],
-                                             timeout=60.0)
-                s.settimeout(None)
-                self._conns[dest] = s
-                self._conn_locks[dest] = threading.Lock()
-            return self._conns[dest], self._conn_locks[dest]
-
     def _send_frame(self, dest: int, mtype: int, payload: bytes) -> None:
-        conn, lock = self._conn_to(dest)
-        frame = _HDR.pack(mtype, self.rank, len(payload)) + payload
-        with lock:
+        frame = _HDR.pack(mtype, self.rank, self.epoch, len(payload)) + payload
+        with self._dest_locks[dest]:
+            conn = self._conns.get(dest)
+            if conn is None:
+                conn = socket.create_connection(self.endpoints[dest],
+                                                timeout=60.0)
+                conn.settimeout(None)
+                self._conns[dest] = conn
             conn.sendall(frame)
 
     def _send(self, dest: int, payload: bytes) -> None:

@@ -23,6 +23,7 @@ import functools
 from typing import Dict, List, Optional, Tuple
 
 import jax
+import jax.flatten_util
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -65,7 +66,41 @@ class ShardedBoxTrainer:
         self.dense_opt = make_dense_optimizer(self.cfg)
         rng = jax.random.PRNGKey(seed)
         self.params = model.init(rng)
-        self.opt_state = self.dense_opt.init(self.params)
+        # dense sync modes (§2.8: step = per-step allreduce; k_step = K local
+        # steps then param sync, boxps_worker.cc:1169-1236; sharding = ZeRO-1
+        # partitioned optimizer, boxps_worker.cc:582-751)
+        self.sharding_mode = (self.cfg.sharding
+                              or self.cfg.sync_mode == "sharding")
+        self.k_step = (max(1, self.cfg.sync_weight_step)
+                       if self.cfg.sync_mode == "k_step" else 1)
+        if self.sharding_mode and self.k_step > 1:
+            raise ValueError("sharding and k_step dense sync are exclusive")
+        if self.sharding_mode and self.cfg.dense_optimizer != "adam":
+            raise ValueError(
+                "ZeRO-1 sharding implements adam only; got dense_optimizer="
+                + self.cfg.dense_optimizer)
+        Pn = self.mesh.devices.size
+        if self.sharding_mode:
+            flat, _ = jax.flatten_util.ravel_pytree(self.params)
+            self._n_dense = int(flat.size)
+            self._n_shard = -(-self._n_dense // Pn)  # ceil
+            sh = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+            # hand-rolled Adam moments, partitioned [P, n/P]
+            self.opt_state = (
+                jax.device_put(np.zeros((Pn, self._n_shard), np.float32), sh),
+                jax.device_put(np.zeros((Pn, self._n_shard), np.float32), sh),
+                jnp.zeros((), jnp.int32))
+        elif self.k_step > 1:
+            # per-device param/optimizer replicas that diverge between syncs
+            sh = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+            stack = lambda x: jax.device_put(
+                np.broadcast_to(np.asarray(x)[None],
+                                (Pn,) + np.asarray(x).shape).copy(), sh)
+            self.opt_state = jax.tree.map(
+                stack, self.dense_opt.init(self.params))
+            self.params = jax.tree.map(stack, self.params)
+        else:
+            self.opt_state = self.dense_opt.init(self.params)
         self.num_slots = len(feed.used_sparse_slots())
         self.use_cvm = use_cvm
         self.multi_task = len(getattr(model, "task_names", ("ctr",))) > 1
@@ -74,6 +109,9 @@ class ShardedBoxTrainer:
         self._shuffle_rng = np.random.RandomState(seed + 1)
         self.timers = {n: Timer() for n in ("step", "pass", "build")}
         self._step = self._build_step()
+        self._param_sync = (self._build_param_sync() if self.k_step > 1
+                            else None)
+        self._steps_since_sync = 0
 
     # ------------------------------------------------------------ jit step
     def _build_step(self):
@@ -88,10 +126,20 @@ class ShardedBoxTrainer:
         from paddlebox_tpu.train.trainer import model_accepts_rank_offset
         wants_rank_offset = model_accepts_rank_offset(model)
 
+        sharding_mode = self.sharding_mode
+        k_step = self.k_step
+        lr = self.cfg.dense_lr
+
         def shard_step(slab, params, opt_state, batch, prng):
             # per-device views: slab [1, C, W]; batch leaves [1, ...]
             slab = slab[0]
             batch = jax.tree.map(lambda x: x[0], batch)
+            if sharding_mode:
+                mu, nu, t = opt_state
+                mu, nu = mu[0], nu[0]
+            elif k_step > 1:
+                params = jax.tree.map(lambda x: x[0], params)
+                opt_state = jax.tree.map(lambda x: x[0], opt_state)
             prng, next_prng = jax.random.split(prng)
             prng = jax.random.fold_in(prng, jax.lax.axis_index(axis))
             buckets = batch["buckets"]                       # [P, KB]
@@ -130,12 +178,50 @@ class ShardedBoxTrainer:
             grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)
             (loss, preds), (dparams, demb) = grad_fn(params, emb)
 
-            # ---- dense sync: data-parallel allreduce (SyncParam/NCCL)
-            dparams = jax.lax.pmean(dparams, axis)
+            # ---- dense sync by mode
             loss = jax.lax.pmean(loss, axis)
-            updates, opt_state = self.dense_opt.update(dparams, opt_state,
-                                                       params)
-            params = optax.apply_updates(params, updates)
+            if sharding_mode:
+                # ZeRO-1: reduce-scatter grads → shard-local Adam →
+                # all-gather params (the TPU shape of the reference's
+                # reduce-scatter + SyncDense + allgather, boxps_worker.cc:
+                # 1194-1218, with per-rank-owned optimizer state, cc:582-751)
+                flat_g, _ = jax.flatten_util.ravel_pytree(dparams)
+                flat_p, unravel = jax.flatten_util.ravel_pytree(params)
+                n = flat_p.size
+                n_shard = -(-n // Pn)
+                pad = Pn * n_shard - n
+                gpad = jnp.pad(flat_g, (0, pad))
+                g_shard = jax.lax.psum_scatter(
+                    gpad, axis, scatter_dimension=0, tiled=True) / Pn
+                i = jax.lax.axis_index(axis)
+                ppad = jnp.pad(flat_p, (0, pad))
+                p_shard = jax.lax.dynamic_slice(ppad, (i * n_shard,),
+                                                (n_shard,))
+                t = t + 1
+                tf = t.astype(jnp.float32)
+                mu = 0.9 * mu + 0.1 * g_shard
+                nu = 0.999 * nu + 0.001 * jnp.square(g_shard)
+                mhat = mu / (1.0 - jnp.power(0.9, tf))
+                vhat = nu / (1.0 - jnp.power(0.999, tf))
+                p_shard = p_shard - lr * mhat / (jnp.sqrt(vhat) + 1e-8)
+                flat_new = jax.lax.all_gather(p_shard, axis, tiled=True)[:n]
+                params = unravel(flat_new)
+                opt_state = (mu[None], nu[None], t)
+            elif k_step > 1:
+                # K-step mode: local update now, param allreduce every K
+                # steps from the host loop (DenseKStep*, boxps_worker.cc:
+                # 389-391,1297-1302)
+                updates, opt_state = self.dense_opt.update(
+                    dparams, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                params = jax.tree.map(lambda x: x[None], params)
+                opt_state = jax.tree.map(lambda x: x[None], opt_state)
+            else:
+                # per-step data-parallel allreduce (SyncParam/NCCL)
+                dparams = jax.lax.pmean(dparams, axis)
+                updates, opt_state = self.dense_opt.update(
+                    dparams, opt_state, params)
+                params = optax.apply_updates(params, updates)
 
             # ---- push: per-key grads → bucket merge → a2a → local update
             label_src = (batch["labels_" + model.task_names[0]] if multi_task
@@ -156,12 +242,47 @@ class ShardedBoxTrainer:
         spec_rep = P()
         # prefix specs: spec_sh applies to every leaf of the batch dict /
         # preds dict
+        if self.sharding_mode:
+            opt_in = opt_out = (spec_sh, spec_sh, spec_rep)
+            par_in = par_out = spec_rep
+        elif self.k_step > 1:
+            opt_in = opt_out = spec_sh
+            par_in = par_out = spec_sh
+        else:
+            opt_in = opt_out = spec_rep
+            par_in = par_out = spec_rep
         fn = jax.shard_map(
             shard_step, mesh=self.mesh,
-            in_specs=(spec_sh, spec_rep, spec_rep, spec_sh, spec_rep),
-            out_specs=(spec_sh, spec_rep, spec_rep, spec_rep, spec_sh,
-                       spec_rep))
+            in_specs=(spec_sh, par_in, opt_in, spec_sh, spec_rep),
+            out_specs=(spec_sh, par_out, opt_out, spec_rep, spec_sh,
+                       spec_rep),
+            check_vma=False)
         return jax.jit(fn)
+
+    def _build_param_sync(self):
+        """K-step dense sync: allreduce-mean the diverged per-device param
+        and optimizer replicas (SyncParam, boxps_worker.cc:1169-1236 —
+        scale 1/(dev×node))."""
+        axis = self.axis
+
+        def _avg(x):
+            # int leaves (e.g. adam count) are identical replicas: pass through
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                return jax.lax.pmean(x, axis)
+            return x
+
+        def sync(params, opt_state):
+            params = jax.tree.map(lambda x: x[0], params)
+            opt_state = jax.tree.map(lambda x: x[0], opt_state)
+            params = jax.tree.map(_avg, params)
+            opt_state = jax.tree.map(_avg, opt_state)
+            return (jax.tree.map(lambda x: x[None], params),
+                    jax.tree.map(lambda x: x[None], opt_state))
+
+        spec_sh = P(self.axis)
+        return jax.jit(jax.shard_map(
+            sync, mesh=self.mesh, in_specs=(spec_sh, spec_sh),
+            out_specs=(spec_sh, spec_sh), check_vma=False))
 
     # -------------------------------------------------------------- batches
     def shard_batches(self, per_worker: List[List[PackedBatch]]
@@ -221,12 +342,30 @@ class ShardedBoxTrainer:
                                       self.opt_state, batch, self._prng)
             self.timers["step"].pause()
             losses.append(float(loss))
+            if self._param_sync is not None:
+                self._steps_since_sync += 1
+                if self._steps_since_sync >= self.k_step:
+                    self.params, self.opt_state = self._param_sync(
+                        self.params, self.opt_state)
+                    self._steps_since_sync = 0
             self._add_metrics(preds, raw_steps[i])
+        if self._param_sync is not None and self._steps_since_sync:
+            # pass boundary is always a sync point
+            self.params, self.opt_state = self._param_sync(
+                self.params, self.opt_state)
+            self._steps_since_sync = 0
         self.table.write_back(np.asarray(self._slabs))
         self._slabs = None
         t_pass.pause()
         return {"loss": float(np.mean(losses)) if losses else 0.0,
                 "batches": len(dev_batches), "instances": len(dataset)}
+
+    def merged_params(self):
+        """Single-copy dense params for eval/checkpoint (k_step mode keeps
+        per-device replicas; others are already one copy)."""
+        if self.k_step > 1:
+            return jax.tree.map(lambda x: np.asarray(x).mean(0), self.params)
+        return self.params
 
     def _add_metrics(self, preds, step_batches: Tuple[PackedBatch, ...]) -> None:
         if not self.metrics.metric_names():

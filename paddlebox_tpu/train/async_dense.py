@@ -1,0 +1,140 @@
+"""Host-side asynchronous dense parameter table.
+
+Analog of `BoxPSAsynDenseTable` (paddle/fluid/framework/boxps_worker.cc:
+57-366): dense params live as ONE flat host vector with Adam moment vectors
+beside it; workers pull a snapshot per step and push raw grads to a queue; a
+background thread merges up to `merge_limit` queued grads (cc:234-260) and
+applies a hand-rolled Adam (cc:262-326) — plus the data-norm "summary"
+update rule (raw accumulation for batch_size/batch_sum/batch_square_sum
+params, cc:89-95) selected by a boolean mask.
+
+The TPU trainer uses this in `sync_mode="async"`: the jitted step returns
+dense grads instead of applying them, the host overlaps the optimizer with
+the next device step (the reference's point: dense update off the critical
+path).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from paddlebox_tpu.utils.stats import stat_add
+
+
+class AsyncDenseTable:
+    def __init__(self, init_params: np.ndarray,
+                 lr: float = 1e-3, beta1: float = 0.9, beta2: float = 0.999,
+                 eps: float = 1e-8,
+                 summary_mask: Optional[np.ndarray] = None,
+                 merge_limit: int = 4) -> None:
+        self._params = np.array(init_params, dtype=np.float32)
+        self._mom1 = np.zeros_like(self._params)
+        self._mom2 = np.zeros_like(self._params)
+        self.lr, self.beta1, self.beta2, self.eps = lr, beta1, beta2, eps
+        # True where the param is a data-norm summary stat: plain += grad
+        self._summary = (summary_mask.astype(bool)
+                         if summary_mask is not None else None)
+        self.merge_limit = merge_limit
+        self._t = 0
+        self._lock = threading.Lock()
+        self._queue: "queue.Queue[Optional[np.ndarray]]" = queue.Queue()
+        self._stopped = False
+        self._thread = threading.Thread(target=self._update_loop, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------ worker API
+    def pull(self) -> np.ndarray:
+        """Snapshot of the current params (PullDense, cc:329-338)."""
+        with self._lock:
+            return self._params.copy()
+
+    def push(self, grad: np.ndarray) -> None:
+        """Queue a flat grad for the background optimizer
+        (PushDense, cc:340-347)."""
+        self._queue.put(np.asarray(grad, dtype=np.float32))
+
+    @property
+    def steps_applied(self) -> int:
+        return self._t
+
+    def wait_drained(self, timeout: float = 60.0) -> None:
+        """Block until every queued grad has been applied."""
+        import time
+        deadline = time.monotonic() + timeout
+        while not self._queue.empty():
+            if time.monotonic() > deadline:
+                raise TimeoutError("async dense queue not drained")
+            time.sleep(0.001)
+        with self._queue.all_tasks_done:
+            while self._queue.unfinished_tasks:
+                if not self._queue.all_tasks_done.wait(timeout):
+                    raise TimeoutError("async dense update not finished")
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._queue.put(None)
+        self._thread.join()
+
+    # ------------------------------------------------------- background loop
+    def _update_loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                self._queue.task_done()
+                return
+            merged: List[np.ndarray] = [item]
+            # merge a limited burst of queued grads into one apply
+            # (AsyncUpdate merge loop, cc:234-260)
+            while len(merged) < self.merge_limit:
+                try:
+                    nxt = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._apply(merged)
+                    for _ in merged:
+                        self._queue.task_done()
+                    self._queue.task_done()
+                    return
+                merged.append(nxt)
+            self._apply(merged)
+            for _ in merged:
+                self._queue.task_done()
+
+    def _apply(self, grads: List[np.ndarray]) -> None:
+        g = grads[0] if len(grads) == 1 else np.sum(grads, axis=0)
+        if len(grads) > 1:
+            g /= float(len(grads))
+        with self._lock:
+            self._t += 1
+            self._mom1 *= self.beta1
+            self._mom1 += (1 - self.beta1) * g
+            self._mom2 *= self.beta2
+            self._mom2 += (1 - self.beta2) * np.square(g)
+            bc1 = 1 - self.beta1 ** self._t
+            bc2 = 1 - self.beta2 ** self._t
+            step = (self.lr * (self._mom1 / bc1)
+                    / (np.sqrt(self._mom2 / bc2) + self.eps))
+            if self._summary is not None:
+                # summary stats accumulate raw "grads" (running sums)
+                step = np.where(self._summary, -g, step)
+            self._params -= step
+        stat_add("async_dense_applies", 1)
+
+    # ------------------------------------------------------------ checkpoint
+    def state(self) -> dict:
+        with self._lock:
+            return {"params": self._params.copy(),
+                    "mom1": self._mom1.copy(), "mom2": self._mom2.copy(),
+                    "t": self._t}
+
+    def load_state(self, st: dict) -> None:
+        with self._lock:
+            self._params[...] = st["params"]
+            self._mom1[...] = st["mom1"]
+            self._mom2[...] = st["mom2"]
+            self._t = int(st["t"])

@@ -21,6 +21,7 @@ import functools
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
+import jax.flatten_util
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -100,7 +101,8 @@ def model_accepts_rank_offset(model) -> bool:
 def make_train_step(model, layout: ValueLayout, table: TableConfig,
                     dense_opt: optax.GradientTransformation,
                     batch_size: int, num_slots: int,
-                    use_cvm: bool = True) -> TrainStepFns:
+                    use_cvm: bool = True,
+                    async_dense: bool = False) -> TrainStepFns:
     conf = table.optimizer
     multi_task = len(getattr(model, "task_names", ("ctr",))) > 1
     wants_rank_offset = model_accepts_rank_offset(model)
@@ -130,29 +132,49 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
             preds = {"ctr": main_pred}
         return loss, preds
 
-    @jax.jit
-    def step(slab, params, opt_state, batch, prng):
-        # split on device: host-side per-step RNG dispatch costs more than
-        # the whole compiled step (2 sync dispatches ≈ 200us)
-        prng, sub = jax.random.split(prng)
-        ids = batch["ids"]
-
-        def loss_fn(params, emb):
-            return forward(params, emb, batch, None)
-
-        emb = pull_sparse(slab, ids, layout)
-        grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)
-        (loss, preds), (dparams, demb) = grad_fn(params, emb)
-        updates, opt_state = dense_opt.update(dparams, opt_state, params)
-        params = optax.apply_updates(params, updates)
+    def _sparse_push(slab, demb, batch, sub):
         # per-key click = its instance's label (first task's label)
         key_label_src = batch["labels_" + model.task_names[0]] if multi_task \
             else batch["labels"]
         clicks = key_label_src[batch["segments"] // num_slots]
         push_grads = build_push_grads(demb, batch["slots"], clicks,
                                       batch["valid"])
-        slab = push_sparse_dedup(slab, ids, push_grads, sub, layout, conf)
+        return push_sparse_dedup(slab, batch["ids"], push_grads, sub, layout,
+                                 conf)
+
+    @jax.jit
+    def step(slab, params, opt_state, batch, prng):
+        # split on device: host-side per-step RNG dispatch costs more than
+        # the whole compiled step (2 sync dispatches ≈ 200us)
+        prng, sub = jax.random.split(prng)
+
+        def loss_fn(params, emb):
+            return forward(params, emb, batch, None)
+
+        emb = pull_sparse(slab, batch["ids"], layout)
+        grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)
+        (loss, preds), (dparams, demb) = grad_fn(params, emb)
+        updates, opt_state = dense_opt.update(dparams, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        slab = _sparse_push(slab, demb, batch, sub)
         return slab, params, opt_state, loss, preds, prng
+
+    @jax.jit
+    def step_async(slab, params, batch, prng):
+        """Async-dense variant: dense grads come back flat for the host
+        table; only the sparse push happens on device
+        (boxps_worker.cc:1278-1296 pull/push around the op loop)."""
+        prng, sub = jax.random.split(prng)
+
+        def loss_fn(params, emb):
+            return forward(params, emb, batch, None)
+
+        emb = pull_sparse(slab, batch["ids"], layout)
+        grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)
+        (loss, preds), (dparams, demb) = grad_fn(params, emb)
+        flat_g = jax.flatten_util.ravel_pytree(dparams)[0]
+        slab = _sparse_push(slab, demb, batch, sub)
+        return slab, flat_g, loss, preds, prng
 
     @jax.jit
     def eval_step(slab, params, batch):
@@ -160,7 +182,8 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         _, preds = forward(params, emb, batch, None)
         return preds
 
-    return TrainStepFns(step=step, eval_step=eval_step,
+    return TrainStepFns(step=step_async if async_dense else step,
+                        eval_step=eval_step,
                         batch_size=batch_size, num_slots=num_slots)
 
 
@@ -181,9 +204,23 @@ class BoxTrainer:
         self.params = model.init(rng)
         self.opt_state = self.dense_opt.init(self.params)
         self.num_slots = len(feed.used_sparse_slots())
+        self.async_mode = (self.cfg.async_mode
+                           or self.cfg.sync_mode == "async")
         self.fns = make_train_step(
             model, self.table.layout, table_cfg, self.dense_opt,
-            feed.batch_size, self.num_slots, use_cvm)
+            feed.batch_size, self.num_slots, use_cvm,
+            async_dense=self.async_mode)
+        self.async_table = None
+        self._unravel = None
+        if self.async_mode:
+            if self.cfg.dense_optimizer != "adam":
+                raise ValueError(
+                    "async dense table implements adam only; got "
+                    + self.cfg.dense_optimizer)
+            from paddlebox_tpu.train.async_dense import AsyncDenseTable
+            flat, self._unravel = jax.flatten_util.ravel_pytree(self.params)
+            self.async_table = AsyncDenseTable(np.asarray(flat),
+                                               lr=self.cfg.dense_lr)
         self.timers = {n: Timer() for n in ("step", "pass")}
         self._step_count = 0
         self._shuffle_rng = np.random.RandomState(seed + 1)
@@ -230,9 +267,19 @@ class BoxTrainer:
             ids = self.table.lookup_ids(b.keys, b.valid)
             batch = self.device_batch(b, ids)
             self.timers["step"].start()
-            (slab, self.params, self.opt_state, loss, preds,
-             prng) = self.fns.step(
-                self.table.slab, self.params, self.opt_state, batch, prng)
+            if self.async_table is not None:
+                # pull a fresh dense snapshot, run the device step, queue the
+                # grads for the host optimizer thread (PullDense/PushDense
+                # around the op loop, boxps_worker.cc:1278-1296)
+                self.params = self._unravel(jnp.asarray(
+                    self.async_table.pull()))
+                slab, flat_g, loss, preds, prng = self.fns.step(
+                    self.table.slab, self.params, batch, prng)
+                self.async_table.push(np.asarray(flat_g))
+            else:
+                (slab, self.params, self.opt_state, loss, preds,
+                 prng) = self.fns.step(
+                    self.table.slab, self.params, self.opt_state, batch, prng)
             self.table.set_slab(slab)
             self.timers["step"].pause()
             self._step_count += 1
@@ -242,6 +289,11 @@ class BoxTrainer:
                     f"nan/inf loss at step {self._step_count}")
             self._add_metrics(preds, b)
         self.table.end_pass()
+        if self.async_table is not None:
+            # pass boundary is a sync point: drain the host optimizer and
+            # refresh the local params for eval/checkpoint
+            self.async_table.wait_drained()
+            self.params = self._unravel(jnp.asarray(self.async_table.pull()))
         t_pass.pause()
         return {"loss": float(np.mean(losses)) if losses else 0.0,
                 "batches": len(worker_batches[0]),
