@@ -239,11 +239,13 @@ class BoxDataset:
             if self._disk_writer is not None:
                 self.disk_files = self._disk_writer.close()
                 self._disk_writer = None
+        if self._load_error is not None:
+            # the load error is the root cause (a dead reader also starves
+            # the shuffle); surface it over any secondary flush failure
+            raise RuntimeError("dataset load failed") from self._load_error
         if flush_error is not None:
             raise RuntimeError(
                 "cross-host shuffle flush failed") from flush_error
-        if self._load_error is not None:
-            raise RuntimeError("dataset load failed") from self._load_error
 
     # -------------------------------------------------------------- disk spill
     def preload_into_disk(self, out_prefix: str,

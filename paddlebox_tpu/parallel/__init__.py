@@ -1,10 +1,15 @@
 from paddlebox_tpu.parallel.mesh import make_mesh, device_mesh_1d
+from paddlebox_tpu.parallel.pipeline import (GPipeRunner, PipelineConfig,
+                                             mlp_stage_apply)
 from paddlebox_tpu.parallel.sharded_table import ShardedPassTable, ShardedBatchIndex
 from paddlebox_tpu.parallel.sharded_trainer import ShardedBoxTrainer
 
 __all__ = [
     "make_mesh",
     "device_mesh_1d",
+    "GPipeRunner",
+    "PipelineConfig",
+    "mlp_stage_apply",
     "ShardedPassTable",
     "ShardedBatchIndex",
     "ShardedBoxTrainer",

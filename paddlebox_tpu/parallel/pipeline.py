@@ -1,0 +1,213 @@
+"""Micro-batch pipeline parallelism over a `stage` mesh axis.
+
+TPU-native re-design of the reference's pipeline training (BoxPSOptimizer
+cut_list program splitting, python/paddle/fluid/optimizer.py:7496-7575 →
+SectionWorker micro-batch section loop, framework/section_worker.cc,
+device_worker.h:639; also the actor-style FleetExecutor pipeline,
+distributed/fleet_executor/). Where the reference moves micro-batch scopes
+between section workers over queues, here the WHOLE schedule is one SPMD
+program: every device holds one stage's params, activations circulate with
+`lax.ppermute` on the ICI ring, and `lax.scan` runs the M + S - 1 GPipe
+ticks. Backward needs no hand-written schedule — jax.grad transposes the
+scan+ppermute into the reverse pipeline automatically.
+
+Stages must be shape-homogeneous (same activation width in/out) so stage
+params stack on the leading axis; in/out projections live in replicated
+pre/post layers of the wrapping model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+STAGE_AXIS = "stage"
+
+
+def init_stage_params(rng: jax.Array, n_stages: int, d_model: int,
+                      layers_per_stage: int = 1,
+                      scale: float = 0.1) -> Dict[str, jax.Array]:
+    """[S, L, d, d] MLP blocks — one row of L dense layers per stage."""
+    w = scale * jax.random.normal(
+        rng, (n_stages, layers_per_stage, d_model, d_model), jnp.float32)
+    b = jnp.zeros((n_stages, layers_per_stage, d_model), jnp.float32)
+    return {"w": w, "b": b}
+
+
+def mlp_stage_apply(params: Dict[str, jax.Array], x: jax.Array) -> jax.Array:
+    """One stage's block: L × (dense + relu). params: [L, d, d] / [L, d]."""
+    L = params["w"].shape[0]
+    for i in range(L):
+        x = jax.nn.relu(x @ params["w"][i] + params["b"][i])
+    return x
+
+
+def _spmd_pipeline(stage_apply: Callable, n_stages: int, n_micro: int,
+                   axis: str):
+    """Per-device GPipe schedule. Inputs arrive replicated [M, mb, d];
+    stage params are this device's slice. Returns replicated [M, mb, d]."""
+
+    def run(stage_params, micro_inputs):
+        S, M = n_stages, n_micro
+        idx = jax.lax.axis_index(axis)
+        is_first = idx == 0
+        is_last = idx == S - 1
+        mb, d = micro_inputs.shape[1], micro_inputs.shape[2]
+        state0 = jnp.zeros((mb, d), micro_inputs.dtype)
+        out0 = jnp.zeros((M, mb, d), micro_inputs.dtype)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            state, out_buf = carry
+            # stage 0 ingests micro-batch t (clamped; extra ticks are
+            # pipeline drain and their stage-0 output is never collected)
+            x_in = micro_inputs[jnp.minimum(t, M - 1)]
+            state = jnp.where(is_first, x_in, state)
+            y = stage_apply(stage_params, state)
+            # last stage emits micro-batch t-(S-1) once the pipe is full
+            widx = jnp.maximum(t - (S - 1), 0)
+            emit = (t >= S - 1) & is_last
+            out_buf = out_buf.at[widx].set(
+                jnp.where(emit, y, out_buf[widx]))
+            state = jax.lax.ppermute(y, axis, perm)
+            return (state, out_buf), None
+
+        (_, out_buf), _ = jax.lax.scan(
+            tick, (state0, out0), jnp.arange(M + S - 1))
+        # replicate the last stage's outputs to every stage (transposes to
+        # routing output-grads back to the last stage in backward)
+        out_buf = jax.lax.psum(
+            jnp.where(is_last, out_buf, jnp.zeros_like(out_buf)), axis)
+        return out_buf
+
+    return run
+
+
+@dataclasses.dataclass
+class PipelineConfig:
+    n_stages: int = 4
+    n_micro: int = 8            # micro-batches per step (= cut_list sections)
+    d_model: int = 64
+    layers_per_stage: int = 2
+    lr: float = 1e-3
+
+
+class GPipeRunner:
+    """Holds stage-sharded params and the jitted pipelined fwd/train step.
+
+    Params live as [S, ...] arrays sharded over the stage axis — each
+    device materialises only its own stage (ZeRO-like by construction,
+    matching how each SectionWorker owns only its section's program).
+    """
+
+    def __init__(self, cfg: PipelineConfig, mesh: Optional[Mesh] = None,
+                 stage_apply: Callable = mlp_stage_apply,
+                 init_fn: Optional[Callable] = None, seed: int = 0):
+        self.cfg = cfg
+        if mesh is None:
+            devs = np.array(jax.devices()[:cfg.n_stages])
+            mesh = Mesh(devs, (STAGE_AXIS,))
+        if mesh.devices.size != cfg.n_stages:
+            raise ValueError("mesh size %d != n_stages %d"
+                             % (mesh.devices.size, cfg.n_stages))
+        self.mesh = mesh
+        self.axis = mesh.axis_names[0]
+        init = init_fn or (lambda rng: init_stage_params(
+            rng, cfg.n_stages, cfg.d_model, cfg.layers_per_stage))
+        sh = NamedSharding(mesh, P(self.axis))
+        self.params = jax.tree.map(
+            lambda x: jax.device_put(x, sh), init(jax.random.PRNGKey(seed)))
+        self.opt = optax.adam(cfg.lr)
+        # optimizer state shards with the params it tracks (scalars like the
+        # adam count stay replicated)
+        host_opt = self.opt.init(jax.tree.map(np.asarray, self.params))
+        self.opt_state = jax.tree.map(
+            lambda x: (jax.device_put(jnp.asarray(x), sh)
+                       if getattr(x, "ndim", 0) else jnp.asarray(x)),
+            host_opt)
+        self._fwd = self._build_fwd(stage_apply)
+        self._step = self._build_step(stage_apply)
+
+    # ------------------------------------------------------------------ fwd
+    def _build_fwd(self, stage_apply):
+        cfg = self.cfg
+        pipe = _spmd_pipeline(stage_apply, cfg.n_stages, cfg.n_micro,
+                              self.axis)
+
+        def fwd(params, micro_inputs):
+            local = jax.tree.map(lambda x: x[0], params)  # [1,...] → [...]
+            return pipe(local, micro_inputs)
+
+        return jax.jit(jax.shard_map(
+            fwd, mesh=self.mesh, in_specs=(P(self.axis), P()),
+            out_specs=P(), check_vma=False))
+
+    def forward(self, x: np.ndarray) -> jax.Array:
+        """x: [M*mb, d] → pipelined output [M*mb, d]."""
+        cfg = self.cfg
+        m = x.reshape(cfg.n_micro, -1, cfg.d_model)
+        out = self._fwd(self.params, jnp.asarray(m))
+        return out.reshape(x.shape[0], cfg.d_model)
+
+    # ----------------------------------------------------------------- train
+    def _build_step(self, stage_apply):
+        cfg = self.cfg
+        pipe = _spmd_pipeline(stage_apply, cfg.n_stages, cfg.n_micro,
+                              self.axis)
+        opt = self.opt
+
+        def step(params, opt_state, micro_inputs, micro_targets):
+            local = jax.tree.map(lambda x: x[0], params)
+            local_opt = jax.tree.map(
+                lambda x: x[0] if getattr(x, "ndim", 0) else x, opt_state)
+
+            def loss_fn(p):
+                out = pipe(p, micro_inputs)
+                return jnp.mean(jnp.square(out - micro_targets))
+
+            loss, grads = jax.value_and_grad(loss_fn)(local)
+            # each device owns its stage: update with LOCAL grads only —
+            # there is nothing to allreduce across stages
+            updates, local_opt = opt.update(grads, local_opt, local)
+            local = optax.apply_updates(local, updates)
+            params = jax.tree.map(lambda x: x[None], local)
+            opt_state = jax.tree.map(
+                lambda x: x[None] if getattr(x, "ndim", 0) else x, local_opt)
+            return params, opt_state, loss
+
+        spec_sh = P(self.axis)
+        opt_spec = jax.tree.map(
+            lambda x: spec_sh if getattr(x, "ndim", 0) else P(),
+            self.opt_state,
+            is_leaf=lambda x: hasattr(x, "ndim") or np.isscalar(x))
+        return jax.jit(jax.shard_map(
+            step, mesh=self.mesh,
+            in_specs=(spec_sh, opt_spec, P(), P()),
+            out_specs=(spec_sh, opt_spec, P()), check_vma=False))
+
+    def train_step(self, x: np.ndarray, y: np.ndarray) -> float:
+        cfg = self.cfg
+        mi = jnp.asarray(x.reshape(cfg.n_micro, -1, cfg.d_model))
+        mt = jnp.asarray(y.reshape(cfg.n_micro, -1, cfg.d_model))
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, mi, mt)
+        return float(loss)
+
+    # ------------------------------------------------------------- reference
+    def sequential_forward(self, x: np.ndarray,
+                           stage_apply: Callable = mlp_stage_apply
+                           ) -> jax.Array:
+        """Unpipelined oracle: run stages in order on one device."""
+        params_host = jax.tree.map(np.asarray, self.params)
+        out = jnp.asarray(x)
+        for s in range(self.cfg.n_stages):
+            p = jax.tree.map(lambda a: jnp.asarray(a[s]), params_host)
+            out = stage_apply(p, out)
+        return out

@@ -75,6 +75,9 @@ class ShardedBoxTrainer:
                        if self.cfg.sync_mode == "k_step" else 1)
         if self.sharding_mode and self.k_step > 1:
             raise ValueError("sharding and k_step dense sync are exclusive")
+        if self.cfg.async_mode or self.cfg.sync_mode == "async":
+            raise ValueError(
+                "async dense mode is single-host: use BoxTrainer")
         if self.sharding_mode and self.cfg.dense_optimizer != "adam":
             raise ValueError(
                 "ZeRO-1 sharding implements adam only; got dense_optimizer="

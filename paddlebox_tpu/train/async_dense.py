@@ -42,7 +42,6 @@ class AsyncDenseTable:
         self._t = 0
         self._lock = threading.Lock()
         self._queue: "queue.Queue[Optional[np.ndarray]]" = queue.Queue()
-        self._stopped = False
         self._thread = threading.Thread(target=self._update_loop, daemon=True)
         self._thread.start()
 
@@ -65,17 +64,14 @@ class AsyncDenseTable:
         """Block until every queued grad has been applied."""
         import time
         deadline = time.monotonic() + timeout
-        while not self._queue.empty():
-            if time.monotonic() > deadline:
-                raise TimeoutError("async dense queue not drained")
-            time.sleep(0.001)
         with self._queue.all_tasks_done:
             while self._queue.unfinished_tasks:
-                if not self._queue.all_tasks_done.wait(timeout):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._queue.all_tasks_done.wait(
+                        remaining):
                     raise TimeoutError("async dense update not finished")
 
     def stop(self) -> None:
-        self._stopped = True
         self._queue.put(None)
         self._thread.join()
 
@@ -106,9 +102,10 @@ class AsyncDenseTable:
                 self._queue.task_done()
 
     def _apply(self, grads: List[np.ndarray]) -> None:
-        g = grads[0] if len(grads) == 1 else np.sum(grads, axis=0)
-        if len(grads) > 1:
-            g /= float(len(grads))
+        gsum = grads[0] if len(grads) == 1 else np.sum(grads, axis=0)
+        # adam consumes the mean of the merged burst; summary slots must
+        # accumulate the RAW sum (running-total semantics, cc:89-95)
+        g = gsum / float(len(grads)) if len(grads) > 1 else gsum
         with self._lock:
             self._t += 1
             self._mom1 *= self.beta1
@@ -121,7 +118,7 @@ class AsyncDenseTable:
                     / (np.sqrt(self._mom2 / bc2) + self.eps))
             if self._summary is not None:
                 # summary stats accumulate raw "grads" (running sums)
-                step = np.where(self._summary, -g, step)
+                step = np.where(self._summary, -gsum, step)
             self._params -= step
         stat_add("async_dense_applies", 1)
 

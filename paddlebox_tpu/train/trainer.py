@@ -204,6 +204,10 @@ class BoxTrainer:
         self.params = model.init(rng)
         self.opt_state = self.dense_opt.init(self.params)
         self.num_slots = len(feed.used_sparse_slots())
+        if self.cfg.sync_mode in ("k_step", "sharding") or self.cfg.sharding:
+            raise ValueError(
+                "sync_mode=%r needs the multi-device ShardedBoxTrainer"
+                % self.cfg.sync_mode)
         self.async_mode = (self.cfg.async_mode
                            or self.cfg.sync_mode == "async")
         self.fns = make_train_step(
@@ -225,6 +229,18 @@ class BoxTrainer:
         self._step_count = 0
         self._shuffle_rng = np.random.RandomState(seed + 1)
         self.multi_task = len(getattr(model, "task_names", ("ctr",))) > 1
+
+    def close(self) -> None:
+        """Stop the async dense optimizer thread (no-op in sync modes)."""
+        if self.async_table is not None:
+            self.async_table.stop()
+            self.async_table = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     # ---------------------------------------------------------- batch utils
     def device_batch(self, b: PackedBatch,

@@ -1,0 +1,85 @@
+"""GPipe pipeline over the stage axis: exactness vs the sequential oracle
+(the property SectionWorker's scope-queue schedule guarantees by
+construction) and end-to-end learning with stage-sharded adam."""
+
+import numpy as np
+import jax
+import pytest
+
+from paddlebox_tpu.parallel.pipeline import (GPipeRunner, PipelineConfig,
+                                             mlp_stage_apply)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return GPipeRunner(PipelineConfig(n_stages=4, n_micro=8, d_model=16,
+                                      layers_per_stage=2, lr=1e-2), seed=3)
+
+
+def test_pipeline_matches_sequential(runner):
+    rng = np.random.RandomState(0)
+    x = rng.randn(8 * 4, 16).astype(np.float32)
+    got = np.asarray(runner.forward(x))
+    want = np.asarray(runner.sequential_forward(x))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_bubble_does_not_corrupt(runner):
+    """Micro-batch count not divisible into the pipe depth: every
+    micro-batch must still come out exact (drain ticks are masked)."""
+    r = GPipeRunner(PipelineConfig(n_stages=4, n_micro=5, d_model=16,
+                                   layers_per_stage=1), seed=5)
+    rng = np.random.RandomState(1)
+    x = rng.randn(5 * 3, 16).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(r.forward(x)),
+                               np.asarray(r.sequential_forward(x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_trains(runner):
+    rng = np.random.RandomState(2)
+    x = rng.randn(8 * 4, 16).astype(np.float32)
+    # target: a fixed random rotation of the input
+    w = rng.randn(16, 16).astype(np.float32) * 0.5
+    y = np.tanh(x @ w)
+    losses = [runner.train_step(x, y) for _ in range(150)]
+    # correctness is pinned by the exactness + grad-oracle tests; this just
+    # checks the stage-sharded adam actually descends
+    assert losses[-1] < 0.85 * losses[0], (losses[0], losses[-1])
+
+
+def test_pipeline_grads_match_sequential():
+    """Backward through scan+ppermute == backward through the plain
+    composition (checked via loss after one identical step)."""
+    cfg = PipelineConfig(n_stages=2, n_micro=4, d_model=8,
+                         layers_per_stage=1, lr=1e-2)
+    r = GPipeRunner(cfg, seed=7)
+    rng = np.random.RandomState(3)
+    x = rng.randn(4 * 2, 8).astype(np.float32)
+    y = rng.randn(4 * 2, 8).astype(np.float32)
+
+    # oracle grads on the same stacked params, sequential composition
+    import jax.numpy as jnp
+    params0 = jax.tree.map(np.asarray, r.params)
+
+    def seq_loss(params):
+        out = jnp.asarray(x)
+        for s in range(cfg.n_stages):
+            p = jax.tree.map(lambda a: a[s], params)
+            out = mlp_stage_apply(p, out)
+        return jnp.mean(jnp.square(out - y))
+
+    want = jax.grad(seq_loss)(params0)
+
+    # pipeline step then recover the applied update direction: compare
+    # param delta signs/magnitudes via a fresh manual adam step on oracle
+    # grads (same optimizer state = zeros)
+    import optax
+    opt = optax.adam(cfg.lr)
+    upd, _ = opt.update(want, opt.init(params0), params0)
+    want_params = optax.apply_updates(params0, upd)
+    r.train_step(x, y)
+    got_params = jax.tree.map(np.asarray, r.params)
+    for wp, gp in zip(jax.tree.leaves(want_params),
+                      jax.tree.leaves(got_params)):
+        np.testing.assert_allclose(gp, wp, rtol=1e-4, atol=1e-5)
