@@ -119,6 +119,7 @@ class GPipeRunner:
                              % (mesh.devices.size, cfg.n_stages))
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
+        self.stage_apply = stage_apply
         init = init_fn or (lambda rng: init_stage_params(
             rng, cfg.n_stages, cfg.d_model, cfg.layers_per_stage))
         sh = NamedSharding(mesh, P(self.axis))
@@ -201,13 +202,12 @@ class GPipeRunner:
         return float(loss)
 
     # ------------------------------------------------------------- reference
-    def sequential_forward(self, x: np.ndarray,
-                           stage_apply: Callable = mlp_stage_apply
-                           ) -> jax.Array:
-        """Unpipelined oracle: run stages in order on one device."""
+    def sequential_forward(self, x: np.ndarray) -> jax.Array:
+        """Unpipelined oracle: run this runner's stages in order on one
+        device."""
         params_host = jax.tree.map(np.asarray, self.params)
         out = jnp.asarray(x)
         for s in range(self.cfg.n_stages):
             p = jax.tree.map(lambda a: jnp.asarray(a[s]), params_host)
-            out = stage_apply(p, out)
+            out = self.stage_apply(p, out)
         return out

@@ -1,0 +1,25 @@
+"""CPU distributed parameter server ("the-one-ps" analog).
+
+TPU-native re-design of paddle/fluid/distributed/ps/: sharded host sparse
+tables with CTR accessor semantics (table/memory_sparse_table.cc,
+ctr_accessor.cc, sparse_sgd_rule.cc), dense tables
+(memory_dense_table.cc), a PSClient interface (service/ps_client.h) with an
+in-process local client (service/ps_local_client.h) and a TCP
+server/client pair standing in for the brpc service
+(service/brpc_ps_server.cc / brpc_ps_client.cc).
+"""
+
+from paddlebox_tpu.ps.sgd_rule import numpy_apply_push
+from paddlebox_tpu.ps.table import DenseTable, SparseTable
+from paddlebox_tpu.ps.service import (PSCore, PSServer, PsLocalClient,
+                                      TcpPSClient)
+
+__all__ = [
+    "numpy_apply_push",
+    "DenseTable",
+    "SparseTable",
+    "PSCore",
+    "PSServer",
+    "PsLocalClient",
+    "TcpPSClient",
+]

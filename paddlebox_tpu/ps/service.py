@@ -1,0 +1,299 @@
+"""PS service: core dispatch + in-process and TCP clients.
+
+Shape of distributed/ps/service/: `PSCore` plays PsService (the handler
+table behind brpc_ps_server.cc), `PsLocalClient` is the in-process client
+fake (ps_local_client.h — single-process PS semantics for tests and
+single-node runs), and `PSServer`/`TcpPSClient` stand in for the brpc
+server/client pair with length-prefixed pickled frames over TCP (the trust
+domain is the training cluster, as with the reference's brpc channel).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from paddlebox_tpu.config.configs import TableConfig
+from paddlebox_tpu.ps.table import DenseTable, SparseTable
+
+_LEN = struct.Struct("<I")
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Frames only ever carry numpy arrays, plain containers, and the two
+    config dataclasses — refuse to resolve anything else (the codec is a
+    cluster-internal channel like the reference's brpc/protobuf, but there
+    is no reason to allow arbitrary class construction)."""
+
+    def find_class(self, module, name):
+        if module.split(".")[0] == "numpy":
+            return super().find_class(module, name)
+        if module == "paddlebox_tpu.config.configs" and name in (
+                "TableConfig", "SparseOptimizerConfig"):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            "refusing to unpickle %s.%s" % (module, name))
+
+
+def _loads(data: bytes) -> Any:
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+# ---------------------------------------------------------------------------
+# Core (server-side handler table)
+# ---------------------------------------------------------------------------
+
+
+class PSCore:
+    def __init__(self) -> None:
+        self.sparse: Dict[int, SparseTable] = {}
+        self.dense: Dict[str, DenseTable] = {}
+        self._barrier_lock = threading.Condition()
+        self._barrier_count = 0
+        self._barrier_gen = 0
+
+    # ---- table management
+    def create_sparse_table(self, table_id: int, table: TableConfig,
+                            shard_num: int = 8, seed: int = 0) -> None:
+        self.sparse[table_id] = SparseTable(table, shard_num, seed=seed)
+
+    def create_dense_table(self, name: str, size: int = 0, rule: str = "adam",
+                           lr: float = 1e-3,
+                           init: Optional[np.ndarray] = None) -> None:
+        self.dense[name] = DenseTable(size, rule, lr, init)
+
+    # ---- sparse
+    def pull_sparse(self, table_id: int, keys: np.ndarray) -> np.ndarray:
+        return self.sparse[table_id].pull(keys)
+
+    def push_sparse(self, table_id: int, keys: np.ndarray,
+                    grads: np.ndarray) -> None:
+        self.sparse[table_id].push(keys, grads)
+
+    def shrink(self, table_id: int) -> int:
+        return self.sparse[table_id].shrink()
+
+    def sparse_size(self, table_id: int) -> int:
+        return len(self.sparse[table_id])
+
+    # ---- dense
+    def pull_dense(self, name: str) -> np.ndarray:
+        return self.dense[name].pull()
+
+    def push_dense(self, name: str, grad: np.ndarray) -> None:
+        self.dense[name].push(grad)
+
+    # ---- checkpoint
+    def save(self, dirpath: str) -> None:
+        import os
+        for tid, t in self.sparse.items():
+            t.save(os.path.join(dirpath, "sparse-%d" % tid))
+        dense_state = {n: t.state() for n, t in self.dense.items()}
+        with open(os.path.join(dirpath, "dense.pkl"), "wb") as f:
+            pickle.dump(dense_state, f)
+
+    def load(self, dirpath: str) -> None:
+        import os
+        for tid, t in self.sparse.items():
+            t.load(os.path.join(dirpath, "sparse-%d" % tid))
+        p = os.path.join(dirpath, "dense.pkl")
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                for n, st in pickle.load(f).items():
+                    if n in self.dense:
+                        self.dense[n].load_state(st)
+
+    # ---- barrier (BarrierTable role, barrier_table_test.cc)
+    def barrier(self, world: int, timeout: float = 120.0) -> None:
+        with self._barrier_lock:
+            gen = self._barrier_gen
+            self._barrier_count += 1
+            if self._barrier_count >= world:
+                self._barrier_count = 0
+                self._barrier_gen += 1
+                self._barrier_lock.notify_all()
+                return
+            ok = self._barrier_lock.wait_for(
+                lambda: self._barrier_gen != gen, timeout)
+            if not ok:
+                raise TimeoutError("ps barrier timed out")
+
+
+# ---------------------------------------------------------------------------
+# Clients
+# ---------------------------------------------------------------------------
+
+
+class PsLocalClient:
+    """In-process client: dispatches straight into a PSCore
+    (ps_local_client.h pattern)."""
+
+    def __init__(self, core: Optional[PSCore] = None) -> None:
+        self.core = core or PSCore()
+
+    def __getattr__(self, name):
+        return getattr(self.core, name)
+
+    def stop_server(self) -> None:
+        pass
+
+
+class TcpPSClient:
+    """Framed request/response client (brpc_ps_client stand-in)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 300.0) -> None:
+        self._sock = socket.create_connection((host, port), timeout=60.0)
+        self._sock.settimeout(timeout)
+        self._lock = threading.Lock()
+
+    def _call(self, method: str, **kwargs) -> Any:
+        payload = pickle.dumps({"method": method, "args": kwargs},
+                               protocol=pickle.HIGHEST_PROTOCOL)
+        with self._lock:
+            self._sock.sendall(_LEN.pack(len(payload)) + payload)
+            hdr = _recv_exact(self._sock, _LEN.size)
+            if hdr is None:
+                raise ConnectionError("ps server closed connection")
+            (length,) = _LEN.unpack(hdr)
+            body = _recv_exact(self._sock, length)
+        resp = _loads(body)
+        if not resp["ok"]:
+            raise RuntimeError("ps rpc %s failed: %s" % (method,
+                                                         resp["error"]))
+        return resp.get("result")
+
+    # mirror the PSClient interface
+    def create_sparse_table(self, table_id, table, shard_num=8, seed=0):
+        return self._call("create_sparse_table", table_id=table_id,
+                          table=table, shard_num=shard_num, seed=seed)
+
+    def create_dense_table(self, name, size=0, rule="adam", lr=1e-3,
+                           init=None):
+        return self._call("create_dense_table", name=name, size=size,
+                          rule=rule, lr=lr, init=init)
+
+    def pull_sparse(self, table_id, keys):
+        return self._call("pull_sparse", table_id=table_id, keys=keys)
+
+    def push_sparse(self, table_id, keys, grads):
+        return self._call("push_sparse", table_id=table_id, keys=keys,
+                          grads=grads)
+
+    def pull_dense(self, name):
+        return self._call("pull_dense", name=name)
+
+    def push_dense(self, name, grad):
+        return self._call("push_dense", name=name, grad=grad)
+
+    def shrink(self, table_id):
+        return self._call("shrink", table_id=table_id)
+
+    def sparse_size(self, table_id):
+        return self._call("sparse_size", table_id=table_id)
+
+    def save(self, dirpath):
+        return self._call("save", dirpath=dirpath)
+
+    def load(self, dirpath):
+        return self._call("load", dirpath=dirpath)
+
+    def barrier(self, world, timeout=120.0):
+        return self._call("barrier", world=world, timeout=timeout)
+
+    def stop_server(self):
+        try:
+            self._call("__stop__")
+        except (ConnectionError, OSError):
+            pass
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+
+class PSServer:
+    """TCP server over a PSCore; one thread per client connection (the
+    brpc_ps_server.cc role; barrier calls may block their conn thread)."""
+
+    def __init__(self, core: Optional[PSCore] = None,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self.core = core or PSCore()
+        self._stop = threading.Event()
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, port))
+        self._server.listen(64)
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._server.getsockname()[1]
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                hdr = _recv_exact(conn, _LEN.size)
+                if hdr is None:
+                    return
+                (length,) = _LEN.unpack(hdr)
+                body = _recv_exact(conn, length)
+                if body is None:
+                    return
+                req = _loads(body)
+                method = req["method"]
+                if method == "__stop__":
+                    self._send(conn, {"ok": True})
+                    self.stop()
+                    return
+                try:
+                    result = getattr(self.core, method)(**req["args"])
+                    self._send(conn, {"ok": True, "result": result})
+                except Exception as e:  # surface to the client
+                    self._send(conn, {"ok": False, "error": repr(e)})
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _send(conn: socket.socket, obj: Any) -> None:
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        conn.sendall(_LEN.pack(len(payload)) + payload)
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._server.close()
+        except OSError:
+            pass

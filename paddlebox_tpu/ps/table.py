@@ -1,0 +1,163 @@
+"""Parameter-server tables: sharded sparse + dense.
+
+SparseTable = N shards of HostEmbeddingStore routed by key % shard_num
+(MemorySparseTable's shard layout, memory_sparse_table.cc; the SSD tier
+comes with the store's spill support = SSDSparseTable role). Push applies
+the numpy SGD rule server-side (sparse_sgd_rule.cc semantics). DenseTable
+mirrors MemoryDenseTable: a flat float vector with adam/sgd/summary update
+rules applied on push.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.config.configs import TableConfig
+from paddlebox_tpu.embedding import accessor as acc
+from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
+from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
+from paddlebox_tpu.ps.sgd_rule import numpy_apply_push
+
+
+class SparseTable:
+    def __init__(self, table: TableConfig, shard_num: int = 8,
+                 seed: int = 0) -> None:
+        self.config = table
+        self.layout = ValueLayout(
+            embedx_dim=table.embedx_dim, expand_dim=table.expand_embed_dim,
+            optimizer=table.optimizer.optimizer)
+        self.push_layout = PushLayout(self.layout.embedx_dim,
+                                      self.layout.expand_dim)
+        self.shard_num = shard_num
+        self.shards = [HostEmbeddingStore(self.layout, table, seed=seed + i)
+                       for i in range(shard_num)]
+        self._locks = [threading.Lock() for _ in range(shard_num)]
+        self._rngs = [np.random.RandomState(seed + 101 + i)
+                      for i in range(shard_num)]
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self.shards)
+
+    def _route(self, keys: np.ndarray) -> np.ndarray:
+        return (keys % np.uint64(self.shard_num)).astype(np.int64)
+
+    # -------------------------------------------------------------- pull/push
+    def pull(self, keys: np.ndarray) -> np.ndarray:
+        """Full value rows for (not necessarily unique) keys — the PS-side
+        half of PullSparse (brpc_ps_server PullSparse handler)."""
+        keys = np.asarray(keys, dtype=np.uint64)
+        out = np.empty((keys.size, self.layout.width), np.float32)
+        shard_of = self._route(keys)
+        for s in range(self.shard_num):
+            m = shard_of == s
+            if not m.any():
+                continue
+            uniq, inv = np.unique(keys[m], return_inverse=True)
+            with self._locks[s]:
+                rows = self.shards[s].lookup_or_create(uniq)
+            out[m] = rows[inv]
+        return out
+
+    def push(self, keys: np.ndarray, grads: np.ndarray) -> None:
+        """Apply push-layout grads; duplicate keys are merged first
+        (show-summed), like the worker-side dedup before PushSparse."""
+        keys = np.asarray(keys, dtype=np.uint64)
+        grads = np.asarray(grads, dtype=np.float32)
+        shard_of = self._route(keys)
+        for s in range(self.shard_num):
+            m = shard_of == s
+            if not m.any():
+                continue
+            uniq, inv = np.unique(keys[m], return_inverse=True)
+            merged = np.zeros((uniq.size, grads.shape[1]), np.float32)
+            np.add.at(merged, inv, grads[m])
+            # slot is a tag, not additive: take any contributor's slot
+            merged[inv, self.push_layout.SLOT] = grads[
+                m, self.push_layout.SLOT]
+            with self._locks[s]:
+                rows = self.shards[s].lookup_or_create(uniq)
+                newrows = numpy_apply_push(rows, merged, self._rngs[s],
+                                           self.layout, self.config.optimizer)
+                self.shards[s].write_back(uniq, newrows)
+
+    # ------------------------------------------------------------- lifecycle
+    def shrink(self) -> int:
+        total = 0
+        for s, lock in zip(self.shards, self._locks):
+            with lock:
+                total += s.shrink()
+        return total
+
+    def save(self, dirpath: str) -> List[str]:
+        """Per-shard files (MemorySparseTable::Save shard file layout)."""
+        os.makedirs(dirpath, exist_ok=True)
+        paths = []
+        for i, (s, lock) in enumerate(zip(self.shards, self._locks)):
+            p = os.path.join(dirpath, "shard-%05d.pkl" % i)
+            with lock:
+                s.save(p)
+            paths.append(p)
+        return paths
+
+    def load(self, dirpath: str) -> None:
+        for i, (s, lock) in enumerate(zip(self.shards, self._locks)):
+            p = os.path.join(dirpath, "shard-%05d.pkl" % i)
+            with lock:
+                s.load(p)
+
+
+class DenseTable:
+    """Flat dense parameter vector with a server-side optimizer
+    (MemoryDenseTable: adam / sgd / summary rules)."""
+
+    def __init__(self, size: int, rule: str = "adam", lr: float = 1e-3,
+                 init: Optional[np.ndarray] = None) -> None:
+        if rule not in ("adam", "sgd", "summary"):
+            raise ValueError(rule)
+        self.rule = rule
+        self.lr = lr
+        self.params = (np.array(init, np.float32) if init is not None
+                       else np.zeros(size, np.float32))
+        self._mom1 = np.zeros_like(self.params)
+        self._mom2 = np.zeros_like(self.params)
+        self._t = 0
+        self._lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self.params.copy()
+
+    def push(self, grad: np.ndarray) -> None:
+        g = np.asarray(grad, np.float32)
+        with self._lock:
+            if self.rule == "summary":
+                self.params += g  # running-sum semantics (data-norm stats)
+                return
+            if self.rule == "sgd":
+                self.params -= self.lr * g
+                return
+            self._t += 1
+            self._mom1 = 0.9 * self._mom1 + 0.1 * g
+            self._mom2 = 0.999 * self._mom2 + 0.001 * g * g
+            bc1 = 1 - 0.9 ** self._t
+            bc2 = 1 - 0.999 ** self._t
+            self.params -= (self.lr * (self._mom1 / bc1)
+                            / (np.sqrt(self._mom2 / bc2) + 1e-8))
+
+    def state(self) -> dict:
+        with self._lock:
+            return {"params": self.params.copy(), "mom1": self._mom1.copy(),
+                    "mom2": self._mom2.copy(), "t": self._t,
+                    "rule": self.rule, "lr": self.lr}
+
+    def load_state(self, st: dict) -> None:
+        with self._lock:
+            self.params = np.asarray(st["params"], np.float32).copy()
+            self._mom1 = np.asarray(st["mom1"], np.float32).copy()
+            self._mom2 = np.asarray(st["mom2"], np.float32).copy()
+            self._t = int(st["t"])

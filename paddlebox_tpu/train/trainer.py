@@ -196,6 +196,10 @@ class BoxTrainer:
                  seed: int = 0, use_cvm: bool = True) -> None:
         self.model = model
         self.cfg = trainer_cfg or TrainerConfig()
+        if self.cfg.sync_mode in ("k_step", "sharding") or self.cfg.sharding:
+            raise ValueError(
+                "sync_mode=%r / sharding=%r need the multi-device "
+                "ShardedBoxTrainer" % (self.cfg.sync_mode, self.cfg.sharding))
         self.feed = feed
         self.table = PassTable(table_cfg, seed=seed)
         self.metrics = MetricRegistry()
@@ -204,10 +208,6 @@ class BoxTrainer:
         self.params = model.init(rng)
         self.opt_state = self.dense_opt.init(self.params)
         self.num_slots = len(feed.used_sparse_slots())
-        if self.cfg.sync_mode in ("k_step", "sharding") or self.cfg.sharding:
-            raise ValueError(
-                "sync_mode=%r needs the multi-device ShardedBoxTrainer"
-                % self.cfg.sync_mode)
         self.async_mode = (self.cfg.async_mode
                            or self.cfg.sync_mode == "async")
         self.fns = make_train_step(

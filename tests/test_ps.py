@@ -1,0 +1,181 @@
+"""CPU PS layer (mirrors distributed/test/: memory_sparse_table_test.cc,
+ctr_accessor_test.cc, sparse_sgd_rule_test.cc, barrier_table_test.cc, and
+brpc_service_sparse_sgd_test.cc's bring-up-a-real-server-in-process
+pattern)."""
+
+import threading
+
+import numpy as np
+import jax
+import pytest
+
+from paddlebox_tpu.config.configs import SparseOptimizerConfig, TableConfig
+from paddlebox_tpu.embedding import accessor as acc
+from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
+from paddlebox_tpu.ps import (DenseTable, PSCore, PSServer, PsLocalClient,
+                              SparseTable, TcpPSClient, numpy_apply_push)
+
+D = 4
+
+
+def conf():
+    return SparseOptimizerConfig(mf_create_thresholds=0.5,
+                                 mf_initial_range=1e-3,
+                                 feature_learning_rate=0.1,
+                                 mf_learning_rate=0.1)
+
+
+def table_cfg():
+    return TableConfig(embedx_dim=D, pass_capacity=1 << 12, optimizer=conf())
+
+
+def _random_rows(layout, n, rng, with_mf=True):
+    rows = layout.new_rows(n, rng, conf())
+    rows[:, acc.SLOT] = rng.randint(0, 5, n)
+    rows[:, acc.SHOW] = rng.randint(1, 20, n)
+    rows[:, acc.CLICK] = rng.randint(0, 5, n)
+    if with_mf:
+        rows[:, acc.MF_SIZE] = D
+        rows[:, layout.embedx_w:layout.embedx_w + D] = rng.randn(n, D) * 0.01
+    return rows.astype(np.float32)
+
+
+def test_numpy_rule_matches_device_apply_push():
+    """The CPU PS rule must be numerically identical to the device push
+    (same accessor semantics on both tiers) — modulo the fresh-embedx
+    random draw, so use rows already past mf creation."""
+    from paddlebox_tpu.embedding.optimizers import apply_push
+    layout = ValueLayout(embedx_dim=D, optimizer="adagrad")
+    push = PushLayout(D)
+    rng = np.random.RandomState(0)
+    n = 64
+    rows = _random_rows(layout, n, rng, with_mf=True)
+    grads = np.zeros((n, push.width), np.float32)
+    grads[:, push.SLOT] = rows[:, acc.SLOT]
+    grads[:, push.SHOW] = rng.randint(0, 4, n)  # some zero-show rows
+    grads[:, push.CLICK] = np.minimum(grads[:, push.SHOW],
+                                      rng.randint(0, 2, n))
+    grads[:, push.EMBED_G] = rng.randn(n) * 0.1
+    grads[:, push.embedx_g:push.embedx_g + D] = rng.randn(n, D) * 0.1
+
+    import jax.numpy as jnp
+    want = np.asarray(apply_push(jnp.asarray(rows), jnp.asarray(grads),
+                                 jax.random.PRNGKey(0), layout, conf()))
+    got = numpy_apply_push(rows, grads, np.random.RandomState(1),
+                           layout, conf())
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_table_pull_creates_and_push_updates():
+    t = SparseTable(table_cfg(), shard_num=4)
+    keys = np.array([3, 11, 19, 3], np.uint64)  # dup key 3
+    vals = t.pull(keys)
+    assert vals.shape == (4, t.layout.width)
+    np.testing.assert_array_equal(vals[0], vals[3])  # dup sees same row
+    assert len(t) == 3
+
+    push = t.push_layout
+    grads = np.zeros((4, push.width), np.float32)
+    grads[:, push.SHOW] = 1.0
+    grads[:, push.CLICK] = [1, 0, 0, 1]
+    grads[:, push.EMBED_G] = [0.5, -0.5, 0.1, 0.5]
+    t.push(keys, grads)
+    after = t.pull(keys)
+    # dup key merged: show += 2
+    assert after[0, acc.SHOW] == 2.0
+    assert after[1, acc.SHOW] == 1.0
+    # adagrad moved embed_w against the grad direction
+    assert after[0, acc.EMBED_W] != vals[0, acc.EMBED_W]
+
+
+def test_sparse_table_save_load_roundtrip(tmp_path):
+    t = SparseTable(table_cfg(), shard_num=2)
+    keys = np.arange(1, 33, dtype=np.uint64)
+    t.pull(keys)
+    push = t.push_layout
+    g = np.zeros((32, push.width), np.float32)
+    g[:, push.SHOW] = 1
+    g[:, push.EMBED_G] = 0.3
+    t.push(keys, g)
+    before = t.pull(keys)
+    t.save(str(tmp_path / "ck"))
+
+    t2 = SparseTable(table_cfg(), shard_num=2)
+    t2.load(str(tmp_path / "ck"))
+    assert len(t2) == 32
+    np.testing.assert_allclose(t2.pull(keys), before, rtol=1e-6)
+
+
+def test_dense_table_rules():
+    g = np.ones(8, np.float32)
+    sgd = DenseTable(8, rule="sgd", lr=0.1)
+    sgd.push(g)
+    np.testing.assert_allclose(sgd.pull(), -0.1 * g, rtol=1e-6)
+    summ = DenseTable(8, rule="summary")
+    summ.push(g)
+    summ.push(2 * g)
+    np.testing.assert_allclose(summ.pull(), 3 * g, rtol=1e-6)
+    adam = DenseTable(8, rule="adam", lr=0.1)
+    adam.push(g)
+    m, v = 0.1 * g, 0.001 * g
+    expect = -0.1 * (m / 0.1) / (np.sqrt(v / 0.001) + 1e-8)
+    np.testing.assert_allclose(adam.pull(), expect, rtol=1e-5)
+
+
+def test_local_client_dispatch():
+    cl = PsLocalClient()
+    cl.create_sparse_table(0, table_cfg(), shard_num=2)
+    cl.create_dense_table("fc", size=16, rule="sgd", lr=0.5)
+    keys = np.array([7, 9], np.uint64)
+    v = cl.pull_sparse(0, keys)
+    assert v.shape[0] == 2
+    cl.push_dense("fc", np.ones(16, np.float32))
+    np.testing.assert_allclose(cl.pull_dense("fc"), -0.5)
+    assert cl.sparse_size(0) == 2
+
+
+def test_tcp_server_roundtrip(tmp_path):
+    server = PSServer()
+    cl = TcpPSClient("127.0.0.1", server.port)
+    cl.create_sparse_table(5, table_cfg(), shard_num=2)
+    cl.create_dense_table("w", size=4, rule="adam", lr=0.01)
+    keys = np.array([1, 2, 3], np.uint64)
+    vals = cl.pull_sparse(5, keys)
+    assert vals.shape == (3, vals.shape[1])
+    push = PushLayout(D)
+    g = np.zeros((3, push.width), np.float32)
+    g[:, push.SHOW] = 1
+    g[:, push.EMBED_G] = 1.0
+    cl.push_sparse(5, keys, g)
+    after = cl.pull_sparse(5, keys)
+    assert (after[:, acc.EMBED_W] != vals[:, acc.EMBED_W]).all()
+    cl.push_dense("w", np.ones(4, np.float32))
+    assert (cl.pull_dense("w") != 0).all()
+    # save on server, reload into a fresh core
+    cl.save(str(tmp_path / "ps_ck"))
+    assert cl.sparse_size(5) == 3
+
+    # error path surfaces server-side exceptions
+    with pytest.raises(RuntimeError, match="pull_dense"):
+        cl.pull_dense("missing")
+    cl.stop_server()
+    cl.close()
+
+
+def test_tcp_barrier_two_clients():
+    server = PSServer()
+    results = []
+
+    def worker(i):
+        cl = TcpPSClient("127.0.0.1", server.port)
+        cl.barrier(world=2, timeout=30.0)
+        results.append(i)
+        cl.close()
+
+    ths = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    assert sorted(results) == [0, 1]
+    server.stop()
