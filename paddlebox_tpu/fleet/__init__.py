@@ -1,0 +1,25 @@
+"""Fleet: multi-host control plane.
+
+Analog of the reference's python fleet layer (python/paddle/distributed/
+fleet/** and the GlooWrapper C++ rendezvous, framework/fleet/gloo_wrapper.h:
+139-244): a TCP key-value store for rendezvous + small host-side
+collectives (barrier / all_reduce / all_gather used by metric reduction and
+dataset bookkeeping — never the training hot path, which is XLA
+collectives over ICI), an env-driven role maker (PaddleCloudRoleMaker
+pattern), a process launcher (fleet launch.py), and an elastic heartbeat
+manager (fleet/elastic/manager.py skeleton).
+"""
+
+from paddlebox_tpu.fleet.store import KVStoreServer, TcpStoreClient
+from paddlebox_tpu.fleet.role_maker import RoleMaker
+from paddlebox_tpu.fleet.fleet import Fleet, fleet
+from paddlebox_tpu.fleet.elastic import ElasticManager
+
+__all__ = [
+    "KVStoreServer",
+    "TcpStoreClient",
+    "RoleMaker",
+    "Fleet",
+    "fleet",
+    "ElasticManager",
+]

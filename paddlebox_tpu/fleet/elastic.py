@@ -1,0 +1,103 @@
+"""Elastic heartbeat manager.
+
+Skeleton of python/paddle/distributed/fleet/elastic/manager.py (etcd-based
+node watch): each rank bumps a store COUNTER on an interval; the watcher
+judges staleness by how long a peer's counter has sat unchanged on its OWN
+clock — no cross-host timestamp comparison, so clock skew between hosts
+cannot fake a death. The BoxPS training path itself is gang-scheduled
+(SURVEY.md §5.3 — a rank failure kills the job and recovery is
+resume-from-last-SaveBase), so the default callback raises; schedulers
+that support scale-in/out can install their own restart hook instead.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from paddlebox_tpu.fleet.store import TcpStoreClient
+
+
+class DeadRankError(RuntimeError):
+    pass
+
+
+class ElasticManager:
+    def __init__(self, client: TcpStoreClient, rank: int, world: int,
+                 heartbeat_interval: float = 2.0,
+                 stale_after: float = 10.0,
+                 on_fault: Optional[Callable[[List[int]], None]] = None):
+        self.client = client
+        self.rank = rank
+        self.world = world
+        self.interval = heartbeat_interval
+        self.stale_after = stale_after
+        self.on_fault = on_fault
+        self._stop = threading.Event()
+        self._dead: List[int] = []
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           daemon=True)
+        self._watch_thread = threading.Thread(target=self._watch_loop,
+                                              daemon=True)
+
+    def start(self) -> None:
+        self._beat()
+        self._hb_thread.start()
+        self._watch_thread.start()
+
+    def _key(self, rank: int) -> str:
+        return "elastic/hb/%d" % rank
+
+    def _beat(self) -> None:
+        self.client.add(self._key(self.rank), 1)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self._beat()
+            except (ConnectionError, OSError, RuntimeError):
+                return  # store gone; the job is ending
+
+    def _watch_loop(self) -> None:
+        # (counter value, local time it last changed) per peer
+        seen: Dict[int, Tuple[int, float]] = {}
+        start = time.monotonic()
+        while not self._stop.wait(self.interval):
+            now = time.monotonic()
+            dead = []
+            for r in range(self.world):
+                if r == self.rank:
+                    continue
+                try:
+                    c = self.client.counter(self._key(r))
+                except (ConnectionError, OSError, RuntimeError):
+                    return
+                last = seen.get(r)
+                if last is None or c != last[0]:
+                    seen[r] = (c, now)
+                    continue
+                born = start if last[0] == 0 else last[1]
+                if now - born > self.stale_after:
+                    dead.append(r)
+            if dead:
+                # flag and notify, but KEEP heartbeating: surviving ranks
+                # must not look dead to each other while a restart hook
+                # replaces the lost one
+                self._dead = dead
+                if self.on_fault is not None:
+                    self.on_fault(dead)
+                return
+
+    @property
+    def dead_ranks(self) -> List[int]:
+        return list(self._dead)
+
+    def check(self) -> None:
+        """Raise if a peer died (call at pass boundaries — the natural
+        recovery unit, SURVEY.md §5.3)."""
+        if self._dead:
+            raise DeadRankError("dead ranks: %s" % self._dead)
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,156 @@
+"""Fleet facade: init + host-side collectives over the KV store.
+
+The python-visible surface of fleet.init / fleet.util (python/paddle/
+distributed/fleet/fleet.py + GlooWrapper Barrier/AllReduce/AllGather,
+gloo_wrapper.h:185-244). These collectives move SMALL host data — metric
+partials, instance counts, batch-count equalization — over DCN; training
+tensors go through XLA collectives on the mesh, never through here.
+
+Collectives are ordered: every rank must issue the same sequence of calls
+(the same contract gloo imposes); a per-instance sequence number namespaces
+each round's keys.
+"""
+
+from __future__ import annotations
+
+import io
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from paddlebox_tpu.fleet.role_maker import RoleMaker
+from paddlebox_tpu.fleet.store import KVStoreServer, TcpStoreClient
+
+_OPS = {
+    "sum": lambda arrs: np.sum(arrs, axis=0),
+    "max": lambda arrs: np.max(arrs, axis=0),
+    "min": lambda arrs: np.min(arrs, axis=0),
+}
+
+
+def _pack(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    return buf.getvalue()
+
+
+def _unpack(data: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+class Fleet:
+    def __init__(self) -> None:
+        self.role: Optional[RoleMaker] = None
+        self._client: Optional[TcpStoreClient] = None
+        self._server: Optional[KVStoreServer] = None
+        self._seq = 0
+        # namespaces this lifecycle's keys: counters persist in the store,
+        # so a restarted job against the same store must not see run 1's
+        # pre-satisfied barriers (the launcher stamps a fresh uuid)
+        self._run_id = "0"
+
+    # ----------------------------------------------------------------- init
+    def init(self, role: Optional[RoleMaker] = None,
+             server: Optional[KVStoreServer] = None,
+             client: Optional[TcpStoreClient] = None) -> "Fleet":
+        """Single-rank jobs need no store; multi-rank jobs rendezvous at
+        role.store_endpoint (rank 0 may host the server in-process by
+        passing `server`, the launcher's default is a dedicated store)."""
+        import os
+        self.role = role or RoleMaker()
+        self._run_id = os.environ.get("PBTPU_RUN_ID", "0")
+        self._seq = 0
+        self._server = server
+        if client is not None:
+            self._client = client
+        elif self.role.world > 1 or self.role.store_endpoint:
+            host, port = (("127.0.0.1", server.port) if server is not None
+                          else self.role.store_addr())
+            self._client = TcpStoreClient(host, port)
+        return self
+
+    @property
+    def initialized(self) -> bool:
+        return self.role is not None
+
+    def worker_index(self) -> int:
+        return self.role.rank
+
+    def worker_num(self) -> int:
+        return self.role.world
+
+    def is_first_worker(self) -> bool:
+        return self.role.is_first_worker()
+
+    # ---------------------------------------------------------- collectives
+    def barrier_worker(self, timeout: float = 120.0) -> None:
+        """All ranks reach this point (GlooWrapper::Barrier)."""
+        if self.role.world <= 1:
+            return
+        self._seq += 1
+        key = "%s/barrier/%d" % (self._run_id, self._seq)
+        self._client.add(key)
+        self._client.wait_counter_ge(key, self.role.world, timeout)
+
+    def all_gather(self, arr: np.ndarray,
+                   timeout: float = 120.0) -> list:
+        """[rank0_arr, rank1_arr, ...] on every rank
+        (GlooWrapper::AllGather)."""
+        if self.role.world <= 1:
+            return [np.asarray(arr)]
+        self._seq += 1
+        prefix = "%s/coll/%d" % (self._run_id, self._seq)
+        self._client.set("%s/%d" % (prefix, self.role.rank),
+                         _pack(np.asarray(arr)))
+        out = [
+            _unpack(self._client.wait("%s/%d" % (prefix, r), timeout))
+            for r in range(self.role.world)
+        ]
+        # ranks ack having READ the round before anyone deletes its data
+        # keys; the ack counter itself is never deleted (a laggard's
+        # wait_counter_ge may arrive after rank 0 passes the barrier, and
+        # counters cost 8 bytes/collective)
+        ack = prefix + "/ack"
+        self._client.add(ack)
+        self._client.wait_counter_ge(ack, self.role.world, timeout)
+        if self.role.rank == 0:
+            for r in range(self.role.world):
+                self._client.delete("%s/%d" % (prefix, r))
+        return out
+
+    def all_reduce(self, arr: np.ndarray, op: str = "sum",
+                   timeout: float = 120.0) -> np.ndarray:
+        """Elementwise reduce across ranks (GlooWrapper::AllReduce; the
+        metric-aggregation path box MPI allreduce serves in the
+        reference)."""
+        if op not in _OPS:
+            raise ValueError("allreduce op must be one of %s" % list(_OPS))
+        parts = self.all_gather(np.asarray(arr), timeout)
+        return _OPS[op](np.stack(parts))
+
+    def metric_allreduce(self) -> Callable[[np.ndarray], np.ndarray]:
+        """Adapter matching MetricRegistry/BasicAucCalculator's
+        `allreduce(vec) -> vec` hook."""
+        return lambda v: self.all_reduce(np.asarray(v, np.float64), "sum")
+
+    def equalize_batches(self) -> Callable[[int], int]:
+        """Adapter for BoxDataset.split_batches(equalize=...): allreduce-max
+        of local batch counts (compute_paddlebox_thread_batch_nccl,
+        data_set.cc:2690-2755)."""
+        return lambda n: int(self.all_reduce(
+            np.asarray([n], np.int64), "max")[0])
+
+    # ------------------------------------------------------------- lifecycle
+    def stop(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+        self.role = None
+
+
+# module-level singleton, like `from paddle.distributed import fleet`
+fleet = Fleet()

@@ -4,16 +4,14 @@ Shape of distributed/ps/service/: `PSCore` plays PsService (the handler
 table behind brpc_ps_server.cc), `PsLocalClient` is the in-process client
 fake (ps_local_client.h — single-process PS semantics for tests and
 single-node runs), and `PSServer`/`TcpPSClient` stand in for the brpc
-server/client pair with length-prefixed pickled frames over TCP (the trust
-domain is the training cluster, as with the reference's brpc channel).
+server/client pair over the shared framed-RPC transport (utils/rpc.py;
+the trust domain is the training cluster, as with the reference's brpc
+channel — unpickling is restricted to numpy + the two config dataclasses).
 """
 
 from __future__ import annotations
 
-import io
 import pickle
-import socket
-import struct
 import threading
 from typing import Any, Dict, Optional
 
@@ -21,28 +19,17 @@ import numpy as np
 
 from paddlebox_tpu.config.configs import TableConfig
 from paddlebox_tpu.ps.table import DenseTable, SparseTable
-
-_LEN = struct.Struct("<I")
-
-
-class _RestrictedUnpickler(pickle.Unpickler):
-    """Frames only ever carry numpy arrays, plain containers, and the two
-    config dataclasses — refuse to resolve anything else (the codec is a
-    cluster-internal channel like the reference's brpc/protobuf, but there
-    is no reason to allow arbitrary class construction)."""
-
-    def find_class(self, module, name):
-        if module.split(".")[0] == "numpy":
-            return super().find_class(module, name)
-        if module == "paddlebox_tpu.config.configs" and name in (
-                "TableConfig", "SparseOptimizerConfig"):
-            return super().find_class(module, name)
-        raise pickle.UnpicklingError(
-            "refusing to unpickle %s.%s" % (module, name))
+from paddlebox_tpu.utils.rpc import FramedClient, FramedServer, make_loads
 
 
-def _loads(data: bytes) -> Any:
-    return _RestrictedUnpickler(io.BytesIO(data)).load()
+def _allow(module: str, name: str) -> bool:
+    if module.split(".")[0] == "numpy":
+        return True
+    return module == "paddlebox_tpu.config.configs" and name in (
+        "TableConfig", "SparseOptimizerConfig")
+
+
+_loads = make_loads(_allow)
 
 
 # ---------------------------------------------------------------------------
@@ -148,25 +135,10 @@ class TcpPSClient:
     """Framed request/response client (brpc_ps_client stand-in)."""
 
     def __init__(self, host: str, port: int, timeout: float = 300.0) -> None:
-        self._sock = socket.create_connection((host, port), timeout=60.0)
-        self._sock.settimeout(timeout)
-        self._lock = threading.Lock()
+        self._rpc = FramedClient(host, port, _loads, timeout)
 
     def _call(self, method: str, **kwargs) -> Any:
-        payload = pickle.dumps({"method": method, "args": kwargs},
-                               protocol=pickle.HIGHEST_PROTOCOL)
-        with self._lock:
-            self._sock.sendall(_LEN.pack(len(payload)) + payload)
-            hdr = _recv_exact(self._sock, _LEN.size)
-            if hdr is None:
-                raise ConnectionError("ps server closed connection")
-            (length,) = _LEN.unpack(hdr)
-            body = _recv_exact(self._sock, length)
-        resp = _loads(body)
-        if not resp["ok"]:
-            raise RuntimeError("ps rpc %s failed: %s" % (method,
-                                                         resp["error"]))
-        return resp.get("result")
+        return self._rpc.call({"method": method, "args": kwargs})
 
     # mirror the PSClient interface
     def create_sparse_table(self, table_id, table, shard_num=8, seed=0):
@@ -213,87 +185,29 @@ class TcpPSClient:
             pass
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
-
-
-def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
-    buf = b""
-    while len(buf) < n:
-        chunk = conn.recv(n - len(buf))
-        if not chunk:
-            return None
-        buf += chunk
-    return buf
-
-
-# ---------------------------------------------------------------------------
-# Server
-# ---------------------------------------------------------------------------
+        self._rpc.close()
 
 
 class PSServer:
-    """TCP server over a PSCore; one thread per client connection (the
+    """TCP server over a PSCore via the shared framed transport (the
     brpc_ps_server.cc role; barrier calls may block their conn thread)."""
 
     def __init__(self, core: Optional[PSCore] = None,
                  host: str = "127.0.0.1", port: int = 0) -> None:
         self.core = core or PSCore()
-        self._stop = threading.Event()
-        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._server.bind((host, port))
-        self._server.listen(64)
-        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
-        self._thread.start()
+        self._rpc = FramedServer(self._handle, _loads, host, port)
 
     @property
     def port(self) -> int:
-        return self._server.getsockname()[1]
+        return self._rpc.port
 
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                conn, _ = self._server.accept()
-            except OSError:
-                return
-            threading.Thread(target=self._serve_conn, args=(conn,),
-                             daemon=True).start()
-
-    def _serve_conn(self, conn: socket.socket) -> None:
-        try:
-            while not self._stop.is_set():
-                hdr = _recv_exact(conn, _LEN.size)
-                if hdr is None:
-                    return
-                (length,) = _LEN.unpack(hdr)
-                body = _recv_exact(conn, length)
-                if body is None:
-                    return
-                req = _loads(body)
-                method = req["method"]
-                if method == "__stop__":
-                    self._send(conn, {"ok": True})
-                    self.stop()
-                    return
-                try:
-                    result = getattr(self.core, method)(**req["args"])
-                    self._send(conn, {"ok": True, "result": result})
-                except Exception as e:  # surface to the client
-                    self._send(conn, {"ok": False, "error": repr(e)})
-        finally:
-            conn.close()
-
-    @staticmethod
-    def _send(conn: socket.socket, obj: Any) -> None:
-        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-        conn.sendall(_LEN.pack(len(payload)) + payload)
+    def _handle(self, req: dict) -> Any:
+        method = req["method"]
+        if method == "__stop__":
+            # reply to this frame first, then tear the listener down
+            threading.Timer(0.05, self.stop).start()
+            return True
+        return getattr(self.core, method)(**req["args"])
 
     def stop(self) -> None:
-        self._stop.set()
-        try:
-            self._server.close()
-        except OSError:
-            pass
+        self._rpc.stop()

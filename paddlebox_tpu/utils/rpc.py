@@ -1,0 +1,154 @@
+"""Shared framed-RPC transport for the host control plane.
+
+One length-prefixed-pickle transport used by both the PS service
+(ps/service.py, the brpc stand-in) and the fleet KV store
+(fleet/store.py, the Gloo-rendezvous stand-in): a threaded TCP server that
+dispatches request dicts to a handler and always answers each frame with
+``{"ok": bool, "result"|"error"}``, and a client that sends one request per
+call over a mutex-guarded connection. Unpickling is restricted by an
+allow-predicate per channel (numpy+configs for the PS, plain data only for
+the store).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_LEN = struct.Struct("<I")
+
+
+def recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def make_loads(allow: Callable[[str, str], bool]) -> Callable[[bytes], Any]:
+    """A pickle.loads whose class resolution is limited to `allow`."""
+
+    class _Unpickler(pickle.Unpickler):
+        def find_class(self, module, name):
+            if allow(module, name):
+                return super().find_class(module, name)
+            raise pickle.UnpicklingError(
+                "refusing to unpickle %s.%s" % (module, name))
+
+    def loads(data: bytes) -> Any:
+        return _Unpickler(io.BytesIO(data)).load()
+
+    return loads
+
+
+def plain_loads(data: bytes) -> Any:
+    """Plain containers/scalars only — no class resolution at all."""
+    return make_loads(lambda m, n: False)(data)
+
+
+class FramedServer:
+    """Accepts connections; one thread per conn; each request frame gets
+    exactly one response frame (even on handler/parse errors, so the
+    client's stream never desyncs)."""
+
+    def __init__(self, handler: Callable[[dict], Any],
+                 loads: Callable[[bytes], Any] = plain_loads,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self._handler = handler
+        self._loads = loads
+        self._stop = threading.Event()
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, port))
+        self._server.listen(128)
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._server.getsockname()[1]
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                hdr = recv_exact(conn, _LEN.size)
+                if hdr is None:
+                    return
+                (length,) = _LEN.unpack(hdr)
+                body = recv_exact(conn, length)
+                if body is None:
+                    return
+                try:
+                    resp = {"ok": True, "result": self._handler(
+                        self._loads(body))}
+                except Exception as e:  # surfaced to the client
+                    resp = {"ok": False, "error": repr(e)}
+                payload = pickle.dumps(resp,
+                                       protocol=pickle.HIGHEST_PROTOCOL)
+                conn.sendall(_LEN.pack(len(payload)) + payload)
+        finally:
+            conn.close()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+
+class FramedClient:
+    def __init__(self, host: str, port: int,
+                 loads: Callable[[bytes], Any] = plain_loads,
+                 timeout: float = 300.0) -> None:
+        self._sock = socket.create_connection((host, port), timeout=60.0)
+        self._sock.settimeout(timeout)
+        self._loads = loads
+        self._lock = threading.Lock()
+        self._broken = False
+
+    def call(self, req: Dict[str, Any]) -> Any:
+        payload = pickle.dumps(req, protocol=pickle.HIGHEST_PROTOCOL)
+        with self._lock:
+            if self._broken:
+                raise ConnectionError("rpc connection previously failed")
+            try:
+                self._sock.sendall(_LEN.pack(len(payload)) + payload)
+                hdr = recv_exact(self._sock, _LEN.size)
+                body = (recv_exact(self._sock, _LEN.unpack(hdr)[0])
+                        if hdr is not None else None)
+            except OSError as e:
+                self._broken = True
+                raise ConnectionError("rpc transport failed") from e
+            if hdr is None or body is None:
+                # mid-frame EOF: the stream is unrecoverable
+                self._broken = True
+                raise ConnectionError("rpc server closed connection")
+        resp = self._loads(body)
+        if not resp["ok"]:
+            raise RuntimeError("rpc %r failed: %s"
+                               % (req.get("method") or req.get("op"),
+                                  resp["error"]))
+        return resp.get("result")
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

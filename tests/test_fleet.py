@@ -1,0 +1,140 @@
+"""Fleet control plane: KV store, collectives, elastic heartbeats, and the
+subprocess launcher (the test_dist_base.py localhost-cluster pattern)."""
+
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.fleet import (ElasticManager, Fleet, KVStoreServer,
+                                 RoleMaker, TcpStoreClient)
+
+
+@pytest.fixture
+def store():
+    s = KVStoreServer(host="127.0.0.1")
+    yield s
+    s.stop()
+
+
+def test_store_set_get_wait_add(store):
+    cl = TcpStoreClient("127.0.0.1", store.port)
+    assert cl.get("k") is None
+    cl.set("k", b"v1")
+    assert cl.get("k") == b"v1"
+    assert cl.add("c", 2) == 2
+    assert cl.add("c") == 3
+
+    got = {}
+
+    def waiter():
+        got["v"] = cl2.wait("late", timeout=10)
+
+    cl2 = TcpStoreClient("127.0.0.1", store.port)
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.05)
+    cl.set("late", b"arrived")
+    th.join(5)
+    assert got["v"] == b"arrived"
+    cl.delete("k")
+    assert cl.get("k") is None
+    cl.close()
+    cl2.close()
+
+
+def test_store_rejects_pickled_classes(store):
+    import pickle
+    import socket
+    import struct
+    s = socket.create_connection(("127.0.0.1", store.port))
+    evil = pickle.dumps({"op": "set", "key": "x",
+                         "value": RoleMaker(rank=0, world=1)})
+    s.sendall(struct.pack("<I", len(evil)) + evil)
+    hdr = s.recv(4)
+    (n,) = struct.unpack("<I", hdr)
+    resp = pickle.loads(s.recv(n))
+    assert not resp["ok"] and "refusing to unpickle" in resp["error"]
+    s.close()
+
+
+def test_fleet_collectives_two_ranks(store):
+    results = {}
+
+    def run(rank):
+        fl = Fleet().init(RoleMaker(
+            rank=rank, world=2,
+            store_endpoint="127.0.0.1:%d" % store.port))
+        fl.barrier_worker(timeout=30)
+        s = fl.all_reduce(np.array([rank + 1.0, 10.0]), "sum", timeout=30)
+        m = fl.all_reduce(np.array([rank], np.int64), "max", timeout=30)
+        g = fl.all_gather(np.full(2, rank, np.int32), timeout=30)
+        eq = fl.equalize_batches()(5 if rank == 0 else 9)
+        results[rank] = (s, m, g, eq)
+        fl.stop()
+
+    ths = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    for rank in (0, 1):
+        s, m, g, eq = results[rank]
+        np.testing.assert_allclose(s, [3.0, 20.0])
+        assert m[0] == 1
+        np.testing.assert_array_equal(g[0], [0, 0])
+        np.testing.assert_array_equal(g[1], [1, 1])
+        assert eq == 9
+
+
+def test_elastic_detects_dead_rank(store):
+    cl0 = TcpStoreClient("127.0.0.1", store.port)
+    cl1 = TcpStoreClient("127.0.0.1", store.port)
+    faults = []
+    em0 = ElasticManager(cl0, rank=0, world=2, heartbeat_interval=0.1,
+                         stale_after=0.5, on_fault=faults.append)
+    em1 = ElasticManager(cl1, rank=1, world=2, heartbeat_interval=0.1,
+                         stale_after=0.5)
+    em0.start()
+    em1.start()
+    time.sleep(0.3)
+    assert not em0.dead_ranks
+    em1.stop()  # rank 1 "dies" (stops heartbeating)
+    deadline = time.time() + 5
+    while not em0.dead_ranks and time.time() < deadline:
+        time.sleep(0.1)
+    assert em0.dead_ranks == [1]
+    assert faults == [[1]]
+    with pytest.raises(Exception):
+        em0.check()
+    em0.stop()
+    cl0.close()
+    cl1.close()
+
+
+_WORKER = """
+import numpy as np
+from paddlebox_tpu.fleet import fleet
+fleet.init()
+rank = fleet.worker_index()
+total = fleet.all_reduce(np.array([rank + 1.0]))
+assert total[0] == 3.0, total
+fleet.barrier_worker()
+print("rank", rank, "ok")
+"""
+
+
+def test_launch_two_processes(tmp_path):
+    import os
+    import paddlebox_tpu
+    repo_root = os.path.dirname(os.path.dirname(paddlebox_tpu.__file__))
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    from paddlebox_tpu.fleet.launch import launch
+    rc = launch(2, [str(script)],
+                env_extra={"JAX_PLATFORMS": "cpu",
+                           "PYTHONPATH": repo_root})
+    assert rc == 0
