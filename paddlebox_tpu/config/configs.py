@@ -151,3 +151,5 @@ class TrainerConfig:
     dense_optimizer: str = "adam"
     check_nan_inf: bool = False
     profile: bool = False
+    scan_chunk: int = 8                  # batches fused per device dispatch
+                                         # (lax.scan megastep); 1 = off

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from paddlebox_tpu.data.slot_record import SlotRecord
+from paddlebox_tpu.utils.rpc import recv_exact
 from paddlebox_tpu.utils.stats import stat_add
 
 _REC_MAGIC = 0x50425852  # "PBXR"
@@ -283,11 +284,11 @@ class TcpShuffler(ShufflerBase):
     def _recv_loop(self, conn: socket.socket) -> None:
         try:
             while not self._stop.is_set():
-                hdr = self._recv_exact(conn, _HDR.size)
+                hdr = recv_exact(conn, _HDR.size)
                 if hdr is None:
                     return
                 mtype, src, epoch, length = _HDR.unpack(hdr)
-                payload = (self._recv_exact(conn, length) if length
+                payload = (recv_exact(conn, length) if length
                            else b"")
                 if length and payload is None:
                     return
@@ -297,16 +298,6 @@ class TcpShuffler(ShufflerBase):
                     self._peer_done(src, epoch)
         finally:
             conn.close()
-
-    @staticmethod
-    def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
-        buf = b""
-        while len(buf) < n:
-            chunk = conn.recv(n - len(buf))
-            if not chunk:
-                return None
-            buf += chunk
-        return buf
 
     # -- send path ----------------------------------------------------------
     def _send_frame(self, dest: int, mtype: int, payload: bytes) -> None:

@@ -93,7 +93,8 @@ class TcpStoreClient:
         return self._rpc.call({"op": "get", "key": key})
 
     def wait(self, key: str, timeout: float = 60.0) -> bytes:
-        return self._rpc.call({"op": "wait", "key": key, "timeout": timeout})
+        return self._rpc.call({"op": "wait", "key": key, "timeout": timeout},
+                              op_timeout=timeout)
 
     def add(self, key: str, amount: int = 1) -> int:
         return self._rpc.call({"op": "add", "key": key, "amount": amount})
@@ -104,7 +105,8 @@ class TcpStoreClient:
     def wait_counter_ge(self, key: str, target: int,
                         timeout: float = 60.0) -> int:
         return self._rpc.call({"op": "wait_counter_ge", "key": key,
-                               "target": target, "timeout": timeout})
+                               "target": target, "timeout": timeout},
+                              op_timeout=timeout)
 
     def delete(self, key: str) -> None:
         self._rpc.call({"op": "delete", "key": key})

@@ -13,6 +13,23 @@ from typing import Callable, Dict, List, Sequence
 import jax
 import jax.numpy as jnp
 
+from paddlebox_tpu.config import flags
+
+
+def matmul(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """MXU matmul honoring the `matmul_dtype` flag: bfloat16 inputs with
+    float32 accumulation (the MXU's native mode — f32 operands run at half
+    rate), or plain float32. Params stay float32 masters either way.
+
+    The flag is read at TRACE time: set it before building the trainer
+    (jit caches are not keyed on it, so later changes don't retrace)."""
+    if flags.get_flag("matmul_dtype") == "bfloat16":
+        return jax.lax.dot_general(
+            x.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    return x @ w
+
 
 def mlp_init(rng: jax.Array, dims: Sequence[int], name: str = "mlp") -> Dict:
     """He-init MLP params: dims = [in, h1, ..., out]."""
@@ -29,7 +46,7 @@ def mlp_apply(params: Dict, x: jnp.ndarray, name: str = "mlp",
               act: Callable = jax.nn.relu, final_act: bool = False) -> jnp.ndarray:
     i = 0
     while f"{name}_w{i}" in params:
-        x = x @ params[f"{name}_w{i}"] + params[f"{name}_b{i}"]
+        x = matmul(x, params[f"{name}_w{i}"]) + params[f"{name}_b{i}"]
         if final_act or f"{name}_w{i+1}" in params:
             x = act(x)
         i += 1

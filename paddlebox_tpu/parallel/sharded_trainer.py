@@ -115,6 +115,10 @@ class ShardedBoxTrainer:
         self._param_sync = (self._build_param_sync() if self.k_step > 1
                             else None)
         self._steps_since_sync = 0
+        # megastep: scan a chunk of steps inside one dispatch (k_step mode
+        # keeps per-step dispatch so the host can interleave param syncs)
+        from paddlebox_tpu.train.trainer import make_scan
+        self._scan_steps = make_scan(self._step) if self.k_step == 1 else None
 
     # ------------------------------------------------------------ jit step
     def _build_step(self):
@@ -338,7 +342,27 @@ class ShardedBoxTrainer:
         losses = []
         raw_steps = list(zip(*per_worker)) if per_worker[0] else []
         dev_batches = self.shard_batches(per_worker)
-        for i, batch in enumerate(dev_batches):
+        start_i = 0
+        chunk = max(1, self.cfg.scan_chunk)
+        if (self._scan_steps is not None and chunk > 1
+                and len(dev_batches) >= chunk):
+            n_full = (len(dev_batches) // chunk) * chunk
+            for lo in range(0, n_full, chunk):
+                group = dev_batches[lo:lo + chunk]
+                stacked = {k: jnp.stack([d[k] for d in group])
+                           for k in group[0]}
+                self.timers["step"].start()
+                (self._slabs, self.params, self.opt_state, chunk_losses,
+                 preds, self._prng) = self._scan_steps(
+                    self._slabs, self.params, self.opt_state, stacked,
+                    self._prng)
+                self.timers["step"].pause()
+                losses.extend(float(l) for l in np.asarray(chunk_losses))
+                for j in range(len(group)):
+                    self._add_metrics({t: p[j] for t, p in preds.items()},
+                                      raw_steps[lo + j])
+            start_i = n_full
+        for i, batch in enumerate(dev_batches[start_i:], start=start_i):
             self.timers["step"].start()
             (self._slabs, self.params, self.opt_state, loss, preds,
              self._prng) = self._step(self._slabs, self.params,

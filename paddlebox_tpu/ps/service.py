@@ -137,8 +137,9 @@ class TcpPSClient:
     def __init__(self, host: str, port: int, timeout: float = 300.0) -> None:
         self._rpc = FramedClient(host, port, _loads, timeout)
 
-    def _call(self, method: str, **kwargs) -> Any:
-        return self._rpc.call({"method": method, "args": kwargs})
+    def _call(self, method: str, _op_timeout=None, **kwargs) -> Any:
+        return self._rpc.call({"method": method, "args": kwargs},
+                              op_timeout=_op_timeout)
 
     # mirror the PSClient interface
     def create_sparse_table(self, table_id, table, shard_num=8, seed=0):
@@ -176,7 +177,8 @@ class TcpPSClient:
         return self._call("load", dirpath=dirpath)
 
     def barrier(self, world, timeout=120.0):
-        return self._call("barrier", world=world, timeout=timeout)
+        return self._call("barrier", _op_timeout=timeout, world=world,
+                          timeout=timeout)
 
     def stop_server(self):
         try:
@@ -204,8 +206,9 @@ class PSServer:
     def _handle(self, req: dict) -> Any:
         method = req["method"]
         if method == "__stop__":
-            # reply to this frame first, then tear the listener down
-            threading.Timer(0.05, self.stop).start()
+            # stop() only closes the LISTENER; the live connection still
+            # delivers this frame's ack before its serve loop exits
+            self.stop()
             return True
         return getattr(self.core, method)(**req["args"])
 

@@ -48,9 +48,8 @@ def make_loads(allow: Callable[[str, str], bool]) -> Callable[[bytes], Any]:
     return loads
 
 
-def plain_loads(data: bytes) -> Any:
-    """Plain containers/scalars only — no class resolution at all."""
-    return make_loads(lambda m, n: False)(data)
+# plain containers/scalars only — no class resolution at all
+plain_loads = make_loads(lambda m, n: False)
 
 
 class FramedServer:
@@ -123,11 +122,18 @@ class FramedClient:
         self._lock = threading.Lock()
         self._broken = False
 
-    def call(self, req: Dict[str, Any]) -> Any:
+    def call(self, req: Dict[str, Any],
+             op_timeout: Optional[float] = None) -> Any:
+        """op_timeout: when the server-side op legitimately blocks (store
+        waits/barriers), raise the socket deadline past it so the transport
+        doesn't brick the client while the server is still healthy."""
         payload = pickle.dumps(req, protocol=pickle.HIGHEST_PROTOCOL)
         with self._lock:
             if self._broken:
                 raise ConnectionError("rpc connection previously failed")
+            if op_timeout is not None:
+                self._sock.settimeout(
+                    max(self._sock.gettimeout() or 0.0, op_timeout + 30.0))
             try:
                 self._sock.sendall(_LEN.pack(len(payload)) + payload)
                 hdr = recv_exact(self._sock, _LEN.size)
