@@ -270,6 +270,27 @@ class BoxDataset:
         self.preload_into_disk(out_prefix, max_bytes)
         self.wait_preload_done()
 
+    def slots_shuffle(self, slot_indices: Sequence[int],
+                      seed: Optional[int] = None) -> None:
+        """Permute the given slots' feasign lists ACROSS records, leaving
+        every other slot in place (BoxHelper::SlotsShuffle, box_wrapper.h:
+        1174-1198) — the AUC-runner's feature-ablation primitive: retrain/
+        re-eval with one slot decorrelated and measure the AUC drop."""
+        if self._load_columnar:
+            raise RuntimeError("slots_shuffle needs the record path "
+                               "(construct the dataset with columnar=False)")
+        rng = np.random.RandomState(seed)
+        n = len(self._records)
+        for si in slot_indices:
+            vals = [r.uint64_slots.get(si) for r in self._records]
+            perm = rng.permutation(n)
+            for r, j in zip(self._records, perm):
+                v = vals[j]
+                if v is None:
+                    r.uint64_slots.pop(si, None)
+                else:
+                    r.uint64_slots[si] = v
+
     # -------------------------------------------------------------- train prep
     def local_shuffle(self, seed: Optional[int] = None) -> None:
         rng = np.random.RandomState(seed)

@@ -20,8 +20,13 @@ class NativeMultiSlotParser:
     callers fall back to the Python parser.
     """
 
-    def __init__(self, feed: DataFeedConfig, label_slot: str = "click") -> None:
-        lib = get_lib()
+    def __init__(self, feed: DataFeedConfig, label_slot: str = "click",
+                 lib_path: str = None) -> None:
+        if lib_path is not None:
+            from paddlebox_tpu.native.build import load_lib
+            lib = load_lib(lib_path)
+        else:
+            lib = get_lib()
         if lib is None:
             raise RuntimeError("native library unavailable")
         self._lib = lib

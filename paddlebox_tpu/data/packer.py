@@ -39,6 +39,7 @@ class PackedBatch:
     # join-phase extras
     rank_offset: Optional[np.ndarray] = None  # [B, 2*max_rank+1] int32
     qvalues: Optional[np.ndarray] = None      # [B] float32
+    ins_ids: Optional[List[str]] = None       # [n_ins] (dump-field lines)
 
     @property
     def batch_size(self) -> int:
@@ -106,7 +107,8 @@ class BatchPacker:
         # padding key slots point at segment 0 but are masked by valid=False
         batch = PackedBatch(keys=keys, slots=slots, segments=segments,
                             valid=valid, labels=labels, ins_valid=ins_valid,
-                            dense=dense, n_ins=n, qvalues=qvalues)
+                            dense=dense, n_ins=n, qvalues=qvalues,
+                            ins_ids=[r.ins_id for r in records[:n]])
         if with_rank_offset:
             batch.rank_offset = self._build_rank_offset(records[:n], B)
         return batch

@@ -1,0 +1,42 @@
+"""Custom parser plugins.
+
+Analog of DLManager/CustomParser (paddle/fluid/framework/data_feed.h:
+682-780 + `ISlotParser`, h:1963): the reference dlopens user `.so` parsers
+selected per file format by the DataFeedDesc. Here a plugin is either
+
+  * a python module file exporting ``make_parser(feed) -> parser`` where
+    the parser has ``parse_file(path) -> Iterator[SlotRecord]`` (the
+    MultiSlotParser contract), or
+  * a native shared object honoring the columnar slot-parser C ABI
+    (native/slot_parser.cc), loaded through NativeMultiSlotParser.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+from typing import Any
+
+from paddlebox_tpu.config.configs import DataFeedConfig
+
+
+def load_parser_plugin(path: str, feed: DataFeedConfig) -> Any:
+    """Load a parser from a plugin file (LoadParserSo analog)."""
+    if path.endswith(".py"):
+        spec = importlib.util.spec_from_file_location(
+            "pbtpu_parser_plugin_%s" % os.path.basename(path)[:-3], path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        if not hasattr(mod, "make_parser"):
+            raise AttributeError(
+                "parser plugin %s must export make_parser(feed)" % path)
+        parser = mod.make_parser(feed)
+        if not hasattr(parser, "parse_file"):
+            raise AttributeError(
+                "plugin parser must provide parse_file(path)")
+        return parser
+    if path.endswith(".so"):
+        from paddlebox_tpu.data.native_parser import NativeMultiSlotParser
+        return NativeMultiSlotParser(feed, lib_path=path)
+    raise ValueError("parser plugin must be a .py module or native .so: "
+                     + path)

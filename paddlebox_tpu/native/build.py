@@ -93,6 +93,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
+def load_lib(path: str) -> ctypes.CDLL:
+    """Bind a user-supplied shared object honoring the same C ABI
+    (the DLManager dlopen path for custom parser plugins)."""
+    return _bind(ctypes.CDLL(path))
+
+
 def get_lib() -> Optional[ctypes.CDLL]:
     global _lib, _failed
     if _lib is not None or _failed:

@@ -346,22 +346,24 @@ class ShardedBoxTrainer:
         chunk = max(1, self.cfg.scan_chunk)
         if (self._scan_steps is not None and chunk > 1
                 and len(dev_batches) >= chunk):
-            n_full = (len(dev_batches) // chunk) * chunk
-            for lo in range(0, n_full, chunk):
-                group = dev_batches[lo:lo + chunk]
-                stacked = {k: jnp.stack([d[k] for d in group])
-                           for k in group[0]}
-                self.timers["step"].start()
-                (self._slabs, self.params, self.opt_state, chunk_losses,
-                 preds, self._prng) = self._scan_steps(
-                    self._slabs, self.params, self.opt_state, stacked,
-                    self._prng)
-                self.timers["step"].pause()
-                losses.extend(float(l) for l in np.asarray(chunk_losses))
+            from paddlebox_tpu.train.trainer import run_scan_chunks
+
+            def on_chunk(lo, group, chunk_losses, preds):
+                if self.cfg.check_nan_inf and not np.isfinite(
+                        chunk_losses).all():
+                    raise FloatingPointError("nan/inf loss in scan chunk")
                 for j in range(len(group)):
                     self._add_metrics({t: p[j] for t, p in preds.items()},
                                       raw_steps[lo + j])
-            start_i = n_full
+
+            carry = (self._slabs, self.params, self.opt_state, self._prng)
+            carry, chunk_losses, start_i = run_scan_chunks(
+                self._scan_steps, dev_batches, chunk,
+                lambda group: {k: jnp.stack([d[k] for d in group])
+                               for k in group[0]},
+                carry, on_chunk, timer=self.timers["step"])
+            self._slabs, self.params, self.opt_state, self._prng = carry
+            losses.extend(chunk_losses)
         for i, batch in enumerate(dev_batches[start_i:], start=start_i):
             self.timers["step"].start()
             (self._slabs, self.params, self.opt_state, loss, preds,

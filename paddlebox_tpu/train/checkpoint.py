@@ -144,3 +144,72 @@ class CheckpointManager:
         if self._save_thread is not None:
             self._save_thread.join()
             self._save_thread = None
+
+
+# ---------------------------------------------------------------------------
+# Model merge tooling
+# ---------------------------------------------------------------------------
+
+
+def merge_models(batch_dirs, out_dir: str) -> str:
+    """Merge N batch models into one (MergeModel/MergeMultiModels,
+    box_wrapper.h:788-804 — the closed core's impl is not visible, so the
+    combine rule here is the natural one for CTR value rows: counters
+    (show/click/delta_score) SUM across models, weight/state columns
+    average WEIGHTED BY SHOW, unseen_days takes the min and mf_size the
+    max. Dense params are taken from the first model (data-parallel
+    replicas are identical at save time)."""
+    blobs = []
+    for d in batch_dirs:
+        with open(os.path.join(d, "sparse.pkl"), "rb") as f:
+            blobs.append(pickle.load(f))
+    embedx_dim = blobs[0]["embedx_dim"]
+    opt = blobs[0]["optimizer"]
+    width = blobs[0]["values"].shape[1]
+    for b in blobs[1:]:
+        if b["embedx_dim"] != embedx_dim or b["optimizer"] != opt:
+            raise ValueError("cannot merge models with different layouts")
+
+    counter_cols = [acc.SHOW, acc.CLICK, acc.DELTA_SCORE]
+    wsum: Dict[int, np.ndarray] = {}    # show-weighted row sum
+    wtot: Dict[int, float] = {}         # total show weight
+    csum: Dict[int, np.ndarray] = {}    # exact counter sums
+    unseen: Dict[int, float] = {}
+    mfsz: Dict[int, float] = {}
+    for blob in blobs:
+        for k, row in zip(blob["keys"].tolist(), blob["values"]):
+            w = max(float(row[acc.SHOW]), 1e-6)
+            if k not in wsum:
+                wsum[k] = row * w
+                wtot[k] = w
+                csum[k] = row[counter_cols].copy()
+                unseen[k] = row[acc.UNSEEN_DAYS]
+                mfsz[k] = row[acc.MF_SIZE]
+            else:
+                wsum[k] += row * w
+                wtot[k] += w
+                csum[k] += row[counter_cols]
+                unseen[k] = min(unseen[k], row[acc.UNSEEN_DAYS])
+                mfsz[k] = max(mfsz[k], row[acc.MF_SIZE])
+
+    out_keys = np.fromiter(wsum.keys(), dtype=np.uint64, count=len(wsum))
+    out_vals = np.empty((len(wsum), width), np.float32)
+    for i, k in enumerate(wsum):
+        row = wsum[k] / wtot[k]
+        row[counter_cols] = csum[k]
+        row[acc.UNSEEN_DAYS] = unseen[k]
+        row[acc.MF_SIZE] = mfsz[k]
+        out_vals[i] = row
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "sparse.pkl"), "wb") as f:
+        pickle.dump({"keys": out_keys, "values": out_vals,
+                     "embedx_dim": embedx_dim, "optimizer": opt}, f,
+                    protocol=pickle.HIGHEST_PROTOCOL)
+    dense_src = os.path.join(batch_dirs[0], "dense.pkl")
+    if os.path.exists(dense_src):
+        with open(dense_src, "rb") as fsrc, \
+                open(os.path.join(out_dir, "dense.pkl"), "wb") as fdst:
+            fdst.write(fsrc.read())
+    with open(os.path.join(out_dir, "DONE"), "w") as f:
+        f.write(str(time.time()))
+    return out_dir

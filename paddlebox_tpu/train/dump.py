@@ -1,0 +1,101 @@
+"""Dump-for-debug subsystem: per-batch field dump + param dump.
+
+Analog of the reference's dump machinery: BoxPSTrainer's dump thread pool
+draining a channel into rotating files (boxps_trainer.cc:112-163, 2GB
+rotation) and BoxPSWorker::DumpField/DumpParam (boxps_worker.cc:~1535-1700)
+formatting one text line per instance (ins_id + tab-separated
+field:values). Trainers feed `DumpWriter.dump_batch` after each step when
+TrainerConfig.dump_fields is set; `dump_param` snapshots dense params at
+pass end.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.config import flags
+from paddlebox_tpu.utils.channel import Channel, ChannelClosed
+
+
+class DumpWriter:
+    def __init__(self, path: str, thread_num: int = 1,
+                 max_bytes: int = 0, rank: int = 0) -> None:
+        self.path = path
+        self.rank = rank
+        self.max_bytes = max_bytes or flags.get_flag("dump_file_max_bytes")
+        os.makedirs(path, exist_ok=True)
+        self._channel: Channel = Channel(capacity=1024)
+        self._threads = [
+            threading.Thread(target=self._writer_loop, args=(i,), daemon=True)
+            for i in range(max(1, thread_num))
+        ]
+        self.files: List[str] = []
+        self._files_lock = threading.Lock()
+        for t in self._threads:
+            t.start()
+
+    # -------------------------------------------------------------- producers
+    def dump_batch(self, tensors: Dict[str, np.ndarray],
+                   ins_ids: Optional[Sequence[str]] = None,
+                   mask: Optional[np.ndarray] = None) -> None:
+        """One line per instance: `<ins_id>\\t<field>:<v0>,<v1>...`
+        (DumpField's line shape). tensors: field name → [B] or [B, d]."""
+        fields = sorted(tensors)
+        n = len(tensors[fields[0]])
+        lines = []
+        for i in range(n):
+            if mask is not None and not mask[i]:
+                continue
+            ins = ins_ids[i] if ins_ids is not None else str(i)
+            parts = [ins]
+            for f in fields:
+                v = np.atleast_1d(np.asarray(tensors[f][i]))
+                parts.append("%s:%s" % (f, ",".join("%g" % x for x in v)))
+            lines.append("\t".join(parts))
+        if lines:
+            self._channel.put("\n".join(lines) + "\n")
+
+    def dump_param(self, params: Dict[str, np.ndarray],
+                   step: int) -> None:
+        """Flat text dump of dense params (DumpParam)."""
+        lines = ["param_step:%d" % step]
+        for name in sorted(params):
+            v = np.asarray(params[name]).reshape(-1)
+            lines.append("%s:%s" % (name,
+                                    ",".join("%g" % x for x in v[:1024])))
+        self._channel.put("\n".join(lines) + "\n")
+
+    # -------------------------------------------------------------- consumers
+    def _writer_loop(self, tid: int) -> None:
+        f = None
+        written = 0
+        idx = 0
+        while True:
+            try:
+                chunk = self._channel.get()
+            except ChannelClosed:
+                break
+            data = chunk.encode("utf-8")
+            if f is None or written + len(data) > self.max_bytes:
+                if f is not None:
+                    f.close()
+                p = os.path.join(self.path, "dump-rank%d-t%d-%05d.txt"
+                                 % (self.rank, tid, idx))
+                idx += 1
+                f = open(p, "wb")
+                written = 0
+                with self._files_lock:
+                    self.files.append(p)
+            f.write(data)
+            written += len(data)
+        if f is not None:
+            f.close()
+
+    def close(self) -> None:
+        self._channel.close()
+        for t in self._threads:
+            t.join()

@@ -75,6 +75,33 @@ def make_scan(step_fn: Callable) -> Callable:
     return scan_steps
 
 
+def run_scan_chunks(scan_fn: Callable, items: List, chunk: int,
+                    stack_fn: Callable, carry: Tuple,
+                    on_chunk: Callable, timer=None):
+    """Drive the megastep over full chunks of `items`.
+
+    carry = (slab(s), params, opt_state, prng) threaded through scan_fn;
+    on_chunk(lo, group, losses_np, preds) handles metrics/dump/nan per
+    trainer. Returns (carry, losses, n_consumed) — the remainder
+    items[n_consumed:] is the caller's per-step loop."""
+    losses_all: List[float] = []
+    n_full = (len(items) // chunk) * chunk if chunk > 1 else 0
+    for lo in range(0, n_full, chunk):
+        group = items[lo:lo + chunk]
+        stacked = stack_fn(group)
+        if timer is not None:
+            timer.start()
+        slab, params, opt_state, losses, preds, prng = scan_fn(
+            carry[0], carry[1], carry[2], stacked, carry[3])
+        if timer is not None:
+            timer.pause()
+        carry = (slab, params, opt_state, prng)
+        losses_np = np.asarray(losses)
+        losses_all.extend(float(l) for l in losses_np)
+        on_chunk(lo, group, losses_np, preds)
+    return carry, losses_all, n_full
+
+
 def make_dense_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
     if cfg.dense_optimizer == "adam":
         return optax.adam(cfg.dense_lr)
@@ -260,12 +287,33 @@ class BoxTrainer:
         self._step_count = 0
         self._shuffle_rng = np.random.RandomState(seed + 1)
         self.multi_task = len(getattr(model, "task_names", ("ctr",))) > 1
+        self.dump_writer = None
+        if self.cfg.dump_fields and self.cfg.dump_fields_path:
+            from paddlebox_tpu.train.dump import DumpWriter
+            self.dump_writer = DumpWriter(self.cfg.dump_fields_path,
+                                          self.cfg.dump_thread_num)
+
+    def _dump_batch(self, preds: Dict[str, jnp.ndarray],
+                    b: PackedBatch) -> None:
+        """DumpField per batch: one line per real instance with the
+        requested fields (boxps_worker.cc DumpField)."""
+        avail: Dict[str, np.ndarray] = {"label": b.labels}
+        for t, p in preds.items():
+            avail["pred_" + t] = np.asarray(p)
+        avail["pred"] = avail["pred_" + list(preds)[0]]
+        tensors = {f: avail[f] for f in self.cfg.dump_fields if f in avail}
+        if tensors:
+            self.dump_writer.dump_batch(tensors, ins_ids=b.ins_ids,
+                                        mask=b.ins_valid)
 
     def close(self) -> None:
-        """Stop the async dense optimizer thread (no-op in sync modes)."""
+        """Stop the async dense optimizer thread and dump writers."""
         if self.async_table is not None:
             self.async_table.stop()
             self.async_table = None
+        if self.dump_writer is not None:
+            self.dump_writer.close()
+            self.dump_writer = None
 
     def __del__(self):
         try:
@@ -330,28 +378,27 @@ class BoxTrainer:
                 and len(pending) >= chunk):
             # megastep path: scan whole chunks in one dispatch each; the
             # remainder falls through to the per-step loop below
-            n_full = (len(pending) // chunk) * chunk
-            scanned, pending = pending[:n_full], pending[n_full:]
-            for lo in range(0, n_full, chunk):
-                group = scanned[lo:lo + chunk]
-                stacked = self._stack_batches(group)
-                self.timers["step"].start()
-                (slab, self.params, self.opt_state, chunk_losses, preds,
-                 prng) = self.fns.scan_steps(
-                    self.table.slab, self.params, self.opt_state, stacked,
-                    prng)
-                self.table.set_slab(slab)
-                self.timers["step"].pause()
+
+            def on_chunk(lo, group, chunk_losses, preds):
                 self._step_count += len(group)
-                chunk_losses = np.asarray(chunk_losses)
-                losses.extend(float(l) for l in chunk_losses)
                 if self.cfg.check_nan_inf and not np.isfinite(
                         chunk_losses).all():
                     raise FloatingPointError(
                         f"nan/inf loss by step {self._step_count}")
                 for j, b in enumerate(group):
-                    self._add_metrics(
-                        {t: p[j] for t, p in preds.items()}, b)
+                    preds_j = {t: p[j] for t, p in preds.items()}
+                    self._add_metrics(preds_j, b)
+                    if self.dump_writer is not None:
+                        self._dump_batch(preds_j, b)
+
+            carry = (self.table.slab, self.params, self.opt_state, prng)
+            carry, chunk_losses, n_done = run_scan_chunks(
+                self.fns.scan_steps, pending, chunk, self._stack_batches,
+                carry, on_chunk, timer=self.timers["step"])
+            slab, self.params, self.opt_state, prng = carry
+            self.table.set_slab(slab)
+            losses.extend(chunk_losses)
+            pending = pending[n_done:]
         for b in pending:
             ids = self.table.lookup_ids(b.keys, b.valid)
             batch = self.device_batch(b, ids)
@@ -377,6 +424,8 @@ class BoxTrainer:
                 raise FloatingPointError(
                     f"nan/inf loss at step {self._step_count}")
             self._add_metrics(preds, b)
+            if self.dump_writer is not None:
+                self._dump_batch(preds, b)
         self.table.end_pass()
         if self.async_table is not None:
             # pass boundary is a sync point: drain the host optimizer and
@@ -384,6 +433,9 @@ class BoxTrainer:
             self.async_table.wait_drained()
             self.params = self._unravel(jnp.asarray(self.async_table.pull()))
         t_pass.pause()
+        if self.cfg.profile:
+            from paddlebox_tpu.utils.profiler import timer_report
+            print(timer_report(self.timers, prefix="trainer."))
         return {"loss": float(np.mean(losses)) if losses else 0.0,
                 "batches": len(worker_batches[0]),
                 "instances": len(dataset)}

@@ -1,0 +1,57 @@
+"""Profiling hooks: XPlane traces + per-stage timer reports.
+
+The reference's three tracing tiers (SURVEY.md §5.1): (a) cheap inline
+Timers woven through every stage (platform/timer.h — our utils/timer.py),
+(b) per-op profile mode (TrainFilesWithProfiler), (c) the full profiler
+emitting chrome-tracing (platform/profiler/). On TPU, (c) maps to
+jax.profiler traces viewable in XProf/TensorBoard; (a)/(b) map to the
+timer-report helpers here.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Iterator, Optional
+
+from paddlebox_tpu.utils.stats import StatRegistry
+from paddlebox_tpu.utils.timer import Timer
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture a jax.profiler trace (XPlane; open in XProf/TensorBoard).
+    The chrome-tracing-JSON role of platform/profiler/chrometracing_logger."""
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named span inside a trace (platform::RecordEvent analog)."""
+    import jax
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def timer_report(timers: Dict[str, Timer], prefix: str = "") -> str:
+    """PrintSyncTimer/PrintDeviceInfo-style one-liner per stage
+    (box_wrapper.h:784-801)."""
+    lines = []
+    for name in sorted(timers):
+        t = timers[name]
+        if not t.count:
+            continue
+        lines.append("%s%-12s calls=%-6d total=%8.1fms avg=%8.1fus"
+                     % (prefix, name, t.count, t.elapsed_ms(),
+                        t.elapsed_us() / max(1, t.count)))
+    return "\n".join(lines)
+
+
+def stats_report() -> str:
+    """Named-counter dump (StatRegistry / STAT_INT_ADD, monitor.h:80,137)."""
+    snap = StatRegistry.instance().snapshot()
+    return "\n".join("%-32s %d" % (k, v) for k, v in sorted(snap.items()))

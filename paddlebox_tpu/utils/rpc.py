@@ -131,9 +131,10 @@ class FramedClient:
         with self._lock:
             if self._broken:
                 raise ConnectionError("rpc connection previously failed")
+            prev_timeout = self._sock.gettimeout()
             if op_timeout is not None:
                 self._sock.settimeout(
-                    max(self._sock.gettimeout() or 0.0, op_timeout + 30.0))
+                    max(prev_timeout or 0.0, op_timeout + 30.0))
             try:
                 self._sock.sendall(_LEN.pack(len(payload)) + payload)
                 hdr = recv_exact(self._sock, _LEN.size)
@@ -142,6 +143,9 @@ class FramedClient:
             except OSError as e:
                 self._broken = True
                 raise ConnectionError("rpc transport failed") from e
+            finally:
+                if op_timeout is not None and not self._broken:
+                    self._sock.settimeout(prev_timeout)
             if hdr is None or body is None:
                 # mid-frame EOF: the stream is unrecoverable
                 self._broken = True

@@ -1,0 +1,165 @@
+"""Aux subsystems: dump-fields writers, profiler reports, model merge,
+slots-shuffle (AUC runner), parser plugins (SURVEY.md §5 coverage)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config.configs import (DataFeedConfig, SlotConfig,
+                                          SparseOptimizerConfig, TableConfig,
+                                          TrainerConfig)
+from paddlebox_tpu.data import BoxDataset, write_synthetic_ctr_files
+from paddlebox_tpu.data.plugin import load_parser_plugin
+from paddlebox_tpu.train.dump import DumpWriter
+from paddlebox_tpu.utils.profiler import stats_report, timer_report
+from paddlebox_tpu.utils.timer import Timer
+
+
+def test_dump_writer_lines_and_rotation(tmp_path):
+    w = DumpWriter(str(tmp_path / "dump"), thread_num=2, max_bytes=512)
+    for step in range(20):
+        w.dump_batch(
+            {"pred": np.full(4, 0.25), "label": np.array([1, 0, 1, 0])},
+            ins_ids=["i%d_%d" % (step, j) for j in range(4)],
+            mask=np.array([True, True, True, False]))
+    w.dump_param({"w0": np.arange(4.0)}, step=19)
+    w.close()
+    assert len(w.files) > 1  # rotated at 512 bytes
+    text = "".join(open(f).read() for f in w.files)
+    lines = [l for l in text.splitlines() if l and ":" in l]
+    # masked instance never dumped
+    assert not any(l.startswith("i0_3\t") for l in lines)
+    ins_lines = [l for l in lines if "\t" in l]
+    assert len(ins_lines) == 20 * 3
+    one = next(l for l in ins_lines if l.startswith("i0_0\t"))
+    assert "label:1" in one and "pred:0.25" in one
+    assert "param_step:19" in text and "w0:0,1,2,3" in text
+
+
+def test_trainer_dump_fields(tmp_path):
+    from paddlebox_tpu.data.generator import default_feed_config
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.models.base import ModelSpec
+    from paddlebox_tpu.train.trainer import BoxTrainer
+    files, feed = write_synthetic_ctr_files(
+        str(tmp_path / "d"), num_files=1, lines_per_file=64, num_slots=3,
+        vocab_per_slot=50, seed=5)
+    feed = type(feed)(slots=feed.slots, batch_size=16)
+    tcfg = TableConfig(embedx_dim=4, optimizer=SparseOptimizerConfig(
+        mf_create_thresholds=0.0))
+    tr = BoxTrainer(CtrDnn(ModelSpec(num_slots=3, slot_dim=7), hidden=(8,)),
+                    tcfg, feed,
+                    TrainerConfig(dump_fields=("pred", "label"),
+                                  dump_fields_path=str(tmp_path / "dump"),
+                                  scan_chunk=2))
+    ds = BoxDataset(feed, read_threads=1, columnar=False)
+    ds.set_filelist(files)
+    tr.train_pass(ds)
+    tr.close()
+    assert tr.dump_writer is None
+    dumped = [f for f in os.listdir(tmp_path / "dump")]
+    assert dumped
+    text = open(os.path.join(tmp_path / "dump", dumped[0])).read()
+    assert "pred:" in text and "label:" in text
+
+
+def test_timer_and_stats_report():
+    t = Timer()
+    t.start(); t.pause()
+    rep = timer_report({"step": t, "idle": Timer()})
+    assert "step" in rep and "idle" not in rep
+    from paddlebox_tpu.utils.stats import stat_add
+    stat_add("aux_test_counter", 3)
+    assert "aux_test_counter" in stats_report()
+
+
+def test_merge_models(tmp_path):
+    from paddlebox_tpu.embedding import accessor as acc
+    from paddlebox_tpu.embedding.accessor import ValueLayout
+    from paddlebox_tpu.train.checkpoint import merge_models
+    import pickle
+    layout = ValueLayout(embedx_dim=2, optimizer="adagrad")
+
+    def mk(d, keys, shows, ws):
+        os.makedirs(d, exist_ok=True)
+        vals = np.zeros((len(keys), layout.width), np.float32)
+        vals[:, acc.SHOW] = shows
+        vals[:, acc.CLICK] = 1.0
+        vals[:, acc.EMBED_W] = ws
+        with open(os.path.join(d, "sparse.pkl"), "wb") as f:
+            pickle.dump({"keys": np.array(keys, np.uint64), "values": vals,
+                         "embedx_dim": 2, "optimizer": "adagrad"}, f)
+
+    mk(str(tmp_path / "m0"), [1, 2], [4.0, 1.0], [1.0, 5.0])
+    mk(str(tmp_path / "m1"), [2, 3], [3.0, 2.0], [9.0, 7.0])
+    out = merge_models([str(tmp_path / "m0"), str(tmp_path / "m1")],
+                       str(tmp_path / "merged"))
+    with open(os.path.join(out, "sparse.pkl"), "rb") as f:
+        blob = pickle.load(f)
+    got = dict(zip(blob["keys"].tolist(), blob["values"]))
+    assert set(got) == {1, 2, 3}
+    # key 2 in both: show sums, embed_w show-weighted avg
+    assert got[2][acc.SHOW] == 4.0
+    np.testing.assert_allclose(got[2][acc.EMBED_W],
+                               (5.0 * 1 + 9.0 * 3) / 4, rtol=1e-6)
+    # singletons pass through
+    assert got[1][acc.EMBED_W] == 1.0 and got[3][acc.EMBED_W] == 7.0
+
+
+def test_slots_shuffle(tmp_path):
+    files, feed = write_synthetic_ctr_files(
+        str(tmp_path), num_files=1, lines_per_file=100, num_slots=3,
+        vocab_per_slot=50, seed=9)
+    feed = type(feed)(slots=feed.slots, batch_size=16)
+    ds = BoxDataset(feed, read_threads=1, columnar=False)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    before_s0 = [r.uint64_slots.get(0, np.empty(0, np.uint64)).copy()
+                 for r in ds.records]
+    before_s1 = [r.uint64_slots.get(1, np.empty(0, np.uint64)).copy()
+                 for r in ds.records]
+    ds.slots_shuffle([0], seed=3)
+    after_s0 = [r.uint64_slots.get(0, np.empty(0, np.uint64))
+                for r in ds.records]
+    after_s1 = [r.uint64_slots.get(1, np.empty(0, np.uint64))
+                for r in ds.records]
+    # slot 1 untouched
+    for a, b in zip(before_s1, after_s1):
+        np.testing.assert_array_equal(a, b)
+    # slot 0 is a permutation: same multiset of value-lists, mostly moved
+    key = lambda arrs: sorted(tuple(a.tolist()) for a in arrs)
+    assert key(before_s0) == key(after_s0)
+    moved = sum(1 for a, b in zip(before_s0, after_s0)
+                if a.shape != b.shape or (a != b).any())
+    assert moved > 50
+
+
+def test_parser_plugin_python(tmp_path):
+    plug = tmp_path / "myparser.py"
+    plug.write_text(
+        "import numpy as np\n"
+        "from paddlebox_tpu.data.slot_record import SlotRecord\n"
+        "class P:\n"
+        "    def __init__(self, feed): self.feed = feed\n"
+        "    def parse_file(self, path):\n"
+        "        for line in open(path):\n"
+        "            v = int(line)\n"
+        "            yield SlotRecord(label=v % 2,\n"
+        "                uint64_slots={0: np.array([v], np.uint64)})\n"
+        "def make_parser(feed):\n"
+        "    return P(feed)\n")
+    data = tmp_path / "data.txt"
+    data.write_text("\n".join(str(i) for i in range(10)))
+    feed = DataFeedConfig(slots=(
+        SlotConfig("click", type="float", dim=1, is_used=False),
+        SlotConfig("s0", type="uint64", max_len=2)), batch_size=4)
+    parser = load_parser_plugin(str(plug), feed)
+    ds = BoxDataset(feed, read_threads=1, parser=parser, columnar=False)
+    ds.set_filelist([str(data)])
+    ds.load_into_memory()
+    assert len(ds) == 10
+    assert sum(r.label for r in ds.records) == 5
+
+    with pytest.raises(ValueError):
+        load_parser_plugin(str(tmp_path / "x.txt"), feed)
