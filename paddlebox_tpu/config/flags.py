@@ -112,6 +112,9 @@ define_flag("feed_pass_thread_num", 8,
             "threads registering keys during feed pass (ref default 30)")
 define_flag("profile_per_op", False,
             "accumulate per-op timing in the train loop (TrainFilesWithProfiler)")
+define_flag("use_pallas_push", False,
+            "route the in-table adagrad row update through the hand-written "
+            "Pallas kernel (embedding/pallas_push.py) instead of XLA")
 define_flag("matmul_dtype", "float32",
             "dense matmul operand dtype: bfloat16 (MXU native, f32 "
             "accumulation; wins once the MLP dominates the step) or float32")

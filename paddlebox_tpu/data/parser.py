@@ -61,8 +61,44 @@ class MultiSlotParser:
         return rec
 
     def parse_file(self, path: str) -> Iterator[SlotRecord]:
-        with open(path, "r") as f:
-            for line in f:
-                rec = self.parse_line(line)
-                if rec is not None:
-                    yield rec
+        """Stream records from a file. Honors the feed's `pipe_command`
+        (SlotPaddleBoxDataFeed's pipe-command load path, data_feed.h:
+        2119-2134: each file is piped through a user shell command before
+        parsing) and transparently decompresses `.gz` inputs."""
+        for line in self._open_lines(path):
+            rec = self.parse_line(line)
+            if rec is not None:
+                yield rec
+
+    def _open_lines(self, path: str) -> Iterator[str]:
+        pipe = getattr(self.feed, "pipe_command", "")
+        if pipe:
+            import shlex
+            import subprocess
+            src = (open(path, "rb") if not path.endswith(".gz")
+                   else None)
+            cmd = (pipe if src is not None
+                   else "zcat %s | %s" % (shlex.quote(path), pipe))
+            proc = subprocess.Popen(
+                cmd, shell=True, stdin=src,
+                stdout=subprocess.PIPE, text=True)
+            try:
+                yield from proc.stdout
+            finally:
+                if src is not None:
+                    src.close()
+                proc.stdout.close()
+                rc = proc.wait()
+                # 141/-13 = SIGPIPE from the consumer stopping early (e.g.
+                # a peeked record or an aborted load) — not a command error
+                if rc not in (0, 141, -13):
+                    raise IOError("pipe_command %r failed (rc=%d) on %s"
+                                  % (pipe, rc, path))
+            return
+        if path.endswith(".gz"):
+            import gzip
+            with gzip.open(path, "rt") as f:
+                yield from f
+        else:
+            with open(path, "r") as f:
+                yield from f

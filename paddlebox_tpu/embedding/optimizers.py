@@ -222,7 +222,14 @@ def push_sparse_dedup(slab: jnp.ndarray, ids: jnp.ndarray,
     uids, inv = jnp.unique(ids, size=K, fill_value=trash, return_inverse=True)
     merged = jnp.zeros((K, grads.shape[1]), grads.dtype).at[inv].add(grads)
     rows = slab[uids]
-    new_rows = apply_push(rows, merged, prng, layout, conf)
+    from paddlebox_tpu.config import flags
+    if (flags.get_flag("use_pallas_push")
+            and layout.optimizer == "adagrad" and not layout.expand_dim):
+        from paddlebox_tpu.embedding.pallas_push import pallas_apply_push
+        seed = jax.random.randint(prng, (), 0, jnp.int32(2**31 - 1))
+        new_rows = pallas_apply_push(rows, merged, seed, layout, conf)
+    else:
+        new_rows = apply_push(rows, merged, prng, layout, conf)
     return slab.at[uids].set(new_rows)
 
 

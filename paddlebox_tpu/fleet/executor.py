@@ -1,0 +1,177 @@
+"""FleetExecutor analog: actor-style pipeline of interceptors.
+
+Re-design of paddle/fluid/distributed/fleet_executor/ (FleetExecutor,
+Carrier, Interceptor, MessageBus — fleet_executor.cc, carrier.cc,
+interceptor.cc, message_bus.cc): interceptors are small actors addressed
+by int64 ids that exchange `InterceptorMessage`s; a Carrier runs the
+interceptors registered to it on a worker thread per interceptor; the
+MessageBus routes messages whose destination lives on another carrier over
+TCP (the brpc channel role, via utils/rpc.py). The reference uses this as
+the pipeline-by-message inference/training runtime, independent of the
+BoxPS path; here it serves the same role for host-side pipelines (the
+device-side pipeline lives in parallel/pipeline.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from paddlebox_tpu.utils.rpc import FramedClient, FramedServer, plain_loads
+
+STOP = "__stop__"
+
+
+@dataclasses.dataclass
+class InterceptorMessage:
+    src_id: int
+    dst_id: int
+    message_type: str = "DATA"     # DATA | DATA_IS_READY | STOP ...
+    payload: Any = None
+
+    def to_wire(self) -> dict:
+        return {"src": self.src_id, "dst": self.dst_id,
+                "type": self.message_type, "payload": self.payload}
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "InterceptorMessage":
+        return cls(d["src"], d["dst"], d["type"], d.get("payload"))
+
+
+class Interceptor:
+    """One actor: a handler invoked per message on its own thread
+    (interceptor.cc's RegisterMsgHandle + loop)."""
+
+    def __init__(self, interceptor_id: int,
+                 handler: Callable[["Interceptor", InterceptorMessage], None]):
+        self.id = interceptor_id
+        self.handler = handler
+        self.carrier: Optional["Carrier"] = None
+        self._inbox: "queue.Queue[Optional[InterceptorMessage]]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            msg = self._inbox.get()
+            if msg is None or msg.message_type == STOP:
+                return
+            self.handler(self, msg)
+
+    def enqueue(self, msg: InterceptorMessage) -> None:
+        self._inbox.put(msg)
+
+    def send(self, dst_id: int, payload: Any = None,
+             message_type: str = "DATA") -> None:
+        self.carrier.send(InterceptorMessage(self.id, dst_id,
+                                             message_type, payload))
+
+    def stop(self) -> None:
+        self._inbox.put(None)
+        if self._thread is not None:
+            self._thread.join()
+
+
+class Carrier:
+    """Hosts interceptors; routes local messages directly and remote ones
+    through the message bus (carrier.cc Send / EnqueueInterceptorMessage)."""
+
+    def __init__(self, carrier_id: int = 0,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.id = carrier_id
+        self._interceptors: Dict[int, Interceptor] = {}
+        # interceptor_id → (host, port) for remote destinations
+        self._routes: Dict[int, Tuple[str, int]] = {}
+        self._clients: Dict[Tuple[str, int], FramedClient] = {}
+        self._clients_lock = threading.Lock()
+        self._rpc = FramedServer(self._on_remote, plain_loads, host, port)
+
+    @property
+    def port(self) -> int:
+        return self._rpc.port
+
+    # -------------------------------------------------------------- topology
+    def add_interceptor(self, interceptor: Interceptor) -> Interceptor:
+        interceptor.carrier = self
+        self._interceptors[interceptor.id] = interceptor
+        interceptor.start()
+        return interceptor
+
+    def register_route(self, interceptor_id: int, host: str,
+                       port: int) -> None:
+        """MessageBus routing table entry (message_bus.cc Init)."""
+        self._routes[interceptor_id] = (host, port)
+
+    # --------------------------------------------------------------- routing
+    def send(self, msg: InterceptorMessage) -> None:
+        local = self._interceptors.get(msg.dst_id)
+        if local is not None:
+            local.enqueue(msg)
+            return
+        ep = self._routes.get(msg.dst_id)
+        if ep is None:
+            raise KeyError("no route to interceptor %d" % msg.dst_id)
+        with self._clients_lock:
+            cl = self._clients.get(ep)
+            if cl is None:
+                cl = FramedClient(ep[0], ep[1], plain_loads)
+                self._clients[ep] = cl
+        cl.call(msg.to_wire())
+
+    def _on_remote(self, wire: dict) -> bool:
+        msg = InterceptorMessage.from_wire(wire)
+        local = self._interceptors.get(msg.dst_id)
+        if local is None:
+            raise KeyError("carrier %d hosts no interceptor %d"
+                           % (self.id, msg.dst_id))
+        local.enqueue(msg)
+        return True
+
+    def stop(self) -> None:
+        for it in self._interceptors.values():
+            it.stop()
+        for cl in self._clients.values():
+            cl.close()
+        self._rpc.stop()
+
+
+class FleetExecutor:
+    """Top-level runner (fleet_executor.cc): builds a carrier, wires
+    interceptors, kicks the sources, waits for the sinks."""
+
+    def __init__(self, carrier: Optional[Carrier] = None):
+        self.carrier = carrier or Carrier()
+        self._done = threading.Event()
+        self.results: List[Any] = []
+        self._results_lock = threading.Lock()
+
+    def add_sink(self, interceptor_id: int,
+                 expect: int) -> Interceptor:
+        """A terminal interceptor collecting `expect` payloads."""
+        remaining = [expect]
+
+        def handler(it, msg):
+            with self._results_lock:
+                self.results.append(msg.payload)
+                remaining[0] -= 1
+                if remaining[0] <= 0:
+                    self._done.set()
+
+        return self.carrier.add_interceptor(
+            Interceptor(interceptor_id, handler))
+
+    def run(self, source_id: int, payloads: List[Any],
+            timeout: float = 60.0) -> List[Any]:
+        """Feed payloads to the source interceptor; block until the sink
+        collected everything."""
+        src = self.carrier._interceptors[source_id]
+        for p in payloads:
+            src.enqueue(InterceptorMessage(-1, source_id, "DATA", p))
+        if not self._done.wait(timeout):
+            raise TimeoutError("fleet executor run timed out")
+        return list(self.results)

@@ -7,7 +7,11 @@ from paddlebox_tpu.ops.sparse import (
 )
 from paddlebox_tpu.ops.seqpool import (
     fused_seqpool_cvm,
+    fused_seqpool_cvm_tradew,
     fused_seqpool_cvm_with_conv,
+    fused_seqpool_cvm_with_credit,
+    fused_seqpool_cvm_with_diff_thres,
+    fused_seqpool_cvm_with_pcoc,
     cvm_transform,
     cvm_conv_transform,
 )
@@ -26,7 +30,11 @@ __all__ = [
     "pull_sparse_extended",
     "build_push_grads_extended",
     "fused_seqpool_cvm",
+    "fused_seqpool_cvm_tradew",
     "fused_seqpool_cvm_with_conv",
+    "fused_seqpool_cvm_with_credit",
+    "fused_seqpool_cvm_with_diff_thres",
+    "fused_seqpool_cvm_with_pcoc",
     "cvm_transform",
     "cvm_conv_transform",
     "data_norm",

@@ -90,3 +90,96 @@ def fused_seqpool_cvm_with_conv(
         masked, segments, num_segments=batch_size * num_slots)
     pooled = pooled.reshape(batch_size, num_slots, emb.shape[-1])
     return cvm_conv_transform(pooled, use_cvm, show_filter)
+
+
+def _segpool(emb: jnp.ndarray, segments: jnp.ndarray, keep: jnp.ndarray,
+             batch_size: int, num_slots: int) -> jnp.ndarray:
+    # no indices_are_sorted hint: the packer's trailing PADDING slots carry
+    # segment 0 after larger ids, so the ids are not globally sorted (and
+    # the hint measured no win on v5e anyway)
+    masked = jnp.where(keep[:, None], emb, 0.0)
+    pooled = jax.ops.segment_sum(
+        masked, segments, num_segments=batch_size * num_slots)
+    return pooled.reshape(batch_size, num_slots, emb.shape[-1])
+
+
+def fused_seqpool_cvm_with_credit(
+        emb: jnp.ndarray, segments: jnp.ndarray, valid: jnp.ndarray,
+        batch_size: int, num_slots: int, use_cvm: bool = True,
+        show_filter: bool = False) -> jnp.ndarray:
+    """fused_seqpool_cvm_with_credit_op (with_credit_op.cu:53-110): per-key
+    cols [show, click, conv, credit, emb...]; each of the 4 counters maps to
+    log(x+1) independently (no ctr-smooth subtraction); show_filter drops
+    the show column (KernelWithOutShow); use_cvm=False drops all four."""
+    pooled = _segpool(emb, segments, valid, batch_size, num_slots)
+    if not use_cvm:
+        return pooled[..., 4:]
+    counters = jnp.log(pooled[..., :4] + 1.0)
+    if show_filter:
+        counters = counters[..., 1:]
+    return jnp.concatenate([counters, pooled[..., 4:]], axis=-1)
+
+
+def fused_seqpool_cvm_tradew(
+        emb: jnp.ndarray, segments: jnp.ndarray, valid: jnp.ndarray,
+        batch_size: int, num_slots: int, trade_num: int,
+        trade_id: int = None, use_cvm: bool = True) -> jnp.ndarray:
+    """fused_seqpool_cvm_tradew_op (tradew_op.cu:34-131): per-key cols
+    [show, click, trade_w[trade_num], emb...]. The embedding part pools
+    weighted by the selected trade's weight column (KernelWithTradeId,
+    cu:63-88); without a trade_id the trade block is simply skipped
+    (KernelNormal). CVM columns follow the standard transform."""
+    cvm_part = emb[:, :2]
+    emb_part = emb[:, 2 + trade_num:]
+    if trade_id is not None:
+        w = emb[:, 2 + trade_id:3 + trade_id]
+        emb_part = emb_part * w
+    pooled = _segpool(jnp.concatenate([cvm_part, emb_part], axis=1),
+                      segments, valid, batch_size, num_slots)
+    return cvm_transform(pooled, use_cvm)
+
+
+def fused_seqpool_cvm_with_diff_thres(
+        emb: jnp.ndarray, segments: jnp.ndarray, valid: jnp.ndarray,
+        slots: jnp.ndarray, batch_size: int, num_slots: int,
+        slot_thresholds: jnp.ndarray, use_cvm: bool = True,
+        show_coeff: float = 0.2, clk_coeff: float = 1.0,
+        xbox_diff_thres_filter: bool = True,
+        threshold: float = 0.96) -> jnp.ndarray:
+    """fused_seqpool_cvm_with_diff_thres_op (with_diff_thres_op.cu:87-131):
+    the base fused op with a PER-SLOT filter threshold vector — keys whose
+    show/click score falls under threshold_vec[slot] are dropped before
+    pooling (xbox_diff_thres_filter=False falls back to the scalar)."""
+    show, click = emb[:, 0], emb[:, 1]
+    score = (show - click) * show_coeff + click * clk_coeff
+    thres = (jnp.asarray(slot_thresholds)[slots]
+             if xbox_diff_thres_filter else threshold)
+    keep = valid & (score >= thres)
+    pooled = _segpool(emb, segments, keep, batch_size, num_slots)
+    return cvm_transform(pooled, use_cvm)
+
+
+def fused_seqpool_cvm_with_pcoc(
+        emb: jnp.ndarray, segments: jnp.ndarray, valid: jnp.ndarray,
+        batch_size: int, num_slots: int, pclk_num: int,
+        use_cvm: bool = True) -> jnp.ndarray:
+    """fused_seqpool_cvm_with_pcoc_op (with_pcoc_op.cu:122-160): per-key
+    cols [show, click, show2, clk2, pclk_1..pclk_n, emb...]; output
+    counters [log(show+1), log(click+1)-log(show+1),
+    (log(pclk_i+1)-log(show2+1))_i, (log(pclk_i+1)-log(clk2+1))_i] then
+    the embedding passthrough; use_cvm=False drops every counter col."""
+    used = 4 + pclk_num
+    pooled = _segpool(emb, segments, valid, batch_size, num_slots)
+    if not use_cvm:
+        return pooled[..., used:]
+    log1p = jnp.log(pooled[..., :used] + 1.0)
+    log_show, log_click = log1p[..., 0:1], log1p[..., 1:2]
+    log_show2, log_clk2 = log1p[..., 2:3], log1p[..., 3:4]
+    log_pclk = log1p[..., 4:used]
+    return jnp.concatenate([
+        log_show,
+        log_click - log_show,
+        log_pclk - log_show2,
+        log_pclk - log_clk2,
+        pooled[..., used:],
+    ], axis=-1)

@@ -171,35 +171,27 @@ def merge_models(batch_dirs, out_dir: str) -> str:
             raise ValueError("cannot merge models with different layouts")
 
     counter_cols = [acc.SHOW, acc.CLICK, acc.DELTA_SCORE]
-    wsum: Dict[int, np.ndarray] = {}    # show-weighted row sum
-    wtot: Dict[int, float] = {}         # total show weight
-    csum: Dict[int, np.ndarray] = {}    # exact counter sums
-    unseen: Dict[int, float] = {}
-    mfsz: Dict[int, float] = {}
-    for blob in blobs:
-        for k, row in zip(blob["keys"].tolist(), blob["values"]):
-            w = max(float(row[acc.SHOW]), 1e-6)
-            if k not in wsum:
-                wsum[k] = row * w
-                wtot[k] = w
-                csum[k] = row[counter_cols].copy()
-                unseen[k] = row[acc.UNSEEN_DAYS]
-                mfsz[k] = row[acc.MF_SIZE]
-            else:
-                wsum[k] += row * w
-                wtot[k] += w
-                csum[k] += row[counter_cols]
-                unseen[k] = min(unseen[k], row[acc.UNSEEN_DAYS])
-                mfsz[k] = max(mfsz[k], row[acc.MF_SIZE])
+    all_keys = np.concatenate([b["keys"] for b in blobs])
+    all_vals = np.concatenate([b["values"] for b in blobs]).astype(np.float64)
+    out_keys, inv = np.unique(all_keys, return_inverse=True)
+    n = out_keys.size
+    w = np.maximum(all_vals[:, acc.SHOW], 1e-6)[:, None]
+    wsum = np.zeros((n, width), np.float64)
+    np.add.at(wsum, inv, all_vals * w)
+    wtot = np.zeros((n, 1), np.float64)
+    np.add.at(wtot, inv, w)
+    out_vals = (wsum / wtot).astype(np.float32)
+    # counters sum exactly; lifecycle fields take extremes
+    csum = np.zeros((n, len(counter_cols)), np.float64)
+    np.add.at(csum, inv, all_vals[:, counter_cols])
+    out_vals[:, counter_cols] = csum
+    unseen = np.full(n, np.inf)
+    np.minimum.at(unseen, inv, all_vals[:, acc.UNSEEN_DAYS])
+    out_vals[:, acc.UNSEEN_DAYS] = unseen
+    mfsz = np.zeros(n)
+    np.maximum.at(mfsz, inv, all_vals[:, acc.MF_SIZE])
+    out_vals[:, acc.MF_SIZE] = mfsz
 
-    out_keys = np.fromiter(wsum.keys(), dtype=np.uint64, count=len(wsum))
-    out_vals = np.empty((len(wsum), width), np.float32)
-    for i, k in enumerate(wsum):
-        row = wsum[k] / wtot[k]
-        row[counter_cols] = csum[k]
-        row[acc.UNSEEN_DAYS] = unseen[k]
-        row[acc.MF_SIZE] = mfsz[k]
-        out_vals[i] = row
     os.makedirs(out_dir, exist_ok=True)
     with open(os.path.join(out_dir, "sparse.pkl"), "wb") as f:
         pickle.dump({"keys": out_keys, "values": out_vals,

@@ -1,0 +1,84 @@
+"""Trainer factory + executor facade.
+
+The reference's user entry shape (SURVEY.md §3.1): `TrainerFactory::
+CreateTrainer` (trainer_factory.cc:68-89) resolves a TrainerDesc class name
+to a trainer, and `Executor::RunFromDataset` (executor.cc:163) drives
+Initialize → Run → Finalize. Here the same surface maps onto the jitted
+trainers: the factory resolves reference trainer names (so TrainerDesc
+configs carry over) and the Executor runs pass cadences.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from paddlebox_tpu.config.configs import (DataFeedConfig, TableConfig,
+                                          TrainerConfig)
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_trainer(name: str, ctor: Callable) -> None:
+    _REGISTRY[name] = ctor
+
+
+def _builtin(name: str):
+    # lazy imports: trainers pull in jax
+    if name in ("BoxPSTrainer", "MultiTrainer", "DistMultiTrainer"):
+        from paddlebox_tpu.train.trainer import BoxTrainer
+        return BoxTrainer
+    if name in ("ShardedBoxTrainer", "PSGPUTrainer", "HeterXpuTrainer"):
+        from paddlebox_tpu.parallel.sharded_trainer import ShardedBoxTrainer
+        return ShardedBoxTrainer
+    if name in ("PipelineTrainer", "HeterPipelineTrainer"):
+        from paddlebox_tpu.parallel.pipeline import GPipeRunner
+        return GPipeRunner
+    return None
+
+
+def create_trainer(name: str, *args, **kwargs):
+    """TrainerFactory::CreateTrainer analog: reference trainer class names
+    resolve to their TPU-native equivalents (BoxPSTrainer/MultiTrainer →
+    BoxTrainer; PSGPUTrainer/ShardedBoxTrainer → the pod-sharded trainer;
+    PipelineTrainer → the GPipe runner)."""
+    ctor = _REGISTRY.get(name) or _builtin(name)
+    if ctor is None:
+        raise KeyError("unknown trainer %r (registered: %s)"
+                       % (name, sorted(_REGISTRY)))
+    return ctor(*args, **kwargs)
+
+
+class Executor:
+    """Executor facade (train_from_dataset, python executor.py:2412 →
+    Executor::RunFromDataset, executor.cc:163): drives a trainer's pass
+    cadence over a loaded/preloading dataset."""
+
+    def __init__(self) -> None:
+        self._trainers: Dict[int, Any] = {}
+
+    def init_for_dataset(self, trainer_name: str, *args, **kwargs):
+        """InitForDataset analog: build (and remember) the trainer."""
+        tr = create_trainer(trainer_name, *args, **kwargs)
+        self._trainers[id(tr)] = tr
+        return tr
+
+    def train_from_dataset(self, trainer, dataset,
+                           preloaded: bool = False,
+                           debug: bool = False) -> Dict[str, float]:
+        """One pass (RunFromDataset → trainer->Run()). debug=True prints the
+        per-stage timer report after the pass (TrainFilesWithProfiler)."""
+        stats = trainer.train_pass(dataset, preloaded=preloaded)
+        if debug:
+            from paddlebox_tpu.utils.profiler import timer_report
+            print(timer_report(trainer.timers, prefix="trainer."))
+        return stats
+
+    def infer_from_dataset(self, trainer, dataset):
+        """Test-mode pass (SetTestMode pulls)."""
+        return trainer.predict_batches(dataset)
+
+    def close(self) -> None:
+        for tr in self._trainers.values():
+            if hasattr(tr, "close"):
+                tr.close()
+        self._trainers.clear()

@@ -1,0 +1,109 @@
+"""fused_seqpool_cvm variant semantics vs a literal numpy oracle of the
+reference CUDA kernels (fused_seqpool_cvm_with_{credit,pcoc,diff_thres}_op,
+fused_seqpool_cvm_tradew_op)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from paddlebox_tpu.ops import (fused_seqpool_cvm_tradew,
+                               fused_seqpool_cvm_with_credit,
+                               fused_seqpool_cvm_with_diff_thres,
+                               fused_seqpool_cvm_with_pcoc)
+
+B, S, E = 4, 3, 2
+
+
+def _mk(width, seed=0, k_per_seg=2):
+    rng = np.random.RandomState(seed)
+    K = B * S * k_per_seg
+    segments = np.repeat(np.arange(B * S), k_per_seg).astype(np.int32)
+    emb = rng.rand(K, width).astype(np.float32) * 3
+    valid = rng.rand(K) < 0.8
+    return emb, segments, valid
+
+
+def _pool(emb, segments, valid):
+    out = np.zeros((B * S, emb.shape[1]), np.float32)
+    for e, s, v in zip(emb, segments, valid):
+        if v:
+            out[s] += e
+    return out.reshape(B, S, -1)
+
+
+def test_credit_variant():
+    emb, segments, valid = _mk(4 + E)
+    got = np.asarray(fused_seqpool_cvm_with_credit(
+        jnp.asarray(emb), jnp.asarray(segments), jnp.asarray(valid), B, S))
+    pooled = _pool(emb, segments, valid)
+    want = np.concatenate([np.log(pooled[..., :4] + 1), pooled[..., 4:]],
+                          axis=-1)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # show_filter drops col 0
+    got_f = np.asarray(fused_seqpool_cvm_with_credit(
+        jnp.asarray(emb), jnp.asarray(segments), jnp.asarray(valid), B, S,
+        show_filter=True))
+    np.testing.assert_allclose(got_f, want[..., 1:], rtol=1e-5)
+    # no cvm drops all four
+    got_n = np.asarray(fused_seqpool_cvm_with_credit(
+        jnp.asarray(emb), jnp.asarray(segments), jnp.asarray(valid), B, S,
+        use_cvm=False))
+    np.testing.assert_allclose(got_n, pooled[..., 4:], rtol=1e-5)
+
+
+def test_pcoc_variant():
+    pclk = 3
+    emb, segments, valid = _mk(4 + pclk + E, seed=1)
+    got = np.asarray(fused_seqpool_cvm_with_pcoc(
+        jnp.asarray(emb), jnp.asarray(segments), jnp.asarray(valid), B, S,
+        pclk_num=pclk))
+    pooled = _pool(emb, segments, valid)
+    lg = np.log(pooled[..., :4 + pclk] + 1)
+    want = np.concatenate([
+        lg[..., 0:1],
+        lg[..., 1:2] - lg[..., 0:1],
+        lg[..., 4:] - lg[..., 2:3],
+        lg[..., 4:] - lg[..., 3:4],
+        pooled[..., 4 + pclk:],
+    ], axis=-1)
+    assert got.shape == (B, S, 2 + 2 * pclk + E)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_tradew_variant():
+    tn = 2
+    emb, segments, valid = _mk(2 + tn + E, seed=2)
+    # weighted by trade 1's weight column
+    got = np.asarray(fused_seqpool_cvm_tradew(
+        jnp.asarray(emb), jnp.asarray(segments), jnp.asarray(valid), B, S,
+        trade_num=tn, trade_id=1))
+    w = emb[:, 2 + 1:2 + 2]
+    weighted = np.concatenate([emb[:, :2], emb[:, 2 + tn:] * w], axis=1)
+    pooled = _pool(weighted, segments, valid)
+    want = np.concatenate([
+        np.log(pooled[..., 0:1] + 1),
+        np.log(pooled[..., 1:2] + 1) - np.log(pooled[..., 0:1] + 1),
+        pooled[..., 2:],
+    ], axis=-1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_diff_thres_variant():
+    emb, segments, valid = _mk(2 + E, seed=3)
+    slots = (segments % S).astype(np.int32)
+    thres = np.array([0.5, 100.0, 0.0], np.float32)  # slot 1 filters all
+    got = np.asarray(fused_seqpool_cvm_with_diff_thres(
+        jnp.asarray(emb), jnp.asarray(segments), jnp.asarray(valid),
+        jnp.asarray(slots), B, S, slot_thresholds=thres,
+        show_coeff=0.2, clk_coeff=1.0))
+    score = (emb[:, 0] - emb[:, 1]) * 0.2 + emb[:, 1] * 1.0
+    keep = valid & (score >= thres[slots])
+    pooled = _pool(emb, segments, keep)
+    want = np.concatenate([
+        np.log(pooled[..., 0:1] + 1),
+        np.log(pooled[..., 1:2] + 1) - np.log(pooled[..., 0:1] + 1),
+        pooled[..., 2:],
+    ], axis=-1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # slot 1's pooled embedding must be all-zero (every key filtered)
+    np.testing.assert_allclose(got[:, 1, 2:], 0.0)
