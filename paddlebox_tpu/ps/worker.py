@@ -1,0 +1,255 @@
+"""CPU-PS training loop: the Downpour worker path.
+
+Analog of DownpourWorker::TrainFiles (framework/downpour_worker.cc; the
+CPU-PS counterpart of the Box loop, SURVEY.md §2.4): per batch the worker
+FillSparseValue-pulls the batch's feature rows from the PS, runs the fused
+jitted step, and pushes RAW sparse gradients back — the optimizer rule
+runs server-side (sparse_sgd_rule.cc), unlike the Box path's in-slab
+update. Dense grads go to a PS dense table through the same client.
+
+Side machinery mirrors the reference:
+  * `Communicator` — background sparse-grad aggregation + send thread
+    (distributed/ps/service/communicator/communicator.{h,cc}): pushes
+    queue up, get key-merged, and flush on a batch-count threshold.
+  * `PullDenseWorker` — background dense-param refresh
+    (framework/pull_dense_worker.cc).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.config.configs import (DataFeedConfig, TableConfig,
+                                          TrainerConfig)
+from paddlebox_tpu.data.dataset import BoxDataset
+from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
+from paddlebox_tpu.metrics.auc import MetricRegistry
+
+
+class Communicator:
+    def __init__(self, client, table_id: int, push_width: int,
+                 send_batch_threshold: int = 4,
+                 send_interval: float = 0.05) -> None:
+        self.client = client
+        self.table_id = table_id
+        self.push_width = push_width
+        self.threshold = send_batch_threshold
+        self.interval = send_interval
+        self._pending: List[Tuple[np.ndarray, np.ndarray]] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._kick = threading.Event()
+        self._thread = threading.Thread(target=self._send_loop, daemon=True)
+        self._thread.start()
+
+    def push(self, keys: np.ndarray, grads: np.ndarray) -> None:
+        with self._lock:
+            self._pending.append((keys, grads))
+            n = len(self._pending)
+        if n >= self.threshold:
+            self._kick.set()
+
+    def _drain(self) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        with self._lock:
+            if not self._pending:
+                return None
+            batch, self._pending = self._pending, []
+        keys = np.concatenate([k for k, _ in batch])
+        grads = np.concatenate([g for _, g in batch])
+        # pre-merge duplicate keys so one RPC row per key reaches the PS
+        uniq, inv = np.unique(keys, return_inverse=True)
+        merged = np.zeros((uniq.size, grads.shape[1]), np.float32)
+        np.add.at(merged, inv, grads)
+        push = PushLayout(0)  # SLOT col index is layout-independent
+        merged[inv, push.SLOT] = grads[:, push.SLOT]  # tag, not additive
+        return uniq, merged
+
+    def _send_loop(self) -> None:
+        while not self._stop.is_set():
+            self._kick.wait(self.interval)
+            self._kick.clear()
+            item = self._drain()
+            if item is not None:
+                self.client.push_sparse(self.table_id, item[0], item[1])
+
+    def flush(self) -> None:
+        item = self._drain()
+        if item is not None:
+            self.client.push_sparse(self.table_id, item[0], item[1])
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._kick.set()
+        self._thread.join()
+        self.flush()
+
+
+class PullDenseWorker:
+    def __init__(self, client, name: str, interval: float = 0.05) -> None:
+        self.client = client
+        self.name = name
+        self.interval = interval
+        self._value = client.pull_dense(name)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    @property
+    def value(self) -> np.ndarray:
+        with self._lock:
+            return self._value
+
+    def refresh(self) -> np.ndarray:
+        v = self.client.pull_dense(self.name)
+        with self._lock:
+            self._value = v
+        return v
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.refresh()
+            except (ConnectionError, OSError, RuntimeError):
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join()
+
+
+class DownpourTrainer:
+    """Per-batch PS-pull / PS-push trainer over a fused jitted step. The
+    client may be a PsLocalClient (single process) or TcpPSClient (real
+    server) — the test tier uses both, mirroring ps_local_client.h vs
+    brpc_service tests."""
+
+    DENSE_TABLE = "downpour_dense"
+    SPARSE_TABLE = 0
+
+    def __init__(self, model, table_cfg: TableConfig, feed: DataFeedConfig,
+                 client, trainer_cfg: Optional[TrainerConfig] = None,
+                 seed: int = 0, create_tables: bool = True,
+                 use_cvm: bool = True) -> None:
+        import jax
+        import jax.flatten_util
+
+        self.model = model
+        self.cfg = trainer_cfg or TrainerConfig()
+        self.feed = feed
+        self.client = client
+        self.table_cfg = table_cfg
+        self.layout = ValueLayout(
+            embedx_dim=table_cfg.embedx_dim,
+            optimizer=table_cfg.optimizer.optimizer)
+        self.push_layout = PushLayout(self.layout.embedx_dim)
+        self.metrics = MetricRegistry()
+        self.num_slots = len(feed.used_sparse_slots())
+        params0 = model.init(jax.random.PRNGKey(seed))
+        flat0, self._unravel = jax.flatten_util.ravel_pytree(params0)
+        if create_tables:
+            client.create_sparse_table(self.SPARSE_TABLE, table_cfg,
+                                       seed=seed)
+            client.create_dense_table(self.DENSE_TABLE,
+                                      size=int(flat0.size), rule="adam",
+                                      lr=self.cfg.dense_lr,
+                                      init=np.asarray(flat0))
+        self.pull_dense_worker = PullDenseWorker(client, self.DENSE_TABLE)
+        self.communicator = Communicator(client, self.SPARSE_TABLE,
+                                         self.push_layout.width)
+        self._step = self._build_step()
+        self._shuffle_rng = np.random.RandomState(seed + 1)
+        self.multi_task = len(getattr(model, "task_names", ("ctr",))) > 1
+
+    # ------------------------------------------------------------------ step
+    def _build_step(self):
+        import jax
+        import jax.flatten_util
+        import jax.numpy as jnp
+        import optax
+
+        from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
+        from paddlebox_tpu.ops.sparse import build_push_grads, pull_sparse
+
+        model = self.model
+        layout = self.layout
+        B = self.feed.batch_size
+        S = self.num_slots
+
+        @jax.jit
+        def step(slab, params, batch):
+            def loss_fn(params, emb):
+                pooled = fused_seqpool_cvm(emb, batch["segments"],
+                                           batch["valid"], B, S)
+                logits = model.apply(params, pooled, batch.get("dense"))
+                lab = batch["labels"].astype(jnp.float32)
+                bce = optax.sigmoid_binary_cross_entropy(logits, lab)
+                denom = jnp.maximum(batch["ins_valid"].sum(), 1.0)
+                loss = jnp.where(batch["ins_valid"], bce, 0.0).sum() / denom
+                return loss, jax.nn.sigmoid(logits)
+
+            emb = pull_sparse(slab, batch["ids"], layout)
+            grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1),
+                                         has_aux=True)
+            (loss, preds), (dparams, demb) = grad_fn(params, emb)
+            flat_g = jax.flatten_util.ravel_pytree(dparams)[0]
+            clicks = batch["labels"][batch["segments"] // S]
+            push_rows = build_push_grads(demb, batch["slots"], clicks,
+                                         batch["valid"])
+            return flat_g, push_rows, loss, preds
+
+        return step
+
+    # ------------------------------------------------------------- pass loop
+    def train_pass(self, dataset: BoxDataset) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        if len(dataset) == 0:
+            dataset.load_into_memory()
+        dataset.local_shuffle(self._shuffle_rng.randint(1 << 31))
+        losses = []
+        for b in dataset.split_batches(num_workers=1)[0]:
+            # FillSparseValue: batch keys → PS rows → per-batch dense slab
+            uniq, inv = np.unique(b.keys[b.valid], return_inverse=True)
+            rows = self.client.pull_sparse(self.SPARSE_TABLE, uniq)
+            slab = np.vstack([rows,
+                              np.zeros((1, self.layout.width), np.float32)])
+            ids = np.full(b.keys.shape[0], rows.shape[0], np.int64)
+            ids[b.valid] = inv
+            params = self._unravel(jnp.asarray(self.pull_dense_worker.value))
+            batch = {
+                "ids": jnp.asarray(ids),
+                "slots": jnp.asarray(b.slots),
+                "segments": jnp.asarray(b.segments),
+                "valid": jnp.asarray(b.valid),
+                "ins_valid": jnp.asarray(b.ins_valid),
+                "labels": jnp.asarray(b.labels),
+            }
+            if b.dense is not None:
+                batch["dense"] = jnp.asarray(b.dense)
+            flat_g, push_rows, loss, preds = self._step(
+                jnp.asarray(slab), params, batch)
+            push_rows = np.asarray(push_rows)
+            keys = b.keys[b.valid]
+            self.communicator.push(keys, push_rows[b.valid])
+            self.client.push_dense(self.DENSE_TABLE, np.asarray(flat_g))
+            losses.append(float(loss))
+            self._add_metrics(np.asarray(preds), b)
+        self.communicator.flush()
+        self.pull_dense_worker.refresh()
+        return {"loss": float(np.mean(losses)) if losses else 0.0,
+                "batches": len(losses), "instances": len(dataset)}
+
+    def _add_metrics(self, preds: np.ndarray, b) -> None:
+        if not self.metrics.metric_names():
+            return
+        self.metrics.add_batch({"pred": preds, "label": b.labels,
+                                "mask": b.ins_valid})
+
+    def close(self) -> None:
+        self.communicator.stop()
+        self.pull_dense_worker.stop()

@@ -1,0 +1,105 @@
+"""Downpour CPU-PS training loop (DownpourWorker::TrainFiles role) against
+both the in-process and the TCP PS (the two test mechanisms of SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config.configs import (SparseOptimizerConfig, TableConfig,
+                                          TrainerConfig)
+from paddlebox_tpu.data import BoxDataset, write_synthetic_ctr_files
+from paddlebox_tpu.models import CtrDnn
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.ps import PSServer, PsLocalClient, TcpPSClient
+from paddlebox_tpu.ps.worker import (Communicator, DownpourTrainer,
+                                     PullDenseWorker)
+
+D = 4
+
+
+def table_cfg():
+    return TableConfig(embedx_dim=D, optimizer=SparseOptimizerConfig(
+        mf_create_thresholds=0.0, mf_initial_range=1e-3,
+        feature_learning_rate=0.2, mf_learning_rate=0.2))
+
+
+@pytest.fixture(scope="module")
+def data(tmp_path_factory):
+    out = tmp_path_factory.mktemp("downpour")
+    files, feed = write_synthetic_ctr_files(
+        str(out), num_files=2, lines_per_file=300, num_slots=4,
+        vocab_per_slot=100, max_len=3, seed=31)
+    feed = type(feed)(slots=feed.slots, batch_size=32)
+    return files, feed
+
+
+def test_communicator_merges_and_flushes():
+    cl = PsLocalClient()
+    cl.create_sparse_table(0, table_cfg(), shard_num=2)
+    from paddlebox_tpu.embedding.accessor import PushLayout
+    push = PushLayout(D)
+    comm = Communicator(cl, 0, push.width, send_batch_threshold=100,
+                        send_interval=10.0)  # only explicit flush sends
+    g = np.zeros((2, push.width), np.float32)
+    g[:, push.SHOW] = 1
+    g[:, push.EMBED_G] = 0.5
+    comm.push(np.array([5, 5], np.uint64), g)
+    comm.push(np.array([5, 9], np.uint64), g)
+    comm.flush()
+    rows = cl.pull_sparse(0, np.array([5, 9], np.uint64))
+    from paddlebox_tpu.embedding import accessor as acc
+    assert rows[0, acc.SHOW] == 3.0  # three merged occurrences of key 5
+    assert rows[1, acc.SHOW] == 1.0
+    comm.stop()
+
+
+def test_pull_dense_worker_refreshes():
+    cl = PsLocalClient()
+    cl.create_dense_table("w", size=4, rule="sgd", lr=1.0)
+    pw = PullDenseWorker(cl, "w", interval=0.02)
+    assert (pw.value == 0).all()
+    cl.push_dense("w", np.ones(4, np.float32))
+    import time
+    deadline = time.time() + 5
+    while (pw.value == 0).all() and time.time() < deadline:
+        time.sleep(0.02)
+    np.testing.assert_allclose(pw.value, -1.0)
+    pw.stop()
+
+
+def test_downpour_local_client_learns(data):
+    files, feed = data
+    tr = DownpourTrainer(CtrDnn(ModelSpec(num_slots=4, slot_dim=3 + D),
+                                hidden=(16,)),
+                         table_cfg(), feed, PsLocalClient(),
+                         TrainerConfig(dense_lr=0.001))
+    tr.metrics.init_metric("auc", "label", "pred", table_size=1 << 14,
+                           mask_var="mask")
+    losses = []
+    for _ in range(8):
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        losses.append(tr.train_pass(ds)["loss"])
+    assert losses[-1] < losses[0]
+    msg = tr.metrics.get_metric_msg("auc")
+    assert msg["auc"] > 0.6, msg
+    # features were created server-side
+    assert tr.client.sparse_size(DownpourTrainer.SPARSE_TABLE) > 100
+    tr.close()
+
+
+def test_downpour_over_tcp(data):
+    files, feed = data
+    server = PSServer()
+    cl = TcpPSClient("127.0.0.1", server.port)
+    tr = DownpourTrainer(CtrDnn(ModelSpec(num_slots=4, slot_dim=3 + D),
+                                hidden=(16,)),
+                         table_cfg(), feed, cl, TrainerConfig(dense_lr=0.001))
+    losses = []
+    for _ in range(3):
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        losses.append(tr.train_pass(ds)["loss"])
+    assert losses[-1] < losses[0]
+    tr.close()
+    cl.stop_server()
+    cl.close()
