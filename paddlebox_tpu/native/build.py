@@ -10,7 +10,7 @@ from typing import Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _BUILD = os.path.join(_DIR, "_build")
-_SOURCES = ["slot_parser.cc", "host_store.cc"]
+_SOURCES = ["slot_parser.cc", "host_store.cc", "route.cc"]
 _LIB_NAME = "libpbtpu_native.so"
 
 _lock = threading.Lock()
@@ -42,10 +42,11 @@ def _build() -> str:
     return so_path
 
 
-def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+def _bind_parser(lib: ctypes.CDLL) -> ctypes.CDLL:
+    """Bind only the parser ABI — the contract user plugin .so files
+    implement (they need not export the store/router symbols)."""
     c = ctypes
     P = c.POINTER
-    # slot parser
     lib.psr_parse_file.restype = c.c_void_p
     lib.psr_parse_file.argtypes = [c.c_char_p, P(c.c_int32), P(c.c_int32),
                                    P(c.c_int32), c.c_int32, c.c_int32]
@@ -61,6 +62,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         fn.argtypes = [c.c_void_p]
     lib.psr_free.restype = None
     lib.psr_free.argtypes = [c.c_void_p]
+    return lib
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    P = c.POINTER
+    _bind_parser(lib)
     # host store
     lib.hs_create.restype = c.c_void_p
     lib.hs_create.argtypes = [c.c_int32, c.c_double]
@@ -90,13 +98,23 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.hs_arena.argtypes = [c.c_void_p]
     lib.hs_arena_rows.restype = c.c_int64
     lib.hs_arena_rows.argtypes = [c.c_void_p]
+    # batch key routing
+    lib.rt_index_create.restype = c.c_void_p
+    lib.rt_index_create.argtypes = [P(c.c_uint64), P(c.c_int64), c.c_int32]
+    lib.rt_index_destroy.restype = None
+    lib.rt_index_destroy.argtypes = [c.c_void_p]
+    lib.rt_bucketize.restype = c.c_int64
+    lib.rt_bucketize.argtypes = [c.c_void_p, P(c.c_uint64), P(c.c_uint8),
+                                 c.c_int64, c.c_int32, c.c_int32,
+                                 P(c.c_int32), P(c.c_int32), P(c.c_uint64)]
     return lib
 
 
 def load_lib(path: str) -> ctypes.CDLL:
-    """Bind a user-supplied shared object honoring the same C ABI
-    (the DLManager dlopen path for custom parser plugins)."""
-    return _bind(ctypes.CDLL(path))
+    """Bind a user-supplied shared object honoring the parser C ABI
+    (the DLManager dlopen path for custom parser plugins). Plugins only
+    implement psr_*; the internal store/router symbols are not required."""
+    return _bind_parser(ctypes.CDLL(path))
 
 
 def get_lib() -> Optional[ctypes.CDLL]:

@@ -56,8 +56,9 @@ class PSCore:
         self.dense[name] = DenseTable(size, rule, lr, init)
 
     # ---- sparse
-    def pull_sparse(self, table_id: int, keys: np.ndarray) -> np.ndarray:
-        return self.sparse[table_id].pull(keys)
+    def pull_sparse(self, table_id: int, keys: np.ndarray,
+                    create: bool = True) -> np.ndarray:
+        return self.sparse[table_id].pull(keys, create=create)
 
     def push_sparse(self, table_id: int, keys: np.ndarray,
                     grads: np.ndarray) -> None:
@@ -151,8 +152,9 @@ class TcpPSClient:
         return self._call("create_dense_table", name=name, size=size,
                           rule=rule, lr=lr, init=init)
 
-    def pull_sparse(self, table_id, keys):
-        return self._call("pull_sparse", table_id=table_id, keys=keys)
+    def pull_sparse(self, table_id, keys, create=True):
+        return self._call("pull_sparse", table_id=table_id, keys=keys,
+                          create=create)
 
     def push_sparse(self, table_id, keys, grads):
         return self._call("push_sparse", table_id=table_id, keys=keys,

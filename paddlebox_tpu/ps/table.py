@@ -47,9 +47,12 @@ class SparseTable:
         return (keys % np.uint64(self.shard_num)).astype(np.int64)
 
     # -------------------------------------------------------------- pull/push
-    def pull(self, keys: np.ndarray) -> np.ndarray:
+    def pull(self, keys: np.ndarray, create: bool = True) -> np.ndarray:
         """Full value rows for (not necessarily unique) keys — the PS-side
-        half of PullSparse (brpc_ps_server PullSparse handler)."""
+        half of PullSparse (brpc_ps_server PullSparse handler).
+        create=False is the test-mode pull (SetTestMode,
+        box_wrapper.cc:183): missing keys read as zero rows, nothing is
+        inserted server-side."""
         keys = np.asarray(keys, dtype=np.uint64)
         out = np.empty((keys.size, self.layout.width), np.float32)
         shard_of = self._route(keys)
@@ -59,7 +62,8 @@ class SparseTable:
                 continue
             uniq, inv = np.unique(keys[m], return_inverse=True)
             with self._locks[s]:
-                rows = self.shards[s].lookup_or_create(uniq)
+                rows = (self.shards[s].lookup_or_create(uniq) if create
+                        else self.shards[s].lookup(uniq))
             out[m] = rows[inv]
         return out
 

@@ -161,7 +161,7 @@ class DownpourTrainer:
         self.pull_dense_worker = PullDenseWorker(client, self.DENSE_TABLE)
         self.communicator = Communicator(client, self.SPARSE_TABLE,
                                          self.push_layout.width)
-        self._step = self._build_step()
+        self._step, self._eval_step = self._build_step()
         self._shuffle_rng = np.random.RandomState(seed + 1)
         self.multi_task = len(getattr(model, "task_names", ("ctr",))) > 1
 
@@ -202,9 +202,41 @@ class DownpourTrainer:
                                          batch["valid"])
             return flat_g, push_rows, loss, preds
 
-        return step
+        @jax.jit
+        def eval_step(slab, params, batch):
+            pooled = fused_seqpool_cvm(
+                pull_sparse(slab, batch["ids"], layout), batch["segments"],
+                batch["valid"], B, S)
+            return jax.nn.sigmoid(
+                model.apply(params, pooled, batch.get("dense")))
+
+        return step, eval_step
 
     # ------------------------------------------------------------- pass loop
+    def _prepare_batch(self, b, create: bool = True):
+        """FillSparseValue (downpour_worker.cc): batch keys → PS rows →
+        per-batch dense slab + id remap + device batch dict."""
+        import jax.numpy as jnp
+
+        uniq, inv = np.unique(b.keys[b.valid], return_inverse=True)
+        rows = self.client.pull_sparse(self.SPARSE_TABLE, uniq,
+                                       create=create)
+        slab = np.vstack([rows,
+                          np.zeros((1, self.layout.width), np.float32)])
+        ids = np.full(b.keys.shape[0], rows.shape[0], np.int64)
+        ids[b.valid] = inv
+        batch = {
+            "ids": jnp.asarray(ids),
+            "slots": jnp.asarray(b.slots),
+            "segments": jnp.asarray(b.segments),
+            "valid": jnp.asarray(b.valid),
+            "ins_valid": jnp.asarray(b.ins_valid),
+            "labels": jnp.asarray(b.labels),
+        }
+        if b.dense is not None:
+            batch["dense"] = jnp.asarray(b.dense)
+        return jnp.asarray(slab), batch
+
     def train_pass(self, dataset: BoxDataset) -> Dict[str, float]:
         import jax.numpy as jnp
 
@@ -213,26 +245,9 @@ class DownpourTrainer:
         dataset.local_shuffle(self._shuffle_rng.randint(1 << 31))
         losses = []
         for b in dataset.split_batches(num_workers=1)[0]:
-            # FillSparseValue: batch keys → PS rows → per-batch dense slab
-            uniq, inv = np.unique(b.keys[b.valid], return_inverse=True)
-            rows = self.client.pull_sparse(self.SPARSE_TABLE, uniq)
-            slab = np.vstack([rows,
-                              np.zeros((1, self.layout.width), np.float32)])
-            ids = np.full(b.keys.shape[0], rows.shape[0], np.int64)
-            ids[b.valid] = inv
+            slab, batch = self._prepare_batch(b)
             params = self._unravel(jnp.asarray(self.pull_dense_worker.value))
-            batch = {
-                "ids": jnp.asarray(ids),
-                "slots": jnp.asarray(b.slots),
-                "segments": jnp.asarray(b.segments),
-                "valid": jnp.asarray(b.valid),
-                "ins_valid": jnp.asarray(b.ins_valid),
-                "labels": jnp.asarray(b.labels),
-            }
-            if b.dense is not None:
-                batch["dense"] = jnp.asarray(b.dense)
-            flat_g, push_rows, loss, preds = self._step(
-                jnp.asarray(slab), params, batch)
+            flat_g, push_rows, loss, preds = self._step(slab, params, batch)
             push_rows = np.asarray(push_rows)
             keys = b.keys[b.valid]
             self.communicator.push(keys, push_rows[b.valid])
@@ -249,6 +264,26 @@ class DownpourTrainer:
             return
         self.metrics.add_batch({"pred": preds, "label": b.labels,
                                 "mask": b.ins_valid})
+
+    def predict_pass(self, dataset: BoxDataset):
+        """Test-mode inference (SetTestMode pulls, box_wrapper.cc:183):
+        forward-only jitted step, create=False pulls (missing keys read as
+        zero rows, nothing inserted server-side), no sparse/dense push.
+        Returns (preds, labels) over valid instances."""
+        import jax.numpy as jnp
+
+        if len(dataset) == 0:
+            dataset.load_into_memory()
+        preds_all, labels_all = [], []
+        params = self._unravel(jnp.asarray(self.pull_dense_worker.refresh()))
+        for b in dataset.split_batches(num_workers=1)[0]:
+            slab, batch = self._prepare_batch(b, create=False)
+            preds = np.asarray(self._eval_step(slab, params, batch))
+            preds_all.append(preds[b.ins_valid])
+            labels_all.append(b.labels[b.ins_valid])
+        if not preds_all:
+            return np.empty(0, np.float32), np.empty(0, np.int32)
+        return np.concatenate(preds_all), np.concatenate(labels_all)
 
     def close(self) -> None:
         self.communicator.stop()
