@@ -93,7 +93,8 @@ define_flag("dataset_shuffle_thread_num", 10,
 define_flag("dataset_merge_thread_num", 10,
             "threads merging shuffled instances + registering pass keys")
 define_flag("dataset_disable_shuffle", False,
-            "skip the cross-host instance shuffle stage")
+            "disable BOTH the cross-host instance shuffle stage and local "
+            "in-memory shuffling (deterministic load-order passes)")
 define_flag("dataset_disable_polling", False,
             "disable file polling in dataset readers")
 define_flag("auc_runner_mode", False,

@@ -293,6 +293,10 @@ class BoxDataset:
 
     # -------------------------------------------------------------- train prep
     def local_shuffle(self, seed: Optional[int] = None) -> None:
+        if flags.get_flag("dataset_disable_shuffle"):
+            # FLAGS_padbox_dataset_disable_shuffle (flags.cc:969): keep load
+            # order — deterministic runs / cross-process parity tests
+            return
         rng = np.random.RandomState(seed)
         if self._load_columnar:
             if self._block is not None and self._block.n_recs:

@@ -141,6 +141,76 @@ class Fleet:
         return lambda n: int(self.all_reduce(
             np.asarray([n], np.int64), "max")[0])
 
+    def _my_host(self) -> str:
+        """This rank's address as peers should dial it: PBTPU_HOST wins;
+        otherwise loopback for single-machine clusters (store on
+        127.0.0.1), else the hostname — never loopback across machines."""
+        import os
+        import socket
+        host = os.environ.get("PBTPU_HOST")
+        if host:
+            return host
+        store_host = (self.role.store_addr()[0]
+                      if self.role.store_endpoint else "127.0.0.1")
+        if store_host in ("127.0.0.1", "localhost", "::1"):
+            return "127.0.0.1"
+        return socket.gethostname()
+
+    def init_distributed(self, timeout: float = 120.0) -> None:
+        """Join the multi-process XLA runtime with store-based coordinator
+        rendezvous: rank 0 binds a free port itself and publishes the
+        address, so there is no pick-then-rebind race. Call after init().
+        Falls back to the PBTPU_COORDINATOR env when set."""
+        import os
+
+        from paddlebox_tpu.parallel.mesh import init_distributed
+
+        if self.role.world <= 1:
+            return
+        if os.environ.get("PBTPU_COORDINATOR"):
+            init_distributed(world=self.role.world, rank=self.role.rank)
+            return
+        key = "%s/jax_coordinator" % self._run_id
+        if self.role.rank == 0:
+            import socket
+            with socket.socket() as s:
+                s.bind((  # held only within this process: no cross-proc race
+                    "0.0.0.0", 0))
+                port = s.getsockname()[1]
+            coord = "%s:%d" % (self._my_host(), port)
+            self._client.set(key, coord.encode())
+        else:
+            coord = self._client.wait(key, timeout).decode()
+        init_distributed(coordinator=coord, world=self.role.world,
+                         rank=self.role.rank)
+
+    # ------------------------------------------------------------ transports
+    def make_shuffler(self, batch_records: int = 512, host: str = None,
+                      timeout: float = 120.0):
+        """Build this rank's TcpShuffler and rendezvous everyone's
+        (host, port) endpoints through the KV store (the PaddleShuffler
+        bring-up; endpoints replace the closed transport's MPI discovery).
+        Returns None in single-rank jobs."""
+        import os
+
+        from paddlebox_tpu.data.shuffle import TcpShuffler
+
+        if self.role.world <= 1:
+            return None
+        host = host or self._my_host()
+        sh = TcpShuffler(self.role.rank, self.role.world,
+                         [(host, 0)] * self.role.world,
+                         batch_records=batch_records)
+        ep_bytes = ("%s:%d" % (host, sh.port)).encode().ljust(64)
+        eps = self.all_gather(np.frombuffer(ep_bytes, np.uint8), timeout)
+        endpoints = []
+        for e in eps:
+            txt = bytes(e).rstrip(b" \x00").decode()
+            h, p = txt.rsplit(":", 1)
+            endpoints.append((h, int(p)))
+        sh.endpoints = endpoints
+        return sh
+
     # ------------------------------------------------------------- lifecycle
     def stop(self) -> None:
         if self._client is not None:

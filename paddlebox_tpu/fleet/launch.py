@@ -28,6 +28,9 @@ def launch(nproc: int, cmd: List[str], env_extra=None) -> int:
     try:
         for rank in range(nproc):
             env = dict(os.environ)
+            # no PBTPU_COORDINATOR: workers rendezvous the jax.distributed
+            # coordinator through the KV store (fleet.init_distributed),
+            # avoiding a pick-then-rebind port race in the launcher
             env.update({
                 "PBTPU_TRAINER_ID": str(rank),
                 "PBTPU_TRAINERS_NUM": str(nproc),

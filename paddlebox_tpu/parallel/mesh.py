@@ -12,6 +12,7 @@ pipeline) meshes for the wider parallelism surface (§2.8).
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -22,6 +23,44 @@ from paddlebox_tpu.config.configs import MeshConfig
 
 # the 1D axis that is both data- and table-shard-parallel, like BoxPS
 BOX_AXIS = "dp"
+
+_distributed_initialized = False
+
+
+def init_distributed(coordinator: Optional[str] = None,
+                     world: Optional[int] = None,
+                     rank: Optional[int] = None) -> None:
+    """Join the multi-process XLA runtime (jax.distributed.initialize).
+
+    The TPU-native replacement for the reference's MPI world bring-up
+    (boxps::MPICluster::Ins(), box_wrapper.h:433-436) + NCCL comm init
+    (nccl_wrapper.h:61-95): after this, jax.devices() spans every process
+    and one global Mesh carries the pod collectives over ICI/DCN.
+
+    Args default from the launcher env (fleet/launch.py): PBTPU_COORDINATOR,
+    PBTPU_TRAINERS_NUM, PBTPU_TRAINER_ID. No-op when world is 1 or when
+    already initialized.
+    """
+    global _distributed_initialized
+    if _distributed_initialized:
+        return
+    coordinator = coordinator or os.environ.get("PBTPU_COORDINATOR")
+    world = world if world is not None else int(
+        os.environ.get("PBTPU_TRAINERS_NUM", "1"))
+    rank = rank if rank is not None else int(
+        os.environ.get("PBTPU_TRAINER_ID", "0"))
+    if world <= 1:
+        return
+    if not coordinator:
+        # silently proceeding would leave N processes training
+        # independently (wrong results, no diagnostics)
+        raise RuntimeError(
+            "PBTPU_TRAINERS_NUM=%d but no coordinator address: set "
+            "PBTPU_COORDINATOR=host:port or use fleet.init_distributed() "
+            "for store-based rendezvous" % world)
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=world, process_id=rank)
+    _distributed_initialized = True
 
 
 def device_mesh_1d(n_devices: Optional[int] = None,

@@ -70,7 +70,13 @@ class ShardedPassTable:
     """
 
     def __init__(self, table: TableConfig, num_shards: int,
-                 bucket_cap: int, seed: int = 0) -> None:
+                 bucket_cap: int, seed: int = 0,
+                 owned_shards: Optional[List[int]] = None) -> None:
+        """owned_shards: in a multi-process job each process hosts the full
+        store only for the shards whose mesh device it owns (the reference's
+        per-node PS shard layout); None = own all (single process). Routing
+        state (_shard_keys) is always GLOBAL — any batch may reference any
+        shard."""
         self.config = table
         self.layout = ValueLayout(table.embedx_dim, table.optimizer.optimizer)
         self.push_layout = PushLayout(table.embedx_dim)
@@ -79,7 +85,11 @@ class ShardedPassTable:
         if table.pass_capacity % num_shards:
             raise ValueError("pass_capacity must divide evenly into shards")
         self.shard_cap = table.pass_capacity // num_shards
+        self.owned_shards = (list(owned_shards) if owned_shards is not None
+                             else list(range(num_shards)))
+        owned = set(self.owned_shards)
         self.stores = [make_host_store(self.layout, table, seed + s)
+                       if s in owned else None
                        for s in range(num_shards)]
         self._feed_keys: List[np.ndarray] = []
         self._shard_keys: Optional[List[np.ndarray]] = None  # sorted unique per shard
@@ -112,11 +122,22 @@ class ShardedPassTable:
             raise RuntimeError("add_keys outside feed pass")
         self._feed_keys.append(np.asarray(keys, dtype=np.uint64))
 
-    def end_feed_pass(self) -> None:
+    def end_feed_pass(self, allgather=None) -> None:
+        """allgather: optional host collective (fleet.all_gather) used to
+        union the pass key set across processes — each process feeds its own
+        data files but every process must agree on the global per-shard key
+        lists (the role the shared PS plays in the reference's feed pass,
+        box_wrapper.h:1201-1278)."""
         if not self._in_feed_pass:
             raise RuntimeError("end_feed_pass without begin_feed_pass")
-        allk = (np.unique(np.concatenate(self._feed_keys))
-                if self._feed_keys else np.empty(0, np.uint64))
+        local = (np.unique(np.concatenate(self._feed_keys))
+                 if self._feed_keys else np.empty(0, np.uint64))
+        if allgather is not None:
+            parts = allgather(local)
+            allk = np.unique(np.concatenate(
+                [np.asarray(p, np.uint64) for p in parts]))
+        else:
+            allk = local
         P = np.uint64(self.num_shards)
         self._shard_keys = []
         for s in range(self.num_shards):
@@ -146,27 +167,49 @@ class ShardedPassTable:
         self._feed_keys = []
         self._in_feed_pass = False
 
+    def _build_one(self, s: int) -> np.ndarray:
+        C, W = self.shard_cap, self.layout.width
+        slab = np.zeros((C, W), dtype=np.float32)
+        ks = self._shard_keys[s]
+        if ks.size:
+            rows = (self.stores[s].lookup(ks) if self._test_mode
+                    else self.stores[s].lookup_or_create(ks))
+            slab[:ks.size] = rows
+        return slab
+
     def build_slabs(self) -> np.ndarray:
         """BeginPass: promote all shards' working sets → [P, C, W] host array
-        (caller device_puts it with the mesh sharding)."""
+        (caller device_puts it with the mesh sharding). Single-process only
+        — multi-process callers use build_owned_slabs."""
         if self._shard_keys is None:
             raise RuntimeError("build_slabs before feed pass completed")
-        P, C, W = self.num_shards, self.shard_cap, self.layout.width
-        slabs = np.zeros((P, C, W), dtype=np.float32)
-        for s, ks in enumerate(self._shard_keys):
-            if ks.size:
-                rows = (self.stores[s].lookup(ks) if self._test_mode
-                        else self.stores[s].lookup_or_create(ks))
-                slabs[s, :ks.size] = rows
-        return slabs
+        return np.stack([self._build_one(s) for s in range(self.num_shards)])
+
+    def build_owned_slabs(self) -> np.ndarray:
+        """[len(owned), C, W] for this process's shards, in owned order —
+        the process-local piece of the global [P, C, W] array
+        (jax.make_array_from_process_local_data)."""
+        if self._shard_keys is None:
+            raise RuntimeError("build_owned_slabs before feed pass completed")
+        return np.stack([self._build_one(s) for s in self.owned_shards])
 
     def write_back(self, slabs: np.ndarray) -> None:
-        """EndPass: [P, C, W] host array → shard stores."""
+        """EndPass: [P, C, W] host array → shard stores (single process)."""
         if self._test_mode:
             return
         for s, ks in enumerate(self._shard_keys or []):
-            if ks.size:
+            if ks.size and self.stores[s] is not None:
                 self.stores[s].write_back(ks, slabs[s, :ks.size])
+
+    def write_back_shard(self, s: int, slab: np.ndarray) -> None:
+        """EndPass for ONE owned shard: [C, W] device-fetched slab → store
+        (multi-process path: each process writes only its addressable
+        shards)."""
+        if self._test_mode:
+            return
+        ks = self._shard_keys[s]
+        if ks.size:
+            self.stores[s].write_back(ks, slab[:ks.size])
 
     def set_test_mode(self, test: bool) -> None:
         self._test_mode = test
@@ -270,12 +313,14 @@ class ShardedPassTable:
 
     # ------------------------------------------------------------ lifecycle
     def shrink_table(self) -> int:
-        return sum(st.shrink() for st in self.stores)
+        return sum(st.shrink() for st in self.stores if st is not None)
 
     def save(self, path_prefix: str) -> None:
         for s, st in enumerate(self.stores):
-            st.save(f"{path_prefix}.shard{s:03d}")
+            if st is not None:
+                st.save(f"{path_prefix}.shard{s:03d}")
 
     def load(self, path_prefix: str) -> None:
         for s, st in enumerate(self.stores):
-            st.load(f"{path_prefix}.shard{s:03d}")
+            if st is not None:
+                st.load(f"{path_prefix}.shard{s:03d}")

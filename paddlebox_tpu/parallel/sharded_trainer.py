@@ -50,18 +50,37 @@ class ShardedBoxTrainer:
     def __init__(self, model, table_cfg: TableConfig, feed: DataFeedConfig,
                  trainer_cfg: Optional[TrainerConfig] = None,
                  mesh: Optional[Mesh] = None, bucket_cap: Optional[int] = None,
-                 seed: int = 0, use_cvm: bool = True) -> None:
+                 seed: int = 0, use_cvm: bool = True, fleet=None) -> None:
+        """fleet: the host-collective facade (fleet.fleet) — REQUIRED in a
+        multi-process job (jax.process_count() > 1): it unions feed-pass
+        keys, equalizes batch counts across hosts (data_set.cc:2690-2755)
+        and reduces metrics. Single process ignores it except for metric
+        reduction."""
         self.model = model
         self.cfg = trainer_cfg or TrainerConfig()
         self.feed = feed
         self.mesh = mesh or device_mesh_1d()
         self.P = self.mesh.devices.size
         self.axis = self.mesh.axis_names[0]
+        self.fleet = fleet
+        # multi-process topology: this process owns the mesh positions whose
+        # device it hosts (per-node PS shard layout, box_wrapper.h:433-436)
+        self.multiprocess = jax.process_count() > 1
+        mesh_devs = list(self.mesh.devices.flat)
+        pid = jax.process_index()
+        self.local_positions = [i for i, d in enumerate(mesh_devs)
+                                if d.process_index == pid]
+        self.n_local = len(self.local_positions)
+        if self.multiprocess and fleet is None:
+            raise ValueError("multi-process ShardedBoxTrainer needs fleet=")
+        if self.multiprocess and not self.n_local:
+            raise ValueError("mesh has no devices for this process")
         kcap = feed.key_capacity()
         # bucket slack over the uniform K/P expectation (hash imbalance)
         self.bucket_cap = bucket_cap or max(16, (2 * kcap) // self.P)
-        self.table = ShardedPassTable(table_cfg, self.P, self.bucket_cap,
-                                      seed=seed)
+        self.table = ShardedPassTable(
+            table_cfg, self.P, self.bucket_cap, seed=seed,
+            owned_shards=self.local_positions if self.multiprocess else None)
         self.metrics = MetricRegistry()
         self.dense_opt = make_dense_optimizer(self.cfg)
         rng = jax.random.PRNGKey(seed)
@@ -116,9 +135,13 @@ class ShardedBoxTrainer:
                             else None)
         self._steps_since_sync = 0
         # megastep: scan a chunk of steps inside one dispatch (k_step mode
-        # keeps per-step dispatch so the host can interleave param syncs)
+        # keeps per-step dispatch so the host can interleave param syncs;
+        # multi-process keeps per-step dispatch so metrics read only
+        # addressable shards)
         from paddlebox_tpu.train.trainer import make_scan
-        self._scan_steps = make_scan(self._step) if self.k_step == 1 else None
+        self._scan_steps = (make_scan(self._step)
+                            if self.k_step == 1 and not self.multiprocess
+                            else None)
 
     # ------------------------------------------------------------ jit step
     def _build_step(self):
@@ -292,16 +315,28 @@ class ShardedBoxTrainer:
             out_specs=(spec_sh, spec_sh), check_vma=False))
 
     # -------------------------------------------------------------- batches
+    def _put_sharded(self, host_local: np.ndarray, sharding) -> jax.Array:
+        """Local [L, ...] rows → global [P, ...] array on the mesh axis.
+        Single process: L == P and this is a plain device_put."""
+        if not self.multiprocess:
+            return jax.device_put(host_local, sharding)
+        global_shape = (self.P,) + host_local.shape[1:]
+        return jax.make_array_from_process_local_data(
+            sharding, host_local, global_shape)
+
     def shard_batches(self, per_worker: List[List[PackedBatch]]
                       ) -> List[Dict[str, jax.Array]]:
-        """Stack each step's P per-worker batches into [P, ...] device
-        arrays with the mesh sharding + the table routing index."""
+        """Stack each step's local per-worker batches into [P, ...] global
+        device arrays with the mesh sharding + the table routing index.
+        per_worker has P lists in single process, n_local in multi-process
+        (each process feeds the rows of its own mesh positions)."""
         steps = []
         n_steps = len(per_worker[0])
+        n_workers = len(per_worker)
         sharding = NamedSharding(self.mesh, P(self.axis))
         for i in range(n_steps):
             stacked: Dict[str, List[np.ndarray]] = {}
-            for w in range(self.P):
+            for w in range(n_workers):
                 b = per_worker[w][i]
                 valid = b.valid.copy()
                 idx = self.table.bucketize(b.keys, valid)
@@ -319,7 +354,7 @@ class ShardedBoxTrainer:
                         leaves["labels_" + t] = b.labels
                 for k, v in leaves.items():
                     stacked.setdefault(k, []).append(v)
-            dev = {k: jax.device_put(np.stack(v), sharding)
+            dev = {k: self._put_sharded(np.stack(v), sharding)
                    for k, v in stacked.items()}
             steps.append(dev)
         return steps
@@ -329,16 +364,22 @@ class ShardedBoxTrainer:
                    preloaded: bool = False) -> Dict[str, float]:
         t_pass = self.timers["pass"]
         t_pass.start()
+        allgather = (self.fleet.all_gather if self.multiprocess else None)
         if not preloaded:
             self.table.begin_feed_pass()
             dataset.load_into_memory(add_keys_fn=self.table.add_keys)
-            self.table.end_feed_pass()
+            self.table.end_feed_pass(allgather=allgather)
         self.timers["build"].start()
         sharding = NamedSharding(self.mesh, P(self.axis))
-        self._slabs = jax.device_put(self.table.build_slabs(), sharding)
+        self._slabs = self._put_sharded(
+            self.table.build_owned_slabs() if self.multiprocess
+            else self.table.build_slabs(), sharding)
         self.timers["build"].pause()
         dataset.local_shuffle(self._shuffle_rng.randint(1 << 31))
-        per_worker = dataset.split_batches(num_workers=self.P)
+        per_worker = dataset.split_batches(
+            num_workers=self.n_local if self.multiprocess else self.P,
+            equalize=(self.fleet.equalize_batches()
+                      if self.multiprocess else None))
         losses = []
         raw_steps = list(zip(*per_worker)) if per_worker[0] else []
         dev_batches = self.shard_batches(per_worker)
@@ -383,7 +424,16 @@ class ShardedBoxTrainer:
             self.params, self.opt_state = self._param_sync(
                 self.params, self.opt_state)
             self._steps_since_sync = 0
-        self.table.write_back(np.asarray(self._slabs))
+        if self.multiprocess:
+            # each process dumps only its addressable shards (EndPass
+            # HBM→host per node, ps_gpu_wrapper.cc:983+)
+            for sh in self._slabs.addressable_shards:
+                pos = sh.index[0]
+                s = pos.start if isinstance(pos, slice) else int(pos)
+                self.table.write_back_shard(int(s or 0),
+                                            np.asarray(sh.data)[0])
+        else:
+            self.table.write_back(np.asarray(self._slabs))
         self._slabs = None
         t_pass.pause()
         return {"loss": float(np.mean(losses)) if losses else 0.0,
@@ -396,15 +446,33 @@ class ShardedBoxTrainer:
             return jax.tree.map(lambda x: np.asarray(x).mean(0), self.params)
         return self.params
 
+    def _local_rows(self, arr: jax.Array) -> np.ndarray:
+        """Host copy of this process's piece of a mesh-sharded output
+        (shard_map out_specs P(axis) concatenates per-device values on axis
+        0, so preds are globally [P*B]), local shards in ascending global
+        offset = local-worker order. Single process: the whole array."""
+        if not self.multiprocess:
+            return np.asarray(arr)
+        shards = []
+        for sh in arr.addressable_shards:
+            pos = sh.index[0] if sh.index else slice(0, None)
+            start = (pos.start or 0) if isinstance(pos, slice) else int(pos)
+            shards.append((start, np.asarray(sh.data)))
+        shards.sort(key=lambda t: t[0])
+        return np.concatenate([d for _, d in shards], axis=0)
+
     def _add_metrics(self, preds, step_batches: Tuple[PackedBatch, ...]) -> None:
+        """Streams this process's rows only; cross-process reduction happens
+        in get_metric_msg via the fleet allreduce hook (the reference's
+        box MPI allreduce in Metric::calculate)."""
         if not self.metrics.metric_names():
             return
         main = list(preds)[0]
-        arr = np.asarray(preds[main])       # [P, B] (sharded out spec)
+        arr = self._local_rows(preds[main])   # [n_local, B]
         labels = np.stack([b.labels for b in step_batches])
         mask = np.stack([b.ins_valid for b in step_batches])
         tensors = {"pred": arr.reshape(-1), "label": labels.reshape(-1),
                    "mask": mask.reshape(-1)}
         for t, p in preds.items():
-            tensors["pred_" + t] = np.asarray(p).reshape(-1)
+            tensors["pred_" + t] = self._local_rows(p).reshape(-1)
         self.metrics.add_batch(tensors)

@@ -1,0 +1,118 @@
+"""Worker script for the 2-process localhost cluster test (the subprocess
+cluster pattern of the reference's test_dist_base.py:896-1012).
+
+Each process: 4 virtual CPU devices → 8-device global mesh via
+jax.distributed; loads its own half of the files; trains the sharded
+trainer with cross-process feed-key union, equalized batch counts, and
+metric allreduce. Prints ONE json line of results for the parent to check
+against the single-process oracle.
+
+Run via tests/test_multihost.py, never directly by pytest.
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4").strip()
+os.environ["PBTPU_DATASET_DISABLE_SHUFFLE"] = "1"  # strict parity
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
+                                              TableConfig, TrainerConfig)
+    from paddlebox_tpu.data import BoxDataset
+    from paddlebox_tpu.data.generator import default_feed_config
+    from paddlebox_tpu.fleet.fleet import fleet
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.models.base import ModelSpec
+    from paddlebox_tpu.parallel.mesh import device_mesh_1d
+    from paddlebox_tpu.parallel.sharded_trainer import ShardedBoxTrainer
+
+    cfg = json.loads(sys.argv[1])
+    fleet.init()
+    fleet.init_distributed()   # store-based coordinator rendezvous
+    rank, world = fleet.worker_index(), fleet.worker_num()
+    assert jax.process_count() == world, (jax.process_count(), world)
+    assert len(jax.devices()) == 8, jax.devices()
+
+    files = cfg["files"][rank * 4:(rank + 1) * 4]
+    D = cfg["embedx_dim"]
+    feed = default_feed_config(num_slots=cfg["num_slots"],
+                               batch_size=cfg["batch_size"],
+                               max_len=cfg["max_len"])
+    table_cfg = TableConfig(
+        embedx_dim=D, pass_capacity=8 * 1024,
+        optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                        mf_initial_range=1e-3,
+                                        feature_learning_rate=0.1,
+                                        mf_learning_rate=0.1))
+    trainer = ShardedBoxTrainer(
+        CtrDnn(ModelSpec(num_slots=cfg["num_slots"], slot_dim=3 + D),
+               hidden=(32, 16)),
+        table_cfg, feed, TrainerConfig(dense_lr=0.01),
+        mesh=device_mesh_1d(8), seed=0, fleet=fleet)
+    trainer.metrics.init_metric("auc", "label", "pred",
+                                table_size=1 << 14, mask_var="mask")
+
+    losses = []
+    for _ in range(cfg["passes"]):
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        stats = trainer.train_pass(ds)
+        losses.append(stats["loss"])
+        ds.release_memory()
+
+    msg = trainer.metrics.get_metric_msg(
+        "auc", allreduce=fleet.metric_allreduce())
+
+    # sample rows from OWNED stores for the parity check
+    rows = {}
+    for s in trainer.local_positions:
+        st = trainer.table.stores[s]
+        keys, vals = st.state_items()
+        order = np.argsort(keys)
+        take = order[:3]
+        for k, v in zip(keys[take], vals[take]):
+            rows[str(int(k))] = [round(float(x), 6) for x in v]
+
+    # ---- cross-host instance shuffle phase (ShuffleData/PaddleShuffler):
+    # re-enable shuffle, route the load through the TcpShuffler, train one
+    # more pass; instance totals must be conserved across the cluster
+    from paddlebox_tpu.config import flags as pbx_flags
+    pbx_flags.set_flag("dataset_disable_shuffle", False)
+    shuffler = fleet.make_shuffler(batch_records=64)
+    ds = BoxDataset(feed, read_threads=1, shuffler=shuffler)
+    ds.set_filelist(files)
+    shuffled_stats = trainer.train_pass(ds)
+    local_after_shuffle = len(ds)
+    total_after_shuffle = int(fleet.all_reduce(
+        np.asarray([local_after_shuffle], np.int64), "sum")[0])
+    shuffled_loss = shuffled_stats["loss"]
+    ds.release_memory()
+    if shuffler is not None:
+        shuffler.close()
+    pbx_flags.set_flag("dataset_disable_shuffle", True)
+
+    print("RESULT " + json.dumps({
+        "rank": rank, "losses": losses, "auc": msg["auc"],
+        "size": msg["size"], "rows": rows,
+        "local_after_shuffle": local_after_shuffle,
+        "total_after_shuffle": total_after_shuffle,
+        "shuffled_loss": shuffled_loss,
+    }), flush=True)
+    fleet.stop()
+
+
+if __name__ == "__main__":
+    main()
