@@ -109,6 +109,30 @@ class HostEmbeddingStore:
                                dtype=np.int64, count=keys.size)
             self._values[rows] = values
 
+    def assign(self, keys: np.ndarray, values: np.ndarray) -> None:
+        """Create-or-overwrite rows verbatim — the EndPass dump target for
+        unique keys: no value copy-out and no init rng draws for rows that
+        are about to be overwritten anyway."""
+        keys = np.asarray(keys, dtype=np.uint64)
+        with self._lock:
+            rows = np.empty(keys.size, dtype=np.int64)
+            missing: List[int] = []
+            for i, k in enumerate(keys.tolist()):
+                r = self._index.get(k, -1)
+                if r < 0:
+                    # a stale spill entry must not resurrect over the
+                    # assigned value
+                    self._spilled.pop(k, None)
+                    missing.append(i)
+                rows[i] = r
+            if missing:
+                self._grow(len(missing))
+                for i in missing:
+                    r = self._free.pop()
+                    self._index[int(keys[i])] = r
+                    rows[i] = r
+            self._values[rows] = values
+
     def lookup(self, keys: np.ndarray) -> np.ndarray:
         """Inference-mode fetch: missing keys read as zero rows (SetTestMode
         pulls don't create features)."""

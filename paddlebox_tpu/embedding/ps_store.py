@@ -1,0 +1,112 @@
+"""PS-backed shard store: the GPUPS pass-build composition.
+
+The round-1 sharded trainer only read LOCAL per-shard stores; this adapter
+puts the FULL distributed CPU PS behind the same store interface, giving
+the reference's open GPUPS path (PSGPUWrapper, ps_gpu_wrapper.cc):
+
+  feed-pass keys → bulk fetch from the PS over RPC (BuildPull, cc:337)
+  → per-pass device slab (BuildGPUTask, cc:684)
+  → train on device (in-slab optimizer)
+  → EndPass dumps slab rows back to the PS (cc:983+, dump_to_cpu)
+
+One PSBackedStore fronts ONE table shard (key ≡ shard_id mod P); the PS
+itself may live in-process (PsLocalClient) or behind PSServer over TCP —
+both are exercised by tests/test_ps_build.py. Fetches are chunked so a
+1T-param pass never materializes one giant RPC (the chunk_size discipline
+of heter_comm build_ps, heter_comm_inl.h:597).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.config.configs import TableConfig
+from paddlebox_tpu.embedding.accessor import ValueLayout
+from paddlebox_tpu.utils.stats import stat_add
+
+
+class PSBackedStore:
+    """Store interface (lookup_or_create / lookup / write_back) over a
+    PSClient sparse table — the BuildPull/EndPass RPC path."""
+
+    def __init__(self, client, table_id: int, layout: ValueLayout,
+                 table: TableConfig, chunk_keys: int = 1 << 18,
+                 primary: bool = True) -> None:
+        """primary: exactly ONE of the P shard stores fronting the same
+        table_id must be primary — table-wide operations (shrink, len)
+        would otherwise hit the server once per shard (P× decay)."""
+        self.client = client
+        self.table_id = table_id
+        self.layout = layout
+        self.table = table
+        self.chunk_keys = chunk_keys
+        self.primary = primary
+
+    def _pull(self, keys: np.ndarray, create: bool) -> np.ndarray:
+        out = np.empty((keys.size, self.layout.width), np.float32)
+        for lo in range(0, keys.size, self.chunk_keys):
+            chunk = keys[lo:lo + self.chunk_keys]
+            out[lo:lo + chunk.size] = self.client.pull_sparse(
+                self.table_id, chunk, create=create)
+        stat_add("ps_build_keys_pulled", int(keys.size))
+        return out
+
+    def lookup_or_create(self, keys: np.ndarray) -> np.ndarray:
+        """BuildPull: bulk fetch the pass working set (creating missing
+        features server-side, like FleetWrapper::PullSparseVarsSync)."""
+        return self._pull(np.asarray(keys, np.uint64), create=True)
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        """Test-mode fetch: missing keys read as zero rows."""
+        return self._pull(np.asarray(keys, np.uint64), create=False)
+
+    def write_back(self, keys: np.ndarray, values: np.ndarray) -> None:
+        """EndPass dump: slab rows → PS, verbatim (optimizer already ran
+        in-slab on device)."""
+        keys = np.asarray(keys, np.uint64)
+        for lo in range(0, keys.size, self.chunk_keys):
+            chunk = keys[lo:lo + self.chunk_keys]
+            self.client.assign_sparse(self.table_id, chunk,
+                                      values[lo:lo + chunk.size])
+        stat_add("ps_build_keys_dumped", int(keys.size))
+
+    # ---- store protocol odds and ends (delegated / not locally meaningful)
+    def __len__(self) -> int:
+        # table-wide count, reported by the primary shard only so
+        # sum(len(st) for st in stores) stays correct
+        return self.client.sparse_size(self.table_id) if self.primary else 0
+
+    def shrink(self) -> int:
+        # one decay per shrink_table() call, not P (show/click decay is
+        # multiplicative — repeating it over-decays and over-deletes)
+        return self.client.shrink(self.table_id) if self.primary else 0
+
+    def state_items(self) -> Tuple[np.ndarray, np.ndarray]:
+        raise NotImplementedError(
+            "PS-backed shards checkpoint server-side: PSClient.save()")
+
+    def save(self, path: str) -> None:
+        raise NotImplementedError(
+            "PS-backed shards checkpoint server-side: PSClient.save()")
+
+    def load(self, path: str) -> None:
+        raise NotImplementedError(
+            "PS-backed shards checkpoint server-side: PSClient.load()")
+
+
+def ps_store_factory(client, table_id: int):
+    """ShardedPassTable store_factory: every shard fronts the same PS table
+    (the PS routes keys internally; shard s only ever asks for keys ≡ s
+    mod P, so the two shardings never conflict). The first store created
+    is the table's primary for table-wide ops."""
+    state = {"made_primary": False}
+
+    def factory(layout: ValueLayout, table: TableConfig, seed: int):
+        primary = not state["made_primary"]
+        state["made_primary"] = True
+        return PSBackedStore(client, table_id, layout, table,
+                             primary=primary)
+
+    return factory

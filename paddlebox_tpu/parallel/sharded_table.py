@@ -71,12 +71,18 @@ class ShardedPassTable:
 
     def __init__(self, table: TableConfig, num_shards: int,
                  bucket_cap: int, seed: int = 0,
-                 owned_shards: Optional[List[int]] = None) -> None:
+                 owned_shards: Optional[List[int]] = None,
+                 store_factory=None) -> None:
         """owned_shards: in a multi-process job each process hosts the full
         store only for the shards whose mesh device it owns (the reference's
         per-node PS shard layout); None = own all (single process). Routing
         state (_shard_keys) is always GLOBAL — any batch may reference any
-        shard."""
+        shard.
+
+        store_factory(layout, table, seed) -> store overrides the default
+        local host store — e.g. embedding.ps_store.ps_store_factory puts
+        the distributed CPU PS behind every shard (the GPUPS BuildPull/
+        EndPass composition, ps_gpu_wrapper.cc:337,983)."""
         self.config = table
         self.layout = ValueLayout(table.embedx_dim, table.optimizer.optimizer)
         self.push_layout = PushLayout(table.embedx_dim)
@@ -88,7 +94,8 @@ class ShardedPassTable:
         self.owned_shards = (list(owned_shards) if owned_shards is not None
                              else list(range(num_shards)))
         owned = set(self.owned_shards)
-        self.stores = [make_host_store(self.layout, table, seed + s)
+        make_store = store_factory or make_host_store
+        self.stores = [make_store(self.layout, table, seed + s)
                        if s in owned else None
                        for s in range(num_shards)]
         self._feed_keys: List[np.ndarray] = []

@@ -50,12 +50,18 @@ class ShardedBoxTrainer:
     def __init__(self, model, table_cfg: TableConfig, feed: DataFeedConfig,
                  trainer_cfg: Optional[TrainerConfig] = None,
                  mesh: Optional[Mesh] = None, bucket_cap: Optional[int] = None,
-                 seed: int = 0, use_cvm: bool = True, fleet=None) -> None:
+                 seed: int = 0, use_cvm: bool = True, fleet=None,
+                 store_factory=None) -> None:
         """fleet: the host-collective facade (fleet.fleet) — REQUIRED in a
         multi-process job (jax.process_count() > 1): it unions feed-pass
         keys, equalizes batch counts across hosts (data_set.cc:2690-2755)
         and reduces metrics. Single process ignores it except for metric
-        reduction."""
+        reduction.
+
+        store_factory: overrides the shard store backend — pass
+        embedding.ps_store.ps_store_factory(client, table_id) to run the
+        GPUPS composition (pass slabs built from / dumped to the
+        distributed CPU PS, ps_gpu_wrapper.cc:337-760,907-955)."""
         self.model = model
         self.cfg = trainer_cfg or TrainerConfig()
         self.feed = feed
@@ -80,7 +86,8 @@ class ShardedBoxTrainer:
         self.bucket_cap = bucket_cap or max(16, (2 * kcap) // self.P)
         self.table = ShardedPassTable(
             table_cfg, self.P, self.bucket_cap, seed=seed,
-            owned_shards=self.local_positions if self.multiprocess else None)
+            owned_shards=self.local_positions if self.multiprocess else None,
+            store_factory=store_factory)
         self.metrics = MetricRegistry()
         self.dense_opt = make_dense_optimizer(self.cfg)
         rng = jax.random.PRNGKey(seed)

@@ -64,6 +64,10 @@ class PSCore:
                     grads: np.ndarray) -> None:
         self.sparse[table_id].push(keys, grads)
 
+    def assign_sparse(self, table_id: int, keys: np.ndarray,
+                      values: np.ndarray) -> None:
+        self.sparse[table_id].assign(keys, values)
+
     def shrink(self, table_id: int) -> int:
         return self.sparse[table_id].shrink()
 
@@ -159,6 +163,10 @@ class TcpPSClient:
     def push_sparse(self, table_id, keys, grads):
         return self._call("push_sparse", table_id=table_id, keys=keys,
                           grads=grads)
+
+    def assign_sparse(self, table_id, keys, values):
+        return self._call("assign_sparse", table_id=table_id, keys=keys,
+                          values=values)
 
     def pull_dense(self, name):
         return self._call("pull_dense", name=name)

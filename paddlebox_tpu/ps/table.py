@@ -89,6 +89,24 @@ class SparseTable:
                                            self.layout, self.config.optimizer)
                 self.shards[s].write_back(uniq, newrows)
 
+    def assign(self, keys: np.ndarray, values: np.ndarray) -> None:
+        """Overwrite full value rows (creating missing keys) — the PS half
+        of the pass-end HBM→CPU dump (PSGPUWrapper::EndPass →
+        HeterComm::dump_to_cpu, ps_gpu_wrapper.cc:983+): the device slab
+        already applied the optimizer, so rows are stored verbatim.
+        Duplicate keys collapse to the FIRST occurrence's value."""
+        keys = np.asarray(keys, dtype=np.uint64)
+        values = np.asarray(values, dtype=np.float32)
+        uniq, first = np.unique(keys, return_index=True)
+        keys, values = uniq, values[first]
+        shard_of = self._route(keys)
+        for s in range(self.shard_num):
+            m = shard_of == s
+            if not m.any():
+                continue
+            with self._locks[s]:
+                self.shards[s].assign(keys[m], values[m])
+
     # ------------------------------------------------------------- lifecycle
     def shrink(self) -> int:
         total = 0

@@ -1,0 +1,90 @@
+"""Pass preload overlap: load pass N+1 while pass N trains.
+
+The BoxHelper cadence (PreLoadIntoMemory / WaitFeedPassDone,
+box_wrapper.h:1131-1172): the dataset's read/parse/merge threads for the
+NEXT pass run concurrently with the device steps of the CURRENT pass.
+
+Key registration buffers OUTSIDE the table (a plain list) so the active
+pass's routing state (_shard_keys / pass index) is untouched while the
+next pass streams in; the cheap unique+sort+index build (end_feed_pass)
+stays on the pass boundary, exactly the part the reference also leaves in
+EndFeedPass (box_wrapper.cc:153-168).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+from paddlebox_tpu.utils.timer import Timer
+
+
+class PassPreloader:
+    """One in-flight preload at a time, like BoxHelper's single feed agent."""
+
+    def __init__(self, table) -> None:
+        self.table = table
+        self._buffer: Optional[List[np.ndarray]] = None
+        self._dataset = None
+        self.timers = {"wait": Timer()}
+
+    def preload(self, dataset) -> None:
+        """Start the next pass's read threads; returns immediately."""
+        if self._dataset is not None:
+            raise RuntimeError("a preload is already in flight")
+        self._buffer = []
+        self._dataset = dataset
+        dataset.preload_into_memory(add_keys_fn=self._buffer.append)
+
+    def wait(self, dataset, allgather=None) -> None:
+        """Join the load and run the table's feed pass over the buffered
+        keys (WaitFeedPassDone: dataset_->WaitPreLoadDone() +
+        EndFeedPass)."""
+        if dataset is not self._dataset:
+            raise RuntimeError("wait() for a dataset that was not preloaded")
+        t = self.timers["wait"]
+        t.start()
+        dataset.wait_preload_done()
+        self.table.begin_feed_pass()
+        for ks in self._buffer or []:
+            self.table.add_keys(ks)
+        import inspect
+        params = inspect.signature(self.table.end_feed_pass).parameters
+        if "allgather" in params:
+            self.table.end_feed_pass(allgather=allgather)
+        else:  # single-chip PassTable takes no allgather
+            self.table.end_feed_pass()
+        self._buffer = None
+        self._dataset = None
+        t.pause()
+
+
+def run_preloaded_passes(trainer, datasets: Iterable,
+                         release: bool = True) -> List[Dict[str, float]]:
+    """Drive a sequence of datasets with load(N+1) ∥ train(N) overlap.
+
+    Works with BoxTrainer and ShardedBoxTrainer (both accept
+    train_pass(dataset, preloaded=True)). Returns per-pass stats dicts.
+    """
+    allgather = None
+    if getattr(trainer, "multiprocess", False):
+        allgather = trainer.fleet.all_gather
+    pre = PassPreloader(trainer.table)
+    results: List[Dict[str, float]] = []
+    it = iter(datasets)
+    cur = next(it, None)
+    if cur is None:
+        return results
+    pre.preload(cur)
+    while cur is not None:
+        pre.wait(cur, allgather=allgather)
+        nxt = next(it, None)
+        if nxt is not None:
+            # start pass N+1's read threads BEFORE training pass N
+            pre.preload(nxt)
+        results.append(trainer.train_pass(cur, preloaded=True))
+        if release:
+            cur.release_memory()
+        cur = nxt
+    return results

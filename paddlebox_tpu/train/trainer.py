@@ -78,7 +78,11 @@ def make_scan(step_fn: Callable) -> Callable:
 def run_scan_chunks(scan_fn: Callable, items: List, chunk: int,
                     stack_fn: Callable, carry: Tuple,
                     on_chunk: Callable, timer=None):
-    """Drive the megastep over full chunks of `items`.
+    """Drive the megastep over full chunks of `items`, double-buffered:
+    chunk i+1 is host-stacked and dispatched BEFORE chunk i's results are
+    pulled to host, so H2D staging and metric extraction overlap device
+    compute (the MiniBatchGpuPack pinned-async-copy role,
+    data_feed.h:519-680 — one chunk of pipelining, bounded memory).
 
     carry = (slab(s), params, opt_state, prng) threaded through scan_fn;
     on_chunk(lo, group, losses_np, preds) handles metrics/dump/nan per
@@ -86,9 +90,17 @@ def run_scan_chunks(scan_fn: Callable, items: List, chunk: int,
     items[n_consumed:] is the caller's per-step loop."""
     losses_all: List[float] = []
     n_full = (len(items) // chunk) * chunk if chunk > 1 else 0
+    pending = None  # (lo, group, losses_dev, preds_dev)
+
+    def drain(p):
+        lo, group, losses_dev, preds_dev = p
+        losses_np = np.asarray(losses_dev)      # sync point for chunk i
+        losses_all.extend(float(l) for l in losses_np)
+        on_chunk(lo, group, losses_np, preds_dev)
+
     for lo in range(0, n_full, chunk):
         group = items[lo:lo + chunk]
-        stacked = stack_fn(group)
+        stacked = stack_fn(group)               # host work ∥ device compute
         if timer is not None:
             timer.start()
         slab, params, opt_state, losses, preds, prng = scan_fn(
@@ -96,9 +108,11 @@ def run_scan_chunks(scan_fn: Callable, items: List, chunk: int,
         if timer is not None:
             timer.pause()
         carry = (slab, params, opt_state, prng)
-        losses_np = np.asarray(losses)
-        losses_all.extend(float(l) for l in losses_np)
-        on_chunk(lo, group, losses_np, preds)
+        if pending is not None:
+            drain(pending)
+        pending = (lo, group, losses, preds)
+    if pending is not None:
+        drain(pending)
     return carry, losses_all, n_full
 
 

@@ -1,0 +1,134 @@
+"""GPUPS pass-build composition: sharded trainer with shard stores behind
+the distributed CPU PS (PSGPUWrapper BuildPull → device slab → train →
+EndPass dump, ps_gpu_wrapper.cc:337-760,907-955,983+).
+
+Parity holds exactly: the PS table shards by key % P with the same
+per-shard seeds and sorted-unique creation order as the local host stores,
+so the PS-backed run and the local-store oracle produce identical rows.
+"""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config.configs import (SparseOptimizerConfig, TableConfig,
+                                          TrainerConfig)
+from paddlebox_tpu.data import BoxDataset, write_synthetic_ctr_files
+from paddlebox_tpu.embedding.ps_store import PSBackedStore, ps_store_factory
+from paddlebox_tpu.models import CtrDnn
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.parallel.mesh import device_mesh_1d
+from paddlebox_tpu.parallel.sharded_trainer import ShardedBoxTrainer
+from paddlebox_tpu.ps import PSServer, PsLocalClient, TcpPSClient
+
+D = 4
+NUM_SLOTS = 4
+TABLE_ID = 7
+
+
+def table_cfg():
+    return TableConfig(
+        embedx_dim=D, pass_capacity=8 * 512,
+        optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                        mf_initial_range=1e-3,
+                                        feature_learning_rate=0.1,
+                                        mf_learning_rate=0.1))
+
+
+@pytest.fixture(scope="module")
+def data(tmp_path_factory):
+    out = tmp_path_factory.mktemp("psbuild")
+    files, feed = write_synthetic_ctr_files(
+        str(out), num_files=2, lines_per_file=256, num_slots=NUM_SLOTS,
+        vocab_per_slot=100, max_len=3, seed=41)
+    feed = type(feed)(slots=feed.slots, batch_size=32)
+    return files, feed
+
+
+def run_trainer(files, feed, store_factory=None, passes=3, seed=0):
+    from paddlebox_tpu.config import flags
+    flags.set_flag("dataset_disable_shuffle", True)  # strict parity
+    try:
+        trainer = ShardedBoxTrainer(
+            CtrDnn(ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + D),
+                   hidden=(16,)),
+            table_cfg(), feed, TrainerConfig(dense_lr=0.01, scan_chunk=1),
+            mesh=device_mesh_1d(8), seed=seed, store_factory=store_factory)
+        losses = []
+        for _ in range(passes):
+            ds = BoxDataset(feed, read_threads=1)
+            ds.set_filelist(files)
+            losses.append(trainer.train_pass(ds)["loss"])
+            ds.release_memory()
+        return trainer, losses
+    finally:
+        flags.set_flag("dataset_disable_shuffle", False)
+
+
+def test_ps_backed_store_roundtrip():
+    cl = PsLocalClient()
+    cl.create_sparse_table(TABLE_ID, table_cfg(), shard_num=8, seed=0)
+    st = PSBackedStore(cl, TABLE_ID, None, table_cfg(), chunk_keys=4)
+    from paddlebox_tpu.embedding.accessor import ValueLayout
+    st.layout = ValueLayout(D)
+    keys = np.array([3, 11, 19, 27, 35, 43], np.uint64)
+    rows = st.lookup_or_create(keys)          # chunked (4 + 2) create pull
+    assert rows.shape == (6, st.layout.width)
+    rows[:, 1] = 9.0                          # SHOW column
+    st.write_back(keys, rows)
+    back = st.lookup(keys)
+    np.testing.assert_allclose(back[:, 1], 9.0)
+    assert len(st) == 6
+    # lookup of unknown keys reads zero rows and creates nothing
+    miss = st.lookup(np.array([999], np.uint64))
+    assert (miss == 0).all() and len(st) == 6
+
+
+def test_gpups_local_client_matches_local_stores(data):
+    """Same seeds → identical loss trajectory and identical server-side
+    rows vs the local-store oracle."""
+    files, feed = data
+    oracle, losses_local = run_trainer(files, feed)
+
+    cl = PsLocalClient()
+    cl.create_sparse_table(TABLE_ID, table_cfg(), shard_num=8, seed=0)
+    ps_trainer, losses_ps = run_trainer(
+        files, feed, store_factory=ps_store_factory(cl, TABLE_ID))
+    np.testing.assert_allclose(losses_ps, losses_local, rtol=1e-5)
+
+    # rows on the PS equal the oracle's local store rows
+    checked = 0
+    for s in range(8):
+        keys, vals = oracle.table.stores[s].state_items()
+        if not keys.size:
+            continue
+        take = keys[np.argsort(keys)][:4]
+        ps_rows = cl.pull_sparse(TABLE_ID, take, create=False)
+        local_rows = oracle.table.stores[s].lookup(take)
+        np.testing.assert_allclose(ps_rows, local_rows, rtol=1e-5,
+                                   atol=1e-7)
+        checked += take.size
+    assert checked >= 16
+    assert cl.sparse_size(TABLE_ID) > 100  # features created server-side
+
+
+def test_gpups_over_tcp(data):
+    """The same composition with the PS behind a real TCP server must be
+    bit-equal to the in-process client run (the transport is the only
+    difference)."""
+    files, feed = data
+    local_cl = PsLocalClient()
+    local_cl.create_sparse_table(TABLE_ID, table_cfg(), shard_num=8, seed=0)
+    _, losses_local = run_trainer(
+        files, feed, store_factory=ps_store_factory(local_cl, TABLE_ID),
+        passes=2)
+
+    server = PSServer()
+    cl = TcpPSClient("127.0.0.1", server.port)
+    cl.create_sparse_table(TABLE_ID, table_cfg(), shard_num=8, seed=0)
+    trainer, losses = run_trainer(
+        files, feed, store_factory=ps_store_factory(cl, TABLE_ID), passes=2)
+    np.testing.assert_allclose(losses, losses_local, rtol=1e-6)
+    assert cl.sparse_size(TABLE_ID) > 100
+    assert cl.sparse_size(TABLE_ID) == local_cl.sparse_size(TABLE_ID)
+    cl.stop_server()
+    cl.close()
