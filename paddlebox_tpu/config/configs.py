@@ -74,6 +74,13 @@ class TableConfig:
     ssd_dir: Optional[str] = None        # spill tier directory; None = DRAM only
     ssd_threshold_mb: int = 0            # spill host values beyond this budget
 
+    def ssd_max_resident_rows(self, row_width: int) -> Optional[int]:
+        """DRAM row budget for the pass-cadence limiter
+        (CheckNeedLimitMem, box_wrapper.h:627-629); None = no limit."""
+        if not self.ssd_dir or not self.ssd_threshold_mb:
+            return None
+        return (self.ssd_threshold_mb << 20) // (row_width * 4)
+
 
 @dataclasses.dataclass(frozen=True)
 class SlotConfig:
@@ -95,6 +102,10 @@ class DataFeedConfig:
     pipe_command: str = ""               # optional preprocessing pipe, like ref pipe_command
     parser: str = "multislot"            # multislot text | binary archive
     rank_offset: bool = False            # emit pv rank-offset matrix (join phase)
+    # per-task label slots for multi-task models: (task_name, slot_name)
+    # pairs; tasks not listed fall back to the primary click label
+    # (MMoE/ESMM train each head on its own label, metrics.h MultiTask)
+    task_label_slots: Tuple[Tuple[str, str], ...] = ()
     # static capacity of flattened sparse keys per batch; 0 = batch*avg heuristic
     batch_key_capacity: int = 0
 

@@ -64,6 +64,10 @@ class BoxDataset:
             # pv rank-offset matrices are built from per-record pv fields
             # (search_id/rank/cmatch) which the columnar blocks don't carry
             columnar = False
+        if columnar and getattr(feed, "task_label_slots", ()):
+            # per-task labels ride SlotRecord.extra_labels; the native
+            # columnar block carries only the click label
+            columnar = False
         if columnar:
             try:
                 from paddlebox_tpu.data.native_parser import \

@@ -19,42 +19,61 @@ from paddlebox_tpu.config.configs import DataFeedConfig, SlotConfig
 
 
 def default_feed_config(num_slots: int = 8, batch_size: int = 256,
-                        max_len: int = 4, dense_dim: int = 0) -> DataFeedConfig:
+                        max_len: int = 4, dense_dim: int = 0,
+                        conversion: bool = False) -> DataFeedConfig:
     slots: List[SlotConfig] = [SlotConfig("click", type="float", dim=1,
                                           is_used=False)]
+    if conversion:
+        # post-click conversion label for the ESMM cvr head
+        slots.append(SlotConfig("label_cvr", type="float", dim=1,
+                                is_used=False))
     for i in range(num_slots):
         slots.append(SlotConfig(f"slot_{i}", type="uint64", max_len=max_len))
     if dense_dim:
         slots.append(SlotConfig("dense", type="float", dim=dense_dim))
-    return DataFeedConfig(slots=tuple(slots), batch_size=batch_size)
+    return DataFeedConfig(
+        slots=tuple(slots), batch_size=batch_size,
+        task_label_slots=(("cvr", "label_cvr"),) if conversion else ())
 
 
 def write_synthetic_ctr_files(
         out_dir: str, num_files: int = 4, lines_per_file: int = 1024,
         num_slots: int = 8, vocab_per_slot: int = 1000, max_len: int = 4,
-        dense_dim: int = 0, seed: int = 0) -> Tuple[List[str], DataFeedConfig]:
-    """Returns (file list, matching DataFeedConfig)."""
+        dense_dim: int = 0, seed: int = 0,
+        conversion: bool = False) -> Tuple[List[str], DataFeedConfig]:
+    """Returns (file list, matching DataFeedConfig).
+
+    conversion=True additionally emits a `label_cvr` slot: a post-click
+    conversion label with its OWN hidden feasign weights, so an ESMM cvr
+    head trained on it is learnable and distinct from the click signal."""
     rng = np.random.RandomState(seed)
     os.makedirs(out_dir, exist_ok=True)
     # hidden per-slot feasign weights define the true click logit
     hidden = [rng.randn(vocab_per_slot) * 0.7 for _ in range(num_slots)]
+    hidden_cvr = [rng.randn(vocab_per_slot) * 0.7 for _ in range(num_slots)]
     files = []
     for fi in range(num_files):
         path = os.path.join(out_dir, f"part-{fi:05d}.txt")
         with open(path, "w") as f:
             for _ in range(lines_per_file):
                 logit = -0.7
+                logit_cvr = 0.3
                 toks: List[str] = []
                 line_feas = []
                 for si in range(num_slots):
                     n = rng.randint(1, max_len + 1)
                     feas = rng.randint(0, vocab_per_slot, n)
                     logit += hidden[si][feas].mean()
+                    logit_cvr += hidden_cvr[si][feas].mean()
                     # globally unique feasign = slot_base + local id
                     line_feas.append((n, feas + si * vocab_per_slot))
                 p = 1.0 / (1.0 + np.exp(-logit))
                 click = int(rng.rand() < p)
                 toks.append(f"1 {click}")
+                if conversion:
+                    p_cvr = 1.0 / (1.0 + np.exp(-logit_cvr))
+                    conv = int(click and rng.rand() < p_cvr)
+                    toks.append(f"1 {conv}")
                 for n, feas in line_feas:
                     toks.append(str(n) + " " + " ".join(str(x) for x in feas))
                 if dense_dim:
@@ -64,5 +83,5 @@ def write_synthetic_ctr_files(
                 f.write(" ".join(toks) + "\n")
         files.append(path)
     feed = default_feed_config(num_slots, max_len=max_len,
-                               dense_dim=dense_dim)
+                               dense_dim=dense_dim, conversion=conversion)
     return files, feed

@@ -40,6 +40,11 @@ class PackedBatch:
     rank_offset: Optional[np.ndarray] = None  # [B, 2*max_rank+1] int32
     qvalues: Optional[np.ndarray] = None      # [B] float32
     ins_ids: Optional[List[str]] = None       # [n_ins] (dump-field lines)
+    # (cmatch << 32) | (rank & 0xff) per instance — the encoded
+    # cmatch_rank metric var (metrics.h parse_cmatch_rank)
+    cmatch_rank: Optional[np.ndarray] = None  # [B] uint64
+    # task name → [B] int32 labels (tasks fall back to `labels`)
+    task_labels: Optional[dict] = None
 
     @property
     def batch_size(self) -> int:
@@ -72,6 +77,11 @@ class BatchPacker:
         dense = (np.zeros((B, self.dense_dim), dtype=np.float32)
                  if self.dense_dim else None)
         qvalues = np.zeros(B, dtype=np.float32)
+        cmatch_rank = np.zeros(B, dtype=np.uint64)
+        task_names = [t for t, _ in getattr(self.feed, "task_label_slots",
+                                            ())]
+        task_labels = ({t: np.zeros(B, dtype=np.int32) for t in task_names}
+                       if task_names else None)
 
         w = 0
         dropped = 0
@@ -80,6 +90,11 @@ class BatchPacker:
             labels[i] = rec.label
             ins_valid[i] = True
             qvalues[i] = rec.qvalue
+            cmatch_rank[i] = ((np.uint64(rec.cmatch) << np.uint64(32))
+                              | np.uint64(rec.rank & 0xFF))
+            if task_labels is not None:
+                for t in task_names:
+                    task_labels[t][i] = rec.extra_labels.get(t, rec.label)
             for si, slot_cfg in enumerate(self.sparse_slots):
                 vals = rec.uint64_slots.get(si)
                 if vals is None or vals.size == 0:
@@ -108,7 +123,9 @@ class BatchPacker:
         batch = PackedBatch(keys=keys, slots=slots, segments=segments,
                             valid=valid, labels=labels, ins_valid=ins_valid,
                             dense=dense, n_ins=n, qvalues=qvalues,
-                            ins_ids=[r.ins_id for r in records[:n]])
+                            ins_ids=[r.ins_id for r in records[:n]],
+                            cmatch_rank=cmatch_rank,
+                            task_labels=task_labels)
         if with_rank_offset:
             batch.rank_offset = self._build_rank_offset(records[:n], B)
         return batch

@@ -26,6 +26,9 @@ class MultiSlotParser:
         self.label_slot = label_slot
         self._slots = [s for s in feed.slots if s.is_used]
         self._all_slots = list(feed.slots)
+        # slot name → task name for per-task label extraction
+        self._task_by_slot = {slot: task for task, slot
+                              in getattr(feed, "task_label_slots", ())}
 
     def parse_line(self, line: str) -> Optional[SlotRecord]:
         toks = line.split()
@@ -43,16 +46,26 @@ class MultiSlotParser:
                 if len(vals) != n:
                     raise ValueError(f"slot {slot.name}: expected {n} values")
                 pos += n
-                if not slot.is_used and slot.name != self.label_slot:
+                if (not slot.is_used and slot.name != self.label_slot
+                        and slot.name not in self._task_by_slot):
                     continue
                 if slot.type == "uint64":
-                    arr = np.array([int(v) for v in vals], dtype=np.uint64)
-                    rec.uint64_slots[u_idx] = arr
-                    u_idx += 1
+                    task = self._task_by_slot.get(slot.name)
+                    if task is not None and n >= 1:
+                        rec.extra_labels[task] = int(vals[0])
+                    if slot.is_used:
+                        # an unused label slot must NOT consume a sparse
+                        # slot ordinal (packer indexes by used-slot order)
+                        rec.uint64_slots[u_idx] = np.array(
+                            [int(v) for v in vals], dtype=np.uint64)
+                        u_idx += 1
                 else:
                     arr = np.array([float(v) for v in vals], dtype=np.float32)
                     if slot.name == self.label_slot and n >= 1:
                         rec.label = int(arr[0])
+                    task = self._task_by_slot.get(slot.name)
+                    if task is not None and n >= 1:
+                        rec.extra_labels[task] = int(arr[0])
                     if slot.is_used:
                         rec.float_slots[f_idx] = arr
                         f_idx += 1

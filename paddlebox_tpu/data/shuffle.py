@@ -59,6 +59,12 @@ def serialize_records(recs: Sequence[SlotRecord]) -> bytes:
             v = np.ascontiguousarray(vals, dtype=np.float32)
             parts.append(struct.pack("<HI", slot, v.size))
             parts.append(v.tobytes())
+        extra = sorted(r.extra_labels.items())
+        parts.append(struct.pack("<H", len(extra)))
+        for task, lab in extra:
+            tb = task.encode("utf-8")
+            parts.append(struct.pack("<Hi", len(tb), int(lab)))
+            parts.append(tb)
     return b"".join(parts)
 
 
@@ -90,10 +96,20 @@ def deserialize_records(buf: bytes) -> List[SlotRecord]:
             float_slots[slot] = np.frombuffer(
                 buf, dtype=np.float32, count=cnt, offset=off).copy()
             off += 4 * cnt
+        (n_extra,) = struct.unpack_from("<H", buf, off)
+        off += 2
+        extra_labels: Dict[str, int] = {}
+        for _ in range(n_extra):
+            tlen, lab = struct.unpack_from("<Hi", buf, off)
+            off += 6
+            task = buf[off:off + tlen].decode("utf-8")
+            off += tlen
+            extra_labels[task] = lab
         out.append(SlotRecord(label=label, uint64_slots=u64_slots,
                               float_slots=float_slots, ins_id=ins_id,
                               rank=rank, cmatch=cmatch, qvalue=qvalue,
-                              search_id=search_id))
+                              search_id=search_id,
+                              extra_labels=extra_labels))
     return out
 
 

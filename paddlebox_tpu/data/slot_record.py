@@ -18,13 +18,14 @@ import numpy as np
 
 class SlotRecord:
     __slots__ = ("label", "uint64_slots", "float_slots", "ins_id", "rank",
-                 "cmatch", "qvalue", "search_id")
+                 "cmatch", "qvalue", "search_id", "extra_labels")
 
     def __init__(self, label: int = 0,
                  uint64_slots: Optional[Dict[int, np.ndarray]] = None,
                  float_slots: Optional[Dict[int, np.ndarray]] = None,
                  ins_id: str = "", rank: int = 0, cmatch: int = 0,
-                 qvalue: float = 0.0, search_id: int = 0) -> None:
+                 qvalue: float = 0.0, search_id: int = 0,
+                 extra_labels: Optional[Dict[str, int]] = None) -> None:
         self.label = label
         # slot index (position in feed config) → values
         self.uint64_slots = uint64_slots or {}
@@ -34,6 +35,9 @@ class SlotRecord:
         self.cmatch = cmatch  # channel-match tag for cmatch-rank metrics
         self.qvalue = qvalue  # PCOC q-value
         self.search_id = search_id  # pv (search-session) grouping key
+        # task name → label for multi-task heads (conversion/pay/...);
+        # tasks absent here train on the primary click label
+        self.extra_labels = extra_labels or {}
 
     def all_keys(self) -> np.ndarray:
         if not self.uint64_slots:

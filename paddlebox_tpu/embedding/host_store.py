@@ -44,10 +44,13 @@ class HostEmbeddingStore:
         self._values = np.zeros((_GROW, layout.width), dtype=np.float32)
         self._free: List[int] = list(range(_GROW - 1, -1, -1))
         self._lock = threading.RLock()
-        # SSD spill tier
+        # SSD spill tier; file tag is per-store so shards sharing one
+        # ssd_dir can't clobber each other's blocks
         self._spill_dir = table.ssd_dir
         self._spilled: Dict[int, Tuple[str, int]] = {}  # key -> (file, offset row)
         self._spill_seq = 0  # monotonic file id (len(_spilled) can shrink)
+        self._spill_tag = f"{os.getpid():x}_{id(self):x}"
+        self._file_live: Dict[str, int] = {}  # file → live rows (GC at 0)
 
     def __len__(self) -> int:
         return len(self._index)
@@ -194,7 +197,8 @@ class HostEmbeddingStore:
             unseen = self._values[rows, UNSEEN_DAYS]
             order = np.argsort(-unseen, kind="stable")[:excess]
             fname = os.path.join(
-                self._spill_dir, f"spill_{self._spill_seq:08d}.npy")
+                self._spill_dir,
+                f"spill_{self._spill_tag}_{self._spill_seq:08d}.npy")
             self._spill_seq += 1
             block = self._values[rows[order]]
             np.save(fname, block)
@@ -204,12 +208,22 @@ class HostEmbeddingStore:
                 self._spilled[k] = (fname, off)
                 self._values[r] = 0.0
                 self._free.append(r)
+            self._file_live[fname] = int(order.size)
             stat_add("sparse_keys_spilled", excess)
             return excess
 
     def _fault_in(self, key: int) -> int:
         fname, off = self._spilled.pop(key)
-        row_data = np.load(fname, mmap_mode="r")[off]
+        row_data = np.array(np.load(fname, mmap_mode="r")[off])
+        live = self._file_live.get(fname, 0) - 1
+        if live <= 0:  # SSD GC: no live rows left in the block
+            self._file_live.pop(fname, None)
+            try:
+                os.remove(fname)
+            except OSError:
+                pass
+        else:
+            self._file_live[fname] = live
         self._grow(1)
         r = self._free.pop()
         self._values[r] = row_data
@@ -236,8 +250,26 @@ class HostEmbeddingStore:
             return keys, self._values[rows].copy()
 
     def save(self, path: str) -> None:
+        """Checkpoint resident AND spilled rows (same invariant as the
+        native store: a spilled feature survives a save/load cycle)."""
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         keys, values = self.state_items()
+        with self._lock:
+            spilled = dict(self._spilled)
+        if spilled:
+            skeys = np.fromiter(spilled.keys(), dtype=np.uint64,
+                                count=len(spilled))
+            svals = np.empty((skeys.size, self.layout.width), np.float32)
+            by_file: Dict[str, list] = {}
+            for i, k in enumerate(skeys.tolist()):
+                fname, off = spilled[k]
+                by_file.setdefault(fname, []).append((i, off))
+            for fname, pairs in by_file.items():
+                block = np.load(fname, mmap_mode="r")
+                for i, off in pairs:
+                    svals[i] = block[off]
+            keys = np.concatenate([keys, skeys])
+            values = np.vstack([values, svals])
         with open(path, "wb") as f:
             pickle.dump({"keys": keys, "values": values,
                          "embedx_dim": self.layout.embedx_dim,
@@ -253,6 +285,12 @@ class HostEmbeddingStore:
         with self._lock:
             self._index.clear()
             self._spilled.clear()  # stale spill entries must not resurrect
+            for fname in list(self._file_live):
+                try:
+                    os.remove(fname)
+                except OSError:
+                    pass
+            self._file_live.clear()
             self._free = list(range(self._values.shape[0] - 1, -1, -1))
             self._values[:] = 0.0
             keys, values = blob["keys"], blob["values"]

@@ -42,6 +42,14 @@ class NativeHostEmbeddingStore:
         self.table = table
         self._rng = np.random.RandomState(seed)
         self._h = lib.hs_create(layout.width, 0.75)
+        # SSD spill tier (SSDSparseTable role): key → (file, row offset);
+        # the file token is per-store so shards sharing one ssd_dir can't
+        # clobber each other's blocks
+        self._spill_dir = table.ssd_dir
+        self._spilled: dict = {}
+        self._spill_seq = 0
+        self._spill_tag = f"{os.getpid():x}_{id(self):x}"
+        self._file_live: dict = {}  # file → live spilled rows (GC at 0)
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -66,6 +74,38 @@ class NativeHostEmbeddingStore:
         self._lib.hs_lookup(self._h, _p(keys, _U64P), n, _p(rows, _I64P))
         return rows, np.zeros(n, bool)
 
+    def _read_spilled(self, keys: np.ndarray, consume: bool) -> np.ndarray:
+        """Read spilled rows for `keys` (all present in the spill index),
+        one np.load per file. consume=True removes the index entries and
+        deletes any spill file with no live rows left (SSD GC)."""
+        out = np.empty((keys.size, self.layout.width), np.float32)
+        by_file: dict = {}
+        for i, k in enumerate(keys.tolist()):
+            fname, off = (self._spilled.pop(k) if consume
+                          else self._spilled[k])
+            by_file.setdefault(fname, []).append((i, off))
+        for fname, pairs in by_file.items():
+            block = np.load(fname, mmap_mode="r")
+            for i, off in pairs:
+                out[i] = block[off]
+            if consume:
+                del block  # release the mmap before unlink
+                live = self._file_live.get(fname, 0) - len(pairs)
+                if live <= 0:
+                    self._file_live.pop(fname, None)
+                    try:
+                        os.remove(fname)
+                    except OSError:
+                        pass
+                else:
+                    self._file_live[fname] = live
+        if consume:
+            stat_add("sparse_keys_faulted_in", int(keys.size))
+        return out
+
+    def _fault_in_values(self, keys: np.ndarray) -> np.ndarray:
+        return self._read_spilled(keys, consume=True)
+
     def lookup_or_create(self, keys: np.ndarray) -> np.ndarray:
         keys = np.ascontiguousarray(keys, dtype=np.uint64)
         rows, created = self._rows_of(keys, create=True)
@@ -76,6 +116,15 @@ class NativeHostEmbeddingStore:
         if n_new:
             init = self.layout.new_rows(n_new, self._rng,
                                         self.table.optimizer)
+            if self._spilled:
+                # fault spilled keys back in instead of re-initializing
+                new_keys = keys[created]
+                spilled_m = np.fromiter(
+                    (int(k) in self._spilled for k in new_keys.tolist()),
+                    dtype=bool, count=new_keys.size)
+                if spilled_m.any():
+                    init[spilled_m] = self._fault_in_values(
+                        new_keys[spilled_m])
             out[created] = init
             # persist the init back so the arena matches what we returned
             new_rows = np.ascontiguousarray(rows[created])
@@ -90,6 +139,17 @@ class NativeHostEmbeddingStore:
         out = np.empty((keys.size, self.layout.width), np.float32)
         self._lib.hs_gather(self._h, _p(rows, _I64P), keys.size,
                             _p(out, _F32P))
+        if self._spilled:
+            missing = rows < 0
+            if missing.any():
+                mk = keys[missing]
+                sp = np.fromiter(
+                    (int(k) in self._spilled for k in mk.tolist()),
+                    dtype=bool, count=mk.size)
+                if sp.any():
+                    # test-mode read: peek without consuming the index
+                    idx = np.nonzero(missing)[0][sp]
+                    out[idx] = self._read_spilled(keys[idx], consume=False)
         return out
 
     def write_back(self, keys: np.ndarray, values: np.ndarray) -> None:
@@ -120,13 +180,50 @@ class NativeHostEmbeddingStore:
             values[:, UNSEEN_DAYS] += 1.0
             self.write_back(keys, values)
 
-    # SSD tier: not on the native path (make_host_store routes ssd tables
-    # to the Python store)
+    # ----------------------------------------------------------- SSD tier
     def spill(self, max_resident: int) -> int:
-        return 0
+        """Spill the coldest rows beyond max_resident to the SSD dir
+        (SSDSparseTable / CheckNeedLimitMem+ShrinkResource, box_wrapper.h:
+        627-629): victim selection (largest unseen_days) runs in C++
+        (hs_coldest), the block lands in one .npy file."""
+        if not self._spill_dir:
+            return 0
+        excess = len(self) - max_resident
+        if excess <= 0:
+            return 0
+        os.makedirs(self._spill_dir, exist_ok=True)
+        keys = np.empty(excess, np.uint64)
+        rows = np.empty(excess, np.int64)
+        got = int(self._lib.hs_coldest(self._h, excess, UNSEEN_DAYS,
+                                       _p(keys, _U64P), _p(rows, _I64P)))
+        if got <= 0:
+            return 0
+        keys, rows = keys[:got], rows[:got]
+        block = np.empty((got, self.layout.width), np.float32)
+        self._lib.hs_gather(self._h, _p(rows, _I64P), got, _p(block, _F32P))
+        fname = os.path.join(
+            self._spill_dir,
+            f"nspill_{self._spill_tag}_{self._spill_seq:08d}.npy")
+        self._spill_seq += 1
+        np.save(fname, block)
+        for off, k in enumerate(keys.tolist()):
+            self._spilled[int(k)] = (fname, off)
+        self._file_live[fname] = got
+        self._lib.hs_erase(self._h, _p(keys, _U64P), got)
+        stat_add("sparse_keys_spilled", got)
+        return got
 
     def load_spilled(self) -> int:
-        return 0
+        """LoadSSD2Mem(day): promote every spilled row back to DRAM."""
+        if not self._spilled:
+            return 0
+        keys = np.fromiter(self._spilled.keys(), dtype=np.uint64,
+                           count=len(self._spilled))
+        vals = self._fault_in_values(keys)
+        rows, _ = self._rows_of(keys, create=True)
+        self._lib.hs_scatter(self._h, _p(rows, _I64P), keys.size,
+                             _p(np.ascontiguousarray(vals), _F32P))
+        return int(keys.size)
 
     # ---------------------------------------------------------- checkpoint
     def state_items(self) -> Tuple[np.ndarray, np.ndarray]:
@@ -142,8 +239,16 @@ class NativeHostEmbeddingStore:
         return keys, values
 
     def save(self, path: str) -> None:
+        """Checkpoint resident AND spilled rows (a spilled feature must
+        survive a save/load cycle)."""
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         keys, values = self.state_items()
+        if self._spilled:
+            skeys = np.fromiter(self._spilled.keys(), dtype=np.uint64,
+                                count=len(self._spilled))
+            svals = self._read_spilled(skeys, consume=False)
+            keys = np.concatenate([keys, skeys])
+            values = np.vstack([values, svals])
         with open(path, "wb") as f:
             pickle.dump({"keys": keys, "values": values,
                          "embedx_dim": self.layout.embedx_dim,
@@ -158,6 +263,13 @@ class NativeHostEmbeddingStore:
             raise ValueError("checkpoint layout mismatch")
         self._lib.hs_destroy(self._h)
         self._h = self._lib.hs_create(self.layout.width, 0.75)
+        self._spilled.clear()  # stale spill entries must not resurrect
+        for fname in list(self._file_live):
+            try:
+                os.remove(fname)
+            except OSError:
+                pass
+        self._file_live.clear()
         keys = np.ascontiguousarray(blob["keys"], np.uint64)
         if keys.size:
             rows, _ = self._rows_of(keys, create=True)
@@ -167,12 +279,11 @@ class NativeHostEmbeddingStore:
 
 
 def make_host_store(layout: ValueLayout, table: TableConfig, seed: int = 0):
-    """Native store unless the table needs the SSD tier or the native lib
-    is unavailable."""
+    """Native store (with native SSD spill) unless the native lib is
+    unavailable."""
     from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
-    if table.ssd_dir is None:
-        try:
-            return NativeHostEmbeddingStore(layout, table, seed)
-        except RuntimeError:
-            pass
+    try:
+        return NativeHostEmbeddingStore(layout, table, seed)
+    except RuntimeError:
+        pass
     return HostEmbeddingStore(layout, table, seed)

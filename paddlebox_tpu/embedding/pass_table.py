@@ -143,7 +143,18 @@ class PassTable:
             self.store.write_back(self._pass_keys, host)
         self._slab = None
         self._in_pass = False
+        self.check_need_limit_mem()
         t.pause()
+
+    def check_need_limit_mem(self) -> int:
+        """Pass-cadence memory limiter (CheckNeedLimitMem/ShrinkResource,
+        box_wrapper.h:627-629): when the host store exceeds the configured
+        SSD budget, spill the coldest rows down to it. No-op without
+        ssd_dir + ssd_threshold_mb."""
+        max_resident = self.config.ssd_max_resident_rows(self.layout.width)
+        if max_resident is None:
+            return 0
+        return self.store.spill(max_resident)
 
     def set_test_mode(self, test: bool) -> None:
         """SetTestMode (box_wrapper.cc:183): inference pulls — no feature

@@ -466,6 +466,102 @@ class MetricMsg:
         }
 
 
+def parse_cmatch_rank(x: np.ndarray):
+    """Decode the packed cmatch_rank var: high 32 bits = cmatch, low 8 =
+    rank (metrics.h:271-279; the encode side is the packer's
+    (cmatch<<32)|(rank&0xff))."""
+    x = np.asarray(x, np.uint64)
+    return ((x >> np.uint64(32)).astype(np.int64),
+            (x & np.uint64(0xFF)).astype(np.int64))
+
+
+def _parse_group(cmatch_rank_group: str, ignore_rank: bool):
+    """'222_1,223_2' → [(222,1),(223,2)]; with ignore_rank, bare cmatch
+    entries '222,223' are accepted (CmatchRankMetricMsg ctor,
+    metrics.h:413-443). Comma or space separated."""
+    pairs = []
+    for tok in cmatch_rank_group.replace(",", " ").split():
+        if ignore_rank and "_" not in tok:
+            pairs.append((int(tok), 0))
+            continue
+        parts = tok.split("_")
+        if len(parts) != 2:
+            raise ValueError(f"illegal cmatch_rank spec: {tok!r}")
+        pairs.append((int(parts[0]), int(parts[1])))
+    return pairs
+
+
+class CmatchRankMetricMsg(MetricMsg):
+    """AUC over the instances whose (cmatch, rank) matches the configured
+    group — CmatchRankMetricMsg / CmatchRankMaskMetricMsg
+    (metrics.h:413-491,534-…); ignore_rank compares cmatch only
+    (CmatchAUC)."""
+
+    def __init__(self, label_var: str, pred_var: str, name: str,
+                 cmatch_rank_group: str, cmatch_rank_var: str = "cmatch_rank",
+                 ignore_rank: bool = False, metric_phase: int = -1,
+                 table_size: int = 1 << 20, mask_var: str = "") -> None:
+        super().__init__(label_var, pred_var, name, metric_phase,
+                         table_size, mask_var=mask_var)
+        self.cmatch_rank_var = cmatch_rank_var
+        self.ignore_rank = ignore_rank
+        self.pairs = _parse_group(cmatch_rank_group, ignore_rank)
+
+    def _match_mask(self, tensors: Dict[str, np.ndarray]) -> np.ndarray:
+        cmatch, rank = parse_cmatch_rank(tensors[self.cmatch_rank_var])
+        sel = np.zeros(cmatch.shape, bool)
+        for cm, rk in self.pairs:
+            if self.ignore_rank:
+                sel |= cmatch == cm
+            else:
+                sel |= (cmatch == cm) & (rank == rk)
+        return sel
+
+    def add_from(self, tensors: Dict[str, np.ndarray]) -> None:
+        sel = self._match_mask(tensors)
+        if self.mask_var:
+            sel = sel & (np.asarray(tensors[self.mask_var]) != 0)
+        if not sel.any():
+            return
+        self.calculator.add_data(np.asarray(tensors[self.pred_var])[sel],
+                                 np.asarray(tensors[self.label_var])[sel])
+
+
+class MultiTaskMetricMsg(MetricMsg):
+    """One AUC fed from a DIFFERENT pred var per matched (cmatch, rank)
+    pair (MultiTaskMetricMsg, metrics.h:327-410): instance i matching
+    pairs[j] contributes pred_list[j][i]."""
+
+    def __init__(self, label_var: str, pred_var_list, name: str,
+                 cmatch_rank_group: str, cmatch_rank_var: str = "cmatch_rank",
+                 metric_phase: int = -1, table_size: int = 1 << 20,
+                 mask_var: str = "") -> None:
+        preds = (pred_var_list.split() if isinstance(pred_var_list, str)
+                 else list(pred_var_list))
+        super().__init__(label_var, preds[0], name, metric_phase,
+                         table_size, mask_var=mask_var)
+        self.pred_list = preds
+        self.cmatch_rank_var = cmatch_rank_var
+        self.pairs = _parse_group(cmatch_rank_group, ignore_rank=False)
+        if len(self.pairs) != len(self.pred_list):
+            raise ValueError(
+                "cmatch_rank group size %d != pred list size %d"
+                % (len(self.pairs), len(self.pred_list)))
+
+    def add_from(self, tensors: Dict[str, np.ndarray]) -> None:
+        cmatch, rank = parse_cmatch_rank(tensors[self.cmatch_rank_var])
+        label = np.asarray(tensors[self.label_var])
+        base = (np.asarray(tensors[self.mask_var]) != 0 if self.mask_var
+                else np.ones(label.shape, bool))
+        taken = np.zeros(label.shape, bool)  # first matching pair wins
+        for (cm, rk), pv in zip(self.pairs, self.pred_list):
+            sel = (cmatch == cm) & (rank == rk) & base & ~taken
+            taken |= sel
+            if sel.any():
+                self.calculator.add_data(np.asarray(tensors[pv])[sel],
+                                         label[sel])
+
+
 class MetricRegistry:
     """Name → MetricMsg with phase filtering; analog of the metric registry in
     BoxWrapper (box_wrapper.h:758-781) with phase filter (join/update)."""
@@ -479,6 +575,34 @@ class MetricRegistry:
                     **kwargs) -> MetricMsg:
         msg = MetricMsg(label_var, pred_var, name, metric_phase, table_size,
                         **kwargs)
+        self._metrics[name] = msg
+        return msg
+
+    def init_cmatch_rank_metric(self, name: str, label_var: str,
+                                pred_var: str, cmatch_rank_group: str,
+                                cmatch_rank_var: str = "cmatch_rank",
+                                ignore_rank: bool = False,
+                                metric_phase: int = -1,
+                                table_size: int = 1 << 20,
+                                mask_var: str = "") -> MetricMsg:
+        """CmatchRank / CmatchRankMask AUC (metrics.h:413-491,534-…)."""
+        msg = CmatchRankMetricMsg(
+            label_var, pred_var, name, cmatch_rank_group, cmatch_rank_var,
+            ignore_rank, metric_phase, table_size, mask_var)
+        self._metrics[name] = msg
+        return msg
+
+    def init_multi_task_metric(self, name: str, label_var: str,
+                               pred_var_list, cmatch_rank_group: str,
+                               cmatch_rank_var: str = "cmatch_rank",
+                               metric_phase: int = -1,
+                               table_size: int = 1 << 20,
+                               mask_var: str = "") -> MetricMsg:
+        """Per-pair pred selection AUC (MultiTaskMetricMsg,
+        metrics.h:327-410)."""
+        msg = MultiTaskMetricMsg(
+            label_var, pred_var_list, name, cmatch_rank_group,
+            cmatch_rank_var, metric_phase, table_size, mask_var)
         self._metrics[name] = msg
         return msg
 

@@ -98,6 +98,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.hs_arena.argtypes = [c.c_void_p]
     lib.hs_arena_rows.restype = c.c_int64
     lib.hs_arena_rows.argtypes = [c.c_void_p]
+    lib.hs_coldest.restype = c.c_int64
+    lib.hs_coldest.argtypes = [c.c_void_p, c.c_int64, c.c_int32,
+                               P(c.c_uint64), P(c.c_int64)]
     # batch key routing
     lib.rt_index_create.restype = c.c_void_p
     lib.rt_index_create.argtypes = [P(c.c_uint64), P(c.c_int64), c.c_int32]

@@ -11,6 +11,7 @@
 // C ABI for ctypes. Row memory is owned here; Python reads/writes rows
 // through bulk gather/scatter calls (no per-key Python overhead).
 
+#include <algorithm>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -224,5 +225,43 @@ int64_t hs_items(Store* s, uint64_t* out_keys, int64_t* out_rows) {
 // create/grow): base pointer + row capacity.
 float* hs_arena(Store* s) { return s->arena; }
 int64_t hs_arena_rows(Store* s) { return s->arena_cap; }
+
+// Select the `want` coldest live keys (largest value in column `cold_col`,
+// e.g. unseen_days — the SSD spill victim policy, ssd_sparse_table.cc /
+// CheckNeedLimitMem box_wrapper.h:627-629). Writes keys + row ids; returns
+// count (<= want). O(n) selection via nth_element.
+int64_t hs_coldest(Store* s, int64_t want, int32_t cold_col,
+                   uint64_t* out_keys, int64_t* out_rows) {
+  int64_t n = static_cast<int64_t>(s->size);
+  if (want <= 0 || n == 0) return 0;
+  if (want > n) want = n;
+  struct Item {
+    float cold;
+    uint64_t key;
+    int64_t row;
+  };
+  Item* items = static_cast<Item*>(malloc(n * sizeof(Item)));
+  if (!items) return -1;
+  int64_t w = 0;
+  for (uint64_t i = 0; i < s->cap; ++i) {
+    if (s->slots[i] != kEmpty) {
+      items[w].key = s->slots[i];
+      items[w].row = s->rows[i];
+      items[w].cold = s->arena[s->rows[i] * s->width + cold_col];
+      ++w;
+    }
+  }
+  std::nth_element(items, items + (want - 1), items + w,
+                   [](const Item& a, const Item& b) {
+                     return a.cold > b.cold ||
+                            (a.cold == b.cold && a.key < b.key);
+                   });
+  for (int64_t i = 0; i < want; ++i) {
+    out_keys[i] = items[i].key;
+    out_rows[i] = items[i].row;
+  }
+  free(items);
+  return want;
+}
 
 }  // extern "C"

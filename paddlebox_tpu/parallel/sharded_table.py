@@ -319,6 +319,17 @@ class ShardedPassTable:
                                  overflow=overflow)
 
     # ------------------------------------------------------------ lifecycle
+    def check_need_limit_mem(self) -> int:
+        """Per-shard pass-cadence spill (CheckNeedLimitMem/ShrinkResource,
+        box_wrapper.h:627-629); budget divides evenly across owned
+        shards."""
+        budget = self.config.ssd_max_resident_rows(self.layout.width)
+        if budget is None:
+            return 0
+        per_shard = budget // max(1, len(self.owned_shards))
+        return sum(st.spill(per_shard) for st in self.stores
+                   if st is not None and hasattr(st, "spill"))
+
     def shrink_table(self) -> int:
         return sum(st.shrink() for st in self.stores if st is not None)
 

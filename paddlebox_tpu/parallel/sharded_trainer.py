@@ -357,8 +357,9 @@ class ShardedBoxTrainer:
                 if b.rank_offset is not None:
                     leaves["rank_offset"] = b.rank_offset
                 if self.multi_task:
+                    packed = b.task_labels or {}
                     for t in self.model.task_names:
-                        leaves["labels_" + t] = b.labels
+                        leaves["labels_" + t] = packed.get(t, b.labels)
                 for k, v in leaves.items():
                     stacked.setdefault(k, []).append(v)
             dev = {k: self._put_sharded(np.stack(v), sharding)
@@ -441,6 +442,7 @@ class ShardedBoxTrainer:
                                             np.asarray(sh.data)[0])
         else:
             self.table.write_back(np.asarray(self._slabs))
+        self.table.check_need_limit_mem()
         self._slabs = None
         t_pass.pause()
         return {"loss": float(np.mean(losses)) if losses else 0.0,
@@ -480,6 +482,12 @@ class ShardedBoxTrainer:
         mask = np.stack([b.ins_valid for b in step_batches])
         tensors = {"pred": arr.reshape(-1), "label": labels.reshape(-1),
                    "mask": mask.reshape(-1)}
+        if step_batches[0].cmatch_rank is not None:
+            tensors["cmatch_rank"] = np.stack(
+                [b.cmatch_rank for b in step_batches]).reshape(-1)
+        for t in (step_batches[0].task_labels or {}):
+            tensors["label_" + t] = np.stack(
+                [b.task_labels[t] for b in step_batches]).reshape(-1)
         for t, p in preds.items():
             tensors["pred_" + t] = self._local_rows(p).reshape(-1)
         self.metrics.add_batch(tensors)

@@ -134,7 +134,10 @@ class DownpourTrainer:
     def __init__(self, model, table_cfg: TableConfig, feed: DataFeedConfig,
                  client, trainer_cfg: Optional[TrainerConfig] = None,
                  seed: int = 0, create_tables: bool = True,
-                 use_cvm: bool = True) -> None:
+                 use_cvm: bool = True, sync_comm: bool = False) -> None:
+        """sync_comm=True flushes sparse pushes and refreshes dense params
+        every batch (the Communicator's sync mode, communicator.h) —
+        deterministic, at the cost of the async pipeline overlap."""
         import jax
         import jax.flatten_util
 
@@ -161,6 +164,7 @@ class DownpourTrainer:
         self.pull_dense_worker = PullDenseWorker(client, self.DENSE_TABLE)
         self.communicator = Communicator(client, self.SPARSE_TABLE,
                                          self.push_layout.width)
+        self.sync_comm = sync_comm
         self._step, self._eval_step = self._build_step()
         self._shuffle_rng = np.random.RandomState(seed + 1)
         self.multi_task = len(getattr(model, "task_names", ("ctr",))) > 1
@@ -246,11 +250,15 @@ class DownpourTrainer:
         losses = []
         for b in dataset.split_batches(num_workers=1)[0]:
             slab, batch = self._prepare_batch(b)
-            params = self._unravel(jnp.asarray(self.pull_dense_worker.value))
+            dense = (self.pull_dense_worker.refresh() if self.sync_comm
+                     else self.pull_dense_worker.value)
+            params = self._unravel(jnp.asarray(dense))
             flat_g, push_rows, loss, preds = self._step(slab, params, batch)
             push_rows = np.asarray(push_rows)
             keys = b.keys[b.valid]
             self.communicator.push(keys, push_rows[b.valid])
+            if self.sync_comm:
+                self.communicator.flush()
             self.client.push_dense(self.DENSE_TABLE, np.asarray(flat_g))
             losses.append(float(loss))
             self._add_metrics(np.asarray(preds), b)

@@ -360,10 +360,11 @@ class BoxTrainer:
         if b.rank_offset is not None:
             out["rank_offset"] = b.rank_offset
         if self.multi_task:
-            # single-label data trains every task on the same label unless
-            # the dataset packed task labels (labels_<task> fields)
+            # per-task labels from the packer (task_label_slots config);
+            # tasks without a packed label train on the click label
+            packed = b.task_labels or {}
             for t in self.model.task_names:
-                out["labels_" + t] = b.labels
+                out["labels_" + t] = packed.get(t, b.labels)
         return out
 
     def device_batch(self, b: PackedBatch,
@@ -460,6 +461,10 @@ class BoxTrainer:
             return
         mask = b.ins_valid
         tensors = {"label": b.labels, "mask": mask}
+        if b.cmatch_rank is not None:
+            tensors["cmatch_rank"] = b.cmatch_rank
+        for task, lab in (b.task_labels or {}).items():
+            tensors["label_" + task] = lab
         for task, p in preds.items():
             tensors["pred_" + task] = np.asarray(p)
         tensors["pred"] = tensors["pred_" + list(preds)[0]]

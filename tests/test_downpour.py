@@ -71,7 +71,9 @@ def test_downpour_local_client_learns(data):
     tr = DownpourTrainer(CtrDnn(ModelSpec(num_slots=4, slot_dim=3 + D),
                                 hidden=(16,)),
                          table_cfg(), feed, PsLocalClient(),
-                         TrainerConfig(dense_lr=0.01))
+                         TrainerConfig(dense_lr=0.01),
+                         sync_comm=True)  # deterministic (async variant is
+                                          # timing-sensitive under CI load)
     tr.metrics.init_metric("auc", "label", "pred", table_size=1 << 14,
                            mask_var="mask")
     losses = []

@@ -1,0 +1,155 @@
+"""Per-task labels + cmatch-rank metric variants (VERDICT r1 missing #5).
+
+The round-1 packer aliased every task's label to the click label, so ESMM
+trained cvr on clicks. Now: task_label_slots routes designated label slots
+through parser → SlotRecord.extra_labels → PackedBatch.task_labels →
+labels_<task>, and the metric registry grows the cmatch-rank/multi-task
+variants of metrics.h:327-568."""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config.configs import (SparseOptimizerConfig, TableConfig,
+                                          TrainerConfig)
+from paddlebox_tpu.data import BoxDataset, write_synthetic_ctr_files
+from paddlebox_tpu.data.packer import BatchPacker
+from paddlebox_tpu.data.parser import MultiSlotParser
+from paddlebox_tpu.data.shuffle import deserialize_records, serialize_records
+from paddlebox_tpu.data.slot_record import SlotRecord
+from paddlebox_tpu.metrics.auc import (BasicAucCalculator, MetricRegistry,
+                                       parse_cmatch_rank)
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.models.esmm import ESMM
+from paddlebox_tpu.train.trainer import BoxTrainer
+
+D = 4
+NUM_SLOTS = 4
+
+
+@pytest.fixture(scope="module")
+def conv_data(tmp_path_factory):
+    out = tmp_path_factory.mktemp("mtl")
+    files, feed = write_synthetic_ctr_files(
+        str(out), num_files=2, lines_per_file=400, num_slots=NUM_SLOTS,
+        vocab_per_slot=80, max_len=3, seed=77, conversion=True)
+    feed = type(feed)(slots=feed.slots, batch_size=32,
+                      task_label_slots=feed.task_label_slots)
+    return files, feed
+
+
+def test_parser_extracts_task_labels(conv_data):
+    files, feed = conv_data
+    parser = MultiSlotParser(feed)
+    recs = list(parser.parse_file(files[0]))
+    assert recs, "no records parsed"
+    convs = np.array([r.extra_labels.get("cvr", -1) for r in recs])
+    clicks = np.array([r.label for r in recs])
+    assert (convs >= 0).all()
+    # conversion implies click, and the labels genuinely differ
+    assert ((convs == 1) <= (clicks == 1)).all()
+    assert (convs != clicks).any()
+
+
+def test_packer_fills_task_labels_and_cmatch_rank(conv_data):
+    files, feed = conv_data
+    parser = MultiSlotParser(feed)
+    recs = list(parser.parse_file(files[0]))[:16]
+    for i, r in enumerate(recs):
+        r.cmatch = 222 if i % 2 == 0 else 223
+        r.rank = (i % 3) + 1
+    packer = BatchPacker(type(feed)(slots=feed.slots, batch_size=16,
+                                    task_label_slots=feed.task_label_slots))
+    b = packer.pack(recs)
+    assert b.task_labels is not None and "cvr" in b.task_labels
+    np.testing.assert_array_equal(
+        b.task_labels["cvr"][:16],
+        [r.extra_labels["cvr"] for r in recs])
+    cm, rk = parse_cmatch_rank(b.cmatch_rank[:16])
+    np.testing.assert_array_equal(cm, [r.cmatch for r in recs])
+    np.testing.assert_array_equal(rk, [r.rank for r in recs])
+
+
+def test_shuffle_codec_roundtrips_extra_labels():
+    r = SlotRecord(label=1, uint64_slots={0: np.array([5], np.uint64)},
+                   extra_labels={"cvr": 1, "pay": 0}, cmatch=222, rank=2)
+    out = deserialize_records(serialize_records([r]))[0]
+    assert out.extra_labels == {"cvr": 1, "pay": 0}
+    assert out.cmatch == 222 and out.rank == 2
+
+
+def test_esmm_trains_cvr_on_conversion_label(conv_data):
+    """The cvr head must see the conversion label: its predictions rank
+    conversions (given click) better than the click predictor does."""
+    files, feed = conv_data
+    table_cfg = TableConfig(
+        embedx_dim=D, pass_capacity=1 << 13,
+        optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                        mf_initial_range=1e-3,
+                                        feature_learning_rate=0.2,
+                                        mf_learning_rate=0.2))
+    trainer = BoxTrainer(
+        ESMM(ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + D), tower=(16,)),
+        table_cfg, feed, TrainerConfig(dense_lr=0.01), seed=0)
+    trainer.metrics.init_metric("ctcvr_auc", "label_cvr", "pred_ctcvr",
+                                table_size=1 << 14, mask_var="mask")
+    for _ in range(8):
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        trainer.train_pass(ds)
+        ds.release_memory()
+    msg = trainer.metrics.get_metric_msg("ctcvr_auc")
+    assert msg["auc"] > 0.6, msg
+    # the labels the metric consumed were conversions, not clicks:
+    # conversion rate < click rate by construction
+    assert msg["actual_ctr"] < 0.45, msg
+
+
+def test_cmatch_rank_metric_filters():
+    reg = MetricRegistry()
+    reg.init_cmatch_rank_metric("join_auc", "label", "pred",
+                                cmatch_rank_group="222_1,223_2")
+    reg.init_cmatch_rank_metric("cmatch_auc", "label", "pred",
+                                cmatch_rank_group="222", ignore_rank=True)
+    rng = np.random.RandomState(0)
+    n = 512
+    cmatch = rng.choice([222, 223, 224], n)
+    rank = rng.randint(1, 4, n)
+    label = rng.randint(0, 2, n)
+    pred = np.where(label == 1, rng.rand(n) * 0.5 + 0.5, rng.rand(n) * 0.5)
+    enc = (cmatch.astype(np.uint64) << np.uint64(32)) | rank.astype(np.uint64)
+    reg.add_batch({"label": label, "pred": pred, "cmatch_rank": enc})
+
+    sel = ((cmatch == 222) & (rank == 1)) | ((cmatch == 223) & (rank == 2))
+    oracle = BasicAucCalculator(1 << 14)
+    oracle.add_data(pred[sel], label[sel])
+    oracle.compute()
+    msg = reg.get_metric_msg("join_auc")
+    assert msg["size"] == sel.sum()
+    np.testing.assert_allclose(msg["auc"], oracle.auc(), rtol=1e-9)
+
+    msg2 = reg.get_metric_msg("cmatch_auc")
+    assert msg2["size"] == (cmatch == 222).sum()
+
+
+def test_multi_task_metric_selects_pred_per_pair():
+    reg = MetricRegistry()
+    reg.init_multi_task_metric("mt_auc", "label", ["pred_a", "pred_b"],
+                               cmatch_rank_group="222_1 223_1")
+    rng = np.random.RandomState(1)
+    n = 256
+    cmatch = rng.choice([222, 223], n)
+    rank = np.ones(n, np.int64)
+    label = rng.randint(0, 2, n)
+    # pred_a is informative, pred_b is noise
+    pred_a = np.where(label == 1, 0.9, 0.1)
+    pred_b = rng.rand(n)
+    enc = (cmatch.astype(np.uint64) << np.uint64(32)) | rank.astype(np.uint64)
+    reg.add_batch({"label": label, "pred_a": pred_a, "pred_b": pred_b,
+                   "cmatch_rank": enc})
+    oracle = BasicAucCalculator(1 << 14)
+    oracle.add_data(pred_a[cmatch == 222], label[cmatch == 222])
+    oracle.add_data(pred_b[cmatch == 223], label[cmatch == 223])
+    oracle.compute()
+    msg = reg.get_metric_msg("mt_auc")
+    assert msg["size"] == n
+    np.testing.assert_allclose(msg["auc"], oracle.auc(), rtol=1e-9)
