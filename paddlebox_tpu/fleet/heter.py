@@ -1,0 +1,249 @@
+"""Heterogenous parallel: CPU workers offload dense compute to an
+accelerator service.
+
+TPU-native re-design of HeterWrapper / HeterXpuTrainer / HeterCpuWorker
+(paddle/fluid/framework/fleet/heter_wrapper.{h,cc}; trainer.h:184): in the
+reference, CPU-bound workers run the data pipeline + sparse PS traffic and
+ship the dense forward/backward to a GPU/XPU service over brpc. Here:
+
+  * ``HeterDenseService`` lives on the accelerator host: it owns the dense
+    params + optimizer and serves jitted train/eval steps over the shared
+    framed RPC (utils/rpc.py, the brpc stand-in). Input per call is the
+    batch's pulled embedding view + batch meta; output is the embedding
+    cotangent (for the worker's sparse push) + loss + preds. Dense updates
+    never leave the service.
+  * ``HeterTrainer`` is the CPU-side worker: the Downpour data/sparse
+    machinery (pull from the CPU PS, dedup, push raw grads back) with the
+    compute step replaced by the RPC call.
+
+The split point is the pulled embedding [K, 3+D] — exactly the tensor the
+reference ships between heter workers (heter_wrapper.cc SerializeToReq of
+the per-batch vars).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.config.configs import (DataFeedConfig, TableConfig,
+                                          TrainerConfig)
+from paddlebox_tpu.data.dataset import BoxDataset
+from paddlebox_tpu.metrics.auc import MetricRegistry
+from paddlebox_tpu.ps.worker import Communicator, DownpourTrainer
+from paddlebox_tpu.utils.rpc import FramedClient, FramedServer, make_loads
+
+
+def _allow(module: str, name: str) -> bool:
+    return module.split(".")[0] == "numpy"
+
+
+_loads = make_loads(_allow)
+
+
+class HeterDenseService:
+    """Accelerator-side dense executor (the HeterXpuTrainer service role)."""
+
+    def __init__(self, model, feed: DataFeedConfig, dense_lr: float = 1e-3,
+                 use_cvm: bool = True, seed: int = 0,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
+
+        self.model = model
+        B = feed.batch_size
+        S = len(feed.used_sparse_slots())
+        self.params = model.init(jax.random.PRNGKey(seed))
+        self.opt = optax.adam(dense_lr)
+        self.opt_state = self.opt.init(self.params)
+        self._lock = threading.Lock()
+
+        def loss_fn(params, emb, batch):
+            pooled = fused_seqpool_cvm(emb, batch["segments"],
+                                       batch["valid"], B, S, use_cvm)
+            logits = model.apply(params, pooled, batch.get("dense"))
+            lab = batch["labels"].astype(jnp.float32)
+            bce = optax.sigmoid_binary_cross_entropy(logits, lab)
+            denom = jnp.maximum(batch["ins_valid"].sum(), 1.0)
+            loss = jnp.where(batch["ins_valid"], bce, 0.0).sum() / denom
+            return loss, jax.nn.sigmoid(logits)
+
+        @jax.jit
+        def train_step(params, opt_state, emb, batch):
+            grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1),
+                                         has_aux=True)
+            (loss, preds), (dparams, demb) = grad_fn(params, emb, batch)
+            updates, opt_state = self.opt.update(dparams, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, demb, loss, preds
+
+        @jax.jit
+        def eval_step(params, emb, batch):
+            _, preds = loss_fn(params, emb, batch)
+            return preds
+
+        self._train_step = train_step
+        self._eval_step = eval_step
+        self._rpc = FramedServer(self._handle, _loads, host, port)
+
+    @property
+    def port(self) -> int:
+        return self._rpc.port
+
+    def _batch_to_device(self, req: dict) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        batch = {k: jnp.asarray(v) for k, v in req["batch"].items()}
+        return batch
+
+    def _handle(self, req: dict) -> Any:
+        import jax.numpy as jnp
+        method = req["method"]
+        if method == "__stop__":
+            self.stop()
+            return True
+        if method == "train_step":
+            batch = self._batch_to_device(req)
+            emb = jnp.asarray(req["emb"])
+            with self._lock:  # one optimizer stream; workers serialize here
+                (self.params, self.opt_state, demb, loss,
+                 preds) = self._train_step(self.params, self.opt_state,
+                                           emb, batch)
+            return (np.asarray(demb), float(loss), np.asarray(preds))
+        if method == "eval_step":
+            batch = self._batch_to_device(req)
+            emb = jnp.asarray(req["emb"])
+            with self._lock:
+                preds = self._eval_step(self.params, emb, batch)
+            return np.asarray(preds)
+        raise ValueError(f"unknown heter method {method!r}")
+
+    def stop(self) -> None:
+        self._rpc.stop()
+
+
+class HeterDenseClient:
+    def __init__(self, host: str, port: int, timeout: float = 300.0) -> None:
+        self._rpc = FramedClient(host, port, _loads, timeout)
+
+    def train_step(self, emb: np.ndarray, batch: Dict[str, np.ndarray]
+                   ) -> Tuple[np.ndarray, float, np.ndarray]:
+        return self._rpc.call({"method": "train_step", "emb": emb,
+                               "batch": batch})
+
+    def eval_step(self, emb: np.ndarray,
+                  batch: Dict[str, np.ndarray]) -> np.ndarray:
+        return self._rpc.call({"method": "eval_step", "emb": emb,
+                               "batch": batch})
+
+    def stop_server(self) -> None:
+        try:
+            self._rpc.call({"method": "__stop__"})
+        except (ConnectionError, OSError):
+            pass
+
+    def close(self) -> None:
+        self._rpc.close()
+
+
+class HeterTrainer:
+    """CPU-side worker (HeterCpuWorker role): data pipeline + PS sparse
+    traffic local, dense step remote."""
+
+    SPARSE_TABLE = DownpourTrainer.SPARSE_TABLE
+
+    def __init__(self, ps_client, heter: HeterDenseClient,
+                 table_cfg: TableConfig, feed: DataFeedConfig,
+                 seed: int = 0, create_tables: bool = True) -> None:
+        from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
+
+        self.client = ps_client
+        self.heter = heter
+        self.feed = feed
+        self.layout = ValueLayout(table_cfg.embedx_dim,
+                                  table_cfg.optimizer.optimizer)
+        self.push_layout = PushLayout(self.layout.embedx_dim)
+        self.num_slots = len(feed.used_sparse_slots())
+        self.metrics = MetricRegistry()
+        if create_tables:
+            ps_client.create_sparse_table(self.SPARSE_TABLE, table_cfg,
+                                          seed=seed)
+        self.communicator = Communicator(ps_client, self.SPARSE_TABLE,
+                                         self.push_layout.width)
+        self._shuffle_rng = np.random.RandomState(seed + 1)
+
+    # ------------------------------------------------------------- batches
+    def _pull_view(self, b, create: bool = True
+                   ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        """FillSparseValue on the CPU worker: PS rows → pull view [K, 3+D]
+        (show, click, embed_w, embedx — what pull_sparse emits on-device).
+        create=False is the test-mode pull (no server-side inserts)."""
+        from paddlebox_tpu.embedding import accessor as acc
+
+        uniq, inv = np.unique(b.keys[b.valid], return_inverse=True)
+        rows = self.client.pull_sparse(self.SPARSE_TABLE, uniq,
+                                       create=create)
+        D = self.layout.embedx_dim
+        xw0 = self.layout.embedx_w
+        view = np.concatenate([
+            rows[:, acc.SHOW:acc.SHOW + 1],
+            rows[:, acc.CLICK:acc.CLICK + 1],
+            rows[:, acc.EMBED_W:acc.EMBED_W + 1],
+            rows[:, xw0:xw0 + D],
+        ], axis=1)
+        emb = np.zeros((b.keys.shape[0], view.shape[1]), np.float32)
+        emb[b.valid] = view[inv]
+        batch = {
+            "segments": b.segments, "valid": b.valid,
+            "ins_valid": b.ins_valid, "labels": b.labels,
+        }
+        if b.dense is not None:
+            batch["dense"] = b.dense
+        return emb, batch
+
+    def train_pass(self, dataset: BoxDataset) -> Dict[str, float]:
+        from paddlebox_tpu.ops.sparse import build_push_grads
+
+        if len(dataset) == 0:
+            dataset.load_into_memory()
+        dataset.local_shuffle(self._shuffle_rng.randint(1 << 31))
+        losses = []
+        for b in dataset.split_batches(num_workers=1)[0]:
+            emb, batch = self._pull_view(b)
+            demb, loss, preds = self.heter.train_step(emb, batch)
+            # push construction runs on the CPU worker with the canonical
+            # layout helper (ops/sparse.py)
+            clicks = b.labels[b.segments // self.num_slots]
+            push_rows = np.asarray(build_push_grads(
+                np.asarray(demb), b.slots, clicks, b.valid))
+            self.communicator.push(b.keys[b.valid], push_rows[b.valid])
+            losses.append(float(loss))
+            if self.metrics.metric_names():
+                self.metrics.add_batch({"pred": np.asarray(preds),
+                                        "label": b.labels,
+                                        "mask": b.ins_valid})
+        self.communicator.flush()
+        return {"loss": float(np.mean(losses)) if losses else 0.0,
+                "batches": len(losses), "instances": len(dataset)}
+
+    def predict_pass(self, dataset: BoxDataset):
+        """Test-mode eval: create=False pulls (nothing inserted
+        server-side) + the service's eval_step."""
+        if len(dataset) == 0:
+            dataset.load_into_memory()
+        preds_all, labels_all = [], []
+        for b in dataset.split_batches(num_workers=1)[0]:
+            emb, batch = self._pull_view(b, create=False)
+            preds = np.asarray(self.heter.eval_step(emb, batch))
+            preds_all.append(preds[b.ins_valid])
+            labels_all.append(b.labels[b.ins_valid])
+        if not preds_all:
+            return np.empty(0, np.float32), np.empty(0, np.int32)
+        return np.concatenate(preds_all), np.concatenate(labels_all)
+
+    def close(self) -> None:
+        self.communicator.stop()

@@ -1,0 +1,127 @@
+"""Elastic recovery loop: pass boundary = checkpoint + fault-check unit.
+
+Wires the pieces VERDICT r1 flagged as unconnected: the heartbeat watcher
+(fleet/elastic.py) detects a dead rank, training stops at the next pass
+boundary (the reference's recovery semantics — gang-scheduled MPI, a rank
+failure kills the job, recovery = restart + resume from the last SaveBase,
+SURVEY.md §5.3), and the restarted job resumes from the newest completed
+per-pass batch model.
+
+Each completed pass writes batch_model_dir/<day>/pass-<i>/ with a DONE
+marker (crash mid-save leaves no DONE → that pass replays). The checkpoint
+carries the table PRNG key so a resumed run is bit-identical to an
+uninterrupted one (mf-creation noise included).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from paddlebox_tpu.train.checkpoint import CheckpointManager
+
+
+class RecoverableRunner:
+    def __init__(self, trainer, ckpt: CheckpointManager, day: str,
+                 elastic=None, keep_last: int = 2) -> None:
+        """elastic: optional fleet.elastic.ElasticManager — check()ed at
+        every pass boundary; keep_last: completed per-pass checkpoints
+        retained (older ones are pruned)."""
+        self.trainer = trainer
+        self.ckpt = ckpt
+        self.day = day
+        self.elastic = elastic
+        self.keep_last = max(1, keep_last)
+
+    # ------------------------------------------------------------ resume
+    def _pass_dir_root(self) -> str:
+        return os.path.join(self.ckpt.cfg.batch_model_dir, self.day)
+
+    def completed_passes(self) -> int:
+        """Highest i with a DONE marker in <day>/pass-<i>, +1; 0 if none."""
+        root = self._pass_dir_root()
+        if not os.path.isdir(root):
+            return 0
+        best = -1
+        for name in os.listdir(root):
+            m = re.fullmatch(r"pass-(\d+)", name)
+            if m and os.path.exists(os.path.join(root, name, "DONE")):
+                best = max(best, int(m.group(1)))
+        return best + 1
+
+    def resume(self) -> int:
+        """Restore trainer state from the newest completed pass; returns
+        the number of passes already done (0 = fresh start)."""
+        done = self.completed_passes()
+        if done == 0:
+            return 0
+        params, opt_state, extra = self.ckpt.load_base(
+            os.path.join(self.day, f"pass-{done - 1}"))
+        self.trainer.params = params
+        self.trainer.opt_state = opt_state
+        async_table = getattr(self.trainer, "async_table", None)
+        if async_table is not None:
+            # async mode reads dense params from the host table, not
+            # trainer.params — restore there or resume silently diverges
+            st = extra.get("async_dense_state")
+            if st is None:
+                raise ValueError(
+                    "checkpoint has no async dense state but the trainer "
+                    "runs in async mode")
+            async_table.load_state(st)
+        prng = extra.get("table_prng")
+        if prng is not None:
+            import jax.numpy as jnp
+            self.trainer.table._prng = jnp.asarray(prng)
+        tprng = extra.get("trainer_prng")
+        if tprng is not None and hasattr(self.trainer, "_prng"):
+            import jax.numpy as jnp
+            self.trainer._prng = jnp.asarray(tprng)
+        sh_state = extra.get("shuffle_rng_state")
+        if sh_state is not None:
+            self.trainer._shuffle_rng.set_state(sh_state)
+        return done
+
+    # --------------------------------------------------------------- run
+    def _prune(self, done: int) -> None:
+        import shutil
+        for base in (self.ckpt.cfg.batch_model_dir,
+                     self.ckpt.cfg.xbox_model_dir):
+            root = os.path.join(base, self.day)
+            for i in range(done - self.keep_last):
+                d = os.path.join(root, f"pass-{i}")
+                if os.path.isdir(d):
+                    shutil.rmtree(d, ignore_errors=True)
+
+    def run(self, datasets, resume: bool = True) -> List[Dict[str, float]]:
+        """Train the dataset sequence with per-pass checkpointing and
+        elastic fault checks. On DeadRankError the exception propagates —
+        the scheduler restarts the job and this method resumes."""
+        done = self.resume() if resume else 0
+        stats: List[Dict[str, float]] = []
+        for i, ds in enumerate(datasets):
+            if i < done:
+                continue
+            if self.elastic is not None:
+                self.elastic.check()  # pass boundary = fault check point
+            stats.append(self.trainer.train_pass(ds))
+            extra = {"completed_passes": i + 1,
+                     "shuffle_rng_state":
+                         self.trainer._shuffle_rng.get_state()}
+            if hasattr(self.trainer.table, "_prng"):
+                extra["table_prng"] = np.asarray(self.trainer.table._prng)
+            if hasattr(self.trainer, "_prng"):
+                extra["trainer_prng"] = np.asarray(self.trainer._prng)
+            async_table = getattr(self.trainer, "async_table", None)
+            if async_table is not None:
+                async_table.wait_drained()
+                extra["async_dense_state"] = async_table.state()
+            self.ckpt.save_base(self.trainer.params, self.trainer.opt_state,
+                                day=os.path.join(self.day, f"pass-{i}"),
+                                extra=extra)
+            self.ckpt.wait()
+            self._prune(i + 1)
+        return stats

@@ -1,0 +1,66 @@
+"""Heterogenous parallel (HeterWrapper/HeterXpuTrainer analog): CPU worker
+does data + sparse PS traffic, the dense fwd/bwd runs in a separate
+accelerator service over RPC."""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config.configs import SparseOptimizerConfig, TableConfig
+from paddlebox_tpu.data import BoxDataset, write_synthetic_ctr_files
+from paddlebox_tpu.fleet.heter import (HeterDenseClient, HeterDenseService,
+                                       HeterTrainer)
+from paddlebox_tpu.metrics.auc import BasicAucCalculator
+from paddlebox_tpu.models import CtrDnn
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.ps import PsLocalClient
+
+D = 4
+NUM_SLOTS = 4
+
+
+@pytest.fixture(scope="module")
+def data(tmp_path_factory):
+    out = tmp_path_factory.mktemp("heter")
+    files, feed = write_synthetic_ctr_files(
+        str(out), num_files=2, lines_per_file=300, num_slots=NUM_SLOTS,
+        vocab_per_slot=100, max_len=3, seed=31)
+    feed = type(feed)(slots=feed.slots, batch_size=32)
+    return files, feed
+
+
+def test_heter_offload_learns(data):
+    files, feed = data
+    table_cfg = TableConfig(
+        embedx_dim=D, optimizer=SparseOptimizerConfig(
+            mf_create_thresholds=0.0, mf_initial_range=1e-3,
+            feature_learning_rate=0.2, mf_learning_rate=0.2))
+    model = CtrDnn(ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + D),
+                   hidden=(16,))
+    service = HeterDenseService(model, feed, dense_lr=0.01, seed=0)
+    heter = HeterDenseClient("127.0.0.1", service.port)
+    trainer = HeterTrainer(PsLocalClient(), heter, table_cfg, feed, seed=0)
+    trainer.metrics.init_metric("auc", "label", "pred",
+                                table_size=1 << 14, mask_var="mask")
+    for _ in range(8):
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        trainer.train_pass(ds)
+        ds.release_memory()
+
+    # fresh test-mode eval over the service's eval_step; create=False pulls
+    # must not insert rows server-side
+    n_before = trainer.client.sparse_size(HeterTrainer.SPARSE_TABLE)
+    ds = BoxDataset(feed, read_threads=1)
+    ds.set_filelist(files)
+    preds, labels = trainer.predict_pass(ds)
+    calc = BasicAucCalculator(1 << 14)
+    calc.add_data(preds, labels)
+    calc.compute()
+    assert calc.auc() > 0.7, calc.auc()
+    assert trainer.client.sparse_size(HeterTrainer.SPARSE_TABLE) == n_before
+
+    # sparse features were created on the CPU PS, not in the service
+    assert n_before > 100
+    trainer.close()
+    heter.stop_server()
+    heter.close()
