@@ -164,3 +164,7 @@ class TrainerConfig:
     profile: bool = False
     scan_chunk: int = 8                  # batches fused per device dispatch
                                          # (lax.scan megastep); 1 = off
+    # dense-tower compute dtype: "float32" | "bfloat16" (mixed precision —
+    # params/optimizer state stay f32, matmuls run bf16 on the MXU; bf16
+    # keeps f32's exponent range so CTR losses need no loss scaling)
+    compute_dtype: str = "float32"

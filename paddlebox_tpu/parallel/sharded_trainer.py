@@ -166,6 +166,11 @@ class ShardedBoxTrainer:
         sharding_mode = self.sharding_mode
         k_step = self.k_step
         lr = self.cfg.dense_lr
+        from paddlebox_tpu.train.trainer import (apply_mixed_precision,
+                                                 mixed_logits_to_f32,
+                                                 resolve_compute_dtype)
+        cdtype = resolve_compute_dtype(self.cfg.compute_dtype)
+        mixed = cdtype != jnp.float32
 
         def shard_step(slab, params, opt_state, batch, prng):
             # per-device views: slab [1, C, W]; batch leaves [1, ...]
@@ -193,11 +198,19 @@ class ShardedBoxTrainer:
             def loss_fn(params, emb):
                 pooled = fused_seqpool_cvm(
                     emb, batch["segments"], batch["valid"], B, S, use_cvm)
+                dense_in = batch.get("dense")
+                if mixed:
+                    # bf16 matmul path; f32 master params — the same
+                    # shared contract as the single-host trainer
+                    params, pooled, dense_in = apply_mixed_precision(
+                        params, pooled, dense_in, cdtype)
                 if wants_rank_offset and "rank_offset" in batch:
-                    logits = model.apply(params, pooled, batch.get("dense"),
+                    logits = model.apply(params, pooled, dense_in,
                                          rank_offset=batch["rank_offset"])
                 else:
-                    logits = model.apply(params, pooled, batch.get("dense"))
+                    logits = model.apply(params, pooled, dense_in)
+                if mixed:
+                    logits = mixed_logits_to_f32(logits)
                 ins_valid = batch["ins_valid"]
                 if multi_task:
                     labels = {t: batch["labels_" + t] for t in model.task_names}

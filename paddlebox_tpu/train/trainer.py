@@ -50,15 +50,18 @@ class TrainStepFns:
     num_slots: int
     # scan_steps(slab, params, opt_state, stacked_batches, prng) runs a
     # whole chunk of batches inside ONE dispatch (lax.scan over the leading
-    # axis) — measured 6.8x step throughput on v5e vs per-step dispatch
+    # axis), amortizing dispatch overhead (1.11x honest-sync on CPU where
+    # compute dominates; the win grows with faster devices — round-1's
+    # "6.8x on v5e" figure was measured with the axon backend's broken
+    # block_until_ready and is retracted, see BASELINE.md)
     scan_steps: Optional[Callable] = None
 
 
 def make_scan(step_fn: Callable) -> Callable:
     """Wrap a (slab, params, opt_state, batch, prng) step into a jitted
     megastep scanning a leading chunk axis of `stacked` — one dispatch runs
-    the whole chunk back-to-back on device (6.8x step throughput on v5e vs
-    per-step python dispatch)."""
+    the whole chunk back-to-back on device, hiding per-step dispatch
+    latency."""
 
     @jax.jit
     def scan_steps(slab, params, opt_state, stacked, prng):
@@ -164,24 +167,70 @@ def model_accepts_rank_offset(model) -> bool:
         return False
 
 
+def resolve_compute_dtype(name: str) -> jnp.dtype:
+    """Validated compute dtype: f32 or bf16 only — the no-loss-scaling
+    mixed-precision contract relies on bf16's f32-sized exponent range
+    (f16 would need loss scaling this path doesn't implement)."""
+    d = jnp.dtype(name)
+    if d not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+        raise ValueError(
+            f"compute_dtype must be float32 or bfloat16, got {name!r}")
+    return d
+
+
+def cast_for_compute(tree, dtype):
+    """Mixed precision: float leaves → compute dtype (grads flow back
+    through the cast to the f32 master copies)."""
+    def _cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+    return jax.tree.map(_cast, tree)
+
+
+def apply_mixed_precision(params, pooled, dense_in, cdtype):
+    """The one casting contract both trainers share: inputs+params to the
+    compute dtype (logits are cast back by mixed_logits_to_f32)."""
+    pooled = pooled.astype(cdtype)
+    params = cast_for_compute(params, cdtype)
+    if dense_in is not None:
+        dense_in = dense_in.astype(cdtype)
+    return params, pooled, dense_in
+
+
+def mixed_logits_to_f32(logits):
+    return jax.tree.map(lambda x: x.astype(jnp.float32), logits)
+
+
 def make_train_step(model, layout: ValueLayout, table: TableConfig,
                     dense_opt: optax.GradientTransformation,
                     batch_size: int, num_slots: int,
                     use_cvm: bool = True,
-                    async_dense: bool = False) -> TrainStepFns:
+                    async_dense: bool = False,
+                    compute_dtype: str = "float32") -> TrainStepFns:
     conf = table.optimizer
     multi_task = len(getattr(model, "task_names", ("ctr",))) > 1
     wants_rank_offset = model_accepts_rank_offset(model)
+    cdtype = resolve_compute_dtype(compute_dtype)
+    mixed = cdtype != jnp.float32
 
     def forward(params, emb, batch, dn_extra):
         pooled = fused_seqpool_cvm(
             emb, batch["segments"], batch["valid"], batch_size, num_slots,
             use_cvm=use_cvm)
+        dense_in = batch.get("dense")
+        if mixed:
+            # matmuls ride the MXU in bf16; logits return to f32 for the
+            # loss (master params/opt state stay f32 outside)
+            params, pooled, dense_in = apply_mixed_precision(
+                params, pooled, dense_in, cdtype)
         if wants_rank_offset and "rank_offset" in batch:
-            logits = model.apply(params, pooled, batch.get("dense"),
+            logits = model.apply(params, pooled, dense_in,
                                  rank_offset=batch["rank_offset"])
         else:
-            logits = model.apply(params, pooled, batch.get("dense"))
+            logits = model.apply(params, pooled, dense_in)
+        if mixed:
+            logits = mixed_logits_to_f32(logits)
         ins_valid = batch["ins_valid"]
         if multi_task:
             labels = {t: batch["labels_" + t] for t in model.task_names}
@@ -285,7 +334,8 @@ class BoxTrainer:
         self.fns = make_train_step(
             model, self.table.layout, table_cfg, self.dense_opt,
             feed.batch_size, self.num_slots, use_cvm,
-            async_dense=self.async_mode)
+            async_dense=self.async_mode,
+            compute_dtype=self.cfg.compute_dtype)
         self.async_table = None
         self._unravel = None
         if self.async_mode:

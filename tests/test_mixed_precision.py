@@ -1,0 +1,80 @@
+"""bf16 mixed-precision dense compute (TrainerConfig.compute_dtype):
+matmuls run in the compute dtype, master params/opt state stay f32, and
+learning survives the precision drop."""
+
+import jax
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config.configs import (SparseOptimizerConfig, TableConfig,
+                                          TrainerConfig)
+from paddlebox_tpu.data import BoxDataset, write_synthetic_ctr_files
+from paddlebox_tpu.metrics.auc import BasicAucCalculator
+from paddlebox_tpu.models import CtrDnn
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.parallel.mesh import device_mesh_1d
+from paddlebox_tpu.parallel.sharded_trainer import ShardedBoxTrainer
+from paddlebox_tpu.train.trainer import BoxTrainer
+
+D = 4
+NUM_SLOTS = 4
+
+
+@pytest.fixture(scope="module")
+def data(tmp_path_factory):
+    out = tmp_path_factory.mktemp("bf16")
+    files, feed = write_synthetic_ctr_files(
+        str(out), num_files=2, lines_per_file=300, num_slots=NUM_SLOTS,
+        vocab_per_slot=80, max_len=3, seed=21)
+    feed = type(feed)(slots=feed.slots, batch_size=32)
+    return files, feed
+
+
+def table_cfg():
+    return TableConfig(
+        embedx_dim=D, pass_capacity=1 << 13,
+        optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                        mf_initial_range=1e-3,
+                                        feature_learning_rate=0.2,
+                                        mf_learning_rate=0.2))
+
+
+def test_bf16_box_trainer_learns(data):
+    files, feed = data
+    trainer = BoxTrainer(
+        CtrDnn(ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + D), hidden=(16,)),
+        table_cfg(), feed,
+        TrainerConfig(dense_lr=0.01, compute_dtype="bfloat16"), seed=0)
+    for _ in range(6):
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        trainer.train_pass(ds)
+        ds.release_memory()
+    # master params stayed f32
+    for leaf in jax.tree.leaves(trainer.params):
+        assert leaf.dtype == np.float32, leaf.dtype
+    ds = BoxDataset(feed, read_threads=1)
+    ds.set_filelist(files)
+    trainer.table.begin_feed_pass()
+    ds.load_into_memory(add_keys_fn=trainer.table.add_keys)
+    trainer.table.end_feed_pass()
+    preds, labels = trainer.predict_batches(ds)
+    calc = BasicAucCalculator(1 << 14)
+    calc.add_data(preds, labels)
+    calc.compute()
+    assert calc.auc() > 0.68, calc.auc()
+
+
+def test_bf16_sharded_trainer_step(data):
+    files, feed = data
+    trainer = ShardedBoxTrainer(
+        CtrDnn(ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + D), hidden=(16,)),
+        table_cfg(), feed,
+        TrainerConfig(dense_lr=0.01, compute_dtype="bfloat16", scan_chunk=1),
+        mesh=device_mesh_1d(8), seed=0)
+    ds = BoxDataset(feed, read_threads=1)
+    ds.set_filelist(files)
+    stats = trainer.train_pass(ds)
+    assert np.isfinite(stats["loss"])
+    for leaf in jax.tree.leaves(trainer.params):
+        assert leaf.dtype == np.float32, leaf.dtype
