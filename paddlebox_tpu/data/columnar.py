@@ -30,6 +30,7 @@ class ColumnarBlock:
     labels: np.ndarray      # [N] int32
     rec_offsets: np.ndarray  # [N+1] int64
     dense: Optional[np.ndarray] = None  # [N, dense_dim] float32
+    task_labels: Optional[dict] = None  # task → [N] int32
 
     @property
     def n_recs(self) -> int:
@@ -40,8 +41,8 @@ class ColumnarBlock:
         return self.keys.shape[0]
 
     @staticmethod
-    def from_key_rec(keys, key_slot, key_rec, labels, dense=None
-                     ) -> "ColumnarBlock":
+    def from_key_rec(keys, key_slot, key_rec, labels, dense=None,
+                     task_labels=None) -> "ColumnarBlock":
         """From parser output where key_rec[i] is each key's record index
         (keys already grouped by record)."""
         n = labels.shape[0]
@@ -50,7 +51,8 @@ class ColumnarBlock:
         offsets = np.zeros(n + 1, dtype=np.int64)
         np.cumsum(counts, out=offsets[1:])
         return ColumnarBlock(keys=keys, key_slot=key_slot, labels=labels,
-                             rec_offsets=offsets, dense=dense)
+                             rec_offsets=offsets, dense=dense,
+                             task_labels=task_labels)
 
     @staticmethod
     def concat(blocks: Sequence["ColumnarBlock"]) -> "ColumnarBlock":
@@ -71,7 +73,13 @@ class ColumnarBlock:
         dense = None
         if blocks[0].dense is not None:
             dense = np.concatenate([b.dense for b in blocks])
-        return ColumnarBlock(keys, key_slot, labels, rec_offsets, dense)
+        task_labels = None
+        if blocks[0].task_labels is not None:
+            task_labels = {t: np.concatenate([b.task_labels[t]
+                                              for b in blocks])
+                           for t in blocks[0].task_labels}
+        return ColumnarBlock(keys, key_slot, labels, rec_offsets, dense,
+                             task_labels)
 
 
 def pack_columnar(block: ColumnarBlock, rec_idx: np.ndarray,
@@ -100,6 +108,13 @@ def pack_columnar(block: ColumnarBlock, rec_idx: np.ndarray,
         dense = np.zeros((B, block.dense.shape[1]), np.float32)
         dense[:n] = block.dense[rec_idx]
     qvalues = np.zeros(B, dtype=np.float32)
+    task_labels = None
+    if block.task_labels is not None:
+        task_labels = {}
+        for t, col in block.task_labels.items():
+            arr = np.zeros(B, dtype=np.int32)
+            arr[:n] = col[rec_idx]
+            task_labels[t] = arr
 
     keys = np.zeros(kcap, dtype=np.uint64)
     slots = np.zeros(kcap, dtype=np.int32)
@@ -132,7 +147,9 @@ def pack_columnar(block: ColumnarBlock, rec_idx: np.ndarray,
 
     return PackedBatch(keys=keys, slots=slots, segments=segments, valid=valid,
                        labels=labels, ins_valid=ins_valid, dense=dense,
-                       n_ins=n, qvalues=qvalues)
+                       n_ins=n, qvalues=qvalues,
+                       cmatch_rank=np.zeros(B, dtype=np.uint64),
+                       task_labels=task_labels)
 
 
 def _run_aranges(counts: np.ndarray) -> np.ndarray:

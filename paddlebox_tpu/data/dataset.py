@@ -52,6 +52,15 @@ class BoxDataset:
         # numpy-only batch packing (no per-record Python objects). Default:
         # on when the native lib builds and no cross-host shuffler is
         # attached (the shuffle transport routes SlotRecord objects).
+        # task-label config errors fail loudly on EVERY host (the native
+        # parser would raise only where the lib builds; the record path
+        # would silently substitute the click label)
+        slot_names = {s.name for s in feed.slots}
+        for task, slot_name in getattr(feed, "task_label_slots", ()):
+            if slot_name not in slot_names:
+                raise ValueError(
+                    f"task_label_slots: slot {slot_name!r} (task {task!r}) "
+                    f"not in the feed config")
         self._native_parser = None
         if columnar is None:
             columnar = shuffler is None
@@ -64,10 +73,10 @@ class BoxDataset:
             # pv rank-offset matrices are built from per-record pv fields
             # (search_id/rank/cmatch) which the columnar blocks don't carry
             columnar = False
-        if columnar and getattr(feed, "task_label_slots", ()):
-            # per-task labels ride SlotRecord.extra_labels; the native
-            # columnar block carries only the click label
-            columnar = False
+        # per-task label feeds ride the columnar path too: the extended
+        # native entry (psr_parse_file2) emits task-label columns; the
+        # NativeMultiSlotParser constructor raises if the lib lacks it,
+        # which downgrades to the record path below
         if columnar:
             try:
                 from paddlebox_tpu.data.native_parser import \

@@ -41,16 +41,40 @@ class NativeMultiSlotParser:
             if s.name == label_slot:
                 label_idx = i
         self._label_idx = label_idx
+        # per-task label slot indices (task_label_slots config); needs the
+        # extended native entry
+        name_to_idx = {s.name: i for i, s in enumerate(slots)}
+        self._task_names = []
+        task_idx = []
+        for task, slot_name in getattr(feed, "task_label_slots", ()):
+            if slot_name not in name_to_idx:
+                raise ValueError(f"task label slot {slot_name!r} not in feed")
+            self._task_names.append(task)
+            task_idx.append(name_to_idx[slot_name])
+        self._task_idx = np.asarray(task_idx, np.int32)
+        if self._task_names and not hasattr(lib, "psr_parse_file2"):
+            raise RuntimeError(
+                "native parser lacks psr_parse_file2 (task labels)")
 
     def parse_file_columnar(self, path: str) -> ColumnarBlock:
         lib = self._lib
         c = ctypes
-        handle = lib.psr_parse_file(
-            path.encode(),
-            self._slot_types.ctypes.data_as(c.POINTER(c.c_int32)),
-            self._used.ctypes.data_as(c.POINTER(c.c_int32)),
-            self._dense_dims.ctypes.data_as(c.POINTER(c.c_int32)),
-            c.c_int32(self._slot_types.size), c.c_int32(self._label_idx))
+        if self._task_names:
+            handle = lib.psr_parse_file2(
+                path.encode(),
+                self._slot_types.ctypes.data_as(c.POINTER(c.c_int32)),
+                self._used.ctypes.data_as(c.POINTER(c.c_int32)),
+                self._dense_dims.ctypes.data_as(c.POINTER(c.c_int32)),
+                c.c_int32(self._slot_types.size), c.c_int32(self._label_idx),
+                self._task_idx.ctypes.data_as(c.POINTER(c.c_int32)),
+                c.c_int32(len(self._task_names)))
+        else:
+            handle = lib.psr_parse_file(
+                path.encode(),
+                self._slot_types.ctypes.data_as(c.POINTER(c.c_int32)),
+                self._used.ctypes.data_as(c.POINTER(c.c_int32)),
+                self._dense_dims.ctypes.data_as(c.POINTER(c.c_int32)),
+                c.c_int32(self._slot_types.size), c.c_int32(self._label_idx))
         if not handle:
             raise FileNotFoundError(path)
         try:
@@ -76,7 +100,15 @@ class NativeMultiSlotParser:
                 dense = np.ctypeslib.as_array(
                     lib.psr_dense(handle),
                     shape=(n_recs, dense_dim)).astype(np.float32, copy=True)
+            task_labels = None
+            if self._task_names and n_recs:
+                tl = np.ctypeslib.as_array(
+                    lib.psr_task_labels(handle),
+                    shape=(n_recs, len(self._task_names))).astype(
+                        np.int32, copy=True)
+                task_labels = {t: tl[:, i]
+                               for i, t in enumerate(self._task_names)}
             return ColumnarBlock.from_key_rec(keys, key_slot, key_rec,
-                                             labels, dense)
+                                             labels, dense, task_labels)
         finally:
             lib.psr_free(handle)

@@ -62,6 +62,17 @@ def _bind_parser(lib: ctypes.CDLL) -> ctypes.CDLL:
         fn.argtypes = [c.c_void_p]
     lib.psr_free.restype = None
     lib.psr_free.argtypes = [c.c_void_p]
+    # optional extended entry (older plugin .so files may lack it)
+    if hasattr(lib, "psr_parse_file2"):
+        lib.psr_parse_file2.restype = c.c_void_p
+        lib.psr_parse_file2.argtypes = [c.c_char_p, P(c.c_int32),
+                                        P(c.c_int32), P(c.c_int32),
+                                        c.c_int32, c.c_int32,
+                                        P(c.c_int32), c.c_int32]
+        lib.psr_n_tasks.restype = c.c_int32
+        lib.psr_n_tasks.argtypes = [c.c_void_p]
+        lib.psr_task_labels.restype = P(c.c_int32)
+        lib.psr_task_labels.argtypes = [c.c_void_p]
     return lib
 
 

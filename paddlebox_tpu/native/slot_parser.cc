@@ -26,10 +26,12 @@ struct ParsedFile {
   int64_t* key_rec = nullptr;   // [n_keys] record index
   int32_t* labels = nullptr;    // [n_recs]
   float* dense = nullptr;       // [n_recs * dense_dim] (row-major)
+  int32_t* task_labels = nullptr;  // [n_recs * n_tasks] (row-major)
   int64_t n_keys = 0;
   int64_t n_recs = 0;
   int64_t n_bad = 0;
   int32_t dense_dim = 0;
+  int32_t n_tasks = 0;
 };
 
 inline const char* skip_ws(const char* p, const char* end) {
@@ -67,9 +69,13 @@ extern "C" {
 // Parse a whole file. Returns nullptr on open failure. Caller frees with
 // psr_free(). dense layout: for each record, used float slots packed in
 // config order at their fixed dims (dense_dims[i] per used float slot).
-ParsedFile* psr_parse_file(const char* path, const int32_t* slot_types,
-                           const int32_t* used, const int32_t* dense_dims,
-                           int32_t n_slots, int32_t label_slot) {
+// task_slots[t] (may be null/n_tasks=0): slot indices whose first value is
+// task t's label (multi-task heads, metrics.h MultiTask); a record missing
+// that slot's value defaults to the click label (packer parity).
+ParsedFile* psr_parse_file2(const char* path, const int32_t* slot_types,
+                            const int32_t* used, const int32_t* dense_dims,
+                            int32_t n_slots, int32_t label_slot,
+                            const int32_t* task_slots, int32_t n_tasks) {
   FILE* f = fopen(path, "rb");
   if (!f) return nullptr;
   fseek(f, 0, SEEK_END);
@@ -89,6 +95,7 @@ ParsedFile* psr_parse_file(const char* path, const int32_t* slot_types,
   std::vector<int64_t> key_rec;
   std::vector<int32_t> labels;
   std::vector<float> dense;
+  std::vector<int32_t> task_labels;
   keys.reserve(1 << 16);
   int64_t n_bad = 0;
 
@@ -97,6 +104,8 @@ ParsedFile* psr_parse_file(const char* path, const int32_t* slot_types,
   std::vector<float> dense_row(static_cast<size_t>(dense_dim), 0.0f);
   std::vector<uint64_t> rec_keys;
   std::vector<int32_t> rec_slot;
+  std::vector<int32_t> tl_row(static_cast<size_t>(n_tasks), 0);
+  std::vector<uint8_t> tl_seen(static_cast<size_t>(n_tasks), 0);
 
   while (p < bend) {
     const char* line_end = static_cast<const char*>(
@@ -115,14 +124,22 @@ ParsedFile* psr_parse_file(const char* path, const int32_t* slot_types,
     rec_keys.clear();
     rec_slot.clear();
     std::fill(dense_row.begin(), dense_row.end(), 0.0f);
+    std::fill(tl_seen.begin(), tl_seen.end(), 0);
 
     for (int s = 0; s < n_slots && ok; ++s) {
       uint64_t cnt = 0;
       if (!parse_u64(q, line_end, &cnt)) { ok = false; break; }
+      int task = -1;  // n_tasks is tiny (a few heads): linear scan
+      for (int t = 0; t < n_tasks; ++t)
+        if (task_slots[t] == s) { task = t; break; }
       if (slot_types[s] == 0) {
         for (uint64_t j = 0; j < cnt; ++j) {
           uint64_t v;
           if (!parse_u64(q, line_end, &v)) { ok = false; break; }
+          if (task >= 0 && j == 0) {
+            tl_row[task] = static_cast<int32_t>(v);
+            tl_seen[task] = 1;
+          }
           if (used[s]) {
             rec_keys.push_back(v);
             rec_slot.push_back(u_ord);
@@ -134,6 +151,10 @@ ParsedFile* psr_parse_file(const char* path, const int32_t* slot_types,
           float v;
           if (!parse_f32(q, line_end, &v)) { ok = false; break; }
           if (s == label_slot && j == 0) label = static_cast<int32_t>(v);
+          if (task >= 0 && j == 0) {
+            tl_row[task] = static_cast<int32_t>(v);
+            tl_seen[task] = 1;
+          }
           if (used[s] && static_cast<int>(j) < dense_dims[s])
             dense_row[static_cast<size_t>(d_off) + j] = v;
         }
@@ -148,6 +169,8 @@ ParsedFile* psr_parse_file(const char* path, const int32_t* slot_types,
     }
     int64_t rec = static_cast<int64_t>(labels.size());
     labels.push_back(label);
+    for (int t = 0; t < n_tasks; ++t)
+      task_labels.push_back(tl_seen[t] ? tl_row[t] : label);
     for (size_t j = 0; j < rec_keys.size(); ++j) {
       keys.push_back(rec_keys[j]);
       key_slot.push_back(rec_slot[j]);
@@ -177,8 +200,22 @@ ParsedFile* psr_parse_file(const char* path, const int32_t* slot_types,
       out->dense = static_cast<float*>(malloc(dense.size() * 4));
       memcpy(out->dense, dense.data(), dense.size() * 4);
     }
+    if (n_tasks) {
+      out->n_tasks = n_tasks;
+      out->task_labels =
+          static_cast<int32_t*>(malloc(task_labels.size() * 4));
+      memcpy(out->task_labels, task_labels.data(), task_labels.size() * 4);
+    }
   }
   return out;
+}
+
+// Legacy entry (pre-task-label plugin ABI): no task label extraction.
+ParsedFile* psr_parse_file(const char* path, const int32_t* slot_types,
+                           const int32_t* used, const int32_t* dense_dims,
+                           int32_t n_slots, int32_t label_slot) {
+  return psr_parse_file2(path, slot_types, used, dense_dims, n_slots,
+                         label_slot, nullptr, 0);
 }
 
 int64_t psr_n_keys(ParsedFile* p) { return p->n_keys; }
@@ -190,6 +227,8 @@ int32_t* psr_key_slot(ParsedFile* p) { return p->key_slot; }
 int64_t* psr_key_rec(ParsedFile* p) { return p->key_rec; }
 int32_t* psr_labels(ParsedFile* p) { return p->labels; }
 float* psr_dense(ParsedFile* p) { return p->dense; }
+int32_t psr_n_tasks(ParsedFile* p) { return p->n_tasks; }
+int32_t* psr_task_labels(ParsedFile* p) { return p->task_labels; }
 
 void psr_free(ParsedFile* p) {
   if (!p) return;
@@ -198,6 +237,7 @@ void psr_free(ParsedFile* p) {
   free(p->key_rec);
   free(p->labels);
   free(p->dense);
+  free(p->task_labels);
   delete p;
 }
 

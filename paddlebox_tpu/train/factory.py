@@ -22,14 +22,37 @@ def register_trainer(name: str, ctor: Callable) -> None:
     _REGISTRY[name] = ctor
 
 
+def _psgpu_trainer(*args, ps_client=None, ps_table_id=0, **kwargs):
+    """PSGPUTrainer: the sharded trainer with its shard stores behind the
+    distributed CPU PS (the BuildPull/EndPass composition,
+    ps_gpu_wrapper.cc:337-760). ps_client is required — that's the whole
+    point of the GPUPS path."""
+    from paddlebox_tpu.embedding.ps_store import ps_store_factory
+    from paddlebox_tpu.parallel.sharded_trainer import ShardedBoxTrainer
+    if ps_client is None:
+        raise ValueError("PSGPUTrainer needs ps_client= (a PS client whose "
+                         "sparse table backs the pass slabs)")
+    return ShardedBoxTrainer(
+        *args, store_factory=ps_store_factory(ps_client, ps_table_id),
+        **kwargs)
+
+
 def _builtin(name: str):
     # lazy imports: trainers pull in jax
     if name in ("BoxPSTrainer", "MultiTrainer", "DistMultiTrainer"):
         from paddlebox_tpu.train.trainer import BoxTrainer
         return BoxTrainer
-    if name in ("ShardedBoxTrainer", "PSGPUTrainer", "HeterXpuTrainer"):
+    if name == "ShardedBoxTrainer":
         from paddlebox_tpu.parallel.sharded_trainer import ShardedBoxTrainer
         return ShardedBoxTrainer
+    if name == "PSGPUTrainer":
+        return _psgpu_trainer
+    if name in ("HeterXpuTrainer", "HeterTrainer"):
+        from paddlebox_tpu.fleet.heter import HeterTrainer
+        return HeterTrainer
+    if name == "DownpourTrainer":
+        from paddlebox_tpu.ps.worker import DownpourTrainer
+        return DownpourTrainer
     if name in ("PipelineTrainer", "HeterPipelineTrainer"):
         from paddlebox_tpu.parallel.pipeline import GPipeRunner
         return GPipeRunner

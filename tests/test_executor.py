@@ -80,3 +80,46 @@ def test_interceptor_pipeline_cross_carrier():
     assert sorted(out) == [3, 6, 9, 12]
     c1.stop()
     c2.stop()
+
+
+def test_factory_resolves_round2_trainer_names():
+    """PSGPUTrainer builds the PS-backed sharded trainer; Heter/Downpour
+    names resolve (trainer_factory.cc:68-89 registry parity)."""
+    import numpy as np
+    import pytest as _pytest
+
+    from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
+                                              TableConfig, TrainerConfig)
+    from paddlebox_tpu.data.generator import default_feed_config
+    from paddlebox_tpu.fleet.heter import HeterTrainer
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.models.base import ModelSpec
+    from paddlebox_tpu.parallel.mesh import device_mesh_1d
+    from paddlebox_tpu.ps import PsLocalClient
+    from paddlebox_tpu.ps.worker import DownpourTrainer
+
+    assert create_trainer.__module__  # smoke: symbol available
+    feed = default_feed_config(num_slots=2, batch_size=16, max_len=2)
+    tcfg = TableConfig(embedx_dim=4, pass_capacity=8 * 64,
+                       optimizer=SparseOptimizerConfig())
+    cl = PsLocalClient()
+    cl.create_sparse_table(0, tcfg, shard_num=8, seed=0)
+    tr = create_trainer(
+        "PSGPUTrainer",
+        CtrDnn(ModelSpec(num_slots=2, slot_dim=7), hidden=(8,)),
+        tcfg, feed, TrainerConfig(), mesh=device_mesh_1d(8),
+        ps_client=cl, ps_table_id=0)
+    from paddlebox_tpu.embedding.ps_store import PSBackedStore
+    assert isinstance(tr.table.stores[0], PSBackedStore)
+    with _pytest.raises(ValueError):
+        create_trainer("PSGPUTrainer",
+                       CtrDnn(ModelSpec(num_slots=2, slot_dim=7),
+                              hidden=(8,)),
+                       tcfg, feed, TrainerConfig())
+    assert _builtin_resolves("HeterXpuTrainer") is HeterTrainer
+    assert _builtin_resolves("DownpourTrainer") is DownpourTrainer
+
+
+def _builtin_resolves(name):
+    from paddlebox_tpu.train import factory
+    return factory._builtin(name)

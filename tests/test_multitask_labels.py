@@ -153,3 +153,27 @@ def test_multi_task_metric_selects_pred_per_pair():
     msg = reg.get_metric_msg("mt_auc")
     assert msg["size"] == n
     np.testing.assert_allclose(msg["auc"], oracle.auc(), rtol=1e-9)
+
+
+def test_columnar_path_carries_task_labels(conv_data):
+    """The native columnar fast path must emit the same per-task labels as
+    the record path (psr_parse_file2)."""
+    from paddlebox_tpu.native.build import available
+
+    if not available():
+        pytest.skip("native library unavailable")
+    files, feed = conv_data
+    ds_col = BoxDataset(feed, read_threads=1, columnar=True)
+    assert ds_col.columnar, "columnar path should engage for task labels"
+    ds_col.set_filelist(files)
+    ds_col.load_into_memory()
+    ds_rec = BoxDataset(feed, read_threads=1, columnar=False)
+    ds_rec.set_filelist(files)
+    ds_rec.load_into_memory()
+    assert len(ds_col) == len(ds_rec)
+    b_col = ds_col.split_batches(num_workers=1)[0][0]
+    b_rec = ds_rec.split_batches(num_workers=1)[0][0]
+    assert b_col.task_labels is not None
+    np.testing.assert_array_equal(b_col.task_labels["cvr"],
+                                  b_rec.task_labels["cvr"])
+    np.testing.assert_array_equal(b_col.labels, b_rec.labels)
