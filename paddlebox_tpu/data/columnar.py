@@ -108,12 +108,18 @@ def pack_columnar(block: ColumnarBlock, rec_idx: np.ndarray,
         dense = np.zeros((B, block.dense.shape[1]), np.float32)
         dense[:n] = block.dense[rec_idx]
     qvalues = np.zeros(B, dtype=np.float32)
+    # presence keyed on the FEED config, not the block: a host whose file
+    # shard parsed zero records must emit the same batch schema as its
+    # peers (lockstep collectives; record-path packer parity)
+    task_names = [t for t, _ in getattr(feed, "task_label_slots", ())]
     task_labels = None
-    if block.task_labels is not None:
+    if task_names:
         task_labels = {}
-        for t, col in block.task_labels.items():
+        block_tl = block.task_labels or {}
+        for t in task_names:
             arr = np.zeros(B, dtype=np.int32)
-            arr[:n] = col[rec_idx]
+            col = block_tl.get(t)
+            arr[:n] = col[rec_idx] if col is not None else labels[:n]
             task_labels[t] = arr
 
     keys = np.zeros(kcap, dtype=np.uint64)

@@ -36,14 +36,10 @@ class NativeMultiSlotParser:
             [0 if s.type == "uint64" else 1 for s in slots], np.int32)
         self._used = np.array([1 if s.is_used else 0 for s in slots], np.int32)
         self._dense_dims = np.array([s.dim for s in slots], np.int32)
-        label_idx = -1
-        for i, s in enumerate(slots):
-            if s.name == label_slot:
-                label_idx = i
-        self._label_idx = label_idx
+        name_to_idx = {s.name: i for i, s in enumerate(slots)}
+        self._label_idx = name_to_idx.get(label_slot, -1)
         # per-task label slot indices (task_label_slots config); needs the
         # extended native entry
-        name_to_idx = {s.name: i for i, s in enumerate(slots)}
         self._task_names = []
         task_idx = []
         for task, slot_name in getattr(feed, "task_label_slots", ()):

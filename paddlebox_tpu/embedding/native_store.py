@@ -74,6 +74,19 @@ class NativeHostEmbeddingStore:
         self._lib.hs_lookup(self._h, _p(keys, _U64P), n, _p(rows, _I64P))
         return rows, np.zeros(n, bool)
 
+    def _dec_file_live(self, fname: str, n: int) -> None:
+        """Spill-file GC: drop n live rows from a block file; unlink when
+        none remain."""
+        live = self._file_live.get(fname, 0) - n
+        if live <= 0:
+            self._file_live.pop(fname, None)
+            try:
+                os.remove(fname)
+            except OSError:
+                pass
+        else:
+            self._file_live[fname] = live
+
     def _read_spilled(self, keys: np.ndarray, consume: bool) -> np.ndarray:
         """Read spilled rows for `keys` (all present in the spill index),
         one np.load per file. consume=True removes the index entries and
@@ -90,15 +103,7 @@ class NativeHostEmbeddingStore:
                 out[i] = block[off]
             if consume:
                 del block  # release the mmap before unlink
-                live = self._file_live.get(fname, 0) - len(pairs)
-                if live <= 0:
-                    self._file_live.pop(fname, None)
-                    try:
-                        os.remove(fname)
-                    except OSError:
-                        pass
-                else:
-                    self._file_live[fname] = live
+                self._dec_file_live(fname, len(pairs))
         if consume:
             stat_add("sparse_keys_faulted_in", int(keys.size))
         return out
@@ -157,6 +162,22 @@ class NativeHostEmbeddingStore:
         rows, _ = self._rows_of(keys, create=False)
         if (rows < 0).any():
             raise KeyError("write_back of unknown key")
+        vals = np.ascontiguousarray(values, dtype=np.float32)
+        self._lib.hs_scatter(self._h, _p(rows, _I64P), keys.size,
+                             _p(vals, _F32P))
+
+    def assign(self, keys: np.ndarray, values: np.ndarray) -> None:
+        """Create-or-overwrite rows verbatim (EndPass dump target): no
+        init rng draws for rows that are immediately overwritten — same
+        contract as HostEmbeddingStore.assign."""
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        if self._spilled:
+            # a stale spill entry must not resurrect over assigned values
+            for k in keys.tolist():
+                if k in self._spilled:
+                    fname, _ = self._spilled.pop(k)
+                    self._dec_file_live(fname, 1)
+        rows, _ = self._rows_of(keys, create=True)
         vals = np.ascontiguousarray(values, dtype=np.float32)
         self._lib.hs_scatter(self._h, _p(rows, _I64P), keys.size,
                              _p(vals, _F32P))

@@ -20,7 +20,7 @@ import numpy as np
 from paddlebox_tpu.config.configs import TableConfig
 from paddlebox_tpu.embedding import accessor as acc
 from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
-from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
+from paddlebox_tpu.embedding.native_store import make_host_store
 from paddlebox_tpu.ps.sgd_rule import numpy_apply_push
 
 
@@ -34,7 +34,10 @@ class SparseTable:
         self.push_layout = PushLayout(self.layout.embedx_dim,
                                       self.layout.expand_dim)
         self.shard_num = shard_num
-        self.shards = [HostEmbeddingStore(self.layout, table, seed=seed + i)
+        # native C++ store when it builds (bulk C calls per RPC instead of
+        # per-key Python dict loops), Python fallback otherwise — identical
+        # creation rng, so either backend serves the same rows
+        self.shards = [make_host_store(self.layout, table, seed=seed + i)
                        for i in range(shard_num)]
         self._locks = [threading.Lock() for _ in range(shard_num)]
         self._rngs = [np.random.RandomState(seed + 101 + i)

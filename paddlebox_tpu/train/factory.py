@@ -42,12 +42,15 @@ def _builtin(name: str):
     if name in ("BoxPSTrainer", "MultiTrainer", "DistMultiTrainer"):
         from paddlebox_tpu.train.trainer import BoxTrainer
         return BoxTrainer
-    if name == "ShardedBoxTrainer":
+    if name in ("ShardedBoxTrainer", "HeterXpuTrainer"):
+        # HeterXpuTrainer is the reference's ACCELERATOR-side trainer; the
+        # sharded trainer plays that role (the CPU-worker half is
+        # HeterTrainer below)
         from paddlebox_tpu.parallel.sharded_trainer import ShardedBoxTrainer
         return ShardedBoxTrainer
     if name == "PSGPUTrainer":
         return _psgpu_trainer
-    if name in ("HeterXpuTrainer", "HeterTrainer"):
+    if name in ("HeterTrainer", "HeterCpuWorker"):
         from paddlebox_tpu.fleet.heter import HeterTrainer
         return HeterTrainer
     if name == "DownpourTrainer":

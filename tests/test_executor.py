@@ -85,9 +85,6 @@ def test_interceptor_pipeline_cross_carrier():
 def test_factory_resolves_round2_trainer_names():
     """PSGPUTrainer builds the PS-backed sharded trainer; Heter/Downpour
     names resolve (trainer_factory.cc:68-89 registry parity)."""
-    import numpy as np
-    import pytest as _pytest
-
     from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
                                               TableConfig, TrainerConfig)
     from paddlebox_tpu.data.generator import default_feed_config
@@ -98,7 +95,6 @@ def test_factory_resolves_round2_trainer_names():
     from paddlebox_tpu.ps import PsLocalClient
     from paddlebox_tpu.ps.worker import DownpourTrainer
 
-    assert create_trainer.__module__  # smoke: symbol available
     feed = default_feed_config(num_slots=2, batch_size=16, max_len=2)
     tcfg = TableConfig(embedx_dim=4, pass_capacity=8 * 64,
                        optimizer=SparseOptimizerConfig())
@@ -111,13 +107,16 @@ def test_factory_resolves_round2_trainer_names():
         ps_client=cl, ps_table_id=0)
     from paddlebox_tpu.embedding.ps_store import PSBackedStore
     assert isinstance(tr.table.stores[0], PSBackedStore)
-    with _pytest.raises(ValueError):
+    with pytest.raises(ValueError):
         create_trainer("PSGPUTrainer",
                        CtrDnn(ModelSpec(num_slots=2, slot_dim=7),
                               hidden=(8,)),
                        tcfg, feed, TrainerConfig())
-    assert _builtin_resolves("HeterXpuTrainer") is HeterTrainer
+    assert _builtin_resolves("HeterTrainer") is HeterTrainer
     assert _builtin_resolves("DownpourTrainer") is DownpourTrainer
+    # HeterXpuTrainer keeps its accelerator-side mapping
+    from paddlebox_tpu.parallel.sharded_trainer import ShardedBoxTrainer
+    assert _builtin_resolves("HeterXpuTrainer") is ShardedBoxTrainer
 
 
 def _builtin_resolves(name):
