@@ -307,7 +307,8 @@ class ShardedBoxTrainer:
             out_specs=(spec_sh, par_out, opt_out, spec_rep, spec_sh,
                        spec_rep),
             check_vma=False)
-        return jax.jit(fn)
+        # slabs donated: one live copy of the (huge) pass slab per device
+        return jax.jit(fn, donate_argnums=(0,))
 
     def _build_param_sync(self):
         """K-step dense sync: allreduce-mean the diverged per-device param

@@ -63,7 +63,7 @@ def make_scan(step_fn: Callable) -> Callable:
     the whole chunk back-to-back on device, hiding per-step dispatch
     latency."""
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def scan_steps(slab, params, opt_state, stacked, prng):
         def body(carry, batch):
             slab, params, opt_state, prng = carry
@@ -257,9 +257,13 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         return push_sparse_dedup(slab, batch["ids"], push_grads, sub, layout,
                                  conf)
 
-    # NOT donated: measured on v5e, donating the slab forces a serialized
-    # in-place update chain (118us/step vs 92 without); XLA's non-donated
-    # scatter pipeline overlaps better and wins
+    # The slab is DONATED into the step: at production pass capacities the
+    # slab is hundreds of MB and the pass holds exactly one live copy, so
+    # non-donated steps would double peak HBM. (Round-1 recorded "donation
+    # measured slower on v5e" — that timing used the axon backend's broken
+    # block_until_ready and is retracted, BASELINE.md.) Donation is honored
+    # on every backend incl. CPU: the input slab buffer is DEAD after the
+    # call — rebind (set_slab/carry) before any further read.
     def _step_impl(slab, params, opt_state, batch, prng):
         # split on device: host-side per-step RNG dispatch costs more than
         # the whole compiled step (2 sync dispatches ≈ 200us)
@@ -276,10 +280,10 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         slab = _sparse_push(slab, demb, batch, sub)
         return slab, params, opt_state, loss, preds, prng
 
-    step = jax.jit(_step_impl)
+    step = jax.jit(_step_impl, donate_argnums=(0,))
     scan_steps = make_scan(_step_impl)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def step_async(slab, params, batch, prng):
         """Async-dense variant: dense grads come back flat for the host
         table; only the sparse push happens on device

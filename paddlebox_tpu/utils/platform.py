@@ -1,0 +1,21 @@
+"""Platform selection helpers."""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_if_requested() -> None:
+    """Honor an explicit JAX_PLATFORMS=cpu request.
+
+    Some environments (e.g. an accelerator vendor's sitecustomize) call
+    jax.config.update("jax_platforms", ...) at interpreter start, which
+    overrides the JAX_PLATFORMS env var — re-assert the user's cpu choice
+    before any backend initializes. Only acts when "cpu" is the FIRST
+    platform listed (a trailing fallback entry like "tpu,cpu" is not a
+    cpu request)."""
+    plats = [p.strip() for p in
+             os.environ.get("JAX_PLATFORMS", "").split(",") if p.strip()]
+    if plats and plats[0] == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
