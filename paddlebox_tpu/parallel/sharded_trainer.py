@@ -138,6 +138,7 @@ class ShardedBoxTrainer:
         self._shuffle_rng = np.random.RandomState(seed + 1)
         self.timers = {n: Timer() for n in ("step", "pass", "build")}
         self._step = self._build_step()
+        self._eval_step = None  # built lazily on first predict_batches
         self._param_sync = (self._build_param_sync() if self.k_step > 1
                             else None)
         self._steps_since_sync = 0
@@ -151,26 +152,73 @@ class ShardedBoxTrainer:
                             else None)
 
     # ------------------------------------------------------------ jit step
+    def _pull_and_forward(self):
+        """The ONE pull+forward contract shared by the train step and the
+        eval step: (pull_emb, forward_logits, preds_of). Changing the a2a
+        pull, mixed precision, or rank-offset handling here changes both
+        paths together."""
+        model = self.model
+        layout = self.table.layout
+        B = self.feed.batch_size
+        S = self.num_slots
+        use_cvm = self.use_cvm
+        axis = self.axis
+        from paddlebox_tpu.train.trainer import (apply_mixed_precision,
+                                                 mixed_logits_to_f32,
+                                                 model_accepts_rank_offset,
+                                                 resolve_compute_dtype)
+        wants_rank_offset = model_accepts_rank_offset(model)
+        cdtype = resolve_compute_dtype(self.cfg.compute_dtype)
+        mixed = cdtype != jnp.float32
+
+        def pull_emb(slab, batch):
+            # a2a ids → local gather → a2a values → restore
+            buckets = batch["buckets"]                       # [P, KB]
+            KB = buckets.shape[1]
+            Pn = buckets.shape[0]
+            req = jax.lax.all_to_all(buckets, axis, 0, 0, tiled=True)
+            vals = pull_sparse(slab, req.reshape(-1), layout)  # [P*KB, Dp]
+            resp = jax.lax.all_to_all(
+                vals.reshape(Pn, KB, -1), axis, 0, 0, tiled=True)
+            emb = resp.reshape(Pn * KB, -1)[batch["restore"]]  # [K, Dp]
+            return emb, req
+
+        def forward_logits(params, emb, batch):
+            pooled = fused_seqpool_cvm(
+                emb, batch["segments"], batch["valid"], B, S, use_cvm)
+            dense_in = batch.get("dense")
+            if mixed:
+                # bf16 matmul path; f32 master params — the same shared
+                # contract as the single-host trainer
+                params, pooled, dense_in = apply_mixed_precision(
+                    params, pooled, dense_in, cdtype)
+            if wants_rank_offset and "rank_offset" in batch:
+                logits = model.apply(params, pooled, dense_in,
+                                     rank_offset=batch["rank_offset"])
+            else:
+                logits = model.apply(params, pooled, dense_in)
+            if mixed:
+                logits = mixed_logits_to_f32(logits)
+            return logits
+
+        def preds_of(logits):
+            if self.multi_task:
+                return {t: jax.nn.sigmoid(lg) for t, lg in logits.items()}
+            return {"ctr": jax.nn.sigmoid(logits)}
+
+        return pull_emb, forward_logits, preds_of
+
     def _build_step(self):
         model = self.model
         layout = self.table.layout
         conf = self.table.config.optimizer
-        B = self.feed.batch_size
         S = self.num_slots
-        use_cvm = self.use_cvm
         multi_task = self.multi_task
         axis = self.axis
-        from paddlebox_tpu.train.trainer import model_accepts_rank_offset
-        wants_rank_offset = model_accepts_rank_offset(model)
-
         sharding_mode = self.sharding_mode
         k_step = self.k_step
         lr = self.cfg.dense_lr
-        from paddlebox_tpu.train.trainer import (apply_mixed_precision,
-                                                 mixed_logits_to_f32,
-                                                 resolve_compute_dtype)
-        cdtype = resolve_compute_dtype(self.cfg.compute_dtype)
-        mixed = cdtype != jnp.float32
+        pull_emb, forward_logits, preds_of = self._pull_and_forward()
 
         def shard_step(slab, params, opt_state, batch, prng):
             # per-device views: slab [1, C, W]; batch leaves [1, ...]
@@ -184,33 +232,12 @@ class ShardedBoxTrainer:
                 opt_state = jax.tree.map(lambda x: x[0], opt_state)
             prng, next_prng = jax.random.split(prng)
             prng = jax.random.fold_in(prng, jax.lax.axis_index(axis))
-            buckets = batch["buckets"]                       # [P, KB]
-            KB = buckets.shape[1]
-            Pn = buckets.shape[0]
-
-            # ---- pull: a2a ids → local gather → a2a values → restore
-            req = jax.lax.all_to_all(buckets, axis, 0, 0, tiled=True)
-            vals = pull_sparse(slab, req.reshape(-1), layout)  # [P*KB, Dp]
-            resp = jax.lax.all_to_all(
-                vals.reshape(Pn, KB, -1), axis, 0, 0, tiled=True)
-            emb = resp.reshape(Pn * KB, -1)[batch["restore"]]  # [K, Dp]
+            KB = batch["buckets"].shape[1]
+            Pn = batch["buckets"].shape[0]
+            emb, req = pull_emb(slab, batch)
 
             def loss_fn(params, emb):
-                pooled = fused_seqpool_cvm(
-                    emb, batch["segments"], batch["valid"], B, S, use_cvm)
-                dense_in = batch.get("dense")
-                if mixed:
-                    # bf16 matmul path; f32 master params — the same
-                    # shared contract as the single-host trainer
-                    params, pooled, dense_in = apply_mixed_precision(
-                        params, pooled, dense_in, cdtype)
-                if wants_rank_offset and "rank_offset" in batch:
-                    logits = model.apply(params, pooled, dense_in,
-                                         rank_offset=batch["rank_offset"])
-                else:
-                    logits = model.apply(params, pooled, dense_in)
-                if mixed:
-                    logits = mixed_logits_to_f32(logits)
+                logits = forward_logits(params, emb, batch)
                 ins_valid = batch["ins_valid"]
                 if multi_task:
                     labels = {t: batch["labels_" + t] for t in model.task_names}
@@ -462,6 +489,80 @@ class ShardedBoxTrainer:
         return {"loss": float(np.mean(losses)) if losses else 0.0,
                 "batches": len(dev_batches), "instances": len(dataset)}
 
+    # ------------------------------------------------------------- eval
+    def _build_eval_step(self):
+        """Forward-only shard_map step (the SetTestMode inference path —
+        no push, no dense update) over the SAME pull+forward closures as
+        the train step."""
+        pull_emb, forward_logits, preds_of = self._pull_and_forward()
+        k_step = self.k_step
+
+        def shard_eval(slab, params, batch):
+            slab = slab[0]
+            batch = jax.tree.map(lambda x: x[0], batch)
+            if k_step > 1:
+                params = jax.tree.map(lambda x: x[0], params)
+            emb, _req = pull_emb(slab, batch)
+            return preds_of(forward_logits(params, emb, batch))
+
+        spec_sh = P(self.axis)
+        par_in = spec_sh if self.k_step > 1 else P()
+        return jax.jit(jax.shard_map(
+            shard_eval, mesh=self.mesh,
+            in_specs=(spec_sh, par_in, spec_sh), out_specs=spec_sh,
+            check_vma=False))
+
+    def predict_batches(self, dataset: BoxDataset):
+        """Test-mode inference over a loaded dataset (SetTestMode,
+        box_wrapper.cc:183): no feature creation, no write-back. Returns
+        (preds, labels) over this process's valid instances."""
+        if self._eval_step is None:
+            self._eval_step = self._build_eval_step()
+        if len(dataset) == 0:
+            dataset.load_into_memory()
+        allgather = (self.fleet.all_gather if self.multiprocess else None)
+        self.table.set_test_mode(True)
+        try:
+            self.table.begin_feed_pass()
+            self.table.add_keys(dataset.all_keys())
+            self.table.end_feed_pass(allgather=allgather)
+            sharding = NamedSharding(self.mesh, P(self.axis))
+            slabs = self._put_sharded(
+                self.table.build_owned_slabs() if self.multiprocess
+                else self.table.build_slabs(), sharding)
+            nw = self.n_local if self.multiprocess else self.P
+            per_worker = dataset.split_batches(
+                num_workers=nw,
+                equalize=(self.fleet.equalize_batches()
+                          if self.multiprocess else None))
+            raw_steps = list(zip(*per_worker)) if per_worker[0] else []
+            # equalization pads short workers with WRAPPED (duplicate)
+            # batches so collectives stay lockstep; those batches still run
+            # but their predictions are excluded from the returned set
+            n = len(dataset)
+            per_w = (n + nw - 1) // nw
+            bs = self.feed.batch_size
+            real_batches = [
+                -(-max(0, min(per_w, n - w * per_w)) // bs)
+                for w in range(nw)]
+            main_task = (self.model.task_names[0] if self.multi_task
+                         else None)
+            preds_all, labels_all = [], []
+            for i, batch in enumerate(self.shard_batches(per_worker)):
+                preds = self._eval_step(slabs, self.params, batch)
+                key = main_task if main_task is not None else list(preds)[0]
+                main = self._local_rows(preds[key]).reshape(nw, -1)
+                for w, b in enumerate(raw_steps[i]):
+                    if i >= real_batches[w]:
+                        continue  # wrapped duplicate batch
+                    preds_all.append(main[w][b.ins_valid])
+                    labels_all.append(b.labels[b.ins_valid])
+        finally:
+            self.table.set_test_mode(False)
+        if not preds_all:
+            return np.empty(0, np.float32), np.empty(0, np.int32)
+        return np.concatenate(preds_all), np.concatenate(labels_all)
+
     def merged_params(self):
         """Single-copy dense params for eval/checkpoint (k_step mode keeps
         per-device replicas; others are already one copy)."""
@@ -490,7 +591,10 @@ class ShardedBoxTrainer:
         box MPI allreduce in Metric::calculate)."""
         if not self.metrics.metric_names():
             return
-        main = list(preds)[0]
+        # pytree dicts come back key-SORTED across the jit boundary, so
+        # the main task is named explicitly, not taken positionally
+        main = (self.model.task_names[0] if self.multi_task
+                else list(preds)[0])
         arr = self._local_rows(preds[main])   # [n_local, B]
         labels = np.stack([b.labels for b in step_batches])
         mask = np.stack([b.ins_valid for b in step_batches])

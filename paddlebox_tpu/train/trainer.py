@@ -368,7 +368,9 @@ class BoxTrainer:
         avail: Dict[str, np.ndarray] = {"label": b.labels}
         for t, p in preds.items():
             avail["pred_" + t] = np.asarray(p)
-        avail["pred"] = avail["pred_" + list(preds)[0]]
+        main = (self.model.task_names[0] if self.multi_task
+                else list(preds)[0])
+        avail["pred"] = avail["pred_" + main]
         tensors = {f: avail[f] for f in self.cfg.dump_fields if f in avail}
         if tensors:
             self.dump_writer.dump_batch(tensors, ins_ids=b.ins_ids,
@@ -521,7 +523,11 @@ class BoxTrainer:
             tensors["label_" + task] = lab
         for task, p in preds.items():
             tensors["pred_" + task] = np.asarray(p)
-        tensors["pred"] = tensors["pred_" + list(preds)[0]]
+        # jit returns pytree dicts key-sorted: name the main task, don't
+        # take it positionally
+        main = (self.model.task_names[0] if self.multi_task
+                else list(preds)[0])
+        tensors["pred"] = tensors["pred_" + main]
         self.metrics.add_batch(tensors)
 
     # ------------------------------------------------------------- eval
@@ -537,7 +543,9 @@ class BoxTrainer:
             ids = self.table.lookup_ids(b.keys, b.valid)
             batch = self.device_batch(b, ids)
             preds = self.fns.eval_step(self.table.slab, self.params, batch)
-            main = np.asarray(preds[list(preds)[0]])
+            key = (self.model.task_names[0] if self.multi_task
+                   else list(preds)[0])
+            main = np.asarray(preds[key])
             preds_all.append(main[b.ins_valid])
             labels_all.append(b.labels[b.ins_valid])
         self.table.end_pass()

@@ -239,3 +239,44 @@ def test_bucketize_max_key_sentinel():
         check()  # numpy fallback explicitly
     finally:
         stmod._route_lib = orig
+
+
+def test_sharded_predict_batches(sharded_setup):
+    """SetTestMode inference on the sharded trainer: forward-only a2a
+    pulls, no feature creation, ranking beats chance after training."""
+    files, feed = sharded_setup
+    trainer = make_sharded_trainer(feed, seed=3)
+    for _ in range(6):
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        trainer.train_pass(ds)
+        ds.release_memory()
+    rows_before = sum(len(st) for st in trainer.table.stores
+                      if st is not None)
+
+    ds = BoxDataset(feed, read_threads=1)
+    ds.set_filelist(files)
+    preds, labels = trainer.predict_batches(ds)
+    assert preds.size == labels.size == 1600
+    calc = BasicAucCalculator(1 << 14)
+    calc.add_data(preds, labels)
+    calc.compute()
+    assert calc.auc() > 0.62, calc.auc()
+    # test-mode pulls created nothing
+    rows_after = sum(len(st) for st in trainer.table.stores
+                     if st is not None)
+    assert rows_after == rows_before
+
+
+def test_sharded_predict_excludes_wrap_duplicates(sharded_setup):
+    """Equalization wraps short workers onto duplicate batches for lockstep
+    collectives; predict_batches must not count those instances."""
+    files, feed = sharded_setup
+    trainer = make_sharded_trainer(feed, seed=5)
+    ds = BoxDataset(feed, read_threads=1, columnar=False)
+    ds.set_filelist(files[:1])
+    ds.load_into_memory()
+    # shrink to 10 records: 8 workers → workers 5-7 run wrapped batches
+    ds._records = ds.records[:10]
+    preds, labels = trainer.predict_batches(ds)
+    assert preds.size == labels.size == 10
