@@ -124,7 +124,11 @@ def pack_columnar(block: ColumnarBlock, rec_idx: np.ndarray,
 
     keys = np.zeros(kcap, dtype=np.uint64)
     slots = np.zeros(kcap, dtype=np.int32)
-    segments = np.zeros(kcap, dtype=np.int32)
+    # padding tail pinned to the last segment id: the native parser emits
+    # keys per record in used-slot-ordinal order (slot_parser.cc config-order
+    # loop), so the whole vector stays nondecreasing and seqpool may declare
+    # indices_are_sorted (zero-masked padding leaves the last pool untouched)
+    segments = np.full(kcap, B * num_slots - 1, dtype=np.int32)
     valid = np.zeros(kcap, dtype=bool)
 
     if total:

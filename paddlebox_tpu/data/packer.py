@@ -70,7 +70,12 @@ class BatchPacker:
         n = min(len(records), B)
         keys = np.zeros(self.kcap, dtype=np.uint64)
         slots = np.zeros(self.kcap, dtype=np.int32)
-        segments = np.zeros(self.kcap, dtype=np.int32)
+        # padding tail pinned to the LAST segment id so the whole segment
+        # vector is nondecreasing (CSR write order is instance-major,
+        # slot-ascending) — seqpool can then declare indices_are_sorted;
+        # padding contributions are zero-masked by `valid` so the last
+        # segment's pool is unaffected
+        segments = np.full(self.kcap, B * self.num_slots - 1, dtype=np.int32)
         valid = np.zeros(self.kcap, dtype=bool)
         labels = np.zeros(B, dtype=np.int32)
         ins_valid = np.zeros(B, dtype=bool)
@@ -119,7 +124,6 @@ class BatchPacker:
                     off += d
         if dropped:
             stat_add("packer_keys_dropped", dropped)
-        # padding key slots point at segment 0 but are masked by valid=False
         batch = PackedBatch(keys=keys, slots=slots, segments=segments,
                             valid=valid, labels=labels, ins_valid=ins_valid,
                             dense=dense, n_ins=n, qvalues=qvalues,

@@ -206,6 +206,20 @@ def apply_push(values: jnp.ndarray, grads: jnp.ndarray, prng: jax.Array,
     return jnp.where(active, out, values)
 
 
+def _dispatch_apply_push(rows: jnp.ndarray, merged: jnp.ndarray,
+                         prng: jax.Array, layout: ValueLayout,
+                         conf: SparseOptimizerConfig) -> jnp.ndarray:
+    """One place that picks the in-table update kernel (Pallas adagrad when
+    flagged and applicable, XLA apply_push otherwise) for both push paths."""
+    from paddlebox_tpu.config import flags
+    if (flags.get_flag("use_pallas_push")
+            and layout.optimizer == "adagrad" and not layout.expand_dim):
+        from paddlebox_tpu.embedding.pallas_push import pallas_apply_push
+        seed = jax.random.randint(prng, (), 0, jnp.int32(2**31 - 1))
+        return pallas_apply_push(rows, merged, seed, layout, conf)
+    return apply_push(rows, merged, prng, layout, conf)
+
+
 def push_sparse_dedup(slab: jnp.ndarray, ids: jnp.ndarray,
                       grads: jnp.ndarray, prng: jax.Array,
                       layout: ValueLayout,
@@ -222,15 +236,37 @@ def push_sparse_dedup(slab: jnp.ndarray, ids: jnp.ndarray,
     uids, inv = jnp.unique(ids, size=K, fill_value=trash, return_inverse=True)
     merged = jnp.zeros((K, grads.shape[1]), grads.dtype).at[inv].add(grads)
     rows = slab[uids]
-    from paddlebox_tpu.config import flags
-    if (flags.get_flag("use_pallas_push")
-            and layout.optimizer == "adagrad" and not layout.expand_dim):
-        from paddlebox_tpu.embedding.pallas_push import pallas_apply_push
-        seed = jax.random.randint(prng, (), 0, jnp.int32(2**31 - 1))
-        new_rows = pallas_apply_push(rows, merged, seed, layout, conf)
-    else:
-        new_rows = apply_push(rows, merged, prng, layout, conf)
+    new_rows = _dispatch_apply_push(rows, merged, prng, layout, conf)
     return slab.at[uids].set(new_rows)
+
+
+def push_sparse_hostdedup(slab: jnp.ndarray, uids: jnp.ndarray,
+                          perm: jnp.ndarray, inv_sorted: jnp.ndarray,
+                          grads: jnp.ndarray, prng: jax.Array,
+                          layout: ValueLayout,
+                          conf: SparseOptimizerConfig) -> jnp.ndarray:
+    """Push with HOST-precomputed dedup (PassTable.dedup_for_push): no
+    on-device sort. jnp.unique in push_sparse_dedup lowers to an XLA sort of
+    the whole key vector per step — measured as the dominant cost of the
+    fused step on v5e — while the host already walks the batch's keys to
+    assign pass-local ids, so the dedup rides the (overlapped) host stage
+    instead (DedupKeysAndFillIdx done host-side, box_wrapper_impl.h:129).
+
+    uids:       [K] sorted unique ids; tail padded with ids >= capacity
+                (unique + monotone), which drop at the scatter
+    perm:       [K] stable argsort of the occurrence ids
+    inv_sorted: [K] nondecreasing merged-row index per sorted occurrence
+    grads:      [K, push.width] per-occurrence push rows (padding all-zero)
+    """
+    sorted_grads = jnp.take(grads, perm, axis=0, indices_are_sorted=False,
+                            unique_indices=True)
+    merged = jax.ops.segment_sum(sorted_grads, inv_sorted,
+                                 num_segments=uids.shape[0],
+                                 indices_are_sorted=True)
+    rows = jnp.take(slab, uids, axis=0, mode="clip")
+    new_rows = _dispatch_apply_push(rows, merged, prng, layout, conf)
+    # out-of-range padding ids drop; in-range ids are unique by construction
+    return slab.at[uids].set(new_rows, mode="drop", unique_indices=True)
 
 
 def make_push_fn(layout: ValueLayout,

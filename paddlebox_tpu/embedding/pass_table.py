@@ -161,6 +161,10 @@ class PassTable:
         creation, no write-back."""
         self._test_mode = test
 
+    @property
+    def test_mode(self) -> bool:
+        return self._test_mode
+
     # ------------------------------------------------------------- id space
     @property
     def pass_size(self) -> int:
@@ -192,6 +196,33 @@ class PassTable:
             raise KeyError(
                 f"keys not registered in feed pass (first few: {missing})")
         return ids.astype(np.int32)
+
+    def dedup_for_push(self, ids: np.ndarray):
+        """Host-side per-batch dedup for push_sparse_hostdedup: the device
+        analog (jnp.unique) is an XLA sort of the whole key vector inside
+        every train step; here it rides the already-overlapped host batch
+        stage (DedupKeysAndFillIdx host-side, box_wrapper_impl.h:129).
+
+        Returns (uids, perm, inv) int32 [K] arrays:
+          perm — stable argsort of ids; inv — nondecreasing merged-row index
+          per sorted occurrence; uids — sorted unique ids, tail padded with
+          capacity+i (unique, monotone, out-of-range → scatter-dropped).
+        """
+        ids = np.asarray(ids)
+        K = ids.shape[0]
+        perm = np.argsort(ids, kind="stable")
+        sorted_ids = ids[perm]
+        newseg = np.empty(K, dtype=bool)
+        if K:
+            newseg[0] = True
+            np.not_equal(sorted_ids[1:], sorted_ids[:-1], out=newseg[1:])
+        inv = np.cumsum(newseg, dtype=np.int32) - 1
+        uids = np.full(K, 0, dtype=np.int32)
+        real = sorted_ids[newseg]
+        n_u = real.shape[0]
+        uids[:n_u] = real
+        uids[n_u:] = self.capacity + np.arange(K - n_u, dtype=np.int32)
+        return uids, perm.astype(np.int32), inv
 
     # ------------------------------------------------------------ pull/push
     def pull(self, ids: jnp.ndarray) -> jnp.ndarray:

@@ -35,16 +35,24 @@ def cvm_transform(pooled: jnp.ndarray, use_cvm: bool = True) -> jnp.ndarray:
 def fused_seqpool_cvm(emb: jnp.ndarray, segments: jnp.ndarray,
                       valid: jnp.ndarray, batch_size: int, num_slots: int,
                       use_cvm: bool = True,
-                      pad_empty_zero: bool = True) -> jnp.ndarray:
+                      pad_empty_zero: bool = True,
+                      sorted_segments: bool = False) -> jnp.ndarray:
     """emb: [K, 2+E] per-key pull view; segments: [K] = ins*num_slots+slot;
     valid: [K] bool. Returns [batch, num_slots, out_dim] where out_dim is
     2+E with CVM or E without.
 
     Empty slots pool to zero (need_filter/padding_value=0 behavior of the
-    reference kernel)."""
+    reference kernel).
+
+    sorted_segments=True asserts `segments` is nondecreasing — true for
+    BatchPacker output (CSR order, padding tail pinned to the last segment)
+    — letting XLA lower the pool as a sorted segment reduction instead of a
+    random scatter-add (the TPU analog of the reference's one-kernel fusion,
+    fused_seqpool_cvm_op.cu)."""
     masked = jnp.where(valid[:, None], emb, 0.0)
     pooled = jax.ops.segment_sum(
-        masked, segments, num_segments=batch_size * num_slots)
+        masked, segments, num_segments=batch_size * num_slots,
+        indices_are_sorted=sorted_segments)
     pooled = pooled.reshape(batch_size, num_slots, emb.shape[-1])
     return cvm_transform(pooled, use_cvm)
 

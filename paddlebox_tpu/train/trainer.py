@@ -215,9 +215,10 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
     mixed = cdtype != jnp.float32
 
     def forward(params, emb, batch, dn_extra):
+        # packer/columnar batches carry nondecreasing segments by contract
         pooled = fused_seqpool_cvm(
             emb, batch["segments"], batch["valid"], batch_size, num_slots,
-            use_cvm=use_cvm)
+            use_cvm=use_cvm, sorted_segments=True)
         dense_in = batch.get("dense")
         if mixed:
             # matmuls ride the MXU in bf16; logits return to f32 for the
@@ -254,6 +255,13 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         clicks = key_label_src[batch["segments"] // num_slots]
         push_grads = build_push_grads(demb, batch["slots"], clicks,
                                       batch["valid"])
+        if "uids" in batch:
+            # host precomputed the dedup (dedup_for_push): no device sort
+            from paddlebox_tpu.embedding.optimizers import \
+                push_sparse_hostdedup
+            return push_sparse_hostdedup(
+                slab, batch["uids"], batch["perm"], batch["inv"],
+                push_grads, sub, layout, conf)
         return push_sparse_dedup(slab, batch["ids"], push_grads, sub, layout,
                                  conf)
 
@@ -411,6 +419,11 @@ class BoxTrainer:
             "ins_valid": b.ins_valid,
             "labels": b.labels,
         }
+        if not self.table.test_mode:
+            # train batches carry the host-precomputed push dedup; eval
+            # batches never push, so skip the argsort + 3 extra transfers
+            uids, perm, inv = self.table.dedup_for_push(ids)
+            out.update(uids=uids, perm=perm, inv=inv)
         if b.dense is not None:
             out["dense"] = b.dense
         if b.rank_offset is not None:

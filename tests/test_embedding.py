@@ -192,6 +192,69 @@ def test_pass_lifecycle_and_dedup():
     assert row_unpushed[acc.SHOW] == 0.0
 
 
+def test_hostdedup_push_matches_device_dedup():
+    """push_sparse_hostdedup (host argsort + sorted segment-sum, no device
+    sort) must produce bit-identical slabs to the jnp.unique path."""
+    from paddlebox_tpu.embedding.optimizers import (push_sparse_dedup,
+                                                    push_sparse_hostdedup)
+    table = TableConfig(embedx_dim=D, pass_capacity=1 << 8,
+                        optimizer=SparseOptimizerConfig(
+                            mf_initial_range=0.0, mf_create_thresholds=0.0))
+    pt = PassTable(table, seed=3)
+    rng = np.random.RandomState(5)
+    keys = np.unique(rng.randint(1, 10**9, 40).astype(np.uint64))
+    pt.begin_feed_pass()
+    pt.add_keys(keys)
+    pt.end_feed_pass()
+    pt.begin_pass()
+
+    K = 64
+    occ = rng.choice(keys, K).astype(np.uint64)
+    valid = rng.rand(K) > 0.2
+    ids = pt.lookup_ids(occ, valid)
+    push = PushLayout(D)
+    grads = rng.randn(K, push.width).astype(np.float32)
+    grads[:, push.SHOW] = 1.0
+    grads[:, push.CLICK] = (rng.rand(K) < 0.3)
+    grads[~valid] = 0.0
+
+    prng = jax.random.PRNGKey(11)
+    slab0 = pt.slab
+    ref = push_sparse_dedup(slab0, jnp.asarray(ids), jnp.asarray(grads),
+                            prng, pt.layout, table.optimizer)
+    uids, perm, inv = pt.dedup_for_push(ids)
+    got = push_sparse_hostdedup(slab0, jnp.asarray(uids), jnp.asarray(perm),
+                                jnp.asarray(inv), jnp.asarray(grads), prng,
+                                pt.layout, table.optimizer)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+    pt.end_pass()
+
+
+def test_dedup_for_push_invariants():
+    table = TableConfig(embedx_dim=D, pass_capacity=128)
+    pt = PassTable(table)
+    pt.begin_feed_pass()
+    pt.add_keys(np.arange(1, 50, dtype=np.uint64))
+    pt.end_feed_pass()
+    pt.begin_pass()
+    rng = np.random.RandomState(0)
+    occ = rng.randint(1, 50, 32).astype(np.uint64)
+    valid = rng.rand(32) > 0.3
+    ids = pt.lookup_ids(occ, valid)
+    uids, perm, inv = pt.dedup_for_push(ids)
+    # uids strictly increasing (unique + monotone incl. out-of-range padding)
+    assert (np.diff(uids.astype(np.int64)) > 0).all()
+    # inv nondecreasing over the sorted occurrence order
+    assert (np.diff(inv) >= 0).all()
+    # reconstruction: uids[inv] == ids[perm] for every occurrence
+    np.testing.assert_array_equal(uids[inv], ids[perm])
+    # padding ids out of range exactly beyond the unique count
+    n_u = np.unique(ids).size
+    assert (uids[:n_u] < table.pass_capacity).all()
+    assert (uids[n_u:] >= table.pass_capacity).all()
+    pt.end_pass()
+
+
 def test_unregistered_key_raises():
     table = TableConfig(embedx_dim=D, pass_capacity=64)
     pt = PassTable(table)
