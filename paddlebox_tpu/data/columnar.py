@@ -150,9 +150,16 @@ def pack_columnar(block: ColumnarBlock, rec_idx: np.ndarray,
             w = kcap
         if dropped:
             stat_add("packer_keys_dropped", dropped)
+        seg = (brec * num_slots + bslots).astype(np.int32)
+        # the sorted-segments contract is load-bearing (seqpool declares
+        # indices_are_sorted): built-in parsers emit config order, but a
+        # user plugin .so may not — repair with a stable group sort
+        if seg.size and (np.diff(seg) < 0).any():
+            order = np.argsort(seg, kind="stable")
+            bkeys, bslots, seg = bkeys[order], bslots[order], seg[order]
         keys[:w] = bkeys
         slots[:w] = bslots
-        segments[:w] = (brec * num_slots + bslots).astype(np.int32)
+        segments[:w] = seg
         valid[:w] = True
 
     return PackedBatch(keys=keys, slots=slots, segments=segments, valid=valid,

@@ -252,10 +252,10 @@ def push_sparse_hostdedup(slab: jnp.ndarray, uids: jnp.ndarray,
     assign pass-local ids, so the dedup rides the (overlapped) host stage
     instead (DedupKeysAndFillIdx done host-side, box_wrapper_impl.h:129).
 
-    uids:       [K] sorted unique ids; tail padded with ids >= capacity
-                (unique + monotone), which drop at the scatter
-    perm:       [K] stable argsort of the occurrence ids
-    inv_sorted: [K] nondecreasing merged-row index per sorted occurrence
+    uids:       [K] unique ids; tail padded with ids >= capacity, which
+                drop at the scatter
+    perm:       [K] occurrence indices grouped by unique id
+    inv_sorted: [K] nondecreasing merged-row index per permuted occurrence
     grads:      [K, push.width] per-occurrence push rows (padding all-zero)
     """
     sorted_grads = jnp.take(grads, perm, axis=0, indices_are_sorted=False,

@@ -204,13 +204,33 @@ class PassTable:
         stage (DedupKeysAndFillIdx host-side, box_wrapper_impl.h:129).
 
         Returns (uids, perm, inv) int32 [K] arrays:
-          perm — stable argsort of ids; inv — nondecreasing merged-row index
-          per sorted occurrence; uids — sorted unique ids, tail padded with
-          capacity+i (unique, monotone, out-of-range → scatter-dropped).
+          uids — unique ids (tail padded with capacity+i: unique and
+          out-of-range → scatter-dropped); perm — occurrence indices grouped
+          by unique id; inv — merged-row index per PERMUTED occurrence,
+          nondecreasing so the device merge is a sorted segment-sum.
+
+        Fast path: native rt_dedup (hash dedup + counting sort, no
+        comparison sort); numpy argsort fallback.
         """
-        ids = np.asarray(ids)
+        ids = np.ascontiguousarray(ids, dtype=np.int32)
         K = ids.shape[0]
-        perm = np.argsort(ids, kind="stable")
+        from paddlebox_tpu.native.build import get_lib
+        lib = get_lib()
+        if lib is not None and K:
+            import ctypes
+            uids = np.empty(K, np.int32)
+            perm = np.empty(K, np.int32)
+            inv = np.empty(K, np.int32)
+            scratch = np.empty(2 * K, np.int64)
+            i32p = ctypes.POINTER(ctypes.c_int32)
+            n_u = lib.rt_dedup(
+                ids.ctypes.data_as(i32p), K, self.capacity,
+                uids.ctypes.data_as(i32p), perm.ctypes.data_as(i32p),
+                inv.ctypes.data_as(i32p),
+                scratch.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            if n_u >= 0:
+                return uids, perm, inv
+        perm = np.argsort(ids, kind="stable").astype(np.int32)
         sorted_ids = ids[perm]
         newseg = np.empty(K, dtype=bool)
         if K:
@@ -222,7 +242,7 @@ class PassTable:
         n_u = real.shape[0]
         uids[:n_u] = real
         uids[n_u:] = self.capacity + np.arange(K - n_u, dtype=np.int32)
-        return uids, perm.astype(np.int32), inv
+        return uids, perm, inv
 
     # ------------------------------------------------------------ pull/push
     def pull(self, ids: jnp.ndarray) -> jnp.ndarray:

@@ -121,13 +121,23 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rt_bucketize.argtypes = [c.c_void_p, P(c.c_uint64), P(c.c_uint8),
                                  c.c_int64, c.c_int32, c.c_int32,
                                  P(c.c_int32), P(c.c_int32), P(c.c_uint64)]
+    lib.rt_dedup.restype = c.c_int64
+    lib.rt_dedup.argtypes = [P(c.c_int32), c.c_int64, c.c_int32,
+                             P(c.c_int32), P(c.c_int32), P(c.c_int32),
+                             P(c.c_int64)]
     return lib
 
 
 def load_lib(path: str) -> ctypes.CDLL:
     """Bind a user-supplied shared object honoring the parser C ABI
     (the DLManager dlopen path for custom parser plugins). Plugins only
-    implement psr_*; the internal store/router symbols are not required."""
+    implement psr_*; the internal store/router symbols are not required.
+
+    Ordering contract: within each record, emit keys grouped by used-slot
+    ordinal in ascending (config) order — downstream pooling assumes
+    nondecreasing segment ids. pack_columnar detects and repairs violations
+    with a stable sort, at a per-batch host cost plugins can avoid by
+    honoring the order."""
     return _bind_parser(ctypes.CDLL(path))
 
 

@@ -213,4 +213,70 @@ int64_t rt_bucketize(void* index, const uint64_t* keys, uint8_t* valid,
   return overflow;
 }
 
+// Per-batch id dedup for the single-shard push (host analog of
+// DedupKeysAndFillIdx, box_wrapper_impl.h:129): hash dedup + counting sort,
+// no comparison sort. Outputs feed push_sparse_hostdedup:
+//   uids[K]  unique ids in first-occurrence order, tail padded with
+//            pad_base+i (unique, outside the slab -> scatter-dropped)
+//   perm[K]  occurrence indices grouped by unique id (stable within a group)
+//   inv[K]   merged-row index per PERMUTED occurrence — nondecreasing, so
+//            the device merge is a sorted segment-sum, not a sort.
+// scratch: caller-provided int64[2*K] (group id + counts/offsets).
+// Returns the unique count, or -2 on allocation failure.
+int64_t rt_dedup(const int32_t* ids, int64_t K, int32_t pad_base,
+                 int32_t* uids, int32_t* perm, int32_t* inv,
+                 int64_t* scratch) {
+  // local gen-free open addressing over this batch's ids (K is small
+  // enough that an on-stack-sized table per call is cheap to allocate)
+  uint64_t cap = next_pow2(static_cast<uint64_t>(K) * 2 + 8);
+  uint64_t mask = cap - 1;
+  int32_t* hkeys = static_cast<int32_t*>(malloc(cap * 4));
+  int32_t* hgrp = static_cast<int32_t*>(malloc(cap * 4));
+  if (!hkeys || !hgrp) {
+    free(hkeys);
+    free(hgrp);
+    return -2;
+  }
+  memset(hkeys, 0xFF, cap * 4);  // -1 = empty (ids are nonnegative)
+  int64_t* ginv = scratch;       // [K] group per occurrence
+  int64_t* count = scratch + K;  // [K] group sizes -> offsets
+  int64_t n_u = 0;
+  for (int64_t i = 0; i < K; ++i) {
+    int32_t id = ids[i];
+    uint64_t h = mix64(static_cast<uint64_t>(id)) & mask;
+    while (hkeys[h] != -1 && hkeys[h] != id) h = (h + 1) & mask;
+    int32_t g;
+    if (hkeys[h] == -1) {
+      g = static_cast<int32_t>(n_u);
+      hkeys[h] = id;
+      hgrp[h] = g;
+      uids[n_u] = id;
+      count[n_u] = 0;
+      ++n_u;
+    } else {
+      g = hgrp[h];
+    }
+    ginv[i] = g;
+    ++count[g];
+  }
+  free(hkeys);
+  free(hgrp);
+  // counting sort: group offsets, then stable placement
+  int64_t run = 0;
+  for (int64_t g = 0; g < n_u; ++g) {
+    int64_t c = count[g];
+    count[g] = run;
+    run += c;
+  }
+  for (int64_t i = 0; i < K; ++i) {
+    int64_t g = ginv[i];
+    int64_t j = count[g]++;
+    perm[j] = static_cast<int32_t>(i);
+    inv[j] = static_cast<int32_t>(g);
+  }
+  for (int64_t i = n_u; i < K; ++i)
+    uids[i] = pad_base + static_cast<int32_t>(i - n_u);
+  return n_u;
+}
+
 }  // extern "C"

@@ -31,7 +31,8 @@ from paddlebox_tpu.config.configs import (DataFeedConfig, TableConfig,
 from paddlebox_tpu.data.dataset import BoxDataset
 from paddlebox_tpu.data.packer import PackedBatch
 from paddlebox_tpu.embedding.accessor import ValueLayout
-from paddlebox_tpu.embedding.optimizers import push_sparse_dedup
+from paddlebox_tpu.embedding.optimizers import (push_sparse_dedup,
+                                                push_sparse_hostdedup)
 from paddlebox_tpu.embedding.pass_table import PassTable
 from paddlebox_tpu.metrics.auc import MetricRegistry
 from paddlebox_tpu.models.base import ModelSpec
@@ -257,8 +258,6 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
                                       batch["valid"])
         if "uids" in batch:
             # host precomputed the dedup (dedup_for_push): no device sort
-            from paddlebox_tpu.embedding.optimizers import \
-                push_sparse_hostdedup
             return push_sparse_hostdedup(
                 slab, batch["uids"], batch["perm"], batch["inv"],
                 push_grads, sub, layout, conf)

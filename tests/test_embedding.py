@@ -241,18 +241,37 @@ def test_dedup_for_push_invariants():
     occ = rng.randint(1, 50, 32).astype(np.uint64)
     valid = rng.rand(32) > 0.3
     ids = pt.lookup_ids(occ, valid)
-    uids, perm, inv = pt.dedup_for_push(ids)
-    # uids strictly increasing (unique + monotone incl. out-of-range padding)
-    assert (np.diff(uids.astype(np.int64)) > 0).all()
-    # inv nondecreasing over the sorted occurrence order
-    assert (np.diff(inv) >= 0).all()
-    # reconstruction: uids[inv] == ids[perm] for every occurrence
-    np.testing.assert_array_equal(uids[inv], ids[perm])
-    # padding ids out of range exactly beyond the unique count
-    n_u = np.unique(ids).size
-    assert (uids[:n_u] < table.pass_capacity).all()
-    assert (uids[n_u:] >= table.pass_capacity).all()
+    for native in (True, False):
+        if native and not _native_available():
+            continue
+        uids, perm, inv = (pt.dedup_for_push(ids) if native
+                           else _numpy_dedup(pt, ids))
+        # all uids distinct (unique scatter contract)
+        assert np.unique(uids).size == uids.size
+        # inv nondecreasing over the permuted occurrence order (sorted
+        # segment-sum contract)
+        assert (np.diff(inv) >= 0).all()
+        # perm is a permutation
+        assert np.array_equal(np.sort(perm), np.arange(ids.size))
+        # reconstruction: uids[inv] == ids[perm] for every occurrence
+        np.testing.assert_array_equal(uids[inv], ids[perm])
+        # padding ids out of range exactly beyond the unique count
+        n_u = np.unique(ids).size
+        assert (uids[:n_u] < table.pass_capacity).all()
+        assert (uids[n_u:] >= table.pass_capacity).all()
     pt.end_pass()
+
+
+def _native_available():
+    from paddlebox_tpu.native.build import available
+    return available()
+
+
+def _numpy_dedup(pt, ids):
+    """Force the numpy fallback branch of dedup_for_push."""
+    import unittest.mock as mock
+    with mock.patch("paddlebox_tpu.native.build.get_lib", return_value=None):
+        return pt.dedup_for_push(ids)
 
 
 def test_unregistered_key_raises():
