@@ -53,14 +53,32 @@ def _adam_step(w, m, v, b1p, b2p, g, scale, lr, beta1, beta2, min_b, max_b,
     return neww, new_m, new_v, b1p * beta1, b2p * beta2
 
 
+def _fresh_uniform(prng: jax.Array, row_ids, shape, dtype,
+                   maxval: float, stream: int = 0) -> jnp.ndarray:
+    """Lazy-creation randoms. With row_ids: CONTENT-ADDRESSED — each row's
+    draw is a pure function of (prng, its slab id), so created embeddings
+    are identical no matter how a batch was deduped, routed, or merged
+    (host vs device dedup, sharded vs single-chip). Without: positional."""
+    if stream:
+        prng = jax.random.fold_in(prng, stream)
+    if row_ids is None:
+        return jax.random.uniform(prng, shape, dtype, 0.0, maxval)
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(prng, row_ids)
+    return jax.vmap(
+        lambda k: jax.random.uniform(k, shape[1:], dtype, 0.0, maxval))(keys)
+
+
 def apply_push(values: jnp.ndarray, grads: jnp.ndarray, prng: jax.Array,
-               layout: ValueLayout, conf: SparseOptimizerConfig) -> jnp.ndarray:
+               layout: ValueLayout, conf: SparseOptimizerConfig,
+               row_ids=None) -> jnp.ndarray:
     """Apply merged per-key gradients to their value rows.
 
     values: [N, layout.width]  — gathered rows of the deduped keys
     grads:  [N, push.width]    — show/click-merged gradients (g_show = number
                                  of occurrences merged into the row)
     prng:   key for lazy embedx init
+    row_ids: [N] optional slab ids per row — when given, lazy-creation
+            randoms are content-addressed (order/route independent)
     Returns updated rows; rows with g_show == 0 are passed through untouched.
     """
     push = PushLayout(layout.embedx_dim, layout.expand_dim)
@@ -163,8 +181,8 @@ def apply_push(values: jnp.ndarray, grads: jnp.ndarray, prng: jax.Array,
     mf_size = values[:, acc.MF_SIZE:acc.MF_SIZE + 1]
     score = conf.nonclk_coeff * (show - click) + conf.clk_coeff * click
     create = (mf_size == 0) & (score >= conf.mf_create_thresholds) & active
-    fresh = jax.random.uniform(
-        prng, embedx.shape, embedx.dtype, 0.0, conf.mf_initial_range)
+    fresh = _fresh_uniform(prng, row_ids, embedx.shape, embedx.dtype,
+                           conf.mf_initial_range)
     newx, state_updates = embedx_updated
     has_mf = mf_size > 0
     out = out.at[:, xw0:xw0 + D].set(
@@ -195,9 +213,8 @@ def apply_push(values: jnp.ndarray, grads: jnp.ndarray, prng: jax.Array,
         else:  # naive
             newe = jnp.clip(expand + conf.mf_learning_rate * (eg / scale),
                             conf.mf_min_bound, conf.mf_max_bound)
-        fresh_e = jax.random.uniform(
-            jax.random.fold_in(prng, 1), expand.shape, expand.dtype,
-            0.0, conf.mf_initial_range)
+        fresh_e = _fresh_uniform(prng, row_ids, expand.shape, expand.dtype,
+                                 conf.mf_initial_range, stream=1)
         out = out.at[:, ew0:ew0 + E].set(
             jnp.where(create, fresh_e,
                       jnp.where(has_mf & active, newe, expand)))
@@ -208,7 +225,8 @@ def apply_push(values: jnp.ndarray, grads: jnp.ndarray, prng: jax.Array,
 
 def _dispatch_apply_push(rows: jnp.ndarray, merged: jnp.ndarray,
                          prng: jax.Array, layout: ValueLayout,
-                         conf: SparseOptimizerConfig) -> jnp.ndarray:
+                         conf: SparseOptimizerConfig,
+                         row_ids=None) -> jnp.ndarray:
     """One place that picks the in-table update kernel (Pallas adagrad when
     flagged and applicable, XLA apply_push otherwise) for both push paths."""
     from paddlebox_tpu.config import flags
@@ -216,8 +234,9 @@ def _dispatch_apply_push(rows: jnp.ndarray, merged: jnp.ndarray,
             and layout.optimizer == "adagrad" and not layout.expand_dim):
         from paddlebox_tpu.embedding.pallas_push import pallas_apply_push
         seed = jax.random.randint(prng, (), 0, jnp.int32(2**31 - 1))
-        return pallas_apply_push(rows, merged, seed, layout, conf)
-    return apply_push(rows, merged, prng, layout, conf)
+        return pallas_apply_push(rows, merged, seed, layout, conf,
+                                 row_ids=row_ids)
+    return apply_push(rows, merged, prng, layout, conf, row_ids=row_ids)
 
 
 def push_sparse_dedup(slab: jnp.ndarray, ids: jnp.ndarray,
@@ -236,7 +255,8 @@ def push_sparse_dedup(slab: jnp.ndarray, ids: jnp.ndarray,
     uids, inv = jnp.unique(ids, size=K, fill_value=trash, return_inverse=True)
     merged = jnp.zeros((K, grads.shape[1]), grads.dtype).at[inv].add(grads)
     rows = slab[uids]
-    new_rows = _dispatch_apply_push(rows, merged, prng, layout, conf)
+    new_rows = _dispatch_apply_push(rows, merged, prng, layout, conf,
+                                    row_ids=uids)
     return slab.at[uids].set(new_rows)
 
 
@@ -264,7 +284,8 @@ def push_sparse_hostdedup(slab: jnp.ndarray, uids: jnp.ndarray,
                                  num_segments=uids.shape[0],
                                  indices_are_sorted=True)
     rows = jnp.take(slab, uids, axis=0, mode="clip")
-    new_rows = _dispatch_apply_push(rows, merged, prng, layout, conf)
+    new_rows = _dispatch_apply_push(rows, merged, prng, layout, conf,
+                                    row_ids=uids)
     # out-of-range padding ids drop; in-range ids are unique by construction
     return slab.at[uids].set(new_rows, mode="drop", unique_indices=True)
 

@@ -38,10 +38,8 @@ def _adagrad(w, g2sum, scaled, lr, conf):
     return neww, g2sum + add_g2
 
 
-def _push_kernel(seed_ref, vals_ref, grads_ref, out_ref, *, layout, conf,
-                 use_hw_prng=True):
-    if use_hw_prng:
-        pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
+def _push_kernel(seed_ref, vals_ref, grads_ref, rid_ref, out_ref, *, layout,
+                 conf):
     vals = vals_ref[:]
     grads = grads_ref[:]
     push = PushLayout(layout.embedx_dim)
@@ -79,22 +77,21 @@ def _push_kernel(seed_ref, vals_ref, grads_ref, out_ref, *, layout, conf,
                             grads[:, push.embedx_g:push.embedx_g + D] / scale,
                             jnp.full_like(w, conf.mf_learning_rate), conf)
 
-    # lazy mf creation: uniform [0, mf_initial_range) from the core PRNG
+    # lazy mf creation: uniform [0, mf_initial_range). CONTENT-ADDRESSED:
+    # bits are a Weyl/LCG mix of (slab row id, col, seed) — NOT row position
+    # or tile id — so a created key draws the same values however the batch
+    # was deduped, ordered, or routed (the same contract as apply_push's
+    # fold_in(prng, row_id); the hardware PRNG can't be keyed per row)
     mf_size = vals[:, acc.MF_SIZE:acc.MF_SIZE + 1]
     score = conf.nonclk_coeff * (show - click) + conf.clk_coeff * click
     create = (mf_size == 0) & (score >= conf.mf_create_thresholds) & active
-    if use_hw_prng:
-        bits = pltpu.prng_random_bits(embedx.shape).astype(jnp.uint32)
-    else:
-        # interpret mode (CPU tests) has no hardware PRNG: a Weyl/LCG mix
-        # over (row, col, seed, tile) stands in — uniform enough for init
-        r = jax.lax.broadcasted_iota(jnp.uint32, embedx.shape, 0)
-        c = jax.lax.broadcasted_iota(jnp.uint32, embedx.shape, 1)
-        s = (seed_ref[0].astype(jnp.uint32)
-             + jnp.uint32(pl.program_id(0)) * jnp.uint32(0x9E3779B9))
-        bits = (r * jnp.uint32(2654435761) ^ (c * jnp.uint32(40503) + s))
-        bits = bits * jnp.uint32(747796405) + jnp.uint32(2891336453)
-        bits ^= bits >> 16
+    rid = rid_ref[:].astype(jnp.uint32)                    # [TILE, 1]
+    r = jnp.broadcast_to(rid, embedx.shape)
+    c = jax.lax.broadcasted_iota(jnp.uint32, embedx.shape, 1)
+    s = seed_ref[0].astype(jnp.uint32)
+    bits = (r * jnp.uint32(2654435761) ^ (c * jnp.uint32(40503) + s))
+    bits = bits * jnp.uint32(747796405) + jnp.uint32(2891336453)
+    bits ^= bits >> 16
     # >>8 keeps 24 bits, which fit int32 exactly (Mosaic has no u32→f32)
     u01 = ((bits >> 8).astype(jnp.int32).astype(jnp.float32)
            * (1.0 / (1 << 24)))
@@ -114,28 +111,35 @@ def _push_kernel(seed_ref, vals_ref, grads_ref, out_ref, *, layout, conf,
 def pallas_apply_push(values: jnp.ndarray, grads: jnp.ndarray, seed,
                       layout: ValueLayout,
                       conf: SparseOptimizerConfig,
-                      interpret: bool = False) -> jnp.ndarray:
+                      interpret: bool = False,
+                      row_ids=None) -> jnp.ndarray:
     """Drop-in for apply_push (adagrad, no expand block). values padded to
-    a _TILE multiple by the caller-invisible grid; seed: int32 scalar."""
+    a _TILE multiple by the caller-invisible grid; seed: int32 scalar;
+    row_ids: [n] slab ids keying the creation randoms (positional arange
+    fallback when the caller has none)."""
     if layout.optimizer != "adagrad" or layout.expand_dim:
         raise ValueError("pallas push kernel supports the adagrad layout "
                          "without expand block")
     n, width = values.shape
+    if row_ids is None:
+        row_ids = jnp.arange(n, dtype=jnp.int32)
+    row_ids = row_ids.astype(jnp.int32).reshape(n, 1)
     pad = (-n) % _TILE
     if pad:
         values = jnp.pad(values, ((0, pad), (0, 0)))
         grads = jnp.pad(grads, ((0, pad), (0, 0)))
+        row_ids = jnp.pad(row_ids, ((0, pad), (0, 0)))
     n_pad = values.shape[0]
     seed_arr = jnp.asarray([seed], jnp.int32).astype(jnp.int32)
 
-    kernel = functools.partial(_push_kernel, layout=layout, conf=conf,
-                               use_hw_prng=not interpret)
+    kernel = functools.partial(_push_kernel, layout=layout, conf=conf)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_pad // _TILE,),
         in_specs=[
             pl.BlockSpec((_TILE, width), lambda i, s: (i, 0)),
             pl.BlockSpec((_TILE, grads.shape[1]), lambda i, s: (i, 0)),
+            pl.BlockSpec((_TILE, 1), lambda i, s: (i, 0)),
         ],
         out_specs=pl.BlockSpec((_TILE, width), lambda i, s: (i, 0)),
     )
@@ -144,5 +148,5 @@ def pallas_apply_push(values: jnp.ndarray, grads: jnp.ndarray, seed,
         out_shape=jax.ShapeDtypeStruct((n_pad, width), values.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(seed_arr, values, grads)
+    )(seed_arr, values, grads, row_ids)
     return out[:n]

@@ -54,6 +54,63 @@ def _push_kernel(slab: jnp.ndarray, ids: jnp.ndarray, grads: jnp.ndarray,
     return push_sparse_dedup(slab, ids, grads, prng, layout, conf)
 
 
+def dedup_ids(ids: np.ndarray, pad_base: int):
+    """Host-side per-batch id dedup for push_sparse_hostdedup: the device
+    analog (jnp.unique) is an XLA sort of the whole key vector inside every
+    train step; here it rides the already-overlapped host batch stage
+    (DedupKeysAndFillIdx host-side, box_wrapper_impl.h:129).
+
+    Returns (uids, perm, inv) int32 [K] arrays:
+      uids — unique ids (tail padded with pad_base+i: unique and
+      out-of-slab → scatter-dropped); perm — occurrence indices grouped by
+      unique id; inv — merged-row index per PERMUTED occurrence,
+      nondecreasing so the device merge is a sorted segment-sum.
+
+    Fast path: native rt_dedup (hash dedup + counting sort, no comparison
+    sort); numpy argsort fallback.
+    """
+    raw = np.asarray(ids)
+    ids = np.ascontiguousarray(raw, dtype=np.int32)
+    K = ids.shape[0]
+    # ids must be nonnegative pass-local ids; a raw uint64 feasign wrapped
+    # by the int32 cast would alias rt_dedup's -1 empty sentinel and break
+    # the unique-uids scatter contract
+    if K and (ids.min() < 0 or (raw.dtype != np.int32
+                                and np.uint64(raw.max()) > np.uint64(2**31 - 1))):
+        raise ValueError("dedup_ids expects nonnegative int32 pass-local "
+                         "ids, got range [%s, %s] dtype %s"
+                         % (raw.min(), raw.max(), raw.dtype))
+    from paddlebox_tpu.native.build import get_lib
+    lib = get_lib()
+    if lib is not None and K:
+        import ctypes
+        uids = np.empty(K, np.int32)
+        perm = np.empty(K, np.int32)
+        inv = np.empty(K, np.int32)
+        scratch = np.empty(2 * K, np.int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        n_u = lib.rt_dedup(
+            ids.ctypes.data_as(i32p), K, pad_base,
+            uids.ctypes.data_as(i32p), perm.ctypes.data_as(i32p),
+            inv.ctypes.data_as(i32p),
+            scratch.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if n_u >= 0:
+            return uids, perm, inv
+    perm = np.argsort(ids, kind="stable").astype(np.int32)
+    sorted_ids = ids[perm]
+    newseg = np.empty(K, dtype=bool)
+    if K:
+        newseg[0] = True
+        np.not_equal(sorted_ids[1:], sorted_ids[:-1], out=newseg[1:])
+    inv = np.cumsum(newseg, dtype=np.int32) - 1
+    uids = np.full(K, 0, dtype=np.int32)
+    real = sorted_ids[newseg]
+    n_u = real.shape[0]
+    uids[:n_u] = real
+    uids[n_u:] = pad_base + np.arange(K - n_u, dtype=np.int32)
+    return uids, perm, inv
+
+
 class PassTable:
     """Single-shard (one-device or host-replicated) sparse table with the
     BoxPS pass lifecycle. The pod-sharded variant composes these per shard
@@ -198,51 +255,9 @@ class PassTable:
         return ids.astype(np.int32)
 
     def dedup_for_push(self, ids: np.ndarray):
-        """Host-side per-batch dedup for push_sparse_hostdedup: the device
-        analog (jnp.unique) is an XLA sort of the whole key vector inside
-        every train step; here it rides the already-overlapped host batch
-        stage (DedupKeysAndFillIdx host-side, box_wrapper_impl.h:129).
-
-        Returns (uids, perm, inv) int32 [K] arrays:
-          uids — unique ids (tail padded with capacity+i: unique and
-          out-of-range → scatter-dropped); perm — occurrence indices grouped
-          by unique id; inv — merged-row index per PERMUTED occurrence,
-          nondecreasing so the device merge is a sorted segment-sum.
-
-        Fast path: native rt_dedup (hash dedup + counting sort, no
-        comparison sort); numpy argsort fallback.
-        """
-        ids = np.ascontiguousarray(ids, dtype=np.int32)
-        K = ids.shape[0]
-        from paddlebox_tpu.native.build import get_lib
-        lib = get_lib()
-        if lib is not None and K:
-            import ctypes
-            uids = np.empty(K, np.int32)
-            perm = np.empty(K, np.int32)
-            inv = np.empty(K, np.int32)
-            scratch = np.empty(2 * K, np.int64)
-            i32p = ctypes.POINTER(ctypes.c_int32)
-            n_u = lib.rt_dedup(
-                ids.ctypes.data_as(i32p), K, self.capacity,
-                uids.ctypes.data_as(i32p), perm.ctypes.data_as(i32p),
-                inv.ctypes.data_as(i32p),
-                scratch.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
-            if n_u >= 0:
-                return uids, perm, inv
-        perm = np.argsort(ids, kind="stable").astype(np.int32)
-        sorted_ids = ids[perm]
-        newseg = np.empty(K, dtype=bool)
-        if K:
-            newseg[0] = True
-            np.not_equal(sorted_ids[1:], sorted_ids[:-1], out=newseg[1:])
-        inv = np.cumsum(newseg, dtype=np.int32) - 1
-        uids = np.full(K, 0, dtype=np.int32)
-        real = sorted_ids[newseg]
-        n_u = real.shape[0]
-        uids[:n_u] = real
-        uids[n_u:] = self.capacity + np.arange(K - n_u, dtype=np.int32)
-        return uids, perm, inv
+        """Host-side per-batch dedup for push_sparse_hostdedup (see
+        dedup_ids): padding ids start at this table's capacity."""
+        return dedup_ids(ids, self.capacity)
 
     # ------------------------------------------------------------ pull/push
     def pull(self, ids: jnp.ndarray) -> jnp.ndarray:

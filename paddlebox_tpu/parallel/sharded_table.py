@@ -218,6 +218,10 @@ class ShardedPassTable:
         if ks.size:
             self.stores[s].write_back(ks, slab[:ks.size])
 
+    @property
+    def test_mode(self) -> bool:
+        return self._test_mode
+
     def set_test_mode(self, test: bool) -> None:
         self._test_mode = test
 

@@ -33,7 +33,9 @@ from paddlebox_tpu.config.configs import (DataFeedConfig, TableConfig,
                                           TrainerConfig)
 from paddlebox_tpu.data.dataset import BoxDataset
 from paddlebox_tpu.data.packer import PackedBatch
-from paddlebox_tpu.embedding.optimizers import push_sparse_dedup
+from paddlebox_tpu.embedding.optimizers import (push_sparse_dedup,
+                                                push_sparse_hostdedup)
+from paddlebox_tpu.embedding.pass_table import dedup_ids
 from paddlebox_tpu.metrics.auc import MetricRegistry
 from paddlebox_tpu.models.base import ModelSpec
 from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
@@ -310,9 +312,17 @@ class ShardedBoxTrainer:
                 jnp.where(batch["valid"][:, None], pg, 0.0))
             recv_g = jax.lax.all_to_all(
                 bucket_g.reshape(Pn, KB, -1), axis, 0, 0, tiled=True)
-            slab = push_sparse_dedup(slab, req.reshape(-1),
-                                     recv_g.reshape(Pn * KB, -1), prng,
-                                     layout, conf)
+            if "push_uids" in batch:
+                # single-process mesh: the incoming-id dedup was precomputed
+                # on the host (shard_batches) — no device sort
+                slab = push_sparse_hostdedup(
+                    slab, batch["push_uids"], batch["push_perm"],
+                    batch["push_inv"], recv_g.reshape(Pn * KB, -1), prng,
+                    layout, conf)
+            else:
+                slab = push_sparse_dedup(slab, req.reshape(-1),
+                                         recv_g.reshape(Pn * KB, -1), prng,
+                                         layout, conf)
             return slab[None], params, opt_state, loss, preds, next_prng
 
         spec_sh = P(self.axis)
@@ -403,6 +413,20 @@ class ShardedBoxTrainer:
                         leaves["labels_" + t] = packed.get(t, b.labels)
                 for k, v in leaves.items():
                     stacked.setdefault(k, []).append(v)
+            if not self.multiprocess and not self.table.test_mode:
+                # single process sees every worker's outgoing buckets, so
+                # the ids each shard RECEIVES through the a2a are host-known:
+                # precompute the push dedup per destination shard and spare
+                # the device its per-step jnp.unique sort (multi-process
+                # keeps the device path — incoming ids live on peers)
+                for d in range(self.P):
+                    incoming = np.concatenate(
+                        [stacked["buckets"][w][d] for w in range(n_workers)])
+                    uids, perm, inv = dedup_ids(incoming,
+                                                self.table.shard_cap)
+                    stacked.setdefault("push_uids", []).append(uids)
+                    stacked.setdefault("push_perm", []).append(perm)
+                    stacked.setdefault("push_inv", []).append(inv)
             dev = {k: self._put_sharded(np.stack(v), sharding)
                    for k, v in stacked.items()}
             steps.append(dev)

@@ -192,14 +192,18 @@ def test_pass_lifecycle_and_dedup():
     assert row_unpushed[acc.SHOW] == 0.0
 
 
-def test_hostdedup_push_matches_device_dedup():
-    """push_sparse_hostdedup (host argsort + sorted segment-sum, no device
-    sort) must produce bit-identical slabs to the jnp.unique path."""
+@pytest.mark.parametrize("init_range", [0.0, 1e-3])
+def test_hostdedup_push_matches_device_dedup(init_range):
+    """push_sparse_hostdedup (host dedup + sorted segment-sum, no device
+    sort) must produce bit-identical slabs to the jnp.unique path — incl.
+    lazily CREATED embedx rows, whose randoms are content-addressed by slab
+    id so the two paths' different row orders draw the same values."""
     from paddlebox_tpu.embedding.optimizers import (push_sparse_dedup,
                                                     push_sparse_hostdedup)
     table = TableConfig(embedx_dim=D, pass_capacity=1 << 8,
                         optimizer=SparseOptimizerConfig(
-                            mf_initial_range=0.0, mf_create_thresholds=0.0))
+                            mf_initial_range=init_range,
+                            mf_create_thresholds=0.0))
     pt = PassTable(table, seed=3)
     rng = np.random.RandomState(5)
     keys = np.unique(rng.randint(1, 10**9, 40).astype(np.uint64))

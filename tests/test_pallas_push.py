@@ -86,6 +86,22 @@ def test_pallas_push_rejects_unsupported_layout():
                           conf(), interpret=True)
 
 
+def test_pallas_create_randoms_content_addressed():
+    """The same slab row must draw the same creation randoms regardless of
+    its position in the batch (row_ids keying, not positional)."""
+    from paddlebox_tpu.embedding.pallas_push import pallas_apply_push
+    layout, rows, grads = _rows_and_grads(32, seed=9, with_mf=False)
+    c = conf(create_thres=0.0)
+    ids = np.arange(32, dtype=np.int32)
+    fwd = pallas_apply_push(jnp.asarray(rows), jnp.asarray(grads), 7, layout,
+                            c, interpret=True, row_ids=jnp.asarray(ids))
+    perm = np.random.RandomState(0).permutation(32)
+    rev = pallas_apply_push(jnp.asarray(rows[perm]), jnp.asarray(grads[perm]),
+                            7, layout, c, interpret=True,
+                            row_ids=jnp.asarray(ids[perm]))
+    np.testing.assert_array_equal(np.asarray(fwd)[perm], np.asarray(rev))
+
+
 def test_flagged_push_sparse_dedup_roundtrip():
     """End-to-end through push_sparse_dedup with the flag on (interpreted
     pallas on CPU)."""
@@ -103,8 +119,8 @@ def test_flagged_push_sparse_dedup_roundtrip():
         # update fns (the flag wiring itself is exercised by tracing)
         import paddlebox_tpu.embedding.pallas_push as pp
         orig = pp.pallas_apply_push
-        pp.pallas_apply_push = lambda v, g, s, l, cf: orig(
-            v, g, s, l, cf, interpret=True)
+        pp.pallas_apply_push = lambda v, g, s, l, cf, **kw: orig(
+            v, g, s, l, cf, interpret=True, **kw)
         try:
             out = push_sparse_dedup(slab, ids, jnp.asarray(grads),
                                     jax.random.PRNGKey(0), layout, c)
