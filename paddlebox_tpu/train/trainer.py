@@ -214,11 +214,22 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
     wants_rank_offset = model_accepts_rank_offset(model)
     cdtype = resolve_compute_dtype(compute_dtype)
     mixed = cdtype != jnp.float32
+    padding_id = table.pass_capacity - 1
+
+    # per-key slots/valid are DERIVED on device, not transferred: the packer
+    # guarantees segments = ins*num_slots + slot and lookup_ids maps every
+    # invalid occurrence (and only those) to the trash row — 5 bytes/key less
+    # H2D on the (tunnel-constrained) input path
+    def _key_valid(batch):
+        return batch["ids"] != padding_id
+
+    def _key_slots(batch):
+        return batch["segments"] % num_slots
 
     def forward(params, emb, batch, dn_extra):
         # packer/columnar batches carry nondecreasing segments by contract
         pooled = fused_seqpool_cvm(
-            emb, batch["segments"], batch["valid"], batch_size, num_slots,
+            emb, batch["segments"], _key_valid(batch), batch_size, num_slots,
             use_cvm=use_cvm, sorted_segments=True)
         dense_in = batch.get("dense")
         if mixed:
@@ -254,12 +265,18 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         key_label_src = batch["labels_" + model.task_names[0]] if multi_task \
             else batch["labels"]
         clicks = key_label_src[batch["segments"] // num_slots]
-        push_grads = build_push_grads(demb, batch["slots"], clicks,
-                                      batch["valid"])
-        if "uids" in batch:
-            # host precomputed the dedup (dedup_for_push): no device sort
+        push_grads = build_push_grads(demb, _key_slots(batch), clicks,
+                                      _key_valid(batch))
+        if "perm" in batch:
+            # host precomputed the dedup (dedup_for_push): no device sort.
+            # uids rebuilt on device from (ids, perm, inv) — cheaper than
+            # shipping them: out-of-slab defaults, then each group's id
+            # scatter-set from its permuted occurrences
+            K = batch["ids"].shape[0]
+            uids = (jnp.arange(K, dtype=jnp.int32) + table.pass_capacity
+                    ).at[batch["inv"]].set(batch["ids"][batch["perm"]])
             return push_sparse_hostdedup(
-                slab, batch["uids"], batch["perm"], batch["inv"],
+                slab, uids, batch["perm"], batch["inv"],
                 push_grads, sub, layout, conf)
         return push_sparse_dedup(slab, batch["ids"], push_grads, sub, layout,
                                  conf)
@@ -410,19 +427,19 @@ class BoxTrainer:
 
     def host_batch(self, b: PackedBatch,
                    ids: np.ndarray) -> Dict[str, np.ndarray]:
+        # per-key slots/valid/uids are derived on device (make_train_step):
+        # only ids/segments/perm/inv ride the H2D path
         out = {
             "ids": ids,
-            "slots": b.slots,
             "segments": b.segments,
-            "valid": b.valid,
             "ins_valid": b.ins_valid,
             "labels": b.labels,
         }
         if not self.table.test_mode:
             # train batches carry the host-precomputed push dedup; eval
-            # batches never push, so skip the argsort + 3 extra transfers
-            uids, perm, inv = self.table.dedup_for_push(ids)
-            out.update(uids=uids, perm=perm, inv=inv)
+            # batches never push, so skip the dedup + extra transfers
+            _uids, perm, inv = self.table.dedup_for_push(ids)
+            out.update(perm=perm, inv=inv)
         if b.dense is not None:
             out["dense"] = b.dense
         if b.rank_offset is not None:

@@ -231,6 +231,16 @@ def test_hostdedup_push_matches_device_dedup(init_range):
                                 jnp.asarray(inv), jnp.asarray(grads), prng,
                                 pt.layout, table.optimizer)
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+    # the train step re-derives uids ON DEVICE from (ids, perm, inv)
+    # (_sparse_push in train/trainer.py) instead of transferring them —
+    # the rebuild must hit the same slab rows bit-identically
+    ids_j = jnp.asarray(ids)
+    rebuilt = (jnp.arange(K, dtype=jnp.int32) + table.pass_capacity
+               ).at[jnp.asarray(inv)].set(ids_j[jnp.asarray(perm)])
+    got2 = push_sparse_hostdedup(slab0, rebuilt, jnp.asarray(perm),
+                                 jnp.asarray(inv), jnp.asarray(grads), prng,
+                                 pt.layout, table.optimizer)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got2))
     pt.end_pass()
 
 
