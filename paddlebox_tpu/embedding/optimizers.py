@@ -260,6 +260,16 @@ def push_sparse_dedup(slab: jnp.ndarray, ids: jnp.ndarray,
     return slab.at[uids].set(new_rows)
 
 
+def rebuild_uids(ids: jnp.ndarray, perm: jnp.ndarray, inv: jnp.ndarray,
+                 pad_base: int) -> jnp.ndarray:
+    """Reconstruct dedup_ids' uids on device from (ids, perm, inv) — cheaper
+    than transferring them: out-of-slab defaults (pad_base+i, unique, drop at
+    the scatter), then each group's id scatter-set from its permuted
+    occurrences (duplicate indices all write the same value)."""
+    K = ids.shape[0]
+    return (jnp.arange(K, dtype=jnp.int32) + pad_base).at[inv].set(ids[perm])
+
+
 def push_sparse_hostdedup(slab: jnp.ndarray, uids: jnp.ndarray,
                           perm: jnp.ndarray, inv_sorted: jnp.ndarray,
                           grads: jnp.ndarray, prng: jax.Array,

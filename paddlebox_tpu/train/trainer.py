@@ -31,8 +31,8 @@ from paddlebox_tpu.config.configs import (DataFeedConfig, TableConfig,
 from paddlebox_tpu.data.dataset import BoxDataset
 from paddlebox_tpu.data.packer import PackedBatch
 from paddlebox_tpu.embedding.accessor import ValueLayout
-from paddlebox_tpu.embedding.optimizers import (push_sparse_dedup,
-                                                push_sparse_hostdedup)
+from paddlebox_tpu.embedding.optimizers import (push_sparse_hostdedup,
+                                                rebuild_uids)
 from paddlebox_tpu.embedding.pass_table import PassTable
 from paddlebox_tpu.metrics.auc import MetricRegistry
 from paddlebox_tpu.models.base import ModelSpec
@@ -267,19 +267,16 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         clicks = key_label_src[batch["segments"] // num_slots]
         push_grads = build_push_grads(demb, _key_slots(batch), clicks,
                                       _key_valid(batch))
-        if "perm" in batch:
-            # host precomputed the dedup (dedup_for_push): no device sort.
-            # uids rebuilt on device from (ids, perm, inv) — cheaper than
-            # shipping them: out-of-slab defaults, then each group's id
-            # scatter-set from its permuted occurrences
-            K = batch["ids"].shape[0]
-            uids = (jnp.arange(K, dtype=jnp.int32) + table.pass_capacity
-                    ).at[batch["inv"]].set(batch["ids"][batch["perm"]])
-            return push_sparse_hostdedup(
-                slab, uids, batch["perm"], batch["inv"],
-                push_grads, sub, layout, conf)
-        return push_sparse_dedup(slab, batch["ids"], push_grads, sub, layout,
-                                 conf)
+        if "perm" not in batch:
+            # never fall back to the on-device jnp.unique sort silently —
+            # that is the dominant step cost this path exists to remove
+            raise KeyError(
+                "train batch lacks host dedup (perm/inv) — host_batch must "
+                "run dedup_for_push for train batches")
+        uids = rebuild_uids(batch["ids"], batch["perm"], batch["inv"],
+                            table.pass_capacity)
+        return push_sparse_hostdedup(slab, uids, batch["perm"], batch["inv"],
+                                     push_grads, sub, layout, conf)
 
     # The slab is DONATED into the step: at production pass capacities the
     # slab is hundreds of MB and the pass holds exactly one live copy, so

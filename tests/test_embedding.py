@@ -232,11 +232,11 @@ def test_hostdedup_push_matches_device_dedup(init_range):
                                 pt.layout, table.optimizer)
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
     # the train step re-derives uids ON DEVICE from (ids, perm, inv)
-    # (_sparse_push in train/trainer.py) instead of transferring them —
-    # the rebuild must hit the same slab rows bit-identically
-    ids_j = jnp.asarray(ids)
-    rebuilt = (jnp.arange(K, dtype=jnp.int32) + table.pass_capacity
-               ).at[jnp.asarray(inv)].set(ids_j[jnp.asarray(perm)])
+    # (rebuild_uids) instead of transferring them — the rebuild must hit
+    # the same slab rows bit-identically
+    from paddlebox_tpu.embedding.optimizers import rebuild_uids
+    rebuilt = rebuild_uids(jnp.asarray(ids), jnp.asarray(perm),
+                           jnp.asarray(inv), table.pass_capacity)
     got2 = push_sparse_hostdedup(slab0, rebuilt, jnp.asarray(perm),
                                  jnp.asarray(inv), jnp.asarray(grads), prng,
                                  pt.layout, table.optimizer)

@@ -1,0 +1,151 @@
+"""One-window TPU measurement battery (run when the axon tunnel is up).
+
+Stages, each D2H-synced (np.asarray of chain-dependent data — axon's
+block_until_ready is a no-op, BASELINE.md):
+  1. full fused step at bench shapes (the bench number)
+  2. same step at 4x slab rows (slab-size scaling)
+  3. step WITHOUT the sparse push (isolates push cost)
+  4. step WITHOUT pull+push (dense fwd/bwd only)
+Prints one JSON line per stage; safe to kill any time.
+
+Usage:  timeout 1500 python -u tools/tpu_probe.py [platform]
+"""
+import json
+import sys
+import time
+
+import jax
+jax.config.update("jax_platforms",
+                  sys.argv[1] if len(sys.argv) > 1 else "axon")
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, "/root/repo")
+from paddlebox_tpu.config.configs import (SparseOptimizerConfig, TableConfig,
+                                          TrainerConfig)
+from paddlebox_tpu.data.generator import default_feed_config
+from paddlebox_tpu.data.packer import BatchPacker
+from paddlebox_tpu.data.slot_record import SlotRecord
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.models.deepfm import DeepFM
+from paddlebox_tpu.train.trainer import BoxTrainer
+
+D, NUM_SLOTS, BATCH, MAX_LEN = 8, 32, 1024, 4
+CHUNK, REPS = 8, 6
+
+
+def make_trainer(pass_cap):
+    feed = default_feed_config(num_slots=NUM_SLOTS, batch_size=BATCH,
+                               max_len=MAX_LEN)
+    table = TableConfig(embedx_dim=D, pass_capacity=pass_cap,
+                        optimizer=SparseOptimizerConfig(
+                            mf_create_thresholds=0.0, mf_initial_range=1e-3))
+    model = DeepFM(ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + D),
+                   hidden=(512, 256, 128))
+    return BoxTrainer(model, table, feed,
+                      TrainerConfig(dense_lr=1e-3, compute_dtype="bfloat16"),
+                      seed=0), feed
+
+
+def make_batches(feed, n):
+    rng = np.random.RandomState(0)
+    packer = BatchPacker(feed)
+    out = []
+    for _ in range(n):
+        recs = []
+        for _ in range(BATCH):
+            slots = {}
+            for si in range(NUM_SLOTS):
+                k = rng.randint(1, MAX_LEN + 1)
+                feas = (rng.randint(0, 1 << 22, k).astype(np.uint64)
+                        * np.uint64(NUM_SLOTS) + np.uint64(si))
+                slots[si] = feas
+            recs.append(SlotRecord(label=int(rng.rand() < 0.25),
+                                   uint64_slots=slots))
+        out.append(packer.pack(recs))
+    return out
+
+
+def timed_scan(scan, state, stacked, reps=REPS):
+    for _ in range(2):
+        slab, params, opt, losses, _p, key = scan(
+            state[0], state[1], state[2], stacked, state[3])
+        state = (slab, params, opt, key)
+    np.asarray(losses)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        slab, params, opt, losses, _p, key = scan(
+            state[0], state[1], state[2], stacked, state[3])
+        state = (slab, params, opt, key)
+    np.asarray(losses)
+    dt = (time.perf_counter() - t0) / (reps * CHUNK)
+    return dt
+
+
+def stage(name, pass_cap, strip=None):
+    """strip: None | 'push' | 'sparse' — build a variant step."""
+    tr, feed = make_trainer(pass_cap)
+    batches = make_batches(feed, CHUNK)
+    tr.table.begin_feed_pass()
+    for b in batches:
+        tr.table.add_keys(b.keys[b.valid])
+    tr.table.end_feed_pass()
+    tr.table.begin_pass()
+    stacked = tr._stack_batches(batches)
+    if strip is None:
+        scan = tr.fns.scan_steps
+    else:
+        from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
+        from paddlebox_tpu.ops.sparse import pull_sparse
+        from paddlebox_tpu.train.trainer import make_scan
+        layout = tr.table.layout
+        dense_opt = tr.dense_opt
+        model = tr.model
+        trash = tr.table.padding_id
+
+        def step(slab, params, opt_state, batch, prng):
+            prng, sub = jax.random.split(prng)
+            valid = batch["ids"] != trash
+
+            def loss_fn(p, emb):
+                pooled = fused_seqpool_cvm(emb, batch["segments"], valid,
+                                           BATCH, NUM_SLOTS, use_cvm=True,
+                                           sorted_segments=True)
+                pj = jax.tree.map(
+                    lambda x: x.astype(jnp.bfloat16)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
+                logits = model.apply(pj, pooled.astype(jnp.bfloat16), None)
+                lab = batch["labels"].astype(jnp.float32)
+                bce = optax.sigmoid_binary_cross_entropy(
+                    logits.astype(jnp.float32), lab)
+                return jnp.where(batch["ins_valid"], bce, 0.0).sum() / BATCH
+
+            if strip == "sparse":
+                emb = jnp.zeros((batch["ids"].shape[0], 3 + D), jnp.float32)
+            else:
+                emb = pull_sparse(slab, batch["ids"], layout)
+            loss, (dp, demb) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1))(params, emb)
+            updates, opt_state = dense_opt.update(dp, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            # no push in either stripped variant; keep a slab dependency
+            slab = slab.at[0, 0].add(loss * 0.0)
+            return slab, params, opt_state, loss, {"ctr": loss}, prng
+
+        scan = make_scan(step)
+    state = (tr.table.slab, tr.params, tr.opt_state, tr.table.next_prng())
+    dt = timed_scan(scan, state, stacked)
+    print(json.dumps({"stage": name, "pass_cap": pass_cap,
+                      "ms_per_step": round(dt * 1e3, 3),
+                      "examples_per_sec": round(BATCH / dt, 1)}), flush=True)
+
+
+if __name__ == "__main__":
+    dev = jax.devices()[0]
+    print(json.dumps({"device": str(dev), "platform": dev.platform}),
+          flush=True)
+    stage("full_step", 1 << 20)
+    stage("full_step_4x_slab", 1 << 22)
+    stage("no_push", 1 << 20, strip="push")
+    stage("dense_only", 1 << 20, strip="sparse")
