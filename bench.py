@@ -86,11 +86,11 @@ def measure(platform: str) -> None:
     import jax
     import numpy as np
 
+    from tools.bench_util import make_ctr_batches, timed_scan_chain
+
     from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
                                               TableConfig, TrainerConfig)
     from paddlebox_tpu.data.generator import default_feed_config
-    from paddlebox_tpu.data.packer import BatchPacker
-    from paddlebox_tpu.data.slot_record import SlotRecord
     from paddlebox_tpu.models.base import ModelSpec
     from paddlebox_tpu.models.deepfm import DeepFM
     from paddlebox_tpu.train.trainer import BoxTrainer
@@ -110,23 +110,7 @@ def measure(platform: str) -> None:
                          TrainerConfig(dense_lr=1e-3, compute_dtype=dtype),
                          seed=0)
 
-    rng = np.random.RandomState(0)
-    packer = BatchPacker(feed)
-
-    def make_batch():
-        recs = []
-        for _ in range(BATCH):
-            slots = {}
-            for si in range(NUM_SLOTS):
-                n = rng.randint(1, MAX_LEN + 1)
-                feas = (rng.randint(0, 1 << 22, n).astype(np.uint64)
-                        * np.uint64(NUM_SLOTS) + np.uint64(si))
-                slots[si] = feas
-            recs.append(SlotRecord(label=int(rng.rand() < 0.25),
-                                   uint64_slots=slots))
-        return packer.pack(recs)
-
-    batches = [make_batch() for _ in range(CHUNK)]
+    batches = make_ctr_batches(feed, CHUNK, NUM_SLOTS, MAX_LEN, seed=0)
     trainer.table.begin_feed_pass()
     for b in batches:
         trainer.table.add_keys(b.keys[b.valid])
@@ -139,34 +123,16 @@ def measure(platform: str) -> None:
              trainer.table.next_prng())
 
     t_compile = time.perf_counter()
-    for _ in range(WARMUP):
-        slab, params, opt, losses, _preds, key = scan(
-            state[0], state[1], state[2], stacked, state[3])
-        state = (slab, params, opt, key)
-    warm = np.asarray(losses)          # real D2H: forces compile + warmup
-    if not np.isfinite(warm).all():
-        raise FloatingPointError(f"non-finite warmup losses {warm}")
-    t_compile = time.perf_counter() - t_compile
+    dt = timed_scan_chain(scan, state, stacked, STEPS, warmup=WARMUP)
+    t_compile = time.perf_counter() - t_compile - dt * STEPS
 
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        slab, params, opt, losses, _preds, key = scan(
-            state[0], state[1], state[2], stacked, state[3])
-        state = (slab, params, opt, key)
-    # sync on data that depends on the whole chunk chain (each chunk's input
-    # slab/params are the previous chunk's outputs)
-    final = np.asarray(losses)
-    dt = time.perf_counter() - t0
-    if not np.isfinite(final).all():
-        raise FloatingPointError(f"non-finite losses {final}")
-
-    eps = STEPS * CHUNK * BATCH / dt
+    eps = CHUNK * BATCH / dt
     print(json.dumps({
         "examples_per_sec": eps,
         "platform": jax.devices()[0].platform,
         "device": str(jax.devices()[0]),
         "compute_dtype": dtype,
-        "steady_ms_per_step": round(dt * 1e3 / (STEPS * CHUNK), 4),
+        "steady_ms_per_step": round(dt * 1e3 / CHUNK, 4),
         "compile_warmup_s": round(t_compile, 1),
     }))
 

@@ -31,7 +31,14 @@ def main() -> None:
                     choices=["step", "k_step", "sharding"])
     ap.add_argument("--gpups", action="store_true",
                     help="back the shard stores with a TCP CPU PS")
+    ap.add_argument("--ssd-budget-mb", type=float, default=0,
+                    help="feed-ranking posture: host-DRAM row budget; rows "
+                         "beyond it spill to an SSD tier each end_pass")
     args = ap.parse_args()
+    if args.gpups and args.ssd_budget_mb:
+        ap.error("--ssd-budget-mb spills the LOCAL host stores; with "
+                 "--gpups the stores live on the CPU PS (its tables manage "
+                 "their own tiering) — pick one")
 
     import jax
 
@@ -56,7 +63,9 @@ def main() -> None:
     table = TableConfig(
         embedx_dim=D, pass_capacity=P * (1 << 15),
         optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
-                                        mf_initial_range=1e-3))
+                                        mf_initial_range=1e-3),
+        ssd_dir=(os.path.join(data_dir, "ssd") if args.ssd_budget_mb else None),
+        ssd_threshold_mb=args.ssd_budget_mb)
     tcfg = TrainerConfig(dense_lr=1e-3, sync_mode=args.sync,
                          sync_weight_step=4 if args.sync == "k_step" else 1,
                          sharding=args.sync == "sharding")

@@ -72,14 +72,15 @@ class TableConfig:
     # host/SSD tiering
     host_shard_bits: int = 6             # host store sharded into 2**bits locks
     ssd_dir: Optional[str] = None        # spill tier directory; None = DRAM only
-    ssd_threshold_mb: int = 0            # spill host values beyond this budget
+    ssd_threshold_mb: float = 0          # spill host values beyond this budget
 
     def ssd_max_resident_rows(self, row_width: int) -> Optional[int]:
         """DRAM row budget for the pass-cadence limiter
-        (CheckNeedLimitMem, box_wrapper.h:627-629); None = no limit."""
+        (CheckNeedLimitMem, box_wrapper.h:627-629); None = no limit.
+        Fractional MB budgets are honored (small-scale tests)."""
         if not self.ssd_dir or not self.ssd_threshold_mb:
             return None
-        return (self.ssd_threshold_mb << 20) // (row_width * 4)
+        return int(self.ssd_threshold_mb * (1 << 20)) // (row_width * 4)
 
 
 @dataclasses.dataclass(frozen=True)

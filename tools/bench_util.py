@@ -1,0 +1,58 @@
+"""Shared helpers for bench.py and tools/tpu_probe.py — ONE definition of
+the synthetic workload and of the D2H-synced timing loop, so the probe
+decomposes exactly the number the bench reports."""
+
+import time
+from typing import List
+
+import numpy as np
+
+
+def make_ctr_batches(feed, n_batches: int, num_slots: int, max_len: int,
+                     seed: int = 0) -> List:
+    """The bench's synthetic CTR batches: ~(max_len+1)/2 keys per slot per
+    instance, globally slot-disambiguated uint64 feasigns, 25% positives."""
+    from paddlebox_tpu.data.packer import BatchPacker
+    from paddlebox_tpu.data.slot_record import SlotRecord
+
+    rng = np.random.RandomState(seed)
+    packer = BatchPacker(feed)
+    out = []
+    for _ in range(n_batches):
+        recs = []
+        for _ in range(feed.batch_size):
+            slots = {}
+            for si in range(num_slots):
+                n = rng.randint(1, max_len + 1)
+                feas = (rng.randint(0, 1 << 22, n).astype(np.uint64)
+                        * np.uint64(num_slots) + np.uint64(si))
+                slots[si] = feas
+            recs.append(SlotRecord(label=int(rng.rand() < 0.25),
+                                   uint64_slots=slots))
+        out.append(packer.pack(recs))
+    return out
+
+
+def timed_scan_chain(scan, state, stacked, reps: int, warmup: int = 2):
+    """Run `scan(slab, params, opt_state, stacked, prng)` reps times with the
+    state threaded through (each call consumes the previous call's outputs)
+    and return seconds per call. The sync point is np.asarray of the LAST
+    call's losses — data that depends on the whole chain — because axon's
+    block_until_ready returns early (BASELINE.md measurement validity)."""
+    for _ in range(warmup):
+        slab, params, opt, losses, _p, key = scan(
+            state[0], state[1], state[2], stacked, state[3])
+        state = (slab, params, opt, key)
+    warm = np.asarray(losses)
+    if not np.isfinite(warm).all():
+        raise FloatingPointError(f"non-finite warmup losses {warm}")
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        slab, params, opt, losses, _p, key = scan(
+            state[0], state[1], state[2], stacked, state[3])
+        state = (slab, params, opt, key)
+    final = np.asarray(losses)
+    dt = (time.perf_counter() - t0) / reps
+    if not np.isfinite(final).all():
+        raise FloatingPointError(f"non-finite losses {final}")
+    return dt

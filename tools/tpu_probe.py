@@ -1,8 +1,8 @@
 """One-window TPU measurement battery (run when the axon tunnel is up).
 
-Stages, each D2H-synced (np.asarray of chain-dependent data — axon's
+Stages, each D2H-synced via tools.bench_util.timed_scan_chain (axon's
 block_until_ready is a no-op, BASELINE.md):
-  1. full fused step at bench shapes (the bench number)
+  1. full fused step at bench shapes (decomposes the bench number)
   2. same step at 4x slab rows (slab-size scaling)
   3. step WITHOUT the sparse push (isolates push cost)
   4. step WITHOUT pull+push (dense fwd/bwd only)
@@ -11,8 +11,10 @@ Prints one JSON line per stage; safe to kill any time.
 Usage:  timeout 1500 python -u tools/tpu_probe.py [platform]
 """
 import json
+import os
 import sys
-import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 jax.config.update("jax_platforms",
@@ -21,15 +23,14 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-sys.path.insert(0, "/root/repo")
+from tools.bench_util import make_ctr_batches, timed_scan_chain
+
 from paddlebox_tpu.config.configs import (SparseOptimizerConfig, TableConfig,
                                           TrainerConfig)
 from paddlebox_tpu.data.generator import default_feed_config
-from paddlebox_tpu.data.packer import BatchPacker
-from paddlebox_tpu.data.slot_record import SlotRecord
 from paddlebox_tpu.models.base import ModelSpec
 from paddlebox_tpu.models.deepfm import DeepFM
-from paddlebox_tpu.train.trainer import BoxTrainer
+from paddlebox_tpu.train.trainer import BoxTrainer, cast_for_compute
 
 D, NUM_SLOTS, BATCH, MAX_LEN = 8, 32, 1024, 4
 CHUNK, REPS = 8, 6
@@ -48,45 +49,10 @@ def make_trainer(pass_cap):
                       seed=0), feed
 
 
-def make_batches(feed, n):
-    rng = np.random.RandomState(0)
-    packer = BatchPacker(feed)
-    out = []
-    for _ in range(n):
-        recs = []
-        for _ in range(BATCH):
-            slots = {}
-            for si in range(NUM_SLOTS):
-                k = rng.randint(1, MAX_LEN + 1)
-                feas = (rng.randint(0, 1 << 22, k).astype(np.uint64)
-                        * np.uint64(NUM_SLOTS) + np.uint64(si))
-                slots[si] = feas
-            recs.append(SlotRecord(label=int(rng.rand() < 0.25),
-                                   uint64_slots=slots))
-        out.append(packer.pack(recs))
-    return out
-
-
-def timed_scan(scan, state, stacked, reps=REPS):
-    for _ in range(2):
-        slab, params, opt, losses, _p, key = scan(
-            state[0], state[1], state[2], stacked, state[3])
-        state = (slab, params, opt, key)
-    np.asarray(losses)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        slab, params, opt, losses, _p, key = scan(
-            state[0], state[1], state[2], stacked, state[3])
-        state = (slab, params, opt, key)
-    np.asarray(losses)
-    dt = (time.perf_counter() - t0) / (reps * CHUNK)
-    return dt
-
-
 def stage(name, pass_cap, strip=None):
     """strip: None | 'push' | 'sparse' — build a variant step."""
     tr, feed = make_trainer(pass_cap)
-    batches = make_batches(feed, CHUNK)
+    batches = make_ctr_batches(feed, CHUNK, NUM_SLOTS, MAX_LEN, seed=0)
     tr.table.begin_feed_pass()
     for b in batches:
         tr.table.add_keys(b.keys[b.valid])
@@ -112,9 +78,7 @@ def stage(name, pass_cap, strip=None):
                 pooled = fused_seqpool_cvm(emb, batch["segments"], valid,
                                            BATCH, NUM_SLOTS, use_cvm=True,
                                            sorted_segments=True)
-                pj = jax.tree.map(
-                    lambda x: x.astype(jnp.bfloat16)
-                    if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
+                pj = cast_for_compute(p, jnp.bfloat16)
                 logits = model.apply(pj, pooled.astype(jnp.bfloat16), None)
                 lab = batch["labels"].astype(jnp.float32)
                 bce = optax.sigmoid_binary_cross_entropy(
@@ -135,7 +99,7 @@ def stage(name, pass_cap, strip=None):
 
         scan = make_scan(step)
     state = (tr.table.slab, tr.params, tr.opt_state, tr.table.next_prng())
-    dt = timed_scan(scan, state, stacked)
+    dt = timed_scan_chain(scan, state, stacked, REPS) / CHUNK
     print(json.dumps({"stage": name, "pass_cap": pass_cap,
                       "ms_per_step": round(dt * 1e3, 3),
                       "examples_per_sec": round(BATCH / dt, 1)}), flush=True)
