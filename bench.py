@@ -38,10 +38,11 @@ import time
 # First honest recorded numbers per platform (np.asarray-synced chain).
 # Update only when the workload definition changes, never for code speedups
 # — vs_baseline > 1.0 means this build is faster than the recorded round.
-# No TPU entry yet: every TPU-side number before round 2 was invalidated by
-# the fake-sync finding above; the first D2H-synced TPU run will set it.
 SELF_BASELINE = {
     "cpu": 9_609.0,        # round 2, container CPU (fallback tier)
+    # round 2, v5e via axon, first D2H-synced TPU run (device-sort push,
+    # before the host-dedup redesign): 23.3 ms/step — BASELINE.md r2 row
+    "tpu": 44_031.0,
 }
 
 D = 8

@@ -121,11 +121,44 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rt_bucketize.argtypes = [c.c_void_p, P(c.c_uint64), P(c.c_uint8),
                                  c.c_int64, c.c_int32, c.c_int32,
                                  P(c.c_int32), P(c.c_int32), P(c.c_uint64)]
+    lib.rt_lookup.restype = c.c_int64
+    lib.rt_lookup.argtypes = [c.c_void_p, P(c.c_uint64), P(c.c_uint8),
+                              c.c_int64, c.c_int32, P(c.c_int32),
+                              P(c.c_uint64)]
     lib.rt_dedup.restype = c.c_int64
     lib.rt_dedup.argtypes = [P(c.c_int32), c.c_int64, c.c_int32,
                              P(c.c_int32), P(c.c_int32), P(c.c_int32),
                              P(c.c_int64)]
     return lib
+
+
+def create_route_index(shard_keys) -> Optional[int]:
+    """Build the native pass key→id hash index from per-shard SORTED key
+    arrays (rt_index_create copies the keys into its own table). Returns the
+    opaque handle, or None when the native lib is unavailable or the pass is
+    empty. The single-shard PassTable is just the P=1 case."""
+    import numpy as np
+    lib = get_lib()
+    shard_keys = [np.ascontiguousarray(k, dtype=np.uint64)
+                  for k in shard_keys]
+    total = sum(k.size for k in shard_keys)
+    if lib is None or not total:
+        return None
+    flat = np.ascontiguousarray(np.concatenate(shard_keys))
+    off = np.zeros(len(shard_keys) + 1, np.int64)
+    np.cumsum([k.size for k in shard_keys], out=off[1:])
+    return lib.rt_index_create(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(shard_keys))
+
+
+def destroy_route_index(handle) -> None:
+    if handle is None:
+        return
+    lib = get_lib()
+    if lib is not None:
+        lib.rt_index_destroy(handle)
 
 
 def load_lib(path: str) -> ctypes.CDLL:

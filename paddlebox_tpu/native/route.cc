@@ -213,6 +213,40 @@ int64_t rt_bucketize(void* index, const uint64_t* keys, uint8_t* valid,
   return overflow;
 }
 
+// Plain key -> pass-local id translation over the pass index (the
+// single-shard analog of rt_bucketize: no bucketing, no dedup). Replaces
+// np.searchsorted's ~20 dependent cache misses per key with ~1 probe.
+// valid==0 positions get padding_id. Returns 0, or -1 with *missing_out set
+// when a valid key is not in the pass index.
+int64_t rt_lookup(void* index, const uint64_t* keys, const uint8_t* valid,
+                  int64_t K, int32_t padding_id, int32_t* out_ids,
+                  uint64_t* missing_out) {
+  RouteIndex* ix = static_cast<RouteIndex*>(index);
+  for (int64_t i = 0; i < K; ++i) {
+    if (valid && !valid[i]) {
+      out_ids[i] = padding_id;
+      continue;
+    }
+    uint64_t k = keys[i];
+    if (k == kEmpty) {  // sentinel-colliding key lives out-of-band
+      if (!ix->has_max_key) {
+        *missing_out = k;
+        return -1;
+      }
+      out_ids[i] = ix->max_key_pos;
+      continue;
+    }
+    uint64_t h = mix64(k) & ix->mask;
+    while (ix->keys[h] != kEmpty && ix->keys[h] != k) h = (h + 1) & ix->mask;
+    if (ix->keys[h] == kEmpty) {
+      *missing_out = k;
+      return -1;
+    }
+    out_ids[i] = ix->pos[h];
+  }
+  return 0;
+}
+
 // Per-batch id dedup for the single-shard push (host analog of
 // DedupKeysAndFillIdx, box_wrapper_impl.h:129): hash dedup + counting sort,
 // no comparison sort. Outputs feed push_sparse_hostdedup:

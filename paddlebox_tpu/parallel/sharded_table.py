@@ -105,11 +105,9 @@ class ShardedPassTable:
         self._route_index = None  # native pass index handle
 
     def _drop_route_index(self) -> None:
-        if self._route_index is not None:
-            native = _route_lib()
-            if native is not None:
-                native.rt_index_destroy(self._route_index)
-            self._route_index = None
+        from paddlebox_tpu.native.build import destroy_route_index
+        destroy_route_index(self._route_index)
+        self._route_index = None
 
     def __del__(self):
         try:
@@ -155,22 +153,10 @@ class ShardedPassTable:
                     f"{self.shard_cap} (raise TableConfig.pass_capacity)")
             self._shard_keys.append(ks)
         self._drop_route_index()
-        native = _route_lib()
-        if native is not None:
-            # native pass index (key → slab-local id hash map): built once
-            # here, amortized over every batch of the pass; the flat copy is
-            # scratch (rt_index_create hashes the keys into its own table)
-            import ctypes
-            c = ctypes
-            sk_flat = np.ascontiguousarray(
-                np.concatenate(self._shard_keys)
-                if self._shard_keys else np.empty(0, np.uint64))
-            sk_off = np.zeros(self.num_shards + 1, np.int64)
-            np.cumsum([k.size for k in self._shard_keys], out=sk_off[1:])
-            self._route_index = native.rt_index_create(
-                sk_flat.ctypes.data_as(c.POINTER(c.c_uint64)),
-                sk_off.ctypes.data_as(c.POINTER(c.c_int64)),
-                self.num_shards)
+        # native pass index (key → slab-local id hash map): built once here,
+        # amortized over every batch of the pass
+        from paddlebox_tpu.native.build import create_route_index
+        self._route_index = create_route_index(self._shard_keys)
         self._feed_keys = []
         self._in_feed_pass = False
 

@@ -288,6 +288,31 @@ def _numpy_dedup(pt, ids):
         return pt.dedup_for_push(ids)
 
 
+def test_native_lookup_matches_searchsorted():
+    """rt_lookup (hash probe) must agree with the numpy fallback, honor
+    valid masking, and reject unregistered keys."""
+    table = TableConfig(embedx_dim=D, pass_capacity=1 << 10)
+    pt = PassTable(table)
+    rng = np.random.RandomState(7)
+    keys = np.unique(rng.randint(1, 1 << 60, 300).astype(np.uint64))
+    pt.begin_feed_pass()
+    pt.add_keys(keys)
+    pt.end_feed_pass()
+    pt.begin_pass()
+    batch = rng.choice(keys, 128).astype(np.uint64)
+    valid = rng.rand(128) > 0.25
+    got = pt.lookup_ids(batch, valid)
+    ri, pt._route_index = pt._route_index, None
+    want = pt.lookup_ids(batch, valid)
+    pt._route_index = ri
+    np.testing.assert_array_equal(got, want)
+    assert (got[~valid] == pt.padding_id).all()
+    if ri is not None:
+        with pytest.raises(KeyError):
+            pt.lookup_ids(np.array([keys.max() + 1], dtype=np.uint64))
+    pt.end_pass()
+
+
 def test_unregistered_key_raises():
     table = TableConfig(embedx_dim=D, pass_capacity=64)
     pt = PassTable(table)

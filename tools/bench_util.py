@@ -39,6 +39,8 @@ def timed_scan_chain(scan, state, stacked, reps: int, warmup: int = 2):
     and return seconds per call. The sync point is np.asarray of the LAST
     call's losses — data that depends on the whole chain — because axon's
     block_until_ready returns early (BASELINE.md measurement validity)."""
+    if warmup < 1:
+        raise ValueError("warmup must be >= 1 (the first call compiles)")
     for _ in range(warmup):
         slab, params, opt, losses, _p, key = scan(
             state[0], state[1], state[2], stacked, state[3])
