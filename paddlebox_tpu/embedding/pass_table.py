@@ -259,26 +259,9 @@ class PassTable:
         if self._pass_keys is None:
             raise RuntimeError("no active pass key set")
         if self._route_index is not None:
-            import ctypes
-            c = ctypes
-            keys_c = np.ascontiguousarray(keys)
-            v = (np.ascontiguousarray(valid, np.uint8) if valid is not None
-                 else None)
-            out = np.empty(keys.shape[0], np.int32)
-            missing = np.zeros(1, np.uint64)
-            from paddlebox_tpu.native.build import get_lib
-            rc = get_lib().rt_lookup(
-                self._route_index,
-                keys_c.ctypes.data_as(c.POINTER(c.c_uint64)),
-                v.ctypes.data_as(c.POINTER(c.c_uint8)) if v is not None
-                else None,
-                keys.shape[0], self.padding_id,
-                out.ctypes.data_as(c.POINTER(c.c_int32)),
-                missing.ctypes.data_as(c.POINTER(c.c_uint64)))
-            if rc == -1:
-                raise KeyError(
-                    f"key not registered in feed pass: {missing[0]}")
-            return out
+            from paddlebox_tpu.native.build import route_lookup
+            return route_lookup(self._route_index, keys, valid,
+                                self.padding_id)
         ids = np.searchsorted(self._pass_keys, keys)
         ids = np.minimum(ids, max(self._pass_keys.size - 1, 0))
         if self._pass_keys.size:

@@ -3,7 +3,19 @@
 Baseline config 1 of BASELINE.json; structurally the model built by the
 reference's test_boxps.py graph (emb via _pull_box_sparse → sum-pool → cvm →
 fc stack → sigmoid, python/paddle/fluid/tests/unittests/test_boxps.py:87-103
-and ctr_dataset_reader-style examples)."""
+and ctr_dataset_reader-style examples).
+
+use_data_norm adds the reference CTR models' streaming input normalization
+(data_norm_op over the flattened slot features; the "summary" params of
+boxps_worker.cc:89-95). The summary state lives in params under
+``dn_summary`` but is updated by the trainers via ``update_summary`` (the
+running-sums decay rule), NOT by the dense optimizer — its entries are
+stop_gradient'ed in apply so optax sees zero grads. No special sync mode is
+needed in multi-device training: normalization uses only the RATIOS
+batch_sum/batch_size and batch_size/batch_square_sum, which are invariant
+under the trainers' pmean dense sync (mean vs the reference's
+DenseDataNormal sum differs by the world-size factor on all three
+components at once)."""
 
 from __future__ import annotations
 
@@ -14,6 +26,8 @@ import jax.numpy as jnp
 
 from paddlebox_tpu.models.base import ModelSpec
 from paddlebox_tpu.models.layers import mlp_apply, mlp_init
+from paddlebox_tpu.ops.data_norm import (DataNormState, data_norm,
+                                         data_norm_summary_update)
 
 
 class CtrDnn:
@@ -21,17 +35,58 @@ class CtrDnn:
     task_names = ("ctr",)
 
     def __init__(self, spec: ModelSpec,
-                 hidden: Sequence[int] = (512, 256, 128)) -> None:
+                 hidden: Sequence[int] = (512, 256, 128),
+                 use_data_norm: bool = False,
+                 dn_slot_dim: int = 0,
+                 dn_decay: float = 0.9999999) -> None:
         self.spec = spec
         self.hidden = tuple(hidden)
+        self.use_data_norm = use_data_norm
+        self.dn_slot_dim = dn_slot_dim
+        self.dn_decay = dn_decay
 
     def init(self, rng: jax.Array) -> Dict:
         dims = [self.spec.total_in, *self.hidden, 1]
-        return mlp_init(rng, dims, "dnn")
+        params = mlp_init(rng, dims, "dnn")
+        if self.use_data_norm:
+            st = DataNormState.init(self.spec.total_in)
+            params["dn_summary"] = {"batch_size": st.batch_size,
+                                    "batch_sum": st.batch_sum,
+                                    "batch_square_sum": st.batch_square_sum}
+        return params
 
-    def apply(self, params: Dict, pooled: jnp.ndarray,
-              dense: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    def _dn_state(self, params: Dict) -> DataNormState:
+        dn = params["dn_summary"]
+        return DataNormState(dn["batch_size"], dn["batch_sum"],
+                             dn["batch_square_sum"])
+
+    def _assemble(self, pooled: jnp.ndarray,
+                  dense: Optional[jnp.ndarray]) -> jnp.ndarray:
         x = pooled.reshape(pooled.shape[0], -1)
         if dense is not None:
             x = jnp.concatenate([x, dense], axis=-1)
+        return x
+
+    def apply(self, params: Dict, pooled: jnp.ndarray,
+              dense: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        x = self._assemble(pooled, dense)
+        if self.use_data_norm:
+            state = jax.tree.map(jax.lax.stop_gradient,
+                                 self._dn_state(params))
+            x = data_norm(x.astype(jnp.float32), state,
+                          slot_dim=self.dn_slot_dim).astype(x.dtype)
         return mlp_apply(params, x, "dnn")[:, 0]
+
+    def update_summary(self, params: Dict, pooled: jnp.ndarray,
+                       dense: Optional[jnp.ndarray] = None) -> Dict:
+        """Accumulate this batch into the running summaries (the trainers
+        call this after the optimizer step; summary stats never flow
+        through optax)."""
+        x = self._assemble(pooled, dense).astype(jnp.float32)
+        st = data_norm_summary_update(self._dn_state(params),
+                                      x, decay=self.dn_decay,
+                                      slot_dim=self.dn_slot_dim)
+        return dict(params, dn_summary={"batch_size": st.batch_size,
+                                        "batch_sum": st.batch_sum,
+                                        "batch_square_sum":
+                                            st.batch_square_sum})

@@ -161,6 +161,28 @@ def destroy_route_index(handle) -> None:
         lib.rt_index_destroy(handle)
 
 
+def route_lookup(handle, keys, valid, padding_id: int):
+    """Translate keys → pass-local ids via the native index (rt_lookup).
+    valid may be None (all positions valid); invalid positions map to
+    padding_id. Raises KeyError for an unregistered valid key."""
+    import numpy as np
+    lib = get_lib()
+    keys = np.ascontiguousarray(keys, dtype=np.uint64)
+    v = None if valid is None else np.ascontiguousarray(valid, np.uint8)
+    out = np.empty(keys.shape[0], np.int32)
+    missing = np.zeros(1, np.uint64)
+    rc = lib.rt_lookup(
+        handle, keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        v.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)) if v is not None
+        else None,
+        keys.shape[0], padding_id,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        missing.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+    if rc == -1:
+        raise KeyError(f"key not registered in feed pass: {missing[0]}")
+    return out
+
+
 def load_lib(path: str) -> ctypes.CDLL:
     """Bind a user-supplied shared object honoring the parser C ABI
     (the DLManager dlopen path for custom parser plugins). Plugins only

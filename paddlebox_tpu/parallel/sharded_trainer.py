@@ -215,11 +215,15 @@ class ShardedBoxTrainer:
         layout = self.table.layout
         conf = self.table.config.optimizer
         S = self.num_slots
+        B = self.feed.batch_size
+        use_cvm = self.use_cvm
         multi_task = self.multi_task
         axis = self.axis
         sharding_mode = self.sharding_mode
         k_step = self.k_step
         lr = self.cfg.dense_lr
+        has_summary = (getattr(model, "use_data_norm", False)
+                       and hasattr(model, "update_summary"))
         pull_emb, forward_logits, preds_of = self._pull_and_forward()
 
         def shard_step(slab, params, opt_state, batch, prng):
@@ -256,6 +260,15 @@ class ShardedBoxTrainer:
 
             grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)
             (loss, preds), (dparams, demb) = grad_fn(params, emb)
+            # data_norm summary delta from THIS device's batch (running-sums
+            # rule; grads are zero by stop_gradient). Applied after the mode
+            # branch; pmean sync keeps the ratios exact (see CtrDnn docs).
+            dn_new = None
+            if has_summary:
+                pooled_f32 = fused_seqpool_cvm(
+                    emb, batch["segments"], batch["valid"], B, S, use_cvm)
+                dn_new = model.update_summary(
+                    params, pooled_f32, batch.get("dense"))["dn_summary"]
 
             # ---- dense sync by mode
             loss = jax.lax.pmean(loss, axis)
@@ -301,6 +314,20 @@ class ShardedBoxTrainer:
                 updates, opt_state = self.dense_opt.update(
                     dparams, opt_state, params)
                 params = optax.apply_updates(params, updates)
+
+            if dn_new is not None:
+                # overwrite the summary leaves with the running-sums result
+                # (the optimizer's zero-grad update on them is a no-op).
+                # Replicated-params modes must pmean the per-device results
+                # (decay·state is common; the per-batch deltas average,
+                # which preserves the normalization ratios exactly);
+                # k_step replicas diverge by design until the param sync.
+                if k_step > 1 and not sharding_mode:
+                    params = dict(params, dn_summary=jax.tree.map(
+                        lambda x: x[None], dn_new))
+                else:
+                    params = dict(params, dn_summary=jax.lax.pmean(
+                        dn_new, axis))
 
             # ---- push: per-key grads → bucket merge → a2a → local update
             label_src = (batch["labels_" + model.task_names[0]] if multi_task

@@ -159,6 +159,20 @@ def _multi_task_loss(logits, labels_dict, ins_valid, loss_mode: str = "sum"):
     return total, preds
 
 
+def _flat_summary_mask(params) -> Optional[np.ndarray]:
+    """Flat bool mask marking data_norm summary leaves in the raveled param
+    vector (AsyncDenseTable applies raw running-sum deltas there instead of
+    adam); None when the model has no summary state."""
+    if not (isinstance(params, dict) and "dn_summary" in params):
+        return None
+    marked = {k: jax.tree.map(
+        lambda x, _k=k: jnp.full(jnp.shape(x),
+                                 1.0 if _k == "dn_summary" else 0.0), v)
+        for k, v in params.items()}
+    flat = jax.flatten_util.ravel_pytree(marked)[0]
+    return np.asarray(flat) > 0.5
+
+
 def model_accepts_rank_offset(model) -> bool:
     """Join-phase models take the pv rank matrix as a keyword arg."""
     import inspect
@@ -179,13 +193,18 @@ def resolve_compute_dtype(name: str) -> jnp.dtype:
     return d
 
 
-def cast_for_compute(tree, dtype):
+def cast_for_compute(tree, dtype, preserve=("dn_summary",)):
     """Mixed precision: float leaves → compute dtype (grads flow back
-    through the cast to the f32 master copies)."""
+    through the cast to the f32 master copies). Top-level subtrees named in
+    ``preserve`` stay f32 — data_norm summary stats (magnitudes ~1e4) must
+    normalize at full precision, which an 8-bit-mantissa cast would defeat."""
     def _cast(x):
         if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
             return x.astype(dtype)
         return x
+    if isinstance(tree, dict) and any(k in tree for k in preserve):
+        return {k: (v if k in preserve else jax.tree.map(_cast, v))
+                for k, v in tree.items()}
     return jax.tree.map(_cast, tree)
 
 
@@ -215,6 +234,11 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
     cdtype = resolve_compute_dtype(compute_dtype)
     mixed = cdtype != jnp.float32
     padding_id = table.pass_capacity - 1
+    # data_norm summary params (boxps_worker.cc:89-95) update by the
+    # running-sums rule, not the optimizer (their grads are zero — the model
+    # stop_gradients the state in apply)
+    has_summary = (getattr(model, "use_data_norm", False)
+                   and hasattr(model, "update_summary"))
 
     # per-key slots/valid are DERIVED on device, not transferred: the packer
     # guarantees segments = ins*num_slots + slot and lookup_ids maps every
@@ -298,6 +322,12 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         (loss, preds), (dparams, demb) = grad_fn(params, emb)
         updates, opt_state = dense_opt.update(dparams, opt_state, params)
         params = optax.apply_updates(params, updates)
+        if has_summary:
+            # recomputed pooled CSEs with the forward's (same inputs)
+            pooled = fused_seqpool_cvm(
+                emb, batch["segments"], _key_valid(batch), batch_size,
+                num_slots, use_cvm=use_cvm, sorted_segments=True)
+            params = model.update_summary(params, pooled, batch.get("dense"))
         slab = _sparse_push(slab, demb, batch, sub)
         return slab, params, opt_state, loss, preds, prng
 
@@ -317,6 +347,21 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         emb = pull_sparse(slab, batch["ids"], layout)
         grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)
         (loss, preds), (dparams, demb) = grad_fn(params, emb)
+        if has_summary:
+            # the host adam thread sees zero grads for the summary leaves;
+            # their running-sums update happens here on device and rides
+            # back to the host table through the flat grad vector as a
+            # DELTA the summary mask applies raw (async_dense.py:119-121)
+            pooled = fused_seqpool_cvm(
+                emb, batch["segments"], _key_valid(batch), batch_size,
+                num_slots, use_cvm=use_cvm, sorted_segments=True)
+            new_params = model.update_summary(params, pooled,
+                                              batch.get("dense"))
+            # the summary mask applies raw sums: params += grad, so the
+            # pushed "grad" is the state delta (async_dense.py:119-122)
+            dparams = dict(dparams, dn_summary=jax.tree.map(
+                lambda old, new: new - old,
+                params["dn_summary"], new_params["dn_summary"]))
         flat_g = jax.flatten_util.ravel_pytree(dparams)[0]
         slab = _sparse_push(slab, demb, batch, sub)
         return slab, flat_g, loss, preds, prng
@@ -370,8 +415,9 @@ class BoxTrainer:
                     + self.cfg.dense_optimizer)
             from paddlebox_tpu.train.async_dense import AsyncDenseTable
             flat, self._unravel = jax.flatten_util.ravel_pytree(self.params)
-            self.async_table = AsyncDenseTable(np.asarray(flat),
-                                               lr=self.cfg.dense_lr)
+            self.async_table = AsyncDenseTable(
+                np.asarray(flat), lr=self.cfg.dense_lr,
+                summary_mask=_flat_summary_mask(self.params))
         self.timers = {n: Timer() for n in ("step", "pass")}
         self._step_count = 0
         self._shuffle_rng = np.random.RandomState(seed + 1)
