@@ -111,6 +111,9 @@ define_flag("dump_file_max_bytes", 2 << 30,
             "rotation size for debug dump files (2GB like dump writers)")
 define_flag("feed_pass_thread_num", 8,
             "threads registering keys during feed pass (ref default 30)")
+define_flag("stack_threads", 4,
+            "host batch-staging threads per scan chunk (lookup + dedup; "
+            "the feed-thread pool role, box_wrapper.h:862); <=1 = serial")
 define_flag("profile_per_op", False,
             "accumulate per-op timing in the train loop (TrainFilesWithProfiler)")
 define_flag("use_pallas_push", False,

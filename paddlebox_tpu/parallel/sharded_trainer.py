@@ -186,8 +186,10 @@ class ShardedBoxTrainer:
             return emb, req
 
         def forward_logits(params, emb, batch):
+            # packer batches carry nondecreasing segments by contract
             pooled = fused_seqpool_cvm(
-                emb, batch["segments"], batch["valid"], B, S, use_cvm)
+                emb, batch["segments"], batch["valid"], B, S, use_cvm,
+                sorted_segments=True)
             dense_in = batch.get("dense")
             if mixed:
                 # bf16 matmul path; f32 master params — the same shared
@@ -265,10 +267,10 @@ class ShardedBoxTrainer:
             # branch; pmean sync keeps the ratios exact (see CtrDnn docs).
             dn_new = None
             if has_summary:
-                pooled_f32 = fused_seqpool_cvm(
-                    emb, batch["segments"], batch["valid"], B, S, use_cvm)
-                dn_new = model.update_summary(
-                    params, pooled_f32, batch.get("dense"))["dn_summary"]
+                from paddlebox_tpu.train.trainer import dn_update_params
+                dn_new = dn_update_params(
+                    model, params, emb, batch["segments"], batch["valid"],
+                    B, S, use_cvm, batch.get("dense"))["dn_summary"]
 
             # ---- dense sync by mode
             loss = jax.lax.pmean(loss, axis)

@@ -159,6 +159,18 @@ def _multi_task_loss(logits, labels_dict, ins_valid, loss_mode: str = "sum"):
     return total, preds
 
 
+def dn_update_params(model, params, emb, segments, valid, batch_size: int,
+                     num_slots: int, use_cvm: bool, dense) -> Dict:
+    """The ONE data_norm summary update used by every trainer: recompute the
+    pooled features exactly as the forward does (XLA CSEs the duplicate) and
+    apply the model's running-sums rule. Keeping this in one place means the
+    stats can never normalize against a different pooled assembly than the
+    forward used."""
+    pooled = fused_seqpool_cvm(emb, segments, valid, batch_size, num_slots,
+                               use_cvm=use_cvm, sorted_segments=True)
+    return model.update_summary(params, pooled, dense)
+
+
 def _flat_summary_mask(params) -> Optional[np.ndarray]:
     """Flat bool mask marking data_norm summary leaves in the raveled param
     vector (AsyncDenseTable applies raw running-sum deltas there instead of
@@ -323,11 +335,9 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         updates, opt_state = dense_opt.update(dparams, opt_state, params)
         params = optax.apply_updates(params, updates)
         if has_summary:
-            # recomputed pooled CSEs with the forward's (same inputs)
-            pooled = fused_seqpool_cvm(
-                emb, batch["segments"], _key_valid(batch), batch_size,
-                num_slots, use_cvm=use_cvm, sorted_segments=True)
-            params = model.update_summary(params, pooled, batch.get("dense"))
+            params = dn_update_params(
+                model, params, emb, batch["segments"], _key_valid(batch),
+                batch_size, num_slots, use_cvm, batch.get("dense"))
         slab = _sparse_push(slab, demb, batch, sub)
         return slab, params, opt_state, loss, preds, prng
 
@@ -351,14 +361,11 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
             # the host adam thread sees zero grads for the summary leaves;
             # their running-sums update happens here on device and rides
             # back to the host table through the flat grad vector as a
-            # DELTA the summary mask applies raw (async_dense.py:119-121)
-            pooled = fused_seqpool_cvm(
-                emb, batch["segments"], _key_valid(batch), batch_size,
-                num_slots, use_cvm=use_cvm, sorted_segments=True)
-            new_params = model.update_summary(params, pooled,
-                                              batch.get("dense"))
-            # the summary mask applies raw sums: params += grad, so the
-            # pushed "grad" is the state delta (async_dense.py:119-122)
+            # DELTA the summary mask applies raw: params += grad
+            # (async_dense.py:119-122)
+            new_params = dn_update_params(
+                model, params, emb, batch["segments"], _key_valid(batch),
+                batch_size, num_slots, use_cvm, batch.get("dense"))
             dparams = dict(dparams, dn_summary=jax.tree.map(
                 lambda old, new: new - old,
                 params["dn_summary"], new_params["dn_summary"]))
@@ -419,6 +426,7 @@ class BoxTrainer:
                 np.asarray(flat), lr=self.cfg.dense_lr,
                 summary_mask=_flat_summary_mask(self.params))
         self.timers = {n: Timer() for n in ("step", "pass")}
+        self._stage_pool = None  # lazy host-staging thread pool
         self._step_count = 0
         self._shuffle_rng = np.random.RandomState(seed + 1)
         self.multi_task = len(getattr(model, "task_names", ("ctr",))) > 1
@@ -444,13 +452,17 @@ class BoxTrainer:
                                         mask=b.ins_valid)
 
     def close(self) -> None:
-        """Stop the async dense optimizer thread and dump writers."""
+        """Stop the async dense optimizer thread, staging pool and dump
+        writers."""
         if self.async_table is not None:
             self.async_table.stop()
             self.async_table = None
         if self.dump_writer is not None:
             self.dump_writer.close()
             self.dump_writer = None
+        if self._stage_pool and self._stage_pool[1] is not None:
+            self._stage_pool[1].shutdown(wait=False)
+        self._stage_pool = None
 
     def __del__(self):
         try:
@@ -459,12 +471,40 @@ class BoxTrainer:
             pass
 
     # ---------------------------------------------------------- batch utils
+    def _host_pool(self):
+        """Thread pool for per-batch host staging (lookup + dedup): the
+        native rt_lookup/rt_dedup calls and numpy ops release the GIL, so
+        batches of a chunk stage in parallel — the 30-feed-thread role of
+        the reference (box_wrapper.h:862). Sized by the stack_threads flag,
+        re-read on every chunk so a live set_flag takes effect; <=1 runs
+        serial."""
+        from paddlebox_tpu.config import flags
+        n = int(flags.get_flag("stack_threads"))
+        cur_n, pool = self._stage_pool or (0, None)
+        if n != cur_n:
+            if pool is not None:
+                pool.shutdown(wait=False)
+            if n > 1:
+                from concurrent.futures import ThreadPoolExecutor
+                pool = ThreadPoolExecutor(n,
+                                          thread_name_prefix="pbtpu-stage")
+            else:
+                pool = None
+            self._stage_pool = (n, pool)
+        return pool
+
+    def _stage_one(self, b: PackedBatch) -> Dict[str, np.ndarray]:
+        return self.host_batch(b, self.table.lookup_ids(b.keys, b.valid))
+
     def _stack_batches(self, group: List[PackedBatch]) -> Dict[str, jnp.ndarray]:
         """Stack a chunk of packed batches on a leading scan axis — stacked
         on HOST, one transfer per key (stacking device arrays would double
         the H2D traffic and peak memory)."""
-        hosts = [self.host_batch(b, self.table.lookup_ids(b.keys, b.valid))
-                 for b in group]
+        pool = self._host_pool()
+        if pool is not None and len(group) > 1:
+            hosts = list(pool.map(self._stage_one, group))
+        else:
+            hosts = [self._stage_one(b) for b in group]
         return {k: jnp.asarray(np.stack([h[k] for h in hosts]))
                 for k in hosts[0]}
 

@@ -67,18 +67,21 @@ def test_box_trainer_data_norm_learns_and_accumulates(tmp_path):
                    hidden=(32, 16), use_data_norm=True)
     tr = BoxTrainer(model, _table(), feed,
                     TrainerConfig(dense_lr=1e-2, scan_chunk=2))
-    ds = BoxDataset(feed)
-    ds.set_filelist(files)
-    bs0 = float(np.asarray(tr.params["dn_summary"]["batch_size"])[0])
-    losses = [tr.train_pass(ds)["loss"] for _ in range(3)]
-    bs1 = float(np.asarray(tr.params["dn_summary"]["batch_size"])[0])
-    # summary accumulated every step (init 1e4, +batch rows per step)
-    assert bs1 > bs0, (bs0, bs1)
-    assert losses[-1] < losses[0], losses
-    # the state stayed out of the optimizer: batch_sum finite and the
-    # normalized model still separates classes in eval
-    preds, labels = tr.predict_batches(ds)
-    assert np.isfinite(preds).all()
+    try:
+        ds = BoxDataset(feed)
+        ds.set_filelist(files)
+        bs0 = float(np.asarray(tr.params["dn_summary"]["batch_size"])[0])
+        losses = [tr.train_pass(ds)["loss"] for _ in range(3)]
+        bs1 = float(np.asarray(tr.params["dn_summary"]["batch_size"])[0])
+        # summary accumulated every step (init 1e4, +batch rows per step)
+        assert bs1 > bs0, (bs0, bs1)
+        assert losses[-1] < losses[0], losses
+        # the state stayed out of the optimizer: batch_sum finite and the
+        # normalized model still separates classes in eval
+        preds, labels = tr.predict_batches(ds)
+        assert np.isfinite(preds).all()
+    finally:
+        tr.close()
 
 
 def test_async_dense_data_norm_accumulates(tmp_path):

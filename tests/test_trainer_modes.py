@@ -149,3 +149,45 @@ def test_k_step_sync_replicas_converge(data):
     # merged_params drops the replica dim
     merged = tr.merged_params()
     assert jax.tree.leaves(merged)[0].shape == arr.shape[1:]
+
+
+def test_threaded_staging_matches_serial(data):
+    """The stack_threads pool must stage chunks bit-identically to the
+    serial path (order-preserving map; lookup/dedup are read-only over the
+    shared pass index)."""
+    from paddlebox_tpu.config import flags
+    from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
+                                              TableConfig)
+    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.models.base import ModelSpec
+    from paddlebox_tpu.train.trainer import BoxTrainer
+    from tools.bench_util import make_ctr_batches
+
+    from paddlebox_tpu.data.generator import default_feed_config
+    feed = default_feed_config(num_slots=8, batch_size=64, max_len=3)
+    table = TableConfig(embedx_dim=4, pass_capacity=1 << 14,
+                        optimizer=SparseOptimizerConfig(
+                            mf_create_thresholds=0.0))
+    model = DeepFM(ModelSpec(num_slots=8, slot_dim=7), hidden=(16,))
+    tr = BoxTrainer(model, table, feed, TrainerConfig())
+    batches = make_ctr_batches(feed, 6, 8, 3, seed=1)
+    tr.table.begin_feed_pass()
+    for b in batches:
+        tr.table.add_keys(b.keys[b.valid])
+    tr.table.end_feed_pass()
+    tr.table.begin_pass()
+    try:
+        threaded = tr._stack_batches(batches)
+        old = flags.get_flag("stack_threads")
+        flags.set_flag("stack_threads", 1)
+        try:
+            # live flag change takes effect on the SAME trainer
+            serial = tr._stack_batches(batches)
+        finally:
+            flags.set_flag("stack_threads", old)
+        for k in threaded:
+            np.testing.assert_array_equal(np.asarray(threaded[k]),
+                                          np.asarray(serial[k]), err_msg=k)
+        tr.table.end_pass()
+    finally:
+        tr.close()
