@@ -56,6 +56,12 @@ class TrainStepFns:
     # "6.8x on v5e" figure was measured with the axon backend's broken
     # block_until_ready and is retracted, see BASELINE.md)
     scan_steps: Optional[Callable] = None
+    # the fused step's building blocks, exposed so the staged profiling
+    # mode (train_pass_profiled) runs EXACTLY the fused semantics — cvm
+    # flag, mixed precision, rank_offset, data_norm, dedup guard included
+    forward: Optional[Callable] = None          # (params, emb, batch) -> (loss, preds)
+    sparse_push: Optional[Callable] = None      # (slab, demb, batch, sub) -> slab
+    dn_update: Optional[Callable] = None        # (params, emb, batch) -> params
 
 
 def make_scan(step_fn: Callable) -> Callable:
@@ -379,10 +385,21 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         _, preds = forward(params, emb, batch, None)
         return preds
 
+    def _dn_update(params, emb, batch):
+        if not has_summary:
+            return params
+        return dn_update_params(model, params, emb, batch["segments"],
+                                _key_valid(batch), batch_size, num_slots,
+                                use_cvm, batch.get("dense"))
+
     return TrainStepFns(step=step_async if async_dense else step,
                         eval_step=eval_step,
                         batch_size=batch_size, num_slots=num_slots,
-                        scan_steps=None if async_dense else scan_steps)
+                        scan_steps=None if async_dense else scan_steps,
+                        forward=lambda params, emb, batch: forward(
+                            params, emb, batch, None),
+                        sparse_push=_sparse_push,
+                        dn_update=_dn_update)
 
 
 class BoxTrainer:
@@ -544,6 +561,11 @@ class BoxTrainer:
     def train_pass(self, dataset: BoxDataset,
                    preloaded: bool = False) -> Dict[str, float]:
         """One full pass: feed → build → train → metrics → end."""
+        from paddlebox_tpu.config import flags
+        if (flags.get_flag("profile_per_op") and not preloaded
+                and not self.multi_task and self.async_table is None):
+            # debug tier: staged dispatches with per-stage attribution
+            return self.train_pass_profiled(dataset)
         t_pass = self.timers["pass"]
         t_pass.start()
         if not preloaded:
@@ -641,6 +663,80 @@ class BoxTrainer:
                 else list(preds)[0])
         tensors["pred"] = tensors["pred_" + main]
         self.metrics.add_batch(tensors)
+
+    # ------------------------------------------------------ profiled mode
+    def train_pass_profiled(self, dataset: BoxDataset) -> Dict[str, float]:
+        """TrainFilesWithProfiler analog (boxps_worker.cc:1336, enabled by
+        the profile_per_op flag): one pass with the fused step SPLIT into
+        separately dispatched, D2H-synced stages — slower than the fused
+        path by design, in exchange for per-stage attribution. Runs the
+        SAME forward/push/data_norm closures as the fused step (TrainStepFns
+        exposes them), the same shuffle cadence, nan guard, dump and step
+        accounting; prints a stage report at pass end."""
+        fns = self.fns
+
+        @jax.jit
+        def stage_fwd_bwd(params, emb, batch):
+            (loss, preds), (dp, demb) = jax.value_and_grad(
+                fns.forward, argnums=(0, 1), has_aux=True)(params, emb,
+                                                           batch)
+            return loss, preds, dp, demb
+
+        @jax.jit
+        def stage_dense_opt(params, opt_state, dp, emb, batch):
+            updates, opt_state = self.dense_opt.update(dp, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return fns.dn_update(params, emb, batch), opt_state
+
+        stage_push = jax.jit(fns.sparse_push, donate_argnums=(0,))
+
+        timers = {n: Timer() for n in ("host_stage", "pull", "fwd_bwd",
+                                       "dense_opt", "push")}
+
+        def timed(t, fn, *a):
+            """Sync each stage on a tiny D2H slice of every output leaf —
+            wall-clock-true on axon (block_until_ready returns early there)
+            without hauling slab-sized buffers to host."""
+            t.start()
+            out = fn(*a)
+            for leaf in jax.tree.leaves(out):
+                np.asarray(jnp.ravel(leaf)[:1])
+            t.pause()
+            return out
+
+        self.table.begin_feed_pass()
+        dataset.load_into_memory(add_keys_fn=self.table.add_keys)
+        self.table.end_feed_pass()
+        self.table.begin_pass()
+        dataset.local_shuffle(self._shuffle_rng.randint(1 << 31))
+        losses = []
+        for b in dataset.split_batches(num_workers=1)[0]:
+            timers["host_stage"].start()
+            batch = self.device_batch(b, self.table.lookup_ids(b.keys,
+                                                               b.valid))
+            timers["host_stage"].pause()
+            emb = timed(timers["pull"], self.table.pull, batch["ids"])
+            loss, preds, dp, demb = timed(
+                timers["fwd_bwd"], stage_fwd_bwd, self.params, emb, batch)
+            self.params, self.opt_state = timed(
+                timers["dense_opt"], stage_dense_opt, self.params,
+                self.opt_state, dp, emb, batch)
+            slab = timed(timers["push"], stage_push, self.table.slab, demb,
+                         batch, self.table.next_prng())
+            self.table.set_slab(slab)
+            self._step_count += 1
+            losses.append(float(loss))
+            if self.cfg.check_nan_inf and not np.isfinite(losses[-1]):
+                raise FloatingPointError(
+                    f"nan/inf loss at step {self._step_count}")
+            self._add_metrics(preds, b)
+            if self.dump_writer is not None:
+                self._dump_batch(preds, b)
+        self.table.end_pass()
+        from paddlebox_tpu.utils.profiler import timer_report
+        print(timer_report(timers, prefix="stage."))
+        return {"loss": float(np.mean(losses)) if losses else 0.0,
+                "batches": len(losses), "instances": len(dataset)}
 
     # ------------------------------------------------------------- eval
     def predict_batches(self, dataset: BoxDataset) -> Tuple[np.ndarray, np.ndarray]:
