@@ -665,6 +665,32 @@ class BoxTrainer:
         self.metrics.add_batch(tensors)
 
     # ------------------------------------------------------ profiled mode
+    def _profiled_stages(self):
+        """The staged jits, built ONCE per trainer (a fresh jit per pass
+        would land a full compile inside the first batch's stage timer and
+        skew the attribution report)."""
+        if getattr(self, "_staged_jits", None) is None:
+            fns = self.fns
+
+            @jax.jit
+            def stage_fwd_bwd(params, emb, batch):
+                (loss, preds), (dp, demb) = jax.value_and_grad(
+                    fns.forward, argnums=(0, 1), has_aux=True)(params, emb,
+                                                               batch)
+                return loss, preds, dp, demb
+
+            @jax.jit
+            def stage_dense_opt(params, opt_state, dp, emb, batch):
+                updates, opt_state = self.dense_opt.update(dp, opt_state,
+                                                           params)
+                params = optax.apply_updates(params, updates)
+                return fns.dn_update(params, emb, batch), opt_state
+
+            self._staged_jits = (stage_fwd_bwd, stage_dense_opt,
+                                 jax.jit(fns.sparse_push,
+                                         donate_argnums=(0,)))
+        return self._staged_jits
+
     def train_pass_profiled(self, dataset: BoxDataset) -> Dict[str, float]:
         """TrainFilesWithProfiler analog (boxps_worker.cc:1336, enabled by
         the profile_per_op flag): one pass with the fused step SPLIT into
@@ -673,34 +699,19 @@ class BoxTrainer:
         SAME forward/push/data_norm closures as the fused step (TrainStepFns
         exposes them), the same shuffle cadence, nan guard, dump and step
         accounting; prints a stage report at pass end."""
-        fns = self.fns
-
-        @jax.jit
-        def stage_fwd_bwd(params, emb, batch):
-            (loss, preds), (dp, demb) = jax.value_and_grad(
-                fns.forward, argnums=(0, 1), has_aux=True)(params, emb,
-                                                           batch)
-            return loss, preds, dp, demb
-
-        @jax.jit
-        def stage_dense_opt(params, opt_state, dp, emb, batch):
-            updates, opt_state = self.dense_opt.update(dp, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return fns.dn_update(params, emb, batch), opt_state
-
-        stage_push = jax.jit(fns.sparse_push, donate_argnums=(0,))
+        stage_fwd_bwd, stage_dense_opt, stage_push = self._profiled_stages()
 
         timers = {n: Timer() for n in ("host_stage", "pull", "fwd_bwd",
                                        "dense_opt", "push")}
 
         def timed(t, fn, *a):
-            """Sync each stage on a tiny D2H slice of every output leaf —
+            """Sync each stage on a tiny D2H scalar of every output leaf —
             wall-clock-true on axon (block_until_ready returns early there)
-            without hauling slab-sized buffers to host."""
+            without hauling (or even device-copying) slab-sized buffers."""
             t.start()
             out = fn(*a)
             for leaf in jax.tree.leaves(out):
-                np.asarray(jnp.ravel(leaf)[:1])
+                np.asarray(leaf[(0,) * leaf.ndim] if leaf.ndim else leaf)
             t.pause()
             return out
 

@@ -46,6 +46,18 @@ def main() -> None:
     assert jax.process_count() == world, (jax.process_count(), world)
     assert len(jax.devices()) == 8, jax.devices()
 
+    # GPUPS variant: every process's shard stores live on ONE central CPU
+    # PS over TCP (the distributed-full-store → per-pass-HBM-slab
+    # composition, ps_gpu_wrapper.cc:337-760); the parent created the table
+    ps_client = None
+    store_factory = None
+    if cfg.get("ps_endpoint"):
+        from paddlebox_tpu.embedding.ps_store import ps_store_factory
+        from paddlebox_tpu.ps import TcpPSClient
+        host, port = cfg["ps_endpoint"].rsplit(":", 1)
+        ps_client = TcpPSClient(host, int(port))
+        store_factory = ps_store_factory(ps_client, cfg["ps_table_id"])
+
     files = cfg["files"][rank * 4:(rank + 1) * 4]
     D = cfg["embedx_dim"]
     feed = default_feed_config(num_slots=cfg["num_slots"],
@@ -61,7 +73,8 @@ def main() -> None:
         CtrDnn(ModelSpec(num_slots=cfg["num_slots"], slot_dim=3 + D),
                hidden=(32, 16)),
         table_cfg, feed, TrainerConfig(dense_lr=0.01),
-        mesh=device_mesh_1d(8), seed=0, fleet=fleet)
+        mesh=device_mesh_1d(8), seed=0, fleet=fleet,
+        store_factory=store_factory)
     trainer.metrics.init_metric("auc", "label", "pred",
                                 table_size=1 << 14, mask_var="mask")
 
@@ -76,15 +89,17 @@ def main() -> None:
     msg = trainer.metrics.get_metric_msg(
         "auc", allreduce=fleet.metric_allreduce())
 
-    # sample rows from OWNED stores for the parity check
+    # sample rows from OWNED stores for the parity check (PS-backed shards
+    # keep their rows server-side; the parent samples via its own client)
     rows = {}
-    for s in trainer.local_positions:
-        st = trainer.table.stores[s]
-        keys, vals = st.state_items()
-        order = np.argsort(keys)
-        take = order[:3]
-        for k, v in zip(keys[take], vals[take]):
-            rows[str(int(k))] = [round(float(x), 6) for x in v]
+    if ps_client is None:
+        for s in trainer.local_positions:
+            st = trainer.table.stores[s]
+            keys, vals = st.state_items()
+            order = np.argsort(keys)
+            take = order[:3]
+            for k, v in zip(keys[take], vals[take]):
+                rows[str(int(k))] = [round(float(x), 6) for x in v]
 
     # ---- cross-host instance shuffle phase (ShuffleData/PaddleShuffler):
     # re-enable shuffle, route the load through the TcpShuffler, train one
@@ -104,13 +119,18 @@ def main() -> None:
         shuffler.close()
     pbx_flags.set_flag("dataset_disable_shuffle", True)
 
+    ps_rows = (int(ps_client.sparse_size(cfg["ps_table_id"]))
+               if ps_client is not None else None)
     print("RESULT " + json.dumps({
         "rank": rank, "losses": losses, "auc": msg["auc"],
         "size": msg["size"], "rows": rows,
         "local_after_shuffle": local_after_shuffle,
         "total_after_shuffle": total_after_shuffle,
         "shuffled_loss": shuffled_loss,
+        "ps_rows": ps_rows,
     }), flush=True)
+    if ps_client is not None:
+        ps_client.close()
     fleet.stop()
 
 

@@ -75,15 +75,15 @@ def run_single_process_oracle(files, feed):
     return losses, msg, rows
 
 
-def test_two_process_cluster_matches_single_process(data, tmp_path):
-    files, feed = data
-    ref_losses, ref_msg, ref_rows = run_single_process_oracle(files, feed)
-
+def run_two_process_cluster(files, extra_cfg=None):
+    """Spawn the 2-process localhost cluster (subprocess pattern,
+    test_dist_base.py:896-1012) and collect each rank's RESULT line."""
     from paddlebox_tpu.fleet.store import KVStoreServer
     server = KVStoreServer(host="127.0.0.1")
-    cfg = json.dumps({"files": files, "embedx_dim": D,
-                      "num_slots": NUM_SLOTS, "batch_size": 32,
-                      "max_len": 3, "passes": PASSES})
+    cfg = {"files": files, "embedx_dim": D, "num_slots": NUM_SLOTS,
+           "batch_size": 32, "max_len": 3, "passes": PASSES}
+    cfg.update(extra_cfg or {})
+    cfg = json.dumps(cfg)
     worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
     run_id = uuid.uuid4().hex[:8]
     procs = []
@@ -117,6 +117,13 @@ def test_two_process_cluster_matches_single_process(data, tmp_path):
             if p.poll() is None:
                 p.kill()
         server.stop()
+    return results
+
+
+def test_two_process_cluster_matches_single_process(data, tmp_path):
+    files, feed = data
+    ref_losses, ref_msg, ref_rows = run_single_process_oracle(files, feed)
+    results = run_two_process_cluster(files)
 
     assert set(results) == {0, 1}
     # losses identical across ranks (replicated pmean) and vs the oracle
@@ -144,3 +151,42 @@ def test_two_process_cluster_matches_single_process(data, tmp_path):
         assert r["total_after_shuffle"] == 8 * 128, r
         assert 0 < r["local_after_shuffle"] < 8 * 128, r
         assert np.isfinite(r["shuffled_loss"]), r
+
+
+def test_two_process_gpups_over_central_ps(data):
+    """The 1T-param composition: a 2-process pod mesh whose shard stores
+    ALL live on one central CPU PS over TCP (distributed full store →
+    per-pass HBM slabs, built/dumped at pass boundaries —
+    ps_gpu_wrapper.cc:337-760,983). Losses must match the local-store
+    oracle (server-side row init is key-deterministic) and the features
+    must exist server-side afterwards."""
+    files, feed = data
+    ref_losses, ref_msg, _ref_rows = run_single_process_oracle(files, feed)
+
+    from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
+                                              TableConfig)
+    from paddlebox_tpu.ps import PSServer, TcpPSClient
+    server = PSServer()
+    admin = TcpPSClient("127.0.0.1", server.port)
+    table_cfg = TableConfig(
+        embedx_dim=D, pass_capacity=8 * 1024,
+        optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                        mf_initial_range=1e-3,
+                                        feature_learning_rate=0.1,
+                                        mf_learning_rate=0.1))
+    try:
+        admin.create_sparse_table(7, table_cfg, shard_num=8, seed=0)
+        results = run_two_process_cluster(
+            files, {"ps_endpoint": "127.0.0.1:%d" % server.port,
+                    "ps_table_id": 7})
+        assert set(results) == {0, 1}
+        np.testing.assert_allclose(results[0]["losses"],
+                                   results[1]["losses"], rtol=1e-6)
+        np.testing.assert_allclose(results[0]["losses"], ref_losses,
+                                   rtol=1e-4,
+                                   err_msg="GPUPS cluster diverges from "
+                                           "local-store oracle")
+        assert results[0]["ps_rows"] and results[0]["ps_rows"] > 100
+    finally:
+        admin.stop_server()
+        admin.close()
