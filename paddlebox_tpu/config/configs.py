@@ -13,6 +13,8 @@ from __future__ import annotations
 import dataclasses
 from typing import List, Optional, Sequence, Tuple
 
+from paddlebox_tpu.config import flags
+
 
 @dataclasses.dataclass(frozen=True)
 class SparseOptimizerConfig:
@@ -119,6 +121,9 @@ class DataFeedConfig:
     def key_capacity(self, batch_size: Optional[int] = None) -> int:
         if self.batch_key_capacity:
             return self.batch_key_capacity
+        override = int(flags.get_flag("padbox_max_batch_keys"))
+        if override:
+            return override
         bs = batch_size or self.batch_size
         per_ins = sum(min(s.max_len, 16) for s in self.used_sparse_slots())
         return max(128, bs * max(per_ins, 1))
@@ -161,7 +166,9 @@ class TrainerConfig:
     dump_thread_num: int = 1
     dense_lr: float = 1e-3
     dense_optimizer: str = "adam"
-    check_nan_inf: bool = False
+    # default from the check_nan_inf env flag (FLAGS_check_nan_inf)
+    check_nan_inf: bool = dataclasses.field(
+        default_factory=lambda: bool(flags.get_flag("check_nan_inf")))
     profile: bool = False
     scan_chunk: int = 8                  # batches fused per device dispatch
                                          # (lax.scan megastep); 1 = off

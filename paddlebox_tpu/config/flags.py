@@ -82,35 +82,36 @@ def all_flags() -> Dict[str, Any]:
 # worker flags boxps_worker.cc:41-54, re-expressed for the TPU runtime).
 # ---------------------------------------------------------------------------
 
-define_flag("enable_pullpush_dedup_keys", True,
-            "dedup feasign keys inside pull/push (DedupKeysAndFillIdx analog)")
-define_flag("record_pool_max_size", 2_000_000,
-            "max retained SlotRecord objects in the slab pool")
-define_flag("slotrecord_extend_dim", 0,
-            "extra float dims appended to each slot record")
-define_flag("dataset_shuffle_thread_num", 10,
-            "threads for cross-host instance shuffle")
-define_flag("dataset_merge_thread_num", 10,
-            "threads merging shuffled instances + registering pass keys")
+# Reference flags that are STRUCTURAL NO-OPS here and therefore do not
+# exist (deliberate divergences, see ARCHITECTURE.md):
+#   enable_pullpush_dedup_keys — dedup is load-bearing in the fused step's
+#       merge-then-optimize contract, never optional
+#   padbox_record_pool_max_size / padbox_slotrecord_extend_dim — the
+#       zero-object columnar path replaces the SlotObjPool; expand dims
+#       live in TableConfig.expand_embed_dim
+#   padbox_dataset_disable_polling — readers consume a fixed file list,
+#       no polling loop exists
+#   enable_sparse_push_barrier — the push is part of the fused step; there
+#       is no async push stream to barrier on
+#   feed-pass/shuffle/merge thread counts — read parallelism is
+#       BoxDataset(read_threads=...); key registration and merge ride the
+#       channel consumer; per-chunk staging parallelism is stack_threads
+
 define_flag("dataset_disable_shuffle", False,
             "disable BOTH the cross-host instance shuffle stage and local "
             "in-memory shuffling (deterministic load-order passes)")
-define_flag("dataset_disable_polling", False,
-            "disable file polling in dataset readers")
 define_flag("auc_runner_mode", False,
             "AUC-runner replay mode (slots-shuffle evaluation)")
 define_flag("check_nan_inf", False,
-            "after each batch, check outputs for NaN/Inf and dump on trip")
+            "default for TrainerConfig.check_nan_inf: after each batch, "
+            "check the loss for NaN/Inf and raise (FLAGS_check_nan_inf)")
 define_flag("padbox_max_batch_keys", 0,
-            "static per-batch key capacity; 0 = derive from feed config")
+            "static per-batch key capacity override; 0 = derive from the "
+            "feed config (DataFeedConfig.key_capacity)")
 define_flag("sparse_table_load_factor", 0.75,
-            "host hash table resize load factor")
-define_flag("enable_sparse_push_barrier", False,
-            "block until async sparse push of previous step completes")
+            "native host hash table resize load factor (hashtable.h:211)")
 define_flag("dump_file_max_bytes", 2 << 30,
             "rotation size for debug dump files (2GB like dump writers)")
-define_flag("feed_pass_thread_num", 8,
-            "threads registering keys during feed pass (ref default 30)")
 define_flag("stack_threads", 4,
             "host batch-staging threads per scan chunk (lookup + dedup; "
             "the feed-thread pool role, box_wrapper.h:862); <=1 = serial")

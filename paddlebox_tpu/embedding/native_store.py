@@ -16,6 +16,7 @@ from typing import Tuple
 
 import numpy as np
 
+from paddlebox_tpu.config import flags
 from paddlebox_tpu.config.configs import TableConfig
 from paddlebox_tpu.embedding.accessor import ValueLayout, UNSEEN_DAYS
 from paddlebox_tpu.utils.stats import stat_add
@@ -41,7 +42,8 @@ class NativeHostEmbeddingStore:
         self.layout = layout
         self.table = table
         self._rng = np.random.RandomState(seed)
-        self._h = lib.hs_create(layout.width, 0.75)
+        self._h = lib.hs_create(
+            layout.width, float(flags.get_flag("sparse_table_load_factor")))
         # SSD spill tier (SSDSparseTable role): key → (file, row offset);
         # the file token is per-store so shards sharing one ssd_dir can't
         # clobber each other's blocks
@@ -283,7 +285,9 @@ class NativeHostEmbeddingStore:
                 blob["optimizer"] != self.layout.optimizer:
             raise ValueError("checkpoint layout mismatch")
         self._lib.hs_destroy(self._h)
-        self._h = self._lib.hs_create(self.layout.width, 0.75)
+        self._h = self._lib.hs_create(
+            self.layout.width,
+            float(flags.get_flag("sparse_table_load_factor")))
         self._spilled.clear()  # stale spill entries must not resurrect
         for fname in list(self._file_live):
             try:

@@ -96,12 +96,17 @@ class PSBackedStore:
             "PS-backed shards checkpoint server-side: PSClient.load()")
 
 
-def ps_store_factory(client, table_id: int):
+def ps_store_factory(client, table_id: int, process_primary: bool = True):
     """ShardedPassTable store_factory: every shard fronts the same PS table
     (the PS routes keys internally; shard s only ever asks for keys ≡ s
     mod P, so the two shardings never conflict). The first store created
-    is the table's primary for table-wide ops."""
-    state = {"made_primary": False}
+    becomes the table's primary for table-wide ops (len, shrink).
+
+    Multi-process clusters: the primary must be GLOBALLY unique or a
+    shrink_table() applies the multiplicative show/click decay once per
+    process — pass process_primary=(rank == 0) so only rank 0's first
+    owned shard claims it."""
+    state = {"made_primary": not process_primary}
 
     def factory(layout: ValueLayout, table: TableConfig, seed: int):
         primary = not state["made_primary"]

@@ -56,7 +56,8 @@ def main() -> None:
         from paddlebox_tpu.ps import TcpPSClient
         host, port = cfg["ps_endpoint"].rsplit(":", 1)
         ps_client = TcpPSClient(host, int(port))
-        store_factory = ps_store_factory(ps_client, cfg["ps_table_id"])
+        store_factory = ps_store_factory(ps_client, cfg["ps_table_id"],
+                                         process_primary=(rank == 0))
 
     files = cfg["files"][rank * 4:(rank + 1) * 4]
     D = cfg["embedx_dim"]

@@ -8,18 +8,39 @@ from paddlebox_tpu.utils import Channel, ChannelClosed, StatRegistry, Timer, Tim
 
 
 def test_flags_defaults_and_set():
-    assert flags.get_flag("enable_pullpush_dedup_keys") is True
-    assert flags.get_flag("record_pool_max_size") == 2_000_000
-    flags.set_flag("record_pool_max_size", 123)
-    assert flags.get_flag("record_pool_max_size") == 123
-    flags.set_flag("record_pool_max_size", 2_000_000)
+    assert flags.get_flag("dataset_disable_shuffle") is False
+    assert flags.get_flag("stack_threads") == 4
+    flags.set_flag("stack_threads", 2)
+    assert flags.get_flag("stack_threads") == 2
+    flags.set_flag("stack_threads", 4)
     with pytest.raises(KeyError):
         flags.get_flag("nonexistent_flag")
 
 
 def test_flag_redefine_rejected():
     with pytest.raises(ValueError):
-        flags.define_flag("enable_pullpush_dedup_keys", False)
+        flags.define_flag("dataset_disable_shuffle", True)
+
+
+def test_flag_wiring():
+    """Flags that claim behavior must actually drive it."""
+    from paddlebox_tpu.config.configs import DataFeedConfig, SlotConfig, \
+        TrainerConfig
+    feed = DataFeedConfig(slots=(SlotConfig("a", max_len=4),), batch_size=8)
+    base = feed.key_capacity()
+    flags.set_flag("padbox_max_batch_keys", 999)
+    try:
+        assert feed.key_capacity() == 999
+    finally:
+        flags.set_flag("padbox_max_batch_keys", 0)
+    assert feed.key_capacity() == base
+
+    flags.set_flag("check_nan_inf", True)
+    try:
+        assert TrainerConfig().check_nan_inf is True
+    finally:
+        flags.set_flag("check_nan_inf", False)
+    assert TrainerConfig().check_nan_inf is False
 
 
 def test_timer_accumulates():
