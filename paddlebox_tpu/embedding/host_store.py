@@ -25,6 +25,51 @@ from paddlebox_tpu.config.configs import TableConfig
 from paddlebox_tpu.embedding.accessor import ValueLayout, UNSEEN_DAYS
 from paddlebox_tpu.utils.stats import stat_add
 
+
+class SpillAgeBook:
+    """Aging bookkeeping for the SSD tier: resident rows age in place at
+    each day boundary, but spilled rows are immutable on disk — so every
+    spill records (epoch, unseen_at_spill) and the missed days are added
+    back lazily at fault-in. Shrink can also delete spilled rows by the
+    unseen-days rule WITHOUT faulting them in (the coldest rows — exactly
+    the deletion candidates — must not be immortal; score-threshold deletes
+    still apply after fault-in, documented approximation)."""
+
+    def __init__(self) -> None:
+        self.epoch = 0
+        self.meta: Dict[int, Tuple[int, float]] = {}
+
+    def tick(self) -> None:
+        self.epoch += 1
+
+    def note(self, key: int, unseen_at_spill: float) -> None:
+        self.meta[key] = (self.epoch, float(unseen_at_spill))
+
+    def drop(self, key: int) -> None:
+        self.meta.pop(key, None)
+
+    def missed_days(self, key: int, pop: bool) -> float:
+        e_u = self.meta.pop(key, None) if pop else self.meta.get(key)
+        return float(self.epoch - e_u[0]) if e_u else 0.0
+
+    def dead_keys(self, delete_after_days: float) -> List[int]:
+        return [k for k, (e, u) in self.meta.items()
+                if u + (self.epoch - e) > delete_after_days]
+
+    def sweep(self, spilled: Dict, dec_file_live, delete_after_days: float
+              ) -> int:
+        """Delete spilled rows past the unseen-days lifetime WITHOUT
+        faulting them in: pop the spill index entry, GC the block file's
+        live count. Returns rows deleted. (The ONE sweep both stores
+        share — keep fixes here.)"""
+        n = 0
+        for k in self.dead_keys(delete_after_days):
+            fname, _off = spilled.pop(k)
+            self.drop(k)
+            dec_file_live(fname, 1)
+            n += 1
+        return n
+
 _GROW = 1 << 16
 
 
@@ -50,6 +95,7 @@ class HostEmbeddingStore:
         self._spilled: Dict[int, Tuple[str, int]] = {}  # key -> (file, offset row)
         self._spill_seq = 0  # monotonic file id (len(_spilled) can shrink)
         self._spill_tag = f"{os.getpid():x}_{id(self):x}"
+        self._age_book = SpillAgeBook()
         self._file_live: Dict[str, int] = {}  # file → live rows (GC at 0)
 
     def __len__(self) -> int:
@@ -124,8 +170,11 @@ class HostEmbeddingStore:
                 r = self._index.get(k, -1)
                 if r < 0:
                     # a stale spill entry must not resurrect over the
-                    # assigned value
-                    self._spilled.pop(k, None)
+                    # assigned value (and its block row is dead: GC it)
+                    stale = self._spilled.pop(k, None)
+                    if stale is not None:
+                        self._age_book.drop(k)
+                        self._dec_file_live(stale[0], 1)
                     missing.append(i)
                 rows[i] = r
             if missing:
@@ -155,22 +204,28 @@ class HostEmbeddingStore:
         """ShrinkTable: decay show/click and delete dead features
         (ctr_accessor.cc:63-79 via layout.shrink_mask). Returns deletions."""
         with self._lock:
-            if not self._index:
-                return 0
-            keys = np.fromiter(self._index.keys(), dtype=np.uint64,
-                               count=len(self._index))
-            rows = np.fromiter(self._index.values(), dtype=np.int64,
-                               count=len(self._index))
-            view = self._values[rows]
-            mask = self.layout.shrink_mask(view, self.table)
-            self._values[rows] = view  # decay writeback
-            dead = np.nonzero(mask)[0]
-            for i in dead.tolist():
-                r = self._index.pop(int(keys[i]))
-                self._values[r] = 0.0
-                self._free.append(r)
-            stat_add("sparse_keys_shrunk", int(dead.size))
-            return int(dead.size)
+            n_dead = 0
+            if self._index:
+                keys = np.fromiter(self._index.keys(), dtype=np.uint64,
+                                   count=len(self._index))
+                rows = np.fromiter(self._index.values(), dtype=np.int64,
+                                   count=len(self._index))
+                view = self._values[rows]
+                mask = self.layout.shrink_mask(view, self.table)
+                self._values[rows] = view  # decay writeback
+                dead = np.nonzero(mask)[0]
+                for i in dead.tolist():
+                    r = self._index.pop(int(keys[i]))
+                    self._values[r] = 0.0
+                    self._free.append(r)
+                n_dead = int(dead.size)
+            # spilled rows sweep runs even when nothing is resident
+            n_dead += self._age_book.sweep(
+                self._spilled, self._dec_file_live,
+                self.table.delete_after_unseen_days)
+            if n_dead:
+                stat_add("sparse_keys_shrunk", n_dead)
+            return n_dead
 
     def age_unseen_days(self) -> None:
         with self._lock:
@@ -178,6 +233,15 @@ class HostEmbeddingStore:
                                count=len(self._index))
             if rows.size:
                 self._values[rows, UNSEEN_DAYS] += 1.0
+            # spilled rows age lazily via the epoch (added at fault-in)
+            self._age_book.tick()
+
+    def tick_spill_age(self) -> None:
+        """Advance ONLY the spilled rows' day clock — for day boundaries
+        where the resident rows were already aged by another path
+        (save_base's update_stat_after_save touches resident rows only)."""
+        with self._lock:
+            self._age_book.tick()
 
     # ----------------------------------------------------------- SSD tier
     def spill(self, max_resident: int) -> int:
@@ -206,17 +270,18 @@ class HostEmbeddingStore:
                 k = int(keys[i])
                 r = self._index.pop(k)
                 self._spilled[k] = (fname, off)
+                self._age_book.note(k, unseen[i])
                 self._values[r] = 0.0
                 self._free.append(r)
             self._file_live[fname] = int(order.size)
             stat_add("sparse_keys_spilled", excess)
             return excess
 
-    def _fault_in(self, key: int) -> int:
-        fname, off = self._spilled.pop(key)
-        row_data = np.array(np.load(fname, mmap_mode="r")[off])
-        live = self._file_live.get(fname, 0) - 1
-        if live <= 0:  # SSD GC: no live rows left in the block
+    def _dec_file_live(self, fname: str, n: int) -> None:
+        """Spill-file GC: drop n live rows from a block file; unlink when
+        none remain."""
+        live = self._file_live.get(fname, 0) - n
+        if live <= 0:
             self._file_live.pop(fname, None)
             try:
                 os.remove(fname)
@@ -224,6 +289,13 @@ class HostEmbeddingStore:
                 pass
         else:
             self._file_live[fname] = live
+
+    def _fault_in(self, key: int) -> int:
+        fname, off = self._spilled.pop(key)
+        row_data = np.array(np.load(fname, mmap_mode="r")[off])
+        # add the day boundaries this row slept through on disk
+        row_data[UNSEEN_DAYS] += self._age_book.missed_days(key, pop=True)
+        self._dec_file_live(fname, 1)
         self._grow(1)
         r = self._free.pop()
         self._values[r] = row_data
@@ -268,6 +340,12 @@ class HostEmbeddingStore:
                 block = np.load(fname, mmap_mode="r")
                 for i, off in pairs:
                     svals[i] = block[off]
+            # checkpoint the EFFECTIVE age: add the day boundaries each
+            # spilled row slept through (load() clears the age book, so
+            # un-added days would be lost forever)
+            for i, k in enumerate(skeys.tolist()):
+                svals[i, UNSEEN_DAYS] += self._age_book.missed_days(
+                    int(k), pop=False)
             keys = np.concatenate([keys, skeys])
             values = np.vstack([values, svals])
         with open(path, "wb") as f:
@@ -285,6 +363,7 @@ class HostEmbeddingStore:
         with self._lock:
             self._index.clear()
             self._spilled.clear()  # stale spill entries must not resurrect
+            self._age_book.meta.clear()
             for fname in list(self._file_live):
                 try:
                     os.remove(fname)

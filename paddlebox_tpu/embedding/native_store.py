@@ -52,6 +52,8 @@ class NativeHostEmbeddingStore:
         self._spill_seq = 0
         self._spill_tag = f"{os.getpid():x}_{id(self):x}"
         self._file_live: dict = {}  # file → live spilled rows (GC at 0)
+        from paddlebox_tpu.embedding.host_store import SpillAgeBook
+        self._age_book = SpillAgeBook()
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -95,9 +97,11 @@ class NativeHostEmbeddingStore:
         deletes any spill file with no live rows left (SSD GC)."""
         out = np.empty((keys.size, self.layout.width), np.float32)
         by_file: dict = {}
+        missed = np.empty(keys.size, np.float32)
         for i, k in enumerate(keys.tolist()):
             fname, off = (self._spilled.pop(k) if consume
                           else self._spilled[k])
+            missed[i] = self._age_book.missed_days(k, pop=consume)
             by_file.setdefault(fname, []).append((i, off))
         for fname, pairs in by_file.items():
             block = np.load(fname, mmap_mode="r")
@@ -106,6 +110,8 @@ class NativeHostEmbeddingStore:
             if consume:
                 del block  # release the mmap before unlink
                 self._dec_file_live(fname, len(pairs))
+        # add the day boundaries each row slept through on disk
+        out[:, UNSEEN_DAYS] += missed
         if consume:
             stat_add("sparse_keys_faulted_in", int(keys.size))
         return out
@@ -178,6 +184,7 @@ class NativeHostEmbeddingStore:
             for k in keys.tolist():
                 if k in self._spilled:
                     fname, _ = self._spilled.pop(k)
+                    self._age_book.drop(k)
                     self._dec_file_live(fname, 1)
         rows, _ = self._rows_of(keys, create=True)
         vals = np.ascontiguousarray(values, dtype=np.float32)
@@ -187,21 +194,33 @@ class NativeHostEmbeddingStore:
     # ------------------------------------------------------------ lifecycle
     def shrink(self) -> int:
         keys, values = self.state_items()
-        if not keys.size:
-            return 0
-        mask = self.layout.shrink_mask(values, self.table)
-        self.write_back(keys, values)  # decay writeback
-        dead = np.ascontiguousarray(keys[mask])
-        if dead.size:
-            self._lib.hs_erase(self._h, _p(dead, _U64P), dead.size)
-            stat_add("sparse_keys_shrunk", int(dead.size))
-        return int(dead.size)
+        n_dead = 0
+        if keys.size:
+            mask = self.layout.shrink_mask(values, self.table)
+            self.write_back(keys, values)  # decay writeback
+            dead = np.ascontiguousarray(keys[mask])
+            if dead.size:
+                self._lib.hs_erase(self._h, _p(dead, _U64P), dead.size)
+            n_dead = int(dead.size)
+        # spilled rows sweep runs even when nothing is resident
+        n_dead += self._age_book.sweep(
+            self._spilled, self._dec_file_live,
+            self.table.delete_after_unseen_days)
+        if n_dead:
+            stat_add("sparse_keys_shrunk", n_dead)
+        return n_dead
 
     def age_unseen_days(self) -> None:
-        keys, values = self.state_items()
-        if keys.size:
-            values[:, UNSEEN_DAYS] += 1.0
-            self.write_back(keys, values)
+        # in-place single-column increment in C++ (a state_items round trip
+        # would copy the whole table twice); spilled rows age lazily via
+        # the epoch, added back at fault-in
+        self._lib.hs_add_col(self._h, UNSEEN_DAYS, 1.0)
+        self._age_book.tick()
+
+    def tick_spill_age(self) -> None:
+        """Advance only the spilled rows' day clock (see
+        HostEmbeddingStore.tick_spill_age)."""
+        self._age_book.tick()
 
     # ----------------------------------------------------------- SSD tier
     def spill(self, max_resident: int) -> int:
@@ -231,6 +250,7 @@ class NativeHostEmbeddingStore:
         np.save(fname, block)
         for off, k in enumerate(keys.tolist()):
             self._spilled[int(k)] = (fname, off)
+            self._age_book.note(int(k), block[off, UNSEEN_DAYS])
         self._file_live[fname] = got
         self._lib.hs_erase(self._h, _p(keys, _U64P), got)
         stat_add("sparse_keys_spilled", got)
@@ -289,6 +309,7 @@ class NativeHostEmbeddingStore:
             self.layout.width,
             float(flags.get_flag("sparse_table_load_factor")))
         self._spilled.clear()  # stale spill entries must not resurrect
+        self._age_book.meta.clear()
         for fname in list(self._file_live):
             try:
                 os.remove(fname)

@@ -323,6 +323,23 @@ class PassTable:
         """ShrinkTable (box_wrapper.h:627): decay + delete on the host tier."""
         return self.store.shrink()
 
+    def end_day(self, age: bool = True) -> int:
+        """Day boundary (the python-driven day cadence around
+        SaveBase(…, day)): age every feature's unseen_days — shrink_table's
+        delete_after_unseen_days rule keys off it — then shrink. Returns
+        rows deleted.
+
+        age=False when CheckpointManager.save_base already ran this
+        boundary: its update_stat_after_save(param=3) ages the table, and
+        aging twice per day halves every feature's configured lifetime.
+        save_base touches only RESIDENT rows, so the spilled rows' lazy
+        day clock still advances here either way."""
+        if age:
+            self.store.age_unseen_days()
+        else:
+            self.store.tick_spill_age()
+        return self.shrink_table()
+
     def save(self, path: str) -> None:
         self.store.save(path)
 

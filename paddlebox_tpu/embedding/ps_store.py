@@ -83,6 +83,16 @@ class PSBackedStore:
         # multiplicative — repeating it over-decays and over-deletes)
         return self.client.shrink(self.table_id) if self.primary else 0
 
+    def age_unseen_days(self) -> None:
+        # one +1 per day boundary, not P — primary-gated like shrink
+        if self.primary:
+            self.client.age_unseen_days(self.table_id)
+
+    def tick_spill_age(self) -> None:
+        # spill tiering lives server-side (the PS table's own shards track
+        # their spill clocks through age_unseen_days) — nothing client-side
+        pass
+
     def state_items(self) -> Tuple[np.ndarray, np.ndarray]:
         raise NotImplementedError(
             "PS-backed shards checkpoint server-side: PSClient.save()")

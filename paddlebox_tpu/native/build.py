@@ -103,6 +103,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
                                P(c.c_float)]
     lib.hs_erase.restype = c.c_int64
     lib.hs_erase.argtypes = [c.c_void_p, P(c.c_uint64), c.c_int64]
+    lib.hs_add_col.restype = c.c_int64
+    lib.hs_add_col.argtypes = [c.c_void_p, c.c_int32, c.c_float]
     lib.hs_items.restype = c.c_int64
     lib.hs_items.argtypes = [c.c_void_p, P(c.c_uint64), P(c.c_int64)]
     lib.hs_arena.restype = P(c.c_float)

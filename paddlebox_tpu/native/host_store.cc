@@ -221,6 +221,21 @@ int64_t hs_items(Store* s, uint64_t* out_keys, int64_t* out_rows) {
   return w;
 }
 
+// Add `delta` to one column of EVERY live row in place (the day-boundary
+// unseen_days increment — a full-table gather/scatter via Python for a
+// single-column += would double peak host memory). Returns rows touched.
+int64_t hs_add_col(Store* s, int32_t col, float delta) {
+  if (col < 0 || col >= s->width) return -1;
+  int64_t touched = 0;
+  for (uint64_t i = 0; i < s->cap; ++i) {
+    if (s->slots[i] != kEmpty) {
+      s->arena[s->rows[i] * s->width + col] += delta;
+      ++touched;
+    }
+  }
+  return touched;
+}
+
 // Direct arena access for zero-copy numpy views (valid until next
 // create/grow): base pointer + row capacity.
 float* hs_arena(Store* s) { return s->arena; }

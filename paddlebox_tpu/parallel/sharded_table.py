@@ -323,6 +323,19 @@ class ShardedPassTable:
     def shrink_table(self) -> int:
         return sum(st.shrink() for st in self.stores if st is not None)
 
+    def end_day(self, age: bool = True) -> int:
+        """Day boundary over the owned shards: age unseen_days, then
+        shrink (see PassTable.end_day for the age=False/save_base rule).
+        PS-backed shards age server-side through their primary."""
+        for st in self.stores:
+            if st is None:
+                continue
+            if age:
+                st.age_unseen_days()
+            else:
+                st.tick_spill_age()
+        return self.shrink_table()
+
     def save(self, path_prefix: str) -> None:
         for s, st in enumerate(self.stores):
             if st is not None:

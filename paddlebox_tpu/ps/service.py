@@ -71,6 +71,9 @@ class PSCore:
     def shrink(self, table_id: int) -> int:
         return self.sparse[table_id].shrink()
 
+    def age_unseen_days(self, table_id: int) -> None:
+        self.sparse[table_id].age_unseen_days()
+
     def sparse_size(self, table_id: int) -> int:
         return len(self.sparse[table_id])
 
@@ -176,6 +179,9 @@ class TcpPSClient:
 
     def shrink(self, table_id):
         return self._call("shrink", table_id=table_id)
+
+    def age_unseen_days(self, table_id):
+        return self._call("age_unseen_days", table_id=table_id)
 
     def sparse_size(self, table_id):
         return self._call("sparse_size", table_id=table_id)

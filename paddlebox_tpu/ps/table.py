@@ -118,6 +118,13 @@ class SparseTable:
                 total += s.shrink()
         return total
 
+    def age_unseen_days(self) -> None:
+        """Server-side day boundary: advance every feature's unseen_days
+        (the delete_after_unseen_days clock)."""
+        for s, lock in zip(self.shards, self._locks):
+            with lock:
+                s.age_unseen_days()
+
     def save(self, dirpath: str) -> List[str]:
         """Per-shard files (MemorySparseTable::Save shard file layout)."""
         os.makedirs(dirpath, exist_ok=True)

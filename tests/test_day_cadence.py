@@ -1,0 +1,217 @@
+"""Day-boundary lifecycle: age unseen_days → shrink → SaveBase, composed
+(the python-driven day cadence around box_wrapper's ShrinkTable +
+SaveBase(batch, xbox, day); delete rule ctr_accessor's
+delete_after_unseen_days)."""
+
+import dataclasses
+
+import numpy as np
+
+from paddlebox_tpu.config.configs import (CheckpointConfig,
+                                          SparseOptimizerConfig, TableConfig,
+                                          TrainerConfig)
+from paddlebox_tpu.data import BoxDataset, write_synthetic_ctr_files
+from paddlebox_tpu.embedding import accessor as acc
+from paddlebox_tpu.models import CtrDnn
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.train import BoxTrainer, CheckpointManager
+
+D = 4
+
+
+def _table(delete_days=2.0):
+    return TableConfig(
+        embedx_dim=D, pass_capacity=1 << 13,
+        delete_after_unseen_days=delete_days,
+        # high thresholds so shrink deletes by unseen-days only
+        delete_threshold=0.0, show_click_decay_rate=1.0,
+        optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                        mf_initial_range=1e-3))
+
+
+def test_day_cadence_ages_shrinks_and_checkpoints(tmp_path):
+    files, feed = write_synthetic_ctr_files(
+        str(tmp_path / "data"), num_files=2, lines_per_file=200,
+        num_slots=4, vocab_per_slot=80, max_len=3, seed=9)
+    feed = dataclasses.replace(feed, batch_size=32)
+    tr = BoxTrainer(CtrDnn(ModelSpec(num_slots=4, slot_dim=3 + D),
+                           hidden=(16,)),
+                    _table(), feed, TrainerConfig(dense_lr=1e-2))
+    try:
+        ds = BoxDataset(feed)
+        ds.set_filelist(files)
+        tr.train_pass(ds)
+        day1_keys, day1_vals = tr.table.store.state_items()
+        assert day1_keys.size > 50
+        assert (day1_vals[:, acc.UNSEEN_DAYS] == 0).all()
+
+        # two day boundaries with NO further sightings of these keys
+        deleted_total = 0
+        for _ in range(2):
+            deleted_total += tr.table.end_day()
+        # after day 1: unseen_days=1 (kept); after day 2: aged to 2, then
+        # shrink deletes unseen_days > delete_after_unseen_days=2? No —
+        # rule is strict '>': 2 > 2 is False, so a third boundary kills
+        assert deleted_total == 0
+        tr.table.end_day()
+        keys_after, _ = tr.table.store.state_items()
+        assert keys_after.size == 0, keys_after.size
+
+        # keys seen every day survive the same cadence
+        ds2 = BoxDataset(feed)
+        ds2.set_filelist(files)
+        tr.train_pass(ds2)
+        tr.table.end_day()
+        ds3 = BoxDataset(feed)
+        ds3.set_filelist(files)
+        tr.train_pass(ds3)           # re-seen: push resets unseen_days
+        tr.table.end_day()
+        surviving, vals = tr.table.store.state_items()
+        assert surviving.size > 50
+        assert (vals[:, acc.UNSEEN_DAYS] <= 1).all()
+
+        # SaveBase at the day boundary + resume keeps the aged state
+        cm = CheckpointManager(
+            CheckpointConfig(batch_model_dir=str(tmp_path / "batch"),
+                             xbox_model_dir=str(tmp_path / "xbox"),
+                             async_save=False),
+            tr.table)
+        cm.save_base(tr.params, tr.opt_state, day="20260730")
+        tr2 = BoxTrainer(CtrDnn(ModelSpec(num_slots=4, slot_dim=3 + D),
+                                hidden=(16,)),
+                         _table(), feed, TrainerConfig(dense_lr=1e-2))
+        cm2 = CheckpointManager(
+            CheckpointConfig(batch_model_dir=str(tmp_path / "batch"),
+                             xbox_model_dir=str(tmp_path / "xbox"),
+                             async_save=False),
+            tr2.table)
+        tr2.params, tr2.opt_state, _meta = cm2.load_base(day="20260730")
+        keys2, vals2 = tr2.table.store.state_items()
+        np.testing.assert_array_equal(np.sort(keys2), np.sort(surviving))
+    finally:
+        tr.close()
+
+
+def test_save_base_plus_end_day_single_aging(tmp_path):
+    """save_base already ages (update_stat_after_save param=3); the
+    combined day boundary must age exactly ONCE (end_day(age=False))."""
+    files, feed = write_synthetic_ctr_files(
+        str(tmp_path / "data"), num_files=1, lines_per_file=100,
+        num_slots=4, vocab_per_slot=50, max_len=3, seed=4)
+    feed = dataclasses.replace(feed, batch_size=32)
+    tr = BoxTrainer(CtrDnn(ModelSpec(num_slots=4, slot_dim=3 + D),
+                           hidden=(16,)),
+                    _table(delete_days=30.0), feed,
+                    TrainerConfig(dense_lr=1e-2))
+    try:
+        ds = BoxDataset(feed)
+        ds.set_filelist(files)
+        tr.train_pass(ds)
+        cm = CheckpointManager(
+            CheckpointConfig(batch_model_dir=str(tmp_path / "b"),
+                             xbox_model_dir=str(tmp_path / "x"),
+                             async_save=False), tr.table)
+        cm.save_base(tr.params, tr.opt_state, day="d0")   # ages once
+        tr.table.end_day(age=False)                       # must NOT re-age
+        _, vals = tr.table.store.state_items()
+        assert (vals[:, acc.UNSEEN_DAYS] == 1.0).all(), \
+            vals[:, acc.UNSEEN_DAYS].max()
+    finally:
+        tr.close()
+
+
+def test_spilled_rows_age_lazily(tmp_path):
+    """Spilled rows must keep aging (epoch-based): fault-in adds the days
+    slept on disk, and shrink deletes spilled rows by the unseen-days rule
+    without faulting them in."""
+    from paddlebox_tpu.embedding.accessor import ValueLayout
+    from paddlebox_tpu.embedding.native_store import make_host_store
+
+    table = dataclasses.replace(
+        _table(delete_days=3.0), ssd_dir=str(tmp_path / "ssd"),
+        ssd_threshold_mb=0.001)
+    layout = ValueLayout(D, "adagrad")
+    store = make_host_store(layout, table, seed=0)
+    keys = np.arange(1, 41, dtype=np.uint64)
+    store.lookup_or_create(keys)
+    # make rows 1..20 colder so they become the spill victims
+    sk, sv = store.state_items()
+    sv[:, acc.UNSEEN_DAYS] = np.where(sk <= 20, 1.0, 0.0)
+    store.write_back(sk, sv)
+    spilled = store.spill(max_resident=20)
+    assert spilled == 20
+
+    # two day boundaries while spilled
+    store.age_unseen_days()
+    store.age_unseen_days()
+    # fault one spilled row back in: 1 (at spill) + 2 missed = 3
+    row = store.lookup_or_create(np.array([1], np.uint64))[0]
+    assert row[acc.UNSEEN_DAYS] == 3.0, row[acc.UNSEEN_DAYS]
+
+    # one more boundary: remaining spilled rows reach 1+3=4 > 3 → shrink
+    # deletes them WITHOUT faulting in; the resident fresh rows survive
+    store.age_unseen_days()
+    deleted = store.shrink()
+    assert deleted >= 19, deleted
+    keys_left, _ = store.state_items()
+    assert (keys_left > 20).sum() == 20  # warm rows intact
+
+
+def test_age_false_still_ticks_spill_clock(tmp_path):
+    """end_day(age=False) (the save_base cadence) must still advance the
+    spilled rows' lazy day clock, and save() must checkpoint spilled rows
+    at their EFFECTIVE age."""
+    from paddlebox_tpu.embedding.accessor import ValueLayout
+    from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
+
+    table = dataclasses.replace(
+        _table(delete_days=30.0), ssd_dir=str(tmp_path / "ssd"),
+        ssd_threshold_mb=0.001)
+    layout = ValueLayout(D, "adagrad")
+    store = HostEmbeddingStore(layout, table, seed=0)
+    keys = np.arange(1, 31, dtype=np.uint64)
+    store.lookup_or_create(keys)
+    sk, sv = store.state_items()
+    sv[:, acc.UNSEEN_DAYS] = np.where(sk <= 15, 1.0, 0.0)
+    store.write_back(sk, sv)
+    assert store.spill(max_resident=15) == 15
+
+    store.tick_spill_age()   # the age=False day boundary
+    store.tick_spill_age()
+    # checkpoint now: spilled rows must be written at 1+2=3
+    ckpt = str(tmp_path / "store.pkl")
+    store.save(ckpt)
+    store2 = HostEmbeddingStore(layout, table, seed=0)
+    store2.load(ckpt)
+    row = store2.lookup(np.array([1], np.uint64))[0]
+    assert row[acc.UNSEEN_DAYS] == 3.0, row[acc.UNSEEN_DAYS]
+
+    # all-spilled table: shrink must still run the spilled sweep
+    table3 = dataclasses.replace(table, delete_after_unseen_days=1.0)
+    store3 = HostEmbeddingStore(layout, table3, seed=0)
+    store3.lookup_or_create(keys[:10])
+    assert store3.spill(max_resident=0) == 10   # nothing resident
+    store3.tick_spill_age()
+    store3.tick_spill_age()
+    assert store3.shrink() == 10                # 0+2 > 1 → all swept
+    assert len(store3._spilled) == 0
+
+
+def test_ps_backed_aging_primary_once(tmp_path):
+    """The PS path ages server-side exactly once per end_day regardless of
+    shard count (primary-gated, like shrink)."""
+    from paddlebox_tpu.embedding.ps_store import ps_store_factory
+    from paddlebox_tpu.ps import PsLocalClient
+
+    cl = PsLocalClient()
+    cfg = _table(delete_days=30.0)
+    cl.create_sparse_table(3, cfg, shard_num=4, seed=0)
+    factory = ps_store_factory(cl, 3)
+    layout_table = [(factory(None, cfg, 0)) for _ in range(4)]
+    keys = np.arange(1, 30, dtype=np.uint64)
+    cl.pull_sparse(3, keys, create=True)
+    for st in layout_table:
+        st.age_unseen_days()   # only the primary may act
+    rows = cl.pull_sparse(3, keys, create=False)
+    assert (rows[:, acc.UNSEEN_DAYS] == 1.0).all(), \
+        rows[:, acc.UNSEEN_DAYS].max()
