@@ -22,18 +22,36 @@ from typing import Dict, Iterable, List, Optional, Tuple
 import numpy as np
 
 from paddlebox_tpu.config.configs import TableConfig
-from paddlebox_tpu.embedding.accessor import ValueLayout, UNSEEN_DAYS
+from paddlebox_tpu.embedding.accessor import (ValueLayout, CLICK, SHOW,
+                                              UNSEEN_DAYS)
 from paddlebox_tpu.utils.stats import stat_add
+
+
+def dec_file_live(file_live: Dict[str, int], fname: str, n: int) -> None:
+    """Spill-file GC shared by both stores: drop n live rows from a block
+    file's count; unlink the file when none remain."""
+    live = file_live.get(fname, 0) - n
+    if live <= 0:
+        file_live.pop(fname, None)
+        try:
+            os.remove(fname)
+        except OSError:
+            pass
+    else:
+        file_live[fname] = live
 
 
 class SpillAgeBook:
     """Aging bookkeeping for the SSD tier: resident rows age in place at
     each day boundary, but spilled rows are immutable on disk — so every
     spill records (epoch, unseen_at_spill) and the missed days are added
-    back lazily at fault-in. Shrink can also delete spilled rows by the
-    unseen-days rule WITHOUT faulting them in (the coldest rows — exactly
-    the deletion candidates — must not be immortal; score-threshold deletes
-    still apply after fault-in, documented approximation)."""
+    back lazily at fault-in, together with the show/click time decay the
+    row slept through (decay_rate**missed — assumes the reference's one
+    shrink per day-boundary cadence). Shrink can also delete spilled rows
+    by the unseen-days rule WITHOUT faulting them in (the coldest rows —
+    exactly the deletion candidates — must not be immortal;
+    score-threshold deletes still apply after fault-in, documented
+    approximation)."""
 
     def __init__(self) -> None:
         self.epoch = 0
@@ -278,23 +296,19 @@ class HostEmbeddingStore:
             return excess
 
     def _dec_file_live(self, fname: str, n: int) -> None:
-        """Spill-file GC: drop n live rows from a block file; unlink when
-        none remain."""
-        live = self._file_live.get(fname, 0) - n
-        if live <= 0:
-            self._file_live.pop(fname, None)
-            try:
-                os.remove(fname)
-            except OSError:
-                pass
-        else:
-            self._file_live[fname] = live
+        dec_file_live(self._file_live, fname, n)
 
     def _fault_in(self, key: int) -> int:
         fname, off = self._spilled.pop(key)
         row_data = np.array(np.load(fname, mmap_mode="r")[off])
-        # add the day boundaries this row slept through on disk
-        row_data[UNSEEN_DAYS] += self._age_book.missed_days(key, pop=True)
+        # add the day boundaries this row slept through on disk, and the
+        # show/click time decay those boundaries would have applied
+        missed = self._age_book.missed_days(key, pop=True)
+        if missed:
+            row_data[UNSEEN_DAYS] += missed
+            decay = self.table.show_click_decay_rate ** missed
+            row_data[SHOW] *= decay
+            row_data[CLICK] *= decay
         self._dec_file_live(fname, 1)
         self._grow(1)
         r = self._free.pop()
@@ -325,29 +339,38 @@ class HostEmbeddingStore:
         """Checkpoint resident AND spilled rows (same invariant as the
         native store: a spilled feature survives a save/load cycle)."""
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        keys, values = self.state_items()
+        # the whole snapshot (resident + spilled + age book) happens under
+        # ONE lock hold: a concurrent fault-in popping a spill entry (and
+        # possibly GC'ing its block file) mid-read would lose the missed
+        # days or crash the np.load
         with self._lock:
+            keys, values = self.state_items()
             spilled = dict(self._spilled)
-        if spilled:
-            skeys = np.fromiter(spilled.keys(), dtype=np.uint64,
-                                count=len(spilled))
-            svals = np.empty((skeys.size, self.layout.width), np.float32)
-            by_file: Dict[str, list] = {}
-            for i, k in enumerate(skeys.tolist()):
-                fname, off = spilled[k]
-                by_file.setdefault(fname, []).append((i, off))
-            for fname, pairs in by_file.items():
-                block = np.load(fname, mmap_mode="r")
-                for i, off in pairs:
-                    svals[i] = block[off]
-            # checkpoint the EFFECTIVE age: add the day boundaries each
-            # spilled row slept through (load() clears the age book, so
-            # un-added days would be lost forever)
-            for i, k in enumerate(skeys.tolist()):
-                svals[i, UNSEEN_DAYS] += self._age_book.missed_days(
-                    int(k), pop=False)
-            keys = np.concatenate([keys, skeys])
-            values = np.vstack([values, svals])
+            if spilled:
+                skeys = np.fromiter(spilled.keys(), dtype=np.uint64,
+                                    count=len(spilled))
+                svals = np.empty((skeys.size, self.layout.width), np.float32)
+                by_file: Dict[str, list] = {}
+                for i, k in enumerate(skeys.tolist()):
+                    fname, off = spilled[k]
+                    by_file.setdefault(fname, []).append((i, off))
+                for fname, pairs in by_file.items():
+                    block = np.load(fname, mmap_mode="r")
+                    for i, off in pairs:
+                        svals[i] = block[off]
+                # checkpoint the EFFECTIVE state: add the day boundaries
+                # each spilled row slept through and the show/click decay
+                # they imply (load() clears the age book, so un-added days
+                # would be lost forever)
+                for i, k in enumerate(skeys.tolist()):
+                    missed = self._age_book.missed_days(int(k), pop=False)
+                    if missed:
+                        svals[i, UNSEEN_DAYS] += missed
+                        d = self.table.show_click_decay_rate ** missed
+                        svals[i, SHOW] *= d
+                        svals[i, CLICK] *= d
+                keys = np.concatenate([keys, skeys])
+                values = np.vstack([values, svals])
         with open(path, "wb") as f:
             pickle.dump({"keys": keys, "values": values,
                          "embedx_dim": self.layout.embedx_dim,

@@ -18,7 +18,8 @@ import numpy as np
 
 from paddlebox_tpu.config import flags
 from paddlebox_tpu.config.configs import TableConfig
-from paddlebox_tpu.embedding.accessor import ValueLayout, UNSEEN_DAYS
+from paddlebox_tpu.embedding.accessor import (ValueLayout, CLICK, SHOW,
+                                              UNSEEN_DAYS)
 from paddlebox_tpu.utils.stats import stat_add
 
 _U64P = ctypes.POINTER(ctypes.c_uint64)
@@ -79,17 +80,8 @@ class NativeHostEmbeddingStore:
         return rows, np.zeros(n, bool)
 
     def _dec_file_live(self, fname: str, n: int) -> None:
-        """Spill-file GC: drop n live rows from a block file; unlink when
-        none remain."""
-        live = self._file_live.get(fname, 0) - n
-        if live <= 0:
-            self._file_live.pop(fname, None)
-            try:
-                os.remove(fname)
-            except OSError:
-                pass
-        else:
-            self._file_live[fname] = live
+        from paddlebox_tpu.embedding.host_store import dec_file_live
+        dec_file_live(self._file_live, fname, n)
 
     def _read_spilled(self, keys: np.ndarray, consume: bool) -> np.ndarray:
         """Read spilled rows for `keys` (all present in the spill index),
@@ -110,8 +102,12 @@ class NativeHostEmbeddingStore:
             if consume:
                 del block  # release the mmap before unlink
                 self._dec_file_live(fname, len(pairs))
-        # add the day boundaries each row slept through on disk
+        # add the day boundaries each row slept through on disk, plus the
+        # show/click time decay those boundaries would have applied
         out[:, UNSEEN_DAYS] += missed
+        decay = self.table.show_click_decay_rate ** missed
+        out[:, SHOW] *= decay
+        out[:, CLICK] *= decay
         if consume:
             stat_add("sparse_keys_faulted_in", int(keys.size))
         return out

@@ -1,9 +1,9 @@
 """Functional dense building blocks.
 
 Plain param-pytree functions (no flax dependency in the hot path): params are
-dicts of jnp arrays, so pjit sharding rules and the ZeRO-1 partitioner
-(parallel/sharding.py) can address every leaf by name. Matmul-heavy by
-design — everything lowers onto the MXU.
+dicts of jnp arrays, so sharding rules and the ZeRO-1 partitioner
+(parallel/sharded_trainer.py sharding mode) can address every leaf by name.
+Matmul-heavy by design — everything lowers onto the MXU.
 """
 
 from __future__ import annotations

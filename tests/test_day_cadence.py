@@ -197,6 +197,33 @@ def test_age_false_still_ticks_spill_clock(tmp_path):
     assert len(store3._spilled) == 0
 
 
+def test_spilled_rows_decay_on_fault_in(tmp_path):
+    """A row that slept through N day boundaries faults back with
+    show/click multiplied by decay_rate**N (parity with resident rows'
+    per-shrink decay)."""
+    from paddlebox_tpu.embedding.accessor import ValueLayout
+    from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
+
+    table = dataclasses.replace(
+        _table(delete_days=30.0), show_click_decay_rate=0.5,
+        ssd_dir=str(tmp_path / "ssd"), ssd_threshold_mb=0.001)
+    layout = ValueLayout(D, "adagrad")
+    store = HostEmbeddingStore(layout, table, seed=0)
+    keys = np.arange(1, 21, dtype=np.uint64)
+    store.lookup_or_create(keys)
+    sk, sv = store.state_items()
+    sv[:, acc.SHOW] = 8.0
+    sv[:, acc.CLICK] = 4.0
+    sv[:, acc.UNSEEN_DAYS] = np.where(sk <= 10, 1.0, 0.0)
+    store.write_back(sk, sv)
+    assert store.spill(max_resident=10) == 10
+    store.age_unseen_days()
+    store.age_unseen_days()
+    row = store.lookup_or_create(np.array([1], np.uint64))[0]
+    assert row[acc.SHOW] == 2.0, row[acc.SHOW]     # 8 * 0.5**2
+    assert row[acc.CLICK] == 1.0, row[acc.CLICK]   # 4 * 0.5**2
+
+
 def test_ps_backed_aging_primary_once(tmp_path):
     """The PS path ages server-side exactly once per end_day regardless of
     shard count (primary-gated, like shrink)."""

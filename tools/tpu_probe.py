@@ -113,3 +113,13 @@ if __name__ == "__main__":
     stage("full_step_4x_slab", 1 << 22)
     stage("no_push", 1 << 20, strip="push")
     stage("dense_only", 1 << 20, strip="sparse")
+    # hand-written Pallas in-table adagrad vs the XLA update
+    from paddlebox_tpu.config import flags as _flags
+    _flags.set_flag("use_pallas_push", True)
+    try:
+        stage("full_step_pallas_push", 1 << 20)
+    except Exception as e:  # pallas may not lower on every backend
+        print(json.dumps({"stage": "full_step_pallas_push",
+                          "error": repr(e)[:300]}), flush=True)
+    finally:
+        _flags.set_flag("use_pallas_push", False)
