@@ -27,6 +27,20 @@ from paddlebox_tpu.embedding.accessor import (ValueLayout, CLICK, SHOW,
 from paddlebox_tpu.utils.stats import stat_add
 
 
+def apply_missed_days(vals: np.ndarray, missed, decay_rate: float) -> None:
+    """IN PLACE: add the day boundaries rows slept through on disk and the
+    show/click time decay those boundaries would have applied (the ONE
+    aging/decay rule — one-shrink-per-tick assumption documented on
+    SpillAgeBook). vals: [N, width] (or a single row); missed: scalar or
+    [N]."""
+    vals = np.atleast_2d(vals)
+    missed = np.asarray(missed, np.float32)
+    vals[:, UNSEEN_DAYS] += missed
+    decay = np.asarray(decay_rate, np.float32) ** missed
+    vals[:, SHOW] *= decay
+    vals[:, CLICK] *= decay
+
+
 def dec_file_live(file_live: Dict[str, int], fname: str, n: int) -> None:
     """Spill-file GC shared by both stores: drop n live rows from a block
     file's count; unlink the file when none remain."""
@@ -301,14 +315,10 @@ class HostEmbeddingStore:
     def _fault_in(self, key: int) -> int:
         fname, off = self._spilled.pop(key)
         row_data = np.array(np.load(fname, mmap_mode="r")[off])
-        # add the day boundaries this row slept through on disk, and the
-        # show/click time decay those boundaries would have applied
         missed = self._age_book.missed_days(key, pop=True)
         if missed:
-            row_data[UNSEEN_DAYS] += missed
-            decay = self.table.show_click_decay_rate ** missed
-            row_data[SHOW] *= decay
-            row_data[CLICK] *= decay
+            apply_missed_days(row_data, missed,
+                              self.table.show_click_decay_rate)
         self._dec_file_live(fname, 1)
         self._grow(1)
         r = self._free.pop()
@@ -358,17 +368,14 @@ class HostEmbeddingStore:
                     block = np.load(fname, mmap_mode="r")
                     for i, off in pairs:
                         svals[i] = block[off]
-                # checkpoint the EFFECTIVE state: add the day boundaries
-                # each spilled row slept through and the show/click decay
-                # they imply (load() clears the age book, so un-added days
-                # would be lost forever)
-                for i, k in enumerate(skeys.tolist()):
-                    missed = self._age_book.missed_days(int(k), pop=False)
-                    if missed:
-                        svals[i, UNSEEN_DAYS] += missed
-                        d = self.table.show_click_decay_rate ** missed
-                        svals[i, SHOW] *= d
-                        svals[i, CLICK] *= d
+                # checkpoint the EFFECTIVE state (load() clears the age
+                # book, so un-added days would be lost forever)
+                missed = np.fromiter(
+                    (self._age_book.missed_days(int(k), pop=False)
+                     for k in skeys.tolist()),
+                    dtype=np.float32, count=skeys.size)
+                apply_missed_days(svals, missed,
+                                  self.table.show_click_decay_rate)
                 keys = np.concatenate([keys, skeys])
                 values = np.vstack([values, svals])
         with open(path, "wb") as f:

@@ -18,8 +18,7 @@ import numpy as np
 
 from paddlebox_tpu.config import flags
 from paddlebox_tpu.config.configs import TableConfig
-from paddlebox_tpu.embedding.accessor import (ValueLayout, CLICK, SHOW,
-                                              UNSEEN_DAYS)
+from paddlebox_tpu.embedding.accessor import ValueLayout, UNSEEN_DAYS
 from paddlebox_tpu.utils.stats import stat_add
 
 _U64P = ctypes.POINTER(ctypes.c_uint64)
@@ -102,12 +101,8 @@ class NativeHostEmbeddingStore:
             if consume:
                 del block  # release the mmap before unlink
                 self._dec_file_live(fname, len(pairs))
-        # add the day boundaries each row slept through on disk, plus the
-        # show/click time decay those boundaries would have applied
-        out[:, UNSEEN_DAYS] += missed
-        decay = self.table.show_click_decay_rate ** missed
-        out[:, SHOW] *= decay
-        out[:, CLICK] *= decay
+        from paddlebox_tpu.embedding.host_store import apply_missed_days
+        apply_missed_days(out, missed, self.table.show_click_decay_rate)
         if consume:
             stat_add("sparse_keys_faulted_in", int(keys.size))
         return out

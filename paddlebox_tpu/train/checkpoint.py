@@ -151,6 +151,48 @@ class CheckpointManager:
 # ---------------------------------------------------------------------------
 
 
+def run_day(trainer, datasets, cm: CheckpointManager, day: str,
+            preload: bool = True):
+    """ONE training day, fully composed (the python driver the reference
+    runs around BoxHelper: per pass train → end_pass(need_save_delta) →
+    SaveDelta on the configured cadence; at day end SaveBase + the
+    end_day(age=False) shrink — save_base already aged the residents).
+
+    trainer: BoxTrainer (CheckpointManager snapshots through the
+    single-host PassTable; the sharded trainer checkpoints per owned
+    shard via its table's save()). datasets: the day's passes.
+    Returns (per-pass stats, (batch_dir, xbox_dir) of the day's base save).
+    """
+    from paddlebox_tpu.train.preload import run_preloaded_passes
+
+    if not hasattr(trainer.table, "store"):
+        raise TypeError("run_day drives the single-host BoxTrainer; "
+                        "sharded tables checkpoint via table.save()")
+    every = max(1, cm.cfg.save_delta_every_passes)
+    state = {"delta_id": 0}
+
+    def on_pass(i, _stats):
+        if (i + 1) % every == 0:
+            state["delta_id"] += 1
+            cm.save_delta(day, state["delta_id"])
+
+    if preload:
+        # real overlap: pass N+1's readers run while pass N trains AND
+        # while its cadenced delta save snapshots
+        stats = run_preloaded_passes(trainer, datasets, release=True,
+                                     after_pass=on_pass)
+    else:
+        stats = []
+        for i, ds in enumerate(datasets):
+            stats.append(trainer.train_pass(ds))
+            on_pass(i, stats[-1])
+            ds.release_memory()
+    dirs = cm.save_base(trainer.params, trainer.opt_state, day)
+    trainer.table.end_day(age=False)
+    cm.wait()
+    return stats, dirs
+
+
 def merge_models(batch_dirs, out_dir: str) -> str:
     """Merge N batch models into one (MergeModel/MergeMultiModels,
     box_wrapper.h:788-804 — the closed core's impl is not visible, so the

@@ -61,11 +61,15 @@ class PassPreloader:
 
 
 def run_preloaded_passes(trainer, datasets: Iterable,
-                         release: bool = True) -> List[Dict[str, float]]:
+                         release: bool = True,
+                         after_pass=None) -> List[Dict[str, float]]:
     """Drive a sequence of datasets with load(N+1) ∥ train(N) overlap.
 
     Works with BoxTrainer and ShardedBoxTrainer (both accept
-    train_pass(dataset, preloaded=True)). Returns per-pass stats dicts.
+    train_pass(dataset, preloaded=True)). after_pass(pass_index, stats),
+    when given, runs after each pass WITH the next pass's readers already
+    live — the hook for pass-cadenced work like delta saves
+    (end_pass(need_save_delta)). Returns per-pass stats dicts.
     """
     allgather = None
     if getattr(trainer, "multiprocess", False):
@@ -84,6 +88,8 @@ def run_preloaded_passes(trainer, datasets: Iterable,
             # start pass N+1's read threads BEFORE training pass N
             pre.preload(nxt)
         results.append(trainer.train_pass(cur, preloaded=True))
+        if after_pass is not None:
+            after_pass(len(results) - 1, results[-1])
         if release:
             cur.release_memory()
         cur = nxt

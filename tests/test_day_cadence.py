@@ -4,6 +4,7 @@ SaveBase(batch, xbox, day); delete rule ctr_accessor's
 delete_after_unseen_days)."""
 
 import dataclasses
+import os
 
 import numpy as np
 
@@ -195,6 +196,45 @@ def test_age_false_still_ticks_spill_clock(tmp_path):
     store3.tick_spill_age()
     assert store3.shrink() == 10                # 0+2 > 1 → all swept
     assert len(store3._spilled) == 0
+
+
+def test_run_day_composed_cadence(tmp_path):
+    """run_day: per-pass delta saves on cadence, end-of-day base save +
+    single aging, preload overlap — the whole day driver in one call."""
+    import glob
+    from paddlebox_tpu.train.checkpoint import run_day
+
+    files, feed = write_synthetic_ctr_files(
+        str(tmp_path / "data"), num_files=2, lines_per_file=160,
+        num_slots=4, vocab_per_slot=60, max_len=3, seed=6)
+    feed = dataclasses.replace(feed, batch_size=32)
+    tr = BoxTrainer(CtrDnn(ModelSpec(num_slots=4, slot_dim=3 + D),
+                           hidden=(16,)),
+                    _table(delete_days=30.0), feed,
+                    TrainerConfig(dense_lr=1e-2))
+    try:
+        cm = CheckpointManager(
+            CheckpointConfig(batch_model_dir=str(tmp_path / "b"),
+                             xbox_model_dir=str(tmp_path / "x"),
+                             save_delta_every_passes=1, async_save=False),
+            tr.table)
+        datasets = []
+        for _ in range(3):
+            ds = BoxDataset(feed)
+            ds.set_filelist(files)
+            datasets.append(ds)
+        stats, (batch_dir, xbox_dir) = run_day(tr, datasets, cm, "d7")
+        assert len(stats) == 3
+        assert stats[-1]["loss"] < stats[0]["loss"]
+        # 3 delta saves + the base save exist on disk
+        deltas = glob.glob(str(tmp_path / "x" / "d7" / "delta-*"))
+        assert len(deltas) == 3, deltas
+        assert os.path.exists(os.path.join(batch_dir, "DONE"))
+        # exactly ONE aging for the whole day (save_base's)
+        _, vals = tr.table.store.state_items()
+        assert (vals[:, acc.UNSEEN_DAYS] == 1.0).all()
+    finally:
+        tr.close()
 
 
 def test_spilled_rows_decay_on_fault_in(tmp_path):
