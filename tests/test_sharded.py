@@ -280,3 +280,31 @@ def test_sharded_predict_excludes_wrap_duplicates(sharded_setup):
     ds._records = ds.records[:10]
     preds, labels = trainer.predict_batches(ds)
     assert preds.size == labels.size == 10
+
+
+def test_sharded_table_save_load_roundtrip(sharded_setup, tmp_path):
+    """Per-shard checkpoint files: a fresh trainer loading them serves
+    identical rows and keeps training (the sharded batch-model tier)."""
+    files, feed = sharded_setup
+    tr = make_sharded_trainer(feed, seed=3)
+    ds = BoxDataset(feed, read_threads=1)
+    ds.set_filelist(files)
+    tr.train_pass(ds)
+    prefix = str(tmp_path / "sharded_ckpt")
+    tr.table.save(prefix)
+
+    tr2 = make_sharded_trainer(feed, seed=3)
+    tr2.table.load(prefix)
+    for s in range(8):
+        k1, v1 = tr.table.stores[s].state_items()
+        k2, v2 = tr2.table.stores[s].state_items()
+        o1, o2 = np.argsort(k1), np.argsort(k2)
+        np.testing.assert_array_equal(k1[o1], k2[o2])
+        np.testing.assert_allclose(v1[o1], v2[o2], rtol=1e-6)
+    # restored trainer keeps training from the loaded state
+    tr2.params = tr.params
+    tr2.opt_state = tr.opt_state
+    ds2 = BoxDataset(feed, read_threads=1)
+    ds2.set_filelist(files)
+    stats = tr2.train_pass(ds2)
+    assert np.isfinite(stats["loss"])
