@@ -328,12 +328,35 @@ class HostEmbeddingStore:
         return r
 
     def load_spilled(self) -> int:
-        """LoadSSD2Mem(day): promote every spilled row back to DRAM."""
-        n = 0
-        for k in list(self._spilled.keys()):
-            self._fault_in(k)
-            n += 1
-        return n
+        """LoadSSD2Mem(day): promote every spilled row back to DRAM —
+        batched by block file (one np.load per file, not per row) and under
+        the lock (a concurrent lookup fault-in of the same key would
+        double-pop the spill index)."""
+        with self._lock:
+            if not self._spilled:
+                return 0
+            by_file: Dict[str, list] = {}
+            for k, (fname, off) in self._spilled.items():
+                by_file.setdefault(fname, []).append((k, off))
+            self._grow(len(self._spilled))
+            n = 0
+            for fname, pairs in by_file.items():
+                block = np.load(fname, mmap_mode="r")
+                for k, off in pairs:
+                    row = np.array(block[off])
+                    missed = self._age_book.missed_days(k, pop=True)
+                    if missed:
+                        apply_missed_days(row, missed,
+                                          self.table.show_click_decay_rate)
+                    r = self._free.pop()
+                    self._values[r] = row
+                    self._index[k] = r
+                    n += 1
+                del block  # release the mmap before unlink
+                self._dec_file_live(fname, len(pairs))
+            self._spilled.clear()
+            stat_add("sparse_keys_faulted_in", n)
+            return n
 
     # ---------------------------------------------------------- checkpoint
     def state_items(self) -> Tuple[np.ndarray, np.ndarray]:

@@ -345,3 +345,11 @@ class PassTable:
 
     def load(self, path: str) -> None:
         self.store.load(path)
+
+    def load_ssd_to_mem(self) -> int:
+        """LoadSSD2Mem (box_wrapper.cc:1319): promote every spilled row
+        back to DRAM — the explicit warm-up after a model load, before the
+        day's first feed pass. Returns rows promoted."""
+        if hasattr(self.store, "load_spilled"):
+            return self.store.load_spilled()
+        return 0

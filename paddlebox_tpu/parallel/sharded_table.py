@@ -345,3 +345,8 @@ class ShardedPassTable:
         for s, st in enumerate(self.stores):
             if st is not None:
                 st.load(f"{path_prefix}.shard{s:03d}")
+
+    def load_ssd_to_mem(self) -> int:
+        """LoadSSD2Mem over the owned shards (box_wrapper.cc:1319)."""
+        return sum(st.load_spilled() for st in self.stores
+                   if st is not None and hasattr(st, "load_spilled"))

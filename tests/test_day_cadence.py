@@ -264,6 +264,40 @@ def test_spilled_rows_decay_on_fault_in(tmp_path):
     assert row[acc.CLICK] == 1.0, row[acc.CLICK]   # 4 * 0.5**2
 
 
+def test_load_ssd_to_mem_promotes_all(tmp_path):
+    """PassTable.load_ssd_to_mem (LoadSSD2Mem): after a spill, the warm-up
+    promotes every spilled row back to DRAM with its effective age."""
+    files, feed = write_synthetic_ctr_files(
+        str(tmp_path / "data"), num_files=1, lines_per_file=150,
+        num_slots=4, vocab_per_slot=60, max_len=3, seed=8)
+    feed = dataclasses.replace(feed, batch_size=32)
+    table = dataclasses.replace(
+        _table(delete_days=30.0), ssd_dir=str(tmp_path / "ssd"),
+        ssd_threshold_mb=0.002)
+    tr = BoxTrainer(CtrDnn(ModelSpec(num_slots=4, slot_dim=3 + D),
+                           hidden=(16,)), table, feed,
+                    TrainerConfig(dense_lr=1e-2))
+    try:
+        ds = BoxDataset(feed)
+        ds.set_filelist(files)
+        tr.train_pass(ds)   # end_pass spills beyond the tiny budget
+        spilled_keys = np.array(sorted(tr.table.store._spilled),
+                                dtype=np.uint64)
+        assert spilled_keys.size > 0
+        tr.table.end_day()  # one day on disk for the spilled rows
+        promoted = tr.table.load_ssd_to_mem()
+        assert promoted == spilled_keys.size
+        assert len(tr.table.store._spilled) == 0
+        # the PROMOTED rows specifically carry the missed day: resident
+        # rows were aged in place to 1.0, spilled rows slept at their
+        # spill-time value and got the epoch delta added at promotion
+        rows = tr.table.store.lookup(spilled_keys)
+        assert (rows[:, acc.UNSEEN_DAYS] >= 1.0).all(), \
+            rows[:, acc.UNSEEN_DAYS].min()
+    finally:
+        tr.close()
+
+
 def test_ps_backed_aging_primary_once(tmp_path):
     """The PS path ages server-side exactly once per end_day regardless of
     shard count (primary-gated, like shrink)."""
