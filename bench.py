@@ -183,11 +183,20 @@ def main() -> None:
     eps = result["examples_per_sec"]
     base = env_baseline or SELF_BASELINE.get(result["platform"]) or 0.0
     vs = eps / base if base > 0 else 1.0
+    # a CPU-fallback ratio is a container number, not chip progress:
+    # vs_baseline must read null so the round artifact can't mistake it.
+    # The explicit cpu self-ratio is always against SELF_BASELINE["cpu"]
+    # (an env-provided TPU baseline must not leak into a CPU-named key).
+    on_tpu = result["platform"] not in ("cpu",)
+    cpu_base = SELF_BASELINE["cpu"]
     print(json.dumps({
         "metric": "deepfm_sparse_train_examples_per_sec_per_chip",
         "value": round(eps, 1),
         "unit": "examples/sec/chip",
-        "vs_baseline": round(vs, 3),
+        "vs_baseline": round(vs, 3) if on_tpu else None,
+        **({} if on_tpu else {"cpu_fallback": True,
+                              "vs_cpu_self_baseline": round(eps / cpu_base,
+                                                            3)}),
         "platform": result["platform"],
         "device": result.get("device"),
         "steady_ms_per_step": result.get("steady_ms_per_step"),

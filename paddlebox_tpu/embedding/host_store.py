@@ -368,6 +368,37 @@ class HostEmbeddingStore:
                                count=len(self._index))
             return keys, self._values[rows].copy()
 
+    def spilled_snapshot(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(keys, EFFECTIVE values) of the spilled rows, without faulting
+        them in or mutating the store: missed days + show/click decay are
+        applied to the returned copy (the age book keeps its entries).
+        Every checkpoint path that snapshots beyond state_items() must use
+        this — a snapshot of the raw disk blocks would lose the un-added
+        days forever once the age book is cleared on load."""
+        with self._lock:
+            if not self._spilled:
+                return (np.empty(0, np.uint64),
+                        np.empty((0, self.layout.width), np.float32))
+            spilled = dict(self._spilled)
+            skeys = np.fromiter(spilled.keys(), dtype=np.uint64,
+                                count=len(spilled))
+            svals = np.empty((skeys.size, self.layout.width), np.float32)
+            by_file: Dict[str, list] = {}
+            for i, k in enumerate(skeys.tolist()):
+                fname, off = spilled[k]
+                by_file.setdefault(fname, []).append((i, off))
+            for fname, pairs in by_file.items():
+                block = np.load(fname, mmap_mode="r")
+                for i, off in pairs:
+                    svals[i] = block[off]
+            missed = np.fromiter(
+                (self._age_book.missed_days(int(k), pop=False)
+                 for k in skeys.tolist()),
+                dtype=np.float32, count=skeys.size)
+            apply_missed_days(svals, missed,
+                              self.table.show_click_decay_rate)
+            return skeys, svals
+
     def save(self, path: str) -> None:
         """Checkpoint resident AND spilled rows (same invariant as the
         native store: a spilled feature survives a save/load cycle)."""
@@ -378,27 +409,8 @@ class HostEmbeddingStore:
         # days or crash the np.load
         with self._lock:
             keys, values = self.state_items()
-            spilled = dict(self._spilled)
-            if spilled:
-                skeys = np.fromiter(spilled.keys(), dtype=np.uint64,
-                                    count=len(spilled))
-                svals = np.empty((skeys.size, self.layout.width), np.float32)
-                by_file: Dict[str, list] = {}
-                for i, k in enumerate(skeys.tolist()):
-                    fname, off = spilled[k]
-                    by_file.setdefault(fname, []).append((i, off))
-                for fname, pairs in by_file.items():
-                    block = np.load(fname, mmap_mode="r")
-                    for i, off in pairs:
-                        svals[i] = block[off]
-                # checkpoint the EFFECTIVE state (load() clears the age
-                # book, so un-added days would be lost forever)
-                missed = np.fromiter(
-                    (self._age_book.missed_days(int(k), pop=False)
-                     for k in skeys.tolist()),
-                    dtype=np.float32, count=skeys.size)
-                apply_missed_days(svals, missed,
-                                  self.table.show_click_decay_rate)
+            skeys, svals = self.spilled_snapshot()
+            if skeys.size:
                 keys = np.concatenate([keys, skeys])
                 values = np.vstack([values, svals])
         with open(path, "wb") as f:

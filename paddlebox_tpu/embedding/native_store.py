@@ -205,7 +205,12 @@ class NativeHostEmbeddingStore:
         # in-place single-column increment in C++ (a state_items round trip
         # would copy the whole table twice); spilled rows age lazily via
         # the epoch, added back at fault-in
-        self._lib.hs_add_col(self._h, UNSEEN_DAYS, 1.0)
+        touched = int(self._lib.hs_add_col(self._h, UNSEEN_DAYS, 1.0))
+        if touched < 0:  # -1 = column out of range: layout/width mismatch
+            raise RuntimeError(
+                f"hs_add_col(col={UNSEEN_DAYS}) rejected by native store "
+                f"(width={self._lib.hs_width(self._h)}) — layout mismatch")
+        stat_add("sparse_rows_aged", touched)
         self._age_book.tick()
 
     def tick_spill_age(self) -> None:
@@ -272,15 +277,23 @@ class NativeHostEmbeddingStore:
                                 _p(values, _F32P))
         return keys, values
 
+    def spilled_snapshot(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(keys, EFFECTIVE values) of spilled rows without consuming the
+        spill index (see HostEmbeddingStore.spilled_snapshot)."""
+        if not self._spilled:
+            return (np.empty(0, np.uint64),
+                    np.empty((0, self.layout.width), np.float32))
+        skeys = np.fromiter(self._spilled.keys(), dtype=np.uint64,
+                            count=len(self._spilled))
+        return skeys, self._read_spilled(skeys, consume=False)
+
     def save(self, path: str) -> None:
         """Checkpoint resident AND spilled rows (a spilled feature must
         survive a save/load cycle)."""
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         keys, values = self.state_items()
-        if self._spilled:
-            skeys = np.fromiter(self._spilled.keys(), dtype=np.uint64,
-                                count=len(self._spilled))
-            svals = self._read_spilled(skeys, consume=False)
+        skeys, svals = self.spilled_snapshot()
+        if skeys.size:
             keys = np.concatenate([keys, skeys])
             values = np.vstack([values, svals])
         with open(path, "wb") as f:
@@ -317,10 +330,16 @@ class NativeHostEmbeddingStore:
 
 def make_host_store(layout: ValueLayout, table: TableConfig, seed: int = 0):
     """Native store (with native SSD spill) unless the native lib is
-    unavailable."""
+    unavailable — in which case the fallback is LOUD (warning + stat), so
+    a broken native build shows up as a flagged degraded mode, not a
+    mystery ~10× slowdown in the per-pass store calls."""
     from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
     try:
         return NativeHostEmbeddingStore(layout, table, seed)
     except RuntimeError:
-        pass
+        import logging
+        logging.getLogger("paddlebox_tpu").warning(
+            "make_host_store: native lib unavailable — using pure-python "
+            "HostEmbeddingStore (per-pass lookups ~10x slower)")
+        stat_add("host_store_python_fallback")
     return HostEmbeddingStore(layout, table, seed)

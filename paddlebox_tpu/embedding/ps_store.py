@@ -89,9 +89,15 @@ class PSBackedStore:
             self.client.age_unseen_days(self.table_id)
 
     def tick_spill_age(self) -> None:
-        # spill tiering lives server-side (the PS table's own shards track
-        # their spill clocks through age_unseen_days) — nothing client-side
-        pass
+        # the age=False/save_base cadence assumes the checkpoint path
+        # already aged resident rows (update_stat_after_save param=3) —
+        # but PS checkpoints go through PSClient.save, which does NOT run
+        # that mutation, so a PS-backed table would never advance
+        # unseen_days and delete_after_unseen_days would never fire. The
+        # day boundary must therefore age server-side here, primary-gated
+        # like every other table-wide op (one +1 per boundary, not P).
+        if self.primary:
+            self.client.age_unseen_days(self.table_id)
 
     def state_items(self) -> Tuple[np.ndarray, np.ndarray]:
         raise NotImplementedError(

@@ -207,9 +207,22 @@ def get_lib() -> Optional[ctypes.CDLL]:
             return _lib
         try:
             _lib = _bind(ctypes.CDLL(_build()))
-        except Exception:
+        except Exception as e:
+            # LOUD degraded mode: every consumer (host store, router,
+            # parser) silently drops to a ~10× slower pure-python path —
+            # warn once and bump a stat so CI / dashboards notice a broken
+            # native build instead of a mystery slowdown
             _failed = True
             _lib = None
+            import logging
+            from paddlebox_tpu.utils.stats import stat_add
+            detail = e.stderr.decode()[-500:] if isinstance(
+                e, subprocess.CalledProcessError) and e.stderr else repr(e)
+            logging.getLogger("paddlebox_tpu").warning(
+                "native library build/load FAILED — falling back to "
+                "pure-python host store/router/parser (order-of-magnitude "
+                "slower). Cause: %s", detail)
+            stat_add("native_lib_unavailable")
     return _lib
 
 

@@ -48,8 +48,17 @@ class CheckpointManager:
         os.makedirs(xbox_dir, exist_ok=True)
 
         keys, values = self.table.store.state_items()  # snapshot (copy)
-        xbox_blob = self._xbox_view(keys, values, base=True)
-        sparse_blob = {"keys": keys, "values": values.copy(),
+        # SSD-tier rows are NOT in state_items(); a base model must cover
+        # them (the reference's SaveBase covers SSD-tier rows) or a resume
+        # after load_base — which clears the spill index — loses every
+        # spilled feature. Snapshot them at their EFFECTIVE age; the
+        # post-save stat mutation below stays resident-only (spilled rows
+        # age via the age-book epoch at the day boundary).
+        skeys, svals = self._spilled_snapshot()
+        all_keys = np.concatenate([keys, skeys]) if skeys.size else keys
+        all_vals = np.vstack([values, svals]) if skeys.size else values
+        xbox_blob = self._xbox_view(all_keys, all_vals, base=True)
+        sparse_blob = {"keys": all_keys, "values": all_vals.copy(),
                        "embedx_dim": self.table.layout.embedx_dim,
                        "optimizer": self.table.layout.optimizer}
         # base save covers everything: clear delta scores + age days, now
@@ -98,6 +107,13 @@ class CheckpointManager:
         else:
             do_save()
         return xbox_dir
+
+    def _spilled_snapshot(self):
+        snap = getattr(self.table.store, "spilled_snapshot", None)
+        if snap is None:
+            return (np.empty(0, np.uint64),
+                    np.empty((0, self.table.layout.width), np.float32))
+        return snap()
 
     def _xbox_view(self, keys: np.ndarray, values: np.ndarray,
                    base: bool) -> Dict:

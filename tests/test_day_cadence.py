@@ -316,3 +316,77 @@ def test_ps_backed_aging_primary_once(tmp_path):
     rows = cl.pull_sparse(3, keys, create=False)
     assert (rows[:, acc.UNSEEN_DAYS] == 1.0).all(), \
         rows[:, acc.UNSEEN_DAYS].max()
+
+
+def test_save_base_covers_spilled_rows(tmp_path):
+    """ADVICE r2 (medium): save_base on a table with an active SSD spill
+    tier must cover the spilled rows at their EFFECTIVE age — load_base
+    clears the spill index, so a base model built from state_items() alone
+    would lose every spilled feature (the reference's SaveBase covers the
+    SSD tier)."""
+    files, feed = write_synthetic_ctr_files(
+        str(tmp_path / "data"), num_files=1, lines_per_file=200,
+        num_slots=4, vocab_per_slot=80, max_len=3, seed=3)
+    feed = dataclasses.replace(feed, batch_size=32)
+    # ssd_dir with NO auto-spill threshold: the spill below is manual so
+    # the test controls exactly which rows are on the SSD tier at save
+    table = dataclasses.replace(_table(delete_days=30.0),
+                                ssd_dir=str(tmp_path / "ssd"))
+    tr = BoxTrainer(CtrDnn(ModelSpec(num_slots=4, slot_dim=3 + D),
+                           hidden=(16,)),
+                    table, feed, TrainerConfig(dense_lr=1e-2))
+    try:
+        ds = BoxDataset(feed)
+        ds.set_filelist(files)
+        tr.train_pass(ds)
+        store = tr.table.store
+        sk, sv = store.state_items()
+        n = sk.size
+        assert n > 50
+        cold_mask = np.arange(n) < n // 2
+        sv[:, acc.UNSEEN_DAYS] = np.where(cold_mask, 1.0, 0.0)
+        store.write_back(sk, sv)
+        cold = sk[sv[:, acc.UNSEEN_DAYS] == 1.0]
+        assert store.spill(max_resident=n - n // 2) == n // 2
+        store.tick_spill_age()  # one boundary slept through on disk
+
+        cm = CheckpointManager(
+            CheckpointConfig(batch_model_dir=str(tmp_path / "b"),
+                             xbox_model_dir=str(tmp_path / "x"),
+                             async_save=False), tr.table)
+        _, xbox_dir = cm.save_base(tr.params, tr.opt_state, day="d0")
+        # the serving (xbox) base view covers the spilled rows too
+        import pickle
+        with open(os.path.join(xbox_dir, "embedding.pkl"), "rb") as f:
+            xbox = pickle.load(f)
+        assert set(xbox["keys"].tolist()) == set(sk.tolist())
+
+        cm.load_base("d0")
+        got, _ = store.state_items()
+        assert set(got.tolist()) == set(sk.tolist())
+        # the previously-spilled row resumed at effective age 1+1 missed=2
+        row = store.lookup(cold[:1])[0]
+        assert row[acc.UNSEEN_DAYS] == 2.0, row[acc.UNSEEN_DAYS]
+    finally:
+        tr.close()
+
+
+def test_ps_backed_end_day_age_false_still_ages(tmp_path):
+    """ADVICE r2: end_day(age=False) on PS-backed shards must still age
+    server-side (PS checkpoints never run update_stat_after_save, so the
+    save_base path can't have aged them) — exactly once, primary-gated."""
+    from paddlebox_tpu.embedding.ps_store import ps_store_factory
+    from paddlebox_tpu.ps import PsLocalClient
+
+    cl = PsLocalClient()
+    cfg = _table(delete_days=30.0)
+    cl.create_sparse_table(7, cfg, shard_num=4, seed=0)
+    factory = ps_store_factory(cl, 7)
+    stores = [factory(None, cfg, 0) for _ in range(4)]
+    keys = np.arange(1, 30, dtype=np.uint64)
+    cl.pull_sparse(7, keys, create=True)
+    for st in stores:
+        st.tick_spill_age()   # the age=False day-boundary path
+    rows = cl.pull_sparse(7, keys, create=False)
+    assert (rows[:, acc.UNSEEN_DAYS] == 1.0).all(), \
+        rows[:, acc.UNSEEN_DAYS].max()
