@@ -115,6 +115,11 @@ define_flag("dump_file_max_bytes", 2 << 30,
 define_flag("stack_threads", 4,
             "host batch-staging threads per scan chunk (lookup + dedup; "
             "the feed-thread pool role, box_wrapper.h:862); <=1 = serial")
+define_flag("stream_depth", 2,
+            "sharded-trainer input stream: staged-ahead step queue depth "
+            "(peak live routed steps is this + 2: one in the consumer's "
+            "hands, one in flight on the stager thread; boxps "
+            "device_reader_->Next double-buffer role)")
 define_flag("profile_per_op", False,
             "accumulate per-op timing in the train loop (TrainFilesWithProfiler)")
 define_flag("use_pallas_push", False,

@@ -85,21 +85,29 @@ def make_scan(step_fn: Callable) -> Callable:
     return scan_steps
 
 
-def run_scan_chunks(scan_fn: Callable, items: List, chunk: int,
+def run_scan_chunks(scan_fn: Callable, items, chunk: int,
                     stack_fn: Callable, carry: Tuple,
-                    on_chunk: Callable, timer=None):
+                    on_chunk: Callable, timer=None,
+                    n_items: Optional[int] = None):
     """Drive the megastep over full chunks of `items`, double-buffered:
     chunk i+1 is host-stacked and dispatched BEFORE chunk i's results are
     pulled to host, so H2D staging and metric extraction overlap device
     compute (the MiniBatchGpuPack pinned-async-copy role,
     data_feed.h:519-680 — one chunk of pipelining, bounded memory).
 
+    items: a list, or a bounded iterator (the sharded trainer's streamed
+    input) with n_items passed explicitly. Exactly n_consumed items are
+    pulled either way, so the caller's per-step loop may continue from the
+    same iterator (or from items[n_consumed:]).
+
     carry = (slab(s), params, opt_state, prng) threaded through scan_fn;
     on_chunk(lo, group, losses_np, preds) handles metrics/dump/nan per
-    trainer. Returns (carry, losses, n_consumed) — the remainder
-    items[n_consumed:] is the caller's per-step loop."""
+    trainer. Returns (carry, losses, n_consumed)."""
     losses_all: List[float] = []
-    n_full = (len(items) // chunk) * chunk if chunk > 1 else 0
+    if n_items is None:
+        n_items = len(items)
+    it = iter(items)
+    n_full = (n_items // chunk) * chunk if chunk > 1 else 0
     pending = None  # (lo, group, losses_dev, preds_dev)
 
     def drain(p):
@@ -109,7 +117,7 @@ def run_scan_chunks(scan_fn: Callable, items: List, chunk: int,
         on_chunk(lo, group, losses_np, preds_dev)
 
     for lo in range(0, n_full, chunk):
-        group = items[lo:lo + chunk]
+        group = [next(it) for _ in range(chunk)]
         stacked = stack_fn(group)               # host work ∥ device compute
         if timer is not None:
             timer.start()

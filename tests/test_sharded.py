@@ -308,3 +308,38 @@ def test_sharded_table_save_load_roundtrip(sharded_setup, tmp_path):
     ds2.set_filelist(files)
     stats = tr2.train_pass(ds2)
     assert np.isfinite(stats["loss"])
+
+
+def test_stream_bounded_memory(sharded_setup):
+    """shard_batches is a bounded STREAM (VERDICT r2 #2): training a long
+    pass keeps at most stream_depth routed steps staged ahead — never the
+    whole pass — while producing the same learning behavior (covered by
+    the e2e/parity tests, which now also run through the stream)."""
+    files, feed = sharded_setup
+    feed_small = type(feed)(slots=feed.slots, batch_size=4)
+    spec = ModelSpec(num_slots=4, slot_dim=3 + D)
+    trainer = ShardedBoxTrainer(
+        CtrDnn(spec, hidden=(16,)), table_cfg(), feed_small,
+        TrainerConfig(dense_lr=0.01, scan_chunk=1),
+        mesh=device_mesh_1d(8), seed=0)
+    ds = BoxDataset(feed_small, read_threads=1)
+    ds.set_filelist(files)
+    stats = trainer.train_pass(ds)
+    assert stats["batches"] >= 50, stats        # long pass, many steps
+    # live staged steps = queue (<= stream_depth=2) + the one in hand
+    assert 1 <= trainer.stream_high_water <= 3, trainer.stream_high_water
+
+
+def test_stream_surfaces_producer_errors(sharded_setup):
+    """A routing failure on the stager thread must surface in the training
+    loop, not hang the queue."""
+    files, feed = sharded_setup
+    trainer = make_sharded_trainer(feed)
+    ds = BoxDataset(feed, read_threads=1)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    per_worker = ds.split_batches(num_workers=8)
+    # no feed pass registered → bucketize must raise through the stream
+    with pytest.raises(RuntimeError, match="no active pass"):
+        for _ in trainer.shard_batches(per_worker):
+            pass

@@ -1,0 +1,33 @@
+#!/bin/bash
+# TPU-outage watchdog (VERDICT r2 next-round #1): the axon tunnel can be
+# down for hours AND flap mid-measurement; retry a cheap probe forever and
+# fire the full one-window measurement battery + bench the moment the chip
+# answers. Only stops once BOTH artifacts contain real TPU results — a
+# tunnel flap right after a good probe must not end the loop empty-handed.
+#
+# Run detached (nohup). Artifacts:
+#   tools/tpu_watch.log        — probe attempts
+#   tools/tpu_probe_out.jsonl  — stage battery (tools/tpu_probe.py)
+#   tools/bench_out.json       — bench.py line captured on the chip
+cd "$(dirname "$0")/.." || exit 1
+SLEEP="${TPU_WATCH_SLEEP:-540}"
+log() { echo "$(date -u +%FT%TZ) $*" >>tools/tpu_watch.log; }
+while true; do
+  if timeout 180 python bench.py --probe axon >/tmp/axon_probe.json 2>/dev/null \
+      && grep -q '"ok": true' /tmp/axon_probe.json; then
+    log "axon UP — running battery"
+    timeout 1800 python -u tools/tpu_probe.py >tools/tpu_probe_out.jsonl 2>&1
+    rc_probe=$?
+    timeout 900 python bench.py >tools/bench_out.json 2>&1
+    rc_bench=$?
+    if grep -q '"stage"' tools/tpu_probe_out.jsonl 2>/dev/null \
+        && grep -Eq '"platform": "(axon|tpu)"' tools/bench_out.json 2>/dev/null; then
+      log "battery done (probe rc=$rc_probe bench rc=$rc_bench) — TPU evidence captured"
+      break
+    fi
+    log "battery incomplete (probe rc=$rc_probe bench rc=$rc_bench) — retrying"
+  else
+    log "axon down"
+  fi
+  sleep "$SLEEP"
+done
