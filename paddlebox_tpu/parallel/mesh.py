@@ -23,6 +23,8 @@ from paddlebox_tpu.config.configs import MeshConfig
 
 # the 1D axis that is both data- and table-shard-parallel, like BoxPS
 BOX_AXIS = "dp"
+# the inter-node (DCN) axis of the hierarchical 2D mesh
+NODE_AXIS = "node"
 
 _distributed_initialized = False
 
@@ -70,6 +72,30 @@ def device_mesh_1d(n_devices: Optional[int] = None,
     if n > len(devs):
         raise ValueError(f"requested {n} devices, have {len(devs)}")
     return Mesh(np.array(devs[:n]), (axis,))
+
+
+def device_mesh_2d(n_nodes: Optional[int] = None,
+                   chips_per_node: Optional[int] = None,
+                   node_axis: str = NODE_AXIS,
+                   chip_axis: str = BOX_AXIS) -> Mesh:
+    """Hierarchical ("node", "chip") mesh: the chip axis rides ICI inside a
+    node, the node axis crosses DCN (the reference's intra-node NCCL ring +
+    inter-node SyncDense split, boxps_worker.cc:1169-1236). jax.devices()
+    orders devices by process, so with one process per node the node axis
+    aligns with process boundaries and XLA routes its collectives over
+    DCN exactly once per chip-sharded slice."""
+    devs = jax.devices()
+    if n_nodes is None:
+        n_nodes = max(1, jax.process_count())
+    if chips_per_node is None:
+        chips_per_node = len(devs) // n_nodes
+    need = n_nodes * chips_per_node
+    if need > len(devs) or chips_per_node < 1 or n_nodes < 1:
+        raise ValueError(
+            f"mesh needs {n_nodes} nodes x {chips_per_node} chips, "
+            f"have {len(devs)} devices")
+    return Mesh(np.array(devs[:need]).reshape(n_nodes, chips_per_node),
+                (node_axis, chip_axis))
 
 
 def make_mesh(cfg: MeshConfig) -> Mesh:

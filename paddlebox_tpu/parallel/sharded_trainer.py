@@ -71,7 +71,21 @@ class ShardedBoxTrainer:
         self.feed = feed
         self.mesh = mesh or device_mesh_1d()
         self.P = self.mesh.devices.size
-        self.axis = self.mesh.axis_names[0]
+        # 1D mesh: flat BoxPS topology. 2D ("node","chip") mesh
+        # (device_mesh_2d): data/table parallelism over ALL devices, but
+        # dense sync goes hierarchical — reduce-scatter on the chip (ICI)
+        # axis, psum on the node (DCN) axis, allgather back on chip — so
+        # DCN carries 1/chips_per_node of the gradient bytes instead of
+        # the full allreduce (SyncParam, boxps_worker.cc:1169-1236).
+        self.axes = tuple(self.mesh.axis_names)
+        self.hier = len(self.axes) > 1
+        if len(self.axes) > 2:
+            raise ValueError("ShardedBoxTrainer meshes are 1D or 2D "
+                             f"(node, chip); got axes {self.axes}")
+        # collectives over the whole device set use the flattened axis
+        # tuple; routing/batches shard dim 0 over it either way
+        self.axis = self.axes if self.hier else self.axes[0]
+        self.chips = int(self.mesh.shape[self.axes[-1]])
         self.fleet = fleet
         # multi-process topology: this process owns the mesh positions whose
         # device it hosts (per-node PS shard layout, box_wrapper.h:433-436)
@@ -116,16 +130,20 @@ class ShardedBoxTrainer:
         if self.sharding_mode:
             flat, _ = jax.flatten_util.ravel_pytree(self.params)
             self._n_dense = int(flat.size)
-            self._n_shard = -(-self._n_dense // Pn)  # ceil
-            sh = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
-            # hand-rolled Adam moments, partitioned [P, n/P]
+            # hier: moments partition over the chip axis only (per-rank-
+            # owned state within a node, boxps_worker.cc:582-751); nodes
+            # hold identical copies kept in sync by the node-psum'd grads
+            self._n_shard = -(-self._n_dense // (self.chips if self.hier
+                                                 else Pn))  # ceil
+            sh = NamedSharding(self.mesh, P(self.axis))
+            # hand-rolled Adam moments, partitioned [P, n/shards]
             self.opt_state = (
                 jax.device_put(np.zeros((Pn, self._n_shard), np.float32), sh),
                 jax.device_put(np.zeros((Pn, self._n_shard), np.float32), sh),
                 jnp.zeros((), jnp.int32))
         elif self.k_step > 1:
             # per-device param/optimizer replicas that diverge between syncs
-            sh = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+            sh = NamedSharding(self.mesh, P(self.axis))
             stack = lambda x: jax.device_put(
                 np.broadcast_to(np.asarray(x)[None],
                                 (Pn,) + np.asarray(x).shape).copy(), sh)
@@ -223,6 +241,10 @@ class ShardedBoxTrainer:
         use_cvm = self.use_cvm
         multi_task = self.multi_task
         axis = self.axis
+        hier = self.hier
+        chip_axis = self.axes[-1]          # ICI axis (the only axis in 1D)
+        node_axis = self.axes[0] if hier else None
+        chips = self.chips
         sharding_mode = self.sharding_mode
         k_step = self.k_step
         lr = self.cfg.dense_lr
@@ -274,6 +296,24 @@ class ShardedBoxTrainer:
                     model, params, emb, batch["segments"], batch["valid"],
                     B, S, use_cvm, batch.get("dense"))["dn_summary"]
 
+            def reduce_scatter_mean(flat_g):
+                """Grad sum → this device's 1/shards slice, averaged over
+                all Pn devices. Flat mesh: one psum_scatter over the axis.
+                Hierarchical: psum_scatter over chips (ICI), psum over
+                nodes — DCN carries only the scattered 1/chips slice (the
+                2-level SyncParam shape, boxps_worker.cc:1169-1236).
+                Returns (g_shard [n_shard], n_shard, pad)."""
+                n = flat_g.size
+                shards = chips if hier else Pn
+                n_shard = -(-n // shards)
+                pad = shards * n_shard - n
+                g_shard = jax.lax.psum_scatter(
+                    jnp.pad(flat_g, (0, pad)), chip_axis,
+                    scatter_dimension=0, tiled=True)
+                if hier:
+                    g_shard = jax.lax.psum(g_shard, node_axis)
+                return g_shard / Pn, n_shard, pad
+
             # ---- dense sync by mode
             loss = jax.lax.pmean(loss, axis)
             if sharding_mode:
@@ -284,12 +324,8 @@ class ShardedBoxTrainer:
                 flat_g, _ = jax.flatten_util.ravel_pytree(dparams)
                 flat_p, unravel = jax.flatten_util.ravel_pytree(params)
                 n = flat_p.size
-                n_shard = -(-n // Pn)
-                pad = Pn * n_shard - n
-                gpad = jnp.pad(flat_g, (0, pad))
-                g_shard = jax.lax.psum_scatter(
-                    gpad, axis, scatter_dimension=0, tiled=True) / Pn
-                i = jax.lax.axis_index(axis)
+                g_shard, n_shard, pad = reduce_scatter_mean(flat_g)
+                i = jax.lax.axis_index(chip_axis)
                 ppad = jnp.pad(flat_p, (0, pad))
                 p_shard = jax.lax.dynamic_slice(ppad, (i * n_shard,),
                                                 (n_shard,))
@@ -300,7 +336,8 @@ class ShardedBoxTrainer:
                 mhat = mu / (1.0 - jnp.power(0.9, tf))
                 vhat = nu / (1.0 - jnp.power(0.999, tf))
                 p_shard = p_shard - lr * mhat / (jnp.sqrt(vhat) + 1e-8)
-                flat_new = jax.lax.all_gather(p_shard, axis, tiled=True)[:n]
+                flat_new = jax.lax.all_gather(p_shard, chip_axis,
+                                              tiled=True)[:n]
                 params = unravel(flat_new)
                 opt_state = (mu[None], nu[None], t)
             elif k_step > 1:
@@ -313,8 +350,19 @@ class ShardedBoxTrainer:
                 params = jax.tree.map(lambda x: x[None], params)
                 opt_state = jax.tree.map(lambda x: x[None], opt_state)
             else:
-                # per-step data-parallel allreduce (SyncParam/NCCL)
-                dparams = jax.lax.pmean(dparams, axis)
+                if hier:
+                    # 2-level grad mean (numerically identical to the flat
+                    # pmean): scatter → node psum → allgather over chips
+                    flat_g, unravel_g = jax.flatten_util.ravel_pytree(
+                        dparams)
+                    n = flat_g.size
+                    g_sh, _, _ = reduce_scatter_mean(flat_g)
+                    flat_g = jax.lax.all_gather(
+                        g_sh, chip_axis, tiled=True)[:n]
+                    dparams = unravel_g(flat_g)
+                else:
+                    # per-step data-parallel allreduce (SyncParam/NCCL)
+                    dparams = jax.lax.pmean(dparams, axis)
                 updates, opt_state = self.dense_opt.update(
                     dparams, opt_state, params)
                 params = optax.apply_updates(params, updates)
@@ -549,43 +597,51 @@ class ShardedBoxTrainer:
         raw_steps = list(zip(*per_worker)) if per_worker[0] else []
         n_steps = len(raw_steps)
         # bounded stream: the stager routes + device_puts ahead of training
-        # (never the whole pass) — see shard_batches
+        # (never the whole pass) — see shard_batches. close() on ANY exit
+        # stops the stager thread; an abandoned one would race the next
+        # pass's table mutations from the daemon thread.
         stream = self.shard_batches(per_worker)
-        start_i = 0
-        chunk = max(1, self.cfg.scan_chunk)
-        if (self._scan_steps is not None and chunk > 1 and n_steps >= chunk):
-            from paddlebox_tpu.train.trainer import run_scan_chunks
+        try:
+            start_i = 0
+            chunk = max(1, self.cfg.scan_chunk)
+            if (self._scan_steps is not None and chunk > 1
+                    and n_steps >= chunk):
+                from paddlebox_tpu.train.trainer import run_scan_chunks
 
-            def on_chunk(lo, group, chunk_losses, preds):
-                if self.cfg.check_nan_inf and not np.isfinite(
-                        chunk_losses).all():
-                    raise FloatingPointError("nan/inf loss in scan chunk")
-                for j in range(len(group)):
-                    self._add_metrics({t: p[j] for t, p in preds.items()},
-                                      raw_steps[lo + j])
+                def on_chunk(lo, group, chunk_losses, preds):
+                    if self.cfg.check_nan_inf and not np.isfinite(
+                            chunk_losses).all():
+                        raise FloatingPointError("nan/inf loss in scan chunk")
+                    for j in range(len(group)):
+                        self._add_metrics(
+                            {t: p[j] for t, p in preds.items()},
+                            raw_steps[lo + j])
 
-            carry = (self._slabs, self.params, self.opt_state, self._prng)
-            carry, chunk_losses, start_i = run_scan_chunks(
-                self._scan_steps, stream, chunk,
-                lambda group: {k: jnp.stack([d[k] for d in group])
-                               for k in group[0]},
-                carry, on_chunk, timer=self.timers["step"], n_items=n_steps)
-            self._slabs, self.params, self.opt_state, self._prng = carry
-            losses.extend(chunk_losses)
-        for i, batch in enumerate(stream, start=start_i):
-            self.timers["step"].start()
-            (self._slabs, self.params, self.opt_state, loss, preds,
-             self._prng) = self._step(self._slabs, self.params,
-                                      self.opt_state, batch, self._prng)
-            self.timers["step"].pause()
-            losses.append(float(loss))
-            if self._param_sync is not None:
-                self._steps_since_sync += 1
-                if self._steps_since_sync >= self.k_step:
-                    self.params, self.opt_state = self._param_sync(
-                        self.params, self.opt_state)
-                    self._steps_since_sync = 0
-            self._add_metrics(preds, raw_steps[i])
+                carry = (self._slabs, self.params, self.opt_state, self._prng)
+                carry, chunk_losses, start_i = run_scan_chunks(
+                    self._scan_steps, stream, chunk,
+                    lambda group: {k: jnp.stack([d[k] for d in group])
+                                   for k in group[0]},
+                    carry, on_chunk, timer=self.timers["step"],
+                    n_items=n_steps)
+                self._slabs, self.params, self.opt_state, self._prng = carry
+                losses.extend(chunk_losses)
+            for i, batch in enumerate(stream, start=start_i):
+                self.timers["step"].start()
+                (self._slabs, self.params, self.opt_state, loss, preds,
+                 self._prng) = self._step(self._slabs, self.params,
+                                          self.opt_state, batch, self._prng)
+                self.timers["step"].pause()
+                losses.append(float(loss))
+                if self._param_sync is not None:
+                    self._steps_since_sync += 1
+                    if self._steps_since_sync >= self.k_step:
+                        self.params, self.opt_state = self._param_sync(
+                            self.params, self.opt_state)
+                        self._steps_since_sync = 0
+                self._add_metrics(preds, raw_steps[i])
+        finally:
+            stream.close()
         if self._param_sync is not None and self._steps_since_sync:
             # pass boundary is always a sync point
             self.params, self.opt_state = self._param_sync(
@@ -666,15 +722,20 @@ class ShardedBoxTrainer:
             main_task = (self.model.task_names[0] if self.multi_task
                          else None)
             preds_all, labels_all = [], []
-            for i, batch in enumerate(self.shard_batches(per_worker)):
-                preds = self._eval_step(slabs, self.params, batch)
-                key = main_task if main_task is not None else list(preds)[0]
-                main = self._local_rows(preds[key]).reshape(nw, -1)
-                for w, b in enumerate(raw_steps[i]):
-                    if i >= real_batches[w]:
-                        continue  # wrapped duplicate batch
-                    preds_all.append(main[w][b.ins_valid])
-                    labels_all.append(b.labels[b.ins_valid])
+            stream = self.shard_batches(per_worker)
+            try:
+                for i, batch in enumerate(stream):
+                    preds = self._eval_step(slabs, self.params, batch)
+                    key = (main_task if main_task is not None
+                           else list(preds)[0])
+                    main = self._local_rows(preds[key]).reshape(nw, -1)
+                    for w, b in enumerate(raw_steps[i]):
+                        if i >= real_batches[w]:
+                            continue  # wrapped duplicate batch
+                        preds_all.append(main[w][b.ins_valid])
+                        labels_all.append(b.labels[b.ins_valid])
+            finally:
+                stream.close()
         finally:
             self.table.set_test_mode(False)
         if not preds_all:

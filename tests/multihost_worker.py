@@ -36,7 +36,7 @@ def main() -> None:
     from paddlebox_tpu.fleet.fleet import fleet
     from paddlebox_tpu.models import CtrDnn
     from paddlebox_tpu.models.base import ModelSpec
-    from paddlebox_tpu.parallel.mesh import device_mesh_1d
+    from paddlebox_tpu.parallel.mesh import device_mesh_1d, device_mesh_2d
     from paddlebox_tpu.parallel.sharded_trainer import ShardedBoxTrainer
 
     cfg = json.loads(sys.argv[1])
@@ -70,11 +70,17 @@ def main() -> None:
                                         mf_initial_range=1e-3,
                                         feature_learning_rate=0.1,
                                         mf_learning_rate=0.1))
+    # mesh_2d: the node axis spans the two processes (real DCN boundary)
+    # and the chip axis the 4 in-process devices — hierarchical dense sync
+    mesh = (device_mesh_2d(2, 4) if cfg.get("mesh_2d")
+            else device_mesh_1d(8))
     trainer = ShardedBoxTrainer(
         CtrDnn(ModelSpec(num_slots=cfg["num_slots"], slot_dim=3 + D),
                hidden=(32, 16)),
-        table_cfg, feed, TrainerConfig(dense_lr=0.01),
-        mesh=device_mesh_1d(8), seed=0, fleet=fleet,
+        table_cfg, feed,
+        TrainerConfig(dense_lr=0.01,
+                      sync_mode=cfg.get("sync_mode", "step")),
+        mesh=mesh, seed=0, fleet=fleet,
         store_factory=store_factory)
     trainer.metrics.init_metric("auc", "label", "pred",
                                 table_size=1 << 14, mask_var="mask")

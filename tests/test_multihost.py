@@ -190,3 +190,30 @@ def test_two_process_gpups_over_central_ps(data):
     finally:
         admin.stop_server()
         admin.close()
+
+
+def test_two_process_hierarchical_mesh(data):
+    """2D ("node","chip") mesh across the REAL process boundary (VERDICT
+    r2 #4): node axis = the 2 processes (DCN), chip axis = each process's
+    4 devices (ICI). Hierarchical dense sync must reproduce the flat-mesh
+    single-process oracle."""
+    files, feed = data
+    ref_losses, ref_msg, ref_rows = run_single_process_oracle(files, feed)
+    results = run_two_process_cluster(files, {"mesh_2d": True})
+
+    assert set(results) == {0, 1}
+    np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(results[0]["losses"], ref_losses, rtol=1e-4,
+                               err_msg="2D-mesh cluster diverges from the "
+                                       "flat single-process oracle")
+    np.testing.assert_allclose(results[0]["auc"], ref_msg["auc"], rtol=1e-6)
+    merged_rows = {**results[0]["rows"], **results[1]["rows"]}
+    checked = 0
+    for k, v in merged_rows.items():
+        if k in ref_rows:
+            np.testing.assert_allclose(np.asarray(v), ref_rows[k],
+                                       rtol=1e-4, atol=1e-6,
+                                       err_msg=f"row mismatch key {k}")
+            checked += 1
+    assert checked >= 8, f"only {checked} rows overlapped"

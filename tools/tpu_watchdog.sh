@@ -16,9 +16,12 @@ while true; do
   if timeout 180 python bench.py --probe axon >/tmp/axon_probe.json 2>/dev/null \
       && grep -q '"ok": true' /tmp/axon_probe.json; then
     log "axon UP — running battery"
-    timeout 1800 python -u tools/tpu_probe.py >tools/tpu_probe_out.jsonl 2>&1
+    # stderr goes to the log, NOT the artifacts — a stray warning line
+    # would make the captured .json/.jsonl unparseable
+    timeout 1800 python -u tools/tpu_probe.py >tools/tpu_probe_out.jsonl \
+      2>>tools/tpu_watch.log
     rc_probe=$?
-    timeout 900 python bench.py >tools/bench_out.json 2>&1
+    timeout 900 python bench.py >tools/bench_out.json 2>>tools/tpu_watch.log
     rc_bench=$?
     if grep -q '"stage"' tools/tpu_probe_out.jsonl 2>/dev/null \
         && grep -Eq '"platform": "(axon|tpu)"' tools/bench_out.json 2>/dev/null; then
