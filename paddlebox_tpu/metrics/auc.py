@@ -35,10 +35,22 @@ class BasicAucCalculator:
 
     def __init__(self, table_size: int = 1 << 20,
                  mode_collect_in_device: bool = False) -> None:
+        """mode_collect_in_device (metrics.h:776): the trainer accumulates
+        the [2, table_size] bucket table ON DEVICE inside the jitted step
+        and merges it here once per pass via add_bucket_stats — no
+        per-step pred D2H. Off: per-batch host adds (add_data)."""
         self._mode_collect_in_device = mode_collect_in_device
         self._lock = threading.Lock()
         self._table_size = 0
         self.init(table_size)
+
+    @property
+    def mode_collect_in_device(self) -> bool:
+        return self._mode_collect_in_device
+
+    @property
+    def table_size(self) -> int:
+        return self._table_size
 
     # ------------------------------------------------------------------ init
     def init(self, table_size: int, max_batch_size: int = 0) -> None:
@@ -133,6 +145,26 @@ class BasicAucCalculator:
             self._local_pred += float(pred.sum())
             self._local_label += float(label.sum())
             self._local_total_num += float(pred.size)
+
+    def add_bucket_stats(self, table: np.ndarray, abserr: float,
+                         sqrerr: float, pred_sum: float, label_sum: float,
+                         n: float) -> None:
+        """Merge a device-accumulated bucket table + scalar accumulators
+        (the mode_collect_in_device ingest path: the jitted step built
+        table[0]=neg counts, table[1]=pos counts by bucketing preds
+        on-device — metrics.h:776 / metrics.cc add-data kernels — and this
+        merges ONE pass's psum'd result instead of per-step adds)."""
+        table = np.asarray(table, dtype=np.float64)
+        if table.shape != (2, self._table_size):
+            raise ValueError(f"bucket table shape {table.shape} != "
+                             f"(2, {self._table_size})")
+        with self._lock:
+            self._table += table
+            self._local_abserr += float(abserr)
+            self._local_sqrerr += float(sqrerr)
+            self._local_pred += float(pred_sum)
+            self._local_label += float(label_sum)
+            self._local_total_num += float(n)
 
     def add_nan_inf_data(self, pred) -> None:
         pred = np.asarray(pred, dtype=np.float64).reshape(-1)
@@ -416,7 +448,8 @@ class MetricMsg:
     def __init__(self, label_var: str, pred_var: str, name: str,
                  metric_phase: int = -1, table_size: int = 1 << 20,
                  mask_var: str = "", uid_var: str = "",
-                 sample_scale_var: str = "", kind: str = "auc") -> None:
+                 sample_scale_var: str = "", kind: str = "auc",
+                 mode_collect_in_device: bool = False) -> None:
         self.name = name
         self.label_var = label_var
         self.pred_var = pred_var
@@ -425,7 +458,8 @@ class MetricMsg:
         self.sample_scale_var = sample_scale_var
         self.metric_phase = metric_phase
         self.kind = kind
-        self.calculator = BasicAucCalculator(table_size)
+        self.calculator = BasicAucCalculator(table_size,
+                                             mode_collect_in_device)
 
     def add_from(self, tensors: Dict[str, np.ndarray]) -> None:
         pred = tensors[self.pred_var]
@@ -608,6 +642,10 @@ class MetricRegistry:
 
     def metric_names(self) -> List[str]:
         return list(self._metrics)
+
+    def messages(self) -> List["MetricMsg"]:
+        """All registered MetricMsg objects (public iteration surface)."""
+        return list(self._metrics.values())
 
     def get(self, name: str) -> MetricMsg:
         return self._metrics[name]

@@ -159,19 +159,78 @@ class ShardedBoxTrainer:
         self._prng = jax.random.PRNGKey(seed + 17)
         self._shuffle_rng = np.random.RandomState(seed + 1)
         self.timers = {n: Timer() for n in ("step", "pass", "build")}
-        self._step = self._build_step()
+        # device-side metric collection (metrics.h:776): decided per pass
+        # from the registered metrics' mode_collect_in_device flags; the
+        # step is rebuilt when the mode flips (_sync_collect_mode)
+        self._collect_T: Optional[int] = None
         self._eval_step = None  # built lazily on first predict_batches
         self._param_sync = (self._build_param_sync() if self.k_step > 1
                             else None)
         self._steps_since_sync = 0
-        # megastep: scan a chunk of steps inside one dispatch (k_step mode
-        # keeps per-step dispatch so the host can interleave param syncs;
-        # multi-process keeps per-step dispatch so metrics read only
-        # addressable shards)
+        self._rebuild_fns()
+
+    def _rebuild_fns(self) -> None:
+        """(Re)build the jitted step + megastep for the current device-
+        collect mode. Megastep: scan a chunk of steps inside one dispatch
+        (k_step mode keeps per-step dispatch so the host can interleave
+        param syncs; multi-process keeps per-step dispatch so metrics read
+        only addressable shards). The metric state rides the scan carry
+        (extra_carry=2) so collect mode costs no extra dispatches."""
         from paddlebox_tpu.train.trainer import make_scan
-        self._scan_steps = (make_scan(self._step)
+        self._step = self._build_step()
+        self._scan_steps = (make_scan(self._step, extra_carry=2)
                             if self.k_step == 1 and not self.multiprocess
                             else None)
+
+    def make_metric_state(self):
+        """Per-pass device metric state (mtab, mstats) for the CURRENT
+        collect mode — the one source of truth for its layout (train_pass
+        and the driver dryrun both build it here).
+
+        mtab  [L, 2, T] int32: per-device neg/pos bucket counts (int32 —
+              exact to 2^31; float32 would silently saturate at 2^24).
+        mstats [L, 2, 5] float32: Kahan-compensated (sum, c) running sums
+              of (abserr, sqrerr, pred_sum, label_sum, count) — the
+              compensation keeps a pass-long f32 accumulation within ~2
+              ulps where a plain f32 sum loses all sub-2^-24 increments.
+        Dummy T=1 tables when collection is off (the step passes them
+        through)."""
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        L = self.n_local if self.multiprocess else self.P
+        T = self._collect_T or 1
+        mtab = self._put_sharded(np.zeros((L, 2, T), np.int32), sharding)
+        mstats = self._put_sharded(np.zeros((L, 2, 5), np.float32),
+                                   sharding)
+        return mtab, mstats
+
+    def _device_collect_size(self) -> Optional[int]:
+        """table_size when EVERY registered metric can be collected on
+        device: plain single-task AUC over the standard (pred, label,
+        mask) tensors, all-phase, with mode_collect_in_device set — else
+        None and the host path serves everything (a mixed mode would
+        double-count the collectable subset)."""
+        from paddlebox_tpu.metrics.auc import MetricMsg
+        msgs = self.metrics.messages()
+        if not msgs or self.multi_task:
+            return None
+        sizes = set()
+        for m in msgs:
+            c = getattr(m, "calculator", None)
+            if (type(m) is not MetricMsg or m.kind != "auc"
+                    or m.sample_scale_var or m.uid_var
+                    or m.metric_phase != -1
+                    or m.label_var != "label" or m.pred_var != "pred"
+                    or m.mask_var != "mask"
+                    or c is None or not c.mode_collect_in_device):
+                return None
+            sizes.add(c.table_size)
+        return sizes.pop() if len(sizes) == 1 else None
+
+    def _sync_collect_mode(self) -> None:
+        T = self._device_collect_size()
+        if T != self._collect_T:
+            self._collect_T = T
+            self._rebuild_fns()
 
     # ------------------------------------------------------------ jit step
     def _pull_and_forward(self):
@@ -250,9 +309,10 @@ class ShardedBoxTrainer:
         lr = self.cfg.dense_lr
         has_summary = (getattr(model, "use_data_norm", False)
                        and hasattr(model, "update_summary"))
+        collect_T = self._collect_T
         pull_emb, forward_logits, preds_of = self._pull_and_forward()
 
-        def shard_step(slab, params, opt_state, batch, prng):
+        def shard_step(slab, params, opt_state, batch, prng, mtab, mstats):
             # per-device views: slab [1, C, W]; batch leaves [1, ...]
             slab = slab[0]
             batch = jax.tree.map(lambda x: x[0], batch)
@@ -402,7 +462,34 @@ class ShardedBoxTrainer:
                 slab = push_sparse_dedup(slab, req.reshape(-1),
                                          recv_g.reshape(Pn * KB, -1), prng,
                                          layout, conf)
-            return slab[None], params, opt_state, loss, preds, next_prng
+
+            if collect_T is not None:
+                # device-side AUC collection (mode_collect_in_gpu,
+                # metrics.h:776): bucket this device's preds into its
+                # int32 [2, T] table + Kahan-compensated error sums —
+                # preds never leave the device; the host merges ONE table
+                # per pass (see make_metric_state for the layout/precision
+                # rationale)
+                tab, st = mtab[0], mstats[0]
+                p = jnp.clip(preds["ctr"].astype(jnp.float32), 0.0, 1.0)
+                lab = batch["labels"].astype(jnp.int32)
+                w = batch["ins_valid"].astype(jnp.float32)
+                wi = batch["ins_valid"].astype(jnp.int32)
+                pos = jnp.minimum((p * collect_T).astype(jnp.int32),
+                                  collect_T - 1)
+                tab = tab.at[lab, pos].add(wi)
+                labf = lab.astype(jnp.float32)
+                err = p - labf
+                batch_sums = jnp.stack([
+                    (jnp.abs(err) * w).sum(), (err * err * w).sum(),
+                    (p * w).sum(), (labf * w).sum(), w.sum()])
+                s, c = st[0], st[1]
+                y = batch_sums - c
+                t_sum = s + y
+                c = (t_sum - s) - y
+                mtab, mstats = tab[None], jnp.stack([t_sum, c])[None]
+            return (slab[None], params, opt_state, loss, preds, next_prng,
+                    mtab, mstats)
 
         spec_sh = P(self.axis)
         spec_rep = P()
@@ -419,12 +506,13 @@ class ShardedBoxTrainer:
             par_in = par_out = spec_rep
         fn = jax.shard_map(
             shard_step, mesh=self.mesh,
-            in_specs=(spec_sh, par_in, opt_in, spec_sh, spec_rep),
+            in_specs=(spec_sh, par_in, opt_in, spec_sh, spec_rep, spec_sh,
+                      spec_sh),
             out_specs=(spec_sh, par_out, opt_out, spec_rep, spec_sh,
-                       spec_rep),
+                       spec_rep, spec_sh, spec_sh),
             check_vma=False)
-        # slabs donated: one live copy of the (huge) pass slab per device
-        return jax.jit(fn, donate_argnums=(0,))
+        # slabs + metric state donated: one live copy each on device
+        return jax.jit(fn, donate_argnums=(0, 5, 6))
 
     def _build_param_sync(self):
         """K-step dense sync: allreduce-mean the diverged per-device param
@@ -577,6 +665,7 @@ class ShardedBoxTrainer:
                    preloaded: bool = False) -> Dict[str, float]:
         t_pass = self.timers["pass"]
         t_pass.start()
+        self._sync_collect_mode()
         allgather = (self.fleet.all_gather if self.multiprocess else None)
         if not preloaded:
             self.table.begin_feed_pass()
@@ -596,6 +685,9 @@ class ShardedBoxTrainer:
         losses = []
         raw_steps = list(zip(*per_worker)) if per_worker[0] else []
         n_steps = len(raw_steps)
+        # per-device metric state for THIS pass (dummies when device
+        # collection is off — the step passes them through)
+        mtab, mstats = self.make_metric_state()
         # bounded stream: the stager routes + device_puts ahead of training
         # (never the whole pass) — see shard_batches. close() on ANY exit
         # stops the stager thread; an abandoned one would race the next
@@ -617,20 +709,31 @@ class ShardedBoxTrainer:
                             {t: p[j] for t, p in preds.items()},
                             raw_steps[lo + j])
 
-                carry = (self._slabs, self.params, self.opt_state, self._prng)
+                def scan_call(carry, stacked):
+                    (slabs, params, opt_state, losses_d, preds, prng, mt,
+                     ms) = self._scan_steps(carry[0], carry[1], carry[2],
+                                            stacked, carry[3], carry[4],
+                                            carry[5])
+                    return ((slabs, params, opt_state, prng, mt, ms),
+                            losses_d, preds)
+
+                carry = (self._slabs, self.params, self.opt_state,
+                         self._prng, mtab, mstats)
                 carry, chunk_losses, start_i = run_scan_chunks(
-                    self._scan_steps, stream, chunk,
+                    scan_call, stream, chunk,
                     lambda group: {k: jnp.stack([d[k] for d in group])
                                    for k in group[0]},
                     carry, on_chunk, timer=self.timers["step"],
                     n_items=n_steps)
-                self._slabs, self.params, self.opt_state, self._prng = carry
+                (self._slabs, self.params, self.opt_state, self._prng,
+                 mtab, mstats) = carry
                 losses.extend(chunk_losses)
             for i, batch in enumerate(stream, start=start_i):
                 self.timers["step"].start()
                 (self._slabs, self.params, self.opt_state, loss, preds,
-                 self._prng) = self._step(self._slabs, self.params,
-                                          self.opt_state, batch, self._prng)
+                 self._prng, mtab, mstats) = self._step(
+                    self._slabs, self.params, self.opt_state, batch,
+                    self._prng, mtab, mstats)
                 self.timers["step"].pause()
                 losses.append(float(loss))
                 if self._param_sync is not None:
@@ -642,6 +745,17 @@ class ShardedBoxTrainer:
                 self._add_metrics(preds, raw_steps[i])
         finally:
             stream.close()
+        if self._collect_T:
+            # ONE D2H per pass: sum this process's device tables and merge
+            # into every (device-collectable) calculator; cross-process
+            # reduction stays in get_metric_msg's allreduce. Kahan pairs
+            # resolve as s - c (c holds the uncorrected excess of the last
+            # add).
+            tab = self._local_rows(mtab).sum(axis=0).astype(np.float64)
+            st = self._local_rows(mstats).astype(np.float64)
+            sums = (st[:, 0, :] - st[:, 1, :]).sum(axis=0)
+            for m in self.metrics.messages():
+                m.calculator.add_bucket_stats(tab, *sums)
         if self._param_sync is not None and self._steps_since_sync:
             # pass boundary is always a sync point
             self.params, self.opt_state = self._param_sync(
@@ -769,6 +883,10 @@ class ShardedBoxTrainer:
         in get_metric_msg via the fleet allreduce hook (the reference's
         box MPI allreduce in Metric::calculate)."""
         if not self.metrics.metric_names():
+            return
+        if self._collect_T:
+            # device-collect mode: the jitted step already bucketed this
+            # batch on device — touching preds here would D2H them
             return
         # pytree dicts come back key-SORTED across the jit boundary, so
         # the main task is named explicitly, not taken positionally

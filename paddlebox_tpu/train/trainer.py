@@ -64,28 +64,34 @@ class TrainStepFns:
     dn_update: Optional[Callable] = None        # (params, emb, batch) -> params
 
 
-def make_scan(step_fn: Callable) -> Callable:
-    """Wrap a (slab, params, opt_state, batch, prng) step into a jitted
-    megastep scanning a leading chunk axis of `stacked` — one dispatch runs
-    the whole chunk back-to-back on device, hiding per-step dispatch
-    latency."""
+def make_scan(step_fn: Callable, extra_carry: int = 0) -> Callable:
+    """Wrap a (slab, params, opt_state, batch, prng, *extra) step into a
+    jitted megastep scanning a leading chunk axis of `stacked` — one
+    dispatch runs the whole chunk back-to-back on device, hiding per-step
+    dispatch latency.
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def scan_steps(slab, params, opt_state, stacked, prng):
+    extra_carry: number of additional state leaves threaded through the
+    scan after prng (the sharded trainer's device metric state rides here;
+    they are donated like the slab)."""
+
+    @functools.partial(jax.jit,
+                       donate_argnums=(0, *range(5, 5 + extra_carry)))
+    def scan_steps(slab, params, opt_state, stacked, prng, *extra):
         def body(carry, batch):
-            slab, params, opt_state, prng = carry
-            slab, params, opt_state, loss, preds, prng = step_fn(
-                slab, params, opt_state, batch, prng)
-            return (slab, params, opt_state, prng), (loss, preds)
+            slab, params, opt_state, prng, *extra = carry
+            slab, params, opt_state, loss, preds, prng, *extra = step_fn(
+                slab, params, opt_state, batch, prng, *extra)
+            return (slab, params, opt_state, prng, *extra), (loss, preds)
 
-        (slab, params, opt_state, prng), (losses, preds) = jax.lax.scan(
-            body, (slab, params, opt_state, prng), stacked)
-        return slab, params, opt_state, losses, preds, prng
+        carry = (slab, params, opt_state, prng, *extra)
+        carry, (losses, preds) = jax.lax.scan(body, carry, stacked)
+        slab, params, opt_state, prng, *extra = carry
+        return (slab, params, opt_state, losses, preds, prng, *extra)
 
     return scan_steps
 
 
-def run_scan_chunks(scan_fn: Callable, items, chunk: int,
+def run_scan_chunks(scan_call: Callable, items, chunk: int,
                     stack_fn: Callable, carry: Tuple,
                     on_chunk: Callable, timer=None,
                     n_items: Optional[int] = None):
@@ -100,9 +106,11 @@ def run_scan_chunks(scan_fn: Callable, items, chunk: int,
     pulled either way, so the caller's per-step loop may continue from the
     same iterator (or from items[n_consumed:]).
 
-    carry = (slab(s), params, opt_state, prng) threaded through scan_fn;
-    on_chunk(lo, group, losses_np, preds) handles metrics/dump/nan per
-    trainer. Returns (carry, losses, n_consumed)."""
+    scan_call(carry, stacked) -> (carry, losses_dev, preds_dev) dispatches
+    one chunk; the carry tuple is opaque to this driver (each trainer
+    threads whatever state its scan needs). on_chunk(lo, group, losses_np,
+    preds) handles metrics/dump/nan per trainer.
+    Returns (carry, losses, n_consumed)."""
     losses_all: List[float] = []
     if n_items is None:
         n_items = len(items)
@@ -121,11 +129,9 @@ def run_scan_chunks(scan_fn: Callable, items, chunk: int,
         stacked = stack_fn(group)               # host work ∥ device compute
         if timer is not None:
             timer.start()
-        slab, params, opt_state, losses, preds, prng = scan_fn(
-            carry[0], carry[1], carry[2], stacked, carry[3])
+        carry, losses, preds = scan_call(carry, stacked)
         if timer is not None:
             timer.pause()
-        carry = (slab, params, opt_state, prng)
         if pending is not None:
             drain(pending)
         pending = (lo, group, losses, preds)
@@ -604,9 +610,15 @@ class BoxTrainer:
                     if self.dump_writer is not None:
                         self._dump_batch(preds_j, b)
 
+            def scan_call(carry, stacked):
+                slab, params, opt_state, losses, preds, prng = \
+                    self.fns.scan_steps(carry[0], carry[1], carry[2],
+                                        stacked, carry[3])
+                return (slab, params, opt_state, prng), losses, preds
+
             carry = (self.table.slab, self.params, self.opt_state, prng)
             carry, chunk_losses, n_done = run_scan_chunks(
-                self.fns.scan_steps, pending, chunk, self._stack_batches,
+                scan_call, pending, chunk, self._stack_batches,
                 carry, on_chunk, timer=self.timers["step"])
             slab, self.params, self.opt_state, prng = carry
             self.table.set_slab(slab)

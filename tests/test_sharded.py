@@ -379,3 +379,48 @@ def test_hierarchical_mesh_matches_flat(sharded_setup, mode):
     for a, b in zip(params_flat, params_2d):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
     np.testing.assert_allclose(rows_flat, rows_2d, rtol=1e-4, atol=1e-6)
+
+
+def test_device_collect_auc_parity(sharded_setup):
+    """mode_collect_in_device (VERDICT r2 #5): the [2, T] AUC bucket table
+    accumulated INSIDE the jitted step (scatter-add, merged once per pass)
+    must reproduce the host calculator, with the per-step pred D2H
+    eliminated (host-row fetches drop from one per step to two per pass —
+    the table + stats merge)."""
+    files, feed = sharded_setup
+
+    def run(collect):
+        trainer = make_sharded_trainer(feed)
+        trainer.metrics.init_metric(
+            "auc", "label", "pred", table_size=1 << 12, mask_var="mask",
+            mode_collect_in_device=collect)
+        fetches = {"n": 0}
+        orig = trainer._local_rows
+
+        def counting_local_rows(arr):
+            fetches["n"] += 1
+            return orig(arr)
+
+        trainer._local_rows = counting_local_rows
+        n_steps = 0
+        for _ in range(3):
+            ds = BoxDataset(feed, read_threads=1)
+            ds.set_filelist(files)
+            n_steps += trainer.train_pass(ds)["batches"]
+            ds.release_memory()
+        msg = trainer.metrics.get_metric_msg("auc")
+        return msg, fetches["n"], n_steps
+
+    msg_host, fetches_host, n_steps = run(False)
+    msg_dev, fetches_dev, _ = run(True)
+    # host mode: >= 1 pred fetch per step (+1 per extra pred tensor);
+    # collect mode: exactly 2 per pass (table + stats), preds untouched
+    assert fetches_host >= n_steps, (fetches_host, n_steps)
+    assert fetches_dev == 2 * 3, fetches_dev
+    assert msg_dev["size"] == msg_host["size"]
+    np.testing.assert_allclose(msg_dev["auc"], msg_host["auc"], rtol=2e-3)
+    for k in ("mae", "rmse", "actual_ctr", "predicted_ctr"):
+        np.testing.assert_allclose(msg_dev[k], msg_host[k], rtol=1e-4,
+                                   err_msg=k)
+    np.testing.assert_allclose(msg_dev["bucket_error"],
+                               msg_host["bucket_error"], atol=5e-3)
