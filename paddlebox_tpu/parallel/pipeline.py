@@ -50,32 +50,48 @@ def mlp_stage_apply(params: Dict[str, jax.Array], x: jax.Array) -> jax.Array:
 
 
 def _spmd_pipeline(stage_apply: Callable, n_stages: int, n_micro: int,
-                   axis: str):
-    """Per-device GPipe schedule. Inputs arrive replicated [M, mb, d];
-    stage params are this device's slice. Returns replicated [M, mb, d]."""
+                   axis: str, ingest: Optional[Callable] = None,
+                   emit: Optional[Callable] = None):
+    """Per-device GPipe schedule — the ONE implementation of the
+    clamped-ingest / masked-emit / ppermute-ring scan (keep fixes here;
+    both the toy MLP runner and the CTR program split use it).
 
-    def run(stage_params, micro_inputs):
+    inputs: a pytree with leading micro axis [M, ...] (default: the array
+    of stage-0 activations). ingest(stage_params, inputs, tm) -> [mb, d]
+    builds stage 0's injection for micro tm (the CTR embedding section);
+    emit(stage_params, y) maps the last stage's block output to the
+    collected per-micro output (default identity; the CTR head).
+    Returns replicated [M, *emit_shape]."""
+
+    ingest_fn = ingest or (lambda p, inp, tm: inp[tm])
+    emit_fn = emit or (lambda p, y: y)
+
+    def run(stage_params, inputs):
         S, M = n_stages, n_micro
         idx = jax.lax.axis_index(axis)
         is_first = idx == 0
         is_last = idx == S - 1
-        mb, d = micro_inputs.shape[1], micro_inputs.shape[2]
-        state0 = jnp.zeros((mb, d), micro_inputs.dtype)
-        out0 = jnp.zeros((M, mb, d), micro_inputs.dtype)
+        x_sh = jax.eval_shape(ingest_fn, stage_params, inputs, 0)
+        state0 = jnp.zeros(x_sh.shape, x_sh.dtype)
+        y_sh = jax.eval_shape(stage_apply, stage_params, state0)
+        e_sh = jax.eval_shape(emit_fn, stage_params,
+                              jnp.zeros(y_sh.shape, y_sh.dtype))
+        out0 = jnp.zeros((M,) + e_sh.shape, e_sh.dtype)
         perm = [(i, (i + 1) % S) for i in range(S)]
 
         def tick(carry, t):
             state, out_buf = carry
             # stage 0 ingests micro-batch t (clamped; extra ticks are
             # pipeline drain and their stage-0 output is never collected)
-            x_in = micro_inputs[jnp.minimum(t, M - 1)]
+            x_in = ingest_fn(stage_params, inputs, jnp.minimum(t, M - 1))
             state = jnp.where(is_first, x_in, state)
             y = stage_apply(stage_params, state)
             # last stage emits micro-batch t-(S-1) once the pipe is full
             widx = jnp.maximum(t - (S - 1), 0)
-            emit = (t >= S - 1) & is_last
+            emit_now = (t >= S - 1) & is_last
             out_buf = out_buf.at[widx].set(
-                jnp.where(emit, y, out_buf[widx]))
+                jnp.where(emit_now, emit_fn(stage_params, y),
+                          out_buf[widx]))
             state = jax.lax.ppermute(y, axis, perm)
             return (state, out_buf), None
 
@@ -211,3 +227,237 @@ class GPipeRunner:
             p = jax.tree.map(lambda a: jnp.asarray(a[s]), params_host)
             out = self.stage_apply(p, out)
         return out
+
+
+class CtrPipelineRunner:
+    """Pipeline-parallel training of a REAL CTR model (program split).
+
+    The capability the toy GPipeRunner only sketches: the reference cuts
+    the actual training program into sections (BoxPSOptimizer cut_list,
+    python/paddle/fluid/optimizer.py:7496-7575) and runs them as a
+    micro-batch pipeline (section_worker.cc; HeterPipelineTrainer,
+    trainer.h:341). Here the cut is:
+
+      stage 0        sparse pull view → fused seqpool+CVM → input
+                     projection (the embedding section)
+      every stage    its own block of the deep relu tower
+      last stage     sigmoid head + loss
+
+    One SPMD scan+ppermute program runs the M+S-1 GPipe ticks; jax.grad
+    transposes it into the reverse pipeline, so the loss gradient flows
+    back across the stages into stage 0's pull and from there into the
+    in-table sparse optimizer — the single-chip fused step's push
+    semantics (build_push_grads + push_sparse_dedup), now fed through a
+    multi-stage pipeline.
+
+    Pass-table composition: the slab rides the step REPLICATED over the
+    stage axis. Only stage 0's pull carries gradient; the psum of the
+    embedding cotangent makes every device apply the identical push, so
+    the slab replicas never diverge (tests assert parity with a
+    sequential single-chip oracle).
+    """
+
+    def __init__(self, table_cfg, feed, n_stages: int = 2,
+                 d_model: int = 32, layers_per_stage: int = 1,
+                 lr: float = 1e-2, n_micro: Optional[int] = None,
+                 use_cvm: bool = True, mesh: Optional[Mesh] = None,
+                 seed: int = 0):
+        from paddlebox_tpu.embedding.pass_table import PassTable
+        self.table = PassTable(table_cfg, seed=seed)
+        self.table_cfg = table_cfg
+        self.feed = feed
+        self.layout = self.table.layout
+        self.num_slots = len(feed.used_sparse_slots())
+        self.mb = feed.batch_size          # one PackedBatch = one micro-batch
+        self.use_cvm = use_cvm
+        self.n_micro = n_micro or 2 * n_stages
+        if mesh is None:
+            devs = np.array(jax.devices()[:n_stages])
+            mesh = Mesh(devs, (STAGE_AXIS,))
+        if mesh.devices.size != n_stages:
+            raise ValueError("mesh size %d != n_stages %d"
+                             % (mesh.devices.size, n_stages))
+        self.mesh = mesh
+        self.axis = mesh.axis_names[0]
+        D = table_cfg.embedx_dim
+        slot_dim = (3 + D) if use_cvm else (1 + D)
+        pooled_dim = self.num_slots * slot_dim
+        S, L = n_stages, layers_per_stage
+        rng = np.random.RandomState(seed)
+        scale = 0.1
+        host_params = {
+            # stacked [S, ...]: each device materialises one stage's slice;
+            # proj is live on stage 0 only, head on the last only (their
+            # other slices get zero grads and never influence the logits)
+            "proj_w": (scale * rng.randn(S, pooled_dim, d_model)
+                       ).astype(np.float32),
+            "proj_b": np.zeros((S, d_model), np.float32),
+            "blk_w": (scale * rng.randn(S, L, d_model, d_model)
+                      ).astype(np.float32),
+            "blk_b": np.zeros((S, L, d_model), np.float32),
+            "head_w": (scale * rng.randn(S, d_model)).astype(np.float32),
+            "head_b": np.zeros((S,), np.float32),
+        }
+        sh = NamedSharding(mesh, P(self.axis))
+        self.params = {k: jax.device_put(v, sh)
+                       for k, v in host_params.items()}
+        self.opt = optax.adam(lr)
+        host_opt = self.opt.init(host_params)
+        self.opt_state = jax.tree.map(
+            lambda x: (jax.device_put(jnp.asarray(x), sh)
+                       if getattr(x, "ndim", 0) else jnp.asarray(x)),
+            host_opt)
+        self._prng = jax.random.PRNGKey(seed + 31)
+        self._step = self._build_step()
+
+    # ------------------------------------------------------------- jit step
+    def _build_step(self):
+        from paddlebox_tpu.embedding.optimizers import push_sparse_dedup
+        from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
+        from paddlebox_tpu.ops.sparse import build_push_grads, pull_sparse
+
+        S = self.mesh.devices.size
+        M, mb = self.n_micro, self.mb
+        num_slots, use_cvm = self.num_slots, self.use_cvm
+        layout, conf = self.layout, self.table_cfg.optimizer
+        axis = self.axis
+        opt = self.opt
+        pad_id = self.table_cfg.pass_capacity - 1
+        # which opt-state leaves carry the [S, ...] stage axis (rank>=1;
+        # scalars like the adam count stay replicated) — rank AFTER the
+        # stage slice can hit 0 (head_b moments), so the decision must be
+        # made here, not on the sliced value
+        opt_sharded = jax.tree.map(
+            lambda x: getattr(x, "ndim", 0) > 0, self.opt_state)
+
+        # the three program sections hung on the ONE shared GPipe schedule
+        # (_spmd_pipeline): ingest = the embedding section (stage 0 only —
+        # other stages compute-and-discard via the schedule's where, so
+        # grads only flow to the selected branch), stage_apply = this
+        # stage's tower blocks, emit = the head on the last stage
+        def blocks(p, state):
+            y = state
+            for i in range(p["blk_w"].shape[0]):
+                y = jax.nn.relu(y @ p["blk_w"][i] + p["blk_b"][i])
+            return y
+
+        def embed_section(p, inputs, tm):
+            emb_all, segments, key_valid = inputs
+            pooled = fused_seqpool_cvm(
+                emb_all[tm], segments[tm], key_valid[tm], mb, num_slots,
+                use_cvm, sorted_segments=True)
+            return jax.nn.relu(pooled.reshape(mb, -1) @ p["proj_w"]
+                               + p["proj_b"])
+
+        def head(p, y):
+            return y @ p["head_w"] + p["head_b"]
+
+        pipe_run = _spmd_pipeline(blocks, S, M, axis,
+                                  ingest=embed_section, emit=head)
+
+        def pipe(p, emb_all, batch):
+            return pipe_run(p, (emb_all, batch["segments"],
+                                batch["key_valid"]))
+
+        def step(params, opt_state, slab, batch, prng):
+            local = jax.tree.map(lambda x: x[0], params)
+            local_opt = jax.tree.map(
+                lambda x, s: x[0] if s else x, opt_state, opt_sharded)
+            prng, sub = jax.random.split(prng)
+            K = batch["ids"].shape[-1]
+            ids_flat = batch["ids"].reshape(-1)
+            # key validity is DERIVED on device (ids == trash row), like
+            # the single-chip trainer's _key_valid — no redundant H2D leaf
+            batch = dict(batch, key_valid=batch["ids"] != pad_id)
+            emb_all = pull_sparse(slab, ids_flat, layout).reshape(M, K, -1)
+
+            def loss_fn(p, emb_all):
+                logits = pipe(p, emb_all, batch)          # [M, mb]
+                lab = batch["labels"].astype(jnp.float32)
+                iv = batch["ins_valid"]
+                bce = optax.sigmoid_binary_cross_entropy(logits, lab)
+                denom = jnp.maximum(iv.sum(), 1.0)
+                return (jnp.where(iv, bce, 0.0).sum() / denom,
+                        jax.nn.sigmoid(logits))
+
+            (loss, preds), (dp, demb) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True)(local, emb_all)
+            # the pull lives on stage 0 — every other device's demb is
+            # zero; the psum hands stage 0's cotangent to all so the
+            # replicated push below is bit-identical everywhere
+            demb = jax.lax.psum(demb, axis)
+            # per-stage params update with LOCAL grads (each device owns
+            # its section; nothing to allreduce across stages)
+            updates, local_opt = opt.update(dp, local_opt, local)
+            local = optax.apply_updates(local, updates)
+            # single-chip push semantics over all M micro-batches at once
+            ins = batch["segments"] // num_slots          # [M, K]
+            m_off = (jnp.arange(M, dtype=ins.dtype) * mb)[:, None]
+            clicks = batch["labels"].reshape(-1)[(ins + m_off).reshape(-1)]
+            slots = (batch["segments"] % num_slots).reshape(-1)
+            kv = batch["key_valid"].reshape(-1)
+            pg = build_push_grads(demb.reshape(M * K, -1), slots, clicks, kv)
+            slab = push_sparse_dedup(slab, ids_flat, pg, sub, layout, conf)
+            params = jax.tree.map(lambda x: x[None], local)
+            opt_state = jax.tree.map(
+                lambda x, s: x[None] if s else x, local_opt, opt_sharded)
+            return params, opt_state, slab, loss, preds, prng
+
+        spec_sh = P(self.axis)
+        opt_spec = jax.tree.map(
+            lambda x: spec_sh if getattr(x, "ndim", 0) else P(),
+            self.opt_state,
+            is_leaf=lambda x: hasattr(x, "ndim") or np.isscalar(x))
+        fn = jax.shard_map(
+            step, mesh=self.mesh,
+            in_specs=(spec_sh, opt_spec, P(), P(), P()),
+            out_specs=(spec_sh, opt_spec, P(), P(), P(), P()),
+            check_vma=False)
+        return jax.jit(fn, donate_argnums=(2,))
+
+    # ----------------------------------------------------------- host driver
+    def device_batch(self, packed_batches) -> Dict[str, jnp.ndarray]:
+        """n_micro PackedBatches (each one micro-batch / section scope) →
+        stacked [M, ...] device leaves."""
+        if len(packed_batches) != self.n_micro:
+            raise ValueError("need exactly n_micro=%d batches, got %d"
+                             % (self.n_micro, len(packed_batches)))
+        ids = np.stack([self.table.lookup_ids(b.keys, b.valid)
+                        for b in packed_batches])
+        return {
+            "ids": jnp.asarray(ids),
+            "segments": jnp.asarray(
+                np.stack([b.segments for b in packed_batches])),
+            "labels": jnp.asarray(
+                np.stack([b.labels for b in packed_batches])),
+            "ins_valid": jnp.asarray(
+                np.stack([b.ins_valid for b in packed_batches])),
+        }
+
+    def train_step(self, packed_batches) -> float:
+        """ONE pipelined train step over n_micro micro-batches."""
+        batch = self.device_batch(packed_batches)
+        (self.params, self.opt_state, slab, loss, _preds,
+         self._prng) = self._step(self.params, self.opt_state,
+                                  self.table.slab, batch, self._prng)
+        self.table.set_slab(slab)
+        return float(loss)
+
+    def train_pass(self, dataset) -> Dict[str, float]:
+        """BoxPS pass cadence around the pipelined step: feed pass →
+        slab build → n_micro-batch steps → EndPass write-back. Trailing
+        batches short of a full micro-batch group are dropped (the
+        reference's section pipeline also only runs full pipelines)."""
+        self.table.begin_feed_pass()
+        dataset.load_into_memory(add_keys_fn=self.table.add_keys)
+        self.table.end_feed_pass()
+        self.table.begin_pass()
+        batches = dataset.split_batches(num_workers=1)[0]
+        M = self.n_micro
+        losses = []
+        for lo in range(0, len(batches) - M + 1, M):
+            losses.append(self.train_step(batches[lo:lo + M]))
+        self.table.end_pass()
+        return {"loss": float(np.mean(losses)) if losses else 0.0,
+                "steps": len(losses),
+                "dropped_batches": len(batches) % M}

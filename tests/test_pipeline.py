@@ -83,3 +83,142 @@ def test_pipeline_grads_match_sequential():
     for wp, gp in zip(jax.tree.leaves(want_params),
                       jax.tree.leaves(got_params)):
         np.testing.assert_allclose(gp, wp, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------- CTR pipe
+
+
+def _ctr_setup(tmp_path_factory_or_dir, n_files=2, lines=320, mb=16):
+    import dataclasses
+    from paddlebox_tpu.data import write_synthetic_ctr_files
+    files, feed = write_synthetic_ctr_files(
+        str(tmp_path_factory_or_dir), num_files=n_files,
+        lines_per_file=lines, num_slots=4, vocab_per_slot=100, max_len=3,
+        seed=7)
+    return files, dataclasses.replace(feed, batch_size=mb)
+
+
+def _ctr_table(cap=1 << 12):
+    from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
+                                              TableConfig)
+    return TableConfig(
+        embedx_dim=4, pass_capacity=cap,
+        optimizer=SparseOptimizerConfig(mf_create_thresholds=1e9,  # no rng
+                                        mf_initial_range=0.0,
+                                        feature_learning_rate=0.05,
+                                        mf_learning_rate=0.05))
+
+
+def test_ctr_pipeline_matches_sequential_oracle(tmp_path):
+    """Gradient parity (VERDICT r2 #3): one pipelined step over a REAL
+    sparse batch must produce the same params AND the same slab (push
+    included) as the sequential single-chip composition of the same
+    stages."""
+    import jax.numpy as jnp
+    import optax
+    from paddlebox_tpu.data import BoxDataset
+    from paddlebox_tpu.embedding.optimizers import push_sparse_dedup
+    from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
+    from paddlebox_tpu.ops.sparse import build_push_grads, pull_sparse
+    from paddlebox_tpu.parallel.pipeline import CtrPipelineRunner
+
+    files, feed = _ctr_setup(tmp_path, n_files=1, lines=64, mb=16)
+    table_cfg = _ctr_table()
+    S, L, M = 4, 1, 4
+    r = CtrPipelineRunner(table_cfg, feed, n_stages=S, d_model=24,
+                          layers_per_stage=L, lr=1e-2, n_micro=M, seed=3)
+    params0 = {k: np.asarray(v) for k, v in r.params.items()}
+    ds = BoxDataset(feed, read_threads=1)
+    ds.set_filelist(files)
+    r.table.begin_feed_pass()
+    ds.load_into_memory(add_keys_fn=r.table.add_keys)
+    r.table.end_feed_pass()
+    r.table.begin_pass()
+    slab0 = np.asarray(r.table.slab)
+    batches = ds.split_batches(num_workers=1)[0][:M]
+    batch = jax.tree.map(np.asarray, r.device_batch(batches))
+    batch["key_valid"] = batch["ids"] != r.table.padding_id
+    prng0 = np.asarray(r._prng)
+
+    loss_pipe = r.train_step(batches)
+    slab_pipe = np.asarray(r.table.slab)
+
+    # ---- sequential oracle: same math, no pipeline, single device
+    layout, conf = r.layout, table_cfg.optimizer
+    num_slots, mb = r.num_slots, r.mb
+    K = batch["ids"].shape[-1]
+
+    def oracle_loss(p, emb_all):
+        logits = []
+        for t in range(M):
+            pooled = fused_seqpool_cvm(
+                emb_all[t], jnp.asarray(batch["segments"][t]),
+                jnp.asarray(batch["key_valid"][t]), mb, num_slots, True,
+                sorted_segments=True)
+            x = jax.nn.relu(pooled.reshape(mb, -1) @ p["proj_w"][0]
+                            + p["proj_b"][0])
+            for s in range(S):
+                for i in range(L):
+                    x = jax.nn.relu(x @ p["blk_w"][s, i] + p["blk_b"][s, i])
+            logits.append(x @ p["head_w"][S - 1] + p["head_b"][S - 1])
+        logits = jnp.stack(logits)
+        lab = jnp.asarray(batch["labels"]).astype(jnp.float32)
+        iv = jnp.asarray(batch["ins_valid"])
+        bce = optax.sigmoid_binary_cross_entropy(logits, lab)
+        return jnp.where(iv, bce, 0.0).sum() / jnp.maximum(iv.sum(), 1.0)
+
+    ids_flat = jnp.asarray(batch["ids"].reshape(-1))
+    emb_all = pull_sparse(jnp.asarray(slab0), ids_flat,
+                          layout).reshape(M, K, -1)
+    loss_o, (dp, demb) = jax.value_and_grad(oracle_loss, argnums=(0, 1))(
+        {k: jnp.asarray(v) for k, v in params0.items()}, emb_all)
+    np.testing.assert_allclose(loss_pipe, float(loss_o), rtol=1e-5)
+
+    # params: per-stage adam with local grads == runner's sharded update
+    opt = optax.adam(1e-2)
+    p0 = {k: jnp.asarray(v) for k, v in params0.items()}
+    upd, _ = opt.update(dp, opt.init(p0), p0)
+    want_params = optax.apply_updates(p0, upd)
+    for k in want_params:
+        np.testing.assert_allclose(np.asarray(r.params[k]),
+                                   np.asarray(want_params[k]),
+                                   rtol=2e-4, atol=1e-6, err_msg=k)
+
+    # slab: same push (same prng stream as the runner consumed)
+    _, sub = jax.random.split(jnp.asarray(prng0))
+    ins = batch["segments"] // num_slots
+    m_off = (np.arange(M, dtype=ins.dtype) * mb)[:, None]
+    clicks = batch["labels"].reshape(-1)[(ins + m_off).reshape(-1)]
+    slots = (batch["segments"] % num_slots).reshape(-1)
+    kv = batch["key_valid"].reshape(-1)
+    pg = build_push_grads(demb.reshape(M * K, -1), jnp.asarray(slots),
+                          jnp.asarray(clicks), jnp.asarray(kv))
+    want_slab = push_sparse_dedup(jnp.asarray(slab0), ids_flat, pg, sub,
+                                  layout, conf)
+    np.testing.assert_allclose(slab_pipe, np.asarray(want_slab),
+                               rtol=2e-4, atol=1e-6)
+
+
+def test_ctr_pipeline_learns(tmp_path):
+    """A CtrDnn-class tower split across 4 stages trains end to end:
+    loss descends over passes and the pass cadence (feed → slab → steps →
+    write-back) leaves trained rows in the store."""
+    from paddlebox_tpu.data import BoxDataset
+    from paddlebox_tpu.embedding import accessor as acc
+    from paddlebox_tpu.parallel.pipeline import CtrPipelineRunner
+
+    files, feed = _ctr_setup(tmp_path, n_files=2, lines=320, mb=16)
+    r = CtrPipelineRunner(_ctr_table(), feed, n_stages=4, d_model=24,
+                          layers_per_stage=1, lr=5e-3, n_micro=8, seed=0)
+    losses = []
+    for _ in range(6):
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        stats = r.train_pass(ds)
+        losses.append(stats["loss"])
+        ds.release_memory()
+    assert stats["steps"] >= 4
+    assert losses[-1] < losses[0] - 0.01, losses
+    keys, vals = r.table.store.state_items()
+    assert keys.size > 50
+    assert vals[:, acc.SHOW].sum() > 0      # write-back happened
