@@ -119,8 +119,10 @@ class PassTable:
     def __init__(self, table: TableConfig, seed: int = 0,
                  store: Optional[HostEmbeddingStore] = None) -> None:
         self.config = table
-        self.layout = ValueLayout(table.embedx_dim, table.optimizer.optimizer)
-        self.push_layout = PushLayout(table.embedx_dim)
+        self.layout = ValueLayout(table.embedx_dim, table.optimizer.optimizer,
+                                  expand_dim=table.expand_embed_dim)
+        self.push_layout = PushLayout(table.embedx_dim,
+                                      table.expand_embed_dim)
         self.store = store or make_host_store(self.layout, table, seed)
         self.capacity = table.pass_capacity
         self._feed_keys: list = []

@@ -6,6 +6,7 @@ from paddlebox_tpu.models.dlrm import DLRM
 from paddlebox_tpu.models.mmoe import MMoE
 from paddlebox_tpu.models.esmm import ESMM
 from paddlebox_tpu.models.join_pv import JoinPvDnn
+from paddlebox_tpu.models.nn_cross import CtrDnnExpand
 
 MODEL_ZOO = {
     "ctr_dnn": CtrDnn,
@@ -15,7 +16,9 @@ MODEL_ZOO = {
     "mmoe": MMoE,
     "esmm": ESMM,
     "join_pv_dnn": JoinPvDnn,
+    "ctr_dnn_expand": CtrDnnExpand,
 }
 
 __all__ = ["mlp_init", "mlp_apply", "CtrDnn", "DeepFM", "WideDeep", "DLRM",
-           "MMoE", "ESMM", "JoinPvDnn", "MODEL_ZOO"]
+           "MMoE", "ESMM", "JoinPvDnn", "CtrDnnExpand",
+           "MODEL_ZOO"]

@@ -1,0 +1,72 @@
+"""NN-cross CTR model over the dual-output expand embedding.
+
+The model family pull_box_extended_sparse exists for in the reference
+(op: paddle/fluid/operators/pull_box_extended_sparse_op.cc; user API
+`fluid.contrib.layers.pull_box_extended_sparse`, contrib/layers/nn.py:1678):
+every feature carries a SECOND embedding block (the expand/NN-cross
+vector) trained jointly with the base one. The base pooled view feeds the
+deep tower; the expand vectors feed an explicit slot-interaction (cross)
+branch — here an FM-style second-order term plus a linear projection, the
+standard shape of the cross branches those models wire the expand output
+into. Both branches' gradients flow back through ONE extended push
+(build_push_grads_extended → the shared-g2sum expand adagrad rule,
+embedding/optimizers.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.models.layers import mlp_apply, mlp_init
+
+
+class CtrDnnExpand:
+    name = "ctr_dnn_expand"
+    task_names = ("ctr",)
+    use_expand = True   # trainer contract: pull extended, push expand grads
+
+    def __init__(self, spec: ModelSpec, expand_dim: int,
+                 hidden=(64, 32)) -> None:
+        if expand_dim <= 0:
+            raise ValueError("CtrDnnExpand needs expand_dim > 0")
+        self.spec = spec
+        self.expand_dim = expand_dim
+        self.hidden = tuple(hidden)
+
+    def init(self, rng: jax.Array) -> Dict:
+        dims = [self.spec.total_in, *self.hidden, 1]
+        params = mlp_init(rng, dims, "dnn")
+        k = jax.random.fold_in(rng, 7)
+        S, E = self.spec.num_slots, self.expand_dim
+        params["cross"] = {
+            "lin_w": 0.01 * jax.random.normal(k, (S * E, 1), jnp.float32),
+            "lin_b": jnp.zeros((1,), jnp.float32),
+            "fm_scale": jnp.ones((), jnp.float32),
+        }
+        return params
+
+    def apply(self, params: Dict, pooled: jnp.ndarray,
+              dense: Optional[jnp.ndarray] = None,
+              expand: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        """pooled: [B, S, slot_dim] base view; expand: [B, S, E] sum-pooled
+        expand vectors (REQUIRED — the trainer's extended pull supplies
+        it)."""
+        if expand is None:
+            raise ValueError("CtrDnnExpand.apply needs the expand input")
+        x = pooled.reshape(pooled.shape[0], -1)
+        if dense is not None:
+            x = jnp.concatenate([x, dense], axis=-1)
+        deep = mlp_apply(params, x, "dnn")[:, 0]
+        # FM-style second order across slots on the expand vectors:
+        # 0.5 * Σ_e ((Σ_s v_se)² − Σ_s v_se²) = Σ_{s<s'} <v_s, v_s'>
+        s_sum = expand.sum(axis=1)
+        s_sq = jnp.square(expand).sum(axis=1)
+        fm = 0.5 * (jnp.square(s_sum) - s_sq).sum(axis=-1)
+        cr = params["cross"]
+        lin = (expand.reshape(expand.shape[0], -1) @ cr["lin_w"])[:, 0] \
+            + cr["lin_b"][0]
+        return deep + cr["fm_scale"] * fm + lin

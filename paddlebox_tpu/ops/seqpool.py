@@ -77,6 +77,18 @@ def cvm_conv_transform(pooled: jnp.ndarray, use_cvm: bool = True,
     return jnp.concatenate(cols + [rest], axis=-1)
 
 
+def seqpool_sum(emb: jnp.ndarray, segments: jnp.ndarray, valid: jnp.ndarray,
+                batch_size: int, num_slots: int) -> jnp.ndarray:
+    """Plain per-slot sum pooling with NO cvm columns — the
+    sequence_pool-SUM the extended (expand/NN-cross) embedding outputs
+    feed (pull_box_extended_sparse's consumer pattern). The ONE
+    implementation both trainers' expand paths share."""
+    pooled = jax.ops.segment_sum(
+        jnp.where(valid[:, None], emb, 0.0), segments,
+        num_segments=batch_size * num_slots, indices_are_sorted=True)
+    return pooled.reshape(batch_size, num_slots, emb.shape[-1])
+
+
 def fused_seqpool_cvm_with_conv(
         emb: jnp.ndarray, segments: jnp.ndarray, valid: jnp.ndarray,
         batch_size: int, num_slots: int, use_cvm: bool = True,

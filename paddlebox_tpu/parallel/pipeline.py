@@ -263,6 +263,9 @@ class CtrPipelineRunner:
                  use_cvm: bool = True, mesh: Optional[Mesh] = None,
                  seed: int = 0):
         from paddlebox_tpu.embedding.pass_table import PassTable
+        if table_cfg.expand_embed_dim:
+            raise ValueError("CtrPipelineRunner does not consume the "
+                             "expand embedding (expand_embed_dim must be 0)")
         self.table = PassTable(table_cfg, seed=seed)
         self.table_cfg = table_cfg
         self.feed = feed

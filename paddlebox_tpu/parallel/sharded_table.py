@@ -99,8 +99,10 @@ class ShardedPassTable:
         the distributed CPU PS behind every shard (the GPUPS BuildPull/
         EndPass composition, ps_gpu_wrapper.cc:337,983)."""
         self.config = table
-        self.layout = ValueLayout(table.embedx_dim, table.optimizer.optimizer)
-        self.push_layout = PushLayout(table.embedx_dim)
+        self.layout = ValueLayout(table.embedx_dim, table.optimizer.optimizer,
+                                  expand_dim=table.expand_embed_dim)
+        self.push_layout = PushLayout(table.embedx_dim,
+                                      table.expand_embed_dim)
         self.num_shards = num_shards
         self.bucket_cap = bucket_cap
         if table.pass_capacity % num_shards:

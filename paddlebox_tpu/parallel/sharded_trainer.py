@@ -41,7 +41,9 @@ from paddlebox_tpu.embedding.pass_table import dedup_ids
 from paddlebox_tpu.metrics.auc import MetricRegistry
 from paddlebox_tpu.models.base import ModelSpec
 from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
-from paddlebox_tpu.ops.sparse import build_push_grads, pull_sparse
+from paddlebox_tpu.ops.sparse import (build_push_grads,
+                                      build_push_grads_extended,
+                                      pull_sparse, pull_sparse_extended)
 from paddlebox_tpu.parallel.mesh import BOX_AXIS, device_mesh_1d
 from paddlebox_tpu.parallel.sharded_table import (ShardedBatchIndex,
                                                   ShardedPassTable)
@@ -155,6 +157,10 @@ class ShardedBoxTrainer:
         self.num_slots = len(feed.used_sparse_slots())
         self.use_cvm = use_cvm
         self.multi_task = len(getattr(model, "task_names", ("ctr",))) > 1
+        # NN-cross models: extended pull + expand-grad push through the a2a
+        from paddlebox_tpu.train.trainer import check_expand_config
+        self.use_expand = bool(getattr(model, "use_expand", False))
+        check_expand_config(model, self.table.layout, self.use_expand)
         self._slabs: Optional[jax.Array] = None
         self._prng = jax.random.PRNGKey(seed + 17)
         self._shuffle_rng = np.random.RandomState(seed + 1)
@@ -251,20 +257,35 @@ class ShardedBoxTrainer:
         wants_rank_offset = model_accepts_rank_offset(model)
         cdtype = resolve_compute_dtype(self.cfg.compute_dtype)
         mixed = cdtype != jnp.float32
+        use_expand = self.use_expand
+        base_w = 3 + layout.embedx_dim
 
         def pull_emb(slab, batch):
-            # a2a ids → local gather → a2a values → restore
+            # a2a ids → local gather → a2a values → restore. Expand mode:
+            # the local gather is the dual-output extended pull; base +
+            # expand blocks ride ONE a2a concatenated and split after the
+            # restore (pull_box_extended_sparse over HeterComm semantics).
             buckets = batch["buckets"]                       # [P, KB]
             KB = buckets.shape[1]
             Pn = buckets.shape[0]
             req = jax.lax.all_to_all(buckets, axis, 0, 0, tiled=True)
-            vals = pull_sparse(slab, req.reshape(-1), layout)  # [P*KB, Dp]
+            if use_expand:
+                base, exp = pull_sparse_extended(slab, req.reshape(-1),
+                                                 layout)
+                vals = jnp.concatenate([base, exp], axis=1)
+            else:
+                vals = pull_sparse(slab, req.reshape(-1), layout)
             resp = jax.lax.all_to_all(
                 vals.reshape(Pn, KB, -1), axis, 0, 0, tiled=True)
-            emb = resp.reshape(Pn * KB, -1)[batch["restore"]]  # [K, Dp]
+            emb = resp.reshape(Pn * KB, -1)[batch["restore"]]  # [K, Dp(+E)]
+            if use_expand:
+                emb = (emb[:, :base_w], emb[:, base_w:])
             return emb, req
 
         def forward_logits(params, emb, batch):
+            expand_emb = None
+            if use_expand:
+                emb, expand_emb = emb
             # packer batches carry nondecreasing segments by contract
             pooled = fused_seqpool_cvm(
                 emb, batch["segments"], batch["valid"], B, S, use_cvm,
@@ -275,7 +296,15 @@ class ShardedBoxTrainer:
                 # contract as the single-host trainer
                 params, pooled, dense_in = apply_mixed_precision(
                     params, pooled, dense_in, cdtype)
-            if wants_rank_offset and "rank_offset" in batch:
+            if use_expand:
+                from paddlebox_tpu.ops.seqpool import seqpool_sum
+                pooled_exp = seqpool_sum(expand_emb, batch["segments"],
+                                         batch["valid"], B, S)
+                if mixed:
+                    pooled_exp = pooled_exp.astype(cdtype)
+                logits = model.apply(params, pooled, dense_in,
+                                     expand=pooled_exp)
+            elif wants_rank_offset and "rank_offset" in batch:
                 logits = model.apply(params, pooled, dense_in,
                                      rank_offset=batch["rank_offset"])
             else:
@@ -309,6 +338,10 @@ class ShardedBoxTrainer:
         lr = self.cfg.dense_lr
         has_summary = (getattr(model, "use_data_norm", False)
                        and hasattr(model, "update_summary"))
+        use_expand = self.use_expand
+        if use_expand and has_summary:
+            raise ValueError("expand embedding + data_norm summary is not "
+                             "supported in one model")
         collect_T = self._collect_T
         pull_emb, forward_logits, preds_of = self._pull_and_forward()
 
@@ -445,7 +478,12 @@ class ShardedBoxTrainer:
             label_src = (batch["labels_" + model.task_names[0]] if multi_task
                          else batch["labels"])
             clicks = label_src[batch["segments"] // S]
-            pg = build_push_grads(demb, batch["slots"], clicks, batch["valid"])
+            if use_expand:
+                pg = build_push_grads_extended(
+                    demb[0], demb[1], batch["slots"], clicks, batch["valid"])
+            else:
+                pg = build_push_grads(demb, batch["slots"], clicks,
+                                      batch["valid"])
             bucket_g = jnp.zeros((Pn * KB, pg.shape[1]), pg.dtype
                                  ).at[batch["restore"]].add(
                 jnp.where(batch["valid"][:, None], pg, 0.0))

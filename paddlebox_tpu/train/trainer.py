@@ -36,8 +36,10 @@ from paddlebox_tpu.embedding.optimizers import (push_sparse_hostdedup,
 from paddlebox_tpu.embedding.pass_table import PassTable
 from paddlebox_tpu.metrics.auc import MetricRegistry
 from paddlebox_tpu.models.base import ModelSpec
-from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
-from paddlebox_tpu.ops.sparse import build_push_grads, pull_sparse
+from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm, seqpool_sum
+from paddlebox_tpu.ops.sparse import (build_push_grads,
+                                      build_push_grads_extended,
+                                      pull_sparse, pull_sparse_extended)
 from paddlebox_tpu.utils.timer import Timer
 
 
@@ -138,6 +140,28 @@ def run_scan_chunks(scan_call: Callable, items, chunk: int,
     if pending is not None:
         drain(pending)
     return carry, losses_all, n_full
+
+
+def check_expand_config(model, layout: ValueLayout, use_expand: bool) -> None:
+    """Both directions of the expand contract fail LOUDLY at build time —
+    a mismatch otherwise surfaces as an opaque broadcast/dot shape error
+    deep inside the first jitted step."""
+    if use_expand:
+        if not layout.expand_dim:
+            raise ValueError(
+                "model pulls the expand embedding but the table has "
+                "expand_embed_dim == 0 (set TableConfig.expand_embed_dim)")
+        mdim = getattr(model, "expand_dim", layout.expand_dim)
+        if mdim != layout.expand_dim:
+            raise ValueError(
+                f"model.expand_dim={mdim} != "
+                f"TableConfig.expand_embed_dim={layout.expand_dim}")
+    elif layout.expand_dim:
+        raise ValueError(
+            "table has expand_embed_dim="
+            f"{layout.expand_dim} but the model does not consume the "
+            "expand embedding (use an use_expand model, e.g. "
+            "CtrDnnExpand, or set expand_embed_dim=0)")
 
 
 def make_dense_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
@@ -266,11 +290,19 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
     cdtype = resolve_compute_dtype(compute_dtype)
     mixed = cdtype != jnp.float32
     padding_id = table.pass_capacity - 1
+    # NN-cross models (use_expand contract, models/nn_cross.py): dual-output
+    # extended pull + expand-grad push (pull_box_extended_sparse_op.cc;
+    # user API contrib/layers/nn.py:1678)
+    use_expand = bool(getattr(model, "use_expand", False))
+    check_expand_config(model, layout, use_expand)
     # data_norm summary params (boxps_worker.cc:89-95) update by the
     # running-sums rule, not the optimizer (their grads are zero — the model
     # stop_gradients the state in apply)
     has_summary = (getattr(model, "use_data_norm", False)
                    and hasattr(model, "update_summary"))
+    if use_expand and has_summary:
+        raise ValueError("expand embedding + data_norm summary is not "
+                         "supported in one model")
 
     # per-key slots/valid are DERIVED on device, not transferred: the packer
     # guarantees segments = ins*num_slots + slot and lookup_ids maps every
@@ -283,6 +315,9 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         return batch["segments"] % num_slots
 
     def forward(params, emb, batch, dn_extra):
+        expand_emb = None
+        if use_expand:
+            emb, expand_emb = emb
         # packer/columnar batches carry nondecreasing segments by contract
         pooled = fused_seqpool_cvm(
             emb, batch["segments"], _key_valid(batch), batch_size, num_slots,
@@ -293,7 +328,15 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
             # loss (master params/opt state stay f32 outside)
             params, pooled, dense_in = apply_mixed_precision(
                 params, pooled, dense_in, cdtype)
-        if wants_rank_offset and "rank_offset" in batch:
+        if use_expand:
+            pooled_exp = seqpool_sum(expand_emb, batch["segments"],
+                                     _key_valid(batch), batch_size,
+                                     num_slots)
+            if mixed:
+                pooled_exp = pooled_exp.astype(cdtype)
+            logits = model.apply(params, pooled, dense_in,
+                                 expand=pooled_exp)
+        elif wants_rank_offset and "rank_offset" in batch:
             logits = model.apply(params, pooled, dense_in,
                                  rank_offset=batch["rank_offset"])
         else:
@@ -316,13 +359,23 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
             preds = {"ctr": main_pred}
         return loss, preds
 
+    def _pull(slab, ids):
+        if use_expand:
+            return pull_sparse_extended(slab, ids, layout)  # (base, expand)
+        return pull_sparse(slab, ids, layout)
+
     def _sparse_push(slab, demb, batch, sub):
         # per-key click = its instance's label (first task's label)
         key_label_src = batch["labels_" + model.task_names[0]] if multi_task \
             else batch["labels"]
         clicks = key_label_src[batch["segments"] // num_slots]
-        push_grads = build_push_grads(demb, _key_slots(batch), clicks,
-                                      _key_valid(batch))
+        if use_expand:
+            d_base, d_exp = demb
+            push_grads = build_push_grads_extended(
+                d_base, d_exp, _key_slots(batch), clicks, _key_valid(batch))
+        else:
+            push_grads = build_push_grads(demb, _key_slots(batch), clicks,
+                                          _key_valid(batch))
         if "perm" not in batch:
             # never fall back to the on-device jnp.unique sort silently —
             # that is the dominant step cost this path exists to remove
@@ -349,7 +402,7 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         def loss_fn(params, emb):
             return forward(params, emb, batch, None)
 
-        emb = pull_sparse(slab, batch["ids"], layout)
+        emb = _pull(slab, batch["ids"])
         grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)
         (loss, preds), (dparams, demb) = grad_fn(params, emb)
         updates, opt_state = dense_opt.update(dparams, opt_state, params)
@@ -374,7 +427,7 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         def loss_fn(params, emb):
             return forward(params, emb, batch, None)
 
-        emb = pull_sparse(slab, batch["ids"], layout)
+        emb = _pull(slab, batch["ids"])
         grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)
         (loss, preds), (dparams, demb) = grad_fn(params, emb)
         if has_summary:
@@ -395,7 +448,7 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
 
     @jax.jit
     def eval_step(slab, params, batch):
-        emb = pull_sparse(slab, batch["ids"], layout)
+        emb = _pull(slab, batch["ids"])
         _, preds = forward(params, emb, batch, None)
         return preds
 

@@ -1,0 +1,161 @@
+"""Expand/NN-cross embedding end to end (VERDICT r2 #6): a model consuming
+pull_sparse_extended trains through BoxTrainer — expand grads flow through
+the push into the shared-g2sum expand adagrad rule, the expand block
+learns, and SetTestMode inference works. Reference: the
+pull_box_extended_sparse user path (contrib/layers/nn.py:1678 →
+operators/pull_box_extended_sparse_op.cc)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config.configs import (SparseOptimizerConfig, TableConfig,
+                                          TrainerConfig)
+from paddlebox_tpu.data import BoxDataset, write_synthetic_ctr_files
+from paddlebox_tpu.embedding import accessor as acc
+from paddlebox_tpu.models import CtrDnnExpand
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.train import BoxTrainer
+
+D, E = 4, 3
+
+
+def _table():
+    return TableConfig(
+        embedx_dim=D, pass_capacity=1 << 12, expand_embed_dim=E,
+        optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                        mf_initial_range=1e-3,
+                                        feature_learning_rate=0.05,
+                                        mf_learning_rate=0.05))
+
+
+@pytest.fixture(scope="module")
+def data(tmp_path_factory):
+    out = tmp_path_factory.mktemp("expand_data")
+    files, feed = write_synthetic_ctr_files(
+        str(out), num_files=2, lines_per_file=300, num_slots=4,
+        vocab_per_slot=90, max_len=3, seed=13)
+    return files, dataclasses.replace(feed, batch_size=32)
+
+
+def test_expand_model_learns_e2e(data):
+    files, feed = data
+    table = _table()
+    model = CtrDnnExpand(ModelSpec(num_slots=4, slot_dim=3 + D),
+                         expand_dim=E, hidden=(32, 16))
+    tr = BoxTrainer(model, table, feed,
+                    TrainerConfig(dense_lr=1e-2, scan_chunk=2))
+    try:
+        tr.metrics.init_metric("auc", "label", "pred", table_size=1 << 14,
+                               mask_var="mask")
+        losses = []
+        for _ in range(10):
+            ds = BoxDataset(feed, read_threads=1)
+            ds.set_filelist(files)
+            losses.append(tr.train_pass(ds)["loss"])
+            ds.release_memory()
+        assert losses[-1] < losses[0] - 0.02, losses
+        msg = tr.metrics.get_metric_msg("auc")
+        assert msg["auc"] > 0.6, msg
+
+        # the expand block itself trained: nonzero vectors + advanced
+        # shared-g2sum state on trained rows
+        keys, vals = tr.table.store.state_items()
+        lay = tr.table.layout
+        exp = vals[:, lay.expand_w:lay.expand_w + E]
+        assert np.abs(exp).max() > 0, "expand block never updated"
+        assert (vals[:, lay.expand_state] > 0).any(), "expand g2sum still 0"
+
+        # SetTestMode inference through the extended pull
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        preds, labels = tr.predict_batches(ds)
+        assert preds.size == labels.size > 500
+        assert np.isfinite(preds).all()
+    finally:
+        tr.close()
+
+
+def test_expand_requires_table_block(data):
+    files, feed = data
+    table = dataclasses.replace(_table(), expand_embed_dim=0)
+    model = CtrDnnExpand(ModelSpec(num_slots=4, slot_dim=3 + D),
+                         expand_dim=E, hidden=(16,))
+    with pytest.raises(ValueError, match="expand_embed_dim"):
+        BoxTrainer(model, table, feed, TrainerConfig(dense_lr=1e-2))
+
+
+def test_expand_push_changes_only_seen_rows(data):
+    """One step: expand grads land on the batch's rows (dedup'd push), all
+    other rows' expand blocks stay untouched."""
+    files, feed = data
+    table = _table()
+    model = CtrDnnExpand(ModelSpec(num_slots=4, slot_dim=3 + D),
+                         expand_dim=E, hidden=(16,))
+    tr = BoxTrainer(model, table, feed,
+                    TrainerConfig(dense_lr=1e-2, scan_chunk=1))
+    try:
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        tr.train_pass(ds)
+        keys, vals = tr.table.store.state_items()
+        lay = tr.table.layout
+        # every stored row was part of the pass; rows with show>0 trained
+        seen = vals[:, acc.SHOW] > 0
+        assert seen.any()
+        exp_norm = np.abs(vals[:, lay.expand_w:lay.expand_w + E]).sum(1)
+        assert (exp_norm[seen] > 0).mean() > 0.5
+    finally:
+        tr.close()
+
+
+def test_expand_sharded_trainer_learns(data):
+    """The expand path through the SHARDED step: base+expand blocks ride
+    one a2a, expand grads return through the push a2a into the in-table
+    expand adagrad on the owning shard."""
+    import jax
+    from paddlebox_tpu.parallel import ShardedBoxTrainer
+    from paddlebox_tpu.parallel.mesh import device_mesh_1d
+
+    files, feed = data
+    table = _table()
+    model = CtrDnnExpand(ModelSpec(num_slots=4, slot_dim=3 + D),
+                         expand_dim=E, hidden=(32, 16))
+    trainer = ShardedBoxTrainer(
+        model, table, feed, TrainerConfig(dense_lr=1e-2, scan_chunk=2),
+        mesh=device_mesh_1d(8), seed=0)
+    losses = []
+    for _ in range(8):
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        losses.append(trainer.train_pass(ds)["loss"])
+        ds.release_memory()
+    assert losses[-1] < losses[0] - 0.02, losses
+    lay = trainer.table.layout
+    trained = 0
+    for st in trainer.table.stores:
+        _, vals = st.state_items()
+        if vals.size:
+            trained += int((np.abs(
+                vals[:, lay.expand_w:lay.expand_w + E]).sum(1) > 0).sum())
+    assert trained > 50, trained
+
+
+def test_expand_config_mismatches_fail_loud(data):
+    """Both directions of the expand contract fail at build time with a
+    config-level message, not an opaque shape error mid-trace."""
+    files, feed = data
+    from paddlebox_tpu.models import CtrDnn
+
+    # table has an expand block, model does not consume it
+    with pytest.raises(ValueError, match="does not consume"):
+        BoxTrainer(CtrDnn(ModelSpec(num_slots=4, slot_dim=3 + D),
+                          hidden=(8,)),
+                   _table(), feed, TrainerConfig(dense_lr=1e-2))
+    # dim mismatch
+    model = CtrDnnExpand(ModelSpec(num_slots=4, slot_dim=3 + D),
+                         expand_dim=E + 2, hidden=(8,))
+    with pytest.raises(ValueError, match="expand_dim"):
+        BoxTrainer(model, _table(), feed, TrainerConfig(dense_lr=1e-2))
