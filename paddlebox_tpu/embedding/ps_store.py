@@ -88,6 +88,21 @@ class PSBackedStore:
         if self.primary:
             self.client.age_unseen_days(self.table_id)
 
+    # the spill budget is TABLE-wide on the server, not per client shard:
+    # check_need_limit_mem must hand the primary the whole budget once
+    # (the same P×-application class of bug primary gating exists for)
+    spill_table_wide = True
+
+    def spill(self, max_resident: int) -> int:
+        """Server-side DRAM limit (CheckNeedLimitMem → the PS table's SSD
+        tier), primary-gated like every table-wide op."""
+        if not self.primary:
+            return 0
+        n = int(self.client.limit_mem(self.table_id, max_resident))
+        if n:
+            stat_add("ps_rows_spilled", n)
+        return n
+
     def tick_spill_age(self) -> None:
         # the age=False/save_base cadence assumes the checkpoint path
         # already aged resident rows (update_stat_after_save param=3) —

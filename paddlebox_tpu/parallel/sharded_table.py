@@ -328,14 +328,20 @@ class ShardedPassTable:
     # ------------------------------------------------------------ lifecycle
     def check_need_limit_mem(self) -> int:
         """Per-shard pass-cadence spill (CheckNeedLimitMem/ShrinkResource,
-        box_wrapper.h:627-629); budget divides evenly across owned
-        shards."""
+        box_wrapper.h:627-629); budget divides evenly across owned shards
+        — except table-wide backends (PS-backed shards), which receive the
+        WHOLE budget once through their primary."""
         budget = self.config.ssd_max_resident_rows(self.layout.width)
         if budget is None:
             return 0
         per_shard = budget // max(1, len(self.owned_shards))
-        return sum(st.spill(per_shard) for st in self.stores
-                   if st is not None and hasattr(st, "spill"))
+        total = 0
+        for st in self.stores:
+            if st is None or not hasattr(st, "spill"):
+                continue
+            total += st.spill(budget if getattr(st, "spill_table_wide",
+                                                False) else per_shard)
+        return total
 
     def shrink_table(self) -> int:
         return sum(st.shrink() for st in self.stores if st is not None)

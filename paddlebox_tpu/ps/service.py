@@ -74,6 +74,10 @@ class PSCore:
     def age_unseen_days(self, table_id: int) -> None:
         self.sparse[table_id].age_unseen_days()
 
+    def limit_mem(self, table_id: int,
+                  max_resident: Optional[int] = None) -> int:
+        return self.sparse[table_id].check_need_limit_mem(max_resident)
+
     def sparse_size(self, table_id: int) -> int:
         return len(self.sparse[table_id])
 
@@ -182,6 +186,10 @@ class TcpPSClient:
 
     def age_unseen_days(self, table_id):
         return self._call("age_unseen_days", table_id=table_id)
+
+    def limit_mem(self, table_id, max_resident=None):
+        return self._call("limit_mem", table_id=table_id,
+                          max_resident=max_resident)
 
     def sparse_size(self, table_id):
         return self._call("sparse_size", table_id=table_id)

@@ -125,6 +125,23 @@ class SparseTable:
             with lock:
                 s.age_unseen_days()
 
+    def check_need_limit_mem(self, max_resident: Optional[int] = None) -> int:
+        """Server-side DRAM budget (CheckNeedLimitMem/ShrinkResource,
+        box_wrapper.h:627-629, on the SSDSparseTable tier): spill the
+        coldest rows beyond the budget to the table's ssd_dir. Budget
+        defaults from the config's ssd_threshold_mb; divided evenly across
+        the server shards. Returns rows spilled."""
+        budget = (max_resident if max_resident is not None
+                  else self.config.ssd_max_resident_rows(self.layout.width))
+        if budget is None:
+            return 0
+        per = budget // max(1, self.shard_num)
+        total = 0
+        for s, lock in zip(self.shards, self._locks):
+            with lock:
+                total += s.spill(per)
+        return total
+
     def save(self, dirpath: str) -> List[str]:
         """Per-shard files (MemorySparseTable::Save shard file layout)."""
         os.makedirs(dirpath, exist_ok=True)

@@ -17,8 +17,9 @@ import sys
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
+    _devs = os.environ.get("PBTPU_DEVS_PER_PROC", "4")
     os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=4").strip()
+        flags + " --xla_force_host_platform_device_count=" + _devs).strip()
 os.environ["PBTPU_DATASET_DISABLE_SHUFFLE"] = "1"  # strict parity
 
 import jax  # noqa: E402
@@ -44,7 +45,9 @@ def main() -> None:
     fleet.init_distributed()   # store-based coordinator rendezvous
     rank, world = fleet.worker_index(), fleet.worker_num()
     assert jax.process_count() == world, (jax.process_count(), world)
-    assert len(jax.devices()) == 8, jax.devices()
+    n_devs = len(jax.devices())
+    want = world * int(os.environ.get("PBTPU_DEVS_PER_PROC", "4"))
+    assert n_devs == want, (n_devs, want)
 
     # GPUPS variant: every process's shard stores live on ONE central CPU
     # PS over TCP (the distributed-full-store → per-pass-HBM-slab
@@ -59,21 +62,24 @@ def main() -> None:
         store_factory = ps_store_factory(ps_client, cfg["ps_table_id"],
                                          process_primary=(rank == 0))
 
-    files = cfg["files"][rank * 4:(rank + 1) * 4]
+    assert len(cfg["files"]) % world == 0, (len(cfg["files"]), world)
+    nf = len(cfg["files"]) // world
+    files = cfg["files"][rank * nf:(rank + 1) * nf]
     D = cfg["embedx_dim"]
     feed = default_feed_config(num_slots=cfg["num_slots"],
                                batch_size=cfg["batch_size"],
                                max_len=cfg["max_len"])
     table_cfg = TableConfig(
-        embedx_dim=D, pass_capacity=8 * 1024,
+        embedx_dim=D, pass_capacity=n_devs * 1024,
         optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
                                         mf_initial_range=1e-3,
                                         feature_learning_rate=0.1,
-                                        mf_learning_rate=0.1))
-    # mesh_2d: the node axis spans the two processes (real DCN boundary)
-    # and the chip axis the 4 in-process devices — hierarchical dense sync
-    mesh = (device_mesh_2d(2, 4) if cfg.get("mesh_2d")
-            else device_mesh_1d(8))
+                                        mf_learning_rate=0.1),
+        **(cfg.get("table_overrides") or {}))
+    # mesh_2d: the node axis spans the processes (real DCN boundary)
+    # and the chip axis the in-process devices — hierarchical dense sync
+    mesh = (device_mesh_2d(world, n_devs // world) if cfg.get("mesh_2d")
+            else device_mesh_1d(n_devs))
     trainer = ShardedBoxTrainer(
         CtrDnn(ModelSpec(num_slots=cfg["num_slots"], slot_dim=3 + D),
                hidden=(32, 16)),
@@ -111,20 +117,52 @@ def main() -> None:
     # ---- cross-host instance shuffle phase (ShuffleData/PaddleShuffler):
     # re-enable shuffle, route the load through the TcpShuffler, train one
     # more pass; instance totals must be conserved across the cluster
-    from paddlebox_tpu.config import flags as pbx_flags
-    pbx_flags.set_flag("dataset_disable_shuffle", False)
-    shuffler = fleet.make_shuffler(batch_records=64)
-    ds = BoxDataset(feed, read_threads=1, shuffler=shuffler)
-    ds.set_filelist(files)
-    shuffled_stats = trainer.train_pass(ds)
-    local_after_shuffle = len(ds)
-    total_after_shuffle = int(fleet.all_reduce(
-        np.asarray([local_after_shuffle], np.int64), "sum")[0])
-    shuffled_loss = shuffled_stats["loss"]
-    ds.release_memory()
-    if shuffler is not None:
-        shuffler.close()
-    pbx_flags.set_flag("dataset_disable_shuffle", True)
+    local_after_shuffle = total_after_shuffle = shuffled_loss = None
+    if not cfg.get("skip_shuffle_phase"):
+        from paddlebox_tpu.config import flags as pbx_flags
+        pbx_flags.set_flag("dataset_disable_shuffle", False)
+        shuffler = fleet.make_shuffler(batch_records=64)
+        ds = BoxDataset(feed, read_threads=1, shuffler=shuffler)
+        ds.set_filelist(files)
+        shuffled_stats = trainer.train_pass(ds)
+        local_after_shuffle = len(ds)
+        total_after_shuffle = int(fleet.all_reduce(
+            np.asarray([local_after_shuffle], np.int64), "sum")[0])
+        shuffled_loss = shuffled_stats["loss"]
+        ds.release_memory()
+        if shuffler is not None:
+            shuffler.close()
+        pbx_flags.set_flag("dataset_disable_shuffle", True)
+
+    # ---- GPUPS spill + day boundary leg (4-proc composition test):
+    # apply the table-wide DRAM budget (primary-gated limit_mem), train one
+    # more pass so spilled rows fault back through the server pull, then
+    # run the day boundary — aging and the shrink decay must hit the
+    # server EXACTLY once across the whole cluster (process_primary
+    # gating; the Px-decay bug class ps_store.py defends against)
+    spilled = post_spill_loss = probe_key = show_before = None
+    if cfg.get("spill_and_day") and ps_client is not None:
+        # train_pass applies the budget at every pass end already (the
+        # CheckNeedLimitMem cadence); one more pass proves spilled rows
+        # fault back through the server pull, and the accumulated stat
+        # shows the limit ran ONLY through this process's primary
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        post_spill_loss = trainer.train_pass(ds)["loss"]
+        ds.release_memory()
+        from paddlebox_tpu.utils.stats import stat_get
+        spilled = int(stat_get("ps_rows_spilled"))
+        if rank == 0:
+            # a key this rank owns and trained in the last pass
+            probe_key = int(trainer.table._shard_keys[
+                trainer.local_positions[0]][0])
+            from paddlebox_tpu.embedding import accessor as acc
+            show_before = float(ps_client.pull_sparse(
+                cfg["ps_table_id"], np.array([probe_key], np.uint64),
+                create=False)[0, acc.SHOW])
+        fleet.barrier_worker()         # probe read before any decay
+        trainer.table.end_day(age=True)
+        fleet.barrier_worker()         # boundary done on every rank
 
     ps_rows = (int(ps_client.sparse_size(cfg["ps_table_id"]))
                if ps_client is not None else None)
@@ -135,6 +173,8 @@ def main() -> None:
         "total_after_shuffle": total_after_shuffle,
         "shuffled_loss": shuffled_loss,
         "ps_rows": ps_rows,
+        "spilled": spilled, "post_spill_loss": post_spill_loss,
+        "probe_key": probe_key, "show_before": show_before,
     }), flush=True)
     if ps_client is not None:
         ps_client.close()

@@ -75,8 +75,9 @@ def run_single_process_oracle(files, feed):
     return losses, msg, rows
 
 
-def run_two_process_cluster(files, extra_cfg=None):
-    """Spawn the 2-process localhost cluster (subprocess pattern,
+def run_cluster(files, extra_cfg=None, world=2,
+                            devs_per_proc=4):
+    """Spawn a `world`-process localhost cluster (subprocess pattern,
     test_dist_base.py:896-1012) and collect each rank's RESULT line."""
     from paddlebox_tpu.fleet.store import KVStoreServer
     server = KVStoreServer(host="127.0.0.1")
@@ -88,16 +89,17 @@ def run_two_process_cluster(files, extra_cfg=None):
     run_id = uuid.uuid4().hex[:8]
     procs = []
     try:
-        for rank in range(2):
+        for rank in range(world):
             env = dict(os.environ)
-            env.pop("XLA_FLAGS", None)  # worker sets its own 4-device flag
+            env.pop("XLA_FLAGS", None)  # worker sets its own device flag
             repo_root = os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__)))
             env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
                 "PYTHONPATH", "")
             env.update({
                 "PBTPU_TRAINER_ID": str(rank),
-                "PBTPU_TRAINERS_NUM": "2",
+                "PBTPU_TRAINERS_NUM": str(world),
+                "PBTPU_DEVS_PER_PROC": str(devs_per_proc),
                 "PBTPU_STORE_ENDPOINT": "127.0.0.1:%d" % server.port,
                 "PBTPU_RUN_ID": run_id,
             })
@@ -123,7 +125,7 @@ def run_two_process_cluster(files, extra_cfg=None):
 def test_two_process_cluster_matches_single_process(data, tmp_path):
     files, feed = data
     ref_losses, ref_msg, ref_rows = run_single_process_oracle(files, feed)
-    results = run_two_process_cluster(files)
+    results = run_cluster(files)
 
     assert set(results) == {0, 1}
     # losses identical across ranks (replicated pmean) and vs the oracle
@@ -176,7 +178,7 @@ def test_two_process_gpups_over_central_ps(data):
                                         mf_learning_rate=0.1))
     try:
         admin.create_sparse_table(7, table_cfg, shard_num=8, seed=0)
-        results = run_two_process_cluster(
+        results = run_cluster(
             files, {"ps_endpoint": "127.0.0.1:%d" % server.port,
                     "ps_table_id": 7})
         assert set(results) == {0, 1}
@@ -199,7 +201,7 @@ def test_two_process_hierarchical_mesh(data):
     single-process oracle."""
     files, feed = data
     ref_losses, ref_msg, ref_rows = run_single_process_oracle(files, feed)
-    results = run_two_process_cluster(files, {"mesh_2d": True})
+    results = run_cluster(files, {"mesh_2d": True})
 
     assert set(results) == {0, 1}
     np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
@@ -217,3 +219,62 @@ def test_two_process_hierarchical_mesh(data):
                                        err_msg=f"row mismatch key {k}")
             checked += 1
     assert checked >= 8, f"only {checked} rows overlapped"
+
+
+def test_four_process_gpups_spill_and_day_boundary(data, tmp_path):
+    """4-process cluster (VERDICT r2 #8): GPUPS store_factory + an active
+    SSD spill budget + a day boundary. Catches the ownership/primary-
+    gating bug class 2 processes can't: aging and the shrink decay must
+    hit the central PS EXACTLY once (not world x), and the spill must run
+    once through the primary, with spilled rows faulting back through the
+    next pass's server pull."""
+    from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
+                                              TableConfig)
+    from paddlebox_tpu.embedding import accessor as acc
+    from paddlebox_tpu.embedding.accessor import ValueLayout
+    from paddlebox_tpu.ps import PSServer, TcpPSClient
+
+    files, feed = data
+    width = ValueLayout(D, "adagrad").width
+    budget_rows = 128
+    ssd = {"ssd_dir": str(tmp_path / "ps_ssd"),
+           "ssd_threshold_mb": budget_rows * width * 4 / (1 << 20)}
+    overrides = dict(ssd, show_click_decay_rate=0.5,
+                     delete_after_unseen_days=30.0, delete_threshold=0.0)
+    server = PSServer()
+    admin = TcpPSClient("127.0.0.1", server.port)
+    table_cfg = TableConfig(
+        embedx_dim=D, pass_capacity=8 * 1024,
+        optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                        mf_initial_range=1e-3,
+                                        feature_learning_rate=0.1,
+                                        mf_learning_rate=0.1),
+        **overrides)
+    try:
+        admin.create_sparse_table(9, table_cfg, shard_num=8, seed=0)
+        results = run_cluster(
+            files, {"ps_endpoint": "127.0.0.1:%d" % server.port,
+                    "ps_table_id": 9, "spill_and_day": True,
+                    "skip_shuffle_phase": True,
+                    "table_overrides": overrides},
+            world=4, devs_per_proc=2)
+        assert set(results) == {0, 1, 2, 3}
+        # the spill ran exactly once, through rank 0's primary store
+        assert results[0]["spilled"] > 0, results[0]
+        for r in (1, 2, 3):
+            assert results[r]["spilled"] == 0, results[r]
+        # training continued after the spill on every rank (fault-in works)
+        for r in results.values():
+            assert np.isfinite(r["post_spill_loss"]), r
+        # day boundary hit the server exactly once: unseen aged 0 -> 1 and
+        # the show decay applied once (0.5x), not world x
+        key = np.array([results[0]["probe_key"]], np.uint64)
+        row = admin.pull_sparse(9, key, create=False)[0]
+        assert row[acc.UNSEEN_DAYS] == 1.0, row[acc.UNSEEN_DAYS]
+        np.testing.assert_allclose(row[acc.SHOW],
+                                   results[0]["show_before"] * 0.5,
+                                   rtol=1e-6)
+        assert admin.sparse_size(9) > 100
+    finally:
+        admin.stop_server()
+        admin.close()
