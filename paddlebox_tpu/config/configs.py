@@ -176,3 +176,9 @@ class TrainerConfig:
     # params/optimizer state stay f32, matmuls run bf16 on the MXU; bf16
     # keeps f32's exponent range so CTR losses need no loss scaling)
     compute_dtype: str = "float32"
+    # sharded-trainer pull/push all_to_all payload dtype: "float32" |
+    # "bfloat16". bf16 halves the ICI bytes of the two value a2as (the
+    # walk_to_src/walk_to_dest traffic); the in-table optimizer still
+    # merges and updates in f32 (grads upcast after transport). The slab
+    # and its state columns are untouched — only the wire format changes.
+    a2a_dtype: str = "float32"

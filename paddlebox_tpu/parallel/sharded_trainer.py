@@ -158,9 +158,15 @@ class ShardedBoxTrainer:
         self.use_cvm = use_cvm
         self.multi_task = len(getattr(model, "task_names", ("ctr",))) > 1
         # NN-cross models: extended pull + expand-grad push through the a2a
-        from paddlebox_tpu.train.trainer import check_expand_config
+        from paddlebox_tpu.train.trainer import (check_expand_config,
+                                                 resolve_compute_dtype)
         self.use_expand = bool(getattr(model, "use_expand", False))
         check_expand_config(model, self.table.layout, self.use_expand)
+        # wire format of the two VALUE a2as — resolved ONCE; both the pull
+        # and push builders read these
+        self.a2a_dtype = resolve_compute_dtype(self.cfg.a2a_dtype,
+                                               field="a2a_dtype")
+        self.a2a_cast = self.a2a_dtype != jnp.float32
         self._slabs: Optional[jax.Array] = None
         self._prng = jax.random.PRNGKey(seed + 17)
         self._shuffle_rng = np.random.RandomState(seed + 1)
@@ -257,6 +263,10 @@ class ShardedBoxTrainer:
         wants_rank_offset = model_accepts_rank_offset(model)
         cdtype = resolve_compute_dtype(self.cfg.compute_dtype)
         mixed = cdtype != jnp.float32
+        # wire format of the two VALUE a2as (walk_to_src/walk_to_dest
+        # traffic): bf16 halves the ICI bytes; values upcast to f32 right
+        # after transport so pooling/merging/in-table updates stay f32
+        a2a_dtype, a2a_cast = self.a2a_dtype, self.a2a_cast
         use_expand = self.use_expand
         base_w = 3 + layout.embedx_dim
 
@@ -275,9 +285,13 @@ class ShardedBoxTrainer:
                 vals = jnp.concatenate([base, exp], axis=1)
             else:
                 vals = pull_sparse(slab, req.reshape(-1), layout)
+            if a2a_cast:
+                vals = vals.astype(a2a_dtype)
             resp = jax.lax.all_to_all(
                 vals.reshape(Pn, KB, -1), axis, 0, 0, tiled=True)
             emb = resp.reshape(Pn * KB, -1)[batch["restore"]]  # [K, Dp(+E)]
+            if a2a_cast:
+                emb = emb.astype(jnp.float32)
             if use_expand:
                 emb = (emb[:, :base_w], emb[:, base_w:])
             return emb, req
@@ -343,6 +357,7 @@ class ShardedBoxTrainer:
             raise ValueError("expand embedding + data_norm summary is not "
                              "supported in one model")
         collect_T = self._collect_T
+        a2a_dtype, a2a_cast = self.a2a_dtype, self.a2a_cast
         pull_emb, forward_logits, preds_of = self._pull_and_forward()
 
         def shard_step(slab, params, opt_state, batch, prng, mtab, mstats):
@@ -487,8 +502,12 @@ class ShardedBoxTrainer:
             bucket_g = jnp.zeros((Pn * KB, pg.shape[1]), pg.dtype
                                  ).at[batch["restore"]].add(
                 jnp.where(batch["valid"][:, None], pg, 0.0))
+            if a2a_cast:
+                bucket_g = bucket_g.astype(a2a_dtype)
             recv_g = jax.lax.all_to_all(
                 bucket_g.reshape(Pn, KB, -1), axis, 0, 0, tiled=True)
+            if a2a_cast:
+                recv_g = recv_g.astype(jnp.float32)
             if "push_uids" in batch:
                 # single-process mesh: the incoming-id dedup was precomputed
                 # on the host (shard_batches) — no device sort

@@ -56,9 +56,16 @@ def _builtin(name: str):
     if name == "DownpourTrainer":
         from paddlebox_tpu.ps.worker import DownpourTrainer
         return DownpourTrainer
-    if name in ("PipelineTrainer", "HeterPipelineTrainer"):
+    if name == "PipelineTrainer":
         from paddlebox_tpu.parallel.pipeline import GPipeRunner
         return GPipeRunner
+    if name in ("CtrPipelineTrainer", "HeterPipelineTrainer"):
+        # the reference's HeterPipelineTrainer (trainer.h:341) cuts the
+        # REAL training program into sections pipelined across devices;
+        # the CTR program split (sparse section → tower sections → head)
+        # is that capability on this runtime
+        from paddlebox_tpu.parallel.pipeline import CtrPipelineRunner
+        return CtrPipelineRunner
     return None
 
 

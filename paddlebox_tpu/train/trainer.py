@@ -238,14 +238,16 @@ def model_accepts_rank_offset(model) -> bool:
         return False
 
 
-def resolve_compute_dtype(name: str) -> jnp.dtype:
-    """Validated compute dtype: f32 or bf16 only — the no-loss-scaling
-    mixed-precision contract relies on bf16's f32-sized exponent range
-    (f16 would need loss scaling this path doesn't implement)."""
+def resolve_compute_dtype(name: str, field: str = "compute_dtype"
+                          ) -> jnp.dtype:
+    """Validated compute/wire dtype: f32 or bf16 only — the
+    no-loss-scaling mixed-precision contract relies on bf16's f32-sized
+    exponent range (f16 would need loss scaling this path doesn't
+    implement). `field` names the config field in the error."""
     d = jnp.dtype(name)
     if d not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
         raise ValueError(
-            f"compute_dtype must be float32 or bfloat16, got {name!r}")
+            f"{field} must be float32 or bfloat16, got {name!r}")
     return d
 
 

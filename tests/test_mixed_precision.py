@@ -78,3 +78,31 @@ def test_bf16_sharded_trainer_step(data):
     assert np.isfinite(stats["loss"])
     for leaf in jax.tree.leaves(trainer.params):
         assert leaf.dtype == np.float32, leaf.dtype
+
+
+def test_bf16_a2a_payload_close_to_f32(data):
+    """a2a_dtype='bfloat16' halves the value-a2a wire bytes (the
+    walk_to_src/walk_to_dest traffic); the in-table state stays f32, so
+    training tracks the f32-wire run closely and still learns."""
+    files, feed = data
+
+    def run(a2a_dtype):
+        trainer = ShardedBoxTrainer(
+            CtrDnn(ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + D),
+                   hidden=(16,)),
+            table_cfg(), feed,
+            TrainerConfig(dense_lr=0.01, scan_chunk=1,
+                          a2a_dtype=a2a_dtype),
+            mesh=device_mesh_1d(8), seed=0)
+        losses = []
+        for _ in range(4):
+            ds = BoxDataset(feed, read_threads=1)
+            ds.set_filelist(files)
+            losses.append(trainer.train_pass(ds)["loss"])
+            ds.release_memory()
+        return losses
+
+    l32 = run("float32")
+    l16 = run("bfloat16")
+    assert l16[-1] < l16[0], l16               # still learns
+    np.testing.assert_allclose(l16, l32, rtol=3e-2)

@@ -222,3 +222,23 @@ def test_ctr_pipeline_learns(tmp_path):
     keys, vals = r.table.store.state_items()
     assert keys.size > 50
     assert vals[:, acc.SHOW].sum() > 0      # write-back happened
+
+
+def test_factory_resolves_pipeline_trainers(tmp_path):
+    """Reference trainer names resolve: PipelineTrainer → the GPipe
+    runner; HeterPipelineTrainer/CtrPipelineTrainer → the CTR program
+    split (trainer_factory.cc:68-89 name surface)."""
+    from paddlebox_tpu.parallel.pipeline import (CtrPipelineRunner,
+                                                 GPipeRunner,
+                                                 PipelineConfig)
+    from paddlebox_tpu.train.factory import create_trainer
+
+    r = create_trainer("PipelineTrainer",
+                       PipelineConfig(n_stages=2, n_micro=4, d_model=8,
+                                      layers_per_stage=1), seed=0)
+    assert isinstance(r, GPipeRunner)
+
+    files, feed = _ctr_setup(tmp_path, n_files=1, lines=64, mb=16)
+    r2 = create_trainer("HeterPipelineTrainer", _ctr_table(), feed,
+                        n_stages=2, d_model=16, n_micro=4, seed=0)
+    assert isinstance(r2, CtrPipelineRunner)
