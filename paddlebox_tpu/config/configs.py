@@ -158,6 +158,9 @@ class TrainerConfig:
     thread_num: int = 1                  # worker threads (one per local device)
     sync_mode: str = "step"              # step | k_step | async | sharding
     sync_weight_step: int = 1            # K in K-step dense sync
+    # one flat allreduce ring across ALL devices even on a 2D (node, chip)
+    # mesh, instead of the hierarchical RS/psum/AG split (the reference's
+    # sync_one_ring_ TrainerDesc knob, boxps_worker.cc SyncParam)
     sync_one_ring: bool = False
     async_mode: bool = False             # host async dense table
     sharding: bool = False               # ZeRO-1 dense param partitioning

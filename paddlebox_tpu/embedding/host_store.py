@@ -421,7 +421,12 @@ class HostEmbeddingStore:
 
     def load(self, path: str) -> None:
         with open(path, "rb") as f:
-            blob = pickle.load(f)
+            self.load_blob(pickle.load(f))
+
+    def load_blob(self, blob: Dict) -> None:
+        """Restore from an in-memory checkpoint dict (the post-pickle half
+        of load — ShardedStoreView splits one blob across shards without
+        re-serializing)."""
         if blob["embedx_dim"] != self.layout.embedx_dim or \
                 blob["optimizer"] != self.layout.optimizer:
             raise ValueError("checkpoint layout mismatch")

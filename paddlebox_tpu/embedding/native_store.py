@@ -304,7 +304,11 @@ class NativeHostEmbeddingStore:
 
     def load(self, path: str) -> None:
         with open(path, "rb") as f:
-            blob = pickle.load(f)
+            self.load_blob(pickle.load(f))
+
+    def load_blob(self, blob: dict) -> None:
+        """Restore from an in-memory checkpoint dict (see
+        HostEmbeddingStore.load_blob)."""
         if blob["embedx_dim"] != self.layout.embedx_dim or \
                 blob["optimizer"] != self.layout.optimizer:
             raise ValueError("checkpoint layout mismatch")

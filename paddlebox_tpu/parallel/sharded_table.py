@@ -24,6 +24,7 @@ dense compute where profitable.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import List, Optional, Tuple
 
 import jax
@@ -373,3 +374,81 @@ class ShardedPassTable:
         """LoadSSD2Mem over the owned shards (box_wrapper.cc:1319)."""
         return sum(st.load_spilled() for st in self.stores
                    if st is not None and hasattr(st, "load_spilled"))
+
+    def store_view(self) -> "ShardedStoreView":
+        """One store-shaped facade over the owned shards, so the
+        CheckpointManager/run_day day cadence drives the sharded table
+        with the same code as the single-host PassTable. PS-backed shards
+        checkpoint server-side (PSClient.save) and reject this view."""
+        from paddlebox_tpu.embedding.ps_store import PSBackedStore
+        for st in self.stores:
+            if st is None:
+                # a DONE-marked base model missing the non-owned shards'
+                # rows would read as complete — fail here instead
+                raise TypeError(
+                    "store_view needs every shard local (single process); "
+                    "multi-process jobs checkpoint per owned shard via "
+                    "table.save()")
+            if isinstance(st, PSBackedStore):
+                raise TypeError("PS-backed shards checkpoint server-side "
+                                "(PSClient.save), not through store_view")
+        return ShardedStoreView(self)
+
+
+class ShardedStoreView:
+    """state_items/write_back/spilled_snapshot/load over a
+    ShardedPassTable's OWNED shard stores — the store protocol subset the
+    checkpoint tier consumes. Keys route by key % P, identical to the
+    table's own sharding, so a view round trip lands every row in its
+    owning store."""
+
+    def __init__(self, table: ShardedPassTable) -> None:
+        self._table = table
+
+    def _owned(self):
+        return [(s, st) for s, st in enumerate(self._table.stores)
+                if st is not None]
+
+    def state_items(self) -> Tuple[np.ndarray, np.ndarray]:
+        parts = [st.state_items() for _, st in self._owned()]
+        keys = np.concatenate([k for k, _ in parts]) if parts else \
+            np.empty(0, np.uint64)
+        vals = (np.vstack([v for _, v in parts]) if parts else
+                np.empty((0, self._table.layout.width), np.float32))
+        return keys, vals
+
+    def spilled_snapshot(self) -> Tuple[np.ndarray, np.ndarray]:
+        ks, vs = [], []
+        for _, st in self._owned():
+            snap = getattr(st, "spilled_snapshot", None)
+            if snap is None:
+                continue
+            k, v = snap()
+            if k.size:
+                ks.append(k)
+                vs.append(v)
+        if not ks:
+            return (np.empty(0, np.uint64),
+                    np.empty((0, self._table.layout.width), np.float32))
+        return np.concatenate(ks), np.vstack(vs)
+
+    def write_back(self, keys: np.ndarray, values: np.ndarray) -> None:
+        keys = np.asarray(keys, np.uint64)
+        P = np.uint64(self._table.num_shards)
+        for s, st in self._owned():
+            m = keys % P == np.uint64(s)
+            if m.any():
+                st.write_back(keys[m], values[m])
+
+    def load(self, path: str) -> None:
+        """Split a single checkpoint blob across the shard stores (their
+        load_blob handles index reset, stale-spill clearing, and layout
+        validation) — one deserialization, no temp files."""
+        import pickle
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        keys = np.asarray(blob["keys"], np.uint64)
+        P = np.uint64(self._table.num_shards)
+        for s, st in self._owned():
+            m = keys % P == np.uint64(s)
+            st.load_blob(dict(blob, keys=keys[m], values=blob["values"][m]))

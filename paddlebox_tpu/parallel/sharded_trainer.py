@@ -349,6 +349,7 @@ class ShardedBoxTrainer:
         chips = self.chips
         sharding_mode = self.sharding_mode
         k_step = self.k_step
+        one_ring = self.cfg.sync_one_ring
         lr = self.cfg.dense_lr
         has_summary = (getattr(model, "use_data_norm", False)
                        and hasattr(model, "update_summary"))
@@ -458,7 +459,7 @@ class ShardedBoxTrainer:
                 params = jax.tree.map(lambda x: x[None], params)
                 opt_state = jax.tree.map(lambda x: x[None], opt_state)
             else:
-                if hier:
+                if hier and not one_ring:
                     # 2-level grad mean (numerically identical to the flat
                     # pmean): scatter → node psum → allgather over chips
                     flat_g, unravel_g = jax.flatten_util.ravel_pytree(
@@ -469,7 +470,8 @@ class ShardedBoxTrainer:
                         g_sh, chip_axis, tiled=True)[:n]
                     dparams = unravel_g(flat_g)
                 else:
-                    # per-step data-parallel allreduce (SyncParam/NCCL)
+                    # per-step data-parallel allreduce (SyncParam/NCCL;
+                    # sync_one_ring forces this flat ring on a 2D mesh)
                     dparams = jax.lax.pmean(dparams, axis)
                 updates, opt_state = self.dense_opt.update(
                     dparams, opt_state, params)
@@ -919,6 +921,20 @@ class ShardedBoxTrainer:
         if self.k_step > 1:
             return jax.tree.map(lambda x: np.asarray(x).mean(0), self.params)
         return self.params
+
+    def merged_opt_state(self):
+        """Single-copy optimizer state for checkpoints — the k_step merge
+        merged_params applies, on the moments (float leaves average, int
+        leaves like the adam count are identical replicas: take one), so
+        a base model never bakes the mesh size into dense.pkl."""
+        if self.k_step > 1:
+            def _merge(x):
+                a = np.asarray(x)
+                if a.ndim and np.issubdtype(a.dtype, np.floating):
+                    return a.mean(0)
+                return a[0] if a.ndim else a
+            return jax.tree.map(_merge, self.opt_state)
+        return self.opt_state
 
     def _local_rows(self, arr: jax.Array) -> np.ndarray:
         """Host copy of this process's piece of a mesh-sharded output

@@ -28,9 +28,16 @@ from paddlebox_tpu.embedding.pass_table import PassTable
 
 
 class CheckpointManager:
-    def __init__(self, cfg: CheckpointConfig, table: PassTable) -> None:
+    def __init__(self, cfg: CheckpointConfig, table) -> None:
+        """table: PassTable (single host) or ShardedPassTable — the
+        sharded table checkpoints through its store_view facade, so ONE
+        save/load/delta implementation serves both topologies
+        (multi-process jobs checkpoint per owned shard via table.save
+        instead)."""
         self.cfg = cfg
         self.table = table
+        self.store = (table.store if hasattr(table, "store")
+                      else table.store_view())
         self._save_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------ batch tier
@@ -47,7 +54,7 @@ class CheckpointManager:
         os.makedirs(batch_dir, exist_ok=True)
         os.makedirs(xbox_dir, exist_ok=True)
 
-        keys, values = self.table.store.state_items()  # snapshot (copy)
+        keys, values = self.store.state_items()  # snapshot (copy)
         # SSD-tier rows are NOT in state_items(); a base model must cover
         # them (the reference's SaveBase covers SSD-tier rows) or a resume
         # after load_base — which clears the spill index — loses every
@@ -65,7 +72,7 @@ class CheckpointManager:
         self.table.layout.update_stat_after_save(values, self.table.config, 1)
         self.table.layout.update_stat_after_save(values, self.table.config, 3)
         if keys.size:
-            self.table.store.write_back(keys, values)
+            self.store.write_back(keys, values)
 
         def do_save():
             with open(os.path.join(batch_dir, "sparse.pkl"), "wb") as f:
@@ -91,12 +98,12 @@ class CheckpointManager:
         xbox_dir = os.path.join(self.cfg.xbox_model_dir, day,
                                 f"delta-{delta_id}")
         os.makedirs(xbox_dir, exist_ok=True)
-        keys, values = self.table.store.state_items()
+        keys, values = self.store.state_items()
         blob = self._xbox_view(keys, values, base=False)
         # clear covered rows' delta (UpdateStatAfterSave param=1) — sync
         self.table.layout.update_stat_after_save(values, self.table.config, 1)
         if keys.size:
-            self.table.store.write_back(keys, values)
+            self.store.write_back(keys, values)
 
         def do_save():
             self._write_xbox(xbox_dir, blob)
@@ -109,7 +116,7 @@ class CheckpointManager:
         return xbox_dir
 
     def _spilled_snapshot(self):
-        snap = getattr(self.table.store, "spilled_snapshot", None)
+        snap = getattr(self.store, "spilled_snapshot", None)
         if snap is None:
             return (np.empty(0, np.uint64),
                     np.empty((0, self.table.layout.width), np.float32))
@@ -151,7 +158,7 @@ class CheckpointManager:
         batch_dir = os.path.join(self.cfg.batch_model_dir, day)
         if not os.path.exists(os.path.join(batch_dir, "DONE")):
             raise FileNotFoundError(f"no completed checkpoint at {batch_dir}")
-        self.table.store.load(os.path.join(batch_dir, "sparse.pkl"))
+        self.store.load(os.path.join(batch_dir, "sparse.pkl"))
         with open(os.path.join(batch_dir, "dense.pkl"), "rb") as f:
             blob = pickle.load(f)
         return blob["params"], blob["opt_state"], blob["extra"]
@@ -174,16 +181,18 @@ def run_day(trainer, datasets, cm: CheckpointManager, day: str,
     SaveDelta on the configured cadence; at day end SaveBase + the
     end_day(age=False) shrink — save_base already aged the residents).
 
-    trainer: BoxTrainer (CheckpointManager snapshots through the
-    single-host PassTable; the sharded trainer checkpoints per owned
-    shard via its table's save()). datasets: the day's passes.
+    trainer: BoxTrainer or a single-process ShardedBoxTrainer (the
+    CheckpointManager snapshots through PassTable.store or the sharded
+    table's store_view; multi-process jobs checkpoint per owned shard via
+    table.save()). datasets: the day's passes.
     Returns (per-pass stats, (batch_dir, xbox_dir) of the day's base save).
     """
     from paddlebox_tpu.train.preload import run_preloaded_passes
 
-    if not hasattr(trainer.table, "store"):
-        raise TypeError("run_day drives the single-host BoxTrainer; "
-                        "sharded tables checkpoint via table.save()")
+    if getattr(trainer, "multiprocess", False):
+        raise TypeError("multi-process jobs checkpoint per owned shard "
+                        "(table.save) — run_day's single-blob cadence "
+                        "drives single-process trainers (Box or Sharded)")
     every = max(1, cm.cfg.save_delta_every_passes)
     state = {"delta_id": 0}
 
@@ -203,7 +212,12 @@ def run_day(trainer, datasets, cm: CheckpointManager, day: str,
             stats.append(trainer.train_pass(ds))
             on_pass(i, stats[-1])
             ds.release_memory()
-    dirs = cm.save_base(trainer.params, trainer.opt_state, day)
+    params = (trainer.merged_params() if hasattr(trainer, "merged_params")
+              else trainer.params)
+    opt_state = (trainer.merged_opt_state()
+                 if hasattr(trainer, "merged_opt_state")
+                 else trainer.opt_state)
+    dirs = cm.save_base(params, opt_state, day)
     trainer.table.end_day(age=False)
     cm.wait()
     return stats, dirs

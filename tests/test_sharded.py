@@ -424,3 +424,30 @@ def test_device_collect_auc_parity(sharded_setup):
                                    err_msg=k)
     np.testing.assert_allclose(msg_dev["bucket_error"],
                                msg_host["bucket_error"], atol=5e-3)
+
+
+def test_sync_one_ring_matches_hierarchical(sharded_setup):
+    """sync_one_ring forces the flat allreduce ring on a 2D mesh — same
+    result as the hierarchical split (they compute the same mean), just a
+    different collective schedule (the reference's sync_one_ring_ knob)."""
+    from paddlebox_tpu.parallel.mesh import device_mesh_2d
+
+    files, feed = sharded_setup
+
+    def run(one_ring):
+        trainer = ShardedBoxTrainer(
+            CtrDnn(ModelSpec(num_slots=4, slot_dim=3 + D), hidden=(16,)),
+            table_cfg(), feed,
+            TrainerConfig(dense_lr=0.01, scan_chunk=1,
+                          sync_one_ring=one_ring),
+            mesh=device_mesh_2d(2, 4), seed=0)
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        loss = trainer.train_pass(ds)["loss"]
+        return loss, [np.asarray(l) for l in jax.tree.leaves(trainer.params)]
+
+    loss_h, params_h = run(False)
+    loss_r, params_r = run(True)
+    np.testing.assert_allclose(loss_h, loss_r, rtol=1e-6)
+    for a, b in zip(params_h, params_r):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
