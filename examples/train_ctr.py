@@ -27,6 +27,10 @@ def main() -> None:
     ap.add_argument("--passes", type=int, default=4)
     ap.add_argument("--bf16", action="store_true",
                     help="bfloat16 dense compute (MXU path)")
+    ap.add_argument("--expand-dim", type=int, default=0,
+                    help="NN-cross: train a second (expand) embedding "
+                         "block per feature through the extended pull "
+                         "(pull_box_extended_sparse path)")
     ap.add_argument("--workdir", default=None)
     args = ap.parse_args()
 
@@ -34,7 +38,7 @@ def main() -> None:
                                               SparseOptimizerConfig,
                                               TableConfig, TrainerConfig)
     from paddlebox_tpu.data import BoxDataset, write_synthetic_ctr_files
-    from paddlebox_tpu.models import DeepFM
+    from paddlebox_tpu.models import CtrDnnExpand, DeepFM
     from paddlebox_tpu.models.base import ModelSpec
     from paddlebox_tpu.train.checkpoint import CheckpointManager
     from paddlebox_tpu.train.recovery import RecoverableRunner
@@ -54,10 +58,15 @@ def main() -> None:
     D = 8
     table = TableConfig(
         embedx_dim=D, pass_capacity=1 << 18,
+        expand_embed_dim=args.expand_dim,
         optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
                                         mf_initial_range=1e-3))
+    spec = ModelSpec(num_slots=16, slot_dim=3 + D)
+    model = (CtrDnnExpand(spec, expand_dim=args.expand_dim,
+                          hidden=(256, 128)) if args.expand_dim
+             else DeepFM(spec, hidden=(256, 128)))
     trainer = BoxTrainer(
-        DeepFM(ModelSpec(num_slots=16, slot_dim=3 + D), hidden=(256, 128)),
+        model,
         table, feed,
         TrainerConfig(dense_lr=1e-3,
                       compute_dtype="bfloat16" if args.bf16 else "float32"),

@@ -29,12 +29,29 @@ def main() -> None:
     ap.add_argument("--passes", type=int, default=3)
     ap.add_argument("--sync", default="step",
                     choices=["step", "k_step", "sharding"])
+    ap.add_argument("--mesh-2d", type=int, default=0, metavar="NODES",
+                    help="hierarchical (node, chip) mesh with this many "
+                         "node rows: dense sync reduce-scatters on ICI "
+                         "and psums 1/chips of the bytes over DCN")
+    ap.add_argument("--a2a-dtype", default="float32",
+                    choices=["float32", "bfloat16"],
+                    help="wire format of the pull/push value all_to_alls "
+                         "(bfloat16 halves the ICI bytes)")
+    ap.add_argument("--device-auc", action="store_true",
+                    help="accumulate the AUC bucket table inside the "
+                         "jitted step (one D2H per pass, no per-step "
+                         "prediction transfer)")
     ap.add_argument("--gpups", action="store_true",
                     help="back the shard stores with a TCP CPU PS")
     ap.add_argument("--ssd-budget-mb", type=float, default=0,
                     help="feed-ranking posture: host-DRAM row budget; rows "
                          "beyond it spill to an SSD tier each end_pass")
     args = ap.parse_args()
+    if args.mesh_2d:
+        import jax as _jax
+        if len(_jax.devices()) % args.mesh_2d:
+            ap.error(f"--mesh-2d {args.mesh_2d} does not divide "
+                     f"{len(_jax.devices())} devices")
     if args.gpups and args.ssd_budget_mb:
         ap.error("--ssd-budget-mb spills the LOCAL host stores; with "
                  "--gpups the stores live on the CPU PS (its tables manage "
@@ -47,7 +64,7 @@ def main() -> None:
     from paddlebox_tpu.data import BoxDataset, write_synthetic_ctr_files
     from paddlebox_tpu.models import DeepFM
     from paddlebox_tpu.models.base import ModelSpec
-    from paddlebox_tpu.parallel.mesh import device_mesh_1d
+    from paddlebox_tpu.parallel.mesh import device_mesh_1d, device_mesh_2d
     from paddlebox_tpu.parallel.sharded_trainer import ShardedBoxTrainer
     from paddlebox_tpu.train.preload import run_preloaded_passes
 
@@ -68,7 +85,8 @@ def main() -> None:
         ssd_threshold_mb=args.ssd_budget_mb)
     tcfg = TrainerConfig(dense_lr=1e-3, sync_mode=args.sync,
                          sync_weight_step=4 if args.sync == "k_step" else 1,
-                         sharding=args.sync == "sharding")
+                         sharding=args.sync == "sharding",
+                         a2a_dtype=args.a2a_dtype)
 
     store_factory = None
     ps_client = None
@@ -83,9 +101,12 @@ def main() -> None:
 
     trainer = ShardedBoxTrainer(
         DeepFM(ModelSpec(num_slots=16, slot_dim=3 + D), hidden=(256, 128)),
-        table, feed, tcfg, mesh=device_mesh_1d(P), seed=0,
-        store_factory=store_factory)
-    trainer.metrics.init_metric("auc", "label", "pred", mask_var="mask")
+        table, feed, tcfg,
+        mesh=(device_mesh_2d(args.mesh_2d, P // args.mesh_2d)
+              if args.mesh_2d else device_mesh_1d(P)),
+        seed=0, store_factory=store_factory)
+    trainer.metrics.init_metric("auc", "label", "pred", mask_var="mask",
+                                mode_collect_in_device=args.device_auc)
 
     dss = []
     for _ in range(args.passes):

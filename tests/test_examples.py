@@ -34,3 +34,19 @@ def test_train_sharded_example():
 def test_train_downpour_example():
     out = run_example("train_downpour.py", "--passes", "2")
     assert "eval AUC" in out
+
+
+def test_train_pipeline_example():
+    out = run_example("train_pipeline.py", "--passes", "2", "--stages", "4")
+    assert "features trained" in out
+
+
+def test_train_sharded_example_2d_mesh_flags():
+    out = run_example("train_sharded.py", "--passes", "1", "--mesh-2d", "2",
+                      "--a2a-dtype", "bfloat16", "--device-auc")
+    assert "streaming AUC" in out
+
+
+def test_train_ctr_example_expand():
+    out = run_example("train_ctr.py", "--passes", "1", "--expand-dim", "4")
+    assert "streaming AUC" in out
