@@ -196,3 +196,72 @@ def test_crash_resume_parity_with_shuffle_enabled(data, tmp_path):
     k_got, v_got = _store_state(t2)
     np.testing.assert_array_equal(k_got, k_ref)
     np.testing.assert_allclose(v_got, v_ref, rtol=1e-6, atol=1e-7)
+
+
+def test_sharded_crash_resume_matches_uninterrupted(data, tmp_path):
+    """The same pass-boundary recovery loop over the SHARDED trainer:
+    per-pass base checkpoints ride the store_view facade, a restarted
+    fresh trainer resumes from the last DONE pass and converges to the
+    uninterrupted run (store rows + dense params)."""
+    from paddlebox_tpu.parallel import ShardedBoxTrainer
+    from paddlebox_tpu.parallel.mesh import device_mesh_1d
+
+    files, feed = data
+
+    def make_sharded(seed=0):
+        table_cfg = TableConfig(
+            embedx_dim=D, pass_capacity=1 << 13,
+            optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                            mf_initial_range=1e-3,
+                                            feature_learning_rate=0.1,
+                                            mf_learning_rate=0.1))
+        return ShardedBoxTrainer(
+            CtrDnn(ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + D),
+                   hidden=(16,)),
+            table_cfg, feed, TrainerConfig(dense_lr=0.01, scan_chunk=1),
+            mesh=device_mesh_1d(8), seed=seed)
+
+    def sharded_state(trainer):
+        keys, vals = trainer.table.store_view().state_items()
+        order = np.argsort(keys)
+        return keys[order], vals[order]
+
+    oracle = make_sharded()
+    r0 = RecoverableRunner(oracle, CheckpointManager(
+        ckpt_cfg(tmp_path, "sh_oracle"), oracle.table), day="d1")
+    r0.run(datasets(files, feed, 4))
+
+    cfg = ckpt_cfg(tmp_path, "sh_crash")
+    t1 = make_sharded()
+    r1 = RecoverableRunner(t1, CheckpointManager(cfg, t1.table), day="d1")
+
+    class Boom(RuntimeError):
+        pass
+
+    orig = t1.train_pass
+    calls = {"n": 0}
+
+    def crashing_train_pass(ds, **kw):
+        if calls["n"] == 2:
+            raise Boom()
+        calls["n"] += 1
+        return orig(ds, **kw)
+
+    t1.train_pass = crashing_train_pass
+    with pytest.raises(Boom):
+        r1.run(datasets(files, feed, 4))
+
+    t2 = make_sharded(seed=0)
+    r2 = RecoverableRunner(t2, CheckpointManager(cfg, t2.table), day="d1")
+    assert r2.completed_passes() == 2
+    r2.run(datasets(files, feed, 4))
+
+    k_ref, v_ref = sharded_state(oracle)
+    k_got, v_got = sharded_state(t2)
+    np.testing.assert_array_equal(k_got, k_ref)
+    np.testing.assert_allclose(v_got, v_ref, rtol=1e-5, atol=1e-7)
+    import jax
+    for a, b in zip(jax.tree.leaves(oracle.params),
+                    jax.tree.leaves(t2.params)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=1e-5,
+                                   atol=1e-7)

@@ -58,3 +58,29 @@ def timed_scan_chain(scan, state, stacked, reps: int, warmup: int = 2):
     if not np.isfinite(final).all():
         raise FloatingPointError(f"non-finite losses {final}")
     return dt
+
+
+def make_bench_trainer(pass_cap: int = 1 << 20, batch: int = 1024,
+                       num_slots: int = 32, max_len: int = 4, d: int = 8):
+    """ONE definition of the bench-shape trainer (DeepFM 512/256/128, bf16
+    dense, adagrad in-table) shared by bench.py's decomposing probe
+    (tools/tpu_probe.py) and the compiled-step audit (tools/step_audit.py)
+    — the audit's flops/bytes describe the benched program only while the
+    shapes stay identical. Returns (trainer, feed)."""
+    from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
+                                              TableConfig, TrainerConfig)
+    from paddlebox_tpu.data.generator import default_feed_config
+    from paddlebox_tpu.models.base import ModelSpec
+    from paddlebox_tpu.models.deepfm import DeepFM
+    from paddlebox_tpu.train.trainer import BoxTrainer
+
+    feed = default_feed_config(num_slots=num_slots, batch_size=batch,
+                               max_len=max_len)
+    table = TableConfig(embedx_dim=d, pass_capacity=pass_cap,
+                        optimizer=SparseOptimizerConfig(
+                            mf_create_thresholds=0.0, mf_initial_range=1e-3))
+    model = DeepFM(ModelSpec(num_slots=num_slots, slot_dim=3 + d),
+                   hidden=(512, 256, 128))
+    return BoxTrainer(model, table, feed,
+                      TrainerConfig(dense_lr=1e-3, compute_dtype="bfloat16"),
+                      seed=0), feed

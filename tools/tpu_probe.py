@@ -37,16 +37,9 @@ CHUNK, REPS = 8, 6
 
 
 def make_trainer(pass_cap):
-    feed = default_feed_config(num_slots=NUM_SLOTS, batch_size=BATCH,
-                               max_len=MAX_LEN)
-    table = TableConfig(embedx_dim=D, pass_capacity=pass_cap,
-                        optimizer=SparseOptimizerConfig(
-                            mf_create_thresholds=0.0, mf_initial_range=1e-3))
-    model = DeepFM(ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + D),
-                   hidden=(512, 256, 128))
-    return BoxTrainer(model, table, feed,
-                      TrainerConfig(dense_lr=1e-3, compute_dtype="bfloat16"),
-                      seed=0), feed
+    from tools.bench_util import make_bench_trainer
+    return make_bench_trainer(pass_cap, batch=BATCH, num_slots=NUM_SLOTS,
+                              max_len=MAX_LEN, d=D)
 
 
 def stage(name, pass_cap, strip=None):
@@ -110,6 +103,14 @@ if __name__ == "__main__":
     print(json.dumps({"device": str(dev), "platform": dev.platform}),
           flush=True)
     stage("full_step", 1 << 20)
+    # compiler-side audit right after the headline stage so a timeout
+    # kills the long tail, not the donation-regression check
+    try:
+        from tools.step_audit import audit
+        print(json.dumps({"stage": "step_audit", **audit()}), flush=True)
+    except Exception as e:
+        print(json.dumps({"stage": "step_audit", "error": repr(e)[:300]}),
+              flush=True)
     stage("full_step_4x_slab", 1 << 22)
     stage("no_push", 1 << 20, strip="push")
     stage("dense_only", 1 << 20, strip="sparse")
