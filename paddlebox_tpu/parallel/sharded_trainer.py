@@ -171,6 +171,15 @@ class ShardedBoxTrainer:
         self._prng = jax.random.PRNGKey(seed + 17)
         self._shuffle_rng = np.random.RandomState(seed + 1)
         self.timers = {n: Timer() for n in ("step", "pass", "build")}
+        # DumpField debug writers (boxps_worker.cc DumpField): each
+        # process dumps its OWN workers' rows (the per-node dump files of
+        # the reference)
+        self.dump_writer = None
+        if self.cfg.dump_fields and self.cfg.dump_fields_path:
+            from paddlebox_tpu.train.dump import DumpWriter
+            self.dump_writer = DumpWriter(self.cfg.dump_fields_path,
+                                          self.cfg.dump_thread_num,
+                                          rank=jax.process_index())
         # device-side metric collection (metrics.h:776): decided per pass
         # from the registered metrics' mode_collect_in_device flags; the
         # step is rebuilt when the mode flips (_sync_collect_mode)
@@ -224,6 +233,9 @@ class ShardedBoxTrainer:
         from paddlebox_tpu.metrics.auc import MetricMsg
         msgs = self.metrics.messages()
         if not msgs or self.multi_task:
+            return None
+        if self.dump_writer is not None:
+            # DumpField needs per-instance predictions on host every step
             return None
         sizes = set()
         for m in msgs:
@@ -951,24 +963,60 @@ class ShardedBoxTrainer:
         shards.sort(key=lambda t: t[0])
         return np.concatenate([d for _, d in shards], axis=0)
 
+    def _dump_step(self, rows, step_batches) -> None:
+        """DumpField per worker batch (one line per real instance with the
+        requested fields), this process's rows only. rows: the per-task
+        host copies [n_local, B] _add_metrics already made."""
+        from paddlebox_tpu.train.dump import build_dump_tensors
+        main = (self.model.task_names[0] if self.multi_task
+                else list(rows)[0])
+        for w, b in enumerate(step_batches):
+            tensors = build_dump_tensors(
+                self.cfg.dump_fields, b.labels,
+                {t: arr[w] for t, arr in rows.items()}, main)
+            if tensors:
+                self.dump_writer.dump_batch(tensors, ins_ids=b.ins_ids,
+                                            mask=b.ins_valid)
+
+    def close(self) -> None:
+        """Flush and stop the dump writers."""
+        if self.dump_writer is not None:
+            self.dump_writer.close()
+            self.dump_writer = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
     def _add_metrics(self, preds, step_batches: Tuple[PackedBatch, ...]) -> None:
         """Streams this process's rows only; cross-process reduction happens
         in get_metric_msg via the fleet allreduce hook (the reference's
         box MPI allreduce in Metric::calculate)."""
-        if not self.metrics.metric_names():
+        need_dump = self.dump_writer is not None
+        need_metrics = (bool(self.metrics.metric_names())
+                        and not self._collect_T)
+        # device-collect mode: the jitted step already bucketed this
+        # batch on device — touching preds here would D2H them
+        if not (need_dump or need_metrics):
             return
-        if self._collect_T:
-            # device-collect mode: the jitted step already bucketed this
-            # batch on device — touching preds here would D2H them
+        nw = len(step_batches)
+        # ONE host copy per task, shared by dump and metrics
+        rows = {t: self._local_rows(p).reshape(nw, -1)
+                for t, p in preds.items()}
+        if need_dump:
+            self._dump_step(rows, step_batches)
+        if not need_metrics:
             return
         # pytree dicts come back key-SORTED across the jit boundary, so
         # the main task is named explicitly, not taken positionally
         main = (self.model.task_names[0] if self.multi_task
-                else list(preds)[0])
-        arr = self._local_rows(preds[main])   # [n_local, B]
+                else list(rows)[0])
         labels = np.stack([b.labels for b in step_batches])
         mask = np.stack([b.ins_valid for b in step_batches])
-        tensors = {"pred": arr.reshape(-1), "label": labels.reshape(-1),
+        tensors = {"pred": rows[main].reshape(-1),
+                   "label": labels.reshape(-1),
                    "mask": mask.reshape(-1)}
         if step_batches[0].cmatch_rank is not None:
             tensors["cmatch_rank"] = np.stack(
@@ -976,6 +1024,6 @@ class ShardedBoxTrainer:
         for t in (step_batches[0].task_labels or {}):
             tensors["label_" + t] = np.stack(
                 [b.task_labels[t] for b in step_batches]).reshape(-1)
-        for t, p in preds.items():
-            tensors["pred_" + t] = self._local_rows(p).reshape(-1)
+        for t, arr in rows.items():
+            tensors["pred_" + t] = arr.reshape(-1)
         self.metrics.add_batch(tensors)

@@ -21,6 +21,17 @@ from paddlebox_tpu.config import flags
 from paddlebox_tpu.utils.channel import Channel, ChannelClosed
 
 
+def build_dump_tensors(dump_fields, labels, preds_np, main_task: str):
+    """The DumpField tensor dict BOTH trainers share: label + per-task
+    predictions + the main-task 'pred' alias, filtered to the requested
+    fields (keep the dump line contract in one place)."""
+    avail = {"label": labels}
+    for t, p in preds_np.items():
+        avail["pred_" + t] = np.asarray(p)
+    avail["pred"] = avail["pred_" + main_task]
+    return {f: avail[f] for f in dump_fields if f in avail}
+
+
 class DumpWriter:
     def __init__(self, path: str, thread_num: int = 1,
                  max_bytes: int = 0, rank: int = 0) -> None:

@@ -526,13 +526,11 @@ class BoxTrainer:
                     b: PackedBatch) -> None:
         """DumpField per batch: one line per real instance with the
         requested fields (boxps_worker.cc DumpField)."""
-        avail: Dict[str, np.ndarray] = {"label": b.labels}
-        for t, p in preds.items():
-            avail["pred_" + t] = np.asarray(p)
+        from paddlebox_tpu.train.dump import build_dump_tensors
         main = (self.model.task_names[0] if self.multi_task
                 else list(preds)[0])
-        avail["pred"] = avail["pred_" + main]
-        tensors = {f: avail[f] for f in self.cfg.dump_fields if f in avail}
+        tensors = build_dump_tensors(self.cfg.dump_fields, b.labels, preds,
+                                     main)
         if tensors:
             self.dump_writer.dump_batch(tensors, ins_ids=b.ins_ids,
                                         mask=b.ins_valid)
