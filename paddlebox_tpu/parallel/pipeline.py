@@ -277,11 +277,26 @@ class CtrPipelineRunner:
         if mesh is None:
             devs = np.array(jax.devices()[:n_stages])
             mesh = Mesh(devs, (STAGE_AXIS,))
-        if mesh.devices.size != n_stages:
-            raise ValueError("mesh size %d != n_stages %d"
-                             % (mesh.devices.size, n_stages))
+        # 1D (stage,) mesh = pure pipeline; 2D (dp, stage) mesh composes
+        # DATA parallelism over the pipeline: each dp row pipelines its
+        # own micro-batch group, dense grads pmean over dp (per stage),
+        # and every row's sparse push grads allgather so the replicated
+        # slab applies one identical combined update (the multi-worker
+        # push-merge of the reference, pipelined)
+        if len(mesh.axis_names) == 1:
+            self.dp = 1
+        elif len(mesh.axis_names) == 2:
+            self.dp = int(mesh.shape[mesh.axis_names[0]])
+        else:
+            raise ValueError("CtrPipelineRunner meshes are (stage,) or "
+                             f"(dp, stage); got axes {mesh.axis_names}")
+        if int(mesh.shape[mesh.axis_names[-1]]) != n_stages:
+            raise ValueError("mesh stage axis %d != n_stages %d"
+                             % (mesh.shape[mesh.axis_names[-1]], n_stages))
         self.mesh = mesh
-        self.axis = mesh.axis_names[0]
+        self.axis = mesh.axis_names[-1]        # the stage (pipeline) axis
+        self.dp_axis = (mesh.axis_names[0] if len(mesh.axis_names) == 2
+                        else None)
         D = table_cfg.embedx_dim
         slot_dim = (3 + D) if use_cvm else (1 + D)
         pooled_dim = self.num_slots * slot_dim
@@ -319,11 +334,12 @@ class CtrPipelineRunner:
         from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
         from paddlebox_tpu.ops.sparse import build_push_grads, pull_sparse
 
-        S = self.mesh.devices.size
+        S = int(self.mesh.shape[self.axis])
         M, mb = self.n_micro, self.mb
         num_slots, use_cvm = self.num_slots, self.use_cvm
         layout, conf = self.layout, self.table_cfg.optimizer
         axis = self.axis
+        dp_axis = self.dp_axis
         opt = self.opt
         pad_id = self.table_cfg.pass_capacity - 1
         # which opt-state leaves carry the [S, ...] stage axis (rank>=1;
@@ -366,6 +382,9 @@ class CtrPipelineRunner:
             local = jax.tree.map(lambda x: x[0], params)
             local_opt = jax.tree.map(
                 lambda x, s: x[0] if s else x, opt_state, opt_sharded)
+            if dp_axis is not None:
+                # [dp, M, ...] sharded over dp → this row's [M, ...]
+                batch = jax.tree.map(lambda x: x[0], batch)
             prng, sub = jax.random.split(prng)
             K = batch["ids"].shape[-1]
             ids_flat = batch["ids"].reshape(-1)
@@ -383,15 +402,20 @@ class CtrPipelineRunner:
                 return (jnp.where(iv, bce, 0.0).sum() / denom,
                         jax.nn.sigmoid(logits))
 
-            (loss, preds), (dp, demb) = jax.value_and_grad(
+            (loss, preds), (dparams, demb) = jax.value_and_grad(
                 loss_fn, argnums=(0, 1), has_aux=True)(local, emb_all)
             # the pull lives on stage 0 — every other device's demb is
             # zero; the psum hands stage 0's cotangent to all so the
             # replicated push below is bit-identical everywhere
             demb = jax.lax.psum(demb, axis)
+            if dp_axis is not None:
+                # data parallel across the dp rows: each stage's block
+                # grads average over its replicas (per-step NCCL sync)
+                dparams = jax.lax.pmean(dparams, dp_axis)
+                loss = jax.lax.pmean(loss, dp_axis)
             # per-stage params update with LOCAL grads (each device owns
             # its section; nothing to allreduce across stages)
-            updates, local_opt = opt.update(dp, local_opt, local)
+            updates, local_opt = opt.update(dparams, local_opt, local)
             local = optax.apply_updates(local, updates)
             # single-chip push semantics over all M micro-batches at once
             ins = batch["segments"] // num_slots          # [M, K]
@@ -400,6 +424,12 @@ class CtrPipelineRunner:
             slots = (batch["segments"] % num_slots).reshape(-1)
             kv = batch["key_valid"].reshape(-1)
             pg = build_push_grads(demb.reshape(M * K, -1), slots, clicks, kv)
+            if dp_axis is not None:
+                # every dp row's grads combine into ONE push (the dedup
+                # merge handles cross-row duplicate keys) so the
+                # replicated slab applies the identical update everywhere
+                ids_flat = jax.lax.all_gather(ids_flat, dp_axis, tiled=True)
+                pg = jax.lax.all_gather(pg, dp_axis, tiled=True)
             slab = push_sparse_dedup(slab, ids_flat, pg, sub, layout, conf)
             params = jax.tree.map(lambda x: x[None], local)
             opt_state = jax.tree.map(
@@ -411,34 +441,46 @@ class CtrPipelineRunner:
             lambda x: spec_sh if getattr(x, "ndim", 0) else P(),
             self.opt_state,
             is_leaf=lambda x: hasattr(x, "ndim") or np.isscalar(x))
+        dp_spec = P(self.dp_axis) if dp_axis is not None else P()
         fn = jax.shard_map(
             step, mesh=self.mesh,
-            in_specs=(spec_sh, opt_spec, P(), P(), P()),
-            out_specs=(spec_sh, opt_spec, P(), P(), P(), P()),
+            in_specs=(spec_sh, opt_spec, P(), dp_spec, P()),
+            out_specs=(spec_sh, opt_spec, P(), P(), dp_spec, P()),
             check_vma=False)
         return jax.jit(fn, donate_argnums=(2,))
 
     # ----------------------------------------------------------- host driver
+    @property
+    def batches_per_step(self) -> int:
+        """PackedBatches one train_step consumes: dp rows × n_micro."""
+        return self.dp * self.n_micro
+
     def device_batch(self, packed_batches) -> Dict[str, jnp.ndarray]:
-        """n_micro PackedBatches (each one micro-batch / section scope) →
-        stacked [M, ...] device leaves."""
-        if len(packed_batches) != self.n_micro:
-            raise ValueError("need exactly n_micro=%d batches, got %d"
-                             % (self.n_micro, len(packed_batches)))
-        ids = np.stack([self.table.lookup_ids(b.keys, b.valid)
-                        for b in packed_batches])
+        """dp × n_micro PackedBatches (each one micro-batch / section
+        scope; row-major by dp row) → stacked [dp, M, ...] device leaves
+        ([M, ...] on a pure-pipeline 1D mesh)."""
+        if len(packed_batches) != self.batches_per_step:
+            raise ValueError(
+                "need exactly dp*n_micro=%d batches, got %d"
+                % (self.batches_per_step, len(packed_batches)))
+
+        def stack(arrs):
+            out = np.stack(arrs)
+            if self.dp_axis is not None:   # incl. dp=1 on a 2D mesh
+                out = out.reshape(self.dp, self.n_micro, *out.shape[1:])
+            return jnp.asarray(out)
+
+        ids = stack([self.table.lookup_ids(b.keys, b.valid)
+                     for b in packed_batches])
         return {
-            "ids": jnp.asarray(ids),
-            "segments": jnp.asarray(
-                np.stack([b.segments for b in packed_batches])),
-            "labels": jnp.asarray(
-                np.stack([b.labels for b in packed_batches])),
-            "ins_valid": jnp.asarray(
-                np.stack([b.ins_valid for b in packed_batches])),
+            "ids": ids,
+            "segments": stack([b.segments for b in packed_batches]),
+            "labels": stack([b.labels for b in packed_batches]),
+            "ins_valid": stack([b.ins_valid for b in packed_batches]),
         }
 
     def train_step(self, packed_batches) -> float:
-        """ONE pipelined train step over n_micro micro-batches."""
+        """ONE pipelined train step over dp × n_micro micro-batches."""
         batch = self.device_batch(packed_batches)
         (self.params, self.opt_state, slab, loss, _preds,
          self._prng) = self._step(self.params, self.opt_state,
@@ -456,7 +498,7 @@ class CtrPipelineRunner:
         self.table.end_feed_pass()
         self.table.begin_pass()
         batches = dataset.split_batches(num_workers=1)[0]
-        M = self.n_micro
+        M = self.batches_per_step
         losses = []
         for lo in range(0, len(batches) - M + 1, M):
             losses.append(self.train_step(batches[lo:lo + M]))
