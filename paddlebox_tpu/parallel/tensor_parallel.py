@@ -1,0 +1,101 @@
+"""Tensor parallelism (Megatron MLP split) + expert parallelism (MoE).
+
+The reference has no TP/EP (SURVEY.md §2.8 — its dense towers are small
+enough to replicate), but this framework treats them as first-class mesh
+primitives so large towers / expert blocks slot into the same axes the
+sparse table and pipeline use:
+
+  tp_mlp_apply     column-shard W1, row-shard W2, ONE psum per block —
+                   activations stay sharded through the hidden dim, the
+                   classic 2-matmul tensor split.
+  ep_experts_apply each device owns E/P experts; gates are computed
+                   replicated and each device psums its experts'
+                   gate-weighted outputs — expert-parallel MMoE.
+
+Both are pure per-device functions for use inside shard_map (the callers
+own the mesh and the in/out specs), differentiable (see each function's
+autodiff contract), and oracle-tested — forward AND gradients — against
+the single-device dense computation.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def tp_mlp_init(rng: np.random.RandomState, n_shards: int, d_in: int,
+                d_hidden: int, d_out: int,
+                scale: float = 0.1) -> Dict[str, np.ndarray]:
+    """[P, ...] stacked shards: W1 column-split, W2 row-split; b2 is
+    replicated (added AFTER the psum, once)."""
+    if d_hidden % n_shards:
+        raise ValueError(f"d_hidden {d_hidden} not divisible by "
+                         f"{n_shards} shards")
+    h = d_hidden // n_shards
+    return {
+        "w1": (scale * rng.randn(n_shards, d_in, h)).astype(np.float32),
+        "b1": np.zeros((n_shards, h), np.float32),
+        "w2": (scale * rng.randn(n_shards, h, d_out)).astype(np.float32),
+        "b2": np.zeros((d_out,), np.float32),
+    }
+
+
+def tp_mlp_apply(p_local: Dict[str, jnp.ndarray], x: jnp.ndarray,
+                 axis: str) -> jnp.ndarray:
+    """Per-device Megatron block: x replicated [B, d_in]; p_local this
+    device's {w1 [d_in, h/P], b1 [h/P], w2 [h/P, d_out], b2 [d_out]}.
+    relu(x@W1_col) @ W2_row summed across the axis — one collective per
+    block, activations never materialize the full hidden dim.
+
+    Autodiff contract: if every device then computes the SAME replicated
+    loss from the psum'd output, divide that loss by
+    jax.lax.axis_size(axis) (or pmean it) before grad — the psum
+    transpose otherwise scales the shard gradients by P (each device's
+    replicated loss copy contributes a full cotangent)."""
+    h = jax.nn.relu(x @ p_local["w1"] + p_local["b1"])
+    y = jax.lax.psum(h @ p_local["w2"], axis)
+    return y + p_local["b2"]
+
+
+def ep_experts_init(rng: np.random.RandomState, n_experts: int, d_in: int,
+                    d_hidden: int, d_out: int,
+                    scale: float = 0.1) -> Dict[str, np.ndarray]:
+    """[E, ...] stacked expert MLPs + a replicated gate [d_in, E]."""
+    return {
+        "ew1": (scale * rng.randn(n_experts, d_in, d_hidden)
+                ).astype(np.float32),
+        "eb1": np.zeros((n_experts, d_hidden), np.float32),
+        "ew2": (scale * rng.randn(n_experts, d_hidden, d_out)
+                ).astype(np.float32),
+        "eb2": np.zeros((n_experts, d_out), np.float32),
+        "gate": (scale * rng.randn(d_in, n_experts)).astype(np.float32),
+    }
+
+
+def ep_experts_apply(p_local: Dict[str, jnp.ndarray], x: jnp.ndarray,
+                     axis: str) -> jnp.ndarray:
+    """Per-device expert-parallel MoE: x replicated [B, d_in]; p_local
+    holds THIS device's e_local = E/P experts (leading axis) and the
+    replicated gate over all E experts. Dense (MMoE-style) gating: every
+    expert sees every instance; each device computes its experts'
+    gate-weighted outputs and the psum assembles the full mixture —
+    expert weights never leave their owner."""
+    e_local = p_local["ew1"].shape[0]
+    idx = jax.lax.axis_index(axis)
+    # Autodiff contract: expert-block grads are shard-local like TP's
+    # w1/w2, but the REPLICATED gate receives a PARTIAL gradient on each
+    # device (only its expert slice's cotangent reaches it through the
+    # psum transpose) — a trainer must psum the gate grad across the axis
+    # before updating, or it silently trains on one device's partial.
+    gates = jax.nn.softmax(x @ p_local["gate"], axis=-1)    # [B, E]
+    g_local = jax.lax.dynamic_slice_in_dim(
+        gates, idx * e_local, e_local, axis=1)              # [B, E/P]
+    h = jax.nn.relu(jnp.einsum("bi,eih->beh", x, p_local["ew1"])
+                    + p_local["eb1"])
+    y = jnp.einsum("beh,eho->beo", h, p_local["ew2"]) + p_local["eb2"]
+    mix = jnp.einsum("beo,be->bo", y, g_local)
+    return jax.lax.psum(mix, axis)
