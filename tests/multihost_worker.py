@@ -88,8 +88,9 @@ def main() -> None:
                       sync_mode=cfg.get("sync_mode", "step")),
         mesh=mesh, seed=0, fleet=fleet,
         store_factory=store_factory)
-    trainer.metrics.init_metric("auc", "label", "pred",
-                                table_size=1 << 14, mask_var="mask")
+    trainer.metrics.init_metric(
+        "auc", "label", "pred", table_size=1 << 14, mask_var="mask",
+        mode_collect_in_device=bool(cfg.get("device_auc")))
 
     losses = []
     for _ in range(cfg["passes"]):
@@ -169,6 +170,7 @@ def main() -> None:
     print("RESULT " + json.dumps({
         "rank": rank, "losses": losses, "auc": msg["auc"],
         "size": msg["size"], "rows": rows,
+        "collect_T": trainer._collect_T,
         "local_after_shuffle": local_after_shuffle,
         "total_after_shuffle": total_after_shuffle,
         "shuffled_loss": shuffled_loss,

@@ -41,6 +41,14 @@ def data(tmp_path_factory):
     return files, feed
 
 
+@pytest.fixture(scope="module")
+def oracle(data):
+    """ONE single-process oracle run shared by every cluster test in the
+    module (each used to recompute the identical 2-pass training run)."""
+    files, feed = data
+    return run_single_process_oracle(files, feed)
+
+
 def run_single_process_oracle(files, feed):
     """The same training run on the in-process 8-device mesh."""
     from paddlebox_tpu.config import flags
@@ -122,9 +130,9 @@ def run_cluster(files, extra_cfg=None, world=2,
     return results
 
 
-def test_two_process_cluster_matches_single_process(data, tmp_path):
+def test_two_process_cluster_matches_single_process(data, oracle, tmp_path):
     files, feed = data
-    ref_losses, ref_msg, ref_rows = run_single_process_oracle(files, feed)
+    ref_losses, ref_msg, ref_rows = oracle
     results = run_cluster(files)
 
     assert set(results) == {0, 1}
@@ -155,7 +163,7 @@ def test_two_process_cluster_matches_single_process(data, tmp_path):
         assert np.isfinite(r["shuffled_loss"]), r
 
 
-def test_two_process_gpups_over_central_ps(data):
+def test_two_process_gpups_over_central_ps(data, oracle):
     """The 1T-param composition: a 2-process pod mesh whose shard stores
     ALL live on one central CPU PS over TCP (distributed full store →
     per-pass HBM slabs, built/dumped at pass boundaries —
@@ -163,7 +171,7 @@ def test_two_process_gpups_over_central_ps(data):
     oracle (server-side row init is key-deterministic) and the features
     must exist server-side afterwards."""
     files, feed = data
-    ref_losses, ref_msg, _ref_rows = run_single_process_oracle(files, feed)
+    ref_losses, ref_msg, _ref_rows = oracle
 
     from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
                                               TableConfig)
@@ -194,13 +202,13 @@ def test_two_process_gpups_over_central_ps(data):
         admin.close()
 
 
-def test_two_process_hierarchical_mesh(data):
+def test_two_process_hierarchical_mesh(data, oracle):
     """2D ("node","chip") mesh across the REAL process boundary (VERDICT
     r2 #4): node axis = the 2 processes (DCN), chip axis = each process's
     4 devices (ICI). Hierarchical dense sync must reproduce the flat-mesh
     single-process oracle."""
     files, feed = data
-    ref_losses, ref_msg, ref_rows = run_single_process_oracle(files, feed)
+    ref_losses, ref_msg, ref_rows = oracle
     results = run_cluster(files, {"mesh_2d": True})
 
     assert set(results) == {0, 1}
@@ -278,3 +286,23 @@ def test_four_process_gpups_spill_and_day_boundary(data, tmp_path):
     finally:
         admin.stop_server()
         admin.close()
+
+
+def test_two_process_device_auc_matches_host(data, oracle):
+    """mode_collect_in_device at the multi-process tier: each process
+    merges its OWN device shards' bucket tables once per pass; the
+    cross-process allreduce at get_metric_msg completes the reduction —
+    AUC must match the host-collected oracle."""
+    files, feed = data
+    _losses, ref_msg, _rows = oracle
+    results = run_cluster(files, {"device_auc": True,
+                                  "skip_shuffle_phase": True})
+    assert set(results) == {0, 1}
+    # guard against a silent fallback to the host path: the workers must
+    # report an ACTIVE device-collect table size
+    for r in results.values():
+        assert r["collect_T"] == 1 << 14, r["collect_T"]
+    assert results[0]["size"] == ref_msg["size"]
+    np.testing.assert_allclose(results[0]["auc"], ref_msg["auc"], rtol=2e-3)
+    np.testing.assert_allclose(results[0]["auc"], results[1]["auc"],
+                               rtol=1e-6)
