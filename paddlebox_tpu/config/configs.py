@@ -155,7 +155,12 @@ class CheckpointConfig:
 class TrainerConfig:
     """Analog of TrainerDesc + BoxPSWorkerParameter (trainer_desc.proto:78,121-129)."""
 
-    thread_num: int = 1                  # worker threads (one per local device)
+    # TrainerDesc compat (STRUCTURAL NO-OP): the reference's device-worker
+    # thread count. Here the mesh defines device concurrency (one shard_map
+    # program) and host staging parallelism comes from the stack_threads /
+    # stream_depth flags — accepted so TrainerDesc configs carry over,
+    # never consulted.
+    thread_num: int = 1
     sync_mode: str = "step"              # step | k_step | async | sharding
     sync_weight_step: int = 1            # K in K-step dense sync
     # one flat allreduce ring across ALL devices even on a 2D (node, chip)

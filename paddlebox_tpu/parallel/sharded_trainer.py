@@ -845,6 +845,11 @@ class ShardedBoxTrainer:
         self.table.check_need_limit_mem()
         self._slabs = None
         t_pass.pause()
+        if self.cfg.profile:
+            from paddlebox_tpu.utils.profiler import timer_report
+            # rank-tagged so multiprocess reports stay distinguishable
+            print(timer_report(
+                self.timers, prefix=f"sharded.r{jax.process_index()}."))
         return {"loss": float(np.mean(losses)) if losses else 0.0,
                 "batches": n_steps, "instances": len(dataset)}
 
