@@ -277,3 +277,87 @@ def merge_models(batch_dirs, out_dir: str) -> str:
     with open(os.path.join(out_dir, "DONE"), "w") as f:
         f.write(str(time.time()))
     return out_dir
+
+
+class XboxModelReader:
+    """Consumer side of the serving handoff: compose a day's xbox BASE
+    view with its cadenced delta saves into one key → [embed_w, embedx]
+    lookup (the role of the external xbox serving loader that ingests
+    SaveBase/SaveDelta output — box_wrapper.cc:1286-1318 writes, this
+    reads). Views apply in their DONE-marker timestamp order so the
+    freshest save wins regardless of layout — run_day writes the base at
+    day END (after its deltas: base wins), while a mid-day consumer of a
+    prior day's base plus streaming deltas sees the deltas win. Unknown
+    keys read as zeros (the serving default for never-trained
+    features)."""
+
+    def __init__(self, xbox_model_dir: str, *days: str) -> None:
+        """days: one or more day directories, e.g. ("d0",) for a finished
+        day, or ("d0", "d1") for day d0's base composed with day d1's
+        streaming views (d1's base DONE need not exist yet — that's the
+        mid-day scenario). At least one day must have a completed base."""
+        import glob
+        import re
+        if not days:
+            raise ValueError("need at least one day")
+        sources = []
+        have_base = False
+        for day in days:
+            root = os.path.join(xbox_model_dir, day)
+            if os.path.exists(os.path.join(root, "DONE")):
+                have_base = True
+                sources.append((self._done_ts(root), 0, root))
+            for d in glob.glob(os.path.join(root, "delta-*")):
+                m = re.fullmatch(r"delta-(\d+)", os.path.basename(d))
+                if m and os.path.exists(os.path.join(d, "DONE")):
+                    sources.append((self._done_ts(d), int(m.group(1)), d))
+        if not have_base:
+            raise FileNotFoundError(
+                f"no completed xbox base under {xbox_model_dir} for {days}")
+        self._emb: Dict[int, np.ndarray] = {}
+        self._dim: Optional[int] = None
+        self.deltas_applied = sum(1 for _, i, _d in sources if i)
+        for _ts, _i, d in sorted(sources):
+            self._ingest(d)
+        # freeze into a sorted-key gather table (serving-scale lookups are
+        # vectorized, not per-key dict probes)
+        self._keys = np.fromiter(self._emb.keys(), np.uint64,
+                                 count=len(self._emb))
+        order = np.argsort(self._keys)
+        self._keys = self._keys[order]
+        self._rows = (np.stack([self._emb[int(k)] for k in self._keys])
+                      if self._keys.size
+                      else np.empty((0, self.dim), np.float32))
+
+    @staticmethod
+    def _done_ts(dirpath: str) -> float:
+        with open(os.path.join(dirpath, "DONE")) as f:
+            return float(f.read().strip())
+
+    def _ingest(self, dirpath: str) -> None:
+        with open(os.path.join(dirpath, "embedding.pkl"), "rb") as f:
+            blob = pickle.load(f)
+        emb = np.asarray(blob["embedding"], np.float32)
+        if self._dim is None and emb.ndim == 2:
+            self._dim = int(emb.shape[1])   # writer emits 2-D even empty
+        for k, row in zip(blob["keys"].tolist(), emb):
+            self._emb[int(k)] = row
+
+    def __len__(self) -> int:
+        return len(self._emb)
+
+    @property
+    def dim(self) -> int:
+        return self._dim or 0
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        """[K] uint64 feasigns → [K, 1+embedx_dim] (embed_w + embedx);
+        unknown keys are zero rows. Vectorized searchsorted gather."""
+        keys = np.asarray(keys, np.uint64).reshape(-1)
+        out = np.zeros((keys.size, self.dim), np.float32)
+        if self._keys.size and keys.size:
+            pos = np.searchsorted(self._keys, keys)
+            pos = np.minimum(pos, self._keys.size - 1)
+            hit = self._keys[pos] == keys
+            out[hit] = self._rows[pos[hit]]
+        return out

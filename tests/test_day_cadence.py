@@ -451,3 +451,81 @@ def test_run_day_sharded_trainer(tmp_path):
     for s, st in enumerate(trainer.table.stores):
         k, _ = st.state_items()
         assert (k % np.uint64(8) == np.uint64(s)).all()
+
+
+def test_xbox_reader_composes_base_and_deltas(tmp_path):
+    """Serving handoff: the xbox reader composes a day's base view with
+    its cadenced deltas (later wins), matching the trainer's final rows
+    for every delta-covered feature."""
+    from paddlebox_tpu.train.checkpoint import XboxModelReader, run_day
+
+    files, feed = write_synthetic_ctr_files(
+        str(tmp_path / "data"), num_files=2, lines_per_file=160,
+        num_slots=4, vocab_per_slot=60, max_len=3, seed=8)
+    feed = dataclasses.replace(feed, batch_size=32)
+    tr = BoxTrainer(CtrDnn(ModelSpec(num_slots=4, slot_dim=3 + D),
+                           hidden=(16,)),
+                    _table(delete_days=30.0), feed,
+                    TrainerConfig(dense_lr=1e-2))
+    try:
+        cm = CheckpointManager(
+            CheckpointConfig(batch_model_dir=str(tmp_path / "b"),
+                             xbox_model_dir=str(tmp_path / "x"),
+                             async_save=False, save_delta_every_passes=1),
+            tr.table)
+        dss = []
+        for _ in range(2):
+            ds = BoxDataset(feed, read_threads=1)
+            ds.set_filelist(files)
+            dss.append(ds)
+        run_day(tr, dss, cm, day="d0", preload=False)
+
+        reader = XboxModelReader(str(tmp_path / "x"), "d0")
+        assert reader.deltas_applied >= 1
+        keys, vals = tr.table.store.state_items()
+        assert len(reader) >= keys.size
+        lay = tr.table.layout
+        got = reader.lookup(keys)
+        want = np.concatenate(
+            [vals[:, acc.EMBED_W:acc.EMBED_W + 1],
+             vals[:, lay.embedx_w:lay.embedx_w + D]], axis=1)
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+        # unknown key reads as zeros
+        assert (reader.lookup(np.array([np.uint64(2**63 + 1)],
+                                       np.uint64)) == 0).all()
+    finally:
+        tr.close()
+
+
+def test_xbox_reader_mid_day_composition(tmp_path):
+    """Mid-day serving: yesterday's completed base + today's streaming
+    deltas (today's base DONE absent) compose with the freshest view
+    winning by DONE timestamp."""
+    import pickle
+    import time
+    from paddlebox_tpu.train.checkpoint import XboxModelReader
+
+    def write_view(d, keys, val, ts):
+        os.makedirs(d, exist_ok=True)
+        emb = np.full((len(keys), 1 + D), val, np.float32)
+        with open(os.path.join(d, "embedding.pkl"), "wb") as f:
+            pickle.dump({"keys": np.asarray(keys, np.uint64),
+                         "embedding": emb}, f)
+        with open(os.path.join(d, "DONE"), "w") as f:
+            f.write(str(ts))
+
+    x = tmp_path / "x"
+    t0 = time.time()
+    write_view(str(x / "d0"), [1, 2, 3], 1.0, t0)            # base d0
+    write_view(str(x / "d1" / "delta-1"), [2], 2.0, t0 + 10)  # today
+    write_view(str(x / "d1" / "delta-2"), [3, 4], 3.0, t0 + 20)
+
+    r = XboxModelReader(str(x), "d0", "d1")
+    assert r.deltas_applied == 2 and len(r) == 4
+    got = r.lookup(np.array([1, 2, 3, 4, 99], np.uint64))
+    np.testing.assert_allclose(got[:, 0], [1.0, 2.0, 3.0, 3.0, 0.0])
+
+    # today alone (no base anywhere) refuses
+    import pytest
+    with pytest.raises(FileNotFoundError):
+        XboxModelReader(str(x), "d1")
