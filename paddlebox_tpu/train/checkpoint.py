@@ -27,6 +27,16 @@ from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
 from paddlebox_tpu.embedding.pass_table import PassTable
 
 
+def _write_done(dirpath: str) -> None:
+    """Atomic DONE marker (temp + rename): a mid-day reader that observes
+    DONE must never see it empty or half-written — its content is the
+    timestamp the view ordering relies on."""
+    tmp = os.path.join(dirpath, f".DONE.{os.getpid()}.tmp")
+    with open(tmp, "w") as f:
+        f.write(str(time.time()))
+    os.replace(tmp, os.path.join(dirpath, "DONE"))
+
+
 class CheckpointManager:
     def __init__(self, cfg: CheckpointConfig, table) -> None:
         """table: PassTable (single host) or ShardedPassTable — the
@@ -81,8 +91,7 @@ class CheckpointManager:
                 pickle.dump({"params": params, "opt_state": opt_state,
                              "extra": extra or {}}, f)
             self._write_xbox(xbox_dir, xbox_blob)
-            with open(os.path.join(batch_dir, "DONE"), "w") as f:
-                f.write(str(time.time()))
+            _write_done(batch_dir)
 
         if self.cfg.async_save:
             self._save_thread = threading.Thread(target=do_save, daemon=True)
@@ -148,8 +157,7 @@ class CheckpointManager:
     def _write_xbox(xbox_dir: str, blob: Dict) -> None:
         with open(os.path.join(xbox_dir, "embedding.pkl"), "wb") as f:
             pickle.dump(blob, f)
-        with open(os.path.join(xbox_dir, "DONE"), "w") as f:
-            f.write(str(time.time()))
+        _write_done(xbox_dir)
 
     # ---------------------------------------------------------------- resume
     def load_base(self, day: str) -> Tuple[Any, Any, Dict]:
@@ -320,14 +328,16 @@ class XboxModelReader:
         for _ts, _i, d in sorted(sources):
             self._ingest(d)
         # freeze into a sorted-key gather table (serving-scale lookups are
-        # vectorized, not per-key dict probes)
-        self._keys = np.fromiter(self._emb.keys(), np.uint64,
-                                 count=len(self._emb))
+        # vectorized, not per-key dict probes), then DROP the build dict —
+        # its rows are views pinning every ingested blob's full array
+        self._n = len(self._emb)
+        self._keys = np.fromiter(self._emb.keys(), np.uint64, count=self._n)
         order = np.argsort(self._keys)
         self._keys = self._keys[order]
         self._rows = (np.stack([self._emb[int(k)] for k in self._keys])
                       if self._keys.size
                       else np.empty((0, self.dim), np.float32))
+        self._emb = None
 
     @staticmethod
     def _done_ts(dirpath: str) -> float:
@@ -344,7 +354,7 @@ class XboxModelReader:
             self._emb[int(k)] = row
 
     def __len__(self) -> int:
-        return len(self._emb)
+        return self._n
 
     @property
     def dim(self) -> int:

@@ -306,3 +306,21 @@ def test_two_process_device_auc_matches_host(data, oracle):
     np.testing.assert_allclose(results[0]["auc"], ref_msg["auc"], rtol=2e-3)
     np.testing.assert_allclose(results[0]["auc"], results[1]["auc"],
                                rtol=1e-6)
+
+
+def test_four_process_hierarchical_mesh(data, oracle):
+    """The 2D mesh at 4 real process boundaries: node axis = 4 processes
+    (DCN), chip axis = each process's 2 devices — the node psum now spans
+    4 ranks. Must still reproduce the flat single-process oracle."""
+    files, feed = data
+    ref_losses, _msg, _rows = oracle
+    results = run_cluster(files, {"mesh_2d": True,
+                                  "skip_shuffle_phase": True},
+                          world=4, devs_per_proc=2)
+    assert set(results) == {0, 1, 2, 3}
+    for r in (1, 2, 3):
+        np.testing.assert_allclose(results[0]["losses"],
+                                   results[r]["losses"], rtol=1e-6)
+    np.testing.assert_allclose(results[0]["losses"], ref_losses, rtol=1e-4,
+                               err_msg="4-node 2D mesh diverges from the "
+                                       "flat oracle")
