@@ -1,0 +1,84 @@
+"""Serving handoff demo: train a day, then serve from the xbox views.
+
+Runs the full day cadence (run_day: cadenced delta saves + base save +
+day-boundary aging), then loads the day's xbox output with
+XboxModelReader — the consumer role of the external serving loader that
+ingests SaveBase/SaveDelta — and answers embedding lookups from it.
+
+    JAX_PLATFORMS=cpu python examples/serve_xbox.py
+"""
+
+import argparse
+import os
+import pickle
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddlebox_tpu.utils.platform import force_cpu_if_requested
+
+force_cpu_if_requested()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--passes", type=int, default=2)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from paddlebox_tpu.config.configs import (CheckpointConfig,
+                                              SparseOptimizerConfig,
+                                              TableConfig, TrainerConfig)
+    from paddlebox_tpu.data import BoxDataset, write_synthetic_ctr_files
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.models.base import ModelSpec
+    from paddlebox_tpu.train import BoxTrainer, CheckpointManager
+    from paddlebox_tpu.train.checkpoint import XboxModelReader, run_day
+
+    work = tempfile.mkdtemp(prefix="pbx_serve_")
+    files, feed = write_synthetic_ctr_files(
+        os.path.join(work, "data"), num_files=2, lines_per_file=800,
+        num_slots=8, vocab_per_slot=400, max_len=4, seed=9)
+    feed = type(feed)(slots=feed.slots, batch_size=128)
+
+    D = 8
+    table = TableConfig(
+        embedx_dim=D, pass_capacity=1 << 15,
+        optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                        mf_initial_range=1e-3))
+    trainer = BoxTrainer(
+        CtrDnn(ModelSpec(num_slots=8, slot_dim=3 + D), hidden=(64, 32)),
+        table, feed, TrainerConfig(dense_lr=1e-3), seed=0)
+    cm = CheckpointManager(
+        CheckpointConfig(batch_model_dir=os.path.join(work, "batch"),
+                         xbox_model_dir=os.path.join(work, "xbox"),
+                         async_save=False, save_delta_every_passes=1),
+        trainer.table)
+    dss = []
+    for _ in range(args.passes):
+        ds = BoxDataset(feed, read_threads=2)
+        ds.set_filelist(files)
+        dss.append(ds)
+    stats, (batch_dir, xbox_dir) = run_day(trainer, dss, cm, day="day0")
+    print(f"trained day0: {len(stats)} passes, final loss "
+          f"{stats[-1]['loss']:.4f}")
+    trainer.close()
+
+    xbox_root = os.path.dirname(xbox_dir)
+    reader = XboxModelReader(xbox_root, "day0")
+    print(f"serving view: {len(reader)} features x {reader.dim} cols "
+          f"({reader.deltas_applied} deltas composed)")
+    # sample keys from the SERVING artifact itself (the xbox base view —
+    # the file serving consumers actually ingest)
+    with open(os.path.join(xbox_dir, "embedding.pkl"), "rb") as f:
+        keys = pickle.load(f)["keys"][:5]
+    emb = reader.lookup(np.asarray(keys, np.uint64))
+    for k, row in zip(keys.tolist(), emb):
+        print(f"  feasign {k}: embed_w={row[0]:+.4f} "
+              f"embedx={np.round(row[1:4], 4)}...")
+
+
+if __name__ == "__main__":
+    main()

@@ -50,3 +50,8 @@ def test_train_sharded_example_2d_mesh_flags():
 def test_train_ctr_example_expand():
     out = run_example("train_ctr.py", "--passes", "1", "--expand-dim", "4")
     assert "streaming AUC" in out
+
+
+def test_serve_xbox_example():
+    out = run_example("serve_xbox.py", "--passes", "1")
+    assert "serving view:" in out and "feasign" in out
