@@ -171,6 +171,7 @@ class ShardedBoxTrainer:
         self._prng = jax.random.PRNGKey(seed + 17)
         self._shuffle_rng = np.random.RandomState(seed + 1)
         self.timers = {n: Timer() for n in ("step", "pass", "build")}
+        self._pool = None   # routing thread pool, lazy (_stager_pool)
         # DumpField debug writers (boxps_worker.cc DumpField): each
         # process dumps its OWN workers' rows (the per-node dump files of
         # the reference)
@@ -517,11 +518,23 @@ class ShardedBoxTrainer:
                                  ).at[batch["restore"]].add(
                 jnp.where(batch["valid"][:, None], pg, 0.0))
             if a2a_cast:
-                bucket_g = bucket_g.astype(a2a_dtype)
-            recv_g = jax.lax.all_to_all(
-                bucket_g.reshape(Pn, KB, -1), axis, 0, 0, tiled=True)
-            if a2a_cast:
-                recv_g = recv_g.astype(jnp.float32)
+                # the first 3 push columns (slot, merged show, merged click)
+                # are EXACT integers the table stores verbatim — bf16 only
+                # represents integers to 256, so hot-key counts / slot ids
+                # would silently round. Ship them f32 on their own small a2a
+                # (6B/row) and cast only the gradient columns to the wire
+                # dtype; XLA overlaps the two independent collectives.
+                meta = jax.lax.all_to_all(
+                    bucket_g[:, :3].reshape(Pn, KB, 3), axis, 0, 0,
+                    tiled=True)
+                gwire = jax.lax.all_to_all(
+                    bucket_g[:, 3:].astype(a2a_dtype).reshape(Pn, KB, -1),
+                    axis, 0, 0, tiled=True)
+                recv_g = jnp.concatenate(
+                    [meta, gwire.astype(jnp.float32)], axis=-1)
+            else:
+                recv_g = jax.lax.all_to_all(
+                    bucket_g.reshape(Pn, KB, -1), axis, 0, 0, tiled=True)
             if "push_uids" in batch:
                 # single-process mesh: the incoming-id dedup was precomputed
                 # on the host (shard_batches) — no device sort
@@ -542,10 +555,16 @@ class ShardedBoxTrainer:
                 # per pass (see make_metric_state for the layout/precision
                 # rationale)
                 tab, st = mtab[0], mstats[0]
-                p = jnp.clip(preds["ctr"].astype(jnp.float32), 0.0, 1.0)
+                praw = preds["ctr"].astype(jnp.float32)
+                # a NaN pred would survive the clip into a backend-defined
+                # int32 bucket; the host add_data path raises on it — mirror
+                # that signal by excluding non-finite preds from every
+                # accumulator (the count shortfall is the blowup indicator)
+                ok = batch["ins_valid"] & jnp.isfinite(praw)
+                p = jnp.clip(praw, 0.0, 1.0)
                 lab = batch["labels"].astype(jnp.int32)
-                w = batch["ins_valid"].astype(jnp.float32)
-                wi = batch["ins_valid"].astype(jnp.int32)
+                w = ok.astype(jnp.float32)
+                wi = ok.astype(jnp.int32)
                 pos = jnp.minimum((p * collect_T).astype(jnp.int32),
                                   collect_T - 1)
                 tab = tab.at[lab, pos].add(wi)
@@ -620,16 +639,40 @@ class ShardedBoxTrainer:
         return jax.make_array_from_process_local_data(
             sharding, host_local, global_shape)
 
+    def _stager_pool(self):
+        """Shared routing thread pool (flag stager_threads). The native
+        bucketize/dedup calls drop the GIL for their whole run (ctypes
+        releases it around foreign calls), so W workers route W batches
+        genuinely in parallel — the reference runs 20/30 reader/merge
+        threads for exactly this stage (flags.cc:966-968,
+        box_wrapper.h:862); a single-thread stager at the reference's
+        per-batch key budget (~3.69M keys, 12.9M keys/s native) would
+        bound a pod's step rate at ~290ms."""
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            from paddlebox_tpu.config import flags
+            n = max(1, int(flags.get_flag("stager_threads")))
+            self._pool = ThreadPoolExecutor(
+                n, thread_name_prefix="shard-stager")
+        return self._pool
+
     def _step_host_arrays(self, per_worker: List[List[PackedBatch]],
                           i: int) -> Dict[str, np.ndarray]:
         """Bucketize + stack ONE step's local per-worker batches into host
-        arrays [L, ...] (L = local workers) with the table routing index."""
+        arrays [L, ...] (L = local workers) with the table routing index.
+        Per-worker routing and per-destination push dedup fan out on the
+        stager pool."""
         n_workers = len(per_worker)
-        stacked: Dict[str, List[np.ndarray]] = {}
-        for w in range(n_workers):
+        pool = self._stager_pool()
+
+        def route_one(w):
             b = per_worker[w][i]
             valid = b.valid.copy()
-            idx = self.table.bucketize(b.keys, valid)
+            return b, valid, self.table.bucketize(b.keys, valid)
+
+        routed = list(pool.map(route_one, range(n_workers)))
+        stacked: Dict[str, List[np.ndarray]] = {}
+        for b, valid, idx in routed:
             leaves = {
                 "buckets": idx.buckets, "restore": idx.restore,
                 "slots": b.slots, "segments": b.segments, "valid": valid,
@@ -651,11 +694,12 @@ class ShardedBoxTrainer:
             # precompute the push dedup per destination shard and spare
             # the device its per-step jnp.unique sort (multi-process
             # keeps the device path — incoming ids live on peers)
-            for d in range(self.P):
+            def dedup_dest(d):
                 incoming = np.concatenate(
                     [stacked["buckets"][w][d] for w in range(n_workers)])
-                uids, perm, inv = dedup_ids(incoming,
-                                            self.table.shard_cap)
+                return dedup_ids(incoming, self.table.shard_cap)
+
+            for uids, perm, inv in pool.map(dedup_dest, range(self.P)):
                 stacked.setdefault("push_uids", []).append(uids)
                 stacked.setdefault("push_perm", []).append(perm)
                 stacked.setdefault("push_inv", []).append(inv)
@@ -984,10 +1028,13 @@ class ShardedBoxTrainer:
                                             mask=b.ins_valid)
 
     def close(self) -> None:
-        """Flush and stop the dump writers."""
+        """Flush and stop the dump writers + the stager pool."""
         if self.dump_writer is not None:
             self.dump_writer.close()
             self.dump_writer = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
 
     def __del__(self):
         try:
