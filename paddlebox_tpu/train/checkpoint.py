@@ -282,8 +282,7 @@ def merge_models(batch_dirs, out_dir: str) -> str:
         with open(dense_src, "rb") as fsrc, \
                 open(os.path.join(out_dir, "dense.pkl"), "wb") as fdst:
             fdst.write(fsrc.read())
-    with open(os.path.join(out_dir, "DONE"), "w") as f:
-        f.write(str(time.time()))
+    _write_done(out_dir)
     return out_dir
 
 
@@ -292,66 +291,74 @@ class XboxModelReader:
     view with its cadenced delta saves into one key → [embed_w, embedx]
     lookup (the role of the external xbox serving loader that ingests
     SaveBase/SaveDelta output — box_wrapper.cc:1286-1318 writes, this
-    reads). Views apply in their DONE-marker timestamp order so the
-    freshest save wins regardless of layout — run_day writes the base at
-    day END (after its deltas: base wins), while a mid-day consumer of a
-    prior day's base plus streaming deltas sees the deltas win. Unknown
-    keys read as zeros (the serving default for never-trained
-    features)."""
+    reads). Views apply in STRUCTURAL order — day position in `days`,
+    then deltas by id, then that day's base (run_day writes the base at
+    day END, after its deltas: base wins) — with DONE timestamps only as
+    a final tie-break, so clock skew between writer hosts can never
+    invert base/delta precedence. A mid-day consumer of a prior day's
+    base plus the next day's streaming deltas therefore sees the deltas
+    win. Unknown keys read as zeros (the serving default for
+    never-trained features)."""
 
     def __init__(self, xbox_model_dir: str, *days: str) -> None:
-        """days: one or more day directories, e.g. ("d0",) for a finished
-        day, or ("d0", "d1") for day d0's base composed with day d1's
-        streaming views (d1's base DONE need not exist yet — that's the
-        mid-day scenario). At least one day must have a completed base."""
+        """days: one or more day directories IN CADENCE ORDER (oldest
+        first), e.g. ("d0",) for a finished day, or ("d0", "d1") for day
+        d0's base composed with day d1's streaming views (d1's base DONE
+        need not exist yet — that's the mid-day scenario). At least one
+        day must have a completed base."""
         import glob
         import re
         if not days:
             raise ValueError("need at least one day")
         sources = []
         have_base = False
-        for day in days:
+        for di, day in enumerate(days):
             root = os.path.join(xbox_model_dir, day)
             if os.path.exists(os.path.join(root, "DONE")):
                 have_base = True
-                sources.append((self._done_ts(root), 0, root))
+                # base sorts AFTER the day's deltas (is_base=1): it is
+                # written at day end and covers them
+                sources.append((di, 1, 0, self._done_ts(root), root))
             for d in glob.glob(os.path.join(root, "delta-*")):
                 m = re.fullmatch(r"delta-(\d+)", os.path.basename(d))
                 if m and os.path.exists(os.path.join(d, "DONE")):
-                    sources.append((self._done_ts(d), int(m.group(1)), d))
+                    sources.append((di, 0, int(m.group(1)),
+                                    self._done_ts(d), d))
         if not have_base:
             raise FileNotFoundError(
                 f"no completed xbox base under {xbox_model_dir} for {days}")
-        self._emb: Dict[int, np.ndarray] = {}
         self._dim: Optional[int] = None
-        self.deltas_applied = sum(1 for _, i, _d in sources if i)
-        for _ts, _i, d in sorted(sources):
-            self._ingest(d)
-        # freeze into a sorted-key gather table (serving-scale lookups are
-        # vectorized, not per-key dict probes), then DROP the build dict —
-        # its rows are views pinning every ingested blob's full array
-        self._n = len(self._emb)
-        self._keys = np.fromiter(self._emb.keys(), np.uint64, count=self._n)
-        order = np.argsort(self._keys)
-        self._keys = self._keys[order]
-        self._rows = (np.stack([self._emb[int(k)] for k in self._keys])
-                      if self._keys.size
+        self.deltas_applied = sum(1 for s in sources if not s[1])
+        # vectorized composition: concatenate every view's blob in apply
+        # order, then one lexsort by (key, apply order) and keep each
+        # key's LAST occurrence — the freshest view wins, keys come out
+        # sorted for the searchsorted lookup, and no per-key python loop
+        # runs (serving-scale bases are 10M+ keys)
+        key_blocks: list = []
+        row_blocks: list = []
+        for _di, _b, _i, _ts, d in sorted(sources):
+            with open(os.path.join(d, "embedding.pkl"), "rb") as f:
+                blob = pickle.load(f)
+            emb = np.asarray(blob["embedding"], np.float32)
+            if self._dim is None and emb.ndim == 2:
+                self._dim = int(emb.shape[1])  # writer emits 2-D even empty
+            key_blocks.append(np.asarray(blob["keys"], np.uint64).ravel())
+            row_blocks.append(emb)
+        all_keys = np.concatenate(key_blocks)
+        seq = np.arange(all_keys.size)
+        order = np.lexsort((seq, all_keys))
+        sk = all_keys[order]
+        last = (np.r_[sk[1:] != sk[:-1], True] if sk.size
+                else np.zeros(0, bool))
+        self._keys = sk[last]
+        self._n = int(self._keys.size)
+        self._rows = (np.vstack(row_blocks)[order[last]] if self._n
                       else np.empty((0, self.dim), np.float32))
-        self._emb = None
 
     @staticmethod
     def _done_ts(dirpath: str) -> float:
         with open(os.path.join(dirpath, "DONE")) as f:
             return float(f.read().strip())
-
-    def _ingest(self, dirpath: str) -> None:
-        with open(os.path.join(dirpath, "embedding.pkl"), "rb") as f:
-            blob = pickle.load(f)
-        emb = np.asarray(blob["embedding"], np.float32)
-        if self._dim is None and emb.ndim == 2:
-            self._dim = int(emb.shape[1])   # writer emits 2-D even empty
-        for k, row in zip(blob["keys"].tolist(), emb):
-            self._emb[int(k)] = row
 
     def __len__(self) -> int:
         return self._n
