@@ -63,10 +63,13 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     scale = scale if scale is not None else 1.0 / (Dh ** 0.5)
     q_pos = idx * T + jnp.arange(T)
 
-    # pvary: the scan carry becomes device-varying (k_pos depends on
-    # axis_index), so the initial constants must carry the same vma type
-    m0 = jax.lax.pvary(jnp.full((B, H, T), -jnp.inf, q.dtype), (axis_name,))
-    l0 = jax.lax.pvary(jnp.zeros((B, H, T), q.dtype), (axis_name,))
+    # pcast-to-varying: the scan carry becomes device-varying (k_pos
+    # depends on axis_index), so the initial constants must carry the
+    # same vma type
+    m0 = jax.lax.pcast(jnp.full((B, H, T), -jnp.inf, q.dtype),
+                       (axis_name,), to="varying")
+    l0 = jax.lax.pcast(jnp.zeros((B, H, T), q.dtype),
+                       (axis_name,), to="varying")
     o0 = jnp.zeros_like(q)
     perm = [(i, (i + 1) % P) for i in range(P)]
 
