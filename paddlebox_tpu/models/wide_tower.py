@@ -1,0 +1,125 @@
+"""Mesh-parallel model-zoo entries: wide-tower DeepFM (TP) and
+expert-parallel MMoE.
+
+The reference replicates its dense towers on every worker — they are small
+(BASELINE.json configs top out at 512-wide). These entries are the
+beyond-reference counterpart for towers that do NOT fit replicated: the
+deep tower's wide hidden layer column/row-splits over a model-parallel
+mesh axis (Megatron split, parallel/tensor_parallel.py), and the MMoE
+variant shards its expert blocks over the axis. Both are mesh-aware zoo
+entries consumed by parallel.mesh_tower.MeshTowerTrainer, which enforces
+the TP autodiff contracts (tp_loss_scale + tp_fix_grads) so a user cannot
+silently train on partial gradients.
+
+Contract (differs from the replicated zoo's init/apply):
+  host_init(seed)  -> (host_params, sharded) — numpy leaves; sharded is a
+                      matching dict of bools (True = leaf stacks [P, ...]
+                      and lives shard-local on the axis)
+  apply_local(p, pooled, axis) -> [B] logits, called per device inside
+                      shard_map with the SHARDED leaves already sliced to
+                      this device (leading [P] axis removed)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.parallel.tensor_parallel import (ep_experts_apply,
+                                                    ep_experts_init,
+                                                    tp_mlp_apply,
+                                                    tp_mlp_init)
+
+
+class TpDeepFM:
+    """DeepFM whose deep tower's first (wide) layer is tensor-parallel.
+
+    FM first/second-order terms are replicated exactly as models/deepfm.py;
+    the deep path is ONE Megatron block (total_in → d_wide/P per device →
+    d_mid, one psum) followed by a small replicated head. d_wide can be
+    4096+ — per-device tower memory is O(d_wide/P)."""
+
+    name = "tp_deepfm"
+    task_names = ("ctr",)
+
+    def __init__(self, spec: ModelSpec, n_shards: int,
+                 d_wide: int = 4096, d_mid: int = 128,
+                 embedx_dim: int = None) -> None:
+        self.spec = spec
+        self.n_shards = n_shards
+        self.d_wide = d_wide
+        self.d_mid = d_mid
+        self.embedx_dim = (embedx_dim if embedx_dim is not None
+                           else spec.slot_dim - 3)
+
+    def host_init(self, seed: int) -> Tuple[Dict, Dict]:
+        rng = np.random.RandomState(seed)
+        p = tp_mlp_init(rng, self.n_shards, self.spec.total_in,
+                        self.d_wide, self.d_mid)
+        p["head_w"] = (0.1 * rng.randn(self.d_mid)).astype(np.float32)
+        p["head_b"] = np.zeros((), np.float32)
+        p["fm_out_w"] = (0.1 * rng.randn(3)).astype(np.float32)
+        p["fm_out_b"] = np.zeros((), np.float32)
+        sharded = {k: k in ("w1", "b1", "w2") for k in p}
+        return p, sharded
+
+    def apply_local(self, p: Dict, pooled: jnp.ndarray,
+                    axis: str) -> jnp.ndarray:
+        B = pooled.shape[0]
+        D = self.embedx_dim
+        first_order = pooled[:, :, 2].sum(axis=1)
+        v = pooled[:, :, 3:3 + D]
+        sum_v = v.sum(axis=1)
+        fm2 = 0.5 * (sum_v * sum_v - (v * v).sum(axis=1)).sum(axis=-1)
+        x = pooled.reshape(B, -1)
+        mid = jax.nn.relu(tp_mlp_apply(p, x, axis))
+        deep = mid @ p["head_w"] + p["head_b"]
+        stack = jnp.stack([first_order, fm2, deep], axis=-1)
+        return stack @ p["fm_out_w"] + p["fm_out_b"]
+
+
+class EpMMoE:
+    """Expert-parallel MMoE-style CTR tower: n_experts dense expert MLPs
+    shard over the mesh axis (each device owns E/P), a replicated softmax
+    gate mixes them (dense MMoE gating — every expert sees every
+    instance), and a small replicated head reads the mixture. The gate's
+    partial-gradient footgun is closed by the trainer's tp_fix_grads."""
+
+    name = "ep_mmoe"
+    task_names = ("ctr",)
+
+    def __init__(self, spec: ModelSpec, n_shards: int, n_experts: int = 8,
+                 d_hidden: int = 64, d_out: int = 32) -> None:
+        if n_experts % n_shards:
+            raise ValueError(f"n_experts {n_experts} not divisible by "
+                             f"{n_shards} shards")
+        self.spec = spec
+        self.n_shards = n_shards
+        self.n_experts = n_experts
+        self.d_hidden = d_hidden
+        self.d_out = d_out
+
+    def host_init(self, seed: int) -> Tuple[Dict, Dict]:
+        rng = np.random.RandomState(seed)
+        p = ep_experts_init(rng, self.n_experts, self.spec.total_in,
+                            self.d_hidden, self.d_out)
+        # expert leaves regroup [E, ...] → [P, E/P, ...] so the mesh axis
+        # is the leading dim (shard_map slices it off)
+        el = self.n_experts // self.n_shards
+        for k in ("ew1", "eb1", "ew2", "eb2"):
+            p[k] = p[k].reshape((self.n_shards, el) + p[k].shape[1:])
+        p["head_w"] = (0.1 * rng.randn(self.d_out)).astype(np.float32)
+        p["head_b"] = np.zeros((), np.float32)
+        sharded = {k: k in ("ew1", "eb1", "ew2", "eb2") for k in p}
+        return p, sharded
+
+    def apply_local(self, p: Dict, pooled: jnp.ndarray,
+                    axis: str) -> jnp.ndarray:
+        B = pooled.shape[0]
+        x = pooled.reshape(B, -1)
+        mix = ep_experts_apply(p, x, axis)          # [B, d_out], psum'd
+        return mix @ p["head_w"] + p["head_b"]

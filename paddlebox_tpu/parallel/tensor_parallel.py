@@ -61,6 +61,36 @@ def tp_mlp_apply(p_local: Dict[str, jnp.ndarray], x: jnp.ndarray,
     return y + p_local["b2"]
 
 
+def tp_loss_scale(loss: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """Enforce the TP autodiff contract's first half: a per-device
+    REPLICATED loss computed from psum'd activations must divide by the
+    axis size before grad, or the psum transpose scales every sharded
+    leaf's gradient by P (measured; see tp_mlp_apply)."""
+    return loss / jax.lax.axis_size(axis)
+
+
+def tp_fix_grads(grads, sharded, axis: str):
+    """Enforce the contract's second half: under the 1/P-scaled loss,
+    SHARDED leaves (w1/w2/expert blocks — their cotangent arrives through
+    the psum transpose) come out exactly right, while every REPLICATED
+    leaf (post-psum params like b2/head, the gate, and the embedding
+    cotangent) carries a PARTIAL or 1/P gradient that must psum across
+    the axis. `sharded` is a matching pytree of bools (True = leaf is
+    shard-local). Returns the corrected grads — use this instead of
+    hand-psum-ing individual leaves (forgetting one trains silently on a
+    partial gradient)."""
+    return jax.tree.map(
+        lambda g, s: g if s else jax.lax.psum(g, axis), grads, sharded)
+
+
+def ep_gate_psum(grads: Dict[str, jnp.ndarray], axis: str
+                 ) -> Dict[str, jnp.ndarray]:
+    """Enforce ep_experts_apply's gate contract: the replicated gate
+    receives a PARTIAL gradient per device (only its expert slice's
+    cotangent) — psum it across the axis before any update."""
+    return dict(grads, gate=jax.lax.psum(grads["gate"], axis))
+
+
 def ep_experts_init(rng: np.random.RandomState, n_experts: int, d_in: int,
                     d_hidden: int, d_out: int,
                     scale: float = 0.1) -> Dict[str, np.ndarray]:
