@@ -115,6 +115,11 @@ define_flag("dump_file_max_bytes", 2 << 30,
 define_flag("stack_threads", 4,
             "host batch-staging threads per scan chunk (lookup + dedup; "
             "the feed-thread pool role, box_wrapper.h:862); <=1 = serial")
+define_flag("stager_threads", 4,
+            "sharded-trainer routing threads: per-worker bucketize and "
+            "per-destination push dedup fan out on this pool inside the "
+            "stager (reference 20/30 reader/merge threads, "
+            "flags.cc:966-968); <=1 = serial")
 define_flag("stream_depth", 2,
             "sharded-trainer input stream: staged-ahead step queue depth "
             "(peak live routed steps is this + 2: one in the consumer's "
