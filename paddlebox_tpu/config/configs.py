@@ -111,6 +111,10 @@ class DataFeedConfig:
     task_label_slots: Tuple[Tuple[str, str], ...] = ()
     # static capacity of flattened sparse keys per batch; 0 = batch*avg heuristic
     batch_key_capacity: int = 0
+    # lines start with the instance id string (SlotRecordInMemoryDataFeed
+    # parse_ins_id_); the id keys dump-field lines and InputTable aux-row
+    # translation (InputTableDataFeed, data_feed.h:2221-2252)
+    parse_ins_id: bool = False
 
     def used_sparse_slots(self) -> List[SlotConfig]:
         return [s for s in self.slots if s.is_used and s.type == "uint64"]

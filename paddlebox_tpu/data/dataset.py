@@ -34,11 +34,17 @@ AddKeysFn = Callable[[np.ndarray], None]
 class BoxDataset:
     def __init__(self, feed: DataFeedConfig, read_threads: int = 4,
                  parser: Optional[MultiSlotParser] = None,
-                 shuffler=None, columnar: Optional[bool] = None) -> None:
+                 shuffler=None, columnar: Optional[bool] = None,
+                 input_table=None, use_cache_idx: bool = False) -> None:
+        """input_table / use_cache_idx: aux-row offset sources wired
+        through the packer (the InputTableDataFeed / pull_cache_value
+        feed roles — see BatchPacker); they force the record path since
+        offsets translate per SlotRecord."""
         self.feed = feed
         self.read_threads = read_threads
         self.parser = parser or MultiSlotParser(feed)
-        self.packer = BatchPacker(feed)
+        self.packer = BatchPacker(feed, input_table=input_table,
+                                  use_cache_idx=use_cache_idx)
         self.shuffler = shuffler  # cross-host instance shuffle transport
         self._files: List[str] = []
         self._records: List[SlotRecord] = []
@@ -72,6 +78,11 @@ class BoxDataset:
         if columnar and feed.rank_offset:
             # pv rank-offset matrices are built from per-record pv fields
             # (search_id/rank/cmatch) which the columnar blocks don't carry
+            columnar = False
+        if columnar and (input_table is not None or use_cache_idx
+                         or getattr(feed, "parse_ins_id", False)):
+            # aux offsets and ins_id-prefixed lines translate per
+            # SlotRecord; the native columnar parser reads plain lines
             columnar = False
         # per-task label feeds ride the columnar path too: the extended
         # native entry (psr_parse_file2) emits task-label columns; the

@@ -45,6 +45,9 @@ class PackedBatch:
     cmatch_rank: Optional[np.ndarray] = None  # [B] uint64
     # task name → [B] int32 labels (tasks fall back to `labels`)
     task_labels: Optional[dict] = None
+    # per-instance side-table row offset (lookup_input / pull_cache_value
+    # consumers; see BatchPacker input_table/use_cache_idx)
+    aux_offset: Optional[np.ndarray] = None  # [B] int32
 
     @property
     def batch_size(self) -> int:
@@ -52,7 +55,17 @@ class PackedBatch:
 
 
 class BatchPacker:
-    def __init__(self, feed: DataFeedConfig, max_rank: int = 3) -> None:
+    def __init__(self, feed: DataFeedConfig, max_rank: int = 3,
+                 input_table=None, use_cache_idx: bool = False) -> None:
+        """input_table: embedding.side_tables.InputTable — when set, each
+        packed instance's ins_id translates to an aux-row offset at pack
+        time (the InputTableDataFeed role, data_feed.h:2221-2252: the
+        feed, not the model, resolves string keys; misses map to the zero
+        row at offset 0). use_cache_idx: carry SlotRecord.cache_idx as
+        the offset instead (the pull_cache_value index source,
+        GpuReplicaCache box_wrapper.h:62-121). Both emit the SAME
+        `aux_offset` batch leaf — on device each is one gather from a
+        replicated side table."""
         self.feed = feed
         self.sparse_slots = feed.used_sparse_slots()
         self.dense_slots = feed.used_dense_slots()
@@ -61,6 +74,11 @@ class BatchPacker:
         self.batch_size = feed.batch_size
         self.kcap = feed.key_capacity()
         self.max_rank = max_rank
+        if input_table is not None and use_cache_idx:
+            raise ValueError("input_table and use_cache_idx are exclusive "
+                             "aux-offset sources")
+        self.input_table = input_table
+        self.use_cache_idx = use_cache_idx
 
     def pack(self, records: Sequence[SlotRecord],
              with_rank_offset: Optional[bool] = None) -> PackedBatch:
@@ -130,6 +148,27 @@ class BatchPacker:
                             ins_ids=[r.ins_id for r in records[:n]],
                             cmatch_rank=cmatch_rank,
                             task_labels=task_labels)
+        if self.input_table is not None:
+            aux = np.zeros(B, dtype=np.int32)
+            for i in range(n):
+                aux[i] = self.input_table.get_index_offset(
+                    records[i].ins_id)
+            batch.aux_offset = aux
+        elif self.use_cache_idx:
+            # unlike InputTable, ReplicaCache has NO reserved zero row —
+            # index 0 is the first real cached embedding, so a record
+            # without an index must fail loudly rather than silently
+            # train on another record's cache row
+            aux = np.zeros(B, dtype=np.int32)
+            for i in range(n):
+                ci = records[i].cache_idx
+                if ci < 0:
+                    raise ValueError(
+                        f"use_cache_idx: record {i} (ins_id="
+                        f"{records[i].ins_id!r}) has no cache_idx — every "
+                        "instance needs a ReplicaCache row index")
+                aux[i] = ci
+            batch.aux_offset = aux
         if with_rank_offset:
             batch.rank_offset = self._build_rank_offset(records[:n], B)
         return batch

@@ -36,6 +36,12 @@ class MultiSlotParser:
             return None
         rec = SlotRecord()
         pos = 0
+        if getattr(self.feed, "parse_ins_id", False):
+            # parse_ins_id_ lines lead with the instance id string
+            # (SlotRecordInMemoryDataFeed; feeds InputTable translation
+            # and dump-field ins_id columns)
+            rec.ins_id = toks[0]
+            pos = 1
         u_idx = 0
         f_idx = 0
         try:

@@ -18,14 +18,16 @@ import numpy as np
 
 class SlotRecord:
     __slots__ = ("label", "uint64_slots", "float_slots", "ins_id", "rank",
-                 "cmatch", "qvalue", "search_id", "extra_labels")
+                 "cmatch", "qvalue", "search_id", "extra_labels",
+                 "cache_idx")
 
     def __init__(self, label: int = 0,
                  uint64_slots: Optional[Dict[int, np.ndarray]] = None,
                  float_slots: Optional[Dict[int, np.ndarray]] = None,
                  ins_id: str = "", rank: int = 0, cmatch: int = 0,
                  qvalue: float = 0.0, search_id: int = 0,
-                 extra_labels: Optional[Dict[str, int]] = None) -> None:
+                 extra_labels: Optional[Dict[str, int]] = None,
+                 cache_idx: int = -1) -> None:
         self.label = label
         # slot index (position in feed config) → values
         self.uint64_slots = uint64_slots or {}
@@ -38,6 +40,9 @@ class SlotRecord:
         # task name → label for multi-task heads (conversion/pay/...);
         # tasks absent here train on the primary click label
         self.extra_labels = extra_labels or {}
+        # replica-cache row index for pull_cache_value consumers
+        # (GpuReplicaCache, box_wrapper.h:62-121); -1 = none
+        self.cache_idx = cache_idx
 
     def all_keys(self) -> np.ndarray:
         if not self.uint64_slots:

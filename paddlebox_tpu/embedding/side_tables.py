@@ -43,12 +43,22 @@ class ReplicaCache:
     def __len__(self) -> int:
         return len(self._rows)
 
-    def to_device(self) -> jnp.ndarray:
+    def to_device(self, capacity: int = None) -> jnp.ndarray:
         """Freeze → [n, dim] device array (ToHBM analog; callers device_put
-        with a replicated sharding on a mesh)."""
+        with a replicated sharding on a mesh). capacity: zero-pad to a
+        fixed row count so a consumer jitted against the table keeps a
+        static shape across passes (the aux-rows-as-frozen-params path,
+        models/aux_input.py)."""
         with self._lock:
             host = (np.stack(self._rows) if self._rows
                     else np.zeros((1, self.dim), np.float32))
+        if capacity is not None:
+            if host.shape[0] > capacity:
+                raise ValueError(
+                    f"replica cache holds {host.shape[0]} rows > "
+                    f"capacity {capacity}")
+            host = np.vstack([host, np.zeros(
+                (capacity - host.shape[0], self.dim), np.float32)])
         self._device = jnp.asarray(host)
         return self._device
 
@@ -92,9 +102,16 @@ class InputTable:
     def size(self) -> int:
         return len(self._rows)
 
-    def to_device(self) -> jnp.ndarray:
+    def to_device(self, capacity: int = None) -> jnp.ndarray:
+        """See ReplicaCache.to_device for the capacity contract."""
         with self._lock:
             host = np.stack(self._rows)
+        if capacity is not None:
+            if host.shape[0] > capacity:
+                raise ValueError(f"input table holds {host.shape[0]} rows "
+                                 f"> capacity {capacity}")
+            host = np.vstack([host, np.zeros(
+                (capacity - host.shape[0], self.dim), np.float32)])
         self._device = jnp.asarray(host)
         return self._device
 

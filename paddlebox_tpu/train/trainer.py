@@ -305,6 +305,7 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
     if use_expand and has_summary:
         raise ValueError("expand embedding + data_norm summary is not "
                          "supported in one model")
+    wants_aux = bool(getattr(model, "use_aux_input", False))
 
     # per-key slots/valid are DERIVED on device, not transferred: the packer
     # guarantees segments = ins*num_slots + slot and lookup_ids maps every
@@ -341,6 +342,12 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         elif wants_rank_offset and "rank_offset" in batch:
             logits = model.apply(params, pooled, dense_in,
                                  rank_offset=batch["rank_offset"])
+        elif wants_aux:
+            # side-table consumer (lookup_input / pull_cache_value): the
+            # model gathers its frozen aux rows by the feed-translated
+            # offsets; apply raises loudly if the feed lacks the leaf
+            logits = model.apply(params, pooled, dense_in,
+                                 aux_offset=batch.get("aux_offset"))
         else:
             logits = model.apply(params, pooled, dense_in)
         if mixed:
@@ -477,9 +484,19 @@ class BoxTrainer:
 
     def __init__(self, model, table_cfg: TableConfig, feed: DataFeedConfig,
                  trainer_cfg: Optional[TrainerConfig] = None,
-                 seed: int = 0, use_cvm: bool = True) -> None:
+                 seed: int = 0, use_cvm: bool = True,
+                 aux_source=None) -> None:
+        """aux_source: a ReplicaCache or InputTable whose frozen rows an
+        aux-consuming model (use_aux_input, e.g. CtrDnnAux) gathers on
+        device — refreshed into params['aux_rows'] at every pass start at
+        the model's fixed aux_capacity (static shapes, no recompile)."""
         self.model = model
         self.cfg = trainer_cfg or TrainerConfig()
+        self.aux_source = aux_source
+        if aux_source is not None and not getattr(model, "use_aux_input",
+                                                  False):
+            raise ValueError("aux_source given but the model does not "
+                             "consume aux rows (use_aux_input)")
         if self.cfg.sync_mode in ("k_step", "sharding") or self.cfg.sharding:
             raise ValueError(
                 "sync_mode=%r / sharding=%r need the multi-device "
@@ -611,6 +628,8 @@ class BoxTrainer:
             out["dense"] = b.dense
         if b.rank_offset is not None:
             out["rank_offset"] = b.rank_offset
+        if b.aux_offset is not None:
+            out["aux_offset"] = b.aux_offset
         if self.multi_task:
             # per-task labels from the packer (task_label_slots config);
             # tasks without a packed label train on the click label
@@ -623,6 +642,15 @@ class BoxTrainer:
                      ids: np.ndarray) -> Dict[str, jnp.ndarray]:
         return {k: jnp.asarray(v)
                 for k, v in self.host_batch(b, ids).items()}
+
+    def _refresh_aux(self) -> None:
+        """ToHBM cadence (box_wrapper.h:83): freeze the side table's
+        current rows into the non-trained aux_rows leaf — shared by ALL
+        pass drivers (train_pass, train_pass_profiled, predict_batches)
+        so none runs on stale or init-zero rows."""
+        if self.aux_source is not None:
+            self.params = dict(self.params, aux_rows=self.aux_source
+                               .to_device(self.model.aux_capacity))
 
     # ---------------------------------------------------------- pass cadence
     def train_pass(self, dataset: BoxDataset,
@@ -639,6 +667,7 @@ class BoxTrainer:
             self.table.begin_feed_pass()
             dataset.load_into_memory(add_keys_fn=self.table.add_keys)
             self.table.end_feed_pass()
+        self._refresh_aux()
         self.table.begin_pass()
         dataset.local_shuffle(self._shuffle_rng.randint(1 << 31))
         worker_batches = dataset.split_batches(num_workers=1)
@@ -791,6 +820,7 @@ class BoxTrainer:
         self.table.begin_feed_pass()
         dataset.load_into_memory(add_keys_fn=self.table.add_keys)
         self.table.end_feed_pass()
+        self._refresh_aux()
         self.table.begin_pass()
         dataset.local_shuffle(self._shuffle_rng.randint(1 << 31))
         losses = []
@@ -829,6 +859,7 @@ class BoxTrainer:
         self.table.begin_feed_pass()
         self.table.add_keys(dataset.all_keys())
         self.table.end_feed_pass()
+        self._refresh_aux()
         self.table.begin_pass()
         preds_all, labels_all = [], []
         for b in dataset.split_batches(num_workers=1)[0]:

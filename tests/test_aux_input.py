@@ -1,0 +1,178 @@
+"""Side tables consumed THROUGH the feed path (round-3 verdict item 7):
+InputTable offsets translate from ins_id at pack time (InputTableDataFeed,
+data_feed.h:2221-2252), ReplicaCache indexes ride SlotRecord.cache_idx
+(pull_cache_value), and CtrDnnAux gathers the frozen rows on device."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config.configs import (DataFeedConfig, SlotConfig,
+                                          SparseOptimizerConfig,
+                                          TableConfig, TrainerConfig)
+from paddlebox_tpu.data import BoxDataset
+from paddlebox_tpu.data.packer import BatchPacker
+from paddlebox_tpu.data.slot_record import SlotRecord
+from paddlebox_tpu.embedding.side_tables import InputTable, ReplicaCache
+from paddlebox_tpu.models.aux_input import CtrDnnAux
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.train.trainer import BoxTrainer
+
+AUX_DIM = 4
+NUM_SLOTS = 2
+VOCAB = 40
+
+
+def _feed(mb=32):
+    slots = [SlotConfig("click", type="float", dim=1, is_used=False)]
+    for i in range(NUM_SLOTS):
+        slots.append(SlotConfig(f"slot_{i}", type="uint64", max_len=2))
+    return DataFeedConfig(slots=tuple(slots), batch_size=mb,
+                          parse_ins_id=True)
+
+
+def _write_files(tmp_path, n_lines=512, n_items=8, seed=0):
+    """ins_id-prefixed MultiSlot lines where the CLICK depends ONLY on the
+    item's hidden group — learnable solely through the aux row."""
+    rng = np.random.RandomState(seed)
+    item_group = (np.arange(n_items) % 2).astype(np.float32)  # 0/1 groups
+    path = os.path.join(str(tmp_path), "part-00000.txt")
+    with open(path, "w") as f:
+        for _ in range(n_lines):
+            item = rng.randint(n_items)
+            p = 0.9 if item_group[item] else 0.1
+            click = int(rng.rand() < p)
+            toks = [f"item{item}", f"1 {click}"]
+            for si in range(NUM_SLOTS):
+                n = rng.randint(1, 3)
+                feas = rng.randint(0, VOCAB, n) + si * VOCAB
+                toks.append(str(n) + " " + " ".join(map(str, feas)))
+            f.write(" ".join(toks) + "\n")
+    return [path], item_group
+
+
+def _table_cfg():
+    return TableConfig(
+        embedx_dim=4, pass_capacity=1 << 10,
+        optimizer=SparseOptimizerConfig(mf_create_thresholds=1e9,
+                                        mf_initial_range=0.0))
+
+
+def _aux_table(item_group, rows_for):
+    t = InputTable(AUX_DIM)
+    for i in rows_for:
+        row = np.zeros(AUX_DIM, np.float32)
+        row[0] = 1.0 if item_group[i] else -1.0
+        t.add_index_data(f"item{i}", row)
+    return t
+
+
+def test_parse_ins_id_and_pack_offsets(tmp_path):
+    """The feed translates ins_id → offset at pack time; misses → 0."""
+    files, item_group = _write_files(tmp_path, n_lines=64)
+    feed = _feed()
+    table = _aux_table(item_group, rows_for=range(4))  # items 4..7 miss
+    ds = BoxDataset(feed, read_threads=1, input_table=table)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    b = ds.split_batches(num_workers=1)[0][0]
+    assert b.aux_offset is not None and b.aux_offset.shape[0] == 32
+    for j in range(b.n_ins):
+        ins = b.ins_ids[j]
+        assert ins.startswith("item")
+        item = int(ins[4:])
+        if item < 4:
+            assert b.aux_offset[j] == table.get_index_offset(ins) > 0
+        else:
+            assert b.aux_offset[j] == 0
+    assert table.miss > 0
+
+
+def test_input_table_model_e2e_learns_from_aux(tmp_path):
+    """The signal lives ONLY in the aux row: with the populated table the
+    model separates the groups; with an empty table (all-miss → zero
+    rows) it cannot — proof the model consumes the rows through the
+    feed path, not incidentally."""
+    files, item_group = _write_files(tmp_path, n_lines=512)
+    feed = _feed()
+    spec = ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + 4)
+
+    def run(table):
+        model = CtrDnnAux(spec, aux_dim=AUX_DIM, aux_capacity=64,
+                          hidden=(32, 16))
+        tr = BoxTrainer(model, _table_cfg(), feed,
+                        TrainerConfig(dense_lr=5e-3, scan_chunk=1),
+                        seed=0, aux_source=table)
+        ds = BoxDataset(feed, read_threads=1, input_table=table)
+        ds.set_filelist(files)
+        losses = [tr.train_pass(ds)["loss"] for _ in range(4)]
+        return losses
+
+    with_aux = run(_aux_table(item_group, rows_for=range(8)))
+    without = run(_aux_table(item_group, rows_for=()))
+    assert with_aux[-1] < with_aux[0] - 0.05, with_aux
+    # ~0.33 is the label-marginal entropy floor without the aux signal
+    assert with_aux[-1] < without[-1] - 0.1, (with_aux, without)
+
+
+def test_aux_rows_not_trained(tmp_path):
+    """aux_rows is a frozen leaf: the optimizer must never move it (the
+    dn_summary zero-grad contract)."""
+    files, item_group = _write_files(tmp_path, n_lines=128)
+    feed = _feed()
+    table = _aux_table(item_group, rows_for=range(8))
+    spec = ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + 4)
+    model = CtrDnnAux(spec, aux_dim=AUX_DIM, aux_capacity=64,
+                      hidden=(16,))
+    tr = BoxTrainer(model, _table_cfg(), feed,
+                    TrainerConfig(dense_lr=5e-3), seed=0, aux_source=table)
+    ds = BoxDataset(feed, read_threads=1, input_table=table)
+    ds.set_filelist(files)
+    tr.train_pass(ds)
+    want = np.asarray(table.to_device(64))
+    np.testing.assert_array_equal(np.asarray(tr.params["aux_rows"]), want)
+
+
+def test_replica_cache_idx_feed_path():
+    """pull_cache_value flow: records carry cache_idx, the packer emits
+    the offsets, the model's logits respond to the cached rows."""
+    feed = _feed(mb=8)
+    rc = ReplicaCache(AUX_DIM)
+    i_neg = rc.add_items(np.array([-2.0, 0, 0, 0], np.float32))
+    i_pos = rc.add_items(np.array([2.0, 0, 0, 0], np.float32))
+    rng = np.random.RandomState(3)
+    recs = []
+    for j in range(8):
+        slots = {si: rng.randint(0, VOCAB, 2).astype(np.uint64)
+                 for si in range(NUM_SLOTS)}
+        recs.append(SlotRecord(label=j % 2, uint64_slots=slots,
+                               cache_idx=(i_pos if j % 2 else i_neg)))
+    packer = BatchPacker(feed, use_cache_idx=True)
+    b = packer.pack(recs)
+    np.testing.assert_array_equal(b.aux_offset[:8],
+                                  [i_neg, i_pos] * 4)
+
+    spec = ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + 4)
+    model = CtrDnnAux(spec, aux_dim=AUX_DIM, aux_capacity=16, hidden=(8,))
+    tr = BoxTrainer(model, _table_cfg(), feed,
+                    TrainerConfig(dense_lr=1e-2), seed=1, aux_source=rc)
+    tr.table.begin_feed_pass()
+    tr.table.add_keys(b.keys[b.valid])
+    tr.table.end_feed_pass()
+    tr.params = dict(tr.params, aux_rows=rc.to_device(16))
+    tr.table.begin_pass()
+    ids = tr.table.lookup_ids(b.keys, b.valid)
+    batch = tr.device_batch(b, ids)
+    preds_a = np.asarray(
+        tr.fns.eval_step(tr.table.slab, tr.params, batch)["ctr"])
+
+    # different cache contents must change the logits (the gather is live)
+    rc2 = ReplicaCache(AUX_DIM)
+    rc2.add_items(np.array([5.0, 5.0, 5.0, 5.0], np.float32))
+    rc2.add_items(np.array([-5.0, 5.0, -5.0, 5.0], np.float32))
+    tr.params = dict(tr.params, aux_rows=rc2.to_device(16))
+    preds_b = np.asarray(
+        tr.fns.eval_step(tr.table.slab, tr.params, batch)["ctr"])
+    assert np.abs(preds_a - preds_b).max() > 1e-4
