@@ -27,6 +27,9 @@ if __name__ == "__main__":
     os.environ.setdefault("XLA_FLAGS",
                           "--xla_force_host_platform_device_count=8")
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # run as a script: sys.path[0] is tools/, the repo root isn't there
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
 
 import numpy as np
 
@@ -55,8 +58,9 @@ def build_sharded():
     P = len(jax.devices())
     feed = default_feed_config(num_slots=NUM_SLOTS, batch_size=BATCH,
                                max_len=MAX_LEN)
+    # weak scaling: each shard gets the single-device bench's 1M-row slab
     table_cfg = TableConfig(
-        embedx_dim=D, pass_capacity=PASS_CAP,
+        embedx_dim=D, pass_capacity=P * PASS_CAP,
         optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
                                         mf_initial_range=1e-3))
     model = DeepFM(ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + D),
