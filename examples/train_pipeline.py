@@ -31,6 +31,10 @@ def main() -> None:
                     help="pipeline stages (default: all devices)")
     ap.add_argument("--micro", type=int, default=0,
                     help="micro-batches per step (default: 2 x stages)")
+    ap.add_argument("--sharded-slab", action="store_true",
+                    help="key-mod-shard the pass table over the stage "
+                         "devices (O(pass/P) table memory per device) "
+                         "instead of replicating it")
     args = ap.parse_args()
 
     import jax
@@ -54,8 +58,12 @@ def main() -> None:
         optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
                                         mf_initial_range=1e-3))
     # the factory resolves the reference trainer name to the CTR program
-    # split (trainer_factory.cc name surface)
-    runner = create_trainer("HeterPipelineTrainer", table, feed,
+    # split (trainer_factory.cc name surface); --sharded-slab picks the
+    # composition over the full key-mod-sharded PS (section_worker.cc
+    # sections against the sharded table)
+    name = ("ShardedCtrPipelineTrainer" if args.sharded_slab
+            else "HeterPipelineTrainer")
+    runner = create_trainer(name, table, feed,
                             n_stages=S, d_model=64, layers_per_stage=1,
                             lr=5e-3, n_micro=args.micro or 2 * S, seed=0)
 
@@ -66,8 +74,14 @@ def main() -> None:
         print(f"pass {i}: loss={stats['loss']:.4f} steps={stats['steps']} "
               f"(dropped {stats['dropped_batches']} tail batches)")
         ds.release_memory()
-    keys, _ = runner.table.store.state_items()
-    print("features trained:", keys.size)
+    if args.sharded_slab:
+        keys, _ = runner.table.store_view().state_items()
+        print(f"features trained: {keys.size} across "
+              f"{runner.table.num_shards} shards "
+              f"(shard slab {runner.table.shard_cap} rows)")
+    else:
+        keys, _ = runner.table.store.state_items()
+        print("features trained:", keys.size)
 
 
 if __name__ == "__main__":

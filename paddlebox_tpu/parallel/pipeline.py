@@ -229,6 +229,28 @@ class GPipeRunner:
         return out
 
 
+def _grouped_train_pass(runner, dataset, begin_pass, end_pass
+                        ) -> Dict[str, float]:
+    """The ONE pass-cadence driver both CTR pipeline runners share: feed
+    pass → slab build (begin_pass hook) → full dp×n_micro-group steps →
+    EndPass write-back (end_pass hook). Trailing batches short of a full
+    micro-batch group are dropped (the reference's section pipeline also
+    only runs full pipelines)."""
+    runner.table.begin_feed_pass()
+    dataset.load_into_memory(add_keys_fn=runner.table.add_keys)
+    runner.table.end_feed_pass()
+    begin_pass()
+    batches = dataset.split_batches(num_workers=1)[0]
+    M = runner.batches_per_step
+    losses = []
+    for lo in range(0, len(batches) - M + 1, M):
+        losses.append(runner.train_step(batches[lo:lo + M]))
+    end_pass()
+    return {"loss": float(np.mean(losses)) if losses else 0.0,
+            "steps": len(losses),
+            "dropped_batches": len(batches) % M}
+
+
 def ctr_stage_host_params(seed: int, n_stages: int, layers_per_stage: int,
                           pooled_dim: int, d_model: int,
                           scale: float = 0.1) -> Dict[str, np.ndarray]:
@@ -498,23 +520,10 @@ class CtrPipelineRunner:
         return float(loss)
 
     def train_pass(self, dataset) -> Dict[str, float]:
-        """BoxPS pass cadence around the pipelined step: feed pass →
-        slab build → n_micro-batch steps → EndPass write-back. Trailing
-        batches short of a full micro-batch group are dropped (the
-        reference's section pipeline also only runs full pipelines)."""
-        self.table.begin_feed_pass()
-        dataset.load_into_memory(add_keys_fn=self.table.add_keys)
-        self.table.end_feed_pass()
-        self.table.begin_pass()
-        batches = dataset.split_batches(num_workers=1)[0]
-        M = self.batches_per_step
-        losses = []
-        for lo in range(0, len(batches) - M + 1, M):
-            losses.append(self.train_step(batches[lo:lo + M]))
-        self.table.end_pass()
-        return {"loss": float(np.mean(losses)) if losses else 0.0,
-                "steps": len(losses),
-                "dropped_batches": len(batches) % M}
+        """BoxPS pass cadence around the pipelined step (the shared
+        _grouped_train_pass driver)."""
+        return _grouped_train_pass(self, dataset, self.table.begin_pass,
+                                   self.table.end_pass)
 
 
 class ShardedCtrPipelineRunner:
@@ -614,7 +623,7 @@ class ShardedCtrPipelineRunner:
 
     # ------------------------------------------------------------- jit step
     def _build_step(self):
-        from paddlebox_tpu.embedding.optimizers import push_sparse_dedup
+        from paddlebox_tpu.embedding.optimizers import push_sparse_hostdedup
         from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
         from paddlebox_tpu.ops.sparse import build_push_grads, pull_sparse
 
@@ -709,9 +718,14 @@ class ShardedCtrPipelineRunner:
                 jnp.where(kv[:, None], pg, 0.0))
             recv_g = jax.lax.all_to_all(
                 bucket_g.reshape(Pn, KB, -1), flat, 0, 0, tiled=True)
-            slab = push_sparse_dedup(slab, req.reshape(-1),
-                                     recv_g.reshape(Pn * KB, -1), sub,
-                                     layout, conf)
+            # incoming ids are host-known in a single process, so the
+            # shard-side dedup was precomputed (device_batch) — no
+            # per-step on-device jnp.unique sort (the dominant fused-step
+            # cost the sharded trainer's host-dedup path removed)
+            slab = push_sparse_hostdedup(
+                slab, batch["push_uids"], batch["push_perm"],
+                batch["push_inv"], recv_g.reshape(Pn * KB, -1), sub,
+                layout, conf)
 
             params = jax.tree.map(lambda x: x[None], local)
             opt_state = jax.tree.map(
@@ -766,6 +780,17 @@ class ShardedCtrPipelineRunner:
                 leaves["labels"].append(np.stack([b.labels for b in sub]))
                 leaves["ins_valid"].append(np.stack([b.ins_valid
                                                      for b in sub]))
+        # single process sees every device's outgoing buckets: precompute
+        # the per-shard push dedup (the a2a's incoming ids) so the step
+        # needs no on-device sort — same trick as the sharded trainer
+        from paddlebox_tpu.embedding.pass_table import dedup_ids
+        for d in range(self.P):
+            incoming = np.concatenate(
+                [leaves["buckets"][src][d] for src in range(self.P)])
+            uids, perm, inv = dedup_ids(incoming, self.table.shard_cap)
+            leaves.setdefault("push_uids", []).append(uids)
+            leaves.setdefault("push_perm", []).append(perm)
+            leaves.setdefault("push_inv", []).append(inv)
         sh = NamedSharding(self.mesh, P(self.flat_axes))
         return {k: jax.device_put(np.stack(v), sh)
                 for k, v in leaves.items()}
@@ -790,18 +815,8 @@ class ShardedCtrPipelineRunner:
         return float(loss)
 
     def train_pass(self, dataset) -> Dict[str, float]:
-        """Pass cadence with the sharded table (trailing partial groups
-        drop, as in CtrPipelineRunner.train_pass)."""
-        self.table.begin_feed_pass()
-        dataset.load_into_memory(add_keys_fn=self.table.add_keys)
-        self.table.end_feed_pass()
-        self.begin_pass()
-        batches = dataset.split_batches(num_workers=1)[0]
-        M = self.batches_per_step
-        losses = []
-        for lo in range(0, len(batches) - M + 1, M):
-            losses.append(self.train_step(batches[lo:lo + M]))
-        self.end_pass()
-        return {"loss": float(np.mean(losses)) if losses else 0.0,
-                "steps": len(losses),
-                "dropped_batches": len(batches) % M}
+        """Pass cadence with the sharded table (the shared
+        _grouped_train_pass driver; begin/end build and write back the
+        sharded slab stack)."""
+        return _grouped_train_pass(self, dataset, self.begin_pass,
+                                   self.end_pass)

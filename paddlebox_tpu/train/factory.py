@@ -66,6 +66,17 @@ def _builtin(name: str):
         # is that capability on this runtime
         from paddlebox_tpu.parallel.pipeline import CtrPipelineRunner
         return CtrPipelineRunner
+    if name in ("ShardedCtrPipelineTrainer", "SectionPSTrainer"):
+        # section programs over the FULL key-mod-sharded PS (the
+        # section_worker.cc op loop running pull_box_sparse against the
+        # sharded table): per-device table memory O(pass/P)
+        from paddlebox_tpu.parallel.pipeline import ShardedCtrPipelineRunner
+        return ShardedCtrPipelineRunner
+    if name == "MeshTowerTrainer":
+        # model-parallel towers (TP wide layers / EP experts) with the
+        # autodiff contracts enforced in the trainer
+        from paddlebox_tpu.parallel.mesh_tower import MeshTowerTrainer
+        return MeshTowerTrainer
     return None
 
 

@@ -55,3 +55,20 @@ def test_train_ctr_example_expand():
 def test_serve_xbox_example():
     out = run_example("serve_xbox.py", "--passes", "1")
     assert "serving view:" in out and "feasign" in out
+
+
+def test_train_pipeline_example_sharded_slab():
+    out = run_example("train_pipeline.py", "--passes", "2", "--stages", "4",
+                      "--sharded-slab")
+    assert "features trained" in out and "shards" in out
+
+
+def test_train_mesh_tower_example():
+    out = run_example("train_mesh_tower.py", "--kind", "tp", "--passes",
+                      "2", "--wide", "256")
+    assert "features trained" in out
+
+
+def test_train_aux_input_example():
+    out = run_example("train_aux_input.py", "--passes", "2")
+    assert "aux rows served" in out
