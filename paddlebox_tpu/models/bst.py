@@ -1,0 +1,134 @@
+"""BST-style behavior-sequence CTR model — the long-context consumer.
+
+The reference pools every slot (no attention models; SURVEY §2.8 notes no
+sequence parallelism either). This zoo entry treats a user's behavior
+history as a first-class SEQUENCE: one designated slot's feasigns keep
+their order, embed through the same pass slab, and self-attend
+(Behavior Sequence Transformer shape) before joining the pooled-slot CTR
+tower. For long histories the sequence axis shards over an `sp` mesh axis
+and attention runs as ring attention (flash-style ppermute ring) or
+Ulysses (seq→head all_to_all) — the parallel/ring_attention.py primitives,
+here consumed by a real trained model (parallel/seq_trainer.py).
+
+Mesh-aware contract (like models/wide_tower.py): host_init(seed) →
+(host_params, sharded mask — everything replicated here; sequence
+parallelism shards ACTIVATIONS, not params), and per-device apply pieces
+called inside shard_map."""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.models.layers import mlp_apply, mlp_init
+from paddlebox_tpu.parallel.ring_attention import (ring_attention,
+                                                   ulysses_attention)
+
+
+class BstSeqCtr:
+    """Pooled slots (seqpool+CVM) + attended behavior sequence → MLP head.
+
+    seq_len: TOTAL history length (padded; must divide the sp mesh size).
+    attn: "ring" | "ulysses" — which sequence-parallel primitive runs the
+    attention (ulysses needs heads % P == 0)."""
+
+    name = "bst_seq_ctr"
+    task_names = ("ctr",)
+
+    def __init__(self, spec: ModelSpec, seq_len: int, n_shards: int,
+                 heads: int = 4, d_head: int = 8, d_seq: int = 16,
+                 hidden=(64, 32), attn: str = "ring") -> None:
+        if seq_len % n_shards:
+            raise ValueError(f"seq_len {seq_len} must divide by the mesh "
+                             f"size {n_shards}")
+        if attn not in ("ring", "ulysses"):
+            raise ValueError(f"attn must be ring|ulysses, got {attn!r}")
+        if attn == "ulysses" and heads % n_shards:
+            raise ValueError(f"ulysses needs heads {heads} divisible by "
+                             f"{n_shards}")
+        self.spec = spec
+        self.seq_len = seq_len
+        self.n_shards = n_shards
+        self.heads = heads
+        self.d_head = d_head
+        self.d_seq = d_seq
+        self.hidden = tuple(hidden)
+        self.attn = attn
+
+    def host_init(self, seed: int) -> Tuple[Dict, Dict]:
+        rng = np.random.RandomState(seed)
+        Din = self.spec.slot_dim          # pull row width (3 + embedx)
+        H, Dh = self.heads, self.d_head
+        s = 0.1
+
+        def mat(*shape):
+            return (s * rng.randn(*shape)).astype(np.float32)
+
+        p = {
+            "pos_emb": mat(self.seq_len, Din),
+            "wq": mat(Din, H * Dh), "wk": mat(Din, H * Dh),
+            "wv": mat(Din, H * Dh), "wo": mat(H * Dh, self.d_seq),
+            "bo": np.zeros(self.d_seq, np.float32),
+        }
+        dims = [self.spec.total_in + self.d_seq, *self.hidden, 1]
+        rng_j = jax.random.PRNGKey(seed + 7)
+        mlp = jax.tree.map(np.asarray, mlp_init(rng_j, dims, "dnn"))
+        p.update(mlp)
+        # sequence parallelism shards activations, not params
+        return p, {k: False for k in p}
+
+    def seq_feature_local(self, p: Dict, emb_chunk: jnp.ndarray,
+                          valid_chunk: jnp.ndarray, axis: str
+                          ) -> jnp.ndarray:
+        """This device's sequence chunk → the psum'd [B, d_seq] feature.
+
+        emb_chunk: [B, T/P, Din] pulled history embeddings (local chunk);
+        valid_chunk: [B, T/P] bool. Masked positions attend as zeros and
+        are excluded from the mean pool."""
+        B, Tl, Din = emb_chunk.shape
+        H, Dh = self.heads, self.d_head
+        idx = jax.lax.axis_index(axis)
+        pos = jax.lax.dynamic_slice_in_dim(p["pos_emb"], idx * Tl, Tl, 0)
+        tok = jnp.where(valid_chunk[..., None],
+                        emb_chunk + pos[None], 0.0)
+        q = (tok @ p["wq"]).reshape(B, Tl, H, Dh)
+        k = (tok @ p["wk"]).reshape(B, Tl, H, Dh)
+        v = (tok @ p["wv"]).reshape(B, Tl, H, Dh)
+        if self.attn == "ring":
+            o = ring_attention(q, k, v, axis, causal=False)
+        else:
+            o = ulysses_attention(q, k, v, axis, causal=False)
+        o = o.reshape(B, Tl, H * Dh) @ p["wo"] + p["bo"]     # [B, Tl, d_seq]
+        # masked mean over the FULL sequence: local sums psum over the axis
+        w = valid_chunk.astype(jnp.float32)[..., None]
+        num = jax.lax.psum((o * w).sum(axis=1), axis)
+        den = jax.lax.psum(w.sum(axis=1), axis)
+        return num / jnp.maximum(den, 1.0)
+
+    def head_apply(self, p: Dict, pooled: jnp.ndarray,
+                   seq_feat: jnp.ndarray) -> jnp.ndarray:
+        x = jnp.concatenate([pooled.reshape(pooled.shape[0], -1),
+                             seq_feat], axis=-1)
+        return mlp_apply(p, x, "dnn")[:, 0]
+
+    # ------------------------------------------------------- dense oracle
+    def oracle_logits(self, p: Dict, pooled, emb_seq, seq_valid):
+        """Single-device full-sequence reference (tests): identical math,
+        no mesh."""
+        B, T, Din = emb_seq.shape
+        H, Dh = self.heads, self.d_head
+        tok = jnp.where(seq_valid[..., None], emb_seq + p["pos_emb"][None],
+                        0.0)
+        q = (tok @ p["wq"]).reshape(B, T, H, Dh)
+        k = (tok @ p["wk"]).reshape(B, T, H, Dh)
+        v = (tok @ p["wv"]).reshape(B, T, H, Dh)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (Dh ** 0.5)
+        o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
+        o = o.reshape(B, T, H * Dh) @ p["wo"] + p["bo"]
+        w = seq_valid.astype(jnp.float32)[..., None]
+        feat = (o * w).sum(axis=1) / jnp.maximum(w.sum(axis=1), 1.0)
+        return self.head_apply(p, pooled, feat)

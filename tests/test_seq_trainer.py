@@ -1,0 +1,196 @@
+"""Sequence-parallel behavior-sequence CTR (BST): ring/Ulysses attention
+consumed by a real trained model — exact parity with the single-device
+full-attention oracle (params AND slab), plus end-to-end learning."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
+                                          TableConfig, TrainerConfig)
+from paddlebox_tpu.data import BoxDataset, write_synthetic_ctr_files
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.models.bst import BstSeqCtr
+from paddlebox_tpu.parallel.seq_trainer import SeqCtrTrainer
+
+D = 4
+NUM_SLOTS = 3
+SEQ_LEN = 16          # divides the 8-device mesh
+
+
+def _setup(tmp_path, lines=192, mb=16):
+    files, feed = write_synthetic_ctr_files(
+        str(tmp_path), num_files=1, lines_per_file=lines,
+        num_slots=NUM_SLOTS, vocab_per_slot=80, max_len=6, seed=13)
+    return files, dataclasses.replace(feed, batch_size=mb)
+
+
+def _table():
+    return TableConfig(
+        embedx_dim=D, pass_capacity=1 << 11,
+        optimizer=SparseOptimizerConfig(mf_create_thresholds=1e9,
+                                        mf_initial_range=0.0,
+                                        feature_learning_rate=0.05,
+                                        mf_learning_rate=0.05))
+
+
+def _spec():
+    return ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + D)
+
+
+@pytest.mark.parametrize("attn", ["ring", "ulysses"])
+def test_seq_trainer_matches_dense_oracle(tmp_path, attn):
+    """One sequence-parallel step == the dense full-attention step —
+    params (loss-scale + psum contracts) and slab (combined pooled+seq
+    push) both exact."""
+    from paddlebox_tpu.embedding.optimizers import (push_sparse_hostdedup,
+                                                    rebuild_uids)
+    from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
+    from paddlebox_tpu.ops.sparse import build_push_grads, pull_sparse
+
+    files, feed = _setup(tmp_path)
+    table_cfg = _table()
+    model = BstSeqCtr(_spec(), seq_len=SEQ_LEN, n_shards=8, heads=8,
+                      d_head=4, d_seq=8, hidden=(16,), attn=attn)
+    tr = SeqCtrTrainer(model, table_cfg, feed,
+                       TrainerConfig(dense_lr=1e-2), seq_slot=1, seed=4)
+    params0 = {k: np.asarray(v) for k, v in tr.params.items()}
+    ds = BoxDataset(feed, read_threads=1)
+    ds.set_filelist(files)
+    tr.table.begin_feed_pass()
+    ds.load_into_memory(add_keys_fn=tr.table.add_keys)
+    tr.table.end_feed_pass()
+    tr.table.begin_pass()
+    b = ds.split_batches(num_workers=1)[0][0]
+    batch = {k: np.asarray(v) for k, v in tr.host_batch(b).items()}
+    slab0 = np.asarray(tr.table.slab)
+    prng0 = np.asarray(tr._prng)
+
+    loss_sp = tr.train_batch(b)
+    slab_sp = np.asarray(tr.table.slab)
+
+    # ---- dense oracle
+    layout, conf = tr.layout, table_cfg.optimizer
+    B, S, T = feed.batch_size, tr.num_slots, SEQ_LEN
+    key_valid = batch["ids"] != table_cfg.pass_capacity - 1
+    seq_valid = batch["seq_valid"]
+
+    def dense_loss(p, emb_pool, emb_seq):
+        pooled = fused_seqpool_cvm(
+            emb_pool, jnp.asarray(batch["segments"]),
+            jnp.asarray(key_valid), B, S, True, sorted_segments=True)
+        logits = model.oracle_logits(p, pooled, emb_seq,
+                                     jnp.asarray(seq_valid))
+        lab = jnp.asarray(batch["labels"]).astype(jnp.float32)
+        iv = jnp.asarray(batch["ins_valid"])
+        bce = optax.sigmoid_binary_cross_entropy(logits, lab)
+        return jnp.where(iv, bce, 0.0).sum() / jnp.maximum(iv.sum(), 1.0)
+
+    p0 = {k: jnp.asarray(v) for k, v in params0.items()}
+    emb_pool0 = pull_sparse(jnp.asarray(slab0), jnp.asarray(batch["ids"]),
+                            layout)
+    emb_seq0 = pull_sparse(
+        jnp.asarray(slab0), jnp.asarray(batch["seq_ids"].reshape(-1)),
+        layout).reshape(B, T, -1)
+    loss_d, (dp, demb_pool, demb_seq) = jax.value_and_grad(
+        dense_loss, argnums=(0, 1, 2))(p0, emb_pool0, emb_seq0)
+    np.testing.assert_allclose(loss_sp, float(loss_d), rtol=1e-5)
+
+    opt = optax.adam(1e-2)
+    upd, _ = opt.update(dp, opt.init(p0), p0)
+    want = optax.apply_updates(p0, upd)
+    for k in want:
+        np.testing.assert_allclose(np.asarray(tr.params[k]),
+                                   np.asarray(want[k]),
+                                   rtol=3e-4, atol=2e-6, err_msg=k)
+
+    # slab: combined pooled+seq push with the same prng stream
+    _, sub = jax.random.split(jnp.asarray(prng0))
+    clicks = batch["labels"][batch["segments"] // S]
+    pg_pool = build_push_grads(demb_pool,
+                               jnp.asarray(batch["segments"] % S),
+                               jnp.asarray(clicks),
+                               jnp.asarray(key_valid))
+    seq_clicks = np.broadcast_to(batch["labels"][:, None],
+                                 (B, T)).reshape(-1)
+    pg_seq = build_push_grads(demb_seq.reshape(B * T, -1),
+                              jnp.full((B * T,), 1, jnp.int32),
+                              jnp.asarray(seq_clicks),
+                              jnp.asarray(seq_valid.reshape(-1)))
+    # sequence rows are gradient-only (stats count once via pooled rows)
+    pg_seq = pg_seq.at[:, 1:3].set(0.0)
+    pg = jnp.concatenate([pg_pool, pg_seq], axis=0)
+    uids = rebuild_uids(jnp.asarray(batch["push_ids"]),
+                        jnp.asarray(batch["perm"]),
+                        jnp.asarray(batch["inv"]),
+                        table_cfg.pass_capacity)
+    want_slab = push_sparse_hostdedup(
+        jnp.asarray(slab0), uids, jnp.asarray(batch["perm"]),
+        jnp.asarray(batch["inv"]), pg, sub, layout, conf)
+    np.testing.assert_allclose(slab_sp, np.asarray(want_slab),
+                               rtol=3e-4, atol=2e-6)
+
+
+def test_seq_trainer_learns(tmp_path):
+    """End-to-end pass cadence with the attended history: loss descends
+    and the sequence keys' rows train (show counts accumulate for the
+    history slot too)."""
+    from paddlebox_tpu.embedding import accessor as acc
+
+    files, feed = _setup(tmp_path, lines=320)
+    model = BstSeqCtr(_spec(), seq_len=SEQ_LEN, n_shards=8, heads=4,
+                      d_head=4, d_seq=8, hidden=(32, 16), attn="ring")
+    tr = SeqCtrTrainer(model, _table(), feed,
+                       TrainerConfig(dense_lr=5e-3), seq_slot=0, seed=0)
+    losses = []
+    for _ in range(4):
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        losses.append(tr.train_pass(ds)["loss"])
+        ds.release_memory()
+    assert losses[-1] < losses[0] - 0.01, losses
+    keys, vals = tr.table.store.state_items()
+    assert keys.size > 50
+    assert vals[:, acc.SHOW].sum() > 0
+    # show statistics count each data occurrence ONCE even though the
+    # history slot's keys push through both the pooled and the sequence
+    # path (gradient-only seq rows): total show == total valid key
+    # occurrences over the trained passes
+    ds = BoxDataset(feed, read_threads=1)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    occ = sum(int(b.valid.sum())
+              for b in ds.split_batches(num_workers=1)[0])
+    assert vals[:, acc.SHOW].sum() == pytest.approx(4 * occ), (
+        vals[:, acc.SHOW].sum(), occ)
+
+
+def test_seq_ids_extraction(tmp_path):
+    """seq_ids_of keeps per-instance order, truncates at T, pads with the
+    trash row."""
+    files, feed = _setup(tmp_path, lines=64)
+    model = BstSeqCtr(_spec(), seq_len=SEQ_LEN, n_shards=8, heads=4,
+                      d_head=4, hidden=(8,))
+    tr = SeqCtrTrainer(model, _table(), feed,
+                       TrainerConfig(dense_lr=1e-2), seq_slot=1, seed=0)
+    ds = BoxDataset(feed, read_threads=1)
+    ds.set_filelist(files)
+    tr.table.begin_feed_pass()
+    ds.load_into_memory(add_keys_fn=tr.table.add_keys)
+    tr.table.end_feed_pass()
+    tr.table.begin_pass()
+    b = ds.split_batches(num_workers=1)[0][0]
+    ids = tr.table.lookup_ids(b.keys, b.valid)
+    seq_ids, seq_valid = tr.seq_ids_of(b, ids)
+    B, S = feed.batch_size, tr.num_slots
+    pad = tr.table.config.pass_capacity - 1
+    for bi in range(B):
+        mask = (b.slots == 1) & b.valid & (b.segments // S == bi)
+        expect = ids[np.nonzero(mask)[0]][:SEQ_LEN]
+        got = seq_ids[bi][seq_valid[bi]]
+        np.testing.assert_array_equal(got, expect)
+        assert (seq_ids[bi][~seq_valid[bi]] == pad).all()
