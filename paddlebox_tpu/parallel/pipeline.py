@@ -229,26 +229,35 @@ class GPipeRunner:
         return out
 
 
-def _grouped_train_pass(runner, dataset, begin_pass, end_pass
+def _grouped_train_pass(runner, dataset, begin_pass, end_pass,
+                        allgather=None, n_groups_cap=None
                         ) -> Dict[str, float]:
     """The ONE pass-cadence driver both CTR pipeline runners share: feed
     pass → slab build (begin_pass hook) → full dp×n_micro-group steps →
     EndPass write-back (end_pass hook). Trailing batches short of a full
     micro-batch group are dropped (the reference's section pipeline also
-    only runs full pipelines)."""
+    only runs full pipelines). allgather: cross-process feed-key union;
+    n_groups_cap(n) -> n': cross-process step-group equalization (every
+    process must dispatch the same number of collective steps)."""
     runner.table.begin_feed_pass()
     dataset.load_into_memory(add_keys_fn=runner.table.add_keys)
-    runner.table.end_feed_pass()
+    if allgather is not None:
+        runner.table.end_feed_pass(allgather=allgather)
+    else:
+        runner.table.end_feed_pass()
     begin_pass()
     batches = dataset.split_batches(num_workers=1)[0]
     M = runner.batches_per_step
+    n_groups = len(batches) // M
+    if n_groups_cap is not None:
+        n_groups = n_groups_cap(n_groups)
     losses = []
-    for lo in range(0, len(batches) - M + 1, M):
+    for lo in range(0, n_groups * M, M):
         losses.append(runner.train_step(batches[lo:lo + M]))
     end_pass()
     return {"loss": float(np.mean(losses)) if losses else 0.0,
             "steps": len(losses),
-            "dropped_batches": len(batches) % M}
+            "dropped_batches": len(batches) - n_groups * M}
 
 
 def ctr_stage_host_params(seed: int, n_stages: int, layers_per_stage: int,
@@ -560,7 +569,14 @@ class ShardedCtrPipelineRunner:
                  d_model: int = 32, layers_per_stage: int = 1,
                  lr: float = 1e-2, n_micro: Optional[int] = None,
                  use_cvm: bool = True, mesh: Optional[Mesh] = None,
-                 bucket_cap: Optional[int] = None, seed: int = 0):
+                 bucket_cap: Optional[int] = None, seed: int = 0,
+                 fleet=None):
+        """fleet: REQUIRED in a multi-process job — unions feed-pass keys
+        and equalizes the per-process step-group counts. Multi-process
+        topology: the dp axis must span the processes in whole rows (each
+        process feeds its own dp rows' micro-batches; a pipeline row's
+        stage devices need the same data, so a row cannot straddle
+        processes)."""
         from paddlebox_tpu.parallel.sharded_table import ShardedPassTable
         if table_cfg.expand_embed_dim:
             raise ValueError("ShardedCtrPipelineRunner does not consume "
@@ -597,11 +613,35 @@ class ShardedCtrPipelineRunner:
                         else None)
         self.flat_axes = tuple(mesh.axis_names)   # the table axis
         self.P = int(mesh.devices.size)
+        self.fleet = fleet
+        self.multiprocess = jax.process_count() > 1
+        mesh_devs = list(self.mesh.devices.flat)
+        pid = jax.process_index()
+        self.local_positions = [i for i, d in enumerate(mesh_devs)
+                                if d.process_index == pid]
+        self.n_local = len(self.local_positions)
+        if self.multiprocess:
+            if fleet is None:
+                raise ValueError("multi-process ShardedCtrPipelineRunner "
+                                 "needs fleet=")
+            rows = {p // n_stages for p in self.local_positions}
+            want = sorted(r * n_stages + s for r in rows
+                          for s in range(n_stages))
+            if want != sorted(self.local_positions):
+                raise ValueError(
+                    "a pipeline row must live whole in one process (the "
+                    "dp axis spans processes); this process owns mesh "
+                    f"positions {sorted(self.local_positions)}")
+            self.local_rows = sorted(rows)
+        else:
+            self.local_rows = list(range(self.dp))
         kcap = feed.key_capacity()
         self.bucket_cap = bucket_cap or max(
             16, (2 * self.m_local * kcap) // self.P)
-        self.table = ShardedPassTable(table_cfg, self.P, self.bucket_cap,
-                                      seed=seed)
+        self.table = ShardedPassTable(
+            table_cfg, self.P, self.bucket_cap, seed=seed,
+            owned_shards=(self.local_positions if self.multiprocess
+                          else None))
         self.layout = self.table.layout
         D = table_cfg.embedx_dim
         slot_dim = (3 + D) if use_cvm else (1 + D)
@@ -609,13 +649,22 @@ class ShardedCtrPipelineRunner:
         host_params = ctr_stage_host_params(seed, n_stages, layers_per_stage,
                                             pooled_dim, d_model)
         sh = NamedSharding(mesh, P(self.axis))
-        self.params = {k: jax.device_put(v, sh)
-                       for k, v in host_params.items()}
+
+        def put_stage(v):
+            # stage axis is within-process by the whole-row topology rule,
+            # so each process's addressable stage shards cover the full
+            # [S, ...] array (replicated over the dp axis)
+            v = np.asarray(v)
+            if not self.multiprocess:
+                return jax.device_put(v, sh)
+            return jax.make_array_from_process_local_data(sh, v, v.shape)
+
+        self.params = {k: put_stage(v) for k, v in host_params.items()}
         self.opt = optax.adam(lr)
         host_opt = self.opt.init(host_params)
         self.opt_state = jax.tree.map(
-            lambda x: (jax.device_put(jnp.asarray(x), sh)
-                       if getattr(x, "ndim", 0) else jnp.asarray(x)),
+            lambda x: (put_stage(x) if getattr(x, "ndim", 0)
+                       else jnp.asarray(x)),
             host_opt)
         self._prng = jax.random.PRNGKey(seed + 31)
         self._slabs = None
@@ -623,7 +672,8 @@ class ShardedCtrPipelineRunner:
 
     # ------------------------------------------------------------- jit step
     def _build_step(self):
-        from paddlebox_tpu.embedding.optimizers import push_sparse_hostdedup
+        from paddlebox_tpu.embedding.optimizers import (
+            push_sparse_dedup, push_sparse_hostdedup)
         from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
         from paddlebox_tpu.ops.sparse import build_push_grads, pull_sparse
 
@@ -718,14 +768,21 @@ class ShardedCtrPipelineRunner:
                 jnp.where(kv[:, None], pg, 0.0))
             recv_g = jax.lax.all_to_all(
                 bucket_g.reshape(Pn, KB, -1), flat, 0, 0, tiled=True)
-            # incoming ids are host-known in a single process, so the
-            # shard-side dedup was precomputed (device_batch) — no
-            # per-step on-device jnp.unique sort (the dominant fused-step
-            # cost the sharded trainer's host-dedup path removed)
-            slab = push_sparse_hostdedup(
-                slab, batch["push_uids"], batch["push_perm"],
-                batch["push_inv"], recv_g.reshape(Pn * KB, -1), sub,
-                layout, conf)
+            if "push_uids" in batch:
+                # incoming ids are host-known in a single process, so the
+                # shard-side dedup was precomputed (device_batch) — no
+                # per-step on-device jnp.unique sort (the dominant
+                # fused-step cost the sharded trainer's host-dedup path
+                # removed)
+                slab = push_sparse_hostdedup(
+                    slab, batch["push_uids"], batch["push_perm"],
+                    batch["push_inv"], recv_g.reshape(Pn * KB, -1), sub,
+                    layout, conf)
+            else:
+                # multi-process: incoming ids live on peers — device dedup
+                slab = push_sparse_dedup(slab, req.reshape(-1),
+                                         recv_g.reshape(Pn * KB, -1), sub,
+                                         layout, conf)
 
             params = jax.tree.map(lambda x: x[None], local)
             opt_state = jax.tree.map(
@@ -750,22 +807,33 @@ class ShardedCtrPipelineRunner:
     # ----------------------------------------------------------- host driver
     @property
     def batches_per_step(self) -> int:
-        return self.dp * self.n_micro
+        """PackedBatches one train_step consumes FROM THIS PROCESS (its
+        dp rows × n_micro; every row in a single process)."""
+        return len(self.local_rows) * self.n_micro
+
+    def _put_flat(self, host_local: np.ndarray) -> jnp.ndarray:
+        """Local [L, ...] per-device rows → global [P, ...] on the
+        flattened table axis (plain device_put in a single process)."""
+        sh = NamedSharding(self.mesh, P(self.flat_axes))
+        if not self.multiprocess:
+            return jax.device_put(host_local, sh)
+        return jax.make_array_from_process_local_data(
+            sh, host_local, (self.P,) + host_local.shape[1:])
 
     def device_batch(self, packed_batches) -> Dict[str, jnp.ndarray]:
-        """dp × n_micro PackedBatches (row-major by dp row) → per-device
-        leaves stacked [P, ...]: device (r, s) routes the keys of row r's
-        micro slice [s·Ml, (s+1)·Ml)."""
+        """This process's dp rows × n_micro PackedBatches (row-major) →
+        per-device leaves stacked [P, ...] globally: device (r, s) routes
+        the keys of row r's micro slice [s·Ml, (s+1)·Ml)."""
         if len(packed_batches) != self.batches_per_step:
             raise ValueError(
-                "need exactly dp*n_micro=%d batches, got %d"
+                "need exactly local_rows*n_micro=%d batches, got %d"
                 % (self.batches_per_step, len(packed_batches)))
         leaves: Dict[str, list] = {k: [] for k in (
             "buckets", "restore", "valid", "segments", "labels",
             "ins_valid")}
         Ml = self.m_local
-        for r in range(self.dp):
-            row = packed_batches[r * self.n_micro:(r + 1) * self.n_micro]
+        for ri in range(len(self.local_rows)):
+            row = packed_batches[ri * self.n_micro:(ri + 1) * self.n_micro]
             for s in range(self.n_stages):
                 sub = row[s * Ml:(s + 1) * Ml]
                 K = sub[0].keys.shape[0]
@@ -780,30 +848,37 @@ class ShardedCtrPipelineRunner:
                 leaves["labels"].append(np.stack([b.labels for b in sub]))
                 leaves["ins_valid"].append(np.stack([b.ins_valid
                                                      for b in sub]))
-        # single process sees every device's outgoing buckets: precompute
-        # the per-shard push dedup (the a2a's incoming ids) so the step
-        # needs no on-device sort — same trick as the sharded trainer
-        from paddlebox_tpu.embedding.pass_table import dedup_ids
-        for d in range(self.P):
-            incoming = np.concatenate(
-                [leaves["buckets"][src][d] for src in range(self.P)])
-            uids, perm, inv = dedup_ids(incoming, self.table.shard_cap)
-            leaves.setdefault("push_uids", []).append(uids)
-            leaves.setdefault("push_perm", []).append(perm)
-            leaves.setdefault("push_inv", []).append(inv)
-        sh = NamedSharding(self.mesh, P(self.flat_axes))
-        return {k: jax.device_put(np.stack(v), sh)
-                for k, v in leaves.items()}
+        if not self.multiprocess:
+            # single process sees every device's outgoing buckets:
+            # precompute the per-shard push dedup (the a2a's incoming ids)
+            # so the step needs no on-device sort — same trick as the
+            # sharded trainer (multi-process keeps the device path:
+            # incoming ids live on peers)
+            from paddlebox_tpu.embedding.pass_table import dedup_ids
+            for d in range(self.P):
+                incoming = np.concatenate(
+                    [leaves["buckets"][src][d] for src in range(self.P)])
+                uids, perm, inv = dedup_ids(incoming, self.table.shard_cap)
+                leaves.setdefault("push_uids", []).append(uids)
+                leaves.setdefault("push_perm", []).append(perm)
+                leaves.setdefault("push_inv", []).append(inv)
+        return {k: self._put_flat(np.stack(v)) for k, v in leaves.items()}
 
     def begin_pass(self) -> None:
         """BeginPass: promote the feed pass's key set into the sharded
-        [P, C, W] slab stack on the mesh."""
-        sh = NamedSharding(self.mesh, P(self.flat_axes))
-        self._slabs = jax.device_put(self.table.build_slabs(), sh)
+        [P, C, W] slab stack on the mesh (owned shards only in a
+        multi-process job)."""
+        self._slabs = self._put_flat(
+            self.table.build_owned_slabs() if self.multiprocess
+            else self.table.build_slabs())
 
     def end_pass(self) -> None:
-        """EndPass: device slabs → shard stores, then the spill check."""
-        self.table.write_back(np.asarray(self._slabs))
+        """EndPass: device slabs → shard stores, then the spill check.
+        Multi-process: each process dumps only its addressable shards."""
+        if self.multiprocess:
+            self.table.write_back_addressable(self._slabs)
+        else:
+            self.table.write_back(np.asarray(self._slabs))
         self._slabs = None
         self.table.check_need_limit_mem()
 
@@ -817,6 +892,15 @@ class ShardedCtrPipelineRunner:
     def train_pass(self, dataset) -> Dict[str, float]:
         """Pass cadence with the sharded table (the shared
         _grouped_train_pass driver; begin/end build and write back the
-        sharded slab stack)."""
+        sharded slab stack). Multi-process: feed keys union across the
+        cluster and every process runs the SAME number of step groups
+        (collectives stay lockstep)."""
+        allgather = (self.fleet.all_gather if self.multiprocess else None)
+        cap = None
+        if self.multiprocess:
+            def cap(n):
+                return int(self.fleet.all_reduce(
+                    np.asarray([n], np.int64), "min")[0])
         return _grouped_train_pass(self, dataset, self.begin_pass,
-                                   self.end_pass)
+                                   self.end_pass, allgather=allgather,
+                                   n_groups_cap=cap)

@@ -222,6 +222,16 @@ class ShardedPassTable:
         if ks.size:
             self.stores[s].write_back(ks, slab[:ks.size])
 
+    def write_back_addressable(self, slabs) -> None:
+        """EndPass over a jax [P, C, W] global array in a multi-process
+        job: dump THIS process's addressable shards (the one owner of the
+        shard-index-from-addressable-shard idiom — trainers call this
+        instead of walking .addressable_shards themselves)."""
+        for sh in slabs.addressable_shards:
+            pos = sh.index[0]
+            s = (pos.start or 0) if isinstance(pos, slice) else int(pos)
+            self.write_back_shard(int(s), np.asarray(sh.data)[0])
+
     @property
     def test_mode(self) -> bool:
         return self._test_mode

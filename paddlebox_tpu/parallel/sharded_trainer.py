@@ -879,11 +879,7 @@ class ShardedBoxTrainer:
         if self.multiprocess:
             # each process dumps only its addressable shards (EndPass
             # HBM→host per node, ps_gpu_wrapper.cc:983+)
-            for sh in self._slabs.addressable_shards:
-                pos = sh.index[0]
-                s = pos.start if isinstance(pos, slice) else int(pos)
-                self.table.write_back_shard(int(s or 0),
-                                            np.asarray(sh.data)[0])
+            self.table.write_back_addressable(self._slabs)
         else:
             self.table.write_back(np.asarray(self._slabs))
         self.table.check_need_limit_mem()

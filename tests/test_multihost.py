@@ -84,7 +84,7 @@ def run_single_process_oracle(files, feed):
 
 
 def run_cluster(files, extra_cfg=None, world=2,
-                            devs_per_proc=4):
+                            devs_per_proc=4, worker_script=None):
     """Spawn a `world`-process localhost cluster (subprocess pattern,
     test_dist_base.py:896-1012) and collect each rank's RESULT line."""
     from paddlebox_tpu.fleet.store import KVStoreServer
@@ -93,7 +93,8 @@ def run_cluster(files, extra_cfg=None, world=2,
            "batch_size": 32, "max_len": 3, "passes": PASSES}
     cfg.update(extra_cfg or {})
     cfg = json.dumps(cfg)
-    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    worker = os.path.join(os.path.dirname(__file__),
+                          worker_script or "multihost_worker.py")
     run_id = uuid.uuid4().hex[:8]
     procs = []
     try:
@@ -306,6 +307,84 @@ def test_two_process_device_auc_matches_host(data, oracle):
     np.testing.assert_allclose(results[0]["auc"], ref_msg["auc"], rtol=2e-3)
     np.testing.assert_allclose(results[0]["auc"], results[1]["auc"],
                                rtol=1e-6)
+
+
+def test_two_process_sharded_pipeline(data):
+    """Pipeline parallelism at a REAL process boundary: a (dp=2, stage=4)
+    mesh where each process owns one pipeline row and the pass table
+    key-mod-shards over all 8 devices — every pull/push a2a crosses the
+    process boundary. Parity vs a single-process run of the same mesh fed
+    the identical per-row batch streams."""
+    from jax.sharding import Mesh
+    from paddlebox_tpu.config import flags
+    from paddlebox_tpu.parallel.pipeline import (STAGE_AXIS,
+                                                 ShardedCtrPipelineRunner)
+
+    files, feed = data
+    N_MICRO = 4
+    results = run_cluster(files, {"n_micro": N_MICRO},
+                          world=2, devs_per_proc=4,
+                          worker_script="multihost_pipeline_worker.py")
+    assert set(results) == {0, 1}
+    np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
+                               rtol=1e-6)
+    # dp-replicated stage params must agree across the process boundary
+    np.testing.assert_allclose(results[0]["blk_head"],
+                               results[1]["blk_head"], rtol=1e-6)
+
+    # ---- single-process oracle on the same (2, 4) mesh: row r consumes
+    # process r's file half, groups in file order (shuffle disabled)
+    flags.set_flag("dataset_disable_shuffle", True)
+    import jax as _jax
+    table_cfg = TableConfig(
+        embedx_dim=D, pass_capacity=8 * 1024,
+        optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                        mf_initial_range=1e-3,
+                                        feature_learning_rate=0.1,
+                                        mf_learning_rate=0.1))
+    mesh = Mesh(np.array(_jax.devices()[:8]).reshape(2, 4),
+                ("dp", STAGE_AXIS))
+    runner = ShardedCtrPipelineRunner(
+        table_cfg, feed, n_stages=4, d_model=24, layers_per_stage=1,
+        lr=1e-2, n_micro=N_MICRO, mesh=mesh, seed=0)
+    ref_losses = []
+    for _ in range(PASSES):
+        halves = []
+        runner.table.begin_feed_pass()
+        for lo in (0, 4):
+            ds = BoxDataset(feed, read_threads=1)
+            ds.set_filelist(files[lo:lo + 4])
+            ds.load_into_memory(add_keys_fn=runner.table.add_keys)
+            halves.append(ds.split_batches(num_workers=1)[0])
+        runner.table.end_feed_pass()
+        runner.begin_pass()
+        n_groups = min(len(h) for h in halves) // N_MICRO
+        losses = []
+        for g in range(n_groups):
+            group = (halves[0][g * N_MICRO:(g + 1) * N_MICRO]
+                     + halves[1][g * N_MICRO:(g + 1) * N_MICRO])
+            losses.append(runner.train_step(group))
+        runner.end_pass()
+        ref_losses.append(float(np.mean(losses)))
+    np.testing.assert_allclose(results[0]["losses"], ref_losses,
+                               rtol=2e-4,
+                               err_msg="2-process sharded pipeline "
+                                       "diverges from the single-process "
+                                       "composition")
+    # store rows: every cluster-trained row must match the oracle's store
+    sk, sv = runner.table.store_view().state_items()
+    order = np.argsort(sk)
+    sk, sv = sk[order], sv[order]
+    checked = 0
+    for r in (0, 1):
+        for k_str, v in results[r]["rows"].items():
+            i = np.searchsorted(sk, np.uint64(int(k_str)))
+            assert i < sk.size and sk[i] == np.uint64(int(k_str)), k_str
+            np.testing.assert_allclose(sv[i], np.asarray(v, np.float64),
+                                       rtol=2e-4, atol=1e-5,
+                                       err_msg=f"key {k_str}")
+            checked += 1
+    assert checked >= 4
 
 
 def test_four_process_hierarchical_mesh(data, oracle):
