@@ -88,7 +88,9 @@ class MeshTowerTrainer:
             lambda x, s: jax.device_put(jnp.asarray(x), sh if s else rep),
             host_opt, self._opt_sharded)
         self._prng = jax.random.PRNGKey(seed + 13)
-        self._step = self._build_step()
+        from paddlebox_tpu.metrics.auc import MetricRegistry
+        self.metrics = MetricRegistry()
+        self._step, self._eval = self._build_step()
 
     def _opt_mask(self, node):
         """Structural sharded-mask for an optax state tree: dict nodes
@@ -166,6 +168,17 @@ class MeshTowerTrainer:
                 lambda x, s: x[None] if s else x, local_opt, opt_sharded)
             return slab, params, opt_state, loss, preds, prng
 
+        def eval_step(params, slab, batch):
+            # test-mode inference: same model-parallel forward, no push
+            local = {k: (v[0] if sharded[k] else v)
+                     for k, v in params.items()}
+            key_valid = batch["ids"] != pad_base - 1
+            emb = pull_sparse(slab, batch["ids"], layout)
+            pooled = fused_seqpool_cvm(
+                emb, batch["segments"], key_valid, B, S, use_cvm,
+                sorted_segments=True)
+            return jax.nn.sigmoid(model.apply_local(local, pooled, axis))
+
         spec_p = {k: (P(self.axis) if self.sharded[k] else P())
                   for k in self.sharded}
         opt_spec = jax.tree.map(
@@ -175,27 +188,34 @@ class MeshTowerTrainer:
             in_specs=(spec_p, opt_spec, P(), P(), P()),
             out_specs=(P(), spec_p, opt_spec, P(), P(), P()),
             check_vma=False)
-        return jax.jit(fn, donate_argnums=(2,))
+        efn = jax.shard_map(
+            eval_step, mesh=self.mesh, in_specs=(spec_p, P(), P()),
+            out_specs=P(), check_vma=False)
+        return jax.jit(fn, donate_argnums=(2,)), jax.jit(efn)
 
     # ----------------------------------------------------------- host driver
     def host_batch(self, b: PackedBatch) -> Dict[str, jnp.ndarray]:
         ids = self.table.lookup_ids(b.keys, b.valid)
-        _uids, perm, inv = self.table.dedup_for_push(ids)
-        return {
+        out = {
             "ids": jnp.asarray(ids),
             "segments": jnp.asarray(b.segments),
             "labels": jnp.asarray(b.labels),
             "ins_valid": jnp.asarray(b.ins_valid),
-            "perm": jnp.asarray(perm),
-            "inv": jnp.asarray(inv),
         }
+        if not self.table.test_mode:
+            # eval never pushes — skip the dedup + two transfers
+            _uids, perm, inv = self.table.dedup_for_push(ids)
+            out.update(perm=jnp.asarray(perm), inv=jnp.asarray(inv))
+        return out
 
     def train_batch(self, b: PackedBatch) -> float:
+        from paddlebox_tpu.train.eval_driver import feed_simple_metrics
         batch = self.host_batch(b)
-        (slab, self.params, self.opt_state, loss, _preds,
+        (slab, self.params, self.opt_state, loss, preds,
          self._prng) = self._step(self.params, self.opt_state,
                                   self.table.slab, batch, self._prng)
         self.table.set_slab(slab)
+        feed_simple_metrics(self.metrics, preds, b)
         return float(loss)
 
     def train_pass(self, dataset) -> Dict[str, float]:
@@ -210,3 +230,9 @@ class MeshTowerTrainer:
         self.table.end_pass()
         return {"loss": float(np.mean(losses)) if losses else 0.0,
                 "batches": len(losses)}
+
+    def predict_batches(self, dataset):
+        """Test-mode inference (SetTestMode: no creation, no push) —
+        (preds, labels) over the dataset's valid instances."""
+        from paddlebox_tpu.train.eval_driver import simple_predict_batches
+        return simple_predict_batches(self, dataset)

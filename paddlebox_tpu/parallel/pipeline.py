@@ -260,6 +260,74 @@ def _grouped_train_pass(runner, dataset, begin_pass, end_pass,
             "dropped_batches": len(batches) - n_groups * M}
 
 
+def _feed_pipeline_metrics(runner, preds, packed_batches) -> None:
+    """Stream one step group's predictions into the runner's registry
+    (host path — the Metric::add_data role). preds: [dp·M, mb] global
+    (dp-sharded on a 2D mesh); multi-process feeds only this process's
+    addressable rows, which align with its own packed_batches; the
+    cross-process reduction stays in get_metric_msg's allreduce hook."""
+    if not runner.metrics.metric_names():
+        return
+    if getattr(runner, "multiprocess", False):
+        # preds is dp-sharded but STAGE-REPLICATED: addressable_shards
+        # yields one entry per local device, i.e. n_stages copies of each
+        # dp row — keep exactly one shard per distinct index
+        by_start = {}
+        for sh in preds.addressable_shards:
+            pos = sh.index[0] if sh.index else slice(0, None)
+            start = (pos.start or 0) if isinstance(pos, slice) else int(pos)
+            by_start.setdefault(start, np.asarray(sh.data))
+        p = np.concatenate(
+            [by_start[s] for s in sorted(by_start)]).reshape(-1)
+    else:
+        p = np.asarray(preds).reshape(-1)
+    labels = np.concatenate([b.labels for b in packed_batches])
+    mask = np.concatenate([b.ins_valid for b in packed_batches])
+    runner.metrics.add_batch({"pred": p, "label": labels, "mask": mask})
+
+
+def _pipeline_predict(runner, dataset, begin_pass, end_pass, slab_of):
+    """Shared test-mode inference cadence for the pipeline runners:
+    feed pass (no creation) → eval steps over full groups → (preds,
+    labels) of the covered valid instances. Single-process (the eval
+    output must be fully addressable)."""
+    if getattr(runner, "multiprocess", False):
+        raise TypeError("predict_batches is single-process; multi-process "
+                        "jobs evaluate per-rank training preds via the "
+                        "metric registry")
+    runner.table.set_test_mode(True)
+    opened = False
+    try:
+        runner.table.begin_feed_pass()
+        if len(dataset) == 0:
+            dataset.load_into_memory()
+        runner.table.add_keys(dataset.all_keys())
+        runner.table.end_feed_pass()
+        begin_pass()
+        opened = True
+        batches = dataset.split_batches(num_workers=1)[0]
+        M = runner.batches_per_step
+        preds_all, labels_all = [], []
+        for lo in range(0, len(batches) - M + 1, M):
+            group = batches[lo:lo + M]
+            batch = runner.device_batch(group)
+            preds = np.asarray(runner._eval(runner.params, slab_of(),
+                                            batch)).reshape(-1)
+            labels = np.concatenate([b.labels for b in group])
+            mask = np.concatenate([b.ins_valid for b in group])
+            preds_all.append(preds[mask])
+            labels_all.append(labels[mask])
+    finally:
+        # ALWAYS close the pass — a mid-eval error must not wedge every
+        # later train_pass with "pass already open"
+        if opened:
+            end_pass()
+        runner.table.set_test_mode(False)
+    if not preds_all:
+        return np.empty(0, np.float32), np.empty(0, np.int32)
+    return np.concatenate(preds_all), np.concatenate(labels_all)
+
+
 def ctr_stage_host_params(seed: int, n_stages: int, layers_per_stage: int,
                           pooled_dim: int, d_model: int,
                           scale: float = 0.1) -> Dict[str, np.ndarray]:
@@ -366,7 +434,9 @@ class CtrPipelineRunner:
                        if getattr(x, "ndim", 0) else jnp.asarray(x)),
             host_opt)
         self._prng = jax.random.PRNGKey(seed + 31)
-        self._step = self._build_step()
+        from paddlebox_tpu.metrics.auc import MetricRegistry
+        self.metrics = MetricRegistry()
+        self._step, self._eval = self._build_step()
 
     # ------------------------------------------------------------- jit step
     def _build_step(self):
@@ -476,6 +546,18 @@ class CtrPipelineRunner:
                 lambda x, s: x[None] if s else x, local_opt, opt_sharded)
             return params, opt_state, slab, loss, preds, prng
 
+        def eval_step(params, slab, batch):
+            # test-mode inference (SetTestMode): same pipelined forward,
+            # no push, no dense update
+            local = jax.tree.map(lambda x: x[0], params)
+            if dp_axis is not None:
+                batch = jax.tree.map(lambda x: x[0], batch)
+            ids_flat = batch["ids"].reshape(-1)
+            batch = dict(batch, key_valid=batch["ids"] != pad_id)
+            emb_all = pull_sparse(slab, ids_flat, layout).reshape(
+                M, batch["ids"].shape[-1], -1)
+            return jax.nn.sigmoid(pipe(local, emb_all, batch))
+
         spec_sh = P(self.axis)
         opt_spec = jax.tree.map(
             lambda x: spec_sh if getattr(x, "ndim", 0) else P(),
@@ -487,7 +569,11 @@ class CtrPipelineRunner:
             in_specs=(spec_sh, opt_spec, P(), dp_spec, P()),
             out_specs=(spec_sh, opt_spec, P(), P(), dp_spec, P()),
             check_vma=False)
-        return jax.jit(fn, donate_argnums=(2,))
+        efn = jax.shard_map(
+            eval_step, mesh=self.mesh,
+            in_specs=(spec_sh, P(), dp_spec), out_specs=dp_spec,
+            check_vma=False)
+        return jax.jit(fn, donate_argnums=(2,)), jax.jit(efn)
 
     # ----------------------------------------------------------- host driver
     @property
@@ -522,11 +608,20 @@ class CtrPipelineRunner:
     def train_step(self, packed_batches) -> float:
         """ONE pipelined train step over dp × n_micro micro-batches."""
         batch = self.device_batch(packed_batches)
-        (self.params, self.opt_state, slab, loss, _preds,
+        (self.params, self.opt_state, slab, loss, preds,
          self._prng) = self._step(self.params, self.opt_state,
                                   self.table.slab, batch, self._prng)
         self.table.set_slab(slab)
+        _feed_pipeline_metrics(self, preds, packed_batches)
         return float(loss)
+
+    def predict_batches(self, dataset):
+        """Test-mode inference (SetTestMode: no creation, no push) over
+        full micro-batch groups; returns (preds, labels) of the covered
+        valid instances."""
+        return _pipeline_predict(self, dataset, self.table.begin_pass,
+                                 self.table.end_pass,
+                                 lambda: self.table.slab)
 
     def train_pass(self, dataset) -> Dict[str, float]:
         """BoxPS pass cadence around the pipelined step (the shared
@@ -668,7 +763,9 @@ class ShardedCtrPipelineRunner:
             host_opt)
         self._prng = jax.random.PRNGKey(seed + 31)
         self._slabs = None
-        self._step = self._build_step()
+        from paddlebox_tpu.metrics.auc import MetricRegistry
+        self.metrics = MetricRegistry()
+        self._step, self._eval = self._build_step()
 
     # ------------------------------------------------------------- jit step
     def _build_step(self):
@@ -789,6 +886,29 @@ class ShardedCtrPipelineRunner:
                 lambda x, s: x[None] if s else x, local_opt, opt_sharded)
             return params, opt_state, slab[None], loss, preds, prng
 
+        def eval_step(params, slab, batch):
+            # test-mode inference: the same a2a pull + pipelined forward,
+            # no push, no dense update
+            local = jax.tree.map(lambda x: x[0], params)
+            slab = slab[0]
+            batch = jax.tree.map(lambda x: x[0], batch)
+            buckets = batch["buckets"]
+            Pn, KB = buckets.shape
+            K = batch["segments"].shape[-1]
+            req = jax.lax.all_to_all(buckets, flat, 0, 0, tiled=True)
+            vals = pull_sparse(slab, req.reshape(-1), layout)
+            resp = jax.lax.all_to_all(
+                vals.reshape(Pn, KB, -1), flat, 0, 0, tiled=True)
+            emb_loc = resp.reshape(Pn * KB, -1)[batch["restore"]]
+            emb_all = jax.lax.all_gather(
+                emb_loc.reshape(Ml, K, -1), axis, tiled=True)
+            segments = jax.lax.all_gather(batch["segments"], axis,
+                                          tiled=True)
+            key_valid = jax.lax.all_gather(batch["valid"], axis,
+                                           tiled=True)
+            return jax.nn.sigmoid(
+                pipe_run(local, (emb_all, segments, key_valid)))
+
         spec_stage = P(self.axis)
         spec_flat = P(self.flat_axes)
         opt_spec = jax.tree.map(
@@ -802,7 +922,11 @@ class ShardedCtrPipelineRunner:
             out_specs=(spec_stage, opt_spec, spec_flat, P(), preds_spec,
                        P()),
             check_vma=False)
-        return jax.jit(fn, donate_argnums=(2,))
+        efn = jax.shard_map(
+            eval_step, mesh=self.mesh,
+            in_specs=(spec_stage, spec_flat, spec_flat),
+            out_specs=preds_spec, check_vma=False)
+        return jax.jit(fn, donate_argnums=(2,)), jax.jit(efn)
 
     # ----------------------------------------------------------- host driver
     @property
@@ -848,12 +972,12 @@ class ShardedCtrPipelineRunner:
                 leaves["labels"].append(np.stack([b.labels for b in sub]))
                 leaves["ins_valid"].append(np.stack([b.ins_valid
                                                      for b in sub]))
-        if not self.multiprocess:
+        if not self.multiprocess and not self.table.test_mode:
             # single process sees every device's outgoing buckets:
             # precompute the per-shard push dedup (the a2a's incoming ids)
             # so the step needs no on-device sort — same trick as the
             # sharded trainer (multi-process keeps the device path:
-            # incoming ids live on peers)
+            # incoming ids live on peers; eval never pushes)
             from paddlebox_tpu.embedding.pass_table import dedup_ids
             for d in range(self.P):
                 incoming = np.concatenate(
@@ -884,10 +1008,16 @@ class ShardedCtrPipelineRunner:
 
     def train_step(self, packed_batches) -> float:
         batch = self.device_batch(packed_batches)
-        (self.params, self.opt_state, self._slabs, loss, _preds,
+        (self.params, self.opt_state, self._slabs, loss, preds,
          self._prng) = self._step(self.params, self.opt_state, self._slabs,
                                   batch, self._prng)
+        _feed_pipeline_metrics(self, preds, packed_batches)
         return float(loss)
+
+    def predict_batches(self, dataset):
+        """Test-mode inference over the sharded slabs (single process)."""
+        return _pipeline_predict(self, dataset, self.begin_pass,
+                                 self.end_pass, lambda: self._slabs)
 
     def train_pass(self, dataset) -> Dict[str, float]:
         """Pass cadence with the sharded table (the shared

@@ -85,7 +85,9 @@ class SeqCtrTrainer:
             lambda x: jax.device_put(jnp.asarray(x), rep),
             self.opt.init(host_params))
         self._prng = jax.random.PRNGKey(seed + 29)
-        self._step = self._build_step()
+        from paddlebox_tpu.metrics.auc import MetricRegistry
+        self.metrics = MetricRegistry()
+        self._step, self._eval = self._build_step()
 
     # ------------------------------------------------------------- jit step
     def _build_step(self):
@@ -169,16 +171,37 @@ class SeqCtrTrainer:
                                          conf)
             return slab, params, opt_state, loss, preds, prng
 
+        def eval_step(params, slab, batch):
+            # test-mode inference: pooled + attended forward, no push
+            key_valid = batch["ids"] != pad_id
+            emb_pool = pull_sparse(slab, batch["ids"], layout)
+            emb_seq = pull_sparse(
+                slab, batch["seq_ids"].reshape(-1), layout
+            ).reshape(B, Tl, -1)
+            pooled = fused_seqpool_cvm(
+                emb_pool, batch["segments"], key_valid, B, S, use_cvm,
+                sorted_segments=True)
+            feat = model.seq_feature_local(params, emb_seq,
+                                           batch["seq_valid"], axis)
+            return jax.nn.sigmoid(model.head_apply(params, pooled, feat))
+
         seq_spec = P(None, self.axis)
         specs = {"ids": P(), "segments": P(), "labels": P(),
                  "ins_valid": P(), "push_ids": P(), "perm": P(),
                  "inv": P(), "seq_ids": seq_spec, "seq_valid": seq_spec}
+        eval_specs = {k: specs[k] for k in (
+            "ids", "segments", "labels", "ins_valid", "seq_ids",
+            "seq_valid")}
         fn = jax.shard_map(
             step, mesh=self.mesh,
             in_specs=(P(), P(), P(), specs, P()),
             out_specs=(P(), P(), P(), P(), P(), P()),
             check_vma=False)
-        return jax.jit(fn, donate_argnums=(2,))
+        efn = jax.shard_map(
+            eval_step, mesh=self.mesh,
+            in_specs=(P(), P(), eval_specs), out_specs=P(),
+            check_vma=False)
+        return jax.jit(fn, donate_argnums=(2,)), jax.jit(efn)
 
     # ----------------------------------------------------------- host driver
     def seq_ids_of(self, b: PackedBatch, ids: np.ndarray):
@@ -204,31 +227,35 @@ class SeqCtrTrainer:
     def host_batch(self, b: PackedBatch) -> Dict[str, jnp.ndarray]:
         ids = self.table.lookup_ids(b.keys, b.valid)
         seq_ids, seq_valid = self.seq_ids_of(b, ids)
-        # host dedup over the CONCATENATED push id vector (pooled rows
-        # then B*T sequence rows — the device builds pg in that order)
-        push_ids = np.concatenate([ids, seq_ids.reshape(-1)]).astype(
-            np.asarray(ids).dtype)
-        from paddlebox_tpu.embedding.pass_table import dedup_ids
-        _uids, perm, inv = dedup_ids(push_ids,
-                                     self.table.config.pass_capacity)
-        return {
+        out = {
             "ids": jnp.asarray(ids),
             "segments": jnp.asarray(b.segments),
             "labels": jnp.asarray(b.labels),
             "ins_valid": jnp.asarray(b.ins_valid),
             "seq_ids": jnp.asarray(seq_ids),
             "seq_valid": jnp.asarray(seq_valid),
-            "push_ids": jnp.asarray(push_ids),
-            "perm": jnp.asarray(perm),
-            "inv": jnp.asarray(inv),
         }
+        if not self.table.test_mode:
+            # host dedup over the CONCATENATED push id vector (pooled
+            # rows then B*T sequence rows — the device builds pg in that
+            # order); eval never pushes
+            push_ids = np.concatenate([ids, seq_ids.reshape(-1)]).astype(
+                np.asarray(ids).dtype)
+            from paddlebox_tpu.embedding.pass_table import dedup_ids
+            _uids, perm, inv = dedup_ids(push_ids,
+                                         self.table.config.pass_capacity)
+            out.update(push_ids=jnp.asarray(push_ids),
+                       perm=jnp.asarray(perm), inv=jnp.asarray(inv))
+        return out
 
     def train_batch(self, b: PackedBatch) -> float:
+        from paddlebox_tpu.train.eval_driver import feed_simple_metrics
         batch = self.host_batch(b)
-        (slab, self.params, self.opt_state, loss, _preds,
+        (slab, self.params, self.opt_state, loss, preds,
          self._prng) = self._step(self.params, self.opt_state,
                                   self.table.slab, batch, self._prng)
         self.table.set_slab(slab)
+        feed_simple_metrics(self.metrics, preds, b)
         return float(loss)
 
     def train_pass(self, dataset) -> Dict[str, float]:
@@ -241,3 +268,9 @@ class SeqCtrTrainer:
         self.table.end_pass()
         return {"loss": float(np.mean(losses)) if losses else 0.0,
                 "batches": len(losses)}
+
+    def predict_batches(self, dataset):
+        """Test-mode inference (SetTestMode: no creation, no push) —
+        (preds, labels) over the dataset's valid instances."""
+        from paddlebox_tpu.train.eval_driver import simple_predict_batches
+        return simple_predict_batches(self, dataset)

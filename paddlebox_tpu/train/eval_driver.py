@@ -1,0 +1,52 @@
+"""Shared per-batch eval/metrics plumbing for the single-table trainers.
+
+MeshTowerTrainer and SeqCtrTrainer (and any future PassTable-backed
+trainer with a per-batch cadence) share the same test-mode inference
+cadence and the same host metric feed — one implementation here so a fix
+(e.g. closing the pass on a mid-eval error) cannot silently miss a copy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def feed_simple_metrics(metrics, preds, b) -> None:
+    """Stream one batch's [B] predictions into a MetricRegistry
+    (Metric::add_data role)."""
+    if not metrics.metric_names():
+        return
+    metrics.add_batch({"pred": np.asarray(preds), "label": b.labels,
+                       "mask": b.ins_valid})
+
+
+def simple_predict_batches(trainer, dataset):
+    """Test-mode inference (SetTestMode: no creation, no push) over a
+    per-batch trainer: (preds, labels) of the dataset's valid instances.
+    The pass is ALWAYS closed on exit — a mid-eval error must not leave
+    the table's pass open (every later train_pass would fail)."""
+    table = trainer.table
+    table.set_test_mode(True)
+    opened = False
+    try:
+        table.begin_feed_pass()
+        if len(dataset) == 0:
+            dataset.load_into_memory()
+        table.add_keys(dataset.all_keys())
+        table.end_feed_pass()
+        table.begin_pass()
+        opened = True
+        preds_all, labels_all = [], []
+        for b in dataset.split_batches(num_workers=1)[0]:
+            batch = trainer.host_batch(b)
+            preds = np.asarray(trainer._eval(trainer.params, table.slab,
+                                             batch))
+            preds_all.append(preds[b.ins_valid])
+            labels_all.append(b.labels[b.ins_valid])
+    finally:
+        if opened:
+            table.end_pass()
+        table.set_test_mode(False)
+    if not preds_all:
+        return np.empty(0, np.float32), np.empty(0, np.int32)
+    return np.concatenate(preds_all), np.concatenate(labels_all)

@@ -222,6 +222,8 @@ def test_mesh_tower_learns(tmp_path, kind):
                        d_out=8)
     tr = MeshTowerTrainer(model, _table(), feed,
                           TrainerConfig(dense_lr=5e-3), seed=0)
+    tr.metrics.init_metric("auc", "label", "pred", table_size=1 << 14,
+                           mask_var="mask")
     losses = []
     for _ in range(4):
         ds = BoxDataset(feed, read_threads=1)
@@ -232,3 +234,16 @@ def test_mesh_tower_learns(tmp_path, kind):
     keys, vals = tr.table.store.state_items()
     assert keys.size > 50
     assert vals[:, acc.SHOW].sum() > 0
+    # metric plumbing: every trained instance streamed once
+    msg = tr.metrics.get_metric_msg("auc")
+    assert msg["size"] > 0 and 0.0 < msg["actual_ctr"] < 1.0
+    # test-mode inference: no push, preds for every valid instance
+    show_before = vals[:, acc.SHOW].sum()
+    ds = BoxDataset(feed, read_threads=1)
+    ds.set_filelist(files)
+    preds, labels = tr.predict_batches(ds)
+    assert preds.size == labels.size > 100
+    assert (preds > 0).all() and (preds < 1).all()
+    _k, vals_after = tr.table.store.state_items()
+    assert vals_after[:, acc.SHOW].sum() == show_before
+    ds.release_memory()

@@ -146,12 +146,27 @@ def test_seq_trainer_learns(tmp_path):
                       d_head=4, d_seq=8, hidden=(32, 16), attn="ring")
     tr = SeqCtrTrainer(model, _table(), feed,
                        TrainerConfig(dense_lr=5e-3), seq_slot=0, seed=0)
+    tr.metrics.init_metric("auc", "label", "pred", table_size=1 << 14,
+                           mask_var="mask")
     losses = []
     for _ in range(4):
         ds = BoxDataset(feed, read_threads=1)
         ds.set_filelist(files)
         losses.append(tr.train_pass(ds)["loss"])
         ds.release_memory()
+    msg = tr.metrics.get_metric_msg("auc")
+    assert msg["size"] > 0 and 0.0 < msg["actual_ctr"] < 1.0
+    # test-mode inference with the attended history: no push
+    from paddlebox_tpu.embedding import accessor as _acc
+    _k0, _v0 = tr.table.store.state_items()
+    show_pre_eval = _v0[:, _acc.SHOW].sum()
+    ds = BoxDataset(feed, read_threads=1)
+    ds.set_filelist(files)
+    preds_ev, labels_ev = tr.predict_batches(ds)
+    assert preds_ev.size == labels_ev.size > 100
+    _k1, _v1 = tr.table.store.state_items()
+    assert _v1[:, _acc.SHOW].sum() == show_pre_eval
+    ds.release_memory()
     assert losses[-1] < losses[0] - 0.01, losses
     keys, vals = tr.table.store.state_items()
     assert keys.size > 50
