@@ -665,13 +665,20 @@ class ShardedCtrPipelineRunner:
                  lr: float = 1e-2, n_micro: Optional[int] = None,
                  use_cvm: bool = True, mesh: Optional[Mesh] = None,
                  bucket_cap: Optional[int] = None, seed: int = 0,
-                 fleet=None):
+                 fleet=None, store_factory=None):
         """fleet: REQUIRED in a multi-process job — unions feed-pass keys
         and equalizes the per-process step-group counts. Multi-process
         topology: the dp axis must span the processes in whole rows (each
         process feeds its own dp rows' micro-batches; a pipeline row's
         stage devices need the same data, so a row cannot straddle
-        processes)."""
+        processes).
+
+        store_factory: overrides the shard store backend — pass
+        embedding.ps_store.ps_store_factory(client, table_id) to run the
+        GPUPS composition (pipeline sections over pass slabs built from /
+        dumped to the distributed CPU PS — the reference's section
+        programs against the full PS, section_worker.cc +
+        ps_gpu_wrapper.cc:337-955)."""
         from paddlebox_tpu.parallel.sharded_table import ShardedPassTable
         if table_cfg.expand_embed_dim:
             raise ValueError("ShardedCtrPipelineRunner does not consume "
@@ -736,7 +743,8 @@ class ShardedCtrPipelineRunner:
         self.table = ShardedPassTable(
             table_cfg, self.P, self.bucket_cap, seed=seed,
             owned_shards=(self.local_positions if self.multiprocess
-                          else None))
+                          else None),
+            store_factory=store_factory)
         self.layout = self.table.layout
         D = table_cfg.embedx_dim
         slot_dim = (3 + D) if use_cvm else (1 + D)

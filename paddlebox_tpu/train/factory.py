@@ -37,6 +37,20 @@ def _psgpu_trainer(*args, ps_client=None, ps_table_id=0, **kwargs):
         **kwargs)
 
 
+def _section_ps_trainer(*args, ps_client=None, ps_table_id=0, **kwargs):
+    """SectionPSTrainer: the sharded pipeline with its shard stores
+    behind the distributed CPU PS (section programs over the full PS —
+    the PSGPUTrainer convention, same ps_client/ps_table_id surface)."""
+    from paddlebox_tpu.embedding.ps_store import ps_store_factory
+    from paddlebox_tpu.parallel.pipeline import ShardedCtrPipelineRunner
+    if ps_client is None:
+        raise ValueError("SectionPSTrainer needs ps_client= (a PS client "
+                         "whose sparse table backs the pass slabs)")
+    return ShardedCtrPipelineRunner(
+        *args, store_factory=ps_store_factory(ps_client, ps_table_id),
+        **kwargs)
+
+
 def _builtin(name: str):
     # lazy imports: trainers pull in jax
     if name in ("BoxPSTrainer", "MultiTrainer", "DistMultiTrainer"):
@@ -66,12 +80,14 @@ def _builtin(name: str):
         # is that capability on this runtime
         from paddlebox_tpu.parallel.pipeline import CtrPipelineRunner
         return CtrPipelineRunner
-    if name in ("ShardedCtrPipelineTrainer", "SectionPSTrainer"):
+    if name == "ShardedCtrPipelineTrainer":
         # section programs over the FULL key-mod-sharded PS (the
         # section_worker.cc op loop running pull_box_sparse against the
         # sharded table): per-device table memory O(pass/P)
         from paddlebox_tpu.parallel.pipeline import ShardedCtrPipelineRunner
         return ShardedCtrPipelineRunner
+    if name == "SectionPSTrainer":
+        return _section_ps_trainer
     if name == "MeshTowerTrainer":
         # model-parallel towers (TP wide layers / EP experts) with the
         # autodiff contracts enforced in the trainer

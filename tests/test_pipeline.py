@@ -488,6 +488,76 @@ def test_pipeline_metrics_and_eval(tmp_path):
         ds.release_memory()
 
 
+def test_sharded_pipeline_over_gpups_store(tmp_path):
+    """Section programs over the distributed CPU PS: the sharded pipeline
+    with PS-backed shard stores (pass slabs built from / dumped to the
+    server) must match the local-store run exactly — same seeds, same
+    losses, rows land server-side."""
+    from paddlebox_tpu.data import BoxDataset
+    from paddlebox_tpu.embedding.ps_store import ps_store_factory
+    from paddlebox_tpu.parallel.pipeline import ShardedCtrPipelineRunner
+    from paddlebox_tpu.ps import PsLocalClient
+
+    files, feed = _ctr_setup(tmp_path, n_files=1, lines=192, mb=16)
+    table_cfg = _ctr_table(cap=1 << 12)
+
+    def run(store_factory=None):
+        r = ShardedCtrPipelineRunner(
+            table_cfg, feed, n_stages=4, d_model=24, layers_per_stage=1,
+            lr=1e-2, n_micro=4, seed=3, store_factory=store_factory)
+        losses = []
+        for _ in range(2):
+            ds = BoxDataset(feed, read_threads=1)
+            ds.set_filelist(files)
+            losses.append(r.train_pass(ds)["loss"])
+            ds.release_memory()
+        return r, losses
+
+    _local, losses_local = run()
+    cl = PsLocalClient()
+    cl.create_sparse_table(5, table_cfg, shard_num=4, seed=3)
+    _ps, losses_ps = run(ps_store_factory(cl, 5))
+    np.testing.assert_allclose(losses_ps, losses_local, rtol=1e-5)
+    assert cl.sparse_size(5) > 50    # features created server-side
+
+
+def test_sharded_pipeline_day_cadence(tmp_path):
+    """run_day composes over the sharded pipeline runner: cadenced delta
+    saves, base save at day end, and the serving reader resolves trained
+    rows from the xbox views."""
+    from paddlebox_tpu.config.configs import CheckpointConfig
+    from paddlebox_tpu.data import BoxDataset
+    from paddlebox_tpu.embedding import accessor as acc
+    from paddlebox_tpu.parallel.pipeline import ShardedCtrPipelineRunner
+    from paddlebox_tpu.train.checkpoint import (CheckpointManager,
+                                                XboxModelReader, run_day)
+
+    files, feed = _ctr_setup(tmp_path, n_files=2, lines=192, mb=16)
+    r = ShardedCtrPipelineRunner(_ctr_table(cap=1 << 12), feed, n_stages=4,
+                                 d_model=24, layers_per_stage=1, lr=1e-2,
+                                 n_micro=4, seed=0)
+    cm = CheckpointManager(CheckpointConfig(
+        batch_model_dir=str(tmp_path / "batch"),
+        xbox_model_dir=str(tmp_path / "xbox"),
+        save_delta_every_passes=1, async_save=False), r.table)
+    datasets = []
+    for _ in range(2):
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        datasets.append(ds)
+    stats, (batch_dir, xbox_dir) = run_day(r, datasets, cm, "d0",
+                                           preload=False)
+    assert len(stats) == 2 and all(s["steps"] >= 1 for s in stats)
+    reader = XboxModelReader(str(tmp_path / "xbox"), "d0")
+    assert reader.deltas_applied >= 1
+    keys, vals = r.table.store_view().state_items()
+    assert keys.size > 50 and vals[:, acc.SHOW].sum() > 0
+    hot = keys[np.argsort(vals[:, acc.SHOW])[-5:]]
+    rows = reader.lookup(hot)
+    assert rows.shape == (5, 1 + 4)
+    assert np.abs(rows).sum() > 0
+
+
 def test_ctr_pipeline_dp_learns(tmp_path):
     """dp × pipeline end to end: loss descends over passes with the
     combined push keeping the replicated slab consistent."""
