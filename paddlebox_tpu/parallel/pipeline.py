@@ -328,6 +328,40 @@ def _pipeline_predict(runner, dataset, begin_pass, end_pass, slab_of):
     return np.concatenate(preds_all), np.concatenate(labels_all)
 
 
+def ctr_pipeline_sections(mb: int, num_slots: int, use_cvm: bool, E: int):
+    """The ONE definition of the CTR pipeline's three program sections —
+    (blocks, embed_section, head) closures shared by the replicated and
+    sharded runners (their parity tests rely on byte-identical math).
+    embed_section consumes inputs = (emb_all, exp_all, segments,
+    key_valid); exp_all is None when E == 0."""
+    from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm, seqpool_sum
+
+    def blocks(p, state):
+        y = state
+        for i in range(p["blk_w"].shape[0]):
+            y = jax.nn.relu(y @ p["blk_w"][i] + p["blk_b"][i])
+        return y
+
+    def embed_section(p, inputs, tm):
+        emb_all, exp_all, segments, key_valid = inputs
+        pooled = fused_seqpool_cvm(
+            emb_all[tm], segments[tm], key_valid[tm], mb, num_slots,
+            use_cvm, sorted_segments=True)
+        x = pooled.reshape(mb, -1)
+        if E:
+            # expand block: plain per-slot sum pool (the
+            # pull_box_extended_sparse consumer pattern)
+            pexp = seqpool_sum(exp_all[tm], segments[tm], key_valid[tm],
+                               mb, num_slots)
+            x = jnp.concatenate([x, pexp.reshape(mb, -1)], axis=-1)
+        return jax.nn.relu(x @ p["proj_w"] + p["proj_b"])
+
+    def head(p, y):
+        return y @ p["head_w"] + p["head_b"]
+
+    return blocks, embed_section, head
+
+
 def ctr_stage_host_params(seed: int, n_stages: int, layers_per_stage: int,
                           pooled_dim: int, d_model: int,
                           scale: float = 0.1) -> Dict[str, np.ndarray]:
@@ -385,9 +419,6 @@ class CtrPipelineRunner:
                  use_cvm: bool = True, mesh: Optional[Mesh] = None,
                  seed: int = 0):
         from paddlebox_tpu.embedding.pass_table import PassTable
-        if table_cfg.expand_embed_dim:
-            raise ValueError("CtrPipelineRunner does not consume the "
-                             "expand embedding (expand_embed_dim must be 0)")
         self.table = PassTable(table_cfg, seed=seed)
         self.table_cfg = table_cfg
         self.feed = feed
@@ -421,7 +452,9 @@ class CtrPipelineRunner:
                         else None)
         D = table_cfg.embedx_dim
         slot_dim = (3 + D) if use_cvm else (1 + D)
-        pooled_dim = self.num_slots * slot_dim
+        # expand (NN-cross) blocks sum-pool per slot and concat after the
+        # CVM-pooled features into the projection input
+        pooled_dim = self.num_slots * (slot_dim + table_cfg.expand_embed_dim)
         host_params = ctr_stage_host_params(seed, n_stages, layers_per_stage,
                                             pooled_dim, d_model)
         sh = NamedSharding(mesh, P(self.axis))
@@ -441,13 +474,16 @@ class CtrPipelineRunner:
     # ------------------------------------------------------------- jit step
     def _build_step(self):
         from paddlebox_tpu.embedding.optimizers import push_sparse_dedup
-        from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
-        from paddlebox_tpu.ops.sparse import build_push_grads, pull_sparse
+        from paddlebox_tpu.ops.sparse import (build_push_grads,
+                                              build_push_grads_extended,
+                                              pull_sparse,
+                                              pull_sparse_extended)
 
         S = int(self.mesh.shape[self.axis])
         M, mb = self.n_micro, self.mb
         num_slots, use_cvm = self.num_slots, self.use_cvm
         layout, conf = self.layout, self.table_cfg.optimizer
+        E = layout.expand_dim
         axis = self.axis
         dp_axis = self.dp_axis
         opt = self.opt
@@ -464,28 +500,13 @@ class CtrPipelineRunner:
         # other stages compute-and-discard via the schedule's where, so
         # grads only flow to the selected branch), stage_apply = this
         # stage's tower blocks, emit = the head on the last stage
-        def blocks(p, state):
-            y = state
-            for i in range(p["blk_w"].shape[0]):
-                y = jax.nn.relu(y @ p["blk_w"][i] + p["blk_b"][i])
-            return y
-
-        def embed_section(p, inputs, tm):
-            emb_all, segments, key_valid = inputs
-            pooled = fused_seqpool_cvm(
-                emb_all[tm], segments[tm], key_valid[tm], mb, num_slots,
-                use_cvm, sorted_segments=True)
-            return jax.nn.relu(pooled.reshape(mb, -1) @ p["proj_w"]
-                               + p["proj_b"])
-
-        def head(p, y):
-            return y @ p["head_w"] + p["head_b"]
-
+        blocks, embed_section, head = ctr_pipeline_sections(
+            mb, num_slots, use_cvm, E)
         pipe_run = _spmd_pipeline(blocks, S, M, axis,
                                   ingest=embed_section, emit=head)
 
-        def pipe(p, emb_all, batch):
-            return pipe_run(p, (emb_all, batch["segments"],
+        def pipe(p, emb_all, exp_all, batch):
+            return pipe_run(p, (emb_all, exp_all, batch["segments"],
                                 batch["key_valid"]))
 
         def step(params, opt_state, slab, batch, prng):
@@ -501,10 +522,17 @@ class CtrPipelineRunner:
             # key validity is DERIVED on device (ids == trash row), like
             # the single-chip trainer's _key_valid — no redundant H2D leaf
             batch = dict(batch, key_valid=batch["ids"] != pad_id)
-            emb_all = pull_sparse(slab, ids_flat, layout).reshape(M, K, -1)
+            if E:
+                base, exp = pull_sparse_extended(slab, ids_flat, layout)
+                emb_all = base.reshape(M, K, -1)
+                exp_all = exp.reshape(M, K, E)
+            else:
+                emb_all = pull_sparse(slab, ids_flat, layout
+                                      ).reshape(M, K, -1)
+                exp_all = None
 
-            def loss_fn(p, emb_all):
-                logits = pipe(p, emb_all, batch)          # [M, mb]
+            def loss_fn(p, emb_all, exp_all=None):
+                logits = pipe(p, emb_all, exp_all, batch)  # [M, mb]
                 lab = batch["labels"].astype(jnp.float32)
                 iv = batch["ins_valid"]
                 bce = optax.sigmoid_binary_cross_entropy(logits, lab)
@@ -512,8 +540,15 @@ class CtrPipelineRunner:
                 return (jnp.where(iv, bce, 0.0).sum() / denom,
                         jax.nn.sigmoid(logits))
 
-            (loss, preds), (dparams, demb) = jax.value_and_grad(
-                loss_fn, argnums=(0, 1), has_aux=True)(local, emb_all)
+            if E:
+                (loss, preds), (dparams, demb, dexp) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1, 2), has_aux=True)(
+                    local, emb_all, exp_all)
+                dexp = jax.lax.psum(dexp, axis)
+            else:
+                (loss, preds), (dparams, demb) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1), has_aux=True)(local, emb_all)
+                dexp = None
             # the pull lives on stage 0 — every other device's demb is
             # zero; the psum hands stage 0's cotangent to all so the
             # replicated push below is bit-identical everywhere
@@ -533,7 +568,13 @@ class CtrPipelineRunner:
             clicks = batch["labels"].reshape(-1)[(ins + m_off).reshape(-1)]
             slots = (batch["segments"] % num_slots).reshape(-1)
             kv = batch["key_valid"].reshape(-1)
-            pg = build_push_grads(demb.reshape(M * K, -1), slots, clicks, kv)
+            if E:
+                pg = build_push_grads_extended(
+                    demb.reshape(M * K, -1), dexp.reshape(M * K, E),
+                    slots, clicks, kv)
+            else:
+                pg = build_push_grads(demb.reshape(M * K, -1), slots,
+                                      clicks, kv)
             if dp_axis is not None:
                 # every dp row's grads combine into ONE push (the dedup
                 # merge handles cross-row duplicate keys) so the
@@ -553,10 +594,17 @@ class CtrPipelineRunner:
             if dp_axis is not None:
                 batch = jax.tree.map(lambda x: x[0], batch)
             ids_flat = batch["ids"].reshape(-1)
+            K_e = batch["ids"].shape[-1]
             batch = dict(batch, key_valid=batch["ids"] != pad_id)
-            emb_all = pull_sparse(slab, ids_flat, layout).reshape(
-                M, batch["ids"].shape[-1], -1)
-            return jax.nn.sigmoid(pipe(local, emb_all, batch))
+            if E:
+                base, exp = pull_sparse_extended(slab, ids_flat, layout)
+                emb_all = base.reshape(M, K_e, -1)
+                exp_all = exp.reshape(M, K_e, E)
+            else:
+                emb_all = pull_sparse(slab, ids_flat, layout).reshape(
+                    M, K_e, -1)
+                exp_all = None
+            return jax.nn.sigmoid(pipe(local, emb_all, exp_all, batch))
 
         spec_sh = P(self.axis)
         opt_spec = jax.tree.map(
@@ -680,9 +728,6 @@ class ShardedCtrPipelineRunner:
         programs against the full PS, section_worker.cc +
         ps_gpu_wrapper.cc:337-955)."""
         from paddlebox_tpu.parallel.sharded_table import ShardedPassTable
-        if table_cfg.expand_embed_dim:
-            raise ValueError("ShardedCtrPipelineRunner does not consume "
-                             "the expand embedding")
         self.table_cfg = table_cfg
         self.feed = feed
         self.num_slots = len(feed.used_sparse_slots())
@@ -748,7 +793,9 @@ class ShardedCtrPipelineRunner:
         self.layout = self.table.layout
         D = table_cfg.embedx_dim
         slot_dim = (3 + D) if use_cvm else (1 + D)
-        pooled_dim = self.num_slots * slot_dim
+        # expand (NN-cross) blocks sum-pool per slot and concat after the
+        # CVM-pooled features into the projection input
+        pooled_dim = self.num_slots * (slot_dim + table_cfg.expand_embed_dim)
         host_params = ctr_stage_host_params(seed, n_stages, layers_per_stage,
                                             pooled_dim, d_model)
         sh = NamedSharding(mesh, P(self.axis))
@@ -779,34 +826,32 @@ class ShardedCtrPipelineRunner:
     def _build_step(self):
         from paddlebox_tpu.embedding.optimizers import (
             push_sparse_dedup, push_sparse_hostdedup)
-        from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
-        from paddlebox_tpu.ops.sparse import build_push_grads, pull_sparse
+        from paddlebox_tpu.ops.sparse import (build_push_grads,
+                                              build_push_grads_extended,
+                                              pull_sparse,
+                                              pull_sparse_extended)
 
         S, M, Ml, mb = self.n_stages, self.n_micro, self.m_local, self.mb
         num_slots, use_cvm = self.num_slots, self.use_cvm
         layout, conf = self.layout, self.table_cfg.optimizer
+        E = layout.expand_dim
+        base_w = (3 + layout.embedx_dim)   # pull-view width before expand
         axis, dp_axis, flat = self.axis, self.dp_axis, self.flat_axes
         opt = self.opt
         opt_sharded = jax.tree.map(
             lambda x: getattr(x, "ndim", 0) > 0, self.opt_state)
 
-        def blocks(p, state):
-            y = state
-            for i in range(p["blk_w"].shape[0]):
-                y = jax.nn.relu(y @ p["blk_w"][i] + p["blk_b"][i])
-            return y
+        def local_pull(slab, req):
+            # expand mode: base + expand blocks ride ONE value a2a
+            # concatenated (the sharded trainer's wire layout) and split
+            # after the restore
+            if E:
+                b, x = pull_sparse_extended(slab, req.reshape(-1), layout)
+                return jnp.concatenate([b, x], axis=1)
+            return pull_sparse(slab, req.reshape(-1), layout)
 
-        def embed_section(p, inputs, tm):
-            emb_all, segments, key_valid = inputs
-            pooled = fused_seqpool_cvm(
-                emb_all[tm], segments[tm], key_valid[tm], mb, num_slots,
-                use_cvm, sorted_segments=True)
-            return jax.nn.relu(pooled.reshape(mb, -1) @ p["proj_w"]
-                               + p["proj_b"])
-
-        def head(p, y):
-            return y @ p["head_w"] + p["head_b"]
-
+        blocks, embed_section, head = ctr_pipeline_sections(
+            mb, num_slots, use_cvm, E)
         pipe_run = _spmd_pipeline(blocks, S, M, axis,
                                   ingest=embed_section, emit=head)
 
@@ -824,14 +869,19 @@ class ShardedCtrPipelineRunner:
 
             # ---- pull: a2a ids → local shard gather → a2a values →
             # restore THIS device's micro slice, then assemble the dp
-            # row's full [M, K, D'] block over the stage axis
+            # row's full [M, K, D'(+E)] block over the stage axis
             req = jax.lax.all_to_all(buckets, flat, 0, 0, tiled=True)
-            vals = pull_sparse(slab, req.reshape(-1), layout)
+            vals = local_pull(slab, req)
             resp = jax.lax.all_to_all(
                 vals.reshape(Pn, KB, -1), flat, 0, 0, tiled=True)
             emb_loc = resp.reshape(Pn * KB, -1)[batch["restore"]]
-            emb_all = jax.lax.all_gather(
-                emb_loc.reshape(Ml, K, -1), axis, tiled=True)   # [M, K, D']
+            emb_cat = jax.lax.all_gather(
+                emb_loc.reshape(Ml, K, -1), axis, tiled=True)
+            if E:
+                emb_all = emb_cat[..., :base_w]
+                exp_all = emb_cat[..., base_w:]
+            else:
+                emb_all, exp_all = emb_cat, None
             segments = jax.lax.all_gather(batch["segments"], axis,
                                           tiled=True)           # [M, K]
             key_valid = jax.lax.all_gather(batch["valid"], axis, tiled=True)
@@ -839,16 +889,24 @@ class ShardedCtrPipelineRunner:
             ins_valid = jax.lax.all_gather(batch["ins_valid"], axis,
                                            tiled=True)          # [M, mb]
 
-            def loss_fn(p, emb_all):
-                logits = pipe_run(p, (emb_all, segments, key_valid))
+            def loss_fn(p, emb_all, exp_all=None):
+                logits = pipe_run(p, (emb_all, exp_all, segments,
+                                      key_valid))
                 lab = labels.astype(jnp.float32)
                 bce = optax.sigmoid_binary_cross_entropy(logits, lab)
                 denom = jnp.maximum(ins_valid.sum(), 1.0)
                 return (jnp.where(ins_valid, bce, 0.0).sum() / denom,
                         jax.nn.sigmoid(logits))
 
-            (loss, preds), (dparams, demb) = jax.value_and_grad(
-                loss_fn, argnums=(0, 1), has_aux=True)(local, emb_all)
+            if E:
+                (loss, preds), (dparams, demb, dexp) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1, 2), has_aux=True)(
+                    local, emb_all, exp_all)
+                dexp = jax.lax.psum(dexp, axis)
+            else:
+                (loss, preds), (dparams, demb) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1), has_aux=True)(local, emb_all)
+                dexp = None
             # stage 0 owns the pull — psum hands its cotangent to all
             demb = jax.lax.psum(demb, axis)
             if dp_axis is not None:
@@ -866,8 +924,17 @@ class ShardedCtrPipelineRunner:
             clicks = jnp.take_along_axis(batch["labels"], ins, axis=1)
             slots = batch["segments"] % num_slots
             kv = batch["valid"].reshape(-1)
-            pg = build_push_grads(demb_loc.reshape(Ml * K, -1),
-                                  slots.reshape(-1), clicks.reshape(-1), kv)
+            if E:
+                dexp_loc = jax.lax.dynamic_slice_in_dim(
+                    dexp, sidx * Ml, Ml, axis=0)
+                pg = build_push_grads_extended(
+                    demb_loc.reshape(Ml * K, -1),
+                    dexp_loc.reshape(Ml * K, E), slots.reshape(-1),
+                    clicks.reshape(-1), kv)
+            else:
+                pg = build_push_grads(demb_loc.reshape(Ml * K, -1),
+                                      slots.reshape(-1),
+                                      clicks.reshape(-1), kv)
             bucket_g = jnp.zeros((Pn * KB, pg.shape[1]), pg.dtype
                                  ).at[batch["restore"]].add(
                 jnp.where(kv[:, None], pg, 0.0))
@@ -904,18 +971,23 @@ class ShardedCtrPipelineRunner:
             Pn, KB = buckets.shape
             K = batch["segments"].shape[-1]
             req = jax.lax.all_to_all(buckets, flat, 0, 0, tiled=True)
-            vals = pull_sparse(slab, req.reshape(-1), layout)
+            vals = local_pull(slab, req)
             resp = jax.lax.all_to_all(
                 vals.reshape(Pn, KB, -1), flat, 0, 0, tiled=True)
             emb_loc = resp.reshape(Pn * KB, -1)[batch["restore"]]
-            emb_all = jax.lax.all_gather(
+            emb_cat = jax.lax.all_gather(
                 emb_loc.reshape(Ml, K, -1), axis, tiled=True)
+            if E:
+                emb_all, exp_all = emb_cat[..., :base_w], \
+                    emb_cat[..., base_w:]
+            else:
+                emb_all, exp_all = emb_cat, None
             segments = jax.lax.all_gather(batch["segments"], axis,
                                           tiled=True)
             key_valid = jax.lax.all_gather(batch["valid"], axis,
                                            tiled=True)
             return jax.nn.sigmoid(
-                pipe_run(local, (emb_all, segments, key_valid)))
+                pipe_run(local, (emb_all, exp_all, segments, key_valid)))
 
         spec_stage = P(self.axis)
         spec_flat = P(self.flat_axes)

@@ -98,11 +98,11 @@ def _ctr_setup(tmp_path_factory_or_dir, n_files=2, lines=320, mb=16):
     return files, dataclasses.replace(feed, batch_size=mb)
 
 
-def _ctr_table(cap=1 << 12):
+def _ctr_table(cap=1 << 12, expand=0):
     from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
                                               TableConfig)
     return TableConfig(
-        embedx_dim=4, pass_capacity=cap,
+        embedx_dim=4, pass_capacity=cap, expand_embed_dim=expand,
         optimizer=SparseOptimizerConfig(mf_create_thresholds=1e9,  # no rng
                                         mf_initial_range=0.0,
                                         feature_learning_rate=0.05,
@@ -343,6 +343,127 @@ def test_ctr_pipeline_dp_composition_matches_oracle(tmp_path):
         jnp.concatenate(pgs), sub, layout, conf)
     np.testing.assert_allclose(slab_pipe, np.asarray(want_slab),
                                rtol=2e-4, atol=1e-6)
+
+
+def test_ctr_pipeline_expand_oracle_and_sharded_parity(tmp_path):
+    """Expand (NN-cross) through the pipeline (the round-3 'explicitly
+    rejected' edge): one pipelined step with the dual-output extended
+    pull must equal the sequential oracle — params AND slab including
+    the expand-block gradients — and the sharded-slab runner must match
+    the replicated one over full passes."""
+    import jax.numpy as jnp
+    import optax
+    from paddlebox_tpu.data import BoxDataset
+    from paddlebox_tpu.embedding.optimizers import push_sparse_dedup
+    from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm, seqpool_sum
+    from paddlebox_tpu.ops.sparse import (build_push_grads_extended,
+                                          pull_sparse_extended)
+    from paddlebox_tpu.parallel.pipeline import (CtrPipelineRunner,
+                                                 ShardedCtrPipelineRunner)
+
+    files, feed = _ctr_setup(tmp_path, n_files=1, lines=128, mb=16)
+    Ex = 3
+    table_cfg = _ctr_table(expand=Ex)
+    S, L, M = 4, 1, 4
+    r = CtrPipelineRunner(table_cfg, feed, n_stages=S, d_model=24,
+                          layers_per_stage=L, lr=1e-2, n_micro=M, seed=3)
+    params0 = {k: np.asarray(v) for k, v in r.params.items()}
+    ds = BoxDataset(feed, read_threads=1)
+    ds.set_filelist(files)
+    r.table.begin_feed_pass()
+    ds.load_into_memory(add_keys_fn=r.table.add_keys)
+    r.table.end_feed_pass()
+    r.table.begin_pass()
+    slab0 = np.asarray(r.table.slab)
+    batches = ds.split_batches(num_workers=1)[0][:M]
+    batch = jax.tree.map(np.asarray, r.device_batch(batches))
+    batch["key_valid"] = batch["ids"] != r.table.padding_id
+    prng0 = np.asarray(r._prng)
+
+    loss_pipe = r.train_step(batches)
+    slab_pipe = np.asarray(r.table.slab)
+
+    # ---- sequential oracle with the extended pull + expand push
+    layout, conf = r.layout, table_cfg.optimizer
+    num_slots, mb = r.num_slots, r.mb
+    K = batch["ids"].shape[-1]
+
+    def oracle_loss(p, emb_all, exp_all):
+        logits = []
+        for t in range(M):
+            pooled = fused_seqpool_cvm(
+                emb_all[t], jnp.asarray(batch["segments"][t]),
+                jnp.asarray(batch["key_valid"][t]), mb, num_slots, True,
+                sorted_segments=True)
+            pexp = seqpool_sum(exp_all[t],
+                               jnp.asarray(batch["segments"][t]),
+                               jnp.asarray(batch["key_valid"][t]), mb,
+                               num_slots)
+            x = jnp.concatenate([pooled.reshape(mb, -1),
+                                 pexp.reshape(mb, -1)], axis=-1)
+            x = jax.nn.relu(x @ p["proj_w"][0] + p["proj_b"][0])
+            for s in range(S):
+                for i in range(L):
+                    x = jax.nn.relu(x @ p["blk_w"][s, i] + p["blk_b"][s, i])
+            logits.append(x @ p["head_w"][S - 1] + p["head_b"][S - 1])
+        logits = jnp.stack(logits)
+        lab = jnp.asarray(batch["labels"]).astype(jnp.float32)
+        iv = jnp.asarray(batch["ins_valid"])
+        bce = optax.sigmoid_binary_cross_entropy(logits, lab)
+        return jnp.where(iv, bce, 0.0).sum() / jnp.maximum(iv.sum(), 1.0)
+
+    ids_flat = jnp.asarray(batch["ids"].reshape(-1))
+    base, exp = pull_sparse_extended(jnp.asarray(slab0), ids_flat, layout)
+    emb_all = base.reshape(M, K, -1)
+    exp_all = exp.reshape(M, K, Ex)
+    loss_o, (dp, demb, dexp) = jax.value_and_grad(
+        oracle_loss, argnums=(0, 1, 2))(
+        {k: jnp.asarray(v) for k, v in params0.items()}, emb_all, exp_all)
+    np.testing.assert_allclose(loss_pipe, float(loss_o), rtol=1e-5)
+
+    opt = optax.adam(1e-2)
+    p0 = {k: jnp.asarray(v) for k, v in params0.items()}
+    upd, _ = opt.update(dp, opt.init(p0), p0)
+    want_params = optax.apply_updates(p0, upd)
+    for k in want_params:
+        np.testing.assert_allclose(np.asarray(r.params[k]),
+                                   np.asarray(want_params[k]),
+                                   rtol=2e-4, atol=1e-6, err_msg=k)
+
+    _, sub = jax.random.split(jnp.asarray(prng0))
+    ins = batch["segments"] // num_slots
+    m_off = (np.arange(M, dtype=ins.dtype) * mb)[:, None]
+    clicks = batch["labels"].reshape(-1)[(ins + m_off).reshape(-1)]
+    slots = (batch["segments"] % num_slots).reshape(-1)
+    kv = batch["key_valid"].reshape(-1)
+    pg = build_push_grads_extended(
+        demb.reshape(M * K, -1), dexp.reshape(M * K, Ex),
+        jnp.asarray(slots), jnp.asarray(clicks), jnp.asarray(kv))
+    want_slab = push_sparse_dedup(jnp.asarray(slab0), ids_flat, pg, sub,
+                                  layout, conf)
+    np.testing.assert_allclose(slab_pipe, np.asarray(want_slab),
+                               rtol=2e-4, atol=1e-6)
+    ds.release_memory()
+
+    # ---- sharded-slab runner parity over full passes (same seed)
+    rep = CtrPipelineRunner(table_cfg, feed, n_stages=S, d_model=24,
+                            layers_per_stage=L, lr=1e-2, n_micro=M, seed=5)
+    shd = ShardedCtrPipelineRunner(table_cfg, feed, n_stages=S, d_model=24,
+                                   layers_per_stage=L, lr=1e-2, n_micro=M,
+                                   seed=5)
+    stats = []
+    for rr in (rep, shd):
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        stats.append(rr.train_pass(ds))
+        ds.release_memory()
+    np.testing.assert_allclose(stats[1]["loss"], stats[0]["loss"],
+                               rtol=1e-5)
+    rk, rv = rep.table.store.state_items()
+    sk, sv = shd.table.store_view().state_items()
+    ro, so = np.argsort(rk), np.argsort(sk)
+    np.testing.assert_array_equal(rk[ro], sk[so])
+    np.testing.assert_allclose(sv[so], rv[ro], rtol=2e-4, atol=1e-6)
 
 
 def test_sharded_ctr_pipeline_matches_replicated(tmp_path):
