@@ -277,13 +277,23 @@ def _feed_pipeline_metrics(runner, preds, packed_batches) -> None:
             pos = sh.index[0] if sh.index else slice(0, None)
             start = (pos.start or 0) if isinstance(pos, slice) else int(pos)
             by_start.setdefault(start, np.asarray(sh.data))
-        p = np.concatenate(
-            [by_start[s] for s in sorted(by_start)]).reshape(-1)
+        arr = np.concatenate([by_start[s] for s in sorted(by_start)])
     else:
-        p = np.asarray(preds).reshape(-1)
+        arr = np.asarray(preds)
     labels = np.concatenate([b.labels for b in packed_batches])
     mask = np.concatenate([b.ins_valid for b in packed_batches])
-    runner.metrics.add_batch({"pred": p, "label": labels, "mask": mask})
+    tensors = {"label": labels, "mask": mask}
+    names = getattr(runner, "task_names", ("ctr",))
+    if len(names) > 1:
+        # per-task prediction/label columns (metrics.h MultiTask naming)
+        for ti, t in enumerate(names):
+            tensors["pred_" + t] = arr[..., ti].reshape(-1)
+            tensors["label_" + t] = np.concatenate(
+                [_task_label_of(b, t) for b in packed_batches])
+        tensors["pred"] = tensors["pred_" + names[0]]
+    else:
+        tensors["pred"] = arr.reshape(-1)
+    runner.metrics.add_batch(tensors)
 
 
 def _pipeline_predict(runner, dataset, begin_pass, end_pass, slab_of):
@@ -312,7 +322,10 @@ def _pipeline_predict(runner, dataset, begin_pass, end_pass, slab_of):
             group = batches[lo:lo + M]
             batch = runner.device_batch(group)
             preds = np.asarray(runner._eval(runner.params, slab_of(),
-                                            batch)).reshape(-1)
+                                            batch))
+            if getattr(runner, "multi_task", False):
+                preds = preds[..., 0]   # main task (task_names[0])
+            preds = preds.reshape(-1)
             labels = np.concatenate([b.labels for b in group])
             mask = np.concatenate([b.ins_valid for b in group])
             preds_all.append(preds[mask])
@@ -326,6 +339,31 @@ def _pipeline_predict(runner, dataset, begin_pass, end_pass, slab_of):
     if not preds_all:
         return np.empty(0, np.float32), np.empty(0, np.int32)
     return np.concatenate(preds_all), np.concatenate(labels_all)
+
+
+def _task_label_of(b, t):
+    """The ONE per-task label fallback rule: tasks without a label slot
+    in the feed train/stream on the primary click label."""
+    return (b.task_labels or {}).get(t, b.labels)
+
+
+def ctr_pipeline_loss(logits, labels, ins_valid, task_labels, task_names):
+    """The ONE loss both pipeline runners share. Single task: masked-mean
+    bce on [M, mb] logits. Multi-task: per-task bce over the [M, mb, T]
+    head summed (the trainers' _multi_task_loss 'sum' mode; tasks absent
+    from the feed fall back to the click label at batch build)."""
+    denom = jnp.maximum(ins_valid.sum(), 1.0)
+    if len(task_names) == 1:
+        bce = optax.sigmoid_binary_cross_entropy(
+            logits, labels.astype(jnp.float32))
+        return (jnp.where(ins_valid, bce, 0.0).sum() / denom,
+                jax.nn.sigmoid(logits))
+    loss = 0.0
+    for ti, t in enumerate(task_names):
+        lab = task_labels[t].astype(jnp.float32)
+        bce = optax.sigmoid_binary_cross_entropy(logits[..., ti], lab)
+        loss = loss + jnp.where(ins_valid, bce, 0.0).sum() / denom
+    return loss, jax.nn.sigmoid(logits)
 
 
 def ctr_pipeline_sections(mb: int, num_slots: int, use_cvm: bool, E: int):
@@ -364,12 +402,17 @@ def ctr_pipeline_sections(mb: int, num_slots: int, use_cvm: bool, E: int):
 
 def ctr_stage_host_params(seed: int, n_stages: int, layers_per_stage: int,
                           pooled_dim: int, d_model: int,
-                          scale: float = 0.1) -> Dict[str, np.ndarray]:
+                          scale: float = 0.1,
+                          n_tasks: int = 1) -> Dict[str, np.ndarray]:
     """The ONE init of the CTR pipeline's stage-stacked params — shared by
     the replicated-slab and sharded-slab runners so same-seed runs are
-    bit-identical (the parity tests rely on it)."""
+    bit-identical (the parity tests rely on it). n_tasks > 1 grows the
+    head to [d_model, T] (multi-task logits per micro-batch); n_tasks=1
+    keeps the historical scalar-head shapes."""
     S, L = n_stages, layers_per_stage
     rng = np.random.RandomState(seed)
+    head_shape = (S, d_model) if n_tasks == 1 else (S, d_model, n_tasks)
+    head_b = (S,) if n_tasks == 1 else (S, n_tasks)
     return {
         # stacked [S, ...]: each device materialises one stage's slice;
         # proj is live on stage 0 only, head on the last only (their
@@ -380,8 +423,8 @@ def ctr_stage_host_params(seed: int, n_stages: int, layers_per_stage: int,
         "blk_w": (scale * rng.randn(S, L, d_model, d_model)
                   ).astype(np.float32),
         "blk_b": np.zeros((S, L, d_model), np.float32),
-        "head_w": (scale * rng.randn(S, d_model)).astype(np.float32),
-        "head_b": np.zeros((S,), np.float32),
+        "head_w": (scale * rng.randn(*head_shape)).astype(np.float32),
+        "head_b": np.zeros(head_b, np.float32),
     }
 
 
@@ -417,8 +460,14 @@ class CtrPipelineRunner:
                  d_model: int = 32, layers_per_stage: int = 1,
                  lr: float = 1e-2, n_micro: Optional[int] = None,
                  use_cvm: bool = True, mesh: Optional[Mesh] = None,
-                 seed: int = 0):
+                 seed: int = 0, task_names=("ctr",)):
+        """task_names: >1 entries grow the last stage's head to T logits
+        per instance trained on per-task labels (feed.task_label_slots;
+        absent tasks fall back to the click label) — ESMM/MMoE-style
+        multi-task through the pipeline."""
         from paddlebox_tpu.embedding.pass_table import PassTable
+        self.task_names = tuple(task_names)
+        self.multi_task = len(self.task_names) > 1
         self.table = PassTable(table_cfg, seed=seed)
         self.table_cfg = table_cfg
         self.feed = feed
@@ -455,8 +504,9 @@ class CtrPipelineRunner:
         # expand (NN-cross) blocks sum-pool per slot and concat after the
         # CVM-pooled features into the projection input
         pooled_dim = self.num_slots * (slot_dim + table_cfg.expand_embed_dim)
-        host_params = ctr_stage_host_params(seed, n_stages, layers_per_stage,
-                                            pooled_dim, d_model)
+        host_params = ctr_stage_host_params(
+            seed, n_stages, layers_per_stage, pooled_dim, d_model,
+            n_tasks=len(self.task_names))
         sh = NamedSharding(mesh, P(self.axis))
         self.params = {k: jax.device_put(v, sh)
                        for k, v in host_params.items()}
@@ -484,6 +534,7 @@ class CtrPipelineRunner:
         num_slots, use_cvm = self.num_slots, self.use_cvm
         layout, conf = self.layout, self.table_cfg.optimizer
         E = layout.expand_dim
+        task_names = self.task_names
         axis = self.axis
         dp_axis = self.dp_axis
         opt = self.opt
@@ -531,14 +582,14 @@ class CtrPipelineRunner:
                                       ).reshape(M, K, -1)
                 exp_all = None
 
+            task_labels = {t: batch["labels_" + t] for t in task_names
+                           } if len(task_names) > 1 else None
+
             def loss_fn(p, emb_all, exp_all=None):
-                logits = pipe(p, emb_all, exp_all, batch)  # [M, mb]
-                lab = batch["labels"].astype(jnp.float32)
-                iv = batch["ins_valid"]
-                bce = optax.sigmoid_binary_cross_entropy(logits, lab)
-                denom = jnp.maximum(iv.sum(), 1.0)
-                return (jnp.where(iv, bce, 0.0).sum() / denom,
-                        jax.nn.sigmoid(logits))
+                logits = pipe(p, emb_all, exp_all, batch)  # [M, mb(, T)]
+                return ctr_pipeline_loss(logits, batch["labels"],
+                                         batch["ins_valid"], task_labels,
+                                         task_names)
 
             if E:
                 (loss, preds), (dparams, demb, dexp) = jax.value_and_grad(
@@ -565,7 +616,11 @@ class CtrPipelineRunner:
             # single-chip push semantics over all M micro-batches at once
             ins = batch["segments"] // num_slots          # [M, K]
             m_off = (jnp.arange(M, dtype=ins.dtype) * mb)[:, None]
-            clicks = batch["labels"].reshape(-1)[(ins + m_off).reshape(-1)]
+            # per-key click stat = FIRST task's label (the trainers'
+            # convention, trainer.py _sparse_push)
+            click_src = (batch["labels_" + task_names[0]]
+                         if len(task_names) > 1 else batch["labels"])
+            clicks = click_src.reshape(-1)[(ins + m_off).reshape(-1)]
             slots = (batch["segments"] % num_slots).reshape(-1)
             kv = batch["key_valid"].reshape(-1)
             if E:
@@ -646,12 +701,17 @@ class CtrPipelineRunner:
 
         ids = stack([self.table.lookup_ids(b.keys, b.valid)
                      for b in packed_batches])
-        return {
+        out = {
             "ids": ids,
             "segments": stack([b.segments for b in packed_batches]),
             "labels": stack([b.labels for b in packed_batches]),
             "ins_valid": stack([b.ins_valid for b in packed_batches]),
         }
+        if self.multi_task:
+            for t in self.task_names:
+                out["labels_" + t] = stack(
+                    [_task_label_of(b, t) for b in packed_batches])
+        return out
 
     def train_step(self, packed_batches) -> float:
         """ONE pipelined train step over dp × n_micro micro-batches."""
@@ -713,8 +773,11 @@ class ShardedCtrPipelineRunner:
                  lr: float = 1e-2, n_micro: Optional[int] = None,
                  use_cvm: bool = True, mesh: Optional[Mesh] = None,
                  bucket_cap: Optional[int] = None, seed: int = 0,
-                 fleet=None, store_factory=None):
-        """fleet: REQUIRED in a multi-process job — unions feed-pass keys
+                 fleet=None, store_factory=None, task_names=("ctr",)):
+        """task_names: >1 grows the head to T logits per instance
+        (multi-task through the pipeline, see CtrPipelineRunner).
+
+        fleet: REQUIRED in a multi-process job — unions feed-pass keys
         and equalizes the per-process step-group counts. Multi-process
         topology: the dp axis must span the processes in whole rows (each
         process feeds its own dp rows' micro-batches; a pipeline row's
@@ -728,6 +791,8 @@ class ShardedCtrPipelineRunner:
         programs against the full PS, section_worker.cc +
         ps_gpu_wrapper.cc:337-955)."""
         from paddlebox_tpu.parallel.sharded_table import ShardedPassTable
+        self.task_names = tuple(task_names)
+        self.multi_task = len(self.task_names) > 1
         self.table_cfg = table_cfg
         self.feed = feed
         self.num_slots = len(feed.used_sparse_slots())
@@ -796,8 +861,9 @@ class ShardedCtrPipelineRunner:
         # expand (NN-cross) blocks sum-pool per slot and concat after the
         # CVM-pooled features into the projection input
         pooled_dim = self.num_slots * (slot_dim + table_cfg.expand_embed_dim)
-        host_params = ctr_stage_host_params(seed, n_stages, layers_per_stage,
-                                            pooled_dim, d_model)
+        host_params = ctr_stage_host_params(
+            seed, n_stages, layers_per_stage, pooled_dim, d_model,
+            n_tasks=len(self.task_names))
         sh = NamedSharding(mesh, P(self.axis))
 
         def put_stage(v):
@@ -835,6 +901,7 @@ class ShardedCtrPipelineRunner:
         num_slots, use_cvm = self.num_slots, self.use_cvm
         layout, conf = self.layout, self.table_cfg.optimizer
         E = layout.expand_dim
+        task_names = self.task_names
         base_w = (3 + layout.embedx_dim)   # pull-view width before expand
         axis, dp_axis, flat = self.axis, self.dp_axis, self.flat_axes
         opt = self.opt
@@ -888,15 +955,16 @@ class ShardedCtrPipelineRunner:
             labels = jax.lax.all_gather(batch["labels"], axis, tiled=True)
             ins_valid = jax.lax.all_gather(batch["ins_valid"], axis,
                                            tiled=True)          # [M, mb]
+            task_labels = ({t: jax.lax.all_gather(batch["labels_" + t],
+                                                  axis, tiled=True)
+                            for t in task_names}
+                           if len(task_names) > 1 else None)
 
             def loss_fn(p, emb_all, exp_all=None):
                 logits = pipe_run(p, (emb_all, exp_all, segments,
                                       key_valid))
-                lab = labels.astype(jnp.float32)
-                bce = optax.sigmoid_binary_cross_entropy(logits, lab)
-                denom = jnp.maximum(ins_valid.sum(), 1.0)
-                return (jnp.where(ins_valid, bce, 0.0).sum() / denom,
-                        jax.nn.sigmoid(logits))
+                return ctr_pipeline_loss(logits, labels, ins_valid,
+                                         task_labels, task_names)
 
             if E:
                 (loss, preds), (dparams, demb, dexp) = jax.value_and_grad(
@@ -921,7 +989,10 @@ class ShardedCtrPipelineRunner:
             demb_loc = jax.lax.dynamic_slice_in_dim(
                 demb, sidx * Ml, Ml, axis=0)                   # [Ml, K, D']
             ins = batch["segments"] // num_slots               # [Ml, K]
-            clicks = jnp.take_along_axis(batch["labels"], ins, axis=1)
+            # per-key click stat = FIRST task's label (trainers' rule)
+            click_src = (batch["labels_" + task_names[0]]
+                         if len(task_names) > 1 else batch["labels"])
+            clicks = jnp.take_along_axis(click_src, ins, axis=1)
             slots = batch["segments"] % num_slots
             kv = batch["valid"].reshape(-1)
             if E:
@@ -1052,6 +1123,10 @@ class ShardedCtrPipelineRunner:
                 leaves["labels"].append(np.stack([b.labels for b in sub]))
                 leaves["ins_valid"].append(np.stack([b.ins_valid
                                                      for b in sub]))
+                if self.multi_task:
+                    for t in self.task_names:
+                        leaves.setdefault("labels_" + t, []).append(
+                            np.stack([_task_label_of(b, t) for b in sub]))
         if not self.multiprocess and not self.table.test_mode:
             # single process sees every device's outgoing buckets:
             # precompute the per-shard push dedup (the a2a's incoming ids)

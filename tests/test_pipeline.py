@@ -466,6 +466,104 @@ def test_ctr_pipeline_expand_oracle_and_sharded_parity(tmp_path):
     np.testing.assert_allclose(sv[so], rv[ro], rtol=2e-4, atol=1e-6)
 
 
+def test_ctr_pipeline_multi_task(tmp_path):
+    """ESMM-style multi-task through the pipeline: the last stage's head
+    emits T logits per instance trained on per-task labels. One
+    pipelined step equals the sequential multi-task oracle; the sharded
+    runner matches the replicated one; per-task metric columns stream."""
+    import dataclasses
+    import jax.numpy as jnp
+    import optax
+    from paddlebox_tpu.data import BoxDataset, write_synthetic_ctr_files
+    from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
+    from paddlebox_tpu.ops.sparse import pull_sparse
+    from paddlebox_tpu.parallel.pipeline import (CtrPipelineRunner,
+                                                 ShardedCtrPipelineRunner)
+
+    files, feed = write_synthetic_ctr_files(
+        str(tmp_path), num_files=1, lines_per_file=128, num_slots=4,
+        vocab_per_slot=100, max_len=3, seed=7, conversion=True)
+    feed = dataclasses.replace(feed, batch_size=16)
+    table_cfg = _ctr_table()
+    S, L, M = 4, 1, 4
+    TASKS = ("ctr", "cvr")
+    r = CtrPipelineRunner(table_cfg, feed, n_stages=S, d_model=24,
+                          layers_per_stage=L, lr=1e-2, n_micro=M, seed=3,
+                          task_names=TASKS)
+    params0 = {k: np.asarray(v) for k, v in r.params.items()}
+    assert params0["head_w"].shape == (S, 24, 2)
+    ds = BoxDataset(feed, read_threads=1)
+    ds.set_filelist(files)
+    r.table.begin_feed_pass()
+    ds.load_into_memory(add_keys_fn=r.table.add_keys)
+    r.table.end_feed_pass()
+    r.table.begin_pass()
+    slab0 = np.asarray(r.table.slab)
+    batches = ds.split_batches(num_workers=1)[0][:M]
+    batch = jax.tree.map(np.asarray, r.device_batch(batches))
+    batch["key_valid"] = batch["ids"] != r.table.padding_id
+
+    loss_pipe = r.train_step(batches)
+
+    # ---- sequential multi-task oracle (loss only — the params/slab
+    # machinery is pinned by the single-task oracle tests; here the new
+    # surface is the T-logit head + summed per-task loss)
+    layout = r.layout
+    num_slots, mb = r.num_slots, r.mb
+    K = batch["ids"].shape[-1]
+
+    def oracle_loss(p, emb_all):
+        logits = []
+        for t in range(M):
+            pooled = fused_seqpool_cvm(
+                emb_all[t], jnp.asarray(batch["segments"][t]),
+                jnp.asarray(batch["key_valid"][t]), mb, num_slots, True,
+                sorted_segments=True)
+            x = jax.nn.relu(pooled.reshape(mb, -1) @ p["proj_w"][0]
+                            + p["proj_b"][0])
+            for s in range(S):
+                for i in range(L):
+                    x = jax.nn.relu(x @ p["blk_w"][s, i] + p["blk_b"][s, i])
+            logits.append(x @ p["head_w"][S - 1] + p["head_b"][S - 1])
+        logits = jnp.stack(logits)                       # [M, mb, 2]
+        iv = jnp.asarray(batch["ins_valid"])
+        denom = jnp.maximum(iv.sum(), 1.0)
+        loss = 0.0
+        for ti, t in enumerate(TASKS):
+            lab = jnp.asarray(batch["labels_" + t]).astype(jnp.float32)
+            bce = optax.sigmoid_binary_cross_entropy(logits[..., ti], lab)
+            loss = loss + jnp.where(iv, bce, 0.0).sum() / denom
+        return loss
+
+    ids_flat = jnp.asarray(batch["ids"].reshape(-1))
+    emb_all = pull_sparse(jnp.asarray(slab0), ids_flat,
+                          layout).reshape(M, K, -1)
+    loss_o = float(oracle_loss(
+        {k: jnp.asarray(v) for k, v in params0.items()}, emb_all))
+    np.testing.assert_allclose(loss_pipe, loss_o, rtol=1e-5)
+    ds.release_memory()
+
+    # ---- replicated vs sharded parity + per-task metric stream
+    rep = CtrPipelineRunner(table_cfg, feed, n_stages=S, d_model=24,
+                            layers_per_stage=L, lr=1e-2, n_micro=M,
+                            seed=5, task_names=TASKS)
+    shd = ShardedCtrPipelineRunner(table_cfg, feed, n_stages=S, d_model=24,
+                                   layers_per_stage=L, lr=1e-2, n_micro=M,
+                                   seed=5, task_names=TASKS)
+    rep.metrics.init_metric("auc_cvr", "label_cvr", "pred_cvr",
+                            table_size=1 << 14, mask_var="mask")
+    stats = []
+    for rr in (rep, shd):
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        stats.append(rr.train_pass(ds))
+        ds.release_memory()
+    np.testing.assert_allclose(stats[1]["loss"], stats[0]["loss"],
+                               rtol=1e-5)
+    msg = rep.metrics.get_metric_msg("auc_cvr")
+    assert msg["size"] > 0      # the cvr column streamed
+
+
 def test_sharded_ctr_pipeline_matches_replicated(tmp_path):
     """Pipeline × sharded-table composition (the round-3 verdict's one
     remaining partial): the key-mod-sharded slab behind the SAME pipeline
