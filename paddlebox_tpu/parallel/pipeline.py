@@ -366,12 +366,20 @@ def ctr_pipeline_loss(logits, labels, ins_valid, task_labels, task_names):
     return loss, jax.nn.sigmoid(logits)
 
 
-def ctr_pipeline_sections(mb: int, num_slots: int, use_cvm: bool, E: int):
-    """The ONE definition of the CTR pipeline's three program sections —
-    (blocks, embed_section, head) closures shared by the replicated and
-    sharded runners (their parity tests rely on byte-identical math).
-    embed_section consumes inputs = (emb_all, exp_all, segments,
-    key_valid); exp_all is None when E == 0."""
+def ctr_pipeline_sections(mb: int, num_slots: int, use_cvm: bool, E: int,
+                          use_data_norm: bool = False,
+                          dn_slot_dim: int = 0):
+    """The ONE definition of the CTR pipeline's program sections —
+    (blocks, embed_section, head, proj_input) closures shared by the
+    replicated and sharded runners (their parity tests rely on
+    byte-identical math). embed_section consumes inputs = (emb_all,
+    exp_all, segments, key_valid); exp_all is None when E == 0.
+    proj_input assembles stage 0's pre-projection features for micro tm
+    — embed_section normalizes it (data_norm over stop_gradient'ed
+    summary leaves dn_size/dn_sum/dn_sqsum when use_data_norm) and the
+    runners reuse it for the running-sums summary update (XLA CSEs the
+    duplicate assembly, the dn_update_params pattern)."""
+    from paddlebox_tpu.ops.data_norm import DataNormState, data_norm
     from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm, seqpool_sum
 
     def blocks(p, state):
@@ -380,30 +388,69 @@ def ctr_pipeline_sections(mb: int, num_slots: int, use_cvm: bool, E: int):
             y = jax.nn.relu(y @ p["blk_w"][i] + p["blk_b"][i])
         return y
 
-    def embed_section(p, inputs, tm):
-        emb_all, exp_all, segments, key_valid = inputs
-        pooled = fused_seqpool_cvm(
-            emb_all[tm], segments[tm], key_valid[tm], mb, num_slots,
-            use_cvm, sorted_segments=True)
-        x = pooled.reshape(mb, -1)
-        if E:
-            # expand block: plain per-slot sum pool (the
-            # pull_box_extended_sparse consumer pattern)
-            pexp = seqpool_sum(exp_all[tm], segments[tm], key_valid[tm],
-                               mb, num_slots)
-            x = jnp.concatenate([x, pexp.reshape(mb, -1)], axis=-1)
+    def proj_input_all(emb_all, exp_all, segments, key_valid):
+        """ALL M micros' pre-projection features [M, mb, in_dim],
+        assembled ONCE outside the GPipe scan — the in-scan ingest would
+        otherwise re-run seqpool+concat on every tick including the S-1
+        drain ticks whose stage-0 output is discarded. Gradients flow to
+        emb/exp through this trace; the dn summary update reuses the
+        same tensor."""
+        M = emb_all.shape[0]
+        xs = []
+        for t in range(M):
+            pooled = fused_seqpool_cvm(
+                emb_all[t], segments[t], key_valid[t], mb, num_slots,
+                use_cvm, sorted_segments=True)
+            x = pooled.reshape(mb, -1)
+            if E:
+                # expand block: plain per-slot sum pool (the
+                # pull_box_extended_sparse consumer pattern)
+                pexp = seqpool_sum(exp_all[t], segments[t], key_valid[t],
+                                   mb, num_slots)
+                x = jnp.concatenate([x, pexp.reshape(mb, -1)], axis=-1)
+            xs.append(x)
+        return jnp.stack(xs)
+
+    def embed_section(p, x_all, tm):
+        x = x_all[tm]
+        if use_data_norm:
+            st = DataNormState(
+                jax.lax.stop_gradient(p["dn_size"]),
+                jax.lax.stop_gradient(p["dn_sum"]),
+                jax.lax.stop_gradient(p["dn_sqsum"]))
+            x = data_norm(x, st, slot_dim=dn_slot_dim)
         return jax.nn.relu(x @ p["proj_w"] + p["proj_b"])
 
     def head(p, y):
         return y @ p["head_w"] + p["head_b"]
 
-    return blocks, embed_section, head
+    return blocks, embed_section, head, proj_input_all
+
+
+def dn_summary_apply(local, x_all, dn_decay: float, dn_slot_dim: int,
+                     dp_axis):
+    """The ONE running-sums summary update both runners share: fold every
+    micro's pre-projection features into the dn leaves (the optimizer's
+    zero-grad update on them was a no-op); dp rows pmean the result —
+    ratio-preserving, the sharded trainer's documented dn rule."""
+    from paddlebox_tpu.ops.data_norm import (DataNormState,
+                                             data_norm_summary_update)
+    st = data_norm_summary_update(
+        DataNormState(local["dn_size"], local["dn_sum"],
+                      local["dn_sqsum"]),
+        x_all.reshape(-1, x_all.shape[-1]).astype(jnp.float32),
+        decay=dn_decay, slot_dim=dn_slot_dim)
+    if dp_axis is not None:
+        st = jax.tree.map(lambda a: jax.lax.pmean(a, dp_axis), st)
+    return dict(local, dn_size=st.batch_size, dn_sum=st.batch_sum,
+                dn_sqsum=st.batch_square_sum)
 
 
 def ctr_stage_host_params(seed: int, n_stages: int, layers_per_stage: int,
                           pooled_dim: int, d_model: int,
-                          scale: float = 0.1,
-                          n_tasks: int = 1) -> Dict[str, np.ndarray]:
+                          scale: float = 0.1, n_tasks: int = 1,
+                          use_data_norm: bool = False
+                          ) -> Dict[str, np.ndarray]:
     """The ONE init of the CTR pipeline's stage-stacked params — shared by
     the replicated-slab and sharded-slab runners so same-seed runs are
     bit-identical (the parity tests rely on it). n_tasks > 1 grows the
@@ -413,7 +460,7 @@ def ctr_stage_host_params(seed: int, n_stages: int, layers_per_stage: int,
     rng = np.random.RandomState(seed)
     head_shape = (S, d_model) if n_tasks == 1 else (S, d_model, n_tasks)
     head_b = (S,) if n_tasks == 1 else (S, n_tasks)
-    return {
+    p = {
         # stacked [S, ...]: each device materialises one stage's slice;
         # proj is live on stage 0 only, head on the last only (their
         # other slices get zero grads and never influence the logits)
@@ -426,6 +473,14 @@ def ctr_stage_host_params(seed: int, n_stages: int, layers_per_stage: int,
         "head_w": (scale * rng.randn(*head_shape)).astype(np.float32),
         "head_b": np.zeros(head_b, np.float32),
     }
+    if use_data_norm:
+        # running-summary leaves (DataNormState.init defaults): updated
+        # by the running-sums rule, never by the optimizer (zero grads
+        # via stop_gradient in the embed section)
+        p["dn_size"] = np.full((S, pooled_dim), 1e4, np.float32)
+        p["dn_sum"] = np.zeros((S, pooled_dim), np.float32)
+        p["dn_sqsum"] = np.full((S, pooled_dim), 1e4, np.float32)
+    return p
 
 
 class CtrPipelineRunner:
@@ -460,14 +515,24 @@ class CtrPipelineRunner:
                  d_model: int = 32, layers_per_stage: int = 1,
                  lr: float = 1e-2, n_micro: Optional[int] = None,
                  use_cvm: bool = True, mesh: Optional[Mesh] = None,
-                 seed: int = 0, task_names=("ctr",)):
+                 seed: int = 0, task_names=("ctr",),
+                 use_data_norm: bool = False, dn_slot_dim: int = 0,
+                 dn_decay: float = 0.9999999):
         """task_names: >1 entries grow the last stage's head to T logits
         per instance trained on per-task labels (feed.task_label_slots;
         absent tasks fall back to the click label) — ESMM/MMoE-style
-        multi-task through the pipeline."""
+        multi-task through the pipeline.
+
+        use_data_norm: streaming input normalization of stage 0's
+        projection input by running summaries updated with the
+        running-sums rule (the CtrDnn(use_data_norm) semantics through
+        the pipeline; boxps_worker.cc:89-95 summary params)."""
         from paddlebox_tpu.embedding.pass_table import PassTable
         self.task_names = tuple(task_names)
         self.multi_task = len(self.task_names) > 1
+        self.use_data_norm = use_data_norm
+        self.dn_slot_dim = dn_slot_dim
+        self.dn_decay = dn_decay
         self.table = PassTable(table_cfg, seed=seed)
         self.table_cfg = table_cfg
         self.feed = feed
@@ -506,7 +571,8 @@ class CtrPipelineRunner:
         pooled_dim = self.num_slots * (slot_dim + table_cfg.expand_embed_dim)
         host_params = ctr_stage_host_params(
             seed, n_stages, layers_per_stage, pooled_dim, d_model,
-            n_tasks=len(self.task_names))
+            n_tasks=len(self.task_names),
+            use_data_norm=self.use_data_norm)
         sh = NamedSharding(mesh, P(self.axis))
         self.params = {k: jax.device_put(v, sh)
                        for k, v in host_params.items()}
@@ -551,14 +617,19 @@ class CtrPipelineRunner:
         # other stages compute-and-discard via the schedule's where, so
         # grads only flow to the selected branch), stage_apply = this
         # stage's tower blocks, emit = the head on the last stage
-        blocks, embed_section, head = ctr_pipeline_sections(
-            mb, num_slots, use_cvm, E)
+        blocks, embed_section, head, proj_input_all = ctr_pipeline_sections(
+            mb, num_slots, use_cvm, E,
+            use_data_norm=self.use_data_norm,
+            dn_slot_dim=self.dn_slot_dim)
+        use_dn, dn_decay, dn_sd = (self.use_data_norm, self.dn_decay,
+                                   self.dn_slot_dim)
         pipe_run = _spmd_pipeline(blocks, S, M, axis,
                                   ingest=embed_section, emit=head)
 
         def pipe(p, emb_all, exp_all, batch):
-            return pipe_run(p, (emb_all, exp_all, batch["segments"],
-                                batch["key_valid"]))
+            x_all = proj_input_all(emb_all, exp_all, batch["segments"],
+                                   batch["key_valid"])
+            return pipe_run(p, x_all), x_all
 
         def step(params, opt_state, slab, batch, prng):
             local = jax.tree.map(lambda x: x[0], params)
@@ -586,19 +657,23 @@ class CtrPipelineRunner:
                            } if len(task_names) > 1 else None
 
             def loss_fn(p, emb_all, exp_all=None):
-                logits = pipe(p, emb_all, exp_all, batch)  # [M, mb(, T)]
-                return ctr_pipeline_loss(logits, batch["labels"],
-                                         batch["ins_valid"], task_labels,
-                                         task_names)
+                logits, x_all = pipe(p, emb_all, exp_all, batch)
+                loss, preds = ctr_pipeline_loss(
+                    logits, batch["labels"], batch["ins_valid"],
+                    task_labels, task_names)
+                return loss, (preds, x_all)
 
             if E:
-                (loss, preds), (dparams, demb, dexp) = jax.value_and_grad(
-                    loss_fn, argnums=(0, 1, 2), has_aux=True)(
-                    local, emb_all, exp_all)
+                (loss, (preds, x_all)), (dparams, demb, dexp) = \
+                    jax.value_and_grad(
+                        loss_fn, argnums=(0, 1, 2), has_aux=True)(
+                        local, emb_all, exp_all)
                 dexp = jax.lax.psum(dexp, axis)
             else:
-                (loss, preds), (dparams, demb) = jax.value_and_grad(
-                    loss_fn, argnums=(0, 1), has_aux=True)(local, emb_all)
+                (loss, (preds, x_all)), (dparams, demb) = \
+                    jax.value_and_grad(
+                        loss_fn, argnums=(0, 1), has_aux=True)(
+                        local, emb_all)
                 dexp = None
             # the pull lives on stage 0 — every other device's demb is
             # zero; the psum hands stage 0's cotangent to all so the
@@ -613,6 +688,9 @@ class CtrPipelineRunner:
             # its section; nothing to allreduce across stages)
             updates, local_opt = opt.update(dparams, local_opt, local)
             local = optax.apply_updates(local, updates)
+            if use_dn:
+                local = dn_summary_apply(local, x_all, dn_decay, dn_sd,
+                                         dp_axis)
             # single-chip push semantics over all M micro-batches at once
             ins = batch["segments"] // num_slots          # [M, K]
             m_off = (jnp.arange(M, dtype=ins.dtype) * mb)[:, None]
@@ -659,7 +737,8 @@ class CtrPipelineRunner:
                 emb_all = pull_sparse(slab, ids_flat, layout).reshape(
                     M, K_e, -1)
                 exp_all = None
-            return jax.nn.sigmoid(pipe(local, emb_all, exp_all, batch))
+            logits, _x = pipe(local, emb_all, exp_all, batch)
+            return jax.nn.sigmoid(logits)
 
         spec_sh = P(self.axis)
         opt_spec = jax.tree.map(
@@ -773,9 +852,12 @@ class ShardedCtrPipelineRunner:
                  lr: float = 1e-2, n_micro: Optional[int] = None,
                  use_cvm: bool = True, mesh: Optional[Mesh] = None,
                  bucket_cap: Optional[int] = None, seed: int = 0,
-                 fleet=None, store_factory=None, task_names=("ctr",)):
-        """task_names: >1 grows the head to T logits per instance
-        (multi-task through the pipeline, see CtrPipelineRunner).
+                 fleet=None, store_factory=None, task_names=("ctr",),
+                 use_data_norm: bool = False, dn_slot_dim: int = 0,
+                 dn_decay: float = 0.9999999):
+        """task_names: >1 grows the head to T logits per instance;
+        use_data_norm: streaming input normalization (see
+        CtrPipelineRunner for both).
 
         fleet: REQUIRED in a multi-process job — unions feed-pass keys
         and equalizes the per-process step-group counts. Multi-process
@@ -793,6 +875,9 @@ class ShardedCtrPipelineRunner:
         from paddlebox_tpu.parallel.sharded_table import ShardedPassTable
         self.task_names = tuple(task_names)
         self.multi_task = len(self.task_names) > 1
+        self.use_data_norm = use_data_norm
+        self.dn_slot_dim = dn_slot_dim
+        self.dn_decay = dn_decay
         self.table_cfg = table_cfg
         self.feed = feed
         self.num_slots = len(feed.used_sparse_slots())
@@ -863,7 +948,8 @@ class ShardedCtrPipelineRunner:
         pooled_dim = self.num_slots * (slot_dim + table_cfg.expand_embed_dim)
         host_params = ctr_stage_host_params(
             seed, n_stages, layers_per_stage, pooled_dim, d_model,
-            n_tasks=len(self.task_names))
+            n_tasks=len(self.task_names),
+            use_data_norm=self.use_data_norm)
         sh = NamedSharding(mesh, P(self.axis))
 
         def put_stage(v):
@@ -917,8 +1003,12 @@ class ShardedCtrPipelineRunner:
                 return jnp.concatenate([b, x], axis=1)
             return pull_sparse(slab, req.reshape(-1), layout)
 
-        blocks, embed_section, head = ctr_pipeline_sections(
-            mb, num_slots, use_cvm, E)
+        blocks, embed_section, head, proj_input_all = ctr_pipeline_sections(
+            mb, num_slots, use_cvm, E,
+            use_data_norm=self.use_data_norm,
+            dn_slot_dim=self.dn_slot_dim)
+        use_dn, dn_decay, dn_sd = (self.use_data_norm, self.dn_decay,
+                                   self.dn_slot_dim)
         pipe_run = _spmd_pipeline(blocks, S, M, axis,
                                   ingest=embed_section, emit=head)
 
@@ -961,19 +1051,24 @@ class ShardedCtrPipelineRunner:
                            if len(task_names) > 1 else None)
 
             def loss_fn(p, emb_all, exp_all=None):
-                logits = pipe_run(p, (emb_all, exp_all, segments,
-                                      key_valid))
-                return ctr_pipeline_loss(logits, labels, ins_valid,
-                                         task_labels, task_names)
+                x_all = proj_input_all(emb_all, exp_all, segments,
+                                       key_valid)
+                logits = pipe_run(p, x_all)
+                loss, preds = ctr_pipeline_loss(logits, labels, ins_valid,
+                                                task_labels, task_names)
+                return loss, (preds, x_all)
 
             if E:
-                (loss, preds), (dparams, demb, dexp) = jax.value_and_grad(
-                    loss_fn, argnums=(0, 1, 2), has_aux=True)(
-                    local, emb_all, exp_all)
+                (loss, (preds, x_all)), (dparams, demb, dexp) = \
+                    jax.value_and_grad(
+                        loss_fn, argnums=(0, 1, 2), has_aux=True)(
+                        local, emb_all, exp_all)
                 dexp = jax.lax.psum(dexp, axis)
             else:
-                (loss, preds), (dparams, demb) = jax.value_and_grad(
-                    loss_fn, argnums=(0, 1), has_aux=True)(local, emb_all)
+                (loss, (preds, x_all)), (dparams, demb) = \
+                    jax.value_and_grad(
+                        loss_fn, argnums=(0, 1), has_aux=True)(
+                        local, emb_all)
                 dexp = None
             # stage 0 owns the pull — psum hands its cotangent to all
             demb = jax.lax.psum(demb, axis)
@@ -982,6 +1077,9 @@ class ShardedCtrPipelineRunner:
                 loss = jax.lax.pmean(loss, dp_axis)
             updates, local_opt = opt.update(dparams, local_opt, local)
             local = optax.apply_updates(local, updates)
+            if use_dn:
+                local = dn_summary_apply(local, x_all, dn_decay, dn_sd,
+                                         dp_axis)
 
             # ---- push: MY micro slice of the cotangent goes back through
             # the reverse a2a into the shard-side merge + in-table update
@@ -1057,8 +1155,8 @@ class ShardedCtrPipelineRunner:
                                           tiled=True)
             key_valid = jax.lax.all_gather(batch["valid"], axis,
                                            tiled=True)
-            return jax.nn.sigmoid(
-                pipe_run(local, (emb_all, exp_all, segments, key_valid)))
+            x_all = proj_input_all(emb_all, exp_all, segments, key_valid)
+            return jax.nn.sigmoid(pipe_run(local, x_all))
 
         spec_stage = P(self.axis)
         spec_flat = P(self.flat_axes)

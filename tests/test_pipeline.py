@@ -564,6 +564,102 @@ def test_ctr_pipeline_multi_task(tmp_path):
     assert msg["size"] > 0      # the cvr column streamed
 
 
+def test_ctr_pipeline_data_norm(tmp_path):
+    """data_norm through the pipeline: stage 0 normalizes its projection
+    input by the running summaries, which update by the running-sums
+    rule (never the optimizer). One step matches the hand-computed rule;
+    the sharded runner matches the replicated one with dn on."""
+    import jax.numpy as jnp
+    from paddlebox_tpu.data import BoxDataset
+    from paddlebox_tpu.ops.data_norm import (DataNormState, data_norm,
+                                             data_norm_summary_update)
+    from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
+    from paddlebox_tpu.ops.sparse import pull_sparse
+    from paddlebox_tpu.parallel.pipeline import (CtrPipelineRunner,
+                                                 ShardedCtrPipelineRunner)
+
+    files, feed = _ctr_setup(tmp_path, n_files=1, lines=128, mb=16)
+    table_cfg = _ctr_table()
+    S, M = 4, 4
+    r = CtrPipelineRunner(table_cfg, feed, n_stages=S, d_model=24,
+                          layers_per_stage=1, lr=1e-2, n_micro=M, seed=3,
+                          use_data_norm=True)
+    assert r.params["dn_size"].shape[0] == S
+    ds = BoxDataset(feed, read_threads=1)
+    ds.set_filelist(files)
+    r.table.begin_feed_pass()
+    ds.load_into_memory(add_keys_fn=r.table.add_keys)
+    r.table.end_feed_pass()
+    r.table.begin_pass()
+    slab0 = np.asarray(r.table.slab)
+    batches = ds.split_batches(num_workers=1)[0][:M]
+    batch = jax.tree.map(np.asarray, r.device_batch(batches))
+    key_valid = batch["ids"] != r.table.padding_id
+    dn0 = DataNormState(jnp.asarray(r.params["dn_size"][0]),
+                        jnp.asarray(r.params["dn_sum"][0]),
+                        jnp.asarray(r.params["dn_sqsum"][0]))
+
+    loss_pipe = r.train_step(batches)
+
+    # hand-computed oracle: assemble all M micros' proj inputs, apply
+    # the running-sums rule to the INITIAL state (the step normalizes
+    # with the pre-update summaries and updates after)
+    layout = r.layout
+    K = batch["ids"].shape[-1]
+    emb_all = pull_sparse(jnp.asarray(slab0),
+                          jnp.asarray(batch["ids"].reshape(-1)),
+                          layout).reshape(M, K, -1)
+    xs = []
+    for t in range(M):
+        pooled = fused_seqpool_cvm(
+            emb_all[t], jnp.asarray(batch["segments"][t]),
+            jnp.asarray(key_valid[t]), 16, r.num_slots, True,
+            sorted_segments=True)
+        xs.append(pooled.reshape(16, -1))
+    x_all = jnp.concatenate(xs, axis=0)
+    want = data_norm_summary_update(dn0, x_all, decay=r.dn_decay)
+    np.testing.assert_allclose(np.asarray(r.params["dn_size"][0]),
+                               np.asarray(want.batch_size), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(r.params["dn_sum"][0]),
+                               np.asarray(want.batch_sum), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(r.params["dn_sqsum"][0]),
+                               np.asarray(want.batch_square_sum),
+                               rtol=1e-5, atol=1e-6)
+    # the forward actually normalizes: shifting the running mean (a
+    # poisoned dn_sum) must move the predictions — a deterministic probe
+    # of the normalization being INSIDE the compiled program (loss-level
+    # A/B at near-init weights sits below f32 resolution)
+    dev_batch = r.device_batch(batches)
+    ev_norm = np.asarray(r._eval(r.params, r.table.slab, dev_batch))
+    poisoned = dict(r.params,
+                    dn_sum=jnp.full_like(r.params["dn_sum"], 1e5))
+    ev_poison = np.asarray(r._eval(poisoned, r.table.slab, dev_batch))
+    assert np.abs(ev_norm - ev_poison).max() > 1e-5
+    assert np.isfinite(loss_pipe)
+    ds.release_memory()
+
+    # replicated vs sharded parity with dn on, over a full pass
+    rep = CtrPipelineRunner(table_cfg, feed, n_stages=S, d_model=24,
+                            layers_per_stage=1, lr=1e-2, n_micro=M,
+                            seed=5, use_data_norm=True)
+    shd = ShardedCtrPipelineRunner(table_cfg, feed, n_stages=S, d_model=24,
+                                   layers_per_stage=1, lr=1e-2, n_micro=M,
+                                   seed=5, use_data_norm=True)
+    stats = []
+    for rr in (rep, shd):
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        stats.append(rr.train_pass(ds))
+        ds.release_memory()
+    np.testing.assert_allclose(stats[1]["loss"], stats[0]["loss"],
+                               rtol=1e-5)
+    for k in ("dn_size", "dn_sum", "dn_sqsum"):
+        np.testing.assert_allclose(np.asarray(shd.params[k]),
+                                   np.asarray(rep.params[k]),
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+
+
 def test_sharded_ctr_pipeline_matches_replicated(tmp_path):
     """Pipeline × sharded-table composition (the round-3 verdict's one
     remaining partial): the key-mod-sharded slab behind the SAME pipeline
