@@ -7,6 +7,9 @@ from paddlebox_tpu.models.mmoe import MMoE
 from paddlebox_tpu.models.esmm import ESMM
 from paddlebox_tpu.models.join_pv import JoinPvDnn
 from paddlebox_tpu.models.nn_cross import CtrDnnExpand
+from paddlebox_tpu.models.aux_input import CtrDnnAux
+from paddlebox_tpu.models.bst import BstSeqCtr
+from paddlebox_tpu.models.wide_tower import EpMMoE, TpDeepFM
 
 MODEL_ZOO = {
     "ctr_dnn": CtrDnn,
@@ -17,8 +20,13 @@ MODEL_ZOO = {
     "esmm": ESMM,
     "join_pv_dnn": JoinPvDnn,
     "ctr_dnn_expand": CtrDnnExpand,
+    "ctr_dnn_aux": CtrDnnAux,
+    "bst_seq_ctr": BstSeqCtr,
+    "tp_deepfm": TpDeepFM,
+    "ep_mmoe": EpMMoE,
 }
 
 __all__ = ["mlp_init", "mlp_apply", "CtrDnn", "DeepFM", "WideDeep", "DLRM",
            "MMoE", "ESMM", "JoinPvDnn", "CtrDnnExpand",
+           "CtrDnnAux", "BstSeqCtr", "TpDeepFM", "EpMMoE",
            "MODEL_ZOO"]

@@ -25,8 +25,11 @@ import numpy as np
 
 from paddlebox_tpu.models.base import ModelSpec
 from paddlebox_tpu.models.layers import mlp_apply, mlp_init
-from paddlebox_tpu.parallel.ring_attention import (ring_attention,
-                                                   ulysses_attention)
+
+# NOTE: the ring/ulysses primitives import lazily inside
+# seq_feature_local — a top-level import would cycle through
+# parallel/__init__ → sharded_trainer → train.trainer → models/__init__
+# → this module.
 
 
 class BstSeqCtr:
@@ -89,6 +92,8 @@ class BstSeqCtr:
         emb_chunk: [B, T/P, Din] pulled history embeddings (local chunk);
         valid_chunk: [B, T/P] bool. Masked positions attend as zeros and
         are excluded from the mean pool."""
+        from paddlebox_tpu.parallel.ring_attention import (
+            ring_attention, ulysses_attention)
         B, Tl, Din = emb_chunk.shape
         H, Dh = self.heads, self.d_head
         idx = jax.lax.axis_index(axis)

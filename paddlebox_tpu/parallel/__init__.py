@@ -1,16 +1,61 @@
-from paddlebox_tpu.parallel.mesh import make_mesh, device_mesh_1d
-from paddlebox_tpu.parallel.pipeline import (GPipeRunner, PipelineConfig,
-                                             mlp_stage_apply)
-from paddlebox_tpu.parallel.sharded_table import ShardedPassTable, ShardedBatchIndex
-from paddlebox_tpu.parallel.sharded_trainer import ShardedBoxTrainer
+"""Mesh/parallelism package.
 
-__all__ = [
-    "make_mesh",
-    "device_mesh_1d",
-    "GPipeRunner",
-    "PipelineConfig",
-    "mlp_stage_apply",
-    "ShardedPassTable",
-    "ShardedBatchIndex",
-    "ShardedBoxTrainer",
-]
+Exports resolve LAZILY (PEP 562): model-zoo leaf modules (models/bst.py,
+models/wide_tower.py) import `paddlebox_tpu.parallel.*` primitives, and an
+eager package __init__ would cycle back through sharded_trainer →
+train.trainer → models/__init__ → those same leaves.
+"""
+
+_EXPORTS = {
+    "make_mesh": ("paddlebox_tpu.parallel.mesh", "make_mesh"),
+    "device_mesh_1d": ("paddlebox_tpu.parallel.mesh", "device_mesh_1d"),
+    "device_mesh_2d": ("paddlebox_tpu.parallel.mesh", "device_mesh_2d"),
+    "GPipeRunner": ("paddlebox_tpu.parallel.pipeline", "GPipeRunner"),
+    "PipelineConfig": ("paddlebox_tpu.parallel.pipeline", "PipelineConfig"),
+    "mlp_stage_apply": ("paddlebox_tpu.parallel.pipeline",
+                        "mlp_stage_apply"),
+    "CtrPipelineRunner": ("paddlebox_tpu.parallel.pipeline",
+                          "CtrPipelineRunner"),
+    "ShardedCtrPipelineRunner": ("paddlebox_tpu.parallel.pipeline",
+                                 "ShardedCtrPipelineRunner"),
+    "ShardedPassTable": ("paddlebox_tpu.parallel.sharded_table",
+                         "ShardedPassTable"),
+    "ShardedBatchIndex": ("paddlebox_tpu.parallel.sharded_table",
+                          "ShardedBatchIndex"),
+    "ShardedBoxTrainer": ("paddlebox_tpu.parallel.sharded_trainer",
+                          "ShardedBoxTrainer"),
+    "MeshTowerTrainer": ("paddlebox_tpu.parallel.mesh_tower",
+                         "MeshTowerTrainer"),
+    "SeqCtrTrainer": ("paddlebox_tpu.parallel.seq_trainer",
+                      "SeqCtrTrainer"),
+    "ring_attention": ("paddlebox_tpu.parallel.ring_attention",
+                       "ring_attention"),
+    "ulysses_attention": ("paddlebox_tpu.parallel.ring_attention",
+                          "ulysses_attention"),
+    "tp_mlp_apply": ("paddlebox_tpu.parallel.tensor_parallel",
+                     "tp_mlp_apply"),
+    "tp_loss_scale": ("paddlebox_tpu.parallel.tensor_parallel",
+                      "tp_loss_scale"),
+    "tp_fix_grads": ("paddlebox_tpu.parallel.tensor_parallel",
+                     "tp_fix_grads"),
+    "ep_experts_apply": ("paddlebox_tpu.parallel.tensor_parallel",
+                         "ep_experts_apply"),
+    "ep_gate_psum": ("paddlebox_tpu.parallel.tensor_parallel",
+                     "ep_gate_psum"),
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+    return getattr(importlib.import_module(mod_name), attr)
+
+
+def __dir__():
+    return __all__
