@@ -262,11 +262,13 @@ def _grouped_train_pass(runner, dataset, begin_pass, end_pass,
 
 def _feed_pipeline_metrics(runner, preds, packed_batches) -> None:
     """Stream one step group's predictions into the runner's registry
-    (host path — the Metric::add_data role). preds: [dp·M, mb] global
-    (dp-sharded on a 2D mesh); multi-process feeds only this process's
-    addressable rows, which align with its own packed_batches; the
-    cross-process reduction stays in get_metric_msg's allreduce hook."""
-    if not runner.metrics.metric_names():
+    (host path — the Metric::add_data role) and its DumpField writer.
+    preds: [dp·M, mb] global (dp-sharded on a 2D mesh); multi-process
+    feeds only this process's addressable rows, which align with its own
+    packed_batches; the cross-process reduction stays in get_metric_msg's
+    allreduce hook."""
+    dump = getattr(runner, "dump_writer", None)
+    if not runner.metrics.metric_names() and dump is None:
         return
     if getattr(runner, "multiprocess", False):
         # preds is dp-sharded but STAGE-REPLICATED: addressable_shards
@@ -280,10 +282,24 @@ def _feed_pipeline_metrics(runner, preds, packed_batches) -> None:
         arr = np.concatenate([by_start[s] for s in sorted(by_start)])
     else:
         arr = np.asarray(preds)
+    names = getattr(runner, "task_names", ("ctr",))
+    if dump is not None:
+        # one DumpField line per real instance (this process's rows)
+        from paddlebox_tpu.train.dump import build_dump_tensors
+        rows = arr.reshape((len(packed_batches), -1) + arr.shape[2:])
+        for j, b in enumerate(packed_batches):
+            per_task = ({t: rows[j][..., ti]
+                         for ti, t in enumerate(names)}
+                        if len(names) > 1 else {names[0]: rows[j]})
+            tens = build_dump_tensors(runner.dump_fields, b.labels,
+                                      per_task, names[0])
+            if tens:
+                dump.dump_batch(tens, ins_ids=b.ins_ids, mask=b.ins_valid)
+    if not runner.metrics.metric_names():
+        return
     labels = np.concatenate([b.labels for b in packed_batches])
     mask = np.concatenate([b.ins_valid for b in packed_batches])
     tensors = {"label": labels, "mask": mask}
-    names = getattr(runner, "task_names", ("ctr",))
     if len(names) > 1:
         # per-task prediction/label columns (metrics.h MultiTask naming)
         for ti, t in enumerate(names):
@@ -339,6 +355,18 @@ def _pipeline_predict(runner, dataset, begin_pass, end_pass, slab_of):
     if not preds_all:
         return np.empty(0, np.float32), np.empty(0, np.int32)
     return np.concatenate(preds_all), np.concatenate(labels_all)
+
+
+def _make_dump_writer(dump_fields, dump_fields_path, dump_thread_num):
+    """DumpField writers for the pipeline runners (boxps_worker.cc
+    DumpField): rank-tagged so multi-process dumps stay distinguishable;
+    (fields, writer) — writer None unless both fields and path are set."""
+    fields = tuple(dump_fields or ())
+    if not (fields and dump_fields_path):
+        return fields, None
+    from paddlebox_tpu.train.dump import DumpWriter
+    return fields, DumpWriter(dump_fields_path, dump_thread_num,
+                              rank=jax.process_index())
 
 
 def _task_label_of(b, t):
@@ -517,7 +545,9 @@ class CtrPipelineRunner:
                  use_cvm: bool = True, mesh: Optional[Mesh] = None,
                  seed: int = 0, task_names=("ctr",),
                  use_data_norm: bool = False, dn_slot_dim: int = 0,
-                 dn_decay: float = 0.9999999):
+                 dn_decay: float = 0.9999999, dump_fields=None,
+                 dump_fields_path: Optional[str] = None,
+                 dump_thread_num: int = 1):
         """task_names: >1 entries grow the last stage's head to T logits
         per instance trained on per-task labels (feed.task_label_slots;
         absent tasks fall back to the click label) — ESMM/MMoE-style
@@ -533,6 +563,8 @@ class CtrPipelineRunner:
         self.use_data_norm = use_data_norm
         self.dn_slot_dim = dn_slot_dim
         self.dn_decay = dn_decay
+        self.dump_fields, self.dump_writer = _make_dump_writer(
+            dump_fields, dump_fields_path, dump_thread_num)
         self.table = PassTable(table_cfg, seed=seed)
         self.table_cfg = table_cfg
         self.feed = feed
@@ -810,6 +842,18 @@ class CtrPipelineRunner:
                                  self.table.end_pass,
                                  lambda: self.table.slab)
 
+    def close(self) -> None:
+        """Flush and stop the dump writers."""
+        if self.dump_writer is not None:
+            self.dump_writer.close()
+            self.dump_writer = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
     def train_pass(self, dataset) -> Dict[str, float]:
         """BoxPS pass cadence around the pipelined step (the shared
         _grouped_train_pass driver)."""
@@ -854,7 +898,9 @@ class ShardedCtrPipelineRunner:
                  bucket_cap: Optional[int] = None, seed: int = 0,
                  fleet=None, store_factory=None, task_names=("ctr",),
                  use_data_norm: bool = False, dn_slot_dim: int = 0,
-                 dn_decay: float = 0.9999999):
+                 dn_decay: float = 0.9999999, dump_fields=None,
+                 dump_fields_path: Optional[str] = None,
+                 dump_thread_num: int = 1):
         """task_names: >1 grows the head to T logits per instance;
         use_data_norm: streaming input normalization (see
         CtrPipelineRunner for both).
@@ -878,6 +924,8 @@ class ShardedCtrPipelineRunner:
         self.use_data_norm = use_data_norm
         self.dn_slot_dim = dn_slot_dim
         self.dn_decay = dn_decay
+        self.dump_fields, self.dump_writer = _make_dump_writer(
+            dump_fields, dump_fields_path, dump_thread_num)
         self.table_cfg = table_cfg
         self.feed = feed
         self.num_slots = len(feed.used_sparse_slots())
@@ -1271,6 +1319,18 @@ class ShardedCtrPipelineRunner:
         """Test-mode inference over the sharded slabs (single process)."""
         return _pipeline_predict(self, dataset, self.begin_pass,
                                  self.end_pass, lambda: self._slabs)
+
+    def close(self) -> None:
+        """Flush and stop the dump writers."""
+        if self.dump_writer is not None:
+            self.dump_writer.close()
+            self.dump_writer = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def train_pass(self, dataset) -> Dict[str, float]:
         """Pass cadence with the sharded table (the shared

@@ -873,6 +873,35 @@ def test_sharded_pipeline_day_cadence(tmp_path):
     assert np.abs(rows).sum() > 0
 
 
+def test_pipeline_dump_fields(tmp_path):
+    """DumpField through the pipeline runners: one line per real instance
+    covered by a full micro-batch group, rank-tagged files."""
+    import os
+    from paddlebox_tpu.data import BoxDataset
+    from paddlebox_tpu.parallel.pipeline import (CtrPipelineRunner,
+                                                 ShardedCtrPipelineRunner)
+
+    files, feed = _ctr_setup(tmp_path, n_files=1, lines=192, mb=16)
+    for cls in (CtrPipelineRunner, ShardedCtrPipelineRunner):
+        dump_dir = str(tmp_path / f"dump_{cls.__name__}")
+        r = cls(_ctr_table(), feed, n_stages=4, d_model=24,
+                layers_per_stage=1, lr=1e-2, n_micro=4, seed=0,
+                dump_fields=("pred", "label"), dump_fields_path=dump_dir)
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        stats = r.train_pass(ds)
+        r.close()
+        assert r.dump_writer is None
+        lines = []
+        for f in os.listdir(dump_dir):
+            lines += [l for l in open(os.path.join(dump_dir, f))
+                      if l.strip()]
+        covered = stats["steps"] * r.batches_per_step * feed.batch_size
+        assert len(lines) == covered > 0, (cls.__name__, len(lines))
+        assert all("pred:" in l and "label:" in l for l in lines)
+        ds.release_memory()
+
+
 def test_ctr_pipeline_dp_learns(tmp_path):
     """dp × pipeline end to end: loss descends over passes with the
     combined push keeping the replicated slab consistent."""
