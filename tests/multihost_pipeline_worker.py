@@ -43,6 +43,18 @@ def main() -> None:
     n_devs = len(jax.devices())
     S = n_devs // world
 
+    # GPUPS variant: shard stores front ONE central CPU PS over TCP
+    # (sections over the distributed PS at real process boundaries)
+    ps_client = None
+    store_factory = None
+    if cfg.get("ps_endpoint"):
+        from paddlebox_tpu.embedding.ps_store import ps_store_factory
+        from paddlebox_tpu.ps import TcpPSClient
+        host, port = cfg["ps_endpoint"].rsplit(":", 1)
+        ps_client = TcpPSClient(host, int(port))
+        store_factory = ps_store_factory(ps_client, cfg["ps_table_id"],
+                                         process_primary=(rank == 0))
+
     nf = len(cfg["files"]) // world
     files = cfg["files"][rank * nf:(rank + 1) * nf]
     D = cfg["embedx_dim"]
@@ -61,7 +73,8 @@ def main() -> None:
                 ("dp", STAGE_AXIS))
     runner = ShardedCtrPipelineRunner(
         table_cfg, feed, n_stages=S, d_model=24, layers_per_stage=1,
-        lr=1e-2, n_micro=cfg["n_micro"], mesh=mesh, seed=0, fleet=fleet)
+        lr=1e-2, n_micro=cfg["n_micro"], mesh=mesh, seed=0, fleet=fleet,
+        store_factory=store_factory)
     assert runner.multiprocess and runner.local_rows == [rank]
 
     losses, steps = [], 0
@@ -74,12 +87,15 @@ def main() -> None:
         ds.release_memory()
 
     rows = {}
-    for s in runner.local_positions:
-        st = runner.table.stores[s]
-        keys, vals = st.state_items()
-        order = np.argsort(keys)
-        for k, v in zip(keys[order[:3]], vals[order[:3]]):
-            rows[str(int(k))] = [round(float(x), 6) for x in v]
+    if ps_client is None:
+        for s in runner.local_positions:
+            st = runner.table.stores[s]
+            keys, vals = st.state_items()
+            order = np.argsort(keys)
+            for k, v in zip(keys[order[:3]], vals[order[:3]]):
+                rows[str(int(k))] = [round(float(x), 6) for x in v]
+    ps_rows = (int(ps_client.sparse_size(cfg["ps_table_id"]))
+               if ps_client is not None else None)
     # first stage block of this process's dp replica (replicated over dp
     # — every rank must report identical values; the global array is not
     # fully addressable, so read the lowest addressable stage shard)
@@ -92,7 +108,10 @@ def main() -> None:
     print("RESULT " + json.dumps({
         "rank": rank, "losses": losses, "steps": steps, "rows": rows,
         "blk_head": [round(float(x), 6) for x in blk],
+        "ps_rows": ps_rows,
     }), flush=True)
+    if ps_client is not None:
+        ps_client.close()
     fleet.stop()
 
 

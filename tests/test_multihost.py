@@ -309,7 +309,20 @@ def test_two_process_device_auc_matches_host(data, oracle):
                                rtol=1e-6)
 
 
-def test_two_process_sharded_pipeline(data):
+PIPE_N_MICRO = 4
+
+
+@pytest.fixture(scope="module")
+def pipeline_cluster(data):
+    """ONE local-store 2-process pipeline cluster run shared by the
+    pipeline cluster tests (the `oracle` fixture pattern)."""
+    files, _feed = data
+    return run_cluster(files, {"n_micro": PIPE_N_MICRO}, world=2,
+                       devs_per_proc=4,
+                       worker_script="multihost_pipeline_worker.py")
+
+
+def test_two_process_sharded_pipeline(data, pipeline_cluster):
     """Pipeline parallelism at a REAL process boundary: a (dp=2, stage=4)
     mesh where each process owns one pipeline row and the pass table
     key-mod-shards over all 8 devices — every pull/push a2a crosses the
@@ -321,10 +334,8 @@ def test_two_process_sharded_pipeline(data):
                                                  ShardedCtrPipelineRunner)
 
     files, feed = data
-    N_MICRO = 4
-    results = run_cluster(files, {"n_micro": N_MICRO},
-                          world=2, devs_per_proc=4,
-                          worker_script="multihost_pipeline_worker.py")
+    N_MICRO = PIPE_N_MICRO
+    results = pipeline_cluster
     assert set(results) == {0, 1}
     np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
                                rtol=1e-6)
@@ -385,6 +396,55 @@ def test_two_process_sharded_pipeline(data):
                                        err_msg=f"key {k_str}")
             checked += 1
     assert checked >= 4
+
+
+def test_two_process_pipeline_over_central_ps(data, pipeline_cluster):
+    """The deepest composition: pipeline parallelism at 2 real process
+    boundaries with every shard store fronting ONE central CPU PS over
+    TCP — section programs over the distributed PS across the cluster.
+    Losses must agree across ranks and match the local-store 2-process
+    pipeline run (parity holds because embed-row init is all-zeros:
+    SparseOptimizerConfig.initial_range defaults to 0.0 — with a nonzero
+    initial_range the two ranks' interleaved pulls would create keys in
+    nondeterministic order and draw different init values than the
+    local-store run); features must exist server-side afterwards."""
+    from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
+                                              TableConfig)
+    from paddlebox_tpu.ps import PSServer, TcpPSClient
+
+    files, feed = data
+    N_MICRO = PIPE_N_MICRO
+    # local-store reference cluster (already parity-pinned to the
+    # single-process composition by test_two_process_sharded_pipeline)
+    ref = pipeline_cluster
+
+    server = PSServer()
+    admin = TcpPSClient("127.0.0.1", server.port)
+    table_cfg = TableConfig(
+        embedx_dim=D, pass_capacity=8 * 1024,
+        optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                        mf_initial_range=1e-3,
+                                        feature_learning_rate=0.1,
+                                        mf_learning_rate=0.1))
+    try:
+        admin.create_sparse_table(11, table_cfg, shard_num=8, seed=0)
+        results = run_cluster(
+            files, {"n_micro": N_MICRO,
+                    "ps_endpoint": "127.0.0.1:%d" % server.port,
+                    "ps_table_id": 11},
+            world=2, devs_per_proc=4,
+            worker_script="multihost_pipeline_worker.py")
+        assert set(results) == {0, 1}
+        np.testing.assert_allclose(results[0]["losses"],
+                                   results[1]["losses"], rtol=1e-6)
+        np.testing.assert_allclose(results[0]["losses"],
+                                   ref[0]["losses"], rtol=1e-4,
+                                   err_msg="GPUPS pipeline cluster "
+                                           "diverges from local stores")
+        assert results[0]["ps_rows"] and results[0]["ps_rows"] > 100
+    finally:
+        admin.stop_server()
+        admin.close()
 
 
 def test_four_process_hierarchical_mesh(data, oracle):
