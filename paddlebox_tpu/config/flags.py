@@ -127,6 +127,18 @@ define_flag("stream_depth", 2,
             "device_reader_->Next double-buffer role)")
 define_flag("profile_per_op", False,
             "accumulate per-op timing in the train loop (TrainFilesWithProfiler)")
+define_flag("push_write", "auto",
+            "how the push writes updated rows back into the pass slab: "
+            "'scatter' (row scatter, cost ~ touched rows — right for CPU "
+            "and small batches), 'rebuild' (host-staged pos map + full "
+            "slab gather/select, flat cost ~ slab bytes — right where "
+            "scatter is per-index expensive, e.g. the axon TPU runtime; "
+            "tools/push_ablate.py measurements), or 'auto' (rebuild on "
+            "tpu backends, scatter elsewhere)")
+define_flag("flatten_dense_opt", True,
+            "wrap the dense optimizer in optax.flatten so the whole dense "
+            "update runs as one fused vector op instead of per-parameter "
+            "op chains (elementwise optimizers only; exact same numbers)")
 define_flag("use_pallas_push", False,
             "route the in-table adagrad row update through the hand-written "
             "Pallas kernel (embedding/pallas_push.py) instead of XLA")

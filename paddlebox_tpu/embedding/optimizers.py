@@ -288,16 +288,52 @@ def push_sparse_hostdedup(slab: jnp.ndarray, uids: jnp.ndarray,
     inv_sorted: [K] nondecreasing merged-row index per permuted occurrence
     grads:      [K, push.width] per-occurrence push rows (padding all-zero)
     """
+    new_rows = _merged_new_rows(slab, uids, perm, inv_sorted, grads, prng,
+                                layout, conf)
+    # out-of-range padding ids drop; in-range ids are unique by construction
+    return slab.at[uids].set(new_rows, mode="drop", unique_indices=True)
+
+
+def _merged_new_rows(slab, uids, perm, inv_sorted, grads, prng, layout,
+                     conf) -> jnp.ndarray:
+    """Shared push prologue: occurrence gather → sorted segment-sum merge →
+    row gather → in-table optimizer. Both slab-write strategies (scatter /
+    rebuild) consume these rows — keep them in one place so merge or
+    lazy-init fixes can't diverge between the two."""
     sorted_grads = jnp.take(grads, perm, axis=0, indices_are_sorted=False,
                             unique_indices=True)
     merged = jax.ops.segment_sum(sorted_grads, inv_sorted,
                                  num_segments=uids.shape[0],
                                  indices_are_sorted=True)
     rows = jnp.take(slab, uids, axis=0, mode="clip")
-    new_rows = _dispatch_apply_push(rows, merged, prng, layout, conf,
-                                    row_ids=uids)
-    # out-of-range padding ids drop; in-range ids are unique by construction
-    return slab.at[uids].set(new_rows, mode="drop", unique_indices=True)
+    return _dispatch_apply_push(rows, merged, prng, layout, conf,
+                                row_ids=uids)
+
+
+def push_sparse_rebuild(slab: jnp.ndarray, uids: jnp.ndarray,
+                        pos: jnp.ndarray, perm: jnp.ndarray,
+                        inv_sorted: jnp.ndarray, grads: jnp.ndarray,
+                        prng: jax.Array, layout: ValueLayout,
+                        conf: SparseOptimizerConfig) -> jnp.ndarray:
+    """push_sparse_hostdedup with the final row SCATTER replaced by a
+    full-slab gather-rebuild: out[r] = new_rows[pos[r]] if pos[r] >= 0 else
+    slab[r], with pos ([capacity] int32, -1 = untouched) precomputed on the
+    host next to the dedup (PassTable.pos_for_rebuild).
+
+    Same alternative lowering, identical results; exists because scatter
+    cost scales ~linearly with index count on some backends (measured
+    ~75 ns/index + ms-scale fixed cost on the axon v5e runtime,
+    tools/push_ablate.py) while this rebuild is one gather + one select at
+    flat cost ~ slab bytes / copy bandwidth — the better trade whenever
+    touched-row count is large relative to the slab (big batches, merged
+    chunks). Reference work shape: PushSparseGradCaseGPU merge + update
+    (box_wrapper_impl.h:373-522); the write strategy is ours.
+    """
+    new_rows = _merged_new_rows(slab, uids, perm, inv_sorted, grads, prng,
+                                layout, conf)
+    sel = jnp.take(new_rows, jnp.clip(pos, 0, new_rows.shape[0] - 1),
+                   axis=0)
+    return jnp.where((pos >= 0)[:, None], sel, slab)
 
 
 def make_push_fn(layout: ValueLayout,

@@ -284,6 +284,16 @@ class PassTable:
         dedup_ids): padding ids start at this table's capacity."""
         return dedup_ids(ids, self.capacity)
 
+    def pos_for_rebuild(self, uids: np.ndarray) -> np.ndarray:
+        """[capacity] int32 inverse of the dedup's uids for the
+        push_write='rebuild' slab write: pos[r] = row index into the push's
+        new_rows for touched slab rows, -1 elsewhere. Rides the overlapped
+        host batch stage like the dedup itself."""
+        pos = np.full(self.capacity, -1, np.int32)
+        m = uids < self.capacity
+        pos[uids[m]] = np.arange(uids.shape[0], dtype=np.int32)[m]
+        return pos
+
     # ------------------------------------------------------------ pull/push
     def pull(self, ids: jnp.ndarray) -> jnp.ndarray:
         """PullSparseGPU analog: per-key pull view [K, 3+D]."""

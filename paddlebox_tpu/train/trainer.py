@@ -32,6 +32,7 @@ from paddlebox_tpu.data.dataset import BoxDataset
 from paddlebox_tpu.data.packer import PackedBatch
 from paddlebox_tpu.embedding.accessor import ValueLayout
 from paddlebox_tpu.embedding.optimizers import (push_sparse_hostdedup,
+                                                push_sparse_rebuild,
                                                 rebuild_uids)
 from paddlebox_tpu.embedding.pass_table import PassTable
 from paddlebox_tpu.metrics.auc import MetricRegistry
@@ -164,14 +165,36 @@ def check_expand_config(model, layout: ValueLayout, use_expand: bool) -> None:
             "CtrDnnExpand, or set expand_embed_dim=0)")
 
 
+def resolve_push_write() -> str:
+    """'scatter' | 'rebuild' from the push_write flag; 'auto' picks rebuild
+    on tpu backends (scatter per-index cost dominates there, measured
+    tools/push_ablate.py) and scatter elsewhere."""
+    from paddlebox_tpu.config import flags
+    mode = flags.get_flag("push_write")
+    if mode == "auto":
+        return "rebuild" if jax.default_backend() in ("tpu", "axon") \
+            else "scatter"
+    if mode not in ("scatter", "rebuild"):
+        raise ValueError(f"push_write flag: unknown mode {mode!r}")
+    return mode
+
+
 def make_dense_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
     if cfg.dense_optimizer == "adam":
-        return optax.adam(cfg.dense_lr)
-    if cfg.dense_optimizer == "sgd":
-        return optax.sgd(cfg.dense_lr)
-    if cfg.dense_optimizer == "adagrad":
-        return optax.adagrad(cfg.dense_lr)
-    raise ValueError(cfg.dense_optimizer)
+        opt = optax.adam(cfg.dense_lr)
+    elif cfg.dense_optimizer == "sgd":
+        opt = optax.sgd(cfg.dense_lr)
+    elif cfg.dense_optimizer == "adagrad":
+        opt = optax.adagrad(cfg.dense_lr)
+    else:
+        raise ValueError(cfg.dense_optimizer)
+    from paddlebox_tpu.config import flags
+    if flags.get_flag("flatten_dense_opt"):
+        # one fused update over the concatenated parameter vector instead of
+        # an op chain per parameter tensor — identical numbers (these
+        # optimizers are elementwise), fewer dispatches
+        opt = optax.flatten(opt)
+    return opt
 
 
 def _multi_task_loss(logits, labels_dict, ins_valid, loss_mode: str = "sum"):
@@ -391,8 +414,17 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
             raise KeyError(
                 "train batch lacks host dedup (perm/inv) — host_batch must "
                 "run dedup_for_push for train batches")
-        uids = rebuild_uids(batch["ids"], batch["perm"], batch["inv"],
-                            table.pass_capacity)
+        # uids ride the (overlapped) host stage when present — the on-device
+        # rebuild_uids reconstruction is a [K] scatter, which is ms-scale
+        # fixed cost on the axon runtime (tools/push_ablate.py)
+        uids = batch.get("uids")
+        if uids is None:
+            uids = rebuild_uids(batch["ids"], batch["perm"], batch["inv"],
+                                table.pass_capacity)
+        if "push_pos" in batch:
+            return push_sparse_rebuild(slab, uids, batch["push_pos"],
+                                       batch["perm"], batch["inv"],
+                                       push_grads, sub, layout, conf)
         return push_sparse_hostdedup(slab, uids, batch["perm"], batch["inv"],
                                      push_grads, sub, layout, conf)
 
@@ -504,6 +536,10 @@ class BoxTrainer:
         self.feed = feed
         self.table = PassTable(table_cfg, seed=seed)
         self.metrics = MetricRegistry()
+        # resolved once here and refreshed at pass start — never per batch,
+        # so one scan chunk can't mix rebuild and scatter host dicts (and an
+        # invalid flag value fails at construction, not in a staging thread)
+        self._push_write = resolve_push_write()
         self.dense_opt = make_dense_optimizer(self.cfg)
         rng = jax.random.PRNGKey(seed)
         self.params = model.init(rng)
@@ -611,8 +647,10 @@ class BoxTrainer:
 
     def host_batch(self, b: PackedBatch,
                    ids: np.ndarray) -> Dict[str, np.ndarray]:
-        # per-key slots/valid/uids are derived on device (make_train_step):
-        # only ids/segments/perm/inv ride the H2D path
+        # per-key slots/valid are derived on device (make_train_step);
+        # ids/segments/perm/inv/uids ride the H2D path, plus the [capacity]
+        # push_pos map in push_write=rebuild mode (the largest transfer —
+        # it buys removing the slab scatter from the step)
         out = {
             "ids": ids,
             "segments": b.segments,
@@ -620,10 +658,13 @@ class BoxTrainer:
             "labels": b.labels,
         }
         if not self.table.test_mode:
-            # train batches carry the host-precomputed push dedup; eval
+            # train batches carry the host-precomputed push dedup (uids
+            # included: rebuilding them on device is a scatter); eval
             # batches never push, so skip the dedup + extra transfers
-            _uids, perm, inv = self.table.dedup_for_push(ids)
-            out.update(perm=perm, inv=inv)
+            uids, perm, inv = self.table.dedup_for_push(ids)
+            out.update(perm=perm, inv=inv, uids=uids)
+            if self._push_write == "rebuild":
+                out["push_pos"] = self.table.pos_for_rebuild(uids)
         if b.dense is not None:
             out["dense"] = b.dense
         if b.rank_offset is not None:
@@ -663,6 +704,9 @@ class BoxTrainer:
             return self.train_pass_profiled(dataset)
         t_pass = self.timers["pass"]
         t_pass.start()
+        # live set_flag takes effect at pass boundaries only (mid-pass flips
+        # would mix rebuild/scatter host dicts inside one scan chunk)
+        self._push_write = resolve_push_write()
         if not preloaded:
             self.table.begin_feed_pass()
             dataset.load_into_memory(add_keys_fn=self.table.add_keys)

@@ -139,3 +139,25 @@ def test_delta_save_covers_touched_keys(data, tmp_path):
     with open(f"{xbox_dir2}/embedding.pkl", "rb") as f:
         blob2 = pickle.load(f)
     assert blob2["keys"].size < blob["keys"].size
+
+
+def test_push_write_rebuild_matches_scatter(data):
+    """push_write='rebuild' (gather-rebuild slab write; the TPU-side
+    default via 'auto') must train bit-identically to the scatter path —
+    whole pass, real feed, host dedup + pos staged per batch."""
+    from paddlebox_tpu.config import flags
+    files, feed = data
+    slabs = {}
+    for mode in ("scatter", "rebuild"):
+        flags.set_flag("push_write", mode)
+        try:
+            trainer = make_trainer(feed, seed=9)
+            ds = BoxDataset(feed, read_threads=1)
+            ds.set_filelist(files[:1])
+            trainer.train_pass(ds)
+            keys = np.sort(trainer.table._pass_keys)
+            slabs[mode] = (keys, trainer.table.store.lookup(keys).copy())
+        finally:
+            flags.set_flag("push_write", "auto")
+    np.testing.assert_array_equal(slabs["scatter"][0], slabs["rebuild"][0])
+    np.testing.assert_array_equal(slabs["scatter"][1], slabs["rebuild"][1])

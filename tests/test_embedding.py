@@ -241,6 +241,16 @@ def test_hostdedup_push_matches_device_dedup(init_range):
                                  jnp.asarray(inv), jnp.asarray(grads), prng,
                                  pt.layout, table.optimizer)
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(got2))
+    # push_write='rebuild' (gather-rebuild slab write, no scatter) must be
+    # bit-identical too — pos comes from the host next to the dedup
+    from paddlebox_tpu.embedding.optimizers import push_sparse_rebuild
+    pos = pt.pos_for_rebuild(uids)
+    assert (pos >= 0).sum() == np.unique(ids).shape[0]
+    got3 = push_sparse_rebuild(slab0, jnp.asarray(uids), jnp.asarray(pos),
+                               jnp.asarray(perm), jnp.asarray(inv),
+                               jnp.asarray(grads), prng,
+                               pt.layout, table.optimizer)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got3))
     pt.end_pass()
 
 

@@ -1,0 +1,109 @@
+"""How to append rows cheaply on this backend + H2D bandwidth.
+
+overlay_probe.py: dus of 131k rows into a 71MB buffer costs 4.1 ms (the
+runtime copies the output buffer; no in-place aliasing). Folding scatters
+amortize only with LARGE windows, which need a cheap append. Candidates:
+(a) dus into buffers of growing size (does cost scale with buffer?),
+(b) lax.scan's native ys stacking (loop machinery writes slices itself),
+(c) donated-arg dus at top jit level (explicit donation may alias).
+Plus: H2D throughput for the uids-from-host decision.
+
+Usage: timeout 900 python -u tools/append_probe.py [platform]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms",
+                  sys.argv[1] if len(sys.argv) > 1 else "axon")
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+K = 131072
+W = 17
+REPS = 5
+
+
+def main():
+    dev = jax.devices()[0]
+    print(json.dumps({"device": str(dev), "platform": dev.platform}),
+          flush=True)
+    rng = np.random.RandomState(0)
+    rows = jnp.asarray(rng.rand(K, W).astype(np.float32))
+
+    # (a) dus chained inside fori, buffer sizes 8K..64K rows worth
+    for mult in (8, 16, 32, 64):
+        buf = jnp.zeros((mult * K, W), jnp.float32)
+        iters = 16
+
+        def run(b, r):
+            def step(i, c):
+                return lax.dynamic_update_slice(
+                    c, r + c[:1, :1] * 0, ((i * K) % ((mult - 1) * K), 0))
+            return lax.fori_loop(0, iters, step, b)
+        f = jax.jit(run)
+        out = f(buf, rows); np.asarray(out.ravel()[:1])
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            out = f(out, rows)
+            np.asarray(out.ravel()[:1])
+        ms = (time.perf_counter() - t0) / REPS / iters * 1e3
+        mb = mult * K * W * 4 // (1 << 20)
+        print(json.dumps({"op": f"dus_into_{mb}MB_buffer",
+                          "ms_per_call": round(ms, 4)}), flush=True)
+
+    # (b) scan ys stacking: 16 iterations each emitting [K, W]
+    def scan_ys(x):
+        def step(c, _):
+            c = c * 1.000001
+            return c, c
+        return lax.scan(step, x, None, length=16)
+    f = jax.jit(scan_ys)
+    c, ys = f(rows); np.asarray(ys.ravel()[:1])
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        c, ys = f(c)
+        np.asarray(ys.ravel()[:1])
+    ms = (time.perf_counter() - t0) / REPS / 16 * 1e3
+    print(json.dumps({"op": "scan_ys_append_131k_rows_x16",
+                      "ms_per_call": round(ms, 4)}), flush=True)
+
+    # (c) donated top-level dus, 142MB buffer
+    buf = jnp.zeros((32 * K, W), jnp.float32)
+
+    @jax.jit
+    def dono(b, r, off):
+        return lax.dynamic_update_slice(b, r, (off, 0))
+    dono2 = jax.jit(lambda b, r, off: lax.dynamic_update_slice(b, r, (off, 0)),
+                    donate_argnums=(0,))
+    out = dono2(buf, rows, jnp.int32(0)); np.asarray(out.ravel()[:1])
+    t0 = time.perf_counter()
+    for i in range(REPS * 4):
+        out = dono2(out, rows, jnp.int32((i * K) % (31 * K)))
+    np.asarray(out.ravel()[:1])
+    ms = (time.perf_counter() - t0) / (REPS * 4) * 1e3
+    print(json.dumps({"op": "dus_donated_toplevel_142MB",
+                      "ms_per_call": round(ms, 4)}), flush=True)
+
+    # H2D: 512KB and 8MB device_put
+    for nbytes, label in ((K * 4, "512KB"), (K * 4 * 16, "8MB")):
+        arr = np.random.rand(nbytes // 4).astype(np.float32)
+        jax.device_put(arr).block_until_ready()
+        t0 = time.perf_counter()
+        outs = [jax.device_put(arr) for _ in range(8)]
+        np.asarray(outs[-1].ravel()[:1])
+        ms = (time.perf_counter() - t0) / 8 * 1e3
+        print(json.dumps({"op": f"h2d_{label}", "ms_per_call": round(ms, 4),
+                          "gb_per_s": round(nbytes / (ms * 1e-3) / 1e9, 2)}),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

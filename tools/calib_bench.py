@@ -1,0 +1,100 @@
+"""Calibrate the chip: fixed per-iteration overhead vs real HBM/MXU rates.
+
+layout_bench.py saw ~4.2 ms/iteration on nearly everything — before
+trusting any layout conclusion, measure (a) a chained elementwise across
+sizes 4 MB -> 256 MB (slope = bandwidth, intercept = per-iteration
+overhead), (b) a bf16 matmul chain for MXU rate, (c) loop overhead with a
+trivial scalar body.
+
+Usage: timeout 900 python -u tools/calib_bench.py [platform]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms",
+                  sys.argv[1] if len(sys.argv) > 1 else "axon")
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+REPS = 5
+
+
+def timed(name, fn, iters, *args, bytes_moved=None, flops=None):
+    out = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    ms = (time.perf_counter() - t0) / REPS / iters * 1e3
+    rec = {"op": name, "ms_per_iter": round(ms, 4)}
+    if bytes_moved:
+        rec["gb_per_s"] = round(bytes_moved / (ms * 1e-3) / 1e9, 1)
+    if flops:
+        rec["tflop_per_s"] = round(flops / (ms * 1e-3) / 1e12, 2)
+    print(json.dumps(rec), flush=True)
+    return ms
+
+
+def chain(body, iters):
+    def run(carry, *args):
+        def step(_, c):
+            return body(c, *args)
+        return lax.fori_loop(0, iters, step, carry)
+    return jax.jit(run)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(json.dumps({"device": str(dev), "platform": dev.platform}),
+          flush=True)
+    rng = np.random.RandomState(0)
+
+    # (c) trivial body: pure loop overhead
+    timed("loop_overhead_scalar", chain(lambda x: x * 1.000001, 256), 256,
+          jnp.float32(1.0))
+
+    # (a) elementwise across sizes
+    for logn, iters in ((20, 64), (22, 64), (24, 32), (26, 16)):
+        x = jnp.asarray(rng.rand(1 << logn).astype(np.float32))
+        mb = (1 << logn) * 4 // (1 << 20)
+        timed(f"elementwise_{mb}MB", chain(lambda v: v * 0.999 + 0.001,
+                                           iters), iters, x,
+              bytes_moved=2 * x.size * 4)
+
+    # (b) MXU: bf16 matmul 2048^3 and 4096^3
+    for n, iters in ((2048, 32), (4096, 16)):
+        a = jnp.asarray(rng.rand(n, n).astype(np.float32)).astype(
+            jnp.bfloat16)
+
+        def mm(c, m):
+            return (c @ m) * 0.5
+        timed(f"matmul_bf16_{n}", chain(mm, iters), iters, a, a,
+              flops=2 * n ** 3)
+
+    # same elementwise WITHOUT the loop: single fat op, python-level chain
+    x = jnp.asarray(rng.rand(1 << 26).astype(np.float32))
+    f = jax.jit(lambda v: v * 0.999 + 0.001)
+    y = f(x); np.asarray(y.ravel()[:1])
+    t0 = time.perf_counter()
+    n = 8
+    for _ in range(n):
+        y = f(y)
+    np.asarray(y.ravel()[:1])
+    ms = (time.perf_counter() - t0) / n * 1e3
+    print(json.dumps({"op": "elementwise_256MB_noloop",
+                      "ms_per_iter": round(ms, 4),
+                      "gb_per_s": round(2 * x.size * 4 / (ms * 1e-3) / 1e9,
+                                        1)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,129 @@
+"""Slab-layout experiment matrix on the live chip.
+
+push_microbench.py showed EVERY push sub-op on the [CAP, 17] slab running
+~2 orders under HBM roofline, and the XLA audit shows the slab padded
+CAP x 24 x 4 bytes (width padded 17->24, i.e. width on SUBLANES and rows
+on LANES — row gathers cross lanes). This measures, per candidate width
+W in {17, 24, 32, 128} plus flat-1D: raw elementwise bandwidth, K-row
+gather, K-row scatter — to pick the layout the pass slab should use.
+
+Usage: timeout 900 python -u tools/layout_bench.py [platform]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms",
+                  sys.argv[1] if len(sys.argv) > 1 else "axon")
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+CAP = 1 << 20
+K = 131072
+ITERS = 16
+REPS = 5
+
+
+def timed(name, fn, *args, bytes_moved=None):
+    out = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    ms = (time.perf_counter() - t0) / REPS / ITERS * 1e3
+    rec = {"op": name, "ms_per_call": round(ms, 4)}
+    if bytes_moved:
+        rec["gb_per_s"] = round(bytes_moved / (ms * 1e-3) / 1e9, 1)
+    print(json.dumps(rec), flush=True)
+    return ms
+
+
+def chain(body):
+    def run(carry, *args):
+        def step(_, c):
+            return body(c, *args)
+        return lax.fori_loop(0, ITERS, step, carry)
+    return jax.jit(run)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(json.dumps({"device": str(dev), "platform": dev.platform}),
+          flush=True)
+    rng = np.random.RandomState(0)
+
+    # Raw HBM bandwidth roofline: elementwise on 256 MB flat
+    big = jnp.asarray(rng.rand(1 << 26).astype(np.float32))
+    timed("roofline_elementwise_256MB",
+          chain(lambda x: x * 0.999 + 0.001), big,
+          bytes_moved=2 * big.size * 4)
+
+    n_uniq = int(K * 0.85)
+    uids_np = np.sort(rng.choice(CAP - 1, n_uniq, replace=False)).astype(
+        np.int32)
+    uids_np = np.concatenate(
+        [uids_np, np.arange(K - n_uniq, dtype=np.int32) + CAP])
+    uids = jnp.asarray(uids_np)
+
+    for W in (17, 24, 32, 128):
+        slab = jnp.asarray(rng.rand(CAP, W).astype(np.float32))
+        rows = jnp.take(slab, uids, axis=0, mode="clip")
+        timed(f"elementwise_slab_W{W}",
+              chain(lambda s: s * 0.999 + 0.001), slab,
+              bytes_moved=2 * CAP * W * 4)
+
+        def gath(c, s, u):
+            r = jnp.take(s, u, axis=0, mode="clip")
+            return c + r[:1, :1]
+        timed(f"gather_K_rows_W{W}", chain(gath), jnp.zeros((1, 1)),
+              slab, uids, bytes_moved=2 * K * W * 4)
+
+        def scat(s, u, r):
+            return s.at[u].set(r, mode="drop", unique_indices=True)
+        timed(f"scatter_K_rows_W{W}", chain(scat), slab, uids, rows,
+              bytes_moved=2 * K * W * 4)
+
+    # flat-1D variant: rows expanded to element indices (contiguous runs)
+    W = 17
+    flat = jnp.asarray(rng.rand(CAP * W).astype(np.float32))
+    eidx = (uids[:, None].astype(jnp.int32) * W
+            + jnp.arange(W, dtype=jnp.int32)[None, :]).reshape(-1)
+    vals = jnp.take(flat, jnp.clip(eidx, 0, CAP * W - 1))
+
+    def gath_flat(c, f, i):
+        r = jnp.take(f, jnp.clip(i, 0, CAP * W - 1))
+        return c + r[:1]
+    timed("gather_flat1d_W17", chain(gath_flat), jnp.zeros((1,)),
+          flat, eidx, bytes_moved=2 * K * W * 4)
+
+    def scat_flat(f, i, v):
+        return f.at[i].set(v, mode="drop", unique_indices=True)
+    timed("scatter_flat1d_W17", chain(scat_flat), flat, eidx, vals,
+          bytes_moved=2 * K * W * 4)
+
+    # one-hot matmul gather (MXU path): [K, CAP] @ [CAP, W] is too big, but
+    # blocked one-hot over 8k-row tiles of the K side is the classic
+    # TPU-friendly trick; measure a single 8k tile to extrapolate.
+    KT = 8192
+    slab17 = jnp.asarray(rng.rand(CAP, 17).astype(np.float32))
+    ut = uids[:KT]
+
+    def gath_onehot(c, s, u):
+        oh = jax.nn.one_hot(u // 128, CAP // 128, dtype=jnp.bfloat16)
+        # coarse proxy: block-gather via matmul on 128-row superblocks
+        r = oh @ s.reshape(CAP // 128, -1).astype(jnp.bfloat16)
+        return c + r[:1, :1].astype(jnp.float32)
+    timed("gather_onehot_8k_superblock", chain(gath_onehot),
+          jnp.zeros((1, 1)), slab17, ut)
+
+
+if __name__ == "__main__":
+    main()

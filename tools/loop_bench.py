@@ -1,0 +1,98 @@
+"""Is lax control flow itself slow on this backend?
+
+calib_bench.py measured 0.28 ms PER fori_loop ITERATION on a scalar body
+(~100x a normal TPU). Hypothesis: the axon tunnel dispatches per loop
+iteration. Compare: unrolled multiply chains vs fori_loop vs scan, and a
+single fat op — at equal logical work.
+
+Usage: timeout 900 python -u tools/loop_bench.py [platform]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms",
+                  sys.argv[1] if len(sys.argv) > 1 else "axon")
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+REPS = 5
+N = 256
+
+
+def timed(name, fn, *args):
+    out = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    ms = (time.perf_counter() - t0) / REPS * 1e3
+    print(json.dumps({"op": name, "ms_per_call": round(ms, 4),
+                      "ms_per_unit": round(ms / N, 4)}), flush=True)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(json.dumps({"device": str(dev), "platform": dev.platform}),
+          flush=True)
+    x = jnp.float32(1.0)
+    v = jnp.zeros((8, 128), jnp.float32) + 1.0
+
+    @jax.jit
+    def unrolled_scalar(y):
+        for _ in range(N):
+            y = y * 1.000001
+        return y
+    timed("unrolled_256_scalar_mults", unrolled_scalar, x)
+
+    @jax.jit
+    def loop_scalar(y):
+        return lax.fori_loop(0, N, lambda i, c: c * 1.000001, y)
+    timed("fori_256_scalar_mults", loop_scalar, x)
+
+    @jax.jit
+    def scan_scalar(y):
+        def step(c, _):
+            return c * 1.000001, ()
+        out, _ = lax.scan(step, y, None, length=N)
+        return out
+    timed("scan_256_scalar_mults", scan_scalar, x)
+
+    @jax.jit
+    def unrolled_vec(y):
+        for _ in range(N):
+            y = y * 1.000001 + 1e-9
+        return y
+    timed("unrolled_256_vec_ops", unrolled_vec, v)
+
+    @jax.jit
+    def scan_vec(y):
+        def step(c, _):
+            return c * 1.000001 + 1e-9, ()
+        out, _ = lax.scan(step, y, None, length=N)
+        return out
+    timed("scan_256_vec_ops", scan_vec, v)
+
+    # dispatch cost: N separate tiny jit calls, python-chained
+    f = jax.jit(lambda y: y * 1.000001)
+    y = f(x); np.asarray(y.ravel()[:1])
+    t0 = time.perf_counter()
+    for _ in range(N):
+        y = f(y)
+    np.asarray(y.ravel()[:1])
+    ms = (time.perf_counter() - t0) * 1e3
+    print(json.dumps({"op": "python_256_dispatches",
+                      "ms_per_call": round(ms, 4),
+                      "ms_per_unit": round(ms / N, 4)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

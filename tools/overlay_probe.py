@@ -1,0 +1,105 @@
+"""Validate the chunk-overlay push design's cost assumptions on the chip.
+
+push_ablate.py: scatter ops cost ~7-12 ms FIXED on this backend; the
+overlay design replaces per-batch scatters with traced-offset
+dynamic_update_slice + a blended gather, and one fold scatter per chunk.
+Measure each piece at real shapes.
+
+Usage: timeout 900 python -u tools/overlay_probe.py [platform]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms",
+                  sys.argv[1] if len(sys.argv) > 1 else "axon")
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+CAP = 1 << 20
+K = 131072
+W = 17
+ITERS = 16
+REPS = 5
+
+
+def timed(name, fn, *args):
+    out = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    ms = (time.perf_counter() - t0) / REPS / ITERS * 1e3
+    print(json.dumps({"op": name, "ms_per_call": round(ms, 4)}), flush=True)
+    return ms
+
+
+def chain(body):
+    def run(carry, *args):
+        def step(i, c):
+            return body(c, i, *args)
+        return lax.fori_loop(0, ITERS, step, carry)
+    return jax.jit(run)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(json.dumps({"device": str(dev), "platform": dev.platform}),
+          flush=True)
+    rng = np.random.RandomState(0)
+    slab = jnp.asarray(rng.rand(CAP, W).astype(np.float32))
+    overlay = jnp.asarray(rng.rand(8 * K, W).astype(np.float32))
+    rows = jnp.asarray(rng.rand(K, W).astype(np.float32))
+    ids = jnp.asarray(rng.randint(0, CAP - 1, K).astype(np.int32))
+    ov_idx = jnp.asarray(
+        np.where(rng.rand(K) < 0.3, rng.randint(0, 8 * K, K), -1)
+        .astype(np.int32))
+
+    # 1. dynamic_update_slice at a TRACED row offset
+    def dus(ov, i, r):
+        return lax.dynamic_update_slice(ov, r, (i * K % (7 * K), 0))
+    timed("dus_traced_offset_131k_rows", chain(dus), overlay, rows)
+
+    # 2. blended pull: slab gather + overlay gather + select
+    def blend(c, i, s, ov, idx, oi):
+        base = jnp.take(s, idx, axis=0, mode="clip")
+        over = jnp.take(ov, jnp.maximum(oi, 0), axis=0)
+        r = jnp.where((oi >= 0)[:, None], over, base)
+        return c + r[:1, :1]
+    timed("blended_pull_gather_select", chain(blend), jnp.zeros((1, 1)),
+          slab, overlay, ids, ov_idx)
+
+    # plain pull for reference
+    def plain(c, i, s, idx):
+        return c + jnp.take(s, idx, axis=0, mode="clip")[:1, :1]
+    timed("plain_pull_gather", chain(plain), jnp.zeros((1, 1)), slab, ids)
+
+    # 3. scatter cost vs index count (fold cadence): 16k / 131k / 700k
+    for n in (16384, 131072, 700000):
+        u = jnp.asarray(np.sort(rng.choice(CAP - 1, n, replace=False))
+                        .astype(np.int32))
+        r = jnp.asarray(rng.rand(n, W).astype(np.float32))
+
+        def scat(s, i, uu, rr):
+            return s.at[uu].set(rr, mode="drop", unique_indices=True)
+        timed(f"fold_scatter_{n}_idx", chain(scat), slab, u, r)
+
+    # 4. gather of final rows from overlay (fold's read side)
+    fin = jnp.asarray(rng.randint(0, 8 * K, 700000).astype(np.int32))
+
+    def gfin(c, i, ov, f):
+        return c + jnp.take(ov, f, axis=0)[:1, :1]
+    timed("fold_gather_700k_from_overlay", chain(gfin), jnp.zeros((1, 1)),
+          overlay, fin)
+
+
+if __name__ == "__main__":
+    main()

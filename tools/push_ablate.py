@@ -1,0 +1,157 @@
+"""Ablate the push's sub-ops INSIDE the real fused step, on the live chip.
+
+tools/tpu_probe.py attributes ~79% of the step to the push; the microbench
+(tools/push_microbench.py) can't see fusion context. This rebuilds the
+REAL bench trainer with one sub-op surgically stubbed per variant (via
+monkeypatching the trainer/optimizer module globals) and times the real
+scan megastep — the difference vs `full` is that sub-op's true in-step
+cost. Stubs keep all dataflow dependencies (timing valid) but NOT
+numerics (losses stay finite; values are wrong — never use for training).
+
+Usage: timeout 1800 python -u tools/push_ablate.py [platform]
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms",
+                  sys.argv[1] if len(sys.argv) > 1 else "axon")
+
+import jax.numpy as jnp
+import numpy as np
+
+from tools.bench_util import (make_bench_trainer, make_ctr_batches,
+                              timed_scan_chain)
+
+BATCH, NUM_SLOTS, MAX_LEN = 1024, 32, 4
+PASS_CAP = 1 << 20
+CHUNK, REPS = 8, 6
+
+
+def run_variant(name, patches):
+    """patches: list of (module, attr, replacement_factory) applied before
+    the trainer (and so the jitted step) is built."""
+    import paddlebox_tpu.embedding.optimizers as opt_mod
+    import paddlebox_tpu.train.trainer as tr_mod
+    saved = []
+    try:
+        for mod, attr, repl in patches:
+            saved.append((mod, attr, getattr(mod, attr)))
+            setattr(mod, attr, repl)
+        tr, feed = make_bench_trainer(PASS_CAP, batch=BATCH,
+                                      num_slots=NUM_SLOTS, max_len=MAX_LEN)
+        batches = make_ctr_batches(feed, CHUNK, NUM_SLOTS, MAX_LEN, seed=0)
+        tr.table.begin_feed_pass()
+        for b in batches:
+            tr.table.add_keys(b.keys[b.valid])
+        tr.table.end_feed_pass()
+        tr.table.begin_pass()
+        stacked = tr._stack_batches(batches)
+        state = (tr.table.slab, tr.params, tr.opt_state,
+                 jax.random.PRNGKey(0))
+        dt = timed_scan_chain(tr.fns.scan_steps, state, stacked, REPS)
+        ms = dt / CHUNK * 1e3
+        print(json.dumps({"variant": name, "ms_per_step": round(ms, 3),
+                          "examples_per_sec": round(BATCH / (dt / CHUNK),
+                                                    1)}), flush=True)
+    finally:
+        for mod, attr, orig in saved:
+            setattr(mod, attr, orig)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(json.dumps({"device": str(dev), "platform": dev.platform}),
+          flush=True)
+    import paddlebox_tpu.embedding.optimizers as opt_mod
+    import paddlebox_tpu.train.trainer as tr_mod
+    from paddlebox_tpu.embedding.optimizers import (_dispatch_apply_push,
+                                                    rebuild_uids)
+
+    run_variant("full", [])
+
+    # threefry lazy-init randoms -> zeros (keeps prng dataflow dep)
+    def no_fresh(prng, row_ids, shape, dtype, maxval, stream=0):
+        return jnp.zeros(shape, dtype) + jax.random.key_data(
+            prng).astype(dtype).ravel()[:1] * 0
+    run_variant("no_fresh_prng",
+                [(opt_mod, "_fresh_uniform", no_fresh)])
+
+    orig_push = opt_mod.push_sparse_hostdedup
+
+    def push_noscatter(slab, uids, perm, inv_sorted, grads, prng, layout,
+                       conf):
+        sorted_grads = jnp.take(grads, perm, axis=0, unique_indices=True)
+        merged = jax.ops.segment_sum(sorted_grads, inv_sorted,
+                                     num_segments=uids.shape[0],
+                                     indices_are_sorted=True)
+        rows = jnp.take(slab, uids, axis=0, mode="clip")
+        new_rows = _dispatch_apply_push(rows, merged, prng, layout, conf,
+                                        row_ids=uids)
+        return jax.lax.dynamic_update_slice(slab, new_rows[:8], (0, 0))
+    run_variant("no_slab_scatter",
+                [(tr_mod, "push_sparse_hostdedup", push_noscatter)])
+
+    def push_norowgather(slab, uids, perm, inv_sorted, grads, prng, layout,
+                         conf):
+        sorted_grads = jnp.take(grads, perm, axis=0, unique_indices=True)
+        merged = jax.ops.segment_sum(sorted_grads, inv_sorted,
+                                     num_segments=uids.shape[0],
+                                     indices_are_sorted=True)
+        rows = (jnp.zeros((uids.shape[0], slab.shape[1]), slab.dtype)
+                + uids[:, None].astype(slab.dtype) * 0 + 0.5)
+        new_rows = _dispatch_apply_push(rows, merged, prng, layout, conf,
+                                        row_ids=uids)
+        return slab.at[uids].set(new_rows, mode="drop", unique_indices=True)
+    run_variant("no_slab_row_gather",
+                [(tr_mod, "push_sparse_hostdedup", push_norowgather)])
+
+    def push_nosegsum(slab, uids, perm, inv_sorted, grads, prng, layout,
+                      conf):
+        merged = (jnp.take(grads, perm, axis=0, unique_indices=True)
+                  + inv_sorted[:, None].astype(grads.dtype) * 0)
+        rows = jnp.take(slab, uids, axis=0, mode="clip")
+        new_rows = _dispatch_apply_push(rows, merged, prng, layout, conf,
+                                        row_ids=uids)
+        return slab.at[uids].set(new_rows, mode="drop", unique_indices=True)
+    run_variant("no_segment_sum",
+                [(tr_mod, "push_sparse_hostdedup", push_nosegsum)])
+
+    def push_nopermgather(slab, uids, perm, inv_sorted, grads, prng, layout,
+                          conf):
+        merged = jax.ops.segment_sum(
+            grads + perm[:, None].astype(grads.dtype) * 0, inv_sorted,
+            num_segments=uids.shape[0], indices_are_sorted=True)
+        rows = jnp.take(slab, uids, axis=0, mode="clip")
+        new_rows = _dispatch_apply_push(rows, merged, prng, layout, conf,
+                                        row_ids=uids)
+        return slab.at[uids].set(new_rows, mode="drop", unique_indices=True)
+    run_variant("no_perm_gather",
+                [(tr_mod, "push_sparse_hostdedup", push_nopermgather)])
+
+    def push_noapply(slab, uids, perm, inv_sorted, grads, prng, layout,
+                     conf):
+        sorted_grads = jnp.take(grads, perm, axis=0, unique_indices=True)
+        merged = jax.ops.segment_sum(sorted_grads, inv_sorted,
+                                     num_segments=uids.shape[0],
+                                     indices_are_sorted=True)
+        rows = jnp.take(slab, uids, axis=0, mode="clip")
+        pad = slab.shape[1] - merged.shape[1]
+        new_rows = rows * 0.999 + jnp.pad(merged, ((0, 0), (0, pad))) * 1e-6
+        return slab.at[uids].set(new_rows, mode="drop", unique_indices=True)
+    run_variant("no_apply_push",
+                [(tr_mod, "push_sparse_hostdedup", push_noapply)])
+
+    def cheap_rebuild(ids, perm, inv, pad_base):
+        return (jnp.arange(ids.shape[0], dtype=jnp.int32)
+                + ids[:1] * 0 + perm[:1] * 0 + inv[:1] * 0)
+    run_variant("no_rebuild_uids",
+                [(tr_mod, "rebuild_uids", cheap_rebuild)])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,135 @@
+"""Micro-bench the push sub-ops on the live chip (axon) or CPU.
+
+The TPU probe battery attributes ~79% of the fused step to the push
+(tools/tpu_probe.py, BASELINE.md round-4 TPU rows). This decomposes the
+push into its five sub-ops — occurrence gather, segment_sum merge, slab
+row gather, in-table optimizer elementwise, slab row scatter — and times
+each in a dependence-chained fori_loop (axon's block_until_ready returns
+early, so every timed region ends in np.asarray of data that depends on
+all iterations).
+
+Usage: timeout 900 python -u tools/push_microbench.py [platform]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms",
+                  sys.argv[1] if len(sys.argv) > 1 else "axon")
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+CAP = 1 << 20          # slab rows (bench pass_capacity)
+W = 17                 # slab value width (bench layout)
+K = 131072             # keys/batch at bench shapes (1024 x 32 x 4)
+PW = 12                # push row width (4 + D=8)
+ITERS = 32
+REPS = 5
+
+
+def timed(name, fn, *args):
+    out = fn(*args)                      # compile
+    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    ms = (time.perf_counter() - t0) / REPS / ITERS * 1e3
+    print(json.dumps({"op": name, "ms_per_call": round(ms, 4)}), flush=True)
+    return ms
+
+
+def chain(body):
+    """Wrap op so iteration i+1 depends on iteration i's output."""
+    def run(carry, *args):
+        def step(_, c):
+            return body(c, *args)
+        return lax.fori_loop(0, ITERS, step, carry)
+    return jax.jit(run)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(json.dumps({"device": str(dev), "platform": dev.platform}),
+          flush=True)
+    rng = np.random.RandomState(0)
+    slab = jnp.asarray(rng.rand(CAP, W).astype(np.float32))
+    # host-dedup products: sorted unique ids, padded tail out-of-range
+    n_uniq = int(K * 0.85)
+    uids_np = np.sort(rng.choice(CAP - 1, n_uniq, replace=False)).astype(
+        np.int32)
+    uids_np = np.concatenate(
+        [uids_np, np.arange(K - n_uniq, dtype=np.int32) + CAP])
+    uids = jnp.asarray(uids_np)
+    perm = jnp.asarray(rng.permutation(K).astype(np.int32))
+    inv_sorted = jnp.asarray(
+        np.sort(rng.randint(0, n_uniq, K)).astype(np.int32))
+    grads = jnp.asarray(rng.rand(K, PW).astype(np.float32))
+    rows = jnp.take(slab, uids, axis=0, mode="clip")
+
+    # 1. occurrence gather [K, PW] by perm
+    timed("grad_gather_perm",
+          chain(lambda g, p: jnp.take(g, p, axis=0,
+                                      unique_indices=True) + 1.0),
+          grads, perm)
+
+    # 2. segment-sum merge (sorted segments)
+    def seg(g, iv):
+        return jax.ops.segment_sum(g, iv, num_segments=K,
+                                   indices_are_sorted=True)[:K] + 1.0
+    timed("segment_sum_sorted", chain(seg), grads, inv_sorted)
+
+    # 3. slab row gather, unsorted-declared vs sorted-declared
+    def gath(c, s, u):
+        r = jnp.take(s, u, axis=0, mode="clip")
+        return c + r[:1, :1]
+    timed("slab_gather", chain(gath), jnp.zeros((1, 1)), slab, uids)
+
+    def gath_sorted(c, s, u):
+        r = jnp.take(s, u, axis=0, mode="clip", indices_are_sorted=True)
+        return c + r[:1, :1]
+    timed("slab_gather_sorted", chain(gath_sorted), jnp.zeros((1, 1)),
+          slab, uids)
+
+    # 4. elementwise optimizer proxy (rows -> rows, no gather/scatter)
+    timed("elementwise_rows",
+          chain(lambda r: r * 0.999 + 0.001), rows)
+
+    # 5. slab row scatter variants
+    def scat(s, u, r):
+        return s.at[u].set(r, mode="drop", unique_indices=True)
+    timed("slab_scatter_unique", chain(scat), slab, uids, rows)
+
+    def scat_sorted(s, u, r):
+        return s.at[u].set(r, mode="drop", unique_indices=True,
+                           indices_are_sorted=True)
+    timed("slab_scatter_unique_sorted", chain(scat_sorted), slab, uids, rows)
+
+    def scat_add(s, u, r):
+        return s.at[u].add(r, mode="drop", unique_indices=True,
+                           indices_are_sorted=True)
+    timed("slab_scatter_add_sorted", chain(scat_add), slab, uids, rows)
+
+    # 6. the full hostdedup push as composed in the trainer
+    from paddlebox_tpu.config.configs import SparseOptimizerConfig
+    from paddlebox_tpu.embedding.layout import ValueLayout
+    from paddlebox_tpu.embedding.optimizers import push_sparse_hostdedup
+    layout = ValueLayout.build(embedx_dim=8, optimizer="adagrad")
+    conf = SparseOptimizerConfig()
+    key = jax.random.PRNGKey(0)
+
+    def full(s, u, p, iv, g, k):
+        return push_sparse_hostdedup(s, u, p, iv, g, k, layout, conf)
+    timed("full_push_hostdedup", chain(full), slab, uids, perm, inv_sorted,
+          grads, key)
+
+
+if __name__ == "__main__":
+    main()
