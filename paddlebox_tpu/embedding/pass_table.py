@@ -111,6 +111,18 @@ def dedup_ids(ids: np.ndarray, pad_base: int):
     return uids, perm, inv
 
 
+def pos_for_rebuild(uids: np.ndarray, capacity: int) -> np.ndarray:
+    """[capacity] int32 inverse of a dedup's uids for the
+    push_write='rebuild' slab write: pos[r] = row index into the push's
+    new_rows for touched slab rows, -1 elsewhere. One definition shared by
+    every trainer's host stage (BoxTrainer per batch, the sharded stager
+    per destination shard) so the rebuild contract can't diverge."""
+    pos = np.full(capacity, -1, np.int32)
+    m = uids < capacity
+    pos[uids[m]] = np.arange(uids.shape[0], dtype=np.int32)[m]
+    return pos
+
+
 class PassTable:
     """Single-shard (one-device or host-replicated) sparse table with the
     BoxPS pass lifecycle. The pod-sharded variant composes these per shard
@@ -286,13 +298,9 @@ class PassTable:
 
     def pos_for_rebuild(self, uids: np.ndarray) -> np.ndarray:
         """[capacity] int32 inverse of the dedup's uids for the
-        push_write='rebuild' slab write: pos[r] = row index into the push's
-        new_rows for touched slab rows, -1 elsewhere. Rides the overlapped
-        host batch stage like the dedup itself."""
-        pos = np.full(self.capacity, -1, np.int32)
-        m = uids < self.capacity
-        pos[uids[m]] = np.arange(uids.shape[0], dtype=np.int32)[m]
-        return pos
+        push_write='rebuild' slab write (see pos_for_rebuild below). Rides
+        the overlapped host batch stage like the dedup itself."""
+        return pos_for_rebuild(uids, self.capacity)
 
     # ------------------------------------------------------------ pull/push
     def pull(self, ids: jnp.ndarray) -> jnp.ndarray:

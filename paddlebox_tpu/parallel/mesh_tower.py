@@ -33,6 +33,7 @@ from paddlebox_tpu.config.configs import (DataFeedConfig, TableConfig,
                                           TrainerConfig)
 from paddlebox_tpu.data.packer import PackedBatch
 from paddlebox_tpu.embedding.optimizers import (push_sparse_hostdedup,
+                                                push_sparse_rebuild,
                                                 rebuild_uids)
 from paddlebox_tpu.embedding.pass_table import PassTable
 from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
@@ -68,6 +69,8 @@ class MeshTowerTrainer:
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.table = PassTable(table_cfg, seed=seed)
+        from paddlebox_tpu.train.trainer import resolve_push_write
+        self._push_write = resolve_push_write()
         self.layout = self.table.layout
         self.num_slots = len(feed.used_sparse_slots())
         self.use_cvm = use_cvm
@@ -155,13 +158,20 @@ class MeshTowerTrainer:
             clicks = batch["labels"][batch["segments"] // S]
             pg = build_push_grads(demb, batch["segments"] % S, clicks,
                                   key_valid)
-            uids = rebuild_uids(batch["ids"], batch["perm"], batch["inv"],
-                                pad_base)
+            uids = batch.get("uids")
+            if uids is None:
+                uids = rebuild_uids(batch["ids"], batch["perm"],
+                                    batch["inv"], pad_base)
             # shared prng + psum'd demb → bit-identical push everywhere;
             # the replicated slab cannot diverge
-            slab = push_sparse_hostdedup(slab, uids, batch["perm"],
-                                         batch["inv"], pg, sub, layout,
-                                         conf)
+            if "push_pos" in batch:
+                slab = push_sparse_rebuild(slab, uids, batch["push_pos"],
+                                           batch["perm"], batch["inv"],
+                                           pg, sub, layout, conf)
+            else:
+                slab = push_sparse_hostdedup(slab, uids, batch["perm"],
+                                             batch["inv"], pg, sub, layout,
+                                             conf)
             params = {k: (v[None] if sharded[k] else v)
                       for k, v in local.items()}
             opt_state = jax.tree.map(
@@ -203,9 +213,15 @@ class MeshTowerTrainer:
             "ins_valid": jnp.asarray(b.ins_valid),
         }
         if not self.table.test_mode:
-            # eval never pushes — skip the dedup + two transfers
-            _uids, perm, inv = self.table.dedup_for_push(ids)
-            out.update(perm=jnp.asarray(perm), inv=jnp.asarray(inv))
+            # eval never pushes — skip the dedup + transfers; uids ride the
+            # host stage (device reconstruction is a scatter), and rebuild
+            # mode stages the pos map for the scatter-free slab write
+            uids, perm, inv = self.table.dedup_for_push(ids)
+            out.update(perm=jnp.asarray(perm), inv=jnp.asarray(inv),
+                       uids=jnp.asarray(uids))
+            if self._push_write == "rebuild":
+                out["push_pos"] = jnp.asarray(
+                    self.table.pos_for_rebuild(uids))
         return out
 
     def train_batch(self, b: PackedBatch) -> float:

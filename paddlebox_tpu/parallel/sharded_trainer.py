@@ -36,8 +36,9 @@ from paddlebox_tpu.config.configs import (DataFeedConfig, TableConfig,
 from paddlebox_tpu.data.dataset import BoxDataset
 from paddlebox_tpu.data.packer import PackedBatch
 from paddlebox_tpu.embedding.optimizers import (push_sparse_dedup,
-                                                push_sparse_hostdedup)
-from paddlebox_tpu.embedding.pass_table import dedup_ids
+                                                push_sparse_hostdedup,
+                                                push_sparse_rebuild)
+from paddlebox_tpu.embedding.pass_table import dedup_ids, pos_for_rebuild
 from paddlebox_tpu.metrics.auc import MetricRegistry
 from paddlebox_tpu.models.base import ModelSpec
 from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
@@ -109,6 +110,12 @@ class ShardedBoxTrainer:
             owned_shards=self.local_positions if self.multiprocess else None,
             store_factory=store_factory)
         self.metrics = MetricRegistry()
+        # scatter-free slab write (push_write flag; see BoxTrainer) — only
+        # the single-process mesh can host-precompute the pos maps (incoming
+        # ids of a peer process's shards are not host-visible here)
+        from paddlebox_tpu.train.trainer import resolve_push_write
+        self._push_write = (resolve_push_write()
+                            if not self.multiprocess else "scatter")
         self.dense_opt = make_dense_optimizer(self.cfg)
         rng = jax.random.PRNGKey(seed)
         self.params = model.init(rng)
@@ -535,7 +542,14 @@ class ShardedBoxTrainer:
             else:
                 recv_g = jax.lax.all_to_all(
                     bucket_g.reshape(Pn, KB, -1), axis, 0, 0, tiled=True)
-            if "push_uids" in batch:
+            if "push_pos" in batch:
+                # single-process mesh, scatter-free write: host-staged
+                # per-shard pos map turns the slab write into gather+select
+                slab = push_sparse_rebuild(
+                    slab, batch["push_uids"], batch["push_pos"],
+                    batch["push_perm"], batch["push_inv"],
+                    recv_g.reshape(Pn * KB, -1), prng, layout, conf)
+            elif "push_uids" in batch:
                 # single-process mesh: the incoming-id dedup was precomputed
                 # on the host (shard_batches) — no device sort
                 slab = push_sparse_hostdedup(
@@ -694,15 +708,23 @@ class ShardedBoxTrainer:
             # precompute the push dedup per destination shard and spare
             # the device its per-step jnp.unique sort (multi-process
             # keeps the device path — incoming ids live on peers)
+            rebuild = self._push_write == "rebuild"
+
             def dedup_dest(d):
                 incoming = np.concatenate(
                     [stacked["buckets"][w][d] for w in range(n_workers)])
-                return dedup_ids(incoming, self.table.shard_cap)
+                uids, perm, inv = dedup_ids(incoming, self.table.shard_cap)
+                # per-shard inverse map for the scatter-free slab write
+                pos = (pos_for_rebuild(uids, self.table.shard_cap)
+                       if rebuild else None)
+                return uids, perm, inv, pos
 
-            for uids, perm, inv in pool.map(dedup_dest, range(self.P)):
+            for uids, perm, inv, pos in pool.map(dedup_dest, range(self.P)):
                 stacked.setdefault("push_uids", []).append(uids)
                 stacked.setdefault("push_perm", []).append(perm)
                 stacked.setdefault("push_inv", []).append(inv)
+                if pos is not None:
+                    stacked.setdefault("push_pos", []).append(pos)
         return {k: np.stack(v) for k, v in stacked.items()}
 
     def shard_batches(self, per_worker: List[List[PackedBatch]],

@@ -60,6 +60,21 @@ class RecoverableRunner:
             return 0
         params, opt_state, extra = self.ckpt.load_base(
             os.path.join(self.day, f"pass-{done - 1}"))
+        # dense opt_state structure depends on the flatten_dense_opt flag
+        # (optax.flatten stores one flat vector instead of per-param trees);
+        # a checkpoint written under the other setting would crash deep in
+        # the first post-resume update — fail loud with the fix instead
+        import jax
+        want = jax.tree_util.tree_structure(
+            getattr(self.trainer, "opt_state", opt_state))
+        got = jax.tree_util.tree_structure(opt_state)
+        if want != got:
+            raise ValueError(
+                "restored dense opt_state structure does not match this "
+                "trainer's optimizer (likely the flatten_dense_opt flag "
+                "differs from the run that wrote the checkpoint — set "
+                "PBTPU_FLATTEN_DENSE_OPT to match it):\n"
+                f"  checkpoint: {got}\n  trainer:    {want}")
         self.trainer.params = params
         self.trainer.opt_state = opt_state
         async_table = getattr(self.trainer, "async_table", None)

@@ -698,15 +698,16 @@ class BoxTrainer:
                    preloaded: bool = False) -> Dict[str, float]:
         """One full pass: feed → build → train → metrics → end."""
         from paddlebox_tpu.config import flags
+        # live set_flag takes effect at pass boundaries only (mid-pass flips
+        # would mix rebuild/scatter host dicts inside one scan chunk);
+        # refreshed BEFORE the profiled-path fork so both tiers honor it
+        self._push_write = resolve_push_write()
         if (flags.get_flag("profile_per_op") and not preloaded
                 and not self.multi_task and self.async_table is None):
             # debug tier: staged dispatches with per-stage attribution
             return self.train_pass_profiled(dataset)
         t_pass = self.timers["pass"]
         t_pass.start()
-        # live set_flag takes effect at pass boundaries only (mid-pass flips
-        # would mix rebuild/scatter host dicts inside one scan chunk)
-        self._push_write = resolve_push_write()
         if not preloaded:
             self.table.begin_feed_pass()
             dataset.load_into_memory(add_keys_fn=self.table.add_keys)

@@ -247,3 +247,26 @@ def test_mesh_tower_learns(tmp_path, kind):
     _k, vals_after = tr.table.store.state_items()
     assert vals_after[:, acc.SHOW].sum() == show_before
     ds.release_memory()
+
+
+def test_mesh_tower_push_write_rebuild_matches_scatter(tmp_path):
+    """rebuild-mode slab write through the TP tower trainer must match the
+    scatter path bit-exactly (replicated slab, shared prng)."""
+    from paddlebox_tpu.config import flags
+    files, feed = _setup(tmp_path, lines=192)
+    states = {}
+    for mode in ("scatter", "rebuild"):
+        flags.set_flag("push_write", mode)
+        try:
+            model = TpDeepFM(_spec(feed), n_shards=8, d_wide=64, d_mid=8)
+            tr = MeshTowerTrainer(model, _table(), feed,
+                                  TrainerConfig(dense_lr=5e-3), seed=2)
+            assert tr._push_write == mode
+            ds = BoxDataset(feed, read_threads=1)
+            ds.set_filelist(files)
+            tr.train_pass(ds)
+            states[mode] = tr.table.store.state_items()
+        finally:
+            flags.set_flag("push_write", "auto")
+    np.testing.assert_array_equal(states["scatter"][0], states["rebuild"][0])
+    np.testing.assert_array_equal(states["scatter"][1], states["rebuild"][1])

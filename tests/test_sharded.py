@@ -451,3 +451,27 @@ def test_sync_one_ring_matches_hierarchical(sharded_setup):
     np.testing.assert_allclose(loss_h, loss_r, rtol=1e-6)
     for a, b in zip(params_h, params_r):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+def test_sharded_push_write_rebuild_matches_scatter(sharded_setup):
+    """push_write='rebuild' on the sharded mesh (per-shard pos maps staged
+    next to the per-destination dedup) must train bit-identically to the
+    scatter path."""
+    from paddlebox_tpu.config import flags
+    files, feed = sharded_setup
+    states = {}
+    for mode in ("scatter", "rebuild"):
+        flags.set_flag("push_write", mode)
+        try:
+            trainer = make_sharded_trainer(feed, seed=4)
+            assert trainer._push_write == mode
+            ds = BoxDataset(feed, read_threads=1)
+            ds.set_filelist(files[:1])
+            trainer.train_pass(ds)
+            states[mode] = [st.state_items()
+                            for st in trainer.table.stores]
+        finally:
+            flags.set_flag("push_write", "auto")
+    for (k_s, v_s), (k_r, v_r) in zip(states["scatter"], states["rebuild"]):
+        np.testing.assert_array_equal(k_s, k_r)
+        np.testing.assert_array_equal(v_s, v_r)
