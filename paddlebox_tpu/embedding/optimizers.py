@@ -22,7 +22,7 @@ Rows whose merged g_show == 0 (padding) are returned unchanged.
 from __future__ import annotations
 
 import functools
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -274,7 +274,10 @@ def push_sparse_hostdedup(slab: jnp.ndarray, uids: jnp.ndarray,
                           perm: jnp.ndarray, inv_sorted: jnp.ndarray,
                           grads: jnp.ndarray, prng: jax.Array,
                           layout: ValueLayout,
-                          conf: SparseOptimizerConfig) -> jnp.ndarray:
+                          conf: SparseOptimizerConfig,
+                          pulled_rows: Optional[jnp.ndarray] = None,
+                          first_idx: Optional[jnp.ndarray] = None
+                          ) -> jnp.ndarray:
     """Push with HOST-precomputed dedup (PassTable.dedup_for_push): no
     on-device sort. jnp.unique in push_sparse_dedup lowers to an XLA sort of
     the whole key vector per step — measured as the dominant cost of the
@@ -287,25 +290,37 @@ def push_sparse_hostdedup(slab: jnp.ndarray, uids: jnp.ndarray,
     perm:       [K] occurrence indices grouped by unique id
     inv_sorted: [K] nondecreasing merged-row index per permuted occurrence
     grads:      [K, push.width] per-occurrence push rows (padding all-zero)
+    pulled_rows/first_idx: optional pull-gather reuse (see _merged_new_rows)
     """
     new_rows = _merged_new_rows(slab, uids, perm, inv_sorted, grads, prng,
-                                layout, conf)
+                                layout, conf, pulled_rows, first_idx)
     # out-of-range padding ids drop; in-range ids are unique by construction
     return slab.at[uids].set(new_rows, mode="drop", unique_indices=True)
 
 
 def _merged_new_rows(slab, uids, perm, inv_sorted, grads, prng, layout,
-                     conf) -> jnp.ndarray:
+                     conf, pulled_rows=None, first_idx=None) -> jnp.ndarray:
     """Shared push prologue: occurrence gather → sorted segment-sum merge →
     row gather → in-table optimizer. Both slab-write strategies (scatter /
     rebuild) consume these rows — keep them in one place so merge or
-    lazy-init fixes can't diverge between the two."""
+    lazy-init fixes can't diverge between the two.
+
+    pulled_rows [K, width] + first_idx [K]: the step's pull already
+    gathered every occurrence's full row from this same pre-update slab, so
+    when given, each unique's row comes from pulled_rows[first_idx[j]] (a
+    [K]-domain gather; host stages first_idx next to the dedup) instead of
+    a second slab-wide gather. first_idx[j] must be an occurrence index of
+    uids[j] (padding tail entries may point anywhere: their g_show == 0
+    rows pass through untouched and are never written back)."""
     sorted_grads = jnp.take(grads, perm, axis=0, indices_are_sorted=False,
                             unique_indices=True)
     merged = jax.ops.segment_sum(sorted_grads, inv_sorted,
                                  num_segments=uids.shape[0],
                                  indices_are_sorted=True)
-    rows = jnp.take(slab, uids, axis=0, mode="clip")
+    if pulled_rows is not None and first_idx is not None:
+        rows = jnp.take(pulled_rows, first_idx, axis=0)
+    else:
+        rows = jnp.take(slab, uids, axis=0, mode="clip")
     return _dispatch_apply_push(rows, merged, prng, layout, conf,
                                 row_ids=uids)
 
@@ -314,7 +329,10 @@ def push_sparse_rebuild(slab: jnp.ndarray, uids: jnp.ndarray,
                         pos: jnp.ndarray, perm: jnp.ndarray,
                         inv_sorted: jnp.ndarray, grads: jnp.ndarray,
                         prng: jax.Array, layout: ValueLayout,
-                        conf: SparseOptimizerConfig) -> jnp.ndarray:
+                        conf: SparseOptimizerConfig,
+                        pulled_rows: Optional[jnp.ndarray] = None,
+                        first_idx: Optional[jnp.ndarray] = None
+                        ) -> jnp.ndarray:
     """push_sparse_hostdedup with the final row SCATTER replaced by a
     full-slab gather-rebuild: out[r] = new_rows[pos[r]] if pos[r] >= 0 else
     slab[r], with pos ([capacity] int32, -1 = untouched) precomputed on the
@@ -330,7 +348,7 @@ def push_sparse_rebuild(slab: jnp.ndarray, uids: jnp.ndarray,
     (box_wrapper_impl.h:373-522); the write strategy is ours.
     """
     new_rows = _merged_new_rows(slab, uids, perm, inv_sorted, grads, prng,
-                                layout, conf)
+                                layout, conf, pulled_rows, first_idx)
     sel = jnp.take(new_rows, jnp.clip(pos, 0, new_rows.shape[0] - 1),
                    axis=0)
     return jnp.where((pos >= 0)[:, None], sel, slab)

@@ -111,6 +111,24 @@ def dedup_ids(ids: np.ndarray, pad_base: int):
     return uids, perm, inv
 
 
+def first_occurrence_idx(perm: np.ndarray, inv: np.ndarray) -> np.ndarray:
+    """[K] int32 occurrence index of each dedup unique's FIRST occurrence:
+    first_idx[j] is a position into the batch's key vector whose id is
+    uids[j]. Lets the push reuse the pull's already-gathered rows
+    (pulled_rows[first_idx] == slab[uids], see _merged_new_rows) instead of
+    a second slab-wide gather. Padding tail entries point at occurrence 0;
+    their merged g_show is 0 so the row value is never used."""
+    K = perm.shape[0]
+    first = np.zeros(K, np.int32)
+    if K:
+        newseg = np.empty(K, bool)
+        newseg[0] = True
+        np.not_equal(inv[1:], inv[:-1], out=newseg[1:])
+        starts = perm[newseg]
+        first[:starts.shape[0]] = starts
+    return first
+
+
 def pos_for_rebuild(uids: np.ndarray, capacity: int) -> np.ndarray:
     """[capacity] int32 inverse of a dedup's uids for the
     push_write='rebuild' slab write: pos[r] = row index into the push's

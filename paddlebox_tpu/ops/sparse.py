@@ -26,10 +26,12 @@ from paddlebox_tpu.embedding import accessor as acc
 from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
 
 
-def pull_sparse(slab: jnp.ndarray, ids: jnp.ndarray,
-                layout: ValueLayout) -> jnp.ndarray:
-    """Gather per-key pull view [K, 3+D]: show, click, embed_w, embedx."""
-    rows = slab[ids]
+def pull_view_from_rows(rows: jnp.ndarray,
+                        layout: ValueLayout) -> jnp.ndarray:
+    """Pull view [K, 3+D] (show, click, embed_w, embedx) from already
+    gathered full rows — split out so a step can keep the full rows and
+    hand them to the push (which needs the state columns too) without a
+    second slab-wide gather."""
     D = layout.embedx_dim
     xw0 = layout.embedx_w
     return jnp.concatenate([
@@ -38,6 +40,12 @@ def pull_sparse(slab: jnp.ndarray, ids: jnp.ndarray,
         rows[:, acc.EMBED_W:acc.EMBED_W + 1],
         rows[:, xw0:xw0 + D],
     ], axis=1)
+
+
+def pull_sparse(slab: jnp.ndarray, ids: jnp.ndarray,
+                layout: ValueLayout) -> jnp.ndarray:
+    """Gather per-key pull view [K, 3+D]: show, click, embed_w, embedx."""
+    return pull_view_from_rows(slab[ids], layout)
 
 
 def build_push_grads(d_emb: jnp.ndarray, slots: jnp.ndarray,

@@ -34,13 +34,15 @@ from paddlebox_tpu.embedding.accessor import ValueLayout
 from paddlebox_tpu.embedding.optimizers import (push_sparse_hostdedup,
                                                 push_sparse_rebuild,
                                                 rebuild_uids)
-from paddlebox_tpu.embedding.pass_table import PassTable
+from paddlebox_tpu.embedding.pass_table import (PassTable,
+                                                first_occurrence_idx)
 from paddlebox_tpu.metrics.auc import MetricRegistry
 from paddlebox_tpu.models.base import ModelSpec
 from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm, seqpool_sum
 from paddlebox_tpu.ops.sparse import (build_push_grads,
                                       build_push_grads_extended,
-                                      pull_sparse, pull_sparse_extended)
+                                      pull_sparse, pull_sparse_extended,
+                                      pull_view_from_rows)
 from paddlebox_tpu.utils.timer import Timer
 
 
@@ -392,11 +394,14 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         return loss, preds
 
     def _pull(slab, ids):
+        """(emb_view, full_rows) — full_rows kept for the push's row reuse
+        (None on the expand path, which pulls a dual view)."""
         if use_expand:
-            return pull_sparse_extended(slab, ids, layout)  # (base, expand)
-        return pull_sparse(slab, ids, layout)
+            return pull_sparse_extended(slab, ids, layout), None
+        rows = slab[ids]
+        return pull_view_from_rows(rows, layout), rows
 
-    def _sparse_push(slab, demb, batch, sub):
+    def _sparse_push(slab, demb, batch, sub, pulled_rows=None):
         # per-key click = its instance's label (first task's label)
         key_label_src = batch["labels_" + model.task_names[0]] if multi_task \
             else batch["labels"]
@@ -421,12 +426,18 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         if uids is None:
             uids = rebuild_uids(batch["ids"], batch["perm"], batch["inv"],
                                 table.pass_capacity)
+        # pull-gather reuse: the pull already gathered every occurrence's
+        # full row from this same pre-update slab
+        fi = batch.get("first_idx") if pulled_rows is not None else None
+        rows = pulled_rows if fi is not None else None
         if "push_pos" in batch:
             return push_sparse_rebuild(slab, uids, batch["push_pos"],
                                        batch["perm"], batch["inv"],
-                                       push_grads, sub, layout, conf)
+                                       push_grads, sub, layout, conf,
+                                       pulled_rows=rows, first_idx=fi)
         return push_sparse_hostdedup(slab, uids, batch["perm"], batch["inv"],
-                                     push_grads, sub, layout, conf)
+                                     push_grads, sub, layout, conf,
+                                     pulled_rows=rows, first_idx=fi)
 
     # The slab is DONATED into the step: at production pass capacities the
     # slab is hundreds of MB and the pass holds exactly one live copy, so
@@ -443,7 +454,7 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         def loss_fn(params, emb):
             return forward(params, emb, batch, None)
 
-        emb = _pull(slab, batch["ids"])
+        emb, rows = _pull(slab, batch["ids"])
         grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)
         (loss, preds), (dparams, demb) = grad_fn(params, emb)
         updates, opt_state = dense_opt.update(dparams, opt_state, params)
@@ -452,7 +463,7 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
             params = dn_update_params(
                 model, params, emb, batch["segments"], _key_valid(batch),
                 batch_size, num_slots, use_cvm, batch.get("dense"))
-        slab = _sparse_push(slab, demb, batch, sub)
+        slab = _sparse_push(slab, demb, batch, sub, rows)
         return slab, params, opt_state, loss, preds, prng
 
     step = jax.jit(_step_impl, donate_argnums=(0,))
@@ -468,7 +479,7 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         def loss_fn(params, emb):
             return forward(params, emb, batch, None)
 
-        emb = _pull(slab, batch["ids"])
+        emb, rows = _pull(slab, batch["ids"])
         grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)
         (loss, preds), (dparams, demb) = grad_fn(params, emb)
         if has_summary:
@@ -484,12 +495,12 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
                 lambda old, new: new - old,
                 params["dn_summary"], new_params["dn_summary"]))
         flat_g = jax.flatten_util.ravel_pytree(dparams)[0]
-        slab = _sparse_push(slab, demb, batch, sub)
+        slab = _sparse_push(slab, demb, batch, sub, rows)
         return slab, flat_g, loss, preds, prng
 
     @jax.jit
     def eval_step(slab, params, batch):
-        emb = _pull(slab, batch["ids"])
+        emb, _ = _pull(slab, batch["ids"])
         _, preds = forward(params, emb, batch, None)
         return preds
 
@@ -663,6 +674,10 @@ class BoxTrainer:
             # batches never push, so skip the dedup + extra transfers
             uids, perm, inv = self.table.dedup_for_push(ids)
             out.update(perm=perm, inv=inv, uids=uids)
+            if not getattr(self.model, "use_expand", False):
+                # pull-row reuse index — the expand path pulls a dual view
+                # and never consumes it, so don't compute/transfer it there
+                out["first_idx"] = first_occurrence_idx(perm, inv)
             if self._push_write == "rebuild":
                 out["push_pos"] = self.table.pos_for_rebuild(uids)
         if b.dense is not None:
@@ -818,6 +833,14 @@ class BoxTrainer:
         skew the attribution report)."""
         if getattr(self, "_staged_jits", None) is None:
             fns = self.fns
+            layout = self.table.layout
+
+            @jax.jit
+            def stage_pull(slab, ids):
+                # mirrors the fused step's _pull: keep the full rows so the
+                # push stage reuses them exactly like the fused path does
+                rows = slab[ids]
+                return pull_view_from_rows(rows, layout), rows
 
             @jax.jit
             def stage_fwd_bwd(params, emb, batch):
@@ -833,7 +856,7 @@ class BoxTrainer:
                 params = optax.apply_updates(params, updates)
                 return fns.dn_update(params, emb, batch), opt_state
 
-            self._staged_jits = (stage_fwd_bwd, stage_dense_opt,
+            self._staged_jits = (stage_pull, stage_fwd_bwd, stage_dense_opt,
                                  jax.jit(fns.sparse_push,
                                          donate_argnums=(0,)))
         return self._staged_jits
@@ -846,7 +869,8 @@ class BoxTrainer:
         SAME forward/push/data_norm closures as the fused step (TrainStepFns
         exposes them), the same shuffle cadence, nan guard, dump and step
         accounting; prints a stage report at pass end."""
-        stage_fwd_bwd, stage_dense_opt, stage_push = self._profiled_stages()
+        stage_pull, stage_fwd_bwd, stage_dense_opt, stage_push = \
+            self._profiled_stages()
 
         timers = {n: Timer() for n in ("host_stage", "pull", "fwd_bwd",
                                        "dense_opt", "push")}
@@ -874,14 +898,15 @@ class BoxTrainer:
             batch = self.device_batch(b, self.table.lookup_ids(b.keys,
                                                                b.valid))
             timers["host_stage"].pause()
-            emb = timed(timers["pull"], self.table.pull, batch["ids"])
+            emb, rows = timed(timers["pull"], stage_pull, self.table.slab,
+                              batch["ids"])
             loss, preds, dp, demb = timed(
                 timers["fwd_bwd"], stage_fwd_bwd, self.params, emb, batch)
             self.params, self.opt_state = timed(
                 timers["dense_opt"], stage_dense_opt, self.params,
                 self.opt_state, dp, emb, batch)
             slab = timed(timers["push"], stage_push, self.table.slab, demb,
-                         batch, self.table.next_prng())
+                         batch, self.table.next_prng(), rows)
             self.table.set_slab(slab)
             self._step_count += 1
             losses.append(float(loss))

@@ -1,0 +1,73 @@
+"""Throughput vs batch size at a FIXED pass (1M-row slab, same keyspace).
+
+The rebuild slab write costs ~slab bytes regardless of touched rows
+(BASELINE.md axon characterization), and per-op dispatch floors charge
+per batch — so examples/sec should rise steeply with batch size until
+streaming costs take over. Measures the REAL trainer step at batch
+1024..8192, scatter vs rebuild, one chunk of batches covering the same
+~1M-key draw budget per dispatch.
+
+Usage: timeout 1800 python -u tools/batch_scale_probe.py [platform]
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms",
+                  sys.argv[1] if len(sys.argv) > 1 else "axon")
+
+import numpy as np
+
+from paddlebox_tpu.config import flags
+from tools.bench_util import (make_bench_trainer, make_ctr_batches,
+                              timed_scan_chain)
+
+NUM_SLOTS, MAX_LEN = 32, 4
+PASS_CAP = 1 << 20
+TOTAL_EXAMPLES = 8192          # one dispatch covers this many examples
+REPS = 6
+
+
+def run(batch, mode):
+    flags.set_flag("push_write", mode)
+    try:
+        n_batches = max(1, TOTAL_EXAMPLES // batch)
+        tr, feed = make_bench_trainer(PASS_CAP, batch=batch,
+                                      num_slots=NUM_SLOTS, max_len=MAX_LEN)
+        batches = make_ctr_batches(feed, n_batches, NUM_SLOTS, MAX_LEN,
+                                   seed=0)
+        tr.table.begin_feed_pass()
+        for b in batches:
+            tr.table.add_keys(b.keys[b.valid])
+        tr.table.end_feed_pass()
+        tr.table.begin_pass()
+        stacked = tr._stack_batches(batches)
+        state = (tr.table.slab, tr.params, tr.opt_state,
+                 jax.random.PRNGKey(0))
+        dt = timed_scan_chain(tr.fns.scan_steps, state, stacked, REPS)
+        ms_batch = dt / n_batches * 1e3
+        eps = batch * n_batches / dt
+        print(json.dumps({"batch": batch, "push_write": mode,
+                          "ms_per_batch": round(ms_batch, 3),
+                          "examples_per_sec": round(eps, 1)}), flush=True)
+        # no end_pass: the slab was donated into the timed chain (the live
+        # copy is inside timed_scan_chain's final state) — just drop it
+    finally:
+        flags.set_flag("push_write", "auto")
+
+
+def main():
+    dev = jax.devices()[0]
+    print(json.dumps({"device": str(dev), "platform": dev.platform}),
+          flush=True)
+    for batch in (1024, 2048, 4096, 8192):
+        for mode in ("rebuild", "scatter"):
+            run(batch, mode)
+
+
+if __name__ == "__main__":
+    main()

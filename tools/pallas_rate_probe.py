@@ -1,0 +1,134 @@
+"""Does Pallas/Mosaic sidestep the axon runtime's per-op costs?
+
+XLA ops measured ~90-130 GB/s streaming + ms-scale floors (BASELINE.md).
+If a Pallas kernel streams at real v5e HBM rate (~819 GB/s), the hot
+path belongs in a few fused kernels. Measures: pallas copy at 64/256 MB,
+pallas gather-rows (the pull shape), and the same in XLA for reference.
+
+Usage: timeout 900 python -u tools/pallas_rate_probe.py [platform]
+"""
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms",
+                  sys.argv[1] if len(sys.argv) > 1 else "axon")
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+REPS = 5
+ITERS = 8
+
+
+def timed(name, fn, *args, bytes_moved=None):
+    out = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    ms = (time.perf_counter() - t0) / REPS / ITERS * 1e3
+    rec = {"op": name, "ms_per_call": round(ms, 4)}
+    if bytes_moved:
+        rec["gb_per_s"] = round(bytes_moved / (ms * 1e-3) / 1e9, 1)
+    print(json.dumps(rec), flush=True)
+
+
+def chain(body):
+    def run(carry, *args):
+        def step(_, c):
+            return body(c, *args)
+        return lax.fori_loop(0, ITERS, step, carry)
+    return jax.jit(run)
+
+
+def copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 0.999 + 0.001
+
+
+def pallas_scale(x, block_rows):
+    n = x.shape[0]
+    return pl.pallas_call(
+        copy_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid=(n // block_rows,),
+        in_specs=[pl.BlockSpec((block_rows, x.shape[1]),
+                               lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((block_rows, x.shape[1]), lambda i: (i, 0)),
+    )(x)
+
+
+def gather_kernel(idx_ref, slab_ref, o_ref, *, rows_per_step):
+    i = pl.program_id(0)
+    def body(j, _):
+        r = idx_ref[i * rows_per_step + j]
+        o_ref[j, :] = slab_ref[r, :]
+        return 0
+    lax.fori_loop(0, rows_per_step, body, 0)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(json.dumps({"device": str(dev), "platform": dev.platform}),
+          flush=True)
+    rng = np.random.RandomState(0)
+
+    for mb, rows in ((64, 1 << 17), (256, 1 << 19)):
+        x = jnp.asarray(rng.rand(rows, 128).astype(np.float32))
+        f = functools.partial(pallas_scale, block_rows=1024)
+        timed(f"pallas_scale_{mb}MB", chain(lambda v: f(v)), x,
+              bytes_moved=2 * x.size * 4)
+        timed(f"xla_scale_{mb}MB", chain(lambda v: v * 0.999 + 0.001), x,
+              bytes_moved=2 * x.size * 4)
+
+    # pallas row gather at pull shapes: 131k rows of 128 lanes from 1M-row
+    # table (the slab padded to lane width for a fair kernel)
+    CAP, K = 1 << 20, 131072
+    slab = jnp.asarray(rng.rand(CAP, 128).astype(np.float32))
+    idx = jnp.asarray(np.sort(rng.choice(CAP - 1, K, replace=False))
+                      .astype(np.int32))
+    RPS = 8
+
+    def pgather(i, s):
+        return pl.pallas_call(
+            functools.partial(gather_kernel, rows_per_step=RPS),
+            out_shape=jax.ShapeDtypeStruct((K, 128), jnp.float32),
+            grid=(K // RPS,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec((RPS, 128), lambda g: (g, 0)),
+            interpret=False,
+        )(i, s)
+
+    # both sides consume the FULL gathered result (a slice-of-gather can be
+    # folded into a 1-row gather by the simplifier, which would invalidate
+    # the comparison)
+    try:
+        def g(c, i, s):
+            return c + jnp.sum(pgather(i, s), keepdims=True)[:1, :1]
+        timed("pallas_gather_131k_rows", chain(g), jnp.zeros((1, 1)),
+              idx, slab, bytes_moved=2 * K * 128 * 4)
+    except Exception as e:
+        print(json.dumps({"op": "pallas_gather_131k_rows",
+                          "error": str(e)[:300]}), flush=True)
+
+    def xg(c, i, s):
+        return c + jnp.sum(jnp.take(s, i, axis=0, mode="clip"),
+                           keepdims=True)[:1, :1]
+    timed("xla_gather_131k_rows_W128", chain(xg), jnp.zeros((1, 1)),
+          idx, slab, bytes_moved=2 * K * 128 * 4)
+
+
+if __name__ == "__main__":
+    main()

@@ -27,9 +27,11 @@ import numpy as np
 from tools.bench_util import (make_bench_trainer, make_ctr_batches,
                               timed_scan_chain)
 
-BATCH, NUM_SLOTS, MAX_LEN = 1024, 32, 4
+BATCH = int(os.environ.get("ABLATE_BATCH", "1024"))
+NUM_SLOTS, MAX_LEN = 32, 4
 PASS_CAP = 1 << 20
-CHUNK, REPS = 8, 6
+CHUNK = max(1, 8192 // BATCH)
+REPS = 6
 
 
 def run_variant(name, patches):
