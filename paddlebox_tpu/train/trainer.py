@@ -61,6 +61,10 @@ class TrainStepFns:
     # "6.8x on v5e" figure was measured with the axon backend's broken
     # block_until_ready and is retracted, see BASELINE.md)
     scan_steps: Optional[Callable] = None
+    # chunk-synchronous sparse megastep (TrainerConfig.sparse_chunk_sync):
+    # (slab, params, opt_state, stacked, cpush, prng) -> (slab, params,
+    # opt_state, losses, preds, prng) — one pull + one merged push per chunk
+    scan_chunk: Optional[Callable] = None
     # the fused step's building blocks, exposed so the staged profiling
     # mode (train_pass_profiled) runs EXACTLY the fused semantics — cvm
     # flag, mixed precision, rank_offset, data_norm, dedup guard included
@@ -99,7 +103,8 @@ def make_scan(step_fn: Callable, extra_carry: int = 0) -> Callable:
 def run_scan_chunks(scan_call: Callable, items, chunk: int,
                     stack_fn: Callable, carry: Tuple,
                     on_chunk: Callable, timer=None,
-                    n_items: Optional[int] = None):
+                    n_items: Optional[int] = None,
+                    chunk1_ok: bool = False):
     """Drive the megastep over full chunks of `items`, double-buffered:
     chunk i+1 is host-stacked and dispatched BEFORE chunk i's results are
     pulled to host, so H2D staging and metric extraction overlap device
@@ -120,7 +125,11 @@ def run_scan_chunks(scan_call: Callable, items, chunk: int,
     if n_items is None:
         n_items = len(items)
     it = iter(items)
-    n_full = (n_items // chunk) * chunk if chunk > 1 else 0
+    # chunk=1 normally means "megastep off" (per-step path); chunk1_ok
+    # forces chunking anyway — the chunk-sync sparse mode needs its
+    # 1-batch chunks to run through the chunk scan, not fall through
+    n_full = ((n_items // chunk) * chunk
+              if (chunk > 1 or chunk1_ok) else 0)
     pending = None  # (lo, group, losses_dev, preds_dev)
 
     def drain(p):
@@ -310,7 +319,8 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
                     batch_size: int, num_slots: int,
                     use_cvm: bool = True,
                     async_dense: bool = False,
-                    compute_dtype: str = "float32") -> TrainStepFns:
+                    compute_dtype: str = "float32",
+                    sparse_chunk: int = 0) -> TrainStepFns:
     conf = table.optimizer
     multi_task = len(getattr(model, "task_names", ("ctr",))) > 1
     wants_rank_offset = model_accepts_rank_offset(model)
@@ -342,14 +352,16 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
     def _key_slots(batch):
         return batch["segments"] % num_slots
 
-    def forward(params, emb, batch, dn_extra):
+    def forward(params, emb, batch, dn_extra, pooled=None):
         expand_emb = None
         if use_expand:
             emb, expand_emb = emb
-        # packer/columnar batches carry nondecreasing segments by contract
-        pooled = fused_seqpool_cvm(
-            emb, batch["segments"], _key_valid(batch), batch_size, num_slots,
-            use_cvm=use_cvm, sorted_segments=True)
+        if pooled is None:
+            # packer/columnar batches carry nondecreasing segments by
+            # contract
+            pooled = fused_seqpool_cvm(
+                emb, batch["segments"], _key_valid(batch), batch_size,
+                num_slots, use_cvm=use_cvm, sorted_segments=True)
         dense_in = batch.get("dense")
         if mixed:
             # matmuls ride the MXU in bf16; logits return to f32 for the
@@ -469,6 +481,90 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
     step = jax.jit(_step_impl, donate_argnums=(0,))
     scan_steps = make_scan(_step_impl)
 
+    scan_chunk_fn = None
+    if sparse_chunk:
+        if use_expand or has_summary or async_dense:
+            raise ValueError(
+                "sparse_chunk_sync is unsupported with expand embeddings, "
+                "data_norm summary params, or async dense — these need "
+                "per-batch table/emb state")
+        C = sparse_chunk
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def scan_chunk_fn(slab, params, opt_state, stacked, cpush, prng):
+            """Chunk-synchronous sparse megastep (TrainerConfig.
+            sparse_chunk_sync): ONE pull at chunk-start state + ONE merged
+            push for the whole chunk; dense adam scans per batch exactly.
+            The C seqpools fuse into one segment-sum by offsetting each
+            batch's segment ids (out_dim stays per (ins, slot)); the dense
+            bwd emits pooled-space cotangents [B, S, out] per batch (far
+            smaller than key space), which one pool-VJP expands back to
+            per-key push grads for the merged update.
+
+            cpush: chunk-level host dedup over the flat [C*K] occurrence
+            space (uids/perm/inv/first, pos in rebuild mode)."""
+            prng, sub = jax.random.split(prng)
+            K = stacked["ids"].shape[1]
+            ids_flat = stacked["ids"].reshape(C * K)
+            rows = slab[ids_flat]
+            valid_flat = ids_flat != padding_id
+            seg_dtype = stacked["segments"].dtype
+            seg_flat = (stacked["segments"]
+                        + (jnp.arange(C, dtype=seg_dtype)
+                           * (batch_size * num_slots))[:, None]
+                        ).reshape(C * K)
+            emb_flat = pull_view_from_rows(rows, layout)
+
+            def pool(e):
+                return fused_seqpool_cvm(
+                    e, seg_flat, valid_flat, C * batch_size, num_slots,
+                    use_cvm=use_cvm, sorted_segments=True)
+
+            pooled, pool_vjp = jax.vjp(pool, emb_flat)
+            pooled_c = pooled.reshape((C, batch_size) + pooled.shape[1:])
+
+            def body(carry, xs):
+                params, opt_state = carry
+                pooled_b, batch = xs
+
+                def loss_fn(params, pooled_b):
+                    return forward(params, None, batch, None,
+                                   pooled=pooled_b)
+
+                grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1),
+                                             has_aux=True)
+                (loss, preds), (dp, dpooled) = grad_fn(params, pooled_b)
+                updates, opt_state = dense_opt.update(dp, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), (loss, preds, dpooled)
+
+            # the dense body never touches the [K]-sized leaves (pooling
+            # already happened) — scanning them as xs would pay a per-
+            # iteration slice on each, which is ms-scale on some runtimes
+            dense_xs = {k: v for k, v in stacked.items()
+                        if k not in ("ids", "segments")}
+            (params, opt_state), (losses, preds, dpooled_c) = jax.lax.scan(
+                body, (params, opt_state), (pooled_c, dense_xs))
+            (d_emb_flat,) = pool_vjp(
+                dpooled_c.reshape((C * batch_size,) + dpooled_c.shape[2:]))
+            label_key = ("labels_" + model.task_names[0] if multi_task
+                         else "labels")
+            clicks_flat = stacked[label_key].reshape(
+                C * batch_size)[seg_flat // num_slots]
+            push_grads = build_push_grads(
+                d_emb_flat, seg_flat % num_slots, clicks_flat, valid_flat)
+            if "pos" in cpush:
+                slab = push_sparse_rebuild(
+                    slab, cpush["uids"], cpush["pos"], cpush["perm"],
+                    cpush["inv"], push_grads, sub, layout, conf,
+                    pulled_rows=rows, first_idx=cpush["first"])
+            else:
+                slab = push_sparse_hostdedup(
+                    slab, cpush["uids"], cpush["perm"], cpush["inv"],
+                    push_grads, sub, layout, conf,
+                    pulled_rows=rows, first_idx=cpush["first"])
+            return slab, params, opt_state, losses, preds, prng
+
     @functools.partial(jax.jit, donate_argnums=(0,))
     def step_async(slab, params, batch, prng):
         """Async-dense variant: dense grads come back flat for the host
@@ -515,6 +611,7 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
                         eval_step=eval_step,
                         batch_size=batch_size, num_slots=num_slots,
                         scan_steps=None if async_dense else scan_steps,
+                        scan_chunk=scan_chunk_fn,
                         forward=lambda params, emb, batch: forward(
                             params, emb, batch, None),
                         sparse_push=_sparse_push,
@@ -558,11 +655,16 @@ class BoxTrainer:
         self.num_slots = len(feed.used_sparse_slots())
         self.async_mode = (self.cfg.async_mode
                            or self.cfg.sync_mode == "async")
+        self.sparse_chunk_sync = bool(self.cfg.sparse_chunk_sync)
+        if self.sparse_chunk_sync and self.cfg.scan_chunk < 1:
+            raise ValueError("sparse_chunk_sync needs scan_chunk >= 1")
         self.fns = make_train_step(
             model, self.table.layout, table_cfg, self.dense_opt,
             feed.batch_size, self.num_slots, use_cvm,
             async_dense=self.async_mode,
-            compute_dtype=self.cfg.compute_dtype)
+            compute_dtype=self.cfg.compute_dtype,
+            sparse_chunk=(self.cfg.scan_chunk
+                          if self.sparse_chunk_sync else 0))
         self.async_table = None
         self._unravel = None
         if self.async_mode:
@@ -642,7 +744,12 @@ class BoxTrainer:
         return pool
 
     def _stage_one(self, b: PackedBatch) -> Dict[str, np.ndarray]:
-        return self.host_batch(b, self.table.lookup_ids(b.keys, b.valid))
+        # chunk-sync megasteps use ONE chunk-level dedup (_stack_batches);
+        # computing the per-batch products here would be pure waste in the
+        # staging hot path (tail batches go through host_batch directly
+        # and still get them)
+        return self.host_batch(b, self.table.lookup_ids(b.keys, b.valid),
+                               skip_push_dedup=self.sparse_chunk_sync)
 
     def _stack_batches(self, group: List[PackedBatch]) -> Dict[str, jnp.ndarray]:
         """Stack a chunk of packed batches on a leading scan axis — stacked
@@ -653,11 +760,28 @@ class BoxTrainer:
             hosts = list(pool.map(self._stage_one, group))
         else:
             hosts = [self._stage_one(b) for b in group]
+        if self.sparse_chunk_sync:
+            # chunk-synchronous sparse: ONE dedup over the chunk's flat
+            # occurrence space replaces the per-batch dedup products (which
+            # are stripped from the stacked dict — the chunk scan never
+            # reads them)
+            from paddlebox_tpu.embedding.pass_table import (
+                dedup_ids, pos_for_rebuild)
+            ids_flat = np.concatenate([h["ids"] for h in hosts])
+            uids, perm, inv = dedup_ids(ids_flat, self.table.capacity)
+            cpush = {"uids": uids, "perm": perm, "inv": inv,
+                     "first": first_occurrence_idx(perm, inv)}
+            if self._push_write == "rebuild":
+                cpush["pos"] = pos_for_rebuild(uids, self.table.capacity)
+            drop = ("perm", "inv", "uids", "first_idx", "push_pos")
+            stacked = {k: jnp.asarray(np.stack([h[k] for h in hosts]))
+                       for k in hosts[0] if k not in drop}
+            return stacked, {k: jnp.asarray(v) for k, v in cpush.items()}
         return {k: jnp.asarray(np.stack([h[k] for h in hosts]))
                 for k in hosts[0]}
 
-    def host_batch(self, b: PackedBatch,
-                   ids: np.ndarray) -> Dict[str, np.ndarray]:
+    def host_batch(self, b: PackedBatch, ids: np.ndarray,
+                   skip_push_dedup: bool = False) -> Dict[str, np.ndarray]:
         # per-key slots/valid are derived on device (make_train_step);
         # ids/segments/perm/inv/uids ride the H2D path, plus the [capacity]
         # push_pos map in push_write=rebuild mode (the largest transfer —
@@ -668,7 +792,7 @@ class BoxTrainer:
             "ins_valid": b.ins_valid,
             "labels": b.labels,
         }
-        if not self.table.test_mode:
+        if not self.table.test_mode and not skip_push_dedup:
             # train batches carry the host-precomputed push dedup (uids
             # included: rebuilding them on device is a scatter); eval
             # batches never push, so skip the dedup + extra transfers
@@ -735,8 +859,9 @@ class BoxTrainer:
         prng = self.table.next_prng()
         chunk = max(1, self.cfg.scan_chunk)
         pending = worker_batches[0]
-        if (self.fns.scan_steps is not None and chunk > 1
-                and len(pending) >= chunk):
+        use_scan = (self.fns.scan_chunk is not None or
+                    (self.fns.scan_steps is not None and chunk > 1))
+        if use_scan and len(pending) >= chunk:
             # megastep path: scan whole chunks in one dispatch each; the
             # remainder falls through to the per-step loop below
 
@@ -752,16 +877,25 @@ class BoxTrainer:
                     if self.dump_writer is not None:
                         self._dump_batch(preds_j, b)
 
-            def scan_call(carry, stacked):
-                slab, params, opt_state, losses, preds, prng = \
-                    self.fns.scan_steps(carry[0], carry[1], carry[2],
-                                        stacked, carry[3])
-                return (slab, params, opt_state, prng), losses, preds
+            if self.sparse_chunk_sync:
+                def scan_call(carry, staged):
+                    stacked, cpush = staged
+                    slab, params, opt_state, losses, preds, prng = \
+                        self.fns.scan_chunk(carry[0], carry[1], carry[2],
+                                            stacked, cpush, carry[3])
+                    return (slab, params, opt_state, prng), losses, preds
+            else:
+                def scan_call(carry, stacked):
+                    slab, params, opt_state, losses, preds, prng = \
+                        self.fns.scan_steps(carry[0], carry[1], carry[2],
+                                            stacked, carry[3])
+                    return (slab, params, opt_state, prng), losses, preds
 
             carry = (self.table.slab, self.params, self.opt_state, prng)
             carry, chunk_losses, n_done = run_scan_chunks(
                 scan_call, pending, chunk, self._stack_batches,
-                carry, on_chunk, timer=self.timers["step"])
+                carry, on_chunk, timer=self.timers["step"],
+                chunk1_ok=self.sparse_chunk_sync)
             slab, self.params, self.opt_state, prng = carry
             self.table.set_slab(slab)
             losses.extend(chunk_losses)

@@ -439,9 +439,9 @@ def test_first_occurrence_idx_alignment():
 
 
 def test_push_pull_row_reuse_matches_slab_gather():
-    init_range = 1e-3
     """push with pulled_rows/first_idx (the fused step's reuse) must be
     bit-identical to the slab-gather path, scatter and rebuild both."""
+    init_range = 1e-3
     from paddlebox_tpu.embedding.optimizers import (push_sparse_hostdedup,
                                                     push_sparse_rebuild)
     from paddlebox_tpu.embedding.pass_table import (first_occurrence_idx,

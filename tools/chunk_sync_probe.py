@@ -1,0 +1,91 @@
+"""Measure chunk-synchronous sparse mode (sparse_chunk_sync) on the chip
+vs the exact per-batch step, at bench shapes.
+
+Usage: timeout 1500 python -u tools/chunk_sync_probe.py [platform] [chunks]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms",
+                  sys.argv[1] if len(sys.argv) > 1 else "axon")
+
+import numpy as np
+
+from tools.bench_util import make_ctr_batches, timed_scan_chain
+
+BATCH, NUM_SLOTS, MAX_LEN = 1024, 32, 4
+PASS_CAP = 1 << 20
+REPS = 6
+
+
+def make_trainer(chunk_sync, scan_chunk):
+    from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
+                                              TableConfig, TrainerConfig)
+    from paddlebox_tpu.data.generator import default_feed_config
+    from paddlebox_tpu.models.base import ModelSpec
+    from paddlebox_tpu.models.deepfm import DeepFM
+    from paddlebox_tpu.train.trainer import BoxTrainer
+
+    feed = default_feed_config(num_slots=NUM_SLOTS, batch_size=BATCH,
+                               max_len=MAX_LEN)
+    table_cfg = TableConfig(
+        embedx_dim=8, pass_capacity=PASS_CAP,
+        optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                        mf_initial_range=1e-3))
+    model_spec = ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + 8)
+    model = DeepFM(model_spec, hidden=(512, 256, 128))
+    dtype = ("float32" if jax.default_backend() == "cpu" else "bfloat16")
+    return BoxTrainer(model, table_cfg, feed,
+                      TrainerConfig(dense_lr=1e-3, compute_dtype=dtype,
+                                    scan_chunk=scan_chunk,
+                                    sparse_chunk_sync=chunk_sync),
+                      seed=0), feed
+
+
+def run(chunk_sync, C):
+    tr, feed = make_trainer(chunk_sync, C)
+    batches = make_ctr_batches(feed, C, NUM_SLOTS, MAX_LEN, seed=0)
+    tr.table.begin_feed_pass()
+    for b in batches:
+        tr.table.add_keys(b.keys[b.valid])
+    tr.table.end_feed_pass()
+    tr.table.begin_pass()
+    staged = tr._stack_batches(batches)
+    prng = jax.random.PRNGKey(0)
+    if chunk_sync:
+        stacked, cpush = staged
+
+        def call(slab, params, opt, _stacked, prng):
+            return tr.fns.scan_chunk(slab, params, opt, _stacked, cpush,
+                                     prng)
+        scan, arg = call, stacked
+    else:
+        scan, arg = tr.fns.scan_steps, staged
+    state = (tr.table.slab, tr.params, tr.opt_state, prng)
+    dt = timed_scan_chain(scan, state, arg, REPS)
+    ms = dt / C * 1e3
+    print(json.dumps({"mode": "chunk_sync" if chunk_sync else "exact",
+                      "chunk": C, "ms_per_batch": round(ms, 3),
+                      "examples_per_sec": round(BATCH / (dt / C), 1)}),
+          flush=True)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(json.dumps({"device": str(dev), "platform": dev.platform}),
+          flush=True)
+    chunks = [int(c) for c in (sys.argv[2].split(",")
+                               if len(sys.argv) > 2 else ["8", "16"])]
+    run(False, 8)
+    for C in chunks:
+        run(True, C)
+
+
+if __name__ == "__main__":
+    main()
