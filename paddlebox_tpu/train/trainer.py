@@ -34,8 +34,9 @@ from paddlebox_tpu.embedding.accessor import ValueLayout
 from paddlebox_tpu.embedding.optimizers import (push_sparse_hostdedup,
                                                 push_sparse_rebuild,
                                                 rebuild_uids)
-from paddlebox_tpu.embedding.pass_table import (PassTable,
-                                                first_occurrence_idx)
+from paddlebox_tpu.embedding.pass_table import (PassTable, dedup_ids,
+                                                first_occurrence_idx,
+                                                pos_for_rebuild)
 from paddlebox_tpu.metrics.auc import MetricRegistry
 from paddlebox_tpu.models.base import ModelSpec
 from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm, seqpool_sum
@@ -762,20 +763,16 @@ class BoxTrainer:
             hosts = [self._stage_one(b) for b in group]
         if self.sparse_chunk_sync:
             # chunk-synchronous sparse: ONE dedup over the chunk's flat
-            # occurrence space replaces the per-batch dedup products (which
-            # are stripped from the stacked dict — the chunk scan never
-            # reads them)
-            from paddlebox_tpu.embedding.pass_table import (
-                dedup_ids, pos_for_rebuild)
+            # occurrence space (the per-batch products were never computed
+            # — _stage_one staged with skip_push_dedup)
             ids_flat = np.concatenate([h["ids"] for h in hosts])
             uids, perm, inv = dedup_ids(ids_flat, self.table.capacity)
             cpush = {"uids": uids, "perm": perm, "inv": inv,
                      "first": first_occurrence_idx(perm, inv)}
             if self._push_write == "rebuild":
                 cpush["pos"] = pos_for_rebuild(uids, self.table.capacity)
-            drop = ("perm", "inv", "uids", "first_idx", "push_pos")
             stacked = {k: jnp.asarray(np.stack([h[k] for h in hosts]))
-                       for k in hosts[0] if k not in drop}
+                       for k in hosts[0]}
             return stacked, {k: jnp.asarray(v) for k, v in cpush.items()}
         return {k: jnp.asarray(np.stack([h[k] for h in hosts]))
                 for k in hosts[0]}

@@ -143,8 +143,7 @@ def test_chunk_sync_learns(tmp_path):
 
 
 def test_chunk_sync_rejects_expand_and_summary(tmp_path):
-    files, feed = make_data(tmp_path)
-    from paddlebox_tpu.models import CtrDnn
+    _, feed = make_data(tmp_path)
     table_cfg = TableConfig(embedx_dim=D, pass_capacity=1 << 12,
                             expand_embed_dim=4,
                             optimizer=SparseOptimizerConfig())
@@ -154,3 +153,36 @@ def test_chunk_sync_rejects_expand_and_summary(tmp_path):
     with pytest.raises(ValueError, match="sparse_chunk_sync"):
         BoxTrainer(model, table_cfg, feed,
                    TrainerConfig(sparse_chunk_sync=True, scan_chunk=2))
+    # data_norm summary models and async dense hit the same gate
+    plain_cfg = TableConfig(embedx_dim=D, pass_capacity=1 << 12,
+                            optimizer=SparseOptimizerConfig())
+    dn = CtrDnn(ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + D),
+                hidden=(16,), use_data_norm=True)
+    with pytest.raises(ValueError, match="sparse_chunk_sync"):
+        BoxTrainer(dn, plain_cfg, feed,
+                   TrainerConfig(sparse_chunk_sync=True, scan_chunk=2))
+    plain = CtrDnn(ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + D),
+                   hidden=(16,))
+    with pytest.raises(ValueError, match="sparse_chunk_sync"):
+        BoxTrainer(plain, plain_cfg, feed,
+                   TrainerConfig(sparse_chunk_sync=True, scan_chunk=2,
+                                 async_mode=True))
+    from paddlebox_tpu.parallel.mesh_tower import MeshTowerTrainer
+    from paddlebox_tpu.models.wide_tower import TpDeepFM
+    tp = TpDeepFM(ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + D),
+                  n_shards=8, d_wide=32, d_mid=8)
+    with pytest.raises(ValueError, match="sparse_chunk_sync"):
+        MeshTowerTrainer(tp, plain_cfg, feed,
+                         TrainerConfig(sparse_chunk_sync=True))
+
+
+def test_parallel_trainers_reject_chunk_sync(tmp_path):
+    from paddlebox_tpu.parallel.sharded_trainer import ShardedBoxTrainer
+    _, feed = make_data(tmp_path, lines=64)
+    table_cfg = TableConfig(embedx_dim=D, pass_capacity=1 << 10,
+                            optimizer=SparseOptimizerConfig())
+    model = CtrDnn(ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + D),
+                   hidden=(16,))
+    with pytest.raises(ValueError, match="sparse_chunk_sync"):
+        ShardedBoxTrainer(model, table_cfg, feed,
+                          TrainerConfig(sparse_chunk_sync=True))

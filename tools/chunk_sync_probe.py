@@ -6,7 +6,6 @@ Usage: timeout 1500 python -u tools/chunk_sync_probe.py [platform] [chunks]
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -14,8 +13,6 @@ import jax
 
 jax.config.update("jax_platforms",
                   sys.argv[1] if len(sys.argv) > 1 else "axon")
-
-import numpy as np
 
 from tools.bench_util import make_ctr_batches, timed_scan_chain
 
