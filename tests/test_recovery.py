@@ -265,3 +265,15 @@ def test_sharded_crash_resume_matches_uninterrupted(data, tmp_path):
                     jax.tree.leaves(t2.params)):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=1e-5,
                                    atol=1e-7)
+
+
+def test_crash_resume_under_rebuild_push(data, tmp_path):
+    """Crash-resume parity must hold with push_write='rebuild' (the
+    tpu-side default via 'auto'): the recovered run's state matches the
+    uninterrupted one exactly, as in the scatter-mode test above."""
+    from paddlebox_tpu.config import flags
+    flags.set_flag("push_write", "rebuild")
+    try:
+        test_crash_resume_matches_uninterrupted(data, tmp_path)
+    finally:
+        flags.set_flag("push_write", "auto")

@@ -186,3 +186,33 @@ def test_parallel_trainers_reject_chunk_sync(tmp_path):
     with pytest.raises(ValueError, match="sparse_chunk_sync"):
         ShardedBoxTrainer(model, table_cfg, feed,
                           TrainerConfig(sparse_chunk_sync=True))
+
+
+def test_chunk_sync_dump_and_metrics(tmp_path):
+    """DumpField writers and streaming metrics compose with the chunk
+    megastep: every batch's preds/labels stream once, dump lines appear."""
+    import os
+    files, feed = make_data(tmp_path / "d", lines=256, mb=64)
+    table_cfg = TableConfig(
+        embedx_dim=D, pass_capacity=1 << 13,
+        optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0))
+    model = CtrDnn(ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + D),
+                   hidden=(16,))
+    tr = BoxTrainer(model, table_cfg, feed,
+                    TrainerConfig(dense_lr=1e-3, scan_chunk=2,
+                                  sparse_chunk_sync=True,
+                                  dump_fields=("pred", "label"),
+                                  dump_fields_path=str(tmp_path / "dump")))
+    tr.metrics.init_metric("auc", "label", "pred", table_size=1 << 14,
+                           mask_var="mask")
+    ds = BoxDataset(feed, read_threads=1)
+    ds.set_filelist(files)
+    stats = tr.train_pass(ds)
+    tr.close()
+    assert stats["instances"] == 256
+    msg = tr.metrics.get_metric_msg("auc")
+    assert msg["size"] == 256            # every instance streamed once
+    dumped = os.listdir(tmp_path / "dump")
+    assert dumped
+    text = open(os.path.join(tmp_path / "dump", dumped[0])).read()
+    assert "pred:" in text and "label:" in text
