@@ -960,6 +960,12 @@ class ShardedCtrPipelineRunner:
         self.P = int(mesh.devices.size)
         self.fleet = fleet
         self.multiprocess = jax.process_count() > 1
+        # resolved ONCE — per-batch re-resolution would let a mid-pass flag
+        # flip change the batch pytree (retrace of the shard_map step) and
+        # mix write modes inside one pass (same policy as the trainers)
+        from paddlebox_tpu.train.trainer import resolve_push_write
+        self._push_write = (resolve_push_write()
+                            if not self.multiprocess else "scatter")
         mesh_devs = list(self.mesh.devices.flat)
         pid = jax.process_index()
         self.local_positions = [i for i, d in enumerate(mesh_devs)
@@ -1025,7 +1031,7 @@ class ShardedCtrPipelineRunner:
     # ------------------------------------------------------------- jit step
     def _build_step(self):
         from paddlebox_tpu.embedding.optimizers import (
-            push_sparse_dedup, push_sparse_hostdedup)
+            push_sparse_dedup, push_sparse_hostdedup, push_sparse_rebuild)
         from paddlebox_tpu.ops.sparse import (build_push_grads,
                                               build_push_grads_extended,
                                               pull_sparse,
@@ -1157,7 +1163,14 @@ class ShardedCtrPipelineRunner:
                 jnp.where(kv[:, None], pg, 0.0))
             recv_g = jax.lax.all_to_all(
                 bucket_g.reshape(Pn, KB, -1), flat, 0, 0, tiled=True)
-            if "push_uids" in batch:
+            if "push_pos" in batch:
+                # scatter-free shard write: host-staged pos map turns the
+                # slab write into gather+select (push_write=rebuild)
+                slab = push_sparse_rebuild(
+                    slab, batch["push_uids"], batch["push_pos"],
+                    batch["push_perm"], batch["push_inv"],
+                    recv_g.reshape(Pn * KB, -1), sub, layout, conf)
+            elif "push_uids" in batch:
                 # incoming ids are host-known in a single process, so the
                 # shard-side dedup was precomputed (device_batch) — no
                 # per-step on-device jnp.unique sort (the dominant
@@ -1279,7 +1292,12 @@ class ShardedCtrPipelineRunner:
             # so the step needs no on-device sort — same trick as the
             # sharded trainer (multi-process keeps the device path:
             # incoming ids live on peers; eval never pushes)
-            from paddlebox_tpu.embedding.pass_table import dedup_ids
+            from paddlebox_tpu.embedding.pass_table import (dedup_ids,
+                                                            pos_for_rebuild)
+            rebuild = self._push_write == "rebuild"
+            # serial per shard: this runner's staging is synchronous (no
+            # stager pool like shard_batches'); on the 1-core CI box a pool
+            # wouldn't overlap anyway — grow a stager before optimizing
             for d in range(self.P):
                 incoming = np.concatenate(
                     [leaves["buckets"][src][d] for src in range(self.P)])
@@ -1287,6 +1305,11 @@ class ShardedCtrPipelineRunner:
                 leaves.setdefault("push_uids", []).append(uids)
                 leaves.setdefault("push_perm", []).append(perm)
                 leaves.setdefault("push_inv", []).append(inv)
+                if rebuild:
+                    # scatter-free shard write (push_write flag; the same
+                    # per-shard pos map the sharded trainer stages)
+                    leaves.setdefault("push_pos", []).append(
+                        pos_for_rebuild(uids, self.table.shard_cap))
         return {k: self._put_flat(np.stack(v)) for k, v in leaves.items()}
 
     def begin_pass(self) -> None:

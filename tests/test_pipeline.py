@@ -923,3 +923,35 @@ def test_ctr_pipeline_dp_learns(tmp_path):
         ds.release_memory()
     assert stats["steps"] >= 4
     assert losses[-1] < losses[0] - 0.01, losses
+
+
+def test_sharded_pipeline_push_write_rebuild_matches_scatter(tmp_path):
+    """push_write='rebuild' through the sharded pipeline runner (per-shard
+    pos maps staged next to the a2a dedup) must train bit-identically to
+    the scatter path."""
+    from paddlebox_tpu.config import flags
+    from paddlebox_tpu.data import BoxDataset
+    from paddlebox_tpu.parallel.pipeline import ShardedCtrPipelineRunner
+
+    files, feed = _ctr_setup(tmp_path, n_files=1, lines=128, mb=16)
+    table_cfg = _ctr_table(cap=1 << 12)
+    states = {}
+    for mode in ("scatter", "rebuild"):
+        flags.set_flag("push_write", mode)
+        try:
+            r = ShardedCtrPipelineRunner(table_cfg, feed, n_stages=4,
+                                         d_model=24, layers_per_stage=1,
+                                         lr=1e-2, n_micro=4, seed=6)
+            assert r._push_write == mode
+            ds = BoxDataset(feed, read_threads=1)
+            ds.set_filelist(files)
+            r.train_pass(ds)
+            ks, vs = r.table.store_view().state_items()
+            o = np.argsort(ks)
+            states[mode] = (ks[o], vs[o])
+        finally:
+            flags.set_flag("push_write", "auto")
+    np.testing.assert_array_equal(states["scatter"][0],
+                                  states["rebuild"][0])
+    np.testing.assert_array_equal(states["scatter"][1],
+                                  states["rebuild"][1])
