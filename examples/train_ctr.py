@@ -31,8 +31,19 @@ def main() -> None:
                     help="NN-cross: train a second (expand) embedding "
                          "block per feature through the extended pull "
                          "(pull_box_extended_sparse path)")
+    ap.add_argument("--push-write", default="auto",
+                    choices=("auto", "scatter", "rebuild"),
+                    help="slab write strategy (auto = rebuild on tpu "
+                         "backends; BASELINE.md axon characterization)")
+    ap.add_argument("--sparse-chunk-sync", action="store_true",
+                    help="one merged table update per scan chunk "
+                         "(effective sparse batch = chunk x batch; dense "
+                         "adam stays exact per batch)")
     ap.add_argument("--workdir", default=None)
     args = ap.parse_args()
+
+    from paddlebox_tpu.config import flags
+    flags.set_flag("push_write", args.push_write)
 
     from paddlebox_tpu.config.configs import (CheckpointConfig,
                                               SparseOptimizerConfig,
@@ -69,7 +80,8 @@ def main() -> None:
         model,
         table, feed,
         TrainerConfig(dense_lr=1e-3,
-                      compute_dtype="bfloat16" if args.bf16 else "float32"),
+                      compute_dtype="bfloat16" if args.bf16 else "float32",
+                      sparse_chunk_sync=args.sparse_chunk_sync),
         seed=0)
     trainer.metrics.init_metric("auc", "label", "pred", mask_var="mask")
 

@@ -52,6 +52,13 @@ def test_train_ctr_example_expand():
     assert "streaming AUC" in out
 
 
+def test_train_ctr_example_perf_knobs():
+    # the round-4 throughput knobs must stay wired to the public example
+    out = run_example("train_ctr.py", "--passes", "1", "--push-write",
+                      "rebuild", "--sparse-chunk-sync")
+    assert "streaming AUC" in out
+
+
 def test_serve_xbox_example():
     out = run_example("serve_xbox.py", "--passes", "1")
     assert "serving view:" in out and "feasign" in out
