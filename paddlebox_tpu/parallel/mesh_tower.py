@@ -73,7 +73,9 @@ class MeshTowerTrainer:
         self.axis = mesh.axis_names[0]
         self.table = PassTable(table_cfg, seed=seed)
         from paddlebox_tpu.train.trainer import resolve_push_write
-        self._push_write = resolve_push_write()
+        self._push_write = resolve_push_write(
+            capacity=table_cfg.pass_capacity,
+            batch_keys=feed.key_capacity())
         self.layout = self.table.layout
         self.num_slots = len(feed.used_sparse_slots())
         self.use_cvm = use_cvm

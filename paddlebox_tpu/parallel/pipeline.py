@@ -960,12 +960,6 @@ class ShardedCtrPipelineRunner:
         self.P = int(mesh.devices.size)
         self.fleet = fleet
         self.multiprocess = jax.process_count() > 1
-        # resolved ONCE — per-batch re-resolution would let a mid-pass flag
-        # flip change the batch pytree (retrace of the shard_map step) and
-        # mix write modes inside one pass (same policy as the trainers)
-        from paddlebox_tpu.train.trainer import resolve_push_write
-        self._push_write = (resolve_push_write()
-                            if not self.multiprocess else "scatter")
         mesh_devs = list(self.mesh.devices.flat)
         pid = jax.process_index()
         self.local_positions = [i for i, d in enumerate(mesh_devs)
@@ -994,6 +988,13 @@ class ShardedCtrPipelineRunner:
             owned_shards=(self.local_positions if self.multiprocess
                           else None),
             store_factory=store_factory)
+        # resolved ONCE — per-batch re-resolution would let a mid-pass flag
+        # flip change the batch pytree (retrace of the shard_map step) and
+        # mix write modes inside one pass (same policy as the trainers)
+        from paddlebox_tpu.train.trainer import resolve_push_write_sharded
+        self._push_write = resolve_push_write_sharded(
+            self.table.shard_cap, self.P, self.bucket_cap,
+            self.multiprocess)
         self.layout = self.table.layout
         D = table_cfg.embedx_dim
         slot_dim = (3 + D) if use_cvm else (1 + D)

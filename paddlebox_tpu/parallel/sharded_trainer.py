@@ -115,12 +115,11 @@ class ShardedBoxTrainer:
             owned_shards=self.local_positions if self.multiprocess else None,
             store_factory=store_factory)
         self.metrics = MetricRegistry()
-        # scatter-free slab write (push_write flag; see BoxTrainer) — only
-        # the single-process mesh can host-precompute the pos maps (incoming
-        # ids of a peer process's shards are not host-visible here)
-        from paddlebox_tpu.train.trainer import resolve_push_write
-        self._push_write = (resolve_push_write()
-                            if not self.multiprocess else "scatter")
+        # scatter-free slab write (push_write flag; see BoxTrainer)
+        from paddlebox_tpu.train.trainer import resolve_push_write_sharded
+        self._push_write = resolve_push_write_sharded(
+            self.table.shard_cap, self.P, self.bucket_cap,
+            self.multiprocess)
         self.dense_opt = make_dense_optimizer(self.cfg)
         rng = jax.random.PRNGKey(seed)
         self.params = model.init(rng)

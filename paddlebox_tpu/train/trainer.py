@@ -177,18 +177,43 @@ def check_expand_config(model, layout: ValueLayout, use_expand: bool) -> None:
             "CtrDnnExpand, or set expand_embed_dim=0)")
 
 
-def resolve_push_write() -> str:
-    """'scatter' | 'rebuild' from the push_write flag; 'auto' picks rebuild
-    on tpu backends (scatter per-index cost dominates there, measured
-    tools/push_ablate.py) and scatter elsewhere."""
+def resolve_push_write(capacity: Optional[int] = None,
+                       batch_keys: Optional[int] = None) -> str:
+    """'scatter' | 'rebuild' from the push_write flag.
+
+    'auto' picks by measured cost model on tpu backends (scatter ≈ fixed +
+    ~75 ns/index; rebuild ≈ flat in touched rows but ~ slab bytes — the
+    axon characterization, tools/push_ablate.py + the 4×-slab battery
+    row): rebuild while the slab is ≤ ~16× the per-batch key budget, else
+    the slab rewrite dominates and scatter wins. With no shape hints the
+    tpu default stays rebuild (the bench-shape regime). CPU always
+    scatters (its scatter is cheap; a full-slab rewrite per batch is not).
+    """
     from paddlebox_tpu.config import flags
     mode = flags.get_flag("push_write")
     if mode == "auto":
-        return "rebuild" if jax.default_backend() in ("tpu", "axon") \
-            else "scatter"
+        if jax.default_backend() not in ("tpu", "axon"):
+            return "scatter"
+        if capacity and batch_keys:
+            return "rebuild" if capacity <= 16 * batch_keys else "scatter"
+        return "rebuild"
     if mode not in ("scatter", "rebuild"):
         raise ValueError(f"push_write flag: unknown mode {mode!r}")
     return mode
+
+
+def resolve_push_write_sharded(shard_cap: int, num_shards: int,
+                               bucket_cap: int,
+                               multiprocess: bool) -> str:
+    """ONE shard-regime policy for every sharded runner (trainer +
+    pipeline): per-shard slab rows vs the padded incoming a2a key budget
+    (num_shards buckets of bucket_cap land on every shard). Multi-process
+    always scatters — a peer process's incoming ids are not host-visible,
+    so the pos maps cannot be staged."""
+    if multiprocess:
+        return "scatter"
+    return resolve_push_write(capacity=shard_cap,
+                              batch_keys=num_shards * bucket_cap)
 
 
 def make_dense_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
@@ -648,7 +673,9 @@ class BoxTrainer:
         # resolved once here and refreshed at pass start — never per batch,
         # so one scan chunk can't mix rebuild and scatter host dicts (and an
         # invalid flag value fails at construction, not in a staging thread)
-        self._push_write = resolve_push_write()
+        self._push_write = resolve_push_write(
+            capacity=table_cfg.pass_capacity,
+            batch_keys=feed.key_capacity())
         self.dense_opt = make_dense_optimizer(self.cfg)
         rng = jax.random.PRNGKey(seed)
         self.params = model.init(rng)
@@ -837,7 +864,9 @@ class BoxTrainer:
         # live set_flag takes effect at pass boundaries only (mid-pass flips
         # would mix rebuild/scatter host dicts inside one scan chunk);
         # refreshed BEFORE the profiled-path fork so both tiers honor it
-        self._push_write = resolve_push_write()
+        self._push_write = resolve_push_write(
+            capacity=self.table.capacity,
+            batch_keys=self.feed.key_capacity())
         if (flags.get_flag("profile_per_op") and not preloaded
                 and not self.multi_task and self.async_table is None):
             # debug tier: staged dispatches with per-stage attribution

@@ -161,3 +161,16 @@ def test_push_write_rebuild_matches_scatter(data):
             flags.set_flag("push_write", "auto")
     np.testing.assert_array_equal(slabs["scatter"][0], slabs["rebuild"][0])
     np.testing.assert_array_equal(slabs["scatter"][1], slabs["rebuild"][1])
+
+
+def test_push_write_auto_heuristic(monkeypatch):
+    """'auto' picks by the measured crossover on tpu backends (rebuild's
+    full-slab rewrite loses once the slab dwarfs the per-batch key
+    budget) and always scatters on CPU."""
+    import jax as _jax
+    from paddlebox_tpu.train.trainer import resolve_push_write
+    assert resolve_push_write(1 << 20, 131072) == "scatter"  # cpu backend
+    monkeypatch.setattr(_jax, "default_backend", lambda: "tpu")
+    assert resolve_push_write(1 << 20, 131072) == "rebuild"
+    assert resolve_push_write(1 << 22, 131072) == "scatter"  # 32x keys
+    assert resolve_push_write(None, None) == "rebuild"       # no hints
