@@ -845,6 +845,11 @@ class ShardedBoxTrainer:
                     if self.cfg.check_nan_inf and not np.isfinite(
                             chunk_losses).all():
                         raise FloatingPointError("nan/inf loss in scan chunk")
+                    # per-step device slices: _add_metrics makes one
+                    # GATED host copy per task via _local_rows (device-
+                    # collect mode transfers nothing; multiprocess preds
+                    # span non-addressable devices and MUST go through
+                    # the addressable-shards path, not np.asarray)
                     for j in range(len(group)):
                         self._add_metrics(
                             {t: p[j] for t, p in preds.items()},

@@ -897,8 +897,16 @@ class BoxTrainer:
                         chunk_losses).all():
                     raise FloatingPointError(
                         f"nan/inf loss by step {self._step_count}")
+                # ONE D2H per task per chunk, sliced on host — per-batch
+                # device slices would each pay a full transfer round-trip
+                # (~80 ms on the axon tunnel, tools D2H probe). Skipped
+                # entirely when nothing consumes preds.
+                if not (self.metrics.metric_names()
+                        or self.dump_writer is not None):
+                    return
+                preds_np = {t: np.asarray(p) for t, p in preds.items()}
                 for j, b in enumerate(group):
-                    preds_j = {t: p[j] for t, p in preds.items()}
+                    preds_j = {t: p[j] for t, p in preds_np.items()}
                     self._add_metrics(preds_j, b)
                     if self.dump_writer is not None:
                         self._dump_batch(preds_j, b)
