@@ -141,7 +141,10 @@ define_flag("flatten_dense_opt", True,
             "op chains (elementwise optimizers only; exact same numbers)")
 define_flag("use_pallas_push", False,
             "route the in-table adagrad row update through the hand-written "
-            "Pallas kernel (embedding/pallas_push.py) instead of XLA")
+            "Pallas kernel (embedding/pallas_push.py) instead of XLA "
+            "(helped the old scatter write path ~2.6 ms/step on v5e; "
+            "measured slightly SLOWER under push_write=rebuild — leave "
+            "off there, BASELINE.md)")
 define_flag("matmul_dtype", "float32",
             "dense matmul operand dtype: bfloat16 (MXU native, f32 "
             "accumulation; wins once the MLP dominates the step) or float32")
