@@ -112,6 +112,11 @@ define_flag("sparse_table_load_factor", 0.75,
             "native host hash table resize load factor (hashtable.h:211)")
 define_flag("dump_file_max_bytes", 2 << 30,
             "rotation size for debug dump files (2GB like dump writers)")
+define_flag("chunk_prefetch_depth", 1,
+            "single-host trainer: scan chunks staged AHEAD on a producer "
+            "thread while the device trains (the shard_batches stager "
+            "role; peak extra memory = this many staged chunks); 0 = "
+            "stage inline between dispatches")
 define_flag("stack_threads", 4,
             "host batch-staging threads per scan chunk (lookup + dedup; "
             "the feed-thread pool role, box_wrapper.h:862); <=1 = serial")

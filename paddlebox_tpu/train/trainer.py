@@ -105,7 +105,8 @@ def run_scan_chunks(scan_call: Callable, items, chunk: int,
                     stack_fn: Callable, carry: Tuple,
                     on_chunk: Callable, timer=None,
                     n_items: Optional[int] = None,
-                    chunk1_ok: bool = False):
+                    chunk1_ok: bool = False,
+                    prefetch_depth: int = 0):
     """Drive the megastep over full chunks of `items`, double-buffered:
     chunk i+1 is host-stacked and dispatched BEFORE chunk i's results are
     pulled to host, so H2D staging and metric extraction overlap device
@@ -121,6 +122,13 @@ def run_scan_chunks(scan_call: Callable, items, chunk: int,
     one chunk; the carry tuple is opaque to this driver (each trainer
     threads whatever state its scan needs). on_chunk(lo, group, losses_np,
     preds) handles metrics/dump/nan per trainer.
+
+    prefetch_depth > 0 stages up to that many chunks AHEAD on a producer
+    thread (the sharded trainer's shard_batches stager role for the
+    single-host path): stack_fn then runs concurrently with device
+    compute instead of serially between dispatches. stack_fn must be
+    safe to call off-thread (the table is read-only during a pass). Peak
+    extra memory = prefetch_depth staged chunks.
     Returns (carry, losses, n_consumed)."""
     losses_all: List[float] = []
     if n_items is None:
@@ -139,19 +147,78 @@ def run_scan_chunks(scan_call: Callable, items, chunk: int,
         losses_all.extend(float(l) for l in losses_np)
         on_chunk(lo, group, losses_np, preds_dev)
 
-    for lo in range(0, n_full, chunk):
-        group = [next(it) for _ in range(chunk)]
-        stacked = stack_fn(group)               # host work ∥ device compute
-        if timer is not None:
-            timer.start()
-        carry, losses, preds = scan_call(carry, stacked)
-        if timer is not None:
-            timer.pause()
+    def chunks():
+        # the ONE definition of chunk grouping + staging, shared by both
+        # paths (a grouping change applied to only one would silently
+        # diverge prefetch-on and prefetch-off runs)
+        for lo in range(0, n_full, chunk):
+            group = [next(it) for _ in range(chunk)]
+            yield lo, group, stack_fn(group)
+
+    stop = None
+    producer = None
+    if prefetch_depth > 0 and n_full:
+        import queue as _queue
+        import threading as _threading
+        q: "_queue.Queue" = _queue.Queue(maxsize=prefetch_depth)
+        stop = _threading.Event()
+
+        def _put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for item in chunks():
+                    if not _put(item):
+                        return
+            except BaseException as e:   # surfaced at the consumer's get
+                _put(e)
+
+        producer = _threading.Thread(target=produce, daemon=True,
+                                     name="chunk-stager")
+        producer.start()
+
+        def staged_chunks():
+            for _ in range(0, n_full, chunk):
+                item = q.get()
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        source = staged_chunks()
+    else:
+        source = chunks()
+
+    try:
+        for lo, group, stacked in source:
+            if timer is not None:
+                timer.start()
+            carry, losses, preds = scan_call(carry, stacked)
+            if timer is not None:
+                timer.pause()
+            if pending is not None:
+                drain(pending)
+            pending = (lo, group, losses, preds)
         if pending is not None:
             drain(pending)
-        pending = (lo, group, losses, preds)
-    if pending is not None:
-        drain(pending)
+    finally:
+        if stop is not None:
+            # consumer exit (normal or raising): stop the stager so it
+            # cannot keep reading the table into the caller's NEXT pass
+            # (the zombie-stager race shard_batches guards the same way),
+            # then unblock and join it
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except Exception:
+                pass
+            producer.join(timeout=5.0)
     return carry, losses_all, n_full
 
 
@@ -929,7 +996,9 @@ class BoxTrainer:
             carry, chunk_losses, n_done = run_scan_chunks(
                 scan_call, pending, chunk, self._stack_batches,
                 carry, on_chunk, timer=self.timers["step"],
-                chunk1_ok=self.sparse_chunk_sync)
+                chunk1_ok=self.sparse_chunk_sync,
+                prefetch_depth=max(0, int(
+                    flags.get_flag("chunk_prefetch_depth"))))
             slab, self.params, self.opt_state, prng = carry
             self.table.set_slab(slab)
             losses.extend(chunk_losses)

@@ -174,3 +174,69 @@ def test_push_write_auto_heuristic(monkeypatch):
     assert resolve_push_write(1 << 20, 131072) == "rebuild"
     assert resolve_push_write(1 << 22, 131072) == "scatter"  # 32x keys
     assert resolve_push_write(None, None) == "rebuild"       # no hints
+
+
+def test_chunk_prefetch_matches_inline(data):
+    """The chunk-staging prefetch thread (chunk_prefetch_depth) must be
+    invisible to results: bit-identical trained state vs inline staging,
+    and a staging error must surface at the caller, not die on the
+    producer thread."""
+    from paddlebox_tpu.config import flags
+    states = {}
+    for depth in (0, 2):
+        flags.set_flag("chunk_prefetch_depth", depth)
+        try:
+            files, feed = data
+            trainer = make_trainer(feed, seed=21)
+            ds = BoxDataset(feed, read_threads=1)
+            ds.set_filelist(files[:1])
+            trainer.train_pass(ds)
+            keys = np.sort(trainer.table._pass_keys)
+            states[depth] = (keys, trainer.table.store.lookup(keys).copy())
+        finally:
+            flags.set_flag("chunk_prefetch_depth", 1)
+    np.testing.assert_array_equal(states[0][0], states[2][0])
+    np.testing.assert_array_equal(states[0][1], states[2][1])
+
+    # producer-thread staging errors surface at the consumer
+    from paddlebox_tpu.train.trainer import run_scan_chunks
+
+    def bad_stack(group):
+        raise RuntimeError("staging boom")
+
+    with pytest.raises(RuntimeError, match="staging boom"):
+        run_scan_chunks(lambda c, s: (c, None, None), list(range(8)), 4,
+                        bad_stack, (), lambda *a: None, prefetch_depth=1)
+
+
+def test_chunk_prefetch_stager_stops_on_consumer_error():
+    """A consumer-side error (e.g. the nan guard) must STOP the producer
+    thread — a zombie stager would keep reading the table into the
+    caller's next pass (the shard_batches race)."""
+    import threading
+    import time as _time
+    from paddlebox_tpu.train.trainer import run_scan_chunks
+
+    staged = []
+
+    def slow_stack(group):
+        staged.append(group)
+        _time.sleep(0.05)
+        return group
+
+    calls = []
+
+    def scan_call(carry, stacked):
+        calls.append(stacked)
+        if len(calls) == 2:
+            raise FloatingPointError("nan guard")
+        return carry, np.zeros(4), None
+
+    before = threading.active_count()
+    with pytest.raises(FloatingPointError):
+        run_scan_chunks(scan_call, list(range(64)), 4, slow_stack, (),
+                        lambda *a: None, prefetch_depth=2)
+    # the producer must wind down promptly, not stage all 16 chunks
+    _time.sleep(0.5)
+    assert threading.active_count() <= before
+    assert len(staged) < 16
