@@ -347,6 +347,10 @@ def push_sparse_rebuild(slab: jnp.ndarray, uids: jnp.ndarray,
     chunks). Reference work shape: PushSparseGradCaseGPU merge + update
     (box_wrapper_impl.h:373-522); the write strategy is ours.
     """
+    if uids.shape[0] == 0:
+        # the clip below would otherwise build the inverted range [0, -1];
+        # an empty dedup touches nothing by definition
+        return slab
     new_rows = _merged_new_rows(slab, uids, perm, inv_sorted, grads, prng,
                                 layout, conf, pulled_rows, first_idx)
     sel = jnp.take(new_rows, jnp.clip(pos, 0, new_rows.shape[0] - 1),

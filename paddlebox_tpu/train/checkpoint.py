@@ -64,6 +64,14 @@ class CheckpointManager:
         os.makedirs(batch_dir, exist_ok=True)
         os.makedirs(xbox_dir, exist_ok=True)
 
+        # opt_state tree STRUCTURE depends on flatten_dense_opt (optax.
+        # flatten stores one flat vector instead of per-param trees);
+        # record it so load_base can fail loud on a mismatched restore
+        # instead of crashing deep in the first post-restore update
+        from paddlebox_tpu.config import flags as _flags
+        flags_snapshot = {
+            "flatten_dense_opt": bool(_flags.get_flag("flatten_dense_opt"))}
+
         keys, values = self.store.state_items()  # snapshot (copy)
         # SSD-tier rows are NOT in state_items(); a base model must cover
         # them (the reference's SaveBase covers SSD-tier rows) or a resume
@@ -89,7 +97,8 @@ class CheckpointManager:
                 pickle.dump(sparse_blob, f, protocol=pickle.HIGHEST_PROTOCOL)
             with open(os.path.join(batch_dir, "dense.pkl"), "wb") as f:
                 pickle.dump({"params": params, "opt_state": opt_state,
-                             "extra": extra or {}}, f)
+                             "extra": extra or {},
+                             "flags": flags_snapshot}, f)
             self._write_xbox(xbox_dir, xbox_blob)
             _write_done(batch_dir)
 
@@ -169,6 +178,19 @@ class CheckpointManager:
         self.store.load(os.path.join(batch_dir, "sparse.pkl"))
         with open(os.path.join(batch_dir, "dense.pkl"), "rb") as f:
             blob = pickle.load(f)
+        # every restore path fails loud on a flatten_dense_opt mismatch —
+        # not just RecoverableRunner.resume (pre-round-5 checkpoints carry
+        # no flags record and skip the check)
+        saved = blob.get("flags", {}).get("flatten_dense_opt")
+        if saved is not None:
+            from paddlebox_tpu.config import flags as _flags
+            cur = bool(_flags.get_flag("flatten_dense_opt"))
+            if saved != cur:
+                raise ValueError(
+                    "checkpoint was written with flatten_dense_opt="
+                    f"{saved} but this run has {cur}: the dense opt_state "
+                    "pytree structures are incompatible — set "
+                    "PBTPU_FLATTEN_DENSE_OPT to match the checkpoint")
         return blob["params"], blob["opt_state"], blob["extra"]
 
     def wait(self) -> None:

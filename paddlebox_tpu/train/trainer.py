@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -152,6 +153,11 @@ def run_scan_chunks(scan_call: Callable, items, chunk: int,
         # paths (a grouping change applied to only one would silently
         # diverge prefetch-on and prefetch-off runs)
         for lo in range(0, n_full, chunk):
+            if stop is not None and stop.is_set():
+                # consumer already exited — bail BEFORE the next stack_fn,
+                # not just between queue puts (a long native dedup here
+                # would otherwise keep reading the caller's table)
+                return
             group = [next(it) for _ in range(chunk)]
             yield lo, group, stack_fn(group)
 
@@ -211,14 +217,25 @@ def run_scan_chunks(scan_call: Callable, items, chunk: int,
             # consumer exit (normal or raising): stop the stager so it
             # cannot keep reading the table into the caller's NEXT pass
             # (the zombie-stager race shard_batches guards the same way),
-            # then unblock and join it
+            # then unblock and join it. A stager mid-stack_fn finishes
+            # that one item, sees the stop flag, and exits — so keep
+            # draining + joining until it does; if it outlives a long
+            # grace (wedged native call), returning would hand the caller
+            # a live thread racing end_pass(), so raise instead.
             stop.set()
-            try:
-                while True:
-                    q.get_nowait()
-            except Exception:
-                pass
-            producer.join(timeout=5.0)
+            deadline = time.monotonic() + 60.0
+            while producer.is_alive():
+                try:
+                    while True:
+                        q.get_nowait()
+                except _queue.Empty:
+                    pass
+                producer.join(timeout=1.0)
+                if producer.is_alive() and time.monotonic() > deadline:
+                    raise RuntimeError(
+                        "chunk-stager thread failed to stop within 60s — "
+                        "it may still be reading the pass table; not "
+                        "returning control with a live stager")
     return carry, losses_all, n_full
 
 

@@ -1,0 +1,155 @@
+"""Round-5 slab-write strategy probe.
+
+The round-4 characterization (BASELINE.md) left the push WRITE as the one
+slab-size-dependent cost: rebuild ~ slab bytes (6-9 ms @1M rows, ~20 @4M),
+scatter ~ 75 ns/index (14 ms @131k keys). This probe measures the round-5
+candidates for a slab-size-INDEPENDENT write on the live runtime:
+
+  rebuild    where(pos>=0, new_rows[pos], slab)      -- r4 baseline
+  scatter    slab.at[uids].set(rows)                 -- r4 fallback
+  dus        dynamic_update_slice(log, new, (off,0)) -- log-structured write
+  shift      concat(log[K:], new)                    -- log write as pure copy
+  pull2      where(m, slab[i1], log[i2])             -- slab+log combined read
+  selonly    where(mask, c, slab)                    -- select w/o gather term
+  opchain    16 dependent elementwise ops on [K,W]   -- per-op dispatch recal
+
+Every timed region is a fori_loop chain ending in np.asarray of dependent
+data (axon's block_until_ready returns early, BASELINE.md). Micro numbers
+are only comparable within one run (2-4x cross-session drift, r4 finding).
+
+Usage: timeout 1200 python -u tools/write_probe.py [platform] [caps...]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms",
+                  sys.argv[1] if len(sys.argv) > 1 else "axon")
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+W = 17                 # slab value width (bench layout)
+K = 131072             # keys/batch at bench shapes (1024 x 32 x 4)
+ITERS = 16
+REPS = 3
+
+
+def timed(name, fn, *args, extra=None):
+    try:
+        out = fn(*args)                      # compile
+        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            out = fn(*args)
+            np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+        ms = (time.perf_counter() - t0) / REPS / ITERS * 1e3
+    except Exception as e:  # one failed variant must not kill the battery
+        print(json.dumps({"op": name, "error": str(e)[:200]}), flush=True)
+        return None
+    rec = {"op": name, "ms_per_call": round(ms, 4)}
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec), flush=True)
+    return ms
+
+
+def chain(body):
+    def run(carry, *args):
+        def step(i, c):
+            return body(i, c, *args)
+        return lax.fori_loop(0, ITERS, step, carry)
+    return jax.jit(run)
+
+
+def probe_cap(cap: int, rng):
+    tag = {"cap": cap}
+    slab = jnp.asarray(rng.rand(cap, W).astype(np.float32))
+    n_uniq = int(K * 0.85)
+    uids_np = np.sort(rng.choice(cap - 1, n_uniq, replace=False)).astype(
+        np.int32)
+    uids_np = np.concatenate(
+        [uids_np, np.arange(K - n_uniq, dtype=np.int32) + cap])
+    uids = jnp.asarray(uids_np)
+    new_rows = jnp.asarray(rng.rand(K, W).astype(np.float32))
+    pos_np = np.full(cap, -1, np.int32)
+    pos_np[uids_np[:n_uniq]] = np.arange(n_uniq, dtype=np.int32)
+    pos = jnp.asarray(pos_np)
+
+    # 1. rebuild (r4 baseline): gather over [cap] + select over [cap, W]
+    def rebuild(i, s, p, nr):
+        sel = jnp.take(nr + 1.0, jnp.clip(p, 0, nr.shape[0] - 1), axis=0)
+        return jnp.where((p >= 0)[:, None], sel, s)
+    timed("rebuild", chain(rebuild), slab, pos, new_rows, extra=tag)
+
+    # 2. scatter (r4 fallback)
+    def scat(i, s, u, nr):
+        return s.at[u].set(nr + 1.0, mode="drop", unique_indices=True)
+    timed("scatter", chain(scat), slab, uids, new_rows, extra=tag)
+
+    # 3. DUS of [K, W] at an iteration-varying offset into a [cap, W] log
+    n_off = max(1, cap // K)
+
+    def dus(i, lg, nr):
+        off = (i % n_off) * K
+        return lax.dynamic_update_slice(lg, nr + 1.0, (off, 0))
+    timed("dus", chain(dus), slab + 0.0, new_rows, extra=tag)
+
+    # 4. shift-log: pure copy, no gather/scatter; positions roll by K
+    def shift(i, lg, nr):
+        return jnp.concatenate([lg[K:], nr + lg[:1, :1]], axis=0)
+    timed("shift", chain(shift), slab + 0.0, new_rows, extra=tag)
+
+    # 5. combined slab+log pull: 2 gathers + select, K indices
+    lg = jnp.asarray(rng.rand(min(cap, 8 * K), W).astype(np.float32))
+    i1 = jnp.asarray(rng.randint(0, cap, K).astype(np.int32))
+    i2 = jnp.asarray(rng.randint(0, lg.shape[0], K).astype(np.int32))
+    msk = jnp.asarray((rng.rand(K) < 0.5))
+
+    def pull2(i, c, s, l2, a, b, m):
+        r = jnp.where(m[:, None], jnp.take(s, a, axis=0),
+                      jnp.take(l2, b, axis=0))
+        return c + r[:1, :1]
+    timed("pull2", chain(pull2), jnp.zeros((1, 1)), slab, lg, i1, i2, msk,
+          extra=tag)
+
+    def pull1(i, c, s, a):
+        return c + jnp.take(s, a, axis=0)[:1, :1]
+    timed("pull1", chain(pull1), jnp.zeros((1, 1)), slab, i1, extra=tag)
+
+    # 6. select-only over [cap, W] (no gather term)
+    mask_cap = jnp.asarray((rng.rand(cap) < 0.1))
+
+    def selonly(i, s, m):
+        return jnp.where(m[:, None], s + 1.0, s)
+    timed("selonly", chain(selonly), slab, mask_cap, extra=tag)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(json.dumps({"device": str(dev), "platform": dev.platform,
+                      "K": K, "W": W, "iters": ITERS}), flush=True)
+    rng = np.random.RandomState(0)
+
+    # per-op dispatch recalibration: 16 dependent elementwise ops on [K, W]
+    x = jnp.asarray(rng.rand(K, W).astype(np.float32))
+
+    def ops16(i, c):
+        for j in range(16):
+            c = jnp.sin(c) + np.float32(j)   # sin blocks fusion collapse
+        return c
+    timed("opchain16_sin_KxW", chain(ops16), x)
+
+    caps = [int(a) for a in sys.argv[2:]] or [1 << 20, 1 << 22]
+    for cap in caps:
+        probe_cap(cap, rng)
+
+
+if __name__ == "__main__":
+    main()
