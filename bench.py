@@ -118,13 +118,25 @@ def measure(platform: str) -> None:
     trainer.table.end_feed_pass()
     trainer.table.begin_pass()
 
-    stacked = trainer._stack_batches(batches)
     scan = trainer.fns.scan_steps
-    state = (trainer.table.slab, trainer.params, trainer.opt_state,
-             trainer.table.next_prng())
-
     t_compile = time.perf_counter()
-    dt = timed_scan_chain(scan, state, stacked, STEPS, warmup=WARMUP)
+    if trainer._push_write == "log":
+        # round-5 headline path: log-structured write; the timed chain
+        # includes the real merge cadence (bench_util.timed_scan_chain_log)
+        from tools.bench_util import (make_log_bench_state,
+                                      timed_scan_chain_log)
+        stacked, bundle, mpos_np, lb = make_log_bench_state(trainer, batches)
+        state = (bundle, trainer.params, trainer.opt_state,
+                 trainer.table.next_prng())
+        dt = timed_scan_chain_log(scan, trainer.fns.merge_log, state,
+                                  stacked, STEPS,
+                                  max(1, lb // CHUNK), mpos_np,
+                                  warmup=WARMUP)
+    else:
+        stacked = trainer._stack_batches(batches)
+        state = (trainer.table.slab, trainer.params, trainer.opt_state,
+                 trainer.table.next_prng())
+        dt = timed_scan_chain(scan, state, stacked, STEPS, warmup=WARMUP)
     t_compile = time.perf_counter() - t_compile - dt * STEPS
 
     eps = CHUNK * BATCH / dt
@@ -133,6 +145,7 @@ def measure(platform: str) -> None:
         "platform": jax.devices()[0].platform,
         "device": str(jax.devices()[0]),
         "compute_dtype": dtype,
+        "push_write": trainer._push_write,
         "steady_ms_per_step": round(dt * 1e3 / CHUNK, 4),
         "compile_warmup_s": round(t_compile, 1),
     }))

@@ -136,10 +136,20 @@ define_flag("push_write", "auto",
             "how the push writes updated rows back into the pass slab: "
             "'scatter' (row scatter, cost ~ touched rows — right for CPU "
             "and small batches), 'rebuild' (host-staged pos map + full "
-            "slab gather/select, flat cost ~ slab bytes — right where "
-            "scatter is per-index expensive, e.g. the axon TPU runtime; "
-            "tools/push_ablate.py measurements), or 'auto' (rebuild on "
-            "tpu backends, scatter elsewhere)")
+            "slab gather/select, flat cost ~ slab bytes), 'log' (updated "
+            "rows append to a fixed-size log via dynamic_update_slice — "
+            "flat in SLAB size, tools/write_probe.py; the slab-"
+            "proportional merge amortizes over log_batches steps; "
+            "single-host trainer, not with expand/async/chunk-sync), or "
+            "'auto' (log on tpu backends where supported, else the r4 "
+            "rebuild/scatter crossover; scatter on CPU)")
+define_flag("log_batches", 0,
+            "push_write=log: log capacity in batches (peak extra HBM = "
+            "this many [key_capacity, width] blocks; merge cadence = one "
+            "slab-sized gather/select per this many steps). 0 = auto: "
+            "capacity//(8*key_capacity) clamped to [max(16, scan_chunk), "
+            "256] — keeps the amortized merge under ~1 ms/step while the "
+            "log stays <~20% of slab bytes")
 define_flag("flatten_dense_opt", True,
             "wrap the dense optimizer in optax.flatten so the whole dense "
             "update runs as one fused vector op instead of per-parameter "

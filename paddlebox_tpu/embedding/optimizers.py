@@ -22,7 +22,7 @@ Rows whose merged g_show == 0 (padding) are returned unchanged.
 from __future__ import annotations
 
 import functools
-from typing import Callable, Optional
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -356,6 +356,49 @@ def push_sparse_rebuild(slab: jnp.ndarray, uids: jnp.ndarray,
     sel = jnp.take(new_rows, jnp.clip(pos, 0, new_rows.shape[0] - 1),
                    axis=0)
     return jnp.where((pos >= 0)[:, None], sel, slab)
+
+
+def push_sparse_log(slab: jnp.ndarray, log: jnp.ndarray, cur: jnp.ndarray,
+                    uids: jnp.ndarray, perm: jnp.ndarray,
+                    inv_sorted: jnp.ndarray, grads: jnp.ndarray,
+                    prng: jax.Array, layout: ValueLayout,
+                    conf: SparseOptimizerConfig,
+                    pulled_rows: jnp.ndarray,
+                    first_idx: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Log-structured push write: updated rows APPEND to a fixed-size log
+    via one dynamic_update_slice instead of mutating the slab at all.
+
+    Round-5 measured basis (tools/write_probe.py, axon v5e): DUS of a
+    [K, W] block is flat in buffer size (4.3 ms @1M-row buffer, 4.7 @4M —
+    at the harness floor) while rebuild costs ~ slab bytes (8.7/22.2) and
+    scatter ~ per index (11/18.9). The write becomes slab-size-INDEPENDENT;
+    the slab-proportional cost moves to a once-per-log-fill merge
+    (merge_log_slab), amortized over log_batches steps.
+
+    Contract: the host stages combined pull indices (`src`) so every pull
+    reads the LATEST version (slab or log — ops/sparse.pull_rows_combined),
+    which is why pulled_rows/first_idx are REQUIRED here: the row values
+    fed to the optimizer must come from the combined pull, not a (stale)
+    slab gather. cur is the carried int32 write cursor; the host mirrors
+    it exactly (trainer.LogStageState). Reference work shape: the same
+    PushSparseGradCaseGPU merge + update (box_wrapper_impl.h:373-522);
+    the log-structured write strategy is ours.
+    """
+    new_rows = _merged_new_rows(slab, uids, perm, inv_sorted, grads, prng,
+                                layout, conf, pulled_rows, first_idx)
+    log = jax.lax.dynamic_update_slice(log, new_rows,
+                                       (cur, jnp.int32(0)))
+    return log, cur + jnp.int32(uids.shape[0])
+
+
+def merge_log_slab(slab: jnp.ndarray, log: jnp.ndarray,
+                   mpos: jnp.ndarray) -> jnp.ndarray:
+    """Fold a full log back into the slab: mpos ([capacity] int32, host-
+    staged) is each row's LATEST log position since the previous merge, -1
+    for untouched rows. One gather + one select ~ slab bytes — paid once
+    per log fill, not per step."""
+    sel = jnp.take(log, jnp.clip(mpos, 0, log.shape[0] - 1), axis=0)
+    return jnp.where((mpos >= 0)[:, None], sel, slab)
 
 
 def make_push_fn(layout: ValueLayout,

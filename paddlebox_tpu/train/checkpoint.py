@@ -175,12 +175,12 @@ class CheckpointManager:
         batch_dir = os.path.join(self.cfg.batch_model_dir, day)
         if not os.path.exists(os.path.join(batch_dir, "DONE")):
             raise FileNotFoundError(f"no completed checkpoint at {batch_dir}")
-        self.store.load(os.path.join(batch_dir, "sparse.pkl"))
         with open(os.path.join(batch_dir, "dense.pkl"), "rb") as f:
             blob = pickle.load(f)
         # every restore path fails loud on a flatten_dense_opt mismatch —
         # not just RecoverableRunner.resume (pre-round-5 checkpoints carry
-        # no flags record and skip the check)
+        # no flags record and skip the check). Checked BEFORE store.load so
+        # a rejected restore leaves the live sparse store untouched.
         saved = blob.get("flags", {}).get("flatten_dense_opt")
         if saved is not None:
             from paddlebox_tpu.config import flags as _flags
@@ -191,6 +191,7 @@ class CheckpointManager:
                     f"{saved} but this run has {cur}: the dense opt_state "
                     "pytree structures are incompatible — set "
                     "PBTPU_FLATTEN_DENSE_OPT to match the checkpoint")
+        self.store.load(os.path.join(batch_dir, "sparse.pkl"))
         return blob["params"], blob["opt_state"], blob["extra"]
 
     def wait(self) -> None:

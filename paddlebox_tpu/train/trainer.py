@@ -32,7 +32,9 @@ from paddlebox_tpu.config.configs import (DataFeedConfig, TableConfig,
 from paddlebox_tpu.data.dataset import BoxDataset
 from paddlebox_tpu.data.packer import PackedBatch
 from paddlebox_tpu.embedding.accessor import ValueLayout
-from paddlebox_tpu.embedding.optimizers import (push_sparse_hostdedup,
+from paddlebox_tpu.embedding.optimizers import (merge_log_slab,
+                                                push_sparse_hostdedup,
+                                                push_sparse_log,
                                                 push_sparse_rebuild,
                                                 rebuild_uids)
 from paddlebox_tpu.embedding.pass_table import (PassTable, dedup_ids,
@@ -43,6 +45,7 @@ from paddlebox_tpu.models.base import ModelSpec
 from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm, seqpool_sum
 from paddlebox_tpu.ops.sparse import (build_push_grads,
                                       build_push_grads_extended,
+                                      pull_rows_combined,
                                       pull_sparse, pull_sparse_extended,
                                       pull_view_from_rows)
 from paddlebox_tpu.utils.timer import Timer
@@ -73,6 +76,10 @@ class TrainStepFns:
     forward: Optional[Callable] = None          # (params, emb, batch) -> (loss, preds)
     sparse_push: Optional[Callable] = None      # (slab, demb, batch, sub) -> slab
     dn_update: Optional[Callable] = None        # (params, emb, batch) -> params
+    # push_write='log': (state, mpos) -> state with the log folded into
+    # the slab and the cursor reset (dispatched between chunks when the
+    # host's LogStageState fills, and once before end_pass)
+    merge_log: Optional[Callable] = None
 
 
 def make_scan(step_fn: Callable, extra_carry: int = 0) -> Callable:
@@ -232,6 +239,18 @@ def run_scan_chunks(scan_call: Callable, items, chunk: int,
                     pass
                 producer.join(timeout=1.0)
                 if producer.is_alive() and time.monotonic() > deadline:
+                    import sys as _sys
+                    if _sys.exc_info()[1] is not None:
+                        # an exception is already propagating (e.g. the
+                        # nan guard) — don't replace the root cause, just
+                        # record the zombie stager and let it through
+                        import logging
+                        logging.getLogger("paddlebox_tpu").error(
+                            "chunk-stager thread failed to stop within "
+                            "60s while unwinding %r — it may still be "
+                            "reading the pass table",
+                            _sys.exc_info()[1])
+                        break
                     raise RuntimeError(
                         "chunk-stager thread failed to stop within 60s — "
                         "it may still be reading the pass table; not "
@@ -262,28 +281,107 @@ def check_expand_config(model, layout: ValueLayout, use_expand: bool) -> None:
 
 
 def resolve_push_write(capacity: Optional[int] = None,
-                       batch_keys: Optional[int] = None) -> str:
-    """'scatter' | 'rebuild' from the push_write flag.
+                       batch_keys: Optional[int] = None,
+                       allow_log: bool = False) -> str:
+    """'scatter' | 'rebuild' | 'log' from the push_write flag.
 
-    'auto' picks by measured cost model on tpu backends (scatter ≈ fixed +
-    ~75 ns/index; rebuild ≈ flat in touched rows but ~ slab bytes — the
-    axon characterization, tools/push_ablate.py + the 4×-slab battery
-    row): rebuild while the slab is ≤ ~16× the per-batch key budget, else
-    the slab rewrite dominates and scatter wins. With no shape hints the
-    tpu default stays rebuild (the bench-shape regime). CPU always
-    scatters (its scatter is cheap; a full-slab rewrite per batch is not).
+    'auto' picks by measured cost model on tpu backends
+    (tools/write_probe.py, round 5): the log-structured write is flat in
+    BOTH slab size and touched rows (DUS 4.3 ms @1M-row buffer, 4.7 @4M,
+    at the harness floor) and beats rebuild (8.7/22.2, ~ slab bytes) and
+    scatter (11/18.9, ~ per index) at every measured size — so auto takes
+    it wherever the caller supports it (allow_log). Paths that can't run
+    the log (expand models, async dense, chunk-sync sparse, the sharded
+    runners) keep the r4 crossover: rebuild while the slab is ≤ ~16× the
+    per-batch key budget, else scatter. CPU always scatters (its scatter
+    is cheap; a full-slab rewrite per batch is not).
     """
     from paddlebox_tpu.config import flags
     mode = flags.get_flag("push_write")
     if mode == "auto":
         if jax.default_backend() not in ("tpu", "axon"):
             return "scatter"
+        if allow_log:
+            return "log"
         if capacity and batch_keys:
             return "rebuild" if capacity <= 16 * batch_keys else "scatter"
         return "rebuild"
-    if mode not in ("scatter", "rebuild"):
+    if mode == "log" and not allow_log:
+        raise ValueError(
+            "push_write=log is unsupported on this path (expand models, "
+            "async dense, chunk-sync sparse, and the sharded runners "
+            "stage per-batch products the log contract does not cover) — "
+            "use 'auto', 'rebuild', or 'scatter'")
+    if mode not in ("scatter", "rebuild", "log"):
         raise ValueError(f"push_write flag: unknown mode {mode!r}")
     return mode
+
+
+def resolve_log_batches(capacity: int, batch_keys: int,
+                        scan_chunk: int) -> int:
+    """Log capacity in batches for push_write='log' (log_batches flag;
+    0 = auto). Auto balances the amortized merge (~ slab bytes / this)
+    against log HBM (~ this × batch bytes): capacity // (8 × batch_keys),
+    clamped to [max(16, scan_chunk), 256]. Must cover at least one scan
+    chunk — merges only happen at dispatch boundaries."""
+    from paddlebox_tpu.config import flags
+    n = int(flags.get_flag("log_batches"))
+    lo = max(16, scan_chunk)
+    if n == 0:
+        return max(lo, min(256, capacity // max(1, 8 * batch_keys)))
+    if n < scan_chunk:
+        raise ValueError(
+            f"log_batches={n} < scan_chunk={scan_chunk}: the log must "
+            "hold a whole chunk (merges happen between dispatches)")
+    return n
+
+
+class LogStageState:
+    """Host bookkeeping for push_write='log' — the exact mirror of the
+    device-side (log, cur) state in push_sparse_log.
+
+    Per trained batch, IN DISPATCH ORDER, assign() computes the combined
+    pull index (`src`: slab id, or capacity + log slot of the latest
+    version) from the pre-batch view, then registers the batch's writes
+    at the advancing cursor. take_mpos() snapshots the latest-slot map
+    for merge_log_slab and resets for the next fill. NOT thread-safe:
+    callers serialize assignment in staging order (the parallel per-batch
+    staging computes lookup/dedup; this sequential tail is a few
+    vectorized [K] numpy ops)."""
+
+    def __init__(self, capacity: int, key_capacity: int,
+                 log_batches: int) -> None:
+        self.capacity = capacity
+        self.K = key_capacity
+        self.log_rows = log_batches * key_capacity
+        self.last_slot = np.full(capacity, -1, np.int32)
+        self.cur = 0
+
+    def need_merge(self, n_batches: int = 1) -> bool:
+        return self.cur + n_batches * self.K > self.log_rows
+
+    def take_mpos(self) -> np.ndarray:
+        mpos = self.last_slot.copy()
+        self.last_slot.fill(-1)
+        self.cur = 0
+        return mpos
+
+    def assign(self, ids: np.ndarray, uids: np.ndarray) -> np.ndarray:
+        if uids.shape[0] != self.K:
+            raise ValueError(
+                f"uids length {uids.shape[0]} != key capacity {self.K}")
+        if self.need_merge():
+            raise RuntimeError("log full — caller must merge first "
+                               "(take_mpos) before staging this batch")
+        # pull reads the PRE-batch view: resolve src before registering
+        # this batch's own writes (the step pulls, then pushes)
+        ls = self.last_slot[ids]
+        src = np.where(ls >= 0, self.capacity + ls, ids).astype(np.int32)
+        real = uids < self.capacity
+        slots = self.cur + np.arange(self.K, dtype=np.int32)
+        self.last_slot[uids[real]] = slots[real]
+        self.cur += self.K
+        return src
 
 
 def resolve_push_write_sharded(shard_cap: int, num_shards: int,
@@ -515,15 +613,25 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
             preds = {"ctr": main_pred}
         return loss, preds
 
-    def _pull(slab, ids):
+    def _pull(state, batch):
         """(emb_view, full_rows) — full_rows kept for the push's row reuse
-        (None on the expand path, which pulls a dual view)."""
+        (None on the expand path, which pulls a dual view).
+
+        state is either the bare slab, or the log-structured bundle
+        {slab, log, cur} (push_write='log') — there the pull reads each
+        key's LATEST version through the host-staged combined index."""
+        if isinstance(state, dict):
+            rows = pull_rows_combined(state["slab"], state["log"],
+                                      batch["src"])
+            return pull_view_from_rows(rows, layout), rows
+        ids = batch["ids"]
         if use_expand:
-            return pull_sparse_extended(slab, ids, layout), None
-        rows = slab[ids]
+            return pull_sparse_extended(state, ids, layout), None
+        rows = state[ids]
         return pull_view_from_rows(rows, layout), rows
 
-    def _sparse_push(slab, demb, batch, sub, pulled_rows=None):
+    def _sparse_push(state, demb, batch, sub, pulled_rows=None):
+        slab = state["slab"] if isinstance(state, dict) else state
         # per-key click = its instance's label (first task's label)
         key_label_src = batch["labels_" + model.task_names[0]] if multi_task \
             else batch["labels"]
@@ -552,6 +660,20 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         # full row from this same pre-update slab
         fi = batch.get("first_idx") if pulled_rows is not None else None
         rows = pulled_rows if fi is not None else None
+        if isinstance(state, dict):
+            # log-structured write (push_write='log'): requires the
+            # combined pull (rows ARE the latest versions) — the slab
+            # alone may be stale for keys updated since the last merge
+            if rows is None or fi is None:
+                raise RuntimeError(
+                    "push_write=log needs the pull-row reuse products "
+                    "(pulled_rows + first_idx) — staging must provide "
+                    "src/first_idx and the model must not be expand")
+            lg, cur = push_sparse_log(
+                slab, state["log"], state["cur"], uids, batch["perm"],
+                batch["inv"], push_grads, sub, layout, conf,
+                pulled_rows=rows, first_idx=fi)
+            return {"slab": slab, "log": lg, "cur": cur}
         if "push_pos" in batch:
             return push_sparse_rebuild(slab, uids, batch["push_pos"],
                                        batch["perm"], batch["inv"],
@@ -576,7 +698,7 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         def loss_fn(params, emb):
             return forward(params, emb, batch, None)
 
-        emb, rows = _pull(slab, batch["ids"])
+        emb, rows = _pull(slab, batch)
         grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)
         (loss, preds), (dparams, demb) = grad_fn(params, emb)
         updates, opt_state = dense_opt.update(dparams, opt_state, params)
@@ -685,7 +807,7 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         def loss_fn(params, emb):
             return forward(params, emb, batch, None)
 
-        emb, rows = _pull(slab, batch["ids"])
+        emb, rows = _pull(slab, batch)
         grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)
         (loss, preds), (dparams, demb) = grad_fn(params, emb)
         if has_summary:
@@ -706,7 +828,7 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
 
     @jax.jit
     def eval_step(slab, params, batch):
-        emb, _ = _pull(slab, batch["ids"])
+        emb, _ = _pull(slab, batch)
         _, preds = forward(params, emb, batch, None)
         return preds
 
@@ -717,6 +839,11 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
                                 _key_valid(batch), batch_size, num_slots,
                                 use_cvm, batch.get("dense"))
 
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def merge_log_fn(state, mpos):
+        return {"slab": merge_log_slab(state["slab"], state["log"], mpos),
+                "log": state["log"], "cur": jnp.zeros((), jnp.int32)}
+
     return TrainStepFns(step=step_async if async_dense else step,
                         eval_step=eval_step,
                         batch_size=batch_size, num_slots=num_slots,
@@ -725,7 +852,8 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
                         forward=lambda params, emb, batch: forward(
                             params, emb, batch, None),
                         sparse_push=_sparse_push,
-                        dn_update=_dn_update)
+                        dn_update=_dn_update,
+                        merge_log=merge_log_fn)
 
 
 class BoxTrainer:
@@ -754,22 +882,30 @@ class BoxTrainer:
         self.feed = feed
         self.table = PassTable(table_cfg, seed=seed)
         self.metrics = MetricRegistry()
-        # resolved once here and refreshed at pass start — never per batch,
-        # so one scan chunk can't mix rebuild and scatter host dicts (and an
-        # invalid flag value fails at construction, not in a staging thread)
-        self._push_write = resolve_push_write(
-            capacity=table_cfg.pass_capacity,
-            batch_keys=feed.key_capacity())
-        self.dense_opt = make_dense_optimizer(self.cfg)
-        rng = jax.random.PRNGKey(seed)
-        self.params = model.init(rng)
-        self.opt_state = self.dense_opt.init(self.params)
-        self.num_slots = len(feed.used_sparse_slots())
         self.async_mode = (self.cfg.async_mode
                            or self.cfg.sync_mode == "async")
         self.sparse_chunk_sync = bool(self.cfg.sparse_chunk_sync)
         if self.sparse_chunk_sync and self.cfg.scan_chunk < 1:
             raise ValueError("sparse_chunk_sync needs scan_chunk >= 1")
+        # log-structured push: per-step exact pulls through a combined
+        # slab+log index — expand's dual-view pull, async dense's per-step
+        # dispatch cadence, and chunk-sync's chunk-level dedup don't carry
+        # the required products, so those paths keep rebuild/scatter
+        self._allow_log = not (self.async_mode or self.sparse_chunk_sync
+                               or bool(getattr(model, "use_expand", False)))
+        # resolved once here and refreshed at pass start — never per batch,
+        # so one scan chunk can't mix rebuild and scatter host dicts (and an
+        # invalid flag value fails at construction, not in a staging thread)
+        self._push_write = resolve_push_write(
+            capacity=table_cfg.pass_capacity,
+            batch_keys=feed.key_capacity(),
+            allow_log=self._allow_log)
+        self._log_stage: Optional[LogStageState] = None  # per-pass, log mode
+        self.dense_opt = make_dense_optimizer(self.cfg)
+        rng = jax.random.PRNGKey(seed)
+        self.params = model.init(rng)
+        self.opt_state = self.dense_opt.init(self.params)
+        self.num_slots = len(feed.used_sparse_slots())
         self.fns = make_train_step(
             model, self.table.layout, table_cfg, self.dense_opt,
             feed.batch_size, self.num_slots, use_cvm,
@@ -885,6 +1021,28 @@ class BoxTrainer:
             stacked = {k: jnp.asarray(np.stack([h[k] for h in hosts]))
                        for k in hosts[0]}
             return stacked, {k: jnp.asarray(v) for k, v in cpush.items()}
+        if self._push_write == "log":
+            # sequential tail of the staging: combined pull indices +
+            # write-slot registration must follow dispatch order (the
+            # pool above parallelized the order-free lookup/dedup work).
+            # A full log emits the merge map FIRST — the consumer
+            # dispatches the merge before this chunk's scan.
+            st = self._log_stage
+            if st is None:
+                # direct callers (tools/step_audit, ablation probes) that
+                # stage outside train_pass must pick an explicit write
+                # mode — log staging is stateful and pass-scoped
+                raise RuntimeError(
+                    "push_write=log staging requires an active train_pass "
+                    "(LogStageState); direct _stack_batches callers set "
+                    "trainer._push_write to 'rebuild' or 'scatter', or "
+                    "use tools.bench_util.make_log_bench_state")
+            mpos = (st.take_mpos() if st.need_merge(len(hosts)) else None)
+            for h in hosts:
+                h["src"] = st.assign(h["ids"], h["uids"])
+            stacked = {k: jnp.asarray(np.stack([h[k] for h in hosts]))
+                       for k in hosts[0]}
+            return stacked, mpos
         return {k: jnp.asarray(np.stack([h[k] for h in hosts]))
                 for k in hosts[0]}
 
@@ -950,10 +1108,12 @@ class BoxTrainer:
         # refreshed BEFORE the profiled-path fork so both tiers honor it
         self._push_write = resolve_push_write(
             capacity=self.table.capacity,
-            batch_keys=self.feed.key_capacity())
+            batch_keys=self.feed.key_capacity(),
+            allow_log=self._allow_log)
         if (flags.get_flag("profile_per_op") and not preloaded
                 and not self.multi_task and self.async_table is None):
             # debug tier: staged dispatches with per-stage attribution
+            # (stages with no log products → the hostdedup scatter write)
             return self.train_pass_profiled(dataset)
         t_pass = self.timers["pass"]
         t_pass.start()
@@ -969,6 +1129,20 @@ class BoxTrainer:
         prng = self.table.next_prng()
         chunk = max(1, self.cfg.scan_chunk)
         pending = worker_batches[0]
+        log_mode = self._push_write == "log"
+        if log_mode:
+            K = self.feed.key_capacity()
+            self._log_stage = LogStageState(
+                self.table.capacity, K,
+                resolve_log_batches(self.table.capacity, K, chunk))
+            state = {"slab": self.table.slab,
+                     "log": jnp.zeros((self._log_stage.log_rows,
+                                       self.table.layout.width),
+                                      jnp.float32),
+                     "cur": jnp.zeros((), jnp.int32)}
+            self.table.set_slab(None)  # the bundle owns the (donated) slab
+        else:
+            state = self.table.slab
         use_scan = (self.fns.scan_chunk is not None or
                     (self.fns.scan_steps is not None and chunk > 1))
         if use_scan and len(pending) >= chunk:
@@ -1002,6 +1176,18 @@ class BoxTrainer:
                         self.fns.scan_chunk(carry[0], carry[1], carry[2],
                                             stacked, cpush, carry[3])
                     return (slab, params, opt_state, prng), losses, preds
+            elif log_mode:
+                def scan_call(carry, staged):
+                    stacked, mpos = staged
+                    st = carry[0]
+                    if mpos is not None:
+                        # the stager declared the log full before this
+                        # chunk: fold it into the slab first
+                        st = self.fns.merge_log(st, jnp.asarray(mpos))
+                    st, params, opt_state, losses, preds, prng = \
+                        self.fns.scan_steps(st, carry[1], carry[2],
+                                            stacked, carry[3])
+                    return (st, params, opt_state, prng), losses, preds
             else:
                 def scan_call(carry, stacked):
                     slab, params, opt_state, losses, preds, prng = \
@@ -1009,20 +1195,29 @@ class BoxTrainer:
                                             stacked, carry[3])
                     return (slab, params, opt_state, prng), losses, preds
 
-            carry = (self.table.slab, self.params, self.opt_state, prng)
+            carry = (state, self.params, self.opt_state, prng)
             carry, chunk_losses, n_done = run_scan_chunks(
                 scan_call, pending, chunk, self._stack_batches,
                 carry, on_chunk, timer=self.timers["step"],
                 chunk1_ok=self.sparse_chunk_sync,
                 prefetch_depth=max(0, int(
                     flags.get_flag("chunk_prefetch_depth"))))
-            slab, self.params, self.opt_state, prng = carry
-            self.table.set_slab(slab)
+            state, self.params, self.opt_state, prng = carry
+            if not log_mode:
+                self.table.set_slab(state)
             losses.extend(chunk_losses)
             pending = pending[n_done:]
         for b in pending:
             ids = self.table.lookup_ids(b.keys, b.valid)
-            batch = self.device_batch(b, ids)
+            if log_mode:
+                h = self.host_batch(b, ids)
+                if self._log_stage.need_merge():
+                    state = self.fns.merge_log(
+                        state, jnp.asarray(self._log_stage.take_mpos()))
+                h["src"] = self._log_stage.assign(h["ids"], h["uids"])
+                batch = {k: jnp.asarray(v) for k, v in h.items()}
+            else:
+                batch = self.device_batch(b, ids)
             self.timers["step"].start()
             if self.async_table is not None:
                 # pull a fresh dense snapshot, run the device step, queue the
@@ -1033,11 +1228,14 @@ class BoxTrainer:
                 slab, flat_g, loss, preds, prng = self.fns.step(
                     self.table.slab, self.params, batch, prng)
                 self.async_table.push(np.asarray(flat_g))
+                self.table.set_slab(slab)
             else:
-                (slab, self.params, self.opt_state, loss, preds,
+                (state, self.params, self.opt_state, loss, preds,
                  prng) = self.fns.step(
-                    self.table.slab, self.params, self.opt_state, batch, prng)
-            self.table.set_slab(slab)
+                    state if log_mode else self.table.slab,
+                    self.params, self.opt_state, batch, prng)
+                if not log_mode:
+                    self.table.set_slab(state)
             self.timers["step"].pause()
             self._step_count += 1
             losses.append(float(loss))
@@ -1047,6 +1245,14 @@ class BoxTrainer:
             self._add_metrics(preds, b)
             if self.dump_writer is not None:
                 self._dump_batch(preds, b)
+        if log_mode:
+            # fold any remaining log entries, hand the merged slab back to
+            # the table for end_pass write-back, and drop the log
+            if self._log_stage.cur:
+                state = self.fns.merge_log(
+                    state, jnp.asarray(self._log_stage.take_mpos()))
+            self.table.set_slab(state["slab"])
+            self._log_stage = None
         self.table.end_pass()
         if self.async_table is not None:
             # pass boundary is a sync point: drain the host optimizer and

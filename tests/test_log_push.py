@@ -1,0 +1,185 @@
+"""push_write='log' — the log-structured slab write (round 5).
+
+Contract under test: with the write redirected to a fixed-size log
+(push_sparse_log) and pulls reading through the host-staged combined
+index (pull_rows_combined), training is BIT-IDENTICAL to the scatter
+write at every merge cadence — including mid-pass merges forced by a
+tiny log, the per-step tail path, and multi-pass runs. The measured
+motivation (write cost flat in slab size) is tools/write_probe.py /
+BASELINE.md round 5."""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import flags
+from paddlebox_tpu.config.configs import (SparseOptimizerConfig, TableConfig,
+                                          TrainerConfig)
+from paddlebox_tpu.data import BoxDataset, write_synthetic_ctr_files
+from paddlebox_tpu.models import CtrDnn
+from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.train import BoxTrainer
+from paddlebox_tpu.train.trainer import LogStageState, resolve_log_batches
+
+D = 4
+NUM_SLOTS = 4
+
+
+@pytest.fixture(scope="module")
+def data(tmp_path_factory):
+    out = tmp_path_factory.mktemp("log_push_data")
+    # small vocab → heavy key recurrence across batches: read-after-write
+    # through the log (and across merge boundaries) is exercised hard
+    files, feed = write_synthetic_ctr_files(
+        str(out), num_files=2, lines_per_file=480, num_slots=NUM_SLOTS,
+        vocab_per_slot=120, max_len=3, seed=11)
+    feed = type(feed)(slots=feed.slots, batch_size=64)
+    return files, feed
+
+
+def run_mode(files, feed, mode, log_batches=0, scan_chunk=2, passes=2,
+             optimizer="adagrad"):
+    flags.set_flag("push_write", mode)
+    flags.set_flag("log_batches", log_batches)
+    try:
+        table = TableConfig(
+            embedx_dim=D, pass_capacity=2048,
+            optimizer=SparseOptimizerConfig(
+                optimizer=optimizer, mf_create_thresholds=0.0,
+                mf_initial_range=1e-3))
+        model = CtrDnn(ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + D),
+                       hidden=(16,))
+        tr = BoxTrainer(model, table, feed, TrainerConfig(
+            scan_chunk=scan_chunk), seed=0)
+        losses = []
+        for p in range(passes):
+            ds = BoxDataset(feed, read_threads=1)
+            ds.set_filelist(files)
+            losses.append(tr.train_pass(ds)["loss"])
+            ds.release_memory()
+        keys, vals = tr.table.store.state_items()
+        order = np.argsort(keys)
+        params = tr.params
+        tr.close()
+        return losses, keys[order], vals[order], params
+    finally:
+        flags.set_flag("push_write", "auto")
+        flags.set_flag("log_batches", 0)
+
+
+def assert_identical(a, b):
+    la, ka, va, pa = a
+    lb, kb, vb, pb = b
+    assert la == lb
+    assert np.array_equal(ka, kb)
+    assert np.array_equal(va, vb)
+    import jax
+    for xa, xb in zip(jax.tree.leaves(pa), jax.tree.leaves(pb)):
+        assert np.array_equal(np.asarray(xa), np.asarray(xb))
+
+
+def test_log_matches_scatter_tiny_log(data):
+    """log_batches=3 < batches/pass forces multiple mid-pass merges; the
+    15-batch pass (scan_chunk=2) also exercises the per-step tail."""
+    files, feed = data
+    base = run_mode(files, feed, "scatter")
+    log = run_mode(files, feed, "log", log_batches=3)
+    assert_identical(base, log)
+
+
+def test_log_matches_rebuild_large_log(data):
+    """A log larger than the pass: no mid-pass merge, one final fold."""
+    files, feed = data
+    base = run_mode(files, feed, "rebuild")
+    log = run_mode(files, feed, "log", log_batches=64)
+    assert_identical(base, log)
+
+
+def test_log_per_step_only(data):
+    """scan_chunk=1 routes every batch through the per-step tail path
+    (merge checks + src staging inline)."""
+    files, feed = data
+    base = run_mode(files, feed, "scatter", scan_chunk=1, passes=1)
+    log = run_mode(files, feed, "log", log_batches=3, scan_chunk=1,
+                   passes=1)
+    assert_identical(base, log)
+
+
+def test_log_adam_optimizer(data):
+    """In-table adam carries 4 state columns through the log."""
+    files, feed = data
+    base = run_mode(files, feed, "scatter", passes=1, optimizer="adam")
+    log = run_mode(files, feed, "log", log_batches=3, passes=1,
+                   optimizer="adam")
+    assert_identical(base, log)
+
+
+def test_log_stage_state_unit():
+    """Host bookkeeping: src resolves to the latest version at assign
+    time (pre-batch view), slots advance, merge resets."""
+    st = LogStageState(capacity=100, key_capacity=4, log_batches=2)
+    ids = np.array([5, 7, 5, 99], np.int32)          # 99 = trash row
+    uids = np.array([5, 7, 99, 100], np.int32)       # 100 = padding
+    src0 = st.assign(ids, uids)
+    # first batch: nothing logged yet -> src = slab ids
+    assert np.array_equal(src0, ids)
+    assert st.cur == 4
+    # second batch re-reads key 5 -> its log slot (100 + 0)
+    ids2 = np.array([5, 8, 8, 99], np.int32)
+    uids2 = np.array([5, 8, 99, 101], np.int32)
+    src2 = st.assign(ids2, uids2)
+    assert src2[0] == 100 + 0           # key 5 logged at slot 0
+    assert src2[1] == 8                 # key 8 unseen -> slab
+    assert src2[3] == 100 + 2           # trash row logged too (slot 2)
+    assert st.need_merge()
+    mpos = st.take_mpos()
+    assert mpos[5] == 4                 # latest write of key 5 = slot 4
+    assert mpos[8] == 5
+    assert mpos[7] == 1
+    assert mpos[99] == 6                # trash row's latest slot
+    assert (mpos >= 0).sum() == 4       # 5, 7, 8, 99 (padding uids skip)
+    assert st.cur == 0 and not st.need_merge()
+    # after merge everything resolves to the slab again
+    src3 = st.assign(ids, uids)
+    assert np.array_equal(src3, ids)
+
+
+def test_log_stage_guards():
+    st = LogStageState(capacity=100, key_capacity=4, log_batches=1)
+    ids = np.array([1, 2, 3, 99], np.int32)
+    uids = np.array([1, 2, 3, 99], np.int32)
+    st.assign(ids, uids)
+    with pytest.raises(RuntimeError, match="log full"):
+        st.assign(ids, uids)
+    with pytest.raises(ValueError, match="key capacity"):
+        st.assign(ids, np.array([1, 2], np.int32))
+
+
+def test_resolve_log_batches_validation():
+    assert resolve_log_batches(1 << 20, 1024, scan_chunk=8) == \
+        max(16, min(256, (1 << 20) // (8 * 1024)))
+    flags.set_flag("log_batches", 4)
+    try:
+        with pytest.raises(ValueError, match="scan_chunk"):
+            resolve_log_batches(1 << 20, 1024, scan_chunk=8)
+        assert resolve_log_batches(1 << 20, 1024, scan_chunk=4) == 4
+    finally:
+        flags.set_flag("log_batches", 0)
+
+
+def test_push_write_log_rejected_where_unsupported(data):
+    """Explicit push_write=log on an unsupported path fails loud at
+    construction, not deep in a staging thread."""
+    files, feed = data
+    flags.set_flag("push_write", "log")
+    try:
+        table = TableConfig(
+            embedx_dim=D, pass_capacity=2048,
+            optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0))
+        model = CtrDnn(ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + D),
+                       hidden=(16,))
+        with pytest.raises(ValueError, match="push_write=log"):
+            BoxTrainer(model, table, feed,
+                       TrainerConfig(sparse_chunk_sync=True, scan_chunk=2),
+                       seed=0)
+    finally:
+        flags.set_flag("push_write", "auto")

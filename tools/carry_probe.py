@@ -1,0 +1,123 @@
+"""Does a scan COPY unchanged pass-through carries on this runtime?
+
+The r5 log-structured step carries the slab through the scan untouched
+(only the log mutates). full_step log-mode rows still scale with slab
+size (tpu_probe: 16.3 ms @1M rows -> 27.4 @4M) even though every written
+buffer is slab-size-independent — hypothesis: the runtime materializes a
+copy of the unchanged slab carry each scan iteration. Compare:
+
+  carry_pass   scan carry = (slab, log); body mutates log only
+  invariant    scan carry = (log,); slab is a closed-over loop invariant
+  carry_used   carry = (slab, log); body also READS slab (gather) — the
+               real step's shape
+
+Usage: timeout 900 python -u tools/carry_probe.py [platform] [caps...]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms",
+                  sys.argv[1] if len(sys.argv) > 1 else "axon")
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+W = 17
+K = 131072
+L = 16 * K
+ITERS = 8
+REPS = 3
+
+
+def timed(name, fn, state, extra=None):
+    try:
+        out = fn(*state)
+        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            out = fn(*state)
+            np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+        ms = (time.perf_counter() - t0) / REPS / ITERS * 1e3
+    except Exception as e:
+        print(json.dumps({"op": name, "error": str(e)[:200]}), flush=True)
+        return
+    rec = {"op": name, "ms_per_iter": round(ms, 4)}
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def probe(cap, rng):
+    tag = {"cap": cap}
+    slab = jnp.asarray(rng.rand(cap, W).astype(np.float32))
+    log = jnp.asarray(rng.rand(L, W).astype(np.float32))
+    nr = jnp.asarray(rng.rand(K, W).astype(np.float32))
+    idx = jnp.asarray(rng.randint(0, cap, K).astype(np.int32))
+
+    @jax.jit
+    def carry_pass(slab, log, nr):
+        def body(c, x):
+            s, lg = c
+            lg = lax.dynamic_update_slice(lg, nr + x, (0, 0))
+            return (s, lg), x
+        (s, lg), _ = lax.scan(body, (slab, log),
+                              jnp.arange(ITERS, dtype=jnp.float32))
+        return lg
+
+    timed("carry_pass", carry_pass, (slab, log, nr), tag)
+
+    @jax.jit
+    def invariant(log, nr, slab):
+        def body(lg, x):
+            lg = lax.dynamic_update_slice(
+                lg, nr + x + slab[:1, :1], (0, 0))
+            return lg, x
+        lg, _ = lax.scan(body, log, jnp.arange(ITERS, dtype=jnp.float32))
+        return lg
+
+    timed("invariant", invariant, (log, nr, slab), tag)
+
+    @jax.jit
+    def carry_used(slab, log, nr, idx):
+        def body(c, x):
+            s, lg = c
+            rows = jnp.take(s, idx, axis=0)
+            lg = lax.dynamic_update_slice(lg, rows + x, (0, 0))
+            return (s, lg), x
+        (s, lg), _ = lax.scan(body, (slab, log),
+                              jnp.arange(ITERS, dtype=jnp.float32))
+        return lg
+
+    timed("carry_used", carry_used, (slab, log, nr, idx), tag)
+
+    @jax.jit
+    def invariant_used(log, nr, idx, slab):
+        def body(lg, x):
+            rows = jnp.take(slab, idx, axis=0)
+            lg = lax.dynamic_update_slice(lg, rows + x, (0, 0))
+            return lg, x
+        lg, _ = lax.scan(body, log, jnp.arange(ITERS, dtype=jnp.float32))
+        return lg
+
+    timed("invariant_used", invariant_used, (log, nr, idx, slab), tag)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(json.dumps({"device": str(dev), "platform": dev.platform}),
+          flush=True)
+    rng = np.random.RandomState(0)
+    caps = [int(a) for a in sys.argv[2:]] or [1 << 20, 1 << 22]
+    for cap in caps:
+        probe(cap, rng)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,206 @@
+"""In-step ablation of the push_write='log' composition (round 5).
+
+tpu_probe shows the log-mode full step at ~16.3 ms/step — the micro
+marginals (write_probe: DUS ~0.1 ms, pull2-pull1 ~+0.3) predict ~12.
+This decomposes the REAL log-path push, built from the production
+building blocks at bench shapes, inside a donated scan chain (the exact
+carry structure the trainer uses):
+
+  pull_plain     rows = slab[ids]                       (r4 baseline read)
+  pull_comb      rows = pull_rows_combined(slab,log,src)
+  push_nowrite   merged_new_rows only (no log write)
+  push_dus       merged_new_rows + DUS at carried cursor (the log write)
+  push_rebuild   merged_new_rows + rebuild write         (r4 comparison)
+
+Each variant runs the SAME scan-of-8 structure, donated, D2H-synced.
+Usage: timeout 1200 python -u tools/log_ablate.py [platform]
+"""
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms",
+                  sys.argv[1] if len(sys.argv) > 1 else "axon")
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from paddlebox_tpu.config.configs import SparseOptimizerConfig
+from paddlebox_tpu.embedding.accessor import ValueLayout
+from paddlebox_tpu.embedding.optimizers import _merged_new_rows
+from paddlebox_tpu.ops.sparse import pull_rows_combined
+
+CAP = 1 << 20
+W = 17
+K = 131072
+PW = 12
+CHUNK = 8
+LOG_BATCHES = 16
+REPS = 4
+
+
+def timed(name, fn, state, extra=None):
+    out = fn(*state)
+    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    # re-make state each rep is impossible after donation: thread it
+    st = out
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        st = fn(*st) if isinstance(st, tuple) else fn(st)
+        np.asarray(jax.tree_util.tree_leaves(st)[0].ravel()[:1])
+    ms = (time.perf_counter() - t0) / REPS / CHUNK * 1e3
+    rec = {"variant": name, "ms_per_step": round(ms, 3)}
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(json.dumps({"device": str(dev), "platform": dev.platform}),
+          flush=True)
+    rng = np.random.RandomState(0)
+    layout = ValueLayout(8, "adagrad")
+    conf = SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                 mf_initial_range=1e-3)
+    L = LOG_BATCHES * K
+
+    slab = jnp.asarray(rng.rand(CAP, W).astype(np.float32))
+    log0 = jnp.zeros((L, W), jnp.float32)
+    # host-dedup products like the real stage (85% unique)
+    n_u = int(K * 0.85)
+    uids_np = np.sort(rng.choice(CAP - 1, n_u, replace=False)).astype(np.int32)
+    uids_np = np.concatenate(
+        [uids_np, np.arange(K - n_u, dtype=np.int32) + CAP])
+    ids_np = uids_np[np.minimum(
+        np.sort(rng.randint(0, n_u, K)), n_u - 1)].astype(np.int32)
+    perm_np = rng.permutation(K).astype(np.int32)
+    inv_np = np.sort(rng.randint(0, n_u, K)).astype(np.int32)
+    first_np = rng.randint(0, K, K).astype(np.int32)
+    src_np = ids_np.copy()
+    src_np[::7] = CAP + rng.randint(0, L, src_np[::7].shape[0])  # ~14% log hits
+    stacked = {
+        "ids": jnp.asarray(np.broadcast_to(ids_np, (CHUNK, K)).copy()),
+        "src": jnp.asarray(np.broadcast_to(src_np, (CHUNK, K)).copy()),
+        "uids": jnp.asarray(np.broadcast_to(uids_np, (CHUNK, K)).copy()),
+        "perm": jnp.asarray(np.broadcast_to(perm_np, (CHUNK, K)).copy()),
+        "inv": jnp.asarray(np.broadcast_to(inv_np, (CHUNK, K)).copy()),
+        "first": jnp.asarray(np.broadcast_to(first_np, (CHUNK, K)).copy()),
+        "grads": jnp.asarray(rng.rand(CHUNK, K, PW).astype(np.float32)),
+    }
+    pos_np = np.full(CAP, -1, np.int32)
+    pos_np[uids_np[:n_u]] = np.arange(n_u, dtype=np.int32)
+    stacked_pos = jnp.asarray(
+        np.broadcast_to(pos_np, (CHUNK, CAP)).copy())
+
+    def scan_of(body, with_pos=False):
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def run(carry, stk, stkpos):
+            def step(c, xs):
+                b, bp = xs
+                return body(c, b, bp), 0.0
+            carry2, _ = lax.scan(step, carry, (stk, stkpos))
+            return carry2
+        return lambda *c: (run(c[0], stacked, stacked_pos),)
+
+    def mk_state():
+        prng = jax.random.PRNGKey(0)
+        return ((slab + 0.0, log0 + 0.0, jnp.zeros((), jnp.int32), prng),)
+
+    # --- read variants ------------------------------------------------
+    def pull_plain(c, b, bp):
+        s, lg, cur, prng = c
+        rows = jnp.take(s, jnp.minimum(b["ids"], CAP - 1), axis=0)
+        return (s, lax.dynamic_update_slice(lg, rows * 0.999, (cur, 0)),
+                (cur + K) % (L - K), prng)
+
+    def pull_comb(c, b, bp):
+        s, lg, cur, prng = c
+        rows = pull_rows_combined(s, lg, b["src"])
+        return (s, lax.dynamic_update_slice(lg, rows * 0.999, (cur, 0)),
+                (cur + K) % (L - K), prng)
+
+    timed("pull_plain_plus_dus", scan_of(pull_plain), mk_state())
+    timed("pull_comb_plus_dus", scan_of(pull_comb), mk_state())
+
+    # flush-first ordering: the PREVIOUS step's rows DUS into the log
+    # BEFORE this step's gather — write-then-read instead of the
+    # read-after-write hazard (which forces a log copy)
+    def scan_flush(body):
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def run(carry, stk, stkpos):
+            def step(c, xs):
+                b, bp = xs
+                return body(c, b, bp), 0.0
+            carry2, _ = lax.scan(step, carry, (stk, stkpos))
+            return carry2
+        return lambda *c: (run(c[0], stacked, stacked_pos),)
+
+    def mk_state_flush():
+        prng = jax.random.PRNGKey(0)
+        prev = jnp.zeros((K, W), jnp.float32)
+        return ((slab + 0.0, log0 + 0.0, prev, jnp.zeros((), jnp.int32),
+                 prng),)
+
+    def pull_comb_flush(c, b, bp):
+        s, lg, prev, cur, prng = c
+        lg = lax.dynamic_update_slice(lg, prev, (cur, 0))
+        rows = pull_rows_combined(s, lg, b["src"])
+        return (s, lg, rows * 0.999, (cur + K) % (L - K), prng)
+
+    timed("pull_comb_flush_first", scan_flush(pull_comb_flush),
+          mk_state_flush())
+
+    def push_flush(c, b, bp):
+        s, lg, prev, cur, prng = c
+        lg = lax.dynamic_update_slice(lg, prev, (cur, 0))
+        prng, sub = jax.random.split(prng)
+        rows = pull_rows_combined(s, lg, b["src"])
+        new_rows = _merged_new_rows(s, b["uids"], b["perm"], b["inv"],
+                                    b["grads"], sub, layout, conf,
+                                    pulled_rows=rows, first_idx=b["first"])
+        return (s, lg, new_rows, (cur + K) % (L - K), prng)
+
+    timed("push_full_flush_first", scan_flush(push_flush), mk_state_flush())
+
+    # --- push variants (all read via combined pull) -------------------
+    def push_common(c, b):
+        s, lg, cur, prng = c
+        prng, sub = jax.random.split(prng)
+        rows = pull_rows_combined(s, lg, b["src"])
+        new_rows = _merged_new_rows(s, b["uids"], b["perm"], b["inv"],
+                                    b["grads"], sub, layout, conf,
+                                    pulled_rows=rows, first_idx=b["first"])
+        return s, lg, cur, prng, new_rows
+
+    def push_nowrite(c, b, bp):
+        s, lg, cur, prng, new_rows = push_common(c, b)
+        # keep new_rows alive via the cursor (scalar) — no log-sized op
+        cur = cur + K + (new_rows[0, 0] * 0.0).astype(jnp.int32)
+        return (s, lg, cur % (L - K), prng)
+
+    def push_dus(c, b, bp):
+        s, lg, cur, prng, new_rows = push_common(c, b)
+        lg = lax.dynamic_update_slice(lg, new_rows, (cur, 0))
+        return (s, lg, (cur + K) % (L - K), prng)
+
+    def push_rebuild(c, b, bp):
+        s, lg, cur, prng, new_rows = push_common(c, b)
+        sel = jnp.take(new_rows, jnp.clip(bp, 0, K - 1), axis=0)
+        s = jnp.where((bp >= 0)[:, None], sel, s)
+        return (s, lg, cur, prng)
+
+    timed("push_nowrite", scan_of(push_nowrite), mk_state())
+    timed("push_dus", scan_of(push_dus), mk_state())
+    timed("push_rebuild", scan_of(push_rebuild), mk_state())
+
+
+if __name__ == "__main__":
+    main()

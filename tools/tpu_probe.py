@@ -42,15 +42,35 @@ def make_trainer(pass_cap):
                               max_len=MAX_LEN, d=D)
 
 
-def stage(name, pass_cap, strip=None):
-    """strip: None | 'push' | 'sparse' — build a variant step."""
+def stage(name, pass_cap, strip=None, push_write=None):
+    """strip: None | 'push' | 'sparse' — build a variant step.
+    push_write: force a write mode (None = the trainer's auto resolve —
+    'log' on tpu backends since round 5)."""
     tr, feed = make_trainer(pass_cap)
+    if push_write is not None:
+        tr._push_write = push_write
+    elif strip is not None:
+        tr._push_write = "scatter"   # stripped steps don't push; plain dicts
     batches = make_ctr_batches(feed, CHUNK, NUM_SLOTS, MAX_LEN, seed=0)
     tr.table.begin_feed_pass()
     for b in batches:
         tr.table.add_keys(b.keys[b.valid])
     tr.table.end_feed_pass()
     tr.table.begin_pass()
+    if tr._push_write == "log" and strip is None:
+        from tools.bench_util import (make_log_bench_state,
+                                      timed_scan_chain_log)
+        stacked, bundle, mpos_np, lb = make_log_bench_state(tr, batches)
+        state = (bundle, tr.params, tr.opt_state, tr.table.next_prng())
+        dt = timed_scan_chain_log(
+            tr.fns.scan_steps, tr.fns.merge_log, state, stacked, REPS,
+            max(1, lb // CHUNK), mpos_np) / CHUNK
+        print(json.dumps({"stage": name, "pass_cap": pass_cap,
+                          "push_write": "log", "log_batches": lb,
+                          "ms_per_step": round(dt * 1e3, 3),
+                          "examples_per_sec": round(BATCH / dt, 1)}),
+              flush=True)
+        return
     stacked = tr._stack_batches(batches)
     if strip is None:
         scan = tr.fns.scan_steps
@@ -94,6 +114,7 @@ def stage(name, pass_cap, strip=None):
     state = (tr.table.slab, tr.params, tr.opt_state, tr.table.next_prng())
     dt = timed_scan_chain(scan, state, stacked, REPS) / CHUNK
     print(json.dumps({"stage": name, "pass_cap": pass_cap,
+                      "push_write": tr._push_write if strip is None else None,
                       "ms_per_step": round(dt * 1e3, 3),
                       "examples_per_sec": round(BATCH / dt, 1)}), flush=True)
 
@@ -112,6 +133,9 @@ if __name__ == "__main__":
         print(json.dumps({"stage": "step_audit", "error": repr(e)[:300]}),
               flush=True)
     stage("full_step_4x_slab", 1 << 22)
+    # r4<->r5 write-mode comparison rows in the same window
+    stage("full_step_rebuild", 1 << 20, push_write="rebuild")
+    stage("full_step_rebuild_4x", 1 << 22, push_write="rebuild")
     stage("no_push", 1 << 20, strip="push")
     stage("dense_only", 1 << 20, strip="sparse")
     # hand-written Pallas in-table adagrad vs the XLA update
