@@ -49,8 +49,11 @@ D = 8
 NUM_SLOTS = 32
 BATCH = 1024
 MAX_LEN = 4
-PASS_CAP = 1 << 20
-CHUNK = 8          # batches per scan megastep dispatch
+PASS_CAP = int(os.environ.get("PBTPU_BENCH_PASSCAP", str(1 << 20)))
+# batches per scan megastep dispatch; override for dispatch-amortization
+# experiments (round 5: per-CALL runtime overhead is ms-scale, so more
+# steps per dispatch is a lever batch-size scaling is not)
+CHUNK = int(os.environ.get("PBTPU_BENCH_CHUNK", "8"))
 STEPS = 12         # timed chunks
 WARMUP = 2
 

@@ -160,6 +160,11 @@ define_flag("use_pallas_push", False,
             "(helped the old scatter write path ~2.6 ms/step on v5e; "
             "measured slightly SLOWER under push_write=rebuild — leave "
             "off there, BASELINE.md)")
+define_flag("strict_bucket_overflow", False,
+            "raise on sharded bucket overflow instead of dropping the "
+            "overflowed keys' gradients with a warning (the "
+            "PADDLE_ENFORCE discipline, box_wrapper_impl.h:139); the "
+            "sharded_bucket_overflow stat counts drops either way")
 define_flag("matmul_dtype", "float32",
             "dense matmul operand dtype: bfloat16 (MXU native, f32 "
             "accumulation; wins once the MLP dominates the step) or float32")

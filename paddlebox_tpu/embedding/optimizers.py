@@ -358,47 +358,58 @@ def push_sparse_rebuild(slab: jnp.ndarray, uids: jnp.ndarray,
     return jnp.where((pos >= 0)[:, None], sel, slab)
 
 
-def push_sparse_log(slab: jnp.ndarray, log: jnp.ndarray, cur: jnp.ndarray,
+def push_sparse_log(buf: jnp.ndarray, cur: jnp.ndarray, capacity: int,
                     uids: jnp.ndarray, perm: jnp.ndarray,
                     inv_sorted: jnp.ndarray, grads: jnp.ndarray,
                     prng: jax.Array, layout: ValueLayout,
                     conf: SparseOptimizerConfig,
                     pulled_rows: jnp.ndarray,
-                    first_idx: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Log-structured push write: updated rows APPEND to a fixed-size log
-    via one dynamic_update_slice instead of mutating the slab at all.
+                    first_idx: jnp.ndarray
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Log-structured push over the UNIFIED buffer: buf[0:capacity) is
+    the slab, buf[capacity:) is the append log; updated rows DUS into the
+    log region at the carried cursor and the slab region is untouched.
 
-    Round-5 measured basis (tools/write_probe.py, axon v5e): DUS of a
-    [K, W] block is flat in buffer size (4.3 ms @1M-row buffer, 4.7 @4M —
-    at the harness floor) while rebuild costs ~ slab bytes (8.7/22.2) and
-    scatter ~ per index (11/18.9). The write becomes slab-size-INDEPENDENT;
-    the slab-proportional cost moves to a once-per-log-fill merge
+    Why this shape (round-5 measured design, tools/log_ablate.py on the
+    axon v5e runtime):
+      * a per-step slab write costs ~ slab bytes (rebuild) or ~ index
+        count + buffer copy (scatter) — both scale;
+      * a DUS append is ~1-2 ms flat — but a SPLIT slab+log needed a
+        2-gather+select combined pull, measured +4.3 ms/step in-scan
+        (the select structure itself, not a read/write hazard);
+      * unifying the buffer makes the pull ONE plain gather, because the
+        host already stages combined indices (`src` = slab id, or
+        capacity + log slot — trainer.LogStageState.assign).
+    The slab-proportional cost moves to a once-per-log-fill merge
     (merge_log_slab), amortized over log_batches steps.
 
-    Contract: the host stages combined pull indices (`src`) so every pull
-    reads the LATEST version (slab or log — ops/sparse.pull_rows_combined),
-    which is why pulled_rows/first_idx are REQUIRED here: the row values
-    fed to the optimizer must come from the combined pull, not a (stale)
-    slab gather. cur is the carried int32 write cursor; the host mirrors
-    it exactly (trainer.LogStageState). Reference work shape: the same
-    PushSparseGradCaseGPU merge + update (box_wrapper_impl.h:373-522);
-    the log-structured write strategy is ours.
+    pulled_rows/first_idx are REQUIRED: row values fed to the optimizer
+    must be the latest versions, i.e. the combined-index pull — a bare
+    slab gather is stale for keys updated since the last merge.
+    Reference work shape: PushSparseGradCaseGPU merge + update
+    (box_wrapper_impl.h:373-522); the write strategy is ours.
     """
-    new_rows = _merged_new_rows(slab, uids, perm, inv_sorted, grads, prng,
+    new_rows = _merged_new_rows(buf, uids, perm, inv_sorted, grads, prng,
                                 layout, conf, pulled_rows, first_idx)
-    log = jax.lax.dynamic_update_slice(log, new_rows,
-                                       (cur, jnp.int32(0)))
-    return log, cur + jnp.int32(uids.shape[0])
+    buf = jax.lax.dynamic_update_slice(
+        buf, new_rows, (jnp.int32(capacity) + cur, jnp.int32(0)))
+    return buf, cur + jnp.int32(uids.shape[0])
 
 
-def merge_log_slab(slab: jnp.ndarray, log: jnp.ndarray,
-                   mpos: jnp.ndarray) -> jnp.ndarray:
-    """Fold a full log back into the slab: mpos ([capacity] int32, host-
-    staged) is each row's LATEST log position since the previous merge, -1
-    for untouched rows. One gather + one select ~ slab bytes — paid once
-    per log fill, not per step."""
-    sel = jnp.take(log, jnp.clip(mpos, 0, log.shape[0] - 1), axis=0)
-    return jnp.where((mpos >= 0)[:, None], sel, slab)
+def merge_log_slab(buf: jnp.ndarray, mpos: jnp.ndarray,
+                   capacity: int) -> jnp.ndarray:
+    """Fold the log region back into the slab region of the unified
+    buffer: mpos ([capacity] int32, host-staged) is each slab row's
+    LATEST log slot since the previous merge, -1 for untouched rows.
+    One gather + one select ~ buffer bytes — paid once per log fill,
+    not per step. The log region is left as-is: its slots are dead until
+    the host reassigns them (LogStageState.take_mpos resets)."""
+    L = buf.shape[0] - capacity
+    mfull = jnp.concatenate(
+        [mpos, jnp.full((L,), -1, jnp.int32)])
+    sel = jnp.take(buf, jnp.int32(capacity) + jnp.clip(mfull, 0, L - 1),
+                   axis=0)
+    return jnp.where((mfull >= 0)[:, None], sel, buf)
 
 
 def make_push_fn(layout: ValueLayout,

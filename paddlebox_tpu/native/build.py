@@ -127,6 +127,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rt_lookup.argtypes = [c.c_void_p, P(c.c_uint64), P(c.c_uint8),
                               c.c_int64, c.c_int32, P(c.c_int32),
                               P(c.c_uint64)]
+    lib.rt_lookup_serve.restype = c.c_int64
+    lib.rt_lookup_serve.argtypes = [c.c_void_p, P(c.c_uint64), c.c_int64,
+                                    c.c_int32, P(c.c_int32)]
     lib.rt_dedup.restype = c.c_int64
     lib.rt_dedup.argtypes = [P(c.c_int32), c.c_int64, c.c_int32,
                              P(c.c_int32), P(c.c_int32), P(c.c_int32),
@@ -146,7 +149,19 @@ def create_route_index(shard_keys) -> Optional[int]:
     total = sum(k.size for k in shard_keys)
     if lib is None or not total:
         return None
-    flat = np.ascontiguousarray(np.concatenate(shard_keys))
+    if total > 2**31 - 1:
+        # rt_* position outputs are int32; beyond that the index would
+        # silently truncate — callers fall back to their numpy tier
+        import logging
+        logging.getLogger("paddlebox_tpu").warning(
+            "native route index disabled: %d keys exceeds the int32 "
+            "position space — searchsorted fallback active", total)
+        return None
+    # single-shard: avoid np.concatenate's copy (a serving-scale mmap key
+    # column must not be copied into RAM just to build the index;
+    # ascontiguousarray on an already-contiguous mmap is a no-op view)
+    flat = (np.ascontiguousarray(shard_keys[0]) if len(shard_keys) == 1
+            else np.ascontiguousarray(np.concatenate(shard_keys)))
     off = np.zeros(len(shard_keys) + 1, np.int64)
     np.cumsum([k.size for k in shard_keys], out=off[1:])
     return lib.rt_index_create(

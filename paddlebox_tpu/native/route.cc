@@ -247,6 +247,26 @@ int64_t rt_lookup(void* index, const uint64_t* keys, const uint8_t* valid,
   return 0;
 }
 
+// Serving-tier key translation (the xbox mmap store's id lookup): like
+// rt_lookup but a key absent from the index maps to miss_id instead of
+// failing — unknown features read as zero rows at serving time
+// (box_wrapper.cc:1286-1318 writes the views; this serves them).
+int64_t rt_lookup_serve(void* index, const uint64_t* keys, int64_t K,
+                        int32_t miss_id, int32_t* out_ids) {
+  RouteIndex* ix = static_cast<RouteIndex*>(index);
+  for (int64_t i = 0; i < K; ++i) {
+    uint64_t k = keys[i];
+    if (k == kEmpty) {
+      out_ids[i] = ix->has_max_key ? ix->max_key_pos : miss_id;
+      continue;
+    }
+    uint64_t h = mix64(k) & ix->mask;
+    while (ix->keys[h] != kEmpty && ix->keys[h] != k) h = (h + 1) & ix->mask;
+    out_ids[i] = (ix->keys[h] == kEmpty) ? miss_id : ix->pos[h];
+  }
+  return 0;
+}
+
 // Per-batch id dedup for the single-shard push (host analog of
 // DedupKeysAndFillIdx, box_wrapper_impl.h:129): hash dedup + counting sort,
 // no comparison sort. Outputs feed push_sparse_hostdedup:

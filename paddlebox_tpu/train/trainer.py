@@ -45,7 +45,6 @@ from paddlebox_tpu.models.base import ModelSpec
 from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm, seqpool_sum
 from paddlebox_tpu.ops.sparse import (build_push_grads,
                                       build_push_grads_extended,
-                                      pull_rows_combined,
                                       pull_sparse, pull_sparse_extended,
                                       pull_view_from_rows)
 from paddlebox_tpu.utils.timer import Timer
@@ -285,27 +284,28 @@ def resolve_push_write(capacity: Optional[int] = None,
                        allow_log: bool = False) -> str:
     """'scatter' | 'rebuild' | 'log' from the push_write flag.
 
-    'auto' picks by measured cost model on tpu backends
-    (tools/write_probe.py, round 5): the log-structured write is flat in
-    BOTH slab size and touched rows (DUS 4.3 ms @1M-row buffer, 4.7 @4M,
-    at the harness floor) and beats rebuild (8.7/22.2, ~ slab bytes) and
-    scatter (11/18.9, ~ per index) at every measured size — so auto takes
-    it wherever the caller supports it (allow_log). Paths that can't run
-    the log (expand models, async dense, chunk-sync sparse, the sharded
-    runners) keep the r4 crossover: rebuild while the slab is ≤ ~16× the
-    per-batch key budget, else scatter. CPU always scatters (its scatter
-    is cheap; a full-slab rewrite per batch is not).
+    'auto' picks by measured cost model on tpu backends (round-5 battery,
+    tools/tpu_probe.py): rebuild wins in the small-slab regime (14.9
+    ms/step @1M rows vs log 15.7 — its gather/select ~ slab bytes is
+    cheap there), while the log-structured write wins at scale (26.7
+    @4M vs rebuild 34.4 / r4 scatter 25.0 → the gap grows with slab) —
+    so auto keeps the r4 crossover at ~16× the per-batch key budget and
+    replaces the big-slab SCATTER retreat with the log wherever the
+    caller supports it (allow_log). Paths that can't run the log (expand
+    models, async dense, chunk-sync sparse, the sharded runners) retreat
+    to scatter as before. CPU always scatters (its scatter is cheap; a
+    full-slab rewrite per batch is not).
     """
     from paddlebox_tpu.config import flags
     mode = flags.get_flag("push_write")
     if mode == "auto":
         if jax.default_backend() not in ("tpu", "axon"):
             return "scatter"
+        if capacity and batch_keys and capacity <= 16 * batch_keys:
+            return "rebuild"
         if allow_log:
             return "log"
-        if capacity and batch_keys:
-            return "rebuild" if capacity <= 16 * batch_keys else "scatter"
-        return "rebuild"
+        return "scatter" if capacity and batch_keys else "rebuild"
     if mode == "log" and not allow_log:
         raise ValueError(
             "push_write=log is unsupported on this path (expand models, "
@@ -390,10 +390,11 @@ def resolve_push_write_sharded(shard_cap: int, num_shards: int,
     """ONE shard-regime policy for every sharded runner (trainer +
     pipeline): per-shard slab rows vs the padded incoming a2a key budget
     (num_shards buckets of bucket_cap land on every shard). Multi-process
-    always scatters — a peer process's incoming ids are not host-visible,
-    so the pos maps cannot be staged."""
-    if multiprocess:
-        return "scatter"
+    runs the same policy since round 5: the per-step bucket exchange
+    (sharded_table.exchange_outgoing_buckets) makes every shard's
+    incoming ids host-known cluster-wide, so host dedup + rebuild pos
+    maps stage identically to single-process."""
+    del multiprocess  # kept in the signature for call-site clarity
     return resolve_push_write(capacity=shard_cap,
                               batch_keys=num_shards * bucket_cap)
 
@@ -621,8 +622,10 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         {slab, log, cur} (push_write='log') — there the pull reads each
         key's LATEST version through the host-staged combined index."""
         if isinstance(state, dict):
-            rows = pull_rows_combined(state["slab"], state["log"],
-                                      batch["src"])
+            # unified slab+log buffer: src addresses the latest version of
+            # every key directly — one plain gather (the split-buffer
+            # 2-gather select measured +4.3 ms/step, tools/log_ablate.py)
+            rows = jnp.take(state["buf"], batch["src"], axis=0)
             return pull_view_from_rows(rows, layout), rows
         ids = batch["ids"]
         if use_expand:
@@ -631,7 +634,7 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         return pull_view_from_rows(rows, layout), rows
 
     def _sparse_push(state, demb, batch, sub, pulled_rows=None):
-        slab = state["slab"] if isinstance(state, dict) else state
+        slab = state["buf"] if isinstance(state, dict) else state
         # per-key click = its instance's label (first task's label)
         key_label_src = batch["labels_" + model.task_names[0]] if multi_task \
             else batch["labels"]
@@ -663,17 +666,17 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         if isinstance(state, dict):
             # log-structured write (push_write='log'): requires the
             # combined pull (rows ARE the latest versions) — the slab
-            # alone may be stale for keys updated since the last merge
+            # region alone may be stale for keys updated since the merge
             if rows is None or fi is None:
                 raise RuntimeError(
                     "push_write=log needs the pull-row reuse products "
                     "(pulled_rows + first_idx) — staging must provide "
                     "src/first_idx and the model must not be expand")
-            lg, cur = push_sparse_log(
-                slab, state["log"], state["cur"], uids, batch["perm"],
-                batch["inv"], push_grads, sub, layout, conf,
-                pulled_rows=rows, first_idx=fi)
-            return {"slab": slab, "log": lg, "cur": cur}
+            buf, cur = push_sparse_log(
+                slab, state["cur"], table.pass_capacity, uids,
+                batch["perm"], batch["inv"], push_grads, sub, layout,
+                conf, pulled_rows=rows, first_idx=fi)
+            return {"buf": buf, "cur": cur}
         if "push_pos" in batch:
             return push_sparse_rebuild(slab, uids, batch["push_pos"],
                                        batch["perm"], batch["inv"],
@@ -841,8 +844,9 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def merge_log_fn(state, mpos):
-        return {"slab": merge_log_slab(state["slab"], state["log"], mpos),
-                "log": state["log"], "cur": jnp.zeros((), jnp.int32)}
+        return {"buf": merge_log_slab(state["buf"], mpos,
+                                      table.pass_capacity),
+                "cur": jnp.zeros((), jnp.int32)}
 
     return TrainStepFns(step=step_async if async_dense else step,
                         eval_step=eval_step,
@@ -1135,10 +1139,12 @@ class BoxTrainer:
             self._log_stage = LogStageState(
                 self.table.capacity, K,
                 resolve_log_batches(self.table.capacity, K, chunk))
-            state = {"slab": self.table.slab,
-                     "log": jnp.zeros((self._log_stage.log_rows,
-                                       self.table.layout.width),
-                                      jnp.float32),
+            # unified buffer: slab rows [0, capacity) + log region after
+            state = {"buf": jnp.concatenate(
+                         [self.table.slab,
+                          jnp.zeros((self._log_stage.log_rows,
+                                     self.table.layout.width),
+                                    jnp.float32)]),
                      "cur": jnp.zeros((), jnp.int32)}
             self.table.set_slab(None)  # the bundle owns the (donated) slab
         else:
@@ -1246,12 +1252,12 @@ class BoxTrainer:
             if self.dump_writer is not None:
                 self._dump_batch(preds, b)
         if log_mode:
-            # fold any remaining log entries, hand the merged slab back to
-            # the table for end_pass write-back, and drop the log
+            # fold any remaining log entries, hand the merged slab region
+            # back to the table for end_pass write-back, drop the log
             if self._log_stage.cur:
                 state = self.fns.merge_log(
                     state, jnp.asarray(self._log_stage.take_mpos()))
-            self.table.set_slab(state["slab"])
+            self.table.set_slab(state["buf"][:self.table.capacity])
             self._log_stage = None
         self.table.end_pass()
         if self.async_table is not None:

@@ -18,3 +18,22 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _flag_isolation():
+    """Snapshot/restore the process-flag registry around EVERY test:
+    round-4's full-suite-order flake (test_hierarchical_mesh_matches_flat
+    passing alone, failing in suite order) was cross-test contamination of
+    exactly this global state — a test that sets a flag and raises (or
+    just forgets to reset) silently changes every later test's numerics.
+    Restoring unconditionally makes test order irrelevant to flags."""
+    from paddlebox_tpu.config import flags as _f
+
+    snapshot = _f.all_flags()
+    yield
+    for name, value in snapshot.items():
+        if _f.get_flag(name) != value:
+            _f.set_flag(name, value)

@@ -84,7 +84,8 @@ def run_single_process_oracle(files, feed):
 
 
 def run_cluster(files, extra_cfg=None, world=2,
-                            devs_per_proc=4, worker_script=None):
+                            devs_per_proc=4, worker_script=None,
+                            extra_env=None):
     """Spawn a `world`-process localhost cluster (subprocess pattern,
     test_dist_base.py:896-1012) and collect each rank's RESULT line."""
     from paddlebox_tpu.fleet.store import KVStoreServer
@@ -112,6 +113,7 @@ def run_cluster(files, extra_cfg=None, world=2,
                 "PBTPU_STORE_ENDPOINT": "127.0.0.1:%d" % server.port,
                 "PBTPU_RUN_ID": run_id,
             })
+            env.update(extra_env or {})
             procs.append(subprocess.Popen(
                 [sys.executable, worker, cfg], env=env,
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
@@ -162,6 +164,54 @@ def test_two_process_cluster_matches_single_process(data, oracle, tmp_path):
         assert r["total_after_shuffle"] == 8 * 128, r
         assert 0 < r["local_after_shuffle"] < 8 * 128, r
         assert np.isfinite(r["shuffled_loss"]), r
+
+
+def test_two_process_rebuild_matches_oracle(data, oracle):
+    """Round-5 verdict item 2: push_write=rebuild at process_count > 1.
+    The per-step bucket exchange (exchange_outgoing_buckets) makes every
+    shard's incoming ids host-known, so the scatter-free pos-map write
+    runs in the multi-process flagship shape too — and must reproduce
+    the single-process (scatter-mode) oracle's rows."""
+    files, feed = data
+    ref_losses, ref_msg, ref_rows = oracle
+    results = run_cluster(files,
+                          extra_env={"PBTPU_PUSH_WRITE": "rebuild"})
+    assert set(results) == {0, 1}
+    np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(results[0]["losses"], ref_losses, rtol=1e-4)
+    merged_rows = {**results[0]["rows"], **results[1]["rows"]}
+    checked = 0
+    for k, v in merged_rows.items():
+        if k in ref_rows:
+            np.testing.assert_allclose(np.asarray(v), ref_rows[k],
+                                       rtol=1e-4, atol=1e-6,
+                                       err_msg=f"row mismatch key {k}")
+            checked += 1
+    assert checked >= 8, f"only {checked} rows overlapped for comparison"
+
+
+def test_two_process_pipeline_rebuild(data, pipeline_cluster):
+    """The sharded pipeline's multi-process fast push (round-5 verdict
+    item 2): forced push_write=rebuild across 2 processes must reproduce
+    the default-mode cluster run exactly (same losses, same replicated
+    stage params) — the exchanged pos maps change the write strategy,
+    never the numbers."""
+    files, _feed = data
+    base = pipeline_cluster
+    results = run_cluster(files, {"n_micro": PIPE_N_MICRO}, world=2,
+                          devs_per_proc=4,
+                          worker_script="multihost_pipeline_worker.py",
+                          extra_env={"PBTPU_PUSH_WRITE": "rebuild"})
+    assert set(results) == {0, 1}
+    np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(results[0]["losses"], base[0]["losses"],
+                               rtol=1e-5,
+                               err_msg="rebuild-mode cluster diverges "
+                                       "from default-mode cluster")
+    np.testing.assert_allclose(results[0]["blk_head"], base[0]["blk_head"],
+                               rtol=1e-5)
 
 
 def test_two_process_gpups_over_central_ps(data, oracle):

@@ -108,9 +108,10 @@ def make_log_bench_state(trainer, batches):
     stacked, mpos0 = trainer._stack_batches(batches)
     assert mpos0 is None, "bench chunk must fit the fresh log"
     mpos_np = trainer._log_stage.last_slot.copy()
-    bundle = {"slab": trainer.table.slab,
-              "log": jnp.zeros((trainer._log_stage.log_rows,
-                                trainer.table.layout.width), jnp.float32),
+    bundle = {"buf": jnp.concatenate(
+                  [trainer.table.slab,
+                   jnp.zeros((trainer._log_stage.log_rows,
+                              trainer.table.layout.width), jnp.float32)]),
               "cur": jnp.zeros((), jnp.int32)}
     return stacked, bundle, mpos_np, lb
 

@@ -1,0 +1,157 @@
+"""Peak sparse params/chip + reference-key-budget step (round-5 item 3).
+
+Measures the unreported half of BASELINE.json's metric:
+  1. the largest pass slab that BUILDS AND TRAINS on the chip — walk the
+     capacity ladder until allocation/compile fails, reporting ms/step
+     and params/chip at each size (params = rows × width incl optimizer
+     state; trainable = rows × (1 + embedx_dim));
+  2. one step at the reference's per-batch key budget (1800×2048 ≈ 3.69M
+     keys — heter_comm.h:348) — the key-throughput shape the closed core
+     is sized for.
+
+The slab is created ON DEVICE (jnp.zeros) and the pass key set is only
+the bench batches' keys: promotion H2D of a multi-GB slab through the
+~68 MB/s tunnel would measure the link, not the chip (BASELINE.md). The
+step itself is the production fused step (make_train_step via
+make_bench_trainer), write mode from the auto resolve at each capacity.
+
+Usage: timeout 3000 python -u tools/capacity_probe.py [platform] [caps...]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms",
+                  sys.argv[1] if len(sys.argv) > 1 else "axon")
+
+import jax.numpy as jnp
+import numpy as np
+
+from tools.bench_util import (make_bench_trainer, make_ctr_batches,
+                              make_log_bench_state, timed_scan_chain,
+                              timed_scan_chain_log)
+
+D, NUM_SLOTS, BATCH, MAX_LEN = 8, 32, 1024, 4
+CHUNK, REPS = 8, 3
+
+
+def fake_begin_pass(tr, cap):
+    """Device-side slab creation (no multi-GB H2D through the tunnel)."""
+    W = tr.table.layout.width
+    tr.table._slab = jnp.zeros((cap, W), jnp.float32)
+    tr.table._in_pass = True
+
+
+def try_cap(cap):
+    t0 = time.perf_counter()
+    tr, feed = make_bench_trainer(cap, batch=BATCH, num_slots=NUM_SLOTS,
+                                  max_len=MAX_LEN, d=D)
+    batches = make_ctr_batches(feed, CHUNK, NUM_SLOTS, MAX_LEN, seed=0)
+    tr.table.begin_feed_pass()
+    for b in batches:
+        tr.table.add_keys(b.keys[b.valid])
+    tr.table.end_feed_pass()
+    W = tr.table.layout.width
+    if tr._push_write == "log":
+        # build the unified buffer DIRECTLY on device — going through
+        # begin_pass + concat would transiently hold 2× the slab and
+        # halve the measurable capacity
+        from paddlebox_tpu.train.trainer import (LogStageState,
+                                                 resolve_log_batches)
+        K = feed.key_capacity()
+        lb = resolve_log_batches(cap, K, CHUNK)
+        tr._log_stage = LogStageState(cap, K, lb)
+        stacked, mpos0 = tr._stack_batches(batches)
+        assert mpos0 is None
+        mpos_np = tr._log_stage.last_slot.copy()
+        bundle = {"buf": jnp.zeros((cap + lb * K, W), jnp.float32),
+                  "cur": jnp.zeros((), jnp.int32)}
+        state = (bundle, tr.params, tr.opt_state, tr.table.next_prng())
+        dt = timed_scan_chain_log(tr.fns.scan_steps, tr.fns.merge_log,
+                                  state, stacked, REPS,
+                                  max(1, lb // CHUNK), mpos_np) / CHUNK
+    else:
+        fake_begin_pass(tr, cap)
+        stacked = tr._stack_batches(batches)
+        state = (tr.table.slab, tr.params, tr.opt_state,
+                 tr.table.next_prng())
+        dt = timed_scan_chain(tr.fns.scan_steps, state, stacked,
+                              REPS) / CHUNK
+    rec = {
+        "cap_rows": cap,
+        "push_write": tr._push_write,
+        "slab_gb": round(cap * W * 4 / 2**30, 2),
+        "params_per_chip": cap * W,
+        "trainable_params_per_chip": cap * (1 + D),
+        "ms_per_step": round(dt * 1e3, 2),
+        "examples_per_sec": round(BATCH / dt, 0),
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+    print(json.dumps(rec), flush=True)
+    return True
+
+
+def reference_key_budget():
+    """One step at ~1800 keys/instance × 2048 instances (heter_comm.h:348):
+    batch 2048, 32 slots × max_len 56 ≈ 1792 keys/ins → K ≈ 3.67M."""
+    cap = 1 << 23
+    tr, feed = make_bench_trainer(cap, batch=2048, num_slots=NUM_SLOTS,
+                                  max_len=56, d=D)
+    batches = make_ctr_batches(feed, 2, NUM_SLOTS, 56, seed=0)
+    tr.table.begin_feed_pass()
+    for b in batches:
+        tr.table.add_keys(b.keys[b.valid])
+    tr.table.end_feed_pass()
+    fake_begin_pass(tr, cap)
+    if tr._push_write == "log":
+        stacked, bundle, mpos_np, lb = make_log_bench_state(tr, batches)
+        state = (bundle, tr.params, tr.opt_state, tr.table.next_prng())
+        dt = timed_scan_chain_log(tr.fns.scan_steps, tr.fns.merge_log,
+                                  state, stacked, REPS,
+                                  max(1, lb // 2), mpos_np) / 2
+    else:
+        stacked = tr._stack_batches(batches)
+        state = (tr.table.slab, tr.params, tr.opt_state,
+                 tr.table.next_prng())
+        dt = timed_scan_chain(tr.fns.scan_steps, state, stacked,
+                              REPS) / 2
+    K = feed.key_capacity()
+    print(json.dumps({
+        "stage": "reference_key_budget",
+        "keys_per_batch": K, "batch": 2048, "pass_cap": cap,
+        "push_write": tr._push_write,
+        "ms_per_step": round(dt * 1e3, 2),
+        "keys_per_sec": round(K / dt, 0),
+        "examples_per_sec": round(2048 / dt, 0),
+    }), flush=True)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(json.dumps({"device": str(dev), "platform": dev.platform}),
+          flush=True)
+    caps = ([int(a) for a in sys.argv[2:]]
+            or [1 << 23, 1 << 24, 1 << 25, 1 << 26, 3 << 25, 1 << 27])
+    for cap in caps:
+        try:
+            ok = try_cap(cap)
+        except Exception as e:
+            print(json.dumps({"cap_rows": cap,
+                              "error": repr(e)[:300]}), flush=True)
+            ok = False
+        if not ok:
+            break
+    try:
+        reference_key_budget()
+    except Exception as e:
+        print(json.dumps({"stage": "reference_key_budget",
+                          "error": repr(e)[:300]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -201,6 +201,86 @@ def main():
     timed("push_dus", scan_of(push_dus), mk_state())
     timed("push_rebuild", scan_of(push_rebuild), mk_state())
 
+    # ---- operand-placement matrix (round-5b): what makes the combined
+    # pull cost ~5 ms in-scan, and what scales with cap in log mode?
+    for cap2 in (CAP, CAP * 4):
+        slab2 = jnp.asarray(rng.rand(cap2, W).astype(np.float32))
+        tag = {"cap": cap2}
+        ids2 = jnp.asarray(
+            np.broadcast_to(rng.randint(0, cap2, K).astype(np.int32),
+                            (CHUNK, K)).copy())
+        src2_np = rng.randint(0, cap2, (CHUNK, K)).astype(np.int32)
+        src2_np[:, ::7] = cap2 + rng.randint(0, L, src2_np[:, ::7].shape)
+        src2 = jnp.asarray(src2_np)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def dus_only(carry, stk):
+            def step(c, b):
+                lg, cur = c
+                nr2 = jnp.ones((K, W), jnp.float32) * b[0].astype(jnp.float32)
+                return (lax.dynamic_update_slice(lg, nr2, (cur, 0)),
+                        (cur + K) % (L - K)), 0.0
+            c2, _ = lax.scan(step, carry, stk)
+            return c2
+
+        timed("m_dus_only_logcarry", lambda *c: (dus_only(c[0], ids2),),
+              ((log0 + 0.0, jnp.zeros((), jnp.int32)),), tag)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def gather_carry(carry, stk):
+            def step(c, b):
+                s, acc = c
+                rows = jnp.take(s, jnp.minimum(b, cap2 - 1), axis=0)
+                return (s, acc + rows[:1, :1]), 0.0
+            c2, _ = lax.scan(step, carry, stk)
+            return c2
+
+        timed("m_gather_slabcarry",
+              lambda *c: (gather_carry(c[0], ids2),),
+              ((slab2 + 0.0, jnp.zeros((1, 1))),), tag)
+
+        @jax.jit
+        def gather_inv(acc, stk, s):
+            def step(a, b):
+                rows = jnp.take(s, jnp.minimum(b, cap2 - 1), axis=0)
+                return a + rows[:1, :1], 0.0
+            a2, _ = lax.scan(step, acc, stk)
+            return a2
+
+        timed("m_gather_slabinv",
+              lambda *c: (gather_inv(c[0], ids2, slab2),),
+              (jnp.zeros((1, 1)),), tag)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def comb_carry(carry, stk):
+            def step(c, b):
+                s, lg, cur, acc = c
+                rows = pull_rows_combined(s, lg, b)
+                lg = lax.dynamic_update_slice(lg, rows * 0.999, (cur, 0))
+                return (s, lg, (cur + K) % (L - K), acc + rows[:1, :1]), 0.0
+            c2, _ = lax.scan(step, carry, stk)
+            return c2
+
+        timed("m_comb_carry_dus",
+              lambda *c: (comb_carry(c[0], src2),),
+              ((slab2 + 0.0, log0 + 0.0, jnp.zeros((), jnp.int32),
+                jnp.zeros((1, 1))),), tag)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def comb_nowrite(carry, stk, s):
+            # log carried but NEVER written: is the read the cost, or the
+            # read+write combination?
+            def step(c, b):
+                lg, acc = c
+                rows = pull_rows_combined(s, lg, b)
+                return (lg, acc + rows[:1, :1]), 0.0
+            c2, _ = lax.scan(step, carry, stk)
+            return c2
+
+        timed("m_comb_logcarry_nowrite",
+              lambda *c: (comb_nowrite(c[0], src2, slab2),),
+              ((log0 + 0.0, jnp.zeros((1, 1))),), tag)
+
 
 if __name__ == "__main__":
     main()

@@ -1,0 +1,129 @@
+"""Does lax.scan double-buffer big mutated carries where fori_loop
+aliases them in place?
+
+Round-5 evidence so far: a DUS write in a fori chain measures ~free
+(write_probe), but the unified-buffer log step under lax.scan still
+costs ~ buffer bytes per step. If scan copies mutated carries and fori
+does not, the megastep loop should be fori with manual ys.
+
+Body per iteration (the log-mode write pattern at bench shapes):
+  rows = gather(buf, src)         [K rows]
+  buf  = DUS(buf, rows*0.999, (cap+cur, 0))
+  acc += rows[0, 0]               (chain + sync point)
+
+Donated jit, state threaded across reps, ONE np.asarray sync of the
+small dependent output per rep (same pattern as timed_scan_chain).
+
+Usage: timeout 1200 python -u tools/scan_vs_fori.py [platform] [rows...]
+"""
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms",
+                  sys.argv[1] if len(sys.argv) > 1 else "axon")
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+W = 17
+K = 131072
+ITERS = 8
+REPS = 4
+L = 16 * K
+
+
+def timed(name, fn, state, extra=None):
+    try:
+        out = fn(*state)
+        np.asarray(out[-1])           # sync on the small acc only
+        st = out
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            st = fn(*st[:-1], st[-1])
+            np.asarray(st[-1])
+        ms = (time.perf_counter() - t0) / REPS / ITERS * 1e3
+    except Exception as e:
+        print(json.dumps({"op": name, "error": str(e)[:200]}), flush=True)
+        return
+    rec = {"op": name, "ms_per_iter": round(ms, 4)}
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def probe(cap, rng):
+    tag = {"cap": cap, "buf_rows": cap + L}
+    buf = jnp.asarray(rng.rand(cap + L, W).astype(np.float32))
+    src = jnp.asarray(rng.randint(0, cap, K).astype(np.int32))
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def scan_step(buf, src, acc):
+        def body(c, _):
+            b, cur, a = c
+            rows = jnp.take(b, src + cur * 0, axis=0)
+            b = lax.dynamic_update_slice(
+                b, rows * 0.999, (jnp.int32(cap) + cur, 0))
+            return (b, (cur + K) % (L - K), a + rows[0, 0]), 0.0
+        (b, cur, a), _ = lax.scan(
+            body, (buf, jnp.int32(0), acc),
+            jnp.arange(ITERS, dtype=jnp.int32))
+        return b, src, a
+
+    timed("scan_gather_dus", scan_step, (buf + 0.0, src,
+                                         jnp.zeros(())), tag)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def fori_step(buf, src, acc):
+        def body(i, c):
+            b, cur, a = c
+            rows = jnp.take(b, src + cur * 0, axis=0)
+            b = lax.dynamic_update_slice(
+                b, rows * 0.999, (jnp.int32(cap) + cur, 0))
+            return (b, (cur + K) % (L - K), a + rows[0, 0])
+        b, cur, a = lax.fori_loop(0, ITERS, body,
+                                  (buf, jnp.int32(0), acc))
+        return b, src, a
+
+    timed("fori_gather_dus", fori_step, (buf + 0.0, src,
+                                         jnp.zeros(())), tag)
+
+    # fori with manual small-ys accumulation (what a megastep needs)
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def fori_ys(buf, src, acc):
+        losses = jnp.zeros((ITERS,), jnp.float32)
+
+        def body(i, c):
+            b, cur, ls, a = c
+            rows = jnp.take(b, src + cur * 0, axis=0)
+            b = lax.dynamic_update_slice(
+                b, rows * 0.999, (jnp.int32(cap) + cur, 0))
+            ls = lax.dynamic_update_slice(ls, rows[:1, 0], (i,))
+            return (b, (cur + K) % (L - K), ls, a + rows[0, 0])
+        b, cur, ls, a = lax.fori_loop(0, ITERS, body,
+                                      (buf, jnp.int32(0), losses, acc))
+        return b, src, a + ls.sum()
+
+    timed("fori_gather_dus_ys", fori_ys, (buf + 0.0, src,
+                                          jnp.zeros(())), tag)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(json.dumps({"device": str(dev), "platform": dev.platform,
+                      "K": K, "log_rows": L, "iters": ITERS}), flush=True)
+    rng = np.random.RandomState(0)
+    caps = [int(a) for a in sys.argv[2:]] or [1 << 20, 1 << 22]
+    for cap in caps:
+        probe(cap, rng)
+
+
+if __name__ == "__main__":
+    main()

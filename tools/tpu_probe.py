@@ -137,6 +137,9 @@ if __name__ == "__main__":
     stage("full_step_rebuild", 1 << 20, push_write="rebuild")
     stage("full_step_rebuild_4x", 1 << 22, push_write="rebuild")
     stage("no_push", 1 << 20, strip="push")
+    # capacity-growth attribution: if this row grows with pass_cap too,
+    # the 4x-slab cost lives in pull/dense/scan, not the push write
+    stage("no_push_4x", 1 << 22, strip="push")
     stage("dense_only", 1 << 20, strip="sparse")
     # hand-written Pallas in-table adagrad vs the XLA update
     from paddlebox_tpu.config import flags as _flags

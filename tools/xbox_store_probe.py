@@ -1,0 +1,107 @@
+"""Measure the serving-scale xbox mmap store (round-5 verdict item 8).
+
+Builds a synthetic sorted columnar base of N keys DIRECTLY ON DISK (the
+file is written in chunks — the probe box never holds the row matrix in
+RAM, matching the store's no-full-ingest contract), then measures:
+  * store open (mmap + native key-index build) seconds
+  * lookup keys/s, hot (resident working set) and uniform-random over
+    the whole base, at serving batch sizes
+  * the searchsorted fallback tier for comparison
+
+Usage: timeout 1800 python -u tools/xbox_store_probe.py [n_keys] [dim]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from paddlebox_tpu.train.checkpoint import (MmapXboxStore, _XBOX_MAGIC)
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 30_000_000
+DIM = int(sys.argv[2]) if len(sys.argv) > 2 else 9
+PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "_xbox_probe.store")
+CHUNK = 4_000_000
+BATCH = 131072          # serving batch = the trainer's per-batch key budget
+
+
+def build_file():
+    """Sorted keys = 16*i + small jitter (strictly increasing, sparse in
+    key space so misses are probeable); rows = f32 pattern."""
+    t0 = time.perf_counter()
+    key_off = (8 + 8 + 8 + 63) // 64 * 64
+    row_off = (key_off + N * 8 + 63) // 64 * 64
+    with open(PATH, "wb") as f:
+        f.write(_XBOX_MAGIC)
+        f.write(np.int64(N).tobytes())
+        f.write(np.int64(DIM).tobytes())
+        for lo in range(0, N, CHUNK):
+            n = min(CHUNK, N - lo)
+            ks = (np.arange(lo, lo + n, dtype=np.uint64) * 16
+                  + np.uint64(3))
+            f.seek(key_off + lo * 8)
+            ks.tofile(f)
+        for lo in range(0, N, CHUNK):
+            n = min(CHUNK, N - lo)
+            rows = np.ones((n, DIM), np.float32)
+            rows[:, 0] = np.arange(lo, lo + n, dtype=np.float32)
+            f.seek(row_off + lo * DIM * 4)
+            rows.tofile(f)
+    print(json.dumps({"stage": "build_file", "n": N, "dim": DIM,
+                      "bytes": os.path.getsize(PATH),
+                      "secs": round(time.perf_counter() - t0, 1)}),
+          flush=True)
+
+
+def run_lookups(store, tag):
+    rng = np.random.RandomState(0)
+    # hot set: 1M distinct keys probed repeatedly (the serving cache case)
+    hot_ids = rng.randint(0, min(N, 1 << 20), 4 * BATCH).astype(np.uint64)
+    hot = hot_ids * np.uint64(16) + np.uint64(3)
+    # uniform: spans the whole base (page-cache-hostile case) + 10% misses
+    uni_ids = rng.randint(0, N, 4 * BATCH).astype(np.uint64)
+    uni = uni_ids * np.uint64(16) + np.uint64(3)
+    uni[::10] += np.uint64(1)  # misses
+    for name, probe in (("hot", hot), ("uniform", uni)):
+        batches = probe.reshape(4, BATCH)
+        store.lookup(batches[0])      # warm
+        t0 = time.perf_counter()
+        reps = 0
+        while time.perf_counter() - t0 < 3.0:
+            out = store.lookup(batches[reps % 4])
+            reps += 1
+        dt = time.perf_counter() - t0
+        kps = reps * BATCH / dt
+        # correctness spot check on the last batch
+        got = out[:, 0]
+        ids = batches[(reps - 1) % 4] // np.uint64(16)
+        hitmask = (batches[(reps - 1) % 4] % np.uint64(16)
+                   ) == np.uint64(3)
+        assert np.allclose(got[hitmask], ids[hitmask].astype(np.float32))
+        assert (out[~hitmask] == 0).all()
+        print(json.dumps({"stage": f"lookup_{name}_{tag}",
+                          "keys_per_sec": round(kps, 0),
+                          "batch": BATCH, "reps": reps}), flush=True)
+
+
+def main():
+    if not (os.path.exists(PATH)
+            and os.path.getsize(PATH) > N * (8 + DIM * 4)):
+        build_file()
+    t0 = time.perf_counter()
+    store = MmapXboxStore(PATH)
+    print(json.dumps({"stage": "open_with_index", "n": len(store),
+                      "secs": round(time.perf_counter() - t0, 1),
+                      "native_index": store._index is not None}),
+          flush=True)
+    run_lookups(store, "native")
+    store.close()   # drops to the searchsorted fallback tier
+    run_lookups(store, "searchsorted")
+
+
+if __name__ == "__main__":
+    main()
