@@ -117,6 +117,21 @@ define_flag("chunk_prefetch_depth", 1,
             "thread while the device trains (the shard_batches stager "
             "role; peak extra memory = this many staged chunks); 0 = "
             "stage inline between dispatches")
+define_flag("h2d_lean", False,
+            "input-bound deployments (slow host->device links): stage "
+            "train batches WITHOUT the host dedup products (~70% fewer "
+            "H2D bytes/batch: ids+segments+labels only) and dedup on "
+            "device instead (jnp.unique sort in the step, ~+8 ms on the "
+            "axon chip). Forces push_write=scatter (rebuild/log need "
+            "host-staged maps). Wins when H2D bytes dominate the pass "
+            "(the 68 MB/s tunnel regime, BASELINE.md e2e rows); the "
+            "resident-data step is faster with host dedup")
+define_flag("h2d_stack_chunks", 1,
+            "scan chunks whose host-staged batch arrays share ONE device "
+            "transfer per leaf (the per-transfer fixed cost — ~250 ms on "
+            "the axon tunnel — amortizes over the group; per-chunk views "
+            "are device-side slices). 1 = one transfer set per chunk; "
+            "peak staged host memory grows with the group")
 define_flag("stack_threads", 4,
             "host batch-staging threads per scan chunk (lookup + dedup; "
             "the feed-thread pool role, box_wrapper.h:862); <=1 = serial")

@@ -113,7 +113,9 @@ def run_scan_chunks(scan_call: Callable, items, chunk: int,
                     on_chunk: Callable, timer=None,
                     n_items: Optional[int] = None,
                     chunk1_ok: bool = False,
-                    prefetch_depth: int = 0):
+                    prefetch_depth: int = 0,
+                    transfer_group: int = 1,
+                    group_fn: Optional[Callable] = None):
     """Drive the megastep over full chunks of `items`, double-buffered:
     chunk i+1 is host-stacked and dispatched BEFORE chunk i's results are
     pulled to host, so H2D staging and metric extraction overlap device
@@ -136,6 +138,14 @@ def run_scan_chunks(scan_call: Callable, items, chunk: int,
     compute instead of serially between dispatches. stack_fn must be
     safe to call off-thread (the table is read-only during a pass). Peak
     extra memory = prefetch_depth staged chunks.
+
+    transfer_group > 1 + group_fn: stack_fn returns HOST-staged items and
+    group_fn(list_of_staged) converts that many chunks to device items
+    with ONE H2D transfer per leaf for the whole group — the per-transfer
+    fixed cost (~250 ms on the axon tunnel, BASELINE.md) amortizes over
+    the group instead of being paid per chunk per leaf (round-5 verdict
+    item 4; the MiniBatchGpuPack pinned-buffer stacking role,
+    data_feed.h:519-680).
     Returns (carry, losses, n_consumed)."""
     losses_all: List[float] = []
     if n_items is None:
@@ -167,6 +177,26 @@ def run_scan_chunks(scan_call: Callable, items, chunk: int,
             group = [next(it) for _ in range(chunk)]
             yield lo, group, stack_fn(group)
 
+    def transfer(src):
+        # grouped H2D: buffer G host-staged chunks, device-ize together
+        if group_fn is None or transfer_group <= 1:
+            yield from src
+            return
+        buf = []
+
+        def emit(b):
+            for (lo, group, _), dev in zip(b, group_fn(
+                    [x[2] for x in b])):
+                yield lo, group, dev
+
+        for item in src:
+            buf.append(item)
+            if len(buf) == transfer_group:
+                yield from emit(buf)
+                buf = []
+        if buf:
+            yield from emit(buf)
+
     stop = None
     producer = None
     if prefetch_depth > 0 and n_full:
@@ -186,7 +216,7 @@ def run_scan_chunks(scan_call: Callable, items, chunk: int,
 
         def produce():
             try:
-                for item in chunks():
+                for item in transfer(chunks()):
                     if not _put(item):
                         return
             except BaseException as e:   # surfaced at the consumer's get
@@ -204,7 +234,7 @@ def run_scan_chunks(scan_call: Callable, items, chunk: int,
                 yield item
         source = staged_chunks()
     else:
-        source = chunks()
+        source = transfer(chunks())
 
     try:
         for lo, group, stacked in source:
@@ -298,6 +328,14 @@ def resolve_push_write(capacity: Optional[int] = None,
     """
     from paddlebox_tpu.config import flags
     mode = flags.get_flag("push_write")
+    if flags.get_flag("h2d_lean"):
+        # wire-lean staging ships no host dedup products, so the
+        # host-map-dependent writes (rebuild pos / log src) can't stage
+        if mode not in ("auto", "scatter"):
+            raise ValueError(
+                f"h2d_lean stages no host push products; push_write="
+                f"{mode!r} needs them — use 'auto' or 'scatter'")
+        return "scatter"
     if mode == "auto":
         if jax.default_backend() not in ("tpu", "axon"):
             return "scatter"
@@ -624,8 +662,14 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         if isinstance(state, dict):
             # unified slab+log buffer: src addresses the latest version of
             # every key directly — one plain gather (the split-buffer
-            # 2-gather select measured +4.3 ms/step, tools/log_ablate.py)
-            rows = jnp.take(state["buf"], batch["src"], axis=0)
+            # 2-gather select measured +4.3 ms/step, tools/log_ablate.py).
+            # The barrier materializes the gathered rows BEFORE anything
+            # else: without it XLA fuses this gather into late consumers,
+            # the buffer stays live past the push's DUS, and the DUS
+            # writes a full buffer COPY every step (~2.6 ms per M slab
+            # rows measured, tools/capacity_probe.py round 5)
+            rows = jax.lax.optimization_barrier(
+                jnp.take(state["buf"], batch["src"], axis=0))
             return pull_view_from_rows(rows, layout), rows
         ids = batch["ids"]
         if use_expand:
@@ -647,6 +691,17 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
             push_grads = build_push_grads(demb, _key_slots(batch), clicks,
                                           _key_valid(batch))
         if "perm" not in batch:
+            from paddlebox_tpu.config import flags as _flags
+            if _flags.get_flag("h2d_lean"):
+                # deliberate wire-lean mode: the dedup runs on device
+                # (jnp.unique sort — the cost host dedup normally
+                # removes) because shipping the host products costs more
+                # than the sort on input-bound links (BASELINE.md round-5
+                # e2e measurements)
+                from paddlebox_tpu.embedding.optimizers import (
+                    push_sparse_dedup)
+                return push_sparse_dedup(slab, batch["ids"], push_grads,
+                                         sub, layout, conf)
             # never fall back to the on-device jnp.unique sort silently —
             # that is the dominant step cost this path exists to remove
             raise KeyError(
@@ -1003,10 +1058,11 @@ class BoxTrainer:
         return self.host_batch(b, self.table.lookup_ids(b.keys, b.valid),
                                skip_push_dedup=self.sparse_chunk_sync)
 
-    def _stack_batches(self, group: List[PackedBatch]) -> Dict[str, jnp.ndarray]:
-        """Stack a chunk of packed batches on a leading scan axis — stacked
-        on HOST, one transfer per key (stacking device arrays would double
-        the H2D traffic and peak memory)."""
+    def _stack_batches_host(self, group: List[PackedBatch]):
+        """Stack a chunk of packed batches on a leading scan axis as HOST
+        arrays: dict, or (dict, mpos|cpush) in log / chunk-sync modes.
+        The device conversion is separate (_stack_batches / the grouped
+        H2D path) so N chunks can share one transfer per leaf."""
         pool = self._host_pool()
         if pool is not None and len(group) > 1:
             hosts = list(pool.map(self._stage_one, group))
@@ -1022,9 +1078,8 @@ class BoxTrainer:
                      "first": first_occurrence_idx(perm, inv)}
             if self._push_write == "rebuild":
                 cpush["pos"] = pos_for_rebuild(uids, self.table.capacity)
-            stacked = {k: jnp.asarray(np.stack([h[k] for h in hosts]))
-                       for k in hosts[0]}
-            return stacked, {k: jnp.asarray(v) for k, v in cpush.items()}
+            return ({k: np.stack([h[k] for h in hosts]) for k in hosts[0]},
+                    cpush)
         if self._push_write == "log":
             # sequential tail of the staging: combined pull indices +
             # write-slot registration must follow dispatch order (the
@@ -1044,11 +1099,38 @@ class BoxTrainer:
             mpos = (st.take_mpos() if st.need_merge(len(hosts)) else None)
             for h in hosts:
                 h["src"] = st.assign(h["ids"], h["uids"])
-            stacked = {k: jnp.asarray(np.stack([h[k] for h in hosts]))
-                       for k in hosts[0]}
-            return stacked, mpos
-        return {k: jnp.asarray(np.stack([h[k] for h in hosts]))
-                for k in hosts[0]}
+            return ({k: np.stack([h[k] for h in hosts]) for k in hosts[0]},
+                    mpos)
+        return {k: np.stack([h[k] for h in hosts]) for k in hosts[0]}
+
+    def _stack_batches(self, group: List[PackedBatch]):
+        """Host-stack + one H2D per leaf (the single-chunk transfer path)."""
+        staged = self._stack_batches_host(group)
+        if isinstance(staged, tuple):
+            stacked, aux = staged
+            stacked = {k: jnp.asarray(v) for k, v in stacked.items()}
+            if self.sparse_chunk_sync:
+                aux = {k: jnp.asarray(v) for k, v in aux.items()}
+            return stacked, aux
+        return {k: jnp.asarray(v) for k, v in staged.items()}
+
+    def _group_to_device(self, staged_list):
+        """Round-5 verdict item 4: convert G host-staged chunks to device
+        chunks with ONE jnp.asarray per LEAF for the whole group — the
+        ~250 ms fixed per-transfer tunnel cost amortizes /G (the
+        MiniBatchGpuPack stacked-pinned-copy role, data_feed.h:519-680).
+        Per-chunk views are device-side slices of the grouped arrays."""
+        log = self._push_write == "log"
+        dicts = [s[0] if log else s for s in staged_list]
+        sizes = [d["ids"].shape[0] for d in dicts]
+        big = {k: jnp.asarray(np.concatenate([d[k] for d in dicts]))
+               for k in dicts[0]}
+        out, off = [], 0
+        for i, d in enumerate(dicts):
+            sl = {k: big[k][off:off + sizes[i]] for k in big}
+            out.append((sl, staged_list[i][1]) if log else sl)
+            off += sizes[i]
+        return out
 
     def host_batch(self, b: PackedBatch, ids: np.ndarray,
                    skip_push_dedup: bool = False) -> Dict[str, np.ndarray]:
@@ -1062,6 +1144,11 @@ class BoxTrainer:
             "ins_valid": b.ins_valid,
             "labels": b.labels,
         }
+        from paddlebox_tpu.config import flags as _flags
+        if _flags.get_flag("h2d_lean"):
+            # wire-lean staging: no host dedup products at all — the
+            # device step dedups (see _sparse_push's h2d_lean branch)
+            skip_push_dedup = True
         if not self.table.test_mode and not skip_push_dedup:
             # train batches carry the host-precomputed push dedup (uids
             # included: rebuilding them on device is a scatter); eval
@@ -1202,12 +1289,18 @@ class BoxTrainer:
                     return (slab, params, opt_state, prng), losses, preds
 
             carry = (state, self.params, self.opt_state, prng)
+            tg = max(1, int(flags.get_flag("h2d_stack_chunks")))
+            if self.sparse_chunk_sync:
+                tg = 1   # cpush aux arrays keep their own per-chunk H2D
             carry, chunk_losses, n_done = run_scan_chunks(
-                scan_call, pending, chunk, self._stack_batches,
+                scan_call, pending, chunk,
+                self._stack_batches_host if tg > 1 else self._stack_batches,
                 carry, on_chunk, timer=self.timers["step"],
                 chunk1_ok=self.sparse_chunk_sync,
                 prefetch_depth=max(0, int(
-                    flags.get_flag("chunk_prefetch_depth"))))
+                    flags.get_flag("chunk_prefetch_depth"))),
+                transfer_group=tg,
+                group_fn=self._group_to_device if tg > 1 else None)
             state, self.params, self.opt_state, prng = carry
             if not log_mode:
                 self.table.set_slab(state)

@@ -166,6 +166,48 @@ def test_resolve_log_batches_validation():
         flags.set_flag("log_batches", 0)
 
 
+def test_grouped_h2d_matches_per_chunk(data):
+    """h2d_stack_chunks>1 (round-5 verdict item 4): G chunks sharing one
+    transfer per leaf — with device-side slicing back to per-chunk views
+    — must be bit-identical to per-chunk transfers, in both the log and
+    scatter write modes (including the mid-pass merge cadence and the
+    per-step tail)."""
+    files, feed = data
+    for mode, lb in (("scatter", 0), ("log", 3)):
+        base = run_mode(files, feed, mode, log_batches=lb)
+        flags.set_flag("h2d_stack_chunks", 4)
+        try:
+            grouped = run_mode(files, feed, mode, log_batches=lb)
+        finally:
+            flags.set_flag("h2d_stack_chunks", 1)
+        assert_identical(base, grouped)
+
+
+def test_h2d_lean_matches_host_dedup(data):
+    """h2d_lean (round-5 item 4 follow-on): device-side dedup with the
+    minimal wire must train bit-identically to the host-dedup scatter
+    path — the content-addressed lazy-init randoms make created rows
+    independent of WHERE the dedup ran."""
+    files, feed = data
+    base = run_mode(files, feed, "scatter", passes=1)
+    flags.set_flag("h2d_lean", True)
+    try:
+        lean = run_mode(files, feed, "auto", passes=1)
+    finally:
+        flags.set_flag("h2d_lean", False)
+    assert_identical(base, lean)
+
+
+def test_h2d_lean_rejects_host_map_modes(data):
+    files, feed = data
+    flags.set_flag("h2d_lean", True)
+    try:
+        with pytest.raises(ValueError, match="h2d_lean"):
+            run_mode(files, feed, "rebuild", passes=1)
+    finally:
+        flags.set_flag("h2d_lean", False)
+
+
 def test_push_write_log_rejected_where_unsupported(data):
     """Explicit push_write=log on an unsupported path fails loud at
     construction, not deep in a staging thread."""

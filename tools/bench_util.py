@@ -117,7 +117,8 @@ def make_log_bench_state(trainer, batches):
 
 
 def make_bench_trainer(pass_cap: int = 1 << 20, batch: int = 1024,
-                       num_slots: int = 32, max_len: int = 4, d: int = 8):
+                       num_slots: int = 32, max_len: int = 4, d: int = 8,
+                       trainer_cfg=None):
     """ONE definition of the bench-shape trainer (DeepFM 512/256/128, bf16
     dense, adagrad in-table) shared by bench.py's decomposing probe
     (tools/tpu_probe.py) and the compiled-step audit (tools/step_audit.py)
@@ -138,5 +139,6 @@ def make_bench_trainer(pass_cap: int = 1 << 20, batch: int = 1024,
     model = DeepFM(ModelSpec(num_slots=num_slots, slot_dim=3 + d),
                    hidden=(512, 256, 128))
     return BoxTrainer(model, table, feed,
-                      TrainerConfig(dense_lr=1e-3, compute_dtype="bfloat16"),
+                      trainer_cfg or TrainerConfig(
+                          dense_lr=1e-3, compute_dtype="bfloat16"),
                       seed=0), feed

@@ -1,0 +1,171 @@
+"""Where does the per-step cap-proportional copy come from? (round 5)
+
+capacity_probe shows the log-mode step growing ~2.6 ms per M slab rows
+(~ one full-buffer copy/step at ~27 GB/s) while an isolated
+gather+DUS scan over the same buffer is FLAT (scan_vs_fori). This grows
+the scan body stepwise from the flat probe toward the real step and
+measures the cap slope of each variant at two capacities:
+
+  A  gather(buf, xs_src) -> rows; DUS(buf, rows*0.999)
+  B  A with new_rows = apply_push-style column rewrite of rows
+  C  B with the real _merged_new_rows (perm gather + segment-sum +
+     in-table adagrad + threefry lazy-init)
+  D  C plus a dense fwd/bwd-sized matmul chain on pooled rows
+
+Usage: timeout 2400 python -u tools/slope_probe.py [platform]
+"""
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms",
+                  sys.argv[1] if len(sys.argv) > 1 else "axon")
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from paddlebox_tpu.config.configs import SparseOptimizerConfig
+from paddlebox_tpu.embedding.accessor import ValueLayout
+from paddlebox_tpu.embedding.optimizers import _merged_new_rows
+
+W = 17
+K = 131072
+ITERS = 8
+REPS = 3
+L = 16 * K
+CAPS = [1 << 22, 1 << 24]
+
+
+def timed(name, fn, state, extra=None):
+    try:
+        st = fn(*state)
+        np.asarray(jax.tree_util.tree_leaves(st)[-1])
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            st = fn(*st)
+            np.asarray(jax.tree_util.tree_leaves(st)[-1])
+        ms = (time.perf_counter() - t0) / REPS / ITERS * 1e3
+    except Exception as e:
+        print(json.dumps({"op": name, "error": str(e)[:200]}), flush=True)
+        return
+    rec = {"op": name, "ms_per_iter": round(ms, 3)}
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def probe(cap, rng):
+    tag = {"cap": cap}
+    layout = ValueLayout(8, "adagrad")
+    conf = SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                 mf_initial_range=1e-3)
+    buf0 = jnp.asarray(np.zeros((cap + L, W), np.float32))
+    src = jnp.asarray(
+        rng.randint(0, cap, (ITERS, K)).astype(np.int32))
+    n_u = int(K * 0.85)
+    uids = jnp.asarray(np.broadcast_to(np.concatenate(
+        [np.sort(rng.choice(cap - 1, n_u, replace=False)).astype(np.int32),
+         np.arange(K - n_u, dtype=np.int32) + cap]), (ITERS, K)).copy())
+    perm = jnp.asarray(np.broadcast_to(
+        rng.permutation(K).astype(np.int32), (ITERS, K)).copy())
+    inv = jnp.asarray(np.broadcast_to(
+        np.sort(rng.randint(0, n_u, K)).astype(np.int32),
+        (ITERS, K)).copy())
+    first = jnp.asarray(np.broadcast_to(
+        rng.randint(0, K, K).astype(np.int32), (ITERS, K)).copy())
+    grads = jnp.asarray(rng.rand(ITERS, K, 12).astype(np.float32))
+    prng0 = jax.random.PRNGKey(0)
+
+    def scan_run(body):
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def run(carry):
+            def step(c, xs):
+                return body(c, xs), 0.0
+            c2, _ = lax.scan(step, carry,
+                             (src, uids, perm, inv, first, grads))
+            return c2
+        return lambda *c: (run(c[0]),)
+
+    def mk():
+        # fresh leaves per variant: donation consumes the whole carry
+        return ((buf0 + 0.0, jnp.zeros((), jnp.int32),
+                 jax.random.PRNGKey(0), jnp.zeros(())),)
+
+    def vA(c, xs):
+        buf, cur, prng, acc = c
+        s, u, p, iv, f, g = xs
+        rows = jnp.take(buf, s, axis=0)
+        nr = rows * 0.999
+        buf = lax.dynamic_update_slice(buf, nr, (jnp.int32(cap) + cur, 0))
+        return (buf, (cur + K) % (L - K), prng, acc + nr[0, 0])
+
+    def colwork(rows, g):
+        # apply_push-shaped column rewrite (~30 masked col ops)
+        out = rows
+        show = g[:, 1:2]
+        for col in range(W):
+            out = out.at[:, col:col + 1].set(
+                jnp.where(show > 0, out[:, col:col + 1] * 0.999 + 0.001,
+                          out[:, col:col + 1]))
+        return out
+
+    def vB(c, xs):
+        buf, cur, prng, acc = c
+        s, u, p, iv, f, g = xs
+        rows = jnp.take(buf, s, axis=0)
+        nr = colwork(rows, g)
+        buf = lax.dynamic_update_slice(buf, nr, (jnp.int32(cap) + cur, 0))
+        return (buf, (cur + K) % (L - K), prng, acc + nr[0, 0])
+
+    def vC(c, xs):
+        buf, cur, prng, acc = c
+        s, u, p, iv, f, g = xs
+        prng, sub = jax.random.split(prng)
+        rows = jnp.take(buf, s, axis=0)
+        nr = _merged_new_rows(buf, u, p, iv, g, sub, layout, conf,
+                              pulled_rows=rows, first_idx=f)
+        buf = lax.dynamic_update_slice(buf, nr, (jnp.int32(cap) + cur, 0))
+        return (buf, (cur + K) % (L - K), prng, acc + nr[0, 0])
+
+    Wd = 352
+
+    def vD(c, xs):
+        buf, cur, prng, acc = c
+        s, u, p, iv, f, g = xs
+        prng, sub = jax.random.split(prng)
+        rows = jnp.take(buf, s, axis=0)
+        pooled = rows[:1024 * 11, :].reshape(1024, -1)[:, :Wd // 2]
+        h = jnp.concatenate([pooled, pooled], axis=1).astype(jnp.bfloat16)
+        for wm in (jnp.ones((Wd, 512), jnp.bfloat16),
+                   jnp.ones((512, 256), jnp.bfloat16),
+                   jnp.ones((256, 128), jnp.bfloat16)):
+            h = jnp.tanh(h @ wm)
+        loss = h.astype(jnp.float32).sum() * 1e-6
+        nr = _merged_new_rows(buf, u, p, iv, g, sub, layout, conf,
+                              pulled_rows=rows, first_idx=f)
+        buf = lax.dynamic_update_slice(buf, nr, (jnp.int32(cap) + cur, 0))
+        return (buf, (cur + K) % (L - K), prng, acc + nr[0, 0] + loss)
+
+    for name, body in (("A_gather_dus", vA), ("B_colwork", vB),
+                       ("C_real_push", vC), ("D_plus_dense", vD)):
+        timed(name, scan_run(body), mk(), tag)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(json.dumps({"device": str(dev), "platform": dev.platform}),
+          flush=True)
+    rng = np.random.RandomState(0)
+    for cap in CAPS:
+        probe(cap, rng)
+
+
+if __name__ == "__main__":
+    main()

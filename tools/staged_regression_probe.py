@@ -1,0 +1,132 @@
+"""Staged-path CPU regression probe (round-5 hygiene item).
+
+CPU ex/s rows are load-noise (±12% quiet, 4× under load — BASELINE.md),
+so between TPU windows nothing guarded the data/staging path. This
+checks the HOST stages in keys(or lines)/s against floor thresholds set
+at ~40% of the recorded quiet-box rates — low enough to ride out
+container noise, high enough to catch an algorithmic regression (the
+r1 python-loop router was 10-25× under these rates).
+
+Prints one JSON line per stage with ok=true/false; exits 1 if any fails.
+Usage: timeout 900 python -u tools/staged_regression_probe.py
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+# (recorded quiet-box rate AT THIS PROBE'S OWN WORKLOAD — round-5
+# first run, 2026-07-31 — , floor = ~40% of it). The r2-r4 BASELINE.md
+# rates used different shapes (32 slots, bigger vocab), so this probe
+# records its own reference once and guards against regression from it.
+FLOORS = {
+    "rt_lookup_keys_per_sec": (51.8e6, 20e6),
+    "rt_dedup_keys_per_sec": (47.2e6, 19e6),
+    "bucketize_keys_per_sec": (21.1e6, 8e6),
+    "parse_lines_per_sec": (722e3, 290e3),
+    "pack_instances_per_sec": (722e3, 290e3),
+}
+
+failures = []
+
+
+def report(stage, rate):
+    rec, floor = FLOORS[stage]
+    ok = rate >= floor
+    if not ok:
+        failures.append(stage)
+    print(json.dumps({"stage": stage, "rate": round(rate, 0),
+                      "recorded": rec, "floor": floor, "ok": ok}),
+          flush=True)
+
+
+def timed_rate(fn, n_items, secs=2.0):
+    fn()                                   # warm
+    t0 = time.perf_counter()
+    reps = 0
+    while time.perf_counter() - t0 < secs:
+        fn()
+        reps += 1
+    return reps * n_items / (time.perf_counter() - t0)
+
+
+def main():
+    rng = np.random.RandomState(0)
+    K = 131072
+
+    # --- native route tier -------------------------------------------
+    from paddlebox_tpu.native.build import (create_route_index,
+                                            destroy_route_index, get_lib,
+                                            route_lookup)
+    if get_lib() is None:
+        print(json.dumps({"error": "native lib unavailable"}), flush=True)
+        sys.exit(1)
+    pass_keys = np.unique(rng.randint(0, 1 << 40, 1 << 20).astype(np.uint64))
+    idx = create_route_index([pass_keys])
+    probe = rng.choice(pass_keys, K).astype(np.uint64)
+    report("rt_lookup_keys_per_sec",
+           timed_rate(lambda: route_lookup(idx, probe, None, 0), K))
+    destroy_route_index(idx)
+
+    from paddlebox_tpu.embedding.pass_table import dedup_ids
+    ids = rng.randint(0, 1 << 20, K).astype(np.int32)
+    report("rt_dedup_keys_per_sec",
+           timed_rate(lambda: dedup_ids(ids, 1 << 20), K))
+
+    from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
+                                              TableConfig)
+    from paddlebox_tpu.parallel.sharded_table import ShardedPassTable
+    t = ShardedPassTable(
+        TableConfig(embedx_dim=8, pass_capacity=1 << 21,
+                    optimizer=SparseOptimizerConfig()),
+        num_shards=8, bucket_cap=4 * K // 8)
+    t.begin_feed_pass()
+    t.add_keys(pass_keys)
+    t.end_feed_pass()
+    valid = np.ones(K, bool)
+    report("bucketize_keys_per_sec",
+           timed_rate(lambda: t.bucketize(probe, valid.copy()), K))
+
+    # --- parse + pack tier -------------------------------------------
+    import tempfile
+
+    from paddlebox_tpu.data import BoxDataset, write_synthetic_ctr_files
+    out = tempfile.mkdtemp()
+    files, feed = write_synthetic_ctr_files(
+        out, num_files=2, lines_per_file=8000, num_slots=16,
+        vocab_per_slot=5000, max_len=4, seed=1)
+    feed = type(feed)(slots=feed.slots, batch_size=512)
+
+    def load():
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        n = len(ds)
+        ds.release_memory()
+        return n
+
+    n_lines = 16000
+    t0 = time.perf_counter()
+    reps = 0
+    load()
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < 4.0:
+        n = load()
+        reps += 1
+    dt = time.perf_counter() - t0
+    report("parse_lines_per_sec", reps * n_lines / dt)
+    # load_into_memory covers parse+merge+batch build in this design
+    report("pack_instances_per_sec", reps * n / dt)
+
+    if failures:
+        print(json.dumps({"failed": failures}), flush=True)
+        sys.exit(1)
+    print(json.dumps({"all_ok": True}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

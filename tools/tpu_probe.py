@@ -119,6 +119,43 @@ def stage(name, pass_cap, strip=None, push_write=None):
                       "examples_per_sec": round(BATCH / dt, 1)}), flush=True)
 
 
+def chunk_sync_stage():
+    """One pull + one merged push per chunk (TrainerConfig.
+    sparse_chunk_sync) at bench shapes — the per-runtime fresh-evidence
+    row the round-4 verdict asked to keep or delete the mode by."""
+    from paddlebox_tpu.config.configs import TrainerConfig
+    from tools.bench_util import make_bench_trainer
+    tr, feed = make_bench_trainer(
+        1 << 20, batch=BATCH, num_slots=NUM_SLOTS, max_len=MAX_LEN, d=D,
+        trainer_cfg=TrainerConfig(dense_lr=1e-3, compute_dtype="bfloat16",
+                                  sparse_chunk_sync=True,
+                                  scan_chunk=CHUNK))
+    batches = make_ctr_batches(feed, CHUNK, NUM_SLOTS, MAX_LEN, seed=0)
+    tr.table.begin_feed_pass()
+    for b in batches:
+        tr.table.add_keys(b.keys[b.valid])
+    tr.table.end_feed_pass()
+    tr.table.begin_pass()
+    stacked, cpush = tr._stack_batches(batches)
+    state = (tr.table.slab, tr.params, tr.opt_state, tr.table.next_prng())
+
+    import time as _time
+    for rep in range(REPS + 1):
+        if rep == 1:
+            np.asarray(losses)
+            t0 = _time.perf_counter()
+        slab, params, opt, losses, preds, prng = tr.fns.scan_chunk(
+            state[0], state[1], state[2], stacked, cpush, state[3])
+        state = (slab, params, opt, prng)
+    np.asarray(losses)
+    dt = (_time.perf_counter() - t0) / REPS / CHUNK
+    print(json.dumps({"stage": "full_step_chunk_sync",
+                      "pass_cap": 1 << 20,
+                      "ms_per_step": round(dt * 1e3, 3),
+                      "examples_per_sec": round(BATCH / dt, 1)}),
+          flush=True)
+
+
 if __name__ == "__main__":
     dev = jax.devices()[0]
     print(json.dumps({"device": str(dev), "platform": dev.platform}),
@@ -151,3 +188,11 @@ if __name__ == "__main__":
                           "error": repr(e)[:300]}), flush=True)
     finally:
         _flags.set_flag("use_pallas_push", False)
+    # the chunk-synchronous sparse mode re-measures on every new runtime
+    # window (round-5 hygiene): it targets per-op-floor-dominated
+    # runtimes and stays default-off while it loses here (BASELINE.md)
+    try:
+        chunk_sync_stage()
+    except Exception as e:
+        print(json.dumps({"stage": "full_step_chunk_sync",
+                          "error": repr(e)[:300]}), flush=True)

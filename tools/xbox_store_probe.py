@@ -48,7 +48,8 @@ def build_file():
         for lo in range(0, N, CHUNK):
             n = min(CHUNK, N - lo)
             rows = np.ones((n, DIM), np.float32)
-            rows[:, 0] = np.arange(lo, lo + n, dtype=np.float32)
+            rows[:, 0] = ((np.arange(lo, lo + n, dtype=np.int64)
+               & 0xFFFF).astype(np.float32))  # f32-exact check value
             f.seek(row_off + lo * DIM * 4)
             rows.tofile(f)
     print(json.dumps({"stage": "build_file", "n": N, "dim": DIM,
@@ -78,10 +79,11 @@ def run_lookups(store, tag):
         kps = reps * BATCH / dt
         # correctness spot check on the last batch
         got = out[:, 0]
-        ids = batches[(reps - 1) % 4] // np.uint64(16)
+        ids = ((batches[(reps - 1) % 4] // np.uint64(16))
+               .astype(np.int64) & 0xFFFF)
         hitmask = (batches[(reps - 1) % 4] % np.uint64(16)
                    ) == np.uint64(3)
-        assert np.allclose(got[hitmask], ids[hitmask].astype(np.float32))
+        assert np.array_equal(got[hitmask], ids[hitmask].astype(np.float32))
         assert (out[~hitmask] == 0).all()
         print(json.dumps({"stage": f"lookup_{name}_{tag}",
                           "keys_per_sec": round(kps, 0),
