@@ -79,6 +79,20 @@ def main() -> None:
         print(f"  feasign {k}: embed_w={row[0]:+.4f} "
               f"embedx={np.round(row[1:4], 4)}...")
 
+    # serving-scale tier (round 5): compile the composed view into the
+    # columnar store file and serve it via mmap + the native hash index
+    # — no row-matrix RAM ingest (10.75M keys/s hot at a 30M-key base,
+    # BASELINE.md round-5 xbox table)
+    from paddlebox_tpu.train.checkpoint import MmapXboxStore
+    store_path = reader.save_columnar(os.path.join(work, "serve.xbox"))
+    store = MmapXboxStore(store_path)
+    mm = store.lookup(np.asarray(keys, np.uint64))
+    assert np.array_equal(mm, emb), "mmap store must match the reader"
+    print(f"mmap store: {len(store)} features served from "
+          f"{os.path.getsize(store_path) >> 20} MB file "
+          f"(native_index={store._index is not None})")
+    store.close()
+
 
 if __name__ == "__main__":
     main()

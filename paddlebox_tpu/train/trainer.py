@@ -314,17 +314,24 @@ def resolve_push_write(capacity: Optional[int] = None,
                        allow_log: bool = False) -> str:
     """'scatter' | 'rebuild' | 'log' from the push_write flag.
 
-    'auto' picks by measured cost model on tpu backends (round-5 battery,
-    tools/tpu_probe.py): rebuild wins in the small-slab regime (14.9
-    ms/step @1M rows vs log 15.7 — its gather/select ~ slab bytes is
-    cheap there), while the log-structured write wins at scale (26.7
-    @4M vs rebuild 34.4 / r4 scatter 25.0 → the gap grows with slab) —
-    so auto keeps the r4 crossover at ~16× the per-batch key budget and
-    replaces the big-slab SCATTER retreat with the log wherever the
-    caller supports it (allow_log). Paths that can't run the log (expand
-    models, async dense, chunk-sync sparse, the sharded runners) retreat
-    to scatter as before. CPU always scatters (its scatter is cheap; a
-    full-slab rewrite per batch is not).
+    'auto' picks by the round-5 measured matrix (tools/tpu_probe.py +
+    tools/capacity_probe.py, ms/step at bench batch):
+
+        cap      rebuild   scatter   log
+        1M rows  14.9-16.1 ~16 (r4)  15.7
+        4M       34.4-36.1 25.6      26.3
+        33M      (compile×) **23.9** 104.7
+
+    rebuild (gather/select ~ slab bytes) wins small slabs; DONATED
+    in-step scatter is ~capacity-flat and wins at scale — the r4 belief
+    that scatter grows with capacity came from a non-donated probe
+    harness paying an output-copy per call (BASELINE.md round-5
+    "probe-harness corrections"). So auto = rebuild ≤ ~16× the per-batch
+    key budget, scatter beyond — the r4 policy, now with the measured
+    explanation. The log-structured write (built + bit-parity-tested
+    round 5) stays available explicitly: it beats rebuild at mid slabs
+    but its DUS pays a buffer-proportional cost the scatter does not.
+    CPU always scatters.
     """
     from paddlebox_tpu.config import flags
     mode = flags.get_flag("push_write")
@@ -339,11 +346,9 @@ def resolve_push_write(capacity: Optional[int] = None,
     if mode == "auto":
         if jax.default_backend() not in ("tpu", "axon"):
             return "scatter"
-        if capacity and batch_keys and capacity <= 16 * batch_keys:
-            return "rebuild"
-        if allow_log:
-            return "log"
-        return "scatter" if capacity and batch_keys else "rebuild"
+        if capacity and batch_keys and capacity > 16 * batch_keys:
+            return "scatter"
+        return "rebuild"
     if mode == "log" and not allow_log:
         raise ValueError(
             "push_write=log is unsupported on this path (expand models, "
