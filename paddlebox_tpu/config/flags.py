@@ -155,9 +155,10 @@ define_flag("push_write", "auto",
             "rows append to a fixed-size log via dynamic_update_slice — "
             "flat in SLAB size, tools/write_probe.py; the slab-"
             "proportional merge amortizes over log_batches steps; "
-            "single-host trainer, not with expand/async/chunk-sync), or "
-            "'auto' (log on tpu backends where supported, else the r4 "
-            "rebuild/scatter crossover; scatter on CPU)")
+            "single-host trainer, not with expand/async/chunk-sync; "
+            "explicit opt-in only — 'auto' never selects it, see "
+            "resolve_push_write), or 'auto' (measured rebuild/scatter "
+            "crossover on accelerators; scatter on CPU)")
 define_flag("log_batches", 0,
             "push_write=log: log capacity in batches (peak extra HBM = "
             "this many [key_capacity, width] blocks; merge cadence = one "
@@ -183,3 +184,24 @@ define_flag("strict_bucket_overflow", False,
 define_flag("matmul_dtype", "float32",
             "dense matmul operand dtype: bfloat16 (MXU native, f32 "
             "accumulation; wins once the MLP dominates the step) or float32")
+define_flag("incremental_pass", True,
+            "incremental pass lifecycle (BeginPass/EndPass delta, the "
+            "BoxPS keep-rows-resident cadence): begin_pass diffs the new "
+            "pass's key set against the rows already resident in the slab "
+            "and promotes only NEW keys (device-side permute instead of a "
+            "full host rebuild + H2D); end_pass transfers and writes back "
+            "only the rows the pass actually touched. Bit-parity with the "
+            "full path (tests/test_pass_incremental.py). Memory: the "
+            "single-chip slab stays resident in HBM between passes (no "
+            "extra copy); the SHARDED table instead keeps a host-DRAM "
+            "mirror of each owned shard's slab between passes (~slab "
+            "bytes of host RAM — small next to the host store itself, "
+            "but not free). Off = rebuild the whole slab every pass (the "
+            "pre-round-6 behavior, no residency anywhere)")
+define_flag("preload_promote", True,
+            "overlap the NEXT pass's host-side promote work (key diff + "
+            "host-store reads for non-resident keys) with the current "
+            "pass's training on the preload thread (the PreLoad/"
+            "WaitFeedPassDone tail-hiding role, box_wrapper.h:1131-1172); "
+            "only active with incremental_pass and a store that supports "
+            "lookup_present")

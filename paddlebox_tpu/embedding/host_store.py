@@ -231,6 +231,28 @@ class HostEmbeddingStore:
                     out[i] = self._values[self._fault_in(k)]
         return out
 
+    def lookup_present(self, keys: np.ndarray
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+        """(values, found) without creating missing features — the preload
+        promote-stager read: keys already in the store (resident or
+        spilled) return their rows (spilled keys fault in, exactly as the
+        eventual lookup_or_create would); genuinely new keys report
+        found=False and are left for the pass boundary's sorted
+        lookup_or_create so init-rng draw order stays identical to the
+        full path."""
+        keys = np.asarray(keys, dtype=np.uint64)
+        out = np.zeros((keys.size, self.layout.width), dtype=np.float32)
+        found = np.zeros(keys.size, bool)
+        with self._lock:
+            for i, k in enumerate(keys.tolist()):
+                r = self._index.get(k, -1)
+                if r < 0 and k in self._spilled:
+                    r = self._fault_in(k)
+                if r >= 0:
+                    out[i] = self._values[r]
+                    found[i] = True
+        return out, found
+
     # ------------------------------------------------------------ lifecycle
     def shrink(self) -> int:
         """ShrinkTable: decay show/click and delete dead features

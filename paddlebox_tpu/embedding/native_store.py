@@ -156,6 +156,29 @@ class NativeHostEmbeddingStore:
                     out[idx] = self._read_spilled(keys[idx], consume=False)
         return out
 
+    def lookup_present(self, keys: np.ndarray):
+        """(values, found) without creating missing features — the preload
+        promote-stager read (see HostEmbeddingStore.lookup_present).
+
+        SPILLED keys deliberately report found=False here: this store's
+        lookup_or_create counts spilled keys among its created set, so it
+        consumes one init-rng draw per spilled key before overwriting the
+        row with the faulted-in value. Prefetching them (zero draws) would
+        shift the rng stream vs the full lifecycle and break bit-parity —
+        they resolve at the pass boundary's lookup_or_create instead,
+        which reproduces the full path's draws exactly."""
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        rows, _ = self._rows_of(keys, create=False)
+        found = rows >= 0
+        out = np.zeros((keys.size, self.layout.width), np.float32)
+        if found.any():
+            hit_rows = np.ascontiguousarray(rows[found])
+            vals = np.empty((int(found.sum()), self.layout.width), np.float32)
+            self._lib.hs_gather(self._h, _p(hit_rows, _I64P), hit_rows.size,
+                                _p(vals, _F32P))
+            out[found] = vals
+        return out, found
+
     def write_back(self, keys: np.ndarray, values: np.ndarray) -> None:
         keys = np.ascontiguousarray(keys, dtype=np.uint64)
         rows, _ = self._rows_of(keys, create=False)

@@ -21,6 +21,7 @@ ids; its values never reach the host store.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Optional, Tuple
 
 import jax
@@ -33,6 +34,7 @@ from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
 from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
 from paddlebox_tpu.embedding.native_store import make_host_store
 from paddlebox_tpu.embedding.optimizers import apply_push
+from paddlebox_tpu.utils.stats import stat_add
 from paddlebox_tpu.utils.timer import Timer
 
 
@@ -52,6 +54,44 @@ def _push_kernel(slab: jnp.ndarray, ids: jnp.ndarray, grads: jnp.ndarray,
     """jit wrapper over the dedup-merge-optimize-scatter push."""
     from paddlebox_tpu.embedding.optimizers import push_sparse_dedup
     return push_sparse_dedup(slab, ids, grads, prng, layout, conf)
+
+
+def _delta_promote_impl(old_slab, src, keep, new_idx, new_rows):
+    """Pure bit-move: new_slab[i] = old_slab[src[i]] where keep[i] (the key
+    at new sorted position i was resident at old position src[i]), zeros
+    elsewhere, then the freshly promoted host rows scatter into their new
+    positions. new_idx is padded to a power-of-two bucket with `capacity`
+    (out of range, mode='drop') so promote counts don't recompile per pass."""
+    out = jnp.where(keep[:, None], old_slab[src], 0.0)
+    return out.at[new_idx].set(new_rows, mode="drop")
+
+
+# donated: begin_pass consumes the previous pass's slab in place — one
+# live slab at any moment, like the full path (test-mode passes donate
+# too; their eval slab can't become resident, so keeping a second copy
+# would only double peak HBM)
+_delta_promote = jax.jit(_delta_promote_impl, donate_argnums=(0,))
+
+
+def _pow2_pad(m: int) -> int:
+    p = 1
+    while p < m:
+        p <<= 1
+    return p
+
+
+def sorted_member(sorted_keys: np.ndarray, keys: np.ndarray):
+    """(pos, hit) membership probe of `keys` against a SORTED UNIQUE key
+    array: pos[i] is the index of keys[i] in sorted_keys where hit[i],
+    clamped garbage elsewhere. The ONE definition of the searchsorted+
+    equality idiom every incremental-lifecycle diff uses (resident diff
+    fallback, staged-promote matching, prefetcher known-sets)."""
+    if sorted_keys.size == 0:
+        return (np.zeros(keys.size, np.int64),
+                np.zeros(keys.size, bool))
+    pos = np.minimum(np.searchsorted(sorted_keys, keys),
+                     sorted_keys.size - 1)
+    return pos, sorted_keys[pos] == keys
 
 
 def dedup_ids(ids: np.ndarray, pad_base: int):
@@ -163,6 +203,21 @@ class PassTable:
         self._in_pass = False
         self._test_mode = False
         self._prng = jax.random.PRNGKey(seed)
+        # incremental pass lifecycle (BoxPS keep-rows-resident cadence):
+        # after end_pass the slab stays in HBM and _resident_keys records
+        # which key occupies which row; the next begin_pass promotes only
+        # the delta. _prev_route keeps the ended pass's native hash index
+        # alive across the feed boundary so the diff is a probe, not a
+        # searchsorted. store_lock serializes host-store access between
+        # end_pass and the preload promote stager.
+        self._resident_keys: Optional[np.ndarray] = None
+        self._prev_route = None
+        self._route_for: Optional[np.ndarray] = None  # keys _route_index maps
+        self._touched: Optional[np.ndarray] = None  # bool[capacity] mirror
+        self._touch_seen = False  # any mark this pass? (else full writeback)
+        self._residency_poisoned = False  # mid-pass invalidate: drop at end
+        self._staged: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self.store_lock = threading.Lock()
         self.timers = {name: Timer() for name in
                        ("feed", "build", "pull", "push", "end")}
 
@@ -197,12 +252,23 @@ class PassTable:
             raise RuntimeError(
                 f"pass working set {self._pass_keys.size} exceeds table "
                 f"pass_capacity {self.capacity} (raise TableConfig.pass_capacity)")
+        # the outgoing index maps resident keys → slab rows: keep it for
+        # the incremental begin_pass diff (one hash probe per key). Only
+        # when it really covers the RESIDENT key set — after a test-mode
+        # pass the live index maps the eval keys instead (identity check
+        # against the array end_pass recorded).
+        self._drop_prev_route()
+        if (self._resident_keys is not None
+                and self._route_for is self._resident_keys):
+            self._prev_route = self._route_index
+            self._route_index = None
         self._drop_route_index()
         # native key→id hash index, built once per pass and probed per
         # batch (~1 cache miss/key vs searchsorted's ~20): the host-side
         # DedupKeysAndFillIdx tier at line rate
         from paddlebox_tpu.native.build import create_route_index
         self._route_index = create_route_index([self._pass_keys])
+        self._route_for = self._pass_keys
         self._feed_keys = []
         self._in_feed_pass = False
         with_timer.pause()
@@ -212,15 +278,73 @@ class PassTable:
         destroy_route_index(self._route_index)
         self._route_index = None
 
+    def _drop_prev_route(self) -> None:
+        from paddlebox_tpu.native.build import destroy_route_index
+        destroy_route_index(self._prev_route)
+        self._prev_route = None
+
     def __del__(self):
         try:
             self._drop_route_index()
+            self._drop_prev_route()
         except Exception:
             pass
 
+    @staticmethod
+    def _incremental() -> bool:
+        from paddlebox_tpu.config import flags
+        return bool(flags.get_flag("incremental_pass"))
+
+    def _resident_pos(self, keys: np.ndarray) -> np.ndarray:
+        """[n] int32 resident slab row per key, -1 when not resident —
+        the delta-promote diff. Native hash probe over the previous pass's
+        index when available, sorted searchsorted fallback."""
+        res = self._resident_keys
+        if self._prev_route is not None:
+            from paddlebox_tpu.native.build import route_lookup_serve
+            return route_lookup_serve(self._prev_route, keys, -1)
+        if res is None:
+            return np.full(keys.size, -1, np.int32)
+        pos, hit = sorted_member(res, keys)
+        return np.where(hit, pos, -1).astype(np.int32)
+
+    def _promote_missing_rows(self, missing_keys: np.ndarray) -> np.ndarray:
+        """Host rows for the keys being promoted this pass. Rows the
+        preload promote stager already read (store-present keys) come from
+        the staged cache; the remainder goes through ONE sorted store call
+        — lookup_or_create draws init rng for genuinely-new keys in the
+        same sorted order the full path would."""
+        W = self.layout.width
+        rows = np.empty((missing_keys.size, W), np.float32)
+        need = np.ones(missing_keys.size, bool)
+        if self._staged is not None and not self._test_mode:
+            skeys, srows = self._staged
+            pos, hit = sorted_member(skeys, missing_keys)
+            if hit.any():
+                rows[hit] = srows[pos[hit]]
+                need = ~hit
+                stat_add("pass_rows_promote_prefetched", int(hit.sum()))
+        if need.any():
+            rem = missing_keys[need]
+            with self.store_lock:
+                got = (self.store.lookup(rem) if self._test_mode
+                       else self.store.lookup_or_create(rem))
+            rows[need] = got
+        return rows
+
     def begin_pass(self) -> None:
         """BeginPass (box_wrapper.cc:171): promote the working set into the
-        device slab."""
+        device slab.
+
+        Incremental mode (incremental_pass flag, default on): the previous
+        pass's slab stayed resident in HBM, so this diffs the new key set
+        against the resident one, moves surviving rows into their new
+        (sorted) positions with one on-device permute — compaction instead
+        of reallocation — and promotes only the NEW keys (host-store read
+        + H2D for the delta alone). A pass with 90% key overlap does ~10%
+        of the full build's host and wire work. Bit-parity with the full
+        path: ids stay the sorted-unique positions, row bits move without
+        arithmetic, the tail (and trash row) zero exactly as before."""
         if self._in_pass:
             raise RuntimeError("pass already open")
         if self._pass_keys is None:
@@ -228,30 +352,165 @@ class PassTable:
         t = self.timers["build"]
         t.start()
         n = self._pass_keys.size
-        host_rows = (self.store.lookup(self._pass_keys) if self._test_mode
-                     else self.store.lookup_or_create(self._pass_keys))
-        slab = np.zeros((self.capacity, self.layout.width), dtype=np.float32)
-        if n:
-            slab[:n] = host_rows
-        self._slab = jnp.asarray(slab)
+        inc = (self._incremental() and self._resident_keys is not None
+               and self._slab is not None)
+        if inc:
+            old_pos = self._resident_pos(self._pass_keys)
+            hit = old_pos >= 0
+            miss_idx = np.nonzero(~hit)[0].astype(np.int32)
+            new_rows = self._promote_missing_rows(self._pass_keys[~hit])
+            src = np.zeros(self.capacity, np.int32)
+            keep = np.zeros(self.capacity, bool)
+            if n:
+                src[:n][hit] = old_pos[hit]
+                keep[:n] = hit
+            m = miss_idx.size
+            pad = _pow2_pad(max(m, 1))
+            idx_p = np.full(pad, self.capacity, np.int32)  # drop sentinel
+            rows_p = np.zeros((pad, self.layout.width), np.float32)
+            idx_p[:m] = miss_idx
+            rows_p[:m] = new_rows
+            # test mode CONSUMES the resident slab too (donated — a copy
+            # would hold 2× slab HBM for the whole eval, an OOM at the
+            # capacity-probe scale the chip is sized to); the eval slab
+            # can't become resident (zero rows for store-missing keys),
+            # so end_pass drops residency and the next train pass pays
+            # one full rebuild — the pre-round-6 eval HBM profile
+            self._slab = _delta_promote(self._slab, jnp.asarray(src),
+                                        jnp.asarray(keep),
+                                        jnp.asarray(idx_p),
+                                        jnp.asarray(rows_p))
+            stat_add("pass_rows_promote_hit", int(hit.sum()))
+            stat_add("pass_rows_promote_new", m)
+        else:
+            with self.store_lock:
+                host_rows = (self.store.lookup(self._pass_keys)
+                             if self._test_mode
+                             else self.store.lookup_or_create(self._pass_keys))
+            # zero only the tail beyond n: a full-capacity zeros() here was
+            # pure memcpy waste — every [0, n) row is overwritten next
+            slab = np.empty((self.capacity, self.layout.width),
+                            dtype=np.float32)
+            if n:
+                slab[:n] = host_rows
+            slab[n:] = 0.0
+            self._slab = jnp.asarray(slab)
+        self._drop_prev_route()
+        self._touch_seen = False
+        self._residency_poisoned = False
+        if not self._test_mode:
+            self._staged = None  # consumed (or stale) either way
+            if self._incremental():
+                self._touched = np.zeros(self.capacity, bool)
         self._in_pass = True
         t.pause()
 
+    def note_touched(self, ids: np.ndarray) -> None:
+        """Accumulate the per-pass touched-row bitmap (host mirror, OR'd
+        per batch): every id that reaches a pull/push marks its row so
+        end_pass can write back only rows the pass actually updated.
+        Idempotent True stores — safe from concurrent staging threads.
+        No-op outside an incremental train pass. end_pass uses the delta
+        only when at least one mark arrived — raw-slab callers that
+        bypass lookup_ids/push still get the full writeback."""
+        t = self._touched
+        if t is not None:
+            t[ids] = True
+            self._touch_seen = True
+
     def end_pass(self) -> None:
         """EndPass (box_wrapper.cc:188): write the slab back to the host
-        store and drop the HBM working set."""
+        store. Incremental mode transfers and writes back only TOUCHED
+        rows (untouched rows are bit-identical to the host store by
+        construction) and keeps the slab resident in HBM for the next
+        pass's delta promote; test-mode passes never establish residency
+        (their slab holds zero rows for store-missing keys)."""
         if not self._in_pass:
             raise RuntimeError("end_pass without begin_pass")
         t = self.timers["end"]
         t.start()
         n = self._pass_keys.size
-        if n and not self._test_mode:
-            host = np.asarray(self._slab[:n])
-            self.store.write_back(self._pass_keys, host)
-        self._slab = None
+        if self._test_mode:
+            # no write-back, no residency from an eval slab
+            self._slab = None
+            self._resident_keys = None
+        else:
+            if n:
+                if self._touched is not None and self._touch_seen:
+                    self._touched[self.padding_id] = False
+                    idx = np.nonzero(self._touched[:n])[0]
+                    if idx.size:
+                        rows = np.asarray(self._slab[jnp.asarray(idx)])
+                        with self.store_lock:
+                            self.store.write_back(self._pass_keys[idx], rows)
+                    stat_add("pass_rows_written_back", int(idx.size))
+                    stat_add("pass_rows_writeback_skipped", n - int(idx.size))
+                else:
+                    host = np.asarray(self._slab[:n])
+                    with self.store_lock:
+                        self.store.write_back(self._pass_keys, host)
+            if self._incremental() and not self._residency_poisoned:
+                # rows stay resident (BoxPS cadence): the slab lives on in
+                # HBM and the next begin_pass promotes only the delta
+                self._resident_keys = self._pass_keys
+            else:
+                # flag off, or a mid-pass store mutation poisoned the
+                # residency (invalidate_residency during the pass must
+                # not be undone here)
+                self._slab = None
+                self._resident_keys = None
+        self._touched = None
+        self._residency_poisoned = False
         self._in_pass = False
-        self.check_need_limit_mem()
+        self.check_need_limit_mem()  # spill>0 invalidates internally
         t.pause()
+
+    def invalidate_residency(self) -> None:
+        """Drop the cross-pass resident state (slab, key map, staged
+        promote rows). Must be called after ANY host-store mutation that
+        bypasses the pass cadence — aging, shrink/decay, spill, checkpoint
+        stat rewrites, load — or the next delta promote would reuse stale
+        row bits. The next begin_pass falls back to a full build. Called
+        mid-pass, the live slab survives (the pass still needs it) but a
+        poison flag stops end_pass from re-establishing residency."""
+        if self._in_pass:
+            self._residency_poisoned = True
+        else:
+            self._slab = None
+        self._resident_keys = None
+        self._staged = None
+        self._drop_prev_route()
+
+    # ------------------------------------------------- preload promote hooks
+    def promote_prefetch_ctx(self):
+        """(known_fn, store, lock) for preload.PromotePrefetcher, or None
+        when the overlapped promote cannot run (flag off, test mode, store
+        without lookup_present, or no active pass to diff against). The
+        known_fn snapshots THIS pass's key set — exactly the set that will
+        be resident when the next begin_pass diffs."""
+        from paddlebox_tpu.config import flags
+        if (not flags.get_flag("incremental_pass")
+                or not flags.get_flag("preload_promote")
+                or self._test_mode
+                or not hasattr(self.store, "lookup_present")
+                or self._pass_keys is None or self._pass_keys.size == 0):
+            return None
+        # NOTE: the closure diffs against the numpy snapshot, NOT the
+        # native route index — the index handle can be destroyed by an
+        # interleaved eval pass's end_feed_pass while the prefetch thread
+        # is mid-probe; the snapshot array is kept alive by the closure
+        snapshot = self._pass_keys
+
+        def known(keys: np.ndarray) -> np.ndarray:
+            return sorted_member(snapshot, keys)[1]
+
+        return known, self.store, self.store_lock
+
+    def accept_staged_rows(self, keys: np.ndarray, rows: np.ndarray) -> None:
+        """Install the promote stager's prefetched (key, row) pairs for the
+        next train begin_pass. keys must be sorted unique."""
+        if keys.size:
+            self._staged = (keys, rows)
 
     def check_need_limit_mem(self) -> int:
         """Pass-cadence memory limiter (CheckNeedLimitMem/ShrinkResource,
@@ -261,7 +520,17 @@ class PassTable:
         max_resident = self.config.ssd_max_resident_rows(self.layout.width)
         if max_resident is None:
             return 0
-        return self.store.spill(max_resident)
+        # under the lock: a concurrent PromotePrefetcher lookup_present
+        # must never observe the spill mid-flight (native store has no
+        # internal lock — arena rows move)
+        with self.store_lock:
+            n = self.store.spill(max_resident)
+        if n:
+            # rows left the store: the resident slab no longer mirrors it
+            # (internal, so DIRECT callers are covered too — matching the
+            # sharded table)
+            self.invalidate_residency()
+        return n
 
     def set_test_mode(self, test: bool) -> None:
         """SetTestMode (box_wrapper.cc:183): inference pulls — no feature
@@ -292,8 +561,13 @@ class PassTable:
             raise RuntimeError("no active pass key set")
         if self._route_index is not None:
             from paddlebox_tpu.native.build import route_lookup
-            return route_lookup(self._route_index, keys, valid,
-                                self.padding_id)
+            ids = route_lookup(self._route_index, keys, valid,
+                               self.padding_id)
+            # every staged train batch flows through here, so this is the
+            # ONE accumulation point for the touched-row bitmap (uids are
+            # a subset of these ids; h2d_lean stages no uids at all)
+            self.note_touched(ids)
+            return ids
         ids = np.searchsorted(self._pass_keys, keys)
         ids = np.minimum(ids, max(self._pass_keys.size - 1, 0))
         if self._pass_keys.size:
@@ -307,7 +581,9 @@ class PassTable:
             missing = keys[~hit][:5]
             raise KeyError(
                 f"keys not registered in feed pass (first few: {missing})")
-        return ids.astype(np.int32)
+        ids = ids.astype(np.int32)
+        self.note_touched(ids)
+        return ids
 
     def dedup_for_push(self, ids: np.ndarray):
         """Host-side per-batch dedup for push_sparse_hostdedup (see
@@ -339,6 +615,10 @@ class PassTable:
             return
         t = self.timers["push"]
         t.start()
+        # direct pushes may carry ids that never went through lookup_ids
+        # (raw-op callers); this is the slow per-call path, so the D2H of
+        # a [K] id vector is noise next to the dispatch
+        self.note_touched(np.asarray(ids))
         self._prng, sub = jax.random.split(self._prng)
         self._slab = _push_kernel(self._slab, ids, grads, sub,
                                   self.layout, self.config.optimizer)
@@ -358,8 +638,11 @@ class PassTable:
 
     # ------------------------------------------------------------ lifecycle
     def shrink_table(self) -> int:
-        """ShrinkTable (box_wrapper.h:627): decay + delete on the host tier."""
-        return self.store.shrink()
+        """ShrinkTable (box_wrapper.h:627): decay + delete on the host tier.
+        Mutates every resident store row (decay) — drops pass residency."""
+        self.invalidate_residency()
+        with self.store_lock:
+            return self.store.shrink()
 
     def end_day(self, age: bool = True) -> int:
         """Day boundary (the python-driven day cadence around
@@ -372,16 +655,19 @@ class PassTable:
         aging twice per day halves every feature's configured lifetime.
         save_base touches only RESIDENT rows, so the spilled rows' lazy
         day clock still advances here either way."""
-        if age:
-            self.store.age_unseen_days()
-        else:
-            self.store.tick_spill_age()
+        self.invalidate_residency()  # aging rewrites every store row
+        with self.store_lock:
+            if age:
+                self.store.age_unseen_days()
+            else:
+                self.store.tick_spill_age()
         return self.shrink_table()
 
     def save(self, path: str) -> None:
         self.store.save(path)
 
     def load(self, path: str) -> None:
+        self.invalidate_residency()
         self.store.load(path)
 
     def load_ssd_to_mem(self) -> int:
@@ -389,5 +675,6 @@ class PassTable:
         back to DRAM — the explicit warm-up after a model load, before the
         day's first feed pass. Returns rows promoted."""
         if hasattr(self.store, "load_spilled"):
+            self.invalidate_residency()  # fault-in applies missed days
             return self.store.load_spilled()
         return 0

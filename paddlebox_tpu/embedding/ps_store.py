@@ -55,7 +55,16 @@ class PSBackedStore:
 
     def lookup_or_create(self, keys: np.ndarray) -> np.ndarray:
         """BuildPull: bulk fetch the pass working set (creating missing
-        features server-side, like FleetWrapper::PullSparseVarsSync)."""
+        features server-side, like FleetWrapper::PullSparseVarsSync).
+
+        Under the incremental pass lifecycle the sharded table calls this
+        with only the NEW-key delta — consecutive overlapping passes cut
+        BuildPull RPC volume to the non-resident fraction (the
+        ps_build_keys_pulled stat records exactly what went over the
+        wire). Note: no lookup_present here — the PS cannot distinguish
+        found from zero-row-missing over pull_sparse, so the preload
+        promote stager skips PS-backed shards and their delta reads
+        resolve at the pass boundary."""
         return self._pull(np.asarray(keys, np.uint64), create=True)
 
     def lookup(self, keys: np.ndarray) -> np.ndarray:
@@ -64,7 +73,10 @@ class PSBackedStore:
 
     def write_back(self, keys: np.ndarray, values: np.ndarray) -> None:
         """EndPass dump: slab rows → PS, verbatim (optimizer already ran
-        in-slab on device)."""
+        in-slab on device). assign_sparse is create-or-overwrite, so the
+        incremental touched-row delta (a subset of the pass keys) dumps
+        through the same call — ps_build_keys_dumped then counts only
+        rows the pass actually updated."""
         keys = np.asarray(keys, np.uint64)
         for lo in range(0, keys.size, self.chunk_keys):
             chunk = keys[lo:lo + self.chunk_keys]

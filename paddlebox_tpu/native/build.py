@@ -200,6 +200,23 @@ def route_lookup(handle, keys, valid, padding_id: int):
     return out
 
 
+def route_lookup_serve(handle, keys, miss_id: int):
+    """Translate keys → pass-local ids via the native index, mapping keys
+    ABSENT from the index to miss_id instead of raising (rt_lookup_serve).
+    This is the hash-probe diff the incremental begin_pass uses: probing
+    the PREVIOUS pass's index with the new pass's keys yields each key's
+    resident slab row, or miss_id for keys that must be promoted."""
+    import numpy as np
+    lib = get_lib()
+    keys = np.ascontiguousarray(keys, dtype=np.uint64)
+    out = np.empty(keys.shape[0], np.int32)
+    lib.rt_lookup_serve(
+        handle, keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        keys.shape[0], miss_id,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return out
+
+
 def load_lib(path: str) -> ctypes.CDLL:
     """Bind a user-supplied shared object honoring the parser C ABI
     (the DLManager dlopen path for custom parser plugins). Plugins only

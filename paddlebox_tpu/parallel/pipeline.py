@@ -1402,7 +1402,8 @@ class ShardedCtrPipelineRunner:
                 leaves["buckets"], self.local_positions, self.P,
                 self.table.shard_cap, self.multiprocess,
                 self.fleet.all_gather if self.multiprocess else None,
-                rebuild=self._push_write == "rebuild", pool=pool))
+                rebuild=self._push_write == "rebuild", pool=pool,
+                note_touched=self.table.note_touched))
         return {k: self._put_flat(np.stack(v)) for k, v in leaves.items()}
 
     def begin_pass(self) -> None:
@@ -1419,7 +1420,8 @@ class ShardedCtrPipelineRunner:
         if self.multiprocess:
             self.table.write_back_addressable(self._slabs)
         else:
-            self.table.write_back(np.asarray(self._slabs))
+            # touched-row delta D2H when the incremental lifecycle ran
+            self.table.end_pass_write_back(self._slabs)
         self._slabs = None
         self.table.check_need_limit_mem()
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 from typing import List, Optional, Tuple
 
 import jax
@@ -122,7 +123,7 @@ def exchange_outgoing_buckets(buckets_local: np.ndarray,
 
 def stage_push_dedup(buckets, local_positions, num_devices: int,
                      shard_cap: int, multiprocess: bool, all_gather,
-                     rebuild: bool, pool):
+                     rebuild: bool, pool, note_touched=None):
     """Per-destination push-dedup staging shared by BOTH sharded runners
     (trainer's _step_host_arrays + pipeline's device_batch): makes each
     shard's incoming a2a ids host-known (exchange_outgoing_buckets when
@@ -145,6 +146,11 @@ def stage_push_dedup(buckets, local_positions, num_devices: int,
         incoming = np.concatenate(
             [global_buckets[src][d] for src in range(num_devices)])
         uids, perm, inv = dedup_ids(incoming, shard_cap)
+        if note_touched is not None:
+            # every id this destination shard will push rides these uids —
+            # the per-pass touched-row accumulation point (incremental
+            # EndPass writes back only these rows)
+            note_touched(d, uids)
         pos = pos_for_rebuild(uids, shard_cap) if rebuild else None
         return uids, perm, inv, pos
 
@@ -203,6 +209,17 @@ class ShardedPassTable:
         self._test_mode = False
         self._route_index = None  # native pass index handle
         self._overflow_warned = False  # one warning per pass (reset per feed)
+        # incremental pass lifecycle (per-shard host residency cache):
+        # _res_keys[s]/_res_rows[s] mirror the rows the store holds for the
+        # last built pass, so the next _build_one promotes only the key
+        # DELTA (numpy row moves instead of store hash-gathers) and the
+        # end-of-pass writeback touches only rows the pass pushed.
+        self._res_keys: dict = {}
+        self._res_rows: dict = {}
+        self._touched_sh: Optional[dict] = None  # shard -> bool[shard_cap]
+        self._touch_seen = False  # any mark this pass? (else full writeback)
+        self._staged_sh: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self.store_lock = threading.Lock()
 
     def _drop_route_index(self) -> None:
         from paddlebox_tpu.native.build import destroy_route_index
@@ -261,15 +278,88 @@ class ShardedPassTable:
         self._in_feed_pass = False
         self._overflow_warned = False  # fresh warning budget per pass
 
+    @staticmethod
+    def _incremental() -> bool:
+        from paddlebox_tpu.config import flags
+        return bool(flags.get_flag("incremental_pass"))
+
+    def _staged_rows_for(self, missing: np.ndarray, rows: np.ndarray
+                         ) -> np.ndarray:
+        """Fill `rows` from the preload promote stage where possible;
+        returns the mask of positions still needing a store read."""
+        from paddlebox_tpu.embedding.pass_table import sorted_member
+        need = np.ones(missing.size, bool)
+        if self._staged_sh is not None and not self._test_mode:
+            skeys, srows = self._staged_sh
+            pos, hit = sorted_member(skeys, missing)
+            if hit.any():
+                rows[hit] = srows[pos[hit]]
+                need = ~hit
+                stat_add("pass_rows_promote_prefetched", int(hit.sum()))
+        return need
+
     def _build_one(self, s: int) -> np.ndarray:
+        """One shard's BeginPass promote. Incremental mode reuses the
+        host residency cache for keys that were in the last pass (pure
+        numpy row moves) and reads only NEW keys from the store —
+        compaction instead of reallocation; the tail beyond the working
+        set zeroes either way (never a full-capacity memset)."""
         C, W = self.shard_cap, self.layout.width
-        slab = np.zeros((C, W), dtype=np.float32)
         ks = self._shard_keys[s]
-        if ks.size:
-            rows = (self.stores[s].lookup(ks) if self._test_mode
-                    else self.stores[s].lookup_or_create(ks))
-            slab[:ks.size] = rows
+        n = ks.size
+        slab = np.empty((C, W), dtype=np.float32)
+        store = self.stores[s]
+        res_k = self._res_keys.get(s)
+        base = self._res_rows.get(s)
+        if (self._incremental() and res_k is not None and base is not None
+                and store is not None and n):
+            from paddlebox_tpu.embedding.pass_table import sorted_member
+            pos, hit = sorted_member(res_k, ks)
+            slab[:n][hit] = base[pos[hit]]
+            miss = ks[~hit]
+            rows = np.empty((miss.size, W), np.float32)
+            need = self._staged_rows_for(miss, rows)
+            if need.any():
+                with self.store_lock:
+                    got = (store.lookup(miss[need]) if self._test_mode
+                           else store.lookup_or_create(miss[need]))
+                rows[need] = got
+            slab[:n][~hit] = rows
+            stat_add("pass_rows_promote_hit", int(hit.sum()))
+            stat_add("pass_rows_promote_new", int(miss.size))
+        elif n:
+            if store is None:
+                raise RuntimeError(f"shard {s} store not owned by this "
+                                   "process")
+            with self.store_lock:
+                rows = (store.lookup(ks) if self._test_mode
+                        else store.lookup_or_create(ks))
+            slab[:n] = rows
+        slab[n:] = 0.0
+        if self._incremental() and not self._test_mode and store is not None:
+            # the cache tracks what the store holds for this pass's rows;
+            # end-of-pass delta writeback refreshes only touched entries
+            self._res_keys[s] = ks
+            self._res_rows[s] = slab
         return slab
+
+    def _begin_pass_state(self) -> None:
+        """Per-pass promote bookkeeping shared by both build entry points:
+        allocate the touched bitmaps (train mode, incremental only) and
+        consume the staged promote rows."""
+        self._touch_seen = False
+        if self._incremental():
+            if not self._test_mode:
+                self._touched_sh = {s: np.zeros(self.shard_cap, bool)
+                                    for s in self.owned_shards}
+            else:
+                self._touched_sh = None
+        else:
+            self._touched_sh = None
+            # with the flag off the caches stop being maintained — drop
+            # them now or a later re-enable would delta-build from stale
+            # rows (PassTable's non-incremental end_pass does the same)
+            self.invalidate_residency()
 
     def build_slabs(self) -> np.ndarray:
         """BeginPass: promote all shards' working sets → [P, C, W] host array
@@ -277,7 +367,11 @@ class ShardedPassTable:
         — multi-process callers use build_owned_slabs."""
         if self._shard_keys is None:
             raise RuntimeError("build_slabs before feed pass completed")
-        return np.stack([self._build_one(s) for s in range(self.num_shards)])
+        self._begin_pass_state()
+        out = np.stack([self._build_one(s) for s in range(self.num_shards)])
+        if not self._test_mode:
+            self._staged_sh = None
+        return out
 
     def build_owned_slabs(self) -> np.ndarray:
         """[len(owned), C, W] for this process's shards, in owned order —
@@ -285,15 +379,78 @@ class ShardedPassTable:
         (jax.make_array_from_process_local_data)."""
         if self._shard_keys is None:
             raise RuntimeError("build_owned_slabs before feed pass completed")
-        return np.stack([self._build_one(s) for s in self.owned_shards])
+        self._begin_pass_state()
+        out = np.stack([self._build_one(s) for s in self.owned_shards])
+        if not self._test_mode:
+            self._staged_sh = None
+        return out
+
+    def note_touched(self, dest: int, uids: np.ndarray) -> None:
+        """OR one push's dedup'd local ids into destination shard `dest`'s
+        touched bitmap (stage_push_dedup calls this per staged step).
+        Padding uids (>= shard_cap) drop; the trash row is cleared at
+        writeback. Idempotent True stores — stager-thread safe. The delta
+        writeback engages only if at least one mark arrived this pass —
+        raw-slab callers that push outside the staged path (probes,
+        oracle tests) still get the full writeback."""
+        t = self._touched_sh
+        if t is None:
+            return
+        m = t.get(dest)
+        if m is None:
+            return
+        m[uids[uids < self.shard_cap]] = True
+        self._touch_seen = True
+
+    def _touched_idx(self, s: int, n: int) -> Optional[np.ndarray]:
+        """Touched row indices within [0, n) for shard s, or None when the
+        pass ran without touched accounting (full writeback required)."""
+        t = self._touched_sh
+        if t is None or not self._touch_seen:
+            return None
+        m = t.get(s)
+        if m is None:
+            return None
+        m[self.shard_cap - 1] = False  # trash row never reaches the store
+        return np.nonzero(m[:n])[0]
 
     def write_back(self, slabs: np.ndarray) -> None:
-        """EndPass: [P, C, W] host array → shard stores (single process)."""
+        """EndPass: [P, C, W] host array → shard stores (single process).
+        Incremental mode writes back only touched rows per shard."""
         if self._test_mode:
+            self._touched_sh = None
             return
         for s, ks in enumerate(self._shard_keys or []):
             if ks.size and self.stores[s] is not None:
-                self.stores[s].write_back(ks, slabs[s, :ks.size])
+                self._write_back_rows(s, ks, slabs[s])
+        self._touched_sh = None
+
+    def _write_back_rows(self, s: int, ks: np.ndarray,
+                         slab_host: np.ndarray) -> None:
+        """Store one shard's end-of-pass rows from a HOST [C, W] array:
+        touched delta when the pass accounted touches, full otherwise."""
+        idx = self._touched_idx(s, ks.size)
+        with self.store_lock:
+            if idx is None:
+                self.stores[s].write_back(ks, slab_host[:ks.size])
+                if self._incremental():
+                    self._res_keys[s] = ks
+                    self._res_rows[s] = np.array(slab_host)
+                else:
+                    # flag off mid-pass: this cache entry is no longer
+                    # maintained — a stale read on re-enable is corruption
+                    self._res_keys.pop(s, None)
+                    self._res_rows.pop(s, None)
+            else:
+                if idx.size:
+                    rows = np.ascontiguousarray(slab_host[idx])
+                    self.stores[s].write_back(ks[idx], rows)
+                    cache = self._res_rows.get(s)
+                    if cache is not None:
+                        cache[idx] = rows
+                stat_add("pass_rows_written_back", int(idx.size))
+                stat_add("pass_rows_writeback_skipped",
+                         int(ks.size) - int(idx.size))
 
     def write_back_shard(self, s: int, slab: np.ndarray) -> None:
         """EndPass for ONE owned shard: [C, W] device-fetched slab → store
@@ -303,17 +460,103 @@ class ShardedPassTable:
             return
         ks = self._shard_keys[s]
         if ks.size:
-            self.stores[s].write_back(ks, slab[:ks.size])
+            self._write_back_rows(s, ks, slab)
+
+    def _write_back_shard_dev(self, s: int, dev) -> None:
+        """EndPass for one shard straight from its single-device [1, C, W]
+        buffer: with touched accounting, gather + D2H ONLY the touched
+        rows (the incremental lifecycle's delta transfer); otherwise the
+        classic full-shard fetch."""
+        ks = self._shard_keys[s]
+        if not ks.size or self.stores[s] is None:
+            return
+        idx = self._touched_idx(s, ks.size)
+        if idx is None:
+            self.write_back_shard(s, np.asarray(dev)[0])
+            return
+        if idx.size:
+            import jax.numpy as jnp
+            rows = np.asarray(jnp.asarray(dev)[0][jnp.asarray(idx)])
+            with self.store_lock:
+                self.stores[s].write_back(ks[idx], rows)
+            cache = self._res_rows.get(s)
+            if cache is not None:
+                cache[idx] = rows
+        stat_add("pass_rows_written_back", int(idx.size))
+        stat_add("pass_rows_writeback_skipped",
+                 int(ks.size) - int(idx.size))
 
     def write_back_addressable(self, slabs) -> None:
-        """EndPass over a jax [P, C, W] global array in a multi-process
-        job: dump THIS process's addressable shards (the one owner of the
-        shard-index-from-addressable-shard idiom — trainers call this
-        instead of walking .addressable_shards themselves)."""
+        """EndPass over a jax [P, C, W] global array: dump THIS process's
+        addressable shards (the one owner of the shard-index-from-
+        addressable-shard idiom — trainers call this instead of walking
+        .addressable_shards themselves). With touched accounting only the
+        touched rows cross the device→host wire; single-process callers
+        get the same delta through end_pass_write_back."""
+        if self._test_mode:
+            self._touched_sh = None
+            return
         for sh in slabs.addressable_shards:
             pos = sh.index[0]
             s = (pos.start or 0) if isinstance(pos, slice) else int(pos)
-            self.write_back_shard(int(s), np.asarray(sh.data)[0])
+            self._write_back_shard_dev(int(s), sh.data)
+        self._touched_sh = None
+
+    def end_pass_write_back(self, slabs) -> None:
+        """Single-process EndPass over the device [P, C, W] global array:
+        per-shard touched-row gather + D2H (all shards are addressable in
+        one process, so this shares write_back_addressable's path). The
+        pre-incremental equivalent was write_back(np.asarray(slabs)) — a
+        full-slab transfer every pass."""
+        self.write_back_addressable(slabs)
+
+    def invalidate_residency(self) -> None:
+        """Drop the per-shard residency caches and staged promote rows.
+        Must follow ANY store mutation outside the pass cadence (aging,
+        shrink decay, spill, checkpoint stat rewrites, load) — the next
+        build falls back to full store reads."""
+        self._res_keys = {}
+        self._res_rows = {}
+        self._staged_sh = None
+
+    # ------------------------------------------------- preload promote hooks
+    def promote_prefetch_ctx(self):
+        """(known_fn, store_facade, lock) for preload.PromotePrefetcher,
+        or None (flag off, test mode, no active pass). The facade routes
+        lookup_present by key % P over the owned shards; shards whose
+        store lacks lookup_present (e.g. PS-backed) report found=False and
+        fall through to the boundary's lookup_or_create."""
+        from paddlebox_tpu.config import flags
+        if (not flags.get_flag("incremental_pass")
+                or not flags.get_flag("preload_promote")
+                or self._test_mode or self._shard_keys is None):
+            return None
+        if not any(st is not None and hasattr(st, "lookup_present")
+                   for st in self.stores):
+            return None
+        # numpy snapshot diff, NOT the native route index: the index
+        # handle can be destroyed by an interleaved eval pass while the
+        # prefetch thread is mid-probe; the arrays stay alive here
+        snapshot = [np.asarray(k) for k in self._shard_keys]
+        P = np.uint64(self.num_shards)
+
+        def known(keys: np.ndarray) -> np.ndarray:
+            from paddlebox_tpu.embedding.pass_table import sorted_member
+            out = np.zeros(keys.size, bool)
+            shard = (keys % P).astype(np.int64)
+            for s in range(self.num_shards):
+                m = shard == s
+                if m.any():
+                    out[m] = sorted_member(snapshot[s], keys[m])[1]
+            return out
+
+        return known, _ShardLookupFacade(self), self.store_lock
+
+    def accept_staged_rows(self, keys: np.ndarray, rows: np.ndarray) -> None:
+        """Install the promote stager's prefetched (key, row) pairs for the
+        next train build. keys must be sorted unique."""
+        if keys.size:
+            self._staged_sh = (keys, rows)
 
     @property
     def test_mode(self) -> bool:
@@ -444,38 +687,48 @@ class ShardedPassTable:
                 "in stats.sharded_bucket_overflow only", count,
                 self.bucket_cap)
 
-    # ------------------------------------------------------------ lifecycle
     def check_need_limit_mem(self) -> int:
         """Per-shard pass-cadence spill (CheckNeedLimitMem/ShrinkResource,
         box_wrapper.h:627-629); budget divides evenly across owned shards
         — except table-wide backends (PS-backed shards), which receive the
-        WHOLE budget once through their primary."""
+        WHOLE budget once through their primary. Any spill drops the
+        incremental residency caches (rows left the stores)."""
         budget = self.config.ssd_max_resident_rows(self.layout.width)
         if budget is None:
             return 0
         per_shard = budget // max(1, len(self.owned_shards))
         total = 0
-        for st in self.stores:
-            if st is None or not hasattr(st, "spill"):
-                continue
-            total += st.spill(budget if getattr(st, "spill_table_wide",
-                                                False) else per_shard)
+        # under the lock: a concurrent PromotePrefetcher lookup_present
+        # must never observe a spill mid-flight (native stores have no
+        # internal lock — arena rows move)
+        with self.store_lock:
+            for st in self.stores:
+                if st is None or not hasattr(st, "spill"):
+                    continue
+                total += st.spill(budget if getattr(st, "spill_table_wide",
+                                                    False) else per_shard)
+        if total:
+            self.invalidate_residency()
         return total
 
     def shrink_table(self) -> int:
-        return sum(st.shrink() for st in self.stores if st is not None)
+        self.invalidate_residency()  # decay rewrites every store row
+        with self.store_lock:
+            return sum(st.shrink() for st in self.stores if st is not None)
 
     def end_day(self, age: bool = True) -> int:
         """Day boundary over the owned shards: age unseen_days, then
         shrink (see PassTable.end_day for the age=False/save_base rule).
         PS-backed shards age server-side through their primary."""
-        for st in self.stores:
-            if st is None:
-                continue
-            if age:
-                st.age_unseen_days()
-            else:
-                st.tick_spill_age()
+        self.invalidate_residency()
+        with self.store_lock:
+            for st in self.stores:
+                if st is None:
+                    continue
+                if age:
+                    st.age_unseen_days()
+                else:
+                    st.tick_spill_age()
         return self.shrink_table()
 
     def save(self, path_prefix: str) -> None:
@@ -484,12 +737,14 @@ class ShardedPassTable:
                 st.save(f"{path_prefix}.shard{s:03d}")
 
     def load(self, path_prefix: str) -> None:
+        self.invalidate_residency()
         for s, st in enumerate(self.stores):
             if st is not None:
                 st.load(f"{path_prefix}.shard{s:03d}")
 
     def load_ssd_to_mem(self) -> int:
         """LoadSSD2Mem over the owned shards (box_wrapper.cc:1319)."""
+        self.invalidate_residency()  # fault-in applies missed days
         return sum(st.load_spilled() for st in self.stores
                    if st is not None and hasattr(st, "load_spilled"))
 
@@ -511,6 +766,30 @@ class ShardedPassTable:
                 raise TypeError("PS-backed shards checkpoint server-side "
                                 "(PSClient.save), not through store_view")
         return ShardedStoreView(self)
+
+
+class _ShardLookupFacade:
+    """Single-store lookup_present view over a ShardedPassTable's owned
+    shards (the preload promote stager's read interface): keys route by
+    key % P; shards without lookup_present (non-owned, PS-backed) report
+    found=False so those keys resolve at the pass boundary instead."""
+
+    def __init__(self, table: "ShardedPassTable") -> None:
+        self._table = table
+
+    def lookup_present(self, keys: np.ndarray):
+        t = self._table
+        out = np.zeros((keys.size, t.layout.width), np.float32)
+        found = np.zeros(keys.size, bool)
+        shard = (keys % np.uint64(t.num_shards)).astype(np.int64)
+        for s in t.owned_shards:
+            st = t.stores[s]
+            if st is None or not hasattr(st, "lookup_present"):
+                continue
+            m = shard == s
+            if m.any():
+                out[m], found[m] = st.lookup_present(keys[m])
+        return out, found
 
 
 class ShardedStoreView:
@@ -551,6 +830,9 @@ class ShardedStoreView:
         return np.concatenate(ks), np.vstack(vs)
 
     def write_back(self, keys: np.ndarray, values: np.ndarray) -> None:
+        # checkpoint stat rewrites land here — the residency caches no
+        # longer mirror the stores afterwards
+        self._table.invalidate_residency()
         keys = np.asarray(keys, np.uint64)
         P = np.uint64(self._table.num_shards)
         for s, st in self._owned():
@@ -562,6 +844,7 @@ class ShardedStoreView:
         """Split a single checkpoint blob across the shard stores (their
         load_blob handles index reset, stale-spill clearing, and layout
         validation) — one deserialization, no temp files."""
+        self._table.invalidate_residency()
         import pickle
         with open(path, "rb") as f:
             blob = pickle.load(f)

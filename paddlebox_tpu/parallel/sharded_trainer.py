@@ -719,7 +719,8 @@ class ShardedBoxTrainer:
                 stacked["buckets"], self.local_positions, self.P,
                 self.table.shard_cap, self.multiprocess,
                 self.fleet.all_gather if self.multiprocess else None,
-                rebuild=self._push_write == "rebuild", pool=pool))
+                rebuild=self._push_write == "rebuild", pool=pool,
+                note_touched=self.table.note_touched))
         return {k: np.stack(v) for k, v in stacked.items()}
 
     def shard_batches(self, per_worker: List[List[PackedBatch]],
@@ -903,7 +904,9 @@ class ShardedBoxTrainer:
             # HBM→host per node, ps_gpu_wrapper.cc:983+)
             self.table.write_back_addressable(self._slabs)
         else:
-            self.table.write_back(np.asarray(self._slabs))
+            # touched-row delta D2H when the incremental lifecycle ran
+            # (the pre-round-6 full np.asarray rode here every pass)
+            self.table.end_pass_write_back(self._slabs)
         self.table.check_need_limit_mem()
         self._slabs = None
         t_pass.pause()

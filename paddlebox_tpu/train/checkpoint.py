@@ -91,6 +91,9 @@ class CheckpointManager:
         self.table.layout.update_stat_after_save(values, self.table.config, 3)
         if keys.size:
             self.store.write_back(keys, values)
+            # the stat rewrite bypassed the pass cadence: any resident
+            # slab no longer mirrors the store (incremental lifecycle)
+            self._invalidate_residency()
 
         def do_save():
             with open(os.path.join(batch_dir, "sparse.pkl"), "wb") as f:
@@ -122,6 +125,7 @@ class CheckpointManager:
         self.table.layout.update_stat_after_save(values, self.table.config, 1)
         if keys.size:
             self.store.write_back(keys, values)
+            self._invalidate_residency()
 
         def do_save():
             self._write_xbox(xbox_dir, blob)
@@ -132,6 +136,16 @@ class CheckpointManager:
         else:
             do_save()
         return xbox_dir
+
+    def _invalidate_residency(self) -> None:
+        """Incremental pass lifecycle hook: checkpoint stat rewrites and
+        loads mutate store rows outside the pass cadence, so the table's
+        cross-pass resident slab/caches must drop (ShardedStoreView's own
+        write_back/load already invalidate; PassTable's direct store needs
+        this explicit call)."""
+        inval = getattr(self.table, "invalidate_residency", None)
+        if inval is not None:
+            inval()
 
     def _spilled_snapshot(self):
         snap = getattr(self.store, "spilled_snapshot", None)
@@ -192,6 +206,7 @@ class CheckpointManager:
                     "pytree structures are incompatible — set "
                     "PBTPU_FLATTEN_DENSE_OPT to match the checkpoint")
         self.store.load(os.path.join(batch_dir, "sparse.pkl"))
+        self._invalidate_residency()
         return blob["params"], blob["opt_state"], blob["extra"]
 
     def wait(self) -> None:

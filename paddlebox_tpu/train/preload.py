@@ -9,15 +9,115 @@ pass's routing state (_shard_keys / pass index) is untouched while the
 next pass streams in; the cheap unique+sort+index build (end_feed_pass)
 stays on the pass boundary, exactly the part the reference also leaves in
 EndFeedPass (box_wrapper.cc:153-168).
+
+Incremental promote overlap (round-6): with the incremental pass
+lifecycle, most of begin_pass's remaining host cost is store reads for
+keys that are NOT in the currently-resident set but HAVE been seen in
+earlier passes. A PromotePrefetcher thread diffs each arriving key chunk
+against the resident set (hash probe over the live pass index) and reads
+those rows from the host store while the previous pass still trains —
+the same tail-hiding the reference gets from PreLoad/WaitFeedPassDone.
+Creation of genuinely-new keys stays at the pass boundary so init-rng
+draw order (and therefore every bit) matches the non-overlapped path.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Optional
+import queue
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
 from paddlebox_tpu.utils.timer import Timer
+
+
+class PromotePrefetcher:
+    """Background diff + host-store read of the next pass's non-resident
+    keys (the overlapped half of the incremental begin_pass).
+
+    known_fn(keys)->bool mask marks keys already resident (the current
+    pass's set — exactly what the next begin_pass will diff against);
+    store.lookup_present(keys)->(rows, found) reads WITHOUT creating, so
+    rng parity with the boundary path holds; lock serializes store access
+    against the current pass's end_pass writeback."""
+
+    def __init__(self, known_fn, store, lock: threading.Lock) -> None:
+        self._known = known_fn
+        self._store = store
+        self._lock = lock
+        self._q: "queue.Queue" = queue.Queue()
+        # sorted accumulated candidate set — the dedup stays in numpy
+        # (sorted_member probe + union1d merge); a Python set at feed-key
+        # line rate would cost hundreds of ms/pass on this thread
+        self._seen = np.empty(0, np.uint64)
+        self._keys: List[np.ndarray] = []
+        self._rows: List[np.ndarray] = []
+        self._err: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="promote-prefetch")
+        self._thread.start()
+
+    def feed(self, keys: np.ndarray) -> None:
+        self._q.put(np.asarray(keys, np.uint64))
+
+    def _run(self) -> None:
+        from paddlebox_tpu.embedding.pass_table import sorted_member
+        try:
+            done = False
+            while not done:
+                chunk = self._q.get()
+                if chunk is None:
+                    return
+                # drain everything already queued: readers feed many small
+                # chunks, and one union over the batch beats one re-sort
+                # of the accumulated set per chunk
+                parts = [chunk]
+                while True:
+                    try:
+                        nxt = self._q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is None:
+                        done = True  # process this batch, then exit
+                        break
+                    parts.append(nxt)
+                chunk = np.concatenate(parts)
+                if not chunk.size:
+                    continue
+                cand = np.unique(chunk)
+                cand = cand[~self._known(cand)]
+                if cand.size:
+                    cand = cand[~sorted_member(self._seen, cand)[1]]
+                if not cand.size:
+                    continue
+                self._seen = np.union1d(self._seen, cand)
+                with self._lock:
+                    rows, found = self._store.lookup_present(cand)
+                if found.any():
+                    self._keys.append(cand[found])
+                    self._rows.append(rows[found])
+        except BaseException as e:  # surfaced at finish()
+            self._err = e
+
+    def finish(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Join the worker and return (sorted unique keys, rows)."""
+        self._q.put(None)
+        self._thread.join()
+        if self._err is not None:
+            raise self._err
+        if not self._keys:
+            return np.empty(0, np.uint64), np.empty((0, 0), np.float32)
+        keys = np.concatenate(self._keys)
+        rows = np.vstack(self._rows)
+        order = np.argsort(keys, kind="stable")
+        return keys[order], rows[order]
+
+    def stop(self) -> None:
+        """Abandon the prefetch (error paths): unblock and join the
+        worker, discarding whatever it staged."""
+        self._q.put(None)
+        self._thread.join(timeout=30.0)
 
 
 class PassPreloader:
@@ -27,37 +127,84 @@ class PassPreloader:
         self.table = table
         self._buffer: Optional[List[np.ndarray]] = None
         self._dataset = None
+        self._prefetch: Optional[PromotePrefetcher] = None
         self.timers = {"wait": Timer()}
 
     def preload(self, dataset) -> None:
-        """Start the next pass's read threads; returns immediately."""
+        """Start the next pass's read threads; returns immediately. When
+        the incremental lifecycle is active, a PromotePrefetcher also
+        starts pulling the next pass's non-resident rows from the host
+        store under the current pass's training."""
         if self._dataset is not None:
             raise RuntimeError("a preload is already in flight")
         self._buffer = []
         self._dataset = dataset
-        dataset.preload_into_memory(add_keys_fn=self._buffer.append)
+        ctx_fn = getattr(self.table, "promote_prefetch_ctx", None)
+        ctx = ctx_fn() if ctx_fn is not None else None
+        try:
+            if ctx is not None:
+                self._prefetch = PromotePrefetcher(*ctx)
+                buf = self._buffer
+                pre = self._prefetch
+
+                def add(keys):
+                    buf.append(keys)
+                    pre.feed(keys)
+
+                dataset.preload_into_memory(add_keys_fn=add)
+            else:
+                dataset.preload_into_memory(add_keys_fn=self._buffer.append)
+        except BaseException:
+            # a failed launch must not wedge the preloader (or leave the
+            # prefetch worker parked on its queue forever)
+            self._reset()
+            raise
+
+    def _reset(self) -> None:
+        """Drop all in-flight preload state (error paths included) so the
+        preloader can accept a fresh preload() instead of reporting 'a
+        preload is already in flight' forever."""
+        if self._prefetch is not None:
+            try:
+                self._prefetch.stop()
+            finally:
+                self._prefetch = None
+        self._buffer = None
+        self._dataset = None
 
     def wait(self, dataset, allgather=None) -> None:
         """Join the load and run the table's feed pass over the buffered
         keys (WaitFeedPassDone: dataset_->WaitPreLoadDone() +
-        EndFeedPass)."""
+        EndFeedPass). On ANY error the preloader resets — a retrying
+        driver can preload again."""
         if dataset is not self._dataset:
             raise RuntimeError("wait() for a dataset that was not preloaded")
         t = self.timers["wait"]
         t.start()
-        dataset.wait_preload_done()
-        self.table.begin_feed_pass()
-        for ks in self._buffer or []:
-            self.table.add_keys(ks)
-        import inspect
-        params = inspect.signature(self.table.end_feed_pass).parameters
-        if "allgather" in params:
-            self.table.end_feed_pass(allgather=allgather)
-        else:  # single-chip PassTable takes no allgather
-            self.table.end_feed_pass()
-        self._buffer = None
-        self._dataset = None
-        t.pause()
+        try:
+            dataset.wait_preload_done()
+            pre, self._prefetch = self._prefetch, None
+            if pre is not None:
+                keys, rows = pre.finish()
+                if keys.size:
+                    self.table.accept_staged_rows(keys, rows)
+            self.table.begin_feed_pass()
+            for ks in self._buffer or []:
+                self.table.add_keys(ks)
+            import inspect
+            params = inspect.signature(self.table.end_feed_pass).parameters
+            if "allgather" in params:
+                self.table.end_feed_pass(allgather=allgather)
+            else:  # single-chip PassTable takes no allgather
+                self.table.end_feed_pass()
+        except BaseException:
+            self._reset()
+            raise
+        else:
+            self._buffer = None
+            self._dataset = None
+        finally:
+            t.pause()
 
 
 def run_preloaded_passes(trainer, datasets: Iterable,

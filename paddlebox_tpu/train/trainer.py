@@ -314,24 +314,36 @@ def resolve_push_write(capacity: Optional[int] = None,
                        allow_log: bool = False) -> str:
     """'scatter' | 'rebuild' | 'log' from the push_write flag.
 
-    'auto' picks by the round-5 measured matrix (tools/tpu_probe.py +
-    tools/capacity_probe.py, ms/step at bench batch):
+    Measured regimes (tools/tpu_probe.py + tools/capacity_probe.py,
+    ms/step at the bench batch; BASELINE.md round-5 rows):
 
-        cap      rebuild   scatter   log
-        1M rows  14.9-16.1 ~16 (r4)  15.7
-        4M       34.4-36.1 25.6      26.3
-        33M      (compile×) **23.9** 104.7
+        cap       rebuild    scatter    log
+        1M rows   14.9-16.1  ~16 (r4)   15.7
+        4M        34.4-36.1  25.6       26.3
+        33M       (compile×) **23.9**   104.7
 
-    rebuild (gather/select ~ slab bytes) wins small slabs; DONATED
-    in-step scatter is ~capacity-flat and wins at scale — the r4 belief
-    that scatter grows with capacity came from a non-donated probe
-    harness paying an output-copy per call (BASELINE.md round-5
-    "probe-harness corrections"). So auto = rebuild ≤ ~16× the per-batch
-    key budget, scatter beyond — the r4 policy, now with the measured
-    explanation. The log-structured write (built + bit-parity-tested
-    round 5) stays available explicitly: it beats rebuild at mid slabs
-    but its DUS pays a buffer-proportional cost the scatter does not.
-    CPU always scatters.
+    Where each mode wins, and what 'auto' does with that:
+
+    * rebuild — full slab gather/select driven by a host-staged pos map;
+      cost ~ slab bytes, so it wins SMALL slabs (≤ ~16× the per-batch
+      key budget) where the gather is cheaper than a scatter's index
+      plumbing. 'auto' selects it in exactly that regime on accelerators.
+    * scatter — donated in-step row scatter; ~capacity-flat, wins at
+      scale. (The r4 belief that scatter grows with capacity came from a
+      non-donated probe harness paying an output-copy per call —
+      BASELINE.md round-5 "probe-harness corrections".) 'auto' selects it
+      beyond the rebuild regime, and ALWAYS on CPU.
+    * log — DEPRECATED as an auto candidate: 'auto' can never select it.
+      It beats rebuild at mid-size slabs (the 4M row above) but its
+      dynamic_update_slice pays a buffer-proportional cost the scatter
+      does not, it loses badly at scale (104.7 ms at 33M), and it is
+      restricted to the single-host trainer without expand/async/
+      chunk-sync. It remains available by explicit push_write='log' only;
+      findings: BASELINE.md round-5 "log-structured write" rows.
+
+    So: auto = rebuild when capacity ≤ ~16× batch keys on tpu/axon,
+    scatter otherwise. h2d_lean forces scatter (no host-staged maps on
+    the wire-lean path).
     """
     from paddlebox_tpu.config import flags
     mode = flags.get_flag("push_write")
@@ -1142,7 +1154,11 @@ class BoxTrainer:
         # per-key slots/valid are derived on device (make_train_step);
         # ids/segments/perm/inv/uids ride the H2D path, plus the [capacity]
         # push_pos map in push_write=rebuild mode (the largest transfer —
-        # it buys removing the slab scatter from the step)
+        # it buys removing the slab scatter from the step).
+        # Touched-row accounting for the incremental EndPass happens in
+        # table.lookup_ids (the `ids` passed here already marked the pass
+        # bitmap) — ONE accumulation point that covers every write path,
+        # including h2d_lean where no uids/perm/inv are staged at all.
         out = {
             "ids": ids,
             "segments": b.segments,

@@ -1,0 +1,329 @@
+"""Bit-parity: incremental pass lifecycle vs the full rebuild path.
+
+The incremental lifecycle (delta promote + touched-row writeback +
+cross-pass HBM residency, flags.incremental_pass) must be byte-identical
+to the full begin_pass/end_pass round trip: same slab contents after
+every begin_pass, same host-store contents (values INCLUDING optimizer
+state columns) after every end_pass, across consecutive overlapping
+passes, at 0% overlap, and through a test_mode (no-create, no-writeback)
+eval pass in the middle."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddlebox_tpu.config import flags
+from paddlebox_tpu.config.configs import SparseOptimizerConfig, TableConfig
+from paddlebox_tpu.embedding.pass_table import PassTable
+from paddlebox_tpu.parallel.sharded_table import ShardedPassTable
+
+D = 4
+CAP = 1 << 10
+
+
+def table_cfg():
+    return TableConfig(
+        embedx_dim=D, pass_capacity=CAP,
+        optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                        mf_initial_range=1e-3))
+
+
+@pytest.fixture
+def incremental_flag():
+    """Restore the flag whatever a test sets it to."""
+    saved = flags.get_flag("incremental_pass")
+    yield
+    flags.set_flag("incremental_pass", saved)
+
+
+def make_passes(rng, n_passes=3, n_keys=500, overlap=0.9):
+    """Consecutive sorted-unique key sets with ~`overlap` retention."""
+    cur = np.unique(rng.randint(0, 1 << 30, n_keys).astype(np.uint64))
+    out = [cur]
+    for _ in range(n_passes - 1):
+        keep = rng.rand(cur.size) < overlap
+        fresh = np.unique(
+            rng.randint(0, 1 << 30, max(8, int(n_keys * (1 - overlap))))
+            .astype(np.uint64))
+        cur = np.unique(np.concatenate([cur[keep], fresh]))
+        out.append(cur)
+    return out
+
+
+def sorted_store_items(store):
+    keys, vals = store.state_items()
+    order = np.argsort(keys)
+    return keys[order], vals[order]
+
+
+def run_single(passes, incremental, test_pass=None, seed=11):
+    """Drive a PassTable through the passes with real device pushes;
+    returns per-pass (slab_after_pushes, store_keys, store_vals).
+    test_pass, when given, is a key set run in test_mode between the
+    train passes (after the first one)."""
+    flags.set_flag("incremental_pass", incremental)
+    t = PassTable(table_cfg(), seed=seed)
+    pl = t.push_layout
+    out = []
+    for pi, ks in enumerate(passes):
+        if test_pass is not None and pi == 1:
+            # eval pass in the middle: no create, no writeback
+            t.set_test_mode(True)
+            t.begin_feed_pass()
+            t.add_keys(test_pass)
+            t.end_feed_pass()
+            t.begin_pass()
+            eval_ids = t.lookup_ids(test_pass)
+            eval_rows = np.asarray(t.pull(jnp.asarray(eval_ids)))
+            t.end_pass()
+            t.set_test_mode(False)
+            ek, ev = sorted_store_items(t.store)
+            out.append(("eval", eval_rows, ek, ev))
+        t.begin_feed_pass()
+        t.add_keys(ks)
+        t.end_feed_pass()
+        t.begin_pass()
+        # push gradients on a deterministic subset (with repeats, so the
+        # dedup + merge path runs), leave the rest untouched
+        sub = np.concatenate([ks[: max(1, ks.size // 2)], ks[:7]])
+        ids = t.lookup_ids(sub)
+        g = np.zeros((ids.size, pl.width), np.float32)
+        g[:, pl.SHOW] = 1.0
+        g[:, pl.CLICK] = (np.arange(ids.size) % 2).astype(np.float32)
+        g[:, pl.EMBED_G] = 0.05
+        g[:, pl.embedx_g:] = 0.01
+        t.push(jnp.asarray(ids), jnp.asarray(g))
+        slab = np.asarray(t.slab)
+        t.end_pass()
+        k, v = sorted_store_items(t.store)
+        out.append(("train", slab, k, v))
+    return out
+
+
+def assert_runs_equal(full, inc):
+    assert len(full) == len(inc)
+    for (tag_f, slab_f, k_f, v_f), (tag_i, slab_i, k_i, v_i) in zip(full,
+                                                                    inc):
+        assert tag_f == tag_i
+        np.testing.assert_array_equal(slab_f, slab_i)
+        np.testing.assert_array_equal(k_f, k_i)
+        np.testing.assert_array_equal(v_f, v_i)
+
+
+def test_pass_table_parity_overlapping(incremental_flag):
+    passes = make_passes(np.random.RandomState(0), n_passes=4, overlap=0.9)
+    full = run_single(passes, incremental=False)
+    inc = run_single(passes, incremental=True)
+    assert_runs_equal(full, inc)
+
+
+def test_pass_table_parity_zero_overlap(incremental_flag):
+    rng = np.random.RandomState(1)
+    # disjoint ranges: 0% overlap — the incremental worst case must still
+    # be bit-exact (every row evicted + promoted each pass)
+    passes = [np.unique((rng.randint(0, 1 << 20, 300)
+                         + (p << 32)).astype(np.uint64))
+              for p in range(3)]
+    full = run_single(passes, incremental=False)
+    inc = run_single(passes, incremental=True)
+    assert_runs_equal(full, inc)
+
+
+def test_pass_table_parity_through_test_mode(incremental_flag):
+    rng = np.random.RandomState(2)
+    passes = make_passes(rng, n_passes=3, overlap=0.85)
+    # the eval set mixes resident keys with NEVER-SEEN keys: test mode
+    # must not create them, and the incremental path must not leak the
+    # eval slab (zero rows for unseen keys) into the next train promote
+    unseen = np.unique((rng.randint(0, 1 << 20, 64)
+                        + (7 << 40)).astype(np.uint64))
+    test_keys = np.unique(np.concatenate([passes[0][:100], unseen]))
+    full = run_single(passes, incremental=False, test_pass=test_keys)
+    inc = run_single(passes, incremental=True, test_pass=test_keys)
+    assert_runs_equal(full, inc)
+    # the eval pass must not have created the unseen keys in either run
+    for run in (full, inc):
+        tag, _, keys, _ = run[1]
+        assert tag == "eval"
+        assert not np.isin(unseen, keys).any()
+
+
+def test_pass_table_delta_path_actually_ran(incremental_flag):
+    """Guard against the delta promote silently falling back to full
+    builds: at high overlap the resident-hit stat must move."""
+    from paddlebox_tpu.utils.stats import stat_get
+    passes = make_passes(np.random.RandomState(3), n_passes=3, overlap=0.9)
+    before = stat_get("pass_rows_promote_hit")
+    run_single(passes, incremental=True)
+    assert stat_get("pass_rows_promote_hit") > before
+
+
+def test_pass_table_invalidation_forces_full_build(incremental_flag):
+    """A store mutation outside the pass cadence (end_day aging) must
+    drop residency — and the next pass must still be bit-exact vs a
+    full-path table subjected to the same cadence."""
+    passes = make_passes(np.random.RandomState(4), n_passes=2, overlap=0.9)
+
+    def run(incremental):
+        flags.set_flag("incremental_pass", incremental)
+        t = PassTable(table_cfg(), seed=5)
+        outs = []
+        for ks in passes:
+            t.begin_feed_pass()
+            t.add_keys(ks)
+            t.end_feed_pass()
+            t.begin_pass()
+            ids = t.lookup_ids(ks[: ks.size // 2])
+            pl = t.push_layout
+            g = np.zeros((ids.size, pl.width), np.float32)
+            g[:, pl.SHOW] = 1.0
+            g[:, pl.EMBED_G] = 0.1
+            t.push(jnp.asarray(ids), jnp.asarray(g))
+            outs.append(np.asarray(t.slab))
+            t.end_pass()
+            t.end_day()  # ages + shrinks between every pass
+        return outs, sorted_store_items(t.store)
+
+    slabs_f, store_f = run(False)
+    slabs_i, store_i = run(True)
+    for a, b in zip(slabs_f, slabs_i):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(store_f[0], store_i[0])
+    np.testing.assert_array_equal(store_f[1], store_i[1])
+
+
+# --------------------------------------------------------------- sharded
+def run_sharded(passes, incremental, seed=9, num_shards=4):
+    """Drive a ShardedPassTable through build → simulated push →
+    write_back; the 'push' mutates a deterministic subset of each shard's
+    rows on the host copy (the device step is exercised by the trainer
+    tests; here the contract under test is the table's promote/writeback
+    bookkeeping). Returns per-pass (built_slabs, store items per shard)."""
+    flags.set_flag("incremental_pass", incremental)
+    t = ShardedPassTable(table_cfg(), num_shards=num_shards,
+                         bucket_cap=256, seed=seed)
+    out = []
+    for ks in passes:
+        t.begin_feed_pass()
+        t.add_keys(ks)
+        t.end_feed_pass()
+        slabs = t.build_slabs()
+        built = slabs.copy()
+        # simulate training: bump half of each shard's working set and
+        # report those rows touched (the stage_push_dedup callback role)
+        for s in range(num_shards):
+            n = t._shard_keys[s].size
+            if not n:
+                continue
+            rows = np.arange(0, n, 2, dtype=np.int32)
+            slabs[s, rows] += 0.125
+            t.note_touched(s, rows)
+        t.write_back(slabs)
+        items = [sorted_store_items(st) for st in t.stores]
+        out.append((built, slabs.copy(), items))
+    return out
+
+
+def test_sharded_parity_overlapping(incremental_flag):
+    passes = make_passes(np.random.RandomState(6), n_passes=4, overlap=0.9)
+    full = run_sharded(passes, incremental=False)
+    inc = run_sharded(passes, incremental=True)
+    for (b_f, s_f, it_f), (b_i, s_i, it_i) in zip(full, inc):
+        np.testing.assert_array_equal(b_f, b_i)
+        np.testing.assert_array_equal(s_f, s_i)
+        for (k_f, v_f), (k_i, v_i) in zip(it_f, it_i):
+            np.testing.assert_array_equal(k_f, k_i)
+            np.testing.assert_array_equal(v_f, v_i)
+
+
+def test_sharded_parity_zero_overlap(incremental_flag):
+    rng = np.random.RandomState(7)
+    passes = [np.unique((rng.randint(0, 1 << 20, 300)
+                         + (p << 32)).astype(np.uint64))
+              for p in range(3)]
+    full = run_sharded(passes, incremental=False)
+    inc = run_sharded(passes, incremental=True)
+    for (b_f, s_f, it_f), (b_i, s_i, it_i) in zip(full, inc):
+        np.testing.assert_array_equal(b_f, b_i)
+        for (k_f, v_f), (k_i, v_i) in zip(it_f, it_i):
+            np.testing.assert_array_equal(k_f, k_i)
+            np.testing.assert_array_equal(v_f, v_i)
+
+
+def test_sharded_test_mode_no_create_no_writeback(incremental_flag):
+    flags.set_flag("incremental_pass", True)
+    rng = np.random.RandomState(8)
+    passes = make_passes(rng, n_passes=2, overlap=0.9)
+    t = ShardedPassTable(table_cfg(), num_shards=4, bucket_cap=256, seed=1)
+    # train pass 0
+    t.begin_feed_pass()
+    t.add_keys(passes[0])
+    t.end_feed_pass()
+    slabs = t.build_slabs()
+    t.write_back(slabs)
+    sizes = [len(st) for st in t.stores]
+    items = [sorted_store_items(st) for st in t.stores]
+    # eval pass with unseen keys: stores must not change at all
+    unseen = np.unique((rng.randint(0, 1 << 20, 50)
+                        + (9 << 40)).astype(np.uint64))
+    t.set_test_mode(True)
+    t.begin_feed_pass()
+    t.add_keys(np.concatenate([passes[0][:50], unseen]))
+    t.end_feed_pass()
+    eval_slabs = t.build_slabs()
+    t.write_back(eval_slabs + 1.0)  # must be ignored in test mode
+    t.set_test_mode(False)
+    assert [len(st) for st in t.stores] == sizes
+    for (k0, v0), st in zip(items, t.stores):
+        k1, v1 = sorted_store_items(st)
+        np.testing.assert_array_equal(k0, k1)
+        np.testing.assert_array_equal(v0, v1)
+
+
+def test_preloaded_incremental_matches_sequential_full(incremental_flag,
+                                                       tmp_path):
+    """End-to-end: run_preloaded_passes with the incremental lifecycle
+    (+ promote prefetch thread) must produce the same losses as plain
+    sequential passes with the lifecycle OFF — the whole stack (trainer
+    staging, scan path, preloader, writeback) rides the same bits."""
+    from paddlebox_tpu.config.configs import TrainerConfig
+    from paddlebox_tpu.data import BoxDataset, write_synthetic_ctr_files
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.models.base import ModelSpec
+    from paddlebox_tpu.train.preload import run_preloaded_passes
+    from paddlebox_tpu.train.trainer import BoxTrainer
+
+    num_slots = 4
+    files, feed = write_synthetic_ctr_files(
+        str(tmp_path), num_files=2, lines_per_file=160, num_slots=num_slots,
+        vocab_per_slot=60, max_len=3, seed=21)
+    feed = type(feed)(slots=feed.slots, batch_size=32)
+    spec = ModelSpec(num_slots=num_slots, slot_dim=3 + D)
+    flags.set_flag("dataset_disable_shuffle", True)
+    try:
+        def datasets(n):
+            out = []
+            for _ in range(n):
+                ds = BoxDataset(feed, read_threads=1)
+                ds.set_filelist(files)
+                out.append(ds)
+            return out
+
+        flags.set_flag("incremental_pass", False)
+        seq = BoxTrainer(CtrDnn(spec, hidden=(16,)), table_cfg(), feed,
+                         TrainerConfig(dense_lr=0.01), seed=0)
+        seq_losses = [seq.train_pass(ds)["loss"] for ds in datasets(3)]
+        sk, sv = sorted_store_items(seq.table.store)
+
+        flags.set_flag("incremental_pass", True)
+        pipe = BoxTrainer(CtrDnn(spec, hidden=(16,)), table_cfg(), feed,
+                          TrainerConfig(dense_lr=0.01), seed=0)
+        stats = run_preloaded_passes(pipe, datasets(3))
+        np.testing.assert_allclose([s["loss"] for s in stats], seq_losses,
+                                   rtol=1e-6)
+        pk, pv = sorted_store_items(pipe.table.store)
+        np.testing.assert_array_equal(sk, pk)
+        np.testing.assert_array_equal(sv, pv)
+    finally:
+        flags.set_flag("dataset_disable_shuffle", False)

@@ -1,0 +1,131 @@
+"""Pass-cadence regression probe (round-6, same pattern as
+tools/staged_regression_probe.py).
+
+Measures the begin_pass/end_pass wall clock of ONE PassTable at a
+configurable slab size and working-set overlap ratio, for both the full
+lifecycle and the incremental (delta promote + touched-row writeback)
+lifecycle, and FAILS LOUDLY on regression vs recorded floors:
+
+  * full_lifecycle_rows_per_sec / delta_lifecycle_rows_per_sec — rows of
+    the working set divided by (begin + end) seconds, floors at ~40% of
+    the recorded quiet-box rates (low enough to ride out container
+    noise, high enough to catch an algorithmic regression — the full
+    path re-promoting everything through the delta machinery would blow
+    straight through them).
+  * delta_speedup_at_overlap — delta (begin+end) must stay faster than
+    the full lifecycle at the probed overlap; losing this means the
+    incremental path silently degenerated into a rebuild.
+
+Prints one JSON line per stage with ok=true/false; exits 1 if any fails.
+Usage: timeout 900 python -u tools/pass_cadence_probe.py [rows] [overlap]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+# (recorded quiet-box rate AT THIS PROBE'S OWN WORKLOAD — round-6 first
+# run, 2026-08-03, container CPU, 256k rows @ 0.9 overlap, 10% touched:
+# full begin+end ≈ 349 ms, delta ≈ 149 ms — floor = ~40% of the recorded
+# rate. The container is load-noisy (±30%+); the speedup ratio floor is
+# deliberately low so only a real degeneration trips it.)
+FLOORS = {
+    "full_lifecycle_rows_per_sec": (751e3, 300e3),
+    "delta_lifecycle_rows_per_sec": (1.76e6, 700e3),
+    "delta_speedup_at_overlap": (2.34, 1.25),
+}
+
+failures = []
+
+
+def report(stage, rate):
+    rec, floor = FLOORS[stage]
+    ok = rate >= floor
+    if not ok:
+        failures.append(stage)
+    print(json.dumps({"stage": stage, "rate": round(float(rate), 2),
+                      "recorded": rec, "floor": floor, "ok": ok}),
+          flush=True)
+
+
+def lifecycle_seconds(rows, overlap, incremental, touched_frac=0.1,
+                      passes=6, warm_from=2, seed=0):
+    """Mean (begin+end) seconds of the warm passes (the first `warm_from`
+    are cold build + jit-bucket compiles and are excluded). Marks a FIXED
+    count of touched rows per pass via lookup_ids, like a real pass's
+    staging would — fixed so the harness's own mutation never recompiles."""
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.config import flags
+    from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
+                                              TableConfig)
+    from paddlebox_tpu.embedding.pass_table import PassTable
+
+    flags.set_flag("incremental_pass", incremental)
+    cap = 1
+    while cap < rows * 2:
+        cap <<= 1
+    table = PassTable(TableConfig(
+        embedx_dim=8, pass_capacity=cap,
+        optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                        mf_initial_range=1e-3)), seed=seed)
+    rng = np.random.RandomState(seed)
+    cur = np.unique(rng.randint(0, 1 << 40, rows).astype(np.uint64))
+    times = []
+    for p in range(passes):
+        t0 = time.perf_counter()
+        table.begin_feed_pass()
+        table.add_keys(cur)
+        table.end_feed_pass()
+        table.begin_pass()
+        np.asarray(table.slab[0, 0:1])  # sync the promote
+        t1 = time.perf_counter()
+        # a real pass pulls/pushes a subset: mark it touched and mutate
+        # those device rows so end_pass has real delta work to do
+        n_touch = max(1, min(int(rows * touched_frac), cur.size))
+        sub = cur[rng.choice(cur.size, n_touch, replace=False)]
+        ids = table.lookup_ids(sub)
+        table.set_slab(table.slab.at[jnp.asarray(ids)].add(0.5))
+        np.asarray(table.slab[0, 0:1])  # keep the mutation out of `end`
+        t2 = time.perf_counter()
+        table.end_pass()
+        t3 = time.perf_counter()
+        if p >= warm_from:
+            times.append((t1 - t0) + (t3 - t2))
+        keep = rng.rand(cur.size) < overlap
+        fresh = np.unique(rng.randint(
+            0, 1 << 40, max(1, int(rows * (1 - overlap)))).astype(np.uint64))
+        cur = np.unique(np.concatenate([cur[keep], fresh]))
+    table.invalidate_residency()
+    return float(np.mean(times))
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 18
+    overlap = float(sys.argv[2]) if len(sys.argv) > 2 else 0.9
+    from paddlebox_tpu.config import flags
+    saved = flags.get_flag("incremental_pass")
+    try:
+        full_s = lifecycle_seconds(rows, overlap, incremental=False)
+        delta_s = lifecycle_seconds(rows, overlap, incremental=True)
+    finally:
+        flags.set_flag("incremental_pass", saved)
+    print(json.dumps({"rows": rows, "overlap": overlap,
+                      "full_begin_end_ms": round(full_s * 1e3, 2),
+                      "delta_begin_end_ms": round(delta_s * 1e3, 2)}),
+          flush=True)
+    report("full_lifecycle_rows_per_sec", rows / full_s)
+    report("delta_lifecycle_rows_per_sec", rows / delta_s)
+    report("delta_speedup_at_overlap", full_s / delta_s)
+    if failures:
+        print(json.dumps({"failed": failures}), flush=True)
+        sys.exit(1)
+    print(json.dumps({"all_ok": True}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
