@@ -22,3 +22,4 @@ Layer map (TPU-native analog of reference SURVEY.md §1):
 from paddlebox_tpu.version import __version__
 
 from paddlebox_tpu.config import flags  # noqa: F401
+from paddlebox_tpu.utils import compat  # noqa: F401  (jax.shard_map alias)

@@ -125,12 +125,12 @@ class ShufflerBase:
         self.rank = rank
         self.world = world
         self.batch_records = batch_records
-        self._out: List[List[SlotRecord]] = [[] for _ in range(world)]
+        self._out: List[List[SlotRecord]] = [[] for _ in range(world)]  # guarded-by: _out_lock
         self._out_lock = threading.Lock()
         # pass epoch: frames are tagged so a fast peer's next-pass records
         # can't leak into this rank's still-draining current pass
         self.epoch = 0
-        self._inbox: Dict[int, List[SlotRecord]] = {}
+        self._inbox: Dict[int, List[SlotRecord]] = {}  # guarded-by: _inbox_lock
         self._inbox_lock = threading.Lock()
         self._done_from: Dict[int, set] = {}
         self._done_cv = threading.Condition()
@@ -256,7 +256,10 @@ _MSG_DONE = 1
 _HDR = struct.Struct("<IIII")  # type, src_rank, epoch, payload_len
 
 
-class TcpShuffler(ShufflerBase):
+# shared record state (_out/_inbox) is annotated on ShufflerBase; the
+# per-destination _dest_locks list guards one socket each, which the
+# one-lock-attr guarded-by convention cannot express
+class TcpShuffler(ShufflerBase):  # boxlint: disable=BX403
     """Framed point-to-point shuffle over TCP between hosts.
 
     endpoints[i] = (host, port) of rank i's listener. Connections are

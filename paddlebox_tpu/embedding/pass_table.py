@@ -193,7 +193,11 @@ class PassTable:
                                   expand_dim=table.expand_embed_dim)
         self.push_layout = PushLayout(table.embedx_dim,
                                       table.expand_embed_dim)
-        self.store = store or make_host_store(self.layout, table, seed)
+        # store contents move under concurrent access (native arena rows
+        # relocate on spill/resize) — every touch while a PromotePrefetcher
+        # can be live holds store_lock; lock-free boundary sites carry an
+        # explicit boxlint disable with their single-threaded rationale
+        self.store = store or make_host_store(self.layout, table, seed)  # guarded-by: store_lock
         self.capacity = table.pass_capacity
         self._feed_keys: list = []
         self._pass_keys: Optional[np.ndarray] = None  # sorted unique
@@ -492,7 +496,9 @@ class PassTable:
         if (not flags.get_flag("incremental_pass")
                 or not flags.get_flag("preload_promote")
                 or self._test_mode
-                or not hasattr(self.store, "lookup_present")
+                # capability probe, no store mutation; no prefetcher is
+                # live before this ctx is handed out
+                or not hasattr(self.store, "lookup_present")  # boxlint: disable=BX401
                 or self._pass_keys is None or self._pass_keys.size == 0):
             return None
         # NOTE: the closure diffs against the numpy snapshot, NOT the
@@ -504,7 +510,9 @@ class PassTable:
         def known(keys: np.ndarray) -> np.ndarray:
             return sorted_member(snapshot, keys)[1]
 
-        return known, self.store, self.store_lock
+        # handing the ref out, not touching contents: the prefetcher's
+        # own accesses are the locked ones (preload.PromotePrefetcher)
+        return known, self.store, self.store_lock  # boxlint: disable=BX401
 
     def accept_staged_rows(self, keys: np.ndarray, rows: np.ndarray) -> None:
         """Install the promote stager's prefetched (key, row) pairs for the
@@ -663,10 +671,12 @@ class PassTable:
                 self.store.tick_spill_age()
         return self.shrink_table()
 
-    def save(self, path: str) -> None:
+    # checkpoint boundary: the driver serializes save/load against passes,
+    # so no prefetch thread can be live here
+    def save(self, path: str) -> None:  # boxlint: disable=BX401
         self.store.save(path)
 
-    def load(self, path: str) -> None:
+    def load(self, path: str) -> None:  # boxlint: disable=BX401
         self.invalidate_residency()
         self.store.load(path)
 
@@ -674,7 +684,8 @@ class PassTable:
         """LoadSSD2Mem (box_wrapper.cc:1319): promote every spilled row
         back to DRAM — the explicit warm-up after a model load, before the
         day's first feed pass. Returns rows promoted."""
-        if hasattr(self.store, "load_spilled"):
+        # load boundary, same single-threaded window as load()
+        if hasattr(self.store, "load_spilled"):  # boxlint: disable=BX401
             self.invalidate_residency()  # fault-in applies missed days
-            return self.store.load_spilled()
+            return self.store.load_spilled()  # boxlint: disable=BX401
         return 0

@@ -39,7 +39,7 @@ class Communicator:
         self.push_width = push_width
         self.threshold = send_batch_threshold
         self.interval = send_interval
-        self._pending: List[Tuple[np.ndarray, np.ndarray]] = []
+        self._pending: List[Tuple[np.ndarray, np.ndarray]] = []  # guarded-by: _lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._kick = threading.Event()
@@ -93,7 +93,7 @@ class PullDenseWorker:
         self.client = client
         self.name = name
         self.interval = interval
-        self._value = client.pull_dense(name)
+        self._value = client.pull_dense(name)  # guarded-by: _lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)

@@ -31,15 +31,15 @@ class AsyncDenseTable:
                  eps: float = 1e-8,
                  summary_mask: Optional[np.ndarray] = None,
                  merge_limit: int = 4) -> None:
-        self._params = np.array(init_params, dtype=np.float32)
-        self._mom1 = np.zeros_like(self._params)
-        self._mom2 = np.zeros_like(self._params)
+        self._params = np.array(init_params, dtype=np.float32)  # guarded-by: _lock
+        self._mom1 = np.zeros_like(self._params)  # guarded-by: _lock
+        self._mom2 = np.zeros_like(self._params)  # guarded-by: _lock
         self.lr, self.beta1, self.beta2, self.eps = lr, beta1, beta2, eps
         # True where the param is a data-norm summary stat: plain += grad
         self._summary = (summary_mask.astype(bool)
                          if summary_mask is not None else None)
         self.merge_limit = merge_limit
-        self._t = 0
+        self._t = 0  # guarded-by: _lock
         self._lock = threading.Lock()
         self._queue: "queue.Queue[Optional[np.ndarray]]" = queue.Queue()
         self._thread = threading.Thread(target=self._update_loop, daemon=True)
@@ -58,7 +58,8 @@ class AsyncDenseTable:
 
     @property
     def steps_applied(self) -> int:
-        return self._t
+        with self._lock:
+            return self._t
 
     def wait_drained(self, timeout: float = 60.0) -> None:
         """Block until every queued grad has been applied."""

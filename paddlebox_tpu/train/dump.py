@@ -44,7 +44,7 @@ class DumpWriter:
             threading.Thread(target=self._writer_loop, args=(i,), daemon=True)
             for i in range(max(1, thread_num))
         ]
-        self.files: List[str] = []
+        self.files: List[str] = []  # guarded-by: _files_lock
         self._files_lock = threading.Lock()
         for t in self._threads:
             t.start()

@@ -44,7 +44,10 @@ class PromotePrefetcher:
 
     def __init__(self, known_fn, store, lock: threading.Lock) -> None:
         self._known = known_fn
-        self._store = store
+        # the table's store_lock: every store touch from this worker must
+        # hold it or race the current pass's end_pass writeback (round-6
+        # serialization claim, machine-checked by boxlint BX401)
+        self._store = store  # guarded-by: _lock
         self._lock = lock
         self._q: "queue.Queue" = queue.Queue()
         # sorted accumulated candidate set — the dedup stays in numpy

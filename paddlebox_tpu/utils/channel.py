@@ -23,11 +23,11 @@ class Channel(Generic[T]):
     def __init__(self, capacity: int = 0) -> None:
         # capacity 0 = unbounded (like default ChannelObject)
         self._capacity = capacity
-        self._deque: collections.deque = collections.deque()
+        self._deque: collections.deque = collections.deque()  # guarded-by: _mutex
         self._mutex = threading.Lock()
         self._not_empty = threading.Condition(self._mutex)
         self._not_full = threading.Condition(self._mutex)
-        self._closed = False
+        self._closed = False  # guarded-by: _mutex
 
     # -- producer side -----------------------------------------------------
     def put(self, item: T) -> None:
@@ -98,4 +98,5 @@ class Channel(Generic[T]):
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        with self._mutex:
+            return self._closed

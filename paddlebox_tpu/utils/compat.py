@@ -1,0 +1,49 @@
+"""JAX version-compat shims.
+
+The repo is written against the modern ``jax.shard_map`` spelling
+(jax >= 0.6); on the 0.4.x line the same function lives at
+``jax.experimental.shard_map.shard_map``. Importing this module resolves
+``shard_map`` to whichever exists and — when the top-level name is
+missing — installs the alias on the ``jax`` module so every
+``jax.shard_map(...)`` call site (package, tests, examples) works
+unchanged on both lines. Imported from ``paddlebox_tpu/__init__.py`` so
+the alias exists before any trainer module needs it.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # jax 0.4.x: experimental home; the replication
+    # checker kwarg is spelled check_rep there (check_vma today)
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map_04
+
+    @functools.wraps(_shard_map_04)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_04(*args, **kwargs)
+
+    jax.shard_map = shard_map
+
+if not hasattr(jax.lax, "pcast"):
+    # modern jax: pcast moves values between replicated and
+    # device-varying *types*; data is unchanged. 0.4.x has no
+    # varying-manual type system, so the identity is exact.
+    def pcast(x, axis_name=None, *, to=None):
+        return x
+
+    jax.lax.pcast = pcast
+
+try:
+    axis_size = jax.lax.axis_size
+except AttributeError:  # jax 0.4.x spelling: psum of the literal 1 is
+    # constant-folded to the axis size (a static int, no collective)
+    def axis_size(axis_name):
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = axis_size

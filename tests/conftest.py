@@ -37,3 +37,10 @@ def _flag_isolation():
     for name, value in snapshot.items():
         if _f.get_flag(name) != value:
             _f.set_flag(name, value)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the budgeted tier-1 run (-m 'not slow'); "
+        "runs in the slow-inclusive suite and on TPU windows")

@@ -1,0 +1,469 @@
+"""boxlint: fixture unit tests for all four passes + the tier-1 gate.
+
+The gate test at the bottom is the point of the whole tool: it runs the
+real checker over the real tree against the committed baseline, so any
+NEW violation of the jit-purity / collective-axis / flag-hygiene /
+lock-discipline invariants fails tier-1 — the mechanical replacement for
+the reference's static-graph checks, gflags registry, and NCCL comm
+groups (ARCHITECTURE.md "Enforced invariants").
+
+These tests are pure-stdlib (ast only): no jax import, no devices.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.boxlint.core import (
+    SourceFile, Violation, diff_against_baseline, format_baseline,
+    load_baseline, load_tree, run_passes,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_snippet(tmp_path, code, passes, name="snippet.py", extra=()):
+    """Run selected passes over an inline fixture; returns violations."""
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(code))
+    paths = [str(p)] + [str(e) for e in extra]
+    files, errors = load_tree(paths, root=str(tmp_path))
+    assert not errors, errors
+    return run_passes(files, passes)
+
+
+def codes(violations):
+    return [v.code for v in violations]
+
+
+# ---------------------------------------------------------------- purity
+
+PURE_FIXTURE = """
+    import jax, jax.numpy as jnp, numpy as np
+    from jax import lax
+
+    @jax.jit
+    def step(x, y):
+        z = jnp.dot(x, y)
+        u = jnp.unique(z, size=8, fill_value=0)
+        host_n = int(x.shape[0])            # static: fine
+        return z * host_n + u.sum()
+"""
+
+IMPURE_FIXTURE = """
+    import jax, jax.numpy as jnp, numpy as np
+    from jax import lax
+
+    @jax.jit
+    def step(x, y):
+        v = x.sum().item()                  # BX101
+        print(v)                            # BX101
+        f = float(x)                        # BX102
+        a = np.maximum(x, 0)                # BX103
+        u = jnp.unique(y)                   # BX104
+        m = x[y > 0]                        # BX105
+        return v, f, a, u, m
+
+    def helper(z):
+        return z.item()                     # BX101 via the call graph
+
+    @jax.jit
+    def outer(z):
+        return helper(z)
+
+    def scan_body(carry, t):
+        jax.device_get(carry)               # BX101 via lax.scan seeding
+        return carry, t
+
+    def run(xs):
+        return lax.scan(scan_body, 0.0, xs)
+"""
+
+
+def test_purity_clean_fixture(tmp_path):
+    assert lint_snippet(tmp_path, PURE_FIXTURE, ["purity"]) == []
+
+
+def test_purity_flags_all_codes(tmp_path):
+    got = codes(lint_snippet(tmp_path, IMPURE_FIXTURE, ["purity"]))
+    for expect in ("BX101", "BX102", "BX103", "BX104", "BX105"):
+        assert expect in got, (expect, got)
+    # transitive: helper reached from the jitted outer, body from lax.scan
+    assert got.count("BX101") >= 4
+
+
+def test_purity_ignores_host_code(tmp_path):
+    got = lint_snippet(tmp_path, """
+        import numpy as np
+
+        def host_only(x):
+            return float(np.asarray(x).sum())   # never traced: fine
+    """, ["purity"])
+    assert got == []
+
+
+def test_purity_excludes_callback_bodies(tmp_path):
+    got = lint_snippet(tmp_path, """
+        import jax, jax.numpy as jnp, numpy as np
+
+        @jax.jit
+        def step(x):
+            def on_host(v):
+                return np.asarray(v).sum()      # host by contract: fine
+            return jax.pure_callback(on_host, x, x)
+    """, ["purity"])
+    assert got == []
+
+
+def test_purity_control_flow_wrappers_seed_correct_args(tmp_path):
+    # fori_loop's body is args[2], cond's branches are args[1:2] — and the
+    # bound/predicate args must NOT be seeded as traced functions
+    got = lint_snippet(tmp_path, """
+        from jax import lax
+
+        def body(i, c):
+            return c + c.item()            # BX101 (fori_loop body)
+
+        def on_true(x):
+            return x.item()                # BX101 (cond branch)
+
+        def pred(x):
+            return float(x) > 0            # host predicate: NOT seeded
+
+        def run(x):
+            y = lax.fori_loop(0, 10, body, x)
+            z = lax.cond(True, on_true, lambda v: v, y)
+            return lax.switch(0, [on_true, lambda v: v], z)
+    """, ["purity"])
+    assert codes(got) == ["BX101", "BX101"]
+    assert all("pred" not in v.message for v in got)
+
+
+# ------------------------------------------------------------ collectives
+
+def test_collective_axis_known_vs_unknown(tmp_path):
+    got = lint_snippet(tmp_path, """
+        import jax, numpy as np
+        from jax import lax
+        from jax.sharding import Mesh
+
+        BOX_AXIS = "dp"
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+        def inside(x):
+            good = lax.psum(x, "dp")
+            also_good = lax.pmean(x, BOX_AXIS)
+            bad = lax.psum(x, "dpp")            # BX201 (typo'd axis)
+            return good + also_good + bad
+    """, ["collectives"])
+    assert codes(got) == ["BX201"]
+    assert "dpp" in got[0].message
+
+
+def test_collective_axis_dynamic_is_trusted(tmp_path):
+    got = lint_snippet(tmp_path, """
+        from jax import lax
+
+        class T:
+            def step(self, x, mesh):
+                a = lax.psum(x, self.axis)           # dynamic: trusted
+                b = lax.pmean(x, mesh.axis_names[0])  # dynamic: trusted
+                return a + b
+    """, ["collectives"])
+    assert got == []
+
+
+def test_collective_axis_default_param_resolves(tmp_path):
+    got = lint_snippet(tmp_path, """
+        from jax import lax
+        from jax.sharding import Mesh
+        import numpy as np
+
+        mesh = Mesh(np.empty(1, object), ("dp",))
+
+        def f(x, axis="nope"):              # BX201: default resolves
+            return lax.all_gather(x, axis, tiled=True)
+    """, ["collectives"])
+    assert codes(got) == ["BX201"]
+
+
+def test_repo_axis_vocabulary_includes_mesh_axes():
+    from tools.boxlint.collectives import collect_axis_vocabulary
+    files, _ = load_tree([os.path.join(REPO, "paddlebox_tpu")], root=REPO)
+    vocab = collect_axis_vocabulary(files)
+    # the canonical axes from parallel/mesh.py must all be declared
+    assert {"dp", "node", "data", "model", "pipeline"} <= vocab
+
+
+# ----------------------------------------------------------------- flags
+
+FLAGS_DECL = """
+    def define_flag(name, default, help=""):
+        pass
+
+    define_flag("alive", 1, "read somewhere")
+    define_flag("dead_flag", 0, "never read")        # BX302
+    define_flag("helpless", 0)                        # BX303
+    define_flag("alive", 2, "dup")                    # BX304
+"""
+
+FLAGS_READER = """
+    from config import flags
+
+    def use():
+        a = flags.get_flag("alive")
+        b = flags.get_flag("mystery")                 # BX301
+        return a, b
+"""
+
+
+def test_flags_all_codes(tmp_path):
+    cfg = tmp_path / "config"
+    cfg.mkdir()
+    (cfg / "flags.py").write_text(textwrap.dedent(FLAGS_DECL))
+    (tmp_path / "reader.py").write_text(textwrap.dedent(FLAGS_READER))
+    files, errors = load_tree([str(tmp_path)], root=str(tmp_path))
+    assert not errors
+    got = run_passes(files, ["flags"])
+    by_code = {c: [v for v in got if v.code == c]
+               for c in ("BX301", "BX302", "BX303", "BX304")}
+    assert len(by_code["BX301"]) == 1 and "mystery" in by_code["BX301"][0].message
+    assert {"dead_flag", "helpless"} == {
+        v.message.split("'")[1] for v in by_code["BX302"]}
+    assert len(by_code["BX303"]) == 1 and "helpless" in by_code["BX303"][0].message
+    assert len(by_code["BX304"]) == 1
+
+
+def test_flags_silent_without_registry_file(tmp_path):
+    # linting a subtree that lacks config/flags.py must not fabricate
+    # BX301 for every read
+    got = lint_snippet(tmp_path, """
+        from paddlebox_tpu.config import flags
+
+        def f():
+            return flags.get_flag("whatever")
+    """, ["flags"])
+    assert got == []
+
+
+def test_repo_flags_registry_is_clean():
+    files, _ = load_tree([os.path.join(REPO, "paddlebox_tpu"),
+                          os.path.join(REPO, "tools")], root=REPO)
+    assert run_passes(files, ["flags"]) == []
+
+
+# ----------------------------------------------------------------- locks
+
+LOCKS_FIXTURE = """
+    import threading
+
+    class Shared:
+        def __init__(self):
+            self._q = []          # guarded-by: _lock
+            self._lock = threading.Lock()
+            self._t = threading.Thread(target=self.worker)
+
+        def worker(self):
+            with self._lock:
+                self._q.append(1)          # locked: fine
+
+        def racy(self):
+            return len(self._q)            # BX401
+
+        def boundary(self):  # boxlint: disable=BX401
+            return list(self._q)           # suppressed: fine
+"""
+
+
+def test_lock_discipline(tmp_path):
+    got = lint_snippet(tmp_path, LOCKS_FIXTURE, ["locks"])
+    assert codes(got) == ["BX401"]
+    assert "racy" not in got[0].message  # message names class.attr
+    assert "_q" in got[0].message and "_lock" in got[0].message
+
+
+def test_lock_stale_annotation(tmp_path):
+    got = lint_snippet(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._x = 0       # guarded-by: _gone
+                self._t = threading.Thread(target=None)
+    """, ["locks"])
+    assert codes(got) == ["BX402"]
+
+
+def test_lock_unannotated_thread_class(tmp_path):
+    got = lint_snippet(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._t = threading.Thread(target=None)
+    """, ["locks"])
+    assert codes(got) == ["BX403"]
+
+
+def test_lock_with_inside_except_handler_is_seen(tmp_path):
+    # ExceptHandler is not an ast.stmt: the walk must still recurse into
+    # it statement-wise or a correctly-locked access spuriously flags
+    got = lint_snippet(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._d = []  # guarded-by: _lock
+                self._lock = threading.Lock()
+                self._t = threading.Thread(target=None)
+
+            def m(self):
+                try:
+                    x = 1
+                except Exception:
+                    with self._lock:
+                        self._d.append(1)      # locked: fine
+                match x:
+                    case 1:
+                        with self._lock:
+                            self._d.append(2)  # locked: fine
+                    case _:
+                        return len(self._d)    # BX401
+    """, ["locks"])
+    assert codes(got) == ["BX401"]
+
+
+def test_lock_init_is_exempt(tmp_path):
+    got = lint_snippet(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._x = 0       # guarded-by: _lock
+                self._lock = threading.Lock()
+                self._x = 1       # later in __init__: still exempt
+                self._t = threading.Thread(target=None)
+    """, ["locks"])
+    assert got == []
+
+
+# ---------------------------------------------------- suppression syntax
+
+def test_line_suppression(tmp_path):
+    got = lint_snippet(tmp_path, """
+        import jax
+
+        @jax.jit
+        def step(x):
+            v = x.sum().item()   # boxlint: disable=BX101
+            w = x.min().item()   # boxlint: disable
+            return v + w
+    """, ["purity"])
+    assert got == []
+
+
+def test_suppression_is_code_specific(tmp_path):
+    got = lint_snippet(tmp_path, """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.sum().item()   # boxlint: disable=BX999
+    """, ["purity"])
+    assert codes(got) == ["BX101"]
+
+
+# ------------------------------------------------------ baseline machinery
+
+def test_baseline_roundtrip(tmp_path):
+    vs = [Violation("a.py", 3, "BX101", "host sync"),
+          Violation("b.py", 7, "BX301", "mystery flag")]
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(format_baseline(vs))
+    entries = load_baseline(str(bl))
+    assert len(entries) == 2
+    # same violations at DIFFERENT lines still match (line-drift immunity)
+    moved = [Violation("a.py", 30, "BX101", "host sync"),
+             Violation("b.py", 1, "BX301", "mystery flag")]
+    new, stale = diff_against_baseline(moved, entries)
+    assert new == [] and stale == []
+    # a genuinely new violation surfaces; a fixed one reports stale
+    new, stale = diff_against_baseline(
+        moved + [Violation("c.py", 1, "BX104", "fresh")], entries)
+    assert [v.code for v in new] == ["BX104"]
+    new, stale = diff_against_baseline(moved[:1], entries)
+    assert new == [] and len(stale) == 1
+
+
+def test_baseline_multiset_counts(tmp_path):
+    # two identical violations need two baseline entries
+    v = Violation("a.py", 1, "BX101", "dup site")
+    bl = tmp_path / "b.txt"
+    bl.write_text(format_baseline([v]))
+    new, _ = diff_against_baseline([v, Violation("a.py", 9, "BX101",
+                                                 "dup site")],
+                                   load_baseline(str(bl)))
+    assert len(new) == 1
+
+
+# ------------------------------------------------------------ CLI contract
+
+def run_cli(args, cwd=REPO):
+    return subprocess.run([sys.executable, "-m", "tools.boxlint"] + args,
+                          cwd=cwd, capture_output=True, text=True)
+
+
+def test_cli_exit_0_clean_tree():
+    r = run_cli(["paddlebox_tpu/", "tools/"])
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_exit_1_on_new_violation(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.item()
+    """))
+    r = run_cli([str(bad)])
+    assert r.returncode == 1
+    assert "BX101" in r.stdout
+
+
+def test_cli_exit_2_on_bad_args():
+    r = run_cli(["--passes", "nonsense", "paddlebox_tpu/"])
+    assert r.returncode == 2
+
+
+def test_cli_fix_baseline_roundtrip(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n\n@jax.jit\ndef f(x):\n    return x.item()\n")
+    bl = tmp_path / "bl.txt"
+    r = run_cli(["--baseline", str(bl), "--fix-baseline", str(bad)])
+    assert r.returncode == 0 and bl.exists()
+    # gate is green against the fresh baseline, red without it
+    assert run_cli(["--baseline", str(bl), str(bad)]).returncode == 0
+    assert run_cli(["--no-baseline", str(bad)]).returncode == 1
+
+
+# ------------------------------------------------------------ the gate
+
+def test_boxlint_gate_no_new_violations():
+    """Tier-1 gate: the real tree lints clean against the committed
+    baseline. A failure here means a NEW invariant violation (or a fix
+    that should shrink baseline.txt via --fix-baseline)."""
+    files, errors = load_tree([os.path.join(REPO, "paddlebox_tpu"),
+                               os.path.join(REPO, "tools")], root=REPO)
+    assert not errors, [e.render() for e in errors]
+    violations = run_passes(files)
+    baseline = load_baseline(os.path.join(REPO, "tools", "boxlint",
+                                          "baseline.txt"))
+    new, _stale = diff_against_baseline(violations, baseline)
+    assert not new, "NEW boxlint violations:\n" + "\n".join(
+        v.render() for v in new)

@@ -41,17 +41,29 @@ def test_train_pipeline_example():
     assert "features trained" in out
 
 
+# tier-1 budget: flag/mesh/expand variant of a base example that
+# still runs above; the variant runs in the slow-inclusive suite
+# and on TPU windows
+@pytest.mark.slow
 def test_train_sharded_example_2d_mesh_flags():
     out = run_example("train_sharded.py", "--passes", "1", "--mesh-2d", "2",
                       "--a2a-dtype", "bfloat16", "--device-auc")
     assert "streaming AUC" in out
 
 
+# tier-1 budget: flag/mesh/expand variant of a base example that
+# still runs above; the variant runs in the slow-inclusive suite
+# and on TPU windows
+@pytest.mark.slow
 def test_train_ctr_example_expand():
     out = run_example("train_ctr.py", "--passes", "1", "--expand-dim", "4")
     assert "streaming AUC" in out
 
 
+# tier-1 budget: flag/mesh/expand variant of a base example that
+# still runs above; the variant runs in the slow-inclusive suite
+# and on TPU windows
+@pytest.mark.slow
 def test_train_ctr_example_perf_knobs():
     # the round-4 throughput knobs must stay wired to the public example
     out = run_example("train_ctr.py", "--passes", "1", "--push-write",

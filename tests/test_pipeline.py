@@ -345,6 +345,12 @@ def test_ctr_pipeline_dp_composition_matches_oracle(tmp_path):
                                rtol=2e-4, atol=1e-6)
 
 
+# tier-1 budget: the capability this composes is covered by its own
+# dedicated suite (expand: test_expand_e2e, multi-task:
+# test_multitask_labels, data_norm: test_data_norm_e2e, metrics:
+# test_metrics); the through-the-pipe composition runs in the
+# slow-inclusive suite and on TPU windows
+@pytest.mark.slow
 def test_ctr_pipeline_expand_oracle_and_sharded_parity(tmp_path):
     """Expand (NN-cross) through the pipeline (the round-3 'explicitly
     rejected' edge): one pipelined step with the dual-output extended
@@ -466,6 +472,12 @@ def test_ctr_pipeline_expand_oracle_and_sharded_parity(tmp_path):
     np.testing.assert_allclose(sv[so], rv[ro], rtol=2e-4, atol=1e-6)
 
 
+# tier-1 budget: the capability this composes is covered by its own
+# dedicated suite (expand: test_expand_e2e, multi-task:
+# test_multitask_labels, data_norm: test_data_norm_e2e, metrics:
+# test_metrics); the through-the-pipe composition runs in the
+# slow-inclusive suite and on TPU windows
+@pytest.mark.slow
 def test_ctr_pipeline_multi_task(tmp_path):
     """ESMM-style multi-task through the pipeline: the last stage's head
     emits T logits per instance trained on per-task labels. One
@@ -564,6 +576,12 @@ def test_ctr_pipeline_multi_task(tmp_path):
     assert msg["size"] > 0      # the cvr column streamed
 
 
+# tier-1 budget: the capability this composes is covered by its own
+# dedicated suite (expand: test_expand_e2e, multi-task:
+# test_multitask_labels, data_norm: test_data_norm_e2e, metrics:
+# test_metrics); the through-the-pipe composition runs in the
+# slow-inclusive suite and on TPU windows
+@pytest.mark.slow
 def test_ctr_pipeline_data_norm(tmp_path):
     """data_norm through the pipeline: stage 0 normalizes its projection
     input by the running summaries, which update by the running-sums
@@ -750,6 +768,12 @@ def test_sharded_ctr_pipeline_dp_composition(tmp_path):
     np.testing.assert_allclose(sv[so], rv[ro], rtol=2e-4, atol=1e-6)
 
 
+# tier-1 budget: the capability this composes is covered by its own
+# dedicated suite (expand: test_expand_e2e, multi-task:
+# test_multitask_labels, data_norm: test_data_norm_e2e, metrics:
+# test_metrics); the through-the-pipe composition runs in the
+# slow-inclusive suite and on TPU windows
+@pytest.mark.slow
 def test_pipeline_metrics_and_eval(tmp_path):
     """Both pipeline runners stream training predictions into the metric
     registry (Metric::add_data role) and serve test-mode inference

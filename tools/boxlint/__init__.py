@@ -1,0 +1,38 @@
+"""boxlint: repo-specific AST invariant checker.
+
+The reference enforced this repo's load-bearing invariants mechanically —
+the static graph verified op purity at build time, gflags collected every
+flag into one registry (flags.cc), NCCL comm groups type-checked collective
+membership, and C++ lock types documented which mutex guards which member.
+The JAX port replaces all four mechanisms with conventions, and conventions
+drift. boxlint is the lint gate that makes them mechanical again:
+
+  BX1xx  jit-purity / static-shape: functions reachable from jax.jit /
+         shard_map / lax.scan entry points must not host-sync (.item(),
+         float()/int() on traced values, np.* on traced data,
+         jax.device_get, print) or build data-dependent shapes
+         (jnp.unique / nonzero without size=, boolean-mask indexing).
+  BX2xx  collective-axis contracts: every lax.psum / all_to_all / ppermute
+         / all_gather / pmean axis name must resolve to an axis declared
+         by a Mesh / shard_map / PartitionSpec somewhere in the tree
+         (parallel/mesh.py is the canonical declaration site).
+  BX3xx  flag-registry hygiene: every flags.get_flag("x") resolves to a
+         define_flag in config/flags.py, every declared flag is read
+         somewhere, help strings are non-empty, env names are unique.
+  BX4xx  lock discipline: attributes annotated ``# guarded-by: <lock>``
+         must only be touched inside ``with self.<lock>:`` (outside
+         __init__); deliberate lock-free boundary accesses carry an
+         inline ``# boxlint: disable=BX401`` with a rationale.
+
+Suppression: ``# boxlint: disable=BX101[,BX102]`` (or a bare ``disable``)
+on the offending line, or on a ``def``/``class`` line to cover the whole
+body. Pre-existing violations live in tools/boxlint/baseline.txt; the gate
+(tests/test_boxlint.py) fails only on NEW violations.
+
+CLI: ``python -m tools.boxlint [--baseline FILE] [--fix-baseline] PATH...``
+"""
+
+from tools.boxlint.core import (  # noqa: F401
+    Violation, SourceFile, load_tree, run_passes, load_baseline,
+    diff_against_baseline, format_baseline, ALL_PASSES,
+)

@@ -1,0 +1,129 @@
+"""boxlint CLI.
+
+Usage:
+    python -m tools.boxlint [options] PATH [PATH ...]
+
+Exit codes (the CI contract):
+    0  clean — no violations beyond the committed baseline
+    1  NEW violations (or --fail-on-stale and the baseline has dead entries)
+    2  internal error (checker crash, unreadable baseline, bad arguments)
+
+Typical invocations:
+    python -m tools.boxlint paddlebox_tpu/ tools/
+    python -m tools.boxlint --no-baseline paddlebox_tpu/parallel/mesh.py
+    python -m tools.boxlint --fix-baseline paddlebox_tpu/ tools/
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+from tools.boxlint.core import (
+    ALL_PASSES, diff_against_baseline, format_baseline, load_baseline,
+    load_tree, run_passes,
+)
+
+_DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.txt")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.boxlint",
+        description=(
+            "AST-level invariant checker for this repo: jit purity / "
+            "static shapes (BX1xx), collective axis contracts (BX2xx), "
+            "flag registry hygiene (BX3xx), guarded-by lock discipline "
+            "(BX4xx). Suppress a single site with '# boxlint: "
+            "disable=BX101' on the line (or the def line for a whole "
+            "method); long-lived exceptions belong in the baseline."),
+        epilog=(
+            "exit codes: 0 = clean vs baseline; 1 = new violations "
+            "(each printed as file:line: CODE message); 2 = internal "
+            "error. Regenerate the baseline after deliberate changes "
+            "with --fix-baseline (review the diff — shrinking is "
+            "progress, growth needs a reason)."))
+    p.add_argument("paths", nargs="+", metavar="PATH",
+                   help="files or directories to lint (e.g. "
+                        "paddlebox_tpu/ tools/)")
+    p.add_argument("--baseline", default=_DEFAULT_BASELINE, metavar="FILE",
+                   help="baseline file of tolerated pre-existing "
+                        "violations (default: tools/boxlint/baseline.txt)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every violation, ignoring the baseline")
+    p.add_argument("--fix-baseline", action="store_true",
+                   help="rewrite the baseline file to exactly the current "
+                        "violation set and exit 0")
+    p.add_argument("--passes", default=",".join(ALL_PASSES), metavar="LIST",
+                   help="comma-separated subset of passes to run "
+                        f"(default: {','.join(ALL_PASSES)})")
+    p.add_argument("--fail-on-stale", action="store_true",
+                   help="also exit 1 when baseline entries no longer "
+                        "match any violation (ratchet mode)")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress the summary line; print violations only")
+    return p
+
+
+def main(argv: List[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    passes = [s.strip() for s in args.passes.split(",") if s.strip()]
+    bad = [s for s in passes if s not in ALL_PASSES]
+    if bad:
+        print(f"boxlint: unknown pass(es): {', '.join(bad)} "
+              f"(valid: {', '.join(ALL_PASSES)})", file=sys.stderr)
+        return 2
+    try:
+        files, parse_errors = load_tree(args.paths)
+        violations = list(parse_errors) + run_passes(files, passes)
+    except Exception as e:  # checker bug — never masquerade as "clean"
+        print(f"boxlint: internal error: {e.__class__.__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if args.fix_baseline:
+        try:
+            with open(args.baseline, "w", encoding="utf-8") as fh:
+                fh.write(format_baseline(violations))
+        except OSError as e:
+            print(f"boxlint: cannot write baseline: {e}", file=sys.stderr)
+            return 2
+        if not args.quiet:
+            print(f"boxlint: baseline rewritten with {len(violations)} "
+                  f"entr{'y' if len(violations) == 1 else 'ies'} "
+                  f"-> {args.baseline}")
+        return 0
+
+    if args.no_baseline:
+        new, stale = violations, []
+    else:
+        try:
+            baseline = load_baseline(args.baseline)
+        except OSError as e:
+            print(f"boxlint: cannot read baseline: {e}", file=sys.stderr)
+            return 2
+        new, stale = diff_against_baseline(violations, baseline)
+
+    for v in new:
+        print(v.render())
+    if stale and not args.quiet:
+        for path, code, msg in stale:
+            print(f"boxlint: stale baseline entry (fixed? run "
+                  f"--fix-baseline): {path}: {code} {msg}", file=sys.stderr)
+    if not args.quiet:
+        total = len(violations)
+        print(f"boxlint: {len(files)} files, {total} violation"
+              f"{'' if total == 1 else 's'} ({len(new)} new, "
+              f"{total - len(new)} baselined, {len(stale)} stale)",
+              file=sys.stderr)
+    if new:
+        return 1
+    if stale and args.fail_on_stale:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,212 @@
+"""Pass 2 — collective axis contracts (BX2xx).
+
+Every ``lax.psum / pmean / ppermute / all_gather / all_to_all /
+psum_scatter / axis_index`` names a mesh axis, and that name must be an
+axis some enclosing ``shard_map`` / ``Mesh`` actually declares — the
+contract NCCL comm groups enforced by construction in the reference and
+the exact one behind the seed's shard_map drift failures (a collective
+over an axis the mesh no longer names fails at dispatch time, on pod
+hardware only).
+
+Static resolution strategy (documented over-approximation):
+
+  1. Collect the declared-axis vocabulary over the whole tree: literal
+     axis tuples passed to ``Mesh(...)``, literal ``axis_names=`` /
+     ``axis_name=`` kwargs, ``PartitionSpec``/``P`` literals, module
+     constants named ``*AXIS*`` bound to a string, and — for
+     ``parallel/mesh.py`` only, the canonical declaration site — any
+     literal tuple of identifier-like strings (the ("data", "model",
+     "pipeline") table).
+  2. For each collective call, resolve its axis argument: a string
+     literal checks directly; a plain Name resolves through function
+     params' literal defaults, simple local ``name = "lit"`` assignments,
+     and module string constants; literal tuples check element-wise.
+     Dynamic expressions (``self.axis``, ``mesh.axis_names[0]``) are
+     trusted — they are derived from a live Mesh by construction.
+
+Codes:
+  BX201  collective names an axis not declared by any Mesh/shard_map
+  BX202  collective with no axis argument at all
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from tools.boxlint.core import SourceFile, Violation
+from tools.boxlint.purity import dotted
+
+# collective -> positional index of the axis-name argument
+_COLLECTIVES = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "ppermute": 1,
+    "all_gather": 1, "all_to_all": 1, "psum_scatter": 1, "pshuffle": 1,
+    "axis_index": 0, "axis_size": 0, "pbroadcast": 1,
+}
+# only axis_name: in lax collectives the ``axis=`` kwarg is the ARRAY
+# axis (an int), not the mesh axis
+_AXIS_KWARGS = ("axis_name",)
+_SPEC_CTORS = {"P", "PartitionSpec", "jax.sharding.PartitionSpec"}
+_IDENT = str.isidentifier
+
+
+def _literal_strings(node: ast.AST) -> List[str]:
+    """String literals in a (possibly nested) tuple/list literal."""
+    out: List[str] = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.append(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            out.extend(_literal_strings(elt))
+    return out
+
+
+def collect_axis_vocabulary(files: Sequence[SourceFile]) -> Set[str]:
+    vocab: Set[str] = set()
+    for f in files:
+        canonical = f.rel.endswith("parallel/mesh.py")
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d and d.split(".")[-1] in ("Mesh", "make_mesh"):
+                    # Mesh(devices, ("dp",)) — 2nd positional or axis_names=
+                    if len(node.args) >= 2:
+                        vocab.update(_literal_strings(node.args[1]))
+                    for kw in node.keywords:
+                        if kw.arg == "axis_names":
+                            vocab.update(_literal_strings(kw.value))
+                elif d and (d in _SPEC_CTORS or d.split(".")[-1] == "PartitionSpec"):
+                    for a in node.args:
+                        vocab.update(_literal_strings(a))
+                for kw in node.keywords:
+                    if kw.arg in ("axis_name", "axis_names"):
+                        vocab.update(_literal_strings(kw.value))
+            elif isinstance(node, ast.Assign):
+                # module constants: BOX_AXIS = "dp"
+                for t in node.targets:
+                    if (isinstance(t, ast.Name) and "AXIS" in t.id.upper()
+                            and isinstance(node.value, ast.Constant)
+                            and isinstance(node.value.value, str)):
+                        vocab.add(node.value.value)
+            if canonical and isinstance(node, (ast.Tuple, ast.List)):
+                lits = _literal_strings(node)
+                if lits and len(lits) == len(node.elts) and all(
+                        _IDENT(s) for s in lits):
+                    vocab.update(lits)
+    return {v for v in vocab if v and _IDENT(v)}
+
+
+class _NameEnv:
+    """Literal string bindings visible to a function: module constants,
+    parameter defaults, and simple local assignments."""
+
+    def __init__(self, tree: ast.Module):
+        self.module: Dict[str, str] = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Constant):
+                v = node.value.value
+                if isinstance(v, str):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.module[t.id] = v
+
+    def for_function(self, fn: Optional[ast.AST]) -> Dict[str, str]:
+        env = dict(self.module)
+        if fn is None:
+            return env
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = fn.args
+            pos = list(a.posonlyargs) + list(a.args)
+            for arg, dflt in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+                self._bind(env, arg.arg, dflt)
+            for arg, dflt in zip(a.kwonlyargs, a.kw_defaults):
+                if dflt is not None:
+                    self._bind(env, arg.arg, dflt)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self._bind(env, t.id, node.value)
+        return env
+
+    def _bind(self, env: Dict[str, str], name: str, value: ast.AST) -> None:
+        if isinstance(value, ast.Constant) and isinstance(value.value, str):
+            env[name] = value.value
+        elif isinstance(value, ast.Name) and value.id in self.module:
+            env[name] = self.module[value.id]
+
+
+def _axis_arg(call: ast.Call, pos: int) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg in _AXIS_KWARGS:
+            return kw.value
+    if len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+def _resolve_axis_names(node: ast.AST, env: Dict[str, str]
+                        ) -> Optional[List[str]]:
+    """Axis name(s) if statically resolvable, else None (dynamic)."""
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, str):
+            return [node.value]
+        return None  # e.g. integer positional axis — not a named axis
+    if isinstance(node, ast.Name):
+        if node.id in env:
+            return [env[node.id]]
+        return None
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for elt in node.elts:
+            got = _resolve_axis_names(elt, env)
+            if got is None:
+                return None
+            out.extend(got)
+        return out
+    return None
+
+
+def check(files: Sequence[SourceFile]) -> List[Violation]:
+    vocab = collect_axis_vocabulary(files)
+    out: List[Violation] = []
+    for f in files:
+        envs = _NameEnv(f.tree)
+        # map every node to its enclosing function for env resolution
+        owner: Dict[int, ast.AST] = {}
+        for fn in ast.walk(f.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(fn):
+                    owner[id(sub)] = fn  # innermost wins (walk order: outer
+                    # first, inner overwrites)
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if not d:
+                continue
+            parts = d.split(".")
+            if parts[-1] not in _COLLECTIVES:
+                continue
+            if parts[0] not in ("jax", "lax") and "lax" not in parts:
+                continue
+            arg = _axis_arg(node, _COLLECTIVES[parts[-1]])
+            if arg is None:
+                out.append(Violation(
+                    f.rel, node.lineno, "BX202",
+                    f"collective {parts[-1]} without an axis name: it "
+                    f"reduces over nothing (or crashes at dispatch)"))
+                continue
+            env = envs.for_function(owner.get(id(node)))
+            names = _resolve_axis_names(arg, env)
+            if names is None:
+                continue  # dynamic (mesh.axis_names[...], self.axis): trusted
+            for name in names:
+                if name not in vocab:
+                    out.append(Violation(
+                        f.rel, node.lineno, "BX201",
+                        f"collective {parts[-1]} over axis {name!r} which "
+                        f"no Mesh/shard_map/PartitionSpec in the tree "
+                        f"declares (declared: {sorted(vocab)})"))
+    return out

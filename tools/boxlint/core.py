@@ -1,0 +1,217 @@
+"""boxlint core: source loading, suppressions, violations, baseline io.
+
+No third-party deps — stdlib ``ast`` + ``tokenize`` only, so the checker
+runs anywhere the repo's Python does (CI, the container, a laptop without
+jax installed).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# ``# boxlint: disable=BX101,BX401`` or ``# boxlint: disable`` (all codes)
+_SUPPRESS_RE = re.compile(
+    r"#\s*boxlint:\s*disable(?:\s*=\s*(?P<codes>[A-Z0-9,\s]+))?")
+# ``# guarded-by: <lock-attr>`` trailing annotation (pass 4)
+GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*(?P<lock>[A-Za-z_][\w]*)")
+
+
+@dataclass(frozen=True)
+class Violation:
+    path: str          # repo-relative, forward slashes
+    line: int
+    code: str          # BXnnn
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: line numbers drift under unrelated edits, so
+        matching ignores them (file, code, message)."""
+        return (self.path, self.code, self.message)
+
+
+class SourceFile:
+    """One parsed module plus the comment-derived metadata ast drops."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        # line -> set of suppressed codes (empty set == all codes)
+        self.suppress: Dict[int, Optional[Set[str]]] = {}
+        # line -> lock attr name from a guarded-by annotation
+        self.guarded_by: Dict[int, str] = {}
+        self._scan_comments()
+        # lines covered by a def/class-level suppression
+        self._block_suppress: List[Tuple[int, int, Optional[Set[str]]]] = []
+        self._scan_block_suppressions()
+
+    def _scan_comments(self) -> None:
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            for tok in toks:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if m:
+                    codes = m.group("codes")
+                    self.suppress[tok.start[0]] = (
+                        {c.strip() for c in codes.split(",") if c.strip()}
+                        if codes else None)
+                g = GUARDED_BY_RE.search(tok.string)
+                if g:
+                    self.guarded_by[tok.start[0]] = g.group("lock")
+        except tokenize.TokenError:
+            pass  # malformed tail; ast.parse already succeeded
+
+    def _scan_block_suppressions(self) -> None:
+        """A disable comment on a ``def``/``class`` line suppresses the
+        whole body — the ergonomic form for deliberately lock-free
+        boundary methods."""
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                for ln in range(node.lineno, node.body[0].lineno):
+                    if ln in self.suppress:
+                        self._block_suppress.append(
+                            (node.lineno, node.end_lineno or node.lineno,
+                             self.suppress[ln]))
+                        break
+
+    def suppressed(self, line: int, code: str) -> bool:
+        codes = self.suppress.get(line, False)
+        if codes is not False:
+            if codes is None or code in codes:
+                return True
+        for start, end, blk in self._block_suppress:
+            if start <= line <= end and (blk is None or code in blk):
+                return True
+        return False
+
+
+def load_tree(paths: Sequence[str], root: Optional[str] = None
+              ) -> Tuple[List[SourceFile], List[Violation]]:
+    """Collect and parse every .py under ``paths``. Unparseable files are
+    reported as BX000 rather than crashing the run."""
+    root = root or os.getcwd()
+    files: List[SourceFile] = []
+    errors: List[Violation] = []
+    seen: Set[str] = set()
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            candidates = [p]
+        else:
+            candidates = []
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        candidates.append(os.path.join(dirpath, fn))
+        for f in sorted(candidates):
+            if f in seen:
+                continue
+            seen.add(f)
+            rel = os.path.relpath(f, root).replace(os.sep, "/")
+            try:
+                with open(f, "r", encoding="utf-8") as fh:
+                    text = fh.read()
+                files.append(SourceFile(f, rel, text))
+            except (SyntaxError, UnicodeDecodeError) as e:
+                line = getattr(e, "lineno", 1) or 1
+                errors.append(Violation(rel, line, "BX000",
+                                        f"unparseable: {e.__class__.__name__}: {e}"))
+    return files, errors
+
+
+# --------------------------------------------------------------- baseline
+
+def load_baseline(path: str) -> List[Tuple[str, str, str]]:
+    """Baseline lines are rendered violations; identity ignores the line
+    number (see Violation.key). Returns a multiset as a list."""
+    entries: List[Tuple[str, str, str]] = []
+    if not os.path.exists(path):
+        return entries
+    pat = re.compile(r"^(?P<path>[^:]+):(?P<line>\d+): (?P<code>BX\d+) "
+                     r"(?P<msg>.*)$")
+    with open(path, "r", encoding="utf-8") as fh:
+        for raw in fh:
+            raw = raw.rstrip("\n")
+            if not raw or raw.startswith("#"):
+                continue
+            m = pat.match(raw)
+            if m:
+                entries.append((m.group("path"), m.group("code"),
+                                m.group("msg")))
+    return entries
+
+
+def diff_against_baseline(violations: Sequence[Violation],
+                          baseline: Sequence[Tuple[str, str, str]]
+                          ) -> Tuple[List[Violation], List[Tuple[str, str, str]]]:
+    """Multiset subtraction: returns (new_violations, stale_baseline)."""
+    pool: Dict[Tuple[str, str, str], int] = {}
+    for entry in baseline:
+        pool[entry] = pool.get(entry, 0) + 1
+    new: List[Violation] = []
+    for v in violations:
+        k = v.key()
+        if pool.get(k, 0) > 0:
+            pool[k] -= 1
+        else:
+            new.append(v)
+    stale = [k for k, n in pool.items() for _ in range(n)]
+    return new, stale
+
+
+def format_baseline(violations: Sequence[Violation]) -> str:
+    header = ("# boxlint baseline — pre-existing violations the gate "
+              "tolerates.\n"
+              "# Regenerate with: python -m tools.boxlint --fix-baseline "
+              "paddlebox_tpu/ tools/\n"
+              "# Matching ignores line numbers (file + code + message), "
+              "so unrelated edits\n"
+              "# above a baselined site do not break the gate.\n")
+    body = "\n".join(v.render() for v in
+                     sorted(violations, key=lambda v: (v.path, v.line, v.code)))
+    return header + body + ("\n" if body else "")
+
+
+# --------------------------------------------------------------- drivers
+
+def run_passes(files: Sequence[SourceFile],
+               passes: Optional[Iterable[str]] = None) -> List[Violation]:
+    from tools.boxlint import collectives, flagscheck, locks, purity
+    registry = {
+        "purity": purity.check,
+        "collectives": collectives.check,
+        "flags": flagscheck.check,
+        "locks": locks.check,
+    }
+    names = list(passes) if passes else list(registry)
+    out: List[Violation] = []
+    for name in names:
+        out.extend(registry[name](files))
+    out = [v for v in out if not _is_suppressed(files, v)]
+    return sorted(out, key=lambda v: (v.path, v.line, v.code))
+
+
+ALL_PASSES = ("purity", "collectives", "flags", "locks")
+
+
+def _is_suppressed(files: Sequence[SourceFile], v: Violation) -> bool:
+    for f in files:
+        if f.rel == v.path:
+            return f.suppressed(v.line, v.code)
+    return False

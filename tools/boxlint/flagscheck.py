@@ -1,0 +1,136 @@
+"""Pass 3 — flag registry hygiene (BX3xx).
+
+The reference's gflags tier made flags a closed registry: every
+``FLAGS_x`` read linked against a ``PADDLE_DEFINE_EXPORTED_*`` or the
+build failed, and ``--help`` enumerated everything. Our
+``config/flags.py`` registry is runtime-only, so a typo'd
+``get_flag("incremental_pas")`` is a KeyError in production and a flag
+nobody reads anymore silently rots with its env override. This pass
+closes the registry statically.
+
+Codes:
+  BX301  get_flag/set_flag of a name no define_flag declares
+  BX302  declared flag never read by any get_flag in the tree (dead flag)
+  BX303  define_flag with an empty help string
+  BX304  duplicate flag name / env-name collision (PBTPU_<UPPER> space)
+  BX305  define_flag/get_flag with a non-literal name (unauditable)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Sequence, Set, Tuple
+
+from tools.boxlint.core import SourceFile, Violation
+from tools.boxlint.purity import dotted
+
+_DECL_FILE_SUFFIX = "config/flags.py"
+
+
+def _literal_name(call: ast.Call) -> Tuple[object, bool]:
+    """(name, is_literal) for the first arg / name= kwarg."""
+    arg = None
+    if call.args:
+        arg = call.args[0]
+    else:
+        for kw in call.keywords:
+            if kw.arg == "name":
+                arg = kw.value
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value, True
+    return None, arg is None
+
+
+def _help_arg(call: ast.Call) -> Tuple[object, bool]:
+    """(help_value, present) — 3rd positional or help= kwarg."""
+    if len(call.args) >= 3:
+        a = call.args[2]
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            return a.value, True
+        return None, True  # non-literal help: assume intentional
+    for kw in call.keywords:
+        if kw.arg == "help":
+            if isinstance(kw.value, ast.Constant):
+                return kw.value.value, True
+            return None, True
+    return "", False
+
+
+def check(files: Sequence[SourceFile]) -> List[Violation]:
+    out: List[Violation] = []
+    declared: Dict[str, Tuple[str, int]] = {}   # name -> (file, line)
+    env_names: Dict[str, Tuple[str, str, int]] = {}  # env -> (flag, file, line)
+    reads: Set[str] = set()
+    read_sites: List[Tuple[SourceFile, ast.Call, str, str]] = []
+
+    for f in files:
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if not d:
+                continue
+            tail = d.split(".")[-1]
+            if tail == "define_flag":
+                name, lit = _literal_name(node)
+                if not lit or name is None:
+                    out.append(Violation(
+                        f.rel, node.lineno, "BX305",
+                        "define_flag with a non-literal name: the registry "
+                        "is unauditable statically"))
+                    continue
+                if name in declared:
+                    # cross-reference by file only: embedding the other
+                    # site's line number would defeat the baseline's
+                    # line-drift-immune matching (Violation.key)
+                    df, _dl = declared[name]
+                    out.append(Violation(
+                        f.rel, node.lineno, "BX304",
+                        f"flag {name!r} already declared in {df}"))
+                else:
+                    declared[name] = (f.rel, node.lineno)
+                env = "PBTPU_" + str(name).upper()
+                if env in env_names and env_names[env][0] != name:
+                    of, off, _ofl = env_names[env]
+                    out.append(Violation(
+                        f.rel, node.lineno, "BX304",
+                        f"flag {name!r} env name {env} collides with flag "
+                        f"{of!r} ({off})"))
+                else:
+                    env_names.setdefault(env, (str(name), f.rel, node.lineno))
+                hlp, present = _help_arg(node)
+                if isinstance(hlp, str) and not hlp.strip():
+                    out.append(Violation(
+                        f.rel, node.lineno, "BX303",
+                        f"flag {name!r} has an empty help string (the "
+                        f"gflags --help contract: every flag documents "
+                        f"itself)"))
+            elif tail in ("get_flag", "set_flag"):
+                name, lit = _literal_name(node)
+                if name is None:
+                    if not lit:
+                        out.append(Violation(
+                            f.rel, node.lineno, "BX305",
+                            f"{tail} with a non-literal flag name: cannot "
+                            f"be checked against the registry"))
+                    continue
+                read_sites.append((f, node, tail, str(name)))
+                if tail == "get_flag":
+                    reads.add(str(name))
+
+    have_decl_file = any(f.rel.endswith(_DECL_FILE_SUFFIX) for f in files)
+    for f, node, tail, name in read_sites:
+        if name not in declared and have_decl_file:
+            out.append(Violation(
+                f.rel, node.lineno, "BX301",
+                f"{tail}({name!r}) reads a flag config/flags.py never "
+                f"declares (KeyError at runtime)"))
+    if have_decl_file:
+        for name, (df, dl) in sorted(declared.items()):
+            if name not in reads:
+                out.append(Violation(
+                    df, dl, "BX302",
+                    f"flag {name!r} is declared but never read by any "
+                    f"get_flag in the analyzed tree (dead flag — delete "
+                    f"it or wire it up)"))
+    return out

@@ -1,0 +1,196 @@
+"""Pass 4 — lock discipline via ``# guarded-by:`` annotations (BX4xx).
+
+The reference documented lock ownership in C++ types (``std::mutex`` next
+to the member it guards, lock_guard at every touch point); our growing
+thread population (PromotePrefetcher, the chunk stager, AsyncDenseTable's
+update loop, the Channel pipeline, checkpoint writers) shares state under
+ad-hoc ``threading.Lock``s with the guard relationship living in
+docstrings. The annotation convention makes it mechanical:
+
+    self._deque = collections.deque()   # guarded-by: _mutex
+
+Every later ``self._deque`` read or write in that class must then sit
+inside a ``with self._mutex:`` block (``__init__``/``__del__`` are
+exempt — no concurrent observer can exist yet/anymore). A deliberately
+lock-free access (single-threaded boundary method, GIL-atomic probe)
+carries ``# boxlint: disable=BX401`` — on the access line or on the
+``def`` line for a whole boundary method — which turns each lock-free
+access into an explicit, reviewable decision instead of an accident.
+
+Audited classes are those with at least one annotation: annotating is
+the opt-in that declares "instances of this are shared across threads".
+(Thread creation itself is a hint, not the trigger — ShardedPassTable
+never starts a thread, yet its store_lock serializes a PromotePrefetcher
+started two modules away.)
+
+Codes:
+  BX401  annotated attribute touched outside ``with self.<lock>``
+  BX402  guarded-by names a lock attribute the class never assigns
+  BX403  class starts a threading.Thread and takes a threading.Lock but
+         annotates nothing (unauditable shared state)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Sequence, Set, Tuple
+
+from tools.boxlint.core import SourceFile, Violation
+from tools.boxlint.purity import dotted
+
+_EXEMPT_METHODS = {"__init__", "__del__", "__repr__"}
+
+
+def _self_attr(node: ast.AST) -> str:
+    """'x' for ``self.x`` / ``cls.x``, else ''."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("self", "cls")):
+        return node.attr
+    return ""
+
+
+def _with_locks(stmt: ast.With) -> Set[str]:
+    held: Set[str] = set()
+    for item in stmt.items:
+        ctx = item.context_expr
+        attr = _self_attr(ctx)
+        if attr:
+            held.add(attr)
+        elif isinstance(ctx, ast.Call):
+            # with self._lock.acquire_timeout(...), with self._cv: etc.
+            attr = _self_attr(ctx.func)
+            if attr:
+                held.add(attr)
+            else:
+                base = _self_attr(getattr(ctx.func, "value", None))
+                if base:
+                    held.add(base)
+    return held
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef, f: SourceFile):
+        self.node = node
+        self.f = f
+        self.guards: Dict[str, Tuple[str, int]] = {}  # attr -> (lock, line)
+        self.assigned_attrs: Set[str] = set()
+        self.starts_thread = False
+        self.has_lock = False
+        self._scan()
+
+    def _scan(self) -> None:
+        for sub in ast.walk(self.node):
+            if isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (sub.targets if isinstance(sub, ast.Assign)
+                           else [sub.target])
+                for t in targets:
+                    attr = _self_attr(t)
+                    if not attr:
+                        continue
+                    self.assigned_attrs.add(attr)
+                    lock = self.f.guarded_by.get(t.lineno)
+                    if lock is None and sub.end_lineno:
+                        # annotation may trail the statement's last line
+                        # (multi-line assignments)
+                        lock = self.f.guarded_by.get(sub.end_lineno)
+                    if lock is not None:
+                        self.guards.setdefault(attr, (lock, t.lineno))
+            elif isinstance(sub, ast.Call):
+                d = dotted(sub.func)
+                if d and d.split(".")[-1] == "Thread":
+                    self.starts_thread = True
+                if d and d.split(".")[-1] in ("Lock", "RLock", "Condition"):
+                    self.has_lock = True
+
+
+def _audit_class(info: _ClassInfo, out: List[Violation]) -> None:
+    f = info.f
+    for attr, (lock, line) in sorted(info.guards.items()):
+        if lock not in info.assigned_attrs and not _lock_is_param(info, lock):
+            out.append(Violation(
+                f.rel, line, "BX402",
+                f"guarded-by names {lock!r} but the class never assigns "
+                f"self.{lock} (stale annotation?)"))
+    for item in info.node.body:
+        if not isinstance(item, ast.FunctionDef):
+            continue
+        if item.name in _EXEMPT_METHODS:
+            continue
+        _audit_fn(info, item, frozenset(), out)
+
+
+def _lock_is_param(info: _ClassInfo, lock: str) -> bool:
+    for item in info.node.body:
+        if isinstance(item, ast.FunctionDef) and item.name == "__init__":
+            return any(a.arg == lock for a in item.args.args)
+    return False
+
+
+def _audit_fn(info: _ClassInfo, node: ast.AST, held: frozenset,
+              out: List[Violation]) -> None:
+    """Statement-ordered walk tracking the set of held ``self.*`` locks."""
+    if isinstance(node, ast.With):
+        inner = held | _with_locks(node)
+        _check_expr_group(info, [i.context_expr for i in node.items],
+                          held, node.lineno, out)
+        for stmt in node.body:
+            _audit_fn(info, stmt, inner, out)
+        return
+    # expression positions checked with the CURRENT lock set. Containers
+    # that hold statement bodies without BEING statements (except
+    # handlers, match cases) must recurse like statements, or a `with
+    # self.<lock>` inside them is invisible and its accesses spuriously
+    # flag
+    _STMT_LIKE = (ast.stmt, ast.ExceptHandler, ast.match_case)
+    children = list(ast.iter_child_nodes(node))
+    stmt_children = [c for c in children if isinstance(c, _STMT_LIKE)]
+    expr_children = [c for c in children if not isinstance(c, _STMT_LIKE)]
+    _check_expr_group(info, expr_children, held, getattr(
+        node, "lineno", info.node.lineno), out)
+    for stmt in stmt_children:
+        _audit_fn(info, stmt, held, out)
+
+
+def _check_expr_group(info: _ClassInfo, exprs: Sequence[ast.AST],
+                      held: frozenset, line: int,
+                      out: List[Violation]) -> None:
+    f = info.f
+    for e in exprs:
+        if e is None:
+            continue
+        for sub in ast.walk(e):
+            attr = _self_attr(sub)
+            if not attr or attr not in info.guards:
+                continue
+            lock, _ = info.guards[attr]
+            if lock in held or attr == lock:
+                continue
+            kind = ("write" if isinstance(getattr(sub, "ctx", None),
+                                          (ast.Store, ast.Del)) else "read")
+            out.append(Violation(
+                f.rel, getattr(sub, "lineno", line), "BX401",
+                f"{kind} of {info.node.name}.{attr} (guarded-by {lock}) "
+                f"outside `with self.{lock}`"))
+        # nested defs inside expressions (lambdas/comprehensions) are
+        # covered by ast.walk above; nested statements are not expected
+        # in expression position
+
+
+def check(files: Sequence[SourceFile]) -> List[Violation]:
+    out: List[Violation] = []
+    for f in files:
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            info = _ClassInfo(node, f)
+            if info.guards:
+                _audit_class(info, out)
+            elif info.starts_thread and info.has_lock:
+                out.append(Violation(
+                    f.rel, node.lineno, "BX403",
+                    f"class {node.name} starts a Thread and takes a Lock "
+                    f"but has no `# guarded-by:` annotations — its shared "
+                    f"state is unauditable (annotate the attributes the "
+                    f"lock protects)"))
+    return out

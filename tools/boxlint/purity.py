@@ -1,0 +1,370 @@
+"""Pass 1 — jit-purity / static-shape (BX1xx).
+
+The fused step's contract (ARCHITECTURE.md): everything reachable from a
+``jax.jit`` / ``jax.shard_map`` / ``lax.scan`` entry point traces to one
+pure, static-shaped XLA program. The reference got this for free from the
+static graph (ops declare shapes at build time, host code can't leak in);
+here a stray ``.item()`` or ``np.*`` on a tracer silently inserts a
+device->host sync per step, and ``jnp.unique`` without ``size=`` is a
+trace-time error only on the paths tests happen to cover.
+
+Detection is deliberately an over-approximation with a taint heuristic:
+entry functions are found by decorator and call-site (``jax.jit(f)``,
+``jax.shard_map(f, ...)``, ``lax.scan(f, ...)``), the traced set closes
+over same-module calls (``g(...)`` and ``self.m(...)``), and a value is
+"traced" when it flows from a parameter of the traced function or from a
+``jnp.* / jax.* / lax.*`` call. Host-callback bodies
+(``jax.pure_callback`` / ``io_callback`` / ``debug.callback``) are host
+code by design and are excluded.
+
+Codes:
+  BX101  host sync call (.item(), jax.device_get, print) in traced code
+  BX102  float()/int()/bool() cast of a traced value
+  BX103  np.* call on a traced value
+  BX104  data-dependent output shape (jnp.unique/nonzero/... without size=)
+  BX105  boolean-mask indexing (data-dependent shape)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.boxlint.core import SourceFile, Violation
+
+_JIT_NAMES = {"jax.jit", "jit", "functools.partial", "partial"}
+_ENTRY_WRAPPERS = {"jax.jit", "jit", "jax.pmap", "pmap",
+                   "jax.shard_map", "shard_map",
+                   "jax.experimental.shard_map.shard_map"}
+_SCAN_WRAPPERS = {"jax.lax.scan", "lax.scan",
+                  "jax.lax.fori_loop", "lax.fori_loop",
+                  "jax.lax.while_loop", "lax.while_loop",
+                  "jax.lax.cond", "lax.cond", "jax.lax.switch", "lax.switch"}
+_CALLBACKS = {"jax.pure_callback", "jax.experimental.io_callback",
+              "io_callback", "pure_callback", "jax.debug.callback",
+              "debug.callback"}
+_DATA_DEP = {"unique", "nonzero", "flatnonzero", "argwhere"}
+_TRACED_MODULES = ("jnp", "jax", "lax")
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'jax.lax.psum' for Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _decorator_is_jit(dec: ast.AST) -> bool:
+    d = dotted(dec)
+    if d in _ENTRY_WRAPPERS:
+        return True
+    if isinstance(dec, ast.Call):
+        f = dotted(dec.func)
+        if f in _ENTRY_WRAPPERS:
+            return True
+        if f in _JIT_NAMES and dec.args:  # partial(jax.jit, ...)
+            return dotted(dec.args[0]) in _ENTRY_WRAPPERS
+    return False
+
+
+class _Scope:
+    """Function registry for one module: module functions by name,
+    methods by (class, name)."""
+
+    def __init__(self, tree: ast.Module):
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        self.methods: Dict[Tuple[str, str], ast.FunctionDef] = {}
+        self.owner: Dict[int, Optional[str]] = {}  # id(def) -> class name
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef):
+                self.functions[node.name] = node
+                self.owner[id(node)] = None
+                self._register_nested(node, None)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, ast.FunctionDef):
+                        self.methods[(node.name, sub.name)] = sub
+                        self.owner[id(sub)] = node.name
+                        self._register_nested(sub, node.name)
+
+    def _register_nested(self, fn: ast.FunctionDef, cls: Optional[str]) -> None:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.FunctionDef) and node is not fn:
+                # nested defs resolve by bare name too (closure helpers)
+                self.functions.setdefault(node.name, node)
+                self.owner.setdefault(id(node), cls)
+
+
+# wrapper tail -> (positional indices of function args, kwarg names).
+# Positions matter: fori_loop's args[0] is the loop bound and cond's
+# args[0] is the predicate — seeding those would mistrace (or miss the
+# real body entirely).
+_FUNC_ARG_SPEC = {
+    "scan": ((0,), ("f",)),
+    "fori_loop": ((2,), ("body_fun",)),
+    "while_loop": ((0, 1), ("cond_fun", "body_fun")),
+    "cond": ((1, 2), ("true_fun", "false_fun")),
+    "jit": ((0,), ("fun", "f", "func")),
+    "pmap": ((0,), ("fun", "f", "func")),
+    "shard_map": ((0,), ("f", "fun", "func")),
+}
+
+
+def _func_args(call: ast.Call, tail: str) -> List[ast.AST]:
+    """The argument nodes of ``call`` that are traced functions."""
+    if tail == "switch":  # switch(index, branches_sequence, *operands)
+        out: List[ast.AST] = []
+        branches = (call.args[1] if len(call.args) > 1 else
+                    next((kw.value for kw in call.keywords
+                          if kw.arg == "branches"), None))
+        if isinstance(branches, (ast.Tuple, ast.List)):
+            out.extend(branches.elts)
+        elif branches is not None:
+            out.append(branches)
+        return out
+    pos, kws = _FUNC_ARG_SPEC.get(tail, ((0,), ("f", "fun", "func")))
+    out = [call.args[i] for i in pos if len(call.args) > i]
+    out.extend(kw.value for kw in call.keywords if kw.arg in kws)
+    return out
+
+
+def _collect_entries(f: SourceFile, scope: _Scope
+                     ) -> Tuple[Set[int], Set[str]]:
+    """Returns (ids of entry FunctionDefs, names excluded as host callbacks)."""
+    entries: Set[int] = set()
+    callbacks: Set[str] = set()
+    all_defs = list(scope.functions.values()) + list(scope.methods.values())
+    for fn in all_defs:
+        if any(_decorator_is_jit(d) for d in fn.decorator_list):
+            entries.add(id(fn))
+    for node in ast.walk(f.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d in _ENTRY_WRAPPERS or d in _SCAN_WRAPPERS:
+            for target in _func_args(node, d.split(".")[-1]):
+                fn = _resolve_target(target, scope)
+                if fn is not None:
+                    entries.add(id(fn))
+                elif isinstance(target, ast.Lambda):
+                    entries.add(id(target))
+        elif d in _CALLBACKS:
+            for target in _func_args(node, "callback"):
+                name = dotted(target)
+                if name:
+                    callbacks.add(name.split(".")[-1])
+    return entries, callbacks
+
+
+def _resolve_target(target: Optional[ast.AST], scope: _Scope
+                    ) -> Optional[ast.FunctionDef]:
+    if target is None:
+        return None
+    d = dotted(target)
+    if d is None:
+        return None
+    if d in scope.functions:
+        return scope.functions[d]
+    parts = d.split(".")
+    if len(parts) == 2 and parts[0] == "self":
+        for (cls, name), fn in scope.methods.items():
+            if name == parts[1]:
+                return fn
+    return None
+
+
+def _close_over_calls(f: SourceFile, scope: _Scope, entries: Set[int]
+                      ) -> List[ast.AST]:
+    """Worklist: traced set closes over same-module calls."""
+    by_id = {}
+    for fn in list(scope.functions.values()) + list(scope.methods.values()):
+        by_id[id(fn)] = fn
+    lambdas = {id(n): n for n in ast.walk(f.tree)
+               if isinstance(n, ast.Lambda)}
+    by_id.update(lambdas)
+    work = [by_id[i] for i in entries if i in by_id]
+    traced: Set[int] = set()
+    out: List[ast.AST] = []
+    while work:
+        fn = work.pop()
+        if id(fn) in traced:
+            continue
+        traced.add(id(fn))
+        out.append(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _resolve_target(node.func, scope)
+            if callee is not None and id(callee) not in traced:
+                work.append(callee)
+    return out
+
+
+# ----------------------------------------------------------------- taint
+
+def _taint_names(fn: ast.AST) -> Set[str]:
+    """Names holding (likely) traced values: parameters, plus anything
+    assigned from an expression referencing a tainted name or a
+    jnp./jax./lax. call. Two sweeps approximate the fixpoint."""
+    tainted: Set[str] = set()
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        a = fn.args
+        for arg in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs):
+            tainted.add(arg.arg)
+        if a.vararg:
+            tainted.add(a.vararg.arg)
+        if a.kwarg:
+            tainted.add(a.kwarg.arg)
+
+    def expr_tainted(e: ast.AST) -> bool:
+        for n in ast.walk(e):
+            if isinstance(n, ast.Name) and n.id in tainted:
+                return True
+            if isinstance(n, ast.Call):
+                d = dotted(n.func)
+                if d and d.split(".")[0] in _TRACED_MODULES:
+                    return True
+        return False
+
+    body = fn.body if isinstance(fn.body, list) else [ast.Expr(fn.body)]
+    for _ in range(2):
+        for node in ast.walk(ast.Module(body=body, type_ignores=[])):
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AugAssign):
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                targets, value = [node.target], node.iter
+            else:
+                continue
+            if expr_tainted(value):
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+    return tainted
+
+
+# ----------------------------------------------------------------- checks
+
+_SAFE_NP = {"float32", "float64", "int32", "int64", "uint32", "uint64",
+            "int8", "uint8", "int16", "uint16", "bool_", "dtype", "finfo",
+            "iinfo", "ndim", "shape", "prod", "dtype"}
+
+
+def _check_traced_fn(f: SourceFile, fn: ast.AST, callbacks: Set[str],
+                     out: List[Violation]) -> None:
+    tainted = _taint_names(fn)
+    name = getattr(fn, "name", "<lambda>")
+    skip_ids: Set[int] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.FunctionDef) and node.name in callbacks:
+            for sub in ast.walk(node):
+                skip_ids.add(id(sub))
+
+    def is_tainted_expr(e: ast.AST) -> bool:
+        for n in ast.walk(e):
+            if isinstance(n, ast.Name) and n.id in tainted:
+                return True
+        return False
+
+    for node in ast.walk(fn):
+        if id(node) in skip_ids:
+            continue
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            # BX101: unconditional host syncs
+            if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+                out.append(Violation(
+                    f.rel, node.lineno, "BX101",
+                    f"host sync in traced `{name}`: .item() forces a "
+                    f"device->host transfer per call"))
+            elif d in ("jax.device_get", "device_get"):
+                out.append(Violation(
+                    f.rel, node.lineno, "BX101",
+                    f"host sync in traced `{name}`: jax.device_get blocks "
+                    f"on the device inside the traced step"))
+            elif d == "print":
+                out.append(Violation(
+                    f.rel, node.lineno, "BX101",
+                    f"print() in traced `{name}` runs at trace time only "
+                    f"(or syncs under callbacks); use jax.debug.print"))
+            # BX102: host casts of traced values
+            elif d in ("float", "int", "bool") and node.args:
+                if is_tainted_expr(node.args[0]) and not _static_arg(node.args[0]):
+                    out.append(Violation(
+                        f.rel, node.lineno, "BX102",
+                        f"{d}() cast of traced value in `{name}` forces a "
+                        f"host sync (ConcretizationError off the happy path)"))
+            # BX103: numpy on traced values
+            elif d and d.split(".")[0] in ("np", "numpy"):
+                attr = d.split(".")[-1]
+                if attr not in _SAFE_NP and any(
+                        is_tainted_expr(a) for a in node.args):
+                    out.append(Violation(
+                        f.rel, node.lineno, "BX103",
+                        f"np.{attr}() on traced value in `{name}`: numpy "
+                        f"concretizes tracers (host sync / trace error); "
+                        f"use jnp.{attr}"))
+            # BX104: data-dependent output shapes
+            if d:
+                parts = d.split(".")
+                if (parts[-1] in _DATA_DEP
+                        and parts[0] in ("jnp", "jax", "lax")):
+                    has_size = any(kw.arg == "size" for kw in node.keywords)
+                    if not has_size:
+                        out.append(Violation(
+                            f.rel, node.lineno, "BX104",
+                            f"jnp.{parts[-1]} without size= in traced "
+                            f"`{name}`: output shape depends on data "
+                            f"(untraceable); pass size= + fill_value"))
+                if (parts[-1] == "where" and parts[0] in ("jnp",)
+                        and len(node.args) == 1 and not node.keywords):
+                    out.append(Violation(
+                        f.rel, node.lineno, "BX104",
+                        f"one-arg jnp.where in traced `{name}` is "
+                        f"data-dependent-shaped; use the 3-arg form or "
+                        f"nonzero with size="))
+        elif isinstance(node, ast.Subscript):
+            # BX105: x[mask] with mask a comparison => data-dependent shape
+            sl = node.slice
+            if isinstance(sl, ast.Compare) and is_tainted_expr(sl):
+                out.append(Violation(
+                    f.rel, node.lineno, "BX105",
+                    f"boolean-mask indexing in traced `{name}`: result "
+                    f"shape depends on data; use jnp.where or a fixed-size "
+                    f"gather"))
+
+
+def _static_arg(e: ast.AST) -> bool:
+    """Expressions that are static at trace time even when they mention a
+    traced name: shapes, ndim, len()."""
+    if isinstance(e, ast.Constant):
+        return True
+    if isinstance(e, ast.Call) and dotted(e.func) == "len":
+        return True
+    for n in ast.walk(e):
+        if isinstance(n, ast.Attribute) and n.attr in ("shape", "ndim",
+                                                       "size", "dtype"):
+            return True
+    return False
+
+
+def check(files: Sequence[SourceFile]) -> List[Violation]:
+    out: List[Violation] = []
+    for f in files:
+        scope = _Scope(f.tree)
+        entries, callbacks = _collect_entries(f, scope)
+        if not entries:
+            continue
+        for fn in _close_over_calls(f, scope, entries):
+            _check_traced_fn(f, fn, callbacks, out)
+    return out
