@@ -32,6 +32,16 @@ measures the WHOLE lifecycle (begin_feed → train → end_pass) at 0% and
 including the CPU fallback, so the field is never absent from a BENCH
 json.
 
+Round 8 reworks the e2e ladder (staging + H2D + dispatch + D2H over
+fresh chunks): four tiers — grouped / ungrouped / lean(ids-only, the
+round-5 wire with the in-step jnp.unique) / uid-lean (the reunified
+lean wire: sorted uids ship, dedup maps derive on device) plus the
+delta-coded uid wire — each run 3× with the MEDIAN reported (the
+recorded ±30% container-CPU noise otherwise dominates tier deltas),
+each carrying `wire_bytes_per_step` and `host_stage_keys_per_sec`.
+`e2e_lean` now names the CURRENT lean wire (= uid-lean); the r5-
+comparable ids-only number is `e2e_lean_ids_only`.
+
 MFU accounting lives in BASELINE.md (updated whenever the recorded
 baseline moves).
 """
@@ -65,7 +75,7 @@ STEPS = 12         # timed chunks
 WARMUP = 2
 
 PROBE_TIMEOUT = int(os.environ.get("PBTPU_BENCH_PROBE_TIMEOUT", "120"))
-RUN_TIMEOUT = int(os.environ.get("PBTPU_BENCH_RUN_TIMEOUT", "600"))
+RUN_TIMEOUT = int(os.environ.get("PBTPU_BENCH_RUN_TIMEOUT", "900"))
 
 
 def _force_platform(platform: str) -> None:
@@ -130,65 +140,50 @@ def measure(platform: str) -> None:
 
     scan = trainer.fns.scan_steps
     t_compile = time.perf_counter()
-    if trainer._push_write == "log":
-        # round-5 headline path: log-structured write; the timed chain
-        # includes the real merge cadence (bench_util.timed_scan_chain_log)
-        from tools.bench_util import (make_log_bench_state,
-                                      timed_scan_chain_log)
-        stacked, bundle, mpos_np, lb = make_log_bench_state(trainer, batches)
-        state = (bundle, trainer.params, trainer.opt_state,
-                 trainer.table.next_prng())
-        dt = timed_scan_chain_log(scan, trainer.fns.merge_log, state,
-                                  stacked, STEPS,
-                                  max(1, lb // CHUNK), mpos_np,
-                                  warmup=WARMUP)
-    else:
-        stacked = trainer._stack_batches(batches)
-        state = (trainer.table.slab, trainer.params, trainer.opt_state,
-                 trainer.table.next_prng())
-        dt = timed_scan_chain(scan, state, stacked, STEPS, warmup=WARMUP)
+    stacked = trainer._stack_batches(batches)
+    state = (trainer.table.slab, trainer.params, trainer.opt_state,
+             trainer.table.next_prng())
+    dt = timed_scan_chain(scan, state, stacked, STEPS, warmup=WARMUP)
     t_compile = time.perf_counter() - t_compile - dt * STEPS
 
-    def run_e2e(tg: int, n_chunks: int = 8) -> float:
+    from paddlebox_tpu.config import flags as _flags
+
+    def stage_stats() -> dict:
+        """Wire accounting for the CURRENT flag config: bytes the staged
+        batch leaves put on the H2D wire per step, and the host staging
+        rate in keys/s (lookup + dedup + stack — the stager-thread
+        budget)."""
+        staged = trainer._stack_batches_host(batches)  # warm
+        reps = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 1.0:
+            staged = trainer._stack_batches_host(batches)
+            reps += 1
+        dt_s = time.perf_counter() - t0
+        wire = sum(int(np.asarray(v).nbytes) for v in staged.values())
+        keys = CHUNK * feed.key_capacity()
+        return {"wire_bytes_per_step": wire // CHUNK,
+                "host_stage_keys_per_sec": round(reps * keys / dt_s, 0)}
+
+    def run_e2e(tg: int, n_chunks: int = 4, runs: int = 3) -> dict:
         """REAL staged-path throughput: host staging + H2D + dispatch +
         per-chunk D2H over fresh chunk items (the train_pass shape), with
         tg chunks sharing one transfer per leaf (h2d_stack_chunks). The
-        resident chain above deliberately excludes all of this; BENCH_r05
-        reports both (round-5 verdict item 4)."""
+        resident chain above deliberately excludes all of this; BENCH
+        reports both (round-5 verdict item 4). MEDIAN of `runs` timed
+        drives — the recorded ±30% container-CPU noise otherwise
+        dominates tier deltas (round-8 satellite)."""
         import jax.numpy as jnp
 
-        from paddlebox_tpu.train.trainer import (LogStageState,
-                                                 resolve_log_batches,
-                                                 run_scan_chunks)
+        from paddlebox_tpu.train.trainer import run_scan_chunks
         cap, W = trainer.table.capacity, trainer.table.layout.width
-        if trainer._push_write == "log":
-            K = feed.key_capacity()
-            lb = resolve_log_batches(cap, K, CHUNK)
-            trainer._log_stage = LogStageState(cap, K, lb)
-            trainer.table._slab = jnp.zeros((cap, W), jnp.float32)
-            state = {"buf": jnp.concatenate(
-                         [trainer.table._slab,
-                          jnp.zeros((lb * K, W), jnp.float32)]),
-                     "cur": jnp.zeros((), jnp.int32)}
-            trainer.table._slab = None
+        state = jnp.zeros((cap, W), jnp.float32)
 
-            def scan_call(carry, staged):
-                stacked, mpos = staged
-                st = carry[0]
-                if mpos is not None:
-                    st = trainer.fns.merge_log(st, jnp.asarray(mpos))
-                st, params, opt, losses, preds, key = \
-                    trainer.fns.scan_steps(st, carry[1], carry[2],
-                                           stacked, carry[3])
-                return (st, params, opt, key), losses, preds
-        else:
-            state = jnp.zeros((cap, W), jnp.float32)
-
-            def scan_call(carry, stacked):
-                slab, params, opt, losses, preds, key = \
-                    trainer.fns.scan_steps(carry[0], carry[1], carry[2],
-                                           stacked, carry[3])
-                return (slab, params, opt, key), losses, preds
+        def scan_call(carry, stacked):
+            slab, params, opt, losses, preds, key = \
+                trainer.fns.scan_steps(carry[0], carry[1], carry[2],
+                                       stacked, carry[3])
+            return (slab, params, opt, key), losses, preds
 
         def drive(carry, n):
             return run_scan_chunks(
@@ -202,35 +197,51 @@ def measure(platform: str) -> None:
         carry = (state, trainer.params, trainer.opt_state,
                  trainer.table.next_prng())
         carry, _, _ = drive(carry, 1)      # compile + warm this structure
-        t0 = time.perf_counter()
-        carry, losses, n_done = drive(carry, n_chunks)
-        dt_e2e = time.perf_counter() - t0
-        assert n_done == n_chunks * CHUNK and np.isfinite(losses).all()
-        return n_done * BATCH / dt_e2e
+        rates = []
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            carry, losses, n_done = drive(carry, n_chunks)
+            dt_e2e = time.perf_counter() - t0
+            assert n_done == n_chunks * CHUNK and np.isfinite(losses).all()
+            rates.append(n_done * BATCH / dt_e2e)
+        out = {"examples_per_sec": round(float(np.median(rates)), 1),
+               "runs": [round(r, 1) for r in rates]}
+        out.update(stage_stats())
+        return out
 
-    e2e_grouped = run_e2e(tg=4)
-    e2e_per_chunk = run_e2e(tg=1)
-    # wire-lean tier: ~70% fewer H2D bytes, device-side dedup (+ sort in
-    # the step) — the input-bound-link configuration (h2d_lean flag)
-    from paddlebox_tpu.config import flags as _flags
-    _flags.set_flag("h2d_lean", True)
-    saved_mode = trainer._push_write
-    trainer._push_write = "scatter"
-    try:
-        e2e_lean = run_e2e(tg=1)
-    finally:
-        _flags.set_flag("h2d_lean", False)
-        trainer._push_write = saved_mode
+    def lean_tier(uid: bool, delta: bool = False) -> dict:
+        _flags.set_flag("h2d_lean", True)
+        _flags.set_flag("h2d_uid_wire", uid)
+        _flags.set_flag("wire_delta_ids", delta)
+        try:
+            return run_e2e(tg=1)
+        finally:
+            _flags.set_flag("h2d_lean", False)
+            _flags.set_flag("h2d_uid_wire", True)
+            _flags.set_flag("wire_delta_ids", False)
+
+    tiers = {
+        "grouped": run_e2e(tg=4),
+        "ungrouped": run_e2e(tg=1),
+        # the round-5 ids-only wire: minimal bytes, jnp.unique in-step
+        "lean_ids_only": lean_tier(uid=False),
+        # the round-8 reunified lean wire: sorted uids ship, maps derive
+        # on device, fast push — the e2e headline tier
+        "uid_lean": lean_tier(uid=True),
+        # measured wire experiment: int16-delta-coded uid vector
+        "uid_delta": lean_tier(uid=True, delta=True),
+    }
+    e2e_grouped = tiers["grouped"]["examples_per_sec"]
+    e2e_per_chunk = tiers["ungrouped"]["examples_per_sec"]
+    e2e_lean = tiers["uid_lean"]["examples_per_sec"]
 
     # pass-amortized tier (round-6): the full begin_feed → train →
     # end_pass lifecycle at 0% and ~90% working-set overlap, full vs
     # incremental lifecycle — the honest cadence number the resident
     # chain above deliberately excludes. Runs on EVERY platform (CPU
     # fallback included) so the field is never absent from a BENCH json.
-    # NOTE: may downgrade a push_write=log trainer to scatter for its
-    # manual drive — runs LAST, with push_write recorded beforehand, and
-    # GUARDED: a failure here (fresh jit buckets, 12 extra lifecycle
-    # passes) must not discard the platform's already-measured headline.
+    # Runs LAST and GUARDED: a failure here (fresh jit buckets, 12 extra
+    # lifecycle passes) must not discard the measured headline.
     push_write_mode = trainer._push_write
     from tools.bench_util import measure_pass_amortized
     try:
@@ -251,9 +262,16 @@ def measure(platform: str) -> None:
         "steady_ms_per_step": round(dt * 1e3 / CHUNK, 4),
         "e2e_examples_per_sec": round(
             max(e2e_grouped, e2e_per_chunk, e2e_lean), 1),
-        "e2e_grouped": round(e2e_grouped, 1),
-        "e2e_ungrouped": round(e2e_per_chunk, 1),
-        "e2e_lean": round(e2e_lean, 1),
+        "e2e_grouped": e2e_grouped,
+        "e2e_ungrouped": e2e_per_chunk,
+        "e2e_lean": e2e_lean,
+        "e2e_lean_ids_only": tiers["lean_ids_only"]["examples_per_sec"],
+        "e2e_uid_lean": e2e_lean,
+        "e2e_uid_delta": tiers["uid_delta"]["examples_per_sec"],
+        "e2e_lean_vs_resident": round(e2e_lean / eps, 3),
+        "wire_bytes_per_step": {t: v["wire_bytes_per_step"]
+                                for t, v in tiers.items()},
+        "e2e_tiers": tiers,
         "pass_amortized": pass_amortized,
         "pass_amortized_examples_per_sec": pa_eps,
         "compile_warmup_s": round(t_compile, 1),
@@ -328,6 +346,12 @@ def main() -> None:
         "e2e_grouped": result.get("e2e_grouped"),
         "e2e_ungrouped": result.get("e2e_ungrouped"),
         "e2e_lean": result.get("e2e_lean"),
+        "e2e_lean_ids_only": result.get("e2e_lean_ids_only"),
+        "e2e_uid_lean": result.get("e2e_uid_lean"),
+        "e2e_uid_delta": result.get("e2e_uid_delta"),
+        "e2e_lean_vs_resident": result.get("e2e_lean_vs_resident"),
+        "wire_bytes_per_step": result.get("wire_bytes_per_step"),
+        "e2e_tiers": result.get("e2e_tiers"),
         "pass_amortized": result.get("pass_amortized"),
         "pass_amortized_examples_per_sec": result.get(
             "pass_amortized_examples_per_sec", 0.0),

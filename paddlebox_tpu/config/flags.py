@@ -119,13 +119,31 @@ define_flag("chunk_prefetch_depth", 1,
             "stage inline between dispatches")
 define_flag("h2d_lean", False,
             "input-bound deployments (slow host->device links): stage "
-            "train batches WITHOUT the host dedup products (~70% fewer "
-            "H2D bytes/batch: ids+segments+labels only) and dedup on "
-            "device instead (jnp.unique sort in the step, ~+8 ms on the "
-            "axon chip). Forces push_write=scatter (rebuild/log need "
-            "host-staged maps). Wins when H2D bytes dominate the pass "
-            "(the 68 MB/s tunnel regime, BASELINE.md e2e rows); the "
-            "resident-data step is faster with host dedup")
+            "train batches on the LEAN wire — no perm/inv/first_idx/pos "
+            "host products. With h2d_uid_wire (default) the sorted [K] "
+            "uid vector still ships and the step runs the FAST push "
+            "(device-derived maps by searchsorted — no jnp.unique sort); "
+            "with it off, ids only ship and the step pays the on-device "
+            "unique sort (~+8 ms on the axon chip, the round-5 tier). "
+            "Wins when H2D bytes dominate the pass (the 68 MB/s tunnel "
+            "regime, BASELINE.md e2e rows)")
+define_flag("h2d_uid_wire", True,
+            "lean-wire push reunification (round 8): under h2d_lean, ship "
+            "the [K] int32 SORTED deduped uid vector next to the ids and "
+            "derive perm/inverse/position maps on device (searchsorted + "
+            "segment scatter-add + scatter-min) — the fast host-dedup "
+            "push at lean-wire byte cost, bit-identical to the host-"
+            "staged path. Also switches the sharded runners' push staging "
+            "to uid-only (per-destination perm/inv/pos derived on device "
+            "from the a2a'd bucket ids). Off = the round-5 ids-only wire "
+            "(single-host trainer) / full host product staging (sharded)")
+define_flag("wire_delta_ids", False,
+            "measured wire experiment: ship the sorted uid vector as "
+            "(int32 base, int16 deltas) — 2 bytes/key less H2D, one "
+            "device cumsum to decode, pull-row reuse disabled (in-range "
+            "padding recode; see pass_table.delta_encode_uids). Raises "
+            "when an inter-uid gap exceeds int16 (very sparse pass "
+            "shapes). Single-host uid wire only")
 define_flag("h2d_stack_chunks", 1,
             "scan chunks whose host-staged batch arrays share ONE device "
             "transfer per leaf (the per-transfer fixed cost — ~250 ms on "
@@ -150,22 +168,13 @@ define_flag("profile_per_op", False,
 define_flag("push_write", "auto",
             "how the push writes updated rows back into the pass slab: "
             "'scatter' (row scatter, cost ~ touched rows — right for CPU "
-            "and small batches), 'rebuild' (host-staged pos map + full "
-            "slab gather/select, flat cost ~ slab bytes), 'log' (updated "
-            "rows append to a fixed-size log via dynamic_update_slice — "
-            "flat in SLAB size, tools/write_probe.py; the slab-"
-            "proportional merge amortizes over log_batches steps; "
-            "single-host trainer, not with expand/async/chunk-sync; "
-            "explicit opt-in only — 'auto' never selects it, see "
-            "resolve_push_write), or 'auto' (measured rebuild/scatter "
-            "crossover on accelerators; scatter on CPU)")
-define_flag("log_batches", 0,
-            "push_write=log: log capacity in batches (peak extra HBM = "
-            "this many [key_capacity, width] blocks; merge cadence = one "
-            "slab-sized gather/select per this many steps). 0 = auto: "
-            "capacity//(8*key_capacity) clamped to [max(16, scan_chunk), "
-            "256] — keeps the amortized merge under ~1 ms/step while the "
-            "log stays <~20% of slab bytes")
+            "and small batches), 'rebuild' (pos map + full slab "
+            "gather/select, flat cost ~ slab bytes; pos host-staged on "
+            "the full wire, device-derived on the uid wire), or 'auto' "
+            "(measured rebuild/scatter crossover on accelerators; "
+            "scatter on CPU). The round-5 'log' mode was deleted in "
+            "round 8 — no measured regime ever selected it; findings "
+            "retained in BASELINE.md round 5")
 define_flag("flatten_dense_opt", True,
             "wrap the dense optimizer in optax.flatten so the whole dense "
             "update runs as one fused vector op instead of per-parameter "

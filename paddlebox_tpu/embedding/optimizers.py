@@ -22,7 +22,7 @@ Rows whose merged g_show == 0 (padding) are returned unchanged.
 from __future__ import annotations
 
 import functools
-from typing import Callable, Optional, Tuple
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -325,6 +325,76 @@ def _merged_new_rows(slab, uids, perm, inv_sorted, grads, prng, layout,
                                 row_ids=uids)
 
 
+def decode_delta_uids(base: jnp.ndarray, d16: jnp.ndarray,
+                      cut: jnp.ndarray, capacity: int) -> jnp.ndarray:
+    """Reconstruct the sorted uid vector from the delta wire
+    (wire_delta_ids flag, pass_table.delta_encode_uids): data positions
+    i < cut decode as base + cumsum(d16)[i]; the trash/padding tail
+    i >= cut is arithmetic, (capacity-1) + (i-cut). One [K] int32 cumsum
+    + select — the ~2 bytes/key wire saving costs a prefix sum instead
+    of nothing (measured flag, BASELINE.md round 8)."""
+    dec = base + jnp.cumsum(d16.astype(jnp.int32))
+    i = jnp.arange(d16.shape[0], dtype=jnp.int32)
+    return jnp.where(i >= cut, (capacity - 1) + (i - cut), dec)
+
+
+def push_sparse_uidwire(slab: jnp.ndarray, uids: jnp.ndarray,
+                        ids: jnp.ndarray, grads: jnp.ndarray,
+                        prng: jax.Array, layout: ValueLayout,
+                        conf: SparseOptimizerConfig,
+                        pulled_rows: Optional[jnp.ndarray] = None,
+                        write: str = "scatter") -> jnp.ndarray:
+    """Uid-wire push (round 8 — the lean wire and the fast push reunified):
+    the host ships ONLY the SORTED deduped uid vector ([K] int32); every
+    other dedup product derives on device —
+
+      inv    binary search of each occurrence's id against the sorted
+             uids (jnp.searchsorted: ~log2 K gather/compare rounds, no
+             full device sort, no jnp.unique with a padded size=)
+      merge  segment scatter-add over inv — same per-unique ascending-
+             occurrence addition order as push_sparse_hostdedup's sorted
+             segment-sum, so the merged grads are bit-identical
+      first  scatter-min of occurrence indices (the pull-row-reuse index
+             first_occurrence_idx stages host-side on the full wire)
+      pos    (write='rebuild') one [capacity] int32 scatter — the map
+             pos_for_rebuild stages host-side, at 4 bytes/slab-row H2D
+
+    uids: [K] NONDECREASING unique ids, tail padded with out-of-slab ids
+          (pass_table.dedup_uids_sorted — NOT dedup_ids, whose native
+          fast path returns hash order; sortedness is load-bearing here).
+    ids:  [K] the batch's per-occurrence ids (already on the wire for the
+          pull); every entry must be present in uids.
+    pulled_rows: optional pull-gather reuse. Callers staging IN-RANGE
+          padding uids (the delta wire's no-trash-row edge) must pass
+          None: an inactive row's pass-through value then comes from a
+          real slab gather, never from an arbitrary occurrence's row.
+    Reference work shape: PushSparseGradCaseGPU merge + update
+    (box_wrapper_impl.h:373-522); dedup never skipped (impl.h:129).
+    """
+    K = ids.shape[0]
+    U = uids.shape[0]
+    inv = jnp.searchsorted(uids, ids).astype(jnp.int32)
+    merged = jax.ops.segment_sum(grads, inv, num_segments=U)
+    if pulled_rows is not None:
+        first = jnp.full((U,), K - 1, jnp.int32).at[inv].min(
+            jnp.arange(K, dtype=jnp.int32))
+        rows = jnp.take(pulled_rows, first, axis=0)
+    else:
+        rows = jnp.take(slab, uids, axis=0, mode="clip")
+    new_rows = _dispatch_apply_push(rows, merged, prng, layout, conf,
+                                    row_ids=uids)
+    if write == "rebuild":
+        pos = jnp.full((slab.shape[0],), -1, jnp.int32).at[uids].set(
+            jnp.arange(U, dtype=jnp.int32), mode="drop",
+            unique_indices=True)
+        sel = jnp.take(new_rows, jnp.clip(pos, 0, U - 1), axis=0)
+        return jnp.where((pos >= 0)[:, None], sel, slab)
+    if write != "scatter":
+        raise ValueError(f"uid-wire write strategy {write!r} "
+                         "(scatter or rebuild)")
+    return slab.at[uids].set(new_rows, mode="drop", unique_indices=True)
+
+
 def push_sparse_rebuild(slab: jnp.ndarray, uids: jnp.ndarray,
                         pos: jnp.ndarray, perm: jnp.ndarray,
                         inv_sorted: jnp.ndarray, grads: jnp.ndarray,
@@ -356,60 +426,6 @@ def push_sparse_rebuild(slab: jnp.ndarray, uids: jnp.ndarray,
     sel = jnp.take(new_rows, jnp.clip(pos, 0, new_rows.shape[0] - 1),
                    axis=0)
     return jnp.where((pos >= 0)[:, None], sel, slab)
-
-
-def push_sparse_log(buf: jnp.ndarray, cur: jnp.ndarray, capacity: int,
-                    uids: jnp.ndarray, perm: jnp.ndarray,
-                    inv_sorted: jnp.ndarray, grads: jnp.ndarray,
-                    prng: jax.Array, layout: ValueLayout,
-                    conf: SparseOptimizerConfig,
-                    pulled_rows: jnp.ndarray,
-                    first_idx: jnp.ndarray
-                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Log-structured push over the UNIFIED buffer: buf[0:capacity) is
-    the slab, buf[capacity:) is the append log; updated rows DUS into the
-    log region at the carried cursor and the slab region is untouched.
-
-    Why this shape (round-5 measured design, tools/log_ablate.py on the
-    axon v5e runtime):
-      * a per-step slab write costs ~ slab bytes (rebuild) or ~ index
-        count + buffer copy (scatter) — both scale;
-      * a DUS append is ~1-2 ms flat — but a SPLIT slab+log needed a
-        2-gather+select combined pull, measured +4.3 ms/step in-scan
-        (the select structure itself, not a read/write hazard);
-      * unifying the buffer makes the pull ONE plain gather, because the
-        host already stages combined indices (`src` = slab id, or
-        capacity + log slot — trainer.LogStageState.assign).
-    The slab-proportional cost moves to a once-per-log-fill merge
-    (merge_log_slab), amortized over log_batches steps.
-
-    pulled_rows/first_idx are REQUIRED: row values fed to the optimizer
-    must be the latest versions, i.e. the combined-index pull — a bare
-    slab gather is stale for keys updated since the last merge.
-    Reference work shape: PushSparseGradCaseGPU merge + update
-    (box_wrapper_impl.h:373-522); the write strategy is ours.
-    """
-    new_rows = _merged_new_rows(buf, uids, perm, inv_sorted, grads, prng,
-                                layout, conf, pulled_rows, first_idx)
-    buf = jax.lax.dynamic_update_slice(
-        buf, new_rows, (jnp.int32(capacity) + cur, jnp.int32(0)))
-    return buf, cur + jnp.int32(uids.shape[0])
-
-
-def merge_log_slab(buf: jnp.ndarray, mpos: jnp.ndarray,
-                   capacity: int) -> jnp.ndarray:
-    """Fold the log region back into the slab region of the unified
-    buffer: mpos ([capacity] int32, host-staged) is each slab row's
-    LATEST log slot since the previous merge, -1 for untouched rows.
-    One gather + one select ~ buffer bytes — paid once per log fill,
-    not per step. The log region is left as-is: its slots are dead until
-    the host reassigns them (LogStageState.take_mpos resets)."""
-    L = buf.shape[0] - capacity
-    mfull = jnp.concatenate(
-        [mpos, jnp.full((L,), -1, jnp.int32)])
-    sel = jnp.take(buf, jnp.int32(capacity) + jnp.clip(mfull, 0, L - 1),
-                   axis=0)
-    return jnp.where((mfull >= 0)[:, None], sel, buf)
 
 
 def make_push_fn(layout: ValueLayout,

@@ -151,6 +151,56 @@ def dedup_ids(ids: np.ndarray, pad_base: int):
     return uids, perm, inv
 
 
+def dedup_uids_sorted(ids: np.ndarray, pad_base: int) -> np.ndarray:
+    """[K] SORTED unique ids, tail padded with pad_base+i — the uid-wire
+    host product (round 8): the device derives inv/first/pos by binary
+    search against this vector, so unlike dedup_ids (whose native fast
+    path returns hash-probe order) sortedness is load-bearing. np.unique
+    is the whole computation — one comparison sort of the batch's ids on
+    the (overlapped) host stage buys removing the per-step device sort
+    AND the perm/inv/first_idx wire (3x [K] int32/batch)."""
+    ids = np.ascontiguousarray(np.asarray(ids), np.int32)
+    K = ids.shape[0]
+    if K and ids.min() < 0:
+        raise ValueError("dedup_uids_sorted expects nonnegative int32 "
+                         "pass-local ids")
+    uniq = np.unique(ids)
+    out = np.empty(K, np.int32)
+    n = uniq.shape[0]
+    out[:n] = uniq
+    out[n:] = pad_base + np.arange(K - n, dtype=np.int32)
+    return out
+
+
+def delta_encode_uids(uids: np.ndarray, pad_base: int):
+    """(base, d16, cut) int16-delta wire coding of a SORTED uid vector
+    (wire_delta_ids flag). DATA ids (< pad_base-1, i.e. below the trash
+    row) carry real deltas: uids[i] = base + cumsum(d16)[i] for i < cut,
+    d16[0] = 0. Everything from the trash id up (trash + the out-of-slab
+    padding tail — jumps far beyond int16) is NOT delta-coded at all:
+    the device reconstructs position i >= cut as (pad_base-1) + (i-cut),
+    which reproduces the exact [trash, pad_base, pad_base+1, ...] tail
+    when the trash id is present. When it is absent, position `cut`
+    decodes to the trash id anyway — no occurrence maps to it (its
+    merged g_show is 0), so the one possible in-range write is the trash
+    row's own unchanged bits (the pulled_rows=None contract in
+    push_sparse_uidwire). A DATA-id gap > 32767 cannot be coded in int16
+    and raises — disable the flag for pass shapes that sparse (this is a
+    measured wire experiment, not a default)."""
+    uids = np.asarray(uids, np.int32)
+    cut = int(np.searchsorted(uids, pad_base - 1))
+    d = np.zeros(uids.shape[0], np.int32)
+    if cut:
+        d[1:cut] = np.diff(uids[:cut])
+    if d.size and int(d.max(initial=0)) > np.iinfo(np.int16).max:
+        raise ValueError(
+            "wire_delta_ids: inter-uid gap %d exceeds int16 — this pass "
+            "shape is too sparse for the delta wire (unset the flag)"
+            % int(d.max()))
+    base = uids[0] if cut else np.int32(0)
+    return np.int32(base), d.astype(np.int16), np.int32(cut)
+
+
 def first_occurrence_idx(perm: np.ndarray, inv: np.ndarray) -> np.ndarray:
     """[K] int32 occurrence index of each dedup unique's FIRST occurrence:
     first_idx[j] is a position into the batch's key vector whose id is
@@ -597,6 +647,11 @@ class PassTable:
         """Host-side per-batch dedup for push_sparse_hostdedup (see
         dedup_ids): padding ids start at this table's capacity."""
         return dedup_ids(ids, self.capacity)
+
+    def uids_for_push(self, ids: np.ndarray) -> np.ndarray:
+        """Sorted uid-wire dedup product (see dedup_uids_sorted): padding
+        ids start at this table's capacity."""
+        return dedup_uids_sorted(ids, self.capacity)
 
     def pos_for_rebuild(self, uids: np.ndarray) -> np.ndarray:
         """[capacity] int32 inverse of the dedup's uids for the

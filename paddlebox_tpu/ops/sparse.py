@@ -48,23 +48,6 @@ def pull_sparse(slab: jnp.ndarray, ids: jnp.ndarray,
     return pull_view_from_rows(slab[ids], layout)
 
 
-def pull_rows_combined(slab: jnp.ndarray, log: jnp.ndarray,
-                       src: jnp.ndarray) -> jnp.ndarray:
-    """Latest-version full-row gather for the log-structured push
-    (push_write='log'): src < capacity addresses the slab, src >= capacity
-    addresses log[src - capacity]. The host stages src so that every key
-    reads its most recent value — slab row, or the log entry a previous
-    step's push appended (trainer.LogStageState.assign). Two gathers + one
-    select, all ~ K bytes: measured +0.1-0.9 ms over a single gather
-    (tools/write_probe.py pull2 vs pull1)."""
-    cap = slab.shape[0]
-    in_slab = src < cap
-    s_rows = jnp.take(slab, jnp.where(in_slab, src, 0), axis=0)
-    l_rows = jnp.take(log, jnp.clip(src - cap, 0, log.shape[0] - 1),
-                      axis=0)
-    return jnp.where(in_slab[:, None], s_rows, l_rows)
-
-
 def build_push_grads(d_emb: jnp.ndarray, slots: jnp.ndarray,
                      clicks: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     """Per-key push rows [K, 4+D] from the model's embedding cotangent.

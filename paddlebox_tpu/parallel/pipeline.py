@@ -1109,12 +1109,14 @@ class ShardedCtrPipelineRunner:
     # ------------------------------------------------------------- jit step
     def _build_step(self):
         from paddlebox_tpu.embedding.optimizers import (
-            push_sparse_dedup, push_sparse_hostdedup, push_sparse_rebuild)
+            push_sparse_dedup, push_sparse_hostdedup, push_sparse_rebuild,
+            push_sparse_uidwire)
         from paddlebox_tpu.ops.sparse import (build_push_grads,
                                               build_push_grads_extended,
                                               pull_sparse,
                                               pull_sparse_extended)
 
+        push_write = self._push_write   # uid-wire write strategy (static)
         S, M, Ml, mb = self.n_stages, self.n_micro, self.m_local, self.mb
         num_slots, use_cvm = self.num_slots, self.use_cvm
         layout, conf = self.layout, self.table_cfg.optimizer
@@ -1248,7 +1250,7 @@ class ShardedCtrPipelineRunner:
                     slab, batch["push_uids"], batch["push_pos"],
                     batch["push_perm"], batch["push_inv"],
                     recv_g.reshape(Pn * KB, -1), sub, layout, conf)
-            elif "push_uids" in batch:
+            elif "push_perm" in batch:
                 # incoming ids are host-known in a single process, so the
                 # shard-side dedup was precomputed (device_batch) — no
                 # per-step on-device jnp.unique sort (the dominant
@@ -1258,6 +1260,14 @@ class ShardedCtrPipelineRunner:
                     slab, batch["push_uids"], batch["push_perm"],
                     batch["push_inv"], recv_g.reshape(Pn * KB, -1), sub,
                     layout, conf)
+            elif "push_uids" in batch:
+                # uid wire (h2d_uid_wire, round 8): only the sorted uid
+                # vector staged — the incoming ids are the a2a'd buckets
+                # (req) and the maps derive by searchsorted in the step
+                slab = push_sparse_uidwire(
+                    slab, batch["push_uids"], req.reshape(-1),
+                    recv_g.reshape(Pn * KB, -1), sub, layout, conf,
+                    write=push_write)
             else:
                 # multi-process: incoming ids live on peers — device dedup
                 slab = push_sparse_dedup(slab, req.reshape(-1),
@@ -1397,13 +1407,15 @@ class ShardedCtrPipelineRunner:
             # shared implementation with the sharded trainer; reference
             # cluster-wide routing, heter_comm_inl.h:2231/1117). Eval
             # never pushes.
+            from paddlebox_tpu.config import flags
             from paddlebox_tpu.parallel.sharded_table import stage_push_dedup
             leaves.update(stage_push_dedup(
                 leaves["buckets"], self.local_positions, self.P,
                 self.table.shard_cap, self.multiprocess,
                 self.fleet.all_gather if self.multiprocess else None,
                 rebuild=self._push_write == "rebuild", pool=pool,
-                note_touched=self.table.note_touched))
+                note_touched=self.table.note_touched,
+                uid_only=bool(flags.get_flag("h2d_uid_wire"))))
         return {k: self._put_flat(np.stack(v)) for k, v in leaves.items()}
 
     def begin_pass(self) -> None:

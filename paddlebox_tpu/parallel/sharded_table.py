@@ -123,7 +123,8 @@ def exchange_outgoing_buckets(buckets_local: np.ndarray,
 
 def stage_push_dedup(buckets, local_positions, num_devices: int,
                      shard_cap: int, multiprocess: bool, all_gather,
-                     rebuild: bool, pool, note_touched=None):
+                     rebuild: bool, pool, note_touched=None,
+                     uid_only: bool = False):
     """Per-destination push-dedup staging shared by BOTH sharded runners
     (trainer's _step_host_arrays + pipeline's device_batch): makes each
     shard's incoming a2a ids host-known (exchange_outgoing_buckets when
@@ -131,8 +132,19 @@ def stage_push_dedup(buckets, local_positions, num_devices: int,
     onto the stager pool. Returns {"push_uids": [...], "push_perm": ...,
     "push_inv": ..., ["push_pos": ...]} in destination order (owned
     destinations only in a multi-process job — the process-local piece
-    of the [P, ...] global arrays)."""
+    of the [P, ...] global arrays).
+
+    uid_only (h2d_uid_wire, round 8): stage ONLY the per-destination
+    SORTED uid vector — the device step already holds each shard's
+    incoming ids (the a2a'd buckets) and derives perm/inv (and the
+    rebuild pos) by searchsorted against the sorted uids
+    (push_sparse_uidwire). Cuts the per-step staged push wire from
+    3-4 [P, P*KB]-shaped arrays to one, and the host dedup to one
+    np.unique per destination; composes with the multi-process bucket
+    exchange unchanged (the uids must still be host-known cluster-wide
+    for the touched-row accounting and writeback delta)."""
     from paddlebox_tpu.embedding.pass_table import (dedup_ids,
+                                                    dedup_uids_sorted,
                                                     pos_for_rebuild)
     if multiprocess:
         global_buckets = exchange_outgoing_buckets(
@@ -145,20 +157,28 @@ def stage_push_dedup(buckets, local_positions, num_devices: int,
     def dedup_dest(d):
         incoming = np.concatenate(
             [global_buckets[src][d] for src in range(num_devices)])
-        uids, perm, inv = dedup_ids(incoming, shard_cap)
+        if uid_only:
+            uids = dedup_uids_sorted(incoming, shard_cap)
+            perm = inv = None
+        else:
+            uids, perm, inv = dedup_ids(incoming, shard_cap)
         if note_touched is not None:
             # every id this destination shard will push rides these uids —
             # the per-pass touched-row accumulation point (incremental
             # EndPass writes back only these rows)
             note_touched(d, uids)
-        pos = pos_for_rebuild(uids, shard_cap) if rebuild else None
+        pos = (pos_for_rebuild(uids, shard_cap)
+               if rebuild and not uid_only else None)
         return uids, perm, inv, pos
 
-    out = {"push_uids": [], "push_perm": [], "push_inv": []}
+    out = {"push_uids": []}
+    if not uid_only:
+        out.update(push_perm=[], push_inv=[])
     for uids, perm, inv, pos in pool.map(dedup_dest, dests):
         out["push_uids"].append(uids)
-        out["push_perm"].append(perm)
-        out["push_inv"].append(inv)
+        if perm is not None:
+            out["push_perm"].append(perm)
+            out["push_inv"].append(inv)
         if pos is not None:
             out.setdefault("push_pos", []).append(pos)
     return out

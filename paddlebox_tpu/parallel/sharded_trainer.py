@@ -37,7 +37,8 @@ from paddlebox_tpu.data.dataset import BoxDataset
 from paddlebox_tpu.data.packer import PackedBatch
 from paddlebox_tpu.embedding.optimizers import (push_sparse_dedup,
                                                 push_sparse_hostdedup,
-                                                push_sparse_rebuild)
+                                                push_sparse_rebuild,
+                                                push_sparse_uidwire)
 from paddlebox_tpu.embedding.pass_table import dedup_ids
 from paddlebox_tpu.metrics.auc import MetricRegistry
 from paddlebox_tpu.models.base import ModelSpec
@@ -383,6 +384,7 @@ class ShardedBoxTrainer:
                              "supported in one model")
         collect_T = self._collect_T
         a2a_dtype, a2a_cast = self.a2a_dtype, self.a2a_cast
+        push_write = self._push_write   # uid-wire write strategy (static)
         pull_emb, forward_logits, preds_of = self._pull_and_forward()
 
         def shard_step(slab, params, opt_state, batch, prng, mtab, mstats):
@@ -553,13 +555,22 @@ class ShardedBoxTrainer:
                     slab, batch["push_uids"], batch["push_pos"],
                     batch["push_perm"], batch["push_inv"],
                     recv_g.reshape(Pn * KB, -1), prng, layout, conf)
-            elif "push_uids" in batch:
-                # single-process mesh: the incoming-id dedup was precomputed
+            elif "push_perm" in batch:
+                # full host wire: the incoming-id dedup was precomputed
                 # on the host (shard_batches) — no device sort
                 slab = push_sparse_hostdedup(
                     slab, batch["push_uids"], batch["push_perm"],
                     batch["push_inv"], recv_g.reshape(Pn * KB, -1), prng,
                     layout, conf)
+            elif "push_uids" in batch:
+                # uid wire (h2d_uid_wire, round 8): the shard's incoming
+                # ids ARE the a2a'd buckets already on device (req), so
+                # only the sorted uid vector staged — perm/inv (and the
+                # rebuild pos) derive by searchsorted in the step
+                slab = push_sparse_uidwire(
+                    slab, batch["push_uids"], req.reshape(-1),
+                    recv_g.reshape(Pn * KB, -1), prng, layout, conf,
+                    write=push_write)
             else:
                 slab = push_sparse_dedup(slab, req.reshape(-1),
                                          recv_g.reshape(Pn * KB, -1), prng,
@@ -714,13 +725,15 @@ class ShardedBoxTrainer:
             # destination shard; no runner is left on the on-device
             # jnp.unique sort path (round-5 verdict item 2; ONE shared
             # implementation with the pipeline runner)
+            from paddlebox_tpu.config import flags
             from paddlebox_tpu.parallel.sharded_table import stage_push_dedup
             stacked.update(stage_push_dedup(
                 stacked["buckets"], self.local_positions, self.P,
                 self.table.shard_cap, self.multiprocess,
                 self.fleet.all_gather if self.multiprocess else None,
                 rebuild=self._push_write == "rebuild", pool=pool,
-                note_touched=self.table.note_touched))
+                note_touched=self.table.note_touched,
+                uid_only=bool(flags.get_flag("h2d_uid_wire"))))
         return {k: np.stack(v) for k, v in stacked.items()}
 
     def shard_batches(self, per_worker: List[List[PackedBatch]],

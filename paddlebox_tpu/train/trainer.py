@@ -32,12 +32,13 @@ from paddlebox_tpu.config.configs import (DataFeedConfig, TableConfig,
 from paddlebox_tpu.data.dataset import BoxDataset
 from paddlebox_tpu.data.packer import PackedBatch
 from paddlebox_tpu.embedding.accessor import ValueLayout
-from paddlebox_tpu.embedding.optimizers import (merge_log_slab,
+from paddlebox_tpu.embedding.optimizers import (decode_delta_uids,
                                                 push_sparse_hostdedup,
-                                                push_sparse_log,
                                                 push_sparse_rebuild,
+                                                push_sparse_uidwire,
                                                 rebuild_uids)
 from paddlebox_tpu.embedding.pass_table import (PassTable, dedup_ids,
+                                                delta_encode_uids,
                                                 first_occurrence_idx,
                                                 pos_for_rebuild)
 from paddlebox_tpu.metrics.auc import MetricRegistry
@@ -75,10 +76,10 @@ class TrainStepFns:
     forward: Optional[Callable] = None          # (params, emb, batch) -> (loss, preds)
     sparse_push: Optional[Callable] = None      # (slab, demb, batch, sub) -> slab
     dn_update: Optional[Callable] = None        # (params, emb, batch) -> params
-    # push_write='log': (state, mpos) -> state with the log folded into
-    # the slab and the cursor reset (dispatched between chunks when the
-    # host's LogStageState fills, and once before end_pass)
-    merge_log: Optional[Callable] = None
+    # the slab-write strategy BAKED into the uid-wire push branch at build
+    # time (scatter | rebuild — derived on device, so unlike the full
+    # wire it cannot follow a live push_write flip; train_pass guards)
+    uid_write: str = "scatter"
 
 
 def make_scan(step_fn: Callable, extra_carry: int = 0) -> Callable:
@@ -310,50 +311,47 @@ def check_expand_config(model, layout: ValueLayout, use_expand: bool) -> None:
 
 
 def resolve_push_write(capacity: Optional[int] = None,
-                       batch_keys: Optional[int] = None,
-                       allow_log: bool = False) -> str:
-    """'scatter' | 'rebuild' | 'log' from the push_write flag.
+                       batch_keys: Optional[int] = None) -> str:
+    """'scatter' | 'rebuild' from the push_write flag.
 
     Measured regimes (tools/tpu_probe.py + tools/capacity_probe.py,
     ms/step at the bench batch; BASELINE.md round-5 rows):
 
-        cap       rebuild    scatter    log
-        1M rows   14.9-16.1  ~16 (r4)   15.7
-        4M        34.4-36.1  25.6       26.3
-        33M       (compile×) **23.9**   104.7
+        cap       rebuild    scatter
+        1M rows   14.9-16.1  ~16 (r4)
+        4M        34.4-36.1  25.6
+        33M       (compile×) **23.9**
 
-    Where each mode wins, and what 'auto' does with that:
-
-    * rebuild — full slab gather/select driven by a host-staged pos map;
-      cost ~ slab bytes, so it wins SMALL slabs (≤ ~16× the per-batch
-      key budget) where the gather is cheaper than a scatter's index
-      plumbing. 'auto' selects it in exactly that regime on accelerators.
+    * rebuild — full slab gather/select driven by a pos map; cost ~ slab
+      bytes, so it wins SMALL slabs (≤ ~16× the per-batch key budget)
+      where the gather is cheaper than a scatter's index plumbing.
+      'auto' selects it in exactly that regime on accelerators.
     * scatter — donated in-step row scatter; ~capacity-flat, wins at
       scale. (The r4 belief that scatter grows with capacity came from a
       non-donated probe harness paying an output-copy per call —
       BASELINE.md round-5 "probe-harness corrections".) 'auto' selects it
       beyond the rebuild regime, and ALWAYS on CPU.
-    * log — DEPRECATED as an auto candidate: 'auto' can never select it.
-      It beats rebuild at mid-size slabs (the 4M row above) but its
-      dynamic_update_slice pays a buffer-proportional cost the scatter
-      does not, it loses badly at scale (104.7 ms at 33M), and it is
-      restricted to the single-host trainer without expand/async/
-      chunk-sync. It remains available by explicit push_write='log' only;
-      findings: BASELINE.md round-5 "log-structured write" rows.
 
-    So: auto = rebuild when capacity ≤ ~16× batch keys on tpu/axon,
-    scatter otherwise. h2d_lean forces scatter (no host-staged maps on
-    the wire-lean path).
+    The round-5 'log' mode (DUS append + amortized merge) never earned an
+    auto regime — scatter matched or beat it everywhere that mattered —
+    and was DELETED in round 8 (verdict item 8, net-negative LoC); its
+    measurements live on in BASELINE.md round 5.
+
+    Wire interaction: the full wire stages the rebuild pos map on the
+    host; the uid wire derives it on device (push_sparse_uidwire), same
+    regime policy. Only the ids-only lean wire (h2d_lean with
+    h2d_uid_wire off) forces scatter — it ships no uid vector to derive
+    anything from.
     """
     from paddlebox_tpu.config import flags
     mode = flags.get_flag("push_write")
-    if flags.get_flag("h2d_lean"):
-        # wire-lean staging ships no host dedup products, so the
-        # host-map-dependent writes (rebuild pos / log src) can't stage
+    if flags.get_flag("h2d_lean") and not flags.get_flag("h2d_uid_wire"):
+        # ids-only wire: no host dedup products, no device-derivable maps
         if mode not in ("auto", "scatter"):
             raise ValueError(
-                f"h2d_lean stages no host push products; push_write="
-                f"{mode!r} needs them — use 'auto' or 'scatter'")
+                f"h2d_lean without h2d_uid_wire stages no push products; "
+                f"push_write={mode!r} needs them — use 'auto' or "
+                "'scatter'")
         return "scatter"
     if mode == "auto":
         if jax.default_backend() not in ("tpu", "axon"):
@@ -361,82 +359,11 @@ def resolve_push_write(capacity: Optional[int] = None,
         if capacity and batch_keys and capacity > 16 * batch_keys:
             return "scatter"
         return "rebuild"
-    if mode == "log" and not allow_log:
-        raise ValueError(
-            "push_write=log is unsupported on this path (expand models, "
-            "async dense, chunk-sync sparse, and the sharded runners "
-            "stage per-batch products the log contract does not cover) — "
-            "use 'auto', 'rebuild', or 'scatter'")
-    if mode not in ("scatter", "rebuild", "log"):
-        raise ValueError(f"push_write flag: unknown mode {mode!r}")
+    if mode not in ("scatter", "rebuild"):
+        hint = (" — 'log' was deleted in round 8 (findings: BASELINE.md "
+                "round 5)" if mode == "log" else "")
+        raise ValueError(f"push_write flag: unknown mode {mode!r}{hint}")
     return mode
-
-
-def resolve_log_batches(capacity: int, batch_keys: int,
-                        scan_chunk: int) -> int:
-    """Log capacity in batches for push_write='log' (log_batches flag;
-    0 = auto). Auto balances the amortized merge (~ slab bytes / this)
-    against log HBM (~ this × batch bytes): capacity // (8 × batch_keys),
-    clamped to [max(16, scan_chunk), 256]. Must cover at least one scan
-    chunk — merges only happen at dispatch boundaries."""
-    from paddlebox_tpu.config import flags
-    n = int(flags.get_flag("log_batches"))
-    lo = max(16, scan_chunk)
-    if n == 0:
-        return max(lo, min(256, capacity // max(1, 8 * batch_keys)))
-    if n < scan_chunk:
-        raise ValueError(
-            f"log_batches={n} < scan_chunk={scan_chunk}: the log must "
-            "hold a whole chunk (merges happen between dispatches)")
-    return n
-
-
-class LogStageState:
-    """Host bookkeeping for push_write='log' — the exact mirror of the
-    device-side (log, cur) state in push_sparse_log.
-
-    Per trained batch, IN DISPATCH ORDER, assign() computes the combined
-    pull index (`src`: slab id, or capacity + log slot of the latest
-    version) from the pre-batch view, then registers the batch's writes
-    at the advancing cursor. take_mpos() snapshots the latest-slot map
-    for merge_log_slab and resets for the next fill. NOT thread-safe:
-    callers serialize assignment in staging order (the parallel per-batch
-    staging computes lookup/dedup; this sequential tail is a few
-    vectorized [K] numpy ops)."""
-
-    def __init__(self, capacity: int, key_capacity: int,
-                 log_batches: int) -> None:
-        self.capacity = capacity
-        self.K = key_capacity
-        self.log_rows = log_batches * key_capacity
-        self.last_slot = np.full(capacity, -1, np.int32)
-        self.cur = 0
-
-    def need_merge(self, n_batches: int = 1) -> bool:
-        return self.cur + n_batches * self.K > self.log_rows
-
-    def take_mpos(self) -> np.ndarray:
-        mpos = self.last_slot.copy()
-        self.last_slot.fill(-1)
-        self.cur = 0
-        return mpos
-
-    def assign(self, ids: np.ndarray, uids: np.ndarray) -> np.ndarray:
-        if uids.shape[0] != self.K:
-            raise ValueError(
-                f"uids length {uids.shape[0]} != key capacity {self.K}")
-        if self.need_merge():
-            raise RuntimeError("log full — caller must merge first "
-                               "(take_mpos) before staging this batch")
-        # pull reads the PRE-batch view: resolve src before registering
-        # this batch's own writes (the step pulls, then pushes)
-        ls = self.last_slot[ids]
-        src = np.where(ls >= 0, self.capacity + ls, ids).astype(np.int32)
-        real = uids < self.capacity
-        slots = self.cur + np.arange(self.K, dtype=np.int32)
-        self.last_slot[uids[real]] = slots[real]
-        self.cur += self.K
-        return src
 
 
 def resolve_push_write_sharded(shard_cap: int, num_shards: int,
@@ -584,7 +511,8 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
                     use_cvm: bool = True,
                     async_dense: bool = False,
                     compute_dtype: str = "float32",
-                    sparse_chunk: int = 0) -> TrainStepFns:
+                    sparse_chunk: int = 0,
+                    uid_write: str = "scatter") -> TrainStepFns:
     conf = table.optimizer
     multi_task = len(getattr(model, "task_names", ("ctr",))) > 1
     wants_rank_offset = model_accepts_rank_offset(model)
@@ -671,31 +599,14 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
 
     def _pull(state, batch):
         """(emb_view, full_rows) — full_rows kept for the push's row reuse
-        (None on the expand path, which pulls a dual view).
-
-        state is either the bare slab, or the log-structured bundle
-        {slab, log, cur} (push_write='log') — there the pull reads each
-        key's LATEST version through the host-staged combined index."""
-        if isinstance(state, dict):
-            # unified slab+log buffer: src addresses the latest version of
-            # every key directly — one plain gather (the split-buffer
-            # 2-gather select measured +4.3 ms/step, tools/log_ablate.py).
-            # The barrier materializes the gathered rows BEFORE anything
-            # else: without it XLA fuses this gather into late consumers,
-            # the buffer stays live past the push's DUS, and the DUS
-            # writes a full buffer COPY every step (~2.6 ms per M slab
-            # rows measured, tools/capacity_probe.py round 5)
-            rows = jax.lax.optimization_barrier(
-                jnp.take(state["buf"], batch["src"], axis=0))
-            return pull_view_from_rows(rows, layout), rows
+        (None on the expand path, which pulls a dual view)."""
         ids = batch["ids"]
         if use_expand:
             return pull_sparse_extended(state, ids, layout), None
         rows = state[ids]
         return pull_view_from_rows(rows, layout), rows
 
-    def _sparse_push(state, demb, batch, sub, pulled_rows=None):
-        slab = state["buf"] if isinstance(state, dict) else state
+    def _sparse_push(slab, demb, batch, sub, pulled_rows=None):
         # per-key click = its instance's label (first task's label)
         key_label_src = batch["labels_" + model.task_names[0]] if multi_task \
             else batch["labels"]
@@ -708,13 +619,32 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
             push_grads = build_push_grads(demb, _key_slots(batch), clicks,
                                           _key_valid(batch))
         if "perm" not in batch:
+            if "uid_d16" in batch:
+                # delta-coded uid wire: decode, and DON'T reuse pulled
+                # rows — the decoded tail can name the trash row when it
+                # was absent from the batch, and its pass-through bits
+                # must come from a real slab gather
+                uids = decode_delta_uids(batch["uid_base"],
+                                         batch["uid_d16"],
+                                         batch["uid_cut"],
+                                         table.pass_capacity)
+                return push_sparse_uidwire(
+                    slab, uids, batch["ids"], push_grads, sub, layout,
+                    conf, pulled_rows=None, write=uid_write)
+            if "uids" in batch:
+                # uid wire (round 8): the host shipped ONLY the sorted
+                # uid vector; inv/first (and the rebuild pos) derive on
+                # device — the fast push at lean-wire byte cost
+                return push_sparse_uidwire(
+                    slab, batch["uids"], batch["ids"], push_grads, sub,
+                    layout, conf, pulled_rows=pulled_rows,
+                    write=uid_write)
             from paddlebox_tpu.config import flags as _flags
             if _flags.get_flag("h2d_lean"):
-                # deliberate wire-lean mode: the dedup runs on device
-                # (jnp.unique sort — the cost host dedup normally
-                # removes) because shipping the host products costs more
-                # than the sort on input-bound links (BASELINE.md round-5
-                # e2e measurements)
+                # ids-only wire (h2d_uid_wire off): the dedup runs on
+                # device (jnp.unique sort — the cost the uid wire
+                # removes); kept as the measured fallback for links where
+                # even the uid vector's bytes dominate
                 from paddlebox_tpu.embedding.optimizers import (
                     push_sparse_dedup)
                 return push_sparse_dedup(slab, batch["ids"], push_grads,
@@ -735,20 +665,6 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         # full row from this same pre-update slab
         fi = batch.get("first_idx") if pulled_rows is not None else None
         rows = pulled_rows if fi is not None else None
-        if isinstance(state, dict):
-            # log-structured write (push_write='log'): requires the
-            # combined pull (rows ARE the latest versions) — the slab
-            # region alone may be stale for keys updated since the merge
-            if rows is None or fi is None:
-                raise RuntimeError(
-                    "push_write=log needs the pull-row reuse products "
-                    "(pulled_rows + first_idx) — staging must provide "
-                    "src/first_idx and the model must not be expand")
-            buf, cur = push_sparse_log(
-                slab, state["cur"], table.pass_capacity, uids,
-                batch["perm"], batch["inv"], push_grads, sub, layout,
-                conf, pulled_rows=rows, first_idx=fi)
-            return {"buf": buf, "cur": cur}
         if "push_pos" in batch:
             return push_sparse_rebuild(slab, uids, batch["push_pos"],
                                        batch["perm"], batch["inv"],
@@ -860,7 +776,24 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
                 C * batch_size)[seg_flat // num_slots]
             push_grads = build_push_grads(
                 d_emb_flat, seg_flat % num_slots, clicks_flat, valid_flat)
-            if "pos" in cpush:
+            if "uid_d16" in cpush:
+                # chunk-amortized uid wire, delta-coded (ONE decode +
+                # searchsorted + scatter for the whole chunk)
+                slab = push_sparse_uidwire(
+                    slab, decode_delta_uids(cpush["uid_base"],
+                                            cpush["uid_d16"],
+                                            cpush["uid_cut"],
+                                            table.pass_capacity),
+                    ids_flat, push_grads, sub, layout, conf,
+                    pulled_rows=None, write=uid_write)
+            elif "perm" not in cpush:
+                # chunk-amortized uid wire: one [C*K] sorted uid vector
+                # serves every batch of the chunk — dedup maps derive on
+                # device once per DISPATCH, not once per batch
+                slab = push_sparse_uidwire(
+                    slab, cpush["uids"], ids_flat, push_grads, sub,
+                    layout, conf, pulled_rows=rows, write=uid_write)
+            elif "pos" in cpush:
                 slab = push_sparse_rebuild(
                     slab, cpush["uids"], cpush["pos"], cpush["perm"],
                     cpush["inv"], push_grads, sub, layout, conf,
@@ -914,12 +847,6 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
                                 _key_valid(batch), batch_size, num_slots,
                                 use_cvm, batch.get("dense"))
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def merge_log_fn(state, mpos):
-        return {"buf": merge_log_slab(state["buf"], mpos,
-                                      table.pass_capacity),
-                "cur": jnp.zeros((), jnp.int32)}
-
     return TrainStepFns(step=step_async if async_dense else step,
                         eval_step=eval_step,
                         batch_size=batch_size, num_slots=num_slots,
@@ -929,7 +856,7 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
                             params, emb, batch, None),
                         sparse_push=_sparse_push,
                         dn_update=_dn_update,
-                        merge_log=merge_log_fn)
+                        uid_write=uid_write)
 
 
 class BoxTrainer:
@@ -963,20 +890,12 @@ class BoxTrainer:
         self.sparse_chunk_sync = bool(self.cfg.sparse_chunk_sync)
         if self.sparse_chunk_sync and self.cfg.scan_chunk < 1:
             raise ValueError("sparse_chunk_sync needs scan_chunk >= 1")
-        # log-structured push: per-step exact pulls through a combined
-        # slab+log index — expand's dual-view pull, async dense's per-step
-        # dispatch cadence, and chunk-sync's chunk-level dedup don't carry
-        # the required products, so those paths keep rebuild/scatter
-        self._allow_log = not (self.async_mode or self.sparse_chunk_sync
-                               or bool(getattr(model, "use_expand", False)))
         # resolved once here and refreshed at pass start — never per batch,
         # so one scan chunk can't mix rebuild and scatter host dicts (and an
         # invalid flag value fails at construction, not in a staging thread)
         self._push_write = resolve_push_write(
             capacity=table_cfg.pass_capacity,
-            batch_keys=feed.key_capacity(),
-            allow_log=self._allow_log)
-        self._log_stage: Optional[LogStageState] = None  # per-pass, log mode
+            batch_keys=feed.key_capacity())
         self.dense_opt = make_dense_optimizer(self.cfg)
         rng = jax.random.PRNGKey(seed)
         self.params = model.init(rng)
@@ -988,7 +907,8 @@ class BoxTrainer:
             async_dense=self.async_mode,
             compute_dtype=self.cfg.compute_dtype,
             sparse_chunk=(self.cfg.scan_chunk
-                          if self.sparse_chunk_sync else 0))
+                          if self.sparse_chunk_sync else 0),
+            uid_write=self._push_write)
         self.async_table = None
         self._unravel = None
         if self.async_mode:
@@ -1090,45 +1010,31 @@ class BoxTrainer:
             # occurrence space (the per-batch products were never computed
             # — _stage_one staged with skip_push_dedup)
             ids_flat = np.concatenate([h["ids"] for h in hosts])
-            uids, perm, inv = dedup_ids(ids_flat, self.table.capacity)
-            cpush = {"uids": uids, "perm": perm, "inv": inv,
-                     "first": first_occurrence_idx(perm, inv)}
-            if self._push_write == "rebuild":
-                cpush["pos"] = pos_for_rebuild(uids, self.table.capacity)
+            from paddlebox_tpu.config import flags as _flags
+            if _flags.get_flag("h2d_lean") and _flags.get_flag(
+                    "h2d_uid_wire"):
+                # chunk-amortized uid wire: the sorted [C*K] uid vector is
+                # the ONLY staged product; the megastep derives the maps
+                cpush = {}
+                self._stage_uid_wire(cpush, ids_flat)
+            else:
+                uids, perm, inv = dedup_ids(ids_flat, self.table.capacity)
+                cpush = {"uids": uids, "perm": perm, "inv": inv,
+                         "first": first_occurrence_idx(perm, inv)}
+                if self._push_write == "rebuild":
+                    cpush["pos"] = pos_for_rebuild(uids,
+                                                   self.table.capacity)
             return ({k: np.stack([h[k] for h in hosts]) for k in hosts[0]},
                     cpush)
-        if self._push_write == "log":
-            # sequential tail of the staging: combined pull indices +
-            # write-slot registration must follow dispatch order (the
-            # pool above parallelized the order-free lookup/dedup work).
-            # A full log emits the merge map FIRST — the consumer
-            # dispatches the merge before this chunk's scan.
-            st = self._log_stage
-            if st is None:
-                # direct callers (tools/step_audit, ablation probes) that
-                # stage outside train_pass must pick an explicit write
-                # mode — log staging is stateful and pass-scoped
-                raise RuntimeError(
-                    "push_write=log staging requires an active train_pass "
-                    "(LogStageState); direct _stack_batches callers set "
-                    "trainer._push_write to 'rebuild' or 'scatter', or "
-                    "use tools.bench_util.make_log_bench_state")
-            mpos = (st.take_mpos() if st.need_merge(len(hosts)) else None)
-            for h in hosts:
-                h["src"] = st.assign(h["ids"], h["uids"])
-            return ({k: np.stack([h[k] for h in hosts]) for k in hosts[0]},
-                    mpos)
         return {k: np.stack([h[k] for h in hosts]) for k in hosts[0]}
 
     def _stack_batches(self, group: List[PackedBatch]):
         """Host-stack + one H2D per leaf (the single-chunk transfer path)."""
         staged = self._stack_batches_host(group)
         if isinstance(staged, tuple):
-            stacked, aux = staged
-            stacked = {k: jnp.asarray(v) for k, v in stacked.items()}
-            if self.sparse_chunk_sync:
-                aux = {k: jnp.asarray(v) for k, v in aux.items()}
-            return stacked, aux
+            stacked, cpush = staged
+            return ({k: jnp.asarray(v) for k, v in stacked.items()},
+                    {k: jnp.asarray(v) for k, v in cpush.items()})
         return {k: jnp.asarray(v) for k, v in staged.items()}
 
     def _group_to_device(self, staged_list):
@@ -1137,17 +1043,31 @@ class BoxTrainer:
         ~250 ms fixed per-transfer tunnel cost amortizes /G (the
         MiniBatchGpuPack stacked-pinned-copy role, data_feed.h:519-680).
         Per-chunk views are device-side slices of the grouped arrays."""
-        log = self._push_write == "log"
-        dicts = [s[0] if log else s for s in staged_list]
-        sizes = [d["ids"].shape[0] for d in dicts]
-        big = {k: jnp.asarray(np.concatenate([d[k] for d in dicts]))
-               for k in dicts[0]}
+        sizes = [d["ids"].shape[0] for d in staged_list]
+        big = {k: jnp.asarray(np.concatenate([d[k] for d in staged_list]))
+               for k in staged_list[0]}
         out, off = [], 0
-        for i, d in enumerate(dicts):
-            sl = {k: big[k][off:off + sizes[i]] for k in big}
-            out.append((sl, staged_list[i][1]) if log else sl)
+        for i in range(len(staged_list)):
+            out.append({k: big[k][off:off + sizes[i]] for k in big})
             off += sizes[i]
         return out
+
+    def _stage_uid_wire(self, out: Dict[str, np.ndarray],
+                        ids: np.ndarray) -> None:
+        """Stage the uid-wire dedup product into `out`: the sorted [K]
+        uid vector (round 8), or its (int32 base, int16 delta) coding
+        under wire_delta_ids. Used per batch (host_batch) and per chunk
+        (the chunk-sync cpush) — one definition so the wire format can't
+        diverge between the two."""
+        from paddlebox_tpu.config import flags as _flags
+        uids = self.table.uids_for_push(ids)
+        if _flags.get_flag("wire_delta_ids"):
+            base, d16, cut = delta_encode_uids(uids, self.table.capacity)
+            out["uid_base"] = base
+            out["uid_d16"] = d16
+            out["uid_cut"] = cut
+        else:
+            out["uids"] = uids
 
     def host_batch(self, b: PackedBatch, ids: np.ndarray,
                    skip_push_dedup: bool = False) -> Dict[str, np.ndarray]:
@@ -1166,9 +1086,14 @@ class BoxTrainer:
             "labels": b.labels,
         }
         from paddlebox_tpu.config import flags as _flags
-        if _flags.get_flag("h2d_lean"):
-            # wire-lean staging: no host dedup products at all — the
-            # device step dedups (see _sparse_push's h2d_lean branch)
+        if not self.table.test_mode and not skip_push_dedup \
+                and _flags.get_flag("h2d_lean"):
+            # lean wire: with h2d_uid_wire (default) the sorted uid vector
+            # is the ONLY staged dedup product (maps derive on device,
+            # round-8 reunification); with it off, nothing stages and the
+            # step dedups on device (see _sparse_push's branches)
+            if _flags.get_flag("h2d_uid_wire"):
+                self._stage_uid_wire(out, ids)
             skip_push_dedup = True
         if not self.table.test_mode and not skip_push_dedup:
             # train batches carry the host-precomputed push dedup (uids
@@ -1220,8 +1145,17 @@ class BoxTrainer:
         # refreshed BEFORE the profiled-path fork so both tiers honor it
         self._push_write = resolve_push_write(
             capacity=self.table.capacity,
-            batch_keys=self.feed.key_capacity(),
-            allow_log=self._allow_log)
+            batch_keys=self.feed.key_capacity())
+        if (flags.get_flag("h2d_lean") and flags.get_flag("h2d_uid_wire")
+                and self._push_write != self.fns.uid_write):
+            # the uid wire derives its slab-write strategy ON DEVICE, so
+            # it is baked into the jitted step at construction — a live
+            # push_write flip cannot retarget it silently
+            raise ValueError(
+                "push_write resolved to %r but the uid-wire step was "
+                "built with %r — construct a fresh trainer to change the "
+                "uid-wire write strategy"
+                % (self._push_write, self.fns.uid_write))
         if (flags.get_flag("profile_per_op") and not preloaded
                 and not self.multi_task and self.async_table is None):
             # debug tier: staged dispatches with per-stage attribution
@@ -1241,22 +1175,7 @@ class BoxTrainer:
         prng = self.table.next_prng()
         chunk = max(1, self.cfg.scan_chunk)
         pending = worker_batches[0]
-        log_mode = self._push_write == "log"
-        if log_mode:
-            K = self.feed.key_capacity()
-            self._log_stage = LogStageState(
-                self.table.capacity, K,
-                resolve_log_batches(self.table.capacity, K, chunk))
-            # unified buffer: slab rows [0, capacity) + log region after
-            state = {"buf": jnp.concatenate(
-                         [self.table.slab,
-                          jnp.zeros((self._log_stage.log_rows,
-                                     self.table.layout.width),
-                                    jnp.float32)]),
-                     "cur": jnp.zeros((), jnp.int32)}
-            self.table.set_slab(None)  # the bundle owns the (donated) slab
-        else:
-            state = self.table.slab
+        state = self.table.slab
         use_scan = (self.fns.scan_chunk is not None or
                     (self.fns.scan_steps is not None and chunk > 1))
         if use_scan and len(pending) >= chunk:
@@ -1290,18 +1209,6 @@ class BoxTrainer:
                         self.fns.scan_chunk(carry[0], carry[1], carry[2],
                                             stacked, cpush, carry[3])
                     return (slab, params, opt_state, prng), losses, preds
-            elif log_mode:
-                def scan_call(carry, staged):
-                    stacked, mpos = staged
-                    st = carry[0]
-                    if mpos is not None:
-                        # the stager declared the log full before this
-                        # chunk: fold it into the slab first
-                        st = self.fns.merge_log(st, jnp.asarray(mpos))
-                    st, params, opt_state, losses, preds, prng = \
-                        self.fns.scan_steps(st, carry[1], carry[2],
-                                            stacked, carry[3])
-                    return (st, params, opt_state, prng), losses, preds
             else:
                 def scan_call(carry, stacked):
                     slab, params, opt_state, losses, preds, prng = \
@@ -1323,21 +1230,12 @@ class BoxTrainer:
                 transfer_group=tg,
                 group_fn=self._group_to_device if tg > 1 else None)
             state, self.params, self.opt_state, prng = carry
-            if not log_mode:
-                self.table.set_slab(state)
+            self.table.set_slab(state)
             losses.extend(chunk_losses)
             pending = pending[n_done:]
         for b in pending:
             ids = self.table.lookup_ids(b.keys, b.valid)
-            if log_mode:
-                h = self.host_batch(b, ids)
-                if self._log_stage.need_merge():
-                    state = self.fns.merge_log(
-                        state, jnp.asarray(self._log_stage.take_mpos()))
-                h["src"] = self._log_stage.assign(h["ids"], h["uids"])
-                batch = {k: jnp.asarray(v) for k, v in h.items()}
-            else:
-                batch = self.device_batch(b, ids)
+            batch = self.device_batch(b, ids)
             self.timers["step"].start()
             if self.async_table is not None:
                 # pull a fresh dense snapshot, run the device step, queue the
@@ -1352,10 +1250,9 @@ class BoxTrainer:
             else:
                 (state, self.params, self.opt_state, loss, preds,
                  prng) = self.fns.step(
-                    state if log_mode else self.table.slab,
-                    self.params, self.opt_state, batch, prng)
-                if not log_mode:
-                    self.table.set_slab(state)
+                    self.table.slab, self.params, self.opt_state, batch,
+                    prng)
+                self.table.set_slab(state)
             self.timers["step"].pause()
             self._step_count += 1
             losses.append(float(loss))
@@ -1365,14 +1262,6 @@ class BoxTrainer:
             self._add_metrics(preds, b)
             if self.dump_writer is not None:
                 self._dump_batch(preds, b)
-        if log_mode:
-            # fold any remaining log entries, hand the merged slab region
-            # back to the table for end_pass write-back, drop the log
-            if self._log_stage.cur:
-                state = self.fns.merge_log(
-                    state, jnp.asarray(self._log_stage.take_mpos()))
-            self.table.set_slab(state["buf"][:self.table.capacity])
-            self._log_stage = None
         self.table.end_pass()
         if self.async_table is not None:
             # pass boundary is a sync point: drain the host optimizer and

@@ -60,62 +60,6 @@ def timed_scan_chain(scan, state, stacked, reps: int, warmup: int = 2):
     return dt
 
 
-def timed_scan_chain_log(scan, merge, state, stacked, reps: int,
-                         merge_every: int, mpos_np, warmup: int = 2):
-    """timed_scan_chain for push_write='log': the chain interleaves the
-    real merge cadence (one merge_log dispatch every merge_every chunks,
-    resetting the device cursor exactly like training does) so the
-    reported ms/step INCLUDES the amortized slab merge. state[0] is the
-    {slab, log, cur} bundle; mpos_np is a host latest-slot snapshot with
-    the staged chunk's population (cost-representative)."""
-    import jax.numpy as jnp
-    if warmup < 1:
-        raise ValueError("warmup must be >= 1 (the first call compiles)")
-    mpos = jnp.asarray(mpos_np)
-    for _ in range(warmup):
-        bundle, params, opt, losses, _p, key = scan(
-            state[0], state[1], state[2], stacked, state[3])
-        bundle = merge(bundle, mpos)   # compile + reset cursor
-        state = (bundle, params, opt, key)
-    warm = np.asarray(losses)
-    if not np.isfinite(warm).all():
-        raise FloatingPointError(f"non-finite warmup losses {warm}")
-    t0 = time.perf_counter()
-    for i in range(reps):
-        if i and i % merge_every == 0:
-            state = (merge(state[0], mpos),) + state[1:]
-        bundle, params, opt, losses, _p, key = scan(
-            state[0], state[1], state[2], stacked, state[3])
-        state = (bundle, params, opt, key)
-    final = np.asarray(losses)
-    dt = (time.perf_counter() - t0) / reps
-    if not np.isfinite(final).all():
-        raise FloatingPointError(f"non-finite losses {final}")
-    return dt
-
-
-def make_log_bench_state(trainer, batches):
-    """Stage a bench chunk for push_write='log' and build the device
-    bundle: returns (stacked, bundle, mpos_np, log_batches). Sets up the
-    trainer's LogStageState exactly like train_pass does."""
-    import jax.numpy as jnp
-
-    from paddlebox_tpu.train.trainer import (LogStageState,
-                                             resolve_log_batches)
-    K = trainer.feed.key_capacity()
-    lb = resolve_log_batches(trainer.table.capacity, K, len(batches))
-    trainer._log_stage = LogStageState(trainer.table.capacity, K, lb)
-    stacked, mpos0 = trainer._stack_batches(batches)
-    assert mpos0 is None, "bench chunk must fit the fresh log"
-    mpos_np = trainer._log_stage.last_slot.copy()
-    bundle = {"buf": jnp.concatenate(
-                  [trainer.table.slab,
-                   jnp.zeros((trainer._log_stage.log_rows,
-                              trainer.table.layout.width), jnp.float32)]),
-              "cur": jnp.zeros((), jnp.int32)}
-    return stacked, bundle, mpos_np, lb
-
-
 def measure_pass_amortized(trainer, batches, batch_size: int,
                            overlaps=(0.0, 0.9), n_passes: int = 3,
                            workset_rows: int = 1 << 18, seed: int = 123):
@@ -143,9 +87,6 @@ def measure_pass_amortized(trainer, batches, batch_size: int,
     tab._slab = None
     tab._touched = None
     tab.invalidate_residency()
-    if trainer._push_write == "log":
-        # the manual drive below stages plain (non-log) batch dicts
-        trainer._push_write = "scatter"
 
     batch_keys = np.unique(np.concatenate(
         [np.asarray(b.keys[b.valid], np.uint64) for b in batches]))

@@ -33,8 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tools.bench_util import (make_bench_trainer, make_ctr_batches,
-                              make_log_bench_state, timed_scan_chain,
-                              timed_scan_chain_log)
+                              timed_scan_chain)
 
 D, NUM_SLOTS, BATCH, MAX_LEN = 8, 32, 1024, 4
 CHUNK, REPS = 8, 3
@@ -57,31 +56,12 @@ def try_cap(cap):
         tr.table.add_keys(b.keys[b.valid])
     tr.table.end_feed_pass()
     W = tr.table.layout.width
-    if tr._push_write == "log":
-        # build the unified buffer DIRECTLY on device — going through
-        # begin_pass + concat would transiently hold 2× the slab and
-        # halve the measurable capacity
-        from paddlebox_tpu.train.trainer import (LogStageState,
-                                                 resolve_log_batches)
-        K = feed.key_capacity()
-        lb = resolve_log_batches(cap, K, CHUNK)
-        tr._log_stage = LogStageState(cap, K, lb)
-        stacked, mpos0 = tr._stack_batches(batches)
-        assert mpos0 is None
-        mpos_np = tr._log_stage.last_slot.copy()
-        bundle = {"buf": jnp.zeros((cap + lb * K, W), jnp.float32),
-                  "cur": jnp.zeros((), jnp.int32)}
-        state = (bundle, tr.params, tr.opt_state, tr.table.next_prng())
-        dt = timed_scan_chain_log(tr.fns.scan_steps, tr.fns.merge_log,
-                                  state, stacked, REPS,
-                                  max(1, lb // CHUNK), mpos_np) / CHUNK
-    else:
-        fake_begin_pass(tr, cap)
-        stacked = tr._stack_batches(batches)
-        state = (tr.table.slab, tr.params, tr.opt_state,
-                 tr.table.next_prng())
-        dt = timed_scan_chain(tr.fns.scan_steps, state, stacked,
-                              REPS) / CHUNK
+    fake_begin_pass(tr, cap)
+    stacked = tr._stack_batches(batches)
+    state = (tr.table.slab, tr.params, tr.opt_state,
+             tr.table.next_prng())
+    dt = timed_scan_chain(tr.fns.scan_steps, state, stacked,
+                          REPS) / CHUNK
     rec = {
         "cap_rows": cap,
         "push_write": tr._push_write,
@@ -108,18 +88,11 @@ def reference_key_budget():
         tr.table.add_keys(b.keys[b.valid])
     tr.table.end_feed_pass()
     fake_begin_pass(tr, cap)
-    if tr._push_write == "log":
-        stacked, bundle, mpos_np, lb = make_log_bench_state(tr, batches)
-        state = (bundle, tr.params, tr.opt_state, tr.table.next_prng())
-        dt = timed_scan_chain_log(tr.fns.scan_steps, tr.fns.merge_log,
-                                  state, stacked, REPS,
-                                  max(1, lb // 2), mpos_np) / 2
-    else:
-        stacked = tr._stack_batches(batches)
-        state = (tr.table.slab, tr.params, tr.opt_state,
-                 tr.table.next_prng())
-        dt = timed_scan_chain(tr.fns.scan_steps, state, stacked,
-                              REPS) / 2
+    stacked = tr._stack_batches(batches)
+    state = (tr.table.slab, tr.params, tr.opt_state,
+             tr.table.next_prng())
+    dt = timed_scan_chain(tr.fns.scan_steps, state, stacked,
+                          REPS) / 2
     K = feed.key_capacity()
     print(json.dumps({
         "stage": "reference_key_budget",

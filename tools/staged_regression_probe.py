@@ -26,9 +26,16 @@ import numpy as np
 FLOORS = {
     "rt_lookup_keys_per_sec": (51.8e6, 20e6),
     "rt_dedup_keys_per_sec": (47.2e6, 19e6),
+    "uid_sort_keys_per_sec": (116e6, 40e6),
     "bucketize_keys_per_sec": (21.1e6, 8e6),
     "parse_lines_per_sec": (722e3, 290e3),
     "pack_instances_per_sec": (722e3, 290e3),
+    # round-8: the uid-lean wire END TO END on CPU (host stage + H2D +
+    # jitted scan + D2H, small DeepFM shape below) — guards the whole
+    # staged path so a wire regression fails loud between tunnel windows.
+    # Recorded on a LOADED round-8 container (sibling rows at ~60% of
+    # their quiet-box rates the same run); floor = ~40% of it
+    "e2e_lean_examples_per_sec": (6.8e3, 2.7e3),
 }
 
 failures = []
@@ -72,10 +79,15 @@ def main():
            timed_rate(lambda: route_lookup(idx, probe, None, 0), K))
     destroy_route_index(idx)
 
-    from paddlebox_tpu.embedding.pass_table import dedup_ids
+    from paddlebox_tpu.embedding.pass_table import (dedup_ids,
+                                                    dedup_uids_sorted)
     ids = rng.randint(0, 1 << 20, K).astype(np.int32)
     report("rt_dedup_keys_per_sec",
            timed_rate(lambda: dedup_ids(ids, 1 << 20), K))
+    # the uid-wire host product (np.unique sort — the only staged dedup
+    # work on the uid-lean path)
+    report("uid_sort_keys_per_sec",
+           timed_rate(lambda: dedup_uids_sorted(ids, 1 << 20), K))
 
     from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
                                               TableConfig)
@@ -121,6 +133,42 @@ def main():
     report("parse_lines_per_sec", reps * n_lines / dt)
     # load_into_memory covers parse+merge+batch build in this design
     report("pack_instances_per_sec", reps * n / dt)
+
+    # --- uid-lean wire e2e tier (round 8) ----------------------------
+    # host stage (lookup + uid sort) + H2D + jitted scan + loss D2H over
+    # a small DeepFM shape — the whole staged path the uid wire carries
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddlebox_tpu.config.configs import TrainerConfig
+    from paddlebox_tpu.config import flags as _flags
+    from tools.bench_util import make_bench_trainer, make_ctr_batches
+    _flags.set_flag("h2d_lean", True)
+    try:
+        tr, feed = make_bench_trainer(
+            1 << 18, batch=256, num_slots=16, max_len=4, d=8,
+            trainer_cfg=TrainerConfig(dense_lr=1e-3))
+        chunk = 4
+        batches = make_ctr_batches(feed, chunk, 16, 4, seed=0)
+        tr.table.begin_feed_pass()
+        for b in batches:
+            tr.table.add_keys(b.keys[b.valid])
+        tr.table.end_feed_pass()
+        tr.table.begin_pass()
+        state = [tr.table.slab, tr.params, tr.opt_state,
+                 tr.table.next_prng()]
+
+        def one_chunk():
+            stacked = tr._stack_batches(batches)
+            slab, params, opt, losses, _p, key = tr.fns.scan_steps(
+                state[0], state[1], state[2], stacked, state[3])
+            state[:] = slab, params, opt, key
+            assert np.isfinite(np.asarray(losses)).all()
+
+        report("e2e_lean_examples_per_sec",
+               timed_rate(one_chunk, chunk * 256, secs=4.0))
+        tr.close()
+    finally:
+        _flags.set_flag("h2d_lean", False)
 
     if failures:
         print(json.dumps({"failed": failures}), flush=True)

@@ -44,8 +44,7 @@ def make_trainer(pass_cap):
 
 def stage(name, pass_cap, strip=None, push_write=None):
     """strip: None | 'push' | 'sparse' — build a variant step.
-    push_write: force a write mode (None = the trainer's auto resolve —
-    'log' on tpu backends since round 5)."""
+    push_write: force a write mode (None = the trainer's auto resolve)."""
     tr, feed = make_trainer(pass_cap)
     if push_write is not None:
         tr._push_write = push_write
@@ -57,20 +56,6 @@ def stage(name, pass_cap, strip=None, push_write=None):
         tr.table.add_keys(b.keys[b.valid])
     tr.table.end_feed_pass()
     tr.table.begin_pass()
-    if tr._push_write == "log" and strip is None:
-        from tools.bench_util import (make_log_bench_state,
-                                      timed_scan_chain_log)
-        stacked, bundle, mpos_np, lb = make_log_bench_state(tr, batches)
-        state = (bundle, tr.params, tr.opt_state, tr.table.next_prng())
-        dt = timed_scan_chain_log(
-            tr.fns.scan_steps, tr.fns.merge_log, state, stacked, REPS,
-            max(1, lb // CHUNK), mpos_np) / CHUNK
-        print(json.dumps({"stage": name, "pass_cap": pass_cap,
-                          "push_write": "log", "log_batches": lb,
-                          "ms_per_step": round(dt * 1e3, 3),
-                          "examples_per_sec": round(BATCH / dt, 1)}),
-              flush=True)
-        return
     stacked = tr._stack_batches(batches)
     if strip is None:
         scan = tr.fns.scan_steps
