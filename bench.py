@@ -42,6 +42,11 @@ each carrying `wire_bytes_per_step` and `host_stage_keys_per_sec`.
 `e2e_lean` now names the CURRENT lean wire (= uid-lean); the r5-
 comparable ids-only number is `e2e_lean_ids_only`.
 
+Round 9 attaches the `hostplane` block: the 2-process host-plane
+exchange ladder (store allgather vs p2p socket mesh vs p2p+pre-wire uid
+dedup, parity-checked — tools/hostplane_probe.py) so the emitted json
+carries per-step exchange_ms/exchange_bytes for the multi-process tier.
+
 MFU accounting lives in BASELINE.md (updated whenever the recorded
 baseline moves).
 """
@@ -321,6 +326,31 @@ def main() -> None:
         }))
         return
 
+    # round-9: multi-process host-plane exchange tier (store allgather vs
+    # p2p socket mesh vs p2p+pre-wire-uid-dedup at 2 REAL processes;
+    # parity-checked, median-of-3 — the full 2-and-4-process ladder lives
+    # in tools/hostplane_probe.py, recorded in BASELINE.md). GUARDED: a
+    # failure here must not cost the headline metric.
+    hostplane = None
+    try:
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "hostplane_probe.py"),
+             "--worlds", "2", "--kb", "8192"],
+            capture_output=True, text=True, timeout=240)
+        for line in r.stdout.strip().splitlines():
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if d.get("probe") == "hostplane":
+                hostplane = d
+        if hostplane is None:
+            hostplane = {"error": "no ladder line; rc=%d" % r.returncode}
+    except Exception as e:  # noqa: BLE001 — diagnostic tier, not the metric
+        hostplane = {"error": repr(e)[:200]}
+
     eps = result["examples_per_sec"]
     base = env_baseline or SELF_BASELINE.get(result["platform"]) or 0.0
     vs = eps / base if base > 0 else 1.0
@@ -355,6 +385,7 @@ def main() -> None:
         "pass_amortized": result.get("pass_amortized"),
         "pass_amortized_examples_per_sec": result.get(
             "pass_amortized_examples_per_sec", 0.0),
+        "hostplane": hostplane,
         "compile_warmup_s": result.get("compile_warmup_s"),
         "diags": diags,
     }))

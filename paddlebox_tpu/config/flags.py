@@ -193,6 +193,19 @@ define_flag("strict_bucket_overflow", False,
 define_flag("matmul_dtype", "float32",
             "dense matmul operand dtype: bfloat16 (MXU native, f32 "
             "accumulation; wins once the MLP dominates the step) or float32")
+define_flag("hostplane", "p2p",
+            "multi-process per-step host exchange transport (round 9): "
+            "'p2p' = persistent socket mesh (fleet/mesh_comm.py) — "
+            "endpoints rendezvous once through the TcpStore, then every "
+            "per-step bucket/uid exchange rides direct peer connections "
+            "(O(W*P*KB) bytes, true all-to-all; under h2d_uid_wire the "
+            "per-destination dedup moves BEFORE the network so only "
+            "sorted unique uid vectors travel), with a loud COLLECTIVE "
+            "fallback to 'store' when any rank fails to dial its peers; "
+            "'store' = the round-5 central TcpStore allgather funnel "
+            "(O(W^2*P*KB) through one NIC + 3 counter round-trips per "
+            "rank per step). Must be set identically on every rank — a "
+            "split setting deadlocks the lockstep exchange")
 define_flag("incremental_pass", True,
             "incremental pass lifecycle (BeginPass/EndPass delta, the "
             "BoxPS keep-rows-resident cadence): begin_pass diffs the new "

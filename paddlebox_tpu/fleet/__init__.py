@@ -13,6 +13,7 @@ manager (fleet/elastic/manager.py skeleton).
 from paddlebox_tpu.fleet.store import KVStoreServer, TcpStoreClient
 from paddlebox_tpu.fleet.role_maker import RoleMaker
 from paddlebox_tpu.fleet.fleet import Fleet, fleet
+from paddlebox_tpu.fleet.mesh_comm import MeshComm
 from paddlebox_tpu.fleet.elastic import ElasticManager
 
 __all__ = [
@@ -21,5 +22,6 @@ __all__ = [
     "RoleMaker",
     "Fleet",
     "fleet",
+    "MeshComm",
     "ElasticManager",
 ]

@@ -49,6 +49,7 @@ class Fleet:
         # so a restarted job against the same store must not see run 1's
         # pre-satisfied barriers (the launcher stamps a fresh uuid)
         self._run_id = "0"
+        self._mesh = None  # p2p host-plane mesh (make_mesh_comm, cached)
 
     # ----------------------------------------------------------------- init
     def init(self, role: Optional[RoleMaker] = None,
@@ -92,6 +93,7 @@ class Fleet:
         key = "%s/barrier/%d" % (self._run_id, self._seq)
         self._client.add(key)
         self._client.wait_counter_ge(key, self.role.world, timeout)
+        self._compact_old_counters()
 
     def all_gather(self, arr: np.ndarray,
                    timeout: float = 120.0) -> list:
@@ -108,16 +110,31 @@ class Fleet:
             for r in range(self.role.world)
         ]
         # ranks ack having READ the round before anyone deletes its data
-        # keys; the ack counter itself is never deleted (a laggard's
-        # wait_counter_ge may arrive after rank 0 passes the barrier, and
-        # counters cost 8 bytes/collective)
+        # keys; the ack counter itself outlives its round (a laggard's
+        # wait_counter_ge may arrive after rank 0 passes the barrier) and
+        # is retired two rounds later by _compact_old_counters
         ack = prefix + "/ack"
         self._client.add(ack)
         self._client.wait_counter_ge(ack, self.role.world, timeout)
         if self.role.rank == 0:
             for r in range(self.role.world):
                 self._client.delete("%s/%d" % (prefix, r))
+        self._compact_old_counters()
         return out
+
+    def _compact_old_counters(self) -> None:
+        """Retire collective counters older than 2 rounds so a long run's
+        store stays bounded (they used to accumulate forever). Safety:
+        when rank 0 COMPLETES round n, every rank has ADDED in round n,
+        hence fully finished round n-1 (per-rank call order is strict),
+        hence nothing can ever wait on round n-2's counters again. One
+        delete covers both key shapes — at each seq exactly one of
+        barrier/coll exists and delete of a missing key is a no-op."""
+        if self.role.rank != 0 or self._seq < 3:
+            return
+        old = self._seq - 2
+        self._client.delete("%s/barrier/%d" % (self._run_id, old))
+        self._client.delete("%s/coll/%d/ack" % (self._run_id, old))
 
     def all_reduce(self, arr: np.ndarray, op: str = "sum",
                    timeout: float = 120.0) -> np.ndarray:
@@ -211,8 +228,65 @@ class Fleet:
         sh.endpoints = endpoints
         return sh
 
+    def make_mesh_comm(self, positions=(), timeout: float = 120.0):
+        """Build (once; cached) this rank's p2p host-plane mesh
+        (fleet/mesh_comm.py): endpoints + owned mesh positions rendezvous
+        ONE TIME through the KV store, then every per-step exchange rides
+        persistent direct connections. Returns None in single-rank jobs
+        and on fallback. The fallback is COLLECTIVE and loud: bring-up
+        success is all-gathered, and if ANY rank failed to dial its peers
+        every rank reverts to the store-allgather host plane together — a
+        split decision would deadlock the lockstep exchange. Must be
+        called by every rank in the same collective order."""
+        import logging
+
+        from paddlebox_tpu.fleet.mesh_comm import MeshComm
+
+        if self.role.world <= 1:
+            return None
+        if self._mesh is not None:
+            have = sorted(self._mesh.positions_of.get(self.role.rank, []))
+            if have != sorted(int(p) for p in positions):
+                # fail HERE with construction context, not at the first
+                # per-step exchange deep inside the stager
+                raise ValueError(
+                    "make_mesh_comm: mesh already rendezvous'd for "
+                    "positions %s; requested %s" % (have, list(positions)))
+            return self._mesh
+        log = logging.getLogger("paddlebox_tpu")
+        self._seq += 1
+        ns = "%s/mesh/%d" % (self._run_id, self._seq)
+        mesh = MeshComm(self.role.rank, self.role.world)
+        ok = 1
+        # ANY bring-up failure must still reach the collective ok-flag
+        # vote below — an escaping exception here would leave every peer
+        # blocked in the all_gather (the split-decision hang the vote
+        # exists to prevent) and leak this rank's server socket
+        try:
+            mesh.rendezvous(self._client, ns, self._my_host(),
+                            positions, timeout)
+        except Exception as e:  # noqa: BLE001 — votes fallback, never splits
+            log.warning("hostplane=p2p bring-up FAILED on rank %d: %r",
+                        self.role.rank, e)
+            ok = 0
+        flags = self.all_gather(np.asarray([ok], np.int64), timeout)
+        if not all(int(f[0]) for f in flags):
+            if ok:
+                log.warning(
+                    "hostplane=p2p: a peer failed mesh bring-up — ALL "
+                    "ranks falling back to the store-allgather host plane "
+                    "(per-step exchanges funnel through the central store "
+                    "again; fix peer reachability to restore p2p)")
+            mesh.close()
+            return None
+        self._mesh = mesh
+        return mesh
+
     # ------------------------------------------------------------- lifecycle
     def stop(self) -> None:
+        if self._mesh is not None:
+            self._mesh.close()
+            self._mesh = None
         if self._client is not None:
             self._client.close()
             self._client = None

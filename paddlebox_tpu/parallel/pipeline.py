@@ -1057,6 +1057,13 @@ class ShardedCtrPipelineRunner:
             self.local_rows = sorted(rows)
         else:
             self.local_rows = list(range(self.dp))
+        # p2p host data plane (round 9; see ShardedBoxTrainer): None =
+        # the store-allgather plane (flag 'store' or collective fallback)
+        from paddlebox_tpu.fleet.mesh_comm import resolve_hostplane
+        self.host_mesh = (
+            fleet.make_mesh_comm(self.local_positions)
+            if self.multiprocess and resolve_hostplane() == "p2p"
+            else None)
         kcap = feed.key_capacity()
         self.bucket_cap = bucket_cap or max(
             16, (2 * self.m_local * kcap) // self.P)
@@ -1415,7 +1422,8 @@ class ShardedCtrPipelineRunner:
                 self.fleet.all_gather if self.multiprocess else None,
                 rebuild=self._push_write == "rebuild", pool=pool,
                 note_touched=self.table.note_touched,
-                uid_only=bool(flags.get_flag("h2d_uid_wire"))))
+                uid_only=bool(flags.get_flag("h2d_uid_wire")),
+                mesh=self.host_mesh))
         return {k: self._put_flat(np.stack(v)) for k, v in leaves.items()}
 
     def begin_pass(self) -> None:

@@ -97,13 +97,16 @@ def exchange_outgoing_buckets(buckets_local: np.ndarray,
     own global positions in a header, so fleet rank need not equal jax
     process index).
     """
+    import time as _time
     bl = np.ascontiguousarray(buckets_local, np.int32)
     n_local, P, KB = bl.shape
+    t0 = _time.perf_counter()
     header = np.array([n_local, P, KB] + list(local_positions), np.int32)
     payload = np.concatenate([header, bl.ravel()])
     out = np.empty((num_devices, P, KB), np.int32)
     seen = np.zeros(num_devices, bool)
-    for part in all_gather(payload):
+    gathered = all_gather(payload)
+    for part in gathered:
         part = np.asarray(part, np.int32)
         nl, p2, kb2 = part[0], part[1], part[2]
         if (p2, kb2) != (P, KB):
@@ -118,13 +121,167 @@ def exchange_outgoing_buckets(buckets_local: np.ndarray,
         raise RuntimeError(
             "bucket exchange incomplete: no contribution for device "
             f"positions {np.nonzero(~seen)[0].tolist()}")
+    # wire attribution (weak #6): this rank writes its payload once and
+    # reads every rank's back through the central store
+    stat_add("hostplane_exchange_bytes",
+             int(payload.nbytes) * (1 + len(gathered)))
+    stat_add("hostplane_exchange_us",
+             int((_time.perf_counter() - t0) * 1e6))
+    stat_add("hostplane_exchange_steps")
+    return out
+
+
+def _mesh_dest_plan(mesh, local_positions, num_devices: int):
+    """Per-peer destination lists for the p2p exchanges, validated against
+    the rendezvous'd ownership map: every mesh position must have exactly
+    one owner or the a2a would silently drop shards."""
+    owner = mesh.rank_of_position()
+    missing = [d for d in range(num_devices) if d not in owner]
+    if missing:
+        raise RuntimeError(
+            "p2p host plane: mesh positions %s have no owning rank "
+            "(rendezvous positions incomplete)" % missing)
+    if sorted(mesh.positions_of.get(mesh.rank, [])) != sorted(
+            local_positions):
+        raise RuntimeError(
+            "p2p host plane: this rank rendezvous'd positions %s but is "
+            "staging for %s" % (mesh.positions_of.get(mesh.rank),
+                                list(local_positions)))
+    return [mesh.positions_of[r] for r in range(mesh.world)]
+
+
+def exchange_incoming_p2p(buckets_local: np.ndarray,
+                          local_positions: List[int],
+                          num_devices: int, mesh):
+    """P2P twin of exchange_outgoing_buckets (the tentpole a2a): rank r
+    ships the owner of destination shard d ONLY its buckets[:, d, :]
+    column — O(W*P*KB) direct bytes per step instead of every rank's full
+    [n_local, P, KB] set bouncing through the central store
+    (O(W^2*P*KB) through one NIC). Returns {d: [num_devices, KB] int32}
+    incoming-id arrays in global source-device order for this process's
+    OWNED destinations — exactly the concatenation stage_push_dedup's
+    per-destination dedup consumes, so the staging products stay
+    bit-identical to the store path.
+    """
+    import time as _time
+    bl = np.ascontiguousarray(buckets_local, np.int32)
+    n_local, P, KB = bl.shape
+    dest_of_rank = _mesh_dest_plan(mesh, local_positions, num_devices)
+    t0 = _time.perf_counter()
+    parts = {}
+    for r, dests in enumerate(dest_of_rank):
+        # header: n_local, KB, n_dests, src positions..., dest positions...
+        header = np.array([n_local, KB, len(dests)]
+                          + list(local_positions) + list(dests), np.int32)
+        parts[r] = np.concatenate(
+            [header, bl[:, dests, :].ravel()])
+    got = mesh.exchange(parts)
+    mine = dest_of_rank[mesh.rank]
+    out = {d: np.empty((num_devices, KB), np.int32) for d in mine}
+    seen = np.zeros(num_devices, bool)
+    for part in got.values():
+        part = np.asarray(part, np.int32)
+        nl, kb2, nd = int(part[0]), int(part[1]), int(part[2])
+        if kb2 != KB:
+            raise ValueError("p2p bucket exchange KB mismatch: peer sent "
+                             "KB=%d, local is KB=%d" % (kb2, KB))
+        srcs = part[3:3 + nl]
+        dests = part[3 + nl:3 + nl + nd]
+        if sorted(dests.tolist()) != sorted(mine):
+            raise ValueError(
+                "p2p bucket exchange routed to the wrong owner: got "
+                "destinations %s, own %s" % (dests.tolist(), mine))
+        block = part[3 + nl + nd:].reshape(nl, nd, KB)
+        for j, d in enumerate(dests.tolist()):
+            out[d][srcs] = block[:, j, :]
+        seen[srcs] = True
+    if not seen.all():
+        raise RuntimeError(
+            "p2p bucket exchange incomplete: no contribution for source "
+            f"positions {np.nonzero(~seen)[0].tolist()}")
+    # like-for-like NIC accounting with the store path (which counts its
+    # 1 write + W reads): sends to W-1 peers PLUS receives from W-1 peers
+    wire = sum(int(p.nbytes) for r, p in parts.items() if r != mesh.rank) \
+        + sum(int(p.nbytes) for r, p in got.items() if r != mesh.rank)
+    stat_add("hostplane_exchange_bytes", wire)
+    stat_add("hostplane_exchange_us",
+             int((_time.perf_counter() - t0) * 1e6))
+    stat_add("hostplane_exchange_steps")
+    return out
+
+
+def exchange_push_uids_p2p(buckets_local: np.ndarray,
+                           local_positions: List[int], num_devices: int,
+                           shard_cap: int, mesh, pool=None):
+    """Dedup BEFORE the network (composes the round-8 uid wire with the
+    p2p mesh): for every destination shard this rank sorts-uniques its
+    LOCAL contribution and ships the owner only that vector; the owner
+    unions the per-source vectors — the same id set, hence bit-identical
+    dedup_uids_sorted products, as deduping the full concatenation after
+    a raw exchange, at a fraction of the wire bytes (duplicates never
+    travel). Returns {d: uids[num_devices*KB] int32} for owned
+    destinations, tail-padded exactly like dedup_uids_sorted.
+
+    pool: optional thread pool for the num_devices sender-side np.unique
+    calls (the dominant pre-wire cost; the sort releases the GIL) — the
+    runners pass their stager pool."""
+    import time as _time
+    bl = np.ascontiguousarray(buckets_local, np.int32)
+    n_local, P, KB = bl.shape
+    K = num_devices * KB
+    # same contract dedup_uids_sorted enforces on the post-wire path: a
+    # negative id would sort FIRST and silently shift every device-side
+    # searchsorted mapping instead of failing loud
+    if bl.size and int(bl.min()) < 0:
+        raise ValueError("exchange_push_uids_p2p expects nonnegative "
+                         "int32 pass-local ids")
+    dest_of_rank = _mesh_dest_plan(mesh, local_positions, num_devices)
+    t0 = _time.perf_counter()
+    mapper = pool.map if pool is not None else map
+    uniq_of = list(mapper(lambda d: np.unique(bl[:, d, :]),
+                          range(num_devices)))
+    parts = {}
+    for r, dests in enumerate(dest_of_rank):
+        uniqs = [uniq_of[d] for d in dests]
+        lens = [u.size for u in uniqs]
+        header = np.array([KB, len(dests)] + list(dests) + lens, np.int32)
+        parts[r] = np.concatenate([header] + uniqs)
+    got = mesh.exchange(parts)
+    mine = dest_of_rank[mesh.rank]
+    vecs = {d: [] for d in mine}
+    for part in got.values():
+        part = np.asarray(part, np.int32)
+        kb2, nd = int(part[0]), int(part[1])
+        if kb2 != KB:
+            raise ValueError("p2p uid exchange KB mismatch: peer sent "
+                             "KB=%d, local is KB=%d" % (kb2, KB))
+        dests = part[2:2 + nd].tolist()
+        lens = part[2 + nd:2 + 2 * nd]
+        offs = np.concatenate([[0], np.cumsum(lens)]) + 2 + 2 * nd
+        for j, d in enumerate(dests):
+            vecs[d].append(part[offs[j]:offs[j + 1]])
+    out = {}
+    for d in mine:
+        uniq = np.unique(np.concatenate(vecs[d]))
+        uids = np.empty(K, np.int32)
+        n = uniq.size
+        uids[:n] = uniq
+        uids[n:] = shard_cap + np.arange(K - n, dtype=np.int32)
+        out[d] = uids
+    # sends + receives, matching the store path's 1-write + W-reads count
+    wire = sum(int(p.nbytes) for r, p in parts.items() if r != mesh.rank) \
+        + sum(int(p.nbytes) for r, p in got.items() if r != mesh.rank)
+    stat_add("hostplane_exchange_bytes", wire)
+    stat_add("hostplane_exchange_us",
+             int((_time.perf_counter() - t0) * 1e6))
+    stat_add("hostplane_exchange_steps")
     return out
 
 
 def stage_push_dedup(buckets, local_positions, num_devices: int,
                      shard_cap: int, multiprocess: bool, all_gather,
                      rebuild: bool, pool, note_touched=None,
-                     uid_only: bool = False):
+                     uid_only: bool = False, mesh=None):
     """Per-destination push-dedup staging shared by BOTH sharded runners
     (trainer's _step_host_arrays + pipeline's device_batch): makes each
     shard's incoming a2a ids host-known (exchange_outgoing_buckets when
@@ -142,26 +299,50 @@ def stage_push_dedup(buckets, local_positions, num_devices: int,
     3-4 [P, P*KB]-shaped arrays to one, and the host dedup to one
     np.unique per destination; composes with the multi-process bucket
     exchange unchanged (the uids must still be host-known cluster-wide
-    for the touched-row accounting and writeback delta)."""
+    for the touched-row accounting and writeback delta).
+
+    mesh (hostplane=p2p, round 9): a fleet MeshComm — the multi-process
+    exchange rides the persistent p2p socket mesh instead of the store
+    allgather: raw bucket columns a2a for the full-product wire, or the
+    per-destination PRE-DEDUPED sorted uid vectors under uid_only (dedup
+    moves before the network). Staging products are bit-identical to the
+    store path either way. None = the store allgather (the loud-fallback
+    target)."""
     from paddlebox_tpu.embedding.pass_table import (dedup_ids,
                                                     dedup_uids_sorted,
                                                     pos_for_rebuild)
+    uids_by_dest = inc = global_buckets = None
     if multiprocess:
-        global_buckets = exchange_outgoing_buckets(
-            np.stack(buckets), local_positions, num_devices, all_gather)
         dests = local_positions
+        if mesh is not None and uid_only:
+            uids_by_dest = exchange_push_uids_p2p(
+                np.stack(buckets), local_positions, num_devices,
+                shard_cap, mesh, pool=pool)
+        elif mesh is not None:
+            inc = exchange_incoming_p2p(
+                np.stack(buckets), local_positions, num_devices, mesh)
+        else:
+            global_buckets = exchange_outgoing_buckets(
+                np.stack(buckets), local_positions, num_devices,
+                all_gather)
     else:
         global_buckets = buckets
         dests = range(num_devices)
+    if inc is not None:
+        incoming_of = lambda d: inc[d].reshape(-1)  # noqa: E731
+    else:
+        incoming_of = lambda d: np.concatenate(  # noqa: E731
+            [global_buckets[src][d] for src in range(num_devices)])
 
     def dedup_dest(d):
-        incoming = np.concatenate(
-            [global_buckets[src][d] for src in range(num_devices)])
-        if uid_only:
-            uids = dedup_uids_sorted(incoming, shard_cap)
+        if uids_by_dest is not None:
+            uids = uids_by_dest[d]
+            perm = inv = None
+        elif uid_only:
+            uids = dedup_uids_sorted(incoming_of(d), shard_cap)
             perm = inv = None
         else:
-            uids, perm, inv = dedup_ids(incoming, shard_cap)
+            uids, perm, inv = dedup_ids(incoming_of(d), shard_cap)
         if note_touched is not None:
             # every id this destination shard will push rides these uids —
             # the per-pass touched-row accumulation point (incremental

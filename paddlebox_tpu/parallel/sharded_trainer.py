@@ -108,6 +108,16 @@ class ShardedBoxTrainer:
             raise ValueError("multi-process ShardedBoxTrainer needs fleet=")
         if self.multiprocess and not self.n_local:
             raise ValueError("mesh has no devices for this process")
+        # p2p host data plane (round 9): the per-step bucket/uid exchange
+        # rides a persistent socket mesh rendezvous'd ONCE through the
+        # store (fleet/mesh_comm.py); None = the store-allgather plane
+        # (hostplane=store, or the collective loud fallback on a failed
+        # bring-up — make_mesh_comm warns and every rank reverts together)
+        from paddlebox_tpu.fleet.mesh_comm import resolve_hostplane
+        self.host_mesh = (
+            fleet.make_mesh_comm(self.local_positions)
+            if self.multiprocess and resolve_hostplane() == "p2p"
+            else None)
         kcap = feed.key_capacity()
         # bucket slack over the uniform K/P expectation (hash imbalance)
         self.bucket_cap = bucket_cap or max(16, (2 * kcap) // self.P)
@@ -733,7 +743,8 @@ class ShardedBoxTrainer:
                 self.fleet.all_gather if self.multiprocess else None,
                 rebuild=self._push_write == "rebuild", pool=pool,
                 note_touched=self.table.note_touched,
-                uid_only=bool(flags.get_flag("h2d_uid_wire"))))
+                uid_only=bool(flags.get_flag("h2d_uid_wire")),
+                mesh=self.host_mesh))
         return {k: np.stack(v) for k, v in stacked.items()}
 
     def shard_batches(self, per_worker: List[List[PackedBatch]],

@@ -80,6 +80,9 @@ class FramedServer:
                 conn, _ = self._server.accept()
             except OSError:
                 return
+            # small framed request/response pairs per step: Nagle would
+            # hold each response for the client's ACK (~40ms stalls)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
@@ -116,8 +119,11 @@ class FramedClient:
     def __init__(self, host: str, port: int,
                  loads: Callable[[bytes], Any] = plain_loads,
                  timeout: float = 300.0) -> None:
-        self._sock = socket.create_connection((host, port), timeout=60.0)
+        # connect honors the CALLER's timeout (a 5s-timeout client used to
+        # block 60s dialing a dead peer — mesh bring-up needs fast failure)
+        self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.settimeout(timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._loads = loads
         self._lock = threading.Lock()
         self._broken = False

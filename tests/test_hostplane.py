@@ -1,0 +1,274 @@
+"""P2P host data plane (round 9): socket mesh vs the store allgather.
+
+Fast tier: direct-endpoint mesh pairs + VIRTUAL 2-process staging (the
+test_two_virtual_process_uid_staging pattern) asserting the p2p exchange
+reproduces the store-path staging products BIT-IDENTICALLY in both wire
+modes, plus the fleet-level rendezvous/caching/collective-fallback
+contract, the store counter compaction, and the rpc transport fixes.
+
+Slow tier: a REAL 3-process localhost cluster running the full exchange
+ladder in parity mode (tools/hostplane_probe.py workers — pure host
+plane, no jax collectives, so it runs on the jax-0.4.x CPU container
+that skips test_multihost).
+"""
+
+import concurrent.futures
+import logging
+import os
+import socket
+import sys
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.fleet.fleet import Fleet
+from paddlebox_tpu.fleet.mesh_comm import MeshComm, MeshConnectError
+from paddlebox_tpu.fleet.role_maker import RoleMaker
+from paddlebox_tpu.fleet.store import KVStoreServer, TcpStoreClient
+
+
+@pytest.fixture
+def pool():
+    with concurrent.futures.ThreadPoolExecutor(4) as p:
+        yield p
+
+
+@pytest.fixture
+def mesh_pair():
+    """Two direct-endpoint MeshComm instances (no store)."""
+    meshes = [MeshComm(r, 2) for r in range(2)]
+    eps = {r: ("127.0.0.1", m.port) for r, m in enumerate(meshes)}
+    pos = {0: [0, 1, 2, 3], 1: [4, 5, 6, 7]}
+    for m in meshes:
+        m.connect(eps)
+        m.positions_of = dict(pos)
+    yield meshes
+    for m in meshes:
+        m.close()
+
+
+def test_mesh_exchange_lockstep(mesh_pair, pool):
+    """Per-rank parts land at the right peer, seqs pair send #n with
+    recv #n across multiple rounds, and the wire accounting moves."""
+    m0, m1 = mesh_pair
+    for step in range(3):
+        a = {0: np.array([step, 0]), 1: np.array([step, 1])}
+        b = {0: np.array([step, 100]), 1: np.array([step, 101])}
+        f = pool.submit(m1.exchange, b)
+        r0 = m0.exchange(a)
+        r1 = f.result()
+        np.testing.assert_array_equal(r0[1], b[0])
+        np.testing.assert_array_equal(r1[0], a[1])
+        # self part passes through by reference, no wire bytes
+        assert r0[0] is a[0] and r1[1] is b[1]
+    s0 = m0.stats()
+    assert s0["exchanges"] == 3
+    assert s0["bytes_sent"] > 0 and s0["bytes_recv"] > 0
+    assert m0.rank_of_position()[6] == 1
+
+
+def test_mesh_exchange_timeout(mesh_pair):
+    """A missing peer part surfaces as TimeoutError, not a hang."""
+    m0, _m1 = mesh_pair
+    m0._op_timeout = 0.3
+    with pytest.raises(TimeoutError):
+        m0.exchange({0: np.zeros(1), 1: np.zeros(1)})
+
+
+def _virtual_buckets(P, KB, shard_cap, seed=5):
+    rng = np.random.RandomState(seed)
+    buckets = np.full((P, P, KB), shard_cap - 1, np.int32)
+    for s in range(P):
+        for d in range(P):
+            n = rng.randint(2, KB)
+            buckets[s, d, :n] = rng.randint(0, shard_cap - 1, n)
+    return buckets
+
+
+@pytest.mark.parametrize("uid_only", [False, True])
+def test_p2p_vs_store_staging_parity(mesh_pair, pool, uid_only):
+    """The acceptance bar: stage_push_dedup over the p2p mesh must
+    reproduce the store-allgather path AND the single-process staging
+    bit-identically — uids, perm/inv, and the rebuild pos maps."""
+    from paddlebox_tpu.parallel.sharded_table import stage_push_dedup
+    P, KB, shard_cap = 8, 16, 128
+    buckets = _virtual_buckets(P, KB, shard_cap)
+
+    single = stage_push_dedup(list(buckets), list(range(P)), P, shard_cap,
+                              multiprocess=False, all_gather=None,
+                              rebuild=True, pool=pool, uid_only=uid_only)
+
+    def payload_of(bl, positions):
+        header = np.array([len(positions), P, KB] + list(positions),
+                          np.int32)
+        return np.concatenate([header,
+                               np.ascontiguousarray(bl, np.int32).ravel()])
+
+    parts = [payload_of(buckets[0:4], [0, 1, 2, 3]),
+             payload_of(buckets[4:8], [4, 5, 6, 7])]
+    fake_gather = lambda payload: parts  # noqa: E731
+
+    def run_rank(mesh, lo, positions, sink, touched):
+        staged = stage_push_dedup(
+            list(buckets[lo:lo + 4]), positions, P, shard_cap,
+            multiprocess=True, all_gather=fake_gather, rebuild=True,
+            pool=pool, uid_only=uid_only, mesh=mesh,
+            note_touched=lambda d, u: touched.add(d))
+        for i, d in enumerate(positions):
+            sink[d] = {k: v[i] for k, v in staged.items()}
+
+    out_store, out_p2p = {}, {}
+    t_store, t_p2p = set(), set()
+    run_rank(None, 0, [0, 1, 2, 3], out_store, t_store)
+    run_rank(None, 4, [4, 5, 6, 7], out_store, t_store)
+    f = pool.submit(run_rank, mesh_pair[1], 4, [4, 5, 6, 7], out_p2p,
+                    t_p2p)
+    run_rank(mesh_pair[0], 0, [0, 1, 2, 3], out_p2p, t_p2p)
+    f.result()
+
+    expect_keys = ({"push_uids"} if uid_only
+                   else {"push_uids", "push_perm", "push_inv", "push_pos"})
+    assert t_p2p == set(range(P))   # touched-row accounting still fires
+    for d in range(P):
+        assert set(out_p2p[d]) == expect_keys
+        for k in out_store[d]:
+            np.testing.assert_array_equal(
+                out_store[d][k], out_p2p[d][k],
+                err_msg=f"uid_only={uid_only} dest={d} key={k}")
+        np.testing.assert_array_equal(out_p2p[d]["push_uids"],
+                                      single["push_uids"][d])
+
+
+def test_fleet_mesh_rendezvous_and_cache(pool):
+    """Endpoints + positions rendezvous ONCE through the store; the mesh
+    is cached per Fleet; exchanges ride the persistent connections."""
+    server = KVStoreServer(host="127.0.0.1")
+    ep = "127.0.0.1:%d" % server.port
+    fls = [Fleet().init(RoleMaker(rank=r, world=2, store_endpoint=ep))
+           for r in range(2)]
+    try:
+        f1 = pool.submit(fls[1].make_mesh_comm, [4, 5, 6, 7])
+        m0 = fls[0].make_mesh_comm([0, 1, 2, 3])
+        m1 = f1.result()
+        assert m0 is not None and m1 is not None
+        assert m0.positions_of == {0: [0, 1, 2, 3], 1: [4, 5, 6, 7]}
+        assert fls[0].make_mesh_comm([0, 1, 2, 3]) is m0  # cached
+        f = pool.submit(m1.exchange, {0: np.array([7]), 1: np.array([8])})
+        r0 = m0.exchange({0: np.array([1]), 1: np.array([2])})
+        r1 = f.result()
+        assert r0[1][0] == 7 and r1[0][0] == 2
+    finally:
+        for fl in fls:
+            fl.stop()
+        server.stop()
+
+
+def test_fleet_p2p_fallback_collective_and_loud(pool, caplog):
+    """If ANY rank fails mesh bring-up, EVERY rank falls back to the
+    store plane together (a split decision would deadlock the lockstep
+    exchange) — and it warns loudly on both the failing and the healthy
+    rank."""
+    from paddlebox_tpu.fleet import mesh_comm as mc
+    server = KVStoreServer(host="127.0.0.1")
+    ep = "127.0.0.1:%d" % server.port
+    fls = [Fleet().init(RoleMaker(rank=r, world=2, store_endpoint=ep))
+           for r in range(2)]
+    orig = mc.MeshComm.connect
+
+    def broken(self, endpoints, timeout=60.0):
+        if self.rank == 1:
+            raise MeshConnectError("simulated unreachable peer")
+        return orig(self, endpoints, timeout)
+
+    try:
+        mc.MeshComm.connect = broken
+        with caplog.at_level(logging.WARNING, logger="paddlebox_tpu"):
+            f1 = pool.submit(fls[1].make_mesh_comm, [4, 5, 6, 7])
+            m0 = fls[0].make_mesh_comm([0, 1, 2, 3])
+            m1 = f1.result()
+        assert m0 is None and m1 is None
+        assert any("bring-up FAILED" in m for m in caplog.messages)
+        assert any("falling back to the store-allgather" in m
+                   for m in caplog.messages)
+    finally:
+        mc.MeshComm.connect = orig
+        for fl in fls:
+            fl.stop()
+        server.stop()
+
+
+def test_store_counter_compaction(pool):
+    """Collective counters older than 2 rounds are retired by rank 0 —
+    a long multi-process run no longer grows the store unboundedly —
+    while the last 2 rounds' (which a laggard may still wait on) stay."""
+    server = KVStoreServer(host="127.0.0.1")
+    ep = "127.0.0.1:%d" % server.port
+    fls = [Fleet().init(RoleMaker(rank=r, world=2, store_endpoint=ep))
+           for r in range(2)]
+    admin = TcpStoreClient("127.0.0.1", server.port)
+    try:
+        for i in range(5):
+            f = pool.submit(fls[1].all_gather, np.array([i + 10]))
+            got = fls[0].all_gather(np.array([i]))
+            f.result()
+            assert int(got[1][0]) == i + 10   # collective still correct
+        f = pool.submit(fls[1].barrier_worker)
+        fls[0].barrier_worker()
+        f.result()
+        run, s = fls[0]._run_id, fls[0]._seq
+        for q in range(1, s - 1):
+            assert admin.counter("%s/coll/%d/ack" % (run, q)) == 0
+            assert admin.counter("%s/barrier/%d" % (run, q)) == 0
+        live = [admin.counter("%s/coll/%d/ack" % (run, q))
+                + admin.counter("%s/barrier/%d" % (run, q))
+                for q in (s - 1, s)]
+        assert all(c == 2 for c in live), live
+    finally:
+        admin.close()
+        for fl in fls:
+            fl.stop()
+        server.stop()
+
+
+def test_hostplane_flag_validated():
+    """A hostplane typo must fail loud, not silently select the slow
+    store funnel; case/whitespace variants normalize."""
+    from paddlebox_tpu.config import flags
+    from paddlebox_tpu.fleet.mesh_comm import resolve_hostplane
+    assert resolve_hostplane() == "p2p"          # the default
+    flags.set_flag("hostplane", "P2P ")
+    assert resolve_hostplane() == "p2p"
+    flags.set_flag("hostplane", "store")
+    assert resolve_hostplane() == "store"
+    flags.set_flag("hostplane", "p2pp")
+    with pytest.raises(ValueError, match="hostplane"):
+        resolve_hostplane()
+
+
+def test_rpc_client_timeout_and_nodelay():
+    """Satellite regression: FramedClient must HONOR its timeout arg at
+    connect time (it used to hardcode 60s) and set TCP_NODELAY on the
+    small-framed per-step connections."""
+    from paddlebox_tpu.utils.rpc import FramedClient, FramedServer
+    server = FramedServer(lambda req: req, host="127.0.0.1")
+    try:
+        c = FramedClient("127.0.0.1", server.port, timeout=7.5)
+        assert c._sock.gettimeout() == 7.5
+        assert c._sock.getsockopt(socket.IPPROTO_TCP,
+                                  socket.TCP_NODELAY) != 0
+        assert c.call({"op": "echo"}) == {"op": "echo"}
+        c.close()
+    finally:
+        server.stop()
+
+
+@pytest.mark.slow
+def test_three_process_exchange_parity():
+    """REAL 3-process localhost cluster (uneven shard ownership: 3|3|2
+    of 8 mesh positions): every worker runs the full ladder in parity
+    mode — store vs p2p vs p2p+uid products must be bit-identical."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+    from tools.hostplane_probe import run_world
+    r = run_world(world=3, kb=512, steps=1, runs=1, parity_only=True,
+                  timeout=300.0)
+    assert r["tiers"] == {"parity": "ok"}, r

@@ -36,6 +36,11 @@ FLOORS = {
     # Recorded on a LOADED round-8 container (sibling rows at ~60% of
     # their quiet-box rates the same run); floor = ~40% of it
     "e2e_lean_examples_per_sec": (6.8e3, 2.7e3),
+    # round-9: the p2p host-plane bucket a2a, two in-process mesh
+    # endpoints over loopback (keys = one rank's n_local*P*KB per step);
+    # the multi-process ladder in tools/hostplane_probe.py recorded
+    # store=229.6ms vs p2p=36.4ms at the same shape this round
+    "p2p_exchange_keys_per_sec": (30.1e6, 12e6),
 }
 
 failures = []
@@ -102,6 +107,38 @@ def main():
     valid = np.ones(K, bool)
     report("bucketize_keys_per_sec",
            timed_rate(lambda: t.bucketize(probe, valid.copy()), K))
+
+    # --- p2p host-plane exchange tier (round 9) ----------------------
+    # two in-process mesh endpoints over loopback running the per-step
+    # bucket a2a (exchange_incoming_p2p) in lockstep — guards the socket
+    # mesh data plane between real multi-process runs (the full ladder
+    # incl. the store tier lives in tools/hostplane_probe.py)
+    from concurrent.futures import ThreadPoolExecutor
+
+    from paddlebox_tpu.fleet.mesh_comm import MeshComm
+    from paddlebox_tpu.parallel.sharded_table import exchange_incoming_p2p
+    world, P_hp, KB_hp = 2, 8, 8192
+    meshes = [MeshComm(r, world) for r in range(world)]
+    eps = {r: ("127.0.0.1", m.port) for r, m in enumerate(meshes)}
+    pos = {0: [0, 1, 2, 3], 1: [4, 5, 6, 7]}
+    for m in meshes:
+        m.connect(eps)
+        m.positions_of = dict(pos)
+    bks = [rng.randint(0, (1 << 16) - 1, (4, P_hp, KB_hp)).astype(np.int32)
+           for _ in range(world)]
+    hp_pool = ThreadPoolExecutor(1)
+
+    def one_exchange():
+        f = hp_pool.submit(exchange_incoming_p2p, bks[1], pos[1], P_hp,
+                           meshes[1])
+        exchange_incoming_p2p(bks[0], pos[0], P_hp, meshes[0])
+        f.result()
+
+    report("p2p_exchange_keys_per_sec",
+           timed_rate(one_exchange, 4 * P_hp * KB_hp))
+    for m in meshes:
+        m.close()
+    hp_pool.shutdown(wait=False)
 
     # --- parse + pack tier -------------------------------------------
     import tempfile
