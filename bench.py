@@ -170,7 +170,8 @@ def measure(platform: str) -> None:
         return {"wire_bytes_per_step": wire // CHUNK,
                 "host_stage_keys_per_sec": round(reps * keys / dt_s, 0)}
 
-    def run_e2e(tg: int, n_chunks: int = 4, runs: int = 3) -> dict:
+    def run_e2e(tg: int, n_chunks: int = 4, runs: int = 3,
+                on_chunk=None) -> dict:
         """REAL staged-path throughput: host staging + H2D + dispatch +
         per-chunk D2H over fresh chunk items (the train_pass shape), with
         tg chunks sharing one transfer per leaf (h2d_stack_chunks). The
@@ -195,7 +196,7 @@ def measure(platform: str) -> None:
                 scan_call, batches * n, CHUNK,
                 trainer._stack_batches_host if tg > 1
                 else trainer._stack_batches,
-                carry, lambda *a: None, prefetch_depth=1,
+                carry, on_chunk or (lambda *a: None), prefetch_depth=1,
                 transfer_group=tg,
                 group_fn=trainer._group_to_device if tg > 1 else None)
 
@@ -225,6 +226,117 @@ def measure(platform: str) -> None:
             _flags.set_flag("h2d_uid_wire", True)
             _flags.set_flag("wire_delta_ids", False)
 
+    def telemetry_overhead() -> dict:
+        """Round-10 acceptance block: the SAME e2e drive with the
+        telemetry plane at its default cadence (span tracer on, a
+        StepReporter at obs_report_every=20 feeding a JSONL sink, beats)
+        vs everything off — median paired on/off ratio over alternating
+        back-to-back pairs, plus an in-run validity
+        check that the exported chrome trace round-trips json.loads
+        with the Perfetto-required event fields."""
+        import tempfile
+
+        import paddlebox_tpu.obs as _obs
+        from paddlebox_tpu.obs.tracer import get_tracer
+
+        # ONE monotonically increasing step counter across every "on"
+        # drive: the reporter's cadence state (_last_step) persists, so a
+        # per-drive counter restarting at 0 would fire exactly once ever
+        # and under-measure the report-assembly cost
+        steps = [0]
+
+        def run_with(trace_on: bool, reporter=None) -> float:
+            get_tracer().enabled = trace_on
+
+            def on_chunk(lo, group, losses_np, preds):
+                if reporter is None:
+                    return
+                steps[0] += len(group)
+                reporter.note_examples(len(group) * BATCH)
+                reporter.maybe_report(steps[0])
+
+            return run_e2e(tg=1, runs=1,
+                           on_chunk=on_chunk if reporter else None
+                           )["examples_per_sec"]
+
+        fd, tmp = tempfile.mkstemp(suffix="_obs.jsonl")
+        os.close(fd)
+        reporter = _obs.StepReporter(every=20, sink=_obs.JsonlSink(tmp))
+        # PAIRED on/off ratios, order alternating within pairs: container
+        # load drifts ±20-30% across minutes, so independent medians (or
+        # sequential blocks — the first cut of this block measured "on"
+        # 42% FASTER than "off" that way) measure the load phase, not the
+        # telemetry. Back-to-back pair members share a load environment;
+        # the MEDIAN PAIR RATIO is the drift-robust overhead estimate.
+        # 9 pairs: this container's bursts poison whole pairs (a recorded
+        # run saw one member at 1557 ex/s against 8400 in the same
+        # block), so the median must survive up to 4 bad pairs.
+        rates_on, rates_off, ratios = [], [], []
+        for i in range(9):
+            if i % 2:
+                off = run_with(False, None)
+                on = run_with(True, reporter)
+            else:
+                on = run_with(True, reporter)
+                off = run_with(False, None)
+            rates_on.append(on)
+            rates_off.append(off)
+            ratios.append(on / max(off, 1e-9))
+        reporter.close()
+        eps_on = float(np.median(rates_on))
+        eps_off = float(np.median(rates_off))
+        ratio = float(np.median(ratios))
+        # best-rate ratio: co-tenant noise can only LOWER a run's rate
+        # (it never makes one faster), so each arm's best run over 9
+        # samples is its noise-free ceiling and their ratio is the
+        # load-robust overhead estimate — the rate-domain analog of the
+        # standard min-time-of-k microbenchmark discipline. The median
+        # pair ratio stays recorded as the conservative bound; under
+        # heavy load its own noise floor is several percent (recorded
+        # pair ratios have spanned 0.74-1.50 on this container).
+        ratio_best = float(max(rates_on) / max(max(rates_off), 1e-9))
+        get_tracer().enabled = True
+        fd, trace_path = tempfile.mkstemp(suffix="_trace.json")
+        os.close(fd)
+        doc = _obs.export_chrome_trace(path=trace_path)
+        trace_ok = False
+        try:
+            with open(trace_path) as fh:
+                loaded = json.loads(fh.read())
+            evs = [e for e in loaded["traceEvents"] if e.get("ph") == "X"]
+            trace_ok = bool(evs) and all(
+                k in e for e in evs[:64]
+                for k in ("name", "ts", "dur", "pid", "tid"))
+        except (ValueError, OSError, KeyError):
+            trace_ok = False
+        n_reports = 0
+        if os.path.exists(tmp):
+            with open(tmp) as fh:
+                n_reports = sum(1 for _ in fh)
+        for p in (tmp, trace_path):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        return {"examples_per_sec_on": round(eps_on, 1),
+                "examples_per_sec_off": round(eps_off, 1),
+                "runs_on": [round(r, 1) for r in rates_on],
+                "runs_off": [round(r, 1) for r in rates_off],
+                "pair_ratios": [round(r, 4) for r in ratios],
+                # best-rate on/off ratio (see above); positive =
+                # telemetry costs throughput
+                "overhead_pct": round(100.0 * (1.0 - ratio_best), 2),
+                # conservative bound: median paired on/off ratio (its
+                # noise floor under container load is several percent)
+                "overhead_pct_median_pair": round(100.0 * (1.0 - ratio),
+                                                  2),
+                "reports_emitted": n_reports,
+                # ph:"X" spans only — traceEvents also carries one
+                # thread_name metadata event per thread
+                "span_events": sum(1 for e in doc["traceEvents"]
+                                   if e.get("ph") == "X"),
+                "chrome_trace_valid": trace_ok}
+
     tiers = {
         "grouped": run_e2e(tg=4),
         "ungrouped": run_e2e(tg=1),
@@ -239,6 +351,14 @@ def measure(platform: str) -> None:
     e2e_grouped = tiers["grouped"]["examples_per_sec"]
     e2e_per_chunk = tiers["ungrouped"]["examples_per_sec"]
     e2e_lean = tiers["uid_lean"]["examples_per_sec"]
+
+    # round-10: telemetry-plane overhead at default cadence (≤2% target,
+    # recorded in BASELINE.md round 10). GUARDED: diagnostics must never
+    # cost the headline metric.
+    try:
+        telemetry = telemetry_overhead()
+    except Exception as e:  # noqa: BLE001 — diagnostic tier, not the metric
+        telemetry = {"error": repr(e)[:300]}
 
     # pass-amortized tier (round-6): the full begin_feed → train →
     # end_pass lifecycle at 0% and ~90% working-set overlap, full vs
@@ -279,6 +399,7 @@ def measure(platform: str) -> None:
         "e2e_tiers": tiers,
         "pass_amortized": pass_amortized,
         "pass_amortized_examples_per_sec": pa_eps,
+        "telemetry_overhead": telemetry,
         "compile_warmup_s": round(t_compile, 1),
     }))
 
@@ -385,6 +506,7 @@ def main() -> None:
         "pass_amortized": result.get("pass_amortized"),
         "pass_amortized_examples_per_sec": result.get(
             "pass_amortized_examples_per_sec", 0.0),
+        "telemetry_overhead": result.get("telemetry_overhead"),
         "hostplane": hostplane,
         "compile_warmup_s": result.get("compile_warmup_s"),
         "diags": diags,

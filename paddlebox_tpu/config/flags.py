@@ -220,6 +220,41 @@ define_flag("incremental_pass", True,
             "bytes of host RAM — small next to the host store itself, "
             "but not free). Off = rebuild the whole slab every pass (the "
             "pre-round-6 behavior, no residency anywhere)")
+define_flag("obs_trace", True,
+            "record named spans into the per-thread ring tracer "
+            "(obs/tracer.py — the cheap always-on tier of the reference's "
+            "tracing ladder, platform::RecordEvent role). ~1us/span; the "
+            "ring is what export_chrome_trace and the stall watchdog "
+            "dump read. Off = span() returns a shared no-op")
+define_flag("obs_trace_capacity", 4096,
+            "spans retained PER THREAD in the tracer ring before "
+            "wrap-around (fixed memory: capacity * ~100B per thread)")
+define_flag("obs_report_every", 20,
+            "StepReport cadence in steps (obs/report.py): every N steps "
+            "the trainer assembles one structured record — stage timer "
+            "deltas, StatRegistry counter deltas, gauges, histogram "
+            "percentiles, examples/sec — and emits it through the "
+            "configured sink (obs_report_path); in multi-process runs "
+            "non-zero ranks also piggyback it to rank 0 for the merged "
+            "cluster view. <=0 = reporting off (zero assembly cost)")
+define_flag("obs_report_path", "",
+            "StepReport sink: '' = assemble + retain only (the watchdog "
+            "and cluster aggregation still see reports), 'stderr' = one "
+            "JSON line per report to stderr, any other value = append-"
+            "JSONL file path (rank 0's file also carries the merged "
+            "cluster_report records in multi-process runs)")
+define_flag("obs_watchdog_secs", 0.0,
+            "stall watchdog silence threshold in seconds (obs/"
+            "watchdog.py, the native tools/tpu_watchdog.sh successor): "
+            "runners beat at step and exchange boundaries; when no beat "
+            "arrives within the threshold the watchdog dumps the last-K "
+            "spans, every thread's stack, and the last StepReport to "
+            "stderr. <=0 = disabled")
+define_flag("obs_watchdog_action", "dump",
+            "what the watchdog does after dumping: 'dump' = report only "
+            "(fires once per silence window), 'raise' = also interrupt "
+            "the main thread (KeyboardInterrupt) so a wedged job dies "
+            "loudly instead of burning its reservation")
 define_flag("preload_promote", True,
             "overlap the NEXT pass's host-side promote work (key diff + "
             "host-store reads for non-resident keys) with the current "

@@ -129,7 +129,7 @@ class BoxDataset:  # boxlint: disable=BX403
         self._perm = None
         self._add_keys_fn = add_keys_fn
         self._load_error = None
-        self._channel = Channel(capacity=64)
+        self._channel = Channel(capacity=64, name="dataset_blocks")
         files = list(self._files)
         from paddlebox_tpu.data.archive import is_archive, read_archive
         # per-load state is captured in locals so a failed later call can't

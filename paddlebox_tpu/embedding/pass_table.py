@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -34,7 +35,8 @@ from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
 from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
 from paddlebox_tpu.embedding.native_store import make_host_store
 from paddlebox_tpu.embedding.optimizers import apply_push
-from paddlebox_tpu.utils.stats import stat_add
+from paddlebox_tpu.obs.tracer import record_span
+from paddlebox_tpu.utils.stats import gauge_set, stat_add
 from paddlebox_tpu.utils.timer import Timer
 
 
@@ -405,7 +407,9 @@ class PassTable:
             raise RuntimeError("begin_pass before feed pass completed")
         t = self.timers["build"]
         t.start()
+        _t0 = time.perf_counter()
         n = self._pass_keys.size
+        gauge_set("pass_rows", n)
         inc = (self._incremental() and self._resident_keys is not None
                and self._slab is not None)
         if inc:
@@ -457,6 +461,7 @@ class PassTable:
             if self._incremental():
                 self._touched = np.zeros(self.capacity, bool)
         self._in_pass = True
+        record_span("pass_begin", _t0, time.perf_counter())
         t.pause()
 
     def note_touched(self, ids: np.ndarray) -> None:
@@ -483,6 +488,7 @@ class PassTable:
             raise RuntimeError("end_pass without begin_pass")
         t = self.timers["end"]
         t.start()
+        _t0 = time.perf_counter()
         n = self._pass_keys.size
         if self._test_mode:
             # no write-back, no residency from an eval slab
@@ -517,6 +523,7 @@ class PassTable:
         self._residency_poisoned = False
         self._in_pass = False
         self.check_need_limit_mem()  # spill>0 invalidates internally
+        record_span("pass_end", _t0, time.perf_counter())
         t.pause()
 
     def invalidate_residency(self) -> None:

@@ -84,6 +84,15 @@ class Fleet:
     def is_first_worker(self) -> bool:
         return self.role.is_first_worker()
 
+    def store_client(self):
+        """The KV store client (None in single-rank jobs) — public surface
+        for planes that piggyback on the store (obs/aggregate.py)."""
+        return self._client
+
+    def obs_namespace(self) -> str:
+        """Run-scoped key namespace for telemetry piggyback writes."""
+        return "%s/obs" % self._run_id
+
     # ---------------------------------------------------------- collectives
     def barrier_worker(self, timeout: float = 120.0) -> None:
         """All ranks reach this point (GlooWrapper::Barrier)."""

@@ -35,7 +35,10 @@ from typing import Dict, Iterable, List, Mapping, Optional, Tuple
 
 import numpy as np
 
+from paddlebox_tpu.obs import beat as obs_beat
+from paddlebox_tpu.obs.tracer import record_span
 from paddlebox_tpu.utils.rpc import FramedClient, FramedServer, plain_loads
+from paddlebox_tpu.utils.stats import hist_observe
 
 
 class MeshConnectError(ConnectionError):
@@ -90,8 +93,18 @@ class MeshComm:
         # collects it; bounded by the exchange lockstep (a peer can run at
         # most one exchange ahead before blocking on OUR part)
         self._inbox: Dict[Tuple[int, int], dict] = {}  # guarded-by: _cv
+        # one-way telemetry piggyback (obs/aggregate.py): raw payloads
+        # parked here by the connection threads, drained by the local
+        # reporter at its own cadence — no sequencing, no lockstep
+        self._obs_inbox: List[bytes] = []  # guarded-by: _cv
         self._conn_lock = threading.Lock()
         self._clients: Dict[int, FramedClient] = {}  # guarded-by: _conn_lock
+        self._endpoints: Dict[int, Tuple[str, int]] = {}  # guarded-by: _conn_lock
+        # telemetry frames ride their OWN short-timeout connection: a
+        # transient peer stall during a best-effort obs publish must not
+        # mark the shared EXCHANGE client broken (FramedClient never
+        # reconnects) and take the data plane down with it
+        self._obs_clients: Dict[int, FramedClient] = {}  # guarded-by: _conn_lock
         # mesh-device positions each fleet rank owns (gathered at
         # rendezvous); lets the sharded a2a route destination shard d to
         # its owner rank without assuming fleet rank == jax process index
@@ -112,14 +125,79 @@ class MeshComm:
 
     # ------------------------------------------------------------ recv side
     def _on_request(self, req: dict):
-        if req.get("op") != "part":
-            raise ValueError("unknown mesh op %r" % (req.get("op"),))
+        op = req.get("op")
+        if op == "obs":
+            with self._cv:
+                self._obs_inbox.append(req["data"])
+                # bounded drop-oldest: if the local aggregator stops
+                # draining (dead sink, wedged driver) peers keep
+                # publishing — telemetry must cap at stale-window loss,
+                # never unbounded memory
+                cap = max(64, 4 * self.world)
+                if len(self._obs_inbox) > cap:
+                    del self._obs_inbox[:len(self._obs_inbox) - cap]
+            return True
+        if op != "part":
+            raise ValueError("unknown mesh op %r" % (op,))
         key = (int(req["seq"]), int(req["from"]))
         with self._cv:
             self._inbox[key] = req
             self.bytes_recv += len(req["data"])
             self._cv.notify_all()
         return True
+
+    # -------------------------------------------------- telemetry piggyback
+    OBS_TIMEOUT = 10.0
+
+    def send_obs(self, payload: bytes, to_rank: int = 0) -> None:
+        """One-way telemetry frame to a peer's server over a DEDICATED
+        short-timeout connection (dialed lazily from the rendezvous'd
+        endpoint, re-dialed after a failure). Kept separate from the
+        exchange clients on purpose: a timeout here bricks only the
+        telemetry connection, never the lockstep data plane. Raises on
+        failure — the caller (ClusterAggregator) treats publish as
+        best-effort. Self-sends park directly in the local obs inbox."""
+        if to_rank == self.rank:
+            with self._cv:
+                self._obs_inbox.append(bytes(payload))
+            return
+        with self._conn_lock:
+            c = self._obs_clients.get(to_rank)
+            ep = self._endpoints.get(to_rank)
+        if c is None:
+            if ep is None:
+                raise ConnectionError(
+                    "mesh rank %d has no endpoint for peer %d"
+                    % (self.rank, to_rank))
+            # dial OUTSIDE _conn_lock: the exchange send path takes the
+            # same lock to look up its clients, and a ~OBS_TIMEOUT
+            # connect to a wedged peer must not stall the data plane
+            c = FramedClient(ep[0], ep[1], plain_loads,
+                             timeout=self.OBS_TIMEOUT)
+            with self._conn_lock:
+                prev = self._obs_clients.get(to_rank)
+                if prev is None:
+                    self._obs_clients[to_rank] = c
+                else:           # lost a dial race; use the winner
+                    c.close()
+                    c = prev
+        try:
+            c.call({"op": "obs", "data": bytes(payload)},
+                   op_timeout=self.OBS_TIMEOUT)
+        except (OSError, ConnectionError):
+            # drop the broken telemetry connection; the next publish
+            # re-dials (the exchange clients are untouched)
+            with self._conn_lock:
+                if self._obs_clients.get(to_rank) is c:
+                    del self._obs_clients[to_rank]
+            c.close()
+            raise
+
+    def drain_obs(self) -> List[bytes]:
+        """Pop every parked telemetry payload (rank 0's aggregator)."""
+        with self._cv:
+            out, self._obs_inbox = self._obs_inbox, []
+        return out
 
     # ----------------------------------------------------------- rendezvous
     def rendezvous(self, store, namespace: str, advertise_host: str,
@@ -148,6 +226,8 @@ class MeshComm:
         lifetime. Raises MeshConnectError naming the first unreachable
         peer so the caller can fall back loudly."""
         with self._conn_lock:
+            self._endpoints.update({int(r): (h, int(p))
+                                    for r, (h, p) in endpoints.items()})
             for r, (host, port) in sorted(endpoints.items()):
                 if r == self.rank or r in self._clients:
                     continue
@@ -231,8 +311,14 @@ class MeshComm:
             out[r] = _unframe(frame)
         for f in futs.values():
             self.bytes_sent += f.result()   # surfaces send errors too
-        self.exchange_ms += (time.perf_counter() - t0) * 1e3
+        t1 = time.perf_counter()
+        self.exchange_ms += (t1 - t0) * 1e3
         self.exchanges += 1
+        record_span("mesh_exchange", t0, t1)
+        hist_observe("mesh_exchange_us", (t1 - t0) * 1e6)
+        # the exchange is a cluster-progress boundary: a peer that never
+        # answers shows up as watchdog silence with this as the last beat
+        obs_beat("mesh_exchange")
         return out
 
     def stats(self) -> Dict[str, float]:
@@ -251,4 +337,7 @@ class MeshComm:
             for c in self._clients.values():
                 c.close()
             self._clients = {}
+            for c in self._obs_clients.values():
+                c.close()
+            self._obs_clients = {}
         self._server.stop()

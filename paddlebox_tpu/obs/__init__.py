@@ -1,0 +1,84 @@
+"""obs: the runtime telemetry plane (round 10).
+
+Unifies the reproduction's observability tiers the way the reference's
+platform/monitor.h + timer discipline + chrometracing profiler did
+(SURVEY.md §5.1), always-on cheap:
+
+  * span tracer  — per-thread ring of named spans; chrome-tracing JSON
+                   export loadable in Perfetto without jax.profiler
+                   (obs/tracer.py)
+  * StepReport   — per-cadence structured record (timer/stat deltas,
+                   gauges, histogram percentiles, examples/sec) through a
+                   pluggable MetricsSink (obs/report.py)
+  * aggregation  — non-zero ranks piggyback their reports to rank 0 over
+                   the existing mesh/store plane; rank 0 emits a merged
+                   per-rank min/median/max view (obs/aggregate.py)
+  * watchdog     — heartbeat thread dumping spans + per-thread stacks +
+                   the last StepReport on silence (obs/watchdog.py)
+  * log          — rank-prefixed structured lines replacing bare print()
+                   in library code (obs/log.py; boxlint BX501 enforces)
+
+Import surface is deliberately jax-free: every hot-path hook (span,
+beat) must stay importable and near-free on any host.
+"""
+
+from paddlebox_tpu.obs import log  # noqa: F401
+from paddlebox_tpu.obs.aggregate import (ClusterAggregator,  # noqa: F401
+                                         MeshObsTransport, StoreObsTransport,
+                                         make_transport,
+                                         merge_cluster_reports)
+from paddlebox_tpu.obs.report import (JsonlSink, ListSink,  # noqa: F401
+                                      MetricsSink, NullSink, StderrSink,
+                                      StepReporter, make_sink)
+from paddlebox_tpu.obs.tracer import (SpanTracer, get_tracer,  # noqa: F401
+                                      span)
+from paddlebox_tpu.obs.tracer import \
+    configure_from_flags as _tracer_configure
+from paddlebox_tpu.obs.watchdog import StallWatchdog  # noqa: F401
+from paddlebox_tpu.obs.watchdog import beat  # noqa: F401
+from paddlebox_tpu.obs.watchdog import ensure_from_flags as _wd_ensure
+
+
+def make_step_reporter(rank: int = 0, timers=None, aggregator=None,
+                       **kwargs) -> StepReporter:
+    """Flag-configured reporter + tracer sync + (flag-gated) watchdog —
+    the one call every trainer makes at construction."""
+    _tracer_configure()
+    reporter = StepReporter(rank=rank, timers=timers,
+                            aggregator=aggregator, **kwargs)
+    _wd_ensure(tracer=get_tracer(), report_fn=reporter.peek)
+    return reporter
+
+
+def obs_rank_world(mesh=None, fleet=None):
+    """(rank, world) in the TRANSPORT rank space — mesh rank == fleet
+    worker index, the space both piggyback planes address their "rank 0"
+    in. Never jax.process_index(): a job is free to map fleet ranks onto
+    jax processes differently (MeshComm.positions_of exists for exactly
+    that), and a mismatched aggregator would drain nothing while the
+    real rank 0 self-publishes into an inbox nobody reads."""
+    if mesh is not None:
+        return int(mesh.rank), int(mesh.world)
+    if fleet is not None and getattr(fleet, "initialized", False):
+        return int(fleet.worker_index()), int(fleet.worker_num())
+    return 0, 1
+
+
+def make_cluster_aggregator(mesh=None, fleet=None, rank: int = 0,
+                            world: int = 1):
+    """The ONE multi-process aggregator wiring both sharded runners use:
+    transport from the job's existing plane (p2p mesh, else fleet
+    store), rank 0 emitting merged cluster reports through the
+    flag-configured sink. None when no piggyback plane exists."""
+    transport = make_transport(mesh=mesh, fleet=fleet)
+    if transport is None:
+        return None
+    from paddlebox_tpu.config import flags
+    sink = (make_sink(str(flags.get_flag("obs_report_path")))
+            if rank == 0 else None)
+    return ClusterAggregator(transport, rank, world, sink=sink)
+
+
+def export_chrome_trace(path=None, rank: int = 0) -> dict:
+    """Dump the span rings as chrome-tracing JSON (Perfetto-loadable)."""
+    return get_tracer().export_chrome(path=path, pid=rank)

@@ -1,0 +1,232 @@
+"""Cluster-wide telemetry aggregation: per-rank StepReports → rank-0 view.
+
+Every report cadence, non-zero ranks ship their StepReport to rank 0
+PIGGYBACKED on a plane the job already runs — the p2p socket mesh (a
+one-way "obs" frame to the same FramedServer the exchanges use, but
+over a DEDICATED short-timeout connection so a telemetry stall can
+never brick the lockstep exchange clients; fleet/mesh_comm.py send_obs)
+or, when the job runs the store host plane, fire-and-forget KV writes
+on the TcpStore. Neither is a collective: a slow rank delays nothing,
+rank 0 merges whatever snapshots have arrived and marks the rest stale.
+
+The merged cluster report carries per-rank min/median/max (plus the
+per-rank values) for every numeric window metric — which is exactly the
+view that makes hostplane imbalance and straggler ranks visible — and
+sums histogram bucket counts across ranks before computing percentiles
+(fixed shared bounds make that sound; utils/stats.HIST_BOUNDS).
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from paddlebox_tpu.obs.report import SCHEMA_VERSION, MetricsSink, NullSink
+from paddlebox_tpu.utils.stats import hist_percentile
+
+
+class StoreObsTransport:
+    """Fleet-store piggyback: rank r overwrites ONE key per rank
+    (`<ns>/obs/<rank>`) with its latest report; rank 0 polls them
+    non-blockingly at its own cadence. Overwrite-in-place keeps the store
+    footprint O(world) forever — no per-step key growth, no barriers."""
+
+    def __init__(self, store, namespace: str, rank: int, world: int) -> None:
+        self._store = store
+        self._ns = namespace.rstrip("/")
+        self.rank = int(rank)
+        self.world = int(world)
+        self._last_seq: Dict[int, Tuple[str, int]] = {}
+        self._seq = 0
+        # per-transport epoch: a rank restarted by elastic recovery
+        # builds a FRESH transport whose seq restarts at 0 — without the
+        # epoch in the frame head, rank 0 would discard its reports as
+        # stale forever and the rank would read as a permanent straggler
+        self._epoch = uuid.uuid4().hex[:12]
+
+    def _key(self, rank: int) -> str:
+        return "%s/%d" % (self._ns, rank)
+
+    def publish(self, payload: bytes) -> None:
+        self._seq += 1
+        framed = (json.dumps([self._epoch, self._seq]).encode()
+                  + b"\n" + payload)
+        self._store.set(self._key(self.rank), framed)
+
+    def drain(self) -> List[bytes]:
+        out = []
+        for r in range(self.world):
+            if r == self.rank:
+                continue
+            raw = self._store.get(self._key(r))
+            if raw is None:
+                continue
+            head, _, payload = bytes(raw).partition(b"\n")
+            epoch, seq = json.loads(head)
+            last = self._last_seq.get(r)
+            if (last is not None and last[0] == epoch
+                    and int(seq) <= last[1]):
+                continue            # already merged this window
+            self._last_seq[r] = (str(epoch), int(seq))
+            out.append(payload)
+        return out
+
+
+class MeshObsTransport:
+    """P2P-mesh piggyback: one fire-and-forget framed call to rank 0's
+    FramedServer over MeshComm.send_obs's DEDICATED short-timeout obs
+    connection — deliberately NOT the exchange clients, so a telemetry
+    timeout bricks only the (re-dialable) obs connection, never the
+    lockstep data plane."""
+
+    def __init__(self, mesh) -> None:
+        self._mesh = mesh
+        self.rank = int(mesh.rank)
+        self.world = int(mesh.world)
+
+    def publish(self, payload: bytes) -> None:
+        self._mesh.send_obs(payload, to_rank=0)
+
+    def drain(self) -> List[bytes]:
+        return self._mesh.drain_obs()
+
+
+def make_transport(mesh=None, fleet=None):
+    """The piggyback plane for this job: the p2p mesh when it is up,
+    else the fleet store, else None (single-rank / no control plane)."""
+    if mesh is not None:
+        return MeshObsTransport(mesh)
+    if fleet is not None and getattr(fleet, "initialized", False):
+        client = fleet.store_client()
+        if client is not None and fleet.worker_num() > 1:
+            return StoreObsTransport(client, fleet.obs_namespace(),
+                                     fleet.worker_index(),
+                                     fleet.worker_num())
+    return None
+
+
+def merge_cluster_reports(reports: List[dict]) -> dict:
+    """Rank-0 merge of one window's per-rank StepReports: per-metric
+    min/median/max + per_rank values over stats/gauges/timer-ms/
+    examples_per_sec; histogram counts sum elementwise before the
+    percentile math."""
+    per_metric: Dict[str, Dict[int, float]] = {}
+    hist_sums: Dict[str, List[int]] = {}
+    ranks = []
+    step = 0
+    for rec in reports:
+        r = int(rec.get("rank", 0))
+        ranks.append(r)
+        step = max(step, int(rec.get("step", 0)))
+        per_metric.setdefault("examples_per_sec", {})[r] = float(
+            rec.get("examples_per_sec", 0.0))
+        for k, v in (rec.get("stats") or {}).items():
+            per_metric.setdefault("stats." + k, {})[r] = float(v)
+        for k, v in (rec.get("gauges") or {}).items():
+            per_metric.setdefault("gauges." + k, {})[r] = float(v)
+        for k, v in (rec.get("timers") or {}).items():
+            per_metric.setdefault("timers.%s.ms" % k, {})[r] = float(
+                v.get("ms", 0.0))
+        for k, h in (rec.get("hists") or {}).items():
+            counts = h.get("counts") or []
+            cur = hist_sums.get(k)
+            if cur is None:
+                hist_sums[k] = list(counts)
+            else:
+                for i, c in enumerate(counts):
+                    if i < len(cur):
+                        cur[i] += c
+                    else:
+                        cur.append(c)
+    metrics = {}
+    for k, by_rank in sorted(per_metric.items()):
+        vals = sorted(by_rank.values())
+        n = len(vals)
+        med = (vals[n // 2] if n % 2 else
+               0.5 * (vals[n // 2 - 1] + vals[n // 2]))
+        metrics[k] = {"min": vals[0], "med": round(med, 3),
+                      "max": vals[-1],
+                      "per_rank": {str(r): by_rank[r] for r in sorted(by_rank)}}
+    hists = {}
+    for k, counts in sorted(hist_sums.items()):
+        hists[k] = {"count": sum(counts),
+                    "p50": round(hist_percentile(counts, 0.50), 3),
+                    "p90": round(hist_percentile(counts, 0.90), 3),
+                    "p99": round(hist_percentile(counts, 0.99), 3)}
+    return {"type": "cluster_report", "v": SCHEMA_VERSION, "step": step,
+            "ranks": sorted(set(ranks)), "metrics": metrics, "hists": hists}
+
+
+class ClusterAggregator:
+    """Per-rank façade the StepReporter publishes through.
+
+    Non-zero ranks: every publish ships the report to rank 0 (best
+    effort; a transport failure degrades to a one-line warning, never
+    fails the step). Rank 0: stashes its own report, drains peers'
+    latest, emits ONE merged cluster record through its sink. Only
+    snapshots that ARRIVED since the previous merge are merged — a
+    wedged rank drops out of the metrics (listed in stale_ranks)
+    instead of having its last-ever window re-merged as current
+    forever.
+    """
+
+    MAX_PUBLISH_FAILURES = 3
+
+    def __init__(self, transport, rank: int, world: int,
+                 sink: Optional[MetricsSink] = None) -> None:
+        self.transport = transport
+        self.rank = int(rank)
+        self.world = int(world)
+        self.sink = sink or NullSink()
+        self._window: Dict[int, dict] = {}   # rank -> report THIS window
+        self.last_cluster_report: Optional[dict] = None
+        self._failures = 0
+        self._dead = False
+
+    def publish(self, report: dict) -> Optional[dict]:
+        if self._dead:
+            return None
+        try:
+            if self.rank != 0:
+                self.transport.publish(json.dumps(report).encode())
+                self._failures = 0
+                return None
+            self._window[0] = report
+            return self.collect_and_emit()
+        except Exception as e:  # noqa: BLE001 — telemetry must not kill a step
+            self._failures += 1
+            if self._failures >= self.MAX_PUBLISH_FAILURES:
+                # repeated failures: stop paying the (bounded) publish
+                # cost every cadence — telemetry is best-effort, the
+                # training loop is not its retry budget
+                self._dead = True
+            from paddlebox_tpu.obs import log as obs_log
+            obs_log.warning(
+                "cluster telemetry publish failed%s" % (
+                    " — disabling cluster aggregation" if self._dead
+                    else ""), error=repr(e)[:200],
+                failures=self._failures)
+            return None
+
+    def collect_and_emit(self) -> dict:
+        for payload in self.transport.drain():
+            try:
+                rec = json.loads(payload.decode())
+            except (ValueError, UnicodeDecodeError):
+                continue
+            self._window[int(rec.get("rank", -1))] = rec
+        # merge ONLY this window's arrivals: a rank that published once
+        # and then wedged must drop out of the metrics and read as
+        # stale, not have its old window merged as current forever (the
+        # straggler diagnostic)
+        merged = merge_cluster_reports(list(self._window.values()))
+        merged["stale_ranks"] = sorted(
+            set(range(self.world)) - set(self._window))
+        self._window = {}
+        self.last_cluster_report = merged
+        self.sink.emit(merged)
+        return merged
+
+    def close(self) -> None:
+        self.sink.close()

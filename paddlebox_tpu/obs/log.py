@@ -1,0 +1,95 @@
+"""Rank-prefixed structured logging — the library's replacement for print().
+
+Library code must never bare-print (boxlint BX501 enforces this): a
+multi-process run interleaves unattributed lines, and redirection/capture
+breaks. This thin layer over stdlib logging gives every line a
+``[pbtpu rN HH:MM:SS]`` prefix plus sorted ``key=value`` structured
+fields, lands on stderr by default, and stays swappable through normal
+logging configuration (the emitted records ride logger
+"paddlebox_tpu.obs").
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+from typing import Optional
+
+_RANK: Optional[int] = None
+_LOGGER: Optional[logging.Logger] = None
+
+
+def set_rank(rank: int) -> None:
+    """Pin the rank prefix (the sharded runners call this once the fleet
+    rank is known; before that the PBTPU_RANK env / 0 default applies)."""
+    global _RANK
+    _RANK = int(rank)
+
+
+def get_rank() -> int:
+    if _RANK is not None:
+        return _RANK
+    try:
+        return int(os.environ.get("PBTPU_RANK", "0"))
+    except ValueError:
+        return 0
+
+
+class _StderrHandler(logging.StreamHandler):
+    """Resolves sys.stderr at EMIT time, not handler construction — so
+    test harnesses that swap stderr (pytest capsys) capture our lines."""
+
+    def __init__(self) -> None:
+        logging.Handler.__init__(self)
+
+    @property
+    def stream(self):
+        return sys.stderr
+
+
+class _RankFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        stamp = time.strftime("%H:%M:%S", time.localtime(record.created))
+        prefix = "[pbtpu r%d %s] " % (get_rank(), stamp)
+        msg = record.getMessage()
+        # multi-line payloads (timer reports) get the prefix per line so
+        # interleaved multi-rank output stays attributable
+        return "\n".join(prefix + ln for ln in msg.splitlines() or [""])
+
+
+def get_logger() -> logging.Logger:
+    global _LOGGER
+    if _LOGGER is None:
+        lg = logging.getLogger("paddlebox_tpu.obs")
+        if not lg.handlers:
+            h = _StderrHandler()
+            h.setFormatter(_RankFormatter())
+            lg.addHandler(h)
+            # the parent "paddlebox_tpu" logger keeps its own behavior
+            # (warnings via lastResort); don't double-emit through it
+            lg.propagate = False
+        if lg.level == logging.NOTSET:
+            lg.setLevel(logging.INFO)
+        _LOGGER = lg
+    return _LOGGER
+
+
+def _fmt(msg: str, fields: dict) -> str:
+    if not fields:
+        return msg
+    tail = " ".join("%s=%s" % (k, fields[k]) for k in sorted(fields))
+    return "%s %s" % (msg, tail) if msg else tail
+
+
+def info(msg: str, **fields) -> None:
+    get_logger().info(_fmt(msg, fields))
+
+
+def warning(msg: str, **fields) -> None:
+    get_logger().warning(_fmt(msg, fields))
+
+
+def error(msg: str, **fields) -> None:
+    get_logger().error(_fmt(msg, fields))

@@ -1,0 +1,212 @@
+"""StepReport: the per-cadence structured telemetry record + sinks.
+
+Every `obs_report_every` steps the trainer assembles ONE structured
+record — stage-timer deltas, StatRegistry counter deltas, gauges,
+histogram bucket deltas with percentiles, examples/sec, whatever extras
+the runner attaches (streaming AUC at pass end) — and emits it through a
+pluggable MetricsSink (JSONL file, stderr, in-memory list for tests, or
+nothing: the last report is always retained for the watchdog dump and
+cluster aggregation regardless of sink).
+
+Deltas, not cumulatives: a report describes its WINDOW, so rate math and
+cross-rank comparison need no history, and a merged cluster view can
+min/median/max the windows directly (obs/aggregate.py).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Callable, Dict, List, Optional
+
+from paddlebox_tpu.utils.channel import poll_depth_gauges
+from paddlebox_tpu.utils.stats import (StatRegistry, hist_percentile)
+
+SCHEMA_VERSION = 1
+
+
+class MetricsSink:
+    """Pluggable report destination."""
+
+    def emit(self, record: dict) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class NullSink(MetricsSink):
+    def emit(self, record: dict) -> None:
+        pass
+
+
+class ListSink(MetricsSink):
+    """Retains records in memory (tests, probes)."""
+
+    def __init__(self) -> None:
+        self.records: List[dict] = []
+
+    def emit(self, record: dict) -> None:
+        self.records.append(record)
+
+
+class JsonlSink(MetricsSink):
+    """One JSON object per line, appended + flushed per emit — the
+    machine-consumable export (the abacus/monitor dump role)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def emit(self, record: dict) -> None:
+        self._fh.write(json.dumps(record) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class StderrSink(MetricsSink):
+    def emit(self, record: dict) -> None:
+        sys.stderr.write(json.dumps(record) + "\n")
+
+
+def make_sink(spec: str) -> MetricsSink:
+    """'' → NullSink (assemble + retain only), 'stderr' → StderrSink,
+    anything else → JsonlSink(path)."""
+    spec = (spec or "").strip()
+    if not spec:
+        return NullSink()
+    if spec == "stderr":
+        return StderrSink()
+    return JsonlSink(spec)
+
+
+class StepReporter:
+    """Assembles StepReports at a step cadence from the process-global
+    StatRegistry + the caller's stage timers.
+
+    Thread contract: note_examples/maybe_report come from the ONE pass
+    driver thread (the same thread that owns the timers); peek() may be
+    called from the watchdog thread (it only reads last_report).
+    """
+
+    def __init__(self, rank: int = 0, every: Optional[int] = None,
+                 sink: Optional[MetricsSink] = None,
+                 timers: Optional[Dict] = None,
+                 aggregator=None,
+                 clock: Callable[[], float] = time.perf_counter) -> None:
+        if every is None or sink is None:
+            from paddlebox_tpu.config import flags
+            if every is None:
+                every = int(flags.get_flag("obs_report_every"))
+            if sink is None:
+                sink = make_sink(str(flags.get_flag("obs_report_path")))
+        self.rank = int(rank)
+        self.every = int(every)
+        self.sink = sink
+        self.timers = timers or {}
+        self.aggregator = aggregator
+        self._clock = clock
+        self._registry = StatRegistry.instance()
+        self._prev_counters: Dict[str, int] = {}
+        self._prev_hists: Dict[str, List[int]] = {}
+        self._prev_timers: Dict[str, tuple] = {}
+        self._examples = 0
+        self._last_step = 0
+        self._last_t = clock()
+        self.last_report: Optional[dict] = None
+
+    # ------------------------------------------------------------ cadence
+    def note_examples(self, n: int) -> None:
+        self._examples += int(n)
+
+    def due(self, step: int) -> bool:
+        return self.every > 0 and (step - self._last_step) >= self.every
+
+    def maybe_report(self, step: int, extra: Optional[dict] = None,
+                     force: bool = False) -> Optional[dict]:
+        """Assemble + emit when the cadence is due (or force=True at pass
+        boundaries). Reporting disabled (every<=0) stays disabled even
+        under force — off means off, zero assembly cost."""
+        if self.every <= 0:
+            return None
+        if not force and not self.due(step):
+            return None
+        return self._report(step, extra)
+
+    def peek(self) -> Optional[dict]:
+        """Last assembled report (watchdog dump surface); never assembles."""
+        return self.last_report
+
+    # ----------------------------------------------------------- assembly
+    def _report(self, step: int, extra: Optional[dict]) -> dict:
+        now = self._clock()
+        interval = max(now - self._last_t, 1e-9)
+        poll_depth_gauges()  # sample named-channel depths into gauges
+        snap = self._registry.snapshot_all()
+
+        stats_delta = {}
+        for k, v in snap["counters"].items():
+            d = v - self._prev_counters.get(k, 0)
+            if d:
+                stats_delta[k] = d
+        hists = {}
+        for k, counts in snap["hists"].items():
+            prev = self._prev_hists.get(k)
+            delta = ([c - p for c, p in zip(counts, prev)] if prev
+                     else list(counts))
+            n = sum(delta)
+            if n <= 0:
+                continue
+            hists[k] = {
+                "count": n,
+                "counts": delta,
+                "p50": round(hist_percentile(delta, 0.50), 3),
+                "p90": round(hist_percentile(delta, 0.90), 3),
+                "p99": round(hist_percentile(delta, 0.99), 3),
+            }
+        timers = {}
+        for name, t in self.timers.items():
+            ms, calls = t.elapsed_ms(), t.count
+            pms, pcalls = self._prev_timers.get(name, (0.0, 0))
+            if ms - pms > 1e-6 or calls != pcalls:
+                timers[name] = {"ms": round(ms - pms, 3),
+                                "calls": calls - pcalls}
+            self._prev_timers[name] = (ms, calls)
+
+        record = {
+            "type": "step_report",
+            "v": SCHEMA_VERSION,
+            "ts": time.time(),
+            "rank": self.rank,
+            "step": int(step),
+            "interval_s": round(interval, 6),
+            "examples": self._examples,
+            "examples_per_sec": round(self._examples / interval, 2),
+            "timers": timers,
+            "stats": stats_delta,
+            "gauges": {k: round(v, 6) for k, v in snap["gauges"].items()},
+            "hists": hists,
+        }
+        if extra:
+            record.update(extra)
+
+        self._prev_counters = snap["counters"]
+        self._prev_hists = snap["hists"]
+        self._examples = 0
+        self._last_step = int(step)
+        self._last_t = now
+        self.last_report = record
+        self.sink.emit(record)
+        if self.aggregator is not None:
+            self.aggregator.publish(record)
+        return record
+
+    def close(self) -> None:
+        self.sink.close()
+        if self.aggregator is not None:
+            self.aggregator.close()

@@ -1,0 +1,236 @@
+"""Span tracer: lock-free per-thread ring buffers → chrome-tracing JSON.
+
+The cheap always-on tier of the reference's tracing ladder (SURVEY.md
+§5.1): platform::RecordEvent spans feeding chrometracing_logger. Here a
+span is ONE perf_counter pair appended to the calling thread's private
+ring (no lock, no allocation beyond a tuple), so instrumenting every hot
+path costs ~1us/span and the last `capacity` spans per thread are always
+available — to the watchdog's stall dump, and to export_chrome() which
+emits valid chrome-tracing JSON loadable in Perfetto WITHOUT jax.profiler
+(works on the CPU-fallback container; when a real jax trace is running,
+utils/profiler.trace installs TraceAnnotation so the same spans also land
+in the XPlane).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+# process-relative clock origin: chrome ts fields are µs since this epoch
+_EPOCH = time.perf_counter()
+
+# jax.profiler.TraceAnnotation factory while a real trace is running
+# (installed/removed by utils/profiler.trace) — None = spans are ring-only
+_JAX_ANNOTATE = None
+
+
+def set_jax_annotation(factory) -> None:
+    global _JAX_ANNOTATE
+    _JAX_ANNOTATE = factory
+
+
+class _ThreadRing:
+    """One thread's span ring. Only its owner thread writes; readers
+    (export, watchdog dump) take a best-effort snapshot — a torn slot
+    under concurrent wrap is an acceptable trade for zero locking on the
+    record path."""
+
+    __slots__ = ("buf", "idx", "cap", "tid", "tname", "owner")
+
+    def __init__(self, cap: int, tid: int, tname: str, owner) -> None:
+        self.buf: List[Optional[Tuple[str, float, float]]] = [None] * cap
+        self.idx = 0
+        self.cap = cap
+        self.tid = tid
+        self.tname = tname
+        self.owner = owner      # weakref to the owning thread
+
+    def record(self, name: str, t0: float, t1: float) -> None:
+        i = self.idx
+        self.buf[i % self.cap] = (name, t0, t1)
+        self.idx = i + 1
+
+    def spans(self) -> List[Tuple[str, float, float]]:
+        """Oldest-first snapshot of the live slots."""
+        i, cap = self.idx, self.cap
+        if i <= cap:
+            out = self.buf[:i]
+        else:
+            cut = i % cap
+            out = self.buf[cut:] + self.buf[:cut]
+        return [s for s in out if s is not None]
+
+
+class _NullSpan:
+    """Reusable no-op context manager for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tr", "name", "t0", "_ann")
+
+    def __init__(self, tracer: "SpanTracer", name: str) -> None:
+        self._tr = tracer
+        self.name = name
+
+    def __enter__(self):
+        ann = _JAX_ANNOTATE
+        if ann is not None:
+            self._ann = ann(self.name)
+            self._ann.__enter__()
+        else:
+            self._ann = None
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        self._tr._ring().record(self.name, self.t0, t1)
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        return False
+
+
+class SpanTracer:
+    """Registry of per-thread rings + chrome-trace export."""
+
+    # dead threads' rings retained (newest-first) so a trace exported
+    # after a pass still carries its finished stager/producer threads'
+    # spans; older ones are pruned at the next thread registration —
+    # a job running thousands of passes (one short-lived thread each)
+    # must not accumulate dead 4096-slot rings forever
+    MAX_DEAD_RINGS = 32
+
+    def __init__(self, capacity: int = 4096) -> None:
+        self.capacity = int(capacity)
+        self.enabled = True
+        self._rings: List[_ThreadRing] = []   # guarded-by: _reg_lock
+        self._reg_lock = threading.Lock()
+        self._local = threading.local()
+
+    def _ring(self) -> _ThreadRing:
+        r = getattr(self._local, "ring", None)
+        if r is None:
+            t = threading.current_thread()
+            r = _ThreadRing(self.capacity, t.ident or 0, t.name,
+                            weakref.ref(t))
+            self._local.ring = r
+            with self._reg_lock:
+                # registration is rare (once per thread): keep the
+                # newest MAX_DEAD_RINGS dead-thread rings, prune older
+                dead = [x for x in self._rings
+                        if (th := x.owner()) is None or not th.is_alive()]
+                if len(dead) > self.MAX_DEAD_RINGS:
+                    drop = {id(x) for x in dead[:-self.MAX_DEAD_RINGS]}
+                    self._rings = [x for x in self._rings
+                                   if id(x) not in drop]
+                self._rings.append(r)
+        return r
+
+    def span(self, name: str):
+        """Context manager timing one named region on this thread. The
+        disabled path is one attribute read + one identity return."""
+        if not self.enabled:
+            return _NULL
+        return _Span(self, name)
+
+    def record_span(self, name: str, t0: float, t1: float) -> None:
+        """Post-hoc span from perf_counter stamps the caller already
+        took (sites that time a region anyway record it span-free)."""
+        if self.enabled:
+            self._ring().record(name, t0, t1)
+
+    def clear(self) -> None:
+        with self._reg_lock:
+            self._rings = []
+        # each thread lazily re-registers a fresh ring (its old one is
+        # unreachable from the registry, so export never sees it again);
+        # this thread's cache is dropped eagerly
+        self._local = threading.local()
+
+    # ------------------------------------------------------------- readers
+    def all_spans(self) -> List[Tuple[str, int, str, float, float]]:
+        """(name, tid, thread_name, t0, t1) across every thread, t0-sorted."""
+        with self._reg_lock:
+            rings = list(self._rings)
+        out = []
+        for r in rings:
+            for name, t0, t1 in r.spans():
+                out.append((name, r.tid, r.tname, t0, t1))
+        out.sort(key=lambda s: s[3])
+        return out
+
+    def last_spans(self, k: int = 64) -> List[Tuple[str, int, str, float, float]]:
+        return self.all_spans()[-k:]
+
+    def export_chrome(self, path: Optional[str] = None, pid: int = 0,
+                      meta: Optional[Dict] = None) -> dict:
+        """Chrome-tracing JSON (the chrometracing_logger role): complete
+        ("X") events in µs since process epoch plus thread-name metadata,
+        loadable in Perfetto / chrome://tracing. Returns the document;
+        writes it to `path` when given."""
+        events = []
+        seen_tids = set()
+        for name, tid, tname, t0, t1 in self.all_spans():
+            if tid not in seen_tids:
+                seen_tids.add(tid)
+                events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                               "tid": tid, "args": {"name": tname}})
+            events.append({
+                "ph": "X", "cat": "obs", "name": name, "pid": pid,
+                "tid": tid,
+                "ts": round((t0 - _EPOCH) * 1e6, 3),
+                "dur": round((t1 - t0) * 1e6, 3),
+            })
+        doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if meta:
+            doc["metadata"] = dict(meta)
+        if path:
+            with open(path, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh)
+        return doc
+
+
+# ---------------------------------------------------------------- module API
+_TRACER = SpanTracer()
+
+
+def get_tracer() -> SpanTracer:
+    return _TRACER
+
+
+def span(name: str):
+    """``with obs.span("h2d_stage"): ...`` — the one-liner every hot path
+    uses. Near-free when tracing is disabled."""
+    if not _TRACER.enabled:
+        return _NULL
+    return _Span(_TRACER, name)
+
+
+def record_span(name: str, t0: float, t1: float) -> None:
+    _TRACER.record_span(name, t0, t1)
+
+
+def configure_from_flags() -> None:
+    """Sync the module tracer with the obs_trace / obs_trace_capacity
+    flags (called by the trainers at construction; safe to call often)."""
+    from paddlebox_tpu.config import flags
+    _TRACER.enabled = bool(flags.get_flag("obs_trace"))
+    cap = int(flags.get_flag("obs_trace_capacity"))
+    if cap > 0 and cap != _TRACER.capacity:
+        _TRACER.capacity = cap
+        _TRACER.clear()

@@ -1,0 +1,168 @@
+"""Stall watchdog: heartbeat thread that turns silent hangs into reports.
+
+Native successor to tools/tpu_watchdog.sh: the runners mark progress at
+step and exchange boundaries (`obs.beat("step")` — one monotonic read +
+one tuple store, safe from any thread), and a daemon thread checks the
+age of the last beat. When it exceeds the flag-configured threshold
+(obs_watchdog_secs) the watchdog dumps, to stderr, everything a hang
+post-mortem needs: the last beat label and age, the last-K spans from
+the tracer ring, a stack for EVERY live thread (sys._current_frames —
+the lockstep exchange_incoming_p2p/collective wedges this was built for
+always show as one thread parked in a wait), and the last assembled
+StepReport. Optionally (obs_watchdog_action=raise) it then interrupts
+the main thread so a wedged job dies loudly instead of burning a TPU
+reservation silently.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, List, Optional
+
+
+class StallWatchdog:
+    def __init__(self, threshold_s: float, action: str = "dump",
+                 tracer=None, report_fn: Optional[Callable] = None,
+                 on_stall: Optional[Callable[[str], None]] = None,
+                 stream=None, poll_interval: Optional[float] = None,
+                 last_k_spans: int = 48) -> None:
+        if action not in ("dump", "raise"):
+            raise ValueError("watchdog action must be 'dump' or 'raise', "
+                             "got %r" % (action,))
+        self.threshold_s = float(threshold_s)
+        self.action = action
+        self.tracer = tracer
+        self.report_fn = report_fn
+        self.on_stall = on_stall
+        self.stream = stream
+        self.last_k_spans = int(last_k_spans)
+        self._poll = poll_interval or max(0.05, min(1.0,
+                                                    self.threshold_s / 4.0))
+        # (monotonic, label): swapped atomically as one tuple — beat() is
+        # lock-free and callable from any thread
+        self._beat = (time.monotonic(), "start")
+        self._fired_at: Optional[tuple] = None
+        self.fires = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -------------------------------------------------------------- beats
+    def beat(self, label: str) -> None:
+        self._beat = (time.monotonic(), label)
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "StallWatchdog":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="pbtpu-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2 * self._poll + 1.0)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll):
+            beat = self._beat
+            age = time.monotonic() - beat[0]
+            if age < self.threshold_s:
+                continue
+            if self._fired_at == beat:
+                continue            # already reported THIS silence window
+            self._fired_at = beat
+            self.fire(beat[1], age)
+
+    # --------------------------------------------------------------- dump
+    def render_dump(self, label: str, age: float) -> str:
+        lines: List[str] = []
+        lines.append("=" * 72)
+        lines.append("PBTPU STALL WATCHDOG: no progress beat for %.1fs "
+                     "(threshold %.1fs); last beat: %r"
+                     % (age, self.threshold_s, label))
+        if self.tracer is not None:
+            lines.append("-- last %d spans (most recent last) --"
+                         % self.last_k_spans)
+            for name, tid, tname, t0, t1 in self.tracer.last_spans(
+                    self.last_k_spans):
+                lines.append("  %-28s %10.3fms  [%s/%d]"
+                             % (name, (t1 - t0) * 1e3, tname, tid))
+        lines.append("-- per-thread stacks --")
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid, frame in sys._current_frames().items():
+            lines.append("  thread %s (%d):" % (names.get(tid, "?"), tid))
+            for entry in traceback.format_stack(frame):
+                lines.extend("    " + ln for ln in entry.rstrip().splitlines())
+        if self.report_fn is not None:
+            try:
+                rep = self.report_fn()
+            except Exception as e:  # noqa: BLE001 — the dump must not die
+                rep = {"report_error": repr(e)[:200]}
+            if rep is not None:
+                import json
+                lines.append("-- last StepReport --")
+                lines.append("  " + json.dumps(rep))
+        lines.append("=" * 72)
+        return "\n".join(lines)
+
+    def fire(self, label: str, age: float) -> None:
+        self.fires += 1
+        text = self.render_dump(label, age)
+        stream = self.stream or sys.stderr
+        try:
+            stream.write(text + "\n")
+            stream.flush()
+        except (OSError, ValueError):
+            pass
+        if self.on_stall is not None:
+            self.on_stall(text)
+        if self.action == "raise":
+            import _thread
+            _thread.interrupt_main()
+
+
+# ------------------------------------------------------------- module API
+_ACTIVE: Optional[StallWatchdog] = None
+
+
+def active() -> Optional[StallWatchdog]:
+    return _ACTIVE
+
+
+def set_active(w: Optional[StallWatchdog]) -> Optional[StallWatchdog]:
+    global _ACTIVE
+    prev, _ACTIVE = _ACTIVE, w
+    return prev
+
+
+def beat(label: str) -> None:
+    """Progress mark — near-free (one global read) when no watchdog runs."""
+    w = _ACTIVE
+    if w is not None:
+        w.beat(label)
+
+
+def ensure_from_flags(tracer=None, report_fn=None) -> Optional[StallWatchdog]:
+    """Start (once) the flag-configured watchdog; obs_watchdog_secs<=0 =
+    disabled. Later callers refresh the report_fn so the dump always
+    shows the LIVE trainer's last report."""
+    global _ACTIVE
+    from paddlebox_tpu.config import flags
+    secs = float(flags.get_flag("obs_watchdog_secs"))
+    if secs <= 0:
+        return _ACTIVE
+    if _ACTIVE is None:
+        _ACTIVE = StallWatchdog(
+            secs, action=str(flags.get_flag("obs_watchdog_action")),
+            tracer=tracer, report_fn=report_fn).start()
+    elif report_fn is not None:
+        _ACTIVE.report_fn = report_fn
+    return _ACTIVE

@@ -29,6 +29,10 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from paddlebox_tpu.obs import beat as obs_beat
+from paddlebox_tpu.obs import make_step_reporter
+from paddlebox_tpu.obs import span as obs_span
+
 STAGE_AXIS = "stage"
 
 
@@ -272,7 +276,8 @@ def _grouped_train_pass(runner, dataset, begin_pass, end_pass,
         def produce():
             try:
                 for g in groups:
-                    staged = runner.device_batch(g)
+                    with obs_span("pipe_stage"):
+                        staged = runner.device_batch(g)
                     while not stop.is_set():
                         try:
                             out.put((g, staged), timeout=0.2)
@@ -292,7 +297,10 @@ def _grouped_train_pass(runner, dataset, begin_pass, end_pass,
                 if isinstance(item, BaseException):
                     raise item
                 g, staged = item
-                losses.append(runner.train_step_staged(staged, g))
+                with obs_span("pipe_step"):
+                    losses.append(runner.train_step_staged(staged, g))
+                obs_beat("pipeline_step")
+                _pipe_note_step(runner, len(losses))
         finally:
             stop.set()
             deadline = time.monotonic() + 120.0
@@ -323,11 +331,32 @@ def _grouped_train_pass(runner, dataset, begin_pass, end_pass,
                         "fleet store; not returning with a live stager")
     else:
         for g in groups:
-            losses.append(runner.train_step(g))
+            with obs_span("pipe_step"):
+                losses.append(runner.train_step(g))
+            obs_beat("pipeline_step")
+            _pipe_note_step(runner, len(losses))
     end_pass()
+    reporter = getattr(runner, "reporter", None)
+    if reporter is not None:
+        reporter.maybe_report(
+            getattr(runner, "_step_count", len(losses)), force=True,
+            extra={"event": "pass_end",
+                   "loss": round(float(np.mean(losses)), 6)
+                   if losses else 0.0})
     return {"loss": float(np.mean(losses)) if losses else 0.0,
             "steps": len(losses),
             "dropped_batches": len(batches) - n_groups * M}
+
+
+def _pipe_note_step(runner, step_in_pass: int) -> None:
+    """Per-step telemetry hook for the shared pipeline drivers: feeds the
+    runner's StepReporter (when it has one) with monotone step counts."""
+    reporter = getattr(runner, "reporter", None)
+    if reporter is None:
+        return
+    runner._step_count = getattr(runner, "_step_count", 0) + 1
+    reporter.note_examples(getattr(runner, "_examples_per_step", 0))
+    reporter.maybe_report(runner._step_count)
 
 
 def _feed_pipeline_metrics(runner, preds, packed_batches) -> None:
@@ -687,6 +716,11 @@ class CtrPipelineRunner:
         self._prng = jax.random.PRNGKey(seed + 31)
         from paddlebox_tpu.metrics.auc import MetricRegistry
         self.metrics = MetricRegistry()
+        # telemetry plane (round 10): per-step cadence fed by the shared
+        # pass drivers (_pipe_note_step)
+        self._step_count = 0
+        self._examples_per_step = feed.batch_size * self.batches_per_step
+        self.reporter = make_step_reporter()
         self._step, self._eval = self._build_step()
 
     # ------------------------------------------------------------- jit step
@@ -919,10 +953,13 @@ class CtrPipelineRunner:
                                  lambda: self.table.slab)
 
     def close(self) -> None:
-        """Flush and stop the dump writers."""
+        """Flush and stop the dump writers + telemetry sinks."""
         if self.dump_writer is not None:
             self.dump_writer.close()
             self.dump_writer = None
+        if getattr(self, "reporter", None) is not None:
+            self.reporter.close()
+            self.reporter = None
 
     def __del__(self):
         try:
@@ -1111,6 +1148,20 @@ class ShardedCtrPipelineRunner:
         self._slabs = None
         from paddlebox_tpu.metrics.auc import MetricRegistry
         self.metrics = MetricRegistry()
+        # telemetry plane (round 10): rank-tagged reporter; the shared
+        # pass drivers feed the cadence (_pipe_note_step); multi-process,
+        # reports piggyback to rank 0 for the merged cluster view
+        self._step_count = 0
+        self._examples_per_step = feed.batch_size * self.batches_per_step
+        from paddlebox_tpu.obs import (make_cluster_aggregator,
+                                       obs_rank_world)
+        obs_rank, obs_world = (obs_rank_world(self.host_mesh, fleet)
+                               if self.multiprocess else (0, 1))
+        aggregator = (make_cluster_aggregator(
+            mesh=self.host_mesh, fleet=fleet, rank=obs_rank,
+            world=obs_world) if self.multiprocess else None)
+        self.reporter = make_step_reporter(rank=obs_rank,
+                                           aggregator=aggregator)
         self._step, self._eval = self._build_step()
 
     # ------------------------------------------------------------- jit step
@@ -1463,13 +1514,17 @@ class ShardedCtrPipelineRunner:
                                  self.end_pass, lambda: self._slabs)
 
     def close(self) -> None:
-        """Flush and stop the dump writers + stager pool."""
+        """Flush and stop the dump writers + stager pool + telemetry
+        sinks (the reporter also closes the rank-0 aggregator sink)."""
         if self.dump_writer is not None:
             self.dump_writer.close()
             self.dump_writer = None
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
+        if getattr(self, "reporter", None) is not None:
+            self.reporter.close()
+            self.reporter = None
 
     def __del__(self):
         try:

@@ -35,7 +35,9 @@ import numpy as np
 from paddlebox_tpu.config.configs import TableConfig
 from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
 from paddlebox_tpu.embedding.native_store import make_host_store
-from paddlebox_tpu.utils.stats import stat_add
+from paddlebox_tpu.obs import beat as obs_beat
+from paddlebox_tpu.obs.tracer import record_span
+from paddlebox_tpu.utils.stats import hist_observe, stat_add
 
 
 _warned_numpy_route = False
@@ -123,11 +125,16 @@ def exchange_outgoing_buckets(buckets_local: np.ndarray,
             f"positions {np.nonzero(~seen)[0].tolist()}")
     # wire attribution (weak #6): this rank writes its payload once and
     # reads every rank's back through the central store
+    t1 = _time.perf_counter()
     stat_add("hostplane_exchange_bytes",
              int(payload.nbytes) * (1 + len(gathered)))
-    stat_add("hostplane_exchange_us",
-             int((_time.perf_counter() - t0) * 1e6))
+    stat_add("hostplane_exchange_us", int((t1 - t0) * 1e6))
     stat_add("hostplane_exchange_steps")
+    hist_observe("hostplane_exchange_us", (t1 - t0) * 1e6)
+    record_span("hostplane_store_exchange", t0, t1)
+    # the store funnel is the progress boundary on the hostplane=store
+    # plane (the p2p plane beats inside MeshComm.exchange)
+    obs_beat("store_exchange")
     return out
 
 
@@ -203,10 +210,12 @@ def exchange_incoming_p2p(buckets_local: np.ndarray,
     # 1 write + W reads): sends to W-1 peers PLUS receives from W-1 peers
     wire = sum(int(p.nbytes) for r, p in parts.items() if r != mesh.rank) \
         + sum(int(p.nbytes) for r, p in got.items() if r != mesh.rank)
+    t1 = _time.perf_counter()
     stat_add("hostplane_exchange_bytes", wire)
-    stat_add("hostplane_exchange_us",
-             int((_time.perf_counter() - t0) * 1e6))
+    stat_add("hostplane_exchange_us", int((t1 - t0) * 1e6))
     stat_add("hostplane_exchange_steps")
+    hist_observe("hostplane_exchange_us", (t1 - t0) * 1e6)
+    record_span("hostplane_p2p_exchange", t0, t1)
     return out
 
 
@@ -271,10 +280,12 @@ def exchange_push_uids_p2p(buckets_local: np.ndarray,
     # sends + receives, matching the store path's 1-write + W-reads count
     wire = sum(int(p.nbytes) for r, p in parts.items() if r != mesh.rank) \
         + sum(int(p.nbytes) for r, p in got.items() if r != mesh.rank)
+    t1 = _time.perf_counter()
     stat_add("hostplane_exchange_bytes", wire)
-    stat_add("hostplane_exchange_us",
-             int((_time.perf_counter() - t0) * 1e6))
+    stat_add("hostplane_exchange_us", int((t1 - t0) * 1e6))
     stat_add("hostplane_exchange_steps")
+    hist_observe("hostplane_exchange_us", (t1 - t0) * 1e6)
+    record_span("hostplane_uid_exchange", t0, t1)
     return out
 
 

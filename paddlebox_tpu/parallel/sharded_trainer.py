@@ -42,6 +42,11 @@ from paddlebox_tpu.embedding.optimizers import (push_sparse_dedup,
 from paddlebox_tpu.embedding.pass_table import dedup_ids
 from paddlebox_tpu.metrics.auc import MetricRegistry
 from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.obs import beat as obs_beat
+from paddlebox_tpu.obs import log as obs_log
+from paddlebox_tpu.obs import (make_cluster_aggregator, make_step_reporter,
+                               obs_rank_world)
+from paddlebox_tpu.obs import span as obs_span
 from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
 from paddlebox_tpu.ops.sparse import (build_push_grads,
                                       build_push_grads_extended,
@@ -192,7 +197,23 @@ class ShardedBoxTrainer:
         self._slabs: Optional[jax.Array] = None
         self._prng = jax.random.PRNGKey(seed + 17)
         self._shuffle_rng = np.random.RandomState(seed + 1)
+        self._step_count = 0
         self.timers = {n: Timer() for n in ("step", "pass", "build")}
+        # telemetry plane (round 10): rank-tagged StepReporter; in multi-
+        # process jobs non-zero ranks piggyback their reports to rank 0
+        # (over the p2p mesh when it is up, else the fleet store) and
+        # rank 0 emits the merged per-rank min/med/max cluster view
+        # through the same sink as its own reports
+        self._obs_rank, _obs_world = (
+            obs_rank_world(self.host_mesh, fleet) if self.multiprocess
+            else (0, 1))
+        obs_log.set_rank(self._obs_rank)
+        self.aggregator = (make_cluster_aggregator(
+            mesh=self.host_mesh, fleet=fleet, rank=self._obs_rank,
+            world=_obs_world) if self.multiprocess else None)
+        self.reporter = make_step_reporter(
+            rank=self._obs_rank, timers=self.timers,
+            aggregator=self.aggregator)
         self._pool = None   # routing thread pool, lazy (_stager_pool)
         # DumpField debug writers (boxps_worker.cc DumpField): each
         # process dumps its OWN workers' rows (the per-node dump files of
@@ -845,6 +866,8 @@ class ShardedBoxTrainer:
         # per-device metric state for THIS pass (dummies when device
         # collection is off — the step passes them through)
         mtab, mstats = self.make_metric_state()
+        # examples consumed per raw step (one batch per worker)
+        ex_per_step = self.feed.batch_size * len(per_worker)
         # bounded stream: the stager routes + device_puts ahead of training
         # (never the whole pass) — see shard_batches. close() on ANY exit
         # stops the stager thread; an abandoned one would race the next
@@ -858,6 +881,11 @@ class ShardedBoxTrainer:
                 from paddlebox_tpu.train.trainer import run_scan_chunks
 
                 def on_chunk(lo, group, chunk_losses, preds):
+                    self._step_count += len(group)
+                    obs_beat("step")
+                    self.reporter.note_examples(
+                        len(group) * ex_per_step)
+                    self.reporter.maybe_report(self._step_count)
                     if self.cfg.check_nan_inf and not np.isfinite(
                             chunk_losses).all():
                         raise FloatingPointError("nan/inf loss in scan chunk")
@@ -892,11 +920,16 @@ class ShardedBoxTrainer:
                 losses.extend(chunk_losses)
             for i, batch in enumerate(stream, start=start_i):
                 self.timers["step"].start()
-                (self._slabs, self.params, self.opt_state, loss, preds,
-                 self._prng, mtab, mstats) = self._step(
-                    self._slabs, self.params, self.opt_state, batch,
-                    self._prng, mtab, mstats)
+                with obs_span("shard_step"):
+                    (self._slabs, self.params, self.opt_state, loss, preds,
+                     self._prng, mtab, mstats) = self._step(
+                        self._slabs, self.params, self.opt_state, batch,
+                        self._prng, mtab, mstats)
                 self.timers["step"].pause()
+                self._step_count += 1
+                obs_beat("step")
+                self.reporter.note_examples(ex_per_step)
+                self.reporter.maybe_report(self._step_count)
                 losses.append(float(loss))
                 if self._param_sync is not None:
                     self._steps_since_sync += 1
@@ -934,12 +967,20 @@ class ShardedBoxTrainer:
         self.table.check_need_limit_mem()
         self._slabs = None
         t_pass.pause()
+        mean_loss = float(np.mean(losses)) if losses else 0.0
+        # pass boundary closes the report window (and on rank 0, emits a
+        # merged cluster view of whatever peer snapshots have arrived)
+        self.reporter.maybe_report(
+            self._step_count, force=True,
+            extra={"event": "pass_end", "loss": round(mean_loss, 6),
+                   "auc": {m.name: float(m.calculator.auc())
+                           for m in self.metrics.messages()}})
         if self.cfg.profile:
             from paddlebox_tpu.utils.profiler import timer_report
             # rank-tagged so multiprocess reports stay distinguishable
-            print(timer_report(
+            obs_log.info(timer_report(
                 self.timers, prefix=f"sharded.r{jax.process_index()}."))
-        return {"loss": float(np.mean(losses)) if losses else 0.0,
+        return {"loss": mean_loss,
                 "batches": n_steps, "instances": len(dataset)}
 
     # ------------------------------------------------------------- eval
@@ -1073,13 +1114,17 @@ class ShardedBoxTrainer:
                                             mask=b.ins_valid)
 
     def close(self) -> None:
-        """Flush and stop the dump writers + the stager pool."""
+        """Flush and stop the dump writers + the stager pool + telemetry
+        sinks (the reporter also closes the rank-0 aggregator sink)."""
         if self.dump_writer is not None:
             self.dump_writer.close()
             self.dump_writer = None
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
+        if getattr(self, "reporter", None) is not None:
+            self.reporter.close()
+            self.reporter = None
 
     def __del__(self):
         try:

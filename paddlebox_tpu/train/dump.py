@@ -39,7 +39,7 @@ class DumpWriter:
         self.rank = rank
         self.max_bytes = max_bytes or flags.get_flag("dump_file_max_bytes")
         os.makedirs(path, exist_ok=True)
-        self._channel: Channel = Channel(capacity=1024)
+        self._channel: Channel = Channel(capacity=1024, name="dump")
         self._threads = [
             threading.Thread(target=self._writer_loop, args=(i,), daemon=True)
             for i in range(max(1, thread_num))

@@ -129,8 +129,9 @@ class Executor:
         per-stage timer report after the pass (TrainFilesWithProfiler)."""
         stats = trainer.train_pass(dataset, preloaded=preloaded)
         if debug:
+            from paddlebox_tpu.obs import log as obs_log
             from paddlebox_tpu.utils.profiler import timer_report
-            print(timer_report(trainer.timers, prefix="trainer."))
+            obs_log.info(timer_report(trainer.timers, prefix="trainer."))
         return stats
 
     def infer_from_dataset(self, trainer, dataset):

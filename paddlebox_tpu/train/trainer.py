@@ -43,6 +43,10 @@ from paddlebox_tpu.embedding.pass_table import (PassTable, dedup_ids,
                                                 pos_for_rebuild)
 from paddlebox_tpu.metrics.auc import MetricRegistry
 from paddlebox_tpu.models.base import ModelSpec
+from paddlebox_tpu.obs import beat as obs_beat
+from paddlebox_tpu.obs import log as obs_log
+from paddlebox_tpu.obs import make_step_reporter
+from paddlebox_tpu.obs import span as obs_span
 from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm, seqpool_sum
 from paddlebox_tpu.ops.sparse import (build_push_grads,
                                       build_push_grads_extended,
@@ -176,7 +180,9 @@ def run_scan_chunks(scan_call: Callable, items, chunk: int,
                 # would otherwise keep reading the caller's table)
                 return
             group = [next(it) for _ in range(chunk)]
-            yield lo, group, stack_fn(group)
+            with obs_span("host_stage"):
+                staged = stack_fn(group)
+            yield lo, group, staged
 
     def transfer(src):
         # grouped H2D: buffer G host-staged chunks, device-ize together
@@ -241,14 +247,18 @@ def run_scan_chunks(scan_call: Callable, items, chunk: int,
         for lo, group, stacked in source:
             if timer is not None:
                 timer.start()
-            carry, losses, preds = scan_call(carry, stacked)
+            with obs_span("scan_dispatch"):
+                carry, losses, preds = scan_call(carry, stacked)
             if timer is not None:
                 timer.pause()
+            obs_beat("scan_chunk")
             if pending is not None:
-                drain(pending)
+                with obs_span("chunk_drain"):
+                    drain(pending)
             pending = (lo, group, losses, preds)
         if pending is not None:
-            drain(pending)
+            with obs_span("chunk_drain"):
+                drain(pending)
     finally:
         if stop is not None:
             # consumer exit (normal or raising): stop the stager so it
@@ -922,6 +932,9 @@ class BoxTrainer:
                 np.asarray(flat), lr=self.cfg.dense_lr,
                 summary_mask=_flat_summary_mask(self.params))
         self.timers = {n: Timer() for n in ("step", "pass")}
+        # telemetry plane (round 10): flag-configured StepReporter +
+        # tracer sync + (flag-gated) stall watchdog — one line per runner
+        self.reporter = make_step_reporter(timers=self.timers)
         self._stage_pool = None  # lazy host-staging thread pool
         self._step_count = 0
         self._shuffle_rng = np.random.RandomState(seed + 1)
@@ -957,6 +970,8 @@ class BoxTrainer:
         if self._stage_pool and self._stage_pool[1] is not None:
             self._stage_pool[1].shutdown(wait=False)
         self._stage_pool = None
+        if getattr(self, "reporter", None) is not None:
+            self.reporter.close()
 
     def __del__(self):
         try:
@@ -1184,6 +1199,10 @@ class BoxTrainer:
 
             def on_chunk(lo, group, chunk_losses, preds):
                 self._step_count += len(group)
+                obs_beat("step")
+                self.reporter.note_examples(
+                    len(group) * self.fns.batch_size)
+                self.reporter.maybe_report(self._step_count)
                 if self.cfg.check_nan_inf and not np.isfinite(
                         chunk_losses).all():
                     raise FloatingPointError(
@@ -1234,8 +1253,9 @@ class BoxTrainer:
             losses.extend(chunk_losses)
             pending = pending[n_done:]
         for b in pending:
-            ids = self.table.lookup_ids(b.keys, b.valid)
-            batch = self.device_batch(b, ids)
+            with obs_span("host_stage"):
+                ids = self.table.lookup_ids(b.keys, b.valid)
+                batch = self.device_batch(b, ids)
             self.timers["step"].start()
             if self.async_table is not None:
                 # pull a fresh dense snapshot, run the device step, queue the
@@ -1255,6 +1275,9 @@ class BoxTrainer:
                 self.table.set_slab(state)
             self.timers["step"].pause()
             self._step_count += 1
+            obs_beat("step")
+            self.reporter.note_examples(self.fns.batch_size)
+            self.reporter.maybe_report(self._step_count)
             losses.append(float(loss))
             if self.cfg.check_nan_inf and not np.isfinite(losses[-1]):
                 raise FloatingPointError(
@@ -1269,10 +1292,18 @@ class BoxTrainer:
             self.async_table.wait_drained()
             self.params = self._unravel(jnp.asarray(self.async_table.pull()))
         t_pass.pause()
+        mean_loss = float(np.mean(losses)) if losses else 0.0
+        # pass boundary is always a report boundary: the window closes
+        # with the pass stats + the streaming metrics' last computed AUC
+        self.reporter.maybe_report(
+            self._step_count, force=True,
+            extra={"event": "pass_end", "loss": round(mean_loss, 6),
+                   "auc": {m.name: float(m.calculator.auc())
+                           for m in self.metrics.messages()}})
         if self.cfg.profile:
             from paddlebox_tpu.utils.profiler import timer_report
-            print(timer_report(self.timers, prefix="trainer."))
-        return {"loss": float(np.mean(losses)) if losses else 0.0,
+            obs_log.info(timer_report(self.timers, prefix="trainer."))
+        return {"loss": mean_loss,
                 "batches": len(worker_batches[0]),
                 "instances": len(dataset)}
 
@@ -1387,7 +1418,7 @@ class BoxTrainer:
                 self._dump_batch(preds, b)
         self.table.end_pass()
         from paddlebox_tpu.utils.profiler import timer_report
-        print(timer_report(timers, prefix="stage."))
+        obs_log.info(timer_report(timers, prefix="stage."))
         return {"loss": float(np.mean(losses)) if losses else 0.0,
                 "batches": len(losses), "instances": len(dataset)}
 

@@ -10,9 +10,38 @@ from __future__ import annotations
 
 import collections
 import threading
+import weakref
 from typing import Generic, Iterable, List, Optional, TypeVar
 
+from paddlebox_tpu.utils.stats import gauge_set
+
 T = TypeVar("T")
+
+# Named channels export their live depth as a StepReport gauge
+# (chan_<name>_depth) — the queue-pressure view the reference read off
+# ChannelObject::Size in its monitor loop. Depths are SAMPLED by
+# poll_depth_gauges() at report cadence, never pushed per-op: put/get on
+# the hottest queues must not take the process-global stat registry lock
+# per item. Each name maps to a WeakSet (several writers may share a
+# name — e.g. a trainer's and an eval run's DumpWriter both register
+# "dump"); the gauge is the SUM of live depths, and a name whose
+# channels have all been collected gets one final 0 write before it is
+# dropped — a dead queue must not freeze its last depth into every
+# later report.
+_named: dict = {}           # gauge name -> weakref.WeakSet[Channel]
+_named_lock = threading.Lock()
+
+
+def poll_depth_gauges() -> None:
+    """Sample every live named channel's depth into the stat registry
+    (StepReporter calls this once per report assembly)."""
+    with _named_lock:
+        snap = [(g, list(ws)) for g, ws in _named.items()]
+        for g, live in snap:
+            if not live:
+                del _named[g]
+    for gauge_name, live in snap:
+        gauge_set(gauge_name, float(sum(len(c) for c in live)))
 
 
 class ChannelClosed(Exception):
@@ -20,7 +49,7 @@ class ChannelClosed(Exception):
 
 
 class Channel(Generic[T]):
-    def __init__(self, capacity: int = 0) -> None:
+    def __init__(self, capacity: int = 0, name: str = "") -> None:
         # capacity 0 = unbounded (like default ChannelObject)
         self._capacity = capacity
         self._deque: collections.deque = collections.deque()  # guarded-by: _mutex
@@ -28,6 +57,10 @@ class Channel(Generic[T]):
         self._not_empty = threading.Condition(self._mutex)
         self._not_full = threading.Condition(self._mutex)
         self._closed = False  # guarded-by: _mutex
+        if name:
+            with _named_lock:
+                _named.setdefault("chan_%s_depth" % name,
+                                  weakref.WeakSet()).add(self)
 
     # -- producer side -----------------------------------------------------
     def put(self, item: T) -> None:

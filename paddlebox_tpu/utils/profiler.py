@@ -20,12 +20,19 @@ from paddlebox_tpu.utils.timer import Timer
 @contextlib.contextmanager
 def trace(logdir: str) -> Iterator[None]:
     """Capture a jax.profiler trace (XPlane; open in XProf/TensorBoard).
-    The chrome-tracing-JSON role of platform/profiler/chrometracing_logger."""
+    The chrome-tracing-JSON role of platform/profiler/chrometracing_logger.
+    While the trace runs, every obs.span() also opens a TraceAnnotation so
+    the ring spans land in the XPlane timeline too (the ring export via
+    obs.export_chrome_trace works WITHOUT any of this — CPU container)."""
     import jax
+
+    from paddlebox_tpu.obs import tracer as _obs_tracer
     jax.profiler.start_trace(logdir)
+    _obs_tracer.set_jax_annotation(jax.profiler.TraceAnnotation)
     try:
         yield
     finally:
+        _obs_tracer.set_jax_annotation(None)
         jax.profiler.stop_trace()
 
 

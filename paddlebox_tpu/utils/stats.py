@@ -1,14 +1,59 @@
-"""Named int64 stat registry.
+"""Named stat registry: counters, gauges and fixed-bucket histograms.
 
 Analog of platform::Monitor / StatRegistry (paddle/fluid/platform/monitor.h:80)
 and the STAT_INT_ADD macro (monitor.h:137) used for e.g. device memory stats.
 Thread-safe; exported to the python API directly (no pybind needed here).
+
+Round 10 extends the int64 counters with two aggregation-friendly kinds:
+
+  * gauges — last-written float values (queue depths, residency rows,
+    flag-derived capacities). Unlike counters they are not deltas; a
+    StepReport ships the current value.
+  * histograms — FIXED power-of-two buckets shared by every process
+    (HIST_BOUNDS), so cluster aggregation is an elementwise counts sum and
+    percentiles survive the merge (summing per-rank p99s would not).
 """
 
 from __future__ import annotations
 
+import bisect
 import threading
-from typing import Dict
+from typing import Dict, List, Optional, Sequence
+
+# Fixed bucket upper bounds (inclusive), shared by EVERY rank and process:
+# powers of two from 1 to 2^25 (~33.5s when observing microseconds), plus
+# an implicit +inf overflow bucket. Fixed-and-shared is load-bearing —
+# cluster aggregation sums counts elementwise across ranks.
+HIST_BOUNDS: Sequence[float] = tuple(float(2 ** i) for i in range(26))
+
+
+def new_hist_counts() -> List[int]:
+    return [0] * (len(HIST_BOUNDS) + 1)
+
+
+def hist_percentile(counts: Sequence[int], q: float) -> float:
+    """Percentile estimate from fixed-bucket counts (q in [0, 1]):
+    linear interpolation inside the bucket where the cumulative count
+    crosses q * total. The overflow bucket reports its lower bound (the
+    estimate saturates — by design, the tail bound is what alerting
+    needs). Returns 0.0 for an empty histogram."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    target = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        if cum + c >= target:
+            lo = HIST_BOUNDS[i - 1] if i > 0 else 0.0
+            if i >= len(HIST_BOUNDS):       # overflow bucket: saturate
+                return HIST_BOUNDS[-1]
+            hi = HIST_BOUNDS[i]
+            frac = (target - cum) / c
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        cum += c
+    return HIST_BOUNDS[-1]
 
 
 class StatRegistry:
@@ -18,6 +63,8 @@ class StatRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._stats: Dict[str, int] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, List[int]] = {}
 
     @classmethod
     def instance(cls) -> "StatRegistry":
@@ -27,6 +74,7 @@ class StatRegistry:
                     cls._instance = cls()
         return cls._instance
 
+    # ------------------------------------------------------------- counters
     def add(self, name: str, value: int) -> int:
         with self._lock:
             cur = self._stats.get(name, 0) + int(value)
@@ -41,16 +89,56 @@ class StatRegistry:
         with self._lock:
             return self._stats.get(name, 0)
 
+    # --------------------------------------------------------------- gauges
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def get_gauge(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._gauges.get(name, default)
+
+    # ----------------------------------------------------------- histograms
+    def observe(self, name: str, value: float) -> None:
+        """Add one sample to the named fixed-bucket histogram."""
+        idx = bisect.bisect_left(HIST_BOUNDS, float(value))
+        with self._lock:
+            counts = self._hists.get(name)
+            if counts is None:
+                counts = new_hist_counts()
+                self._hists[name] = counts
+            counts[idx] += 1
+
+    def hist_counts(self, name: str) -> Optional[List[int]]:
+        with self._lock:
+            counts = self._hists.get(name)
+            return list(counts) if counts is not None else None
+
+    # ------------------------------------------------------------ lifecycle
     def reset(self, name: str = None) -> None:
         with self._lock:
             if name is None:
                 self._stats.clear()
+                self._gauges.clear()
+                self._hists.clear()
             else:
                 self._stats.pop(name, None)
+                self._gauges.pop(name, None)
+                self._hists.pop(name, None)
 
     def snapshot(self) -> Dict[str, int]:
+        """Counters only — the pre-round-10 surface (profiler.stats_report
+        and tests consume this shape)."""
         with self._lock:
             return dict(self._stats)
+
+    def snapshot_all(self) -> Dict[str, dict]:
+        """Every kind at once, one lock hold: {"counters", "gauges",
+        "hists"} — the StepReport assembly surface (obs/report.py)."""
+        with self._lock:
+            return {"counters": dict(self._stats),
+                    "gauges": dict(self._gauges),
+                    "hists": {k: list(v) for k, v in self._hists.items()}}
 
 
 def stat_add(name: str, value: int = 1) -> int:
@@ -63,3 +151,15 @@ def stat_get(name: str) -> int:
 
 def stat_reset(name: str = None) -> None:
     StatRegistry.instance().reset(name)
+
+
+def gauge_set(name: str, value: float) -> None:
+    StatRegistry.instance().set_gauge(name, value)
+
+
+def gauge_get(name: str, default: float = 0.0) -> float:
+    return StatRegistry.instance().get_gauge(name, default)
+
+
+def hist_observe(name: str, value: float) -> None:
+    StatRegistry.instance().observe(name, value)

@@ -31,6 +31,12 @@ def test_train_sharded_example():
     assert "streaming AUC" in out
 
 
+# tier-1 budget (round-10 headroom audit, 15.0s): the downpour
+# capability has its OWN dedicated suite (test_downpour.py: local
+# client learns + over-TCP); this example smoke re-runs the same
+# local-client path end to end. Runs in the slow-inclusive suite
+# and on TPU windows
+@pytest.mark.slow
 def test_train_downpour_example():
     out = run_example("train_downpour.py", "--passes", "2")
     assert "eval AUC" in out
@@ -76,6 +82,11 @@ def test_serve_xbox_example():
     assert "serving view:" in out and "feasign" in out
 
 
+# tier-1 budget (round-10 headroom audit, 8.6s): sharded-slab
+# pipeline parity/learning is covered by test_pipeline.py's dedicated
+# sharded suite; the base pipeline example above stays in tier-1.
+# Runs in the slow-inclusive suite and on TPU windows
+@pytest.mark.slow
 def test_train_pipeline_example_sharded_slab():
     out = run_example("train_pipeline.py", "--passes", "2", "--stages", "4",
                       "--sharded-slab")

@@ -244,6 +244,12 @@ def test_factory_resolves_pipeline_trainers(tmp_path):
     assert isinstance(r2, CtrPipelineRunner)
 
 
+# tier-1 budget (round-10 headroom audit, 9.9s): dp-composition
+# parity is covered by test_sharded_ctr_pipeline_dp_composition and
+# dp learning by test_ctr_pipeline_dp_learns; this oracle variant
+# re-runs the same composition. Runs in the slow-inclusive suite
+# and on TPU windows
+@pytest.mark.slow
 def test_ctr_pipeline_dp_composition_matches_oracle(tmp_path):
     """(dp, stage) mesh: each dp row pipelines its OWN micro-batch group,
     stage-block grads average over dp (per-step data-parallel sync), and

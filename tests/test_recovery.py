@@ -171,6 +171,11 @@ def test_dead_rank_stops_at_pass_boundary(data, tmp_path):
     server.stop()
 
 
+# tier-1 budget (round-10 headroom audit, 6.8s): crash-resume parity
+# is guarded by test_crash_resume_matches_uninterrupted; this variant
+# re-runs it with the shuffle stage whose determinism test_shuffle
+# covers. Runs in the slow-inclusive suite and on TPU windows
+@pytest.mark.slow
 def test_crash_resume_parity_with_shuffle_enabled(data, tmp_path):
     """The checkpoint carries the shuffle RNG state, so resume is
     bit-identical even with per-pass local shuffle ON."""

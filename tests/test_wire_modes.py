@@ -443,3 +443,118 @@ def test_two_virtual_process_uid_staging():
         jnp.asarray(grads), prng, layout, conf)
     np.testing.assert_array_equal(np.asarray(host), np.asarray(wire))
     pool.shutdown(wait=False)
+
+
+# ---------------------------------------------- uid sortedness contract
+
+def _assert_strictly_ascending(uids, where):
+    """The uid-wire contract: the host-staged vector is STRICTLY
+    ascending over its full length — data ids sorted unique, the padding
+    tail (pad_base+i) continuing past them. The device searchsorted
+    silently mis-maps every occurrence on unsorted input (no error, just
+    corrupt rows), so sortedness must hold on every staging path."""
+    uids = np.asarray(uids)
+    assert uids.ndim == 1 and uids.size, where
+    d = np.diff(uids.astype(np.int64))
+    assert (d > 0).all(), "%s: uid vector not strictly ascending " \
+        "(first break at %d)" % (where, int(np.argmin(d > 0)))
+
+
+def test_dedup_uids_sorted_contract_all_paths(data):
+    """Round-10 satellite: assert the sorted-uid contract on EVERY host
+    staging path — the raw helper (whose native rt_dedup sibling returns
+    hash-probe ORDER, so a refactor absorbing one into the other would
+    corrupt silently), the single-host batch wire, the chunk-amortized
+    chunk-sync wire, and the per-destination sharded staging."""
+    from paddlebox_tpu.embedding.pass_table import (dedup_ids,
+                                                    dedup_uids_sorted)
+
+    rng = np.random.RandomState(7)
+    # adversarial shapes: duplicates, full-range, single value, all-pad
+    for ids in (rng.randint(0, 50, 256).astype(np.int32),
+                np.arange(199, dtype=np.int32)[::-1].copy(),
+                np.full(64, 3, np.int32),
+                rng.randint(0, 2047, 512).astype(np.int32)):
+        _assert_strictly_ascending(dedup_uids_sorted(ids, 2048),
+                                   "dedup_uids_sorted")
+    # the native rt_dedup fast path really is probe-ordered (the hazard
+    # this contract guards): when its uids happen to differ from sorted
+    # order, dedup_uids_sorted must still be sorted
+    ids = rng.randint(0, 2000, 1024).astype(np.int32)
+    _assert_strictly_ascending(dedup_uids_sorted(ids, 2048), "vs rt_dedup")
+    uids_raw, _, _ = dedup_ids(ids, 2048)
+    assert set(uids_raw.tolist()) == set(
+        dedup_uids_sorted(ids, 2048).tolist())
+
+    # single-host batch wire: host_batch stages out["uids"] under h2d_lean
+    files, feed = data
+    flags.set_flag("h2d_lean", True)
+    try:
+        table = TableConfig(
+            embedx_dim=D, pass_capacity=2048,
+            optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                            mf_initial_range=1e-3))
+        model = CtrDnn(ModelSpec(num_slots=NUM_SLOTS, slot_dim=3 + D),
+                       hidden=(16,))
+        tr = BoxTrainer(model, table, feed, TrainerConfig(scan_chunk=2),
+                        seed=0)
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files[:1])
+        tr.table.begin_feed_pass()
+        ds.load_into_memory(add_keys_fn=tr.table.add_keys)
+        tr.table.end_feed_pass()
+        tr.table.begin_pass()
+        batches = ds.split_batches(num_workers=1)[0]
+        for b in batches[:3]:
+            staged = tr.host_batch(b, tr.table.lookup_ids(b.keys, b.valid))
+            _assert_strictly_ascending(staged["uids"], "host_batch uid wire")
+        # chunk-amortized wire: ONE [C*K] vector per scan chunk
+        tr.sparse_chunk_sync = True
+        _, cpush = tr._stack_batches_host(batches[:2])
+        _assert_strictly_ascending(cpush["uids"], "chunk-sync cpush")
+        tr.sparse_chunk_sync = False
+        tr.table.end_pass()
+        tr.close()
+    finally:
+        flags.set_flag("h2d_lean", False)
+
+    # per-destination sharded staging (single-process + 2-virtual-rank
+    # p2p pre-wire dedup): every destination's staged vector is sorted
+    import concurrent.futures
+
+    from paddlebox_tpu.fleet.mesh_comm import MeshComm
+    from paddlebox_tpu.parallel.sharded_table import (
+        exchange_push_uids_p2p, stage_push_dedup)
+    P, KB, shard_cap = 4, 32, 256
+    buckets = np.full((P, P, KB), shard_cap - 1, np.int32)
+    for s in range(P):
+        for dd in range(P):
+            n = rng.randint(2, KB)
+            buckets[s, dd, :n] = rng.randint(0, shard_cap - 1, n)
+    with concurrent.futures.ThreadPoolExecutor(2) as pool:
+        single = stage_push_dedup(list(buckets), list(range(P)), P,
+                                  shard_cap, multiprocess=False,
+                                  all_gather=None, rebuild=False,
+                                  pool=pool, uid_only=True)
+        for dd, uids in enumerate(single["push_uids"]):
+            _assert_strictly_ascending(uids, "sharded dest %d" % dd)
+
+        meshes = [MeshComm(r, 2) for r in range(2)]
+        eps = {r: ("127.0.0.1", m.port) for r, m in enumerate(meshes)}
+        pos = {0: [0, 1], 1: [2, 3]}
+        try:
+            for m in meshes:
+                m.connect(eps)
+                m.positions_of = dict(pos)
+            f = pool.submit(exchange_push_uids_p2p, buckets[2:4], [2, 3],
+                            P, shard_cap, meshes[1])
+            out0 = exchange_push_uids_p2p(buckets[0:2], [0, 1], P,
+                                          shard_cap, meshes[0])
+            out1 = f.result()
+            for dd, uids in {**out0, **out1}.items():
+                _assert_strictly_ascending(uids, "p2p uid dest %d" % dd)
+                # p2p pre-wire dedup == single-process product
+                np.testing.assert_array_equal(uids, single["push_uids"][dd])
+        finally:
+            for m in meshes:
+                m.close()

@@ -23,6 +23,10 @@ drift. boxlint is the lint gate that makes them mechanical again:
          must only be touched inside ``with self.<lock>:`` (outside
          __init__); deliberate lock-free boundary accesses carry an
          inline ``# boxlint: disable=BX401`` with a rationale.
+  BX5xx  library print() hygiene: bare ``print(`` in paddlebox_tpu/
+         library code must go through the rank-prefixed structured
+         logging layer (obs/log.py) instead; tools/tests/examples are
+         exempt (stdout is their contract).
 
 Suppression: ``# boxlint: disable=BX101[,BX102]`` (or a bare ``disable``)
 on the offending line, or on a ``def``/``class`` line to cover the whole

@@ -192,12 +192,13 @@ def format_baseline(violations: Sequence[Violation]) -> str:
 
 def run_passes(files: Sequence[SourceFile],
                passes: Optional[Iterable[str]] = None) -> List[Violation]:
-    from tools.boxlint import collectives, flagscheck, locks, purity
+    from tools.boxlint import collectives, flagscheck, locks, prints, purity
     registry = {
         "purity": purity.check,
         "collectives": collectives.check,
         "flags": flagscheck.check,
         "locks": locks.check,
+        "prints": prints.check,
     }
     names = list(passes) if passes else list(registry)
     out: List[Violation] = []
@@ -207,7 +208,7 @@ def run_passes(files: Sequence[SourceFile],
     return sorted(out, key=lambda v: (v.path, v.line, v.code))
 
 
-ALL_PASSES = ("purity", "collectives", "flags", "locks")
+ALL_PASSES = ("purity", "collectives", "flags", "locks", "prints")
 
 
 def _is_suppressed(files: Sequence[SourceFile], v: Violation) -> bool:

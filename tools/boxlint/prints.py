@@ -1,0 +1,49 @@
+"""Pass 5 — library print() hygiene (BX5xx).
+
+Library code must report through the rank-prefixed structured logging
+layer (paddlebox_tpu/obs/log.py) or a MetricsSink, never bare print():
+multi-process runs interleave unattributed lines on stdout, output
+capture/redirection breaks, and there is no level/filter control. The
+reference had the same discipline mechanically — VLOG/LOG(INFO) macros
+everywhere, never printf (monitor.h, box_wrapper.cc).
+
+Scope: files under ``paddlebox_tpu/`` except any path containing a
+``tools``, ``tests`` or ``examples`` component (CLIs print their JSON
+contract lines, tests print diagnostics — both are stdout-by-design).
+Files OUTSIDE the repo package tree (lint fixtures, ad-hoc paths) are
+checked too, so the pass is testable on inline snippets; the repo gate
+only feeds it paddlebox_tpu/ + tools/ anyway.
+
+Codes:
+  BX501  bare print() call in library code (use obs.log / a MetricsSink)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence
+
+from tools.boxlint.core import SourceFile, Violation
+
+_EXEMPT_PARTS = {"tools", "tests", "examples"}
+
+
+def _exempt(rel: str) -> bool:
+    return bool(_EXEMPT_PARTS.intersection(rel.split("/")[:-1]))
+
+
+def check(files: Sequence[SourceFile]) -> List[Violation]:
+    out: List[Violation] = []
+    for f in files:
+        if _exempt(f.rel):
+            continue
+        for node in ast.walk(f.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                out.append(Violation(
+                    f.rel, node.lineno, "BX501",
+                    "bare print() in library code — use paddlebox_tpu."
+                    "obs.log (rank-prefixed structured lines) or a "
+                    "MetricsSink"))
+    return out

@@ -7,8 +7,24 @@ at ~40% of the recorded quiet-box rates — low enough to ride out
 container noise, high enough to catch an algorithmic regression (the
 r1 python-loop router was 10-25× under these rates).
 
+Round-10 load guard (the PR-4 flake: "rt_lookup floor dips under
+concurrent load — rerun alone"): every floor section runs SERIALLY in
+this one process and, when a rate lands under its floor, the section is
+re-measured alone up to 2 times after a settle pause before it may
+fail — a transient co-tenant burst can no longer false-fail the probe,
+while a real algorithmic regression (persistently under floor) still
+exits 1. A floor still missed after retries consults a CALIBRATION
+workload (np.sort, ~100M keys/s idle): if calibration is suppressed the
+box provably isn't delivering its quiet rate (loadavg reads 0.0 in this
+container even under full load) and the miss records as INCONCLUSIVE
+instead of failing. Each JSON line records load1, retries and (on a
+miss) calib_vs_quiet so a floor recorded under load is visibly
+annotated.
+``--stage NAME`` runs one section in full isolation (the rerun-alone
+workflow, now built in).
+
 Prints one JSON line per stage with ok=true/false; exits 1 if any fails.
-Usage: timeout 900 python -u tools/staged_regression_probe.py
+Usage: timeout 900 python -u tools/staged_regression_probe.py [--stage N]
 """
 import json
 import os
@@ -43,17 +59,72 @@ FLOORS = {
     "p2p_exchange_keys_per_sec": (30.1e6, 12e6),
 }
 
+RETRIES = 2          # extra isolated re-measures before a floor may fail
+SETTLE_SECS = 2.0    # pause before a retry (let a co-tenant burst pass)
+
+# Calibration workload: np.sort of a fixed 1M-int64 array, measured
+# ~100M keys/s on this container truly idle (2026-08-03). os.getloadavg
+# reads 0.0 inside this container even under full co-tenant load, so
+# the CALIBRATION RATE is the only trustworthy load signal: when a
+# floor stays missed after retries but the calibration itself is
+# suppressed below CALIB_SUPPRESSED of quiet, the box provably isn't
+# delivering its normal rate and the miss is recorded as inconclusive
+# (ok, with a loud note) instead of failing — sustained co-tenant load
+# (e.g. a tier-1 run in another shell) can outlast any retry budget.
+CALIB_RECORDED = 100e6
+CALIB_SUPPRESSED = 0.6
+
 failures = []
 
 
-def report(stage, rate):
+def _load1() -> float:
+    try:
+        return round(os.getloadavg()[0], 2)
+    except OSError:
+        return -1.0
+
+
+def _calib_rate() -> float:
+    a = np.random.RandomState(123).randint(
+        0, 1 << 40, 1 << 20).astype(np.int64)
+    return timed_rate(lambda: np.sort(a), a.size, secs=0.5)
+
+
+def report(stage, rate, remeasure=None):
+    """One floor check. `remeasure()` re-runs JUST this section (nothing
+    else of the probe executing) — the load guard: a below-floor rate is
+    retried alone up to RETRIES times and the BEST rate is judged; a
+    still-missed floor then consults the calibration workload, and only
+    fails when the box is provably delivering its quiet rate. The
+    emitted line carries load1/calib/retries as the load-guard note for
+    any floor recorded under load."""
     rec, floor = FLOORS[stage]
-    ok = rate >= floor
+    retries = 0
+    best = rate
+    while best < floor and remeasure is not None and retries < RETRIES:
+        time.sleep(SETTLE_SECS)
+        retries += 1
+        best = max(best, remeasure())
+    ok = best >= floor
+    line = {"stage": stage, "rate": round(best, 0), "recorded": rec,
+            "floor": floor, "ok": ok, "load1": _load1(),
+            "retries": retries}
     if not ok:
-        failures.append(stage)
-    print(json.dumps({"stage": stage, "rate": round(rate, 0),
-                      "recorded": rec, "floor": floor, "ok": ok}),
-          flush=True)
+        calib = _calib_rate()
+        line["calib_vs_quiet"] = round(calib / CALIB_RECORDED, 3)
+        if calib < CALIB_SUPPRESSED * CALIB_RECORDED:
+            # the box itself is slow right now: inconclusive, not failed
+            line["ok"] = ok = True
+            line["note"] = (
+                "floor missed but calibration at %.0f%% of quiet rate — "
+                "load-suppressed, INCONCLUSIVE; rerun alone"
+                % (100.0 * calib / CALIB_RECORDED))
+        else:
+            failures.append(stage)
+    elif retries:
+        line["note"] = ("below floor on first measure, passed on "
+                        "isolated rerun — transient container load")
+    print(json.dumps(line), flush=True)
 
 
 def timed_rate(fn, n_items, secs=2.0):
@@ -66,11 +137,12 @@ def timed_rate(fn, n_items, secs=2.0):
     return reps * n_items / (time.perf_counter() - t0)
 
 
-def main():
-    rng = np.random.RandomState(0)
-    K = 131072
+# --------------------------------------------------------------- sections
+# Each section measures + reports its stages and tears its state down
+# before returning, so sections never overlap (floor sections run
+# serially/isolated; --stage runs exactly one).
 
-    # --- native route tier -------------------------------------------
+def section_native(rng, K):
     from paddlebox_tpu.native.build import (create_route_index,
                                             destroy_route_index, get_lib,
                                             route_lookup)
@@ -80,23 +152,30 @@ def main():
     pass_keys = np.unique(rng.randint(0, 1 << 40, 1 << 20).astype(np.uint64))
     idx = create_route_index([pass_keys])
     probe = rng.choice(pass_keys, K).astype(np.uint64)
-    report("rt_lookup_keys_per_sec",
-           timed_rate(lambda: route_lookup(idx, probe, None, 0), K))
+    measure = lambda: timed_rate(  # noqa: E731
+        lambda: route_lookup(idx, probe, None, 0), K)
+    report("rt_lookup_keys_per_sec", measure(), remeasure=measure)
     destroy_route_index(idx)
 
     from paddlebox_tpu.embedding.pass_table import (dedup_ids,
                                                     dedup_uids_sorted)
     ids = rng.randint(0, 1 << 20, K).astype(np.int32)
-    report("rt_dedup_keys_per_sec",
-           timed_rate(lambda: dedup_ids(ids, 1 << 20), K))
+    m_dedup = lambda: timed_rate(  # noqa: E731
+        lambda: dedup_ids(ids, 1 << 20), K)
+    report("rt_dedup_keys_per_sec", m_dedup(), remeasure=m_dedup)
     # the uid-wire host product (np.unique sort — the only staged dedup
     # work on the uid-lean path)
-    report("uid_sort_keys_per_sec",
-           timed_rate(lambda: dedup_uids_sorted(ids, 1 << 20), K))
+    m_sort = lambda: timed_rate(  # noqa: E731
+        lambda: dedup_uids_sorted(ids, 1 << 20), K)
+    report("uid_sort_keys_per_sec", m_sort(), remeasure=m_sort)
 
+
+def section_bucketize(rng, K):
     from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
                                               TableConfig)
     from paddlebox_tpu.parallel.sharded_table import ShardedPassTable
+    pass_keys = np.unique(rng.randint(0, 1 << 40, 1 << 20).astype(np.uint64))
+    probe = rng.choice(pass_keys, K).astype(np.uint64)
     t = ShardedPassTable(
         TableConfig(embedx_dim=8, pass_capacity=1 << 21,
                     optimizer=SparseOptimizerConfig()),
@@ -105,9 +184,12 @@ def main():
     t.add_keys(pass_keys)
     t.end_feed_pass()
     valid = np.ones(K, bool)
-    report("bucketize_keys_per_sec",
-           timed_rate(lambda: t.bucketize(probe, valid.copy()), K))
+    measure = lambda: timed_rate(  # noqa: E731
+        lambda: t.bucketize(probe, valid.copy()), K)
+    report("bucketize_keys_per_sec", measure(), remeasure=measure)
 
+
+def section_p2p(rng, K):
     # --- p2p host-plane exchange tier (round 9) ----------------------
     # two in-process mesh endpoints over loopback running the per-step
     # bucket a2a (exchange_incoming_p2p) in lockstep — guards the socket
@@ -134,13 +216,14 @@ def main():
         exchange_incoming_p2p(bks[0], pos[0], P_hp, meshes[0])
         f.result()
 
-    report("p2p_exchange_keys_per_sec",
-           timed_rate(one_exchange, 4 * P_hp * KB_hp))
+    measure = lambda: timed_rate(one_exchange, 4 * P_hp * KB_hp)  # noqa: E731
+    report("p2p_exchange_keys_per_sec", measure(), remeasure=measure)
     for m in meshes:
         m.close()
     hp_pool.shutdown(wait=False)
 
-    # --- parse + pack tier -------------------------------------------
+
+def section_parse(rng, K):
     import tempfile
 
     from paddlebox_tpu.data import BoxDataset, write_synthetic_ctr_files
@@ -159,25 +242,33 @@ def main():
         return n
 
     n_lines = 16000
-    t0 = time.perf_counter()
-    reps = 0
-    load()
-    t0 = time.perf_counter()
-    while time.perf_counter() - t0 < 4.0:
-        n = load()
-        reps += 1
-    dt = time.perf_counter() - t0
-    report("parse_lines_per_sec", reps * n_lines / dt)
-    # load_into_memory covers parse+merge+batch build in this design
-    report("pack_instances_per_sec", reps * n / dt)
 
+    def measure():
+        load()                              # warm
+        t0 = time.perf_counter()
+        reps, n = 0, 0
+        while time.perf_counter() - t0 < 4.0:
+            n = load()
+            reps += 1
+        dt = time.perf_counter() - t0
+        return reps * n_lines / dt, reps * n / dt
+
+    parse_rate, pack_rate = measure()
+    report("parse_lines_per_sec", parse_rate,
+           remeasure=lambda: measure()[0])
+    # load_into_memory covers parse+merge+batch build in this design
+    report("pack_instances_per_sec", pack_rate,
+           remeasure=lambda: measure()[1])
+
+
+def section_e2e(rng, K):
     # --- uid-lean wire e2e tier (round 8) ----------------------------
     # host stage (lookup + uid sort) + H2D + jitted scan + loss D2H over
     # a small DeepFM shape — the whole staged path the uid wire carries
     import jax
     jax.config.update("jax_platforms", "cpu")
-    from paddlebox_tpu.config.configs import TrainerConfig
     from paddlebox_tpu.config import flags as _flags
+    from paddlebox_tpu.config.configs import TrainerConfig
     from tools.bench_util import make_bench_trainer, make_ctr_batches
     _flags.set_flag("h2d_lean", True)
     try:
@@ -201,14 +292,42 @@ def main():
             state[:] = slab, params, opt, key
             assert np.isfinite(np.asarray(losses)).all()
 
-        report("e2e_lean_examples_per_sec",
-               timed_rate(one_chunk, chunk * 256, secs=4.0))
+        measure = lambda: timed_rate(one_chunk, chunk * 256,  # noqa: E731
+                                     secs=4.0)
+        report("e2e_lean_examples_per_sec", measure(), remeasure=measure)
         tr.close()
     finally:
         _flags.set_flag("h2d_lean", False)
 
+
+SECTIONS = (
+    ("native", section_native),
+    ("bucketize", section_bucketize),
+    ("p2p", section_p2p),
+    ("parse", section_parse),
+    ("e2e", section_e2e),
+)
+
+
+def main():
+    only = None
+    if len(sys.argv) == 3 and sys.argv[1] == "--stage":
+        only = sys.argv[2]
+        if only not in dict(SECTIONS):
+            print(json.dumps({"error": "unknown stage %r; have %s"
+                              % (only, [n for n, _ in SECTIONS])}))
+            sys.exit(2)
+    K = 131072
+    for name, fn in SECTIONS:
+        if only is not None and name != only:
+            continue
+        # fresh RNG per section → --stage runs reproduce the full-probe
+        # workload of that section exactly
+        fn(np.random.RandomState(0), K)
+
     if failures:
-        print(json.dumps({"failed": failures}), flush=True)
+        print(json.dumps({"failed": failures, "load1": _load1()}),
+              flush=True)
         sys.exit(1)
     print(json.dumps({"all_ok": True}), flush=True)
 
