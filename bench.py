@@ -377,6 +377,84 @@ def measure(platform: str) -> None:
         pass_amortized = {"error": repr(e)[:300]}
         pa_eps = 0.0
 
+    def push_ladder() -> dict:
+        """Round-11 write-kernel ladder: the uid-wire push (merge +
+        in-table optimize + slab write) alone, donated slab threaded
+        through, at scatter / rebuild / blocked / blocked+pallas /
+        blocked+bf16 — median-of-3 keys/s per tier so the kernel
+        trajectory is recorded even on the CPU fallback (the TPU
+        crossover claim lives in BASELINE.md round 11 until a tunnel
+        window). The pallas tier runs INTERPRETED off-TPU — correct but
+        python-rate, so it gets a smaller shape (recorded per tier)."""
+        import functools
+
+        import jax.numpy as jnp
+
+        from paddlebox_tpu.embedding.accessor import (PushLayout,
+                                                      ValueLayout)
+        from paddlebox_tpu.embedding.optimizers import push_sparse_uidwire
+        from paddlebox_tpu.embedding.pass_table import dedup_uids_sorted
+
+        conf = table_cfg.optimizer
+        push_l = PushLayout(D)
+        rng = np.random.RandomState(7)
+        prng = jax.random.PRNGKey(0)
+
+        def tier(write, cap, K, embed_dtype="float32", pallas=False,
+                 runs=3):
+            layout = ValueLayout(D, "adagrad", embed_dtype=embed_dtype)
+            ids = rng.randint(0, cap // 8, K).astype(np.int32)  # dup ~8
+            uids = jnp.asarray(dedup_uids_sorted(ids, cap))
+            ids_j = jnp.asarray(ids)
+            grads = rng.rand(K, push_l.width).astype(np.float32)
+            grads[:, push_l.SHOW] = 1.0
+            grads_j = jnp.asarray(grads)
+            _flags.set_flag("push_blocked_pallas", pallas)
+            try:
+                step = jax.jit(functools.partial(
+                    push_sparse_uidwire, layout=layout, conf=conf,
+                    write=write), donate_argnums=(0,))
+                state = [jnp.zeros(
+                    (cap, layout.device_width), layout.device_dtype)]
+                state[0] = jax.block_until_ready(     # compile + warm
+                    step(state[0], uids, ids_j, grads_j, prng))
+                rates = []
+                for _ in range(runs):
+                    reps, t0 = 0, time.perf_counter()
+                    while time.perf_counter() - t0 < 1.0 and reps < 64:
+                        state[0] = jax.block_until_ready(
+                            step(state[0], uids, ids_j, grads_j, prng))
+                        reps += 1
+                    rates.append(reps * K / (time.perf_counter() - t0))
+                return {"keys_per_sec": round(float(np.median(rates)), 0),
+                        "cap_rows": cap, "batch_keys": K,
+                        "bytes_per_row": layout.device_bytes_per_row}
+            finally:
+                _flags.set_flag("push_blocked_pallas", False)
+
+        cap, K = 1 << 21, 1 << 18
+        out = {
+            "scatter": tier("scatter", cap, K),
+            "rebuild": tier("rebuild", cap, K),
+            "blocked": tier("blocked", cap, K),
+            # interpreted Mosaic off-TPU: python-rate, tiny shape
+            "blocked_pallas": tier("blocked", 1 << 12, 1 << 9,
+                                   pallas=True, runs=1),
+            "blocked_bf16": tier("blocked", cap, K,
+                                 embed_dtype="bfloat16"),
+        }
+        f32_b = out["blocked"]["bytes_per_row"]
+        b16_b = out["blocked_bf16"]["bytes_per_row"]
+        out["bf16_capacity_gain"] = round(f32_b / b16_b, 3)
+        return out
+
+    # round-11: write-kernel ladder. GUARDED like the other diagnostic
+    # tiers — it must never cost the headline metric.
+    try:
+        ladder = push_ladder()
+    except Exception as e:  # noqa: BLE001 — diagnostic tier, not the metric
+        ladder = {"error": repr(e)[:300]}
+
     eps = CHUNK * BATCH / dt
     print(json.dumps({
         "examples_per_sec": eps,
@@ -399,6 +477,7 @@ def measure(platform: str) -> None:
         "e2e_tiers": tiers,
         "pass_amortized": pass_amortized,
         "pass_amortized_examples_per_sec": pa_eps,
+        "push_ladder": ladder,
         "telemetry_overhead": telemetry,
         "compile_warmup_s": round(t_compile, 1),
     }))
@@ -506,6 +585,7 @@ def main() -> None:
         "pass_amortized": result.get("pass_amortized"),
         "pass_amortized_examples_per_sec": result.get(
             "pass_amortized_examples_per_sec", 0.0),
+        "push_ladder": result.get("push_ladder"),
         "telemetry_overhead": result.get("telemetry_overhead"),
         "hostplane": hostplane,
         "compile_warmup_s": result.get("compile_warmup_s"),

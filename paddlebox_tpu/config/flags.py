@@ -175,6 +175,48 @@ define_flag("push_write", "auto",
             "scatter on CPU). The round-5 'log' mode was deleted in "
             "round 8 — no measured regime ever selected it; findings "
             "retained in BASELINE.md round 5")
+define_flag("push_block_rows", 1024,
+            "blocked-scatter tile height for push_write=blocked (round "
+            "11): the sorted uid vector is bucketized into contiguous "
+            "row blocks of this many slab rows and each touched block is "
+            "applied with ONE dynamic_update_slice of a gathered tile "
+            "instead of a giant row scatter (push_blocked_write). Must "
+            "divide the table's pass_capacity (resolve_push_write "
+            "validates). Cost class ~ min(touched_blocks) * block bytes: "
+            "small blocks approach scatter's touched-rows cost, large "
+            "blocks approach rebuild's slab-bytes cost — bench.py "
+            "push_ladder records the crossover")
+define_flag("push_blocked_pallas", False,
+            "route push_write=blocked's per-block tile placement through "
+            "the hand-written Mosaic kernel (pallas_blocked_write: grid "
+            "over touched blocks, block ids scalar-prefetched, slab "
+            "aliased in place) instead of the XLA fori_loop of "
+            "dynamic_update_slices. Off-TPU it runs interpreted — "
+            "correct but slow (bench records both tiers)")
+define_flag("push_onehot_rows", 0,
+            "MXU one-hot matmul accumulation for the first N merged rows "
+            "of the uid-wire push (merge_grads_onehot): rows [0, N) merge "
+            "as onehot(inv) @ grads on the MXU — cost flat in batch keys "
+            "— while the tail keeps the VPU segment scatter-add, whose "
+            "cost is flat in duplicates. Wins when a dense short tail of "
+            "hot keys absorbs most of the batch's occurrences. f32 "
+            "accumulation ORDER differs from "
+            "the sorted segment-sum — a measured opt-in, not "
+            "bit-parity with the oracle (exact for integer grads). "
+            "0 = off (the default, oracle-exact path)")
+define_flag("slab_embed_dtype", "float32",
+            "DEVICE slab storage precision for the embedding weight "
+            "columns (round-11 dtype diet): 'float32' = the classic "
+            "homogeneous f32 [capacity, width] slab; 'bfloat16' = one "
+            "uint16 slab where embed_w/embedx/expand weights store bf16 "
+            "(half the bytes) and the header + ALL optimizer stats "
+            "(g2sum/adam moments) store lossless f32 bit-splits — "
+            "~2x pass rows per HBM byte at equal optimizer precision "
+            "(accessor.ValueLayout.embed_dtype / encode_slab_rows). "
+            "Host stores, checkpoints and the push/pull math stay f32; "
+            "rows decode at gather and encode at write. Weight updates "
+            "round to bf16 at the slab write (AUC-parity gated, "
+            "tests/test_push_blocked.py), stats round-trip bit-exactly")
 define_flag("flatten_dense_opt", True,
             "wrap the dense optimizer in optax.flatten so the whole dense "
             "update runs as one fused vector op instead of per-parameter "

@@ -64,16 +64,36 @@ class ValueLayout:
     GetInsEx(embedx_dim, expand_embed_dim) in box_wrapper.h:650): columns
     [expand_w[E], expand_g2sum] after the embedx state, updated with the
     shared-g2sum adagrad rule. Only adagrad/naive tables support expand.
+
+    embed_dtype (flag ``slab_embed_dtype``, round 11 dtype diet): the
+    DEVICE slab's storage precision for the weight columns. 'float32' =
+    the classic homogeneous f32 [capacity, width] slab. 'bfloat16' =
+    the slab is ONE uint16 array of ``device_width`` columns where the
+    embed_w/embedx/expand weight columns store their bf16 upper half
+    (1 u16 each) and every other column — the integer-exact header
+    (slot/show/click/delta/unseen/mf_size) and ALL optimizer stats
+    (g2sum / adam moments / beta pows) — stores its f32 bits split into
+    (hi, lo) u16 pairs, LOSSLESSLY. Host stores, checkpoints and the
+    push/pull math stay f32: rows decode at gather and encode at write
+    (encode/decode_slab_rows below), so the diet changes slab bytes and
+    nothing else. The show/click counters can NOT ride bf16 (integers
+    are exact in bf16 only to 256 — hot keys overflow silently), which
+    is why the split is per-column, not per-array.
     """
 
     embedx_dim: int
     optimizer: str = "adagrad"
     expand_dim: int = 0
+    embed_dtype: str = "float32"
 
     def __post_init__(self):
         if self.expand_dim and self.optimizer not in ("adagrad", "naive"):
             raise ValueError(
                 "expand_dim requires adagrad/naive sparse optimizer")
+        if self.embed_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                "embed_dtype must be float32 or bfloat16, got %r"
+                % (self.embed_dtype,))
 
     @property
     def embed_state_dim(self) -> int:
@@ -110,6 +130,25 @@ class ValueLayout:
     @property
     def width(self) -> int:
         return self.expand_state + self.expand_state_dim
+
+    @property
+    def device_width(self) -> int:
+        """Columns of the DEVICE slab array: == width for the f32 slab;
+        under the bf16 diet each non-weight column costs 2 uint16."""
+        if self.embed_dtype == "float32":
+            return self.width
+        return int(2 * self.width - slab_codec_plan(self).bf16_cols.sum())
+
+    @property
+    def device_bytes_per_row(self) -> int:
+        return (4 * self.width if self.embed_dtype == "float32"
+                else 2 * self.device_width)
+
+    @property
+    def device_dtype(self):
+        """Numpy dtype of the DEVICE slab array (f32, or u16 under the
+        bf16 diet — the codec owns all interpretation of the u16 bits)."""
+        return np.float32 if self.embed_dtype == "float32" else np.uint16
 
     # pull view: [show, click, embed_w, embedx_w...]  (CVM columns first, the
     # order PullCopy emits — box_wrapper.cu:75-120)
@@ -172,6 +211,133 @@ class ValueLayout:
             values[covered, DELTA_SCORE] = 0.0
         elif param == 3:
             values[:, UNSEEN_DAYS] += 1.0
+
+
+# --------------------------------------------------------------- slab codec
+# The round-11 dtype diet (ValueLayout.embed_dtype == 'bfloat16'): ONE
+# uint16 device slab whose weight columns are bf16 and whose header/stat
+# columns are lossless (hi, lo) f32 bit-splits. The codec is the SINGLE
+# boundary between the f32 world (host stores, checkpoints, optimizer
+# math, pull views) and the dieted device bytes: decode at every slab
+# gather, encode at every slab write/promote. Both directions are
+# identity pass-throughs for f32 layouts, so the default path compiles
+# to the exact pre-round-11 program.
+
+_KIND_BF16, _KIND_HI, _KIND_LO = 0, 1, 2
+
+
+@dataclasses.dataclass(frozen=True)
+class SlabCodecPlan:
+    """Static per-layout column plan (device col -> logical col + kind)."""
+
+    bf16_cols: np.ndarray   # [width] bool — weight columns stored as bf16
+    kinds: np.ndarray       # [device_width] int32 — _KIND_* per device col
+    srcs: np.ndarray        # [device_width] int32 — logical source column
+    hi_pos: np.ndarray      # [width] int32 — device col of hi half (or bf16)
+    lo_pos: np.ndarray      # [width] int32 — device col of lo half (bf16
+    #                         columns point at their own u16; masked at use)
+
+
+_CODEC_PLANS: dict = {}
+
+
+def slab_codec_plan(layout: "ValueLayout") -> SlabCodecPlan:
+    plan = _CODEC_PLANS.get(layout)
+    if plan is not None:
+        return plan
+    W = layout.width
+    bf = np.zeros(W, bool)
+    bf[EMBED_W] = True
+    bf[layout.embedx_w:layout.embedx_w + layout.embedx_dim] = True
+    if layout.expand_dim:
+        bf[layout.expand_w:layout.expand_w + layout.expand_dim] = True
+    kinds, srcs = [], []
+    hi_pos = np.zeros(W, np.int32)
+    lo_pos = np.zeros(W, np.int32)
+    for c in range(W):
+        hi_pos[c] = len(kinds)
+        if bf[c]:
+            lo_pos[c] = len(kinds)
+            kinds.append(_KIND_BF16)
+            srcs.append(c)
+        else:
+            kinds.append(_KIND_HI)
+            srcs.append(c)
+            lo_pos[c] = len(kinds)
+            kinds.append(_KIND_LO)
+            srcs.append(c)
+    plan = SlabCodecPlan(bf, np.asarray(kinds, np.int32),
+                         np.asarray(srcs, np.int32), hi_pos, lo_pos)
+    _CODEC_PLANS[layout] = plan
+    return plan
+
+
+def encode_slab_rows(rows, layout: "ValueLayout"):
+    """[..., width] f32 jnp rows -> [..., device_width] uint16 (identity
+    for f32 layouts). bf16 columns round-to-nearest-even (XLA convert);
+    everything else splits losslessly."""
+    if layout.embed_dtype == "float32":
+        return rows
+    import jax
+    import jax.numpy as jnp
+    plan = slab_codec_plan(layout)
+    u = jax.lax.bitcast_convert_type(rows, jnp.uint32)
+    hi = (u >> 16).astype(jnp.uint16)
+    lo = (u & jnp.uint32(0xFFFF)).astype(jnp.uint16)
+    b16 = jax.lax.bitcast_convert_type(rows.astype(jnp.bfloat16),
+                                       jnp.uint16)
+    srcs = jnp.asarray(plan.srcs)
+    kinds = jnp.asarray(plan.kinds)
+    return jnp.where(kinds == _KIND_BF16, b16[..., srcs],
+                     jnp.where(kinds == _KIND_HI, hi[..., srcs],
+                               lo[..., srcs]))
+
+
+def decode_slab_rows(rows, layout: "ValueLayout"):
+    """[..., device_width] uint16 jnp rows -> [..., width] f32 (identity
+    for f32 layouts). Stat/header columns recover their exact f32 bits;
+    bf16 columns widen by zero-filling the low mantissa half (exact for
+    every bf16 value)."""
+    if layout.embed_dtype == "float32":
+        return rows
+    import jax
+    import jax.numpy as jnp
+    plan = slab_codec_plan(layout)
+    hi = rows[..., jnp.asarray(plan.hi_pos)].astype(jnp.uint32)
+    lo = jnp.where(jnp.asarray(plan.bf16_cols), jnp.uint32(0),
+                   rows[..., jnp.asarray(plan.lo_pos)].astype(jnp.uint32))
+    return jax.lax.bitcast_convert_type((hi << 16) | lo, jnp.float32)
+
+
+def encode_slab_rows_np(rows: np.ndarray, layout: "ValueLayout") -> np.ndarray:
+    """Numpy twin of encode_slab_rows for the host promote boundary.
+    The bf16 rounding reproduces XLA's convert exactly: round-to-nearest-
+    even via the +0x7FFF+lsb trick, NaNs quieted to (hi | 0x40)."""
+    if layout.embed_dtype == "float32":
+        return np.ascontiguousarray(rows, np.float32)
+    plan = slab_codec_plan(layout)
+    u = np.ascontiguousarray(rows, np.float32).view(np.uint32)
+    hi = (u >> np.uint32(16)).astype(np.uint16)
+    lo = (u & np.uint32(0xFFFF)).astype(np.uint16)
+    rounded = ((u + np.uint32(0x7FFF) + ((u >> np.uint32(16)) & np.uint32(1)))
+               >> np.uint32(16)).astype(np.uint16)
+    isnan = (u & np.uint32(0x7FFFFFFF)) > np.uint32(0x7F800000)
+    b16 = np.where(isnan, hi | np.uint16(0x40), rounded)
+    return np.where(plan.kinds == _KIND_BF16, b16[..., plan.srcs],
+                    np.where(plan.kinds == _KIND_HI, hi[..., plan.srcs],
+                             lo[..., plan.srcs]))
+
+
+def decode_slab_rows_np(rows: np.ndarray, layout: "ValueLayout") -> np.ndarray:
+    """Numpy twin of decode_slab_rows for the D2H writeback boundary."""
+    if layout.embed_dtype == "float32":
+        return np.asarray(rows, np.float32)
+    plan = slab_codec_plan(layout)
+    rows = np.asarray(rows, np.uint16)
+    hi = rows[..., plan.hi_pos].astype(np.uint32)
+    lo = np.where(plan.bf16_cols, np.uint32(0),
+                  rows[..., plan.lo_pos].astype(np.uint32))
+    return np.ascontiguousarray((hi << np.uint32(16)) | lo).view(np.float32)
 
 
 @dataclasses.dataclass(frozen=True)

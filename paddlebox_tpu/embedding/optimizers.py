@@ -29,7 +29,9 @@ import jax.numpy as jnp
 
 from paddlebox_tpu.config.configs import SparseOptimizerConfig
 from paddlebox_tpu.embedding import accessor as acc
-from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
+from paddlebox_tpu.embedding.accessor import (PushLayout, ValueLayout,
+                                              decode_slab_rows,
+                                              encode_slab_rows)
 
 
 def _adagrad_step(w, g2sum, g, scale, lr, initial_g2sum, min_b, max_b):
@@ -254,10 +256,10 @@ def push_sparse_dedup(slab: jnp.ndarray, ids: jnp.ndarray,
     trash = slab.shape[0] - 1
     uids, inv = jnp.unique(ids, size=K, fill_value=trash, return_inverse=True)
     merged = jnp.zeros((K, grads.shape[1]), grads.dtype).at[inv].add(grads)
-    rows = slab[uids]
+    rows = decode_slab_rows(slab[uids], layout)
     new_rows = _dispatch_apply_push(rows, merged, prng, layout, conf,
                                     row_ids=uids)
-    return slab.at[uids].set(new_rows)
+    return slab.at[uids].set(encode_slab_rows(new_rows, layout))
 
 
 def rebuild_uids(ids: jnp.ndarray, perm: jnp.ndarray, inv: jnp.ndarray,
@@ -276,8 +278,8 @@ def push_sparse_hostdedup(slab: jnp.ndarray, uids: jnp.ndarray,
                           layout: ValueLayout,
                           conf: SparseOptimizerConfig,
                           pulled_rows: Optional[jnp.ndarray] = None,
-                          first_idx: Optional[jnp.ndarray] = None
-                          ) -> jnp.ndarray:
+                          first_idx: Optional[jnp.ndarray] = None,
+                          write: str = "scatter") -> jnp.ndarray:
     """Push with HOST-precomputed dedup (PassTable.dedup_for_push): no
     on-device sort. jnp.unique in push_sparse_dedup lowers to an XLA sort of
     the whole key vector per step — measured as the dominant cost of the
@@ -291,11 +293,27 @@ def push_sparse_hostdedup(slab: jnp.ndarray, uids: jnp.ndarray,
     inv_sorted: [K] nondecreasing merged-row index per permuted occurrence
     grads:      [K, push.width] per-occurrence push rows (padding all-zero)
     pulled_rows/first_idx: optional pull-gather reuse (see _merged_new_rows)
+    write: 'scatter' (the classic donated row scatter) or 'blocked'
+           (round 11: bucketize the sorted uids into contiguous row
+           blocks, place per block with dynamic_update_slice). 'blocked'
+           REQUIRES sorted uids: the staging side pins the sorted dedup
+           tier (dedup_ids sort=True — the native rt_dedup tier is
+           hash-ordered and would silently drop rows here). The rebuild
+           twin lives in push_sparse_rebuild.
     """
     new_rows = _merged_new_rows(slab, uids, perm, inv_sorted, grads, prng,
                                 layout, conf, pulled_rows, first_idx)
+    if write == "blocked":
+        from paddlebox_tpu.config import flags
+        return push_blocked_write(slab, uids,
+                                  encode_slab_rows(new_rows, layout),
+                                  int(flags.get_flag("push_block_rows")))
+    if write != "scatter":
+        raise ValueError(f"hostdedup write strategy {write!r} "
+                         "(scatter or blocked)")
     # out-of-range padding ids drop; in-range ids are unique by construction
-    return slab.at[uids].set(new_rows, mode="drop", unique_indices=True)
+    return slab.at[uids].set(encode_slab_rows(new_rows, layout),
+                             mode="drop", unique_indices=True)
 
 
 def _merged_new_rows(slab, uids, perm, inv_sorted, grads, prng, layout,
@@ -306,12 +324,13 @@ def _merged_new_rows(slab, uids, perm, inv_sorted, grads, prng, layout,
     lazy-init fixes can't diverge between the two.
 
     pulled_rows [K, width] + first_idx [K]: the step's pull already
-    gathered every occurrence's full row from this same pre-update slab, so
-    when given, each unique's row comes from pulled_rows[first_idx[j]] (a
-    [K]-domain gather; host stages first_idx next to the dedup) instead of
-    a second slab-wide gather. first_idx[j] must be an occurrence index of
-    uids[j] (padding tail entries may point anywhere: their g_show == 0
-    rows pass through untouched and are never written back)."""
+    gathered every occurrence's full row (DECODED f32 under the bf16 slab
+    diet) from this same pre-update slab, so when given, each unique's row
+    comes from pulled_rows[first_idx[j]] (a [K]-domain gather; host stages
+    first_idx next to the dedup) instead of a second slab-wide gather.
+    first_idx[j] must be an occurrence index of uids[j] (padding tail
+    entries may point anywhere: their g_show == 0 rows pass through
+    untouched and are never written back)."""
     sorted_grads = jnp.take(grads, perm, axis=0, indices_are_sorted=False,
                             unique_indices=True)
     merged = jax.ops.segment_sum(sorted_grads, inv_sorted,
@@ -320,7 +339,8 @@ def _merged_new_rows(slab, uids, perm, inv_sorted, grads, prng, layout,
     if pulled_rows is not None and first_idx is not None:
         rows = jnp.take(pulled_rows, first_idx, axis=0)
     else:
-        rows = jnp.take(slab, uids, axis=0, mode="clip")
+        rows = decode_slab_rows(jnp.take(slab, uids, axis=0, mode="clip"),
+                                layout)
     return _dispatch_apply_push(rows, merged, prng, layout, conf,
                                 row_ids=uids)
 
@@ -336,6 +356,106 @@ def decode_delta_uids(base: jnp.ndarray, d16: jnp.ndarray,
     dec = base + jnp.cumsum(d16.astype(jnp.int32))
     i = jnp.arange(d16.shape[0], dtype=jnp.int32)
     return jnp.where(i >= cut, (capacity - 1) + (i - cut), dec)
+
+
+def merge_grads_onehot(grads: jnp.ndarray, inv: jnp.ndarray, num_rows: int,
+                       hot_rows: int) -> jnp.ndarray:
+    """MXU one-hot matmul accumulation for the dense short tail of hot
+    keys (flag ``push_onehot_rows``): merged rows [0, hot_rows) accumulate
+    as onehot(inv) @ grads — a [H, K] x [K, G] matmul the MXU runs at line
+    rate — while the long tail keeps the VPU segment scatter-add. The
+    scatter-add's per-index cost is flat in duplicates; the matmul's cost
+    is flat in K, so it wins exactly when few merged rows absorb most of
+    the batch's occurrences (hot-key skew). f32 accumulation order differs
+    from the sorted segment-sum, so this is an opt-in measured path, NOT
+    bit-parity with the oracle (exact for integer-representable grads —
+    how the parity test pins it)."""
+    H = min(int(hot_rows), num_rows)
+    inv_cold = jnp.where(inv < H, num_rows, inv)  # hot occurrences drop
+    merged = jax.ops.segment_sum(grads, inv_cold, num_segments=num_rows)
+    onehot = (inv[None, :] == jnp.arange(H, dtype=inv.dtype)[:, None]
+              ).astype(grads.dtype)
+    return merged.at[:H].set(onehot @ grads)
+
+
+def push_blocked_write(slab: jnp.ndarray, uids: jnp.ndarray,
+                       new_rows: jnp.ndarray,
+                       block_rows: int) -> jnp.ndarray:
+    """Blocked slab write (round 11, ``push_write=blocked``): the sorted
+    uid vector is bucketized into contiguous row blocks of ``block_rows``
+    (a prefix-scan over the already-sorted uids — no sort) and each
+    touched block is applied with ONE ``lax.dynamic_update_slice`` of a
+    gather-assembled [B, W] tile, instead of one giant row scatter. Cost
+    class ~ min(U, C/B) * B rows of sequential tile traffic: between
+    scatter (~U rows + per-index plumbing) and rebuild (always C rows) —
+    the middle regime of the write ladder, with DMA-friendly contiguous
+    tiles instead of scattered row writes.
+
+    uids must be STRICTLY ASCENDING with an out-of-slab padding tail
+    (dedup_uids_sorted); new_rows are the ENCODED device rows to place.
+    block_rows must divide the slab's row count (resolve_push_write
+    enforces; keeps every tile aligned — a clamped partial tail block
+    would silently shift its rows' local offsets).
+    """
+    C, W = slab.shape
+    U = uids.shape[0]
+    if U == 0:
+        # an empty dedup touches nothing (same guard as the rebuild
+        # twin); the run-length machinery below assumes U >= 1
+        return slab
+    B = int(block_rows)
+    if B <= 0 or C % B:
+        raise ValueError(
+            "push_blocked_write: block_rows=%d must be positive and divide "
+            "the slab capacity %d" % (B, C))
+    n_blocks = C // B
+    NB = min(U, n_blocks)  # static bound on touched blocks
+    blk = uids // B        # nondecreasing (uids sorted)
+    in_range = uids < C
+    is_first = jnp.concatenate(
+        [jnp.ones((1,), bool), blk[1:] != blk[:-1]])
+    slot = jnp.cumsum(is_first.astype(jnp.int32)) - 1       # [U]
+    # block id per touched-block slot; slots fed only by padding uids keep
+    # the sentinel (their tile clamps to the last block and writes its own
+    # current contents back — a no-op by construction)
+    blk_of_slot = jnp.full((NB,), n_blocks, jnp.int32).at[slot].set(
+        jnp.where(in_range, blk, n_blocks).astype(jnp.int32), mode="drop")
+    # flattened (slot, local offset) -> source row in new_rows; -1 = keep
+    tgt = jnp.where(in_range, slot * B + (uids - blk * B), NB * B)
+    row_map = jnp.full((NB * B,), -1, jnp.int32).at[tgt].set(
+        jnp.arange(U, dtype=jnp.int32), mode="drop").reshape(NB, B)
+    starts = jnp.minimum(blk_of_slot * B, C - B)
+
+    def write_block(i, slab):
+        start = starts[i]
+        cur = jax.lax.dynamic_slice(slab, (start, 0), (B, W))
+        rm = row_map[i]
+        src = jnp.take(new_rows, jnp.clip(rm, 0, U - 1), axis=0)
+        tile = jnp.where((rm >= 0)[:, None], src, cur)
+        return jax.lax.dynamic_update_slice(slab, tile, (start, 0))
+
+    from paddlebox_tpu.config import flags
+    if flags.get_flag("push_blocked_pallas"):
+        from paddlebox_tpu.embedding.pallas_push import pallas_blocked_write
+        tiles = jnp.take(new_rows,
+                         jnp.clip(row_map, 0, U - 1).reshape(NB * B),
+                         axis=0).reshape(NB, B, W)
+        # REVERSED slot order — the grid's block-revisit safety invariant
+        # (pallas_blocked_write docstring): sentinel slots (padding tail,
+        # clamped onto the LAST block) must run BEFORE that block's real
+        # write. A revisit before the update writes the block's original
+        # bits (identity, prefetch-safe); a revisit after it could land
+        # stale prefetched bits over the real update under Mosaic's grid
+        # pipelining. Real slots address distinct blocks, so reversing
+        # puts all sentinels first and leaves the rest hazard-free.
+        rev = jnp.arange(NB - 1, -1, -1)
+        # off-TPU the Mosaic kernel runs interpreted — correct everywhere,
+        # fast only on the hardware it targets (bench records both)
+        return pallas_blocked_write(
+            slab, tiles[rev], row_map[rev],
+            jnp.minimum(blk_of_slot, n_blocks - 1)[rev],
+            interpret=jax.default_backend() not in ("tpu", "axon"))
+    return jax.lax.fori_loop(0, NB, write_block, slab)
 
 
 def push_sparse_uidwire(slab: jnp.ndarray, uids: jnp.ndarray,
@@ -371,27 +491,41 @@ def push_sparse_uidwire(slab: jnp.ndarray, uids: jnp.ndarray,
     Reference work shape: PushSparseGradCaseGPU merge + update
     (box_wrapper_impl.h:373-522); dedup never skipped (impl.h:129).
     """
+    from paddlebox_tpu.config import flags
     K = ids.shape[0]
     U = uids.shape[0]
     inv = jnp.searchsorted(uids, ids).astype(jnp.int32)
-    merged = jax.ops.segment_sum(grads, inv, num_segments=U)
+    hot = int(flags.get_flag("push_onehot_rows"))
+    if hot > 0:
+        # MXU one-hot accumulation for the dense short tail (see
+        # merge_grads_onehot: measured path, integer-exact only)
+        merged = merge_grads_onehot(grads, inv, U, hot)
+    else:
+        merged = jax.ops.segment_sum(grads, inv, num_segments=U)
     if pulled_rows is not None:
         first = jnp.full((U,), K - 1, jnp.int32).at[inv].min(
             jnp.arange(K, dtype=jnp.int32))
         rows = jnp.take(pulled_rows, first, axis=0)
     else:
-        rows = jnp.take(slab, uids, axis=0, mode="clip")
-    new_rows = _dispatch_apply_push(rows, merged, prng, layout, conf,
-                                    row_ids=uids)
+        rows = decode_slab_rows(jnp.take(slab, uids, axis=0, mode="clip"),
+                                layout)
+    new_rows = encode_slab_rows(
+        _dispatch_apply_push(rows, merged, prng, layout, conf,
+                             row_ids=uids), layout)
     if write == "rebuild":
         pos = jnp.full((slab.shape[0],), -1, jnp.int32).at[uids].set(
             jnp.arange(U, dtype=jnp.int32), mode="drop",
             unique_indices=True)
         sel = jnp.take(new_rows, jnp.clip(pos, 0, U - 1), axis=0)
         return jnp.where((pos >= 0)[:, None], sel, slab)
+    if write == "blocked":
+        # blocked scatter (round 11): bucketize the sorted uids into
+        # contiguous row blocks, apply per block with dynamic_update_slice
+        return push_blocked_write(slab, uids, new_rows,
+                                  int(flags.get_flag("push_block_rows")))
     if write != "scatter":
         raise ValueError(f"uid-wire write strategy {write!r} "
-                         "(scatter or rebuild)")
+                         "(scatter, rebuild or blocked)")
     return slab.at[uids].set(new_rows, mode="drop", unique_indices=True)
 
 
@@ -421,8 +555,9 @@ def push_sparse_rebuild(slab: jnp.ndarray, uids: jnp.ndarray,
         # the clip below would otherwise build the inverted range [0, -1];
         # an empty dedup touches nothing by definition
         return slab
-    new_rows = _merged_new_rows(slab, uids, perm, inv_sorted, grads, prng,
-                                layout, conf, pulled_rows, first_idx)
+    new_rows = encode_slab_rows(
+        _merged_new_rows(slab, uids, perm, inv_sorted, grads, prng,
+                         layout, conf, pulled_rows, first_idx), layout)
     sel = jnp.take(new_rows, jnp.clip(pos, 0, new_rows.shape[0] - 1),
                    axis=0)
     return jnp.where((pos >= 0)[:, None], sel, slab)
@@ -430,5 +565,10 @@ def push_sparse_rebuild(slab: jnp.ndarray, uids: jnp.ndarray,
 
 def make_push_fn(layout: ValueLayout,
                  conf: SparseOptimizerConfig) -> Callable:
-    """jit-compiled closure over static layout/conf."""
+    """jit-compiled closure over static layout/conf. Operates on DECODED
+    f32 rows on both sides: the slab codec boundary (bf16 dtype diet)
+    lives at the slab gather/write sites inside the push_sparse_* entry
+    points, never inside the optimizer math — callers holding an encoded
+    slab decode rows first (accessor.decode_slab_rows) and encode the
+    result back."""
     return jax.jit(functools.partial(apply_push, layout=layout, conf=conf))

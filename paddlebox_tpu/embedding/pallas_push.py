@@ -108,6 +108,68 @@ def _push_kernel(seed_ref, vals_ref, grads_ref, rid_ref, out_ref, *, layout,
     out_ref[:] = jnp.where(active, out, vals)
 
 
+def _blocked_write_kernel(bidx_ref, slab_ref, tiles_ref, rmap_ref, out_ref):
+    """One grid step = one touched slab block: read the CURRENT aliased
+    block, overlay the rows this block's tile carries (row_map >= 0), write
+    back. Revisit safety is the CALLER's job, not this read's: under
+    Mosaic grid pipelining the aliased input window for step i+1 may be
+    fetched before step i's store lands, so a sentinel slot revisiting an
+    already-written block could copy back pre-update bits. The caller
+    (push_blocked_write) therefore orders every sentinel slot BEFORE the
+    real write of the block it clamps onto — a revisit-before-update is an
+    identity write of the block's original bits, which is pipeline-safe."""
+    rm = rmap_ref[0]
+    out_ref[:] = jnp.where((rm >= 0)[:, None], tiles_ref[0], slab_ref[:])
+
+
+def pallas_blocked_write(slab: jnp.ndarray, tiles: jnp.ndarray,
+                         row_map: jnp.ndarray, blk_idx: jnp.ndarray,
+                         interpret: bool = False) -> jnp.ndarray:
+    """Blocked slab placement (round 11, `push_blocked_pallas`): the grid
+    runs over the NB touched blocks with the block ids SCALAR-PREFETCHED —
+    each step's in/out BlockSpec index maps through blk_idx[i], so the
+    kernel streams exactly the touched [B, W] tiles through VMEM and the
+    slab stays in place (input_output_aliases). This is the hand-written
+    tier of the blocked scatter: same tile shapes as push_blocked_write's
+    fori_loop, but the placement loop is the Mosaic grid instead of NB
+    sequential XLA dynamic_update_slices.
+
+    slab:    [C, W] (any dtype — pure placement, the encoded-row codec
+             already ran); C % B == 0
+    tiles:   [NB, B, W] gather-assembled source rows (garbage where
+             row_map < 0 — those lanes keep the slab's bits)
+    row_map: [NB, B] int32, >= 0 marks lanes to overwrite
+    blk_idx: [NB] int32 block ids in [0, C//B) (padding slots clamped by
+             the caller; their row_map is all -1 so the write is a no-op
+             — and the caller must schedule them BEFORE the real write of
+             the clamped block, see _blocked_write_kernel)
+    """
+    NB, B, W = tiles.shape
+    C = slab.shape[0]
+    if C % B:
+        raise ValueError("pallas_blocked_write: block rows %d must divide "
+                         "capacity %d" % (B, C))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(NB,),
+        in_specs=[
+            pl.BlockSpec((B, W), lambda i, b: (b[i], 0)),
+            pl.BlockSpec((1, B, W), lambda i, b: (i, 0, 0)),
+            pl.BlockSpec((1, B), lambda i, b: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((B, W), lambda i, b: (b[i], 0)),
+    )
+    return pl.pallas_call(
+        _blocked_write_kernel,
+        out_shape=jax.ShapeDtypeStruct(slab.shape, slab.dtype),
+        grid_spec=grid_spec,
+        # operand 0 is the scalar-prefetch vector; the slab (operand 1)
+        # aliases the output so untouched blocks keep their bits
+        input_output_aliases={1: 0},
+        interpret=interpret,
+    )(blk_idx, slab, tiles, row_map)
+
+
 def pallas_apply_push(values: jnp.ndarray, grads: jnp.ndarray, seed,
                       layout: ValueLayout,
                       conf: SparseOptimizerConfig,

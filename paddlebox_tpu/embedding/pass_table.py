@@ -31,7 +31,9 @@ import numpy as np
 
 from paddlebox_tpu.config.configs import TableConfig
 from paddlebox_tpu.embedding import accessor as acc
-from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
+from paddlebox_tpu.embedding.accessor import (PushLayout, ValueLayout,
+                                              decode_slab_rows_np,
+                                              encode_slab_rows_np)
 from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
 from paddlebox_tpu.embedding.native_store import make_host_store
 from paddlebox_tpu.embedding.optimizers import apply_push
@@ -63,8 +65,12 @@ def _delta_promote_impl(old_slab, src, keep, new_idx, new_rows):
     at new sorted position i was resident at old position src[i]), zeros
     elsewhere, then the freshly promoted host rows scatter into their new
     positions. new_idx is padded to a power-of-two bucket with `capacity`
-    (out of range, mode='drop') so promote counts don't recompile per pass."""
-    out = jnp.where(keep[:, None], old_slab[src], 0.0)
+    (out of range, mode='drop') so promote counts don't recompile per pass.
+    Dtype-agnostic on purpose: under the bf16 slab diet the rows are
+    ENCODED uint16 and must move without arithmetic (a python 0.0 would
+    silently upcast the select to f32)."""
+    out = jnp.where(keep[:, None], old_slab[src],
+                    jnp.zeros((), old_slab.dtype))
     return out.at[new_idx].set(new_rows, mode="drop")
 
 
@@ -73,6 +79,15 @@ def _delta_promote_impl(old_slab, src, keep, new_idx, new_rows):
 # too; their eval slab can't become resident, so keeping a second copy
 # would only double peak HBM)
 _delta_promote = jax.jit(_delta_promote_impl, donate_argnums=(0,))
+
+
+def _slab_embed_dtype() -> str:
+    """Resolve the slab_embed_dtype flag at table construction: the
+    DEVICE slab's weight-column precision (round-11 dtype diet). Read
+    once per table, not per pass — the codec layout is baked into every
+    jitted step's static ValueLayout."""
+    from paddlebox_tpu.config import flags
+    return str(flags.get_flag("slab_embed_dtype"))
 
 
 def _pow2_pad(m: int) -> int:
@@ -96,7 +111,7 @@ def sorted_member(sorted_keys: np.ndarray, keys: np.ndarray):
     return pos, sorted_keys[pos] == keys
 
 
-def dedup_ids(ids: np.ndarray, pad_base: int):
+def dedup_ids(ids: np.ndarray, pad_base: int, sort: bool = False):
     """Host-side per-batch id dedup for push_sparse_hostdedup: the device
     analog (jnp.unique) is an XLA sort of the whole key vector inside every
     train step; here it rides the already-overlapped host batch stage
@@ -110,7 +125,14 @@ def dedup_ids(ids: np.ndarray, pad_base: int):
 
     Fast path: native rt_dedup (hash dedup + counting sort, no comparison
     sort); numpy argsort fallback.
-    """
+
+    sort=True guarantees uids come back STRICTLY ASCENDING (with
+    perm/inv consistent): required whenever the products feed
+    push_write='blocked', whose device-side bucketize trusts sortedness
+    (unsorted uids make its run-length slots overflow and DROP rows, with
+    no error). The native tier returns hash-probe order, so sort=True
+    pins the numpy argsort tier — sorted by construction, same cost
+    class as a post-sort remap without the extra pass."""
     raw = np.asarray(ids)
     ids = np.ascontiguousarray(raw, dtype=np.int32)
     K = ids.shape[0]
@@ -124,7 +146,7 @@ def dedup_ids(ids: np.ndarray, pad_base: int):
                          % (raw.min(), raw.max(), raw.dtype))
     from paddlebox_tpu.native.build import get_lib
     lib = get_lib()
-    if lib is not None and K:
+    if lib is not None and K and not sort:
         import ctypes
         uids = np.empty(K, np.int32)
         perm = np.empty(K, np.int32)
@@ -157,15 +179,46 @@ def dedup_uids_sorted(ids: np.ndarray, pad_base: int) -> np.ndarray:
     """[K] SORTED unique ids, tail padded with pad_base+i — the uid-wire
     host product (round 8): the device derives inv/first/pos by binary
     search against this vector, so unlike dedup_ids (whose native fast
-    path returns hash-probe order) sortedness is load-bearing. np.unique
-    is the whole computation — one comparison sort of the batch's ids on
-    the (overlapped) host stage buys removing the per-step device sort
-    AND the perm/inv/first_idx wire (3x [K] int32/batch)."""
+    path returns hash-probe order) sortedness is load-bearing.
+
+    Fast path (round 11): native rt_dedup_sorted — calloc'd presence-mark
+    dedup over the K occurrences, then an LSD radix sort of the n_u
+    UNIQUES only (byte passes skip when constant), so heavy key
+    recurrence pays one byte store per occurrence + O(n_u) sort instead
+    of np.unique's comparison sort of the whole occurrence vector
+    (measured best-of-7 1.1x at dup 2 up to 4.5x at dup 64, BASELINE.md
+    round 11). The kernel DECLINES low-duplication shapes (pad_base >
+    batch/2, where the presence-table page faults beat the sort it
+    saves) and any id outside [0, pad_base) (the presence table is
+    exactly pad_base bytes) — both return -1 and this wrapper keeps the
+    numpy tier, which also remains the oracle the sortedness contract
+    test pins both against (tests/test_wire_modes.py). NOTE the
+    engagement caveat (BASELINE.md round 11): wired callers pass
+    pad_base = table/shard capacity, so the native tier engages only
+    when a batch carries >= 2x the capacity in occurrences — the
+    K/n_unique duplication re-key is recorded follow-up."""
     ids = np.ascontiguousarray(np.asarray(ids), np.int32)
     K = ids.shape[0]
     if K and ids.min() < 0:
         raise ValueError("dedup_uids_sorted expects nonnegative int32 "
                          "pass-local ids")
+    from paddlebox_tpu.native.build import get_lib
+    lib = get_lib()
+    # the decline predicate is pure shape arithmetic — hoisted here so the
+    # always-declining regime (wired callers pass pad_base = capacity,
+    # usually >> K/2) skips the two K-sized scratch allocs and the FFI
+    # call; the kernel keeps its own check as the backstop
+    if (lib is not None and K and 2 * pad_base <= K
+            and hasattr(lib, "rt_dedup_sorted")):
+        import ctypes
+        out = np.empty(K, np.int32)
+        scratch = np.empty(K, np.int64)
+        n_u = lib.rt_dedup_sorted(
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), K, pad_base,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            scratch.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if n_u >= 0:
+            return out
     uniq = np.unique(ids)
     out = np.empty(K, np.int32)
     n = uniq.shape[0]
@@ -242,7 +295,8 @@ class PassTable:
                  store: Optional[HostEmbeddingStore] = None) -> None:
         self.config = table
         self.layout = ValueLayout(table.embedx_dim, table.optimizer.optimizer,
-                                  expand_dim=table.expand_embed_dim)
+                                  expand_dim=table.expand_embed_dim,
+                                  embed_dtype=_slab_embed_dtype())
         self.push_layout = PushLayout(table.embedx_dim,
                                       table.expand_embed_dim)
         # store contents move under concurrent access (native arena rows
@@ -425,9 +479,13 @@ class PassTable:
             m = miss_idx.size
             pad = _pow2_pad(max(m, 1))
             idx_p = np.full(pad, self.capacity, np.int32)  # drop sentinel
-            rows_p = np.zeros((pad, self.layout.width), np.float32)
+            # promote boundary: freshly-read host f32 rows encode to the
+            # device layout here (identity for f32 slabs); resident rows
+            # move as raw bits inside _delta_promote
+            rows_p = np.zeros((pad, self.layout.device_width),
+                              self.layout.device_dtype)
             idx_p[:m] = miss_idx
-            rows_p[:m] = new_rows
+            rows_p[:m] = encode_slab_rows_np(new_rows, self.layout)
             # test mode CONSUMES the resident slab too (donated — a copy
             # would hold 2× slab HBM for the whole eval, an OOM at the
             # capacity-probe scale the chip is sized to); the eval slab
@@ -447,11 +505,11 @@ class PassTable:
                              else self.store.lookup_or_create(self._pass_keys))
             # zero only the tail beyond n: a full-capacity zeros() here was
             # pure memcpy waste — every [0, n) row is overwritten next
-            slab = np.empty((self.capacity, self.layout.width),
-                            dtype=np.float32)
+            slab = np.empty((self.capacity, self.layout.device_width),
+                            dtype=self.layout.device_dtype)
             if n:
-                slab[:n] = host_rows
-            slab[n:] = 0.0
+                slab[:n] = encode_slab_rows_np(host_rows, self.layout)
+            slab[n:] = 0
             self._slab = jnp.asarray(slab)
         self._drop_prev_route()
         self._touch_seen = False
@@ -500,13 +558,18 @@ class PassTable:
                     self._touched[self.padding_id] = False
                     idx = np.nonzero(self._touched[:n])[0]
                     if idx.size:
-                        rows = np.asarray(self._slab[jnp.asarray(idx)])
+                        # writeback boundary: encoded device rows decode
+                        # back to host f32 (identity for f32 slabs)
+                        rows = decode_slab_rows_np(
+                            np.asarray(self._slab[jnp.asarray(idx)]),
+                            self.layout)
                         with self.store_lock:
                             self.store.write_back(self._pass_keys[idx], rows)
                     stat_add("pass_rows_written_back", int(idx.size))
                     stat_add("pass_rows_writeback_skipped", n - int(idx.size))
                 else:
-                    host = np.asarray(self._slab[:n])
+                    host = decode_slab_rows_np(np.asarray(self._slab[:n]),
+                                               self.layout)
                     with self.store_lock:
                         self.store.write_back(self._pass_keys, host)
             if self._incremental() and not self._residency_poisoned:
@@ -650,10 +713,11 @@ class PassTable:
         self.note_touched(ids)
         return ids
 
-    def dedup_for_push(self, ids: np.ndarray):
+    def dedup_for_push(self, ids: np.ndarray, sort: bool = False):
         """Host-side per-batch dedup for push_sparse_hostdedup (see
-        dedup_ids): padding ids start at this table's capacity."""
-        return dedup_ids(ids, self.capacity)
+        dedup_ids): padding ids start at this table's capacity. sort=True
+        = sorted-uids contract (push_write='blocked' staging)."""
+        return dedup_ids(ids, self.capacity, sort=sort)
 
     def uids_for_push(self, ids: np.ndarray) -> np.ndarray:
         """Sorted uid-wire dedup product (see dedup_uids_sorted): padding

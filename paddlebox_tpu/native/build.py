@@ -134,6 +134,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rt_dedup.argtypes = [P(c.c_int32), c.c_int64, c.c_int32,
                              P(c.c_int32), P(c.c_int32), P(c.c_int32),
                              P(c.c_int64)]
+    # round 11 (optional: user plugin .so files may predate it) — sorted
+    # uid-wire dedup, hash probe + radix sort over the uniques only
+    if hasattr(lib, "rt_dedup_sorted"):
+        lib.rt_dedup_sorted.restype = c.c_int64
+        lib.rt_dedup_sorted.argtypes = [P(c.c_int32), c.c_int64, c.c_int32,
+                                        P(c.c_int32), P(c.c_int64)]
     return lib
 
 

@@ -333,4 +333,75 @@ int64_t rt_dedup(const int32_t* ids, int64_t K, int32_t pad_base,
   return n_u;
 }
 
+// Sorted uid-wire dedup (round 11): presence-mark dedup collects the
+// n_u uniques in O(K), then an LSD radix sort over the UNIQUES ONLY
+// (4 x 8-bit passes, skip-if-constant per byte) orders them ascending —
+// vs np.unique's comparison sort of the full K-occurrence vector. The
+// uid wire ships only this vector (dedup_uids_sorted): perm/inv never
+// materialize here, the device derives them by searchsorted.
+//
+// The presence array is calloc'd, NOT malloc+memset: the kernel hands
+// back zero pages lazily, so a heavily-duplicated batch (the uid wire's
+// motivating shape) faults in only the pages its uniques actually touch
+// instead of paying a full-table memset per call. The mark is one
+// predictable byte store per occurrence — no probe chain, no key
+// compare. This tier exists exactly where it wins (measured best-of-7,
+// BASELINE.md round 11): DUPLICATED batches, pad_base at most half the
+// batch (guaranteed mean dup >= 2). At pad_base == K the sort it saves
+// no longer covers the presence-table faults and np.unique wins ~1.3x,
+// widening with sparsity — the kernel declines (-1) and the caller
+// keeps its numpy tier.
+//   uids[K]  ascending uniques, tail padded with pad_base+i
+//   scratch  caller int64[K] (>= n_u int32 ping-pong buffer)
+// Returns the unique count, -1 when declining (shape, or an id outside
+// [0, pad_base) — the presence table is exactly pad_base bytes, so an
+// out-of-contract id must fall back to the numpy tier rather than
+// write past it), -2 on allocation failure.
+int64_t rt_dedup_sorted(const int32_t* ids, int64_t K, int32_t pad_base,
+                        int32_t* uids, int64_t* scratch) {
+  if (static_cast<int64_t>(pad_base) * 2 > K) return -1;
+  uint8_t* seen = static_cast<uint8_t*>(calloc(pad_base, 1));
+  if (!seen) return -2;
+  int64_t n_u = 0;
+  for (int64_t i = 0; i < K; ++i) {
+    int32_t id = ids[i];
+    if (static_cast<uint32_t>(id) >= static_cast<uint32_t>(pad_base)) {
+      free(seen);
+      return -1;  // unsigned compare also catches id < 0
+    }
+    if (!seen[id]) {
+      seen[id] = 1;
+      uids[n_u++] = id;
+    }
+  }
+  free(seen);
+  int32_t* a = uids;
+  int32_t* b = reinterpret_cast<int32_t*>(scratch);
+  int64_t count[256];
+  for (int shift = 0; shift < 32; shift += 8) {
+    memset(count, 0, sizeof(count));
+    for (int64_t i = 0; i < n_u; ++i)
+      ++count[(static_cast<uint32_t>(a[i]) >> shift) & 0xFF];
+    // pass-local ids cluster low: high bytes are usually constant, and a
+    // single-bucket histogram means the pass is the identity — skip it
+    if (n_u && count[(static_cast<uint32_t>(a[0]) >> shift) & 0xFF] == n_u)
+      continue;
+    int64_t run = 0;
+    for (int j = 0; j < 256; ++j) {
+      int64_t c = count[j];
+      count[j] = run;
+      run += c;
+    }
+    for (int64_t i = 0; i < n_u; ++i)
+      b[count[(static_cast<uint32_t>(a[i]) >> shift) & 0xFF]++] = a[i];
+    int32_t* t = a;
+    a = b;
+    b = t;
+  }
+  if (a != uids) memcpy(uids, a, static_cast<size_t>(n_u) * 4);
+  for (int64_t i = n_u; i < K; ++i)
+    uids[i] = pad_base + static_cast<int32_t>(i - n_u);
+  return n_u;
+}
+
 }  // extern "C"

@@ -23,7 +23,8 @@ import jax
 import jax.numpy as jnp
 
 from paddlebox_tpu.embedding import accessor as acc
-from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
+from paddlebox_tpu.embedding.accessor import (PushLayout, ValueLayout,
+                                              decode_slab_rows)
 
 
 def pull_view_from_rows(rows: jnp.ndarray,
@@ -42,10 +43,21 @@ def pull_view_from_rows(rows: jnp.ndarray,
     ], axis=1)
 
 
+def gather_slab_rows(slab: jnp.ndarray, ids: jnp.ndarray,
+                     layout: ValueLayout) -> jnp.ndarray:
+    """[K, width] DECODED f32 rows gathered from the device slab — the
+    one gather idiom every pull/push row-reuse site shares. Identity
+    passthrough of slab[ids] for f32 layouts; under the bf16 slab diet
+    (layout.embed_dtype) the gathered uint16 rows decode to f32 here, so
+    downstream math (pull views, optimizer, pulled-row reuse) never sees
+    encoded bits."""
+    return decode_slab_rows(slab[ids], layout)
+
+
 def pull_sparse(slab: jnp.ndarray, ids: jnp.ndarray,
                 layout: ValueLayout) -> jnp.ndarray:
     """Gather per-key pull view [K, 3+D]: show, click, embed_w, embedx."""
-    return pull_view_from_rows(slab[ids], layout)
+    return pull_view_from_rows(gather_slab_rows(slab, ids, layout), layout)
 
 
 def build_push_grads(d_emb: jnp.ndarray, slots: jnp.ndarray,
@@ -77,7 +89,7 @@ def pull_sparse_extended(slab: jnp.ndarray, ids: jnp.ndarray,
     (NN-cross) embedding [K, E]. Requires layout.expand_dim > 0."""
     if not layout.expand_dim:
         raise ValueError("layout has no expand block (expand_dim == 0)")
-    rows = slab[ids]
+    rows = gather_slab_rows(slab, ids, layout)
     ew0 = layout.expand_w
     base = jnp.concatenate([
         rows[:, acc.SHOW:acc.SHOW + 1],
@@ -109,6 +121,13 @@ import functools
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def pull_sparse_differentiable(slab, ids, layout: ValueLayout):
+    if layout.embed_dtype != "float32":
+        # the full-graph path's cotangent is a slab-shaped f32 scatter-add
+        # — meaningless against an encoded uint16 slab. The explicit
+        # pull/push integration (what the trainers run) supports the diet.
+        raise ValueError(
+            "pull_sparse_differentiable requires a float32 slab layout; "
+            "the bf16 slab diet (slab_embed_dtype) is explicit-path only")
     return pull_sparse(slab, ids, layout)
 
 

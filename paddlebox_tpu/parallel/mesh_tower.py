@@ -174,9 +174,11 @@ class MeshTowerTrainer:
                                            batch["perm"], batch["inv"],
                                            pg, sub, layout, conf)
             else:
-                slab = push_sparse_hostdedup(slab, uids, batch["perm"],
-                                             batch["inv"], pg, sub, layout,
-                                             conf)
+                slab = push_sparse_hostdedup(
+                    slab, uids, batch["perm"], batch["inv"], pg, sub,
+                    layout, conf,
+                    write=("blocked" if self._push_write == "blocked"
+                           else "scatter"))
             params = {k: (v[None] if sharded[k] else v)
                       for k, v in local.items()}
             opt_state = jax.tree.map(
@@ -221,7 +223,8 @@ class MeshTowerTrainer:
             # eval never pushes — skip the dedup + transfers; uids ride the
             # host stage (device reconstruction is a scatter), and rebuild
             # mode stages the pos map for the scatter-free slab write
-            uids, perm, inv = self.table.dedup_for_push(ids)
+            uids, perm, inv = self.table.dedup_for_push(
+                ids, sort=self._push_write == "blocked")
             out.update(perm=jnp.asarray(perm), inv=jnp.asarray(inv),
                        uids=jnp.asarray(uids))
             if self._push_write == "rebuild":

@@ -1317,7 +1317,9 @@ class ShardedCtrPipelineRunner:
                 slab = push_sparse_hostdedup(
                     slab, batch["push_uids"], batch["push_perm"],
                     batch["push_inv"], recv_g.reshape(Pn * KB, -1), sub,
-                    layout, conf)
+                    layout, conf,
+                    write=("blocked" if push_write == "blocked"
+                           else "scatter"))
             elif "push_uids" in batch:
                 # uid wire (h2d_uid_wire, round 8): only the sorted uid
                 # vector staged — the incoming ids are the a2a'd buckets
@@ -1474,7 +1476,8 @@ class ShardedCtrPipelineRunner:
                 rebuild=self._push_write == "rebuild", pool=pool,
                 note_touched=self.table.note_touched,
                 uid_only=bool(flags.get_flag("h2d_uid_wire")),
-                mesh=self.host_mesh))
+                mesh=self.host_mesh,
+                sort_uids=self._push_write == "blocked"))
         return {k: self._put_flat(np.stack(v)) for k, v in leaves.items()}
 
     def begin_pass(self) -> None:

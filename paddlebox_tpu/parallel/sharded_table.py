@@ -33,7 +33,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddlebox_tpu.config.configs import TableConfig
-from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
+from paddlebox_tpu.embedding.accessor import (PushLayout, ValueLayout,
+                                              decode_slab_rows_np,
+                                              encode_slab_rows_np)
 from paddlebox_tpu.embedding.native_store import make_host_store
 from paddlebox_tpu.obs import beat as obs_beat
 from paddlebox_tpu.obs.tracer import record_span
@@ -292,7 +294,8 @@ def exchange_push_uids_p2p(buckets_local: np.ndarray,
 def stage_push_dedup(buckets, local_positions, num_devices: int,
                      shard_cap: int, multiprocess: bool, all_gather,
                      rebuild: bool, pool, note_touched=None,
-                     uid_only: bool = False, mesh=None):
+                     uid_only: bool = False, mesh=None,
+                     sort_uids: bool = False):
     """Per-destination push-dedup staging shared by BOTH sharded runners
     (trainer's _step_host_arrays + pipeline's device_batch): makes each
     shard's incoming a2a ids host-known (exchange_outgoing_buckets when
@@ -353,7 +356,10 @@ def stage_push_dedup(buckets, local_positions, num_devices: int,
             uids = dedup_uids_sorted(incoming_of(d), shard_cap)
             perm = inv = None
         else:
-            uids, perm, inv = dedup_ids(incoming_of(d), shard_cap)
+            # sort_uids: push_write='blocked' consumes these products and
+            # its device bucketize trusts sorted uids (see dedup_ids)
+            uids, perm, inv = dedup_ids(incoming_of(d), shard_cap,
+                                        sort=sort_uids)
         if note_touched is not None:
             # every id this destination shard will push rides these uids —
             # the per-pass touched-row accumulation point (incremental
@@ -399,8 +405,10 @@ class ShardedPassTable:
         the distributed CPU PS behind every shard (the GPUPS BuildPull/
         EndPass composition, ps_gpu_wrapper.cc:337,983)."""
         self.config = table
+        from paddlebox_tpu.embedding.pass_table import _slab_embed_dtype
         self.layout = ValueLayout(table.embedx_dim, table.optimizer.optimizer,
-                                  expand_dim=table.expand_embed_dim)
+                                  expand_dim=table.expand_embed_dim,
+                                  embed_dtype=_slab_embed_dtype())
         self.push_layout = PushLayout(table.embedx_dim,
                                       table.expand_embed_dim)
         self.num_shards = num_shards
@@ -585,7 +593,11 @@ class ShardedPassTable:
         if self._shard_keys is None:
             raise RuntimeError("build_slabs before feed pass completed")
         self._begin_pass_state()
-        out = np.stack([self._build_one(s) for s in range(self.num_shards)])
+        # promote boundary: the host residency mirror (_res_rows) stays
+        # f32; only the DEVICE-bound copy encodes (identity for f32)
+        out = encode_slab_rows_np(
+            np.stack([self._build_one(s) for s in range(self.num_shards)]),
+            self.layout)
         if not self._test_mode:
             self._staged_sh = None
         return out
@@ -597,7 +609,9 @@ class ShardedPassTable:
         if self._shard_keys is None:
             raise RuntimeError("build_owned_slabs before feed pass completed")
         self._begin_pass_state()
-        out = np.stack([self._build_one(s) for s in self.owned_shards])
+        out = encode_slab_rows_np(
+            np.stack([self._build_one(s) for s in self.owned_shards]),
+            self.layout)
         if not self._test_mode:
             self._staged_sh = None
         return out
@@ -645,7 +659,11 @@ class ShardedPassTable:
     def _write_back_rows(self, s: int, ks: np.ndarray,
                          slab_host: np.ndarray) -> None:
         """Store one shard's end-of-pass rows from a HOST [C, W] array:
-        touched delta when the pass accounted touches, full otherwise."""
+        touched delta when the pass accounted touches, full otherwise.
+        slab_host carries the DEVICE layout (encoded u16 under the bf16
+        diet) — the writeback boundary decodes here, so the stores and
+        the f32 residency mirror never see encoded bits."""
+        slab_host = decode_slab_rows_np(slab_host, self.layout)
         idx = self._touched_idx(s, ks.size)
         with self.store_lock:
             if idx is None:
@@ -693,7 +711,9 @@ class ShardedPassTable:
             return
         if idx.size:
             import jax.numpy as jnp
-            rows = np.asarray(jnp.asarray(dev)[0][jnp.asarray(idx)])
+            rows = decode_slab_rows_np(
+                np.asarray(jnp.asarray(dev)[0][jnp.asarray(idx)]),
+                self.layout)
             with self.store_lock:
                 self.stores[s].write_back(ks[idx], rows)
             cache = self._res_rows.get(s)

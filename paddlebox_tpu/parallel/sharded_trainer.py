@@ -592,7 +592,9 @@ class ShardedBoxTrainer:
                 slab = push_sparse_hostdedup(
                     slab, batch["push_uids"], batch["push_perm"],
                     batch["push_inv"], recv_g.reshape(Pn * KB, -1), prng,
-                    layout, conf)
+                    layout, conf,
+                    write=("blocked" if push_write == "blocked"
+                           else "scatter"))
             elif "push_uids" in batch:
                 # uid wire (h2d_uid_wire, round 8): the shard's incoming
                 # ids ARE the a2a'd buckets already on device (req), so
@@ -765,7 +767,8 @@ class ShardedBoxTrainer:
                 rebuild=self._push_write == "rebuild", pool=pool,
                 note_touched=self.table.note_touched,
                 uid_only=bool(flags.get_flag("h2d_uid_wire")),
-                mesh=self.host_mesh))
+                mesh=self.host_mesh,
+                sort_uids=self._push_write == "blocked"))
         return {k: np.stack(v) for k, v in stacked.items()}
 
     def shard_batches(self, per_worker: List[List[PackedBatch]],

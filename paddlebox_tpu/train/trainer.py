@@ -50,6 +50,7 @@ from paddlebox_tpu.obs import span as obs_span
 from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm, seqpool_sum
 from paddlebox_tpu.ops.sparse import (build_push_grads,
                                       build_push_grads_extended,
+                                      gather_slab_rows,
                                       pull_sparse, pull_sparse_extended,
                                       pull_view_from_rows)
 from paddlebox_tpu.utils.timer import Timer
@@ -322,7 +323,7 @@ def check_expand_config(model, layout: ValueLayout, use_expand: bool) -> None:
 
 def resolve_push_write(capacity: Optional[int] = None,
                        batch_keys: Optional[int] = None) -> str:
-    """'scatter' | 'rebuild' from the push_write flag.
+    """'scatter' | 'rebuild' | 'blocked' from the push_write flag.
 
     Measured regimes (tools/tpu_probe.py + tools/capacity_probe.py,
     ms/step at the bench batch; BASELINE.md round-5 rows):
@@ -341,6 +342,15 @@ def resolve_push_write(capacity: Optional[int] = None,
       non-donated probe harness paying an output-copy per call —
       BASELINE.md round-5 "probe-harness corrections".) 'auto' selects it
       beyond the rebuild regime, and ALWAYS on CPU.
+    * blocked — round 11: bucketize the sorted uid vector into
+      contiguous row blocks of push_block_rows and place each touched
+      block with ONE dynamic_update_slice (optionally the Mosaic kernel,
+      push_blocked_pallas). Cost ~ min(touched_blocks)·block bytes of
+      sequential tile traffic — between scatter and rebuild. NOT yet an
+      auto candidate: the CPU push_ladder (bench.py, BASELINE.md round
+      11) has scatter ahead, and no tunnel window has recorded the
+      TPU crossover — auto adopts it only once a measured regime exists
+      (same bar 'log' failed in round 5 and was deleted for in round 8).
 
     The round-5 'log' mode (DUS append + amortized merge) never earned an
     auto regime — scatter matched or beat it everywhere that mattered —
@@ -369,6 +379,18 @@ def resolve_push_write(capacity: Optional[int] = None,
         if capacity and batch_keys and capacity > 16 * batch_keys:
             return "scatter"
         return "rebuild"
+    if mode == "blocked":
+        block = int(flags.get_flag("push_block_rows"))
+        if block <= 0:
+            raise ValueError(
+                f"push_write=blocked needs push_block_rows > 0, got {block}")
+        if capacity and capacity % block:
+            # a clamped partial tail block would silently shift its rows'
+            # local offsets — refuse at resolve time, not deep in the jit
+            raise ValueError(
+                f"push_write=blocked: push_block_rows={block} must divide "
+                f"the table's pass capacity {capacity}")
+        return mode
     if mode not in ("scatter", "rebuild"):
         hint = (" — 'log' was deleted in round 8 (findings: BASELINE.md "
                 "round 5)" if mode == "log" else "")
@@ -613,7 +635,7 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         ids = batch["ids"]
         if use_expand:
             return pull_sparse_extended(state, ids, layout), None
-        rows = state[ids]
+        rows = gather_slab_rows(state, ids, layout)
         return pull_view_from_rows(rows, layout), rows
 
     def _sparse_push(slab, demb, batch, sub, pulled_rows=None):
@@ -682,7 +704,10 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
                                        pulled_rows=rows, first_idx=fi)
         return push_sparse_hostdedup(slab, uids, batch["perm"], batch["inv"],
                                      push_grads, sub, layout, conf,
-                                     pulled_rows=rows, first_idx=fi)
+                                     pulled_rows=rows, first_idx=fi,
+                                     write=("blocked"
+                                            if uid_write == "blocked"
+                                            else "scatter"))
 
     # The slab is DONATED into the step: at production pass capacities the
     # slab is hundreds of MB and the pass holds exactly one live copy, so
@@ -739,7 +764,7 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
             prng, sub = jax.random.split(prng)
             K = stacked["ids"].shape[1]
             ids_flat = stacked["ids"].reshape(C * K)
-            rows = slab[ids_flat]
+            rows = gather_slab_rows(slab, ids_flat, layout)
             valid_flat = ids_flat != padding_id
             seg_dtype = stacked["segments"].dtype
             seg_flat = (stacked["segments"]
@@ -812,7 +837,9 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
                 slab = push_sparse_hostdedup(
                     slab, cpush["uids"], cpush["perm"], cpush["inv"],
                     push_grads, sub, layout, conf,
-                    pulled_rows=rows, first_idx=cpush["first"])
+                    pulled_rows=rows, first_idx=cpush["first"],
+                    write=("blocked" if uid_write == "blocked"
+                           else "scatter"))
             return slab, params, opt_state, losses, preds, prng
 
     @functools.partial(jax.jit, donate_argnums=(0,))
@@ -1033,7 +1060,9 @@ class BoxTrainer:
                 cpush = {}
                 self._stage_uid_wire(cpush, ids_flat)
             else:
-                uids, perm, inv = dedup_ids(ids_flat, self.table.capacity)
+                uids, perm, inv = dedup_ids(
+                    ids_flat, self.table.capacity,
+                    sort=self._push_write == "blocked")
                 cpush = {"uids": uids, "perm": perm, "inv": inv,
                          "first": first_occurrence_idx(perm, inv)}
                 if self._push_write == "rebuild":
@@ -1114,7 +1143,10 @@ class BoxTrainer:
             # train batches carry the host-precomputed push dedup (uids
             # included: rebuilding them on device is a scatter); eval
             # batches never push, so skip the dedup + extra transfers
-            uids, perm, inv = self.table.dedup_for_push(ids)
+            # blocked write: the device bucketize trusts SORTED uids, so
+            # the staging pins the sorted dedup tier (see dedup_ids)
+            uids, perm, inv = self.table.dedup_for_push(
+                ids, sort=self._push_write == "blocked")
             out.update(perm=perm, inv=inv, uids=uids)
             if not getattr(self.model, "use_expand", False):
                 # pull-row reuse index — the expand path pulls a dual view
@@ -1161,15 +1193,23 @@ class BoxTrainer:
         self._push_write = resolve_push_write(
             capacity=self.table.capacity,
             batch_keys=self.feed.key_capacity())
-        if (flags.get_flag("h2d_lean") and flags.get_flag("h2d_uid_wire")
-                and self._push_write != self.fns.uid_write):
-            # the uid wire derives its slab-write strategy ON DEVICE, so
-            # it is baked into the jitted step at construction — a live
-            # push_write flip cannot retarget it silently
+        if self._push_write != self.fns.uid_write and (
+                (flags.get_flag("h2d_lean")
+                 and flags.get_flag("h2d_uid_wire"))
+                or "blocked" in (self._push_write, self.fns.uid_write)):
+            # the uid wire derives its slab-write strategy ON DEVICE, and
+            # the full wire bakes blocked-vs-scatter into the jitted step
+            # too (round 11) — a live push_write flip cannot retarget
+            # either silently. Worse than silent: a flip OFF 'blocked'
+            # stops the staging sort (dedup_ids sort=False → native hash
+            # order) while the baked step still runs the blocked
+            # bucketize, which silently drops rows (the round-11
+            # sortedness hazard). Full-wire scatter<->rebuild stays live-
+            # retargetable: the push_pos dict structure retraces the step.
             raise ValueError(
-                "push_write resolved to %r but the uid-wire step was "
+                "push_write resolved to %r but the jitted step was "
                 "built with %r — construct a fresh trainer to change the "
-                "uid-wire write strategy"
+                "write strategy"
                 % (self._push_write, self.fns.uid_write))
         if (flags.get_flag("profile_per_op") and not preloaded
                 and not self.multi_task and self.async_table is None):
@@ -1339,7 +1379,7 @@ class BoxTrainer:
             def stage_pull(slab, ids):
                 # mirrors the fused step's _pull: keep the full rows so the
                 # push stage reuses them exactly like the fused path does
-                rows = slab[ids]
+                rows = gather_slab_rows(slab, ids, layout)
                 return pull_view_from_rows(rows, layout), rows
 
             @jax.jit

@@ -558,3 +558,49 @@ def test_dedup_uids_sorted_contract_all_paths(data):
         finally:
             for m in meshes:
                 m.close()
+
+def test_rt_dedup_sorted_native_matches_numpy_oracle():
+    """Round-11 satellite: the native rt_dedup_sorted fast path (presence
+    mark + radix sort over uniques) must return EXACTLY the numpy tier's
+    product — sorted uniques + pad_base+i tail — on every accepted shape,
+    and must DECLINE (numpy fallback, still correct) low-duplication
+    shapes where it measured slower. Skips when the native lib is absent
+    (the wrapper is then the numpy tier by construction)."""
+    import unittest.mock as mock
+
+    from paddlebox_tpu.embedding.pass_table import dedup_uids_sorted
+    from paddlebox_tpu.native.build import get_lib
+
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "rt_dedup_sorted"):
+        pytest.skip("native lib with rt_dedup_sorted not available")
+
+    def numpy_tier(ids, pad_base):
+        with mock.patch("paddlebox_tpu.native.build.get_lib",
+                        return_value=None):
+            return dedup_uids_sorted(ids, pad_base)
+
+    rng = np.random.RandomState(17)
+    shapes = [
+        (1024, 64),     # heavy duplication — the accepted regime
+        (1024, 512),    # boundary: pad_base == K/2, still accepted
+        (1024, 600),    # declined (pad_base > K/2) — numpy fallback
+        (64, 1),        # single unique value
+        (256, 8),
+    ]
+    for K, space in shapes:
+        ids = rng.randint(0, space, K).astype(np.int32)
+        got = dedup_uids_sorted(ids, space)
+        ref = numpy_tier(ids, space)
+        np.testing.assert_array_equal(got, ref, err_msg=f"K={K} {space}")
+        _assert_strictly_ascending(got, f"rt_dedup_sorted K={K} {space}")
+    # out-of-contract ids (>= pad_base) on an otherwise-accepted shape:
+    # the native tier must DECLINE (its presence table is exactly
+    # pad_base bytes — marking past it is a heap overwrite) and the
+    # wrapper degrade to the numpy tier's well-defined product
+    ids = rng.randint(0, 64, 1024).astype(np.int32)
+    ids[7] = 100  # would index 36 bytes past the presence table
+    np.testing.assert_array_equal(dedup_uids_sorted(ids, 64),
+                                  numpy_tier(ids, 64))
+    # empty batch: no native call, trivially sorted-empty
+    assert dedup_uids_sorted(np.empty(0, np.int32), 16).size == 0

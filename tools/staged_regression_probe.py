@@ -57,6 +57,14 @@ FLOORS = {
     # the multi-process ladder in tools/hostplane_probe.py recorded
     # store=229.6ms vs p2p=36.4ms at the same shape this round
     "p2p_exchange_keys_per_sec": (30.1e6, 12e6),
+    # round-11: the uid-wire push kernel (merge + in-table optimize +
+    # slab write) at both write strategies, donated 1M-row slab, dup~8
+    # batch — guards the blocked-scatter path between tunnel windows.
+    # Recorded under the round-10 load guard on 2026-08-03 (CPU tier;
+    # scatter leads blocked HERE — the blocked win is a TPU-regime
+    # claim, BASELINE.md round 11); floors = ~40% of recorded
+    "push_scatter_keys_per_sec": (983e3, 390e3),
+    "push_blocked_keys_per_sec": (845e3, 340e3),
 }
 
 RETRIES = 2          # extra isolated re-measures before a floor may fail
@@ -300,12 +308,60 @@ def section_e2e(rng, K):
         _flags.set_flag("h2d_lean", False)
 
 
+def section_push(rng, K):
+    # --- device push-write kernels (round 11) ------------------------
+    # the uid-wire push at both write strategies, donated slab threaded
+    # through like the train step: keys/s of the merge+optimize+write
+    # kernel alone. Guards the blocked-scatter path between tunnel
+    # windows; recorded on THIS container's CPU tier (the TPU ladder
+    # lives in BASELINE.md round 11).
+    import functools
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.config.configs import SparseOptimizerConfig
+    from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
+    from paddlebox_tpu.embedding.optimizers import push_sparse_uidwire
+    from paddlebox_tpu.embedding.pass_table import dedup_uids_sorted
+
+    cap = 1 << 20
+    layout = ValueLayout(8, "adagrad")
+    conf = SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                 mf_initial_range=1e-3)
+    push = PushLayout(8)
+    ids = rng.randint(0, cap // 8, K).astype(np.int32)   # dup ~8: the
+    uids = dedup_uids_sorted(ids, cap)                   # uid-wire shape
+    grads = rng.rand(K, push.width).astype(np.float32)
+    grads[:, push.SHOW] = 1.0
+    prng = jax.random.PRNGKey(0)
+    uids_j, ids_j, grads_j = (jnp.asarray(uids), jnp.asarray(ids),
+                              jnp.asarray(grads))
+    for write, stage in (("scatter", "push_scatter_keys_per_sec"),
+                         ("blocked", "push_blocked_keys_per_sec")):
+        step = jax.jit(functools.partial(push_sparse_uidwire,
+                                         layout=layout, conf=conf,
+                                         write=write),
+                       donate_argnums=(0,))
+        state = [jnp.zeros((cap, layout.width), jnp.float32)]
+
+        def one():
+            state[0] = jax.block_until_ready(
+                step(state[0], uids_j, ids_j, grads_j, prng))
+
+        measure = lambda: timed_rate(one, K, secs=3.0)  # noqa: E731
+        report(stage, measure(), remeasure=measure)
+        state[0] = None
+
+
 SECTIONS = (
     ("native", section_native),
     ("bucketize", section_bucketize),
     ("p2p", section_p2p),
     ("parse", section_parse),
     ("e2e", section_e2e),
+    ("push", section_push),
 )
 
 
