@@ -297,6 +297,45 @@ define_flag("obs_watchdog_action", "dump",
             "(fires once per silence window), 'raise' = also interrupt "
             "the main thread (KeyboardInterrupt) so a wedged job dies "
             "loudly instead of burning its reservation")
+define_flag("serving_cache_rows", 65536,
+            "hot-key embedding cache capacity per serving process in "
+            "ROWS (serving/cache.py): the hottest rows live in one "
+            "resident [rows, dim] f32 array in front of the mmap'd "
+            "view stack, with frequency-gated admission and CLOCK "
+            "eviction (HierarchicalKV's cache-semantics model). Memory "
+            "= rows * dim * 4 bytes + ~100 B/row bookkeeping. 0 = no "
+            "cache (every pull probes the mmap store)")
+define_flag("serving_cache_admit", 2,
+            "admission threshold for the serving hot-key cache: a "
+            "missed key enters the cache only after this many misses "
+            "within the admission sketch's aging window (TinyLFU-style "
+            "scan resistance — a one-shot sweep over cold keys cannot "
+            "flush the hot set). 1 = admit on first miss")
+define_flag("serving_refresh_secs", 0.5,
+            "delta-refresh poll cadence in seconds (serving/refresh."
+            "py): the watcher re-discovers completed xbox views "
+            "(SaveDelta/SaveBase DONE markers) on this interval and "
+            "atomically swaps a freshly-composed view generation in — "
+            "the serving-side bound on model staleness is this poll "
+            "plus the new views' compile time. <=0 still polls at the "
+            "0.05s floor")
+define_flag("serving_pull_threads", 4,
+            "bounded lookup pool per serving process (serving/server."
+            "py): every pull RPC executes on one of these workers "
+            "regardless of how many connections are open, so overload "
+            "degrades by queueing (visible in the latency histogram) "
+            "instead of by thrashing the box")
+define_flag("serving_drain_secs", 10.0,
+            "graceful-drain bound in seconds: at shutdown a serving "
+            "process refuses new pulls and waits up to this long for "
+            "in-flight pulls to finish before the transport stops")
+define_flag("serving_report_requests", 200,
+            "StepReport cadence for the serving plane, in pull "
+            "REQUESTS (the serving step unit): every N pulls the "
+            "process emits one obs window record — p50/p99 lookup "
+            "latency from the serving_lookup_us histogram, keys/s, "
+            "request count, cache hit rate — through the standard "
+            "obs_report_path sink. <=0 = reporting off")
 define_flag("preload_promote", True,
             "overlap the NEXT pass's host-side promote work (key diff + "
             "host-store reads for non-resident keys) with the current "

@@ -16,6 +16,21 @@
 //    generation-tagged scratch table (no per-call memset), first-occurrence
 //    bucket slot assignment, overflow drop.
 //
+// THREAD CONTRACT (round 12): the pass index is probe-only after
+// rt_index_create, and the per-batch dedup scratch is THREAD-LOCAL — so
+// any number of threads may rt_bucketize/rt_lookup on ONE index
+// concurrently (the sharded stager pool does exactly that, W workers per
+// step). The scratch used to live in RouteIndex; concurrent callers could
+// then draw the same generation and read each other's seen-marks, silently
+// mis-routing an occurrence of a key both batches carried — the PR-6
+// 6/780-elements show-off-by-one flake (reproduced + pinned by
+// tools/sharded_stress_probe.py's concurrent-parity leg, BASELINE.md
+// round 12). Cost of the fix: one scratch table per ROUTING THREAD
+// (~20 B per next_pow2(2K) slots, e.g. ~5 MB/thread at K=128k) instead of
+// one per index. rt_index_create itself must still finish before the
+// first concurrent consumer — the pass-cadence callers already guarantee
+// that.
+//
 // C ABI for ctypes; caller owns the numpy buffers, the index owns its own.
 
 #include <cstdint>
@@ -40,7 +55,8 @@ inline uint64_t next_pow2(uint64_t v) {
 }
 
 struct RouteIndex {
-  // pass map: key -> local id (position in its shard's sorted key list)
+  // pass map: key -> local id (position in its shard's sorted key list).
+  // PROBE-ONLY after rt_index_create — safely shared across threads.
   uint64_t cap = 0, mask = 0;
   uint64_t* keys = nullptr;
   int32_t* pos = nullptr;
@@ -48,22 +64,34 @@ struct RouteIndex {
   // sentinel — tracked out-of-band
   bool has_max_key = false;
   int32_t max_key_pos = 0;
-  // batch-dedup scratch, generation-tagged so calls skip the memset
+
+  ~RouteIndex() {
+    free(keys);
+    free(pos);
+  }
+};
+
+// Per-THREAD batch-dedup scratch, generation-tagged so calls skip the
+// memset. Thread-local (NOT per-index): concurrent rt_bucketize callers
+// on one index never share seen-marks or the generation counter — the
+// cross-thread mis-route class this replaces is described in the file
+// header. Shared across indexes on one thread, which is safe: every call
+// bumps the thread's generation, so marks from any earlier call (either
+// index) read as stale.
+struct BucketScratch {
   uint64_t scap = 0, smask = 0;
   uint64_t* skeys = nullptr;
   int64_t* sslot = nullptr;
   uint32_t* sgen = nullptr;
   uint32_t gen = 0;
 
-  ~RouteIndex() {
-    free(keys);
-    free(pos);
+  ~BucketScratch() {
     free(skeys);
     free(sslot);
     free(sgen);
   }
 
-  bool ensure_scratch(uint64_t want) {
+  bool ensure(uint64_t want) {
     if (scap >= want) return true;
     free(skeys);
     free(sslot);
@@ -90,6 +118,8 @@ struct RouteIndex {
     return true;
   }
 };
+
+thread_local BucketScratch tls_scratch;
 
 }  // namespace
 
@@ -139,15 +169,25 @@ int64_t rt_bucketize(void* index, const uint64_t* keys, uint8_t* valid,
                      int32_t* buckets, int32_t* restore,
                      uint64_t* missing_out) {
   RouteIndex* ix = static_cast<RouteIndex*>(index);
-  if (!ix->ensure_scratch(next_pow2(static_cast<uint64_t>(K) * 2 + 8))) {
+  BucketScratch& sc = tls_scratch;
+  if (!sc.ensure(next_pow2(static_cast<uint64_t>(K) * 2 + 8))) {
     *missing_out = 0;
     return -2;
   }
-  uint32_t gen = ++ix->gen;
+  uint32_t gen = ++sc.gen;
   if (gen == 0) {  // wrapped: hard reset
-    memset(ix->sgen, 0, ix->scap * 4);
-    gen = ix->gen = 1;
+    memset(sc.sgen, 0, sc.scap * 4);
+    gen = sc.gen = 1;
   }
+  // hoist the scratch fields: accesses through the TLS reference make
+  // the compiler re-load them around every store (a uint32 store into
+  // sgen[] could alias sc.gen through the TLS block) — locals keep the
+  // hot loop's pointers in registers (measured ~26% of the whole
+  // routing rate on this container's g++)
+  const uint64_t smask = sc.smask;
+  uint64_t* const skeys = sc.skeys;
+  int64_t* const sslot = sc.sslot;
+  uint32_t* const sgen = sc.sgen;
 
   int64_t* fill = static_cast<int64_t*>(calloc(P, sizeof(int64_t)));
   if (!fill) {
@@ -161,10 +201,10 @@ int64_t rt_bucketize(void* index, const uint64_t* keys, uint8_t* valid,
     if (!valid[i]) continue;
     uint64_t k = keys[i];
     uint64_t hs = mix64(k);
-    uint64_t h = hs & ix->smask;
-    while (ix->sgen[h] == gen && ix->skeys[h] != k) h = (h + 1) & ix->smask;
-    if (ix->sgen[h] == gen) {  // seen earlier in this batch
-      int64_t slot = ix->sslot[h];
+    uint64_t h = hs & smask;
+    while (sgen[h] == gen && skeys[h] != k) h = (h + 1) & smask;
+    if (sgen[h] == gen) {  // seen earlier in this batch
+      int64_t slot = sslot[h];
       if (slot < 0) {  // that occurrence overflowed
         ++overflow;
         valid[i] = 0;
@@ -205,9 +245,9 @@ int64_t rt_bucketize(void* index, const uint64_t* keys, uint8_t* valid,
       slot = static_cast<int64_t>(s) * KB + j;
       restore[i] = static_cast<int32_t>(slot);
     }
-    ix->sgen[h] = gen;
-    ix->skeys[h] = k;
-    ix->sslot[h] = slot;
+    sgen[h] = gen;
+    skeys[h] = k;
+    sslot[h] = slot;
   }
   free(fill);
   return overflow;

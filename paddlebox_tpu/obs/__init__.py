@@ -19,7 +19,10 @@ platform/monitor.h + timer discipline + chrometracing profiler did
                    in library code (obs/log.py; boxlint BX501 enforces)
 
 Import surface is deliberately jax-free: every hot-path hook (span,
-beat) must stay importable and near-free on any host.
+beat) must stay importable and near-free on any host — the serving
+plane (serving/, round 12) runs this whole stack in jax-free replica
+processes (per-pull latency histograms, QPS windows, cache-rate extras
+ride the same StepReport/sink/aggregation machinery unchanged).
 """
 
 from paddlebox_tpu.obs import log  # noqa: F401

@@ -89,9 +89,12 @@ class StepReporter:
     """Assembles StepReports at a step cadence from the process-global
     StatRegistry + the caller's stage timers.
 
-    Thread contract: note_examples/maybe_report come from the ONE pass
-    driver thread (the same thread that owns the timers); peek() may be
-    called from the watchdog thread (it only reads last_report).
+    Thread contract: note_examples/maybe_report come from ONE driver
+    thread at a time — the pass driver in trainers (the thread that owns
+    the timers), or any pool/conn thread in the serving plane provided
+    the caller serializes (ServingServer holds its _report_lock around
+    note+report); peek() may be called from the watchdog thread (it
+    only reads last_report).
     """
 
     def __init__(self, rank: int = 0, every: Optional[int] = None,

@@ -1,0 +1,40 @@
+"""serving: the online inference tier (round 12).
+
+The "millions of users" half of the north star — the consumer side of
+the SaveBase/SaveDelta xbox cadence (box_wrapper.cc:1286-1318), grown
+from the serve_xbox.py demo into a real low-latency plane:
+
+  * store    — mmap columnar views + the base+delta precedence stack
+               (bit-parity with the XboxModelReader oracle, no RAM
+               ingest; N processes share page cache)
+  * cache    — hot-key rows in front of the mmap store: frequency-gated
+               admission + CLOCK eviction (HierarchicalKV's
+               cache-semantics store is the model, PAPERS.md)
+  * codec    — plain-container pull wire (no pickle class resolution on
+               the serving port)
+  * server   — batched pull RPCs on the framed transport, bounded pull
+               pool, graceful drain, StepReport obs (p50/p99 lookup
+               latency, keys/s, cache hit rate)
+  * refresh  — SaveDelta watcher: poll → compile → atomic generation
+               swap, in-flight requests never dropped
+  * client   — round-robin replica failover pulls
+  * fleet    — N spawned replica processes per box
+
+Import surface is deliberately jax-free (numpy + stdlib + the native
+.so): a serving process must spawn in milliseconds and never pay for —
+or inherit — an accelerator runtime.
+"""
+
+from paddlebox_tpu.serving.cache import HotKeyCache  # noqa: F401
+from paddlebox_tpu.serving.client import ServingClient  # noqa: F401
+from paddlebox_tpu.serving.codec import (decode_rows,  # noqa: F401
+                                         encode_pull)
+from paddlebox_tpu.serving.fleet import ServingFleet  # noqa: F401
+from paddlebox_tpu.serving.refresh import (DeltaRefreshWatcher,  # noqa: F401
+                                           ViewManager, make_manager)
+from paddlebox_tpu.serving.server import ServingServer  # noqa: F401
+from paddlebox_tpu.serving.store import (MmapViewStack,  # noqa: F401
+                                         MmapXboxStore, build_stack,
+                                         compile_view_dir,
+                                         discover_xbox_sources,
+                                         write_xbox_columnar)

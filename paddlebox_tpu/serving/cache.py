@@ -1,0 +1,197 @@
+"""Hot-key embedding cache in front of the mmap view stack.
+
+The serving key distribution is zipf-hot (the reference's serving loader
+keeps exactly such a cache; HierarchicalKV in PAPERS.md is the
+cache-semantics store this models): a small resident array of the
+hottest rows absorbs most probes before they touch the mmap'd row
+matrix. Two mechanisms, both deliberately scan-resistant:
+
+  * admission is FREQUENCY-GATED (TinyLFU-style): a missed key is only
+    admitted after it has missed ``admit`` times within the sketch's
+    aging window — a one-shot scan over millions of cold keys cannot
+    flush the hot set (the S3-FIFO insight: most keys are seen once).
+    The sketch is a bounded dict aged by halving (counts decay, memory
+    stays O(sketch_cap)).
+  * eviction is CLOCK (second chance): every hit sets the slot's ref
+    bit; the hand sweeps slots, clearing ref bits, and evicts the first
+    slot found unreferenced — an O(1)-amortized LRU approximation with
+    no per-hit bookkeeping beyond one bool store.
+
+Generation safety: entries are only valid for ONE view generation. The
+view manager bumps ``epoch`` at every delta swap (clear()); inserts
+carry the generation they were read under and are DROPPED on mismatch,
+so a lookup that raced a swap can never plant a stale vector in the new
+generation's cache (tests/test_serving.py pins this).
+
+Counters ride the process StatRegistry so StepReports and cluster
+aggregation see them with zero extra wiring: ``serving_cache_hit`` /
+``serving_cache_miss`` / ``serving_cache_evict`` / ``serving_cache_admit``
+(+ the ``serving_cache_fill`` gauge).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from paddlebox_tpu.utils.stats import gauge_set, stat_add
+
+
+class HotKeyCache:
+    """Fixed-capacity key→row cache (frequency-gated admission, CLOCK
+    eviction). Thread-safe: the serving pool's worker threads share one
+    instance under ``_lock``; the arrays are sized once at construction
+    (capacity rows × dim floats — the only RAM the cache ever holds)."""
+
+    def __init__(self, capacity: int, dim: int, admit: int = 2,
+                 sketch_cap: Optional[int] = None) -> None:
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = int(capacity)
+        self.dim = int(dim)
+        self.admit = max(1, int(admit))
+        self._sketch_cap = int(sketch_cap or max(1024, 4 * capacity))
+        self._lock = threading.Lock()
+        self._slot_of: Dict[int, int] = {}  # guarded-by: _lock
+        self._keys = np.zeros(capacity, np.uint64)  # guarded-by: _lock
+        self._rows = np.zeros((capacity, dim), np.float32)  # guarded-by: _lock
+        self._ref = np.zeros(capacity, bool)  # guarded-by: _lock
+        self._used = 0  # guarded-by: _lock
+        self._hand = 0  # guarded-by: _lock
+        self._freq: Dict[int, int] = {}  # guarded-by: _lock
+        self._epoch = 0  # guarded-by: _lock
+
+    # ------------------------------------------------------------ lookups
+    def get_many(self, keys: np.ndarray, out: np.ndarray,
+                 epoch: Optional[int] = None) -> np.ndarray:
+        """Probe the cache for [K] uint64 keys, filling hit rows of
+        ``out`` [K, dim] in place. Returns the miss mask.
+
+        ``epoch``: the generation tag grabbed atomically WITH the view
+        stack the caller will read misses from (ViewManager._grab). On
+        mismatch the whole probe reports all-miss: a swap landed after
+        the grab, and mixing the new generation's cache hits with the
+        old grabbed stack's reads would hand one response rows from two
+        model generations. None = skip the check (single-generation
+        callers)."""
+        miss = np.ones(keys.size, bool)
+        if not keys.size:
+            return miss
+        with self._lock:
+            if epoch is not None and epoch != self._epoch:
+                stat_add("serving_cache_miss", int(keys.size))
+                return miss
+            slot_of = self._slot_of
+            hit_idx = []
+            hit_slots = []
+            for i, k in enumerate(keys.tolist()):
+                s = slot_of.get(k)
+                if s is not None:
+                    hit_idx.append(i)
+                    hit_slots.append(s)
+            if hit_idx:
+                idx = np.asarray(hit_idx, np.int64)
+                slots = np.asarray(hit_slots, np.int64)
+                out[idx] = self._rows[slots]
+                self._ref[slots] = True      # CLOCK second chance
+                miss[idx] = False
+        nhit = keys.size - int(miss.sum())
+        if nhit:
+            stat_add("serving_cache_hit", nhit)
+        if nhit != keys.size:
+            stat_add("serving_cache_miss", keys.size - nhit)
+        return miss
+
+    # ------------------------------------------------------------- inserts
+    def admit_many(self, keys: np.ndarray, rows: np.ndarray,
+                   epoch: int) -> int:
+        """Offer missed keys (+ their freshly-read rows) for admission.
+        Keys whose sketch frequency reaches the admission threshold are
+        inserted (CLOCK-evicting on a full cache); the rest only bump
+        the sketch. ``epoch`` is the view generation the rows were READ
+        under (ViewManager grabs gen+stack atomically; clear() keeps
+        cache epoch == live gen): on mismatch the whole offer drops — a
+        view swap landed after the read and the rows are stale.
+        Returns admitted count."""
+        if not keys.size:
+            return 0
+        admitted = 0
+        evicted = 0
+        with self._lock:
+            if epoch != self._epoch:
+                return 0                    # raced a swap: rows are stale
+            if len(self._freq) > self._sketch_cap:
+                # age by halving: frequencies decay, zeros drop, memory
+                # stays bounded (the TinyLFU reset)
+                self._freq = {k: c >> 1 for k, c in self._freq.items()
+                              if c >> 1}
+            freq = self._freq
+            for i, k in enumerate(keys.tolist()):
+                if k in self._slot_of:
+                    continue                # another thread admitted it
+                c = freq.get(k, 0) + 1
+                if c < self.admit:
+                    freq[k] = c
+                    continue
+                freq.pop(k, None)
+                if self._used < self.capacity:
+                    s = self._used
+                    self._used += 1
+                else:
+                    s = self._clock_evict()
+                    self._slot_of.pop(int(self._keys[s]), None)
+                    evicted += 1
+                self._keys[s] = k
+                self._rows[s] = rows[i]
+                self._ref[s] = False
+                self._slot_of[k] = s
+                admitted += 1
+            fill = self._used
+        if admitted:
+            stat_add("serving_cache_admit", admitted)
+        if evicted:
+            stat_add("serving_cache_evict", evicted)
+        gauge_set("serving_cache_fill", fill / self.capacity)
+        return admitted
+
+    def _clock_evict(self) -> int:  # boxlint: disable=BX401 (caller holds _lock)
+        """Advance the hand to the first unreferenced slot (clearing ref
+        bits on the way) and return it as the victim. Bounded by 2
+        sweeps: after one full sweep every ref bit is clear. ONLY called
+        from admit_many with ``_lock`` already held."""
+        ref = self._ref
+        n = self.capacity
+        h = self._hand
+        for _ in range(2 * n):
+            if not ref[h]:
+                break
+            ref[h] = False
+            h = (h + 1) % n
+        self._hand = (h + 1) % n
+        return h
+
+    # ----------------------------------------------------------- lifecycle
+    def clear(self) -> int:
+        """Drop every entry and bump the generation epoch (called by the
+        view manager at delta swap: cached vectors may have changed).
+        Returns the new epoch. The admission sketch survives — key
+        hotness is a property of the traffic, not the view."""
+        with self._lock:
+            self._slot_of.clear()
+            self._ref[:] = False
+            self._used = 0
+            self._hand = 0
+            self._epoch += 1
+            gauge_set("serving_cache_fill", 0.0)
+            return self._epoch
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._slot_of)

@@ -1,0 +1,129 @@
+"""Serving client: batched pulls against a fleet of replicas.
+
+Every replica serves the FULL composed view (they mmap the same store
+files — page cache is shared, so N processes cost one copy of the row
+bytes), which makes the client trivially stateless: pick a replica
+round-robin per pull, fail over to the next on a transport error.
+Class resolution never happens on the response path either — the
+client unpickles with ``plain_loads`` too, so a compromised or
+misconfigured server can't hand the client a class-bearing payload.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.serving import codec
+from paddlebox_tpu.utils.rpc import FramedClient, plain_loads
+
+
+class ServingClient:
+    """Thread-safe: pulls may come from many caller threads; each
+    underlying FramedClient serializes its own connection, and replica
+    selection rides one counter lock."""
+
+    def __init__(self, endpoints: Sequence[Tuple[str, int]],
+                 timeout: float = 30.0) -> None:
+        if not endpoints:
+            raise ValueError("need at least one endpoint")
+        self.endpoints = [(h, int(p)) for h, p in endpoints]
+        self._timeout = float(timeout)
+        self._lock = threading.Lock()
+        self._clients: List = [None] * len(self.endpoints)  # guarded-by: _lock
+        self._rr = 0  # guarded-by: _lock
+        self.last_gen = -1  # guarded-by: _lock
+
+    def _client_at(self, i: int) -> FramedClient:
+        with self._lock:
+            c = self._clients[i]
+        if c is None:
+            # dial OUTSIDE the lock: a blackholed replica blocks this
+            # dial for up to the connect timeout, and holding the lock
+            # through it would freeze every other caller thread's pulls
+            # toward healthy replicas — the opposite of failover
+            h, p = self.endpoints[i]
+            c = FramedClient(h, p, loads=plain_loads,
+                             timeout=self._timeout)
+            with self._lock:
+                if self._clients[i] is None:
+                    self._clients[i] = c
+                else:           # another thread won the dial race
+                    c.close()
+                    c = self._clients[i]
+        return c
+
+    def _drop_client(self, i: int) -> None:
+        with self._lock:
+            c, self._clients[i] = self._clients[i], None
+        if c is not None:
+            c.close()
+
+    def _pick(self) -> int:
+        with self._lock:
+            i = self._rr % len(self.endpoints)
+            self._rr += 1
+        return i
+
+    # -------------------------------------------------------------- pulls
+    def pull(self, keys: np.ndarray) -> np.ndarray:
+        """[K] uint64 feasigns → [K, dim] float32 embedding rows.
+        Tries every replica once (round-robin start) before giving up;
+        a draining replica or a dead connection fails over."""
+        req = codec.encode_pull(keys)
+        start = self._pick()
+        n = len(self.endpoints)
+        last_err: Exception = RuntimeError("no endpoints")
+        for k in range(n):
+            i = (start + k) % n
+            try:
+                resp = self._client_at(i).call(req)
+            except OSError as e:
+                # dead replica in ANY flavor — refused, dial timeout,
+                # no-route (TimeoutError/EHOSTUNREACH are OSErrors but
+                # not ConnectionErrors), or a mid-call transport failure
+                # (FramedClient wraps those to ConnectionError ⊂
+                # OSError): drop the conn and fail over to a sibling
+                self._drop_client(i)
+                last_err = e
+                continue
+            except RuntimeError as e:
+                # server-side refusal (draining) is retryable on a
+                # sibling; anything else is a real error
+                if "draining" in str(e):
+                    last_err = e
+                    continue
+                raise
+            with self._lock:
+                self.last_gen = int(resp.get("gen", -1))
+            return codec.decode_rows(resp)
+        raise ConnectionError(
+            f"all {n} serving replicas failed") from last_err
+
+    # ------------------------------------------------------------ control
+    def _call_at(self, i: int, req: Dict[str, Any]) -> Any:
+        return self._client_at(i).call(req)
+
+    def ping(self, i: int = 0) -> Dict[str, Any]:
+        return self._call_at(i, {"method": "ping"})
+
+    def stats(self, i: int = 0) -> Dict[str, Any]:
+        return self._call_at(i, {"method": "stats"})
+
+    def drain_all(self) -> None:
+        """Ask every replica to drain (fleet shutdown)."""
+        for i in range(len(self.endpoints)):
+            try:
+                self._call_at(i, {"method": "drain"})
+            except (ConnectionError, RuntimeError):
+                pass                        # already down
+
+    def close(self) -> None:
+        with self._lock:
+            clients, self._clients = self._clients, [None] * len(
+                self.endpoints)
+        for c in clients:
+            if c is not None:
+                c.close()

@@ -1,0 +1,53 @@
+"""Serving wire codec: PLAIN CONTAINERS ONLY on the serving port.
+
+The pull RPC rides utils/rpc.py's framed transport with its
+``plain_loads`` unpickler — class resolution is refused outright, so a
+request can only be built from dict/list/bytes/str/int/float. Arrays
+therefore travel as raw little-endian bytes with explicit shape fields,
+never as pickled numpy objects: an internet-adjacent serving port must
+not run a codec whose deserializer can be steered into constructing
+arbitrary classes (the PS port's numpy-allowlisted unpickler stays
+train-cluster-internal). tests/test_serving.py pins that a
+class-bearing payload is refused with the stream intact.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+
+def encode_pull(keys: np.ndarray) -> Dict[str, Any]:
+    """[K] uint64 feasigns → pull request frame."""
+    keys = np.ascontiguousarray(np.asarray(keys, np.uint64).reshape(-1))
+    return {"method": "pull", "keys": keys.tobytes(), "n": int(keys.size)}
+
+
+def decode_pull_keys(req: Dict[str, Any]) -> np.ndarray:
+    """Server side of encode_pull, validating the frame shape loudly."""
+    raw = req.get("keys")
+    n = req.get("n")
+    if not isinstance(raw, bytes) or not isinstance(n, int) or n < 0:
+        raise ValueError("pull frame needs bytes 'keys' and int 'n'")
+    if len(raw) != 8 * n:
+        raise ValueError(
+            f"pull frame length mismatch: {len(raw)} bytes for n={n}")
+    return np.frombuffer(raw, np.uint64, count=n)
+
+
+def encode_rows(rows: np.ndarray, gen: int) -> Dict[str, Any]:
+    """[K, dim] float32 rows (+ the serving view generation they were
+    read from) → pull response frame."""
+    rows = np.ascontiguousarray(rows, np.float32)
+    return {"rows": rows.tobytes(), "n": int(rows.shape[0]),
+            "dim": int(rows.shape[1]), "gen": int(gen)}
+
+
+def decode_rows(resp: Dict[str, Any]) -> np.ndarray:
+    raw, n, dim = resp["rows"], int(resp["n"]), int(resp["dim"])
+    if len(raw) != 4 * n * dim:
+        raise ValueError(
+            f"row frame length mismatch: {len(raw)} bytes for "
+            f"n={n} dim={dim}")
+    return np.frombuffer(raw, np.float32, count=n * dim).reshape(n, dim)

@@ -1,0 +1,160 @@
+"""Serving fleet: N replica processes over one xbox store root.
+
+Each replica is a full ServingServer (own pull pool, cache, refresh
+watcher) in its own SPAWNED process — spawn, not fork: the parent may
+be a training driver with jax state and live threads, and the serving
+import surface is deliberately jax-free, so a spawned child interps up
+in milliseconds and never inherits a poisoned runtime. The replicas
+mmap the same compiled view files; the box's page cache holds the one
+copy of the row bytes all of them serve from.
+
+Shutdown is graceful end to end: the parent asks each replica to drain
+over the data port (in-flight pulls finish, new ones are refused), then
+joins the processes.
+
+This is the single-box fleet (the loader-box role). A multi-box serving
+tier is this module per box behind any TCP load balancer — the client
+already fails over between replica endpoints.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import multiprocessing as mp
+import os
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from paddlebox_tpu.serving.client import ServingClient
+
+
+@contextlib.contextmanager
+def _spawn_safe_main():
+    """Spawn re-runs the PARENT's __main__ from its file path inside
+    every child (multiprocessing.spawn._fixup_main_from_path). A driver
+    whose main isn't a real importable file — a REPL, a heredoc/stdin
+    script (__file__ == '<stdin>'), an embedded interpreter — makes
+    every child die on FileNotFoundError before reaching _serve_child.
+    The children never need the caller's main (the target is a
+    module-level function in an importable module), so while spawning
+    we hide a bogus __main__.__file__; multiprocessing then skips the
+    main re-import entirely."""
+    main = sys.modules.get("__main__")
+    mf = getattr(main, "__file__", None)
+    patch = mf is not None and not os.path.exists(mf)
+    if patch:
+        del main.__file__
+    try:
+        yield
+    finally:
+        if patch:
+            main.__file__ = mf
+
+
+def _serve_child(root: str, days: Optional[Sequence[str]],
+                 host: str, conn, flag_overrides: Dict[str, object],
+                 rank: int) -> None:
+    """Child entry (module-level for spawn picklability): build the
+    server, report the bound port, then sit until drained (the drain
+    RPC arrives over the data port)."""
+    os.environ.setdefault("PBTPU_RANK", str(rank))
+    from paddlebox_tpu.config import flags
+    for name, value in (flag_overrides or {}).items():
+        # relaying the PARENT's flag dict into the spawned child — names
+        # were registry-validated when the parent set them
+        flags.set_flag(name, value)  # boxlint: disable=BX305
+    from paddlebox_tpu.serving.server import ServingServer
+    try:
+        server = ServingServer(root, days=days, host=host)
+    except BaseException as e:
+        conn.send(("error", repr(e)))
+        raise
+    conn.send(("port", server.port))
+    # block until the server's transport stops (drain RPC / signal); the
+    # accept thread is a daemon, so wait on the drain event by polling
+    # the stopped server socket state via the drain() join below
+    try:
+        conn.recv()                  # parent closes its end at join time
+    except EOFError:
+        pass
+    server.drain()
+
+
+class ServingFleet:
+    """Spawn + address N serving replicas on this box."""
+
+    def __init__(self, xbox_model_dir: str,
+                 days: Optional[Sequence[str]] = None,
+                 processes: int = 2, host: str = "127.0.0.1",
+                 flag_overrides: Optional[Dict[str, object]] = None,
+                 start_timeout: float = 60.0) -> None:
+        if processes < 1:
+            raise ValueError("need at least one serving process")
+        ctx = mp.get_context("spawn")
+        self._procs: List = []
+        self._pipes: List = []
+        self.endpoints: List[Tuple[str, int]] = []
+        try:
+            with _spawn_safe_main():
+                for rank in range(processes):
+                    parent, child = ctx.Pipe()
+                    p = ctx.Process(
+                        target=_serve_child,
+                        args=(xbox_model_dir, list(days) if days else None,
+                              host, child, dict(flag_overrides or {}),
+                              rank),
+                        daemon=True, name=f"serving-{rank}")
+                    p.start()
+                    child.close()
+                    self._procs.append(p)
+                    self._pipes.append(parent)
+            for rank, parent in enumerate(self._pipes):
+                if not parent.poll(start_timeout):
+                    raise TimeoutError(
+                        f"serving replica {rank} did not come up in "
+                        f"{start_timeout}s")
+                try:
+                    kind, value = parent.recv()
+                except EOFError:
+                    raise RuntimeError(
+                        f"serving replica {rank} died during bring-up "
+                        "(its traceback is on stderr)") from None
+                if kind != "port":
+                    raise RuntimeError(
+                        f"serving replica {rank} failed: {value}")
+                self.endpoints.append((host, int(value)))
+        except BaseException:
+            self.close(drain=False)
+            raise
+
+    def client(self, timeout: float = 30.0) -> ServingClient:
+        return ServingClient(self.endpoints, timeout=timeout)
+
+    def close(self, drain: bool = True, join_timeout: float = 30.0) -> None:
+        """Graceful by default: drain every replica (in-flight pulls
+        finish), then join. drain=False = tear down hard (bring-up
+        failure path)."""
+        if drain and self.endpoints:
+            c = self.client(timeout=10.0)
+            try:
+                c.drain_all()
+            finally:
+                c.close()
+        for parent in self._pipes:
+            try:
+                parent.close()           # EOFs the child's wait
+            except OSError:
+                pass
+        for p in self._procs:
+            p.join(timeout=join_timeout)
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=5.0)
+        self._procs = []
+        self._pipes = []
+
+    def __enter__(self) -> "ServingFleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,218 @@
+"""Generation-swapped serving view + the SaveDelta refresh watcher.
+
+The training cadence lands new xbox views (SaveDelta every N passes,
+SaveBase at day end) while the serving fleet answers traffic; the
+reference's xbox cadence exists precisely so the serving loader can
+refresh at sub-pass latency. Here:
+
+  * ``ViewManager`` owns the CURRENT (generation, stack, cache) triple.
+    Lookups grab the triple once under the swap lock, then run entirely
+    on the grabbed objects — a concurrent swap installs a NEW stack
+    object and never mutates the old one, so in-flight requests finish
+    on the view they started on (zero dropped/blocked requests at swap;
+    the old stack is closed once the last in-flight reference drops).
+  * ``DeltaRefreshWatcher`` polls the xbox root on a flag cadence
+    (serving_refresh_secs); any change in the completed-source set —
+    a new delta DONE, a day's base landing, a new day appearing —
+    compiles the new views and atomically swaps a fresh stack in.
+    Refresh latency is therefore one poll interval + compile time of
+    the NEW views only (deltas: small).
+
+Cache coherence across swaps: the hot-key cache is cleared + epoch-
+bumped inside the swap lock, and inserts echo the epoch they read
+under, so a request racing the swap can never plant a pre-swap vector
+in the post-swap cache (serving/cache.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.obs import log
+from paddlebox_tpu.serving.cache import HotKeyCache
+from paddlebox_tpu.serving.store import MmapViewStack, build_stack
+from paddlebox_tpu.utils.stats import gauge_set, stat_add
+
+
+class ViewManager:
+    """The swap point between refresh and traffic.
+
+    Outgoing-stack lifetime is REFCOUNT-based, not swap-count-based:
+    swap() only drops the manager's reference, and the stack's mmap
+    stores (each owning a native index) free through their __del__ when
+    the LAST in-flight lookup releases its local reference — a lookup
+    slow enough to straddle any number of quick swaps can never probe a
+    destroyed index (no cycles anywhere in the stack object graph, so
+    CPython refcounting frees promptly and deterministically)."""
+
+    def __init__(self, stack: MmapViewStack,
+                 cache: Optional[HotKeyCache] = None) -> None:
+        self._swap_lock = threading.Lock()
+        self.cache = cache
+        self._current: Tuple[int, MmapViewStack] = (0, stack)  # guarded-by: _swap_lock
+        # the cache's generation tag, tracked EXPLICITLY from clear()'s
+        # return — never assumed numerically equal to gen (a cache that
+        # was cleared elsewhere, or one shared across managers, would
+        # silently drop every admission forever under that assumption)
+        self._cache_epoch = cache.epoch if cache is not None else 0  # guarded-by: _swap_lock
+        gauge_set("serving_view_gen", 0)
+
+    # ------------------------------------------------------------- traffic
+    def current(self) -> Tuple[int, MmapViewStack]:
+        with self._swap_lock:
+            return self._current
+
+    def _grab(self) -> Tuple[int, MmapViewStack, int]:
+        """(gen, stack, cache_epoch) in ONE lock hold — the epoch must
+        be the one the stack was grabbed under for the stale-admission
+        guard to work."""
+        with self._swap_lock:
+            gen, stack = self._current
+            return gen, stack, self._cache_epoch
+
+    def lookup(self, keys: np.ndarray) -> Tuple[np.ndarray, int]:
+        """[K] uint64 → ([K, dim] float32, generation served). Cache in
+        front, mmap stack behind, admission offered for misses."""
+        keys = np.asarray(keys, np.uint64).reshape(-1)
+        gen, stack, epoch = self._grab()
+        cache = self.cache
+        if cache is None:
+            return stack.lookup(keys), gen
+        out = np.zeros((keys.size, stack.dim), np.float32)
+        # epoch pins the WHOLE response to the grabbed generation: a
+        # racing swap makes the probe report all-miss (reads then come
+        # from the grabbed stack only — never a two-generation mix)
+        miss = cache.get_many(keys, out, epoch=epoch)
+        if miss.any():
+            miss_idx = np.nonzero(miss)[0]
+            rows = stack.lookup(keys[miss_idx])
+            out[miss_idx] = rows
+            # epoch was grabbed WITH the stack: a swap that landed
+            # between the grab and here bumped the cache epoch and this
+            # offer drops (stale rows never enter the new gen)
+            cache.admit_many(keys[miss_idx], rows, epoch=epoch)
+        return out, gen
+
+    # ------------------------------------------------------------- refresh
+    def swap(self, stack: MmapViewStack) -> int:
+        """Install a new generation; the outgoing stack closes via
+        refcount once the last in-flight lookup drops it (see class
+        docstring). Returns the new generation."""
+        with self._swap_lock:
+            gen, _old = self._current
+            self._current = (gen + 1, stack)
+            if self.cache is not None:
+                self._cache_epoch = self.cache.clear()
+            gauge_set("serving_view_gen", gen + 1)
+        stat_add("serving_refresh_swaps")
+        return gen + 1
+
+    def close(self) -> None:
+        """Callers guarantee no lookup is in flight (ServingServer
+        drains first); the current stack closes eagerly."""
+        with self._swap_lock:
+            self._current[1].close()
+
+
+class DeltaRefreshWatcher:
+    """Daemon thread: poll → discover → compile new views → swap."""
+
+    def __init__(self, manager: ViewManager, xbox_model_dir: str,
+                 days: Optional[Sequence[str]] = None,
+                 poll_secs: Optional[float] = None,
+                 known_sources: Sequence = ()) -> None:
+        """days: explicit day list (cadence order) or None to
+        auto-discover lexically-sorted day dirs each poll (store.
+        discover_days). known_sources: the source tuple the manager's
+        initial stack was built from (build_stack returns it) so the
+        first poll doesn't immediately re-swap an identical view."""
+        if poll_secs is None:
+            from paddlebox_tpu.config import flags
+            poll_secs = float(flags.get_flag("serving_refresh_secs"))
+        self.manager = manager
+        self.root = xbox_model_dir
+        self.days = list(days) if days else None
+        self.poll_secs = max(0.05, float(poll_secs))
+        self._known = tuple(known_sources)  # watcher-thread only
+        self._err_streak = 0                # watcher-thread only
+        self._stop = threading.Event()
+        self._woke = threading.Event()   # test hook: set per poll cycle
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serving-refresh")
+
+    def start(self) -> "DeltaRefreshWatcher":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except FileNotFoundError as e:
+                # a day dir mid-write legitimately reads as missing for
+                # ONE poll (quiet); a PERSISTENT miss — e.g. retention
+                # pruned the only base day — must not freeze refresh
+                # silently forever: count every miss, warn once per
+                # streak once it is clearly not the write race
+                stat_add("serving_refresh_errors")
+                self._err_streak += 1
+                if self._err_streak == 2:
+                    log.warning("serving refresh sources missing for "
+                                "2+ polls — serving a stale generation",
+                                error=repr(e))
+            except Exception as e:
+                # refresh must never take serving down; keep the current
+                # generation and retry on cadence
+                stat_add("serving_refresh_errors")
+                self._err_streak += 1
+                log.warning("serving refresh poll failed", error=repr(e))
+            else:
+                self._err_streak = 0
+            self._woke.set()
+            self._stop.wait(self.poll_secs)
+
+    def poll_once(self) -> bool:
+        """One discovery pass; swaps and returns True when the completed
+        source set changed since the last swap."""
+        stack, sources = None, None
+        from paddlebox_tpu.serving.store import (discover_days,
+                                                 discover_xbox_sources)
+        days = self.days or discover_days(self.root)
+        if not days:
+            return False
+        sources = tuple(discover_xbox_sources(self.root, days))
+        if sources == self._known:
+            return False
+        stack = MmapViewStack(sources)     # compiles only missing views
+        self._known = sources
+        gen = self.manager.swap(stack)
+        log.info("serving view refreshed", gen=gen,
+                 sources=len(sources),
+                 newest=sources[-1].path.rsplit("/", 1)[-1])
+        return True
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+
+
+def make_manager(xbox_model_dir: str,
+                 days: Optional[Sequence[str]] = None,
+                 cache_rows: Optional[int] = None,
+                 cache_admit: Optional[int] = None
+                 ) -> Tuple[ViewManager, tuple]:
+    """Flag-configured manager over the current composed view. Returns
+    (manager, sources) — hand sources to DeltaRefreshWatcher as
+    known_sources. cache_rows 0 disables the cache."""
+    from paddlebox_tpu.config import flags
+    if cache_rows is None:
+        cache_rows = int(flags.get_flag("serving_cache_rows"))
+    if cache_admit is None:
+        cache_admit = int(flags.get_flag("serving_cache_admit"))
+    stack, sources = build_stack(xbox_model_dir, days)
+    cache = (HotKeyCache(cache_rows, stack.dim, admit=cache_admit)
+             if cache_rows > 0 else None)
+    return ViewManager(stack, cache), sources

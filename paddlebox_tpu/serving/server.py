@@ -1,0 +1,211 @@
+"""One serving process: batched pull RPCs over the framed transport.
+
+The request plane: a FramedServer accepts connections (one reader
+thread per connection — the transport's existing model) and every pull
+executes on a BOUNDED worker pool (flag serving_pull_threads), so a
+thousand idle connections cost sockets, not lookup concurrency, and
+tail latency under overload degrades by queueing instead of by
+thrashing. The serving port speaks the plain-container codec ONLY
+(serving/codec.py): class-bearing pickles are refused by the transport
+before the handler runs.
+
+Graceful drain: ``drain()`` flips the draining flag (new pulls are
+refused with a retryable error), waits for in-flight pulls to finish
+(bounded by flag serving_drain_secs), then stops the watcher, the pool
+and the transport — a fleet roll never cuts a request mid-lookup.
+
+Observability rides the PR-5 obs plane unchanged: per-pull latency into
+the shared fixed-bucket histogram (``serving_lookup_us`` → p50/p99 in
+every StepReport window), keys/s as the reporter's examples rate,
+request count + cache hit/miss/evict counters as stat deltas, and a
+``cache_hit_rate`` extra per window — so the cluster aggregator's
+min/med/max merge works on serving ranks with zero new machinery.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional, Sequence
+
+from paddlebox_tpu.obs import log, make_step_reporter
+from paddlebox_tpu.serving import codec
+from paddlebox_tpu.serving.refresh import (DeltaRefreshWatcher, ViewManager,
+                                           make_manager)
+from paddlebox_tpu.utils.rpc import FramedServer, plain_loads
+from paddlebox_tpu.utils.stats import hist_observe, stat_add, stat_get
+
+#: largest accepted request frame (keys bytes + envelope). 128 MB ≈ a
+#: 16M-key pull — far past any sane serving batch; bigger frames are a
+#: client bug or garbage on the port.
+MAX_FRAME_BYTES = 128 << 20
+
+
+class ServingServer:
+    """One process of the serving fleet."""
+
+    def __init__(self, xbox_model_dir: Optional[str] = None,
+                 days: Optional[Sequence[str]] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 manager: Optional[ViewManager] = None,
+                 watch: bool = True,
+                 pull_threads: Optional[int] = None,
+                 report_every: Optional[int] = None) -> None:
+        """Serve the composed view under ``xbox_model_dir`` (flags
+        configure cache/refresh), or a pre-built ``manager`` (probes,
+        tests — no root needed; watch is then ignored unless a root is
+        also given)."""
+        from paddlebox_tpu.config import flags
+        if manager is None:
+            if xbox_model_dir is None:
+                raise ValueError("need xbox_model_dir or manager")
+            manager, sources = make_manager(xbox_model_dir, days)
+        else:
+            # a pre-built manager knows the sources its current stack
+            # composed (empty for from_files probes): seed the watcher
+            # with them so its first poll doesn't spuriously re-swap an
+            # identical view (and clear the just-warmed cache)
+            sources = manager.current()[1].sources
+        self.manager = manager
+        self.watcher: Optional[DeltaRefreshWatcher] = None
+        if watch and xbox_model_dir is not None:
+            self.watcher = DeltaRefreshWatcher(
+                manager, xbox_model_dir, days,
+                known_sources=sources).start()
+        n_threads = max(1, int(pull_threads
+                               if pull_threads is not None
+                               else flags.get_flag("serving_pull_threads")))
+        self._pool = ThreadPoolExecutor(n_threads,
+                                        thread_name_prefix="serve-pull")
+        self._state_cv = threading.Condition()
+        self._inflight = 0  # guarded-by: _state_cv
+        self._draining = False  # guarded-by: _state_cv
+        self._requests = 0  # guarded-by: _report_lock
+        self._prev_hit = 0  # guarded-by: _report_lock
+        self._prev_miss = 0  # guarded-by: _report_lock
+        self._report_lock = threading.Lock()
+        self.reporter = make_step_reporter(
+            every=report_every if report_every is not None
+            else int(flags.get_flag("serving_report_requests")))
+        self._server = FramedServer(self._handle, loads=plain_loads,
+                                    host=host, port=port,
+                                    max_frame_bytes=MAX_FRAME_BYTES)
+        log.info("serving server up", port=self.port,
+                 threads=n_threads,
+                 watch=int(self.watcher is not None))
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    # ------------------------------------------------------------- handler
+    def _handle(self, req: Dict[str, Any]) -> Any:
+        method = req.get("method")
+        if method == "pull":
+            return self._handle_pull(req)
+        if method == "ping":
+            return {"gen": self.manager.current()[0]}
+        if method == "stats":
+            return self._stats()
+        if method == "drain":
+            # fleet shutdown rides the data port: ack first, drain on a
+            # side thread (draining inside the handler would deadlock —
+            # this very request is in flight)
+            threading.Thread(target=self.drain, daemon=True,
+                             name="serve-drain").start()
+            return {"draining": True}
+        raise ValueError(f"unknown serving method {method!r}")
+
+    def _handle_pull(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        with self._state_cv:
+            if self._draining:
+                # retryable by contract: the client fails over to
+                # another replica of the fleet
+                raise RuntimeError("draining: replica is shutting down")
+            self._inflight += 1
+        try:
+            t0 = time.perf_counter()
+            keys = codec.decode_pull_keys(req)
+            # the conn thread blocks on the bounded pool: lookup
+            # concurrency == serving_pull_threads regardless of the
+            # number of open connections; queueing time is part of the
+            # latency the histogram publishes (what the client feels)
+            rows, gen = self._pool.submit(
+                self.manager.lookup, keys).result()
+            dt_us = (time.perf_counter() - t0) * 1e6
+            hist_observe("serving_lookup_us", dt_us)
+            stat_add("serving_requests")
+            stat_add("serving_keys", int(keys.size))
+            self._note_report(int(keys.size))
+            return codec.encode_rows(rows, gen)
+        finally:
+            with self._state_cv:
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._state_cv.notify_all()
+
+    def _note_report(self, n_keys: int) -> None:
+        """StepReport cadence in REQUESTS (the serving step unit); the
+        reporter's examples rate is keys/s. Serialized — any pool/conn
+        thread can carry the Nth request."""
+        with self._report_lock:
+            self._requests += 1
+            self.reporter.note_examples(n_keys)
+            if self.reporter.due(self._requests):
+                hit = stat_get("serving_cache_hit")
+                miss = stat_get("serving_cache_miss")
+                d_hit = hit - self._prev_hit
+                d_tot = d_hit + (miss - self._prev_miss)
+                self._prev_hit, self._prev_miss = hit, miss
+                self.reporter.maybe_report(self._requests, extra={
+                    "role": "serving",
+                    "gen": self.manager.current()[0],
+                    "cache_hit_rate": round(d_hit / d_tot, 4)
+                    if d_tot else None,
+                })
+
+    def _stats(self) -> Dict[str, Any]:
+        gen, stack = self.manager.current()
+        return {
+            "gen": gen,
+            "view_rows": stack.total_rows,
+            "requests": stat_get("serving_requests"),
+            "keys": stat_get("serving_keys"),
+            "cache_hit": stat_get("serving_cache_hit"),
+            "cache_miss": stat_get("serving_cache_miss"),
+            "cache_evict": stat_get("serving_cache_evict"),
+            "last_report": self.reporter.peek(),
+        }
+
+    # ------------------------------------------------------------ lifecycle
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: refuse new pulls, wait for in-flight ones
+        (bounded), then stop watcher/pool/transport. Idempotent.
+        Returns True when in-flight work finished inside the bound."""
+        from paddlebox_tpu.config import flags
+        if timeout is None:
+            timeout = float(flags.get_flag("serving_drain_secs"))
+        deadline = time.monotonic() + timeout
+        with self._state_cv:
+            already = self._draining
+            self._draining = True
+            clean = True
+            while self._inflight > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    clean = False
+                    break
+                self._state_cv.wait(left)
+        if already:
+            return clean
+        if self.watcher is not None:
+            self.watcher.stop()
+        self._server.stop()
+        self._pool.shutdown(wait=True)
+        self.reporter.close()
+        self.manager.close()
+        log.info("serving server drained", clean=int(clean))
+        return clean
+
+    close = drain

@@ -25,6 +25,10 @@ from paddlebox_tpu.config.configs import CheckpointConfig, TableConfig
 from paddlebox_tpu.embedding import accessor as acc
 from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
 from paddlebox_tpu.embedding.pass_table import PassTable
+from paddlebox_tpu.serving.store import (_XBOX_MAGIC,  # noqa: F401
+                                         MmapXboxStore,
+                                         discover_xbox_sources,
+                                         write_xbox_columnar)
 
 
 def _write_done(dirpath: str) -> None:
@@ -344,29 +348,13 @@ class XboxModelReader:
         d0's base composed with day d1's streaming views (d1's base DONE
         need not exist yet — that's the mid-day scenario). At least one
         day must have a completed base."""
-        import glob
-        import re
         if not days:
             raise ValueError("need at least one day")
-        sources = []
-        have_base = False
-        for di, day in enumerate(days):
-            root = os.path.join(xbox_model_dir, day)
-            if os.path.exists(os.path.join(root, "DONE")):
-                have_base = True
-                # base sorts AFTER the day's deltas (is_base=1): it is
-                # written at day end and covers them
-                sources.append((di, 1, 0, self._done_ts(root), root))
-            for d in glob.glob(os.path.join(root, "delta-*")):
-                m = re.fullmatch(r"delta-(\d+)", os.path.basename(d))
-                if m and os.path.exists(os.path.join(d, "DONE")):
-                    sources.append((di, 0, int(m.group(1)),
-                                    self._done_ts(d), d))
-        if not have_base:
-            raise FileNotFoundError(
-                f"no completed xbox base under {xbox_model_dir} for {days}")
+        # the ONE precedence rule, shared with the serving plane's mmap
+        # stack (serving/store.py): structural order, DONE ts tie-break
+        sources = discover_xbox_sources(xbox_model_dir, days)
         self._dim: Optional[int] = None
-        self.deltas_applied = sum(1 for s in sources if not s[1])
+        self.deltas_applied = sum(1 for s in sources if not s.is_base)
         # vectorized composition: concatenate every view's blob in apply
         # order, then one lexsort by (key, apply order) and keep each
         # key's LAST occurrence — the freshest view wins, keys come out
@@ -374,8 +362,8 @@ class XboxModelReader:
         # runs (serving-scale bases are 10M+ keys)
         key_blocks: list = []
         row_blocks: list = []
-        for _di, _b, _i, _ts, d in sorted(sources):
-            with open(os.path.join(d, "embedding.pkl"), "rb") as f:
+        for src in sources:
+            with open(os.path.join(src.path, "embedding.pkl"), "rb") as f:
                 blob = pickle.load(f)
             emb = np.asarray(blob["embedding"], np.float32)
             if self._dim is None and emb.ndim == 2:
@@ -392,11 +380,6 @@ class XboxModelReader:
         self._n = int(self._keys.size)
         self._rows = (np.vstack(row_blocks)[order[last]] if self._n
                       else np.empty((0, self.dim), np.float32))
-
-    @staticmethod
-    def _done_ts(dirpath: str) -> float:
-        with open(os.path.join(dirpath, "DONE")) as f:
-            return float(f.read().strip())
 
     def __len__(self) -> int:
         return self._n
@@ -426,108 +409,8 @@ class XboxModelReader:
         return write_xbox_columnar(path, self._keys, self._rows)
 
 
-_XBOX_MAGIC = b"PBTXBOX1"
-
-
-def write_xbox_columnar(path: str, keys: np.ndarray,
-                        rows: np.ndarray) -> str:
-    """Serving store file: 8-byte magic, int64 n, int64 dim, then the
-    SORTED uint64 key column and the float32 [n, dim] row matrix, each
-    64-byte aligned. Written atomically (tmp + rename)."""
-    keys = np.ascontiguousarray(keys, np.uint64)
-    rows = np.ascontiguousarray(rows, np.float32)
-    if keys.ndim != 1 or rows.ndim != 2 or rows.shape[0] != keys.size:
-        raise ValueError("keys must be [n], rows [n, dim]")
-    if keys.size > 1 and not (keys[1:] > keys[:-1]).all():
-        raise ValueError("keys must be strictly sorted")
-
-    def align(off):
-        return (off + 63) // 64 * 64
-
-    key_off = align(8 + 8 + 8)
-    row_off = align(key_off + keys.nbytes)
-    tmp = f"{path}.{os.getpid()}.tmp"
-    with open(tmp, "wb") as f:
-        f.write(_XBOX_MAGIC)
-        f.write(np.int64(keys.size).tobytes())
-        f.write(np.int64(rows.shape[1]).tobytes())
-        f.seek(key_off)
-        keys.tofile(f)
-        f.seek(row_off)
-        rows.tofile(f)
-    os.replace(tmp, path)
-    return path
-
-
-class MmapXboxStore:
-    """Serving-scale xbox store (round-5 verdict item 8): the composed
-    view lives in ONE columnar file; lookups run against an mmap of it —
-    no full-RAM ingest of the row matrix (the reference's external
-    serving loader role over SaveBase/SaveDelta output,
-    box_wrapper.cc:1286-1318).
-
-    Key translation: a native open-addressing hash index over the key
-    column (route.cc rt_lookup_serve, ~1 probe/key, misses → zero row) —
-    the same index tier the trainer's feed path uses at 31M keys/s. The
-    index holds keys only (~16 B/key); the row matrix (the dominant
-    bytes) stays on disk behind the page cache. Without the native lib,
-    lookups fall back to searchsorted directly on the key mmap."""
-
-    def __init__(self, path: str) -> None:
-        with open(path, "rb") as f:
-            if f.read(8) != _XBOX_MAGIC:
-                raise ValueError(f"{path}: not an xbox columnar store")
-            n = int(np.frombuffer(f.read(8), np.int64)[0])
-            dim = int(np.frombuffer(f.read(8), np.int64)[0])
-        key_off = (8 + 8 + 8 + 63) // 64 * 64
-        row_off = (key_off + n * 8 + 63) // 64 * 64
-        self._n, self._dim = n, dim
-        self._keys = np.memmap(path, np.uint64, "r", key_off, (n,))
-        self._rows = np.memmap(path, np.float32, "r", row_off, (n, dim))
-        self._index = None
-        from paddlebox_tpu.native.build import create_route_index
-        self._index = create_route_index([self._keys]) if n else None
-
-    def __len__(self) -> int:
-        return self._n
-
-    @property
-    def dim(self) -> int:
-        return self._dim
-
-    def lookup(self, keys: np.ndarray) -> np.ndarray:
-        """[K] uint64 → [K, dim]; unknown keys are zero rows."""
-        keys = np.ascontiguousarray(
-            np.asarray(keys, np.uint64).reshape(-1))
-        out = np.zeros((keys.size, self._dim), np.float32)
-        if not (self._n and keys.size):
-            return out
-        if self._index is not None:
-            import ctypes
-
-            from paddlebox_tpu.native.build import get_lib
-            ids = np.empty(keys.size, np.int32)
-            get_lib().rt_lookup_serve(
-                self._index,
-                keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-                keys.size, -1,
-                ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
-            hit = ids >= 0
-            out[hit] = self._rows[ids[hit]]
-            return out
-        pos = np.searchsorted(self._keys, keys)
-        pos = np.minimum(pos, self._n - 1)
-        hit = self._keys[pos] == keys
-        out[hit] = self._rows[pos[hit]]
-        return out
-
-    def close(self) -> None:
-        from paddlebox_tpu.native.build import destroy_route_index
-        destroy_route_index(self._index)
-        self._index = None
-
-    def __del__(self):
-        try:
-            self.close()
-        except Exception:
-            pass
+# The columnar serving-store machinery moved to the serving plane in
+# round 12 (paddlebox_tpu/serving/store.py — jax-free import surface for
+# fleet children); re-exported here for the historical import path.
+# _XBOX_MAGIC / write_xbox_columnar / MmapXboxStore / discover_xbox_sources
+# are the same objects.

@@ -6,8 +6,17 @@ The repo is written against the modern ``jax.shard_map`` spelling
 ``shard_map`` to whichever exists and — when the top-level name is
 missing — installs the alias on the ``jax`` module so every
 ``jax.shard_map(...)`` call site (package, tests, examples) works
-unchanged on both lines. Imported from ``paddlebox_tpu/__init__.py`` so
-the alias exists before any trainer module needs it.
+unchanged on both lines.
+
+Round 12: ``paddlebox_tpu/__init__.py`` no longer imports this module
+EAGERLY — that import was the one thing forcing ``jax`` (seconds +
+hundreds of MB) into every consumer of the package, including the
+jax-free serving replicas and host-side tools. Instead the package
+installs ``install_deferred()``'s import hook: when jax is ALREADY
+imported the shims apply immediately, otherwise they apply the moment
+jax finishes its own import — so the alias still exists before any
+trainer module can touch it, and a process that never imports jax never
+pays for it.
 """
 
 from __future__ import annotations

@@ -1,0 +1,74 @@
+"""Deferred installer for the jax compat shims (round 12).
+
+``utils/compat.py`` must run AFTER jax is imported (it patches the jax
+module) but BEFORE any package module uses the patched spellings. The
+pre-round-12 solution — import compat from the package ``__init__`` —
+met the ordering contract by forcing jax into EVERY consumer of
+``paddlebox_tpu``, which the serving plane (jax-free replica processes)
+and host-side tools cannot afford. This module is the jax-free half:
+
+  * jax already imported → apply the shims right now (identical to the
+    old eager behavior; the test/trainer path, where conftest or the
+    driver imported jax first).
+  * jax not imported yet → install a one-shot ``sys.meta_path`` finder
+    that lets the REAL jax import run to completion and then imports
+    ``utils.compat`` — the shims exist before the importer of jax can
+    execute its next statement, so every ordering the eager import
+    guaranteed still holds.
+  * jax never imported → nothing ever happens; the process stays
+    jax-free (the serving fleet's spawn-in-milliseconds contract).
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.abc
+import importlib.util
+import sys
+
+
+class _CompatAfterJaxLoader(importlib.abc.Loader):
+    """Delegating loader that runs the compat shims after jax's own
+    module body finishes executing."""
+
+    def __init__(self, inner) -> None:
+        self._inner = inner
+
+    def create_module(self, spec):
+        return self._inner.create_module(spec)
+
+    def exec_module(self, module) -> None:
+        self._inner.exec_module(module)
+        # jax is fully in sys.modules here; compat's `import jax` is a
+        # cache hit, and the shims land before the jax importer resumes
+        importlib.import_module("paddlebox_tpu.utils.compat")
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class _CompatAfterJaxFinder(importlib.abc.MetaPathFinder):
+    def find_spec(self, fullname, path=None, target=None):
+        if fullname != "jax":
+            return None
+        # one-shot: step out of the way, resolve the real spec, wrap
+        # only ITS loader (spec objects are per-import — no shared
+        # loader instance is mutated)
+        try:
+            sys.meta_path.remove(self)
+        except ValueError:
+            return None
+        spec = importlib.util.find_spec("jax")
+        if spec is not None and spec.loader is not None:
+            spec.loader = _CompatAfterJaxLoader(spec.loader)
+        return spec
+
+
+def install_deferred() -> None:
+    """Idempotent: apply the shims now if jax is loaded, else arm the
+    one-shot import hook."""
+    if "jax" in sys.modules:
+        importlib.import_module("paddlebox_tpu.utils.compat")
+        return
+    if not any(isinstance(f, _CompatAfterJaxFinder) for f in sys.meta_path):
+        sys.meta_path.insert(0, _CompatAfterJaxFinder())

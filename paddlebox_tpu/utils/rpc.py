@@ -59,9 +59,23 @@ class FramedServer:
 
     def __init__(self, handler: Callable[[dict], Any],
                  loads: Callable[[bytes], Any] = plain_loads,
-                 host: str = "127.0.0.1", port: int = 0) -> None:
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_frame_bytes: Optional[int] = None) -> None:
+        """max_frame_bytes: refuse request frames whose length prefix
+        exceeds this — a best-effort error response is queued, then the
+        connection closes WITHOUT reading the body (the declared length
+        can't be trusted enough to drain or resync past it, and
+        draining is exactly the buffering this guard exists to refuse).
+        A sender mid-way through a payload larger than the socket
+        buffers therefore sees ECONNRESET rather than the error frame;
+        the frame is readable only when the send already completed.
+        Ports exposed beyond the training cluster (the serving plane)
+        set it so a corrupt/hostile 4-byte prefix can't make the server
+        try to buffer gigabytes. None = unlimited (the intra-cluster
+        default, unchanged)."""
         self._handler = handler
         self._loads = loads
+        self._max_frame = max_frame_bytes
         self._stop = threading.Event()
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -93,6 +107,15 @@ class FramedServer:
                 if hdr is None:
                     return
                 (length,) = _LEN.unpack(hdr)
+                if self._max_frame is not None and length > self._max_frame:
+                    resp = {"ok": False,
+                            "error": "RuntimeError('frame of %d bytes "
+                                     "exceeds server max of %d')"
+                                     % (length, self._max_frame)}
+                    payload = pickle.dumps(
+                        resp, protocol=pickle.HIGHEST_PROTOCOL)
+                    conn.sendall(_LEN.pack(len(payload)) + payload)
+                    return
                 body = recv_exact(conn, length)
                 if body is None:
                     return

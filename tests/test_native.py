@@ -170,3 +170,48 @@ def test_native_host_store_grows():
         np.testing.assert_array_equal(rows, r2)
     finally:
         lib.hs_destroy(s)
+
+
+def test_concurrent_bucketize_parity():
+    """Round-12 thread contract: the stager pool calls rt_bucketize on
+    ONE route index from several threads concurrently (ctypes drops the
+    GIL), so concurrent routings must be bit-identical to serial ones.
+    The pre-fix per-INDEX dedup scratch let concurrent callers draw the
+    same generation and read each other's seen-marks — a silently
+    mis-routed occurrence (the PR-6 show-off-by-one flake class,
+    BASELINE.md round 12); this reproduced it in the first few trials.
+    Scratch is per-thread now."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
+                                              TableConfig)
+    from paddlebox_tpu.parallel.sharded_table import ShardedPassTable
+
+    P, KB, K = 8, 2048, 8192
+    rng = np.random.RandomState(0)
+    pass_keys = np.unique(
+        rng.randint(0, 1 << 30, 1 << 15).astype(np.uint64))
+    t = ShardedPassTable(
+        TableConfig(embedx_dim=8, pass_capacity=1 << 18,
+                    optimizer=SparseOptimizerConfig()),
+        num_shards=P, bucket_cap=KB)
+    t.begin_feed_pass()
+    t.add_keys(pass_keys)
+    t.end_feed_pass()
+    # distinct batches sharing many keys: the cross-batch scratch
+    # collision food the race needed
+    batches = [rng.choice(pass_keys, K).astype(np.uint64)
+               for _ in range(6)]
+    valid = np.ones(K, bool)
+    oracle = [t.bucketize(b, valid.copy()) for b in batches]
+    pool = ThreadPoolExecutor(4)
+    try:
+        for _trial in range(30):
+            futs = [pool.submit(
+                lambda b=b: t.bucketize(b, valid.copy()))
+                for b in batches]
+            for got, want in zip([f.result() for f in futs], oracle):
+                np.testing.assert_array_equal(got.buckets, want.buckets)
+                np.testing.assert_array_equal(got.restore, want.restore)
+    finally:
+        pool.shutdown(wait=False)

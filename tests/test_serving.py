@@ -1,0 +1,417 @@
+"""Serving plane (round 12): mmap view stack vs the XboxModelReader
+oracle, hot-key cache accounting, delta swap under load, the
+plain-container serving codec, and the replica fleet."""
+
+import os
+import pickle
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import flags
+from paddlebox_tpu.serving import (HotKeyCache, MmapViewStack,
+                                   ServingClient, ServingServer,
+                                   build_stack, make_manager)
+from paddlebox_tpu.serving.refresh import DeltaRefreshWatcher
+from paddlebox_tpu.serving.store import (compile_view_dir,
+                                         discover_xbox_sources)
+from paddlebox_tpu.train.checkpoint import XboxModelReader
+from paddlebox_tpu.utils.stats import stat_get
+
+D = 4
+
+
+def write_view(root, day, sub=None, keys=(), rows=None, ts=None, seed=0):
+    """One xbox view dir (embedding.pkl + DONE) the way the checkpoint
+    writer lays them out; rows default to a seeded random matrix."""
+    p = os.path.join(root, day) if sub is None else os.path.join(
+        root, day, sub)
+    os.makedirs(p, exist_ok=True)
+    keys = np.asarray(sorted(set(int(k) for k in keys)), np.uint64)
+    if rows is None:
+        rows = np.random.RandomState(seed).randn(
+            keys.size, D).astype(np.float32)
+    with open(os.path.join(p, "embedding.pkl"), "wb") as f:
+        pickle.dump({"keys": keys,
+                     "embedding": np.asarray(rows, np.float32)}, f)
+    with open(os.path.join(p, "DONE"), "w") as f:
+        f.write(str(time.time() if ts is None else ts))
+    return p
+
+
+def probe_keys(rng, *key_sets, extra_misses=8):
+    """Mixed probe: every key that exists somewhere + guaranteed misses,
+    shuffled with duplicates."""
+    pool = sorted(set().union(*[set(int(k) for k in ks)
+                                for ks in key_sets]))
+    misses = [max(pool, default=0) + 1 + i for i in range(extra_misses)]
+    probe = np.array(pool + misses + pool[: len(pool) // 2], np.uint64)
+    rng.shuffle(probe)
+    return probe
+
+
+# ------------------------------------------------------------------ stack
+
+
+def test_stack_matches_reader_bit_parity(tmp_path):
+    """Base + 2 same-day deltas + a next-day streaming delta (the
+    mid-day scenario): the mmap precedence stack serves BIT-identical
+    vectors to the RAM-composed XboxModelReader oracle, misses
+    included."""
+    root = str(tmp_path)
+    rng = np.random.RandomState(0)
+    k_base = rng.choice(1 << 20, 300, replace=False)
+    k_d1 = rng.choice(k_base, 40, replace=False)       # overlap base
+    k_d2 = np.concatenate([rng.choice(k_d1, 10, replace=False),
+                           [1 << 21]])                 # overlap d1 + new
+    k_next = np.concatenate([rng.choice(k_base, 25, replace=False),
+                             [1 << 22]])
+    write_view(root, "day0", "delta-1", k_d1, seed=1)
+    write_view(root, "day0", "delta-2", k_d2, seed=2)
+    write_view(root, "day0", None, k_base, seed=3)
+    write_view(root, "day1", "delta-1", k_next, seed=4)
+
+    oracle = XboxModelReader(root, "day0", "day1")
+    stack, sources = build_stack(root, ["day0", "day1"])
+    assert len(sources) == 4
+    probe = probe_keys(rng, k_base, k_d1, k_d2, k_next)
+    got = stack.lookup(probe)
+    want = oracle.lookup(probe)
+    np.testing.assert_array_equal(got.view(np.uint32),
+                                  want.view(np.uint32))
+    stack.close()
+
+
+def test_stack_clock_skew_tie_break(tmp_path):
+    """DONE timestamps deliberately INVERTED against structural order
+    (the day-1 delta writer's clock lags the day-0 base writer's):
+    precedence must follow structure, identically in oracle and
+    stack — the day-1 delta still wins for its keys."""
+    root = str(tmp_path)
+    rng = np.random.RandomState(5)
+    keys = np.arange(1, 64, dtype=np.uint64)
+    # base stamped FAR in the future, deltas stamped in the past, and
+    # same-day delta ids shuffled against their timestamps
+    write_view(root, "day0", None, keys, seed=6, ts=4e9)
+    write_view(root, "day0", "delta-1", keys[:20], seed=7, ts=3e9)
+    write_view(root, "day1", "delta-1", keys[10:30], seed=8, ts=10.0)
+    write_view(root, "day1", "delta-2", keys[25:40], seed=9, ts=5.0)
+
+    sources = discover_xbox_sources(root, ["day0", "day1"])
+    assert [(s.day_index, s.is_base, s.delta_id) for s in sources] == [
+        (0, 0, 1), (0, 1, 0), (1, 0, 1), (1, 0, 2)]
+    oracle = XboxModelReader(root, "day0", "day1")
+    stack = MmapViewStack(sources)
+    probe = probe_keys(rng, keys)
+    np.testing.assert_array_equal(stack.lookup(probe).view(np.uint32),
+                                  oracle.lookup(probe).view(np.uint32))
+    stack.close()
+
+
+def test_compile_view_dir_idempotent_and_shared(tmp_path):
+    """The columnar twin compiles once (mtime-gated) — the path N
+    serving processes share — and recompiles when the pkl changes."""
+    p = write_view(str(tmp_path), "day0", None, [3, 1, 2], seed=1)
+    out1 = compile_view_dir(p)
+    m1 = os.path.getmtime(out1)
+    assert compile_view_dir(p) == out1
+    assert os.path.getmtime(out1) == m1
+    time.sleep(0.02)
+    write_view(str(tmp_path), "day0", None, [3, 1, 2, 4], seed=2)
+    os.utime(os.path.join(p, "embedding.pkl"))
+    compile_view_dir(p)
+    from paddlebox_tpu.serving.store import MmapXboxStore
+    st = MmapXboxStore(out1)
+    assert len(st) == 4
+    st.close()
+
+
+def test_stack_with_empty_delta_view(tmp_path):
+    """A SaveDelta where nothing crossed the threshold writes a
+    ZERO-KEY view — routine right after a base save cleared delta
+    scores. It must compile, open, and compose identically to the
+    oracle (this crashed server bring-up and wedged the watcher before
+    the round-12 file-padding fix)."""
+    root = str(tmp_path)
+    keys = np.arange(1, 40, dtype=np.uint64)
+    write_view(root, "day0", None, keys, seed=20)
+    write_view(root, "day0", "delta-1", [],
+               rows=np.empty((0, D), np.float32))
+    oracle = XboxModelReader(root, "day0")
+    stack, sources = build_stack(root, ["day0"])
+    assert len(sources) == 2
+    probe = probe_keys(np.random.RandomState(21), keys)
+    np.testing.assert_array_equal(stack.lookup(probe).view(np.uint32),
+                                  oracle.lookup(probe).view(np.uint32))
+    stack.close()
+
+
+# ------------------------------------------------------------------ cache
+
+
+def test_cache_admission_eviction_accounting():
+    """Frequency-gated admission, CLOCK eviction, exact hit/miss/evict
+    counters."""
+    for name in ("serving_cache_hit", "serving_cache_miss",
+                 "serving_cache_evict", "serving_cache_admit"):
+        from paddlebox_tpu.utils.stats import stat_reset
+        stat_reset(name)
+    cache = HotKeyCache(capacity=4, dim=2, admit=2)
+    rows_of = lambda ks: np.tile(  # noqa: E731
+        np.asarray(ks, np.float32)[:, None], (1, 2))
+    k = np.array([1, 2, 3], np.uint64)
+    out = np.zeros((3, 2), np.float32)
+    miss = cache.get_many(k, out)
+    assert miss.all() and stat_get("serving_cache_miss") == 3
+    # first offer: below the admit=2 threshold — nothing enters
+    assert cache.admit_many(k, rows_of(k), epoch=0) == 0
+    assert len(cache) == 0
+    # second miss reaches the threshold — all 3 admitted
+    assert cache.admit_many(k, rows_of(k), epoch=0) == 3
+    assert len(cache) == 3
+    miss = cache.get_many(k, out)
+    assert not miss.any()
+    np.testing.assert_array_equal(out, rows_of(k))
+    assert stat_get("serving_cache_hit") == 3
+    # fill to capacity, then one more hot key evicts via CLOCK; keys
+    # 1..3 were just HIT (ref bits set) so the victim is the unref'd 4
+    k4 = np.array([4], np.uint64)
+    cache.admit_many(k4, rows_of(k4), epoch=0)
+    cache.admit_many(k4, rows_of(k4), epoch=0)
+    assert len(cache) == 4
+    k5 = np.array([5], np.uint64)
+    cache.admit_many(k5, rows_of(k5), epoch=0)
+    cache.admit_many(k5, rows_of(k5), epoch=0)
+    assert len(cache) == 4 and stat_get("serving_cache_evict") == 1
+    out1 = np.zeros((1, 2), np.float32)
+    assert not cache.get_many(np.array([5], np.uint64), out1).any()
+    assert cache.get_many(np.array([4], np.uint64), out1).all()
+
+
+def test_cache_stale_epoch_insert_refused():
+    """An admission offer carrying a pre-swap generation must drop —
+    the race guard for lookups that straddle a view swap."""
+    cache = HotKeyCache(capacity=4, dim=2, admit=1)
+    k = np.array([7], np.uint64)
+    r = np.ones((1, 2), np.float32)
+    assert cache.admit_many(k, r, epoch=0) == 1
+    new_epoch = cache.clear()
+    assert new_epoch == 1 and len(cache) == 0
+    assert cache.admit_many(k, r, epoch=0) == 0      # stale gen: refused
+    assert cache.admit_many(k, r, epoch=1) == 1
+
+
+def test_cache_stale_epoch_probe_reports_all_miss():
+    """The probe side of the swap guard: a get_many carrying a
+    pre-swap epoch must report ALL-miss even for cached keys —
+    otherwise one response could mix new-generation cache hits with
+    old-grabbed-stack reads (two model generations in one pull)."""
+    cache = HotKeyCache(capacity=4, dim=2, admit=1)
+    k = np.array([7], np.uint64)
+    r = np.full((1, 2), 5.0, np.float32)
+    cache.admit_many(k, r, epoch=0)
+    out = np.zeros((1, 2), np.float32)
+    assert not cache.get_many(k, out, epoch=0).any()    # live epoch: hit
+    cache.clear()
+    cache.admit_many(k, r, epoch=1)
+    out[:] = 0
+    assert cache.get_many(k, out, epoch=0).all()        # stale: all-miss
+    assert (out == 0).all()
+    assert not cache.get_many(k, out, epoch=1).any()
+
+
+def test_manager_lookup_caches_and_swap_invalidates(tmp_path):
+    root = str(tmp_path)
+    write_view(root, "day0", None, [1, 2, 3],
+               rows=np.ones((3, D), np.float32))
+    mgr, sources = make_manager(root, ["day0"], cache_rows=8,
+                                cache_admit=1)
+    k = np.array([1, 2], np.uint64)
+    out1, gen1 = mgr.lookup(k)        # misses, admits
+    out2, _ = mgr.lookup(k)           # hits
+    np.testing.assert_array_equal(out1, out2)
+    assert len(mgr.cache) == 2
+    # swap: key 2 changes; the cache must not serve the old vector
+    write_view(root, "day1", "delta-1", [2],
+               rows=np.full((1, D), 9, np.float32))
+    w = DeltaRefreshWatcher(mgr, root, poll_secs=10.0,
+                            known_sources=sources)
+    assert w.poll_once()
+    out3, gen3 = mgr.lookup(k)
+    assert gen3 == gen1 + 1
+    np.testing.assert_array_equal(out3[1], np.full(D, 9, np.float32))
+    mgr.close()
+
+
+def test_manager_tracks_cache_epoch_not_gen(tmp_path):
+    """The stale-admission guard must track the cache's OWN epoch, not
+    assume epoch == manager generation: a cache that was cleared before
+    the manager existed (epoch ahead of gen 0) must still admit."""
+    from paddlebox_tpu.serving.refresh import ViewManager
+    root = str(tmp_path)
+    write_view(root, "day0", None, [1, 2],
+               rows=np.ones((2, D), np.float32))
+    stack, _ = build_stack(root, ["day0"])
+    cache = HotKeyCache(capacity=4, dim=D, admit=1)
+    cache.clear()
+    cache.clear()                      # epoch now 2, gen will start 0
+    mgr = ViewManager(stack, cache)
+    mgr.lookup(np.array([1], np.uint64))
+    assert len(cache) == 1, "admission must survive epoch != gen"
+    mgr.close()
+
+
+# ---------------------------------------------------------------- refresh
+
+
+def test_swap_under_load_no_drops(tmp_path):
+    """Reader threads hammer lookups while deltas land and swap: no
+    request may error or read a torn view (vectors are always exactly
+    one of the generations' values), and the new vector must be served
+    within one poll interval."""
+    root = str(tmp_path)
+    keys = np.arange(1, 33, dtype=np.uint64)
+    write_view(root, "day0", None, keys,
+               rows=np.zeros((32, D), np.float32))
+    mgr, sources = make_manager(root, ["day0"], cache_rows=16,
+                                cache_admit=1)
+    watcher = DeltaRefreshWatcher(mgr, root, poll_secs=0.05,
+                                  known_sources=sources).start()
+    errors = []
+    stop = threading.Event()
+    seen_vals = set()
+
+    def hammer():
+        rng = np.random.RandomState(os.getpid())
+        while not stop.is_set():
+            try:
+                out, _gen = mgr.lookup(keys)
+                vals = set(np.unique(out).tolist())
+                if not vals <= {0.0, 1.0, 2.0, 3.0}:
+                    errors.append(f"torn read: {sorted(vals)[:4]}")
+                seen_vals.update(vals)
+            except Exception as e:   # NO dropped/errored lookups allowed
+                errors.append(repr(e))
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for i, v in enumerate((1.0, 2.0, 3.0), 1):
+            write_view(root, "day1", f"delta-{i}", keys,
+                       rows=np.full((32, D), v, np.float32))
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                out, _ = mgr.lookup(keys[:1])
+                if out[0, 0] == v:
+                    break
+                time.sleep(0.01)
+            else:
+                errors.append(f"delta {i} not served within 5s")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        watcher.stop()
+        mgr.close()
+    assert not errors, errors[:5]
+    assert {1.0, 2.0, 3.0} <= seen_vals
+
+
+# ------------------------------------------------------------ rpc + fleet
+
+
+@pytest.fixture
+def tiny_server(tmp_path):
+    root = str(tmp_path)
+    rng = np.random.RandomState(11)
+    keys = rng.choice(1 << 16, 200, replace=False)
+    write_view(root, "day0", None, keys, seed=12)
+    flags.set_flag("serving_report_requests", 4)
+    server = ServingServer(root, days=["day0"], watch=False)
+    client = ServingClient([("127.0.0.1", server.port)])
+    yield root, keys, server, client
+    client.close()
+    server.drain(timeout=5.0)
+
+
+def test_server_pull_parity_and_obs(tiny_server):
+    """RPC-served vectors are bit-identical to the oracle; the obs
+    plane publishes latency percentiles + cache hit rate."""
+    root, keys, server, client = tiny_server
+    rng = np.random.RandomState(13)
+    oracle = XboxModelReader(root, "day0")
+    probe = probe_keys(rng, keys)
+    for _ in range(6):                 # cross the report cadence
+        got = client.pull(probe)
+    np.testing.assert_array_equal(
+        got.view(np.uint32), oracle.lookup(probe).view(np.uint32))
+    rep = server.reporter.peek()
+    assert rep is not None and rep["role"] == "serving"
+    assert "serving_lookup_us" in rep["hists"]
+    assert rep["hists"]["serving_lookup_us"]["p99"] > 0
+    assert rep["cache_hit_rate"] is not None
+    st = client.stats()
+    assert st["requests"] >= 6 and st["gen"] == 0
+
+
+def test_serving_codec_rejects_class_payloads(tiny_server):
+    """A pickled numpy array (class resolution) on the serving port is
+    refused by the transport, the stream stays in sync, and a plain
+    pull on the SAME connection still works."""
+    from paddlebox_tpu.utils.rpc import FramedClient
+    _root, keys, server, _client = tiny_server
+    raw = FramedClient("127.0.0.1", server.port)  # default plain loads
+    try:
+        # hand-roll a class-bearing request: FramedClient pickles
+        # whatever we pass — a numpy array needs find_class to load
+        with pytest.raises(RuntimeError, match="refusing to unpickle"):
+            raw.call({"method": "pull",
+                      "keys": np.asarray(keys[:3], np.uint64), "n": 3})
+        from paddlebox_tpu.serving import codec
+        resp = raw.call(codec.encode_pull(np.asarray(keys[:3],
+                                                     np.uint64)))
+        assert codec.decode_rows(resp).shape == (3, D)
+        # malformed plain frames fail loud, stream still alive
+        with pytest.raises(RuntimeError, match="length mismatch"):
+            raw.call({"method": "pull", "keys": b"xx", "n": 3})
+        assert raw.call({"method": "ping"})["gen"] == 0
+    finally:
+        raw.close()
+
+
+def test_server_drain_refuses_then_stops(tmp_path):
+    root = str(tmp_path)
+    write_view(root, "day0", None, [1, 2], seed=14)
+    server = ServingServer(root, days=["day0"], watch=False)
+    client = ServingClient([("127.0.0.1", server.port)])
+    client.pull(np.array([1], np.uint64))
+    assert server.drain(timeout=5.0)
+    with pytest.raises((ConnectionError, RuntimeError)):
+        client.pull(np.array([1], np.uint64))
+    client.close()
+
+
+@pytest.mark.slow
+def test_fleet_two_process_smoke(tmp_path):
+    """2 spawned replicas over one store root: parity pulls through
+    round-robin + failover, per-replica stats, graceful close."""
+    from paddlebox_tpu.serving import ServingFleet
+    root = str(tmp_path)
+    rng = np.random.RandomState(15)
+    keys = rng.choice(1 << 18, 500, replace=False)
+    write_view(root, "day0", None, keys, seed=16)
+    oracle = XboxModelReader(root, "day0")
+    probe = probe_keys(rng, keys)
+    with ServingFleet(root, days=["day0"], processes=2) as fleet:
+        assert len(fleet.endpoints) == 2
+        client = fleet.client()
+        for _ in range(4):             # round-robin hits both replicas
+            got = client.pull(probe)
+        np.testing.assert_array_equal(
+            got.view(np.uint32), oracle.lookup(probe).view(np.uint32))
+        assert client.stats(0)["requests"] + client.stats(1)[
+            "requests"] == 4
+        client.close()

@@ -19,7 +19,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-from paddlebox_tpu.train.checkpoint import (MmapXboxStore, _XBOX_MAGIC)
+from paddlebox_tpu.serving.store import MmapXboxStore, _XBOX_MAGIC
 
 N = int(sys.argv[1]) if len(sys.argv) > 1 else 30_000_000
 DIM = int(sys.argv[2]) if len(sys.argv) > 2 else 9
