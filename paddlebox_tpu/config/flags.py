@@ -248,6 +248,43 @@ define_flag("hostplane", "p2p",
             "(O(W^2*P*KB) through one NIC + 3 counter round-trips per "
             "rank per step). Must be set identically on every rank — a "
             "split setting deadlocks the lockstep exchange")
+define_flag("sharding_policy", "key-mod",
+            "2-D sparse parallelism policy for the sharded pass table "
+            "(round 13, parallel/sharding.py): 'key-mod' = shard by "
+            "key % P (the BoxPS split_input_to_shard layout, bit-"
+            "identical to the pre-policy path — the parity oracle); "
+            "'table-wise' = each table pinned whole to one shard "
+            "(table id from the feasign's high bits, see "
+            "sharding_table_shift) so a table's sparse traffic flows "
+            "only to its owner; '2d-grid' = table-group x row grid "
+            "(sharding_grid_rows) with an optional replicated hot-key "
+            "tier (sharding_hot_threshold). Must be set identically on "
+            "every rank — the p2p rendezvous validates and fails loud "
+            "on a split setting")
+define_flag("sharding_num_tables", 64,
+            "number of logical embedding tables the table-wise/2d-grid "
+            "policies route over: table id = "
+            "(key >> sharding_table_shift) % this")
+define_flag("sharding_table_shift", 48,
+            "bit position of the feasign's table/slot field for the "
+            "table-wise/2d-grid policies (the reference packs the slot "
+            "in the feasign's high bits); 0 = fold the low bits")
+define_flag("sharding_grid_rows", 0,
+            "row-axis size R of the 2d-grid policy (shard = "
+            "table_group * R + key % R); must divide the shard count. "
+            "0 = auto (largest divisor of P not above sqrt(P))")
+define_flag("sharding_hot_threshold", 0,
+            "2d-grid replicated hot tier: keys whose frequency-sketch "
+            "estimate reaches this at the pass freeze are REPLICATED "
+            "(served from the host mirror, dropped from the p2p uid "
+            "wire by senders and re-added by owners) instead of "
+            "routed. The sketch must be fed the same frequency "
+            "knowledge on every rank (policy.observe is cluster-"
+            "deterministic input by contract). 0 = hot tier off")
+define_flag("sharding_hot_cap", 1024,
+            "max replicated hot keys per shard for the 2d-grid hot "
+            "tier — freeze_hot raises beyond it (an unbounded "
+            "replicated set defeats the wire saving it exists for)")
 define_flag("incremental_pass", True,
             "incremental pass lifecycle (BeginPass/EndPass delta, the "
             "BoxPS keep-rows-resident cadence): begin_pass diffs the new "

@@ -187,16 +187,19 @@ def dedup_uids_sorted(ids: np.ndarray, pad_base: int) -> np.ndarray:
     recurrence pays one byte store per occurrence + O(n_u) sort instead
     of np.unique's comparison sort of the whole occurrence vector
     (measured best-of-7 1.1x at dup 2 up to 4.5x at dup 64, BASELINE.md
-    round 11). The kernel DECLINES low-duplication shapes (pad_base >
-    batch/2, where the presence-table page faults beat the sort it
-    saves) and any id outside [0, pad_base) (the presence table is
-    exactly pad_base bytes) — both return -1 and this wrapper keeps the
+    round 11). The kernel DECLINES low-duplication shapes and any id
+    outside [0, pad_base) — both return -1 and this wrapper keeps the
     numpy tier, which also remains the oracle the sortedness contract
-    test pins both against (tests/test_wire_modes.py). NOTE the
-    engagement caveat (BASELINE.md round 11): wired callers pass
-    pad_base = table/shard capacity, so the native tier engages only
-    when a batch carries >= 2x the capacity in occurrences — the
-    K/n_unique duplication re-key is recorded follow-up."""
+    test pins both against (tests/test_wire_modes.py).
+
+    ENGAGEMENT (re-keyed round 13, the PR-6 named follow-up): the
+    decline predicate runs on the live id SPAN, not pad_base — wired
+    callers pass pad_base = table/shard capacity but their pass-local
+    ids cluster in [0, working set) with the trash id (pad_base-1) as
+    the one far outlier, which the kernel tracks out-of-band. Engaging
+    requires 2*span <= K, which guarantees mean duplication
+    K/n_unique >= 2 (n_unique <= span) — production bucket
+    concatenations now take the native tier (BASELINE.md round 13)."""
     ids = np.ascontiguousarray(np.asarray(ids), np.int32)
     K = ids.shape[0]
     if K and ids.min() < 0:
@@ -204,12 +207,21 @@ def dedup_uids_sorted(ids: np.ndarray, pad_base: int) -> np.ndarray:
                          "pass-local ids")
     from paddlebox_tpu.native.build import get_lib
     lib = get_lib()
-    # the decline predicate is pure shape arithmetic — hoisted here so the
-    # always-declining regime (wired callers pass pad_base = capacity,
-    # usually >> K/2) skips the two K-sized scratch allocs and the FFI
-    # call; the kernel keeps its own check as the backstop
-    if (lib is not None and K and 2 * pad_base <= K
-            and hasattr(lib, "rt_dedup_sorted")):
+    # hoisted engagement screen (ONE vectorized max) so clearly-
+    # declining shapes skip the scratch allocs and the FFI call: engage
+    # when the span bound already guarantees dup >= 2, and FORWARD the
+    # trash-topped shape (m == pad_base-1, the wired bucket padding) to
+    # the kernel, whose single top-two prepass decides from the
+    # out-of-band span — a numpy twin here would re-pay that pass as a
+    # mask + copy + second max on every ENGAGED production call; the
+    # declining trash shapes instead pay the kernel one O(K) scan
+    # before their numpy fallback, the cheaper side of the tradeoff
+    native_ok = lib is not None and K and hasattr(lib, "rt_dedup_sorted")
+    if native_ok:
+        m = int(ids.max())
+        native_ok = m < pad_base and (2 * (m + 1) <= K
+                                      or m == pad_base - 1)
+    if native_ok:
         import ctypes
         out = np.empty(K, np.int32)
         scratch = np.empty(K, np.int64)

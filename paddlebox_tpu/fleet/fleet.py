@@ -50,6 +50,7 @@ class Fleet:
         # pre-satisfied barriers (the launcher stamps a fresh uuid)
         self._run_id = "0"
         self._mesh = None  # p2p host-plane mesh (make_mesh_comm, cached)
+        self._mesh_policy = None  # the policy id the cached mesh validated
 
     # ----------------------------------------------------------------- init
     def init(self, role: Optional[RoleMaker] = None,
@@ -237,7 +238,8 @@ class Fleet:
         sh.endpoints = endpoints
         return sh
 
-    def make_mesh_comm(self, positions=(), timeout: float = 120.0):
+    def make_mesh_comm(self, positions=(), timeout: float = 120.0,
+                       policy_id=None):
         """Build (once; cached) this rank's p2p host-plane mesh
         (fleet/mesh_comm.py): endpoints + owned mesh positions rendezvous
         ONE TIME through the KV store, then every per-step exchange rides
@@ -246,10 +248,19 @@ class Fleet:
         success is all-gathered, and if ANY rank failed to dial its peers
         every rank reverts to the store-allgather host plane together — a
         split decision would deadlock the lockstep exchange. Must be
-        called by every rank in the same collective order."""
+        called by every rank in the same collective order.
+
+        policy_id (round 13): the sharding policy's identity string
+        (ShardingPolicy.describe) — published with the endpoint and
+        compared across ranks at rendezvous, so a split sharding_policy
+        flag (ranks routing the same key to different owners: silent
+        product corruption) dies at bring-up instead. None skips the
+        check (policy-agnostic callers like the hostplane probe's raw
+        exchange legs)."""
         import logging
 
-        from paddlebox_tpu.fleet.mesh_comm import MeshComm
+        from paddlebox_tpu.fleet.mesh_comm import (MeshComm,
+                                                   MeshPolicyMismatch)
 
         if self.role.world <= 1:
             return None
@@ -261,6 +272,14 @@ class Fleet:
                 raise ValueError(
                     "make_mesh_comm: mesh already rendezvous'd for "
                     "positions %s; requested %s" % (have, list(positions)))
+            if policy_id is not None and policy_id != self._mesh_policy:
+                # the cached mesh validated a DIFFERENT (or no) policy
+                # identity at rendezvous; the cross-rank agreement the
+                # rendezvous check provides cannot be retrofitted here
+                raise ValueError(
+                    "make_mesh_comm: mesh already rendezvous'd under "
+                    "policy %r; requested %r — one policy per fleet "
+                    "lifetime" % (self._mesh_policy, policy_id))
             return self._mesh
         log = logging.getLogger("paddlebox_tpu")
         self._seq += 1
@@ -271,14 +290,24 @@ class Fleet:
         # vote below — an escaping exception here would leave every peer
         # blocked in the all_gather (the split-decision hang the vote
         # exists to prevent) and leak this rank's server socket
+        mismatch = None
         try:
             mesh.rendezvous(self._client, ns, self._my_host(),
-                            positions, timeout)
+                            positions, timeout, policy_id=policy_id)
+        except MeshPolicyMismatch as e:
+            # NOT a fallback case: ranks on different sharding policies
+            # would corrupt the store plane just the same — vote first
+            # (so no peer hangs in the all_gather), then die loud
+            mismatch = e
+            ok = 0
         except Exception as e:  # noqa: BLE001 — votes fallback, never splits
             log.warning("hostplane=p2p bring-up FAILED on rank %d: %r",
                         self.role.rank, e)
             ok = 0
         flags = self.all_gather(np.asarray([ok], np.int64), timeout)
+        if mismatch is not None:
+            mesh.close()
+            raise mismatch
         if not all(int(f[0]) for f in flags):
             if ok:
                 log.warning(
@@ -289,6 +318,7 @@ class Fleet:
             mesh.close()
             return None
         self._mesh = mesh
+        self._mesh_policy = policy_id
         return mesh
 
     # ------------------------------------------------------------- lifecycle
@@ -296,6 +326,7 @@ class Fleet:
         if self._mesh is not None:
             self._mesh.close()
             self._mesh = None
+            self._mesh_policy = None
         if self._client is not None:
             self._client.close()
             self._client = None

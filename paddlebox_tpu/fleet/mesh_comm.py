@@ -46,6 +46,13 @@ class MeshConnectError(ConnectionError):
     (fleet.make_mesh_comm) turns this into the COLLECTIVE store fallback."""
 
 
+class MeshPolicyMismatch(RuntimeError):
+    """Ranks rendezvous'd with DIFFERENT sharding-policy identities
+    (round 13): they would route the same key to different owners and
+    silently corrupt every exchange product — on either host plane, so
+    the caller must die loud, not fall back to the store."""
+
+
 def resolve_hostplane() -> str:
     """The validated `hostplane` flag value. A typo ('P2P', 'p2p ') would
     otherwise SILENTLY select the slow store funnel — fail loud instead."""
@@ -202,13 +209,18 @@ class MeshComm:
     # ----------------------------------------------------------- rendezvous
     def rendezvous(self, store, namespace: str, advertise_host: str,
                    positions: Iterable[int] = (),
-                   timeout: float = 120.0) -> "MeshComm":
+                   timeout: float = 120.0,
+                   policy_id: Optional[str] = None) -> "MeshComm":
         """ONE-TIME endpoint exchange through the KV store (the only step
         the store serves; every per-step exchange afterwards is direct):
-        publish "host:port" + this rank's owned mesh positions under
-        namespace/<rank>, wait for all peers', dial persistent clients."""
+        publish "host:port" + this rank's owned mesh positions (+ the
+        sharding-policy identity when given — the ownership/routing map
+        is policy-produced, so ranks must agree on the policy before the
+        first exchange) under namespace/<rank>, wait for all peers',
+        validate, dial persistent clients."""
         meta = json.dumps({"ep": "%s:%d" % (advertise_host, self.port),
-                           "pos": [int(p) for p in positions]})
+                           "pos": [int(p) for p in positions],
+                           "policy": policy_id})
         store.set("%s/%d" % (namespace, self.rank), meta.encode())
         endpoints: Dict[int, Tuple[str, int]] = {}
         for r in range(self.world):
@@ -217,6 +229,13 @@ class MeshComm:
             host, port = m["ep"].rsplit(":", 1)
             endpoints[r] = (host, int(port))
             self.positions_of[r] = [int(p) for p in m["pos"]]
+            peer_policy = m.get("policy")
+            if policy_id is not None and peer_policy != policy_id:
+                raise MeshPolicyMismatch(
+                    "sharding-policy mismatch at mesh rendezvous: rank "
+                    "%d runs %r, peer %d published %r — set the "
+                    "sharding_policy flag identically on every rank"
+                    % (self.rank, policy_id, r, peer_policy))
         self.connect(endpoints, timeout)
         return self
 

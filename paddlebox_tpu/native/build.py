@@ -123,6 +123,15 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rt_bucketize.argtypes = [c.c_void_p, P(c.c_uint64), P(c.c_uint8),
                                  c.c_int64, c.c_int32, c.c_int32,
                                  P(c.c_int32), P(c.c_int32), P(c.c_uint64)]
+    # round 13 (optional: user plugin .so files may predate it) — the
+    # policy-parameterized router: per-key shard from the caller's
+    # pre-mixed array instead of the baked-in key % P
+    if hasattr(lib, "rt_bucketize_sharded"):
+        lib.rt_bucketize_sharded.restype = c.c_int64
+        lib.rt_bucketize_sharded.argtypes = [
+            c.c_void_p, P(c.c_uint64), P(c.c_int32), P(c.c_uint8),
+            c.c_int64, c.c_int32, c.c_int32, P(c.c_int32), P(c.c_int32),
+            P(c.c_uint64)]
     lib.rt_lookup.restype = c.c_int64
     lib.rt_lookup.argtypes = [c.c_void_p, P(c.c_uint64), P(c.c_uint8),
                               c.c_int64, c.c_int32, P(c.c_int32),

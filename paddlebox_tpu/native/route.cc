@@ -121,54 +121,19 @@ struct BucketScratch {
 
 thread_local BucketScratch tls_scratch;
 
-}  // namespace
-
-extern "C" {
-
-// Build the pass index from the concatenated sorted shard key lists.
-// sk_flat: all shards' sorted pass keys, sk_off[P+1] offsets.
-void* rt_index_create(const uint64_t* sk_flat, const int64_t* sk_off,
-                      int32_t P) {
-  RouteIndex* ix = new RouteIndex();
-  int64_t total = sk_off[P];
-  ix->cap = next_pow2(static_cast<uint64_t>(total) * 2 + 8);
-  ix->mask = ix->cap - 1;
-  ix->keys = static_cast<uint64_t*>(malloc(ix->cap * 8));
-  ix->pos = static_cast<int32_t*>(malloc(ix->cap * 4));
-  if (!ix->keys || !ix->pos) {
-    delete ix;
-    return nullptr;
-  }
-  memset(ix->keys, 0xFF, ix->cap * 8);
-  for (int32_t s = 0; s < P; ++s) {
-    const uint64_t* sk = sk_flat + sk_off[s];
-    int64_t n = sk_off[s + 1] - sk_off[s];
-    for (int64_t i = 0; i < n; ++i) {
-      uint64_t k = sk[i];
-      if (k == kEmpty) {  // sentinel-colliding key lives out-of-band
-        ix->has_max_key = true;
-        ix->max_key_pos = static_cast<int32_t>(i);
-        continue;
-      }
-      uint64_t h = mix64(k) & ix->mask;
-      while (ix->keys[h] != kEmpty) h = (h + 1) & ix->mask;
-      ix->keys[h] = k;
-      ix->pos[h] = static_cast<int32_t>(i);
-    }
-  }
-  return ix;
-}
-
-void rt_index_destroy(void* p) { delete static_cast<RouteIndex*>(p); }
-
-// Routes one batch. Returns overflow occurrence count (>=0), -1 when a key
-// is not registered in the pass (first missing key -> *missing_out), -2 on
-// allocation failure.
-int64_t rt_bucketize(void* index, const uint64_t* keys, uint8_t* valid,
-                     int64_t K, int32_t P, int32_t KB,
-                     int32_t* buckets, int32_t* restore,
-                     uint64_t* missing_out) {
-  RouteIndex* ix = static_cast<RouteIndex*>(index);
+// ONE routing loop for both exported routers (round 13): the only
+// policy-dependent step is where a FIRST occurrence's shard comes from
+// — kShardFromArray=false compiles the baked k % P (rt_bucketize,
+// byte-for-byte the pre-policy behavior), true reads the caller's
+// pre-mixed shard[] (rt_bucketize_sharded) with a range check. A fix
+// to the shared dedup/overflow/sentinel logic lands in both tiers by
+// construction.
+template <bool kShardFromArray>
+inline int64_t bucketize_impl(RouteIndex* ix, const uint64_t* keys,
+                              const int32_t* shard, uint8_t* valid,
+                              int64_t K, int32_t P, int32_t KB,
+                              int32_t* buckets, int32_t* restore,
+                              uint64_t* missing_out) {
   BucketScratch& sc = tls_scratch;
   if (!sc.ensure(next_pow2(static_cast<uint64_t>(K) * 2 + 8))) {
     *missing_out = 0;
@@ -213,8 +178,18 @@ int64_t rt_bucketize(void* index, const uint64_t* keys, uint8_t* valid,
       }
       continue;
     }
-    // first occurrence in this batch
-    int32_t s = static_cast<int32_t>(k % static_cast<uint64_t>(P));
+    // first occurrence in this batch: shard per the routing policy
+    int32_t s;
+    if (kShardFromArray) {
+      s = shard[i];
+      if (s < 0 || s >= P) {
+        *missing_out = k;
+        free(fill);
+        return -3;
+      }
+    } else {
+      s = static_cast<int32_t>(k % static_cast<uint64_t>(P));
+    }
     int64_t slot;
     if (fill[s] >= KB) {
       ++overflow;
@@ -251,6 +226,76 @@ int64_t rt_bucketize(void* index, const uint64_t* keys, uint8_t* valid,
   }
   free(fill);
   return overflow;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Build the pass index from the concatenated sorted shard key lists.
+// sk_flat: all shards' sorted pass keys, sk_off[P+1] offsets.
+void* rt_index_create(const uint64_t* sk_flat, const int64_t* sk_off,
+                      int32_t P) {
+  RouteIndex* ix = new RouteIndex();
+  int64_t total = sk_off[P];
+  ix->cap = next_pow2(static_cast<uint64_t>(total) * 2 + 8);
+  ix->mask = ix->cap - 1;
+  ix->keys = static_cast<uint64_t*>(malloc(ix->cap * 8));
+  ix->pos = static_cast<int32_t*>(malloc(ix->cap * 4));
+  if (!ix->keys || !ix->pos) {
+    delete ix;
+    return nullptr;
+  }
+  memset(ix->keys, 0xFF, ix->cap * 8);
+  for (int32_t s = 0; s < P; ++s) {
+    const uint64_t* sk = sk_flat + sk_off[s];
+    int64_t n = sk_off[s + 1] - sk_off[s];
+    for (int64_t i = 0; i < n; ++i) {
+      uint64_t k = sk[i];
+      if (k == kEmpty) {  // sentinel-colliding key lives out-of-band
+        ix->has_max_key = true;
+        ix->max_key_pos = static_cast<int32_t>(i);
+        continue;
+      }
+      uint64_t h = mix64(k) & ix->mask;
+      while (ix->keys[h] != kEmpty) h = (h + 1) & ix->mask;
+      ix->keys[h] = k;
+      ix->pos[h] = static_cast<int32_t>(i);
+    }
+  }
+  return ix;
+}
+
+void rt_index_destroy(void* p) { delete static_cast<RouteIndex*>(p); }
+
+// Routes one batch with the baked key % P shard (the BoxPS layout; the
+// key-mod ShardingPolicy's tier). Returns overflow occurrence count
+// (>=0), -1 when a key is not registered in the pass (first missing
+// key -> *missing_out), -2 on allocation failure.
+int64_t rt_bucketize(void* index, const uint64_t* keys, uint8_t* valid,
+                     int64_t K, int32_t P, int32_t KB,
+                     int32_t* buckets, int32_t* restore,
+                     uint64_t* missing_out) {
+  return bucketize_impl<false>(static_cast<RouteIndex*>(index), keys,
+                               nullptr, valid, K, P, KB, buckets,
+                               restore, missing_out);
+}
+
+// Policy-parameterized router (round 13, 2-D sparse parallelism): the
+// owning shard of each first occurrence comes from the caller-provided
+// shard[] array (the ShardingPolicy's vectorized numpy shard_of,
+// pre-mixed once per batch) — the shared native dedup/bucket-fill loop
+// keeps its rate under any routing policy. Returns like rt_bucketize,
+// plus -3 when a shard value falls outside [0, P) (a policy bug must
+// fail loud, not write past the bucket array).
+int64_t rt_bucketize_sharded(void* index, const uint64_t* keys,
+                             const int32_t* shard, uint8_t* valid,
+                             int64_t K, int32_t P, int32_t KB,
+                             int32_t* buckets, int32_t* restore,
+                             uint64_t* missing_out) {
+  return bucketize_impl<true>(static_cast<RouteIndex*>(index), keys,
+                              shard, valid, K, P, KB, buckets, restore,
+                              missing_out);
 }
 
 // Plain key -> pass-local id translation over the pass index (the
@@ -385,29 +430,60 @@ int64_t rt_dedup(const int32_t* ids, int64_t K, int32_t pad_base,
 // motivating shape) faults in only the pages its uniques actually touch
 // instead of paying a full-table memset per call. The mark is one
 // predictable byte store per occurrence — no probe chain, no key
-// compare. This tier exists exactly where it wins (measured best-of-7,
-// BASELINE.md round 11): DUPLICATED batches, pad_base at most half the
-// batch (guaranteed mean dup >= 2). At pad_base == K the sort it saves
-// no longer covers the presence-table faults and np.unique wins ~1.3x,
-// widening with sparsity — the kernel declines (-1) and the caller
-// keeps its numpy tier.
+// compare.
+//
+// ENGAGEMENT (re-keyed round 13, the PR-6 named follow-up): the round-11
+// predicate declined whenever 2*pad_base > K, which at the wired callers
+// (pad_base = table/shard capacity, ids = pass-local slab ids) meant the
+// tier only engaged when a batch carried >= 2x the CAPACITY in
+// occurrences — production shapes never did. But the presence-table cost
+// the predicate guards tracks the live id SPAN (the pages the marks
+// touch), not pad_base: pass-local ids cluster in [0, working set), with
+// exactly one far outlier — the trash id pad_base-1 the bucket padding
+// carries. A one-pass top-two scan finds that span: when max1 is the
+// trash id it rides OUT-OF-BAND (a bool + one append after the sort —
+// it is by construction the largest representable id, so it sorts last)
+// and span = max2+1; otherwise span = max1+1. Decline when
+// 2*span > K: since n_unique <= span, engaging guarantees mean
+// duplication K/n_unique >= K/span >= 2 — the measured-win regime
+// (K/n_unique is not computable before deduping; the span is its
+// cheapest sound upper bound). The round-11 benchmark shapes (ids
+// spread over the full [0, pad_base)) keep their old decline; the wired
+// production shapes now engage (BASELINE.md round 13).
 //   uids[K]  ascending uniques, tail padded with pad_base+i
 //   scratch  caller int64[K] (>= n_u int32 ping-pong buffer)
-// Returns the unique count, -1 when declining (shape, or an id outside
-// [0, pad_base) — the presence table is exactly pad_base bytes, so an
-// out-of-contract id must fall back to the numpy tier rather than
-// write past it), -2 on allocation failure.
+// Returns the unique count, -1 when declining (low-duplication span, or
+// an id outside [0, pad_base) — out-of-contract input must fall back to
+// the numpy tier rather than write past the presence table), -2 on
+// allocation failure.
 int64_t rt_dedup_sorted(const int32_t* ids, int64_t K, int32_t pad_base,
                         int32_t* uids, int64_t* scratch) {
-  if (static_cast<int64_t>(pad_base) * 2 > K) return -1;
-  uint8_t* seen = static_cast<uint8_t*>(calloc(pad_base, 1));
+  // O(K) prepass: contract check + top-two distinct ids -> live span
+  int64_t max1 = -1, max2 = -1;
+  for (int64_t i = 0; i < K; ++i) {
+    int32_t id = ids[i];
+    if (static_cast<uint32_t>(id) >= static_cast<uint32_t>(pad_base))
+      return -1;  // unsigned compare also catches id < 0
+    if (id > max1) {
+      max2 = max1;
+      max1 = id;
+    } else if (id < max1 && id > max2) {
+      max2 = id;
+    }
+  }
+  const bool oob_trash = (max1 == pad_base - 1 && max2 < max1);
+  const int64_t span = oob_trash ? max2 + 1 : max1 + 1;
+  if (span * 2 > K) return -1;
+  bool seen_trash = false;
+  uint8_t* seen =
+      static_cast<uint8_t*>(span ? calloc(span, 1) : malloc(1));
   if (!seen) return -2;
   int64_t n_u = 0;
   for (int64_t i = 0; i < K; ++i) {
     int32_t id = ids[i];
-    if (static_cast<uint32_t>(id) >= static_cast<uint32_t>(pad_base)) {
-      free(seen);
-      return -1;  // unsigned compare also catches id < 0
+    if (oob_trash && id == max1) {  // trash id: out-of-band presence
+      seen_trash = true;
+      continue;
     }
     if (!seen[id]) {
       seen[id] = 1;
@@ -439,6 +515,9 @@ int64_t rt_dedup_sorted(const int32_t* ids, int64_t K, int32_t pad_base,
     b = t;
   }
   if (a != uids) memcpy(uids, a, static_cast<size_t>(n_u) * 4);
+  // the out-of-band trash id is larger than every in-table id by
+  // construction — appending keeps the vector strictly ascending
+  if (seen_trash) uids[n_u++] = pad_base - 1;
   for (int64_t i = n_u; i < K; ++i)
     uids[i] = pad_base + static_cast<int32_t>(i - n_u);
   return n_u;

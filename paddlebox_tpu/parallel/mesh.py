@@ -25,6 +25,11 @@ from paddlebox_tpu.config.configs import MeshConfig
 BOX_AXIS = "dp"
 # the inter-node (DCN) axis of the hierarchical 2D mesh
 NODE_AXIS = "node"
+# the 2-D sparse-parallelism grid axes: ONE declaration site
+# (parallel/sharding.py, jax-free at import, so no cycle) — a
+# TwoDGridPolicy slab shards dim 0 over (table, row) when the mesh
+# declares them; re-exported here next to the other axis names
+from paddlebox_tpu.parallel.sharding import ROW_AXIS, TABLE_AXIS  # noqa: E402,F401
 
 _distributed_initialized = False
 
@@ -96,6 +101,25 @@ def device_mesh_2d(n_nodes: Optional[int] = None,
             f"have {len(devs)} devices")
     return Mesh(np.array(devs[:need]).reshape(n_nodes, chips_per_node),
                 (node_axis, chip_axis))
+
+
+def device_mesh_grid(table_groups: int, rows: int,
+                     table_axis: str = TABLE_AXIS,
+                     row_axis: str = ROW_AXIS) -> Mesh:
+    """(table, row) grid mesh for the 2-D sparse-parallelism layout
+    (sharding.TwoDGridPolicy): shard position t*rows + r lands on mesh
+    coordinate (t, r) — the linearization the policy's shard_of bakes,
+    so a [P, C, W] slab stack sharded P((table, row)) places each shard
+    on the same device the flat key-mod layout would (pinned by
+    tests/test_sharding_policy.py)."""
+    devs = jax.devices()
+    need = table_groups * rows
+    if need > len(devs) or table_groups < 1 or rows < 1:
+        raise ValueError(
+            f"grid mesh needs {table_groups} x {rows} devices, "
+            f"have {len(devs)}")
+    return Mesh(np.array(devs[:need]).reshape(table_groups, rows),
+                (table_axis, row_axis))
 
 
 def make_mesh(cfg: MeshConfig) -> Mesh:

@@ -1094,13 +1094,22 @@ class ShardedCtrPipelineRunner:
             self.local_rows = sorted(rows)
         else:
             self.local_rows = list(range(self.dp))
+        # 2-D sparse sharding policy (round 13; see ShardedBoxTrainer)
+        from paddlebox_tpu.parallel.sharding import (
+            resolve_sharding_policy, validate_policy_agreement)
+        self.policy = resolve_sharding_policy(self.P)
         # p2p host data plane (round 9; see ShardedBoxTrainer): None =
         # the store-allgather plane (flag 'store' or collective fallback)
         from paddlebox_tpu.fleet.mesh_comm import resolve_hostplane
         self.host_mesh = (
-            fleet.make_mesh_comm(self.local_positions)
+            fleet.make_mesh_comm(self.local_positions,
+                                 policy_id=self.policy.describe())
             if self.multiprocess and resolve_hostplane() == "p2p"
             else None)
+        if self.multiprocess and self.host_mesh is None:
+            # store plane never rendezvouses — validate the policy
+            # identity across ranks here instead
+            validate_policy_agreement(fleet, self.policy)
         kcap = feed.key_capacity()
         self.bucket_cap = bucket_cap or max(
             16, (2 * self.m_local * kcap) // self.P)
@@ -1108,7 +1117,7 @@ class ShardedCtrPipelineRunner:
             table_cfg, self.P, self.bucket_cap, seed=seed,
             owned_shards=(self.local_positions if self.multiprocess
                           else None),
-            store_factory=store_factory)
+            store_factory=store_factory, policy=self.policy)
         # resolved ONCE — per-batch re-resolution would let a mid-pass flag
         # flip change the batch pytree (retrace of the shard_map step) and
         # mix write modes inside one pass (same policy as the trainers)
@@ -1393,10 +1402,13 @@ class ShardedCtrPipelineRunner:
         dp rows × n_micro; every row in a single process)."""
         return len(self.local_rows) * self.n_micro
 
-    def _put_flat(self, host_local: np.ndarray) -> jnp.ndarray:
+    def _put_flat(self, host_local: np.ndarray,
+                  sharding=None) -> jnp.ndarray:
         """Local [L, ...] per-device rows → global [P, ...] on the
-        flattened table axis (plain device_put in a single process)."""
-        sh = NamedSharding(self.mesh, P(self.flat_axes))
+        flattened table axis (plain device_put in a single process).
+        sharding overrides the default P(flat) placement (the slab put
+        rides the policy's layout)."""
+        sh = sharding or NamedSharding(self.mesh, P(self.flat_axes))
         if not self.multiprocess:
             return jax.device_put(host_local, sh)
         return jax.make_array_from_process_local_data(
@@ -1477,16 +1489,21 @@ class ShardedCtrPipelineRunner:
                 note_touched=self.table.note_touched,
                 uid_only=bool(flags.get_flag("h2d_uid_wire")),
                 mesh=self.host_mesh,
-                sort_uids=self._push_write == "blocked"))
+                sort_uids=self._push_write == "blocked",
+                policy=self.policy))
         return {k: self._put_flat(np.stack(v)) for k, v in leaves.items()}
 
     def begin_pass(self) -> None:
         """BeginPass: promote the feed pass's key set into the sharded
         [P, C, W] slab stack on the mesh (owned shards only in a
-        multi-process job)."""
+        multi-process job). The slab's device layout is the sharding
+        policy's decision (c) — P(flat) for every policy on the
+        (dp, stage) meshes this runner builds."""
         self._slabs = self._put_flat(
             self.table.build_owned_slabs() if self.multiprocess
-            else self.table.build_slabs())
+            else self.table.build_slabs(),
+            sharding=self.policy.slab_sharding(self.mesh,
+                                               self.flat_axes))
 
     def end_pass(self) -> None:
         """EndPass: device slabs → shard stores, then the spill check.

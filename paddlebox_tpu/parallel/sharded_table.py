@@ -140,28 +140,20 @@ def exchange_outgoing_buckets(buckets_local: np.ndarray,
     return out
 
 
-def _mesh_dest_plan(mesh, local_positions, num_devices: int):
-    """Per-peer destination lists for the p2p exchanges, validated against
-    the rendezvous'd ownership map: every mesh position must have exactly
-    one owner or the a2a would silently drop shards."""
-    owner = mesh.rank_of_position()
-    missing = [d for d in range(num_devices) if d not in owner]
-    if missing:
-        raise RuntimeError(
-            "p2p host plane: mesh positions %s have no owning rank "
-            "(rendezvous positions incomplete)" % missing)
-    if sorted(mesh.positions_of.get(mesh.rank, [])) != sorted(
-            local_positions):
-        raise RuntimeError(
-            "p2p host plane: this rank rendezvous'd positions %s but is "
-            "staging for %s" % (mesh.positions_of.get(mesh.rank),
-                                list(local_positions)))
-    return [mesh.positions_of[r] for r in range(mesh.world)]
+def _mesh_dest_plan(mesh, local_positions, num_devices: int, policy=None):
+    """Per-peer destination lists for the p2p exchanges. Round 13: the
+    plan is POLICY-OWNED (parallel/sharding.py) — the policy decides
+    which peers a rank exchanges with; `None` keeps the validated
+    owner-map default every shipped policy rides (and the pre-policy
+    behavior, bit-for-bit)."""
+    from paddlebox_tpu.parallel.sharding import default_dest_plan
+    plan = policy.dest_plan if policy is not None else default_dest_plan
+    return plan(mesh, local_positions, num_devices)
 
 
 def exchange_incoming_p2p(buckets_local: np.ndarray,
                           local_positions: List[int],
-                          num_devices: int, mesh):
+                          num_devices: int, mesh, policy=None):
     """P2P twin of exchange_outgoing_buckets (the tentpole a2a): rank r
     ships the owner of destination shard d ONLY its buckets[:, d, :]
     column — O(W*P*KB) direct bytes per step instead of every rank's full
@@ -175,7 +167,8 @@ def exchange_incoming_p2p(buckets_local: np.ndarray,
     import time as _time
     bl = np.ascontiguousarray(buckets_local, np.int32)
     n_local, P, KB = bl.shape
-    dest_of_rank = _mesh_dest_plan(mesh, local_positions, num_devices)
+    dest_of_rank = _mesh_dest_plan(mesh, local_positions, num_devices,
+                                   policy)
     t0 = _time.perf_counter()
     parts = {}
     for r, dests in enumerate(dest_of_rank):
@@ -223,7 +216,7 @@ def exchange_incoming_p2p(buckets_local: np.ndarray,
 
 def exchange_push_uids_p2p(buckets_local: np.ndarray,
                            local_positions: List[int], num_devices: int,
-                           shard_cap: int, mesh, pool=None):
+                           shard_cap: int, mesh, pool=None, policy=None):
     """Dedup BEFORE the network (composes the round-8 uid wire with the
     p2p mesh): for every destination shard this rank sorts-uniques its
     LOCAL contribution and ships the owner only that vector; the owner
@@ -235,7 +228,18 @@ def exchange_push_uids_p2p(buckets_local: np.ndarray,
 
     pool: optional thread pool for the num_devices sender-side np.unique
     calls (the dominant pre-wire cost; the sort releases the GIL) — the
-    runners pass their stager pool."""
+    runners pass their stager pool.
+
+    policy (round 13): a parallel/sharding.ShardingPolicy — owns the
+    per-peer dest plan, and when it carries a frozen replicated hot tier
+    (2d-grid) the hot local ids are DROPPED from every shipped vector
+    and re-added whole by the owner: replicated rows never travel, and
+    since the hot set is cluster-agreed at the pass freeze the union
+    still covers every id the destination's device a2a will carry. The
+    staged vector over-approximates by hot ids that skipped this step —
+    their merged gradients are zero, a value-level no-op in the
+    in-table optimizer (the replication premise: hot rows are touched
+    essentially every step)."""
     import time as _time
     bl = np.ascontiguousarray(buckets_local, np.int32)
     n_local, P, KB = bl.shape
@@ -246,11 +250,24 @@ def exchange_push_uids_p2p(buckets_local: np.ndarray,
     if bl.size and int(bl.min()) < 0:
         raise ValueError("exchange_push_uids_p2p expects nonnegative "
                          "int32 pass-local ids")
-    dest_of_rank = _mesh_dest_plan(mesh, local_positions, num_devices)
+    dest_of_rank = _mesh_dest_plan(mesh, local_positions, num_devices,
+                                   policy)
+    hot_of = (policy.hot_local_ids if policy is not None
+              else (lambda d: None))
     t0 = _time.perf_counter()
     mapper = pool.map if pool is not None else map
-    uniq_of = list(mapper(lambda d: np.unique(bl[:, d, :]),
-                          range(num_devices)))
+
+    def uniq_dest(d):
+        from paddlebox_tpu.embedding.pass_table import sorted_member
+        u = np.unique(bl[:, d, :])
+        hot = hot_of(d)
+        if hot is not None and hot.size and u.size:
+            # replicated ids never travel: both vectors sorted, one
+            # membership probe
+            u = u[~sorted_member(hot, u)[1]]
+        return u
+
+    uniq_of = list(mapper(uniq_dest, range(num_devices)))
     parts = {}
     for r, dests in enumerate(dest_of_rank):
         uniqs = [uniq_of[d] for d in dests]
@@ -273,9 +290,18 @@ def exchange_push_uids_p2p(buckets_local: np.ndarray,
             vecs[d].append(part[offs[j]:offs[j + 1]])
     out = {}
     for d in mine:
+        hot = hot_of(d)
+        if hot is not None and hot.size:
+            # the owner re-adds its whole replicated set (sorted int32)
+            vecs[d].append(np.asarray(hot, np.int32))
         uniq = np.unique(np.concatenate(vecs[d]))
         uids = np.empty(K, np.int32)
         n = uniq.size
+        if n > K:
+            raise RuntimeError(
+                "p2p uid exchange: union of %d incoming + replicated "
+                "ids exceeds the staged vector length %d for dest %d — "
+                "sharding_hot_cap/bucket_cap are inconsistent" % (n, K, d))
         uids[:n] = uniq
         uids[n:] = shard_cap + np.arange(K - n, dtype=np.int32)
         out[d] = uids
@@ -295,7 +321,7 @@ def stage_push_dedup(buckets, local_positions, num_devices: int,
                      shard_cap: int, multiprocess: bool, all_gather,
                      rebuild: bool, pool, note_touched=None,
                      uid_only: bool = False, mesh=None,
-                     sort_uids: bool = False):
+                     sort_uids: bool = False, policy=None):
     """Per-destination push-dedup staging shared by BOTH sharded runners
     (trainer's _step_host_arrays + pipeline's device_batch): makes each
     shard's incoming a2a ids host-known (exchange_outgoing_buckets when
@@ -321,7 +347,12 @@ def stage_push_dedup(buckets, local_positions, num_devices: int,
     per-destination PRE-DEDUPED sorted uid vectors under uid_only (dedup
     moves before the network). Staging products are bit-identical to the
     store path either way. None = the store allgather (the loud-fallback
-    target)."""
+    target).
+
+    policy (round 13): the ShardingPolicy that routed these buckets —
+    the p2p exchanges ride its dest plan and (2d-grid) its replicated
+    hot-key wire filter. None = the key-mod-equivalent default plan
+    (bit-identical to the pre-policy path)."""
     from paddlebox_tpu.embedding.pass_table import (dedup_ids,
                                                     dedup_uids_sorted,
                                                     pos_for_rebuild)
@@ -331,10 +362,11 @@ def stage_push_dedup(buckets, local_positions, num_devices: int,
         if mesh is not None and uid_only:
             uids_by_dest = exchange_push_uids_p2p(
                 np.stack(buckets), local_positions, num_devices,
-                shard_cap, mesh, pool=pool)
+                shard_cap, mesh, pool=pool, policy=policy)
         elif mesh is not None:
             inc = exchange_incoming_p2p(
-                np.stack(buckets), local_positions, num_devices, mesh)
+                np.stack(buckets), local_positions, num_devices, mesh,
+                policy=policy)
         else:
             global_buckets = exchange_outgoing_buckets(
                 np.stack(buckets), local_positions, num_devices,
@@ -393,7 +425,7 @@ class ShardedPassTable:
     def __init__(self, table: TableConfig, num_shards: int,
                  bucket_cap: int, seed: int = 0,
                  owned_shards: Optional[List[int]] = None,
-                 store_factory=None) -> None:
+                 store_factory=None, policy=None) -> None:
         """owned_shards: in a multi-process job each process hosts the full
         store only for the shards whose mesh device it owns (the reference's
         per-node PS shard layout); None = own all (single process). Routing
@@ -403,7 +435,19 @@ class ShardedPassTable:
         store_factory(layout, table, seed) -> store overrides the default
         local host store — e.g. embedding.ps_store.ps_store_factory puts
         the distributed CPU PS behind every shard (the GPUPS BuildPull/
-        EndPass composition, ps_gpu_wrapper.cc:337,983)."""
+        EndPass composition, ps_gpu_wrapper.cc:337,983).
+
+        policy (round 13): the parallel/sharding.ShardingPolicy that owns
+        key->shard routing (feed-pass assignment, per-batch bucketize,
+        promote prefetch, checkpoint views all route through it); None =
+        resolve from the sharding_policy flag (default key-mod, bit-
+        identical to the pre-policy key % P path)."""
+        from paddlebox_tpu.parallel.sharding import resolve_sharding_policy
+        self.policy = policy or resolve_sharding_policy(num_shards)
+        if self.policy.num_shards != num_shards:
+            raise ValueError(
+                "sharding policy built for %d shards, table has %d"
+                % (self.policy.num_shards, num_shards))
         self.config = table
         from paddlebox_tpu.embedding.pass_table import _slab_embed_dtype
         self.layout = ValueLayout(table.embedx_dim, table.optimizer.optimizer,
@@ -467,7 +511,13 @@ class ShardedPassTable:
     def add_keys(self, keys: np.ndarray) -> None:
         if not self._in_feed_pass:
             raise RuntimeError("add_keys outside feed pass")
-        self._feed_keys.append(np.asarray(keys, dtype=np.uint64))
+        keys = np.asarray(keys, dtype=np.uint64)
+        self._feed_keys.append(keys)
+        if self.policy.wants_observe:
+            # the 2d-grid hot tier's frequency stream (reader threads;
+            # the sketch locks internally). Rank-local counts are summed
+            # cluster-wide at end_feed_pass before the hot set freezes.
+            self.policy.observe(keys)
 
     def end_feed_pass(self, allgather=None) -> None:
         """allgather: optional host collective (fleet.all_gather) used to
@@ -485,15 +535,25 @@ class ShardedPassTable:
                 [np.asarray(p, np.uint64) for p in parts]))
         else:
             allk = local
-        P = np.uint64(self.num_shards)
+        # policy-owned shard assignment (round 13): key-mod reproduces
+        # allk % P bit-for-bit; selecting by mask keeps each shard's
+        # list sorted (allk is sorted)
+        shard = self.policy.shard_of(allk)
         self._shard_keys = []
         for s in range(self.num_shards):
-            ks = allk[allk % P == np.uint64(s)]  # sorted (allk sorted)
+            ks = allk[shard == s]
             if ks.size > self.shard_cap - 1:
                 raise RuntimeError(
                     f"shard {s} working set {ks.size} exceeds shard capacity "
                     f"{self.shard_cap} (raise TableConfig.pass_capacity)")
             self._shard_keys.append(ks)
+        # the replicated hot tier (2d-grid) freezes HERE — the one
+        # boundary where every rank agrees on the global key set; the
+        # rank-local sketches merge over the same collective first so
+        # the frozen hot sets are cluster-identical
+        if allgather is not None and self.policy.wants_observe:
+            self.policy.merge_observations(allgather)
+        self.policy.freeze_hot(self._shard_keys)
         self._drop_route_index()
         # native pass index (key → slab-local id hash map): built once here,
         # amortized over every batch of the pass
@@ -775,12 +835,12 @@ class ShardedPassTable:
         # handle can be destroyed by an interleaved eval pass while the
         # prefetch thread is mid-probe; the arrays stay alive here
         snapshot = [np.asarray(k) for k in self._shard_keys]
-        P = np.uint64(self.num_shards)
+        policy = self.policy
 
         def known(keys: np.ndarray) -> np.ndarray:
             from paddlebox_tpu.embedding.pass_table import sorted_member
             out = np.zeros(keys.size, bool)
-            shard = (keys % P).astype(np.int64)
+            shard = policy.shard_of(keys)
             for s in range(self.num_shards):
                 m = shard == s
                 if m.any():
@@ -808,14 +868,20 @@ class ShardedPassTable:
 
     # ---------------------------------------------------------- batch index
     def bucketize(self, keys: np.ndarray, valid: np.ndarray) -> ShardedBatchIndex:
-        """Route one batch's keys: shard = key % P (split_input_to_shard,
-        heter_comm_inl.h:1117), local id by searchsorted in the shard's
-        sorted pass key list, batch-level dedup into bucket slots.
+        """Route one batch's keys: shard = policy.shard_of(key) (key-mod
+        default = split_input_to_shard, heter_comm_inl.h:1117), local id
+        by searchsorted in the shard's sorted pass key list, batch-level
+        dedup into bucket slots.
 
-        Native route.cc when built (pass-indexed hash, ~13M keys/sec at the
-        reference's 1800×2048 budget) with a vectorized numpy fallback (the
-        host analog of the reference's on-device dedup_keys_and_fillidx,
-        heter_comm_inl.h:2231; the round-1 per-key dict loop managed ~0.5M).
+        Native route.cc when built (pass-indexed hash, ~13M keys/sec at
+        the reference's 1800×2048 budget): the key-mod policy keeps the
+        legacy rt_bucketize (identical code path = pre-policy
+        bit-parity); every other policy pre-mixes its per-key shard
+        array vectorized and runs rt_bucketize_sharded — the native
+        dedup/bucket loop at rate under any routing. Vectorized numpy
+        fallback (the host analog of the reference's on-device
+        dedup_keys_and_fillidx, heter_comm_inl.h:2231; the round-1
+        per-key dict loop managed ~0.5M).
         Mutates `valid` in place to drop occurrences of overflowed keys.
         WHICH keys overflow when a shard bucket fills is unspecified (native
         drops late first-occurrences, numpy drops the largest key values) —
@@ -828,24 +894,45 @@ class ShardedPassTable:
         restore = np.zeros(keys.shape[0], dtype=np.int32)
 
         native = _route_lib()
-        if native is not None and self._route_index is not None:
+        keymod = self.policy.native_keymod
+        if (native is not None and self._route_index is not None
+                and (keymod or hasattr(native, "rt_bucketize_sharded"))):
             import ctypes
             c = ctypes
             keys_c = np.ascontiguousarray(keys, dtype=np.uint64)
             if valid.dtype != np.bool_ or not valid.flags.c_contiguous:
                 raise TypeError("valid must be a contiguous bool array")
             missing = np.zeros(1, np.uint64)
-            rc = native.rt_bucketize(
-                self._route_index,
-                keys_c.ctypes.data_as(c.POINTER(c.c_uint64)),
-                valid.view(np.uint8).ctypes.data_as(c.POINTER(c.c_uint8)),
-                keys_c.size, P, KB,
-                buckets.ctypes.data_as(c.POINTER(c.c_int32)),
-                restore.ctypes.data_as(c.POINTER(c.c_int32)),
-                missing.ctypes.data_as(c.POINTER(c.c_uint64)))
+            if keymod:
+                rc = native.rt_bucketize(
+                    self._route_index,
+                    keys_c.ctypes.data_as(c.POINTER(c.c_uint64)),
+                    valid.view(np.uint8).ctypes.data_as(
+                        c.POINTER(c.c_uint8)),
+                    keys_c.size, P, KB,
+                    buckets.ctypes.data_as(c.POINTER(c.c_int32)),
+                    restore.ctypes.data_as(c.POINTER(c.c_int32)),
+                    missing.ctypes.data_as(c.POINTER(c.c_uint64)))
+            else:
+                shard_c = np.ascontiguousarray(
+                    self.policy.shard_of(keys_c), np.int32)
+                rc = native.rt_bucketize_sharded(
+                    self._route_index,
+                    keys_c.ctypes.data_as(c.POINTER(c.c_uint64)),
+                    shard_c.ctypes.data_as(c.POINTER(c.c_int32)),
+                    valid.view(np.uint8).ctypes.data_as(
+                        c.POINTER(c.c_uint8)),
+                    keys_c.size, P, KB,
+                    buckets.ctypes.data_as(c.POINTER(c.c_int32)),
+                    restore.ctypes.data_as(c.POINTER(c.c_int32)),
+                    missing.ctypes.data_as(c.POINTER(c.c_uint64)))
             if rc == -1:
                 raise KeyError(
                     f"key {int(missing[0])} not registered in feed pass")
+            if rc == -3:
+                raise ValueError(
+                    "sharding policy %s produced an out-of-range shard "
+                    "for key %d" % (self.policy.name, int(missing[0])))
             if rc < 0:
                 raise MemoryError("rt_bucketize scratch allocation failed")
             if rc:
@@ -858,7 +945,7 @@ class ShardedPassTable:
             return ShardedBatchIndex(buckets=buckets, restore=restore,
                                      overflow=0)
         uniq, inv = np.unique(keys[idx], return_inverse=True)
-        shard = (uniq % np.uint64(P)).astype(np.int64)
+        shard = self.policy.shard_of(uniq).astype(np.int64)
         counts = np.bincount(shard, minlength=P)
         starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
         # uniq is sorted, so a stable sort by shard keeps keys sorted within
@@ -1021,7 +1108,7 @@ class _ShardLookupFacade:
         t = self._table
         out = np.zeros((keys.size, t.layout.width), np.float32)
         found = np.zeros(keys.size, bool)
-        shard = (keys % np.uint64(t.num_shards)).astype(np.int64)
+        shard = t.policy.shard_of(keys)
         for s in t.owned_shards:
             st = t.stores[s]
             if st is None or not hasattr(st, "lookup_present"):
@@ -1035,9 +1122,11 @@ class _ShardLookupFacade:
 class ShardedStoreView:
     """state_items/write_back/spilled_snapshot/load over a
     ShardedPassTable's OWNED shard stores — the store protocol subset the
-    checkpoint tier consumes. Keys route by key % P, identical to the
-    table's own sharding, so a view round trip lands every row in its
-    owning store."""
+    checkpoint tier consumes. Keys route by the table's sharding POLICY
+    (identical to the a2a routing), so a view round trip lands every row
+    in its owning store — and a checkpoint written under one policy
+    redistributes automatically when loaded under another (write_back/
+    load route by the live policy, not the one that wrote the blob)."""
 
     def __init__(self, table: ShardedPassTable) -> None:
         self._table = table
@@ -1074,9 +1163,9 @@ class ShardedStoreView:
         # longer mirror the stores afterwards
         self._table.invalidate_residency()
         keys = np.asarray(keys, np.uint64)
-        P = np.uint64(self._table.num_shards)
+        shard = self._table.policy.shard_of(keys)
         for s, st in self._owned():
-            m = keys % P == np.uint64(s)
+            m = shard == s
             if m.any():
                 st.write_back(keys[m], values[m])
 
@@ -1089,7 +1178,7 @@ class ShardedStoreView:
         with open(path, "rb") as f:
             blob = pickle.load(f)
         keys = np.asarray(blob["keys"], np.uint64)
-        P = np.uint64(self._table.num_shards)
+        shard = self._table.policy.shard_of(keys)
         for s, st in self._owned():
-            m = keys % P == np.uint64(s)
+            m = shard == s
             st.load_blob(dict(blob, keys=keys[m], values=blob["values"][m]))

@@ -118,18 +118,31 @@ class ShardedBoxTrainer:
         # store (fleet/mesh_comm.py); None = the store-allgather plane
         # (hostplane=store, or the collective loud fallback on a failed
         # bring-up — make_mesh_comm warns and every rank reverts together)
+        # 2-D sparse sharding policy (round 13, parallel/sharding.py):
+        # owns key->shard routing, the p2p dest plan and the device slab
+        # layout; key-mod (default) is bit-identical to the pre-policy
+        # path. Resolved ONCE — the policy identity also rides the p2p
+        # rendezvous so a split flag across ranks fails at bring-up.
+        from paddlebox_tpu.parallel.sharding import (
+            resolve_sharding_policy, validate_policy_agreement)
+        self.policy = resolve_sharding_policy(self.P)
         from paddlebox_tpu.fleet.mesh_comm import resolve_hostplane
         self.host_mesh = (
-            fleet.make_mesh_comm(self.local_positions)
+            fleet.make_mesh_comm(self.local_positions,
+                                 policy_id=self.policy.describe())
             if self.multiprocess and resolve_hostplane() == "p2p"
             else None)
+        if self.multiprocess and self.host_mesh is None:
+            # store plane (flag or collective fallback) never
+            # rendezvouses — validate the policy identity here instead
+            validate_policy_agreement(fleet, self.policy)
         kcap = feed.key_capacity()
         # bucket slack over the uniform K/P expectation (hash imbalance)
         self.bucket_cap = bucket_cap or max(16, (2 * kcap) // self.P)
         self.table = ShardedPassTable(
             table_cfg, self.P, self.bucket_cap, seed=seed,
             owned_shards=self.local_positions if self.multiprocess else None,
-            store_factory=store_factory)
+            store_factory=store_factory, policy=self.policy)
         self.metrics = MetricRegistry()
         # scatter-free slab write (push_write flag; see BoxTrainer)
         from paddlebox_tpu.train.trainer import resolve_push_write_sharded
@@ -768,7 +781,8 @@ class ShardedBoxTrainer:
                 note_touched=self.table.note_touched,
                 uid_only=bool(flags.get_flag("h2d_uid_wire")),
                 mesh=self.host_mesh,
-                sort_uids=self._push_write == "blocked"))
+                sort_uids=self._push_write == "blocked",
+                policy=self.policy))
         return {k: np.stack(v) for k, v in stacked.items()}
 
     def shard_batches(self, per_worker: List[List[PackedBatch]],
@@ -853,7 +867,11 @@ class ShardedBoxTrainer:
             dataset.load_into_memory(add_keys_fn=self.table.add_keys)
             self.table.end_feed_pass(allgather=allgather)
         self.timers["build"].start()
-        sharding = NamedSharding(self.mesh, P(self.axis))
+        # slab device layout is the policy's decision (c): key-mod (and
+        # every policy on a flat/hier mesh) = P(axis), the pre-policy
+        # layout; the 2d grid expresses itself over (table, row) axes
+        # where a mesh declares them
+        sharding = self.policy.slab_sharding(self.mesh, self.axis)
         self._slabs = self._put_sharded(
             self.table.build_owned_slabs() if self.multiprocess
             else self.table.build_slabs(), sharding)
@@ -1023,7 +1041,7 @@ class ShardedBoxTrainer:
             self.table.begin_feed_pass()
             self.table.add_keys(dataset.all_keys())
             self.table.end_feed_pass(allgather=allgather)
-            sharding = NamedSharding(self.mesh, P(self.axis))
+            sharding = self.policy.slab_sharding(self.mesh, self.axis)
             slabs = self._put_sharded(
                 self.table.build_owned_slabs() if self.multiprocess
                 else self.table.build_slabs(), sharding)

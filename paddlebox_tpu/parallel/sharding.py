@@ -1,0 +1,546 @@
+"""Pluggable 2-D sparse sharding policies over the sharded pass table.
+
+"Two-dimensional Sparse Parallelism" (PAPERS.md) shows that the flat
+key-mod layout — every key hashes to a random device, so every rank
+talks to every rank for every table — is what caps DLRM sparse scaling,
+and that a table-axis x row-axis grid is what scales past it: a table's
+traffic confines to its grid sub-axis, and hot long-tail tables can be
+REPLICATED instead of routed (HierarchicalKV's cache-semantics store in
+PAPERS.md is the model for the replicated hot tier).
+
+This module owns the three decisions that used to be baked into
+parallel/sharded_table.py as ``key % P``:
+
+  (a) ROUTE   — which shard position owns a key (``shard_of``), consumed
+      by the batch bucketize on both its native tier (route.cc
+      ``rt_bucketize`` for key-mod bit-parity; the policy-parameterized
+      ``rt_bucketize_sharded`` for everything else — the per-key shard
+      is pre-mixed vectorized in numpy so the native dedup/bucket loop
+      keeps its rate) and its numpy fallback, plus every host-side
+      router twin (feed-pass shard assignment, promote prefetch,
+      checkpoint store view).
+  (b) EXCHANGE — which peers a rank exchanges with (``dest_plan``: the
+      per-peer destination lists the p2p host plane ships along), plus
+      the replicated-hot-key wire filter (``hot_local_ids``): globally
+      replicated hot rows never travel — senders drop them pre-wire and
+      owners re-add them from the replicated set.
+  (c) LAYOUT  — how the device-side [P, C, W] slab stack is laid out
+      (``slab_spec``/``slab_sharding``, the GSPMD NamedSharding idiom
+      from SNIPPETS.md [2]/[3]): key-mod shards dim 0 over the flat box
+      axis; the 2-D grid expresses the same linearized layout over
+      dedicated ``table`` x ``row`` mesh axes when the mesh declares
+      them.
+
+Three shipped policies:
+
+  key-mod     shard = key % P. Bit-identical to the pre-policy path on
+              both wire modes (pinned by tests/test_sharding_policy.py)
+              — the parity oracle every other policy is measured
+              against.
+  table-wise  shard = table(key) % P: each table lives WHOLE on one
+              shard, so a table's sparse traffic flows only to its
+              owner (zero cross-group traffic per table). Total routed
+              bytes are conserved vs key-mod (every occurrence still
+              reaches one owner) but the per-table confinement is what
+              unlocks heterogeneous worlds — big tables on few ranks.
+  2d-grid     shard = table_group(key) * R + (key % R): table axis x
+              row axis. Row-wise splitting inside a table group
+              rebalances the skew table-wise alone concentrates, and
+              the frequency-sketch hot tier (the serving cache's
+              TinyLFU sketch machinery, serving/cache.py) marks the
+              long tail's hot keys for replication: frozen per pass,
+              filtered off the uid wire, mirrored by ReplicatedHotTier.
+
+The table id of a key is ``(key >> sharding_table_shift) %
+sharding_num_tables`` — the feasign's slot/table field rides the high
+bits (the reference packs feasigns the same way); generators that don't
+can set shift 0 to fold the low bits instead.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+# the 2-D grid's dedicated mesh axes — declared here so the BX2xx
+# collective-axis vocabulary (tools/boxlint/collectives.py collects
+# *AXIS* module constants) admits collectives/specs over them
+TABLE_AXIS = "table"
+ROW_AXIS = "row"
+
+
+def default_dest_plan(mesh, local_positions: Sequence[int],
+                      num_devices: int) -> List[List[int]]:
+    """Per-peer destination lists for the p2p exchanges, validated
+    against the rendezvous'd ownership map: every mesh position must
+    have exactly one owner or the a2a would silently drop shards. This
+    is the owner-map plan every shipped policy rides (routing decides
+    WHAT flows; the plan decides WHERE) — a policy with structural
+    no-traffic guarantees can override ``dest_plan`` to shrink it."""
+    owner = mesh.rank_of_position()
+    missing = [d for d in range(num_devices) if d not in owner]
+    if missing:
+        raise RuntimeError(
+            "p2p host plane: mesh positions %s have no owning rank "
+            "(rendezvous positions incomplete)" % missing)
+    if sorted(mesh.positions_of.get(mesh.rank, [])) != sorted(
+            local_positions):
+        raise RuntimeError(
+            "p2p host plane: this rank rendezvous'd positions %s but is "
+            "staging for %s" % (mesh.positions_of.get(mesh.rank),
+                                list(local_positions)))
+    return [mesh.positions_of[r] for r in range(mesh.world)]
+
+
+class FreqSketch:
+    """Bounded frequency sketch with halving decay — the serving hot-key
+    cache's TinyLFU admission machinery (serving/cache.py ``_freq``)
+    lifted to a reusable class: counts live in a bounded dict; past
+    ``cap`` entries every count halves and zeros drop, so memory stays
+    O(cap) and stale keys age out instead of pinning forever.
+
+    ``observe`` rides the feed-pass load path (ShardedPassTable.add_keys
+    runs on reader threads), so it is locked and vectorized: one
+    np.unique over the batch, then a dict update per UNIQUE — a zipf
+    batch pays for its distinct keys, not its occurrences."""
+
+    def __init__(self, cap: int = 1 << 16) -> None:
+        import threading
+        self.cap = int(cap)
+        self._lock = threading.Lock()
+        self._freq: Dict[int, int] = {}  # guarded-by: _lock
+
+    def observe(self, keys: np.ndarray) -> None:
+        keys = np.asarray(keys, np.uint64)
+        if not keys.size:
+            return
+        uniq, counts = np.unique(keys, return_counts=True)
+        with self._lock:
+            freq = self._freq
+            for k, c in zip(uniq.tolist(), counts.tolist()):
+                freq[k] = freq.get(k, 0) + c
+            if len(freq) > self.cap:
+                self._freq = {k: c >> 1 for k, c in freq.items()
+                              if c >> 1}
+
+    def items(self):
+        """(keys [n] uint64, counts [n] int64) snapshot — the wire form
+        the cross-rank sketch merge ships."""
+        with self._lock:
+            ks = np.fromiter(self._freq.keys(), np.uint64,
+                             len(self._freq))
+            cs = np.fromiter(self._freq.values(), np.int64,
+                             len(self._freq))
+        return ks, cs
+
+    @classmethod
+    def summed(cls, parts, cap: int) -> "FreqSketch":
+        """A NEW sketch holding the element-wise SUM of the given
+        (keys, counts) snapshots — every rank summing the same part set
+        (any order; addition commutes) holds an IDENTICAL view. The
+        inputs are NOT mutated: each rank's local sketch keeps only its
+        own observation history, so re-merging full local histories at
+        every pass boundary counts each occurrence exactly once (a
+        merge that overwrote the local sketch with the global sum would
+        re-sum it W-fold per pass and inflate every count)."""
+        total: Dict[int, int] = {}
+        for ks, cs in parts:
+            for k, c in zip(np.asarray(ks, np.uint64).tolist(),
+                            np.asarray(cs, np.int64).tolist()):
+                total[k] = total.get(k, 0) + c
+        if len(total) > cap:
+            # keep the heaviest cap entries (deterministic: count desc,
+            # key asc tiebreak) so every rank truncates identically
+            keep = sorted(total.items(), key=lambda kv: (-kv[1], kv[0]))
+            total = dict(keep[:cap])
+        out = cls(cap)
+        out._freq = total
+        return out
+
+    def hot_keys(self, threshold: int) -> np.ndarray:
+        """Sorted unique keys whose estimate reached ``threshold``."""
+        if threshold <= 0:
+            return np.empty(0, np.uint64)
+        with self._lock:
+            ks = [k for k, c in self._freq.items() if c >= threshold]
+        return np.sort(np.asarray(ks, np.uint64))
+
+
+class ShardingPolicy:
+    """Owner of route / exchange-plan / device-layout for the sharded
+    pass table. Policies are immutable during a pass: the hot tier (the
+    only mutable piece) freezes at ``freeze_hot`` — the feed-pass
+    boundary, where every rank already agrees on the global key set —
+    because senders drop hot uids the OWNERS re-add, so a mid-pass
+    hot-set change on one rank would silently corrupt the lockstep
+    exchange products."""
+
+    name = "abstract"
+    #: True only when ``shard_of`` is exactly ``key % num_shards`` — the
+    #: bucketize then keeps the legacy rt_bucketize fast path, which is
+    #: the bit-parity guarantee for the pre-policy behavior
+    native_keymod = False
+
+    def __init__(self, num_shards: int) -> None:
+        if num_shards <= 0:
+            raise ValueError("num_shards must be positive")
+        self.num_shards = int(num_shards)
+
+    # ------------------------------------------------------------- route
+    def shard_of(self, keys: np.ndarray) -> np.ndarray:
+        """[K] uint64 feasigns -> [K] int64 owning shard positions in
+        [0, num_shards). Vectorized numpy — this runs per batch ahead of
+        the native bucketize loop."""
+        raise NotImplementedError
+
+    # ---------------------------------------------------------- exchange
+    def dest_plan(self, mesh, local_positions: Sequence[int],
+                  num_devices: int) -> List[List[int]]:
+        """Per-peer destination lists the p2p exchanges ship along."""
+        return default_dest_plan(mesh, local_positions, num_devices)
+
+    # ------------------------------------------------------------ layout
+    def slab_spec(self, mesh, axis):
+        """PartitionSpec for the [P, C, W] slab stack's dim 0 on `mesh`
+        (`axis` = the runner's flat table axis name or tuple)."""
+        from jax.sharding import PartitionSpec
+        return PartitionSpec(axis)
+
+    def slab_sharding(self, mesh, axis):
+        from jax.sharding import NamedSharding
+        return NamedSharding(mesh, self.slab_spec(mesh, axis))
+
+    # ---------------------------------------------------------- hot tier
+    #: True when the policy wants the feed-pass occurrence stream
+    #: (ShardedPassTable.add_keys feeds observe); False short-circuits
+    #: the hot-path call entirely
+    wants_observe = False
+
+    def observe(self, keys: np.ndarray) -> None:
+        """Feed key occurrences to the policy's frequency model (no-op
+        unless the policy carries a sketch)."""
+
+    def merge_observations(self, allgather) -> None:
+        """Cross-rank sketch merge at the feed-pass union (end_feed_pass,
+        right before freeze_hot): rank-local observation streams differ,
+        so every rank allgathers its sketch snapshot and loads the SUM —
+        identical sketches, hence identical frozen hot sets, on every
+        rank. No-op for policies without a sketch."""
+
+    def freeze_hot(self, shard_keys: Sequence[np.ndarray]) -> None:
+        """Pass boundary: resolve the sketch against the new pass's
+        per-shard sorted key lists into per-shard hot LOCAL id sets.
+        No-op for policies without a hot tier."""
+
+    def hot_local_ids(self, dest: int) -> Optional[np.ndarray]:
+        """Sorted int32 pass-local ids replicated for shard `dest`, or
+        None. These ids are dropped from the uid wire by senders and
+        re-added by the owner (exchange_push_uids_p2p)."""
+        return None
+
+    # -------------------------------------------------------- validation
+    def describe(self) -> str:
+        """Stable identity string for cross-rank rendezvous validation
+        (fleet/mesh_comm.py): ranks running different policies would
+        route the same key to different owners and silently corrupt the
+        exchange — the rendezvous compares these and fails loud."""
+        return "%s/%d" % (self.name, self.num_shards)
+
+
+class KeyModPolicy(ShardingPolicy):
+    """shard = key % P — the BoxPS/HeterComm layout
+    (split_input_to_shard, heter_comm_inl.h:1117) and the parity oracle:
+    bit-identical to the pre-policy path on both wire modes."""
+
+    name = "key-mod"
+    native_keymod = True
+
+    def shard_of(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.asarray(keys, np.uint64)
+        return (keys % np.uint64(self.num_shards)).astype(np.int64)
+
+
+class TableWisePolicy(ShardingPolicy):
+    """Each table pinned WHOLE to one shard: shard = table(key) % P.
+    A table's sparse traffic flows only to its owner rank — zero
+    cross-group traffic per table — at the cost of concentrating skewed
+    tables' load on their owners (the imbalance the 2-D grid's row axis
+    exists to fix)."""
+
+    name = "table-wise"
+
+    def __init__(self, num_shards: int, num_tables: int,
+                 table_shift: int = 48) -> None:
+        super().__init__(num_shards)
+        if num_tables <= 0:
+            raise ValueError("num_tables must be positive")
+        if not 0 <= int(table_shift) < 64:
+            raise ValueError("table_shift must be in [0, 64)")
+        self.num_tables = int(num_tables)
+        self.table_shift = int(table_shift)
+
+    def table_of(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.asarray(keys, np.uint64)
+        return ((keys >> np.uint64(self.table_shift))
+                % np.uint64(self.num_tables)).astype(np.int64)
+
+    def shard_of(self, keys: np.ndarray) -> np.ndarray:
+        return self.table_of(keys) % self.num_shards
+
+    def describe(self) -> str:
+        return "%s/%d/t%d>>%d" % (self.name, self.num_shards,
+                                  self.num_tables, self.table_shift)
+
+
+class TwoDGridPolicy(TableWisePolicy):
+    """Table axis x row axis: shard = table_group * R + (key % R).
+
+    The grid linearizes onto the runner's flat device axis (position
+    t*R + r), and ``slab_spec`` expresses the same layout over dedicated
+    (table, row) mesh axes when the mesh declares them — the GSPMD
+    NamedSharding idiom. Row-wise splitting inside a table group spreads
+    a skewed table over R shards (the rebalance table-wise lacks), and
+    the hot tier replicates the long tail's hottest keys so they never
+    travel the wire at all:
+
+      * ``observe`` feeds the TinyLFU-style FreqSketch (the serving
+        cache's machinery) from the feed-pass occurrence stream —
+        ShardedPassTable.add_keys calls it whenever ``wants_observe``;
+      * ``merge_observations`` (end_feed_pass, over the same allgather
+        that unions the pass keys) sums every rank's sketch so the
+        frozen hot sets agree cluster-wide even though the observation
+        streams were rank-local;
+      * ``freeze_hot`` resolves keys at/above ``hot_threshold`` against
+        the new pass's shard key lists ONCE per pass;
+      * exchange_push_uids_p2p drops hot uids pre-wire and the owner
+        re-adds its full hot set: the staged uid vector over-approximates
+        by hot ids that skipped a step, whose merged gradients are zero
+        (a value-level no-op in the in-table optimizer) — that is the
+        replication premise: hot rows are touched essentially every
+        step.
+    """
+
+    name = "2d-grid"
+
+    def __init__(self, num_shards: int, num_tables: int, rows: int,
+                 table_shift: int = 48, hot_threshold: int = 0,
+                 hot_cap: int = 1024, sketch_cap: int = 1 << 16) -> None:
+        super().__init__(num_shards, num_tables, table_shift)
+        if rows <= 0 or num_shards % rows:
+            raise ValueError(
+                "grid rows (%d) must divide num_shards (%d) evenly"
+                % (rows, num_shards))
+        self.rows = int(rows)
+        self.table_groups = self.num_shards // self.rows
+        self.hot_threshold = int(hot_threshold)
+        self.hot_cap = int(hot_cap)
+        self.sketch = FreqSketch(sketch_cap)
+        # the cross-rank merged view (merge_observations); the LOCAL
+        # sketch above keeps only this rank's history so every pass's
+        # re-merge counts each occurrence exactly once
+        self._merged_sketch: Optional[FreqSketch] = None
+        self._hot_local: Dict[int, np.ndarray] = {}
+        self._hot_keys = np.empty(0, np.uint64)
+
+    def shard_of(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.asarray(keys, np.uint64)
+        group = self.table_of(keys) % self.table_groups
+        row = (keys % np.uint64(self.rows)).astype(np.int64)
+        return group * self.rows + row
+
+    def slab_spec(self, mesh, axis):
+        from jax.sharding import PartitionSpec
+        names = tuple(getattr(mesh, "axis_names", ()))
+        if TABLE_AXIS in names and ROW_AXIS in names:
+            # grid mesh: dim 0 shards over (table, row) — position
+            # t*R + r lands on mesh coordinate (t, r), exactly the
+            # linearized flat-axis layout (pinned by test)
+            return PartitionSpec((TABLE_AXIS, ROW_AXIS))
+        return PartitionSpec(axis)
+
+    # ---------------------------------------------------------- hot tier
+    @property
+    def wants_observe(self) -> bool:
+        return self.hot_threshold > 0
+
+    def observe(self, keys: np.ndarray) -> None:
+        if self.hot_threshold > 0:
+            self.sketch.observe(keys)
+
+    def merge_observations(self, allgather) -> None:
+        if self.hot_threshold <= 0:
+            return
+        ks, cs = self.sketch.items()
+        payload = np.concatenate([np.array([ks.size], np.uint64), ks,
+                                  cs.view(np.uint64)])
+        parts = []
+        for p in allgather(payload):
+            p = np.asarray(p, np.uint64)
+            n = int(p[0])
+            parts.append((p[1:1 + n], p[1 + n:1 + 2 * n].view(np.int64)))
+        # a fresh summed VIEW; the local sketch is untouched, so next
+        # pass's merge re-sums local histories, not prior global sums
+        self._merged_sketch = FreqSketch.summed(parts, self.sketch.cap)
+
+    def freeze_hot(self, shard_keys: Sequence[np.ndarray]) -> None:
+        self._hot_local = {}
+        self._hot_keys = np.empty(0, np.uint64)
+        if self.hot_threshold <= 0:
+            return
+        sk = (self._merged_sketch if self._merged_sketch is not None
+              else self.sketch)   # single-process: local IS global
+        hot = sk.hot_keys(self.hot_threshold)
+        if not hot.size:
+            return
+        shard = self.shard_of(hot)
+        kept = []
+        for s in range(self.num_shards):
+            hk = hot[shard == s]
+            if not hk.size:
+                continue
+            sk = np.asarray(shard_keys[s])
+            pos = np.searchsorted(sk, hk)
+            ok = (pos < sk.size)
+            ok[ok] = sk[pos[ok]] == hk[ok]  # only keys IN this pass
+            if not ok.any():
+                continue
+            local = pos[ok].astype(np.int32)
+            if local.size > self.hot_cap:
+                raise ValueError(
+                    "2d-grid hot tier: shard %d has %d hot keys, over "
+                    "sharding_hot_cap=%d — raise the cap or the "
+                    "threshold (an unbounded replicated set defeats "
+                    "the wire saving it exists for)"
+                    % (s, local.size, self.hot_cap))
+            self._hot_local[s] = local  # searchsorted output: ascending
+            kept.append(hk[ok])
+        if kept:
+            self._hot_keys = np.concatenate(kept)
+            self._hot_keys.sort()
+
+    def hot_local_ids(self, dest: int) -> Optional[np.ndarray]:
+        return self._hot_local.get(dest)
+
+    def hot_keys_frozen(self) -> np.ndarray:
+        """Sorted unique hot keys of the frozen pass (the replicated
+        set ReplicatedHotTier mirrors)."""
+        return self._hot_keys
+
+    def describe(self) -> str:
+        # hot_cap rides the identity too: a split cap makes freeze_hot
+        # raise on SOME ranks only — the divergence class this string
+        # exists to kill at bring-up
+        return "%s/%d/t%d>>%d/r%d/h%d/c%d" % (
+            self.name, self.num_shards, self.num_tables,
+            self.table_shift, self.rows, self.hot_threshold,
+            self.hot_cap)
+
+
+class ReplicatedHotTier:
+    """Host-side mirror of the frozen hot keys' rows — the replicated
+    read tier of the 2-D grid (HierarchicalKV's cache-semantics store is
+    the model): ``refresh`` gathers each hot key's row from its OWNING
+    shard store once per pass; ``lookup`` then serves any subset without
+    touching the owners — bit-identical rows to a direct owner-store
+    read (pinned by tests/test_sharding_policy.py)."""
+
+    def __init__(self, policy: TwoDGridPolicy) -> None:
+        self.policy = policy
+        self._keys = np.empty(0, np.uint64)
+        self._rows = np.empty((0, 0), np.float32)
+
+    def refresh(self, stores: Sequence) -> int:
+        """Mirror the policy's frozen hot keys from their owner stores
+        (None entries — shards this process doesn't own — are skipped:
+        each process mirrors what it can address; a full replica needs
+        either all shards local or a store plane that serves remote
+        reads). Returns mirrored row count."""
+        hot = self.policy.hot_keys_frozen()
+        if not hot.size:
+            self._keys = np.empty(0, np.uint64)
+            self._rows = np.empty((0, 0), np.float32)
+            return 0
+        shard = self.policy.shard_of(hot)
+        keys_out, rows_out = [], []
+        for s in range(self.policy.num_shards):
+            st = stores[s] if s < len(stores) else None
+            if st is None:
+                continue
+            hk = hot[shard == s]
+            if hk.size:
+                keys_out.append(hk)
+                rows_out.append(np.asarray(st.lookup(hk), np.float32))
+        if not keys_out:
+            self._keys = np.empty(0, np.uint64)
+            self._rows = np.empty((0, 0), np.float32)
+            return 0
+        keys = np.concatenate(keys_out)
+        rows = np.vstack(rows_out)
+        order = np.argsort(keys, kind="stable")
+        self._keys, self._rows = keys[order], rows[order]
+        return int(keys.size)
+
+    def lookup(self, keys: np.ndarray):
+        """(rows [K, W], found [K]) — found=False rows are zero (the
+        caller falls through to the routed path for them)."""
+        from paddlebox_tpu.embedding.pass_table import sorted_member
+        keys = np.asarray(keys, np.uint64)
+        W = self._rows.shape[1] if self._rows.size else 0
+        rows = np.zeros((keys.size, W), np.float32)
+        pos, found = sorted_member(self._keys, keys)
+        if found.any():
+            rows[found] = self._rows[pos[found]]
+        return rows, found
+
+
+def validate_policy_agreement(fleet, policy: ShardingPolicy) -> None:
+    """Cross-rank policy-identity check for the STORE host plane
+    (hostplane=store, or the collective p2p fallback): the p2p
+    rendezvous validates this itself, but a job on the store funnel
+    never rendezvouses — and ranks on different policies route the same
+    key to different owners on either plane. One allgather of
+    describe() at construction; raises MeshPolicyMismatch naming every
+    identity seen. Collective: every rank must call it (the runners do,
+    gated identically by the shared hostplane flag)."""
+    from paddlebox_tpu.fleet.mesh_comm import MeshPolicyMismatch
+    mine = policy.describe()
+    parts = fleet.all_gather(
+        np.frombuffer(mine.encode("utf-8"), np.uint8).copy())
+    seen = sorted({bytes(np.asarray(p, np.uint8)).decode("utf-8")
+                   for p in parts})
+    if seen != [mine]:
+        raise MeshPolicyMismatch(
+            "sharding-policy mismatch across ranks: cluster published "
+            "%s — set the sharding_policy flag identically on every "
+            "rank" % seen)
+
+
+def resolve_sharding_policy(num_shards: int,
+                            name: Optional[str] = None) -> ShardingPolicy:
+    """Build the policy the ``sharding_policy`` flag (or `name`) selects.
+    A typo'd value would otherwise silently train on the wrong layout —
+    fail loud instead."""
+    from paddlebox_tpu.config import flags
+    v = str(name if name is not None
+            else flags.get_flag("sharding_policy")).strip().lower()
+    if v in ("key-mod", "keymod", "key_mod"):
+        return KeyModPolicy(num_shards)
+    num_tables = int(flags.get_flag("sharding_num_tables"))
+    shift = int(flags.get_flag("sharding_table_shift"))
+    if v in ("table-wise", "tablewise", "table_wise"):
+        return TableWisePolicy(num_shards, num_tables, table_shift=shift)
+    if v in ("2d-grid", "2d_grid", "2dgrid", "grid"):
+        rows = int(flags.get_flag("sharding_grid_rows"))
+        if rows <= 0:
+            # auto: largest divisor of P not above sqrt(P) — a square-ish
+            # grid balances table confinement against row rebalancing
+            rows = max(r for r in range(1, int(num_shards ** 0.5) + 1)
+                       if num_shards % r == 0)
+        return TwoDGridPolicy(
+            num_shards, num_tables, rows, table_shift=shift,
+            hot_threshold=int(flags.get_flag("sharding_hot_threshold")),
+            hot_cap=int(flags.get_flag("sharding_hot_cap")))
+    raise ValueError(
+        "sharding_policy must be 'key-mod', 'table-wise' or '2d-grid', "
+        "got %r" % v)

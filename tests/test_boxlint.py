@@ -194,8 +194,45 @@ def test_repo_axis_vocabulary_includes_mesh_axes():
     from tools.boxlint.collectives import collect_axis_vocabulary
     files, _ = load_tree([os.path.join(REPO, "paddlebox_tpu")], root=REPO)
     vocab = collect_axis_vocabulary(files)
-    # the canonical axes from parallel/mesh.py must all be declared
-    assert {"dp", "node", "data", "model", "pipeline"} <= vocab
+    # the canonical axes from parallel/mesh.py must all be declared,
+    # including the round-13 2-D sparse-parallelism grid axes
+    assert {"dp", "node", "data", "model", "pipeline",
+            "table", "row"} <= vocab
+
+
+def test_collective_axis_grid_pair(tmp_path):
+    """Round-13 satellite: the 2-D grid's table/row axes are declared
+    vocabulary (positive), while a typo'd policy axis still fails the
+    gate (negative) — a PartitionSpec or collective over 'tabel' would
+    otherwise only die at dispatch on pod hardware."""
+    good = lint_snippet(tmp_path, """
+        from jax import lax
+        from jax.sharding import Mesh, PartitionSpec
+        import numpy as np
+
+        TABLE_AXIS = "table"
+        ROW_AXIS = "row"
+        mesh = Mesh(np.empty((2, 4), object), ("table", "row"))
+        spec = PartitionSpec(("table", "row"))
+
+        def step(x):
+            a = lax.psum(x, "table")
+            b = lax.pmean(x, ("table", "row"))
+            return a + b
+    """, ["collectives"])
+    assert good == []
+    bad = lint_snippet(tmp_path, """
+        from jax import lax
+        from jax.sharding import Mesh
+        import numpy as np
+
+        mesh = Mesh(np.empty((2, 4), object), ("table", "row"))
+
+        def step(x):
+            return lax.psum(x, "tabel")     # BX201: typo'd grid axis
+    """, ["collectives"])
+    assert codes(bad) == ["BX201"]
+    assert "tabel" in bad[0].message
 
 
 # ----------------------------------------------------------------- flags

@@ -146,8 +146,9 @@ def test_sharded_e2e_learns(sharded_setup):
     keys0, vals0 = trainer.table.stores[0].state_items()
     from paddlebox_tpu.embedding import accessor as acc
     assert vals0[:, acc.SHOW].sum() > 0
-    # every stored key belongs to shard 0 (key % 8 == 0)
-    assert (keys0 % np.uint64(8) == 0).all()
+    # every stored key belongs to shard 0 under the live sharding policy
+    # (key % 8 == 0 under the default key-mod)
+    assert (trainer.policy.shard_of(keys0) == 0).all()
 
 
 def test_sharded_matches_single_chip_semantics(sharded_setup):
@@ -200,7 +201,7 @@ def test_sharded_matches_single_chip_semantics(sharded_setup):
     st.write_back(slabs)
 
     for k in np.unique(keys):
-        shard = int(k % 8)
+        shard = int(st.policy.shard_of(np.array([k], np.uint64))[0])
         row_sharded = st.stores[shard].lookup(np.array([k], np.uint64))[0]
         row_single = pt.store.lookup(np.array([k], np.uint64))[0]
         np.testing.assert_allclose(row_sharded, row_single, rtol=1e-5,
@@ -266,7 +267,7 @@ def test_bucketize_max_key_sentinel():
         valid = np.ones(3, bool)
         idx = t.bucketize(keys, valid)
         assert idx.overflow == 0 and valid.all()
-        s = int(kmax % np.uint64(8))  # shard 7
+        s = int(t.policy.shard_of(np.array([kmax], np.uint64))[0])
         local = idx.buckets.reshape(-1)[idx.restore[1]]
         assert t._shard_keys[s][local] == kmax
 

@@ -1,0 +1,576 @@
+"""Round-13 tentpole: pluggable sharding policies (key-mod x table-wise
+x 2d-grid) — routing parity vs the numpy oracle per policy, key-mod
+bit-parity vs the pre-policy path, policy-owned dest plans, the
+replicated hot-key tier, and the grid device layout."""
+
+import concurrent.futures
+import types
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config.configs import SparseOptimizerConfig, TableConfig
+from paddlebox_tpu.parallel import sharded_table as stmod
+from paddlebox_tpu.parallel.sharded_table import (ShardedPassTable,
+                                                  stage_push_dedup)
+from paddlebox_tpu.parallel.sharding import (KeyModPolicy, ReplicatedHotTier,
+                                             TableWisePolicy, TwoDGridPolicy,
+                                             default_dest_plan,
+                                             resolve_sharding_policy)
+
+P = 8
+
+
+def table_cfg(cap_per_shard=1 << 11):
+    return TableConfig(
+        embedx_dim=4, pass_capacity=P * cap_per_shard,
+        optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                        mf_initial_range=1e-3))
+
+
+def grid_keys(rng, n=2048, tables=8, shift=48):
+    """Feasigns with the table id in the high bits (the reference's
+    packing; sharding_table_shift default)."""
+    t = rng.randint(0, tables, n).astype(np.uint64)
+    low = rng.randint(0, 1 << 30, n).astype(np.uint64)
+    return np.unique((t << np.uint64(shift)) | low)
+
+
+def policies():
+    return [KeyModPolicy(P),
+            TableWisePolicy(P, num_tables=8, table_shift=48),
+            TwoDGridPolicy(P, num_tables=8, rows=2, table_shift=48)]
+
+
+# ----------------------------------------------------------------- route
+
+def test_keymod_shard_of_is_key_mod():
+    """The parity oracle: KeyModPolicy.shard_of IS key % P, bit-for-bit
+    (the pre-policy routing on every host-side twin)."""
+    rng = np.random.RandomState(0)
+    keys = rng.randint(0, 1 << 62, 4096).astype(np.uint64)
+    keys[-1] = np.uint64(0xFFFFFFFFFFFFFFFF)
+    pol = KeyModPolicy(P)
+    np.testing.assert_array_equal(pol.shard_of(keys),
+                                  (keys % np.uint64(P)).astype(np.int64))
+
+
+@pytest.mark.parametrize("pol", policies(), ids=lambda p: p.name)
+def test_bucketize_parity_native_vs_numpy(pol):
+    """Per-policy routing parity: the native tier (rt_bucketize for
+    key-mod, the policy-parameterized rt_bucketize_sharded otherwise)
+    and the vectorized numpy fallback must produce equivalent routing —
+    same local id per occurrence, same shard per occurrence (== the
+    policy's shard_of), same bucket membership."""
+    if stmod._route_lib() is None:
+        pytest.skip("native router unavailable")
+    rng = np.random.RandomState(3)
+    keys = grid_keys(rng)
+    t = ShardedPassTable(table_cfg(), P, bucket_cap=512, policy=pol)
+    t.begin_feed_pass()
+    t.add_keys(keys)
+    t.end_feed_pass()
+    probe = rng.choice(keys, 1024).astype(np.uint64)
+    v_n = np.ones(probe.size, bool)
+    idx_n = t.bucketize(probe, v_n)
+    orig = stmod._route_lib
+    stmod._route_lib = lambda: None
+    try:
+        v_p = np.ones(probe.size, bool)
+        idx_p = t.bucketize(probe, v_p)
+    finally:
+        stmod._route_lib = orig
+    assert idx_n.overflow == idx_p.overflow == 0
+    np.testing.assert_array_equal(
+        idx_n.buckets.reshape(-1)[idx_n.restore],
+        idx_p.buckets.reshape(-1)[idx_p.restore])
+    np.testing.assert_array_equal(idx_n.restore // t.bucket_cap,
+                                  idx_p.restore // t.bucket_cap)
+    # the shard every occurrence routed to IS the policy's shard_of
+    np.testing.assert_array_equal(idx_n.restore // t.bucket_cap,
+                                  pol.shard_of(probe))
+    # local ids resolve back to the routed keys
+    for i in (0, 17, probe.size - 1):
+        s = int(pol.shard_of(probe[i:i + 1])[0])
+        local = int(idx_n.buckets.reshape(-1)[idx_n.restore[i]])
+        assert t._shard_keys[s][local] == probe[i]
+
+
+def test_policy_shard_assignment_owns_feed_pass():
+    """end_feed_pass assigns each key to policy.shard_of(key)'s list —
+    and the shard lists stay sorted (the searchsorted contract)."""
+    rng = np.random.RandomState(5)
+    keys = grid_keys(rng)
+    for pol in policies():
+        t = ShardedPassTable(table_cfg(), P, bucket_cap=256, policy=pol)
+        t.begin_feed_pass()
+        t.add_keys(keys)
+        t.end_feed_pass()
+        seen = 0
+        shard = pol.shard_of(keys)
+        for s in range(P):
+            ks = t._shard_keys[s]
+            seen += ks.size
+            assert (np.diff(ks.astype(np.int64)) > 0).all() or ks.size <= 1
+            np.testing.assert_array_equal(np.sort(keys[shard == s]), ks)
+        assert seen == keys.size
+
+
+def test_policy_world_mismatch_raises():
+    with pytest.raises(ValueError, match="policy built for"):
+        ShardedPassTable(table_cfg(), P, bucket_cap=64,
+                         policy=KeyModPolicy(4))
+
+
+def test_resolve_sharding_policy_flag():
+    from paddlebox_tpu.config import flags
+    assert resolve_sharding_policy(P).name == "key-mod"
+    flags.set_flag("sharding_policy", "table-wise")
+    assert resolve_sharding_policy(P).name == "table-wise"
+    flags.set_flag("sharding_policy", "2d-grid")
+    pol = resolve_sharding_policy(P)
+    assert pol.name == "2d-grid" and pol.rows == 2  # auto: sqrt-ish
+    flags.set_flag("sharding_policy", "keymod-typo")
+    with pytest.raises(ValueError, match="sharding_policy"):
+        resolve_sharding_policy(P)
+    with pytest.raises(ValueError, match="divide"):
+        TwoDGridPolicy(P, 8, rows=3)
+
+
+# ------------------------------------------------------------- dest plan
+
+def fake_mesh(world=2, rank=0, positions=None):
+    positions = positions or {0: [0, 1, 2, 3], 1: [4, 5, 6, 7]}
+    m = types.SimpleNamespace(rank=rank, world=world,
+                              positions_of=dict(positions))
+    m.rank_of_position = lambda: {p: r for r, ps in m.positions_of.items()
+                                  for p in ps}
+    return m
+
+
+@pytest.mark.parametrize("pol", policies(), ids=lambda p: p.name)
+def test_dest_plan_validation(pol):
+    """Every position exactly one owner per policy; incomplete or
+    mismatched ownership fails loud (the silent-shard-drop guard)."""
+    m = fake_mesh()
+    plan = pol.dest_plan(m, [0, 1, 2, 3], P)
+    assert len(plan) == 2
+    covered = sorted(d for dests in plan for d in dests)
+    assert covered == list(range(P))   # exactly one owner each
+    # missing owner
+    m2 = fake_mesh(positions={0: [0, 1, 2], 1: [4, 5, 6, 7]})
+    with pytest.raises(RuntimeError, match="no owning rank"):
+        pol.dest_plan(m2, [0, 1, 2], P)
+    # staging for positions this rank did not rendezvous
+    with pytest.raises(RuntimeError, match="staging for"):
+        pol.dest_plan(fake_mesh(), [0, 1], P)
+    # the default plan and the policy plan agree (owner-map plan)
+    assert plan == default_dest_plan(m, [0, 1, 2, 3], P)
+
+
+# ------------------------------------------------- staging parity (wires)
+
+def make_buckets(rng, shard_cap, KB=16):
+    buckets = np.full((P, P, KB), shard_cap - 1, np.int32)
+    for s in range(P):
+        for d in range(P):
+            n = rng.randint(2, KB)
+            buckets[s, d, :n] = rng.randint(0, shard_cap - 1, n)
+    return buckets
+
+
+def test_keymod_policy_staging_bit_parity_both_wires():
+    """stage_push_dedup with the key-mod policy must produce BIT-identical
+    products to the policy-less (pre-round-13) call on both wire modes —
+    the tentpole's compatibility bar."""
+    rng = np.random.RandomState(7)
+    shard_cap = 128
+    buckets = make_buckets(rng, shard_cap)
+    with concurrent.futures.ThreadPoolExecutor(2) as pool:
+        for uid_only in (True, False):
+            legacy = stage_push_dedup(
+                list(buckets), list(range(P)), P, shard_cap,
+                multiprocess=False, all_gather=None, rebuild=not uid_only,
+                pool=pool, uid_only=uid_only)
+            poly = stage_push_dedup(
+                list(buckets), list(range(P)), P, shard_cap,
+                multiprocess=False, all_gather=None, rebuild=not uid_only,
+                pool=pool, uid_only=uid_only, policy=KeyModPolicy(P))
+            assert set(legacy) == set(poly)
+            for k in legacy:
+                for a, b in zip(legacy[k], poly[k]):
+                    np.testing.assert_array_equal(a, b, err_msg=k)
+
+
+@pytest.mark.parametrize("pol", policies(), ids=lambda p: p.name)
+def test_two_virtual_process_staging_parity(pol):
+    """Per policy: 2-virtual-process p2p staging (uid wire, the policy's
+    dest plan + hot filter) reproduces the single-process staging
+    bit-for-bit. The hot tier is inactive here (nothing frozen) — the
+    active-hot composition has its own test below."""
+    from paddlebox_tpu.fleet.mesh_comm import MeshComm
+    from paddlebox_tpu.parallel.sharded_table import exchange_push_uids_p2p
+    rng = np.random.RandomState(11)
+    shard_cap = 256
+    buckets = make_buckets(rng, shard_cap, KB=32)
+    with concurrent.futures.ThreadPoolExecutor(2) as pool:
+        single = stage_push_dedup(
+            list(buckets), list(range(P)), P, shard_cap,
+            multiprocess=False, all_gather=None, rebuild=False,
+            pool=pool, uid_only=True, policy=pol)
+        meshes = [MeshComm(r, 2) for r in range(2)]
+        eps = {r: ("127.0.0.1", m.port) for r, m in enumerate(meshes)}
+        pos = {0: [0, 1, 2, 3], 1: [4, 5, 6, 7]}
+        try:
+            for m in meshes:
+                m.connect(eps)
+                m.positions_of = dict(pos)
+            f = pool.submit(exchange_push_uids_p2p, buckets[4:8],
+                            [4, 5, 6, 7], P, shard_cap, meshes[1],
+                            None, pol)
+            out0 = exchange_push_uids_p2p(buckets[0:4], [0, 1, 2, 3], P,
+                                          shard_cap, meshes[0],
+                                          policy=pol)
+            out1 = f.result()
+        finally:
+            for m in meshes:
+                m.close()
+    for d, uids in {**out0, **out1}.items():
+        np.testing.assert_array_equal(uids, single["push_uids"][d],
+                                      err_msg=f"{pol.name} dest {d}")
+
+
+def test_hot_tier_wire_filter_parity_and_bytes():
+    """The 2d-grid replicated hot tier on the p2p uid wire: hot local
+    ids never travel (measured: fewer exchange bytes than the unfiltered
+    run) and the owner's re-added set makes the staged product
+    BIT-identical to the unfiltered staging whenever the hot ids occur
+    in the step — the replication premise."""
+    from paddlebox_tpu.fleet.mesh_comm import MeshComm
+    from paddlebox_tpu.parallel.sharded_table import exchange_push_uids_p2p
+    rng = np.random.RandomState(13)
+    shard_cap = 256
+    buckets = make_buckets(rng, shard_cap, KB=64)
+    # hot ids: a handful of local ids present in EVERY source's column
+    # for every destination (hot = occurs every step, everywhere)
+    hot = {d: np.array([1, 2, 5], np.int32) for d in range(P)}
+    for s in range(P):
+        for d in range(P):
+            buckets[s, d, :3] = hot[d]
+    pol = TwoDGridPolicy(P, num_tables=8, rows=2, hot_threshold=2)
+    pol._hot_local = dict(hot)  # frozen state, set directly for the unit
+
+    def run(policy):
+        meshes = [MeshComm(r, 2) for r in range(2)]
+        eps = {r: ("127.0.0.1", m.port) for r, m in enumerate(meshes)}
+        pos = {0: [0, 1, 2, 3], 1: [4, 5, 6, 7]}
+        try:
+            for m in meshes:
+                m.connect(eps)
+                m.positions_of = dict(pos)
+            with concurrent.futures.ThreadPoolExecutor(1) as pool:
+                f = pool.submit(exchange_push_uids_p2p, buckets[4:8],
+                                [4, 5, 6, 7], P, shard_cap, meshes[1],
+                                None, policy)
+                out0 = exchange_push_uids_p2p(
+                    buckets[0:4], [0, 1, 2, 3], P, shard_cap, meshes[0],
+                    policy=policy)
+                out1 = f.result()
+            return {**out0, **out1}, meshes[0].bytes_sent
+        finally:
+            for m in meshes:
+                m.close()
+
+    plain, plain_bytes = run(None)
+    hot_out, hot_bytes = run(pol)
+    for d in range(P):
+        np.testing.assert_array_equal(hot_out[d], plain[d],
+                                      err_msg=f"dest {d}")
+    assert hot_bytes < plain_bytes  # replicated ids never traveled
+
+
+def test_hot_overapprox_is_push_noop():
+    """A frozen hot id that does NOT occur in a step still rides the
+    staged uid vector (the owner re-adds its whole set). Its merged
+    gradients are zero, so the uid-wire push leaves the slab
+    BIT-identical — the over-approximation is value-free."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
+    from paddlebox_tpu.embedding.optimizers import push_sparse_uidwire
+    from paddlebox_tpu.embedding.pass_table import dedup_uids_sorted
+    rng = np.random.RandomState(17)
+    cap, K = 128, 64
+    layout = ValueLayout(4, "adagrad")
+    conf = SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                 mf_initial_range=1e-3)
+    push = PushLayout(4)
+    ids = rng.randint(0, 40, K).astype(np.int32)
+    assert 99 not in ids
+    grads = rng.randn(K, push.width).astype(np.float32)
+    grads[:, push.SHOW] = 1.0
+    slab = rng.rand(cap, layout.width).astype(np.float32)
+    prng = jax.random.PRNGKey(2)
+    uids = dedup_uids_sorted(ids, cap)
+    # splice the absent hot id 99 in (sorted position), dropping one
+    # padding slot — exactly what the owner-side union produces
+    n = int((uids < cap).sum())
+    uids_hot = np.concatenate([uids[:n], [np.int32(99)],
+                               uids[n:-1]]).astype(np.int32)
+    a = push_sparse_uidwire(jnp.asarray(slab), jnp.asarray(uids),
+                            jnp.asarray(ids), jnp.asarray(grads), prng,
+                            layout, conf)
+    b = push_sparse_uidwire(jnp.asarray(slab), jnp.asarray(uids_hot),
+                            jnp.asarray(ids), jnp.asarray(grads), prng,
+                            layout, conf)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------ replicated reads
+
+def test_replicated_hot_tier_read_parity():
+    """sketch -> freeze -> mirror -> lookup: the replicated tier serves
+    hot keys' rows bit-identical to a direct owner-store read, and
+    reports found=False for everything it doesn't hold."""
+    from paddlebox_tpu.embedding.native_store import make_host_store
+    rng = np.random.RandomState(19)
+    keys = grid_keys(rng, n=512)
+    pol = TwoDGridPolicy(P, num_tables=8, rows=2, hot_threshold=3)
+    t = ShardedPassTable(table_cfg(), P, bucket_cap=64, policy=pol)
+    # the sketch sees a skewed stream: 16 keys dominate
+    hotset = rng.choice(keys, 16, replace=False).astype(np.uint64)
+    for _ in range(4):
+        pol.observe(hotset)
+    pol.observe(rng.choice(keys, 64).astype(np.uint64))  # cold tail x1
+    t.begin_feed_pass()
+    t.add_keys(keys)
+    t.end_feed_pass()                 # freezes the hot tier
+    frozen = pol.hot_keys_frozen()
+    assert set(hotset.tolist()) <= set(frozen.tolist())
+    # materialize rows in the owner stores, then mirror
+    for s in range(P):
+        ks = t._shard_keys[s]
+        if ks.size:
+            t.stores[s].lookup_or_create(ks)
+    tier = ReplicatedHotTier(pol)
+    assert tier.refresh(t.stores) == frozen.size
+    rows, found = tier.lookup(hotset)
+    assert found.all()
+    for i, k in enumerate(hotset):
+        s = int(pol.shard_of(np.array([k], np.uint64))[0])
+        direct = t.stores[s].lookup(np.array([k], np.uint64))[0]
+        np.testing.assert_array_equal(rows[i], direct)
+    # a cold key misses
+    cold = keys[~np.isin(keys, frozen)][:4]
+    _, found = tier.lookup(cold)
+    assert not found.any()
+    # per-shard hot sets are sorted int32 local ids (the wire contract)
+    for d in range(P):
+        h = pol.hot_local_ids(d)
+        if h is not None:
+            assert h.dtype == np.int32
+            assert (np.diff(h.astype(np.int64)) > 0).all() or h.size <= 1
+
+
+def test_hot_tier_production_feed_and_merge():
+    """The production wiring: add_keys feeds the sketch (reader-thread
+    stream), end_feed_pass merges the rank-local sketches over the SAME
+    allgather that unions the pass keys, and the frozen hot sets come
+    out identical on every rank — including keys that are hot only when
+    SUMMED across ranks."""
+    rng = np.random.RandomState(29)
+    keys = grid_keys(rng, n=256)
+    hot_key = keys[7:8]
+    streams = {  # rank-local: each rank alone sees hot_key only twice
+        0: [np.concatenate([hot_key, hot_key, keys[:64]]), keys[64:128]],
+        1: [np.concatenate([hot_key, hot_key, keys[128:]]), keys[:32]],
+    }
+    tables, payloads, key_parts = {}, {}, {}
+    for r in (0, 1):
+        pol = TwoDGridPolicy(P, num_tables=8, rows=2, hot_threshold=4)
+        assert pol.wants_observe
+        t = ShardedPassTable(table_cfg(), P, bucket_cap=64, policy=pol)
+        t.begin_feed_pass()
+        for chunk in streams[r]:
+            t.add_keys(chunk)          # observe rides add_keys now
+        tables[r] = t
+        ks, cs = pol.sketch.items()
+        payloads[r] = np.concatenate(
+            [np.array([ks.size], np.uint64), ks, cs.view(np.uint64)])
+        key_parts[r] = np.unique(np.concatenate(streams[r]))
+
+    for r in (0, 1):
+        calls = iter([list(key_parts.values()),      # key union
+                      list(payloads.values())])      # sketch merge
+        tables[r].end_feed_pass(allgather=lambda _p, c=calls: next(c))
+    frozen0 = tables[0].policy.hot_keys_frozen()
+    frozen1 = tables[1].policy.hot_keys_frozen()
+    np.testing.assert_array_equal(frozen0, frozen1)
+    # 2+2 observations cross the threshold only after the merge
+    assert hot_key[0] in frozen0.tolist()
+    for d in range(P):
+        a, b = (tables[0].policy.hot_local_ids(d),
+                tables[1].policy.hot_local_ids(d))
+        if a is None or b is None:
+            assert a is None and b is None
+        else:
+            np.testing.assert_array_equal(a, b)
+    # NO W-fold inflation across passes: the merge must not fold the
+    # global sum back into the local sketches — a second pass with no
+    # new observations re-merges the SAME local histories and freezes
+    # the SAME set (an overwrite-style merge would double every count
+    # per pass and eventually replicate cold keys)
+    for r in (0, 1):
+        ks, cs = tables[r].policy.sketch.items()
+        order = np.argsort(ks)
+        p0 = np.asarray(payloads[r], np.uint64)
+        n = int(p0[0])
+        ks0, cs0 = p0[1:1 + n], p0[1 + n:1 + 2 * n].view(np.int64)
+        o0 = np.argsort(ks0)
+        np.testing.assert_array_equal(ks[order], ks0[o0])
+        np.testing.assert_array_equal(cs[order], cs0[o0])
+        calls = iter([list(key_parts.values()),
+                      list(payloads.values())])
+        tables[r].begin_feed_pass()
+        for chunk in streams[r]:
+            tables[r]._feed_keys.append(chunk)  # keys only, no observe
+        tables[r].end_feed_pass(allgather=lambda _p, c=calls: next(c))
+        np.testing.assert_array_equal(
+            tables[r].policy.hot_keys_frozen(), frozen0)
+
+
+def test_hot_cap_is_enforced():
+    pol = TwoDGridPolicy(P, num_tables=8, rows=2, hot_threshold=1,
+                         hot_cap=2)
+    keys = np.arange(64, dtype=np.uint64) * np.uint64(8)  # all shard 0
+    pol.observe(keys)
+    with pytest.raises(ValueError, match="hot_cap"):
+        pol.freeze_hot([np.sort(keys)] + [np.empty(0, np.uint64)] * (P - 1))
+
+
+# ---------------------------------------------------------- device layout
+
+def test_grid_slab_sharding_matches_flat_placement():
+    """The GSPMD grid layout: a [P, C, W] slab stack sharded over
+    (table, row) on the grid mesh places shard t*R + r on the SAME
+    device as P(axis) on the flat mesh — the linearization
+    TwoDGridPolicy.shard_of bakes."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from paddlebox_tpu.parallel.mesh import device_mesh_1d, device_mesh_grid
+    pol = TwoDGridPolicy(P, num_tables=8, rows=4)
+    grid = device_mesh_grid(2, 4)
+    flat = device_mesh_1d(P)
+    spec = pol.slab_spec(grid, "dp")
+    assert spec == PartitionSpec(("table", "row"))
+    sh_grid = pol.slab_sharding(grid, "dp")
+    sh_flat = NamedSharding(flat, PartitionSpec("dp"))
+    arr = np.arange(P * 4 * 2, dtype=np.float32).reshape(P, 4, 2)
+    a = jax.device_put(arr, sh_grid)
+    b = jax.device_put(arr, sh_flat)
+    dev_of = lambda x: {  # noqa: E731 — shard row -> device id
+        int(s.index[0].start or 0): s.device.id for s in x.addressable_shards}
+    assert dev_of(a) == dev_of(b)
+    # on a mesh WITHOUT grid axes the policy keeps the flat layout
+    assert pol.slab_spec(flat, "dp") == PartitionSpec("dp")
+
+
+# ------------------------------------------------------------- rendezvous
+
+def test_rendezvous_policy_mismatch_fails_loud():
+    """Ranks publishing different policy identities must die at
+    bring-up (MeshPolicyMismatch), not corrupt the first exchange."""
+    from paddlebox_tpu.fleet.mesh_comm import MeshComm, MeshPolicyMismatch
+    from paddlebox_tpu.fleet.store import KVStoreServer, TcpStoreClient
+    server = KVStoreServer(host="127.0.0.1")
+    try:
+        c0 = TcpStoreClient("127.0.0.1", server.port)
+        c1 = TcpStoreClient("127.0.0.1", server.port)
+        m0, m1 = MeshComm(0, 2), MeshComm(1, 2)
+        try:
+            with concurrent.futures.ThreadPoolExecutor(1) as pool:
+                f = pool.submit(m1.rendezvous, c1, "ns", "127.0.0.1",
+                                [4, 5, 6, 7], 20.0,
+                                KeyModPolicy(P).describe())
+                with pytest.raises(MeshPolicyMismatch, match="mismatch"):
+                    m0.rendezvous(c0, "ns", "127.0.0.1", [0, 1, 2, 3],
+                                  20.0,
+                                  policy_id=TableWisePolicy(
+                                      P, 8).describe())
+                with pytest.raises(MeshPolicyMismatch):
+                    f.result()
+        finally:
+            m0.close()
+            m1.close()
+            c0.close()
+            c1.close()
+    finally:
+        server.stop()
+
+
+def test_validate_policy_agreement_store_plane():
+    """The store host plane never rendezvouses, so the runners validate
+    the policy identity with one fleet allgather — mismatched ranks
+    raise MeshPolicyMismatch, agreeing ranks pass."""
+    from paddlebox_tpu.fleet.mesh_comm import MeshPolicyMismatch
+    from paddlebox_tpu.parallel.sharding import validate_policy_agreement
+    me = KeyModPolicy(P)
+    enc = lambda s: np.frombuffer(s.encode(), np.uint8).copy()  # noqa: E731
+    ok_fleet = types.SimpleNamespace(
+        all_gather=lambda p: [enc(me.describe()), enc(me.describe())])
+    validate_policy_agreement(ok_fleet, me)
+    bad_fleet = types.SimpleNamespace(
+        all_gather=lambda p: [enc(me.describe()),
+                              enc(TableWisePolicy(P, 8).describe())])
+    with pytest.raises(MeshPolicyMismatch, match="identically"):
+        validate_policy_agreement(bad_fleet, me)
+
+
+# --------------------------------------------------------- slow e2e legs
+
+@pytest.mark.slow
+def test_sharded_trainer_trains_under_each_policy():
+    """One real pass of the 8-shard trainer per policy (table shift 0 so
+    synthetic low-bit keys spread): finite loss, rows land in the
+    policy's owner stores."""
+    from paddlebox_tpu.config import flags
+    from paddlebox_tpu.data import BoxDataset, write_synthetic_ctr_files
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.models.base import ModelSpec
+    from paddlebox_tpu.parallel import ShardedBoxTrainer
+    from paddlebox_tpu.parallel.mesh import device_mesh_1d
+    import tempfile
+    out = tempfile.mkdtemp(prefix="pbx_pole2e_")
+    files, feed = write_synthetic_ctr_files(
+        out, num_files=2, lines_per_file=200, num_slots=4,
+        vocab_per_slot=120, max_len=3, seed=23)
+    feed = type(feed)(slots=feed.slots, batch_size=32)
+    flags.set_flag("sharding_table_shift", 0)
+    flags.set_flag("sharding_num_tables", 53)
+    for name in ("key-mod", "table-wise", "2d-grid"):
+        flags.set_flag("sharding_policy", name)
+        tr = ShardedBoxTrainer(
+            CtrDnn(ModelSpec(num_slots=4, slot_dim=3 + 4), hidden=(16,)),
+            table_cfg(1 << 9), feed, mesh=device_mesh_1d(8))
+        assert tr.policy.name == name
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        stats = tr.train_pass(ds)
+        assert np.isfinite(stats["loss"])
+        for s, st in enumerate(tr.table.stores):
+            ks, _ = st.state_items()
+            if ks.size:
+                assert (tr.policy.shard_of(ks) == s).all()
+        tr.close()
+
+
+@pytest.mark.slow
+def test_hostplane_probe_policy_parity_two_ranks():
+    """The probe's policy leg at a REAL 2-process cluster, parity-only:
+    per policy, the p2p uid staging must match the store-path product."""
+    from tools.hostplane_probe import run_world
+    r = run_world(2, kb=2048, steps=1, runs=1, parity_only=True,
+                  policies=True)
+    assert r["tiers"] == {"parity": "ok"}

@@ -583,8 +583,8 @@ def test_rt_dedup_sorted_native_matches_numpy_oracle():
     rng = np.random.RandomState(17)
     shapes = [
         (1024, 64),     # heavy duplication — the accepted regime
-        (1024, 512),    # boundary: pad_base == K/2, still accepted
-        (1024, 600),    # declined (pad_base > K/2) — numpy fallback
+        (1024, 512),    # boundary: span ~ K/2, still accepted
+        (1024, 600),    # declined (live span > K/2) — numpy fallback
         (64, 1),        # single unique value
         (256, 8),
     ]
@@ -594,6 +594,28 @@ def test_rt_dedup_sorted_native_matches_numpy_oracle():
         ref = numpy_tier(ids, space)
         np.testing.assert_array_equal(got, ref, err_msg=f"K={K} {space}")
         _assert_strictly_ascending(got, f"rt_dedup_sorted K={K} {space}")
+    # round-13 engagement re-key (the PR-6 named follow-up): the WIRED
+    # shape — pad_base = capacity >> K, ids clustered in a small working
+    # set PLUS the trash id (capacity-1) from bucket padding. The old
+    # 2*pad_base<=K predicate always declined here; the span predicate
+    # engages (the trash id rides out-of-band) and the product must
+    # still be the numpy oracle's, bit for bit.
+    for K, ws, cap in [(2048, 400, 1 << 16), (1024, 64, 1 << 20),
+                       (4096, 2000, 1 << 13), (256, 255, 1 << 8)]:
+        ids = rng.randint(0, ws, K).astype(np.int32)
+        ids[::7] = cap - 1          # the bucket-padding trash id
+        got = dedup_uids_sorted(ids, cap)
+        np.testing.assert_array_equal(got, numpy_tier(ids, cap),
+                                      err_msg=f"wired K={K} ws={ws}")
+        _assert_strictly_ascending(got, f"wired K={K} ws={ws}")
+    # all-trash batch (a fully-padded bucket column)
+    ids = np.full(128, (1 << 12) - 1, np.int32)
+    np.testing.assert_array_equal(dedup_uids_sorted(ids, 1 << 12),
+                                  numpy_tier(ids, 1 << 12))
+    # clustered low WITHOUT trash (single-host uid-wire shape)
+    ids = rng.randint(0, 100, 1024).astype(np.int32)
+    np.testing.assert_array_equal(dedup_uids_sorted(ids, 1 << 16),
+                                  numpy_tier(ids, 1 << 16))
     # out-of-contract ids (>= pad_base) on an otherwise-accepted shape:
     # the native tier must DECLINE (its presence table is exactly
     # pad_base bytes — marking past it is a heap overwrite) and the

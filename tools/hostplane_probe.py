@@ -1,4 +1,5 @@
-"""Host-plane exchange ladder: store allgather vs p2p a2a vs p2p+uid.
+"""Host-plane exchange ladder: store allgather vs p2p a2a vs p2p+uid,
+plus the round-13 sharding-POLICY leg.
 
 Round-9 acceptance probe: REAL multi-process measurement of the per-step
 cluster bucket exchange (the staging stage the p2p mesh replaces), at 2-4
@@ -18,8 +19,20 @@ Per tier: `runs` timed drives of `steps` exchanges each, MEDIAN per-step
 staging ms reported (container CPU noise otherwise dominates), plus
 exchange bytes/step from the hostplane stat counters.
 
+POLICY leg (--policies, round 13): the full route-and-stage path
+(bucketize through the policy's native router + the p2p uid exchange)
+on a SKEWED-TABLE workload — zipf-ish table sizes with a hot long-tail
+key set carrying half of all occurrences — under key-mod, table-wise,
+2d-grid, and 2d-grid with the replicated hot tier active. Per policy:
+median staging ms + exchange bytes/step from the hostplane counters
+(the PR-5 obs stats are the per-policy measurement), p2p-vs-store
+product parity per rank, and per-rank received-byte imbalance. The
+acceptance bar: the hot-tier leg must cut per-rank exchange bytes vs
+key-mod (routing alone conserves total routed ids — only replication
+removes bytes from this host plane; see BASELINE.md round 13).
+
 Usage:  timeout 900 python -u tools/hostplane_probe.py [--worlds 2,4]
-            [--kb 32768] [--steps 4] [--runs 3]
+            [--kb 32768] [--steps 4] [--runs 3] [--policies]
 Prints one JSON line per world plus {"all_ok": ...}; exits 1 on failure.
 """
 
@@ -65,6 +78,162 @@ def stage_tier(kind: str, buckets, positions, num_devices: int,
         return exchange_push_uids_p2p(buckets, positions, num_devices,
                                       shard_cap, mesh, pool=pool)
     raise ValueError("unknown hostplane tier %r" % kind)
+
+
+def _policy_legs(num_devices: int, num_tables: int, shift: int):
+    """The measured policy ladder (construction shared by worker and any
+    parity caller): hot threshold 2 on the last leg; the hot set is
+    observed deterministically pre-freeze so every rank agrees."""
+    from paddlebox_tpu.parallel.sharding import (KeyModPolicy,
+                                                 TableWisePolicy,
+                                                 TwoDGridPolicy)
+    return [
+        ("key-mod", KeyModPolicy(num_devices)),
+        ("table-wise", TableWisePolicy(num_devices, num_tables, shift)),
+        ("2d-grid", TwoDGridPolicy(num_devices, num_tables,
+                                   rows=2, table_shift=shift)),
+        ("2d-grid+hot", TwoDGridPolicy(num_devices, num_tables, rows=2,
+                                       table_shift=shift,
+                                       hot_threshold=2, hot_cap=4096)),
+    ]
+
+
+def _skewed_world(num_tables: int, shift: int, n_keys: int, n_hot: int):
+    """Deterministic skewed-table key universe (same on every rank):
+    zipf-ish per-table sizes, table id in the high bits, plus a hot
+    long-tail set that will carry half of every batch's occurrences."""
+    rng = np.random.RandomState(777)
+    w = 1.0 / np.arange(1, num_tables + 1)
+    sizes = np.maximum(16, (w / w.sum() * n_keys)).astype(np.int64)
+    parts = []
+    for t, n in enumerate(sizes):
+        low = rng.randint(0, 1 << 30, int(n)).astype(np.uint64)
+        parts.append((np.uint64(t) << np.uint64(shift)) | low)
+    keys = np.unique(np.concatenate(parts))
+    hot = np.sort(rng.choice(keys, n_hot, replace=False))
+    return keys, hot
+
+
+def policy_worker() -> None:
+    """One rank of the policy-leg ladder: route (bucketize via the
+    policy router) + stage (p2p uid exchange under the policy) a skewed
+    batch stream per policy; parity vs the store path; measure ms and
+    exchange bytes from the hostplane stat counters."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from paddlebox_tpu.config import flags
+    from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
+                                              TableConfig)
+    from paddlebox_tpu.fleet.fleet import Fleet
+    from paddlebox_tpu.fleet.role_maker import RoleMaker
+    from paddlebox_tpu.parallel.sharded_table import (ShardedPassTable,
+                                                      stage_push_dedup)
+    from paddlebox_tpu.utils.stats import StatRegistry
+
+    kb = int(os.environ["HOSTPLANE_KB"])
+    steps = int(os.environ["HOSTPLANE_STEPS"])
+    runs = int(os.environ["HOSTPLANE_RUNS"])
+    parity_only = bool(os.environ.get("HOSTPLANE_PARITY_ONLY"))
+    # any bucket overflow would silently change products per policy —
+    # fail loud instead of publishing a corrupt ladder
+    flags.set_flag("strict_bucket_overflow", True)
+    T, SHIFT = 8, 48
+    fl = Fleet().init(RoleMaker())
+    rank, world = fl.worker_index(), fl.worker_num()
+    positions = _owned_positions(rank, world)
+    mesh = fl.make_mesh_comm(positions)
+    assert mesh is not None, "p2p mesh bring-up failed in probe worker"
+    # the zipf-hot regime the 2-D paper targets: a hot set ~kb wide
+    # carries 3/4 of every batch's occurrences, so per-(src,dest)
+    # uniques are hot-dominated — the shape where replication pays
+    keys, hot = _skewed_world(T, SHIFT, n_keys=6 * kb, n_hot=max(256, kb))
+    K = 2 * kb                       # occurrences per source per step
+    shard_cap = 1 << max(12, (6 * kb).bit_length())
+    cfg = TableConfig(embedx_dim=8,
+                      pass_capacity=NUM_DEVICES * shard_cap,
+                      optimizer=SparseOptimizerConfig())
+    pool = ThreadPoolExecutor(4)
+    stats = StatRegistry.instance()
+
+    def batch_for(step_i: int, pos_j: int) -> np.ndarray:
+        rng = np.random.RandomState(10_000 + rank * 211 + pos_j * 31
+                                    + step_i)
+        nh = (3 * K) // 4           # hot tail carries 3/4 of the load
+        b = np.concatenate([rng.choice(hot, nh),
+                            rng.choice(keys, K - nh)]).astype(np.uint64)
+        rng.shuffle(b)
+        return b
+
+    out = {}
+    for name, pol in _policy_legs(NUM_DEVICES, T, SHIFT):
+        table = ShardedPassTable(cfg, NUM_DEVICES, kb, policy=pol)
+        if getattr(pol, "hot_threshold", 0) > 0:
+            # deterministic global frequency knowledge, identical on
+            # every rank — the cluster-agreement contract freeze_hot
+            # relies on
+            for _ in range(pol.hot_threshold):
+                pol.observe(hot)
+        table.begin_feed_pass()
+        table.add_keys(keys)
+        table.end_feed_pass()       # freezes the hot tier
+
+        def stage(step_i: int, use_mesh):
+            buckets = []
+            for j in range(len(positions)):
+                b = batch_for(step_i, j)
+                valid = np.ones(b.size, bool)
+                buckets.append(table.bucketize(b, valid).buckets)
+            return stage_push_dedup(
+                buckets, positions, NUM_DEVICES, table.shard_cap,
+                multiprocess=True, all_gather=fl.all_gather,
+                rebuild=False, pool=pool, uid_only=True,
+                mesh=use_mesh, policy=pol)
+
+        # parity leg: p2p product vs store product on step 0. The hot
+        # leg's p2p product may exceed the store one by EXACTLY the
+        # replicated set (owners re-add whole hot sets; the store path
+        # ships everything) — anything else is corruption.
+        p2p0 = stage(0, mesh)
+        store0 = stage(0, None)
+        for i, d in enumerate(positions):
+            a = p2p0["push_uids"][i]
+            b = store0["push_uids"][i]
+            real_a = set(a[a < table.shard_cap].tolist())
+            real_b = set(b[b < table.shard_cap].tolist())
+            h = pol.hot_local_ids(d)
+            extra = real_a - real_b
+            assert real_b <= real_a, f"{name} dest {d}: p2p lost ids"
+            assert not extra or (h is not None and extra <= set(
+                h.tolist())), f"{name} dest {d}: non-hot extras {extra}"
+        if parity_only:
+            continue
+        fl.barrier_worker()
+        per_ms, per_bytes = [], []
+        for r in range(runs):
+            fl.barrier_worker()
+            b0 = stats.get("hostplane_exchange_bytes")
+            t0 = time.perf_counter()
+            for s in range(steps):
+                stage(1 + r * steps + s, mesh)
+            dt = time.perf_counter() - t0
+            per_ms.append(dt * 1e3 / steps)
+            per_bytes.append(
+                (stats.get("hostplane_exchange_bytes") - b0) // steps)
+        out[name] = {
+            "exchange_ms": round(float(np.median(per_ms)), 2),
+            "runs_ms": [round(x, 2) for x in per_ms],
+            "exchange_bytes": int(np.median(per_bytes)),
+            "hot_replicated": int(sum(
+                h.size for h in (pol.hot_local_ids(d)
+                                 for d in range(NUM_DEVICES))
+                if h is not None)),
+        }
+    if parity_only:
+        out = {"parity": "ok"}
+    print("RESULT " + json.dumps({"rank": rank, "world": world, "kb": kb,
+                                  "tiers": out}), flush=True)
+    pool.shutdown(wait=False)
+    fl.stop()
 
 
 def worker() -> None:
@@ -133,10 +302,12 @@ def worker() -> None:
 
 
 def run_world(world: int, kb: int, steps: int, runs: int,
-              parity_only: bool = False, timeout: float = 600.0) -> dict:
+              parity_only: bool = False, timeout: float = 600.0,
+              policies: bool = False) -> dict:
     """Spawn a `world`-process localhost cluster of probe workers (the
     test_multihost subprocess pattern — but pure host-plane: no jax
-    collectives, so it runs on this CPU container)."""
+    collectives, so it runs on this CPU container). policies=True runs
+    the round-13 policy ladder instead of the transport ladder."""
     import uuid
 
     from paddlebox_tpu.fleet.store import KVStoreServer
@@ -161,6 +332,8 @@ def run_world(world: int, kb: int, steps: int, runs: int,
             })
             if parity_only:
                 env["HOSTPLANE_PARITY_ONLY"] = "1"
+            if policies:
+                env["HOSTPLANE_POLICIES"] = "1"
             procs.append(subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__)], env=env,
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
@@ -190,12 +363,28 @@ def main() -> None:
     ap.add_argument("--kb", type=int, default=32768)
     ap.add_argument("--steps", type=int, default=4)
     ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--policies", action="store_true",
+                    help="run the round-13 sharding-policy ladder "
+                         "(key-mod / table-wise / 2d-grid / +hot) on "
+                         "the skewed-table workload")
     args = ap.parse_args()
     ok = True
     for world in [int(w) for w in args.worlds.split(",")]:
         try:
-            r = run_world(world, args.kb, args.steps, args.runs)
+            r = run_world(world, args.kb, args.steps, args.runs,
+                          policies=args.policies)
             tiers = r["tiers"]
+            if args.policies:
+                # acceptance: the replicated hot tier must remove bytes
+                # from the wire (pure re-routing conserves them)
+                better = (tiers["2d-grid+hot"]["exchange_bytes"]
+                          < tiers["key-mod"]["exchange_bytes"])
+                ok = ok and better
+                print(json.dumps({
+                    "probe": "hostplane_policy", "world": world,
+                    "kb": r["kb"], "tiers": tiers,
+                    "hot_beats_keymod_bytes": better}), flush=True)
+                continue
             # the acceptance bar: p2p must beat the store funnel
             faster = (tiers["p2p"]["exchange_ms"] < tiers["store"]["exchange_ms"]
                       or tiers["p2p_uid"]["exchange_ms"]
@@ -214,6 +403,9 @@ def main() -> None:
 
 if __name__ == "__main__":
     if os.environ.get("HOSTPLANE_WORKER"):
-        worker()
+        if os.environ.get("HOSTPLANE_POLICIES"):
+            policy_worker()
+        else:
+            worker()
     else:
         main()

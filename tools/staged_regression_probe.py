@@ -44,6 +44,14 @@ FLOORS = {
     "rt_dedup_keys_per_sec": (47.2e6, 19e6),
     "uid_sort_keys_per_sec": (116e6, 40e6),
     "bucketize_keys_per_sec": (21.1e6, 8e6),
+    # round-13: the policy-parameterized router (rt_bucketize_sharded
+    # under a non-key-mod ShardingPolicy: vectorized numpy shard_of +
+    # the native dedup/bucket loop) at the bucketize section's exact
+    # shape — measured ~3% under the key-mod tier isolated (recorded
+    # quiet-derived on 2026-08-04: key-mod's 21.1M x 0.95; the same-day
+    # loaded box measured 8.4M vs key-mod's concurrent 10.2M); floor =
+    # ~35% so this section's wider numpy-premix noise rides out
+    "policy_route_keys_per_sec": (20.1e6, 7e6),
     "parse_lines_per_sec": (722e3, 290e3),
     "pack_instances_per_sec": (722e3, 290e3),
     # round-8: the uid-lean wire END TO END on CPU (host stage + H2D +
@@ -215,6 +223,33 @@ def section_bucketize(rng, K):
     measure = lambda: timed_rate(  # noqa: E731
         lambda: t.bucketize(probe, valid.copy()), K)
     report("bucketize_keys_per_sec", measure(), remeasure=measure)
+
+
+def section_policy_route(rng, K):
+    # --- policy-parameterized router (round 13) ----------------------
+    # the same bucketize shape through a NON-key-mod policy, so the
+    # rt_bucketize_sharded tier (pre-mixed numpy shard_of + native
+    # dedup/bucket loop) is guarded separately from the legacy key-mod
+    # fast path — a regression here would silently slow every
+    # table-wise/2d-grid deployment's staging
+    from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
+                                              TableConfig)
+    from paddlebox_tpu.parallel.sharded_table import ShardedPassTable
+    from paddlebox_tpu.parallel.sharding import TableWisePolicy
+    pass_keys = np.unique(rng.randint(0, 1 << 40, 1 << 20).astype(np.uint64))
+    probe = rng.choice(pass_keys, K).astype(np.uint64)
+    t = ShardedPassTable(
+        TableConfig(embedx_dim=8, pass_capacity=1 << 21,
+                    optimizer=SparseOptimizerConfig()),
+        num_shards=8, bucket_cap=4 * K // 8,
+        policy=TableWisePolicy(8, num_tables=64, table_shift=0))
+    t.begin_feed_pass()
+    t.add_keys(pass_keys)
+    t.end_feed_pass()
+    valid = np.ones(K, bool)
+    measure = lambda: timed_rate(  # noqa: E731
+        lambda: t.bucketize(probe, valid.copy()), K)
+    report("policy_route_keys_per_sec", measure(), remeasure=measure)
 
 
 def section_p2p(rng, K):
@@ -426,6 +461,7 @@ def section_serving(rng, K):
 SECTIONS = (
     ("native", section_native),
     ("bucketize", section_bucketize),
+    ("policy_route", section_policy_route),
     ("p2p", section_p2p),
     ("parse", section_parse),
     ("e2e", section_e2e),
