@@ -80,7 +80,42 @@ STEPS = 12         # timed chunks
 WARMUP = 2
 
 PROBE_TIMEOUT = int(os.environ.get("PBTPU_BENCH_PROBE_TIMEOUT", "120"))
-RUN_TIMEOUT = int(os.environ.get("PBTPU_BENCH_RUN_TIMEOUT", "900"))
+RUN_TIMEOUT = int(os.environ.get("PBTPU_BENCH_RUN_TIMEOUT", "1100"))
+
+# Round-14: every run stamps its emitted record to a BENCH_rNN.json in
+# the repo root (the driver stopped archiving them after round 5, which
+# made the bench trajectory invisible — tools/bench_trend.py reads the
+# stamped series). Bump SCHEMA_VERSION when the record's field meanings
+# change, never for additive fields.
+SCHEMA_VERSION = 2
+
+
+def _stamp_bench_json(record: dict) -> str:
+    """Write the final record next to the historical BENCH_r*.json files
+    (same {"n", "parsed"} envelope the driver used, plus schema_version
+    and self_stamped), at the next free round number. Returns the path
+    ('' on failure — stamping must never fail the bench)."""
+    try:
+        out = os.environ.get("PBTPU_BENCH_OUT", "")
+        root = os.path.dirname(os.path.abspath(__file__))
+        if not out:
+            import re
+            taken = []
+            for fn in os.listdir(root):
+                m = re.match(r"BENCH_r(\d+)\.json$", fn)
+                if m:
+                    taken.append(int(m.group(1)))
+            n = max(taken, default=0) + 1
+            out = os.path.join(root, "BENCH_r%02d.json" % n)
+        else:
+            n = 0
+        with open(out, "w", encoding="utf-8") as fh:
+            json.dump({"n": n, "schema_version": SCHEMA_VERSION,
+                       "self_stamped": True, "ts": time.time(),
+                       "parsed": record}, fh)
+        return out
+    except OSError:
+        return ""
 
 
 def _force_platform(platform: str) -> None:
@@ -337,6 +372,78 @@ def measure(platform: str) -> None:
                                    if e.get("ph") == "X"),
                 "chrome_trace_valid": trace_ok}
 
+    def flight_overhead(pairs: int = 7) -> dict:
+        """Round-14 acceptance block: the SAME paired-alternating
+        protocol as telemetry_overhead, but the "on" arm runs the FULL
+        durable tier — span tracer + StepReporter at default cadence +
+        an ACTIVE flight recorder (reports, span windows and beats
+        landing flushed on disk) — against everything-off. Shorter
+        drives (2 chunks) and 7 pairs keep the block inside the bench
+        budget; estimators are identical (best-rate ratio headline,
+        median pair ratio as the conservative bound)."""
+        import shutil
+        import tempfile
+
+        import paddlebox_tpu.obs as _obs
+        from paddlebox_tpu.obs import flight as _flight
+        from paddlebox_tpu.obs import watchdog as _watchdog
+        from paddlebox_tpu.obs.tracer import get_tracer
+
+        d = tempfile.mkdtemp(suffix="_flight")
+        # direct-constructed recorder, NO crash hooks: the bench process
+        # must exit exactly as before, and the recorder swap below must
+        # not leak into the other blocks
+        fr = _flight.FlightRecorder(d, rank=0)
+        reporter = _obs.StepReporter(every=20, sink=_obs.NullSink())
+        steps = [0]
+
+        def run_arm(on: bool) -> float:
+            get_tracer().enabled = on
+            _flight.set_active(fr if on else None)
+
+            def on_chunk(lo, group, losses_np, preds):
+                steps[0] += len(group)
+                _watchdog.beat("bench_step")   # feeds the flight sampler
+                reporter.note_examples(len(group) * BATCH)
+                reporter.maybe_report(steps[0])
+
+            try:
+                return run_e2e(tg=1, runs=1, n_chunks=2,
+                               on_chunk=on_chunk if on else None
+                               )["examples_per_sec"]
+            finally:
+                _flight.set_active(None)
+
+        rates_on, rates_off, ratios = [], [], []
+        for i in range(pairs):
+            if i % 2:
+                off = run_arm(False)
+                on = run_arm(True)
+            else:
+                on = run_arm(True)
+                off = run_arm(False)
+            rates_on.append(on)
+            rates_off.append(off)
+            ratios.append(on / max(off, 1e-9))
+        get_tracer().enabled = True
+        records = 0
+        for p in fr.segments():
+            with open(p) as fh:
+                records += sum(1 for _ in fh)
+        fr.close()
+        shutil.rmtree(d, ignore_errors=True)
+        ratio_best = float(max(rates_on) / max(max(rates_off), 1e-9))
+        ratio_med = float(np.median(ratios))
+        return {"examples_per_sec_on": round(float(np.median(rates_on)), 1),
+                "examples_per_sec_off": round(float(np.median(rates_off)), 1),
+                "runs_on": [round(r, 1) for r in rates_on],
+                "runs_off": [round(r, 1) for r in rates_off],
+                "pair_ratios": [round(r, 4) for r in ratios],
+                "overhead_pct": round(100.0 * (1.0 - ratio_best), 2),
+                "overhead_pct_median_pair": round(
+                    100.0 * (1.0 - ratio_med), 2),
+                "flight_records": records}
+
     tiers = {
         "grouped": run_e2e(tg=4),
         "ungrouped": run_e2e(tg=1),
@@ -359,6 +466,13 @@ def measure(platform: str) -> None:
         telemetry = telemetry_overhead()
     except Exception as e:  # noqa: BLE001 — diagnostic tier, not the metric
         telemetry = {"error": repr(e)[:300]}
+
+    # round-14: flight-recorder overhead at default cadence (≤2% target,
+    # recorded in BASELINE.md round 14). GUARDED like every diagnostic.
+    try:
+        flight = flight_overhead()
+    except Exception as e:  # noqa: BLE001 — diagnostic tier, not the metric
+        flight = {"error": repr(e)[:300]}
 
     # pass-amortized tier (round-6): the full begin_feed → train →
     # end_pass lifecycle at 0% and ~90% working-set overlap, full vs
@@ -457,6 +571,7 @@ def measure(platform: str) -> None:
 
     eps = CHUNK * BATCH / dt
     print(json.dumps({
+        "schema_version": SCHEMA_VERSION,
         "examples_per_sec": eps,
         "platform": jax.devices()[0].platform,
         "device": str(jax.devices()[0]),
@@ -479,6 +594,7 @@ def measure(platform: str) -> None:
         "pass_amortized_examples_per_sec": pa_eps,
         "push_ladder": ladder,
         "telemetry_overhead": telemetry,
+        "flight_overhead": flight,
         "compile_warmup_s": round(t_compile, 1),
     }))
 
@@ -518,12 +634,15 @@ def main() -> None:
         diags[f"measure_{platform}"] = str(meas)
 
     if result is None:
-        print(json.dumps({
+        failed = {
             "metric": "deepfm_sparse_train_examples_per_sec_per_chip",
+            "schema_version": SCHEMA_VERSION,
             "value": 0.0, "unit": "examples/sec/chip", "vs_baseline": 0.0,
             "pass_amortized_examples_per_sec": 0.0,
             "error": "all backends failed", "diags": diags,
-        }))
+        }
+        failed["bench_json"] = _stamp_bench_json(failed)
+        print(json.dumps(failed))
         return
 
     # round-9: multi-process host-plane exchange tier (store allgather vs
@@ -560,8 +679,9 @@ def main() -> None:
     # (an env-provided TPU baseline must not leak into a CPU-named key).
     on_tpu = result["platform"] not in ("cpu",)
     cpu_base = SELF_BASELINE["cpu"]
-    print(json.dumps({
+    final = {
         "metric": "deepfm_sparse_train_examples_per_sec_per_chip",
+        "schema_version": SCHEMA_VERSION,
         "value": round(eps, 1),
         "unit": "examples/sec/chip",
         "vs_baseline": round(vs, 3) if on_tpu else None,
@@ -587,10 +707,13 @@ def main() -> None:
             "pass_amortized_examples_per_sec", 0.0),
         "push_ladder": result.get("push_ladder"),
         "telemetry_overhead": result.get("telemetry_overhead"),
+        "flight_overhead": result.get("flight_overhead"),
         "hostplane": hostplane,
         "compile_warmup_s": result.get("compile_warmup_s"),
         "diags": diags,
-    }))
+    }
+    final["bench_json"] = _stamp_bench_json(final)
+    print(json.dumps(final))
 
 
 if __name__ == "__main__":

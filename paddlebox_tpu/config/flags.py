@@ -329,6 +329,24 @@ define_flag("obs_watchdog_secs", 0.0,
             "arrives within the threshold the watchdog dumps the last-K "
             "spans, every thread's stack, and the last StepReport to "
             "stderr. <=0 = disabled")
+define_flag("obs_flight_dir", "",
+            "flight-recorder directory (obs/flight.py, round 14): when "
+            "set, every rank keeps an always-on bounded on-disk black "
+            "box — segment-rotated JSONL of a flags+env+git-sha header, "
+            "StepReports, cluster reports/health, span windows at "
+            "report cadence, warning/error log lines and sampled beats, "
+            "flushed per record so it survives SIGKILL — plus a SEALED "
+            "postmortem manifest (last-K spans, every thread's stack, "
+            "last reports) written on excepthook, SIGABRT/SIGTERM, or a "
+            "watchdog fire. The failure artifact the elastic fleet "
+            "(ROADMAP item 5) consumes. '' = off (zero cost)")
+define_flag("obs_flight_segment_bytes", 4 << 20,
+            "flight-recorder segment rotation size in bytes; total disk "
+            "per rank is bounded by this times obs_flight_segments")
+define_flag("obs_flight_segments", 4,
+            "flight-recorder segments retained per rank (oldest "
+            "deleted at rotation; each segment re-writes the run "
+            "header so any surviving segment is self-contained)")
 define_flag("obs_watchdog_action", "dump",
             "what the watchdog does after dumping: 'dump' = report only "
             "(fires once per silence window), 'raise' = also interrupt "
@@ -373,6 +391,15 @@ define_flag("serving_report_requests", 200,
             "latency from the serving_lookup_us histogram, keys/s, "
             "request count, cache hit rate — through the standard "
             "obs_report_path sink. <=0 = reporting off")
+define_flag("serving_slo_us", 15000.0,
+            "serving lookup latency SLO in microseconds (round 14): "
+            "every report window each replica publishes gauge "
+            "serving_slo_burn = window p99 of serving_lookup_us divided "
+            "by this — burn > 1.0 means the replica is out of SLO and "
+            "the cluster health plane (obs/health.py) scores it "
+            "degraded. Default 15ms sits above the recorded quiet-"
+            "container p99 ceiling (BASELINE round 12: 4.6-7.1ms at "
+            "b4096 incl first-touch page-in). <=0 disables the gauge")
 define_flag("preload_promote", True,
             "overlap the NEXT pass's host-side promote work (key diff + "
             "host-store reads for non-resident keys) with the current "

@@ -36,7 +36,8 @@ from typing import Dict, Iterable, List, Mapping, Optional, Tuple
 import numpy as np
 
 from paddlebox_tpu.obs import beat as obs_beat
-from paddlebox_tpu.obs.tracer import record_span
+from paddlebox_tpu.obs.tracer import (current_trace, record_span,
+                                      step_trace_id)
 from paddlebox_tpu.utils.rpc import FramedClient, FramedServer, plain_loads
 from paddlebox_tpu.utils.stats import hist_observe
 
@@ -146,11 +147,21 @@ class MeshComm:
             return True
         if op != "part":
             raise ValueError("unknown mesh op %r" % (op,))
+        t0 = time.perf_counter()
         key = (int(req["seq"]), int(req["from"]))
         with self._cv:
             self._inbox[key] = req
             self.bytes_recv += len(req["data"])
             self._cv.notify_all()
+        # receiver-side span tagged with the SENDER's trace id (round
+        # 14): the cross-rank hop trace_stitch.py turns into a ph:s/f
+        # flow event — one step followed sender rank -> owner rank.
+        # isinstance, not int(): a garbage trace from a skewed peer is
+        # a telemetry value and must NEVER fail the lockstep exchange
+        # (same armor as serving/codec.decode_trace)
+        trace = req.get("trace")
+        record_span("mesh_recv_part", t0, time.perf_counter(),
+                    trace=trace if isinstance(trace, int) else None)
         return True
 
     # -------------------------------------------------- telemetry piggyback
@@ -283,11 +294,24 @@ class MeshComm:
                              "%s" % (self.world - 1, sorted(parts)))
         self._seq += 1
         seq = self._seq
+        # cross-plane trace id (round 14): inherit the caller's step
+        # trace when one is set on this thread, else mint a rank+seq id
+        # — the id rides every part's frame header and the receiver
+        # records it, which is what lets trace_stitch.py draw this
+        # exchange as flow arrows across the cluster timeline. The mint
+        # sets bit 62: the stager thread's seq counts ~1:1 with the
+        # consumer's step counter, so an un-namespaced mint would
+        # systematically collide with the rank's own step ids and
+        # stitch unrelated spans into one flow
+        trace = current_trace()
+        if trace is None:
+            trace = (1 << 62) | step_trace_id(self.rank, seq)
         t0 = time.perf_counter()
 
         def send_one(r: int) -> int:
             frame = _frame(parts[r])
             self._client(r).call(dict(frame, op="part", seq=seq,
+                                      trace=trace,
                                       **{"from": self.rank}),
                                  op_timeout=self._op_timeout)
             return len(frame["data"])
@@ -333,7 +357,7 @@ class MeshComm:
         t1 = time.perf_counter()
         self.exchange_ms += (t1 - t0) * 1e3
         self.exchanges += 1
-        record_span("mesh_exchange", t0, t1)
+        record_span("mesh_exchange", t0, t1, trace=trace)
         hist_observe("mesh_exchange_us", (t1 - t0) * 1e6)
         # the exchange is a cluster-progress boundary: a peer that never
         # answers shows up as watchdog silence with this as the last beat

@@ -17,6 +17,21 @@ platform/monitor.h + timer discipline + chrometracing profiler did
                    the last StepReport on silence (obs/watchdog.py)
   * log          — rank-prefixed structured lines replacing bare print()
                    in library code (obs/log.py; boxlint BX501 enforces)
+  * flight       — always-on bounded on-disk black box per rank with
+                   crash SEALING (excepthook / fatal signal / watchdog
+                   fire → durable manifest of spans+stacks+reports);
+                   the postmortem artifact a SIGKILL'd rank leaves
+                   behind (obs/flight.py, round 14)
+  * health       — rank 0 folds report freshness, beat age, error-line
+                   rate, queue depths and serving SLO burn into a
+                   per-rank health score published as cluster_health
+                   each aggregation cadence — the elastic-fleet trigger
+                   signal (obs/health.py, round 14)
+  * trace ids    — 64-bit per-step/per-request ids carried across the
+                   p2p mesh and the serving RPC boundary; spans record
+                   them, tools/trace_stitch.py merges per-rank chrome
+                   traces into one cluster timeline with cross-rank
+                   flow events (obs/tracer.py, round 14)
 
 Import surface is deliberately jax-free: every hot-path hook (span,
 beat) must stay importable and near-free on any host — the serving
@@ -25,16 +40,20 @@ processes (per-pull latency histograms, QPS windows, cache-rate extras
 ride the same StepReport/sink/aggregation machinery unchanged).
 """
 
+from paddlebox_tpu.obs import flight  # noqa: F401
 from paddlebox_tpu.obs import log  # noqa: F401
 from paddlebox_tpu.obs.aggregate import (ClusterAggregator,  # noqa: F401
                                          MeshObsTransport, StoreObsTransport,
                                          make_transport,
                                          merge_cluster_reports)
+from paddlebox_tpu.obs.flight import FlightRecorder  # noqa: F401
+from paddlebox_tpu.obs.health import HealthMonitor  # noqa: F401
 from paddlebox_tpu.obs.report import (JsonlSink, ListSink,  # noqa: F401
                                       MetricsSink, NullSink, StderrSink,
                                       StepReporter, make_sink)
-from paddlebox_tpu.obs.tracer import (SpanTracer, get_tracer,  # noqa: F401
-                                      span)
+from paddlebox_tpu.obs.tracer import (SpanTracer, current_trace,  # noqa: F401
+                                      get_tracer, next_trace_id, span,
+                                      step_trace_id, trace_ctx)
 from paddlebox_tpu.obs.tracer import \
     configure_from_flags as _tracer_configure
 from paddlebox_tpu.obs.watchdog import StallWatchdog  # noqa: F401
@@ -44,9 +63,11 @@ from paddlebox_tpu.obs.watchdog import ensure_from_flags as _wd_ensure
 
 def make_step_reporter(rank: int = 0, timers=None, aggregator=None,
                        **kwargs) -> StepReporter:
-    """Flag-configured reporter + tracer sync + (flag-gated) watchdog —
-    the one call every trainer makes at construction."""
+    """Flag-configured reporter + tracer sync + (flag-gated) watchdog +
+    (flag-gated) flight recorder — the one call every trainer makes at
+    construction."""
     _tracer_configure()
+    flight.ensure_from_flags(rank=rank)
     reporter = StepReporter(rank=rank, timers=timers,
                             aggregator=aggregator, **kwargs)
     _wd_ensure(tracer=get_tracer(), report_fn=reporter.peek)
@@ -71,15 +92,18 @@ def make_cluster_aggregator(mesh=None, fleet=None, rank: int = 0,
                             world: int = 1):
     """The ONE multi-process aggregator wiring both sharded runners use:
     transport from the job's existing plane (p2p mesh, else fleet
-    store), rank 0 emitting merged cluster reports through the
-    flag-configured sink. None when no piggyback plane exists."""
+    store), rank 0 emitting merged cluster reports — and the derived
+    cluster_health records (obs/health.py) — through the flag-configured
+    sink. None when no piggyback plane exists."""
     transport = make_transport(mesh=mesh, fleet=fleet)
     if transport is None:
         return None
     from paddlebox_tpu.config import flags
     sink = (make_sink(str(flags.get_flag("obs_report_path")))
             if rank == 0 else None)
-    return ClusterAggregator(transport, rank, world, sink=sink)
+    health = HealthMonitor(world) if rank == 0 else None
+    return ClusterAggregator(transport, rank, world, sink=sink,
+                             health=health)
 
 
 def export_chrome_trace(path=None, rank: int = 0) -> dict:

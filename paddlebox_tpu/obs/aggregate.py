@@ -19,8 +19,9 @@ sums histogram bucket counts across ranks before computing percentiles
 from __future__ import annotations
 
 import json
+import time
 import uuid
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from paddlebox_tpu.obs.report import SCHEMA_VERSION, MetricsSink, NullSink
 from paddlebox_tpu.utils.stats import hist_percentile
@@ -164,47 +165,86 @@ class ClusterAggregator:
     Non-zero ranks: every publish ships the report to rank 0 (best
     effort; a transport failure degrades to a one-line warning, never
     fails the step). Rank 0: stashes its own report, drains peers'
-    latest, emits ONE merged cluster record through its sink. Only
-    snapshots that ARRIVED since the previous merge are merged — a
-    wedged rank drops out of the metrics (listed in stale_ranks)
-    instead of having its last-ever window re-merged as current
-    forever.
+    latest, emits ONE merged cluster record through its sink — and,
+    when a HealthMonitor is attached (obs/health.py), the derived
+    ``cluster_health`` record right behind it. Only snapshots that
+    ARRIVED since the previous merge are merged — a wedged rank drops
+    out of the metrics (listed in stale_ranks) instead of having its
+    last-ever window re-merged as current forever.
+
+    Failure policy (round 14): consecutive publish failures back off
+    EXPONENTIALLY instead of disabling forever — a transient NIC blip
+    or a peer restart must not kill cluster telemetry for the job
+    lifetime. The backoff is denominated in SKIPPED PUBLISHES (1, 2,
+    4, ... capped at BACKOFF_SKIP_CAP) with a BACKOFF_CAP_S wall-clock
+    ceiling, whichever expires first: publishes happen at report
+    cadence, and every skipped publish is a window rank 0 reads as
+    stale — so the re-probe latency must be bounded in WINDOWS (the
+    unit the health plane's stale-death threshold counts in), not just
+    in seconds. Any success resets everything; a transient blip
+    therefore costs a few stale (→ transiently degraded/unhealthy)
+    windows and recovers, never the rest of the job.
     """
 
+    #: consecutive failures before backoff starts
     MAX_PUBLISH_FAILURES = 3
+    #: max publishes skipped per backoff round (bounds stale windows)
+    BACKOFF_SKIP_CAP = 16
+    #: wall-clock ceiling on one backoff round (slow-cadence jobs)
+    BACKOFF_CAP_S = 60.0
 
     def __init__(self, transport, rank: int, world: int,
-                 sink: Optional[MetricsSink] = None) -> None:
+                 sink: Optional[MetricsSink] = None,
+                 health=None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
         self.transport = transport
         self.rank = int(rank)
         self.world = int(world)
         self.sink = sink or NullSink()
+        self.health = health
+        self._clock = clock
         self._window: Dict[int, dict] = {}   # rank -> report THIS window
         self.last_cluster_report: Optional[dict] = None
+        self.last_cluster_health: Optional[dict] = None
         self._failures = 0
-        self._dead = False
+        self._skip_remaining = 0
+        self._backoff_until = 0.0
 
     def publish(self, report: dict) -> Optional[dict]:
-        if self._dead:
-            return None
+        if (self._skip_remaining > 0
+                and self._clock() < self._backoff_until):
+            self._skip_remaining -= 1
+            return None             # backing off; re-probe after the skips
         try:
             if self.rank != 0:
                 self.transport.publish(json.dumps(report).encode())
                 self._failures = 0
+                self._skip_remaining = 0
+                self._backoff_until = 0.0
                 return None
             self._window[0] = report
-            return self.collect_and_emit()
+            merged = self.collect_and_emit()
+            self._failures = 0
+            self._skip_remaining = 0
+            self._backoff_until = 0.0
+            return merged
         except Exception as e:  # noqa: BLE001 — telemetry must not kill a step
             self._failures += 1
+            skips = 0
             if self._failures >= self.MAX_PUBLISH_FAILURES:
-                # repeated failures: stop paying the (bounded) publish
-                # cost every cadence — telemetry is best-effort, the
-                # training loop is not its retry budget
-                self._dead = True
+                # stop paying the publish cost every cadence, but KEEP
+                # re-probing: skipped-publish count doubles per failure
+                # past the threshold, capped — a transient blip costs a
+                # bounded number of stale windows, never the job
+                skips = min(
+                    self.BACKOFF_SKIP_CAP,
+                    2 ** (self._failures - self.MAX_PUBLISH_FAILURES))
+                self._skip_remaining = skips
+                self._backoff_until = self._clock() + self.BACKOFF_CAP_S
             from paddlebox_tpu.obs import log as obs_log
             obs_log.warning(
                 "cluster telemetry publish failed%s" % (
-                    " — disabling cluster aggregation" if self._dead
+                    " — skipping next %d publish(es)" % skips if skips
                     else ""), error=repr(e)[:200],
                 failures=self._failures)
             return None
@@ -226,6 +266,16 @@ class ClusterAggregator:
         self._window = {}
         self.last_cluster_report = merged
         self.sink.emit(merged)
+        from paddlebox_tpu.obs import flight as _flight
+        fr = _flight.active()
+        if fr is not None:
+            fr.on_report(merged)
+        if self.health is not None:
+            hrec = self.health.update(merged)
+            self.last_cluster_health = hrec
+            self.sink.emit(hrec)
+            if fr is not None:
+                fr.on_report(hrec)
         return merged
 
     def close(self) -> None:

@@ -1,0 +1,445 @@
+"""Flight recorder: always-on, bounded, per-rank on-disk black box.
+
+The PR-5 telemetry plane is rank-local and in-memory: a SIGKILL'd or
+wedged rank takes its spans, reports and stacks to the grave, which is
+exactly when they were needed. The flight recorder is the durable tier:
+every rank appends compact JSONL records — a flags+env+git-sha header,
+StepReports, cluster reports/health, span windows at report cadence,
+warning/error log lines, sampled watchdog beats — into segment-rotated
+files under ``obs_flight_dir`` (bounded: ``obs_flight_segments`` x
+``obs_flight_segment_bytes``, oldest dropped), flushed per record so the
+file survives even SIGKILL.
+
+Crash SEALING: on ``sys.excepthook``, a fatal signal (SIGABRT/SIGTERM;
+faulthandler covers SIGSEGV-class C crashes into ``fatal_r<rank>.txt``),
+or a watchdog fire, the recorder flushes and writes a ``SEALED``
+manifest — one JSON bundling the reason, the exception, last-K spans,
+EVERY thread's stack, the last few StepReports, and the recent
+warning/error log tail. This is the failure artifact ROADMAP item 5
+(elastic fleet) names: the replacement-rank decision can be made from
+the dead rank's bundle, not from guesswork.
+
+Import surface stays jax-free (the serving replicas run this too).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import IO, List, Optional
+
+SCHEMA_VERSION = 1
+
+#: SEALED manifests retained per rank: the first seal usually names the
+#: root cause, but a watchdog seal followed by the real crash must not
+#: be masked — later seals get numbered siblings, bounded.
+MAX_SEALS = 4
+
+
+def _git_sha(start: Optional[str] = None) -> str:
+    """Best-effort HEAD sha by walking ``.git`` upward from ``start`` —
+    no subprocess (the recorder must construct in milliseconds and in
+    processes with no git on PATH)."""
+    d = os.path.abspath(start or os.getcwd())
+    while True:
+        git = os.path.join(d, ".git")
+        if os.path.isdir(git):
+            try:
+                with open(os.path.join(git, "HEAD")) as fh:
+                    head = fh.read().strip()
+                if not head.startswith("ref:"):
+                    return head[:40]
+                ref = head.split(None, 1)[1]
+                ref_path = os.path.join(git, ref)
+                if os.path.exists(ref_path):
+                    with open(ref_path) as fh:
+                        return fh.read().strip()[:40]
+                packed = os.path.join(git, "packed-refs")
+                if os.path.exists(packed):
+                    with open(packed) as fh:
+                        for ln in fh:
+                            if ln.strip().endswith(ref):
+                                return ln.split()[0][:40]
+            except OSError:
+                return ""
+            return ""
+        parent = os.path.dirname(d)
+        if parent == d:
+            return ""
+        d = parent
+
+
+def _thread_stacks() -> dict:
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for tid, frame in sys._current_frames().items():
+        out["%s/%d" % (names.get(tid, "?"), tid)] = [
+            ln.rstrip() for entry in traceback.format_stack(frame)
+            for ln in entry.splitlines()]
+    return out
+
+
+class FlightRecorder:
+    """One rank's black box. Thread contract: ``record`` and friends may
+    be called from any thread (one RLock around the file — reentrant so
+    a fatal-signal seal interrupting a record on the main thread cannot
+    deadlock on itself)."""
+
+    def __init__(self, flight_dir: str, rank: int = 0,
+                 segment_bytes: int = 4 << 20, max_segments: int = 4,
+                 beat_secs: float = 1.0, last_k_spans: int = 96) -> None:
+        self.dir = flight_dir
+        self.rank = int(rank)
+        self.segment_bytes = int(segment_bytes)
+        self.max_segments = max(1, int(max_segments))
+        self.beat_secs = float(beat_secs)
+        self.last_k_spans = int(last_k_spans)
+        self._lock = threading.RLock()
+        self._fh: Optional[IO[str]] = None  # guarded-by: _lock
+        self._seg_idx = 0  # guarded-by: _lock
+        self._seg_bytes = 0  # guarded-by: _lock
+        self._seals = 0  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
+        # lock-free beat sampling gate (one float store; a torn read just
+        # records one extra beat line)
+        self._last_beat_rec = 0.0
+        self._last_reports: deque = deque(maxlen=3)  # guarded-by: _lock
+        self._log_tail: deque = deque(maxlen=64)  # guarded-by: _lock
+        self._last_span_t = 0.0  # guarded-by: _lock
+        os.makedirs(self.dir, exist_ok=True)
+        self._open_segment(0)
+
+    # ------------------------------------------------------------ segments
+    def _seg_path(self, idx: int) -> str:
+        return os.path.join(self.dir,
+                            "flight_r%d_%04d.jsonl" % (self.rank, idx))
+
+    def header(self) -> dict:
+        from paddlebox_tpu.config import flags as _flags
+        return {"type": "header", "v": SCHEMA_VERSION, "ts": time.time(),
+                "rank": self.rank, "pid": os.getpid(),
+                "argv": list(sys.argv), "python": sys.version.split()[0],
+                "git_sha": _git_sha(),
+                "flags": _flags.all_flags(),
+                "env": {k: v for k, v in sorted(os.environ.items())
+                        if k.startswith("PBTPU_")
+                        or k in ("JAX_PLATFORMS", "XLA_FLAGS")}}
+
+    def _open_segment(self, idx: int) -> None:
+        # each segment is self-contained: the header repeats at its top
+        # so rotating away segment 0 never loses the run identity. The
+        # header is written DIRECTLY (no rotation check): a header
+        # larger than segment_bytes must not recurse into rotation
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+            self._seg_idx = idx
+            self._fh = open(self._seg_path(idx), "a", encoding="utf-8")
+            self._seg_bytes = self._fh.tell()
+            drop = self._seg_path(idx - self.max_segments)
+            if os.path.exists(drop):
+                try:
+                    os.unlink(drop)
+                except OSError:
+                    pass
+            try:
+                line = json.dumps(self.header(), default=repr) + "\n"
+                self._fh.write(line)
+                self._fh.flush()
+                self._seg_bytes += len(line.encode("utf-8"))
+            except (OSError, TypeError, ValueError):
+                pass
+
+    def segments(self) -> List[str]:
+        with self._lock:
+            lo = max(0, self._seg_idx - self.max_segments + 1)
+            return [self._seg_path(i)
+                    for i in range(lo, self._seg_idx + 1)
+                    if os.path.exists(self._seg_path(i))]
+
+    # ------------------------------------------------------------- records
+    def record(self, rtype: str, **fields) -> None:
+        """Append one flushed JSONL record; rotates segments past the
+        byte bound. Never raises — a full disk degrades telemetry, it
+        must not fail a training step."""
+        rec = {"type": rtype, "v": SCHEMA_VERSION, "ts": time.time(),
+               "rank": self.rank}
+        rec.update(fields)
+        try:
+            line = json.dumps(rec, default=repr) + "\n"
+        except (TypeError, ValueError):
+            return
+        with self._lock:
+            if self._closed or self._fh is None:
+                return
+            try:
+                self._fh.write(line)
+                self._fh.flush()
+            except (OSError, ValueError):
+                return
+            # ENCODED bytes, not characters: the rotation bound is a
+            # disk contract and multibyte payloads cost up to 4x len()
+            self._seg_bytes += len(line.encode("utf-8"))
+            if self._seg_bytes >= self.segment_bytes:
+                try:
+                    self._open_segment(self._seg_idx + 1)
+                except OSError:
+                    # dir deleted / disk full at rotation: the black
+                    # box degrades closed — it must NEVER crash the
+                    # training step it instruments
+                    self._closed = True
+
+    def on_report(self, report: dict) -> None:
+        """StepReport / cluster_report / cluster_health passthrough —
+        the report IS the record (it already carries type/ts/rank)."""
+        with self._lock:
+            if report.get("type") == "step_report":
+                self._last_reports.append(report)
+        self.record("report", report=report)
+        self._record_span_window()
+
+    def _record_span_window(self) -> None:
+        """Spans that ENDED since the last window, compacted — riding the
+        report cadence keeps the disk rate bounded by obs_report_every,
+        not by span volume."""
+        from paddlebox_tpu.obs.tracer import get_tracer
+        with self._lock:
+            cut = self._last_span_t
+            spans = [s for s in get_tracer().all_spans() if s[4] > cut]
+            if not spans:
+                return
+            self._last_span_t = max(s[4] for s in spans)
+        spans = spans[-256:]
+        self.record("spans", n=len(spans), spans=[
+            [name, tid, round(t0, 6), round((t1 - t0) * 1e3, 3),
+             ("0x%016x" % (trace & (2**64 - 1))) if trace is not None
+             else None]
+            for name, tid, _tname, t0, t1, trace in spans])
+
+    def on_log(self, level: str, line: str) -> None:
+        with self._lock:
+            self._log_tail.append((time.time(), level, line))
+        self.record("log", level=level, line=line[:2000])
+
+    def on_beat(self, label: str) -> None:
+        """Sampled (>= beat_secs apart): beats are per-step-hot, the
+        black box needs liveness evidence, not every step."""
+        now = time.monotonic()
+        if now - self._last_beat_rec < self.beat_secs:
+            return
+        self._last_beat_rec = now
+        self.record("beat", label=label)
+
+    # --------------------------------------------------------------- seal
+    def seal(self, reason: str, exc: Optional[BaseException] = None,
+             extra_text: Optional[str] = None) -> Optional[str]:
+        """Flush and write the SEALED manifest: reason, exception,
+        last-K spans, every thread's stack, last reports, log tail,
+        segment list. Returns the manifest path (None past MAX_SEALS or
+        on an unwritable dir). Later seals write numbered siblings so a
+        watchdog seal can't mask the real crash manifest."""
+        from paddlebox_tpu.obs.tracer import get_tracer
+        with self._lock:
+            if self._seals >= MAX_SEALS:
+                return None
+            self._seals += 1
+            n = self._seals
+            last_reports = list(self._last_reports)
+            log_tail = [{"ts": t, "level": lv, "line": ln}
+                        for t, lv, ln in self._log_tail]
+        manifest = {
+            "type": "sealed", "v": SCHEMA_VERSION, "ts": time.time(),
+            "rank": self.rank, "pid": os.getpid(), "reason": reason,
+            "seal_index": n,
+            "exception": ("".join(traceback.format_exception(
+                type(exc), exc, exc.__traceback__))[-8000:]
+                if exc is not None else None),
+            "spans": [[name, tid, tname, round(t0, 6),
+                       round((t1 - t0) * 1e3, 3),
+                       ("0x%016x" % (trace & (2**64 - 1)))
+                       if trace is not None else None]
+                      for name, tid, tname, t0, t1, trace
+                      in get_tracer().last_spans(self.last_k_spans)],
+            "threads": _thread_stacks(),
+            "last_reports": last_reports,
+            "log_tail": log_tail,
+            "segments": [os.path.basename(p) for p in self.segments()],
+            "header": self.header(),
+        }
+        if extra_text:
+            manifest["extra_text"] = extra_text[-8000:]
+        self.record("sealed", reason=reason, seal_index=n)
+        path = os.path.join(
+            self.dir, "SEALED_r%d.json" % self.rank if n == 1
+            else "SEALED_r%d.%d.json" % (self.rank, n))
+        try:
+            with open(path, "w", encoding="utf-8") as fh:
+                json.dump(manifest, fh, default=repr)
+                fh.flush()
+                os.fsync(fh.fileno())
+        except OSError:
+            return None
+        return path
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+# ------------------------------------------------------------- module API
+_ACTIVE: Optional[FlightRecorder] = None
+_HOOKS_INSTALLED = False
+_FATAL_FH: Optional[IO[str]] = None
+
+
+def active() -> Optional[FlightRecorder]:
+    return _ACTIVE
+
+
+def set_active(fr: Optional[FlightRecorder]) -> Optional[FlightRecorder]:
+    global _ACTIVE
+    prev, _ACTIVE = _ACTIVE, fr
+    return prev
+
+
+def on_beat(label: str) -> None:
+    fr = _ACTIVE
+    if fr is not None:
+        fr.on_beat(label)
+
+
+def seal_active(reason: str, exc: Optional[BaseException] = None,
+                extra_text: Optional[str] = None) -> Optional[str]:
+    fr = _ACTIVE
+    if fr is None:
+        return None
+    try:
+        return fr.seal(reason, exc=exc, extra_text=extra_text)
+    except Exception:  # noqa: BLE001 — sealing must never raise into a crash path
+        return None
+
+
+def _excepthook(exc_type, exc, tb):
+    seal_active("excepthook:%s" % getattr(exc_type, "__name__", "?"),
+                exc=exc)
+    _PREV_EXCEPTHOOK(exc_type, exc, tb)
+
+
+def _thread_excepthook(args):
+    # a dead worker thread (stager, conn thread) is evidence, not a
+    # process death: record, don't seal — the watchdog seals if the job
+    # then wedges on the missing thread
+    fr = _ACTIVE
+    if fr is not None:
+        fr.on_log("ERROR", "uncaught in thread %r: %s" % (
+            getattr(args.thread, "name", "?"),
+            "".join(traceback.format_exception(
+                args.exc_type, args.exc_value, args.exc_traceback))[-2000:]))
+    _PREV_THREADHOOK(args)
+
+
+def _signal_handler(signum, frame):
+    name = signal.Signals(signum).name
+    seal_active("signal:%s" % name)
+    # restore whatever was there and re-deliver so exit semantics
+    # (status, core) are exactly the no-recorder ones
+    prev = _PREV_SIGNAL.get(signum, signal.SIG_DFL)
+    signal.signal(signum, prev if callable(prev) or prev in (
+        signal.SIG_DFL, signal.SIG_IGN) else signal.SIG_DFL)
+    os.kill(os.getpid(), signum)
+
+
+_PREV_EXCEPTHOOK = sys.excepthook
+_PREV_THREADHOOK = threading.excepthook
+_PREV_SIGNAL: dict = {}
+
+
+def install_crash_hooks(fr: FlightRecorder) -> None:
+    """Idempotent: chain sys.excepthook / threading.excepthook, enable
+    faulthandler into ``fatal_r<rank>.txt`` (C-level SIGSEGV-class
+    stacks), and register Python handlers for SIGABRT/SIGTERM that seal
+    then re-deliver. Hooks read the ACTIVE recorder at fire time, so a
+    recorder swap needs no re-install. Signal handlers only land when
+    called from the main thread (signal.signal's own constraint)."""
+    global _HOOKS_INSTALLED, _PREV_EXCEPTHOOK, _PREV_THREADHOOK, _FATAL_FH
+    try:
+        import faulthandler
+        fatal_path = os.path.join(fr.dir, "fatal_r%d.txt" % fr.rank)
+        fh = open(fatal_path, "w", encoding="utf-8")
+        faulthandler.enable(file=fh, all_threads=True)
+        prev, _FATAL_FH = _FATAL_FH, fh  # keep the fd alive for the C handler
+        if prev is not None:
+            try:
+                prev.close()
+            except OSError:
+                pass
+    except (OSError, RuntimeError):
+        pass
+    if _HOOKS_INSTALLED:
+        return
+    _PREV_EXCEPTHOOK = sys.excepthook
+    sys.excepthook = _excepthook
+    _PREV_THREADHOOK = threading.excepthook
+    threading.excepthook = _thread_excepthook
+    if threading.current_thread() is threading.main_thread():
+        for sig in (signal.SIGABRT, signal.SIGTERM):
+            try:
+                _PREV_SIGNAL[sig] = signal.signal(sig, _signal_handler)
+            except (OSError, ValueError):
+                pass
+    _HOOKS_INSTALLED = True
+
+
+def ensure_from_flags(rank: int = 0) -> Optional[FlightRecorder]:
+    """Flag-configured recorder (obs_flight_dir '' = off). Called by
+    make_step_reporter — every runner and serving server goes through
+    it. A changed dir swaps the recorder (tests set per-tmp dirs); an
+    empty flag closes and clears the active one, so the autouse flag
+    restore in tests self-heals the module state."""
+    global _ACTIVE
+    from paddlebox_tpu.config import flags
+    d = str(flags.get_flag("obs_flight_dir")).strip()
+    if not d:
+        if _ACTIVE is not None:
+            _ACTIVE.close()
+            _ACTIVE = None
+        return None
+    # same dir AND same rank reuses; a later caller that knows the real
+    # rank (the sharded runners resolve it after fleet init) must not be
+    # stuck with a stale rank-0 recorder writing the wrong artifacts
+    if (_ACTIVE is not None and _ACTIVE.dir == d
+            and _ACTIVE.rank == int(rank)):
+        return _ACTIVE
+    if _ACTIVE is not None:
+        _ACTIVE.close()
+        _ACTIVE = None
+    try:
+        fr = FlightRecorder(
+            d, rank=rank,
+            segment_bytes=int(flags.get_flag("obs_flight_segment_bytes")),
+            max_segments=int(flags.get_flag("obs_flight_segments")))
+    except OSError as e:
+        # an unwritable/full flight dir degrades telemetry — it must
+        # never kill the trainer/server construction it instruments
+        from paddlebox_tpu.obs import log as obs_log
+        obs_log.warning("flight recorder disabled: dir unusable",
+                        dir=d, error=repr(e)[:200])
+        return None
+    install_crash_hooks(fr)
+    _ACTIVE = fr
+    return fr

@@ -1,0 +1,138 @@
+"""Cluster health plane: per-rank health scores derived on rank 0.
+
+The elastic fleet (ROADMAP item 5) needs a TRIGGER — "rank 3 is gone,
+replace it" — and the aggregation path already sees everything needed to
+derive one: report freshness per window (a wedged/killed rank stops
+publishing), watchdog-beat age (reporters gauge it), warning/error log
+line rates (obs/log counts them into the StatRegistry), channel/pull
+queue depths (the chan_*_depth gauges), and the serving tier's
+p99-vs-SLO burn gauge. HealthMonitor folds those into one score per
+rank each aggregation cadence and rank 0 publishes a ``cluster_health``
+record through the same sink/flight machinery as every other report —
+fleet and serving health read off ONE schema.
+
+Scoring (documented contract, pinned by tests): each rank starts at 1.0
+and loses
+  * 0.4  stale this window (no report arrived since the last merge)
+  * all  (score = 0.0) stale ``stale_unhealthy`` consecutive windows —
+         the "declare it dead" threshold the chaos test pins (a killed
+         rank reads unhealthy within 2 cadences)
+  * 0.3  error log lines in the window
+  * 0.1  warning log lines in the window
+  * 0.2  any channel/queue depth gauge above ``depth_warn``
+  * 0.3  serving SLO burn above 1.0 (window p99 past serving_slo_us)
+  * 0.6  beat age above ``beat_age_warn`` — the rank still REPORTS but
+         its step loop stopped beating (wedged exchange/driver thread
+         behind a live reporting path), which freshness cannot see
+``healthy`` = score >= 0.5.
+
+Staleness measures TELEMETRY silence, which is the only signal rank 0
+has — a rank whose publish transport is down (aggregator backoff skips
+a bounded number of publishes) reads stale→unhealthy exactly like a
+dead rank until its re-probe lands, then recovers. The elastic-fleet
+consumer should therefore act on SUSTAINED unhealthy (``stale_windows``
+in the record makes the streak length explicit), not a single flip.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+
+class HealthMonitor:
+    """Rank-0 resident. Thread contract: ``update`` is called from the
+    aggregation path only (the reporter driver thread)."""
+
+    def __init__(self, world: int, stale_unhealthy: int = 2,
+                 depth_warn: float = 64.0,
+                 beat_age_warn: float = 30.0) -> None:
+        self.world = int(world)
+        self.stale_unhealthy = int(stale_unhealthy)
+        self.depth_warn = float(depth_warn)
+        self.beat_age_warn = float(beat_age_warn)
+        self._stale_windows: Dict[int, int] = {r: 0 for r in range(world)}
+        self.last_health: Optional[dict] = None
+
+    # ------------------------------------------------------------- helpers
+    def _per_rank(self, merged: dict, metric: str) -> Dict[int, float]:
+        m = (merged.get("metrics") or {}).get(metric)
+        if not m:
+            return {}
+        return {int(r): float(v)
+                for r, v in (m.get("per_rank") or {}).items()}
+
+    # -------------------------------------------------------------- update
+    def update(self, merged: dict) -> dict:
+        """Fold one merged cluster_report window into per-rank health;
+        returns the cluster_health record (also kept as last_health)."""
+        stale = set(merged.get("stale_ranks") or [])
+        err = self._per_rank(merged, "stats.log_error_lines")
+        rpc_err = self._per_rank(merged, "stats.rpc_handler_errors")
+        warn = self._per_rank(merged, "stats.log_warning_lines")
+        beat_age = self._per_rank(merged, "gauges.beat_age_s")
+        slo_burn = self._per_rank(merged, "gauges.serving_slo_burn")
+        depths = {}
+        for k, m in (merged.get("metrics") or {}).items():
+            if (k.startswith("gauges.") and k.endswith("_depth")):
+                for r, v in (m.get("per_rank") or {}).items():
+                    depths[int(r)] = max(depths.get(int(r), 0.0), float(v))
+
+        ranks = {}
+        unhealthy: List[int] = []
+        for r in range(self.world):
+            if r in stale:
+                self._stale_windows[r] = self._stale_windows.get(r, 0) + 1
+            else:
+                self._stale_windows[r] = 0
+            sw = self._stale_windows[r]
+            score = 1.0
+            flags: List[str] = []
+            if sw >= self.stale_unhealthy:
+                score = 0.0
+                flags.append("stale_%d_windows" % sw)
+            elif sw:
+                score -= 0.4
+                flags.append("stale")
+            n_err = err.get(r, 0.0) + rpc_err.get(r, 0.0)
+            if n_err > 0:
+                score -= 0.3
+                flags.append("error_lines")
+            if warn.get(r, 0.0) > 0:
+                score -= 0.1
+                flags.append("warning_lines")
+            if depths.get(r, 0.0) > self.depth_warn:
+                score -= 0.2
+                flags.append("queue_depth")
+            if slo_burn.get(r, 0.0) > 1.0:
+                score -= 0.3
+                flags.append("slo_burn")
+            if beat_age.get(r, 0.0) > self.beat_age_warn:
+                # reporting-but-not-beating: the wedge freshness can't
+                # see — weighted past the 0.5 healthy bar on its own
+                score -= 0.6
+                flags.append("beat_stalled")
+            score = max(0.0, min(1.0, score))
+            entry = {"score": round(score, 3),
+                     "healthy": score >= 0.5,
+                     "stale_windows": sw}
+            if flags:
+                entry["flags"] = flags
+            if r in beat_age:
+                entry["beat_age_s"] = round(beat_age[r], 3)
+            if n_err:
+                entry["err_lines"] = n_err
+            if r in slo_burn:
+                entry["slo_burn"] = round(slo_burn[r], 4)
+            ranks[str(r)] = entry
+            if not entry["healthy"]:
+                unhealthy.append(r)
+
+        rec = {"type": "cluster_health", "v": SCHEMA_VERSION,
+               "ts": time.time(), "step": int(merged.get("step", 0)),
+               "world": self.world, "ranks": ranks,
+               "unhealthy_ranks": unhealthy}
+        self.last_health = rec
+        return rec

@@ -49,6 +49,28 @@ class _StderrHandler(logging.StreamHandler):
         return sys.stderr
 
 
+class _ObsTapHandler(logging.Handler):
+    """Second handler on the obs logger: counts warning/error lines into
+    the StatRegistry (the cluster health plane reads the per-window
+    deltas as the error-line rate) and forwards them to the active
+    flight recorder so the black box carries the run's complaints."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        if record.levelno < logging.WARNING:
+            return
+        try:
+            from paddlebox_tpu.obs import flight as _flight
+            from paddlebox_tpu.utils.stats import stat_add
+            stat_add("log_error_lines"
+                     if record.levelno >= logging.ERROR
+                     else "log_warning_lines")
+            fr = _flight.active()
+            if fr is not None:
+                fr.on_log(record.levelname, record.getMessage())
+        except Exception:  # noqa: BLE001 — the tap must never break logging
+            pass
+
+
 class _RankFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
         stamp = time.strftime("%H:%M:%S", time.localtime(record.created))
@@ -67,6 +89,7 @@ def get_logger() -> logging.Logger:
             h = _StderrHandler()
             h.setFormatter(_RankFormatter())
             lg.addHandler(h)
+            lg.addHandler(_ObsTapHandler())
             # the parent "paddlebox_tpu" logger keeps its own behavior
             # (warnings via lastResort); don't double-emit through it
             lg.propagate = False

@@ -150,6 +150,14 @@ class StepReporter:
         now = self._clock()
         interval = max(now - self._last_t, 1e-9)
         poll_depth_gauges()  # sample named-channel depths into gauges
+        # watchdog-beat age as a gauge: the cluster health plane reads
+        # it per rank (a rank that reports but stopped beating is wedged
+        # between cadences — freshness alone can't see that)
+        from paddlebox_tpu.obs import watchdog as _wd
+        w = _wd.active()
+        if w is not None:
+            self._registry.set_gauge(
+                "beat_age_s", max(0.0, time.monotonic() - w._beat[0]))
         snap = self._registry.snapshot_all()
 
         stats_delta = {}
@@ -205,6 +213,12 @@ class StepReporter:
         self._last_t = now
         self.last_report = record
         self.sink.emit(record)
+        # durable tier: the flight recorder keeps the report (and the
+        # span window that produced it) on disk across a crash
+        from paddlebox_tpu.obs import flight as _flight
+        fr = _flight.active()
+        if fr is not None:
+            fr.on_report(record)
         if self.aggregator is not None:
             self.aggregator.publish(record)
         return record

@@ -10,18 +10,32 @@ emits valid chrome-tracing JSON loadable in Perfetto WITHOUT jax.profiler
 (works on the CPU-fallback container; when a real jax trace is running,
 utils/profiler.trace installs TraceAnnotation so the same spans also land
 in the XPlane).
+
+Round 14 adds CROSS-PLANE trace ids: a span optionally carries a 64-bit
+trace id (thread-local "current trace" context, set per step by the
+runners and per request by the serving client), the id travels in mesh
+frame headers / serving request dicts, and receiver-side spans record
+the SENDER's id — which is what lets tools/trace_stitch.py merge
+per-rank chrome traces into one cluster timeline with ph:s/f flow
+events across ranks. Exported traces carry a wall-clock origin in their
+metadata so the stitcher can place every rank on one absolute axis.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
+import os
 import threading
 import time
 import weakref
 from typing import Dict, List, Optional, Tuple
 
-# process-relative clock origin: chrome ts fields are µs since this epoch
+# process-relative clock origin: chrome ts fields are µs since this epoch.
+# _EPOCH_UNIX is the SAME instant on the wall clock (taken back-to-back)
+# — the anchor trace_stitch uses to align per-rank traces on one axis.
 _EPOCH = time.perf_counter()
+_EPOCH_UNIX = time.time()
 
 # jax.profiler.TraceAnnotation factory while a real trace is running
 # (installed/removed by utils/profiler.trace) — None = spans are ring-only
@@ -33,6 +47,65 @@ def set_jax_annotation(factory) -> None:
     _JAX_ANNOTATE = factory
 
 
+# ------------------------------------------------------------- trace ids
+# Thread-local "current trace": spans recorded while a trace id is set
+# carry it into the ring (and from there into the chrome export's args),
+# so one request/step can be followed across every span it touches.
+_TRACE_CTX = threading.local()
+# client-side request ids: salted counter — correlated by equality,
+# never decoded. The 15-bit salt mixes the pid with random bytes: a pid
+# alone collides under modern pid_max (4M >> 2^15, two processes equal
+# mod 32768 would mint identical sequences), the random mix makes a
+# cross-process collision 2^-15 per pair instead of systematic.
+_NEXT_REQ = itertools.count(1)
+_REQ_SALT = ((os.getpid() ^ (os.getpid() >> 15)
+              ^ int.from_bytes(os.urandom(2), "little")) & 0x7FFF)
+
+
+def step_trace_id(rank: int, step: int) -> int:
+    """Deterministic 64-bit per-step id: rank in the high 16 bits, step
+    counter below — collision-free across ranks because each sender only
+    ever mints ids in its own rank-space."""
+    return ((int(rank) & 0xFFFF) << 48) | (int(step) & 0xFFFFFFFFFFFF)
+
+
+def next_trace_id() -> int:
+    """Per-request id for planes without a step counter (serving client
+    pulls): process-salted monotonic counter, high bit set so the id
+    space never collides with step_trace_id's rank<<48 layout."""
+    return ((1 << 63) | (_REQ_SALT << 48)
+            | (next(_NEXT_REQ) & 0xFFFFFFFFFFFF))
+
+
+def current_trace() -> Optional[int]:
+    return getattr(_TRACE_CTX, "id", None)
+
+
+def set_trace(trace: Optional[int]) -> Optional[int]:
+    """Set this thread's current trace id; returns the previous one."""
+    prev = getattr(_TRACE_CTX, "id", None)
+    _TRACE_CTX.id = trace
+    return prev
+
+
+class trace_ctx:
+    """``with trace_ctx(tid): ...`` — spans inside carry ``tid``.
+    Restores the previous id on exit (nesting-safe)."""
+
+    __slots__ = ("_id", "_prev")
+
+    def __init__(self, trace: Optional[int]) -> None:
+        self._id = trace
+
+    def __enter__(self):
+        self._prev = set_trace(self._id)
+        return self._id
+
+    def __exit__(self, *exc):
+        set_trace(self._prev)
+        return False
+
+
 class _ThreadRing:
     """One thread's span ring. Only its owner thread writes; readers
     (export, watchdog dump) take a best-effort snapshot — a torn slot
@@ -42,19 +115,21 @@ class _ThreadRing:
     __slots__ = ("buf", "idx", "cap", "tid", "tname", "owner")
 
     def __init__(self, cap: int, tid: int, tname: str, owner) -> None:
-        self.buf: List[Optional[Tuple[str, float, float]]] = [None] * cap
+        self.buf: List[Optional[Tuple[str, float, float,
+                                      Optional[int]]]] = [None] * cap
         self.idx = 0
         self.cap = cap
         self.tid = tid
         self.tname = tname
         self.owner = owner      # weakref to the owning thread
 
-    def record(self, name: str, t0: float, t1: float) -> None:
+    def record(self, name: str, t0: float, t1: float,
+               trace: Optional[int] = None) -> None:
         i = self.idx
-        self.buf[i % self.cap] = (name, t0, t1)
+        self.buf[i % self.cap] = (name, t0, t1, trace)
         self.idx = i + 1
 
-    def spans(self) -> List[Tuple[str, float, float]]:
+    def spans(self) -> List[Tuple[str, float, float, Optional[int]]]:
         """Oldest-first snapshot of the live slots."""
         i, cap = self.idx, self.cap
         if i <= cap:
@@ -99,7 +174,8 @@ class _Span:
 
     def __exit__(self, *exc):
         t1 = time.perf_counter()
-        self._tr._ring().record(self.name, self.t0, t1)
+        self._tr._ring().record(self.name, self.t0, t1,
+                                getattr(_TRACE_CTX, "id", None))
         if self._ann is not None:
             self._ann.__exit__(*exc)
         return False
@@ -119,7 +195,11 @@ class SpanTracer:
         self.capacity = int(capacity)
         self.enabled = True
         self._rings: List[_ThreadRing] = []   # guarded-by: _reg_lock
-        self._reg_lock = threading.Lock()
+        # RLock, not Lock: the flight recorder's fatal-signal seal path
+        # reads last_spans() from the signal handler, which may interrupt
+        # this very thread mid-all_spans() — a plain lock would deadlock
+        # the dying process instead of sealing and re-delivering
+        self._reg_lock = threading.RLock()
         self._local = threading.local()
 
     def _ring(self) -> _ThreadRing:
@@ -148,11 +228,16 @@ class SpanTracer:
             return _NULL
         return _Span(self, name)
 
-    def record_span(self, name: str, t0: float, t1: float) -> None:
+    def record_span(self, name: str, t0: float, t1: float,
+                    trace: Optional[int] = None) -> None:
         """Post-hoc span from perf_counter stamps the caller already
-        took (sites that time a region anyway record it span-free)."""
+        took (sites that time a region anyway record it span-free).
+        An explicit ``trace`` (receiver-side spans tagging the SENDER's
+        id) wins over this thread's current trace context."""
         if self.enabled:
-            self._ring().record(name, t0, t1)
+            if trace is None:
+                trace = getattr(_TRACE_CTX, "id", None)
+            self._ring().record(name, t0, t1, trace)
 
     def clear(self) -> None:
         with self._reg_lock:
@@ -163,18 +248,22 @@ class SpanTracer:
         self._local = threading.local()
 
     # ------------------------------------------------------------- readers
-    def all_spans(self) -> List[Tuple[str, int, str, float, float]]:
-        """(name, tid, thread_name, t0, t1) across every thread, t0-sorted."""
+    def all_spans(self) -> List[Tuple[str, int, str, float, float,
+                                      Optional[int]]]:
+        """(name, tid, thread_name, t0, t1, trace) across every thread,
+        t0-sorted; trace is None for spans recorded outside a trace
+        context."""
         with self._reg_lock:
             rings = list(self._rings)
         out = []
         for r in rings:
-            for name, t0, t1 in r.spans():
-                out.append((name, r.tid, r.tname, t0, t1))
+            for name, t0, t1, trace in r.spans():
+                out.append((name, r.tid, r.tname, t0, t1, trace))
         out.sort(key=lambda s: s[3])
         return out
 
-    def last_spans(self, k: int = 64) -> List[Tuple[str, int, str, float, float]]:
+    def last_spans(self, k: int = 64) -> List[Tuple[str, int, str, float,
+                                                    float, Optional[int]]]:
         return self.all_spans()[-k:]
 
     def export_chrome(self, path: Optional[str] = None, pid: int = 0,
@@ -185,20 +274,29 @@ class SpanTracer:
         writes it to `path` when given."""
         events = []
         seen_tids = set()
-        for name, tid, tname, t0, t1 in self.all_spans():
+        for name, tid, tname, t0, t1, trace in self.all_spans():
             if tid not in seen_tids:
                 seen_tids.add(tid)
                 events.append({"ph": "M", "name": "thread_name", "pid": pid,
                                "tid": tid, "args": {"name": tname}})
-            events.append({
+            ev = {
                 "ph": "X", "cat": "obs", "name": name, "pid": pid,
                 "tid": tid,
                 "ts": round((t0 - _EPOCH) * 1e6, 3),
                 "dur": round((t1 - t0) * 1e6, 3),
-            })
-        doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+            }
+            if trace is not None:
+                # hex STRING, not int: 64-bit ids exceed the 2^53 range
+                # json numbers survive in every consumer
+                ev["args"] = {"trace": "0x%016x" % (trace & (2**64 - 1))}
+            events.append(ev)
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               # wall-clock instant of ts=0 on THIS process — the anchor
+               # tools/trace_stitch.py aligns per-rank traces with
+               "metadata": {"rank": pid,
+                            "clock_origin_unix_s": _EPOCH_UNIX}}
         if meta:
-            doc["metadata"] = dict(meta)
+            doc["metadata"].update(dict(meta))
         if path:
             with open(path, "w", encoding="utf-8") as fh:
                 json.dump(doc, fh)
@@ -221,8 +319,9 @@ def span(name: str):
     return _Span(_TRACER, name)
 
 
-def record_span(name: str, t0: float, t1: float) -> None:
-    _TRACER.record_span(name, t0, t1)
+def record_span(name: str, t0: float, t1: float,
+                trace: Optional[int] = None) -> None:
+    _TRACER.record_span(name, t0, t1, trace)
 
 
 def configure_from_flags() -> None:

@@ -22,6 +22,8 @@ import time
 import traceback
 from typing import Callable, List, Optional
 
+from paddlebox_tpu.obs import flight as _flight
+
 
 class StallWatchdog:
     def __init__(self, threshold_s: float, action: str = "dump",
@@ -91,10 +93,12 @@ class StallWatchdog:
         if self.tracer is not None:
             lines.append("-- last %d spans (most recent last) --"
                          % self.last_k_spans)
-            for name, tid, tname, t0, t1 in self.tracer.last_spans(
+            for name, tid, tname, t0, t1, trace in self.tracer.last_spans(
                     self.last_k_spans):
-                lines.append("  %-28s %10.3fms  [%s/%d]"
-                             % (name, (t1 - t0) * 1e3, tname, tid))
+                lines.append("  %-28s %10.3fms  [%s/%d]%s"
+                             % (name, (t1 - t0) * 1e3, tname, tid,
+                                " trace=0x%x" % trace
+                                if trace is not None else ""))
         lines.append("-- per-thread stacks --")
         names = {t.ident: t.name for t in threading.enumerate()}
         for tid, frame in sys._current_frames().items():
@@ -122,6 +126,10 @@ class StallWatchdog:
             stream.flush()
         except (OSError, ValueError):
             pass
+        # a stall is a failure the process may not survive (the next
+        # event is often a SIGKILL from the scheduler): seal the flight
+        # recorder NOW so the black box carries the dump durably
+        _flight.seal_active("watchdog_stall:%s" % label, extra_text=text)
         if self.on_stall is not None:
             self.on_stall(text)
         if self.action == "raise":
@@ -144,10 +152,15 @@ def set_active(w: Optional[StallWatchdog]) -> Optional[StallWatchdog]:
 
 
 def beat(label: str) -> None:
-    """Progress mark — near-free (one global read) when no watchdog runs."""
+    """Progress mark — near-free (two global reads) when neither the
+    watchdog nor the flight recorder runs; the flight tier samples
+    (>=1s apart), so the per-step cost stays one monotonic read."""
     w = _ACTIVE
     if w is not None:
         w.beat(label)
+    fr = _flight._ACTIVE
+    if fr is not None:
+        fr.on_beat(label)
 
 
 def ensure_from_flags(tracer=None, report_fn=None) -> Optional[StallWatchdog]:

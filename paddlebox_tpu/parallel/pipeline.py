@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddlebox_tpu.obs import beat as obs_beat
 from paddlebox_tpu.obs import make_step_reporter
+from paddlebox_tpu.obs.tracer import step_trace_id, trace_ctx
 from paddlebox_tpu.obs import span as obs_span
 
 STAGE_AXIS = "stage"
@@ -297,7 +298,13 @@ def _grouped_train_pass(runner, dataset, begin_pass, end_pass,
                 if isinstance(item, BaseException):
                     raise item
                 g, staged = item
-                with obs_span("pipe_step"):
+                # trace id off the PERSISTENT step counter (+1: noted
+                # after the step) — a per-pass counter would repeat ids
+                # across passes and stitch unrelated steps into one flow
+                with trace_ctx(step_trace_id(
+                        getattr(runner, "_obs_rank", 0),
+                        getattr(runner, "_step_count", 0) + 1)), \
+                        obs_span("pipe_step"):
                     losses.append(runner.train_step_staged(staged, g))
                 obs_beat("pipeline_step")
                 _pipe_note_step(runner, len(losses))
@@ -331,7 +338,10 @@ def _grouped_train_pass(runner, dataset, begin_pass, end_pass,
                         "fleet store; not returning with a live stager")
     else:
         for g in groups:
-            with obs_span("pipe_step"):
+            with trace_ctx(step_trace_id(
+                    getattr(runner, "_obs_rank", 0),
+                    getattr(runner, "_step_count", 0) + 1)), \
+                    obs_span("pipe_step"):
                 losses.append(runner.train_step(g))
             obs_beat("pipeline_step")
             _pipe_note_step(runner, len(losses))
@@ -1169,6 +1179,7 @@ class ShardedCtrPipelineRunner:
         aggregator = (make_cluster_aggregator(
             mesh=self.host_mesh, fleet=fleet, rank=obs_rank,
             world=obs_world) if self.multiprocess else None)
+        self._obs_rank = obs_rank   # per-step trace ids (round 14)
         self.reporter = make_step_reporter(rank=obs_rank,
                                            aggregator=aggregator)
         self._step, self._eval = self._build_step()

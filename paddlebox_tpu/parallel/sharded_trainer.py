@@ -47,6 +47,7 @@ from paddlebox_tpu.obs import log as obs_log
 from paddlebox_tpu.obs import (make_cluster_aggregator, make_step_reporter,
                                obs_rank_world)
 from paddlebox_tpu.obs import span as obs_span
+from paddlebox_tpu.obs.tracer import step_trace_id, trace_ctx
 from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm
 from paddlebox_tpu.ops.sparse import (build_push_grads,
                                       build_push_grads_extended,
@@ -941,7 +942,12 @@ class ShardedBoxTrainer:
                 losses.extend(chunk_losses)
             for i, batch in enumerate(stream, start=start_i):
                 self.timers["step"].start()
-                with obs_span("shard_step"):
+                # per-step 64-bit trace id (round 14): every span this
+                # step records on this thread carries it, correlating
+                # the step across the stitched cluster timeline
+                with trace_ctx(step_trace_id(self._obs_rank,
+                                             self._step_count + 1)), \
+                        obs_span("shard_step"):
                     (self._slabs, self.params, self.opt_state, loss, preds,
                      self._prng, mtab, mstats) = self._step(
                         self._slabs, self.params, self.opt_state, batch,

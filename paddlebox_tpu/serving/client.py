@@ -12,10 +12,12 @@ misconfigured server can't hand the client a class-bearing payload.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Sequence, Tuple
 
 import numpy as np
 
+from paddlebox_tpu.obs.tracer import next_trace_id, record_span
 from paddlebox_tpu.serving import codec
 from paddlebox_tpu.utils.rpc import FramedClient, plain_loads
 
@@ -71,8 +73,13 @@ class ServingClient:
     def pull(self, keys: np.ndarray) -> np.ndarray:
         """[K] uint64 feasigns → [K, dim] float32 embedding rows.
         Tries every replica once (round-robin start) before giving up;
-        a draining replica or a dead connection fails over."""
-        req = codec.encode_pull(keys)
+        a draining replica or a dead connection fails over. Each pull
+        mints a 64-bit trace id carried in the request frame (round 14)
+        — the client- and server-side spans share it, so a stitched
+        trace shows the request crossing the RPC boundary."""
+        trace = next_trace_id()
+        req = codec.encode_pull(keys, trace=trace)
+        t_pull = time.perf_counter()
         start = self._pick()
         n = len(self.endpoints)
         last_err: Exception = RuntimeError("no endpoints")
@@ -98,6 +105,8 @@ class ServingClient:
                 raise
             with self._lock:
                 self.last_gen = int(resp.get("gen", -1))
+            record_span("serving_pull_client", t_pull,
+                        time.perf_counter(), trace=trace)
             return codec.decode_rows(resp)
         raise ConnectionError(
             f"all {n} serving replicas failed") from last_err

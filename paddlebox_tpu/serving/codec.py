@@ -13,15 +13,29 @@ class-bearing payload is refused with the stream intact.
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import numpy as np
 
 
-def encode_pull(keys: np.ndarray) -> Dict[str, Any]:
-    """[K] uint64 feasigns → pull request frame."""
+def encode_pull(keys: np.ndarray,
+                trace: Optional[int] = None) -> Dict[str, Any]:
+    """[K] uint64 feasigns → pull request frame. ``trace`` (round 14)
+    is the optional 64-bit request trace id — a plain int in the plain-
+    container wire, recorded on the server-side span so one pull can be
+    followed client → replica in a stitched cluster trace."""
     keys = np.ascontiguousarray(np.asarray(keys, np.uint64).reshape(-1))
-    return {"method": "pull", "keys": keys.tobytes(), "n": int(keys.size)}
+    req = {"method": "pull", "keys": keys.tobytes(), "n": int(keys.size)}
+    if trace is not None:
+        req["trace"] = int(trace)
+    return req
+
+
+def decode_trace(req: Dict[str, Any]):
+    """The request's trace id, or None — NEVER raises: a missing or
+    garbage trace id must not fail a pull (telemetry is best-effort)."""
+    t = req.get("trace")
+    return int(t) if isinstance(t, int) else None
 
 
 def decode_pull_keys(req: Dict[str, Any]) -> np.ndarray:

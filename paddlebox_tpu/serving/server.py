@@ -30,11 +30,14 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional, Sequence
 
 from paddlebox_tpu.obs import log, make_step_reporter
+from paddlebox_tpu.obs.tracer import record_span
 from paddlebox_tpu.serving import codec
 from paddlebox_tpu.serving.refresh import (DeltaRefreshWatcher, ViewManager,
                                            make_manager)
 from paddlebox_tpu.utils.rpc import FramedServer, plain_loads
-from paddlebox_tpu.utils.stats import hist_observe, stat_add, stat_get
+from paddlebox_tpu.utils.stats import (StatRegistry, gauge_set,
+                                       hist_observe, hist_percentile,
+                                       stat_add, stat_get)
 
 #: largest accepted request frame (keys bytes + envelope). 128 MB ≈ a
 #: 16M-key pull — far past any sane serving batch; bigger frames are a
@@ -84,8 +87,15 @@ class ServingServer:
         self._requests = 0  # guarded-by: _report_lock
         self._prev_hit = 0  # guarded-by: _report_lock
         self._prev_miss = 0  # guarded-by: _report_lock
+        self._prev_lat = None  # guarded-by: _report_lock
+        self._slo_us = float(flags.get_flag("serving_slo_us"))
         self._report_lock = threading.Lock()
+        # rank = the replica index ServingFleet exports as PBTPU_RANK
+        # (log.get_rank reads it; 0 standalone) — reports AND the flight
+        # recorder's per-rank files attribute to THIS replica instead of
+        # every replica writing rank-0 artifacts over each other
         self.reporter = make_step_reporter(
+            rank=log.get_rank(),
             every=report_every if report_every is not None
             else int(flags.get_flag("serving_report_requests")))
         self._server = FramedServer(self._handle, loads=plain_loads,
@@ -133,8 +143,14 @@ class ServingServer:
             # latency the histogram publishes (what the client feels)
             rows, gen = self._pool.submit(
                 self.manager.lookup, keys).result()
-            dt_us = (time.perf_counter() - t0) * 1e6
+            t1 = time.perf_counter()
+            dt_us = (t1 - t0) * 1e6
             hist_observe("serving_lookup_us", dt_us)
+            # span tagged with the CLIENT's trace id (round 14): the
+            # stitched cluster trace shows this pull crossing the RPC
+            # boundary from the caller's serving_pull_client span
+            record_span("serving_pull", t0, t1,
+                        trace=codec.decode_trace(req))
             stat_add("serving_requests")
             stat_add("serving_keys", int(keys.size))
             self._note_report(int(keys.size))
@@ -158,6 +174,22 @@ class ServingServer:
                 d_hit = hit - self._prev_hit
                 d_tot = d_hit + (miss - self._prev_miss)
                 self._prev_hit, self._prev_miss = hit, miss
+                # SLO burn gauge (round 14): window p99 of the lookup
+                # histogram over serving_slo_us — gauged BEFORE the
+                # report assembles so this window's record (and the
+                # cluster health plane merging it) carries it
+                if self._slo_us > 0:
+                    counts = StatRegistry.instance().hist_counts(
+                        "serving_lookup_us")
+                    if counts:
+                        prev = self._prev_lat
+                        delta = ([c - p for c, p in zip(counts, prev)]
+                                 if prev else counts)
+                        self._prev_lat = list(counts)
+                        if sum(delta) > 0:
+                            gauge_set("serving_slo_burn", round(
+                                hist_percentile(delta, 0.99)
+                                / self._slo_us, 4))
                 self.reporter.maybe_report(self._requests, extra={
                     "role": "serving",
                     "gen": self.manager.current()[0],

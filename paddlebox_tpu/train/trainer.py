@@ -47,6 +47,7 @@ from paddlebox_tpu.obs import beat as obs_beat
 from paddlebox_tpu.obs import log as obs_log
 from paddlebox_tpu.obs import make_step_reporter
 from paddlebox_tpu.obs import span as obs_span
+from paddlebox_tpu.obs.tracer import set_trace, step_trace_id
 from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm, seqpool_sum
 from paddlebox_tpu.ops.sparse import (build_push_grads,
                                       build_push_grads_extended,
@@ -1292,39 +1293,48 @@ class BoxTrainer:
             self.table.set_slab(state)
             losses.extend(chunk_losses)
             pending = pending[n_done:]
-        for b in pending:
-            with obs_span("host_stage"):
-                ids = self.table.lookup_ids(b.keys, b.valid)
-                batch = self.device_batch(b, ids)
-            self.timers["step"].start()
-            if self.async_table is not None:
-                # pull a fresh dense snapshot, run the device step, queue the
-                # grads for the host optimizer thread (PullDense/PushDense
-                # around the op loop, boxps_worker.cc:1278-1296)
-                self.params = self._unravel(jnp.asarray(
-                    self.async_table.pull()))
-                slab, flat_g, loss, preds, prng = self.fns.step(
-                    self.table.slab, self.params, batch, prng)
-                self.async_table.push(np.asarray(flat_g))
-                self.table.set_slab(slab)
-            else:
-                (state, self.params, self.opt_state, loss, preds,
-                 prng) = self.fns.step(
-                    self.table.slab, self.params, self.opt_state, batch,
-                    prng)
-                self.table.set_slab(state)
-            self.timers["step"].pause()
-            self._step_count += 1
-            obs_beat("step")
-            self.reporter.note_examples(self.fns.batch_size)
-            self.reporter.maybe_report(self._step_count)
-            losses.append(float(loss))
-            if self.cfg.check_nan_inf and not np.isfinite(losses[-1]):
-                raise FloatingPointError(
-                    f"nan/inf loss at step {self._step_count}")
-            self._add_metrics(preds, b)
-            if self.dump_writer is not None:
-                self._dump_batch(preds, b)
+        try:
+            for b in pending:
+                # per-step 64-bit trace id (round 14): host_stage and the
+                # dispatch spans of one step share it in the exported trace
+                set_trace(step_trace_id(0, self._step_count + 1))
+                with obs_span("host_stage"):
+                    ids = self.table.lookup_ids(b.keys, b.valid)
+                    batch = self.device_batch(b, ids)
+                self.timers["step"].start()
+                if self.async_table is not None:
+                    # pull a fresh dense snapshot, run the device step, queue the
+                    # grads for the host optimizer thread (PullDense/PushDense
+                    # around the op loop, boxps_worker.cc:1278-1296)
+                    self.params = self._unravel(jnp.asarray(
+                        self.async_table.pull()))
+                    slab, flat_g, loss, preds, prng = self.fns.step(
+                        self.table.slab, self.params, batch, prng)
+                    self.async_table.push(np.asarray(flat_g))
+                    self.table.set_slab(slab)
+                else:
+                    (state, self.params, self.opt_state, loss, preds,
+                     prng) = self.fns.step(
+                        self.table.slab, self.params, self.opt_state, batch,
+                        prng)
+                    self.table.set_slab(state)
+                self.timers["step"].pause()
+                self._step_count += 1
+                obs_beat("step")
+                self.reporter.note_examples(self.fns.batch_size)
+                self.reporter.maybe_report(self._step_count)
+                losses.append(float(loss))
+                if self.cfg.check_nan_inf and not np.isfinite(losses[-1]):
+                    raise FloatingPointError(
+                        f"nan/inf loss at step {self._step_count}")
+                self._add_metrics(preds, b)
+                if self.dump_writer is not None:
+                    self._dump_batch(preds, b)
+        finally:
+            # exception-safe: a step that raises must not leak its
+            # trace id onto pass-boundary/eval spans (the sharded
+            # runners use trace_ctx for the same guarantee)
+            set_trace(None)
         self.table.end_pass()
         if self.async_table is not None:
             # pass boundary is a sync point: drain the host optimizer and

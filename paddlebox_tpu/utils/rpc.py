@@ -124,6 +124,12 @@ class FramedServer:
                         self._loads(body))}
                 except Exception as e:  # surfaced to the client
                     resp = {"ok": False, "error": repr(e)}
+                    # per-window handler-error rate for the cluster
+                    # health plane (obs/health.py) — the error still
+                    # rides to the client; this just makes the RATE
+                    # visible in every StepReport's stat deltas
+                    from paddlebox_tpu.utils.stats import stat_add
+                    stat_add("rpc_handler_errors")
                 payload = pickle.dumps(resp,
                                        protocol=pickle.HIGHEST_PROTOCOL)
                 conn.sendall(_LEN.pack(len(payload)) + payload)

@@ -7,7 +7,9 @@ jax.config.update("jax_platforms", "axon,cpu") at interpreter start, which
 overrides the JAX_PLATFORMS env var — so we must update the config again
 here, before any backend is initialized."""
 
+import json
 import os
+import time
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -44,3 +46,55 @@ def pytest_configure(config):
         "markers",
         "slow: excluded from the budgeted tier-1 run (-m 'not slow'); "
         "runs in the slow-inclusive suite and on TPU windows")
+    config._pbtpu_t0 = time.monotonic()
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 budget visibility (round 14): the suite runs against a hard
+# 870s timeout with no per-test attribution — this hook writes one
+# durations JSONL per run (who pays), prints the 15 slowest, and WARNS
+# (never fails) when the run lands past 90% of the budget.
+
+_DURATIONS = {}
+
+
+def pytest_runtest_logreport(report):
+    if report.when in ("setup", "call", "teardown"):
+        _DURATIONS[report.nodeid] = (
+            _DURATIONS.get(report.nodeid, 0.0) + report.duration)
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    if not _DURATIONS:
+        return
+    path = os.environ.get("PBTPU_TEST_DURATIONS",
+                          "/tmp/pbtpu_test_durations.jsonl")
+    wall = time.monotonic() - getattr(config, "_pbtpu_t0",
+                                      time.monotonic())
+    ranked = sorted(_DURATIONS.items(), key=lambda kv: -kv[1])
+    try:
+        with open(path, "w", encoding="utf-8") as fh:
+            for nodeid, dur in ranked:
+                fh.write(json.dumps({"nodeid": nodeid,
+                                     "duration_s": round(dur, 3)}) + "\n")
+            fh.write(json.dumps({"summary": True, "tests": len(ranked),
+                                 "sum_s": round(sum(_DURATIONS.values()),
+                                                1),
+                                 "wall_s": round(wall, 1)}) + "\n")
+    except OSError:
+        path = "<unwritable>"
+    tw = terminalreporter
+    tw.write_line("")
+    tw.write_line("slowest 15 tests (durations jsonl: %s)" % path)
+    for nodeid, dur in ranked[:15]:
+        tw.write_line("  %8.2fs  %s" % (dur, nodeid))
+    budget = float(os.environ.get("PBTPU_TIER1_BUDGET_SECS", "870"))
+    # wall is the honest projection (it includes collection + import);
+    # the per-test sum attributes it
+    if budget > 0 and wall > 0.9 * budget:
+        tw.write_line(
+            "WARNING: suite wall %.0fs exceeds 90%% of the %.0fs tier-1 "
+            "budget (sum of test durations %.0fs) — new suites must "
+            "earn their seconds or go slow" % (
+                wall, budget, sum(_DURATIONS.values())),
+            yellow=True)

@@ -489,6 +489,61 @@ def test_cli_fix_baseline_roundtrip(tmp_path):
     assert run_cli(["--no-baseline", str(bad)]).returncode == 1
 
 
+# ----------------------------------------------------------- spans (BX502)
+
+SPAN_BAD_FIXTURE = """
+    from paddlebox_tpu.obs import span as obs_span
+
+
+    class Runner:
+        def step(self, tracer):
+            tracer.span("shard_step")     # bare expression: records NOTHING
+            obs_span("host_stage")        # bare module-helper form
+
+
+    def run(tracer, obs):
+        obs.span("pull")                  # bare attribute form
+"""
+
+SPAN_GOOD_FIXTURE = """
+    from paddlebox_tpu.obs import span as obs_span
+    from paddlebox_tpu.obs.tracer import record_span
+
+
+    def run(tracer, consume):
+        with tracer.span("shard_step"):
+            pass
+        with obs_span("host_stage"):
+            pass
+        s = tracer.span("later")          # stored, entered below
+        with s:
+            pass
+        record_span("post_hoc", 0.0, 1.0)  # post-hoc form, exempt
+        consume(tracer.span("arg"))        # passed on, not discarded
+"""
+
+
+def test_span_bare_expression_flags(tmp_path):
+    """The BX502 positive fixture: every bare-expression span() call —
+    method, module-helper, attribute — flags once."""
+    got = lint_snippet(tmp_path, SPAN_BAD_FIXTURE, ["spans"])
+    assert codes(got) == ["BX502"] * 3
+
+
+def test_span_proper_uses_clean(tmp_path):
+    """Negative fixture: with-statements, stored managers, record_span
+    and argument positions never flag."""
+    assert lint_snippet(tmp_path, SPAN_GOOD_FIXTURE, ["spans"]) == []
+
+
+def test_span_suppression(tmp_path):
+    got = lint_snippet(tmp_path, """
+        def run(tracer):
+            tracer.span("x")  # boxlint: disable=BX502
+    """, ["spans"])
+    assert got == []
+
+
 # ------------------------------------------------------------ the gate
 
 def test_boxlint_gate_no_new_violations():

@@ -66,7 +66,11 @@ def test_pull_dense_worker_refreshes():
     pw.stop()
 
 
+@pytest.mark.slow
 def test_downpour_local_client_learns(data):
+    """Slow tier (round 14, budget): an 8-pass convergence leg + eval
+    drive; tier-1 keeps test_downpour_over_tcp (3-pass loss-decreases
+    over the real transport) and the push/pull mechanics tests."""
     files, feed = data
     tr = DownpourTrainer(CtrDnn(ModelSpec(num_slots=4, slot_dim=3 + D),
                                 hidden=(16,)),

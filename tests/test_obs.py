@@ -152,7 +152,7 @@ def test_tracer_multithread_spans():
         t.join()
     spans = tr.all_spans()
     assert len(spans) == 9
-    assert len({tid for _, tid, _, _, _ in spans}) == 3
+    assert len({s[1] for s in spans}) == 3
     doc = tr.export_chrome()
     assert len([e for e in doc["traceEvents"] if e["ph"] == "M"]) == 3
 

@@ -405,9 +405,15 @@ def assert_identical(a, b):
         assert np.array_equal(np.asarray(xa), np.asarray(xb))
 
 
+@pytest.mark.slow
 def test_blocked_e2e_matches_scatter_full_wire(data):
     """push_write=blocked on the FULL host wire (sorted dedup staging) at
-    chunk>1 over 2 passes: bit-identical training to scatter."""
+    chunk>1 over 2 passes: bit-identical training to scatter.
+
+    Slow tier (round 14, budget): a 2-pass composition of contracts
+    tier-1 keeps pinned individually — unit blocked-vs-scatter parity,
+    the uid-wire e2e below (the default wire), and the dedup sort=True
+    staging contract in test_wire_modes."""
     files, feed = data
     base = run_mode(files, feed, "scatter")
     blocked = run_mode(files, feed, "blocked")
@@ -423,11 +429,16 @@ def test_blocked_e2e_matches_scatter_uid_wire(data):
     assert_identical(base, blocked)
 
 
+@pytest.mark.slow
 def test_blocked_bf16_matches_scatter_bf16(data):
     """The two tentpole layers compose: under the bf16 slab diet the
     write placement is still bit-identical between scatter and blocked
     (same encoded rows, different placement) — so the diet's AUC gate
-    transfers to the blocked path for free."""
+    transfers to the blocked path for free.
+
+    Slow tier (round 14, budget): pure composition — the codec's bit
+    round-trip, bf16 AUC parity, and blocked-vs-scatter parity each
+    stay pinned in tier-1 on their own."""
     files, feed = data
     base = run_mode(files, feed, "scatter", embed_dtype="bfloat16",
                     passes=1)

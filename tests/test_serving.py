@@ -357,6 +357,35 @@ def test_server_pull_parity_and_obs(tiny_server):
     assert st["requests"] >= 6 and st["gen"] == 0
 
 
+def test_serving_slo_burn_gauge_and_rpc_trace(tiny_server):
+    """Round 14: (a) every report window carries gauge serving_slo_burn
+    = window p99 / serving_slo_us (the health plane's SLO signal); (b)
+    one pull's trace id lands on BOTH the client-side and server-side
+    spans — the correlation trace_stitch draws across the RPC boundary
+    (client and replica share this process's tracer here, so the pair
+    is directly observable)."""
+    from paddlebox_tpu.obs.tracer import get_tracer
+    root, keys, server, client = tiny_server
+    get_tracer().clear()
+    rng = np.random.RandomState(17)
+    probe = probe_keys(rng, keys)
+    for _ in range(5):                 # cross the cadence (4 requests)
+        client.pull(probe)
+    rep = server.reporter.peek()
+    assert rep is not None
+    burn = rep["gauges"].get("serving_slo_burn")
+    assert burn is not None and burn > 0
+    slo = float(flags.get_flag("serving_slo_us"))
+    assert burn == pytest.approx(
+        rep["hists"]["serving_lookup_us"]["p99"] / slo, rel=0.05)
+    spans = get_tracer().all_spans()
+    client_t = {s[5] for s in spans if s[0] == "serving_pull_client"}
+    server_t = {s[5] for s in spans if s[0] == "serving_pull"}
+    shared = (client_t & server_t) - {None}
+    assert shared, (client_t, server_t)
+    assert all(t >> 63 for t in shared)    # request-id space, 64-bit
+
+
 def test_serving_codec_rejects_class_payloads(tiny_server):
     """A pickled numpy array (class resolution) on the serving port is
     refused by the transport, the stream stays in sync, and a plain

@@ -36,7 +36,8 @@ def build_parser() -> argparse.ArgumentParser:
             "AST-level invariant checker for this repo: jit purity / "
             "static shapes (BX1xx), collective axis contracts (BX2xx), "
             "flag registry hygiene (BX3xx), guarded-by lock discipline "
-            "(BX4xx), library print hygiene (BX5xx). Suppress a single "
+            "(BX4xx), library print hygiene (BX501), span "
+            "context-manager discipline (BX502). Suppress a single "
             "site with '# boxlint: "
             "disable=BX101' on the line (or the def line for a whole "
             "method); long-lived exceptions belong in the baseline."),

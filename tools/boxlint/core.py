@@ -192,13 +192,15 @@ def format_baseline(violations: Sequence[Violation]) -> str:
 
 def run_passes(files: Sequence[SourceFile],
                passes: Optional[Iterable[str]] = None) -> List[Violation]:
-    from tools.boxlint import collectives, flagscheck, locks, prints, purity
+    from tools.boxlint import (collectives, flagscheck, locks, prints,
+                               purity, spans)
     registry = {
         "purity": purity.check,
         "collectives": collectives.check,
         "flags": flagscheck.check,
         "locks": locks.check,
         "prints": prints.check,
+        "spans": spans.check,
     }
     names = list(passes) if passes else list(registry)
     out: List[Violation] = []
@@ -208,7 +210,8 @@ def run_passes(files: Sequence[SourceFile],
     return sorted(out, key=lambda v: (v.path, v.line, v.code))
 
 
-ALL_PASSES = ("purity", "collectives", "flags", "locks", "prints")
+ALL_PASSES = ("purity", "collectives", "flags", "locks", "prints",
+              "spans")
 
 
 def _is_suppressed(files: Sequence[SourceFile], v: Violation) -> bool:

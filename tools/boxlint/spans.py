@@ -1,0 +1,54 @@
+"""Pass 6 — span context-manager discipline (BX502).
+
+``tracer.span("name")`` / ``obs.span("name")`` / ``obs_span("name")``
+return a context manager; only ``__exit__`` records the span. Used as a
+bare expression statement the call allocates the manager, times
+nothing, records NOTHING, and raises nothing — the instrumentation
+silently vanishes, which is the worst failure mode an observability
+plane can have (round-14 satellite; the BX501 sibling keeps print()
+out, this keeps span() honest).
+
+Flagged: an ``ast.Expr`` statement whose value is a call to a name or
+attribute literally called ``span`` or ``obs_span``. Legitimate uses —
+``with ... :``, storing the manager for a later ``with``, passing it as
+an argument — are not expression statements and never flag.
+``record_span(...)`` (the post-hoc form) is a different name and is
+exempt by construction.
+
+Codes:
+  BX502  tracer.span(...) as a bare expression — records nothing; use
+         ``with`` (or record_span for post-hoc stamps)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence
+
+from tools.boxlint.core import SourceFile, Violation
+
+_SPAN_NAMES = {"span", "obs_span"}
+
+
+def _is_span_call(call: ast.Call) -> bool:
+    func = call.func
+    if isinstance(func, ast.Name):
+        return func.id in _SPAN_NAMES
+    if isinstance(func, ast.Attribute):
+        return func.attr in _SPAN_NAMES
+    return False
+
+
+def check(files: Sequence[SourceFile]) -> List[Violation]:
+    out: List[Violation] = []
+    for f in files:
+        for node in ast.walk(f.tree):
+            if (isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)
+                    and _is_span_call(node.value)):
+                out.append(Violation(
+                    f.rel, node.lineno, "BX502",
+                    "span(...) used as a bare expression records "
+                    "NOTHING — enter it ('with tracer.span(...):') or "
+                    "use record_span for post-hoc stamps"))
+    return out

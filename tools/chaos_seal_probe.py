@@ -1,0 +1,269 @@
+"""Chaos leg: kill a rank mid-pass in a REAL 2-process cluster and
+verify the postmortem plane end to end.
+
+The round-14 acceptance scenario: a localhost fleet of `--world`
+processes runs a pass-shaped loop — per-step p2p mesh exchanges under
+per-step trace ids, StepReports at cadence 1 with rank-0 cluster
+aggregation + health, watchdog beats, an ACTIVE flight recorder per
+rank. The parent SIGABRTs (or SIGKILLs) rank 1 mid-loop, then asserts:
+
+  * SIGABRT leg: the dead rank left a parseable ``SEALED_r1.json``
+    manifest (reason signal:SIGABRT, thread stacks, spans, reports)
+    AND its flight segments parse.
+  * SIGKILL leg: no seal is possible (the kernel gives no notice) —
+    the per-record-flushed flight segments ARE the artifact: they must
+    parse line-by-line and carry the header + beats/reports.
+  * both legs: rank 0's cluster health plane flags the dead rank
+    unhealthy within 2 report cadences of the first post-death merge
+    (measured, reported as windows_to_unhealthy).
+  * stitch leg: the per-rank chrome traces exported before the kill
+    stitch into one timeline with >=1 CROSS-RANK flow event (the mesh
+    frame trace ids at work).
+
+Usage:  timeout 300 python -u tools/chaos_seal_probe.py [--world 2]
+            [--signals ABRT,KILL] [--steps-before-kill 5]
+Prints one JSON line per leg plus {"all_ok": ...}; exits 1 on failure.
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+NUM_DEVICES = 4
+
+
+def _positions(rank: int, world: int):
+    return [int(p) for p in
+            np.array_split(np.arange(NUM_DEVICES), world)[rank]]
+
+
+def worker() -> None:
+    """One rank of the chaos cluster (pure host plane — no jax)."""
+    from paddlebox_tpu.config import flags
+    from paddlebox_tpu.fleet.fleet import Fleet
+    from paddlebox_tpu.fleet.role_maker import RoleMaker
+    import paddlebox_tpu.obs as obs
+    from paddlebox_tpu.obs.tracer import step_trace_id, trace_ctx
+
+    run_dir = os.environ["CHAOS_DIR"]
+    flags.set_flag("obs_flight_dir", run_dir)
+    flags.set_flag("obs_report_every", 1)
+    fl = Fleet().init(RoleMaker())
+    rank, world = fl.worker_index(), fl.worker_num()
+    mesh = fl.make_mesh_comm(_positions(rank, world))
+    assert mesh is not None, "p2p mesh bring-up failed in chaos worker"
+    # a dead peer must surface as a bounded TimeoutError in the
+    # survivor's exchange, not a 300s default stall (probe-local knob)
+    mesh._op_timeout = 15.0
+    aggregator = obs.make_cluster_aggregator(mesh=mesh, rank=rank,
+                                             world=world)
+    reporter = obs.make_step_reporter(rank=rank, aggregator=aggregator)
+    assert obs.flight.active() is not None, "flight recorder not active"
+    trace_path = os.path.join(run_dir, "trace_r%d.json" % rank)
+    rng = np.random.RandomState(100 + rank)
+
+    death_step = 0
+    windows_to_unhealthy = -1
+    for step in range(1, 200):
+        try:
+            with trace_ctx(step_trace_id(rank, step)):
+                mesh.exchange({r: rng.randint(0, 1 << 20, 256)
+                               .astype(np.int32) for r in range(world)})
+        except (ConnectionError, TimeoutError):
+            death_step = step
+            break
+        reporter.note_examples(256)
+        reporter.maybe_report(step)
+        # the chrome trace export before the kill is what the stitch
+        # leg consumes — atomic rename so a kill mid-write can never
+        # leave a truncated json behind
+        obs.export_chrome_trace(path=trace_path + ".tmp", rank=rank)
+        os.replace(trace_path + ".tmp", trace_path)
+        print("STEP %d" % step, flush=True)
+        time.sleep(0.05)
+
+    if rank != 0:
+        fl.stop()
+        return
+    # rank 0 outlives the peer: flush the window that may still hold
+    # the peer's queued last report, then count merges until the health
+    # plane flags it — the "within 2 cadences" acceptance measurement
+    step = death_step
+    reporter.maybe_report(step, force=True)
+    for w in range(1, 11):
+        step += 1
+        time.sleep(0.05)
+        reporter.maybe_report(step, force=True)
+        health = aggregator.last_cluster_health
+        if health and 1 in health["unhealthy_ranks"]:
+            windows_to_unhealthy = w
+            break
+    obs.export_chrome_trace(path=trace_path, rank=0)
+    print("RESULT " + json.dumps({
+        "rank": rank, "death_step": death_step,
+        "windows_to_unhealthy": windows_to_unhealthy,
+        "health": aggregator.last_cluster_health}), flush=True)
+    reporter.close()
+    fl.stop()
+
+
+def _parse_jsonl(path: str):
+    recs = []
+    with open(path, encoding="utf-8") as fh:
+        for ln in fh:
+            recs.append(json.loads(ln))     # raises on corruption
+    return recs
+
+
+def run_leg(world: int, sig_name: str, steps_before_kill: int,
+            run_dir: str, timeout: float = 120.0) -> dict:
+    import uuid
+
+    from paddlebox_tpu.fleet.store import KVStoreServer
+    from tools.trace_stitch import stitch
+
+    os.makedirs(run_dir, exist_ok=True)
+    server = KVStoreServer(host="127.0.0.1")
+    procs = []
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    run_id = uuid.uuid4().hex[:8]   # ONE namespace for the whole leg
+    try:
+        for rank in range(world):
+            env = dict(os.environ)
+            env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH",
+                                                            "")
+            env.update({
+                "PBTPU_TRAINER_ID": str(rank),
+                "PBTPU_TRAINERS_NUM": str(world),
+                "PBTPU_STORE_ENDPOINT": "127.0.0.1:%d" % server.port,
+                "PBTPU_RUN_ID": run_id,
+                "CHAOS_WORKER": "1",
+                "CHAOS_DIR": run_dir,
+                "JAX_PLATFORMS": "cpu",
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, "-u", os.path.abspath(__file__)],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True))
+        victim = procs[1]
+        # wait until the victim is mid-pass, then kill it
+        deadline = time.monotonic() + timeout
+        reached = False
+        for line in victim.stdout:
+            if line.startswith("STEP"):
+                if int(line.split()[1]) >= steps_before_kill:
+                    reached = True
+                    break
+            if time.monotonic() > deadline:
+                break
+        if not reached:
+            raise TimeoutError(
+                "victim never reached kill step; stderr tail: "
+                + (victim.stderr.read() or "")[-1500:])
+        signum = getattr(signal, "SIG" + sig_name)
+        victim.send_signal(signum)
+        victim.wait(timeout=30)
+        rank0_out, rank0_err = procs[0].communicate(timeout=timeout)
+        if procs[0].returncode != 0:
+            raise RuntimeError("rank 0 failed:\n" + rank0_err[-3000:])
+        result = None
+        for line in rank0_out.splitlines():
+            if line.startswith("RESULT "):
+                result = json.loads(line[len("RESULT "):])
+        if result is None:
+            raise RuntimeError("rank 0 printed no RESULT:\n"
+                               + rank0_out[-2000:])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+
+    leg = {"signal": sig_name, "world": world,
+           "victim_rc": victim.returncode,
+           "death_step": result["death_step"],
+           "windows_to_unhealthy": result["windows_to_unhealthy"]}
+
+    # --- artifact assertions -------------------------------------------
+    sealed_path = os.path.join(run_dir, "SEALED_r1.json")
+    if sig_name == "ABRT":
+        with open(sealed_path, encoding="utf-8") as fh:
+            manifest = json.load(fh)
+        assert manifest["reason"] == "signal:SIGABRT", manifest["reason"]
+        assert manifest["threads"], "no thread stacks in manifest"
+        assert manifest["header"]["flags"], "no flags in manifest header"
+        leg["sealed"] = {"reason": manifest["reason"],
+                         "n_threads": len(manifest["threads"]),
+                         "n_spans": len(manifest["spans"]),
+                         "n_reports": len(manifest["last_reports"])}
+    else:
+        assert not os.path.exists(sealed_path), \
+            "SIGKILL cannot seal — a manifest means the leg is fake"
+    segs = sorted(p for p in os.listdir(run_dir)
+                  if p.startswith("flight_r1_"))
+    assert segs, "dead rank left no flight segments"
+    recs = []
+    for s in segs:
+        recs.extend(_parse_jsonl(os.path.join(run_dir, s)))
+    types = {r["type"] for r in recs}
+    assert "header" in types, types
+    assert {"beat", "report"} & types, types
+    leg["flight_records_r1"] = len(recs)
+    leg["flight_record_types"] = sorted(types)
+
+    # --- health assertion ----------------------------------------------
+    assert 0 < result["windows_to_unhealthy"] <= 2, \
+        "health flagged dead rank in %r windows (bound 2)" % (
+            result["windows_to_unhealthy"],)
+
+    # --- stitch leg -----------------------------------------------------
+    docs = []
+    for r in range(world):
+        p = os.path.join(run_dir, "trace_r%d.json" % r)
+        with open(p, encoding="utf-8") as fh:
+            docs.append(json.load(fh))
+    stitched, summary = stitch(docs)
+    json.dumps(stitched)            # loadable end to end
+    assert summary["cross_rank_flows"] >= 1, summary
+    leg["stitch"] = summary
+    return leg
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--world", type=int, default=2)
+    ap.add_argument("--signals", default="ABRT,KILL")
+    ap.add_argument("--steps-before-kill", type=int, default=5)
+    ap.add_argument("--dir", default="")
+    args = ap.parse_args()
+    import tempfile
+    base = args.dir or tempfile.mkdtemp(prefix="pbtpu_chaos_")
+    ok = True
+    for sig_name in [s.strip().upper() for s in args.signals.split(",")]:
+        run_dir = os.path.join(base, "leg_%s" % sig_name)
+        try:
+            leg = run_leg(args.world, sig_name, args.steps_before_kill,
+                          run_dir)
+            leg["probe"] = "chaos_seal"
+            print(json.dumps(leg), flush=True)
+        except Exception as e:  # noqa: BLE001 — keep the ladder going
+            ok = False
+            print(json.dumps({"probe": "chaos_seal", "signal": sig_name,
+                              "error": repr(e)[:500]}), flush=True)
+    print(json.dumps({"all_ok": ok, "dir": base}), flush=True)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    if os.environ.get("CHAOS_WORKER"):
+        worker()
+    else:
+        main()

@@ -1,0 +1,144 @@
+"""Stitch per-rank chrome traces into ONE Perfetto-loadable cluster
+timeline with cross-rank flow events.
+
+Each rank's ``obs.export_chrome_trace`` document is self-relative: ts=0
+is that process's import instant. The export metadata carries the
+wall-clock anchor (``clock_origin_unix_s``) and the rank, so stitching
+is: shift every rank's events onto the earliest rank's axis, set
+pid=rank (named via process_name metadata), and draw chrome flow events
+(``ph: s/t/f``) through every span set that shares a trace id — the
+64-bit ids the runners mint per step, the mesh carries in its frame
+headers, and the serving codec carries in its request dicts. A mesh
+exchange then renders as an arrow from the sender's ``mesh_exchange``
+slice to the owner rank's ``mesh_recv_part`` slice; a serving pull as
+client span -> replica span.
+
+Clock caveat: the anchors come from ``time.time()`` per process — exact
+enough on one box (the 2-4 process clusters this repro runs); across
+machines the stitch inherits NTP skew, which offsets slices but keeps
+the flow arrows (they bind by id, not by time).
+
+Usage:
+    python tools/trace_stitch.py trace_r0.json trace_r1.json ... \
+        [-o cluster_trace.json]
+
+Prints one JSON summary line: ranks, events, flows, cross_rank_flows.
+Exits 1 when the inputs produce no cross-rank flow at all (a stitched
+timeline without a single correlation usually means trace ids are not
+flowing — the failure this tool exists to catch).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+
+def _trace_of(ev: dict) -> Optional[str]:
+    args = ev.get("args")
+    if isinstance(args, dict):
+        t = args.get("trace")
+        if isinstance(t, str) and t:
+            return t
+    return None
+
+
+def stitch(docs: List[dict]) -> Tuple[dict, dict]:
+    """Merge chrome-trace documents into one; returns (stitched_doc,
+    summary). Rank comes from each doc's metadata (fallback: input
+    order); events shift onto the earliest clock origin."""
+    anchors = []
+    for i, doc in enumerate(docs):
+        meta = doc.get("metadata") or {}
+        rank = int(meta.get("rank", i))
+        origin = meta.get("clock_origin_unix_s")
+        anchors.append((rank, float(origin) if origin is not None
+                        else None, doc))
+    # docs without an anchor (pre-round-14 exports) stay UNSHIFTED on
+    # the base axis — treating a missing anchor as unix 0 would shift
+    # every anchored rank by decades of microseconds
+    present = [o for _, o, _ in anchors if o is not None]
+    base = min(present) if present else 0.0
+    unanchored = sorted(r for r, o, _ in anchors if o is None)
+
+    events: List[dict] = []
+    # trace id -> [(ts_mid, pid, tid)] across every rank
+    by_trace: Dict[str, List[Tuple[float, int, int]]] = {}
+    for rank, origin, doc in anchors:
+        shift_us = ((origin - base) * 1e6 if origin is not None else 0.0)
+        events.append({"ph": "M", "name": "process_name", "pid": rank,
+                       "args": {"name": "rank %d" % rank}})
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = rank
+            if "ts" in ev:
+                ev["ts"] = round(float(ev["ts"]) + shift_us, 3)
+            events.append(ev)
+            if ev.get("ph") != "X":
+                continue
+            trace = _trace_of(ev)
+            if trace is None:
+                continue
+            # bind point INSIDE the slice (perfetto attaches a flow
+            # event to the slice containing its ts on that track)
+            mid = float(ev["ts"]) + max(0.0, float(ev.get("dur", 0)) / 2)
+            by_trace.setdefault(trace, []).append(
+                (mid, rank, int(ev.get("tid", 0))))
+
+    flows = cross = 0
+    for trace, sites in sorted(by_trace.items()):
+        if len(sites) < 2:
+            continue
+        sites.sort()
+        pids = {pid for _, pid, _ in sites}
+        is_cross = len(pids) > 1
+        for i, (ts, pid, tid) in enumerate(sites):
+            ph = ("s" if i == 0
+                  else "f" if i == len(sites) - 1 else "t")
+            fev = {"ph": ph, "cat": "trace", "name": "trace",
+                   "id": trace, "pid": pid, "tid": tid,
+                   "ts": round(ts, 3)}
+            if ph == "f":
+                fev["bp"] = "e"     # bind to enclosing slice
+            events.append(fev)
+            flows += 1
+        if is_cross:
+            cross += 1
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "metadata": {"stitched_ranks": sorted(r for r, _, _ in anchors),
+                        "clock_origin_unix_s": base}}
+    summary = {"ranks": len(anchors), "events": len(events),
+               "flow_events": flows, "cross_rank_flows": cross}
+    if unanchored:
+        summary["unanchored_ranks"] = unanchored
+    return doc, summary
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-rank chrome traces into one "
+                    "Perfetto-loadable cluster timeline with "
+                    "cross-rank flow events")
+    ap.add_argument("traces", nargs="+", metavar="TRACE_JSON",
+                    help="per-rank chrome-trace files "
+                         "(obs.export_chrome_trace output)")
+    ap.add_argument("-o", "--out", default="cluster_trace.json",
+                    help="stitched output path (default: "
+                         "cluster_trace.json)")
+    args = ap.parse_args(argv)
+    docs = []
+    for p in args.traces:
+        with open(p, encoding="utf-8") as fh:
+            docs.append(json.load(fh))
+    doc, summary = stitch(docs)
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    summary["out"] = args.out
+    print(json.dumps(summary))
+    return 0 if summary["cross_rank_flows"] > 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
