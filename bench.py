@@ -569,6 +569,136 @@ def measure(platform: str) -> None:
     except Exception as e:  # noqa: BLE001 — diagnostic tier, not the metric
         ladder = {"error": repr(e)[:300]}
 
+    def checkpoint_ladder(R: int = 1 << 20) -> dict:
+        """Round-15 checkpoint-plane ladder at R rows (adagrad embedx=8,
+        width 17 → ~68 MB of row bytes), three layers so each claim is
+        attributable (median-of-3 wall each, keys/s):
+
+          * blob tier — the format alone: pickle.dump/load (as shipped:
+            NO fsync — DONE could land with the blob still in page
+            cache) vs a durability-fair fsync'd pickle vs the columnar
+            writer pool at 1 and ckpt_parts stripes, and both loads.
+          * store tier — the end-to-end resume path (read + store
+            install) per format via PassTable.save/load.
+          * snapshot stall — full save_base vs a touched-mode save at a
+            ~10%-dirty journal epoch (the day-boundary acceptance bar).
+
+        Pure host tier — no jax arrays, identical on every platform;
+        ckpt_io_parallelism records cpu_count (a 1-core container can
+        only overlap I/O WAITS, not memcpys — read BASELINE round 15
+        before comparing boxes)."""
+        import pickle as _pickle
+        import shutil
+        import tempfile
+
+        from paddlebox_tpu.config.configs import (CheckpointConfig,
+                                                  SparseOptimizerConfig,
+                                                  TableConfig)
+        from paddlebox_tpu.embedding import ckpt_store as cks
+        from paddlebox_tpu.embedding.pass_table import PassTable
+        from paddlebox_tpu.train.checkpoint import CheckpointManager
+
+        tcfg = TableConfig(embedx_dim=8, pass_capacity=1 << 10,
+                           optimizer=SparseOptimizerConfig())
+        t = PassTable(tcfg, seed=1)
+        rng = np.random.RandomState(5)
+        keys = rng.permutation(np.arange(1, R + 1, dtype=np.uint64))
+        vals = rng.rand(R, t.layout.width).astype(np.float32)
+        vals[:, 1] = rng.randint(1, 40, R)  # SHOW
+        t.store.assign(keys, vals)
+        meta = {"embedx_dim": tcfg.embedx_dim,
+                "optimizer": t.layout.optimizer}
+        root = tempfile.mkdtemp(prefix="pbtpu_ckpt_bench_")
+
+        def timed(fn, runs=3):
+            walls = []
+            for _ in range(runs):
+                t0 = time.perf_counter()
+                fn()
+                walls.append(time.perf_counter() - t0)
+            return float(np.median(walls))
+
+        def rate(w):
+            return round(R / w, 0)
+
+        try:
+            out = {"rows": R, "width": t.layout.width,
+                   "ckpt_io_parallelism": os.cpu_count() or 1,
+                   "ckpt_parts": int(_flags.get_flag("ckpt_parts"))}
+            pkl = os.path.join(root, "blob.pkl")
+            xman = os.path.join(root, "blob.xman")
+
+            def pkl_dump(fsync):
+                with open(pkl, "wb") as f:
+                    _pickle.dump({"keys": keys, "values": vals, **meta},
+                                 f, protocol=_pickle.HIGHEST_PROTOCOL)
+                    if fsync:
+                        f.flush()
+                        os.fsync(f.fileno())
+
+            blob = {}
+            blob["pickle_dump"] = rate(timed(lambda: pkl_dump(False)))
+            blob["pickle_dump_fsync"] = rate(timed(lambda: pkl_dump(True)))
+            blob["columnar_write_1part"] = rate(timed(
+                lambda: cks.write_sparse_columnar(xman, keys, vals, meta,
+                                                  parts=1)))
+            blob["columnar_write_pool"] = rate(timed(
+                lambda: cks.write_sparse_columnar(xman, keys, vals, meta)))
+            blob["pickle_load"] = rate(timed(
+                lambda: _pickle.load(open(pkl, "rb"))))
+            blob["columnar_load_pool"] = rate(timed(
+                lambda: cks.load_sparse_columnar(xman)))
+            out["blob_keys_per_sec"] = blob
+
+            store = {}
+            for fmt, name in (("pickle", "st.pkl"), ("columnar",
+                                                     "st.xman")):
+                _flags.set_flag("ckpt_format", fmt)
+                p = os.path.join(root, name)
+                store[fmt] = {
+                    "save_keys_per_sec": rate(timed(lambda: t.save(p))),
+                    "load_keys_per_sec": rate(timed(lambda: t.load(p)))}
+            _flags.set_flag("ckpt_format", "columnar")
+            out["store"] = store
+            out["speedup_save_durable"] = round(
+                blob["columnar_write_pool"] / blob["pickle_dump_fsync"], 2)
+            out["speedup_write_pool_vs_1part"] = round(
+                blob["columnar_write_pool"]
+                / blob["columnar_write_1part"], 2)
+
+            # day-boundary stall: full snapshot (sparse + xbox + stat)
+            # vs touched-only at ~10% of rows dirty in the journal epoch
+            cm = CheckpointManager(CheckpointConfig(
+                batch_model_dir=os.path.join(root, "batch"),
+                xbox_model_dir=os.path.join(root, "xbox"),
+                async_save=False), t)
+            cm.save_base({}, {}, day="anchor")  # full anchor for touched
+            frac = max(1, R // 10)
+            stalls_t, stalls_f = [], []
+            for i in range(3):
+                cm.journal.append_rows(keys[:frac], vals[:frac])
+                t0 = time.perf_counter()
+                cm.save_base({}, {}, day=f"t{i}", mode="touched")
+                stalls_t.append(time.perf_counter() - t0)
+            for i in range(3):
+                t0 = time.perf_counter()
+                cm.save_base({}, {}, day=f"f{i}", mode="full")
+                stalls_f.append(time.perf_counter() - t0)
+            st, sf = float(np.median(stalls_t)), float(np.median(stalls_f))
+            out["touched_save"] = {
+                "dirty_rows": frac, "stall_s": round(st, 4),
+                "full_stall_s": round(sf, 4),
+                "stall_ratio_full_over_touched": round(sf / st, 1)}
+            return out
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    # round-15: checkpoint-plane ladder. GUARDED like every diagnostic.
+    try:
+        ckpt = checkpoint_ladder()
+    except Exception as e:  # noqa: BLE001 — diagnostic tier, not the metric
+        ckpt = {"error": repr(e)[:300]}
+
     eps = CHUNK * BATCH / dt
     print(json.dumps({
         "schema_version": SCHEMA_VERSION,
@@ -593,6 +723,13 @@ def measure(platform: str) -> None:
         "pass_amortized": pass_amortized,
         "pass_amortized_examples_per_sec": pa_eps,
         "push_ladder": ladder,
+        "checkpoint": ckpt,
+        "ckpt_save_keys_per_sec": (ckpt.get("store", {})
+                                   .get("columnar", {})
+                                   .get("save_keys_per_sec", 0)),
+        "ckpt_load_keys_per_sec": (ckpt.get("store", {})
+                                   .get("columnar", {})
+                                   .get("load_keys_per_sec", 0)),
         "telemetry_overhead": telemetry,
         "flight_overhead": flight,
         "compile_warmup_s": round(t_compile, 1),
@@ -706,6 +843,9 @@ def main() -> None:
         "pass_amortized_examples_per_sec": result.get(
             "pass_amortized_examples_per_sec", 0.0),
         "push_ladder": result.get("push_ladder"),
+        "checkpoint": result.get("checkpoint"),
+        "ckpt_save_keys_per_sec": result.get("ckpt_save_keys_per_sec", 0),
+        "ckpt_load_keys_per_sec": result.get("ckpt_load_keys_per_sec", 0),
         "telemetry_overhead": result.get("telemetry_overhead"),
         "flight_overhead": result.get("flight_overhead"),
         "hostplane": hostplane,

@@ -76,7 +76,6 @@ def role_demo(args) -> None:
     from paddlebox_tpu.train import BoxTrainer, CheckpointManager
     from paddlebox_tpu.train.checkpoint import XboxModelReader, run_day
 
-    import pickle
     import tempfile
 
     work = tempfile.mkdtemp(prefix="pbx_serve_")
@@ -119,8 +118,8 @@ def role_demo(args) -> None:
     # composition the moment their DONE markers land
     server = ServingServer(xbox_root)
     client = ServingClient([("127.0.0.1", server.port)])
-    with open(os.path.join(xbox_dir, "embedding.pkl"), "rb") as f:
-        keys = np.asarray(pickle.load(f)["keys"][:64], np.uint64)
+    from paddlebox_tpu.serving.store import read_xbox_view
+    keys = np.asarray(read_xbox_view(xbox_dir)[0][:64], np.uint64)
     t0 = time.perf_counter()
     emb = client.pull(keys)
     dt = time.perf_counter() - t0

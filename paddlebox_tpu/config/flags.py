@@ -400,6 +400,56 @@ define_flag("serving_slo_us", 15000.0,
             "degraded. Default 15ms sits above the recorded quiet-"
             "container p99 ceiling (BASELINE round 12: 4.6-7.1ms at "
             "b4096 incl first-touch page-in). <=0 disables the gauge")
+define_flag("ckpt_format", "columnar",
+            "sparse batch-model checkpoint format (round 15): 'columnar' "
+            "= sparse.xman manifest + N striped binary part files "
+            "written by a parallel writer pool (atomic tmp+fsync+rename "
+            "per part; the manifest lands only after every part is "
+            "durable) and loaded via mmap + a reader pool "
+            "(embedding/ckpt_store.py); 'pickle' = the legacy single "
+            "sparse.pkl blob. Loaders sniff the format, so either kind "
+            "of checkpoint loads regardless of this flag")
+define_flag("ckpt_parts", 8,
+            "part files per columnar sparse checkpoint (contiguous row "
+            "stripes; trimmed so no part is empty). More parts = more "
+            "writer/reader parallelism and smaller atomic units; the "
+            "manifest pins the exact part list, so stray parts from an "
+            "interrupted larger-parts save are ignored")
+define_flag("ckpt_io_threads", 0,
+            "checkpoint writer/reader pool threads; 0 = one per part "
+            "capped at the box's cores (and at 16). The pool writes/"
+            "reads disjoint row stripes — np.tofile/memmap copies "
+            "release the GIL, so the threads genuinely overlap")
+define_flag("ckpt_journal", True,
+            "persistent touched-row journal (train/journal.py): every "
+            "end-of-pass write-back appends its touched (keys, rows) "
+            "delta and the day-cadence lifecycle mutations append "
+            "deterministic event records, into segment-rotated binary "
+            "files under <batch_model_dir>/_journal/rank<r>. Enables "
+            "save_base(mode='touched'/'auto') — day-boundary snapshot "
+            "cost proportional to the delta — and the elastic mid-day "
+            "rejoin artifact (replay-over-base, ROADMAP item 5). Spill "
+            "activity taints the epoch (touched saves fall back to "
+            "full, loudly): SSD-tier rows sit outside the journaled "
+            "cadence")
+define_flag("ckpt_journal_segment_bytes", 64 << 20,
+            "touched-row journal segment rotation size in bytes; each "
+            "segment re-writes a self-describing header (flight-"
+            "recorder discipline), records are flushed per append so a "
+            "SIGKILL leaves a parseable prefix")
+define_flag("ckpt_journal_segments", 32,
+            "max live journal segments per rank; exceeding the bound "
+            "drops the OLDEST segment and marks the epoch incomplete "
+            "(touched saves then fall back to full, which re-anchors "
+            "and resets) — bounded disk beats unbounded promises")
+define_flag("ckpt_xbox_columnar", True,
+            "emit xbox serving views (SaveBase/SaveDelta output) "
+            "DIRECTLY as the serving columnar file (view.xcol, sorted "
+            "keys) instead of embedding.pkl: serving's compile_view_dir "
+            "becomes a detect-and-skip no-op on these dirs and "
+            "delta-refresh staleness drops by the pickle->columnar "
+            "re-encode. Off = the legacy pkl views (readers handle "
+            "both, mixed histories compose)")
 define_flag("preload_promote", True,
             "overlap the NEXT pass's host-side promote work (key diff + "
             "host-store reads for non-resident keys) with the current "

@@ -15,14 +15,14 @@ faulted back on lookup (LoadSSD2Mem analog: load_spilled()).
 from __future__ import annotations
 
 import os
-import pickle
 import threading
 from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
 from paddlebox_tpu.config.configs import TableConfig
-from paddlebox_tpu.embedding.accessor import (ValueLayout, CLICK, SHOW,
+from paddlebox_tpu.embedding.accessor import (ValueLayout, CLICK,
+                                              DELTA_SCORE, SHOW,
                                               UNSEEN_DAYS)
 from paddlebox_tpu.utils.stats import stat_add
 
@@ -196,25 +196,27 @@ class HostEmbeddingStore:
         are about to be overwritten anyway."""
         keys = np.asarray(keys, dtype=np.uint64)
         with self._lock:
-            rows = np.empty(keys.size, dtype=np.int64)
-            missing: List[int] = []
-            for i, k in enumerate(keys.tolist()):
-                r = self._index.get(k, -1)
-                if r < 0:
-                    # a stale spill entry must not resurrect over the
-                    # assigned value (and its block row is dead: GC it)
-                    stale = self._spilled.pop(k, None)
-                    if stale is not None:
-                        self._age_book.drop(k)
-                        self._dec_file_live(stale[0], 1)
-                    missing.append(i)
-                rows[i] = r
-            if missing:
-                self._grow(len(missing))
-                for i in missing:
-                    r = self._free.pop()
-                    self._index[int(keys[i])] = r
-                    rows[i] = r
+            idx = self._index
+            rows = np.fromiter((idx.get(k, -1) for k in keys.tolist()),
+                               dtype=np.int64, count=keys.size)
+            missing = np.nonzero(rows < 0)[0]
+            if missing.size:
+                if self._spilled:
+                    for i in missing.tolist():
+                        # a stale spill entry must not resurrect over the
+                        # assigned value (its block row is dead: GC it)
+                        stale = self._spilled.pop(int(keys[i]), None)
+                        if stale is not None:
+                            self._age_book.drop(int(keys[i]))
+                            self._dec_file_live(stale[0], 1)
+                self._grow(missing.size)
+                # exact free-list pop order, batched: pop() yields the
+                # tail back-to-front
+                new_rows = np.asarray(self._free[-missing.size:][::-1],
+                                      np.int64)
+                del self._free[-missing.size:]
+                rows[missing] = new_rows
+                idx.update(zip(keys[missing].tolist(), new_rows.tolist()))
             self._values[rows] = values
 
     def lookup(self, keys: np.ndarray) -> np.ndarray:
@@ -421,9 +423,39 @@ class HostEmbeddingStore:
                               self.table.show_click_decay_rate)
             return skeys, svals
 
+    def spilled_count(self) -> int:
+        """Rows currently on the SSD tier — the journal's taint probe
+        (spilled rows sit outside the journaled mutation cadence)."""
+        with self._lock:
+            return len(self._spilled)
+
+    def update_stat_after_save(self, table: TableConfig, param: int
+                               ) -> None:
+        """In-place UpdateStatAfterSave over the RESIDENT rows — the
+        checkpoint stat rewrite without a full state_items round trip
+        (param 1 gathers four columns, param 3 touches one). Bit-equal
+        to layout.update_stat_after_save on a snapshot + write_back."""
+        with self._lock:
+            if not self._index:
+                return
+            rows = np.fromiter(self._index.values(), dtype=np.int64,
+                               count=len(self._index))
+            if param == 3:
+                self._values[rows, UNSEEN_DAYS] += 1.0
+            elif param == 1:
+                v = self._values
+                score = self.layout.show_click_score(
+                    v[rows, SHOW], v[rows, CLICK], table.optimizer)
+                covered = ((score >= table.base_threshold)
+                           & (v[rows, DELTA_SCORE] >= table.delta_threshold)
+                           & (v[rows, UNSEEN_DAYS] <= table.delta_keep_days))
+                v[rows[covered], DELTA_SCORE] = 0.0
+
     def save(self, path: str) -> None:
         """Checkpoint resident AND spilled rows (same invariant as the
-        native store: a spilled feature survives a save/load cycle)."""
+        native store: a spilled feature survives a save/load cycle).
+        Format rides the ckpt_format flag: columnar manifest + striped
+        parts from the writer pool (default), or the legacy pickle."""
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         # the whole snapshot (resident + spilled + age book) happens under
         # ONE lock hold: a concurrent fault-in popping a spill entry (and
@@ -435,20 +467,24 @@ class HostEmbeddingStore:
             if skeys.size:
                 keys = np.concatenate([keys, skeys])
                 values = np.vstack([values, svals])
-        with open(path, "wb") as f:
-            pickle.dump({"keys": keys, "values": values,
-                         "embedx_dim": self.layout.embedx_dim,
-                         "optimizer": self.layout.optimizer}, f,
-                        protocol=pickle.HIGHEST_PROTOCOL)
+        from paddlebox_tpu.embedding.ckpt_store import save_sparse_auto
+        save_sparse_auto(path, keys, values,
+                         {"embedx_dim": self.layout.embedx_dim,
+                          "optimizer": self.layout.optimizer})
 
     def load(self, path: str) -> None:
-        with open(path, "rb") as f:
-            self.load_blob(pickle.load(f))
+        """Restore from either checkpoint format (sniffed): a columnar
+        manifest loads its parts through the reader pool; a legacy
+        ``sparse.pkl`` keeps loading forever."""
+        from paddlebox_tpu.embedding.ckpt_store import load_sparse_any
+        self.load_blob(load_sparse_any(path))
 
     def load_blob(self, blob: Dict) -> None:
         """Restore from an in-memory checkpoint dict (the post-pickle half
         of load — ShardedStoreView splits one blob across shards without
-        re-serializing)."""
+        re-serializing). Vectorized install: one values memcpy + one
+        dict build (the per-key loop was the old load bottleneck),
+        row placement identical to the historical pop() order."""
         if blob["embedx_dim"] != self.layout.embedx_dim or \
                 blob["optimizer"] != self.layout.optimizer:
             raise ValueError("checkpoint layout mismatch")
@@ -465,8 +501,15 @@ class HostEmbeddingStore:
             self._free = list(range(self._values.shape[0] - 1, -1, -1))
             self._values[:] = 0.0
             keys, values = blob["keys"], blob["values"]
-            self._grow(keys.size)
-            for i, k in enumerate(keys.tolist()):
-                r = self._free.pop()
-                self._index[k] = r
-                self._values[r] = values[i]
+            n = int(np.asarray(keys).size)
+            self._grow(n)
+            # everything was just reset, so place rows 0..n-1 and REBUILD
+            # the free list from the (possibly grown) capacity — deleting
+            # a tail of the grown list instead left rows 0..old_cap-1
+            # both in use and free once the blob exceeded capacity
+            # (grow appends NEW high rows at the pop() end), and the
+            # next created key silently clobbered a restored feature
+            self._values[:n] = values
+            self._free = list(range(self._values.shape[0] - 1, n - 1, -1))
+            self._index = dict(zip(np.asarray(keys, np.uint64).tolist(),
+                                   range(n)))

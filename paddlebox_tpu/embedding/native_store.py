@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import pickle
 from typing import Tuple
 
 import numpy as np
@@ -310,24 +309,58 @@ class NativeHostEmbeddingStore:
                             count=len(self._spilled))
         return skeys, self._read_spilled(skeys, consume=False)
 
+    def spilled_count(self) -> int:
+        """Rows currently on the SSD tier (the journal's taint probe)."""
+        return len(self._spilled)
+
+    def update_stat_after_save(self, table: TableConfig, param: int
+                               ) -> None:
+        """In-place UpdateStatAfterSave over the RESIDENT rows: param 3
+        rides the native single-column add (no table round trip); param
+        1 gathers once and writes back only the covered rows. Bit-equal
+        to layout.update_stat_after_save on a snapshot + write_back."""
+        if param == 3:
+            if int(self._lib.hs_add_col(self._h, UNSEEN_DAYS, 1.0)) < 0:
+                raise RuntimeError(
+                    f"hs_add_col(col={UNSEEN_DAYS}) rejected by native "
+                    "store — layout mismatch")
+            return
+        if param != 1:
+            return
+        from paddlebox_tpu.embedding.accessor import (CLICK, DELTA_SCORE,
+                                                      SHOW)
+        keys, values = self.state_items()
+        if not keys.size:
+            return
+        score = self.layout.show_click_score(
+            values[:, SHOW], values[:, CLICK], table.optimizer)
+        covered = ((score >= table.base_threshold)
+                   & (values[:, DELTA_SCORE] >= table.delta_threshold)
+                   & (values[:, UNSEEN_DAYS] <= table.delta_keep_days))
+        if covered.any():
+            rows = values[covered]
+            rows[:, DELTA_SCORE] = 0.0
+            self.write_back(keys[covered], rows)
+
     def save(self, path: str) -> None:
         """Checkpoint resident AND spilled rows (a spilled feature must
-        survive a save/load cycle)."""
+        survive a save/load cycle). Format rides the ckpt_format flag
+        (columnar manifest + striped parts by default; legacy pickle)."""
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         keys, values = self.state_items()
         skeys, svals = self.spilled_snapshot()
         if skeys.size:
             keys = np.concatenate([keys, skeys])
             values = np.vstack([values, svals])
-        with open(path, "wb") as f:
-            pickle.dump({"keys": keys, "values": values,
-                         "embedx_dim": self.layout.embedx_dim,
-                         "optimizer": self.layout.optimizer}, f,
-                        protocol=pickle.HIGHEST_PROTOCOL)
+        from paddlebox_tpu.embedding.ckpt_store import save_sparse_auto
+        save_sparse_auto(path, keys, values,
+                         {"embedx_dim": self.layout.embedx_dim,
+                          "optimizer": self.layout.optimizer})
 
     def load(self, path: str) -> None:
-        with open(path, "rb") as f:
-            self.load_blob(pickle.load(f))
+        """Restore from either checkpoint format (sniffed)."""
+        from paddlebox_tpu.embedding.ckpt_store import load_sparse_any
+        self.load_blob(load_sparse_any(path))
 
     def load_blob(self, blob: dict) -> None:
         """Restore from an in-memory checkpoint dict (see

@@ -315,7 +315,10 @@ class PassTable:
         # relocate on spill/resize) — every touch while a PromotePrefetcher
         # can be live holds store_lock; lock-free boundary sites carry an
         # explicit boxlint disable with their single-threaded rationale
-        self.store = store or make_host_store(self.layout, table, seed)  # guarded-by: store_lock
+        # `is None`, not truthiness: an explicitly-passed EMPTY store is
+        # falsy through __len__ and used to be silently replaced
+        self.store = (store if store is not None
+                      else make_host_store(self.layout, table, seed))  # guarded-by: store_lock
         self.capacity = table.pass_capacity
         self._feed_keys: list = []
         self._pass_keys: Optional[np.ndarray] = None  # sorted unique
@@ -342,6 +345,25 @@ class PassTable:
         self.store_lock = threading.Lock()
         self.timers = {name: Timer() for name in
                        ("feed", "build", "pull", "push", "end")}
+        # touched-row journal (round 15): when attached, end_pass appends
+        # the rows it writes back and the lifecycle mutations append
+        # deterministic event records (train/journal.py)
+        self._journal = None
+
+    # --------------------------------------------------------------- journal
+    def attach_journal(self, journal) -> None:
+        """Attach a train.journal.TouchedRowJournal: end_pass write-backs
+        append their touched (keys, rows) delta; end_day/shrink append
+        event records; spill and external loads taint the epoch."""
+        self._journal = journal
+
+    def _journal_rows(self, keys: np.ndarray, rows: np.ndarray) -> None:
+        if self._journal is not None:
+            self._journal.append_rows(keys, rows)
+
+    def _journal_event(self, code: int) -> None:
+        if self._journal is not None:
+            self._journal.append_event(code)
 
     # ------------------------------------------------------- pass lifecycle
     def begin_feed_pass(self) -> None:
@@ -483,6 +505,12 @@ class PassTable:
             hit = old_pos >= 0
             miss_idx = np.nonzero(~hit)[0].astype(np.int32)
             new_rows = self._promote_missing_rows(self._pass_keys[~hit])
+            # journal the promote delta: lookup_or_create CREATES missing
+            # features here (init rows the touched write-back may never
+            # revisit) — replay must see them; re-recording store-present
+            # non-resident rows is an idempotent upsert of equal bits
+            if not self._test_mode:
+                self._journal_rows(self._pass_keys[~hit], new_rows)
             src = np.zeros(self.capacity, np.int32)
             keep = np.zeros(self.capacity, bool)
             if n:
@@ -515,6 +543,9 @@ class PassTable:
                 host_rows = (self.store.lookup(self._pass_keys)
                              if self._test_mode
                              else self.store.lookup_or_create(self._pass_keys))
+            # full build: every pass key may have been created just now
+            if not self._test_mode:
+                self._journal_rows(self._pass_keys, host_rows)
             # zero only the tail beyond n: a full-capacity zeros() here was
             # pure memcpy waste — every [0, n) row is overwritten next
             slab = np.empty((self.capacity, self.layout.device_width),
@@ -575,6 +606,7 @@ class PassTable:
                         rows = decode_slab_rows_np(
                             np.asarray(self._slab[jnp.asarray(idx)]),
                             self.layout)
+                        self._journal_rows(self._pass_keys[idx], rows)
                         with self.store_lock:
                             self.store.write_back(self._pass_keys[idx], rows)
                     stat_add("pass_rows_written_back", int(idx.size))
@@ -582,6 +614,7 @@ class PassTable:
                 else:
                     host = decode_slab_rows_np(np.asarray(self._slab[:n]),
                                                self.layout)
+                    self._journal_rows(self._pass_keys, host)
                     with self.store_lock:
                         self.store.write_back(self._pass_keys, host)
             if self._incremental() and not self._residency_poisoned:
@@ -670,6 +703,8 @@ class PassTable:
             # (internal, so DIRECT callers are covered too — matching the
             # sharded table)
             self.invalidate_residency()
+            if self._journal is not None:
+                self._journal.taint(f"{n} rows spilled to the SSD tier")
         return n
 
     def set_test_mode(self, test: bool) -> None:
@@ -788,7 +823,10 @@ class PassTable:
         Mutates every resident store row (decay) — drops pass residency."""
         self.invalidate_residency()
         with self.store_lock:
-            return self.store.shrink()
+            n = self.store.shrink()
+        from paddlebox_tpu.train.journal import EV_SHRINK
+        self._journal_event(EV_SHRINK)
+        return n
 
     def end_day(self, age: bool = True) -> int:
         """Day boundary (the python-driven day cadence around
@@ -807,6 +845,9 @@ class PassTable:
                 self.store.age_unseen_days()
             else:
                 self.store.tick_spill_age()
+        if age:
+            from paddlebox_tpu.train.journal import EV_AGE_DAYS
+            self._journal_event(EV_AGE_DAYS)
         return self.shrink_table()
 
     # checkpoint boundary: the driver serializes save/load against passes,
@@ -816,6 +857,8 @@ class PassTable:
 
     def load(self, path: str) -> None:  # boxlint: disable=BX401
         self.invalidate_residency()
+        if self._journal is not None:
+            self._journal.taint("store loaded outside the checkpoint plane")
         self.store.load(path)
 
     def load_ssd_to_mem(self) -> int:

@@ -489,6 +489,26 @@ class ShardedPassTable:
         self._touch_seen = False  # any mark this pass? (else full writeback)
         self._staged_sh: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self.store_lock = threading.Lock()
+        # touched-row journal (round 15): when attached, every end-of-pass
+        # write-back also appends its (keys, rows) delta, and the
+        # out-of-cadence lifecycle mutations append event records
+        self._journal = None
+
+    # ------------------------------------------------------------- journal
+    def attach_journal(self, journal) -> None:
+        """Attach a train.journal.TouchedRowJournal: end-of-pass write-
+        backs append their touched (keys, rows) delta; end_day/shrink
+        append their deterministic event records; spill and external
+        loads taint the epoch (see journal.py for the replay contract)."""
+        self._journal = journal
+
+    def _journal_rows(self, keys: np.ndarray, rows: np.ndarray) -> None:
+        if self._journal is not None:
+            self._journal.append_rows(keys, rows)
+
+    def _journal_event(self, code: int) -> None:
+        if self._journal is not None:
+            self._journal.append_event(code)
 
     def _drop_route_index(self) -> None:
         from paddlebox_tpu.native.build import destroy_route_index
@@ -610,6 +630,12 @@ class ShardedPassTable:
                            else store.lookup_or_create(miss[need]))
                 rows[need] = got
             slab[:n][~hit] = rows
+            # journal the promote delta: lookup_or_create CREATES missing
+            # features (init rows the touched write-back may never
+            # revisit) — replay must see them; re-recording store-present
+            # non-resident rows is an idempotent upsert of equal bits
+            if not self._test_mode:
+                self._journal_rows(miss, rows)
             stat_add("pass_rows_promote_hit", int(hit.sum()))
             stat_add("pass_rows_promote_new", int(miss.size))
         elif n:
@@ -620,6 +646,9 @@ class ShardedPassTable:
                 rows = (store.lookup(ks) if self._test_mode
                         else store.lookup_or_create(ks))
             slab[:n] = rows
+            # full build: every shard key may have been created just now
+            if not self._test_mode:
+                self._journal_rows(ks, rows)
         slab[n:] = 0.0
         if self._incremental() and not self._test_mode and store is not None:
             # the cache tracks what the store holds for this pass's rows;
@@ -725,6 +754,10 @@ class ShardedPassTable:
         the f32 residency mirror never see encoded bits."""
         slab_host = decode_slab_rows_np(slab_host, self.layout)
         idx = self._touched_idx(s, ks.size)
+        if idx is None:
+            # slab_host[:n] is a view — append_rows copies only when a
+            # journal is actually attached
+            self._journal_rows(ks, slab_host[:ks.size])
         with self.store_lock:
             if idx is None:
                 self.stores[s].write_back(ks, slab_host[:ks.size])
@@ -739,6 +772,10 @@ class ShardedPassTable:
             else:
                 if idx.size:
                     rows = np.ascontiguousarray(slab_host[idx])
+                    # ONE gather serves both (journal-less runs pay no
+                    # extra copy; the journal's own lock is leaf-level,
+                    # no path back into store_lock)
+                    self._journal_rows(ks[idx], rows)
                     self.stores[s].write_back(ks[idx], rows)
                     cache = self._res_rows.get(s)
                     if cache is not None:
@@ -774,6 +811,7 @@ class ShardedPassTable:
             rows = decode_slab_rows_np(
                 np.asarray(jnp.asarray(dev)[0][jnp.asarray(idx)]),
                 self.layout)
+            self._journal_rows(ks[idx], rows)
             with self.store_lock:
                 self.stores[s].write_back(ks[idx], rows)
             cache = self._res_rows.get(s)
@@ -1033,12 +1071,17 @@ class ShardedPassTable:
                                                     False) else per_shard)
         if total:
             self.invalidate_residency()
+            if self._journal is not None:
+                self._journal.taint(f"{total} rows spilled to the SSD tier")
         return total
 
     def shrink_table(self) -> int:
         self.invalidate_residency()  # decay rewrites every store row
         with self.store_lock:
-            return sum(st.shrink() for st in self.stores if st is not None)
+            n = sum(st.shrink() for st in self.stores if st is not None)
+        from paddlebox_tpu.train.journal import EV_SHRINK
+        self._journal_event(EV_SHRINK)
+        return n
 
     def end_day(self, age: bool = True) -> int:
         """Day boundary over the owned shards: age unseen_days, then
@@ -1053,6 +1096,9 @@ class ShardedPassTable:
                     st.age_unseen_days()
                 else:
                     st.tick_spill_age()
+        if age:
+            from paddlebox_tpu.train.journal import EV_AGE_DAYS
+            self._journal_event(EV_AGE_DAYS)
         return self.shrink_table()
 
     # checkpoint boundary: the driver serializes save/load against
@@ -1064,6 +1110,9 @@ class ShardedPassTable:
 
     def load(self, path_prefix: str) -> None:  # boxlint: disable=BX401
         self.invalidate_residency()
+        if self._journal is not None:
+            self._journal.taint("per-shard store load outside the "
+                                "checkpoint plane")
         for s, st in enumerate(self.stores):
             if st is not None:
                 st.load(f"{path_prefix}.shard{s:03d}")
@@ -1158,6 +1207,16 @@ class ShardedStoreView:
                     np.empty((0, self._table.layout.width), np.float32))
         return np.concatenate(ks), np.vstack(vs)
 
+    def spilled_count(self) -> int:
+        """Summed SSD-tier rows over the owned shards (journal taint
+        probe)."""
+        total = 0
+        for _, st in self._owned():
+            probe = getattr(st, "spilled_count", None)
+            if probe is not None:
+                total += probe()
+        return total
+
     def write_back(self, keys: np.ndarray, values: np.ndarray) -> None:
         # checkpoint stat rewrites land here — the residency caches no
         # longer mirror the stores afterwards
@@ -1169,14 +1228,28 @@ class ShardedStoreView:
             if m.any():
                 st.write_back(keys[m], values[m])
 
-    def load(self, path: str) -> None:
-        """Split a single checkpoint blob across the shard stores (their
-        load_blob handles index reset, stale-spill clearing, and layout
-        validation) — one deserialization, no temp files."""
+    def update_stat_after_save(self, table_cfg, param: int) -> None:
+        """Checkpoint stat rewrite, per shard in place (every shard
+        store applies the same accessor rule to its own resident rows —
+        routing is irrelevant, the union is the table)."""
         self._table.invalidate_residency()
-        import pickle
-        with open(path, "rb") as f:
-            blob = pickle.load(f)
+        from paddlebox_tpu.train.journal import apply_stat_after_save
+        for _, st in self._owned():
+            apply_stat_after_save(st, table_cfg, param)
+
+    def load(self, path: str) -> None:
+        """Split a single checkpoint — columnar manifest (loaded through
+        the reader pool) or legacy pickle, sniffed — across the shard
+        stores; keys route by the LIVE sharding policy, so a checkpoint
+        written under one policy redistributes on load under another."""
+        from paddlebox_tpu.embedding.ckpt_store import load_sparse_any
+        self.load_blob(load_sparse_any(path))
+
+    def load_blob(self, blob: dict) -> None:
+        """The post-deserialize half of load (their load_blob handles
+        index reset, stale-spill clearing, and layout validation) — one
+        blob split across shards without re-serializing."""
+        self._table.invalidate_residency()
         keys = np.asarray(blob["keys"], np.uint64)
         shard = self._table.policy.shard_of(keys)
         for s, st in self._owned():

@@ -104,13 +104,7 @@ class MmapXboxStore:
 
     def __init__(self, path: str) -> None:
         self.path = path
-        with open(path, "rb") as f:
-            if f.read(8) != _XBOX_MAGIC:
-                raise ValueError(f"{path}: not an xbox columnar store")
-            n = int(np.frombuffer(f.read(8), np.int64)[0])
-            dim = int(np.frombuffer(f.read(8), np.int64)[0])
-        key_off = (8 + 8 + 8 + 63) // 64 * 64
-        row_off = (key_off + n * 8 + 63) // 64 * 64
+        n, dim, key_off, row_off = _xbox_header(path)
         self._n, self._dim = n, dim
         if n:
             self._keys = np.memmap(path, np.uint64, "r", key_off, (n,))
@@ -254,16 +248,68 @@ def discover_days(xbox_model_dir: str) -> List[str]:
 # ---------------------------------------------------------------------------
 
 
+def _xbox_header(path: str) -> Tuple[int, int, int, int]:
+    """(n, dim, key_off, row_off) of one columnar view file — the ONE
+    reader-side twin of write_xbox_columnar's framing (both mmap
+    consumers parse through here, so the offsets can't drift apart)."""
+    with open(path, "rb") as f:
+        if f.read(8) != _XBOX_MAGIC:
+            raise ValueError(f"{path}: not an xbox columnar store")
+        n = int(np.frombuffer(f.read(8), np.int64)[0])
+        dim = int(np.frombuffer(f.read(8), np.int64)[0])
+    key_off = (8 + 8 + 8 + 63) // 64 * 64
+    row_off = (key_off + n * 8 + 63) // 64 * 64
+    return n, dim, key_off, row_off
+
+
+def read_xbox_columnar(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Header-parse + mmap one columnar view file → (keys [n] uint64,
+    rows [n, dim] f32) read-only views — the one-shot read (no native
+    index build; MmapXboxStore is the serving-lookup tier)."""
+    n, dim, key_off, row_off = _xbox_header(path)
+    if n == 0:
+        return np.empty(0, np.uint64), np.empty((0, dim), np.float32)
+    return (np.memmap(path, np.uint64, "r", key_off, (n,)),
+            np.memmap(path, np.float32, "r", row_off, (n, dim)))
+
+
+def read_xbox_view(view_dir: str) -> Tuple[np.ndarray, np.ndarray]:
+    """(keys, embedding rows) of ONE view dir in either format: the
+    legacy ``embedding.pkl`` the pre-round-15 trainer wrote, or the
+    directly-emitted columnar file (``view.xcol``). The shared read
+    every composition-side consumer (XboxModelReader, tests, examples)
+    goes through, so mixed-format day histories compose fine."""
+    pkl = os.path.join(view_dir, "embedding.pkl")
+    if os.path.exists(pkl):
+        with open(pkl, "rb") as f:
+            blob = pickle.load(f)
+        return (np.asarray(blob["keys"], np.uint64).ravel(),
+                np.asarray(blob["embedding"], np.float32))
+    xcol = os.path.join(view_dir, VIEW_COLUMNAR_NAME)
+    if os.path.exists(xcol):
+        keys, rows = read_xbox_columnar(xcol)
+        return np.asarray(keys), np.asarray(rows, np.float32)
+    raise FileNotFoundError(
+        f"{view_dir}: neither embedding.pkl nor {VIEW_COLUMNAR_NAME}")
+
+
 def compile_view_dir(view_dir: str, force: bool = False) -> str:
     """Compile one view dir's embedding.pkl into its columnar twin
     (``view.xcol``) and return the columnar path. Skipped when an
     up-to-date twin already exists (mtime >= the pkl's), so N serving
     processes on one box compile once and share the file — and its page
-    cache — thereafter. Keys are sorted here (the pkl carries store
-    iteration order); duplicate keys in ONE view are a writer bug and
-    raise."""
+    cache — thereafter. NEW-FORMAT dirs (the round-15 checkpoint plane
+    writes ``view.xcol`` directly, no pkl at all) detect-and-skip: the
+    pickle→columnar re-encode and its staleness window are gone. Keys
+    are sorted here (the pkl carries store iteration order); duplicate
+    keys in ONE view are a writer bug and raise."""
     src = os.path.join(view_dir, "embedding.pkl")
     out = os.path.join(view_dir, VIEW_COLUMNAR_NAME)
+    if not os.path.exists(src):
+        if os.path.exists(out):
+            return out  # already-columnar view: nothing to compile
+        raise FileNotFoundError(
+            f"{view_dir}: neither embedding.pkl nor {VIEW_COLUMNAR_NAME}")
     if (not force and os.path.exists(out)
             and os.path.getmtime(out) >= os.path.getmtime(src)):
         return out

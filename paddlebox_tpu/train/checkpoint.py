@@ -9,6 +9,24 @@ delta_score crossed delta_threshold since the last save, then clears their
 delta scores (UpdateStatAfterSave param=1, ctr_accessor.cc:101-125).
 Dense params are saved with the batch model (the reference uses standard
 fluid persistable saves; here one pickle of the jax pytree).
+
+Round 15 — the line-rate checkpoint/restore plane:
+
+  * The sparse batch tier is COLUMNAR by default (ckpt_format flag):
+    ``sparse.xman`` manifest + N striped part files written by a writer
+    pool and loaded through a reader pool (embedding/ckpt_store.py) —
+    the serving plane's mmap columnar machinery generalized to the full
+    ValueLayout row. Legacy ``sparse.pkl`` checkpoints keep loading.
+  * ``save_base(mode='touched')`` kills the day-boundary snapshot stall:
+    the artifact is {previous full base parts (hard-linked) + the
+    touched-row journal segments since that base} (train/journal.py) —
+    cost proportional to the delta, and replaying the journal over the
+    base reconstructs bit-exactly what a full save would have written
+    (the elastic mid-day rejoin artifact, ROADMAP item 5).
+  * xbox views emit the serving columnar file DIRECTLY (flag
+    ckpt_xbox_columnar), so serving's compile_view_dir becomes a
+    detect-and-skip no-op and delta-refresh staleness drops by the
+    compile step.
 """
 
 from __future__ import annotations
@@ -17,18 +35,25 @@ import os
 import pickle
 import threading
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from paddlebox_tpu.config.configs import CheckpointConfig, TableConfig
 from paddlebox_tpu.embedding import accessor as acc
+from paddlebox_tpu.embedding import ckpt_store as cks
 from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
 from paddlebox_tpu.embedding.pass_table import PassTable
 from paddlebox_tpu.serving.store import (_XBOX_MAGIC,  # noqa: F401
-                                         MmapXboxStore,
+                                         MmapXboxStore, VIEW_COLUMNAR_NAME,
                                          discover_xbox_sources,
+                                         read_xbox_view,
                                          write_xbox_columnar)
+from paddlebox_tpu.train import journal as jr
+
+#: batch-dir sparse tier file names (manifest = columnar, pkl = legacy)
+SPARSE_MANIFEST = "sparse.xman"
+SPARSE_PICKLE = "sparse.pkl"
 
 
 def _write_done(dirpath: str) -> None:
@@ -47,34 +72,121 @@ class CheckpointManager:
         sharded table checkpoints through its store_view facade, so ONE
         save/load/delta implementation serves both topologies
         (multi-process jobs checkpoint per owned shard via table.save
-        instead)."""
+        instead). With the ckpt_journal flag on (default) a touched-row
+        journal is created under the batch model dir and attached to the
+        table, enabling mode='touched'/'auto' base saves and the elastic
+        mid-day rejoin artifact."""
         self.cfg = cfg
         self.table = table
         self.store = (table.store if hasattr(table, "store")
                       else table.store_view())
-        self._save_thread: Optional[threading.Thread] = None
+        # ALL outstanding async writers, not a single slot: a dropped
+        # handle meant wait() joined only the last writer and a
+        # day-boundary load could race a still-running base save
+        self._writers: List[threading.Thread] = []  # guarded-by: _writers_lock
+        self._writers_lock = threading.Lock()
+        self.journal: Optional[jr.TouchedRowJournal] = None
+        from paddlebox_tpu.config import flags as _flags
+        if _flags.get_flag("ckpt_journal"):
+            from paddlebox_tpu.obs import log as _log
+            jdir = os.path.join(cfg.batch_model_dir, "_journal",
+                                "rank%d" % _log.get_rank())
+            try:
+                self.journal = jr.TouchedRowJournal(
+                    jdir, self.table.layout, self.table.config)
+            except OSError as e:
+                from paddlebox_tpu.obs import log
+                log.warning("touched-row journal disabled: cannot create "
+                            "journal dir", dir=jdir, error=repr(e))
+            else:
+                attach = getattr(table, "attach_journal", None)
+                if attach is not None:
+                    attach(self.journal)
+
+    # --------------------------------------------------------- async writers
+    def _spawn_writer(self, fn) -> None:
+        if not self.cfg.async_save:
+            fn()
+            return
+        t = threading.Thread(target=fn, daemon=True)
+        with self._writers_lock:
+            self._writers.append(t)
+        t.start()
+
+    def wait(self) -> None:
+        """Join EVERY outstanding async writer (not just the newest)."""
+        while True:
+            with self._writers_lock:
+                if not self._writers:
+                    return
+                t = self._writers.pop()
+            t.join()
 
     # ------------------------------------------------------------ batch tier
-    def save_base(self, params: Any, opt_state: Any, day: str,
-                  extra: Optional[Dict] = None) -> Tuple[str, str]:
-        """Full save → (batch_path, xbox_path).
-
-        Snapshotting AND the post-save stat mutation (clear delta, age days)
-        happen synchronously so a concurrent next pass can't race the store;
-        only the file writes go to the async thread."""
-        self.wait()
-        batch_dir = os.path.join(self.cfg.batch_model_dir, day)
-        xbox_dir = os.path.join(self.cfg.xbox_model_dir, day)
-        os.makedirs(batch_dir, exist_ok=True)
-        os.makedirs(xbox_dir, exist_ok=True)
-
+    def _flags_snapshot(self) -> Dict:
         # opt_state tree STRUCTURE depends on flatten_dense_opt (optax.
         # flatten stores one flat vector instead of per-param trees);
         # record it so load_base can fail loud on a mismatched restore
         # instead of crashing deep in the first post-restore update
         from paddlebox_tpu.config import flags as _flags
-        flags_snapshot = {
-            "flatten_dense_opt": bool(_flags.get_flag("flatten_dense_opt"))}
+        return {"flatten_dense_opt":
+                bool(_flags.get_flag("flatten_dense_opt"))}
+
+    def _meta(self) -> Dict:
+        return {"embedx_dim": self.table.layout.embedx_dim,
+                "optimizer": self.table.layout.optimizer}
+
+    def _spilled_rows_count(self) -> int:
+        probe = getattr(self.store, "spilled_count", None)
+        return int(probe()) if probe is not None else 0
+
+    def _stat_after_save(self, base: bool) -> None:
+        """The post-save stat mutation, in place on the store (clear
+        covered delta scores; base saves also age the resident rows) +
+        the matching journal event records — the rewrite bypasses the
+        pass cadence, so residency drops too."""
+        jr.apply_stat_after_save(self.store, self.table.config, 1)
+        if base:
+            jr.apply_stat_after_save(self.store, self.table.config, 3)
+        self._invalidate_residency()
+        if self.journal is not None:
+            self.journal.append_event(jr.EV_STAT_SAVE_DELTA)
+            if base:
+                self.journal.append_event(jr.EV_STAT_SAVE_AGE)
+
+    def save_base(self, params: Any, opt_state: Any, day: str,
+                  extra: Optional[Dict] = None,
+                  mode: str = "full") -> Tuple[str, Optional[str]]:
+        """Base save → (batch_path, xbox_path).
+
+        mode='full': snapshot everything — the sparse tier lands as the
+        columnar manifest + striped parts from the writer pool (or the
+        legacy pickle under ckpt_format=pickle) plus the xbox serving
+        base. mode='touched': the batch tier is {previous full base
+        parts (hard-linked) + journal segments since} — cost
+        proportional to rows touched since the last save, NO xbox view
+        (serving's incremental path is save_delta; returns (batch_dir,
+        None)); falls back to a full save, loudly, when the journal
+        cannot reconstruct (no anchor / rotation loss / spill taint).
+        mode='auto': touched when the journal is ready, else full.
+
+        Snapshotting AND the post-save stat mutation (clear delta, age
+        days) happen synchronously so a concurrent next pass can't race
+        the store; only the file writes go to the async thread."""
+        self.wait()
+        if mode == "auto":
+            mode = ("touched" if self.journal is not None
+                    and self.journal.snapshot_ready() else "full")
+        if mode == "touched":
+            return self._save_base_touched(params, opt_state, day, extra)
+        if mode != "full":
+            raise ValueError(f"save_base mode {mode!r} not in "
+                             "('full', 'touched', 'auto')")
+        batch_dir = os.path.join(self.cfg.batch_model_dir, day)
+        xbox_dir = os.path.join(self.cfg.xbox_model_dir, day)
+        os.makedirs(batch_dir, exist_ok=True)
+        os.makedirs(xbox_dir, exist_ok=True)
+        flags_snapshot = self._flags_snapshot()
 
         keys, values = self.store.state_items()  # snapshot (copy)
         # SSD-tier rows are NOT in state_items(); a base model must cover
@@ -83,25 +195,29 @@ class CheckpointManager:
         # spilled feature. Snapshot them at their EFFECTIVE age; the
         # post-save stat mutation below stays resident-only (spilled rows
         # age via the age-book epoch at the day boundary).
+        spilled_rows = self._spilled_rows_count()
         skeys, svals = self._spilled_snapshot()
         all_keys = np.concatenate([keys, skeys]) if skeys.size else keys
         all_vals = np.vstack([values, svals]) if skeys.size else values
         xbox_blob = self._xbox_view(all_keys, all_vals, base=True)
-        sparse_blob = {"keys": all_keys, "values": all_vals.copy(),
-                       "embedx_dim": self.table.layout.embedx_dim,
-                       "optimizer": self.table.layout.optimizer}
+        sparse_path, n_parts, part_paths = self._plan_sparse(
+            batch_dir, int(all_keys.size))
+        meta = self._meta()
+        # journal: new epoch anchored at THIS artifact (pre-mutation
+        # snapshot — exactly what replay-over-base must reproduce); the
+        # part files land on the async writer, but nothing reads them
+        # before the next save's entry wait() joins it
+        if self.journal is not None:
+            self.journal.anchor_full(part_paths, spilled_rows=spilled_rows)
         # base save covers everything: clear delta scores + age days, now
-        self.table.layout.update_stat_after_save(values, self.table.config, 1)
-        self.table.layout.update_stat_after_save(values, self.table.config, 3)
-        if keys.size:
-            self.store.write_back(keys, values)
-            # the stat rewrite bypassed the pass cadence: any resident
-            # slab no longer mirrors the store (incremental lifecycle)
-            self._invalidate_residency()
+        self._stat_after_save(base=True)
 
         def do_save():
-            with open(os.path.join(batch_dir, "sparse.pkl"), "wb") as f:
-                pickle.dump(sparse_blob, f, protocol=pickle.HIGHEST_PROTOCOL)
+            if n_parts is None:
+                cks.save_sparse_auto(sparse_path, all_keys, all_vals, meta)
+            else:
+                cks.write_sparse_columnar(sparse_path, all_keys, all_vals,
+                                          meta, parts=n_parts)
             with open(os.path.join(batch_dir, "dense.pkl"), "wb") as f:
                 pickle.dump({"params": params, "opt_state": opt_state,
                              "extra": extra or {},
@@ -109,16 +225,94 @@ class CheckpointManager:
             self._write_xbox(xbox_dir, xbox_blob)
             _write_done(batch_dir)
 
-        if self.cfg.async_save:
-            self._save_thread = threading.Thread(target=do_save, daemon=True)
-            self._save_thread.start()
-        else:
-            do_save()
+        self._spawn_writer(do_save)
         return batch_dir, xbox_dir
+
+    def _plan_sparse(self, batch_dir: str, n_rows: int
+                     ) -> Tuple[str, Optional[int], List[str]]:
+        """(sparse path, pinned part count or None for pickle, final
+        part paths) — pinned up front so the journal can anchor on the
+        exact files the async writer will produce."""
+        from paddlebox_tpu.config import flags as _flags
+        if str(_flags.get_flag("ckpt_format")) == "pickle":
+            path = os.path.join(batch_dir, SPARSE_PICKLE)
+            return path, None, [path]
+        path = os.path.join(batch_dir, SPARSE_MANIFEST)
+        n_parts = cks.default_parts(n_rows)
+        return path, n_parts, [f"{path}.p{i:04d}" for i in range(n_parts)]
+
+    def _save_base_touched(self, params: Any, opt_state: Any, day: str,
+                           extra: Optional[Dict]) -> Tuple[str, Optional[str]]:
+        batch_dir = os.path.join(self.cfg.batch_model_dir, day)
+        try:
+            if self.journal is None:
+                # ckpt_journal off, or its dir was uncreatable at
+                # construction (warned there) — same loud degrade as
+                # every other journal failure, not a crash
+                raise jr.JournalIncompleteError(
+                    "no touched-row journal on this manager "
+                    "(ckpt_journal flag off or journal dir uncreatable)")
+            refs = self.journal.snapshot_refs()
+            os.makedirs(batch_dir, exist_ok=True)
+            base_names, seg_names = [], []
+            for i, p in enumerate(refs["parts"]):
+                name = f"base.b{i:04d}"
+                cks.link_or_copy(p, os.path.join(batch_dir, name))
+                base_names.append(name)
+            for i, p in enumerate(refs["segments"]):
+                name = f"journal-{i:06d}.jrnl"
+                cks.link_or_copy(p, os.path.join(batch_dir, name))
+                seg_names.append(name)
+            manifest = {"format": cks.MANIFEST_FORMAT,
+                        "version": cks.MANIFEST_VERSION, "mode": "journal",
+                        "width": int(self.table.layout.width),
+                        "meta": self._meta(), "base": base_names,
+                        "segments": seg_names,
+                        "dirty_rows": int(refs["dirty_rows"])}
+            man_path = os.path.join(batch_dir, SPARSE_MANIFEST)
+            tmp = f"{man_path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                import json
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, man_path)
+        except (jr.JournalIncompleteError, OSError) as e:
+            # refusal (no anchor / taint / rotation loss) AND I/O death
+            # (an anchor part pruned externally, a dead async writer that
+            # never materialized its parts) both degrade the SAME way:
+            # loud full save. Stray base.b*/journal-* links from a
+            # half-done attempt are ignored by the full-mode manifest.
+            from paddlebox_tpu.obs import log
+            from paddlebox_tpu.utils.stats import stat_add
+            stat_add("ckpt_touched_fallback_full")
+            log.warning("touched base save falling back to FULL",
+                        reason=repr(e))
+            return self.save_base(params, opt_state, day, extra,
+                                  mode="full")
+        flags_snapshot = self._flags_snapshot()
+        # the snapshot's own links now serve as the anchor: retention
+        # pruning the ORIGINAL base dir can no longer orphan the epoch
+        self.journal.rebase(
+            [os.path.join(batch_dir, n) for n in base_names],
+            [os.path.join(batch_dir, n) for n in seg_names])
+        self._stat_after_save(base=True)
+
+        def do_save():
+            with open(os.path.join(batch_dir, "dense.pkl"), "wb") as f:
+                pickle.dump({"params": params, "opt_state": opt_state,
+                             "extra": extra or {},
+                             "flags": flags_snapshot}, f)
+            _write_done(batch_dir)
+
+        self._spawn_writer(do_save)
+        return batch_dir, None
 
     def save_delta(self, day: str, delta_id: int) -> str:
         """Incremental serving save of features with delta_score >=
-        delta_threshold (SaveDelta, box_wrapper.cc:1309)."""
+        delta_threshold (SaveDelta, box_wrapper.cc:1309). The view lands
+        directly in the serving columnar format by default (flag
+        ckpt_xbox_columnar) — compile_view_dir then has nothing to do."""
         self.wait()
         xbox_dir = os.path.join(self.cfg.xbox_model_dir, day,
                                 f"delta-{delta_id}")
@@ -126,19 +320,12 @@ class CheckpointManager:
         keys, values = self.store.state_items()
         blob = self._xbox_view(keys, values, base=False)
         # clear covered rows' delta (UpdateStatAfterSave param=1) — sync
-        self.table.layout.update_stat_after_save(values, self.table.config, 1)
-        if keys.size:
-            self.store.write_back(keys, values)
-            self._invalidate_residency()
+        self._stat_after_save(base=False)
 
         def do_save():
             self._write_xbox(xbox_dir, blob)
 
-        if self.cfg.async_save:
-            self._save_thread = threading.Thread(target=do_save, daemon=True)
-            self._save_thread.start()
-        else:
-            do_save()
+        self._spawn_writer(do_save)
         return xbox_dir
 
     def _invalidate_residency(self) -> None:
@@ -182,14 +369,82 @@ class CheckpointManager:
 
     @staticmethod
     def _write_xbox(xbox_dir: str, blob: Dict) -> None:
-        with open(os.path.join(xbox_dir, "embedding.pkl"), "wb") as f:
-            pickle.dump(blob, f)
+        """Land one xbox view: by default DIRECTLY as the serving
+        columnar file (sorted keys — exactly what compile_view_dir would
+        have produced from the pkl, minus the second encode); the legacy
+        embedding.pkl under ckpt_xbox_columnar=false."""
+        from paddlebox_tpu.config import flags as _flags
+        if _flags.get_flag("ckpt_xbox_columnar"):
+            keys = np.asarray(blob["keys"], np.uint64).ravel()
+            rows = np.asarray(blob["embedding"], np.float32)
+            order = np.argsort(keys, kind="stable")
+            keys = keys[order]
+            if keys.size > 1 and not (keys[1:] > keys[:-1]).all():
+                raise ValueError(f"{xbox_dir}: duplicate keys in one view")
+            write_xbox_columnar(os.path.join(xbox_dir, VIEW_COLUMNAR_NAME),
+                                keys, rows[order])
+        else:
+            with open(os.path.join(xbox_dir, "embedding.pkl"), "wb") as f:
+                pickle.dump(blob, f)
         _write_done(xbox_dir)
 
     # ---------------------------------------------------------------- resume
+    def _read_base_files(self, paths) -> Dict:
+        """Concatenate a journal-mode snapshot's base files into one blob
+        (each file sniffed: a columnar part or a legacy pickle blob)."""
+        key_blocks, val_blocks = [], []
+        width = self.table.layout.width
+        for p in paths:
+            with open(p, "rb") as f:
+                head = f.read(8)
+            if head == cks.PART_MAGIC:
+                k, v = cks.map_part(p)
+            else:
+                with open(p, "rb") as f:
+                    b = pickle.load(f)
+                if (b["embedx_dim"] != self.table.layout.embedx_dim
+                        or b["optimizer"] != self.table.layout.optimizer):
+                    raise ValueError(f"{p}: checkpoint layout mismatch")
+                k, v = np.asarray(b["keys"], np.uint64), b["values"]
+            if v.shape[1] != width:
+                raise ValueError(f"{p}: width {v.shape[1]} != {width}")
+            key_blocks.append(np.asarray(k))
+            val_blocks.append(np.asarray(v, np.float32))
+        keys = (np.concatenate(key_blocks) if key_blocks
+                else np.empty(0, np.uint64))
+        vals = (np.vstack(val_blocks) if key_blocks
+                else np.empty((0, width), np.float32))
+        return {"keys": keys, "values": vals,
+                "embedx_dim": self.table.layout.embedx_dim,
+                "optimizer": self.table.layout.optimizer}
+
+    def _reconstruct_journal_manifest(self, batch_dir: str,
+                                      doc: Dict) -> Dict:
+        base = self._read_base_files(
+            os.path.join(batch_dir, n) for n in doc["base"])
+        segs = [os.path.join(batch_dir, n) for n in doc["segments"]]
+        return jr.reconstruct_blob(base, segs, self.table.layout,
+                                   self.table.config)
+
+    def _artifact_refs(self, batch_dir: str) -> Tuple[List[str], List[str]]:
+        """(base part files, journal segment files) of a completed batch
+        dir — what the journal re-anchors on after a load."""
+        man = os.path.join(batch_dir, SPARSE_MANIFEST)
+        if os.path.exists(man):
+            doc = cks.read_manifest(man)
+            if doc.get("mode") == "journal":
+                return ([os.path.join(batch_dir, n) for n in doc["base"]],
+                        [os.path.join(batch_dir, n)
+                         for n in doc["segments"]])
+            return cks.manifest_part_paths(man), []
+        return [os.path.join(batch_dir, SPARSE_PICKLE)], []
+
     def load_base(self, day: str) -> Tuple[Any, Any, Dict]:
         """Resume from a batch model (initialize_gpu_and_load_model analog,
-        box_wrapper.cc:1201)."""
+        box_wrapper.cc:1201): columnar manifest (parallel part ingest),
+        journal-over-base manifest (base + replay), or legacy sparse.pkl
+        — dispatched by what the completed dir holds."""
+        self.wait()  # a load must never race a still-running async save
         batch_dir = os.path.join(self.cfg.batch_model_dir, day)
         if not os.path.exists(os.path.join(batch_dir, "DONE")):
             raise FileNotFoundError(f"no completed checkpoint at {batch_dir}")
@@ -209,14 +464,25 @@ class CheckpointManager:
                     f"{saved} but this run has {cur}: the dense opt_state "
                     "pytree structures are incompatible — set "
                     "PBTPU_FLATTEN_DENSE_OPT to match the checkpoint")
-        self.store.load(os.path.join(batch_dir, "sparse.pkl"))
+        man = os.path.join(batch_dir, SPARSE_MANIFEST)
+        if os.path.exists(man):
+            doc = cks.read_manifest(man)
+            if doc.get("mode") == "journal":
+                self.store.load_blob(
+                    self._reconstruct_journal_manifest(batch_dir, doc))
+            else:
+                self.store.load(man)
+        else:
+            self.store.load(os.path.join(batch_dir, SPARSE_PICKLE))
         self._invalidate_residency()
+        # the loaded artifact is a valid full-base anchor: touched saves
+        # can resume immediately after a restore (load_blob cleared any
+        # spill index, so the anchor starts untainted)
+        if self.journal is not None:
+            parts, segs = self._artifact_refs(batch_dir)
+            self.journal.anchor_full(parts, segments=segs,
+                                     spilled_rows=self._spilled_rows_count())
         return blob["params"], blob["opt_state"], blob["extra"]
-
-    def wait(self) -> None:
-        if self._save_thread is not None:
-            self._save_thread.join()
-            self._save_thread = None
 
 
 # ---------------------------------------------------------------------------
@@ -273,6 +539,23 @@ def run_day(trainer, datasets, cm: CheckpointManager, day: str,
     return stats, dirs
 
 
+def read_batch_sparse(batch_dir: str) -> Dict:
+    """The sparse blob of one FULL batch-model dir, either format
+    (columnar manifest via the reader pool, or legacy sparse.pkl).
+    Journal-over-base snapshots need a table to replay against —
+    CheckpointManager.load_base handles those; here they refuse."""
+    man = os.path.join(batch_dir, SPARSE_MANIFEST)
+    if os.path.exists(man):
+        if cks.read_manifest(man).get("mode") == "journal":
+            raise ValueError(
+                f"{batch_dir}: journal-over-base snapshot — load it "
+                "through CheckpointManager.load_base (merge wants "
+                "day-end FULL bases)")
+        return cks.load_sparse_columnar(man)
+    with open(os.path.join(batch_dir, SPARSE_PICKLE), "rb") as f:
+        return pickle.load(f)
+
+
 def merge_models(batch_dirs, out_dir: str) -> str:
     """Merge N batch models into one (MergeModel/MergeMultiModels,
     box_wrapper.h:788-804 — the closed core's impl is not visible, so the
@@ -281,10 +564,7 @@ def merge_models(batch_dirs, out_dir: str) -> str:
     average WEIGHTED BY SHOW, unseen_days takes the min and mf_size the
     max. Dense params are taken from the first model (data-parallel
     replicas are identical at save time)."""
-    blobs = []
-    for d in batch_dirs:
-        with open(os.path.join(d, "sparse.pkl"), "rb") as f:
-            blobs.append(pickle.load(f))
+    blobs = [read_batch_sparse(d) for d in batch_dirs]
     embedx_dim = blobs[0]["embedx_dim"]
     opt = blobs[0]["optimizer"]
     width = blobs[0]["values"].shape[1]
@@ -315,10 +595,13 @@ def merge_models(batch_dirs, out_dir: str) -> str:
     out_vals[:, acc.MF_SIZE] = mfsz
 
     os.makedirs(out_dir, exist_ok=True)
-    with open(os.path.join(out_dir, "sparse.pkl"), "wb") as f:
-        pickle.dump({"keys": out_keys, "values": out_vals,
-                     "embedx_dim": embedx_dim, "optimizer": opt}, f,
-                    protocol=pickle.HIGHEST_PROTOCOL)
+    from paddlebox_tpu.config import flags as _flags
+    out_name = (SPARSE_PICKLE
+                if str(_flags.get_flag("ckpt_format")) == "pickle"
+                else SPARSE_MANIFEST)
+    cks.save_sparse_auto(os.path.join(out_dir, out_name), out_keys,
+                         out_vals, {"embedx_dim": embedx_dim,
+                                    "optimizer": opt})
     dense_src = os.path.join(batch_dirs[0], "dense.pkl")
     if os.path.exists(dense_src):
         with open(dense_src, "rb") as fsrc, \
@@ -363,12 +646,12 @@ class XboxModelReader:
         key_blocks: list = []
         row_blocks: list = []
         for src in sources:
-            with open(os.path.join(src.path, "embedding.pkl"), "rb") as f:
-                blob = pickle.load(f)
-            emb = np.asarray(blob["embedding"], np.float32)
+            # either view format: legacy embedding.pkl, or the columnar
+            # file the round-15 checkpoint plane emits directly
+            keys_v, emb = read_xbox_view(src.path)
             if self._dim is None and emb.ndim == 2:
                 self._dim = int(emb.shape[1])  # writer emits 2-D even empty
-            key_blocks.append(np.asarray(blob["keys"], np.uint64).ravel())
+            key_blocks.append(keys_v)
             row_blocks.append(emb)
         all_keys = np.concatenate(key_blocks)
         seq = np.arange(all_keys.size)

@@ -11,6 +11,13 @@ Each completed pass writes batch_model_dir/<day>/pass-<i>/ with a DONE
 marker (crash mid-save leaves no DONE → that pass replays). The checkpoint
 carries the table PRNG key so a resumed run is bit-identical to an
 uninterrupted one (mf-creation noise included).
+
+Round 15: the per-pass saves run mode='auto' — with the touched-row
+journal live (ckpt_journal flag, default on) every save after the first
+is {base parts hard-linked + journal segments}, so the per-pass
+checkpoint stall is proportional to the rows that pass touched, not the
+table. The artifacts are self-contained (links), so keep_last pruning
+stays safe.
 """
 
 from __future__ import annotations
@@ -136,7 +143,7 @@ class RecoverableRunner:
                 extra["async_dense_state"] = async_table.state()
             self.ckpt.save_base(self.trainer.params, self.trainer.opt_state,
                                 day=os.path.join(self.day, f"pass-{i}"),
-                                extra=extra)
+                                extra=extra, mode="auto")
             self.ckpt.wait()
             self._prune(i + 1)
         return stats

@@ -95,8 +95,10 @@ def test_merge_models(tmp_path):
     mk(str(tmp_path / "m1"), [2, 3], [3.0, 2.0], [9.0, 7.0])
     out = merge_models([str(tmp_path / "m0"), str(tmp_path / "m1")],
                        str(tmp_path / "merged"))
-    with open(os.path.join(out, "sparse.pkl"), "rb") as f:
-        blob = pickle.load(f)
+    # merge output rides the round-15 format flag (columnar manifest by
+    # default); read_batch_sparse dispatches on what the dir holds
+    from paddlebox_tpu.train.checkpoint import read_batch_sparse
+    blob = read_batch_sparse(out)
     got = dict(zip(blob["keys"].tolist(), blob["values"]))
     assert set(got) == {1, 2, 3}
     # key 2 in both: show sums, embed_w show-weighted avg

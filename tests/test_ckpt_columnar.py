@@ -1,0 +1,627 @@
+"""Round 15: the line-rate checkpoint/restore plane.
+
+Columnar sharded sparse checkpoints (manifest + striped parts, writer/
+reader pools) vs the pickle oracle — bit-parity, crash-mid-save
+atomicity, spilled rows, legacy back-compat; the touched-row journal —
+replay-over-base bit-exactness against the live store, touched
+save == full save, taint/rotation/fallback honesty; the CheckpointManager
+writer tracking; and the serving side's detect-and-skip on directly-
+emitted columnar views."""
+
+import json
+import os
+import pickle
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import flags
+from paddlebox_tpu.config.configs import (CheckpointConfig,
+                                          SparseOptimizerConfig,
+                                          TableConfig)
+from paddlebox_tpu.embedding import accessor as acc
+from paddlebox_tpu.embedding import ckpt_store as cks
+from paddlebox_tpu.embedding.accessor import ValueLayout
+from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
+from paddlebox_tpu.embedding.pass_table import PassTable
+from paddlebox_tpu.train import journal as jr
+from paddlebox_tpu.train.checkpoint import (SPARSE_MANIFEST, SPARSE_PICKLE,
+                                            CheckpointManager)
+
+D = 4
+CAP = 1 << 10
+
+
+def table_cfg(**kw):
+    return TableConfig(
+        embedx_dim=D, pass_capacity=CAP,
+        optimizer=SparseOptimizerConfig(mf_create_thresholds=0.0,
+                                        mf_initial_range=1e-3), **kw)
+
+
+def fill_store(store, n=1000, seed=0):
+    rng = np.random.RandomState(seed)
+    keys = np.unique(rng.randint(1, 1 << 40, n).astype(np.uint64))
+    vals = rng.rand(keys.size, store.layout.width).astype(np.float32)
+    vals[:, acc.SHOW] = rng.randint(1, 50, keys.size)
+    vals[:, acc.CLICK] = rng.randint(0, 5, keys.size)
+    vals[:, acc.UNSEEN_DAYS] = 0.0
+    store.assign(keys, vals)
+    return keys, vals
+
+
+def sorted_items(store):
+    keys, vals = store.state_items()
+    order = np.argsort(keys)
+    return keys[order], vals[order]
+
+
+def drive_pass(table, keys, grad_scale=0.05):
+    """One real train pass over `keys` (dedup + push + touched
+    writeback)."""
+    table.begin_feed_pass()
+    table.add_keys(keys)
+    table.end_feed_pass()
+    table.begin_pass()
+    pl = table.push_layout
+    sub = np.concatenate([keys[: max(1, keys.size // 2)], keys[:5]])
+    ids = table.lookup_ids(sub)
+    g = np.zeros((ids.size, pl.width), np.float32)
+    g[:, pl.SHOW] = 1.0
+    g[:, pl.CLICK] = (np.arange(ids.size) % 2).astype(np.float32)
+    g[:, pl.EMBED_G] = grad_scale
+    g[:, pl.embedx_g:] = 0.01
+    table.push(jnp.asarray(ids), jnp.asarray(g))
+    table.end_pass()
+
+
+# --------------------------------------------------------------- format tier
+
+
+def test_columnar_roundtrip_bit_identical_to_pickle(tmp_path):
+    layout = ValueLayout(D)
+    st = HostEmbeddingStore(layout, table_cfg())
+    keys, _ = fill_store(st, 2000)
+    meta = {"embedx_dim": D, "optimizer": layout.optimizer}
+    k0, v0 = st.state_items()
+
+    man = str(tmp_path / "sparse.xman")
+    cks.write_sparse_columnar(man, k0, v0, meta, parts=5)
+    blob = cks.load_sparse_any(man)
+    # contiguous stripes concatenated in manifest order == the arrays a
+    # pickle blob would carry, byte for byte
+    np.testing.assert_array_equal(blob["keys"], k0)
+    np.testing.assert_array_equal(blob["values"], v0)
+
+    # store-level round trip parity: columnar load == pickle load
+    pkl = str(tmp_path / "sparse.pkl")
+    with open(pkl, "wb") as f:
+        pickle.dump({"keys": k0, "values": v0, "embedx_dim": D,
+                     "optimizer": layout.optimizer}, f)
+    st_a = HostEmbeddingStore(layout, table_cfg())
+    st_a.load(man)
+    st_b = HostEmbeddingStore(layout, table_cfg())
+    st_b.load(pkl)
+    ka, va = sorted_items(st_a)
+    kb, vb = sorted_items(st_b)
+    np.testing.assert_array_equal(ka, kb)
+    np.testing.assert_array_equal(va, vb)
+    assert keys.size == ka.size
+
+
+def test_load_blob_beyond_capacity_free_list_integrity(tmp_path):
+    """Review find: loading a blob LARGER than a fresh store's capacity
+    must leave the free list and index disjoint — the vectorized
+    install's tail-delete freed rows that were in use, and the next
+    created key silently clobbered a restored feature."""
+    from paddlebox_tpu.embedding.host_store import _GROW
+    layout = ValueLayout(D)
+    n = _GROW + 1000  # forces _grow during the restore itself
+    keys = np.arange(1, n + 1, dtype=np.uint64)
+    vals = np.tile(np.arange(n, dtype=np.float32)[:, None],
+                   (1, layout.width))
+    st = HostEmbeddingStore(layout, table_cfg())  # FRESH — capacity _GROW
+    st.load_blob({"keys": keys, "values": vals, "embedx_dim": D,
+                  "optimizer": layout.optimizer})
+    in_use = set(st._index.values())
+    assert not in_use.intersection(st._free)
+    assert len(in_use) + len(st._free) == st._values.shape[0]
+    # the next created key must take a genuinely free row, clobbering
+    # nothing
+    st.lookup_or_create(np.uint64([n + 7]))
+    got = st.lookup(keys[1000:1001])[0]
+    np.testing.assert_array_equal(got, vals[1000])
+
+
+def test_columnar_empty_store_roundtrip(tmp_path):
+    layout = ValueLayout(D)
+    st = HostEmbeddingStore(layout, table_cfg())
+    man = str(tmp_path / "empty.xman")
+    st.save(man)
+    st2 = HostEmbeddingStore(layout, table_cfg())
+    st2.load(man)
+    assert len(st2) == 0
+
+
+def test_manifest_pins_part_list_against_strays(tmp_path):
+    """A retried save with FEWER parts must not read a stale extra part
+    from the interrupted wider save."""
+    layout = ValueLayout(D)
+    st = HostEmbeddingStore(layout, table_cfg())
+    fill_store(st, 600)
+    k0, v0 = st.state_items()
+    meta = {"embedx_dim": D, "optimizer": layout.optimizer}
+    man = str(tmp_path / "s.xman")
+    cks.write_sparse_columnar(man, k0, v0, meta, parts=6)
+    cks.write_sparse_columnar(man, k0, v0, meta, parts=2)
+    assert os.path.exists(man + ".p0005")  # the stray is still on disk
+    blob = cks.load_sparse_columnar(man)
+    np.testing.assert_array_equal(blob["keys"], k0)
+    np.testing.assert_array_equal(blob["values"], v0)
+
+
+def test_native_store_columnar_roundtrip(tmp_path):
+    from paddlebox_tpu.embedding.native_store import NativeHostEmbeddingStore
+    try:
+        st = NativeHostEmbeddingStore(ValueLayout(D), table_cfg())
+    except RuntimeError:
+        pytest.skip("native lib unavailable")
+    fill_store(st, 1500)
+    k0, v0 = sorted_items(st)
+    man = str(tmp_path / "n.xman")
+    st.save(man)
+    st2 = NativeHostEmbeddingStore(ValueLayout(D), table_cfg())
+    st2.load(man)
+    k1, v1 = sorted_items(st2)
+    np.testing.assert_array_equal(k0, k1)
+    np.testing.assert_array_equal(v0, v1)
+
+
+# ----------------------------------------------------------- manager + crash
+
+
+def mk_cm(tmp_path, table, async_save=False, sub="a"):
+    return CheckpointManager(
+        CheckpointConfig(batch_model_dir=str(tmp_path / sub / "batch"),
+                         xbox_model_dir=str(tmp_path / sub / "xbox"),
+                         async_save=async_save), table)
+
+
+def test_crash_mid_save_previous_done_base_still_loads(tmp_path,
+                                                       monkeypatch):
+    t = PassTable(table_cfg(), seed=3)
+    drive_pass(t, np.arange(1, 400, dtype=np.uint64) * 7)
+    cm = mk_cm(tmp_path, t)
+    # snapshot BEFORE save: the post-save stat mutation (delta clear +
+    # aging) is the documented save_base semantics — the artifact holds
+    # the pre-mutation state
+    k0, v0 = sorted_items(t.store)
+    cm.save_base({"w": 1.0}, {"m": 0.0}, day="d0")
+
+    calls = {"n": 0}
+    real = cks.write_part
+
+    def dying_write_part(path, keys, values, fsync=True):
+        calls["n"] += 1
+        if calls["n"] >= 3:
+            raise OSError("injected writer death between part files")
+        return real(path, keys, values, fsync=fsync)
+
+    monkeypatch.setattr(cks, "write_part", dying_write_part)
+    drive_pass(t, np.arange(1, 500, dtype=np.uint64) * 11)
+    with pytest.raises(OSError):
+        cm.save_base({"w": 2.0}, {"m": 0.0}, day="d1")
+    monkeypatch.setattr(cks, "write_part", real)
+    # d1 never completed: no manifest, no DONE → it must not load...
+    assert not os.path.exists(
+        os.path.join(cm.cfg.batch_model_dir, "d1", SPARSE_MANIFEST))
+    with pytest.raises(FileNotFoundError):
+        cm.load_base("d1")
+    # ...and the previous DONE base is intact
+    params, _, _ = cm.load_base("d0")
+    assert params == {"w": 1.0}
+    k1, v1 = sorted_items(t.store)
+    np.testing.assert_array_equal(k0, k1)
+    np.testing.assert_array_equal(v0, v1)
+
+
+def test_legacy_pickle_checkpoint_still_loads(tmp_path):
+    t = PassTable(table_cfg(), seed=5)
+    drive_pass(t, np.arange(1, 300, dtype=np.uint64) * 13)
+    flags.set_flag("ckpt_format", "pickle")
+    cm = mk_cm(tmp_path, t)
+    k0, v0 = sorted_items(t.store)  # pre-mutation snapshot = the artifact
+    cm.save_base({"p": 1}, {}, day="d0")
+    assert os.path.exists(
+        os.path.join(cm.cfg.batch_model_dir, "d0", SPARSE_PICKLE))
+    # a columnar-era run resumes from the pickle-era checkpoint
+    flags.set_flag("ckpt_format", "columnar")
+    drive_pass(t, np.arange(1, 200, dtype=np.uint64) * 17)
+    cm.load_base("d0")
+    k1, v1 = sorted_items(t.store)
+    np.testing.assert_array_equal(k0, k1)
+    np.testing.assert_array_equal(v0, v1)
+
+
+def test_columnar_base_covers_spilled_rows(tmp_path):
+    cfg = table_cfg(ssd_dir=str(tmp_path / "ssd"), ssd_threshold_mb=1)
+    layout = ValueLayout(D)
+    st = HostEmbeddingStore(layout, cfg)
+    keys, _ = fill_store(st, 400)
+    assert st.spill(max_resident=keys.size // 2) == keys.size - keys.size // 2
+    man = str(tmp_path / "sp.xman")
+    st.save(man)
+    st2 = HostEmbeddingStore(layout, cfg)
+    st2.load(man)
+    got, _ = st2.state_items()
+    assert set(got.tolist()) == set(keys.tolist())
+
+
+def test_writer_tracking_joins_every_outstanding_writer(tmp_path):
+    """The single-slot _save_thread bug: two outstanding async writers,
+    wait() must join BOTH (and a load must wait for writers)."""
+    t = PassTable(table_cfg(), seed=7)
+    drive_pass(t, np.arange(1, 100, dtype=np.uint64) * 3)
+    cm = mk_cm(tmp_path, t, async_save=True)
+    done = []
+    gates = [threading.Event(), threading.Event()]
+    for i in range(2):
+        def writer(i=i):
+            gates[i].wait(5.0)
+            done.append(i)
+        cm._spawn_writer(writer)
+    assert len(cm._writers) == 2  # both handles tracked, none dropped
+    for g in gates:
+        g.set()
+    cm.wait()
+    assert sorted(done) == [0, 1]
+    assert not cm._writers
+
+    # end-to-end: an async base save joined by the next load
+    cm.save_base({"w": 3}, {}, day="d0")
+    params, _, _ = cm.load_base("d0")  # load_base wait()s internally
+    assert params == {"w": 3}
+
+
+# ------------------------------------------------------------------- journal
+
+
+def run_cadence(tmp_path, sub, seed=21, mode="full"):
+    """Passes + mid-day delta + day-boundary base saves with a live
+    journal; returns (table, cm, sorted store state AFTER everything)."""
+    rng = np.random.RandomState(seed)
+    t = PassTable(table_cfg(), seed=seed)
+    cm = mk_cm(tmp_path, t, sub=sub)
+    base = np.unique(rng.randint(1, 1 << 30, 500).astype(np.uint64))
+    drive_pass(t, base)
+    cm.save_base({"w": 0}, {}, day="d0")        # full anchor
+    # day d1: touched passes + a SaveDelta stat rewrite + day boundary
+    drive_pass(t, base[: base.size // 3])
+    cm.save_delta("d1", delta_id=1)
+    fresh = np.unique(rng.randint(1, 1 << 30, 80).astype(np.uint64))
+    drive_pass(t, np.unique(np.concatenate([base[::4], fresh])))
+    cm.save_base({"w": 1}, {}, day="d1", mode=mode)
+    t.end_day(age=False)
+    return t, cm
+
+
+def test_journal_replay_over_base_matches_live_store(tmp_path):
+    """The elastic-rejoin contract: full base + journal segments replay
+    == the live store, bit-exact — through real passes, a save_delta
+    stat rewrite, a day-boundary save's stat mutation and end_day."""
+    t, cm = run_cadence(tmp_path, "jr", mode="full")
+    drive_pass(t, np.arange(1, 300, dtype=np.uint64) * 19)  # mid-day d2
+    assert cm.journal is not None and cm.journal.snapshot_ready()
+    refs = cm.journal.snapshot_refs()
+    base_blob = cm._read_base_files(refs["parts"])
+    rebuilt = jr.reconstruct_blob(base_blob, refs["segments"],
+                                  t.layout, t.config)
+    ko, vo = sorted_items(t.store)
+    order = np.argsort(rebuilt["keys"])
+    np.testing.assert_array_equal(rebuilt["keys"][order], ko)
+    np.testing.assert_array_equal(rebuilt["values"][order], vo)
+
+
+def test_touched_save_restores_identically_to_full_save(tmp_path):
+    """save_base(mode='touched') → load_base must reconstruct the exact
+    store a full save at the same instant would have restored."""
+    t1, cm1 = run_cadence(tmp_path, "full", seed=33, mode="full")
+    t2, cm2 = run_cadence(tmp_path, "touched", seed=33, mode="touched")
+    # the touched artifact is journal-mode on disk
+    man = json.load(open(os.path.join(cm2.cfg.batch_model_dir, "d1",
+                                      SPARSE_MANIFEST)))
+    assert man["mode"] == "journal" and man["segments"]
+    cm1.load_base("d1")
+    cm2.load_base("d1")
+    k1, v1 = sorted_items(t1.store)
+    k2, v2 = sorted_items(t2.store)
+    np.testing.assert_array_equal(k1, k2)
+    np.testing.assert_array_equal(v1, v2)
+    # and the journal keeps working after a restore: next touched save
+    drive_pass(t2, np.arange(1, 150, dtype=np.uint64) * 23)
+    bdir, xdir = cm2.save_base({"w": 9}, {}, day="d2", mode="auto")
+    assert xdir is None  # touched saves carry no xbox base
+    assert json.load(open(os.path.join(
+        bdir, SPARSE_MANIFEST)))["mode"] == "journal"
+
+
+def test_touched_mode_without_journal_falls_back_full(tmp_path):
+    """ckpt_journal off (or journal dir uncreatable): an explicit
+    mode='touched' save degrades to a loud FULL save, never a crash."""
+    flags.set_flag("ckpt_journal", False)
+    t = PassTable(table_cfg(), seed=19)
+    drive_pass(t, np.arange(1, 150, dtype=np.uint64) * 47)
+    cm = mk_cm(tmp_path, t)
+    assert cm.journal is None
+    bdir, xdir = cm.save_base({}, {}, day="d0", mode="touched")
+    assert json.load(open(os.path.join(
+        bdir, SPARSE_MANIFEST)))["mode"] == "full"
+    assert xdir is not None
+    cm.load_base("d0")
+
+
+def test_retry_after_writer_death_sweeps_orphan_tmps(tmp_path,
+                                                     monkeypatch):
+    """A writer that dies between open() and rename leaves a pid/tid
+    tmp a retry would never overwrite — the retry sweeps it."""
+    layout = ValueLayout(D)
+    st = HostEmbeddingStore(layout, table_cfg())
+    fill_store(st, 300)
+    k0, v0 = st.state_items()
+    meta = {"embedx_dim": D, "optimizer": layout.optimizer}
+    man = str(tmp_path / "s.xman")
+    # fake a dead writer's orphan
+    orphan = f"{man}.p0000.12345.67890.tmp"
+    cks.write_sparse_columnar(man, k0, v0, meta, parts=2)
+    with open(orphan, "wb") as f:
+        f.write(b"garbage")
+    cks.write_sparse_columnar(man, k0, v0, meta, parts=2)
+    assert not os.path.exists(orphan)
+
+
+def test_touched_mode_without_anchor_falls_back_full(tmp_path):
+    t = PassTable(table_cfg(), seed=9)
+    drive_pass(t, np.arange(1, 200, dtype=np.uint64) * 29)
+    cm = mk_cm(tmp_path, t)
+    bdir, xdir = cm.save_base({}, {}, day="d0", mode="auto")
+    # no prior full base → auto resolves to FULL (and emits the xbox base)
+    assert json.load(open(os.path.join(
+        bdir, SPARSE_MANIFEST)))["mode"] == "full"
+    assert xdir is not None
+
+
+def test_spill_taints_journal_and_falls_back(tmp_path):
+    t = PassTable(table_cfg(), seed=13)
+    drive_pass(t, np.arange(1, 300, dtype=np.uint64) * 31)
+    cm = mk_cm(tmp_path, t)
+    cm.save_base({}, {}, day="d0")
+    assert cm.journal.snapshot_ready()
+    t.store._spill_dir = str(tmp_path / "ssd")  # arm the spill tier
+    assert t.store.spill(max_resident=50) > 0
+    t._journal.taint("test spill")  # PassTable.check_need_limit_mem path
+    assert not cm.journal.snapshot_ready()
+    bdir, _ = cm.save_base({}, {}, day="d1", mode="auto")
+    assert json.load(open(os.path.join(
+        bdir, SPARSE_MANIFEST)))["mode"] == "full"
+    # the full save re-anchored with spilled rows present → still tainted
+    assert not cm.journal.snapshot_ready()
+
+
+def test_journal_rotation_bound_marks_incomplete(tmp_path):
+    layout = ValueLayout(D)
+    j = jr.TouchedRowJournal(str(tmp_path / "j"), layout, table_cfg(),
+                             segment_bytes=2048, max_segments=2)
+    j.anchor_full(["/nonexistent/base.p0000"])
+    rng = np.random.RandomState(0)
+    for _ in range(8):  # each append rotates past 2 KB quickly
+        keys = rng.randint(1, 1 << 30, 64).astype(np.uint64)
+        j.append_rows(keys, rng.rand(64, layout.width).astype(np.float32))
+    assert not j.snapshot_ready()
+    with pytest.raises(jr.JournalIncompleteError):
+        j.snapshot_refs()
+
+
+def test_snapshot_seal_itself_tripping_rotation_refuses(tmp_path):
+    """Review find: snapshot_refs seals the ACTIVE segment, and that
+    seal can trip the rotation bound — the completeness check must run
+    AFTER the seal, or the snapshot silently omits the dropped rows."""
+    layout = ValueLayout(D)
+    j = jr.TouchedRowJournal(str(tmp_path / "j"), layout, table_cfg(),
+                             segment_bytes=1 << 20, max_segments=2)
+    j.anchor_full(["/nonexistent/base.p0000"])
+    rng = np.random.RandomState(0)
+
+    def rows():
+        keys = rng.randint(1, 1 << 30, 64).astype(np.uint64)
+        j.append_rows(keys, rng.rand(64, layout.width).astype(np.float32))
+
+    rows()
+    j._seal_locked()  # sealed #1 (test hook: force rotation points)
+    rows()
+    j._seal_locked()  # sealed #2 == max_segments; epoch still complete
+    rows()            # active segment with live rows
+    assert j.snapshot_ready()  # the pre-seal view looks complete...
+    with pytest.raises(jr.JournalIncompleteError):
+        j.snapshot_refs()      # ...but sealing would drop segment #1
+
+
+def test_anchor_spill_taint_is_in_band(tmp_path):
+    """Review find: an anchor-time spill taint must land as an EV_TAINT
+    record too, so a raw segment replay (the elastic-rejoin dir read)
+    refuses instead of silently diverging."""
+    layout = ValueLayout(D)
+    j = jr.TouchedRowJournal(str(tmp_path / "j"), layout, table_cfg())
+    j.anchor_full(["/nonexistent/base.p0000"], spilled_rows=3)
+    keys = np.arange(1, 33, dtype=np.uint64)
+    j.append_rows(keys, np.ones((32, layout.width), np.float32))
+    j.close()
+    segs = sorted(os.path.join(str(tmp_path / "j"), p)
+                  for p in os.listdir(str(tmp_path / "j"))
+                  if p.endswith(".jrnl"))
+    st = HostEmbeddingStore(layout, table_cfg())
+    with pytest.raises(jr.JournalIncompleteError):
+        jr.replay_segments(st, table_cfg(), segs)
+
+
+def test_restart_sweeps_stale_segments(tmp_path):
+    """A restarted process's journal can't replay its predecessor's
+    segments (anchor gone) — construction sweeps them instead of
+    accumulating orphans across restarts."""
+    layout = ValueLayout(D)
+    j1 = jr.TouchedRowJournal(str(tmp_path / "j"), layout, table_cfg())
+    j1.append_rows(np.arange(1, 9, dtype=np.uint64),
+                   np.ones((8, layout.width), np.float32))
+    j1.close()
+    assert any(p.endswith(".jrnl") for p in os.listdir(str(tmp_path / "j")))
+    jr.TouchedRowJournal(str(tmp_path / "j"), layout, table_cfg())
+    assert not any(p.endswith((".jrnl", ".open"))
+                   for p in os.listdir(str(tmp_path / "j")))
+
+
+def test_touched_save_io_death_falls_back_full(tmp_path):
+    """Review find: a pruned anchor part (or a dead async writer that
+    never materialized it) must degrade to a LOUD full save, not crash
+    the day boundary."""
+    t, cm = run_cadence(tmp_path, "io", seed=55, mode="full")
+    drive_pass(t, np.arange(1, 120, dtype=np.uint64) * 43)
+    # sabotage the anchor: point it at part files that don't exist
+    cm.journal.rebase(["/nonexistent/base.p0000"], [])
+    assert cm.journal.snapshot_ready()  # refusal machinery can't see it
+    bdir, xdir = cm.save_base({}, {}, day="d9", mode="touched")
+    assert json.load(open(os.path.join(
+        bdir, SPARSE_MANIFEST)))["mode"] == "full"
+    assert xdir is not None
+
+
+def test_journal_segment_survives_torn_tail(tmp_path):
+    """A crash mid-append leaves a parseable prefix, not garbage."""
+    layout = ValueLayout(D)
+    j = jr.TouchedRowJournal(str(tmp_path / "j"), layout, table_cfg())
+    keys = np.arange(1, 65, dtype=np.uint64)
+    vals = np.random.RandomState(1).rand(64, layout.width).astype(np.float32)
+    j.append_rows(keys, vals)
+    j.append_rows(keys, vals)
+    j.close()
+    seg = [p for p in os.listdir(str(tmp_path / "j"))
+           if p.endswith(".jrnl")][0]
+    path = os.path.join(str(tmp_path / "j"), seg)
+    with open(path, "rb") as f:
+        data = f.read()
+    with open(path, "wb") as f:
+        f.write(data[:-17])  # tear the last record mid-payload
+    recs = list(jr.iter_segment(path))
+    kinds = [k for k, _ in recs]
+    assert kinds == [jr.KIND_HEADER, jr.KIND_ROWS]  # torn tail dropped
+
+
+def test_prune_safe_artifacts_survive_base_dir_deletion(tmp_path):
+    """Touched artifacts hard-link their base: retention-pruning the
+    ORIGINAL full-base dir must not break a later touched artifact."""
+    import shutil
+    t, cm = run_cadence(tmp_path, "pr", seed=44, mode="touched")
+    # what d1's artifact must reconstruct: its own links, no d0 needed
+    oracle = cm._reconstruct_journal_manifest(
+        os.path.join(cm.cfg.batch_model_dir, "d1"),
+        cks.read_manifest(os.path.join(cm.cfg.batch_model_dir, "d1",
+                                       SPARSE_MANIFEST)))
+    shutil.rmtree(os.path.join(cm.cfg.batch_model_dir, "d0"))
+    drive_pass(t, np.arange(1, 100, dtype=np.uint64) * 37)
+    cm.load_base("d1")  # reconstructs from d1's own links
+    k1, v1 = sorted_items(t.store)
+    order = np.argsort(oracle["keys"])
+    np.testing.assert_array_equal(oracle["keys"][order], k1)
+    np.testing.assert_array_equal(oracle["values"][order], v1)
+
+
+# ----------------------------------------------------------- serving plane
+
+
+def test_compile_view_dir_skips_directly_emitted_columnar(tmp_path,
+                                                          monkeypatch):
+    """New-format view dirs (view.xcol, no embedding.pkl): compile is a
+    detect-and-skip no-op — zero bytes rewritten on every call."""
+    from paddlebox_tpu.serving import store as sstore
+    t = PassTable(table_cfg(), seed=15)
+    drive_pass(t, np.arange(1, 300, dtype=np.uint64) * 41)
+    cm = mk_cm(tmp_path, t)
+    _, xbox_dir = cm.save_base({}, {}, day="d0")
+    assert not os.path.exists(os.path.join(xbox_dir, "embedding.pkl"))
+    out = sstore.compile_view_dir(xbox_dir)
+    st0 = os.stat(out)
+    writes = {"n": 0}
+    real = sstore.write_xbox_columnar
+
+    def counting(*a, **kw):
+        writes["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(sstore, "write_xbox_columnar", counting)
+    assert sstore.compile_view_dir(xbox_dir) == out
+    st1 = os.stat(out)
+    assert writes["n"] == 0  # zero bytes rewritten on the second call
+    assert (st0.st_ino, st0.st_mtime_ns) == (st1.st_ino, st1.st_mtime_ns)
+
+
+def test_mixed_format_views_compose(tmp_path):
+    """A pkl-era base day composes with a columnar-era delta through
+    both readers (XboxModelReader and the mmap stack)."""
+    from paddlebox_tpu.serving.store import MmapViewStack, build_stack
+    from paddlebox_tpu.train.checkpoint import XboxModelReader
+    t = PassTable(table_cfg(), seed=17)
+    rng = np.random.RandomState(17)
+    base = np.unique(rng.randint(1, 1 << 30, 400).astype(np.uint64))
+    drive_pass(t, base)
+    flags.set_flag("ckpt_xbox_columnar", False)       # legacy pkl base
+    cm = mk_cm(tmp_path, t)
+    cm.save_base({}, {}, day="d0")
+    flags.set_flag("ckpt_xbox_columnar", True)        # columnar delta
+    drive_pass(t, base[: base.size // 4])
+    cm.save_delta("d1", delta_id=1)
+    root = cm.cfg.xbox_model_dir
+    reader = XboxModelReader(root, "d0", "d1")
+    assert reader.deltas_applied == 1
+    stack, _ = build_stack(root, ["d0", "d1"])
+    probe = np.concatenate([base[:64], np.uint64([1, 2, 3])])
+    np.testing.assert_array_equal(stack.lookup(probe),
+                                  reader.lookup(probe))
+    stack.close()
+
+
+# ------------------------------------------------------------- sharded tier
+
+
+def test_sharded_view_columnar_load_redistributes_by_policy(tmp_path):
+    """A columnar base written under key-mod loads under table-wise: the
+    policy-aware ShardedStoreView.load routes every row to its new
+    owner, content identical."""
+    from paddlebox_tpu.parallel.sharded_table import ShardedPassTable
+    cfg = table_cfg()
+    t1 = ShardedPassTable(cfg, num_shards=4, bucket_cap=64, seed=1)
+    rng = np.random.RandomState(3)
+    keys = np.unique(rng.randint(1, 1 << 40, 800).astype(np.uint64))
+    vals = rng.rand(keys.size, t1.layout.width).astype(np.float32)
+    sv1 = t1.store_view()
+    shard = t1.policy.shard_of(keys)
+    for s in range(4):
+        m = shard == s
+        t1.stores[s].assign(keys[m], vals[m])
+    man = str(tmp_path / "sh.xman")
+    cks.write_sparse_columnar(man, *sv1.state_items(),
+                              {"embedx_dim": D,
+                               "optimizer": t1.layout.optimizer})
+
+    flags.set_flag("sharding_policy", "table-wise")
+    t2 = ShardedPassTable(cfg, num_shards=4, bucket_cap=64, seed=2)
+    t2.store_view().load(man)
+    shard2 = t2.policy.shard_of(keys)
+    for s in range(4):
+        m = shard2 == s
+        got_k, _ = t2.stores[s].state_items()
+        assert set(got_k.tolist()) == set(keys[m].tolist())
+    k2, v2 = sorted_items(t2.store_view())
+    order = np.argsort(keys)
+    np.testing.assert_array_equal(k2, keys[order])
+    np.testing.assert_array_equal(v2, vals[order])

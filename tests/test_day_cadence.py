@@ -356,10 +356,9 @@ def test_save_base_covers_spilled_rows(tmp_path):
                              async_save=False), tr.table)
         _, xbox_dir = cm.save_base(tr.params, tr.opt_state, day="d0")
         # the serving (xbox) base view covers the spilled rows too
-        import pickle
-        with open(os.path.join(xbox_dir, "embedding.pkl"), "rb") as f:
-            xbox = pickle.load(f)
-        assert set(xbox["keys"].tolist()) == set(sk.tolist())
+        from paddlebox_tpu.serving.store import read_xbox_view
+        xkeys, _xrows = read_xbox_view(xbox_dir)
+        assert set(xkeys.tolist()) == set(sk.tolist())
 
         cm.load_base("d0")
         got, _ = store.state_items()

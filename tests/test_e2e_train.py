@@ -121,24 +121,22 @@ def test_delta_save_covers_touched_keys(data, tmp_path):
     ds.set_filelist(files[:1])
     trainer.train_pass(ds)
 
-    import pickle
+    from paddlebox_tpu.serving.store import read_xbox_view
     ckpt_cfg = CheckpointConfig(
         batch_model_dir=str(tmp_path / "batch"),
         xbox_model_dir=str(tmp_path / "xbox"),
         async_save=False)
     cm = CheckpointManager(ckpt_cfg, trainer.table)
     xbox_dir = cm.save_delta("20260729", delta_id=1)
-    with open(f"{xbox_dir}/embedding.pkl", "rb") as f:
-        blob = pickle.load(f)
+    keys1, emb1 = read_xbox_view(xbox_dir)
     # every trained feature crossed delta_threshold=0.25 (each occurrence
     # adds >= nonclk_coeff*1=0.1... clicks add 1.0), so delta covers most
-    assert blob["keys"].size > 0
-    assert blob["embedding"].shape[1] == 1 + D
+    assert keys1.size > 0
+    assert emb1.shape[1] == 1 + D
     # second delta immediately after: nothing new crossed the threshold
     xbox_dir2 = cm.save_delta("20260729", delta_id=2)
-    with open(f"{xbox_dir2}/embedding.pkl", "rb") as f:
-        blob2 = pickle.load(f)
-    assert blob2["keys"].size < blob["keys"].size
+    keys2, _emb2 = read_xbox_view(xbox_dir2)
+    assert keys2.size < keys1.size
 
 
 def test_push_write_rebuild_matches_scatter(data):

@@ -36,6 +36,10 @@ HEADLINES: List[Tuple[str, str, bool]] = [
     ("e2e_lean", "ex/s", True),
     ("pass_amortized_examples_per_sec", "ex/s", True),
     ("steady_ms_per_step", "ms", False),
+    # round-15 checkpoint plane (store-level columnar save/load; absent
+    # pre-round-15 rounds compare as n/a, not as regressions)
+    ("ckpt_save_keys_per_sec", "keys/s", True),
+    ("ckpt_load_keys_per_sec", "keys/s", True),
 ]
 
 

@@ -79,6 +79,15 @@ FLOORS = {
     # store itself; the RPC tiers live in tools/serving_load_probe.py).
     # Recorded under the load guard on 2026-08-03; floor = ~40%
     "serving_lookup_keys_per_sec": (5.0e6, 2e6),
+    # round-15: the columnar checkpoint plane at the store level, BOTH
+    # directions (save = snapshot + fsync'd striped writer pool, load =
+    # reader-pool mmap ingest + store install), 512k rows x width 17 on
+    # the native store. Recorded under the load guard on 2026-08-04 (a
+    # 1-core container: the pools overlap I/O waits, not memcpys —
+    # BASELINE.md round 15 has the layer-by-layer attribution); floors
+    # = ~40% of recorded
+    "ckpt_save_keys_per_sec": (4.6e6, 1.8e6),
+    "ckpt_load_keys_per_sec": (4.1e6, 1.6e6),
 }
 
 # CEILINGS: lower-is-better stages (latencies). Same load-guard
@@ -458,6 +467,45 @@ def section_serving(rng, K):
     os.unlink(path)
 
 
+def section_ckpt(rng, K):
+    # --- checkpoint plane (round 15) ---------------------------------
+    # the columnar sparse batch tier END TO END at the store level:
+    # save = state_items + striped writer pool (fsync'd parts +
+    # manifest), load = manifest + reader-pool mmap ingest + store
+    # install — guards both directions of the restore path between
+    # rounds. 512k rows x width 17 (~36 MB of row bytes), native store
+    # when the lib is present (same tier the trainer runs).
+    import shutil
+    import tempfile
+
+    from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
+                                              TableConfig)
+    from paddlebox_tpu.embedding.pass_table import PassTable
+
+    R = 1 << 19
+    tcfg = TableConfig(embedx_dim=8, pass_capacity=1 << 10,
+                       optimizer=SparseOptimizerConfig())
+    t = PassTable(tcfg, seed=1)
+    keys = rng.permutation(np.arange(1, R + 1, dtype=np.uint64))
+    vals = rng.rand(R, t.layout.width).astype(np.float32)
+    t.store.assign(keys, vals)
+    root = tempfile.mkdtemp(prefix="pbx_ckptprobe_")
+    path = os.path.join(root, "probe.xman")
+    try:
+        def save_rate():
+            return timed_rate(lambda: t.save(path), R)
+
+        def load_rate():
+            return timed_rate(lambda: t.load(path), R)
+
+        rate_s = save_rate()
+        report("ckpt_save_keys_per_sec", rate_s, remeasure=save_rate)
+        rate_l = load_rate()
+        report("ckpt_load_keys_per_sec", rate_l, remeasure=load_rate)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 SECTIONS = (
     ("native", section_native),
     ("bucketize", section_bucketize),
@@ -467,6 +515,7 @@ SECTIONS = (
     ("e2e", section_e2e),
     ("push", section_push),
     ("serving", section_serving),
+    ("ckpt", section_ckpt),
 )
 
 
