@@ -47,6 +47,17 @@ exchange ladder (store allgather vs p2p socket mesh vs p2p+pre-wire uid
 dedup, parity-checked — tools/hostplane_probe.py) so the emitted json
 carries per-step exchange_ms/exchange_bytes for the multi-process tier.
 
+Round 17 adds the `ingest` block — the first measured number on the
+plane bench.py always skipped (it trains on pre-made batches): parse
+keys/s (native columnar read+merge), shuffle codec ladder (block vs
+record codec on identical pre-parsed content, records/s + bytes), pack
+examples/s (split_batches), and the COLD-PASS headline — ONE train_pass
+from text files through the columnar shuffle to the trained slab
+(`ingest_cold_pass_examples_per_sec`) against the same model's resident
+scan rate, plus the preload-overlapped cadence. The real multi-process
+shuffle ladder (record-TCP / block-TCP / block-mesh) lives in
+tools/ingest_probe.py and BASELINE.md round 17.
+
 MFU accounting lives in BASELINE.md (updated whenever the recorded
 baseline moves).
 """
@@ -699,6 +710,178 @@ def measure(platform: str) -> None:
     except Exception as e:  # noqa: BLE001 — diagnostic tier, not the metric
         ckpt = {"error": repr(e)[:300]}
 
+    def ingest_ladder() -> dict:
+        """Round-17 ingest block — the first measured number on the one
+        plane bench.py always skipped (it trains on pre-made synthetic
+        batches): per-stage rates for the parse→shuffle→pack ladder plus
+        the COLD-PASS end-to-end examples/s (a full train_pass from text
+        files through the columnar shuffle to the trained slab) against
+        the SAME model's resident scan rate, and the preload-overlapped
+        cadence (pass N+1 parse+shuffle under pass N training —
+        run_preloaded_passes). Shuffle codec tiers run the codec+routing
+        ALONE on identical pre-parsed content (world 2, in-process), so
+        block-vs-record is the codec claim, not a parse comparison."""
+        import shutil
+        import tempfile
+
+        from paddlebox_tpu.data import BoxDataset, write_synthetic_ctr_files
+        from paddlebox_tpu.data.block_shuffle import (block_shuffle_dests,
+                                                      deserialize_block,
+                                                      serialize_block,
+                                                      split_block)
+        from paddlebox_tpu.data.shuffle import (LocalShuffleGroup,
+                                                deserialize_records,
+                                                serialize_records)
+        from paddlebox_tpu.train.preload import run_preloaded_passes
+
+        I_SLOTS, I_BATCH, I_FILES, I_LINES, IC = 16, 512, 4, 3000, 8
+        out_dir = tempfile.mkdtemp(prefix="pbtpu_ingest_bench_")
+        itrainer = None
+        try:
+            files, ifeed = write_synthetic_ctr_files(
+                out_dir, num_files=I_FILES, lines_per_file=I_LINES,
+                num_slots=I_SLOTS, vocab_per_slot=20000, max_len=MAX_LEN,
+                seed=5)
+            ifeed = type(ifeed)(slots=ifeed.slots, batch_size=I_BATCH)
+            n_total = I_FILES * I_LINES
+
+            def timed_reps(fn, secs):
+                fn()                              # warm
+                reps, t0 = 0, time.perf_counter()
+                while time.perf_counter() - t0 < secs:
+                    fn()
+                    reps += 1
+                return reps, time.perf_counter() - t0
+
+            # parse tier: native columnar read+merge of the whole pass
+            ds = BoxDataset(ifeed, read_threads=2)
+            ds.set_filelist(files)
+            ds.load_into_memory()
+            columnar = ds._load_columnar
+            n_keys = ds.block.n_keys if columnar else ds.all_keys().size
+
+            def parse_once():
+                d2 = BoxDataset(ifeed, read_threads=2)
+                d2.set_filelist(files)
+                d2.load_into_memory()
+
+            reps, dtp = timed_reps(parse_once, 2.0)
+            out = {"instances_per_pass": n_total,
+                   "keys_per_instance": round(n_keys / n_total, 1),
+                   "columnar": columnar,
+                   "parse_keys_per_sec": round(reps * n_keys / dtp, 0),
+                   "parse_lines_per_sec": round(reps * n_total / dtp, 0)}
+
+            # shuffle codec ladder: identical pre-parsed content, both
+            # codecs, world 2 — serialize + hash-route + deserialize
+            block = ds.block
+            rec_ds = BoxDataset(ifeed, read_threads=2, columnar=False)
+            rec_ds.set_filelist(files)
+            rec_ds.load_into_memory()
+            recs = rec_ds.records
+            sizes = {}
+
+            def block_codec():
+                subs = split_block(block, block_shuffle_dests(block, 2), 2)
+                payloads = [serialize_block(s) for s in subs
+                            if s is not None]
+                sizes["block"] = sum(len(p) for p in payloads)
+                assert sum(deserialize_block(p).n_recs
+                           for p in payloads) == n_total
+
+            def record_codec():
+                groups = [[], []]
+                for r in recs:
+                    groups[r.shuffle_hash() % 2].append(r)
+                payloads = [serialize_records(g) for g in groups if g]
+                sizes["record"] = sum(len(p) for p in payloads)
+                assert sum(len(deserialize_records(p))
+                           for p in payloads) == n_total
+
+            b_reps, b_dt = timed_reps(block_codec, 1.5)
+            r_reps, r_dt = timed_reps(record_codec, 1.5)
+            blk = b_reps * n_total / b_dt
+            rec = r_reps * n_total / r_dt
+            out["shuffle"] = {
+                "block_records_per_sec": round(blk, 0),
+                "record_records_per_sec": round(rec, 0),
+                "codec_speedup": round(blk / rec, 1),
+                "block_bytes_per_pass": sizes["block"],
+                "record_bytes_per_pass": sizes["record"]}
+
+            # pack tier: split_batches over the merged block
+            per_pass = [None]
+
+            def pack_once():
+                per_pass[0] = ds.split_batches(num_workers=1)
+
+            p_reps, p_dt = timed_reps(pack_once, 1.5)
+            packed = sum(b.n_ins for b in per_pass[0][0])
+            out["pack_examples_per_sec"] = round(p_reps * packed / p_dt, 0)
+
+            # cold pass: parse -> shuffle -> pack -> train, one call
+            itrainer = BoxTrainer(
+                DeepFM(ModelSpec(num_slots=I_SLOTS, slot_dim=3 + D),
+                       hidden=(256, 128)),
+                TableConfig(embedx_dim=D, pass_capacity=1 << 19,
+                            optimizer=SparseOptimizerConfig(
+                                mf_create_thresholds=0.0,
+                                mf_initial_range=1e-3)),
+                ifeed, TrainerConfig(dense_lr=1e-3, compute_dtype=dtype),
+                seed=0)
+            group = LocalShuffleGroup(1)   # the routed path, all-local
+
+            def fresh_ds():
+                d2 = BoxDataset(ifeed, read_threads=4, shuffler=group[0])
+                d2.set_filelist(files)
+                return d2
+
+            itrainer.train_pass(fresh_ds())      # compile + warm
+            colds = []
+            for _ in range(3):
+                d2 = fresh_ds()
+                t0 = time.perf_counter()
+                itrainer.train_pass(d2)
+                colds.append(len(d2) / (time.perf_counter() - t0))
+            out["cold_pass_examples_per_sec"] = round(
+                float(np.median(colds)), 1)
+            out["cold_runs"] = [round(r, 1) for r in colds]
+
+            # overlapped cadence: pass N+1 parse+shuffle under pass N
+            t0 = time.perf_counter()
+            run_preloaded_passes(itrainer, [fresh_ds() for _ in range(3)])
+            out["overlapped_examples_per_sec"] = round(
+                3 * n_total / (time.perf_counter() - t0), 1)
+
+            # resident tier at the SAME shape/model: scan on pre-staged
+            # batches — what the cold number is honestly compared against
+            batches_i = per_pass[0][0][:IC]
+            itrainer.table.begin_feed_pass()
+            for b in batches_i:
+                itrainer.table.add_keys(b.keys[b.valid])
+            itrainer.table.end_feed_pass()
+            itrainer.table.begin_pass()
+            stacked_i = itrainer._stack_batches(batches_i)
+            st = (itrainer.table.slab, itrainer.params,
+                  itrainer.opt_state, itrainer.table.next_prng())
+            dti = timed_scan_chain(itrainer.fns.scan_steps, st, stacked_i,
+                                   6, warmup=1)
+            out["resident_examples_per_sec"] = round(IC * I_BATCH / dti, 1)
+            out["cold_vs_resident"] = round(
+                out["cold_pass_examples_per_sec"]
+                / max(out["resident_examples_per_sec"], 1e-9), 3)
+            return out
+        finally:
+            if itrainer is not None:
+                itrainer.close()
+            shutil.rmtree(out_dir, ignore_errors=True)
+
+    # round-17: ingest-plane ladder. GUARDED like every diagnostic.
+    try:
+        ingest = ingest_ladder()
+    except Exception as e:  # noqa: BLE001 — diagnostic tier, not the metric
+        ingest = {"error": repr(e)[:300]}
+
     eps = CHUNK * BATCH / dt
     print(json.dumps({
         "schema_version": SCHEMA_VERSION,
@@ -730,6 +913,9 @@ def measure(platform: str) -> None:
         "ckpt_load_keys_per_sec": (ckpt.get("store", {})
                                    .get("columnar", {})
                                    .get("load_keys_per_sec", 0)),
+        "ingest": ingest,
+        "ingest_cold_pass_examples_per_sec": ingest.get(
+            "cold_pass_examples_per_sec", 0),
         "telemetry_overhead": telemetry,
         "flight_overhead": flight,
         "compile_warmup_s": round(t_compile, 1),
@@ -846,6 +1032,9 @@ def main() -> None:
         "checkpoint": result.get("checkpoint"),
         "ckpt_save_keys_per_sec": result.get("ckpt_save_keys_per_sec", 0),
         "ckpt_load_keys_per_sec": result.get("ckpt_load_keys_per_sec", 0),
+        "ingest": result.get("ingest"),
+        "ingest_cold_pass_examples_per_sec": result.get(
+            "ingest_cold_pass_examples_per_sec", 0),
         "telemetry_overhead": result.get("telemetry_overhead"),
         "flight_overhead": result.get("flight_overhead"),
         "hostplane": hostplane,

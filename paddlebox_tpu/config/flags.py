@@ -97,6 +97,28 @@ def all_flags() -> Dict[str, Any]:
 #       BoxDataset(read_threads=...); key registration and merge ride the
 #       channel consumer; per-chunk staging parallelism is stack_threads
 
+define_flag("shuffle_block_codec", True,
+            "cross-host instance shuffle rides whole ColumnarBlocks "
+            "(round 17, data/block_shuffle.py): header + raw column "
+            "bytes per frame (whole-array tobytes/frombuffer), "
+            "destination from ONE vectorized hash over rec_offsets "
+            "(bit-parity with SlotRecord.shuffle_hash), fancy-index "
+            "split into per-destination sub-blocks — zero per-record "
+            "Python end to end. Off = the legacy per-record codec (the "
+            "parity oracle; forces the record-path load for shuffled "
+            "datasets). Keep it identical on every host for line rate: "
+            "mixed frame kinds (also from a RANK-LOCAL downgrade — an "
+            "archive file in one rank's shard, a host whose native lib "
+            "didn't build) CONVERT at the merge worker with a loud "
+            "warning — one stray shard degrades throughput, never "
+            "kills the cluster pass")
+define_flag("shuffle_connect_secs", 20.0,
+            "TcpShuffler peer dial timeout in seconds: a dead peer "
+            "raises ShufflePeerUnreachable naming the endpoint instead "
+            "of the OS-default ~2-minute connect stall (the utils/"
+            "rpc.py round-9 hygiene applied to the shuffle transport). "
+            "Established-connection sends stay unbounded — the flush "
+            "done-barrier timeout bounds the pass")
 define_flag("dataset_disable_shuffle", False,
             "disable BOTH the cross-host instance shuffle stage and local "
             "in-memory shuffling (deterministic load-order passes)")

@@ -1,6 +1,7 @@
 from paddlebox_tpu.data.slot_record import SlotRecord
 from paddlebox_tpu.data.parser import MultiSlotParser
 from paddlebox_tpu.data.packer import PackedBatch, BatchPacker
+from paddlebox_tpu.data.columnar import ColumnarBlock
 from paddlebox_tpu.data.dataset import BoxDataset
 from paddlebox_tpu.data.generator import write_synthetic_ctr_files
 
@@ -9,6 +10,7 @@ __all__ = [
     "MultiSlotParser",
     "PackedBatch",
     "BatchPacker",
+    "ColumnarBlock",
     "BoxDataset",
     "write_synthetic_ctr_files",
 ]

@@ -54,6 +54,27 @@ class ColumnarBlock:
                              rec_offsets=offsets, dense=dense,
                              task_labels=task_labels)
 
+    def select(self, rec_idx: np.ndarray) -> "ColumnarBlock":
+        """Sub-block of the given records, fully vectorized (the
+        fancy-index split primitive of the block shuffle and any other
+        record-subset consumer). Column arrays are fresh copies."""
+        rec_idx = np.asarray(rec_idx, np.int64)
+        starts = self.rec_offsets[rec_idx]
+        counts = self.rec_offsets[rec_idx + 1] - starts
+        flat = np.repeat(starts, counts) + _run_aranges(counts)
+        offsets = np.zeros(rec_idx.size + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        dense = None if self.dense is None else self.dense[rec_idx]
+        task_labels = None
+        if self.task_labels is not None:
+            task_labels = {t: c[rec_idx]
+                           for t, c in self.task_labels.items()}
+        return ColumnarBlock(keys=self.keys[flat],
+                             key_slot=self.key_slot[flat],
+                             labels=self.labels[rec_idx],
+                             rec_offsets=offsets, dense=dense,
+                             task_labels=task_labels)
+
     @staticmethod
     def concat(blocks: Sequence["ColumnarBlock"]) -> "ColumnarBlock":
         blocks = [b for b in blocks if b.n_recs]
@@ -122,6 +143,7 @@ def pack_columnar(block: ColumnarBlock, rec_idx: np.ndarray,
             arr[:n] = col[rec_idx] if col is not None else labels[:n]
             task_labels[t] = arr
 
+    stat_add("ingest_ins_packed", n)
     keys = np.zeros(kcap, dtype=np.uint64)
     slots = np.zeros(kcap, dtype=np.int32)
     # padding tail pinned to the last segment id: the native parser emits

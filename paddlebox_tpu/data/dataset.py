@@ -20,9 +20,11 @@ import numpy as np
 
 from paddlebox_tpu.config import flags
 from paddlebox_tpu.config.configs import DataFeedConfig
+from paddlebox_tpu.data.columnar import ColumnarBlock
 from paddlebox_tpu.data.packer import BatchPacker, PackedBatch
 from paddlebox_tpu.data.parser import MultiSlotParser
 from paddlebox_tpu.data.slot_record import SlotRecord
+from paddlebox_tpu.obs.tracer import span as obs_span
 from paddlebox_tpu.utils.channel import Channel, ChannelClosed
 from paddlebox_tpu.utils.stats import stat_add
 from paddlebox_tpu.utils.timer import Timer
@@ -59,8 +61,11 @@ class BoxDataset:  # boxlint: disable=BX403
         self.timers = {n: Timer() for n in ("read", "merge", "shuffle")}
         # columnar fast path: native C++ parser → struct-of-arrays blocks,
         # numpy-only batch packing (no per-record Python objects). Default:
-        # on when the native lib builds and no cross-host shuffler is
-        # attached (the shuffle transport routes SlotRecord objects).
+        # on whenever the native lib builds — round 17: a cross-host
+        # shuffler no longer forces the record path (blocks ride the
+        # shuffle whole via data/block_shuffle.py's codec + vectorized
+        # hash routing; flag shuffle_block_codec=False restores the
+        # legacy per-record codec, which does need SlotRecords).
         # task-label config errors fail loudly on EVERY host (the native
         # parser would raise only where the lib builds; the record path
         # would silently substitute the click label)
@@ -72,12 +77,10 @@ class BoxDataset:  # boxlint: disable=BX403
                     f"not in the feed config")
         self._native_parser = None
         if columnar is None:
-            columnar = shuffler is None
-        if columnar and shuffler is not None:
-            # the shuffle transport routes SlotRecord objects; columnar
-            # blocks would bypass scatter and break the merge channel —
-            # downgrade to the record path
-            columnar = False
+            # an explicitly-passed custom parser (e.g. a dlopen plugin)
+            # translates per record — the built-in native columnar parse
+            # would silently ignore it
+            columnar = parser is None
         if columnar and feed.rank_offset:
             # pv rank-offset matrices are built from per-record pv fields
             # (search_id/rank/cmatch) which the columnar blocks don't carry
@@ -142,6 +145,12 @@ class BoxDataset:  # boxlint: disable=BX403
         # path sniffs lazily per file inside the read workers.
         if self.columnar and disk_writer is None:
             use_columnar = not any(is_archive(f) for f in files)
+            if (use_columnar and self.shuffler is not None
+                    and not flags.get_flag("shuffle_block_codec")):
+                # the legacy per-record shuffle codec (the block codec's
+                # bit-parity oracle) moves SlotRecords — this load runs
+                # the record path so the oracle stays exercisable
+                use_columnar = False
         else:
             use_columnar = False
         self._load_columnar = use_columnar
@@ -159,8 +168,12 @@ class BoxDataset:  # boxlint: disable=BX403
                         cursor["i"] += 1
                     t.start()
                     if use_columnar:
-                        block = self._native_parser.parse_file_columnar(path)
-                        self._channel.put(block)
+                        with obs_span("ingest_parse"):
+                            block = self._native_parser.parse_file_columnar(
+                                path)
+                        stat_add("ingest_ins_parsed", block.n_recs)
+                        stat_add("ingest_keys_parsed", block.n_keys)
+                        self._put_block(block)
                     elif is_archive(path):
                         for recs in read_archive(path):
                             self._put_records(recs)
@@ -179,10 +192,29 @@ class BoxDataset:  # boxlint: disable=BX403
 
         def merge_worker():
             """MergeInsKeys (data_set.cc:2291-2347): drain channel, register
-            keys with the feed-pass agent, append to the pass memory."""
-            from paddlebox_tpu.data.columnar import ColumnarBlock
+            keys with the feed-pass agent, append to the pass memory.
+            A codec mix — a peer shuffling the OTHER frame kind into this
+            pass because a rank-local downgrade diverged the modes (an
+            archive file in that rank's shard, a host whose native lib
+            didn't build) or the shuffle_block_codec flag was split —
+            CONVERTS here with a loud warning instead of failing: one
+            stray shard must not kill a cluster pass load (round-17
+            review), but the degraded rate must never be silent."""
             t = self.timers["merge"]
             blocks = []
+            mixed_warned = [False]
+
+            def warn_mix(kind: str) -> None:
+                if mixed_warned[0]:
+                    return
+                mixed_warned[0] = True
+                from paddlebox_tpu.obs import log as obs_log
+                obs_log.warning(
+                    "shuffle codec mix: " + kind + " — a peer runs the "
+                    "other ingest mode (archive shard? native lib "
+                    "missing? split shuffle_block_codec flag?); "
+                    "converting at the merge, throughput degraded")
+
             try:
                 while True:
                     try:
@@ -190,26 +222,55 @@ class BoxDataset:  # boxlint: disable=BX403
                     except ChannelClosed:
                         break
                     t.start()
+                    stray = [it for it in items
+                             if isinstance(it, ColumnarBlock)
+                             is not use_columnar]
+                    if stray:
+                        items = [it for it in items
+                                 if isinstance(it, ColumnarBlock)
+                                 is use_columnar]
                     if use_columnar:
-                        for block in items:
-                            if self._add_keys_fn is not None and block.n_keys:
-                                self._add_keys_fn(block.keys)
-                            blocks.append(block)
-                            stat_add("dataset_ins_merged", block.n_recs)
-                    elif disk_writer is not None:
+                        if stray:
+                            warn_mix("record frames in a columnar pass")
+                            from paddlebox_tpu.data.block_shuffle import \
+                                records_to_block
+                            items = items + [records_to_block(stray,
+                                                              self.feed)]
+                            stat_add("ingest_codec_mix_converted",
+                                     len(stray))
+                        with obs_span("ingest_merge"):
+                            for block in items:
+                                if (self._add_keys_fn is not None
+                                        and block.n_keys):
+                                    self._add_keys_fn(block.keys)
+                                blocks.append(block)
+                                stat_add("dataset_ins_merged", block.n_recs)
+                        t.pause()
+                        continue
+                    recs = items
+                    if stray:
+                        warn_mix("columnar block frames in a "
+                                 "record-path pass")
+                        from paddlebox_tpu.data.block_shuffle import \
+                            block_to_records
+                        for b in stray:
+                            recs = recs + block_to_records(b, self.feed)
+                            stat_add("ingest_codec_mix_converted",
+                                     b.n_recs)
+                    if disk_writer is not None:
                         # disk spill: keys are registered when the archives
                         # are loaded back, not at dump time (PreLoadIntoDisk,
                         # data_set.cc:2090-2215)
-                        disk_writer.write_records(items)
-                        stat_add("dataset_ins_spilled", len(items))
+                        disk_writer.write_records(recs)
+                        stat_add("dataset_ins_spilled", len(recs))
                     else:
-                        recs = items
-                        if self._add_keys_fn is not None:
-                            keys = [r.all_keys() for r in recs]
-                            keys = [k for k in keys if k.size]
-                            if keys:
-                                self._add_keys_fn(np.concatenate(keys))
-                        self._records.extend(recs)
+                        with obs_span("ingest_merge"):
+                            if self._add_keys_fn is not None:
+                                keys = [r.all_keys() for r in recs]
+                                keys = [k for k in keys if k.size]
+                                if keys:
+                                    self._add_keys_fn(np.concatenate(keys))
+                            self._records.extend(recs)
                         stat_add("dataset_ins_merged", len(recs))
                     t.pause()
                 if use_columnar:
@@ -237,11 +298,26 @@ class BoxDataset:  # boxlint: disable=BX403
     def _put_records(self, recs: List[SlotRecord]) -> None:
         """Route through cross-host shuffle when configured
         (ShuffleData, data_set.cc:2438-2545)."""
+        stat_add("ingest_ins_parsed", len(recs))
         if self.shuffler is not None and not flags.get_flag(
                 "dataset_disable_shuffle"):
-            self.shuffler.scatter(recs, self._channel)
+            with obs_span("ingest_shuffle"):
+                self.shuffler.scatter(recs, self._channel)
         else:
             self._channel.put_many(recs)
+
+    def _put_block(self, block) -> None:
+        """Columnar twin of _put_records (round 17): the whole parsed
+        block routes through the cross-host shuffle — vectorized hash
+        over rec_offsets, fancy-index split, per-destination sub-block
+        frames (ShufflerBase.scatter_block) — so shuffled jobs stay
+        zero-object end to end."""
+        if self.shuffler is not None and not flags.get_flag(
+                "dataset_disable_shuffle"):
+            with obs_span("ingest_shuffle"):
+                self.shuffler.scatter_block(block, self._channel)
+        else:
+            self._channel.put(block)
 
     def wait_preload_done(self) -> None:
         """WaitFeedPassDone half: join readers, drain merge
@@ -251,7 +327,8 @@ class BoxDataset:  # boxlint: disable=BX403
         flush_error: Optional[BaseException] = None
         try:
             if self.shuffler is not None:
-                self.shuffler.flush(self._channel)
+                with obs_span("ingest_shuffle_flush"):
+                    self.shuffler.flush(self._channel)
         except BaseException as e:
             # a dead peer must not leave the merge thread blocked on a
             # never-closed channel and the dataset stuck in "preload

@@ -142,6 +142,7 @@ class BatchPacker:
                     off += d
         if dropped:
             stat_add("packer_keys_dropped", dropped)
+        stat_add("ingest_ins_packed", n)
         batch = PackedBatch(keys=keys, slots=slots, segments=segments,
                             valid=valid, labels=labels, ins_valid=ins_valid,
                             dense=dense, n_ins=n, qvalues=qvalues,

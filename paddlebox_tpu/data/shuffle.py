@@ -10,12 +10,27 @@ ones are serialized into batches and sent point-to-point; received batches
 are deserialized into the same merge channel. The pass is complete when
 every peer has signalled done (wait_message_done analog).
 
-Two transports share the protocol:
+Three transports share the protocol:
   * `LocalShuffleGroup` — N in-process ranks wired by queues; the
     single-process fake for tests (the PsLocalClient pattern,
     distributed/ps/service/ps_local_client.h).
-  * `TcpShuffler` — length-prefixed framed messages over TCP sockets
-    between hosts (DCN); the PaddleShuffler analog.
+  * `TcpShuffler` — length-prefixed framed messages over ad-hoc TCP
+    sockets between hosts (DCN); the PaddleShuffler analog and the LOUD
+    fallback transport (`Fleet.make_shuffler`), exactly like
+    `hostplane=store`.
+  * `MeshShuffler` — round 17: shuffle frames ride the PERSISTENT p2p
+    host-plane mesh (`fleet/mesh_comm.py`, the PR-4 machinery) over
+    dedicated per-peer framed connections; frames carry cross-plane
+    trace ids.
+
+Two frame codecs share every transport (round 17): the legacy
+per-record codec below, and the zero-object COLUMNAR BLOCK codec
+(`data/block_shuffle.py` — header + raw column bytes, vectorized hash
+routing). `ShufflerBase._deliver` sniffs the frame magic, so the merge
+channel receives whatever the sender shuffled; the dataset's merge
+worker CONVERTS a codec mix (a rank-local downgrade or a split
+`shuffle_block_codec` flag) with a loud warning — degraded rate, never
+a dead cluster pass, never a silent conversion.
 """
 
 from __future__ import annotations
@@ -23,15 +38,30 @@ from __future__ import annotations
 import socket
 import struct
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from paddlebox_tpu.config import flags
+from paddlebox_tpu.data.block_shuffle import (BLOCK_MAGIC,
+                                              block_shuffle_dests,
+                                              deserialize_block,
+                                              serialize_block, split_block)
+from paddlebox_tpu.data.columnar import ColumnarBlock
 from paddlebox_tpu.data.slot_record import SlotRecord
+from paddlebox_tpu.utils.channel import register_depth_gauge
 from paddlebox_tpu.utils.rpc import recv_exact
 from paddlebox_tpu.utils.stats import stat_add
 
 _REC_MAGIC = 0x50425852  # "PBXR"
+
+
+class ShufflePeerUnreachable(ConnectionError):
+    """A shuffle peer could not be dialed within the bounded connect
+    timeout (`shuffle_connect_secs`) — named so a dead host fails the
+    pass load with the endpoint in the message instead of an anonymous
+    OS-default ~2-minute stall (the utils/rpc.py round-9 hygiene
+    applied to the shuffle transport)."""
 
 # ---------------------------------------------------------------------------
 # SlotRecord binary serialization (BinaryArchive analog, framework/archive.h;
@@ -130,10 +160,22 @@ class ShufflerBase:
         # pass epoch: frames are tagged so a fast peer's next-pass records
         # can't leak into this rank's still-draining current pass
         self.epoch = 0
-        self._inbox: Dict[int, List[SlotRecord]] = {}  # guarded-by: _inbox_lock
+        # parked items per epoch: SlotRecords (record codec, extended
+        # individually) and/or ColumnarBlocks (block codec, appended
+        # whole) — _deliver sniffs the frame magic
+        self._inbox: Dict[int, List[Union[SlotRecord, ColumnarBlock]]] = {}  # guarded-by: _inbox_lock
         self._inbox_lock = threading.Lock()
         self._done_from: Dict[int, set] = {}
         self._done_cv = threading.Condition()
+        # parked-inbox depth rides the same sampled gauge machinery as
+        # the dataset channels (chan_shuffle_inbox_depth, round 17)
+        register_depth_gauge("shuffle_inbox", self)
+
+    def __len__(self) -> int:
+        """Parked (not yet drained) shuffle items — the queue-pressure
+        view poll_depth_gauges samples at report cadence."""
+        with self._inbox_lock:
+            return sum(len(v) for v in self._inbox.values())
 
     # -- subclass transport hooks ------------------------------------------
     def _send(self, dest: int, payload: bytes) -> None:
@@ -144,15 +186,33 @@ class ShufflerBase:
 
     # -- receive side (called by transport threads) ------------------------
     def _deliver(self, payload: bytes, epoch: int) -> None:
-        recs = deserialize_records(payload)
-        with self._inbox_lock:
-            self._inbox.setdefault(epoch, []).extend(recs)
-        stat_add("shuffle_ins_received", len(recs))
+        """Deserialize one data frame into the epoch's inbox. The frame
+        magic selects the codec: block frames park as ONE ColumnarBlock
+        (zero per-record work), record frames as individual SlotRecords."""
+        (magic,) = struct.unpack_from("<I", payload, 0)
+        if magic == BLOCK_MAGIC:
+            block = deserialize_block(payload)
+            with self._inbox_lock:
+                self._inbox.setdefault(epoch, []).append(block)
+            n = block.n_recs
+        else:
+            recs = deserialize_records(payload)
+            with self._inbox_lock:
+                self._inbox.setdefault(epoch, []).extend(recs)
+            n = len(recs)
+        stat_add("shuffle_ins_received", n)
+        stat_add("shuffle_bytes_received", len(payload))
 
     def _peer_done(self, src: int, epoch: int) -> None:
         with self._done_cv:
             self._done_from.setdefault(epoch, set()).add(src)
             self._done_cv.notify_all()
+
+    def _send_payload(self, dest: int, payload: bytes) -> None:
+        """Wire-accounted send (both codecs, every transport)."""
+        self._send(dest, payload)
+        stat_add("shuffle_batches_sent", 1)
+        stat_add("shuffle_bytes_sent", len(payload))
 
     # -- dataset-facing API -------------------------------------------------
     def scatter(self, recs: Sequence[SlotRecord], channel) -> None:
@@ -172,10 +232,28 @@ class ShufflerBase:
                         to_send.append((dest, serialize_records(buf)))
                         self._out[dest] = []
         for dest, payload in to_send:
-            self._send(dest, payload)
-            stat_add("shuffle_batches_sent", 1)
+            self._send_payload(dest, payload)
         if local:
             channel.put_many(local)
+        self._drain_inbox(channel)
+
+    def scatter_block(self, block: ColumnarBlock, channel) -> None:
+        """Block-codec twin of scatter (round 17): ONE vectorized hash
+        over `rec_offsets` routes every record, a fancy-index split
+        yields per-destination sub-blocks, and each remote sub-block
+        ships as a single header+raw-columns frame — zero per-record
+        Python anywhere. Blocks are file-sized, so there is no
+        cross-call batching (`batch_records` applies to the record
+        codec only)."""
+        dests = block_shuffle_dests(block, self.world)
+        subs = split_block(block, dests, self.world)
+        for dest, sub in enumerate(subs):
+            if dest == self.rank or sub is None or not sub.n_recs:
+                continue
+            self._send_payload(dest, serialize_block(sub))
+        local = subs[self.rank]
+        if local is not None and local.n_recs:
+            channel.put(local)
         self._drain_inbox(channel)
 
     def _drain_inbox(self, channel) -> None:
@@ -195,7 +273,7 @@ class ShufflerBase:
                        for d, buf in enumerate(self._out) if buf]
             self._out = [[] for _ in range(self.world)]
         for dest, payload in pending:
-            self._send(dest, payload)
+            self._send_payload(dest, payload)
         for dest in range(self.world):
             if dest != self.rank:
                 self._send_done(dest)
@@ -324,8 +402,23 @@ class TcpShuffler(ShufflerBase):  # boxlint: disable=BX403
         with self._dest_locks[dest]:
             conn = self._conns.get(dest)
             if conn is None:
-                conn = socket.create_connection(self.endpoints[dest],
-                                                timeout=60.0)
+                # bounded dial + NODELAY (round-17 hygiene, the same fix
+                # PR 4 applied to utils/rpc.py): a dead peer raises the
+                # NAMED error within shuffle_connect_secs instead of the
+                # OS-default ~2-minute connect stall, and small done/
+                # remainder frames don't sit in Nagle's buffer behind a
+                # bulk send
+                host, port = self.endpoints[dest]
+                try:
+                    conn = socket.create_connection(
+                        (host, port),
+                        timeout=float(flags.get_flag(
+                            "shuffle_connect_secs")))
+                except OSError as e:
+                    raise ShufflePeerUnreachable(
+                        "shuffle peer %d unreachable at %s:%d: %r"
+                        % (dest, host, port, e)) from e
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 conn.settimeout(None)
                 self._conns[dest] = conn
             conn.sendall(frame)
@@ -348,3 +441,52 @@ class TcpShuffler(ShufflerBase):  # boxlint: disable=BX403
             except OSError:
                 pass
         self._conns.clear()
+
+
+# ---------------------------------------------------------------------------
+# p2p mesh transport (round 17): shuffle rides the persistent host plane
+# ---------------------------------------------------------------------------
+
+
+class MeshShuffler(ShufflerBase):
+    """Shuffle frames over the PERSISTENT p2p host-plane mesh
+    (`fleet/mesh_comm.py`) instead of the ad-hoc TcpShuffler sockets:
+    endpoints already rendezvous'd once through the store at mesh
+    bring-up, sends ride dedicated per-peer framed connections (never
+    the lockstep exchange clients), and every frame carries a
+    cross-plane trace id (round 14) so `tools/trace_stitch.py` can draw
+    the shuffle's cross-rank hops.
+
+    ONE MeshShuffler per MeshComm (the mesh has a single shuffle-frame
+    handler); reuse it across passes — the epoch tag keeps a fast
+    peer's next-pass frames parked. `close()` only unregisters the
+    handler: the mesh and its connections belong to the fleet."""
+
+    def __init__(self, mesh, batch_records: int = 512):
+        super().__init__(int(mesh.rank), int(mesh.world), batch_records)
+        self._mesh = mesh
+        mesh.set_shuffle_handler(self._on_frame)
+
+    def _on_frame(self, req: dict) -> None:
+        """Called from the mesh server's connection threads (and the
+        handler-registration drain of frames that arrived earlier)."""
+        mtype = int(req["mtype"])
+        if mtype == _MSG_DATA:
+            self._deliver(req["data"], int(req["epoch"]))
+        elif mtype == _MSG_DONE:
+            self._peer_done(int(req["from"]), int(req["epoch"]))
+        else:
+            raise ValueError("unknown shuffle frame type %r" % (mtype,))
+
+    def _send_frame(self, dest: int, mtype: int, payload: bytes) -> None:
+        self._mesh.send_shuffle(dest, {"mtype": mtype, "epoch": self.epoch,
+                                       "from": self.rank, "data": payload})
+
+    def _send(self, dest: int, payload: bytes) -> None:
+        self._send_frame(dest, _MSG_DATA, payload)
+
+    def _send_done(self, dest: int) -> None:
+        self._send_frame(dest, _MSG_DONE, b"")
+
+    def close(self) -> None:
+        self._mesh.set_shuffle_handler(None)

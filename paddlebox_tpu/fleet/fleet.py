@@ -213,17 +213,39 @@ class Fleet:
 
     # ------------------------------------------------------------ transports
     def make_shuffler(self, batch_records: int = 512, host: str = None,
-                      timeout: float = 120.0):
-        """Build this rank's TcpShuffler and rendezvous everyone's
-        (host, port) endpoints through the KV store (the PaddleShuffler
-        bring-up; endpoints replace the closed transport's MPI discovery).
-        Returns None in single-rank jobs."""
+                      timeout: float = 120.0, mesh=None):
+        """Build this rank's cross-host shuffle transport. Round 17:
+        under `hostplane=p2p` the shuffle rides the PERSISTENT mesh
+        (`MeshShuffler` over fleet/mesh_comm.py) — pass `mesh=` (or let
+        the fleet's already-rendezvous'd mesh serve; building a sharded
+        trainer first rendezvouses it with its owned positions, else
+        this call rendezvouses a position-less mesh COLLECTIVELY). When
+        the mesh is unavailable (collective bring-up fallback) or
+        `hostplane=store`, the ad-hoc `TcpShuffler` is built instead —
+        LOUDLY on the fallback path, exactly like the exchange plane's
+        store fallback. Endpoint rendezvous rides the KV store either
+        way (the PaddleShuffler MPI-discovery analog). Returns None in
+        single-rank jobs. Must be called by every rank in the same
+        collective order."""
+        import logging
         import os
 
-        from paddlebox_tpu.data.shuffle import TcpShuffler
+        from paddlebox_tpu.data.shuffle import MeshShuffler, TcpShuffler
+        from paddlebox_tpu.fleet.mesh_comm import resolve_hostplane
 
         if self.role.world <= 1:
             return None
+        if resolve_hostplane() == "p2p":
+            m = mesh if mesh is not None else self._mesh
+            if m is None:
+                m = self.make_mesh_comm(positions=(), timeout=timeout)
+            if m is not None:
+                return MeshShuffler(m, batch_records=batch_records)
+            logging.getLogger("paddlebox_tpu").warning(
+                "rank %d: p2p mesh unavailable for the instance shuffle "
+                "— falling back to the ad-hoc TCP shuffle transport "
+                "(collective; every rank reverts together)",
+                self.role.rank)
         host = host or self._my_host()
         sh = TcpShuffler(self.role.rank, self.role.world,
                          [(host, 0)] * self.role.world,
@@ -268,10 +290,19 @@ class Fleet:
             have = sorted(self._mesh.positions_of.get(self.role.rank, []))
             if have != sorted(int(p) for p in positions):
                 # fail HERE with construction context, not at the first
-                # per-step exchange deep inside the stager
+                # per-step exchange deep inside the stager. A cached
+                # POSITION-LESS mesh almost always means make_shuffler
+                # auto-rendezvous'd before the sharded trainer ran
+                # (round-17 review) — name the fix, not just the state
+                hint = (" — a position-less mesh was rendezvous'd "
+                        "earlier (make_shuffler's auto bring-up?); "
+                        "construct the sharded trainer BEFORE the "
+                        "shuffler, or pass its mesh to make_shuffler"
+                        if not have else "")
                 raise ValueError(
                     "make_mesh_comm: mesh already rendezvous'd for "
-                    "positions %s; requested %s" % (have, list(positions)))
+                    "positions %s; requested %s%s"
+                    % (have, list(positions), hint))
             if policy_id is not None and policy_id != self._mesh_policy:
                 # the cached mesh validated a DIFFERENT (or no) policy
                 # identity at rendezvous; the cross-rank agreement the

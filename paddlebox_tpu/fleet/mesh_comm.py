@@ -113,6 +113,18 @@ class MeshComm:
         # mark the shared EXCHANGE client broken (FramedClient never
         # reconnects) and take the data plane down with it
         self._obs_clients: Dict[int, FramedClient] = {}  # guarded-by: _conn_lock
+        # shuffle plane (round 17): bulk dataset-shuffle frames ride
+        # their OWN per-peer connections too — a file-sized block send
+        # must never sit in front of a lockstep exchange part on the
+        # shared socket (and a shuffle stall must not brick the data
+        # plane's client)
+        self._shuf_clients: Dict[int, FramedClient] = {}  # guarded-by: _conn_lock
+        self._shuf_handler = None      # guarded-by: _cv
+        # frames that arrived before the MeshShuffler registered (a
+        # peer's read threads can start scattering the moment ITS
+        # dataset preloads); drained through the handler at registration
+        self._shuf_pending: List[dict] = []  # guarded-by: _cv
+        self._shuf_seq = 0             # trace mint counter  # guarded-by: _cv
         # mesh-device positions each fleet rank owns (gathered at
         # rendezvous); lets the sharded a2a route destination shard d to
         # its owner rank without assuming fleet rank == jax process index
@@ -144,6 +156,20 @@ class MeshComm:
                 cap = max(64, 4 * self.world)
                 if len(self._obs_inbox) > cap:
                     del self._obs_inbox[:len(self._obs_inbox) - cap]
+            return True
+        if op == "shuf":
+            t0 = time.perf_counter()
+            with self._cv:
+                h = self._shuf_handler
+                if h is None:
+                    self._shuf_pending.append(req)
+            if h is not None:
+                # handler runs OUTSIDE _cv: it takes the shuffler's own
+                # locks and never blocks (inbox parking, no channel put)
+                h(req)
+            trace = req.get("trace")
+            record_span("mesh_recv_shuffle", t0, time.perf_counter(),
+                        trace=trace if isinstance(trace, int) else None)
             return True
         if op != "part":
             raise ValueError("unknown mesh op %r" % (op,))
@@ -216,6 +242,88 @@ class MeshComm:
         with self._cv:
             out, self._obs_inbox = self._obs_inbox, []
         return out
+
+    # ------------------------------------------------------- shuffle plane
+    def set_shuffle_handler(self, fn) -> None:
+        """Install (fn) or remove (None) the MeshShuffler's frame
+        handler. ONE handler per mesh — a second registration raises.
+        Frames that arrived before registration drain through the new
+        handler here, in arrival order.
+
+        Lifecycle contract (round-17 review): shuffler GENERATIONS on
+        one mesh are sequential — recreate only after the previous
+        generation's flush barrier completed cluster-wide (epoch
+        counters restart per shuffler, so a frame straddling two
+        generations would desynchronize the done-barrier; a peer still
+        mid-pass surfaces as ITS flush timeout). Frames parked at
+        UNREGISTER time belong to the dying generation and are dropped
+        LOUDLY here rather than silently replayed into the next one."""
+        with self._cv:
+            if fn is not None and self._shuf_handler is not None:
+                raise RuntimeError(
+                    "mesh rank %d already has a shuffle handler — one "
+                    "MeshShuffler per mesh" % self.rank)
+            self._shuf_handler = fn
+            pending, self._shuf_pending = self._shuf_pending, []
+        if fn is None:
+            if pending:
+                import logging
+                logging.getLogger("paddlebox_tpu").warning(
+                    "mesh rank %d: dropping %d shuffle frame(s) parked "
+                    "at shuffler close — a peer was still scattering "
+                    "into a torn-down shuffle (its flush will fail "
+                    "loudly)", self.rank, len(pending))
+            return
+        for req in pending:
+            fn(req)
+
+    def send_shuffle(self, to_rank: int, frame: dict) -> None:
+        """One shuffle frame to a peer's server over a DEDICATED
+        persistent connection (dialed lazily from the rendezvous'd
+        endpoint, re-dialed after a failure) — bulk block frames never
+        share a socket with the lockstep exchange. Raises on failure;
+        the dataset read worker surfaces it as the pass-load error.
+        Frames carry a cross-plane trace id (bits 62+61 namespace the
+        shuffle mint apart from both step ids and exchange mints)."""
+        with self._conn_lock:
+            c = self._shuf_clients.get(to_rank)
+            ep = self._endpoints.get(to_rank)
+        if c is None:
+            if ep is None:
+                raise ConnectionError(
+                    "mesh rank %d has no endpoint for shuffle peer %d"
+                    % (self.rank, to_rank))
+            # dial OUTSIDE _conn_lock (the send_obs discipline): a slow
+            # connect must not stall exchange-client lookups
+            c = FramedClient(ep[0], ep[1], plain_loads,
+                             timeout=self._op_timeout)
+            with self._conn_lock:
+                prev = self._shuf_clients.get(to_rank)
+                if prev is None:
+                    self._shuf_clients[to_rank] = c
+                else:           # lost a dial race; use the winner
+                    c.close()
+                    c = prev
+        trace = current_trace()
+        if trace is None:
+            with self._cv:
+                self._shuf_seq += 1
+                seq = self._shuf_seq
+            trace = (1 << 62) | (1 << 61) | step_trace_id(self.rank, seq)
+        t0 = time.perf_counter()
+        try:
+            c.call(dict(frame, op="shuf", trace=trace),
+                   op_timeout=self._op_timeout)
+        except (OSError, ConnectionError):
+            # drop the broken shuffle connection; the next frame
+            # re-dials (exchange + obs clients untouched)
+            with self._conn_lock:
+                if self._shuf_clients.get(to_rank) is c:
+                    del self._shuf_clients[to_rank]
+            c.close()
+            raise
+        record_span("mesh_send_shuffle", t0, time.perf_counter(),
+                    trace=trace)
 
     # ----------------------------------------------------------- rendezvous
     def rendezvous(self, store, namespace: str, advertise_host: str,
@@ -383,4 +491,7 @@ class MeshComm:
             for c in self._obs_clients.values():
                 c.close()
             self._obs_clients = {}
+            for c in self._shuf_clients.values():
+                c.close()
+            self._shuf_clients = {}
         self._server.stop()

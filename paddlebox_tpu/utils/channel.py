@@ -32,6 +32,16 @@ _named: dict = {}           # gauge name -> weakref.WeakSet[Channel]
 _named_lock = threading.Lock()
 
 
+def register_depth_gauge(name: str, obj) -> None:
+    """Register any __len__-bearing, weakref-able queue-like object under
+    gauge ``chan_<name>_depth`` (round 17: the shuffle transports' parked
+    inboxes ride the same sampled-depth machinery as Channels — depth is
+    read at report cadence only, never per op)."""
+    with _named_lock:
+        _named.setdefault("chan_%s_depth" % name,
+                          weakref.WeakSet()).add(obj)
+
+
 def poll_depth_gauges() -> None:
     """Sample every live named channel's depth into the stat registry
     (StepReporter calls this once per report assembly)."""
@@ -58,9 +68,7 @@ class Channel(Generic[T]):
         self._not_full = threading.Condition(self._mutex)
         self._closed = False  # guarded-by: _mutex
         if name:
-            with _named_lock:
-                _named.setdefault("chan_%s_depth" % name,
-                                  weakref.WeakSet()).add(self)
+            register_depth_gauge(name, self)
 
     # -- producer side -----------------------------------------------------
     def put(self, item: T) -> None:

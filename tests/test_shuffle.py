@@ -156,7 +156,11 @@ def test_dataset_disk_spill_and_reload(tmp_path, feed):
 
 def test_dataset_with_local_shuffler(tmp_path, feed):
     """Two in-process 'hosts' each read their file shard; after shuffle
-    every instance lands on the rank its hash selects."""
+    every instance lands on the rank its hash selects. Round 17: with
+    the native lib present this runs the COLUMNAR path end to end (the
+    block codec rides the same transport), so routing is asserted on
+    the merged block's vectorized hash."""
+    from paddlebox_tpu.data.block_shuffle import block_shuffle_dests
     files, gen_feed = write_synthetic_ctr_files(
         str(tmp_path), num_files=4, lines_per_file=50, num_slots=3,
         vocab_per_slot=30, seed=7)
@@ -182,5 +186,10 @@ def test_dataset_with_local_shuffler(tmp_path, feed):
     total = sum(len(ds) for ds in datasets)
     assert total == 200
     for r, ds in enumerate(datasets):
+        if ds._load_columnar:
+            assert ds.block is not None
+            np.testing.assert_array_equal(
+                block_shuffle_dests(ds.block, world),
+                np.full(len(ds), r, np.int64))
         for rec in ds.records:
             assert rec.shuffle_hash() % world == r

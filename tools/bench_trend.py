@@ -40,6 +40,9 @@ HEADLINES: List[Tuple[str, str, bool]] = [
     # pre-round-15 rounds compare as n/a, not as regressions)
     ("ckpt_save_keys_per_sec", "keys/s", True),
     ("ckpt_load_keys_per_sec", "keys/s", True),
+    # round-17 ingest plane: the cold-pass parse→shuffle→pack→train
+    # headline (absent pre-round-17 rounds compare as n/a)
+    ("ingest_cold_pass_examples_per_sec", "ex/s", True),
 ]
 
 

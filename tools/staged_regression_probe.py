@@ -54,6 +54,15 @@ FLOORS = {
     "policy_route_keys_per_sec": (20.1e6, 7e6),
     "parse_lines_per_sec": (722e3, 290e3),
     "pack_instances_per_sec": (722e3, 290e3),
+    # round-17: the zero-object shuffled ingest path's two new hot
+    # stages — the native columnar pass load in keys/s (read+merge at
+    # the probe's 16-slot shape) and the block shuffle codec+routing
+    # alone (hash + split + serialize/deserialize round trip, world 2).
+    # Recorded under the load guard on 2026-08-04 (load1 ~0.6; a fully
+    # co-tenant-loaded same-day run measured 11.3M/0.68M — the floors
+    # ride under both); floors = ~40% of recorded
+    "ingest_parse_keys_per_sec": (27.2e6, 10e6),
+    "ingest_shuffle_records_per_sec": (1.53e6, 600e3),
     # round-8: the uid-lean wire END TO END on CPU (host stage + H2D +
     # jitted scan + D2H, small DeepFM shape below) — guards the whole
     # staged path so a wire regression fails loud between tunnel windows.
@@ -333,6 +342,72 @@ def section_parse(rng, K):
            remeasure=lambda: measure()[1])
 
 
+def section_ingest(rng, K):
+    # --- ingest plane (round 17) -------------------------------------
+    # the native columnar parse (read+merge, keys/s of the whole pass
+    # load) and the block shuffle codec+routing ALONE (vectorized hash
+    # over rec_offsets + fancy-index split + header/raw-column
+    # serialize/deserialize at world 2, records/s) — guards the two new
+    # hot stages of the zero-object shuffled ingest path. The record
+    # codec it replaced measured ~25x slower at this shape (BASELINE.md
+    # round 17) — an algorithmic regression back toward per-record work
+    # lands far under these floors.
+    import tempfile
+
+    from paddlebox_tpu.data import BoxDataset, write_synthetic_ctr_files
+    from paddlebox_tpu.data.block_shuffle import (block_shuffle_dests,
+                                                  deserialize_block,
+                                                  serialize_block,
+                                                  split_block)
+    out = tempfile.mkdtemp()
+    files, feed = write_synthetic_ctr_files(
+        out, num_files=2, lines_per_file=6000, num_slots=16,
+        vocab_per_slot=5000, max_len=4, seed=2)
+    feed = type(feed)(slots=feed.slots, batch_size=512)
+
+    def load():
+        ds = BoxDataset(feed, read_threads=1)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        return ds
+
+    ds = load()                              # warm + the codec's input
+    if not ds._load_columnar:
+        report("ingest_parse_keys_per_sec", 0.0)
+        return
+    n_keys, n_recs = ds.block.n_keys, len(ds)
+
+    def m_parse():
+        t0 = time.perf_counter()
+        reps = 0
+        while time.perf_counter() - t0 < 4.0:
+            load()
+            reps += 1
+        return reps * n_keys / (time.perf_counter() - t0)
+
+    report("ingest_parse_keys_per_sec", m_parse(), remeasure=m_parse)
+    block = ds.block
+
+    def codec_once():
+        subs = split_block(block, block_shuffle_dests(block, 2), 2)
+        n = 0
+        for s in subs:
+            if s is not None:
+                n += deserialize_block(serialize_block(s)).n_recs
+        assert n == n_recs
+
+    def m_codec():
+        codec_once()                         # warm
+        t0 = time.perf_counter()
+        reps = 0
+        while time.perf_counter() - t0 < 3.0:
+            codec_once()
+            reps += 1
+        return reps * n_recs / (time.perf_counter() - t0)
+
+    report("ingest_shuffle_records_per_sec", m_codec(), remeasure=m_codec)
+
+
 def section_e2e(rng, K):
     # --- uid-lean wire e2e tier (round 8) ----------------------------
     # host stage (lookup + uid sort) + H2D + jitted scan + loss D2H over
@@ -512,6 +587,7 @@ SECTIONS = (
     ("policy_route", section_policy_route),
     ("p2p", section_p2p),
     ("parse", section_parse),
+    ("ingest", section_ingest),
     ("e2e", section_e2e),
     ("push", section_push),
     ("serving", section_serving),
