@@ -455,6 +455,118 @@ def measure(platform: str) -> None:
                     100.0 * (1.0 - ratio_med), 2),
                 "flight_records": records}
 
+    def quality_overhead(pairs: int = 7) -> dict:
+        """Round-18 acceptance block: the SAME paired-alternating
+        protocol as telemetry/flight_overhead, but the "on" arm runs
+        the QUALITY + OPS-ENDPOINT planes at their deployed shape — a
+        TaggedQuality fed every chunk from the real preds (the 'all'
+        stream + a 4-way tag split, per the trainers' feed), the slot
+        drift monitor observing a representative block per drive and
+        rolling at drive end, and a LIVE ObsExporter being scraped
+        every 0.5 s from a side thread — against everything-off.
+        Estimators identical (best-rate ratio headline, median pair
+        ratio conservative bound); the ≤2% bar is the acceptance
+        criterion."""
+        import threading
+        import urllib.request
+
+        from paddlebox_tpu.metrics import drift as _drift
+        from paddlebox_tpu.metrics import quality as _qmod
+        from paddlebox_tpu.metrics.quality import TaggedQuality
+        from paddlebox_tpu.obs.exporter import ObsExporter
+
+        rng = np.random.RandomState(11)
+        fake_tags = rng.randint(0, 4, CHUNK * BATCH)
+        fake_labels = (rng.rand(CHUNK * BATCH) < 0.2).astype(np.int64)
+        qual = TaggedQuality(table_size=65536)
+        _qmod.set_active(qual)
+        monitor = _drift.set_active_new()
+        # a representative 4-slot ingest block (the per-pass observe)
+        from paddlebox_tpu.data.columnar import ColumnarBlock
+        n_obs = BATCH
+        obs_block = ColumnarBlock.from_key_rec(
+            rng.randint(1, 1 << 20, n_obs * 8).astype(np.uint64),
+            np.tile(np.arange(4, dtype=np.int32), n_obs * 2),
+            np.repeat(np.arange(n_obs, dtype=np.int64), 8),
+            fake_labels[:n_obs].astype(np.int32))
+        exp = ObsExporter(port=0)
+        scrape_n = [0]
+
+        def scraper(stop: threading.Event):
+            # 0.5s cadence: ~30x denser than a production Prometheus
+            # scrape (10-15s) but not so dense that the scraper thread's
+            # GIL share dominates the measurement on a 1-core container
+            # (a 0.1s first cut measured the scraper, not the planes)
+            url = "http://127.0.0.1:%d/metrics" % exp.port
+            while not stop.wait(0.5):
+                try:
+                    with urllib.request.urlopen(url, timeout=5) as r:
+                        r.read()
+                    scrape_n[0] += 1
+                except OSError:
+                    pass
+
+        def on_chunk(lo, group, losses_np, preds):
+            pred = np.clip(np.asarray(
+                next(iter(preds.values()))).reshape(-1), 0.0, 1.0)
+            n = pred.size
+            tensors = {"pred": pred, "label": fake_labels[:n]}
+            qual.add_batch(tensors)
+            qual.add_tagged(pred, fake_labels[:n], fake_tags[:n],
+                            prefix="tag:")
+            _drift.observe_preds(pred)
+
+        def run_arm(on: bool) -> float:
+            # the scraper runs ONLY during the "on" arm: scraping both
+            # arms would cancel the scrape cost out of the on/off ratio
+            # and the block would no longer bound what it claims to
+            stop = threading.Event()
+            th = None
+            if on:
+                monitor.observe_block(obs_block)
+                th = threading.Thread(target=scraper, args=(stop,),
+                                      daemon=True)
+                th.start()
+            try:
+                return run_e2e(tg=1, runs=1, n_chunks=2,
+                               on_chunk=on_chunk if on else None
+                               )["examples_per_sec"]
+            finally:
+                if on:
+                    stop.set()
+                    th.join(timeout=2.0)
+                    monitor.roll()
+
+        rates_on, rates_off, ratios = [], [], []
+        try:
+            for i in range(pairs):
+                if i % 2:
+                    off = run_arm(False)
+                    on = run_arm(True)
+                else:
+                    on = run_arm(True)
+                    off = run_arm(False)
+                rates_on.append(on)
+                rates_off.append(off)
+                ratios.append(on / max(off, 1e-9))
+        finally:
+            exp.close()
+            _qmod.set_active(None)
+            _drift.set_active(None)
+        ratio_best = float(max(rates_on) / max(max(rates_off), 1e-9))
+        ratio_med = float(np.median(ratios))
+        return {"examples_per_sec_on": round(float(np.median(rates_on)), 1),
+                "examples_per_sec_off": round(float(np.median(rates_off)),
+                                              1),
+                "runs_on": [round(r, 1) for r in rates_on],
+                "runs_off": [round(r, 1) for r in rates_off],
+                "pair_ratios": [round(r, 4) for r in ratios],
+                "overhead_pct": round(100.0 * (1.0 - ratio_best), 2),
+                "overhead_pct_median_pair": round(
+                    100.0 * (1.0 - ratio_med), 2),
+                "scrapes_during_block": scrape_n[0],
+                "quality_tags": len(qual.report()["tags"])}
+
     tiers = {
         "grouped": run_e2e(tg=4),
         "ungrouped": run_e2e(tg=1),
@@ -484,6 +596,13 @@ def measure(platform: str) -> None:
         flight = flight_overhead()
     except Exception as e:  # noqa: BLE001 — diagnostic tier, not the metric
         flight = {"error": repr(e)[:300]}
+
+    # round-18: quality-metric + ops-endpoint overhead under live
+    # scrapes (≤2% target, recorded in BASELINE.md round 18). GUARDED.
+    try:
+        quality = quality_overhead()
+    except Exception as e:  # noqa: BLE001 — diagnostic tier, not the metric
+        quality = {"error": repr(e)[:300]}
 
     # pass-amortized tier (round-6): the full begin_feed → train →
     # end_pass lifecycle at 0% and ~90% working-set overlap, full vs
@@ -918,6 +1037,7 @@ def measure(platform: str) -> None:
             "cold_pass_examples_per_sec", 0),
         "telemetry_overhead": telemetry,
         "flight_overhead": flight,
+        "quality_overhead": quality,
         "compile_warmup_s": round(t_compile, 1),
     }))
 
@@ -1037,6 +1157,7 @@ def main() -> None:
             "ingest_cold_pass_examples_per_sec", 0),
         "telemetry_overhead": result.get("telemetry_overhead"),
         "flight_overhead": result.get("flight_overhead"),
+        "quality_overhead": result.get("quality_overhead"),
         "hostplane": hostplane,
         "compile_warmup_s": result.get("compile_warmup_s"),
         "diags": diags,

@@ -472,6 +472,59 @@ define_flag("ckpt_xbox_columnar", True,
             "delta-refresh staleness drops by the pickle->columnar "
             "re-encode. Off = the legacy pkl views (readers handle "
             "both, mixed histories compose)")
+define_flag("obs_http_port", 0,
+            "per-rank live ops HTTP endpoint (obs/exporter.py, round "
+            "18): every rank (and every serving replica, whose replica "
+            "index is its rank) binds 127.0.0.1:<port + rank> and "
+            "serves /metrics (Prometheus text exposition of the "
+            "StatRegistry counters/gauges/histograms + quality-plane "
+            "auc/copc/ctr), /report (latest StepReport; rank 0 adds "
+            "the merged cluster report), /health (rank 0: per-rank "
+            "cluster health scores), /stacks (every thread's stack), "
+            "/flight (black-box segment list + tail) and /quality — "
+            "all answered from defensive snapshots, never a training "
+            "lock. A port already in use warns and disables the "
+            "endpoint. 0 = off (zero cost)")
+define_flag("quality_metrics", True,
+            "tagged quality-metric plane (metrics/quality.py, round "
+            "18): the trainers stream per-tag masked AUC (the 'all' "
+            "stream, per-cmatch tags, per-task heads), COPC (click "
+            "over predicted click — the calibration alarm), actual/"
+            "predicted CTR per tag AND per slot into sum-mergeable "
+            "bucket tables (MetricMsg parity with the reference's "
+            "tagged metric family); pass_end reports carry the "
+            "computed bundle, multi-process runs ship the raw state "
+            "so rank 0 merges a cluster-wide quality report, and the "
+            "quality_auc/quality_copc gauges feed the health plane. "
+            "Off = no quality adds (zero cost)")
+define_flag("quality_table_size", 65536,
+            "bucket count of each tagged quality AUC table (the "
+            "BasicAucCalculator table_size role; the reference uses "
+            "1<<20 — 65536 keeps per-tag memory at 1 MB and the "
+            "pass_end state wire compact while holding AUC resolution "
+            "to ~1.5e-5 of pred space). Every rank must use the same "
+            "value: cluster merge refuses mismatched table sizes")
+define_flag("data_quality", True,
+            "slot-level data-quality drift monitor (metrics/drift.py, "
+            "round 18): the columnar ingest plane accumulates per-slot "
+            "coverage, keys/record and a distinct-key sketch per "
+            "report window (one bincount over key_slot per block) "
+            "plus label/pred histograms; each pass_end rolls the "
+            "window against a rolling reference and publishes the "
+            "data_drift_score / data_dropped_slots gauges the cluster "
+            "HealthMonitor penalizes — a dropped upstream slot or a "
+            "calibration blow-up turns the rank unhealthy through the "
+            "same plane the elastic fleet triggers on. Off = no "
+            "monitoring (zero cost)")
+define_flag("data_quality_warn", 0.5,
+            "drift-score warn threshold in [0, 1]: a rolled window "
+            "whose worst per-slot departure (coverage drop, keys/"
+            "record drift, cardinality collapse) or label/pred "
+            "distribution drift reaches this logs a warning on the "
+            "victim rank, and rank 0's HealthMonitor scores any rank "
+            "whose data_drift_score gauge is past it -0.6 — past the "
+            "0.5 healthy bar on its own (flag 'data_drift' in the "
+            "cluster_health record)")
 define_flag("preload_promote", True,
             "overlap the NEXT pass's host-side promote work (key diff + "
             "host-store reads for non-resident keys) with the current "

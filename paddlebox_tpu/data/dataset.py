@@ -275,6 +275,15 @@ class BoxDataset:  # boxlint: disable=BX403
                     t.pause()
                 if use_columnar:
                     self._block = ColumnarBlock.concat(blocks)
+                    if self._block.n_recs:
+                        # slot-level data-quality monitor (round 18,
+                        # flag data_quality): one vectorized pass over
+                        # the merged block's columns on this merge
+                        # thread — the runners roll the window at
+                        # pass_end (metrics/drift.py)
+                        from paddlebox_tpu.metrics import drift as _drift
+                        with obs_span("ingest_quality"):
+                            _drift.observe_block(self._block)
                 return
             except BaseException as e:
                 self._load_error = e

@@ -25,6 +25,27 @@ _RELATIVE_ERROR_BOUND = 0.05  # kRelativeErrorBound
 _MAX_SPAN = 0.01              # kMaxSpan
 
 
+def trapezoid_auc(table: np.ndarray):
+    """Trapezoid accumulation from the top bucket down over a [2, T]
+    neg/pos bucket table (metrics.cc:273-343): returns ``(auc, fp, tp)``
+    with auc = -0.5 for one-class/empty tables (the reference's
+    degenerate convention). The ONE implementation shared by
+    BasicAucCalculator.compute and the tagged quality plane
+    (metrics/quality.py) — their bit-parity is by construction, not by
+    duplicated code."""
+    neg_rev = table[0][::-1]
+    pos_rev = table[1][::-1]
+    fp_cum = np.cumsum(neg_rev)
+    tp_cum = np.cumsum(pos_rev)
+    tp_prev = tp_cum - pos_rev
+    area = float(np.sum(neg_rev * (tp_prev + tp_cum) / 2.0))
+    fp = float(fp_cum[-1]) if fp_cum.size else 0.0
+    tp = float(tp_cum[-1]) if tp_cum.size else 0.0
+    if fp < 1e-3 or tp < 1e-3:
+        return -0.5, fp, tp     # all nonclick or all click
+    return area / (fp * tp), fp, tp
+
+
 class BasicAucCalculator:
     """Bucketed streaming AUC with box semantics.
 
@@ -194,20 +215,8 @@ class BasicAucCalculator:
             if allreduce is not None:
                 table = allreduce(table.copy())
 
-            # trapezoid from the top bucket down
-            neg_rev = table[0][::-1]
-            pos_rev = table[1][::-1]
-            fp_cum = np.cumsum(neg_rev)
-            tp_cum = np.cumsum(pos_rev)
-            tp_prev = tp_cum - pos_rev
-            area = float(np.sum(neg_rev * (tp_prev + tp_cum) / 2.0))
-            fp = float(fp_cum[-1]) if fp_cum.size else 0.0
-            tp = float(tp_cum[-1]) if tp_cum.size else 0.0
-
-            if fp < 1e-3 or tp < 1e-3:
-                self._auc = -0.5  # all nonclick or all click
-            else:
-                self._auc = area / (fp * tp)
+            # trapezoid from the top bucket down (shared helper)
+            self._auc, fp, tp = trapezoid_auc(table)
 
             local = np.array(
                 [self._local_abserr, self._local_sqrerr, self._local_pred],

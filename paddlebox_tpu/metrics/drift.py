@@ -1,0 +1,372 @@
+"""Slot-level data-quality drift monitor over the columnar ingest plane.
+
+The two production failures a CTR fleet is blind to without this tier:
+a broken upstream feature pipeline (a slot silently stops arriving, the
+model keeps training on zeros and AUC decays a day later) and
+miscalibration (the pred distribution walks away from the labels). The
+reference's monitor tier watches exactly these (its data_feed slot
+statistics + the COPC alarm of the metric tier); here the signals are
+computed VECTORIZED from each pass's merged ``ColumnarBlock`` — one
+``bincount`` over ``key_slot`` (+ one ``np.unique`` over the
+(record, slot) pairs) per block, so the monitor costs microseconds per
+million keys and rides the ingest thread that built the block anyway.
+
+Per report window (a window = one observed pass load; ``roll()`` is
+called by the runners at pass_end) the monitor derives, per slot:
+
+  * coverage      — fraction of records carrying >=1 key in the slot
+  * keys/record   — mean keys per covered record
+  * cardinality   — distinct-key estimate from a per-slot linear-count
+                    bitmap sketch (fixed 2^11 bits: estimate
+                    -B*ln(1-fill), exact when fill is low)
+
+plus the label positive rate and (fed from the trainers' metric path)
+a fixed-bin pred histogram. The DRIFT SCORE of a window is the worst
+relative departure of any component from the rolling reference (the
+mean of the last ``history`` healthy-ish windows), in [0, 1]; slots
+whose coverage collapses below 10% of reference are named in
+``dropped_slots``. ``roll()`` publishes ``data_drift_score`` /
+``data_dropped_slots`` gauges into the StatRegistry — they ride every
+StepReport to rank 0, where the cluster HealthMonitor (obs/health.py)
+scores a drifting rank unhealthy through the exact plane the elastic
+fleet triggers on.
+
+numpy+stdlib only; the module-level hooks are near-free when the flag
+is off (one global read).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+#: per-slot linear-counting sketch bits (2 KiB of bools per slot seen)
+SKETCH_BITS = 2048
+#: pred-histogram bins over [0, 1]
+PRED_BINS = 32
+#: records observed per block: larger blocks are SAMPLED (evenly
+#: strided select) so the monitor's cost is CONSTANT per pass instead
+#: of proportional to pass size — coverage of a dropped slot reads 0
+#: at any sample size, and drift ratios compare windows sampled
+#: identically. At 4096 records the whole observe is ~2-4 ms.
+SAMPLE_RECS = 4096
+
+
+def _hash_u64(keys: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer, vectorized (uint64 wraparound is the hash)."""
+    k = keys.astype(np.uint64, copy=True)
+    k ^= k >> np.uint64(30)
+    k *= np.uint64(0xBF58476D1CE4E5B9)
+    k ^= k >> np.uint64(27)
+    k *= np.uint64(0x94D049BB133111EB)
+    k ^= k >> np.uint64(31)
+    return k
+
+
+class _Window:
+    """One report window's raw accumulators (grown to the max slot id)."""
+
+    def __init__(self) -> None:
+        self.n_recs = 0
+        self.slot_keys = np.zeros(0, np.int64)
+        self.slot_recs = np.zeros(0, np.int64)
+        self.sketch: Dict[int, np.ndarray] = {}     # slot -> bool[SKETCH_BITS]
+        self.label_pos = 0.0
+        self.label_n = 0.0
+        self.pred_hist = np.zeros(PRED_BINS, np.int64)
+
+    def _grow(self, n: int) -> None:
+        if n <= self.slot_keys.size:
+            return
+        for name in ("slot_keys", "slot_recs"):
+            old = getattr(self, name)
+            new = np.zeros(n, np.int64)
+            new[:old.size] = old
+            setattr(self, name, new)
+
+    # ------------------------------------------------------------ summary
+    def summary(self) -> dict:
+        ns = self.slot_keys.size
+        cov = (self.slot_recs / max(self.n_recs, 1)).astype(np.float64)
+        kpr = np.where(self.slot_recs > 0,
+                       self.slot_keys / np.maximum(self.slot_recs, 1), 0.0)
+        card = np.zeros(ns, np.float64)
+        for s, bits in self.sketch.items():
+            fill = float(bits.sum()) / SKETCH_BITS
+            # linear counting; a saturated sketch reports its ceiling
+            card[s] = (-SKETCH_BITS * np.log(max(1.0 - fill, 1e-9))
+                       if fill < 1.0 else SKETCH_BITS * 20.0)
+        ph = self.pred_hist.astype(np.float64)
+        tot = ph.sum()
+        return {"n_recs": int(self.n_recs),
+                "coverage": cov, "keys_per_rec": kpr, "cardinality": card,
+                "label_rate": (self.label_pos / self.label_n
+                               if self.label_n else 0.0),
+                "pred_hist": (ph / tot if tot else ph)}
+
+
+class SlotDriftMonitor:
+    """Thread contract: observe_* may come from ingest/driver threads
+    (one lock); roll() from the pass driver; snapshot() from the HTTP
+    exporter (reads under the same short lock, no training locks)."""
+
+    def __init__(self, history: int = 4, drift_warn: Optional[float] = None,
+                 min_coverage: float = 0.01) -> None:
+        if drift_warn is None:
+            from paddlebox_tpu.config import flags
+            drift_warn = float(flags.get_flag("data_quality_warn"))
+        self.drift_warn = float(drift_warn)
+        self.history = int(history)
+        self.min_coverage = float(min_coverage)
+        self._lock = threading.Lock()
+        self._cur = _Window()                # guarded-by: _lock
+        self._ref: List[dict] = []           # guarded-by: _lock
+        self.last_roll: Optional[dict] = None
+        self.windows = 0
+
+    # ------------------------------------------------------------- observe
+    def observe_block(self, block) -> None:
+        """One merged ColumnarBlock (or sub-block) of the ingest plane —
+        a single vectorized pass over (a bounded sample of) its columns.
+        Blocks past SAMPLE_RECS records are evenly strided down so the
+        cost per pass is constant, not pass-size-proportional."""
+        n_recs = int(block.n_recs)
+        if n_recs == 0:
+            return
+        if n_recs > SAMPLE_RECS:
+            idx = np.linspace(0, n_recs - 1, SAMPLE_RECS).astype(np.int64)
+            block = block.select(idx)
+            n_recs = SAMPLE_RECS
+        key_slot = np.asarray(block.key_slot)
+        ns = int(key_slot.max()) + 1 if key_slot.size else 0
+        counts = (np.bincount(key_slot, minlength=ns).astype(np.int64)
+                  if key_slot.size else np.zeros(0, np.int64))
+        # records covered per slot: O(K) presence scatter into a
+        # [n_recs, ns] bool plane — NO sort (an np.unique over the
+        # (rec, slot) pairs measured ~2x the native parse itself at the
+        # probe shape; the whole observe must stay a small fraction of
+        # the load it rides)
+        if key_slot.size:
+            rec = np.repeat(np.arange(n_recs, dtype=np.int64),
+                            np.diff(np.asarray(block.rec_offsets)))
+            pres = np.zeros(n_recs * ns, bool)
+            pres[rec * ns + key_slot] = True
+            prec = pres.reshape(n_recs, ns).sum(
+                axis=0, dtype=np.int64)
+            hashed = _hash_u64(np.asarray(block.keys))
+            bit = (hashed % np.uint64(SKETCH_BITS)).astype(np.int64)
+            # sketch bits the same way: one O(K) scatter into a flat
+            # [ns * SKETCH_BITS] bool plane, OR-merged per slot below
+            sk = np.zeros(ns * SKETCH_BITS, bool)
+            sk[key_slot.astype(np.int64) * SKETCH_BITS + bit] = True
+            sk = sk.reshape(ns, SKETCH_BITS)
+        labels = np.asarray(block.labels)
+        pos = float((labels != 0).sum())
+        with self._lock:
+            w = self._cur
+            w.n_recs += n_recs
+            w.label_pos += pos
+            w.label_n += float(labels.size)
+            if ns:
+                w._grow(ns)
+                w.slot_keys[:ns] += counts
+                w.slot_recs[:ns] += prec
+                for s in np.nonzero(counts)[0].tolist():
+                    bits = w.sketch.get(s)
+                    if bits is None:
+                        w.sketch[s] = sk[s].copy()
+                    else:
+                        bits |= sk[s]
+
+    def observe_preds(self, pred, mask=None) -> None:
+        """Pred-distribution histogram (fed from the trainers' metric
+        path — the calibration half of the drift signal)."""
+        pred = np.asarray(pred, np.float64).reshape(-1)
+        if mask is not None:
+            pred = pred[np.asarray(mask).reshape(-1).astype(bool)]
+        if pred.size == 0:
+            return
+        idx = np.clip((pred * PRED_BINS).astype(np.int64), 0,
+                      PRED_BINS - 1)
+        hist = np.bincount(idx, minlength=PRED_BINS)
+        with self._lock:
+            self._cur.pred_hist += hist
+
+    # ---------------------------------------------------------------- roll
+    def _drift_against(self, cur: dict, ref: dict) -> dict:
+        """Worst-component relative departure, each clamped to [0, 1]."""
+        ns = max(cur["coverage"].size, ref["coverage"].size)
+
+        def pad(v):
+            out = np.zeros(ns, np.float64)
+            out[:v.size] = v
+            return out
+
+        ccov, rcov = pad(cur["coverage"]), pad(ref["coverage"])
+        ckpr, rkpr = pad(cur["keys_per_rec"]), pad(ref["keys_per_rec"])
+        ccard, rcard = pad(cur["cardinality"]), pad(ref["cardinality"])
+        watch = rcov >= self.min_coverage
+        per_slot = np.zeros(ns, np.float64)
+        if watch.any():
+            cov_drop = np.clip((rcov - ccov) / np.maximum(rcov, 1e-9),
+                               0.0, 1.0)
+            kpr_drift = np.clip(np.abs(ckpr - rkpr)
+                                / np.maximum(rkpr, 1e-9), 0.0, 1.0)
+            card_drop = np.clip(1.0 - ccard / np.maximum(rcard, 1e-9),
+                                0.0, 1.0)
+            per_slot = np.where(
+                watch, np.maximum(cov_drop,
+                                  np.maximum(kpr_drift, card_drop)), 0.0)
+        dropped = np.nonzero(watch & (ccov < 0.1 * rcov))[0].tolist()
+        label_drift = float(min(abs(cur["label_rate"] - ref["label_rate"])
+                                / max(ref["label_rate"], 1e-9), 1.0))
+        pred_drift = 0.0
+        if cur["pred_hist"].sum() > 0 and ref["pred_hist"].sum() > 0:
+            # total variation distance between the pred distributions
+            pred_drift = float(
+                0.5 * np.abs(cur["pred_hist"] - ref["pred_hist"]).sum())
+        score = float(max(per_slot.max() if ns else 0.0,
+                          label_drift, pred_drift))
+        worst = int(np.argmax(per_slot)) if ns and per_slot.max() > 0 else -1
+        return {"score": round(score, 4),
+                "dropped_slots": dropped,
+                "worst_slot": worst,
+                "label_drift": round(label_drift, 4),
+                "pred_drift": round(pred_drift, 4)}
+
+    @staticmethod
+    def _ref_mean(refs: List[dict]) -> dict:
+        ns = max(r["coverage"].size for r in refs)
+
+        def mean(key):
+            acc = np.zeros(ns, np.float64)
+            for r in refs:
+                v = r[key]
+                acc[:v.size] += v
+            return acc / len(refs)
+
+        ph = np.zeros(PRED_BINS, np.float64)
+        for r in refs:
+            ph += r["pred_hist"]
+        return {"coverage": mean("coverage"),
+                "keys_per_rec": mean("keys_per_rec"),
+                "cardinality": mean("cardinality"),
+                "label_rate": float(np.mean([r["label_rate"]
+                                             for r in refs])),
+                "pred_hist": ph / len(refs)}
+
+    def roll(self) -> Optional[dict]:
+        """Close the current window: drift vs the rolling reference,
+        gauges published, reference advanced. Returns the window's
+        quality record (None when nothing was observed — an eval-only
+        pass must not dilute the reference)."""
+        with self._lock:
+            w, self._cur = self._cur, _Window()
+            if w.n_recs == 0 and w.pred_hist.sum() == 0:
+                return None
+            cur = w.summary()
+            refs = list(self._ref)
+            self.windows += 1
+            win_idx = self.windows
+        if refs:
+            drift = self._drift_against(cur, self._ref_mean(refs))
+        else:
+            # first window IS the reference — no departure to measure
+            drift = {"score": 0.0, "dropped_slots": [], "worst_slot": -1,
+                     "label_drift": 0.0, "pred_drift": 0.0}
+        rec = {
+            "window": win_idx,
+            "ts": time.time(),
+            "n_recs": cur["n_recs"],
+            "n_slots": int(cur["coverage"].size),
+            "label_rate": round(cur["label_rate"], 6),
+            "drift": drift,
+        }
+        with self._lock:
+            # drifting windows still enter the reference (a persistent
+            # upstream change becomes the new normal after `history`
+            # windows instead of alarming forever), bounded deque
+            self._ref.append(cur)
+            if len(self._ref) > self.history:
+                self._ref.pop(0)
+            self.last_roll = rec
+        from paddlebox_tpu.utils.stats import gauge_set
+        gauge_set("data_drift_score", drift["score"])
+        gauge_set("data_dropped_slots", float(len(drift["dropped_slots"])))
+        if drift["score"] >= self.drift_warn:
+            from paddlebox_tpu.obs import log as obs_log
+            obs_log.warning(
+                "data-quality drift past warn threshold",
+                score=drift["score"], warn=self.drift_warn,
+                dropped_slots=str(drift["dropped_slots"][:8]),
+                worst_slot=drift["worst_slot"])
+        return rec
+
+    def snapshot(self) -> dict:
+        """Exporter surface: the last rolled record + the live window's
+        size (defensive copies only)."""
+        with self._lock:
+            import copy
+            return {"windows": self.windows,
+                    "live_recs": int(self._cur.n_recs),
+                    "last": copy.deepcopy(self.last_roll)}
+
+
+# ------------------------------------------------------------- module API
+_ACTIVE: Optional[SlotDriftMonitor] = None
+
+
+def active() -> Optional[SlotDriftMonitor]:
+    return _ACTIVE
+
+
+def set_active(m: Optional[SlotDriftMonitor]) -> Optional[SlotDriftMonitor]:
+    global _ACTIVE
+    prev, _ACTIVE = _ACTIVE, m
+    return prev
+
+
+def observe_block(block) -> None:
+    """Ingest-plane hook (data/dataset.py calls it once per merged pass
+    block): lazily builds the flag-gated monitor on first data. Never
+    raises — a monitoring bug must not kill the pass load it rides."""
+    try:
+        m = _ACTIVE
+        if m is None:
+            from paddlebox_tpu.config import flags
+            if not bool(flags.get_flag("data_quality")):
+                return
+            m = set_active_new()
+        m.observe_block(block)
+    except Exception as e:  # noqa: BLE001 — telemetry degrades, never kills
+        from paddlebox_tpu.obs import log as obs_log
+        obs_log.warning("data-quality observe failed",
+                        error=repr(e)[:200])
+
+
+def observe_preds(pred, mask=None) -> None:
+    m = _ACTIVE
+    if m is not None:
+        m.observe_preds(pred, mask=mask)
+
+
+def set_active_new() -> SlotDriftMonitor:
+    global _ACTIVE
+    _ACTIVE = SlotDriftMonitor()
+    return _ACTIVE
+
+
+def roll_gauges() -> Optional[dict]:
+    """Pass-end hook for the runners: close the window, publish gauges,
+    return the quality record for the pass_end report extra. Never
+    raises — same degrade contract as observe_block."""
+    try:
+        m = _ACTIVE
+        return m.roll() if m is not None else None
+    except Exception as e:  # noqa: BLE001 — telemetry degrades, never kills
+        from paddlebox_tpu.obs import log as obs_log
+        obs_log.warning("data-quality roll failed", error=repr(e)[:200])
+        return None

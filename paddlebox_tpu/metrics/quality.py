@@ -1,0 +1,451 @@
+"""Tagged quality metrics: per-tag masked AUC, COPC, actual/predicted CTR.
+
+MetricMsg-parity port of the reference's tagged multi-task metric family
+(paddle/fluid/framework/fleet/metrics.{h,cc}: CmatchRank/MultiTask
+MetricMsg + the COPC and ctr fields of get_metric_msg): every tag owns a
+``[2, table_size]`` float64 pos/neg bucket table — EXACTLY the
+BasicAucCalculator layout, with the same bucketing arithmetic
+(``min(int(pred*T), T-1)``, metrics.cc add-data kernels) — plus the five
+scalar accumulators (abserr, sqrerr, pred_sum, click_sum, n). Everything
+is SUM-MERGEABLE: two ranks' states merge by elementwise addition, which
+is how the cluster plane composes a fleet-wide quality report for free
+(obs/aggregate.py merges ``quality_state`` extras shipped at pass_end
+through the existing piggyback transport; the same table sum the
+reference runs as an MPI allreduce in Metric::calculate).
+
+The metrics this plane computes per tag (and per slot, fed from the
+batch's slot columns):
+
+  * auc            — trapezoid over the bucket table, BasicAucCalculator
+                     parity (degenerate one-class windows read -0.5,
+                     metrics.cc:273-343's convention)
+  * copc           — Click Over Predicted Click = sum(label)/sum(pred),
+                     THE production calibration alarm (a healthy
+                     calibrated CTR model holds copc ~ 1.0; a blown-up
+                     tower or broken feature drives it off fast)
+  * actual_ctr / predicted_ctr, mae, rmse, size — the get_metric_msg
+                     bundle
+
+Import surface is numpy+stdlib only (the obs exporter serves these from
+jax-free processes).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+STATE_VERSION = 1
+
+#: tag used for the unmasked all-instances stream
+ALL_TAG = "all"
+
+
+def table_auc(table: np.ndarray) -> float:
+    """Trapezoid AUC from a [2, T] pos/neg bucket table — delegates to
+    THE trapezoid (metrics/auc.trapezoid_auc, the exact float64 op
+    sequence of BasicAucCalculator.compute), so the tagged plane is
+    bit-identical to the untagged one by construction. Returns -0.5 for
+    one-class/empty tables."""
+    from paddlebox_tpu.metrics.auc import trapezoid_auc
+    return trapezoid_auc(np.asarray(table, np.float64))[0]
+
+
+class TaggedQuality:
+    """The tagged quality plane of one rank.
+
+    Thread contract: add_* / report / state are lock-serialized (the
+    trainer driver feeds adds; the HTTP exporter may call report() from
+    a handler thread — readers hold the lock only for snapshot COPIES
+    and run the AUC math outside it, so a scrape storm can never stall
+    the add path, and nothing here touches any training lock).
+    """
+
+    #: scalar accumulator layout per tag
+    _S_ABSERR, _S_SQRERR, _S_PRED, _S_CLICK, _S_N = range(5)
+
+    def __init__(self, table_size: Optional[int] = None) -> None:
+        if table_size is None:
+            from paddlebox_tpu.config import flags
+            table_size = int(flags.get_flag("quality_table_size"))
+        self.table_size = int(table_size)
+        self._lock = threading.Lock()
+        self._tables: Dict[str, np.ndarray] = {}    # guarded-by: _lock
+        self._scalars: Dict[str, np.ndarray] = {}   # guarded-by: _lock
+        # per-slot ctr accumulators, grown on demand: [n_slots] each
+        self._slot_click = np.zeros(0, np.float64)  # guarded-by: _lock
+        self._slot_pred = np.zeros(0, np.float64)   # guarded-by: _lock
+        self._slot_n = np.zeros(0, np.float64)      # guarded-by: _lock
+
+    # ------------------------------------------------------------ helpers
+    def _tag_state_locked(self, tag: str):  # boxlint: disable=BX401 — caller holds _lock (the *_locked contract)
+        tab = self._tables.get(tag)
+        if tab is None:
+            tab = np.zeros((2, self.table_size), np.float64)
+            self._tables[tag] = tab
+            self._scalars[tag] = np.zeros(5, np.float64)
+        return tab, self._scalars[tag]
+
+    def _grow_slots_locked(self, n: int) -> None:  # boxlint: disable=BX401 — caller holds _lock (the *_locked contract)
+        if n <= self._slot_n.size:
+            return
+        for name in ("_slot_click", "_slot_pred", "_slot_n"):
+            old = getattr(self, name)
+            new = np.zeros(n, np.float64)
+            new[:old.size] = old
+            setattr(self, name, new)
+
+    # ---------------------------------------------------------------- add
+    def add(self, pred, label, tag: str = ALL_TAG, mask=None) -> None:
+        """Masked streaming add into one tag's table (the CmatchRankMask
+        add_from role). pred in [0,1], label in {0,1}."""
+        pred = np.asarray(pred, np.float64).reshape(-1)
+        label = np.asarray(label).reshape(-1).astype(np.int64)
+        if mask is not None:
+            keep = np.asarray(mask).reshape(-1).astype(bool)
+            pred, label = pred[keep], label[keep]
+        binary = (label == 0) | (label == 1)
+        if not binary.all():
+            # non-binary rows (absent multi-task labels, padding codes)
+            # are structurally not CTR instances: drop them COUNTED, so
+            # tables and scalar accumulators stay consistent
+            from paddlebox_tpu.utils.stats import stat_add
+            stat_add("quality_rows_nonbinary_label",
+                     int((~binary).sum()))
+            pred, label = pred[binary], label[binary]
+        finite = np.isfinite(pred)
+        if not finite.all():
+            # NaN/Inf preds (a diverged model — EXACTLY when this plane
+            # must keep reporting): NaN passes a <0/>1 range check and
+            # its int cast is INT64_MIN, which would IndexError the
+            # bucket add and kill the step — drop COUNTED instead (the
+            # check_nan_inf flag owns loud divergence handling)
+            from paddlebox_tpu.utils.stats import stat_add
+            stat_add("quality_rows_nonfinite_pred",
+                     int((~finite).sum()))
+            pred, label = pred[finite], label[finite]
+        if pred.size == 0:
+            return
+        if pred.min() < 0.0 or pred.max() > 1.0:
+            raise ValueError("pred must lie in [0, 1]")
+        pos = np.minimum((pred * self.table_size).astype(np.int64),
+                         self.table_size - 1)
+        neg_at = pos[label == 0]
+        pos_at = pos[label == 1]
+        s_abs = float(np.abs(pred - label).sum())
+        s_sqr = float(((pred - label) ** 2).sum())
+        s_pred = float(pred.sum())
+        s_click = float(label.sum())
+        with self._lock:
+            tab, sc = self._tag_state_locked(tag)
+            np.add.at(tab[0], neg_at, 1.0)
+            np.add.at(tab[1], pos_at, 1.0)
+            sc += (s_abs, s_sqr, s_pred, s_click, float(pred.size))
+
+    def add_tagged(self, pred, label, tags, prefix: str = "",
+                   mask=None) -> None:
+        """One add call for an int tag column (cmatch ids, task ids):
+        instances group by their tag value into per-tag tables named
+        ``<prefix><tag>``. Zero tags are skipped when a prefix is set —
+        the packer's cmatch_rank default is all-zeros, which would mint
+        a meaningless 'cmatch:0' stream on every untagged job."""
+        tags = np.asarray(tags).reshape(-1).astype(np.int64)
+        pred = np.asarray(pred, np.float64).reshape(-1)
+        label = np.asarray(label).reshape(-1).astype(np.int64)
+        if mask is not None:
+            keep = np.asarray(mask).reshape(-1).astype(bool)
+            tags, pred, label = tags[keep], pred[keep], label[keep]
+        for t in np.unique(tags):
+            if prefix and t == 0:
+                continue
+            sel = tags == t
+            self.add(pred[sel], label[sel], tag="%s%d" % (prefix, int(t)))
+
+    def add_slot_batch(self, pred, label, slots, segments, valid,
+                       num_slots: int) -> None:
+        """Per-slot actual/predicted CTR from ONE packed batch's key
+        columns: an instance contributes its (pred, label) once to every
+        DISTINCT slot it carries a key in. Vectorized — one np.unique
+        over (record, slot) pairs (segments already encode rec*S+slot)."""
+        valid = np.asarray(valid).reshape(-1).astype(bool)
+        seg = np.asarray(segments).reshape(-1)[valid]
+        if seg.size == 0:
+            return
+        pairs = np.unique(seg.astype(np.int64))
+        rec = pairs // num_slots
+        slot = pairs % num_slots
+        pred = np.asarray(pred, np.float64).reshape(-1)[rec]
+        label = np.asarray(label, np.float64).reshape(-1)[rec]
+        with self._lock:
+            self._grow_slots_locked(num_slots)
+            np.add.at(self._slot_click, slot, label)
+            np.add.at(self._slot_pred, slot, pred)
+            np.add.at(self._slot_n, slot, 1.0)
+
+    def add_bucket_table(self, table, abserr: float, sqrerr: float,
+                         pred_sum: float, click_sum: float, n: float,
+                         tag: str = ALL_TAG) -> None:
+        """Merge a device-accumulated [2, Td] bucket table (the sharded
+        runner's mode_collect_in_device pass product). A finer device
+        table folds down by summing Td/T-wide bucket groups — the same
+        counts at coarser pred resolution."""
+        table = np.asarray(table, np.float64)
+        td = table.shape[1]
+        if td != self.table_size:
+            if td % self.table_size:
+                raise ValueError(
+                    "device table size %d does not fold into quality "
+                    "table size %d" % (td, self.table_size))
+            table = table.reshape(2, self.table_size,
+                                  td // self.table_size).sum(axis=2)
+        with self._lock:
+            tab, sc = self._tag_state_locked(tag)
+            tab += table
+            sc += (float(abserr), float(sqrerr), float(pred_sum),
+                   float(click_sum), float(n))
+
+    def add_batch(self, tensors: Dict[str, np.ndarray]) -> None:
+        """MetricMsg-parity feed from the trainers' tensors dict (the
+        _add_metrics shape): the unmasked 'all' stream, per-cmatch tags
+        from the packed cmatch_rank high bits, and one 'task:<name>'
+        stream per multi-task head.
+
+        Degrade contract: the plane is on by default in every trainer,
+        so a head whose output is not a probability (or a non-binary
+        label column) must SKIP with one warning + a counted stat, not
+        kill the training step (explicit add() calls keep the loud
+        ValueError)."""
+        pred = tensors.get("pred")
+        label = tensors.get("label")
+        if pred is None or label is None:
+            return
+        mask = tensors.get("mask")
+        try:
+            self.add(pred, label, tag=ALL_TAG, mask=mask)
+            cm = tensors.get("cmatch_rank")
+            if cm is not None:
+                cmatch = (np.asarray(cm, np.uint64)
+                          >> np.uint64(32)).astype(np.int64)
+                if (cmatch != 0).any():
+                    self.add_tagged(pred, label, cmatch, prefix="cmatch:",
+                                    mask=mask)
+            for k in tensors:
+                if not k.startswith("pred_"):
+                    continue
+                task = k[len("pred_"):]
+                tl = tensors.get("label_" + task)
+                if tl is not None:
+                    self.add(tensors[k], tl, tag="task:" + task, mask=mask)
+        except ValueError as e:
+            from paddlebox_tpu.utils.stats import stat_add
+            if stat_add("quality_batch_skipped") == 1:
+                from paddlebox_tpu.obs import log as obs_log
+                obs_log.warning(
+                    "quality plane skipping non-CTR-shaped batches",
+                    error=repr(e)[:200])
+
+    # ------------------------------------------------------------ compute
+    def _compute(self, tab: np.ndarray, sc: np.ndarray) -> dict:
+        """Pure function of one tag's (table, scalars) — callers pass
+        snapshots, so no lock is needed here."""
+        n = float(sc[self._S_N])
+        click = float(sc[self._S_CLICK])
+        pred_sum = float(sc[self._S_PRED])
+        out = {
+            "auc": round(table_auc(tab), 6),
+            "size": n,
+            "actual_ctr": round(click / n, 6) if n else 0.0,
+            "predicted_ctr": round(pred_sum / n, 6) if n else 0.0,
+            # COPC: click over predicted click — calibration in one
+            # number (1.0 = calibrated; the health plane alarms on a
+            # sustained departure)
+            "copc": round(click / pred_sum, 6) if pred_sum > 0 else 0.0,
+            "mae": round(float(sc[self._S_ABSERR]) / n, 6) if n else 0.0,
+            "rmse": round(math.sqrt(float(sc[self._S_SQRERR]) / n), 6)
+            if n else 0.0,
+        }
+        return out
+
+    def compute(self, tag: str = ALL_TAG) -> dict:
+        """One tag's quality bundle; an unseen tag reads as the empty
+        stream (size 0, auc -0.5). Snapshot under the lock, math
+        outside it (see report)."""
+        with self._lock:
+            tab = self._tables.get(tag)
+            if tab is None:
+                tab = np.zeros((2, self.table_size), np.float64)
+                sc = np.zeros(5, np.float64)
+            else:
+                tab = tab.copy()
+                sc = self._scalars[tag].copy()
+        return self._compute(tab, sc)
+
+    def report(self, max_slots: int = 64) -> dict:
+        """{tag: metrics} for every fed tag plus a 'slots' section of
+        per-slot actual/predicted CTR + copc (slots capped, dominant
+        first by instance count, so the pass_end extra stays bounded).
+
+        Lock discipline: the lock holds only for SNAPSHOT COPIES (a few
+        array memcpys); the per-tag trapezoid AUCs compute OUTSIDE it —
+        the HTTP exporter calls this from scrape threads, and a scrape
+        storm computing cumsums under the add path's lock would stall
+        the training step (the exact coupling the exporter forbids)."""
+        with self._lock:
+            snap = {t: (self._tables[t].copy(), self._scalars[t].copy())
+                    for t in self._tables}
+            slot_click = self._slot_click.copy()
+            slot_pred = self._slot_pred.copy()
+            slot_n = self._slot_n.copy()
+        tags = {t: self._compute(tab, sc)
+                for t, (tab, sc) in sorted(snap.items())}
+        slots = {}
+        order = np.argsort(-slot_n)[:max_slots]
+        for s in order.tolist():
+            cnt = float(slot_n[s])
+            if cnt <= 0:
+                continue
+            pred_sum = float(slot_pred[s])
+            click = float(slot_click[s])
+            slots[str(s)] = {
+                "n": cnt,
+                "actual_ctr": round(click / cnt, 6),
+                "predicted_ctr": round(pred_sum / cnt, 6),
+                "copc": round(click / pred_sum, 6)
+                if pred_sum > 0 else 0.0,
+            }
+        out = {"tags": tags}
+        if slots:
+            out["slots"] = slots
+        return out
+
+    def publish_gauges(self) -> None:
+        """Headline gauges for the report/health plane: the 'all'
+        stream's auc + copc ride every StepReport window (the cluster
+        HealthMonitor alarms on a copc outside its calibration band)."""
+        from paddlebox_tpu.utils.stats import gauge_set
+        m = self.compute(ALL_TAG)
+        if m["size"] > 0:
+            gauge_set("quality_auc", m["auc"])
+            gauge_set("quality_copc", m["copc"])
+
+    # ------------------------------------------------------- state / merge
+    def state(self) -> dict:
+        """Sum-mergeable JSON-safe snapshot: SPARSE bucket tables (most
+        of a window's buckets are empty — nz rows of [idx, neg, pos])
+        plus the scalar vector per tag, plus the slot accumulators."""
+        with self._lock:
+            tags = {}
+            for t, tab in self._tables.items():
+                nz = np.nonzero((tab[0] != 0) | (tab[1] != 0))[0]
+                tags[t] = {
+                    "nz": [[int(i), float(tab[0][i]), float(tab[1][i])]
+                           for i in nz.tolist()],
+                    "s": [float(x) for x in self._scalars[t]],
+                }
+            return {"v": STATE_VERSION, "table_size": self.table_size,
+                    "tags": tags,
+                    "slots": [self._slot_click.tolist(),
+                              self._slot_pred.tolist(),
+                              self._slot_n.tolist()]}
+
+    def merge_state(self, state: dict) -> None:
+        """Elementwise-add a peer rank's state() into this plane (the
+        allreduce-sum role of Metric::calculate, minus the MPI)."""
+        if int(state.get("table_size", self.table_size)) != self.table_size:
+            raise ValueError("cannot merge quality states of different "
+                             "table sizes (%s vs %d)"
+                             % (state.get("table_size"), self.table_size))
+        with self._lock:
+            for t, st in (state.get("tags") or {}).items():
+                tab, sc = self._tag_state_locked(t)
+                for i, neg, pos in st.get("nz", ()):
+                    tab[0][int(i)] += float(neg)
+                    tab[1][int(i)] += float(pos)
+                sc += np.asarray(st.get("s", [0.0] * 5), np.float64)
+            slots = state.get("slots")
+            if slots:
+                click, pred, cnt = (np.asarray(a, np.float64)
+                                    for a in slots)
+                self._grow_slots_locked(click.size)
+                self._slot_click[:click.size] += click
+                self._slot_pred[:pred.size] += pred
+                self._slot_n[:cnt.size] += cnt
+
+    def reset(self) -> None:
+        with self._lock:
+            self._tables.clear()
+            self._scalars.clear()
+            self._slot_click = np.zeros(0, np.float64)
+            self._slot_pred = np.zeros(0, np.float64)
+            self._slot_n = np.zeros(0, np.float64)
+
+
+def merged_report(states: Sequence[dict],
+                  max_slots: int = 64) -> Optional[dict]:
+    """The rank-0 merge: sum N ranks' quality states and compute the
+    cluster-wide report (obs/aggregate.py calls this on the
+    ``quality_state`` extras that arrive piggybacked at pass_end).
+    Returns None when no state merges (mismatched sizes, empty input)."""
+    merged: Optional[TaggedQuality] = None
+    for st in states:
+        if not st:
+            continue
+        try:
+            if merged is None:
+                merged = TaggedQuality(
+                    table_size=int(st.get("table_size", 0)) or 1)
+            merged.merge_state(st)
+        except (ValueError, TypeError, KeyError):
+            continue        # a malformed/mismatched peer degrades, never kills
+    return merged.report(max_slots=max_slots) if merged is not None else None
+
+
+# ------------------------------------------------------------- module API
+# The ops exporter serves the LIVE trainer's quality plane without a
+# binding dance: the owning runner registers its instance here (last
+# writer wins — one trainer per process is the deployed shape).
+_ACTIVE: Optional[TaggedQuality] = None
+
+
+def active() -> Optional[TaggedQuality]:
+    return _ACTIVE
+
+
+def set_active(q: Optional[TaggedQuality]) -> Optional[TaggedQuality]:
+    global _ACTIVE
+    prev, _ACTIVE = _ACTIVE, q
+    return prev
+
+
+def attach_pass_extras(extra: dict, quality: Optional[TaggedQuality],
+                       ship_state: bool = False) -> dict:
+    """pass_end wiring shared by every runner: the computed quality
+    bundle rides the report, multi-process ranks also ship the raw
+    sum-mergeable state for the rank-0 merge, the headline gauges land
+    BEFORE the report assembles (so this window's record — and the
+    health plane merging it — carries them), and the drift monitor's
+    window rolls."""
+    if quality is not None:
+        quality.publish_gauges()
+        extra["quality"] = quality.report()
+        if ship_state:
+            extra["quality_state"] = quality.state()
+    from paddlebox_tpu.metrics import drift as _drift
+    dq = _drift.roll_gauges()
+    if dq is not None:
+        extra["data_quality"] = dq
+    return extra
+
+
+def make_from_flags() -> Optional[TaggedQuality]:
+    """Flag-gated construction (quality_metrics off → None) + module
+    registration — the one call every trainer makes."""
+    from paddlebox_tpu.config import flags
+    if not bool(flags.get_flag("quality_metrics")):
+        return None
+    q = TaggedQuality()
+    set_active(q)
+    return q

@@ -32,6 +32,12 @@ platform/monitor.h + timer discipline + chrometracing profiler did
                    them, tools/trace_stitch.py merges per-rank chrome
                    traces into one cluster timeline with cross-rank
                    flow events (obs/tracer.py, round 14)
+  * exporter     — per-rank HTTP ops endpoint (flag obs_http_port,
+                   port +rank): /metrics Prometheus exposition,
+                   /report, /health, /stacks, /flight, /quality — the
+                   live READ surface over every tier above, answered
+                   from defensive snapshots only (obs/exporter.py,
+                   round 18)
 
 Import surface is deliberately jax-free: every hot-path hook (span,
 beat) must stay importable and near-free on any host — the serving
@@ -40,6 +46,7 @@ processes (per-pull latency histograms, QPS windows, cache-rate extras
 ride the same StepReport/sink/aggregation machinery unchanged).
 """
 
+from paddlebox_tpu.obs import exporter  # noqa: F401
 from paddlebox_tpu.obs import flight  # noqa: F401
 from paddlebox_tpu.obs import log  # noqa: F401
 from paddlebox_tpu.obs.aggregate import (ClusterAggregator,  # noqa: F401
@@ -71,6 +78,12 @@ def make_step_reporter(rank: int = 0, timers=None, aggregator=None,
     reporter = StepReporter(rank=rank, timers=timers,
                             aggregator=aggregator, **kwargs)
     _wd_ensure(tracer=get_tracer(), report_fn=reporter.peek)
+    # live ops endpoint (round 18, flag-gated): /report answers this
+    # reporter's peek, /health reaches the health plane through
+    # reporter.aggregator — one bind per runner/replica construction
+    exp = exporter.ensure_from_flags(rank=rank)
+    if exp is not None:
+        exp.bind(reporter=reporter)
     return reporter
 
 
@@ -101,7 +114,9 @@ def make_cluster_aggregator(mesh=None, fleet=None, rank: int = 0,
     from paddlebox_tpu.config import flags
     sink = (make_sink(str(flags.get_flag("obs_report_path")))
             if rank == 0 else None)
-    health = HealthMonitor(world) if rank == 0 else None
+    health = (HealthMonitor(
+        world, drift_warn=float(flags.get_flag("data_quality_warn")))
+        if rank == 0 else None)
     return ClusterAggregator(transport, rank, world, sink=sink,
                              health=health)
 

@@ -155,8 +155,20 @@ def merge_cluster_reports(reports: List[dict]) -> dict:
                     "p50": round(hist_percentile(counts, 0.50), 3),
                     "p90": round(hist_percentile(counts, 0.90), 3),
                     "p99": round(hist_percentile(counts, 0.99), 3)}
-    return {"type": "cluster_report", "v": SCHEMA_VERSION, "step": step,
-            "ranks": sorted(set(ranks)), "metrics": metrics, "hists": hists}
+    out = {"type": "cluster_report", "v": SCHEMA_VERSION, "step": step,
+           "ranks": sorted(set(ranks)), "metrics": metrics, "hists": hists}
+    # tagged quality plane (round 18): pass_end reports ship each
+    # rank's sum-mergeable quality state — sum the bucket tables and
+    # compute the CLUSTER-wide tagged auc/copc/ctr (per-rank AUCs do
+    # not average; their tables sum, exactly the reference's allreduce)
+    qstates = [rec["quality_state"] for rec in reports
+               if rec.get("quality_state")]
+    if qstates:
+        from paddlebox_tpu.metrics.quality import merged_report
+        q = merged_report(qstates)
+        if q is not None:
+            out["quality"] = q
+    return out
 
 
 class ClusterAggregator:

@@ -1,0 +1,368 @@
+"""Per-rank HTTP ops endpoint: the live READ surface of the obs plane.
+
+Everything the telemetry tiers assemble (PR 5 reports/aggregation, PR 9
+flight/health, this round's quality/drift planes) was push-only and
+file-bound — an operator could not look at a live rank without tailing
+JSONL. This module serves it over stdlib ``http.server`` on
+``obs_http_port`` (+rank, so every rank of a localhost cluster — and
+every serving replica, which carries its replica index as its rank —
+gets its own port from ONE flag; 0 = off):
+
+  ``/metrics``  Prometheus text exposition (version 0.0.4) of the
+                StatRegistry counters, gauges, fixed-bucket histograms
+                (cumulative ``_bucket{le=...}`` series + p50/p90/p99
+                gauges) and the quality plane's per-tag auc/copc/ctr
+  ``/report``   latest StepReport (rank 0 adds its latest merged
+                cluster report)
+  ``/health``   rank-0 cluster health record with per-rank scores
+                (non-zero ranks answer their own liveness)
+  ``/stacks``   every thread's stack, plain text (the watchdog dump,
+                on demand)
+  ``/flight``   flight-recorder segment list + tail of the black box
+  ``/quality``  quality + drift plane snapshot (full detail; /metrics
+                carries the headline series)
+
+Scrape-safety is the design contract: every handler answers from
+DEFENSIVE SNAPSHOTS — the StatRegistry's snapshot_all (one short
+registry lock, the same hold every StepReport assembly takes), the
+reporter's deep-copied ``peek()``, the aggregator's last-merged record,
+the quality plane's short internal lock — and never touches a training
+lock, so a scrape storm can slow scrapes, never the step loop (the
+dial-outside-lock discipline of the aggregator, applied to reads).
+
+A port already in use WARNS AND DISABLES the endpoint (telemetry must
+never kill the trainer it instruments — same degrade contract as the
+flight recorder). Import surface stays jax-free.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+SCHEMA_VERSION = 1
+
+#: Prometheus text exposition content type
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    return "pbtpu_" + _NAME_RE.sub("_", str(name))
+
+
+def render_prometheus(snap: dict, rank: int,
+                      quality: Optional[dict] = None,
+                      drift: Optional[dict] = None) -> str:
+    """StatRegistry snapshot_all + quality/drift snapshots → Prometheus
+    text exposition. Pure function (tests pin the format)."""
+    from paddlebox_tpu.utils.stats import HIST_BOUNDS, hist_percentile
+    lines = []
+    lines.append("# pbtpu ops exporter v%d rank=%d ts=%.3f"
+                 % (SCHEMA_VERSION, rank, time.time()))
+    # ONE TYPE line per metric family, ever: the quality/drift planes
+    # also publish plain gauges of the same names (quality_auc,
+    # data_drift_score — the health plane reads those), and a second
+    # "# TYPE" for a family is a hard parse error to a real Prometheus
+    # scraper, not a cosmetic dupe
+    typed = set()
+
+    def typ(name: str, kind: str) -> None:
+        if name not in typed:
+            typed.add(name)
+            lines.append("# TYPE %s %s" % (name, kind))
+
+    # families the quality/drift sections below render (richer: tagged
+    # series / window detail) — the plain StatRegistry gauges of the
+    # same names are skipped so each family appears exactly once,
+    # contiguously (interleaved families are a parse error too)
+    owned = set()
+    if quality:
+        owned |= {"quality_auc", "quality_copc"}
+    if drift and drift.get("last"):
+        owned |= {"data_drift_score", "data_dropped_slots"}
+    for k in sorted(snap.get("counters") or {}):
+        n = _prom_name(k)
+        typ(n, "counter")
+        lines.append("%s %d" % (n, int(snap["counters"][k])))
+    for k in sorted(snap.get("gauges") or {}):
+        if k in owned:
+            continue
+        n = _prom_name(k)
+        typ(n, "gauge")
+        lines.append("%s %.9g" % (n, float(snap["gauges"][k])))
+    for k in sorted(snap.get("hists") or {}):
+        counts = snap["hists"][k]
+        n = _prom_name(k)
+        typ(n, "histogram")
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += int(c)
+            le = ("+Inf" if i >= len(HIST_BOUNDS)
+                  else "%g" % HIST_BOUNDS[i])
+            lines.append('%s_bucket{le="%s"} %d' % (n, le, cum))
+        lines.append("%s_count %d" % (n, cum))
+        for q, tag in ((0.50, "p50"), (0.90, "p90"), (0.99, "p99")):
+            typ("%s_%s" % (n, tag), "gauge")
+            lines.append("%s_%s %.9g" % (n, tag,
+                                         hist_percentile(counts, q)))
+    if quality:
+        for metric in ("auc", "copc", "actual_ctr", "predicted_ctr",
+                       "size"):
+            n = "pbtpu_quality_" + metric
+            first = True
+            for tag in sorted(quality.get("tags") or {}):
+                v = quality["tags"][tag].get(metric)
+                if v is None:
+                    continue
+                if first:
+                    typ(n, "gauge")
+                    first = False
+                lines.append('%s{tag="%s"} %.9g'
+                             % (n, _NAME_RE.sub("_", tag), float(v)))
+        slots = quality.get("slots") or {}
+        if slots:
+            for metric in ("actual_ctr", "predicted_ctr", "copc", "n"):
+                n = "pbtpu_slot_" + metric
+                typ(n, "gauge")
+                for s in sorted(slots, key=int):
+                    lines.append('%s{slot="%s"} %.9g'
+                                 % (n, s, float(slots[s][metric])))
+    if drift and drift.get("last"):
+        last = drift["last"]
+        d = last.get("drift") or {}
+        for name, v in (("pbtpu_data_drift_score", d.get("score")),
+                        ("pbtpu_data_dropped_slots",
+                         len(d.get("dropped_slots") or ())),
+                        ("pbtpu_data_window_recs", last.get("n_recs")),
+                        ("pbtpu_data_label_rate", last.get("label_rate"))):
+            if v is None:
+                continue
+            typ(name, "gauge")
+            lines.append("%s %.9g" % (name, float(v)))
+    return "\n".join(lines) + "\n"
+
+
+class ObsExporter:
+    """One rank's ops endpoint. Construction BINDS the port (raises
+    OSError on conflict — ensure_from_flags turns that into the
+    warn-and-disable degrade); serve threads are daemons."""
+
+    def __init__(self, port: int, rank: int = 0,
+                 host: str = "127.0.0.1") -> None:
+        self.rank = int(rank)
+        self.host = host
+        self._reporter = None  # guarded-by: _lock
+        self._lock = threading.Lock()
+        exporter = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            # a scrape must never land access-log noise on the job's
+            # stderr (and a broken scraper must never raise into it)
+            def log_message(self, fmt, *args):  # noqa: D401
+                pass
+
+            def do_GET(self):  # noqa: N802 — http.server contract
+                try:
+                    exporter._route(self)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                except Exception as e:  # noqa: BLE001 — degrade, never kill
+                    try:
+                        exporter._send(self, 500, "text/plain",
+                                       ("exporter error: %r" % e).encode())
+                    except OSError:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = int(self._httpd.server_port)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="pbtpu-obs-http")
+        self._thread.start()
+
+    # ------------------------------------------------------------- binding
+    def bind(self, reporter=None) -> "ObsExporter":
+        """Attach the live StepReporter (make_step_reporter calls this;
+        the aggregator — and through it the health plane — is reached
+        via reporter.aggregator)."""
+        with self._lock:
+            if reporter is not None:
+                self._reporter = reporter
+        return self
+
+    # ------------------------------------------------------------ handlers
+    @staticmethod
+    def _send(handler, code: int, ctype: str, body: bytes) -> None:
+        handler.send_response(code)
+        handler.send_header("Content-Type", ctype)
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    def _send_json(self, handler, obj, code: int = 200) -> None:
+        body = json.dumps(obj, default=repr).encode("utf-8")
+        self._send(handler, code, "application/json", body)
+
+    def _route(self, handler) -> None:
+        path = handler.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/metrics":
+            return self._metrics(handler)
+        if path == "/report":
+            return self._report(handler)
+        if path == "/health":
+            return self._health(handler)
+        if path == "/stacks":
+            return self._stacks(handler)
+        if path == "/flight":
+            return self._flight(handler)
+        if path == "/quality":
+            return self._quality(handler)
+        if path == "/":
+            return self._send_json(handler, {
+                "rank": self.rank, "v": SCHEMA_VERSION,
+                "endpoints": ["/metrics", "/report", "/health",
+                              "/stacks", "/flight", "/quality"]})
+        self._send_json(handler, {"error": "unknown path %s" % path},
+                        code=404)
+
+    def _metrics(self, handler) -> None:
+        from paddlebox_tpu.metrics import drift as _drift
+        from paddlebox_tpu.metrics import quality as _quality
+        from paddlebox_tpu.utils.stats import StatRegistry
+        snap = StatRegistry.instance().snapshot_all()
+        q = _quality.active()
+        dm = _drift.active()
+        text = render_prometheus(
+            snap, self.rank,
+            quality=q.report() if q is not None else None,
+            drift=dm.snapshot() if dm is not None else None)
+        self._send(handler, 200, PROM_CONTENT_TYPE, text.encode("utf-8"))
+
+    def _report(self, handler) -> None:
+        with self._lock:
+            rep = self._reporter
+        out = {"rank": self.rank,
+               "report": rep.peek() if rep is not None else None}
+        agg = getattr(rep, "aggregator", None)
+        if agg is not None and agg.last_cluster_report is not None:
+            out["cluster_report"] = agg.last_cluster_report
+        self._send_json(handler, out)
+
+    def _health(self, handler) -> None:
+        with self._lock:
+            rep = self._reporter
+        agg = getattr(rep, "aggregator", None)
+        health = getattr(agg, "health", None) if agg is not None else None
+        if health is not None and health.last_health is not None:
+            return self._send_json(handler, health.last_health)
+        # non-rank-0 (or single-rank): answer own liveness so every
+        # rank's endpoint is curl-able with the same verb
+        last = rep.peek() if rep is not None else None
+        self._send_json(handler, {
+            "type": "rank_liveness", "v": SCHEMA_VERSION,
+            "rank": self.rank, "ts": time.time(),
+            "last_report_step": (last or {}).get("step"),
+            "last_report_ts": (last or {}).get("ts"),
+            "note": "per-rank view; the merged cluster_health record "
+                    "lives on rank 0's endpoint"})
+
+    def _stacks(self, handler) -> None:
+        from paddlebox_tpu.obs.flight import _thread_stacks
+        lines = []
+        for name, stack in sorted(_thread_stacks().items()):
+            lines.append("== %s ==" % name)
+            lines.extend(stack)
+            lines.append("")
+        self._send(handler, 200, "text/plain; charset=utf-8",
+                   ("\n".join(lines) + "\n").encode("utf-8"))
+
+    def _flight(self, handler, tail_lines: int = 64) -> None:
+        from paddlebox_tpu.obs import flight as _flight
+        fr = _flight.active()
+        if fr is None:
+            return self._send_json(handler, {"active": False})
+        segs = fr.segments()
+        tail = []
+        if segs:
+            try:
+                with open(segs[-1], "r", encoding="utf-8",
+                          errors="replace") as fh:
+                    tail = fh.readlines()[-tail_lines:]
+            except OSError:
+                tail = []
+        self._send_json(handler, {
+            "active": True, "dir": fr.dir, "rank": fr.rank,
+            "segments": segs,
+            "tail": [ln.rstrip("\n") for ln in tail]})
+
+    def _quality(self, handler) -> None:
+        from paddlebox_tpu.metrics import drift as _drift
+        from paddlebox_tpu.metrics import quality as _quality
+        q = _quality.active()
+        dm = _drift.active()
+        self._send_json(handler, {
+            "rank": self.rank,
+            "quality": q.report() if q is not None else None,
+            "drift": dm.snapshot() if dm is not None else None})
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2.0)
+
+
+# ------------------------------------------------------------- module API
+_ACTIVE: Optional[ObsExporter] = None
+
+
+def active() -> Optional[ObsExporter]:
+    return _ACTIVE
+
+
+def set_active(e: Optional[ObsExporter]) -> Optional[ObsExporter]:
+    global _ACTIVE
+    prev, _ACTIVE = _ACTIVE, e
+    return prev
+
+
+def ensure_from_flags(rank: int = 0) -> Optional[ObsExporter]:
+    """Flag-configured endpoint (obs_http_port 0 = off; the bound port
+    is flag + rank so one flag serves a whole localhost cluster and a
+    replica fleet). Same port+rank reuses; flag 0 closes and clears
+    (test self-healing, flight-recorder discipline). A port in use
+    WARNS AND DISABLES — never raises into runner construction."""
+    global _ACTIVE
+    from paddlebox_tpu.config import flags
+    base = int(flags.get_flag("obs_http_port"))
+    if base <= 0:
+        if _ACTIVE is not None:
+            _ACTIVE.close()
+            _ACTIVE = None
+        return None
+    port = base + int(rank)
+    if (_ACTIVE is not None and _ACTIVE.port == port
+            and _ACTIVE.rank == int(rank)):
+        return _ACTIVE
+    if _ACTIVE is not None:
+        _ACTIVE.close()
+        _ACTIVE = None
+    try:
+        exp = ObsExporter(port, rank=rank)
+    except OSError as e:
+        from paddlebox_tpu.obs import log as obs_log
+        obs_log.warning("obs http exporter disabled: port unusable",
+                        port=port, rank=rank, error=repr(e)[:200])
+        return None
+    _ACTIVE = exp
+    return exp

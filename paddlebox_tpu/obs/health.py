@@ -24,6 +24,17 @@ and loses
   * 0.6  beat age above ``beat_age_warn`` — the rank still REPORTS but
          its step loop stopped beating (wedged exchange/driver thread
          behind a live reporting path), which freshness cannot see
+  * 0.6  data-quality drift (round 18): the rank's ``data_drift_score``
+         gauge (metrics/drift.py — per-slot coverage collapse, keys/
+         record drift, cardinality collapse, label/pred distribution
+         drift) at or past ``drift_warn`` — weighted past the healthy
+         bar on its own, so a dropped upstream slot turns its victim
+         unhealthy the window its gauge lands, even with every systems
+         signal green
+  * 0.3  miscalibration (round 18): the rank's ``quality_copc`` gauge
+         (metrics/quality.py: click over predicted click) outside the
+         ``copc_band`` calibration band — the failure that kills a
+         production CTR model while every systems signal stays green
 ``healthy`` = score >= 0.5.
 
 Staleness measures TELEMETRY silence, which is the only signal rank 0
@@ -48,11 +59,15 @@ class HealthMonitor:
 
     def __init__(self, world: int, stale_unhealthy: int = 2,
                  depth_warn: float = 64.0,
-                 beat_age_warn: float = 30.0) -> None:
+                 beat_age_warn: float = 30.0,
+                 drift_warn: float = 0.5,
+                 copc_band: tuple = (0.8, 1.25)) -> None:
         self.world = int(world)
         self.stale_unhealthy = int(stale_unhealthy)
         self.depth_warn = float(depth_warn)
         self.beat_age_warn = float(beat_age_warn)
+        self.drift_warn = float(drift_warn)
+        self.copc_band = (float(copc_band[0]), float(copc_band[1]))
         self._stale_windows: Dict[int, int] = {r: 0 for r in range(world)}
         self.last_health: Optional[dict] = None
 
@@ -74,6 +89,8 @@ class HealthMonitor:
         warn = self._per_rank(merged, "stats.log_warning_lines")
         beat_age = self._per_rank(merged, "gauges.beat_age_s")
         slo_burn = self._per_rank(merged, "gauges.serving_slo_burn")
+        drift = self._per_rank(merged, "gauges.data_drift_score")
+        copc = self._per_rank(merged, "gauges.quality_copc")
         depths = {}
         for k, m in (merged.get("metrics") or {}).items():
             if (k.startswith("gauges.") and k.endswith("_depth")):
@@ -114,6 +131,18 @@ class HealthMonitor:
                 # see — weighted past the 0.5 healthy bar on its own
                 score -= 0.6
                 flags.append("beat_stalled")
+            if drift.get(r, 0.0) >= self.drift_warn:
+                # slot-level data-quality drift (a dropped upstream
+                # feature pipeline): weighted past the healthy bar on
+                # its own — the victim rank must read unhealthy even
+                # while every systems signal is green
+                score -= 0.6
+                flags.append("data_drift")
+            c = copc.get(r)
+            if c is not None and c > 0 and not (
+                    self.copc_band[0] <= c <= self.copc_band[1]):
+                score -= 0.3
+                flags.append("miscalibrated")
             score = max(0.0, min(1.0, score))
             entry = {"score": round(score, 3),
                      "healthy": score >= 0.5,

@@ -15,6 +15,7 @@ min/median/max the windows directly (obs/aggregate.py).
 
 from __future__ import annotations
 
+import copy
 import json
 import sys
 import time
@@ -142,8 +143,14 @@ class StepReporter:
         return self._report(step, extra)
 
     def peek(self) -> Optional[dict]:
-        """Last assembled report (watchdog dump surface); never assembles."""
-        return self.last_report
+        """DEEP COPY of the last assembled report (watchdog dump + HTTP
+        exporter surface); never assembles. A copy, not the internal
+        dict: consumers hold and mutate what they get (the exporter
+        hands it to json in another thread, the watchdog stashes it),
+        and a by-reference return would let any of them corrupt
+        reporter state."""
+        rep = self.last_report
+        return copy.deepcopy(rep) if rep is not None else None
 
     # ----------------------------------------------------------- assembly
     def _report(self, step: int, extra: Optional[dict]) -> dict:

@@ -348,11 +348,16 @@ def _grouped_train_pass(runner, dataset, begin_pass, end_pass,
     end_pass()
     reporter = getattr(runner, "reporter", None)
     if reporter is not None:
+        extra = {"event": "pass_end",
+                 "loss": round(float(np.mean(losses)), 6)
+                 if losses else 0.0}
+        from paddlebox_tpu.metrics.quality import attach_pass_extras
+        attach_pass_extras(extra, getattr(runner, "quality", None),
+                           ship_state=getattr(runner, "multiprocess",
+                                              False))
         reporter.maybe_report(
             getattr(runner, "_step_count", len(losses)), force=True,
-            extra={"event": "pass_end",
-                   "loss": round(float(np.mean(losses)), 6)
-                   if losses else 0.0})
+            extra=extra)
     return {"loss": float(np.mean(losses)) if losses else 0.0,
             "steps": len(losses),
             "dropped_batches": len(batches) - n_groups * M}
@@ -377,7 +382,9 @@ def _feed_pipeline_metrics(runner, preds, packed_batches) -> None:
     packed_batches; the cross-process reduction stays in get_metric_msg's
     allreduce hook."""
     dump = getattr(runner, "dump_writer", None)
-    if not runner.metrics.metric_names() and dump is None:
+    quality = getattr(runner, "quality", None)
+    if (not runner.metrics.metric_names() and dump is None
+            and quality is None):
         return
     if getattr(runner, "multiprocess", False):
         # preds is dp-sharded but STAGE-REPLICATED: addressable_shards
@@ -404,7 +411,7 @@ def _feed_pipeline_metrics(runner, preds, packed_batches) -> None:
                                       per_task, names[0])
             if tens:
                 dump.dump_batch(tens, ins_ids=b.ins_ids, mask=b.ins_valid)
-    if not runner.metrics.metric_names():
+    if not runner.metrics.metric_names() and quality is None:
         return
     labels = np.concatenate([b.labels for b in packed_batches])
     mask = np.concatenate([b.ins_valid for b in packed_batches])
@@ -419,6 +426,20 @@ def _feed_pipeline_metrics(runner, preds, packed_batches) -> None:
     else:
         tensors["pred"] = arr.reshape(-1)
     runner.metrics.add_batch(tensors)
+    if quality is not None:
+        quality.add_batch(tensors)
+        # per-slot ctr: same feed the box trainers give it (a pipeline
+        # job's /metrics must not silently lack the pbtpu_slot_* series)
+        num_slots = getattr(runner, "num_slots", 0)
+        if num_slots:
+            preds_by_batch = tensors["pred"].reshape(
+                len(packed_batches), -1)
+            for j, b in enumerate(packed_batches):
+                quality.add_slot_batch(
+                    preds_by_batch[j], b.labels, b.slots, b.segments,
+                    b.valid, num_slots)
+        from paddlebox_tpu.metrics import drift as _drift
+        _drift.observe_preds(tensors["pred"], mask=mask)
 
 
 def _pipeline_predict(runner, dataset, begin_pass, end_pass, slab_of):
@@ -726,6 +747,8 @@ class CtrPipelineRunner:
         self._prng = jax.random.PRNGKey(seed + 31)
         from paddlebox_tpu.metrics.auc import MetricRegistry
         self.metrics = MetricRegistry()
+        from paddlebox_tpu.metrics import quality as _pbtpu_quality
+        self.quality = _pbtpu_quality.make_from_flags()
         # telemetry plane (round 10): per-step cadence fed by the shared
         # pass drivers (_pipe_note_step)
         self._step_count = 0
@@ -1167,6 +1190,8 @@ class ShardedCtrPipelineRunner:
         self._slabs = None
         from paddlebox_tpu.metrics.auc import MetricRegistry
         self.metrics = MetricRegistry()
+        from paddlebox_tpu.metrics import quality as _pbtpu_quality
+        self.quality = _pbtpu_quality.make_from_flags()
         # telemetry plane (round 10): rank-tagged reporter; the shared
         # pass drivers feed the cadence (_pipe_note_step); multi-process,
         # reports piggyback to rank 0 for the merged cluster view

@@ -145,6 +145,11 @@ class ShardedBoxTrainer:
             owned_shards=self.local_positions if self.multiprocess else None,
             store_factory=store_factory, policy=self.policy)
         self.metrics = MetricRegistry()
+        # tagged quality plane (round 18, flag quality_metrics): same
+        # host-tensor feed as BoxTrainer; in device-collect mode the
+        # pass's device bucket table folds in instead (add_bucket_table)
+        from paddlebox_tpu.metrics import quality as _pbtpu_quality
+        self.quality = _pbtpu_quality.make_from_flags()
         # scatter-free slab write (push_write flag; see BoxTrainer)
         from paddlebox_tpu.train.trainer import resolve_push_write_sharded
         self._push_write = resolve_push_write_sharded(
@@ -978,6 +983,16 @@ class ShardedBoxTrainer:
             sums = (st[:, 0, :] - st[:, 1, :]).sum(axis=0)
             for m in self.metrics.messages():
                 m.calculator.add_bucket_stats(tab, *sums)
+            if self.quality is not None:
+                # the device table folds down to the quality table size
+                # — same counts, coarser pred buckets (tag streams need
+                # host preds; device-collect mode keeps them on device)
+                try:
+                    self.quality.add_bucket_table(tab, *sums)
+                except ValueError as e:
+                    obs_log.warning(
+                        "quality plane skipped device table",
+                        error=repr(e)[:200])
         if self._param_sync is not None and self._steps_since_sync:
             # pass boundary is always a sync point
             self.params, self.opt_state = self._param_sync(
@@ -997,11 +1012,16 @@ class ShardedBoxTrainer:
         mean_loss = float(np.mean(losses)) if losses else 0.0
         # pass boundary closes the report window (and on rank 0, emits a
         # merged cluster view of whatever peer snapshots have arrived)
-        self.reporter.maybe_report(
-            self._step_count, force=True,
-            extra={"event": "pass_end", "loss": round(mean_loss, 6),
-                   "auc": {m.name: float(m.calculator.auc())
-                           for m in self.metrics.messages()}})
+        extra = {"event": "pass_end", "loss": round(mean_loss, 6),
+                 "auc": {m.name: float(m.calculator.auc())
+                         for m in self.metrics.messages()}}
+        from paddlebox_tpu.metrics.quality import attach_pass_extras
+        # multi-process ranks ship the raw sum-mergeable state so the
+        # rank-0 merge computes the CLUSTER-wide tagged quality report
+        attach_pass_extras(extra, self.quality,
+                           ship_state=self.multiprocess)
+        self.reporter.maybe_report(self._step_count, force=True,
+                                   extra=extra)
         if self.cfg.profile:
             from paddlebox_tpu.utils.profiler import timer_report
             # rank-tagged so multiprocess reports stay distinguishable
@@ -1164,7 +1184,8 @@ class ShardedBoxTrainer:
         in get_metric_msg via the fleet allreduce hook (the reference's
         box MPI allreduce in Metric::calculate)."""
         need_dump = self.dump_writer is not None
-        need_metrics = (bool(self.metrics.metric_names())
+        need_metrics = ((bool(self.metrics.metric_names())
+                         or self.quality is not None)
                         and not self._collect_T)
         # device-collect mode: the jitted step already bucketed this
         # batch on device — touching preds here would D2H them
@@ -1196,3 +1217,11 @@ class ShardedBoxTrainer:
         for t, arr in rows.items():
             tensors["pred_" + t] = arr.reshape(-1)
         self.metrics.add_batch(tensors)
+        if self.quality is not None:
+            self.quality.add_batch(tensors)
+            for w, b in enumerate(step_batches):
+                self.quality.add_slot_batch(
+                    rows[main][w], b.labels, b.slots, b.segments,
+                    b.valid, self.num_slots)
+            from paddlebox_tpu.metrics import drift as _drift
+            _drift.observe_preds(tensors["pred"], mask=tensors["mask"])

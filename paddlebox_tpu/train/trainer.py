@@ -923,6 +923,11 @@ class BoxTrainer:
         self.feed = feed
         self.table = PassTable(table_cfg, seed=seed)
         self.metrics = MetricRegistry()
+        # tagged quality plane (round 18, flag quality_metrics): per-tag
+        # masked AUC / COPC / actual-vs-predicted CTR streamed from the
+        # same host tensors _add_metrics builds; None when flagged off
+        from paddlebox_tpu.metrics import quality as _quality
+        self.quality = _quality.make_from_flags()
         self.async_mode = (self.cfg.async_mode
                            or self.cfg.sync_mode == "async")
         self.sparse_chunk_sync = bool(self.cfg.sparse_chunk_sync)
@@ -1253,6 +1258,7 @@ class BoxTrainer:
                 # (~80 ms on the axon tunnel, tools D2H probe). Skipped
                 # entirely when nothing consumes preds.
                 if not (self.metrics.metric_names()
+                        or self.quality is not None
                         or self.dump_writer is not None):
                     return
                 preds_np = {t: np.asarray(p) for t, p in preds.items()}
@@ -1345,11 +1351,13 @@ class BoxTrainer:
         mean_loss = float(np.mean(losses)) if losses else 0.0
         # pass boundary is always a report boundary: the window closes
         # with the pass stats + the streaming metrics' last computed AUC
-        self.reporter.maybe_report(
-            self._step_count, force=True,
-            extra={"event": "pass_end", "loss": round(mean_loss, 6),
-                   "auc": {m.name: float(m.calculator.auc())
-                           for m in self.metrics.messages()}})
+        extra = {"event": "pass_end", "loss": round(mean_loss, 6),
+                 "auc": {m.name: float(m.calculator.auc())
+                         for m in self.metrics.messages()}}
+        from paddlebox_tpu.metrics.quality import attach_pass_extras
+        attach_pass_extras(extra, self.quality)
+        self.reporter.maybe_report(self._step_count, force=True,
+                                   extra=extra)
         if self.cfg.profile:
             from paddlebox_tpu.utils.profiler import timer_report
             obs_log.info(timer_report(self.timers, prefix="trainer."))
@@ -1359,7 +1367,7 @@ class BoxTrainer:
 
     def _add_metrics(self, preds: Dict[str, jnp.ndarray],
                      b: PackedBatch) -> None:
-        if not self.metrics.metric_names():
+        if not (self.metrics.metric_names() or self.quality is not None):
             return
         mask = b.ins_valid
         tensors = {"label": b.labels, "mask": mask}
@@ -1375,6 +1383,13 @@ class BoxTrainer:
                 else list(preds)[0])
         tensors["pred"] = tensors["pred_" + main]
         self.metrics.add_batch(tensors)
+        if self.quality is not None:
+            self.quality.add_batch(tensors)
+            self.quality.add_slot_batch(
+                tensors["pred"], b.labels, b.slots, b.segments, b.valid,
+                self.num_slots)
+            from paddlebox_tpu.metrics import drift as _drift
+            _drift.observe_preds(tensors["pred"], mask=mask)
 
     # ------------------------------------------------------ profiled mode
     def _profiled_stages(self):

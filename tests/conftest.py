@@ -39,6 +39,18 @@ def _flag_isolation():
     for name, value in snapshot.items():
         if _f.get_flag(name) != value:
             _f.set_flag(name, value)
+    # round 18: the quality/drift planes keep module-global state (the
+    # live-ops exporter reads them without a binding dance); a drift
+    # reference window leaking across tests would score phantom drift
+    # against the previous test's slot schema
+    from paddlebox_tpu.metrics import drift as _drift
+    from paddlebox_tpu.metrics import quality as _quality
+    _quality.set_active(None)
+    _drift.set_active(None)
+    # with obs_http_port restored (default 0) this closes any exporter
+    # a test left listening, releasing its port for later tests
+    from paddlebox_tpu.obs import exporter as _exporter
+    _exporter.ensure_from_flags()
 
 
 def pytest_configure(config):

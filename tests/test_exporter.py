@@ -1,0 +1,346 @@
+"""Live ops endpoint (round 18): HTTP contract, scrape safety, satellites.
+
+Pins the exporter's endpoint contracts (content types, Prometheus
+exposition shape, per-rank port offset, 404), the degrade paths
+(port-in-use warns + disables, flag 0 closes), scrape-under-load, the
+StepReporter.peek deep-copy satellite, and trace_stitch's postmortem
+mode over flight segment dirs.
+"""
+
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import flags
+from paddlebox_tpu.metrics import drift as drift_mod
+from paddlebox_tpu.metrics import quality as quality_mod
+from paddlebox_tpu.metrics.quality import TaggedQuality
+from paddlebox_tpu.obs import exporter as exporter_mod
+from paddlebox_tpu.obs import flight
+from paddlebox_tpu.obs.exporter import (PROM_CONTENT_TYPE, ObsExporter,
+                                        render_prometheus)
+from paddlebox_tpu.obs.report import ListSink, StepReporter
+from paddlebox_tpu.utils.stats import (StatRegistry, gauge_set,
+                                       hist_observe, stat_add)
+
+
+@pytest.fixture
+def registry():
+    reg = StatRegistry.instance()
+    saved = reg.snapshot_all()
+    reg.reset()
+    yield reg
+    reg.reset()
+    for k, v in saved["counters"].items():
+        reg.set(k, v)
+    for k, v in saved["gauges"].items():
+        reg.set_gauge(k, v)
+
+
+@pytest.fixture
+def exporter():
+    exp = ObsExporter(port=0)       # ephemeral port, direct construction
+    yield exp
+    exp.close()
+
+
+def _get(exp, path, timeout=5.0):
+    r = urllib.request.urlopen(
+        "http://127.0.0.1:%d%s" % (exp.port, path), timeout=timeout)
+    return r.status, r.headers.get("Content-Type", ""), r.read()
+
+
+# ------------------------------------------------------------ endpoints
+
+def test_metrics_exposition_contract(registry, exporter):
+    stat_add("reqs_total", 7)
+    gauge_set("depth_gauge", 2.5)
+    for v in (3.0, 100.0, 9000.0):
+        hist_observe("lat_us", v)
+    status, ctype, body = _get(exporter, "/metrics")
+    assert status == 200
+    assert ctype == PROM_CONTENT_TYPE
+    text = body.decode()
+    assert "# TYPE pbtpu_reqs_total counter" in text
+    assert "pbtpu_reqs_total 7" in text
+    assert "# TYPE pbtpu_depth_gauge gauge" in text
+    assert "pbtpu_depth_gauge 2.5" in text
+    # histogram: cumulative buckets ending at +Inf == count, plus
+    # percentile gauges
+    assert "# TYPE pbtpu_lat_us histogram" in text
+    assert 'pbtpu_lat_us_bucket{le="+Inf"} 3' in text
+    assert "pbtpu_lat_us_count 3" in text
+    assert "pbtpu_lat_us_p99" in text
+    # every non-comment line is "name[{labels}] value"
+    for ln in text.splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        name, val = ln.rsplit(" ", 1)
+        assert name.startswith("pbtpu_")
+        float(val)
+
+
+def test_metrics_carries_quality_and_drift(registry, exporter):
+    rng = np.random.RandomState(0)
+    q = TaggedQuality(table_size=512)
+    pred = rng.rand(500)
+    q.add(pred, (rng.rand(500) < pred).astype(int))
+    quality_mod.set_active(q)
+    m = drift_mod.set_active_new()
+    from tests.test_quality import _block
+    m.observe_block(_block(seed=1))
+    m.roll()
+    q.publish_gauges()      # plain quality_auc/copc gauges land too
+    try:
+        _, _, body = _get(exporter, "/metrics")
+        text = body.decode()
+        assert 'pbtpu_quality_auc{tag="all"}' in text
+        assert 'pbtpu_quality_copc{tag="all"}' in text
+        assert 'pbtpu_slot_actual_ctr{slot=' not in text  # no slot adds
+        assert "pbtpu_data_drift_score 0" in text
+        # Prometheus conformance: one TYPE line per family, and the
+        # quality/drift families appear exactly once even though plain
+        # gauges of the same names sit in the StatRegistry (a second
+        # TYPE — or an interleaved family — is a hard parse error)
+        type_names = [ln.split()[2] for ln in text.splitlines()
+                      if ln.startswith("# TYPE ")]
+        assert len(type_names) == len(set(type_names)), type_names
+        auc_samples = [ln for ln in text.splitlines()
+                       if ln.startswith("pbtpu_quality_auc")]
+        assert auc_samples == ['pbtpu_quality_auc{tag="all"} %.9g'
+                               % q.compute()["auc"]]
+        _, _, qbody = _get(exporter, "/quality")
+        qd = json.loads(qbody)
+        assert qd["quality"]["tags"]["all"]["auc"] == \
+            q.compute()["auc"]
+        assert qd["drift"]["windows"] == 1
+    finally:
+        quality_mod.set_active(None)
+        drift_mod.set_active(None)
+
+
+def test_report_health_stacks_flight_endpoints(registry, exporter,
+                                               tmp_path):
+    rep = StepReporter(rank=0, every=1, sink=ListSink())
+    rep.note_examples(5)
+    rep.maybe_report(1)
+    exporter.bind(reporter=rep)
+    status, ctype, body = _get(exporter, "/report")
+    assert status == 200 and ctype.startswith("application/json")
+    doc = json.loads(body)
+    assert doc["report"]["step"] == 1
+    # no aggregator → own-liveness health answer
+    status, _, body = _get(exporter, "/health")
+    h = json.loads(body)
+    assert status == 200 and h["type"] == "rank_liveness"
+    assert h["last_report_step"] == 1
+    # stacks: every thread, plain text, contains this thread's frame
+    status, ctype, body = _get(exporter, "/stacks")
+    assert status == 200 and ctype.startswith("text/plain")
+    assert b"MainThread" in body
+    # flight: inactive → {"active": false}; active → segments + tail
+    status, _, body = _get(exporter, "/flight")
+    assert not json.loads(body)["active"]
+    prev = flight.set_active(None)
+    fr = flight.FlightRecorder(str(tmp_path / "fl"), rank=0)
+    flight.set_active(fr)
+    try:
+        fr.record("beat", label="x")
+        status, _, body = _get(exporter, "/flight")
+        doc = json.loads(body)
+        assert doc["active"] and len(doc["segments"]) == 1
+        assert any('"type": "beat"' in ln for ln in doc["tail"])
+    finally:
+        flight.set_active(prev)
+        fr.close()
+    # root lists the endpoints; unknown paths 404
+    status, _, body = _get(exporter, "/")
+    assert "/metrics" in json.loads(body)["endpoints"]
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(exporter, "/nope")
+    assert ei.value.code == 404
+
+
+def test_health_serves_cluster_record_behind_aggregator(registry,
+                                                        exporter):
+    from paddlebox_tpu.obs.aggregate import ClusterAggregator
+    from paddlebox_tpu.obs.health import HealthMonitor
+
+    class _NullTransport:
+        def publish(self, payload):
+            pass
+
+        def drain(self):
+            return []
+
+    agg = ClusterAggregator(_NullTransport(), rank=0, world=2,
+                            health=HealthMonitor(2))
+    rep = StepReporter(rank=0, every=1, sink=ListSink(), aggregator=agg)
+    rep.note_examples(1)
+    rep.maybe_report(1)
+    exporter.bind(reporter=rep)
+    _, _, body = _get(exporter, "/health")
+    h = json.loads(body)
+    assert h["type"] == "cluster_health"
+    assert set(h["ranks"]) == {"0", "1"}
+    assert all("score" in e for e in h["ranks"].values())
+    # rank 1 never published: stale path exercised through the merge
+    assert h["ranks"]["1"]["stale_windows"] >= 1
+
+
+def test_scrape_under_load(registry, exporter):
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            stat_add("hammered")
+            hist_observe("hammer_us", 7.0)
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(25):
+            status, _, body = _get(exporter, "/metrics")
+            assert status == 200
+            assert b"pbtpu_hammered" in body
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+
+
+# --------------------------------------------------------- flag plumbing
+
+def test_flag_lifecycle_and_rank_port_offset(registry):
+    base = _free_port_base()
+    flags.set_flag("obs_http_port", base)
+    e0 = exporter_mod.ensure_from_flags(rank=0)
+    assert e0 is not None and e0.port == base
+    assert exporter_mod.ensure_from_flags(rank=0) is e0     # reuse
+    e1 = exporter_mod.ensure_from_flags(rank=1)             # rank swap
+    assert e1 is not e0 and e1.port == base + 1
+    assert _get_port(e1.port, "/metrics")[0] == 200
+    flags.set_flag("obs_http_port", 0)
+    assert exporter_mod.ensure_from_flags() is None
+    assert exporter_mod.active() is None
+
+
+def test_port_in_use_warns_and_disables(registry, capsys):
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    sock.listen(1)
+    port = sock.getsockname()[1]
+    try:
+        flags.set_flag("obs_http_port", port)
+        assert exporter_mod.ensure_from_flags(rank=0) is None
+        err = capsys.readouterr().err
+        assert "obs http exporter disabled" in err
+        # the degrade is counted where the health plane can see it
+        assert StatRegistry.instance().get("log_warning_lines") >= 1
+    finally:
+        sock.close()
+        flags.set_flag("obs_http_port", 0)
+        exporter_mod.ensure_from_flags()
+
+
+def _free_port_base(span: int = 4) -> int:
+    """A base port with `span` free consecutive ports (best effort)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    base = s.getsockname()[1]
+    s.close()
+    return base
+
+
+def _get_port(port, path):
+    r = urllib.request.urlopen("http://127.0.0.1:%d%s" % (port, path),
+                               timeout=5)
+    return r.status, r.read()
+
+
+# ------------------------------------------------------------ satellites
+
+def test_peek_returns_deep_copy(registry):
+    rep = StepReporter(rank=0, every=1, sink=ListSink())
+    rep.note_examples(3)
+    rep.maybe_report(1, extra={"nested": {"k": [1, 2]}})
+    seen = rep.peek()
+    assert seen["nested"]["k"] == [1, 2]
+    # consumer mutation must not reach reporter state
+    seen["nested"]["k"].append(99)
+    seen["step"] = 777
+    again = rep.peek()
+    assert again["nested"]["k"] == [1, 2]
+    assert again["step"] == 1
+    assert rep.last_report["nested"]["k"] == [1, 2]
+    assert rep.peek() is not rep.last_report
+
+
+def test_trace_stitch_postmortem_from_flight_dir(tmp_path, registry):
+    """Two ranks' flight segments (spans records with a shared trace
+    id) stitch into one timeline with a cross-rank flow — no live
+    chrome export involved (the SIGKILL postmortem path)."""
+    from paddlebox_tpu.obs.tracer import get_tracer
+    from tools.trace_stitch import docs_from_flight_dir, main as stitch_main
+
+    d = str(tmp_path / "flightdir")
+    prev = flight.set_active(None)
+    tracer = get_tracer()
+    try:
+        for rank in (0, 1):
+            fr = flight.FlightRecorder(d, rank=rank)
+            tracer.clear()
+            t0 = __import__("time").perf_counter()
+            tracer.record_span("exchange_r%d" % rank, t0, t0 + 0.01,
+                               trace=0xABC0 + 7)      # SHARED id
+            tracer.record_span("local_only_r%d" % rank, t0, t0 + 0.002)
+            fr.on_report({"type": "step_report", "step": 1, "rank": rank})
+            fr.close()
+    finally:
+        tracer.clear()
+        flight.set_active(prev)
+    docs = docs_from_flight_dir(d)
+    assert len(docs) == 2
+    for doc in docs:
+        assert doc["metadata"]["postmortem"]
+        assert doc["metadata"]["clock_origin_unix_s"] > 0
+        assert any(e.get("ph") == "X" for e in doc["traceEvents"])
+    out = str(tmp_path / "stitched.json")
+    rc = stitch_main([d, "-o", out])
+    assert rc == 0                      # cross-rank flow found
+    stitched = json.load(open(out))
+    flows = [e for e in stitched["traceEvents"]
+             if e.get("cat") == "trace"]
+    assert len(flows) >= 2
+    assert {e["pid"] for e in flows} == {0, 1}
+    # an empty dir is a loud exit-2, not a silent zero-flow stitch
+    empty = str(tmp_path / "empty")
+    __import__("os").makedirs(empty)
+    assert stitch_main([empty, "-o", out]) == 2
+
+
+@pytest.mark.slow
+def test_ops_real_cluster():
+    """The round-18 acceptance scenario on a REAL 2-process cluster:
+    /metrics curl-able on both ranks, /health on rank 0 with per-rank
+    scores, and an injected slot drop driving the victim below the
+    healthy bar within 2 report windows (tools/ops_cluster_probe.py)."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-u",
+         os.path.join(repo, "tools", "ops_cluster_probe.py"),
+         "--port", "19765"],
+        capture_output=True, text=True, timeout=280, cwd=repo)
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+    last = json.loads(r.stdout.strip().splitlines()[-1])
+    assert last["all_ok"] is True
+    assert last["windows_to_unhealthy"] <= 2

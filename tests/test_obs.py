@@ -219,7 +219,11 @@ def test_step_report_disabled_and_forced(registry):
     rep2 = obs.StepReporter(every=100, sink=sink)
     rec = rep2.maybe_report(3, force=True, extra={"event": "pass_end"})
     assert rec["event"] == "pass_end"
-    assert rep2.peek() is rec
+    # round 18: peek() returns a DEEP COPY (equal, never the internal
+    # dict) — any consumer may mutate what it gets without corrupting
+    # reporter state (tests/test_exporter.py pins the mutation side)
+    assert rep2.peek() == rec
+    assert rep2.peek() is not rec
 
 
 def test_jsonl_sink_appends(tmp_path, registry):

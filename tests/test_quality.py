@@ -1,0 +1,395 @@
+"""Quality plane (round 18): tagged AUC/COPC/CTR + slot drift monitor.
+
+Pins the acceptance surface: numeric parity of the tagged metrics vs
+plain-numpy oracles AND vs BasicAucCalculator on identical adds (incl.
+empty-tag and one-class masks), the sum-mergeable state (2-virtual-rank
+merged report == single-rank oracle, composed through the rank-0
+cluster merge), per-slot actual/predicted CTR, the drift monitor
+flagging an injected slot drop within ONE report window, and the
+HealthMonitor quality penalties (data_drift weighted past the healthy
+bar, copc band violation flagged).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.data.columnar import ColumnarBlock
+from paddlebox_tpu.metrics import drift as drift_mod
+from paddlebox_tpu.metrics import quality as quality_mod
+from paddlebox_tpu.metrics.auc import BasicAucCalculator
+from paddlebox_tpu.metrics.drift import SlotDriftMonitor
+from paddlebox_tpu.metrics.quality import (TaggedQuality, merged_report,
+                                           table_auc)
+from paddlebox_tpu.obs.aggregate import merge_cluster_reports
+from paddlebox_tpu.obs.health import HealthMonitor
+from paddlebox_tpu.utils.stats import StatRegistry
+
+T = 4096
+
+
+def _data(n=20000, seed=0):
+    rng = np.random.RandomState(seed)
+    pred = rng.rand(n)
+    label = (rng.rand(n) < pred * 0.4).astype(np.int64)
+    return pred, label
+
+
+def _numpy_auc_oracle(pred, label, table_size):
+    """Independent plain-numpy AUC over the same bucketing: trapezoid
+    from the top bucket down (the reference metrics.cc math)."""
+    pos = np.minimum((np.asarray(pred, np.float64)
+                      * table_size).astype(np.int64), table_size - 1)
+    neg_t = np.bincount(pos[label == 0], minlength=table_size
+                        ).astype(np.float64)
+    pos_t = np.bincount(pos[label == 1], minlength=table_size
+                        ).astype(np.float64)
+    area = fp = tp = 0.0
+    for i in range(table_size - 1, -1, -1):
+        newfp, newtp = fp + neg_t[i], tp + pos_t[i]
+        area += neg_t[i] * (tp + newtp) / 2.0
+        fp, tp = newfp, newtp
+    if fp < 1e-3 or tp < 1e-3:
+        return -0.5
+    return area / (fp * tp)
+
+
+# -------------------------------------------------------------- parity
+
+def test_tagged_auc_matches_basic_calculator_bitwise():
+    pred, label = _data()
+    q = TaggedQuality(table_size=T)
+    q.add(pred, label)
+    b = BasicAucCalculator(table_size=T)
+    b.add_data(pred, label)
+    b.compute()
+    m = q.compute()
+    assert m["auc"] == round(b.auc(), 6)
+    assert m["actual_ctr"] == round(b.actual_ctr(), 6)
+    assert m["predicted_ctr"] == round(b.predicted_ctr(), 6)
+    assert m["mae"] == round(b.mae(), 6)
+    assert m["rmse"] == round(b.rmse(), 6)
+
+
+def test_tagged_auc_and_copc_vs_numpy_oracle():
+    pred, label = _data(n=4000, seed=3)
+    q = TaggedQuality(table_size=256)
+    q.add(pred, label)
+    m = q.compute()
+    assert abs(table_auc(q._tables["all"])
+               - _numpy_auc_oracle(pred, label, 256)) < 1e-12
+    assert m["copc"] == round(float(label.sum() / pred.sum()), 6)
+    assert m["actual_ctr"] == round(float(label.mean()), 6)
+    assert m["predicted_ctr"] == round(float(pred.mean()), 6)
+
+
+def test_masked_add_matches_prefiltered():
+    pred, label = _data(n=5000, seed=5)
+    mask = np.arange(5000) % 3 == 0
+    q1 = TaggedQuality(table_size=T)
+    q1.add(pred, label, mask=mask)
+    q2 = TaggedQuality(table_size=T)
+    q2.add(pred[mask], label[mask])
+    assert q1.compute() == q2.compute()
+    assert np.array_equal(q1._tables["all"], q2._tables["all"])
+
+
+def test_empty_tag_and_one_class_masks():
+    q = TaggedQuality(table_size=64)
+    # never-fed tag: empty stream semantics
+    m = q.compute("never_fed")
+    assert m["size"] == 0.0 and m["auc"] == -0.5 and m["copc"] == 0.0
+    # all-one-class: the reference's -0.5 degenerate convention
+    pred, _ = _data(n=100, seed=7)
+    q.add(pred, np.ones(100, np.int64), tag="ones")
+    q.add(pred, np.zeros(100, np.int64), tag="zeros")
+    assert q.compute("ones")["auc"] == -0.5
+    assert q.compute("zeros")["auc"] == -0.5
+    # empty mask add is a no-op, not an error
+    q.add(pred, np.zeros(100), tag="masked", mask=np.zeros(100, bool))
+    assert q.compute("masked")["size"] == 0.0
+
+
+def test_add_tagged_groups_and_skips_zero_with_prefix():
+    pred, label = _data(n=6000, seed=9)
+    tags = np.arange(6000) % 3          # 0, 1, 2
+    q = TaggedQuality(table_size=T)
+    q.add_tagged(pred, label, tags, prefix="cmatch:")
+    names = set(q.report()["tags"])
+    assert names == {"cmatch:1", "cmatch:2"}    # tag 0 skipped
+    oracle = TaggedQuality(table_size=T)
+    oracle.add(pred[tags == 1], label[tags == 1])
+    assert q.compute("cmatch:1") == oracle.compute("all")
+
+
+def test_add_batch_feeds_all_cmatch_and_tasks():
+    pred, label = _data(n=2000, seed=11)
+    cmatch = (np.arange(2000, dtype=np.uint64) % 2) << np.uint64(32)
+    q = TaggedQuality(table_size=T)
+    q.add_batch({"pred": pred, "label": label,
+                 "mask": np.ones(2000, bool), "cmatch_rank": cmatch,
+                 "pred_ctcvr": pred, "label_ctcvr": label})
+    names = set(q.report()["tags"])
+    assert {"all", "cmatch:1", "task:ctcvr"} <= names
+
+
+# ------------------------------------------------------- state / merge
+
+def test_two_virtual_rank_merge_equals_single():
+    pred, label = _data(n=10000, seed=13)
+    whole = TaggedQuality(table_size=T)
+    whole.add(pred, label)
+    whole.add_slot_batch(pred[:6], label[:6],
+                         np.zeros(6, np.int32),
+                         np.array([0, 1, 2, 5, 7, 8]),
+                         np.ones(6, bool), 3)
+    r0 = TaggedQuality(table_size=T)
+    r1 = TaggedQuality(table_size=T)
+    r0.add(pred[:4000], label[:4000])
+    r1.add(pred[4000:], label[4000:])
+    r0.add_slot_batch(pred[:6], label[:6], np.zeros(6, np.int32),
+                      np.array([0, 1, 2, 5, 7, 8]), np.ones(6, bool), 3)
+    # states round-trip through JSON (they ride StepReports on the wire)
+    states = [json.loads(json.dumps(r.state())) for r in (r0, r1)]
+    merged = merged_report(states)
+    assert merged == whole.report()
+    # mismatched table size degrades to the mergeable subset, not a crash
+    bad = TaggedQuality(table_size=128)
+    bad.add(pred[:100], label[:100])
+    still = merged_report(states + [bad.state()])
+    assert still["tags"]["all"] == whole.report()["tags"]["all"]
+
+
+def test_cluster_merge_carries_quality():
+    pred, label = _data(n=8000, seed=17)
+    reports = []
+    whole = TaggedQuality(table_size=T)
+    whole.add(pred, label)
+    for r, sl in ((0, slice(0, 4000)), (1, slice(4000, 8000))):
+        q = TaggedQuality(table_size=T)
+        q.add(pred[sl], label[sl])
+        reports.append({"rank": r, "step": 10, "examples_per_sec": 1.0,
+                        "quality_state": q.state()})
+    merged = merge_cluster_reports(reports)
+    assert merged["quality"]["tags"]["all"] == \
+        whole.report()["tags"]["all"]
+    # reports without states don't grow a quality key
+    assert "quality" not in merge_cluster_reports(
+        [{"rank": 0, "step": 1}])
+
+
+def test_per_slot_ctr_oracle():
+    q = TaggedQuality(table_size=64)
+    # 2 records, 3 slots: rec0 carries slots {0,1} (key in slot 1
+    # twice), rec1 carries slot 2
+    pred = np.array([0.25, 0.75])
+    label = np.array([1, 0])
+    slots = np.array([0, 1, 1, 2], np.int32)
+    segments = np.array([0, 1, 1, 5], np.int32)   # rec*3 + slot
+    valid = np.ones(4, bool)
+    q.add_slot_batch(pred, label, slots, segments, valid, 3)
+    slots_rep = q.report()["slots"]
+    assert slots_rep["0"] == {"n": 1.0, "actual_ctr": 1.0,
+                              "predicted_ctr": 0.25, "copc": 4.0}
+    assert slots_rep["1"]["n"] == 1.0          # distinct (rec, slot) once
+    assert slots_rep["2"] == {"n": 1.0, "actual_ctr": 0.0,
+                              "predicted_ctr": 0.75, "copc": 0.0}
+
+
+def test_add_bucket_table_folds_device_table():
+    pred, label = _data(n=3000, seed=19)
+    fine = TaggedQuality(table_size=4 * T)
+    fine.add(pred, label)
+    q = TaggedQuality(table_size=T)
+    sc = fine._scalars["all"]
+    q.add_bucket_table(fine._tables["all"], *sc)
+    direct = TaggedQuality(table_size=T)
+    direct.add(pred, label)
+    assert np.array_equal(q._tables["all"], direct._tables["all"])
+    assert q.compute() == direct.compute()
+    with pytest.raises(ValueError):
+        q.add_bucket_table(np.zeros((2, T - 1)), 0, 0, 0, 0, 0)
+
+
+# ---------------------------------------------------------------- drift
+
+def _block(n_recs=300, n_slots=4, drop_slot=None, seed=1, keys_per=2):
+    rng = np.random.RandomState(seed)
+    keys, slots, recs = [], [], []
+    for i in range(n_recs):
+        for s in range(n_slots):
+            if s == drop_slot:
+                continue
+            k = rng.randint(1, 2000, size=keys_per)
+            keys.extend(k.tolist())
+            slots.extend([s] * keys_per)
+            recs.extend([i] * keys_per)
+    return ColumnarBlock.from_key_rec(
+        np.array(keys, np.uint64), np.array(slots, np.int32),
+        np.array(recs, np.int64),
+        (rng.rand(n_recs) < 0.2).astype(np.int32))
+
+
+def test_slot_stats_vs_loop_oracle():
+    blk = _block(n_recs=50, seed=23)
+    m = SlotDriftMonitor(drift_warn=0.5)
+    m.observe_block(blk)
+    cur = m._cur.summary()
+    # loop oracle over the block
+    n_slots = int(blk.key_slot.max()) + 1
+    per_rec = [set() for _ in range(blk.n_recs)]
+    key_count = np.zeros(n_slots)
+    uniq = [set() for _ in range(n_slots)]
+    for r in range(blk.n_recs):
+        lo, hi = blk.rec_offsets[r], blk.rec_offsets[r + 1]
+        for k, s in zip(blk.keys[lo:hi], blk.key_slot[lo:hi]):
+            per_rec[r].add(int(s))
+            key_count[s] += 1
+            uniq[s].add(int(k))
+    cov = np.array([sum(s in pr for pr in per_rec)
+                    for s in range(n_slots)]) / blk.n_recs
+    assert np.allclose(cur["coverage"], cov)
+    kpr = key_count / np.maximum(
+        [sum(s in pr for pr in per_rec) for s in range(n_slots)], 1)
+    assert np.allclose(cur["keys_per_rec"], kpr)
+    # linear-count sketch within 15% of the true distinct counts here
+    for s in range(n_slots):
+        assert abs(cur["cardinality"][s] - len(uniq[s])) \
+            < 0.15 * len(uniq[s])
+
+
+def test_drift_flags_injected_slot_drop_within_one_window(registry):
+    m = SlotDriftMonitor(drift_warn=0.5)
+    m.observe_block(_block(seed=1))
+    r1 = m.roll()
+    assert r1["drift"]["score"] == 0.0          # first window = reference
+    m.observe_block(_block(seed=2))
+    r2 = m.roll()
+    assert r2["drift"]["score"] < 0.5           # steady state stays calm
+    m.observe_block(_block(seed=3, drop_slot=2))
+    r3 = m.roll()                               # the injection window
+    assert r3["drift"]["score"] >= 0.5
+    assert r3["drift"]["dropped_slots"] == [2]
+    reg = StatRegistry.instance()
+    assert reg.get_gauge("data_drift_score") >= 0.5
+    assert reg.get_gauge("data_dropped_slots") == 1.0
+
+
+def test_drift_empty_roll_returns_none_and_keeps_reference():
+    m = SlotDriftMonitor(drift_warn=0.5)
+    assert m.roll() is None
+    m.observe_block(_block(seed=1))
+    assert m.roll() is not None
+    assert m.roll() is None                     # eval-only window: no-op
+    assert len(m._ref) == 1
+
+
+def test_drift_pred_distribution_shift_scores(registry):
+    m = SlotDriftMonitor(drift_warn=0.5)
+    rng = np.random.RandomState(0)
+    m.observe_preds(rng.rand(5000) * 0.2)       # low-pred regime
+    m.observe_block(_block(seed=1))
+    m.roll()
+    m.observe_preds(rng.rand(5000) * 0.2 + 0.8)  # calibration blow-up
+    m.observe_block(_block(seed=2))
+    r = m.roll()
+    assert r["drift"]["pred_drift"] > 0.9
+    assert r["drift"]["score"] >= 0.5
+
+
+# --------------------------------------------------------------- health
+
+def _merged_with_gauges(g0: dict, g1: dict) -> dict:
+    reports = []
+    for r, g in ((0, g0), (1, g1)):
+        reports.append({"rank": r, "step": 5, "examples_per_sec": 1.0,
+                        "gauges": g})
+    m = merge_cluster_reports(reports)
+    m["stale_ranks"] = []
+    return m
+
+
+def test_health_drift_penalty_unhealthy_on_its_own():
+    h = HealthMonitor(world=2, drift_warn=0.5)
+    rec = h.update(_merged_with_gauges({"data_drift_score": 0.0},
+                                       {"data_drift_score": 0.9}))
+    assert rec["ranks"]["0"]["healthy"]
+    assert not rec["ranks"]["1"]["healthy"]
+    assert "data_drift" in rec["ranks"]["1"]["flags"]
+    assert rec["unhealthy_ranks"] == [1]
+
+
+def test_health_copc_band_violation_flagged():
+    h = HealthMonitor(world=2)
+    rec = h.update(_merged_with_gauges({"quality_copc": 1.02},
+                                       {"quality_copc": 2.4}))
+    assert rec["ranks"]["0"]["healthy"]
+    assert "miscalibrated" in rec["ranks"]["1"]["flags"]
+    assert rec["ranks"]["1"]["score"] == 0.7
+    # zero copc (no data yet) never flags
+    rec = h.update(_merged_with_gauges({}, {"quality_copc": 0.0}))
+    assert "flags" not in rec["ranks"]["1"]
+
+
+# ------------------------------------------------------------- trainer
+
+def test_trainer_pass_end_carries_quality(registry, tmp_path):
+    import tempfile
+
+    from paddlebox_tpu.config import flags
+    from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
+                                              TableConfig, TrainerConfig)
+    from paddlebox_tpu.data import BoxDataset
+    from paddlebox_tpu.data.generator import write_synthetic_ctr_files
+    from paddlebox_tpu.models.base import ModelSpec
+    from paddlebox_tpu.models.ctr_dnn import CtrDnn
+    from paddlebox_tpu.obs import ListSink
+    from paddlebox_tpu.train.trainer import BoxTrainer
+
+    flags.set_flag("obs_report_every", 1000)    # pass_end force only
+    out = tempfile.mkdtemp(dir=str(tmp_path))
+    files, feed = write_synthetic_ctr_files(
+        out, num_files=1, lines_per_file=256, num_slots=4,
+        vocab_per_slot=500, max_len=3, seed=5)
+    feed = type(feed)(slots=feed.slots, batch_size=64)
+    trainer = BoxTrainer(
+        CtrDnn(ModelSpec(num_slots=4, slot_dim=3 + 4), hidden=(16,)),
+        TableConfig(embedx_dim=4, pass_capacity=1 << 13,
+                    optimizer=SparseOptimizerConfig()),
+        feed, TrainerConfig(dense_lr=1e-3), seed=0)
+    assert trainer.quality is not None
+    assert quality_mod.active() is trainer.quality
+    trainer.reporter.sink = ListSink()
+    ds = BoxDataset(feed, read_threads=1)
+    ds.set_filelist(files)
+    trainer.train_pass(ds)
+    recs = [r for r in trainer.reporter.sink.records
+            if r.get("event") == "pass_end"]
+    assert recs, "no pass_end report"
+    qual = recs[-1].get("quality")
+    assert qual and "all" in qual["tags"]
+    assert qual["tags"]["all"]["size"] > 0
+    assert "copc" in qual["tags"]["all"]
+    assert qual.get("slots"), "per-slot ctr missing"
+    # the ingest hook observed the pass block and pass_end rolled it
+    assert recs[-1].get("data_quality"), "drift window did not roll"
+    assert drift_mod.active() is not None
+    assert StatRegistry.instance().get_gauge("quality_copc") > 0
+    # the whole record (incl. quality extras) is json-serializable —
+    # the sink contract every consumer relies on
+    json.dumps(recs[-1])
+    trainer.close()
+
+
+@pytest.fixture
+def registry():
+    reg = StatRegistry.instance()
+    saved = reg.snapshot_all()
+    reg.reset()
+    yield reg
+    reg.reset()
+    for k, v in saved["counters"].items():
+        reg.set(k, v)
+    for k, v in saved["gauges"].items():
+        reg.set_gauge(k, v)

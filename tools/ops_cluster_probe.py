@@ -1,0 +1,230 @@
+"""Live-ops acceptance probe: a REAL 2-process cluster with HTTP
+endpoints up, quality/drift planes streaming, and an injected slot drop.
+
+The round-18 acceptance scenario end to end:
+
+  * two localhost worker processes rendezvous through a TcpStore fleet,
+    run window-paced report cadences with rank-0 aggregation + health,
+    an active quality plane (synthetic calibrated preds) and a slot
+    drift monitor observing synthetic 4-slot ColumnarBlocks;
+  * every rank binds its ops endpoint at obs_http_port + rank — the
+    parent scrapes ``/metrics`` on BOTH ranks (content-type + exposition
+    sanity + the quality series present), ``/health`` on rank 0
+    (cluster_health with per-rank scores), and measures scrape latency;
+  * at window ``--drop-at`` rank 1's blocks LOSE slot 2 (the broken
+    upstream feature pipeline): the probe asserts rank 0's health plane
+    scores rank 1 below the healthy bar with the ``data_drift`` flag
+    within 2 report windows of the injection, while rank 0 stays
+    healthy.
+
+Usage:  timeout 300 python -u tools/ops_cluster_probe.py
+            [--world 2] [--windows 24] [--drop-at 8] [--port 19750]
+Prints one JSON line with the measurements; exits 1 on failure.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+WINDOW_SECS = 0.3
+
+
+def _make_block(rng, n_recs: int, drop_slot=None):
+    from paddlebox_tpu.data.columnar import ColumnarBlock
+    keys, slots, recs = [], [], []
+    for i in range(n_recs):
+        for s in range(4):
+            if s == drop_slot:
+                continue
+            k = rng.randint(1, 5000, size=2).astype(np.uint64)
+            keys.extend(k.tolist())
+            slots.extend([s, s])
+            recs.extend([i, i])
+    labels = (rng.rand(n_recs) < 0.2).astype(np.int32)
+    return ColumnarBlock.from_key_rec(
+        np.array(keys, np.uint64), np.array(slots, np.int32),
+        np.array(recs, np.int64), labels)
+
+
+def worker() -> None:
+    """One rank: window-paced reports + quality/drift feeds + the ops
+    endpoint (bound by make_step_reporter off obs_http_port)."""
+    from paddlebox_tpu.config import flags
+    from paddlebox_tpu.fleet.fleet import Fleet
+    from paddlebox_tpu.fleet.role_maker import RoleMaker
+    import paddlebox_tpu.obs as obs
+    from paddlebox_tpu.metrics import drift as drift_mod
+    from paddlebox_tpu.metrics import quality as quality_mod
+    from paddlebox_tpu.metrics.quality import attach_pass_extras
+
+    windows = int(os.environ["OPS_WINDOWS"])
+    drop_at = int(os.environ["OPS_DROP_AT"])
+    flags.set_flag("obs_report_every", 1)
+    flags.set_flag("obs_http_port", int(os.environ["OPS_HTTP_PORT"]))
+    fl = Fleet().init(RoleMaker())
+    rank, world = fl.worker_index(), fl.worker_num()
+    aggregator = obs.make_cluster_aggregator(fleet=fl, rank=rank,
+                                             world=world)
+    reporter = obs.make_step_reporter(rank=rank, aggregator=aggregator)
+    quality = quality_mod.TaggedQuality(table_size=4096)
+    quality_mod.set_active(quality)
+    monitor = drift_mod.set_active_new()
+    rng = np.random.RandomState(7 + rank)
+
+    unhealthy_window = -1
+    unhealthy_entry = None
+    for w in range(1, windows + 1):
+        drop = 2 if (rank == 1 and w >= drop_at) else None
+        monitor.observe_block(_make_block(rng, 400, drop_slot=drop))
+        pred = rng.rand(2048)
+        label = (rng.rand(2048) < pred).astype(np.int64)  # calibrated
+        quality.add(pred, label)
+        drift_mod.observe_preds(pred)
+        reporter.note_examples(2048)
+        extra = {"event": "pass_end"}
+        attach_pass_extras(extra, quality, ship_state=True)
+        reporter.maybe_report(w, force=True, extra=extra)
+        if rank == 0:
+            health = aggregator.last_cluster_health
+            if (unhealthy_window < 0 and health
+                    and 1 in health.get("unhealthy_ranks", ())):
+                unhealthy_window = w
+                unhealthy_entry = health["ranks"].get("1")
+                print("UNHEALTHY %d %s" % (w, json.dumps(unhealthy_entry)),
+                      flush=True)
+        print("WINDOW %d" % w, flush=True)
+        time.sleep(WINDOW_SECS)
+    if rank == 0:
+        print("RESULT " + json.dumps({
+            "unhealthy_window": unhealthy_window,
+            "unhealthy_entry": unhealthy_entry,
+            "health": aggregator.last_cluster_health}), flush=True)
+    reporter.close()
+    fl.stop()
+
+
+def _scrape(port: int, path: str, timeout: float = 3.0):
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(
+            "http://127.0.0.1:%d%s" % (port, path), timeout=timeout) as r:
+        body = r.read().decode("utf-8")
+        return (time.perf_counter() - t0, r.status,
+                r.headers.get("Content-Type", ""), body)
+
+
+def run_probe(world: int, windows: int, drop_at: int, port: int) -> dict:
+    from paddlebox_tpu.fleet.store import KVStoreServer
+    server = KVStoreServer(host="127.0.0.1")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    try:
+        for rank in range(world):
+            env = dict(os.environ)
+            env["PYTHONPATH"] = repo + os.pathsep + env.get(
+                "PYTHONPATH", "")
+            env.update({
+                "PBTPU_TRAINER_ID": str(rank),
+                "PBTPU_TRAINERS_NUM": str(world),
+                "PBTPU_STORE_ENDPOINT": "127.0.0.1:%d" % server.port,
+                "OPS_WORKER": "1",
+                "OPS_WINDOWS": str(windows),
+                "OPS_DROP_AT": str(drop_at),
+                "OPS_HTTP_PORT": str(port),
+                "JAX_PLATFORMS": "cpu",
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, "-u", os.path.abspath(__file__)],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True))
+        # wait until rank 0 is a few windows in, then scrape everything
+        for line in procs[0].stdout:
+            if line.startswith("WINDOW") and int(line.split()[1]) >= 3:
+                break
+        scrape_lat = []
+        metrics_ok = {}
+        for rank in range(world):
+            lat, status, ctype, body = _scrape(port + rank, "/metrics")
+            scrape_lat.append(lat)
+            metrics_ok[rank] = (
+                status == 200
+                and ctype.startswith("text/plain; version=0.0.4")
+                and "# TYPE pbtpu_" in body
+                and "pbtpu_quality_auc" in body)
+        # latency sample on rank 0 (the busiest endpoint)
+        for _ in range(20):
+            lat, _, _, _ = _scrape(port, "/metrics")
+            scrape_lat.append(lat)
+        _, _, _, health0 = _scrape(port, "/health")
+        # drain rank 0 to completion for the drift measurement
+        out_rest, err0 = procs[0].communicate(timeout=180)
+        if procs[0].returncode != 0:
+            raise RuntimeError("rank 0 failed:\n" + err0[-3000:])
+        result = None
+        for line in out_rest.splitlines():
+            if line.startswith("RESULT "):
+                result = json.loads(line[len("RESULT "):])
+        if result is None:
+            raise RuntimeError("rank 0 printed no RESULT:\n"
+                               + out_rest[-2000:])
+        procs[1].communicate(timeout=60)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+
+    health0 = json.loads(health0)
+    assert health0.get("type") == "cluster_health", health0
+    assert set(health0.get("ranks", {})) == {str(r)
+                                             for r in range(world)}, health0
+    assert all(metrics_ok.values()), metrics_ok
+    uw = int(result["unhealthy_window"])
+    assert uw > 0, "victim never scored unhealthy: %r" % (result,)
+    windows_to_unhealthy = uw - drop_at
+    assert windows_to_unhealthy <= 2, \
+        "unhealthy after %d windows (bound 2)" % windows_to_unhealthy
+    victim = result.get("unhealthy_entry") or {}
+    assert "data_drift" in (victim.get("flags") or ()), victim
+    assert not victim.get("healthy", True), victim
+    rank0 = (result["health"] or {}).get("ranks", {}).get("0") or {}
+    assert rank0.get("healthy", False), rank0
+    lat_us = np.sort(np.array(scrape_lat) * 1e6)
+    return {"probe": "ops_cluster", "world": world,
+            "windows": windows, "drop_at": drop_at,
+            "metrics_ok": {str(k): v for k, v in metrics_ok.items()},
+            "windows_to_unhealthy": windows_to_unhealthy,
+            "victim_entry": victim,
+            "scrape_p50_us": round(float(lat_us[lat_us.size // 2]), 1),
+            "scrape_max_us": round(float(lat_us[-1]), 1),
+            "all_ok": True}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--world", type=int, default=2)
+    ap.add_argument("--windows", type=int, default=24)
+    ap.add_argument("--drop-at", type=int, default=8)
+    ap.add_argument("--port", type=int, default=19750)
+    args = ap.parse_args()
+    try:
+        out = run_probe(args.world, args.windows, args.drop_at, args.port)
+    except Exception as e:  # noqa: BLE001 — one honest failure line
+        print(json.dumps({"probe": "ops_cluster",
+                          "error": repr(e)[:600]}), flush=True)
+        sys.exit(1)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    if os.environ.get("OPS_WORKER"):
+        worker()
+    else:
+        main()

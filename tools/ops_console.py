@@ -1,0 +1,150 @@
+"""Terminal ops console: poll every rank's live HTTP endpoints.
+
+The operator-side consumer of obs/exporter.py (flag ``obs_http_port``):
+polls ``/report``, ``/health`` and ``/quality`` across a set of ranks
+and renders ONE refreshing table — rank, step, examples/s, health score
+(+ flags), quality auc/copc, drift score — plus the rank-0 cluster
+health summary. Works against trainers and serving replicas alike
+(both bind port + rank off the same flag).
+
+Usage:
+    python tools/ops_console.py --base-port 9100 --ranks 2
+    python tools/ops_console.py 127.0.0.1:9100 127.0.0.1:9101
+    python tools/ops_console.py --base-port 9100 --ranks 2 --once --json
+
+``--once`` prints a single snapshot (scripts, tests); the default loop
+redraws every ``--interval`` seconds until interrupted. ``--json``
+emits the raw merged snapshot as one JSON line instead of the table.
+Exits 0; unreachable ranks render as ``down`` (an ops console must not
+die because a rank did).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+
+def fetch_json(endpoint: str, path: str,
+               timeout: float = 2.0) -> Optional[dict]:
+    try:
+        with urllib.request.urlopen("http://%s%s" % (endpoint, path),
+                                    timeout=timeout) as r:
+            return json.loads(r.read().decode("utf-8"))
+    except Exception:  # noqa: BLE001 — a dead rank renders as down
+        return None
+
+
+def snapshot(endpoints: List[str]) -> dict:
+    """One poll across every rank: {rank_endpoint: {report, health,
+    quality}} + the first merged cluster_health found (rank 0's)."""
+    ranks: Dict[str, dict] = {}
+    cluster = None
+    for ep in endpoints:
+        rep = fetch_json(ep, "/report")
+        health = fetch_json(ep, "/health")
+        qual = fetch_json(ep, "/quality")
+        ranks[ep] = {"report": rep, "health": health, "quality": qual}
+        if (cluster is None and health
+                and health.get("type") == "cluster_health"):
+            cluster = health
+    return {"ts": time.time(), "ranks": ranks, "cluster_health": cluster}
+
+
+def _fmt(v, spec="%s", dash="-"):
+    return spec % v if v is not None else dash
+
+
+def render(snap: dict) -> str:
+    lines = []
+    lines.append("pbtpu ops console  %s"
+                 % time.strftime("%H:%M:%S", time.localtime(snap["ts"])))
+    hdr = ("%-22s %8s %10s %7s %-14s %7s %7s %7s"
+           % ("endpoint", "step", "ex/s", "score", "flags", "auc",
+              "copc", "drift"))
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    cluster = snap.get("cluster_health") or {}
+    cranks = cluster.get("ranks") or {}
+    for ep, d in snap["ranks"].items():
+        rep = (d.get("report") or {}).get("report") or {}
+        if not d.get("report"):
+            lines.append("%-22s %8s" % (ep, "down"))
+            continue
+        rank = str((d.get("report") or {}).get("rank", ""))
+        hent = cranks.get(rank) or {}
+        health = d.get("health") or {}
+        if not hent and health.get("type") == "rank_liveness":
+            hent = {}
+        q = (d.get("quality") or {}).get("quality") or {}
+        allq = (q.get("tags") or {}).get("all") or {}
+        drift = ((d.get("quality") or {}).get("drift") or {})
+        last = (drift.get("last") or {}).get("drift") or {}
+        lines.append("%-22s %8s %10s %7s %-14s %7s %7s %7s" % (
+            ep,
+            _fmt(rep.get("step")),
+            _fmt(rep.get("examples_per_sec"), "%.1f"),
+            _fmt(hent.get("score"), "%.2f"),
+            ",".join(hent.get("flags") or ())[:14] or "-",
+            _fmt(allq.get("auc"), "%.4f"),
+            _fmt(allq.get("copc"), "%.3f"),
+            _fmt(last.get("score"), "%.3f")))
+    if cluster:
+        unhealthy = cluster.get("unhealthy_ranks") or []
+        lines.append("cluster: world=%s step=%s unhealthy=%s"
+                     % (cluster.get("world"), cluster.get("step"),
+                        unhealthy if unhealthy else "none"))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="poll per-rank obs HTTP endpoints into one "
+                    "terminal dashboard")
+    ap.add_argument("endpoints", nargs="*", metavar="HOST:PORT",
+                    help="explicit endpoints (alternative to "
+                         "--base-port/--ranks)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--base-port", type=int, default=0,
+                    help="obs_http_port of the job; rank r polls "
+                         "base+r")
+    ap.add_argument("--ranks", type=int, default=1,
+                    help="number of ranks to poll with --base-port")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw snapshot as one JSON line")
+    args = ap.parse_args(argv)
+    endpoints = list(args.endpoints)
+    if args.base_port:
+        endpoints += ["%s:%d" % (args.host, args.base_port + r)
+                      for r in range(args.ranks)]
+    if not endpoints:
+        ap.error("no endpoints: pass HOST:PORT args or --base-port")
+    while True:
+        snap = snapshot(endpoints)
+        if args.json:
+            print(json.dumps(snap), flush=True)
+        else:
+            out = render(snap)
+            if not args.once:
+                sys.stdout.write("\x1b[2J\x1b[H")     # clear + home
+            print(out, flush=True)
+        if args.once:
+            return 0
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -229,6 +229,68 @@ def main():
     client.close()
     server.drain(timeout=5.0)
 
+    # ---- ops endpoint scrape under pull load (round 18) ---------------
+    # a replica with obs_http_port set binds /metrics at construction
+    # (make_step_reporter → exporter.ensure_from_flags); the leg runs
+    # the closed loop on a side thread while the parent scrapes, so the
+    # number recorded is scrape latency WITH the pull plane busy — the
+    # operator's actual experience — plus the pull rate while scraped.
+    import urllib.request
+
+    from paddlebox_tpu.config import flags as _flags
+    from paddlebox_tpu.obs import exporter as _exporter
+
+    _flags.set_flag("obs_http_port", 19790)
+    server = make_server(cache_rows=0)
+    exp = _exporter.active()
+    if exp is None:
+        # the exporter's documented degrade (port 19790 taken by a
+        # co-tenant/stale probe): skip the leg loudly, don't crash it
+        server.drain(timeout=5.0)
+        _flags.set_flag("obs_http_port", 0)
+        print(json.dumps({"stage": "scrape_under_pull_load",
+                          "skipped": "obs http port 19790 unusable — "
+                                     "exporter degraded off"}),
+              flush=True)
+        return
+    client = ServingClient([("127.0.0.1", server.port)])
+    pulled = {}
+
+    def drive():
+        pulled["res"] = closed_loop(client, batches, SECS)
+
+    th = threading.Thread(target=drive)
+    th.start()
+    lat, errs = [], 0
+    url = "http://127.0.0.1:%d/metrics" % exp.port
+    while th.is_alive():
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(url, timeout=5) as r:
+                ok = (r.status == 200
+                      and b"pbtpu_serving_lookup_us_p99" in r.read())
+            if not ok:
+                errs += 1
+        except OSError:
+            errs += 1
+        lat.append(time.perf_counter() - t0)
+        time.sleep(0.02)
+    th.join()
+    client.close()
+    server.drain(timeout=5.0)
+    _flags.set_flag("obs_http_port", 0)
+    _exporter.ensure_from_flags()       # close + release the port
+    slat = np.sort(np.array(lat) * 1e6)
+    rps, kps, p50, p99 = pulled["res"]
+    print(json.dumps({
+        "stage": "scrape_under_pull_load",
+        "scrapes": int(slat.size), "scrape_errors": errs,
+        "scrape_p50_us": round(float(slat[slat.size // 2]), 0),
+        "scrape_p99_us": round(float(slat[int(0.99 * (slat.size - 1))]),
+                               0),
+        "keys_per_sec_during_scrape": round(kps, 0),
+        "pull_p99_us": round(p99, 0)}), flush=True)
+
 
 if __name__ == "__main__":
     main()

@@ -88,6 +88,13 @@ FLOORS = {
     # store itself; the RPC tiers live in tools/serving_load_probe.py).
     # Recorded under the load guard on 2026-08-03; floor = ~40%
     "serving_lookup_keys_per_sec": (5.0e6, 2e6),
+    # round-18: the tagged quality plane's batch add (bucket np.add.at
+    # + the 5-scalar accumulator bundle over a 256k pred/label window
+    # split across 4 tags — the per-step metric cost the trainers pay
+    # with quality_metrics on; ~0.16 ms at batch 2048). Recorded under
+    # the load guard on 2026-08-04 (load1 0.02, calib 1.1x quiet);
+    # floor = ~40% of recorded
+    "quality_add_keys_per_sec": (13.4e6, 5e6),
     # round-15: the columnar checkpoint plane at the store level, BOTH
     # directions (save = snapshot + fsync'd striped writer pool, load =
     # reader-pool mmap ingest + store install), 512k rows x width 17 on
@@ -107,6 +114,13 @@ CEILINGS = {
     # recorded µs, ceiling = ~2.5x of it (latency noise on this 1-core
     # container is wider than rate noise)
     "serving_lookup_p99_us": (4.6e3, 12e3),
+    # round-18: one /metrics scrape of the live ops endpoint (loopback
+    # HTTP + snapshot_all + Prometheus render with a populated registry
+    # + quality plane), p99 of 50 scrapes. Recorded under the load
+    # guard on 2026-08-04 (load1 0.02, calib 1.1x quiet); ceiling =
+    # ~3.5x (stdlib http.server latency noise under co-tenant load is
+    # wide)
+    "exporter_scrape_p99_us": (5.8e3, 20e3),
 }
 
 RETRIES = 2          # extra isolated re-measures before a floor may fail
@@ -581,6 +595,69 @@ def section_ckpt(rng, K):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def section_quality(rng, K):
+    # --- quality + ops endpoint (round 18) ---------------------------
+    # (a) TaggedQuality.add at the trainers' feed shape: 256k preds/
+    # labels per measure split across 4 tags — bucket np.add.at into
+    # the per-tag [2, T] tables + scalar accumulators; (b) one
+    # /metrics scrape of a live exporter over a populated registry
+    # (the operator-facing read path), p99 of 50 scrapes rides the
+    # CEILINGS check.
+    import urllib.request
+
+    from paddlebox_tpu.metrics.quality import TaggedQuality
+    from paddlebox_tpu.obs.exporter import ObsExporter
+    from paddlebox_tpu.utils.stats import (gauge_set, hist_observe,
+                                           stat_add)
+
+    n = 1 << 18
+    pred = rng.rand(n)
+    label = (rng.rand(n) < pred).astype(np.int64)
+    tags = rng.randint(0, 4, n)
+    q = TaggedQuality(table_size=65536)
+
+    def add_once():
+        q.add_tagged(pred, label, tags)
+
+    rate = timed_rate(add_once, n)
+    report("quality_add_keys_per_sec", rate,
+           remeasure=lambda: timed_rate(add_once, n))
+
+    # a representative registry: a few dozen counters/gauges + two
+    # histograms + the quality plane above (exporter reads it via the
+    # module registration)
+    from paddlebox_tpu.metrics import quality as quality_mod
+    quality_mod.set_active(q)
+    for i in range(32):
+        stat_add("probe_counter_%d" % i, i)
+        gauge_set("probe_gauge_%d" % i, i * 0.5)
+    for v in rng.randint(1, 1 << 20, 512).tolist():
+        hist_observe("probe_hist_us", v)
+        hist_observe("probe_hist2_us", v)
+    exp = ObsExporter(port=0)           # ephemeral port, direct bind
+    url = "http://127.0.0.1:%d/metrics" % exp.port
+    state = {"lat": []}
+
+    def scrape_once():
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(url, timeout=5) as r:
+            r.read()
+        state["lat"].append(time.perf_counter() - t0)
+
+    def p99():
+        state["lat"] = []
+        for _ in range(50):
+            scrape_once()
+        lat = np.sort(np.array(state["lat"]) * 1e6)
+        return float(lat[int(0.99 * (lat.size - 1))])
+
+    try:
+        report("exporter_scrape_p99_us", p99(), remeasure=p99)
+    finally:
+        exp.close()
+        quality_mod.set_active(None)
+
+
 SECTIONS = (
     ("native", section_native),
     ("bucketize", section_bucketize),
@@ -592,6 +669,7 @@ SECTIONS = (
     ("push", section_push),
     ("serving", section_serving),
     ("ckpt", section_ckpt),
+    ("quality", section_quality),
 )
 
 

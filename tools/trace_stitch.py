@@ -18,9 +18,23 @@ enough on one box (the 2-4 process clusters this repro runs); across
 machines the stitch inherits NTP skew, which offsets slices but keeps
 the flow arrows (they bind by id, not by time).
 
+POSTMORTEM mode (round 18): an input that is a DIRECTORY is read as a
+flight-recorder dir (obs/flight.py) — the per-rank segment files'
+``spans`` records (windows of ended spans the recorder lands at report
+cadence, flushed per record so they survive SIGKILL) reconstruct one
+chrome-trace document per rank found in the dir. Span stamps in the
+segments are raw ``perf_counter`` values; each rank's wall anchor is
+estimated from the records' own wall ``ts`` (a spans record is written
+moments after its newest span ended, so ``min(record_ts - newest_t1)``
+over all records bounds the perf-epoch's wall instant from above,
+tightly). Live chrome exports and flight dirs mix freely on one command
+line; the exit contract is unchanged.
+
 Usage:
     python tools/trace_stitch.py trace_r0.json trace_r1.json ... \
         [-o cluster_trace.json]
+    python tools/trace_stitch.py /path/to/flight_dir \
+        [-o cluster_trace.json]        # postmortem, no live export needed
 
 Prints one JSON summary line: ranks, events, flows, cross_rank_flows.
 Exits 1 when the inputs produce no cross-rank flow at all (a stitched
@@ -31,7 +45,9 @@ flowing — the failure this tool exists to catch).
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
 import sys
 from typing import Dict, List, Optional, Tuple
 
@@ -43,6 +59,64 @@ def _trace_of(ev: dict) -> Optional[str]:
         if isinstance(t, str) and t:
             return t
     return None
+
+
+def docs_from_flight_dir(path: str) -> List[dict]:
+    """Flight-recorder dir → one chrome-trace document per rank, built
+    from the segments' ``spans`` records (the postmortem path: works on
+    whatever a SIGKILL'd fleet left flushed on disk).
+
+    Span t0 stamps are raw perf_counter values, so each doc's
+    ``clock_origin_unix_s`` (the wall instant of perf_counter()==0) is
+    estimated from the records themselves: a spans record's wall ``ts``
+    was taken just AFTER its newest span's t1, so ts - max_t1 >= origin
+    and the minimum over records is a tight upper bound (slack = the
+    smallest record-write delay, microseconds on one box)."""
+    by_rank: Dict[int, List[dict]] = {}
+    for seg in sorted(glob.glob(os.path.join(path, "flight_r*_*.jsonl"))):
+        with open(seg, encoding="utf-8", errors="replace") as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue        # torn tail of a killed rank
+                if rec.get("type") == "spans":
+                    by_rank.setdefault(int(rec.get("rank", 0)),
+                                       []).append(rec)
+    docs = []
+    for rank in sorted(by_rank):
+        recs = by_rank[rank]
+        origin = None
+        events: List[dict] = []
+        seen_tids = set()
+        for rec in recs:
+            spans = rec.get("spans") or []
+            newest_t1 = 0.0
+            for name, tid, t0, dur_ms, trace in spans:
+                t0 = float(t0)
+                dur_ms = float(dur_ms)
+                newest_t1 = max(newest_t1, t0 + dur_ms / 1e3)
+                if tid not in seen_tids:
+                    seen_tids.add(tid)
+                    events.append({"ph": "M", "name": "thread_name",
+                                   "pid": rank, "tid": int(tid),
+                                   "args": {"name": "tid%d" % int(tid)}})
+                ev = {"ph": "X", "cat": "obs", "name": name,
+                      "pid": rank, "tid": int(tid),
+                      "ts": round(t0 * 1e6, 3),
+                      "dur": round(dur_ms * 1e3, 3)}
+                if trace:
+                    ev["args"] = {"trace": trace}
+                events.append(ev)
+            if spans and "ts" in rec:
+                est = float(rec["ts"]) - newest_t1
+                origin = est if origin is None else min(origin, est)
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               "metadata": {"rank": rank, "postmortem": True}}
+        if origin is not None:
+            doc["metadata"]["clock_origin_unix_s"] = origin
+        docs.append(doc)
+    return docs
 
 
 def stitch(docs: List[dict]) -> Tuple[dict, dict]:
@@ -121,15 +195,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="merge per-rank chrome traces into one "
                     "Perfetto-loadable cluster timeline with "
                     "cross-rank flow events")
-    ap.add_argument("traces", nargs="+", metavar="TRACE_JSON",
+    ap.add_argument("traces", nargs="+", metavar="TRACE_JSON_OR_DIR",
                     help="per-rank chrome-trace files "
-                         "(obs.export_chrome_trace output)")
+                         "(obs.export_chrome_trace output) and/or "
+                         "flight-recorder dirs (postmortem mode: "
+                         "per-rank docs rebuilt from the segments' "
+                         "spans records)")
     ap.add_argument("-o", "--out", default="cluster_trace.json",
                     help="stitched output path (default: "
                          "cluster_trace.json)")
     args = ap.parse_args(argv)
     docs = []
     for p in args.traces:
+        if os.path.isdir(p):
+            found = docs_from_flight_dir(p)
+            if not found:
+                print(json.dumps({"error": "no spans records under "
+                                           "flight dir %s" % p}))
+                return 2
+            docs.extend(found)
+            continue
         with open(p, encoding="utf-8") as fh:
             docs.append(json.load(fh))
     doc, summary = stitch(docs)
