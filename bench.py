@@ -604,6 +604,85 @@ def measure(platform: str) -> None:
     except Exception as e:  # noqa: BLE001 — diagnostic tier, not the metric
         quality = {"error": repr(e)[:300]}
 
+    def lockwatch_overhead() -> dict:
+        """Round-19 acceptance block: the runtime lock-order validator
+        (flag debug_lock_order, utils/lockwatch.py). OFF is the
+        production default and constructs PLAIN threading locks — parity
+        with unwired code is by construction (type identity asserted
+        here) and the cross-round e2e trend (bench_trend over the
+        headline rates) is the step-block regression guard. What needs
+        measuring is the ON cost: per-acquire wrapper overhead and the
+        hot Channel's put/get rate — each arm constructs its OWN objects
+        (locks wire at construction), paired alternating per the
+        container-drift discipline of the other overhead blocks."""
+        import threading as _th
+
+        from paddlebox_tpu.config import flags as _flags
+        from paddlebox_tpu.utils import lockwatch as _lw
+        from paddlebox_tpu.utils.channel import Channel as _Chan
+
+        _flags.set_flag("debug_lock_order", False)
+        off_is_plain = type(_lw.make_lock("bench._plain")) is type(
+            _th.Lock())
+
+        def acquire_rate(lock, n=200_000):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                with lock:
+                    pass
+            return n / (time.perf_counter() - t0)
+
+        def chan_rate(n=50_000):
+            c = _Chan(capacity=1024)
+            t0 = time.perf_counter()
+            done = 0
+            while done < n:
+                burst = min(1024, n - done)
+                for i in range(burst):
+                    c.put(i)
+                for _ in range(burst):
+                    c.get()
+                done += burst
+            return n / (time.perf_counter() - t0)
+
+        acq_ratios, chan_ratios = [], []
+        try:
+            for i in range(5):
+                order = (False, True) if i % 2 else (True, False)
+                acq, ch = {}, {}
+                for on in order:
+                    _flags.set_flag("debug_lock_order", on)
+                    _lw.reset()
+                    acq[on] = acquire_rate(_lw.make_lock(f"bench._l{i}"))
+                    ch[on] = chan_rate()
+                acq_ratios.append(acq[True] / max(acq[False], 1e-9))
+                chan_ratios.append(ch[True] / max(ch[False], 1e-9))
+        finally:
+            # a raise mid-loop must not leave the watch ON for the later
+            # headline blocks (watched Channels are ~9x slower — a leak
+            # here would record a phantom cross-round regression)
+            _flags.set_flag("debug_lock_order", False)
+            _lw.reset()
+        acq_med = float(np.median(acq_ratios))
+        chan_med = float(np.median(chan_ratios))
+        return {"off_constructs_plain_lock": off_is_plain,
+                "acquire_on_off_ratios": [round(r, 4) for r in acq_ratios],
+                "channel_on_off_ratios": [round(r, 4)
+                                          for r in chan_ratios],
+                # positive = the WATCHED (debug) mode costs throughput;
+                # the off arm is the production path
+                "on_acquire_overhead_pct": round(100.0 * (1.0 - acq_med),
+                                                 2),
+                "on_channel_overhead_pct": round(100.0 * (1.0 - chan_med),
+                                                 2)}
+
+    # round-19: lockwatch runtime-twin cost record (off = parity by
+    # construction + trend guard; on = the debug-mode price). GUARDED.
+    try:
+        lockwatch_cost = lockwatch_overhead()
+    except Exception as e:  # noqa: BLE001 — diagnostic tier, not the metric
+        lockwatch_cost = {"error": repr(e)[:300]}
+
     # pass-amortized tier (round-6): the full begin_feed → train →
     # end_pass lifecycle at 0% and ~90% working-set overlap, full vs
     # incremental lifecycle — the honest cadence number the resident
@@ -1038,6 +1117,7 @@ def measure(platform: str) -> None:
         "telemetry_overhead": telemetry,
         "flight_overhead": flight,
         "quality_overhead": quality,
+        "lockwatch_overhead": lockwatch_cost,
         "compile_warmup_s": round(t_compile, 1),
     }))
 
@@ -1158,6 +1238,7 @@ def main() -> None:
         "telemetry_overhead": result.get("telemetry_overhead"),
         "flight_overhead": result.get("flight_overhead"),
         "quality_overhead": result.get("quality_overhead"),
+        "lockwatch_overhead": result.get("lockwatch_overhead"),
         "hostplane": hostplane,
         "compile_warmup_s": result.get("compile_warmup_s"),
         "diags": diags,

@@ -532,3 +532,12 @@ define_flag("preload_promote", True,
             "WaitFeedPassDone tail-hiding role, box_wrapper.h:1131-1172); "
             "only active with incremental_pass and a store that supports "
             "lookup_present")
+define_flag("debug_lock_order", False,
+            "construct the package's locks through the lockwatch runtime "
+            "validator (utils/lockwatch.py): records per-thread "
+            "acquisition order in the static BX7xx Class._attr identity "
+            "vocabulary, flags AB/BA inversions loudly the first time "
+            "both nestings are observed (lockwatch_inversions stat), and "
+            "publishes lock_hold_us_<name> histograms through the obs "
+            "StatRegistry. Off (default) = plain threading locks, zero "
+            "added cost; the concurrency suites run with it on")

@@ -52,6 +52,7 @@ from paddlebox_tpu.data.slot_record import SlotRecord
 from paddlebox_tpu.utils.channel import register_depth_gauge
 from paddlebox_tpu.utils.rpc import recv_exact
 from paddlebox_tpu.utils.stats import stat_add
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 _REC_MAGIC = 0x50425852  # "PBXR"
 
@@ -156,7 +157,7 @@ class ShufflerBase:
         self.world = world
         self.batch_records = batch_records
         self._out: List[List[SlotRecord]] = [[] for _ in range(world)]  # guarded-by: _out_lock
-        self._out_lock = threading.Lock()
+        self._out_lock = make_lock("ShufflerBase._out_lock")
         # pass epoch: frames are tagged so a fast peer's next-pass records
         # can't leak into this rank's still-draining current pass
         self.epoch = 0
@@ -164,8 +165,8 @@ class ShufflerBase:
         # individually) and/or ColumnarBlocks (block codec, appended
         # whole) — _deliver sniffs the frame magic
         self._inbox: Dict[int, List[Union[SlotRecord, ColumnarBlock]]] = {}  # guarded-by: _inbox_lock
-        self._inbox_lock = threading.Lock()
-        self._done_from: Dict[int, set] = {}
+        self._inbox_lock = make_lock("ShufflerBase._inbox_lock")
+        self._done_from: Dict[int, set] = {}  # guarded-by: _done_cv
         self._done_cv = threading.Condition()
         # parked-inbox depth rides the same sampled gauge machinery as
         # the dataset channels (chan_shuffle_inbox_depth, round 17)

@@ -25,6 +25,7 @@ from paddlebox_tpu.embedding.accessor import (ValueLayout, CLICK,
                                               DELTA_SCORE, SHOW,
                                               UNSEEN_DAYS)
 from paddlebox_tpu.utils.stats import stat_add
+from paddlebox_tpu.utils.lockwatch import make_rlock
 
 
 def apply_missed_days(vals: np.ndarray, missed, decay_rate: float) -> None:
@@ -117,20 +118,20 @@ class HostEmbeddingStore:
         self.layout = layout
         self.table = table
         self._rng = np.random.RandomState(seed)
-        self._index: Dict[int, int] = {}
+        self._index: Dict[int, int] = {}  # guarded-by: _lock
         self._values = np.zeros((_GROW, layout.width), dtype=np.float32)
         self._free: List[int] = list(range(_GROW - 1, -1, -1))
-        self._lock = threading.RLock()
+        self._lock = make_rlock("HostEmbeddingStore._lock")
         # SSD spill tier; file tag is per-store so shards sharing one
         # ssd_dir can't clobber each other's blocks
         self._spill_dir = table.ssd_dir
-        self._spilled: Dict[int, Tuple[str, int]] = {}  # key -> (file, offset row)
+        self._spilled: Dict[int, Tuple[str, int]] = {}  # guarded-by: _lock (key -> (file, offset row))
         self._spill_seq = 0  # monotonic file id (len(_spilled) can shrink)
         self._spill_tag = f"{os.getpid():x}_{id(self):x}"
         self._age_book = SpillAgeBook()
         self._file_live: Dict[str, int] = {}  # file → live rows (GC at 0)
 
-    def __len__(self) -> int:
+    def __len__(self) -> int:  # boxlint: disable=BX401 — GIL-atomic len probe, boundary read
         return len(self._index)
 
     # ------------------------------------------------------------- internal
@@ -336,7 +337,7 @@ class HostEmbeddingStore:
     def _dec_file_live(self, fname: str, n: int) -> None:
         dec_file_live(self._file_live, fname, n)
 
-    def _fault_in(self, key: int) -> int:
+    def _fault_in(self, key: int) -> int:  # boxlint: disable=BX401 — caller holds _lock (the *_locked contract)
         fname, off = self._spilled.pop(key)
         row_data = np.array(np.load(fname, mmap_mode="r")[off])
         missed = self._age_book.missed_days(key, pop=True)

@@ -40,6 +40,7 @@ from paddlebox_tpu.embedding.optimizers import apply_push
 from paddlebox_tpu.obs.tracer import record_span
 from paddlebox_tpu.utils.stats import gauge_set, stat_add
 from paddlebox_tpu.utils.timer import Timer
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 
 @functools.partial(jax.jit, static_argnames=("layout",))
@@ -342,7 +343,7 @@ class PassTable:
         self._touch_seen = False  # any mark this pass? (else full writeback)
         self._residency_poisoned = False  # mid-pass invalidate: drop at end
         self._staged: Optional[Tuple[np.ndarray, np.ndarray]] = None
-        self.store_lock = threading.Lock()
+        self.store_lock = make_lock("PassTable.store_lock")
         self.timers = {name: Timer() for name in
                        ("feed", "build", "pull", "push", "end")}
         # touched-row journal (round 15): when attached, end_pass appends
@@ -431,7 +432,9 @@ class PassTable:
         try:
             self._drop_route_index()
             self._drop_prev_route()
-        except Exception:
+        except Exception:  # rationale: __del__ may run with a
+            # half-torn-down interpreter where even logging fails;
+            # the explicit drop paths are the loud ones
             pass
 
     @staticmethod

@@ -18,6 +18,7 @@ from typing import Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 
 class ReplicaCache:
@@ -27,7 +28,7 @@ class ReplicaCache:
     def __init__(self, dim: int) -> None:
         self.dim = dim
         self._rows: List[np.ndarray] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("ReplicaCache._lock")
         self._device: Optional[jnp.ndarray] = None
 
     def add_items(self, emb: np.ndarray) -> int:
@@ -78,7 +79,7 @@ class InputTable:
         self.dim = dim
         self._offsets: Dict[str, int] = {}
         self._rows: List[np.ndarray] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("InputTable._lock")
         self._device: Optional[jnp.ndarray] = None
         self.miss = 0
         self.add_index_data("-", np.zeros(dim, np.float32))

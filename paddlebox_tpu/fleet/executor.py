@@ -20,6 +20,7 @@ import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from paddlebox_tpu.utils.rpc import FramedClient, FramedServer, plain_loads
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 STOP = "__stop__"
 
@@ -88,7 +89,7 @@ class Carrier:
         # interceptor_id → (host, port) for remote destinations
         self._routes: Dict[int, Tuple[str, int]] = {}
         self._clients: Dict[Tuple[str, int], FramedClient] = {}
-        self._clients_lock = threading.Lock()
+        self._clients_lock = make_lock("Carrier._clients_lock")
         self._rpc = FramedServer(self._on_remote, plain_loads, host, port)
 
     @property
@@ -118,9 +119,18 @@ class Carrier:
             raise KeyError("no route to interceptor %d" % msg.dst_id)
         with self._clients_lock:
             cl = self._clients.get(ep)
-            if cl is None:
-                cl = FramedClient(ep[0], ep[1], plain_loads)
-                self._clients[ep] = cl
+        if cl is None:
+            # dial OUTSIDE _clients_lock (the mesh_comm send_obs
+            # discipline, boxlint BX601): a blackholed peer must stall
+            # only this sender for the connect timeout, not every thread
+            # routing through the carrier
+            fresh = FramedClient(ep[0], ep[1], plain_loads)
+            with self._clients_lock:
+                cl = self._clients.get(ep)
+                if cl is None:
+                    cl = self._clients[ep] = fresh
+            if cl is not fresh:  # lost a dial race; use the winner
+                fresh.close()
         cl.call(msg.to_wire())
 
     def _on_remote(self, wire: dict) -> bool:
@@ -148,7 +158,7 @@ class FleetExecutor:
         self.carrier = carrier or Carrier()
         self._done = threading.Event()
         self.results: List[Any] = []
-        self._results_lock = threading.Lock()
+        self._results_lock = make_lock("FleetExecutor._results_lock")
 
     def add_sink(self, interceptor_id: int,
                  expect: int) -> Interceptor:

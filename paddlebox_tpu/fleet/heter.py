@@ -34,6 +34,7 @@ from paddlebox_tpu.data.dataset import BoxDataset
 from paddlebox_tpu.metrics.auc import MetricRegistry
 from paddlebox_tpu.ps.worker import Communicator, DownpourTrainer
 from paddlebox_tpu.utils.rpc import FramedClient, FramedServer, make_loads
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 
 def _allow(module: str, name: str) -> bool:
@@ -61,7 +62,7 @@ class HeterDenseService:
         self.params = model.init(jax.random.PRNGKey(seed))
         self.opt = optax.adam(dense_lr)
         self.opt_state = self.opt.init(self.params)
-        self._lock = threading.Lock()
+        self._lock = make_lock("HeterDenseService._lock")
 
         def loss_fn(params, emb, batch):
             pooled = fused_seqpool_cvm(emb, batch["segments"],

@@ -40,6 +40,7 @@ from paddlebox_tpu.obs.tracer import (current_trace, record_span,
                                       step_trace_id)
 from paddlebox_tpu.utils.rpc import FramedClient, FramedServer, plain_loads
 from paddlebox_tpu.utils.stats import hist_observe
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 
 class MeshConnectError(ConnectionError):
@@ -105,7 +106,7 @@ class MeshComm:
         # parked here by the connection threads, drained by the local
         # reporter at its own cadence — no sequencing, no lockstep
         self._obs_inbox: List[bytes] = []  # guarded-by: _cv
-        self._conn_lock = threading.Lock()
+        self._conn_lock = make_lock("MeshComm._conn_lock")
         self._clients: Dict[int, FramedClient] = {}  # guarded-by: _conn_lock
         self._endpoints: Dict[int, Tuple[str, int]] = {}  # guarded-by: _conn_lock
         # telemetry frames ride their OWN short-timeout connection: a
@@ -363,19 +364,32 @@ class MeshComm:
         """Dial every peer's FramedServer; persistent for the process
         lifetime. Raises MeshConnectError naming the first unreachable
         peer so the caller can fall back loudly."""
+        eps = {int(r): (h, int(p)) for r, (h, p) in endpoints.items()}
         with self._conn_lock:
-            self._endpoints.update({int(r): (h, int(p))
-                                    for r, (h, p) in endpoints.items()})
-            for r, (host, port) in sorted(endpoints.items()):
-                if r == self.rank or r in self._clients:
-                    continue
-                try:
-                    self._clients[r] = FramedClient(
-                        host, port, plain_loads, timeout=timeout)
-                except OSError as e:
-                    raise MeshConnectError(
-                        "mesh peer %d unreachable at %s:%d: %r"
-                        % (r, host, port, e)) from e
+            self._endpoints.update(eps)
+            missing = [(r, hp) for r, hp in sorted(eps.items())
+                       if r != self.rank and r not in self._clients]
+        # dial OUTSIDE _conn_lock (boxlint BX601): bring-up dials W-1
+        # peers sequentially — holding the lock across them would freeze
+        # every concurrent _client/send_obs lookup for the whole window
+        # (and the elastic re-rendezvous path will re-enter here mid-run)
+        fresh: Dict[int, FramedClient] = {}
+        try:
+            for r, (host, port) in missing:
+                fresh[r] = FramedClient(
+                    host, port, plain_loads, timeout=timeout)
+        except OSError as e:
+            for c in fresh.values():
+                c.close()
+            raise MeshConnectError(
+                "mesh peer %d unreachable at %s:%d: %r"
+                % (r, host, port, e)) from e
+        with self._conn_lock:
+            for r, c in fresh.items():
+                if r in self._clients:  # lost a dial race; use the winner
+                    c.close()
+                else:
+                    self._clients[r] = c
 
     def rank_of_position(self) -> Dict[int, int]:
         """mesh device position -> owning fleet rank (from rendezvous)."""

@@ -19,8 +19,8 @@ from paddlebox_tpu.utils.rpc import FramedClient, FramedServer, plain_loads
 
 class KVStoreServer:
     def __init__(self, host: str = "0.0.0.0", port: int = 0) -> None:
-        self._kv: Dict[str, bytes] = {}
-        self._counters: Dict[str, int] = {}
+        self._kv: Dict[str, bytes] = {}  # guarded-by: _cv
+        self._counters: Dict[str, int] = {}  # guarded-by: _cv
         self._cv = threading.Condition()
         self._rpc = FramedServer(self._handle, plain_loads, host, port)
 

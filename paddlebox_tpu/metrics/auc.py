@@ -17,6 +17,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 # allreduce_fn(np.ndarray) -> np.ndarray summed across workers
 AllreduceFn = Callable[[np.ndarray], np.ndarray]
@@ -61,7 +62,7 @@ class BasicAucCalculator:
         and merges it here once per pass via add_bucket_stats — no
         per-step pred D2H. Off: per-batch host adds (add_data)."""
         self._mode_collect_in_device = mode_collect_in_device
-        self._lock = threading.Lock()
+        self._lock = make_lock("BasicAucCalculator._lock")
         self._table_size = 0
         self.init(table_size)
 
@@ -209,28 +210,36 @@ class BasicAucCalculator:
 
     # --------------------------------------------------------------- compute
     def compute(self, allreduce: Optional[AllreduceFn] = None) -> None:
-        """metrics.cc:273-343 with pluggable cross-worker reduction."""
+        """metrics.cc:273-343 with pluggable cross-worker reduction.
+
+        Snapshot under the lock, reduce + run the trapezoid OUTSIDE it,
+        write results back under the lock: ``allreduce`` is a cross-worker
+        collective (seconds under skew) and the bucket math is O(table) —
+        holding ``_lock`` across either stalls every concurrent
+        ``add_data`` on the training path (the round-18 quality-plane
+        hand-review finding; boxlint BX601 pins the class now)."""
         with self._lock:
-            table = self._table
-            if allreduce is not None:
-                table = allreduce(table.copy())
-
-            # trapezoid from the top bucket down (shared helper)
-            self._auc, fp, tp = trapezoid_auc(table)
-
+            table = self._table.copy()
             local = np.array(
                 [self._local_abserr, self._local_sqrerr, self._local_pred],
                 dtype=np.float64)
-            if allreduce is not None:
-                local = allreduce(local)
-            total = fp + tp
+        if allreduce is not None:
+            table = allreduce(table)
+            local = allreduce(local)
+
+        # trapezoid from the top bucket down (shared helper)
+        auc, fp, tp = trapezoid_auc(table)
+        bucket_error = self._calculate_bucket_error(table[0], table[1])
+        total = fp + tp
+        with self._lock:
+            self._auc = auc
             if total > 0:
                 self._mae = float(local[0]) / total
                 self._rmse = math.sqrt(float(local[1]) / total)
                 self._predicted_ctr = float(local[2]) / total
                 self._actual_ctr = tp / total
             self._size = total
-            self._bucket_error = self._calculate_bucket_error(table[0], table[1])
+            self._bucket_error = bucket_error
 
     def _calculate_bucket_error(self, neg_table: np.ndarray,
                                 pos_table: np.ndarray) -> float:
@@ -379,13 +388,16 @@ class BasicAucCalculator:
         return tp, fp, -1
 
     def compute_nan_inf(self, allreduce: Optional[AllreduceFn] = None) -> None:
-        """computeNanInfMsg (metrics.cc:621+)."""
+        """computeNanInfMsg (metrics.cc:621+). Same snapshot / reduce-
+        outside / write-back discipline as compute(): the collective must
+        not run under the add-path lock."""
         with self._lock:
             v = np.array([self._nan_cnt, self._inf_cnt, self._nan_total],
                          np.float64)
-            if allreduce is not None:
-                v = allreduce(v)
-            nan_cnt, inf_cnt, total = float(v[0]), float(v[1]), float(v[2])
+        if allreduce is not None:
+            v = allreduce(v)
+        nan_cnt, inf_cnt, total = float(v[0]), float(v[1]), float(v[2])
+        with self._lock:
             self._nan_inf_rate = (nan_cnt + inf_cnt) / total if total else 0.0
 
     def compute_continue_msg(self, allreduce: Optional[AllreduceFn] = None) -> None:
@@ -395,9 +407,10 @@ class BasicAucCalculator:
             v = np.array([self._local_abserr, self._local_sqrerr,
                           self._local_pred, self._local_label,
                           self._local_total_num], np.float64)
-            if allreduce is not None:
-                v = allreduce(v)
-            total = float(v[4])
+        if allreduce is not None:
+            v = allreduce(v)  # collective outside the add-path lock
+        total = float(v[4])
+        with self._lock:
             if total > 0:
                 self._mae = float(v[0]) / total
                 self._rmse = math.sqrt(float(v[1]) / total)

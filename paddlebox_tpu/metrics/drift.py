@@ -42,6 +42,7 @@ import time
 from typing import Dict, List, Optional
 
 import numpy as np
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 #: per-slot linear-counting sketch bits (2 KiB of bools per slot seen)
 SKETCH_BITS = 2048
@@ -121,7 +122,7 @@ class SlotDriftMonitor:
         self.drift_warn = float(drift_warn)
         self.history = int(history)
         self.min_coverage = float(min_coverage)
-        self._lock = threading.Lock()
+        self._lock = make_lock("SlotDriftMonitor._lock")
         self._cur = _Window()                # guarded-by: _lock
         self._ref: List[dict] = []           # guarded-by: _lock
         self.last_roll: Optional[dict] = None

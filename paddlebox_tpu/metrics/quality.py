@@ -37,6 +37,7 @@ import threading
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 STATE_VERSION = 1
 
@@ -72,7 +73,7 @@ class TaggedQuality:
             from paddlebox_tpu.config import flags
             table_size = int(flags.get_flag("quality_table_size"))
         self.table_size = int(table_size)
-        self._lock = threading.Lock()
+        self._lock = make_lock("TaggedQuality._lock")
         self._tables: Dict[str, np.ndarray] = {}    # guarded-by: _lock
         self._scalars: Dict[str, np.ndarray] = {}   # guarded-by: _lock
         # per-slot ctr accumulators, grown on demand: [n_slots] each

@@ -13,7 +13,10 @@ _BUILD = os.path.join(_DIR, "_build")
 _SOURCES = ["slot_parser.cc", "host_store.cc", "route.cc"]
 _LIB_NAME = "libpbtpu_native.so"
 
-_lock = threading.Lock()
+# RLock: get_lib is reachable from __del__ paths (destroy_route_index via
+# store/table finalizers) — a GC-triggered finalizer on the thread that is
+# mid-build must re-enter, not self-deadlock (boxlint BX801)
+_lock = threading.RLock()
 _lib: Optional[ctypes.CDLL] = None
 _failed = False
 
@@ -37,7 +40,9 @@ def _build() -> str:
         tmp = f"{so_path}.{os.getpid()}.tmp"
         cmd = ["g++", "-O3", "-shared", "-fPIC",
                "-std=c++17", "-o", tmp, *srcs]
-        subprocess.run(cmd, check=True, capture_output=True)
+        # bounded: a wedged toolchain must fail loudly into the degraded
+        # pure-python tier, not hang import/teardown forever (BX802)
+        subprocess.run(cmd, check=True, capture_output=True, timeout=600)
         os.replace(tmp, so_path)
     return so_path
 
@@ -253,7 +258,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _failed:
             return _lib
         try:
-            _lib = _bind(ctypes.CDLL(_build()))
+            # the lock IS the build serializer: exactly one thread may g++
+            # the .so; contenders legitimately wait on the (bounded,
+            # first-call-only) compile
+            _lib = _bind(ctypes.CDLL(_build()))  # boxlint: disable=BX601
         except Exception as e:
             # LOUD degraded mode: every consumer (host store, router,
             # parser) silently drops to a ~10× slower pure-python path —

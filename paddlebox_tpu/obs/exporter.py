@@ -43,6 +43,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 SCHEMA_VERSION = 1
 
@@ -158,7 +159,7 @@ class ObsExporter:
         self.rank = int(rank)
         self.host = host
         self._reporter = None  # guarded-by: _lock
-        self._lock = threading.Lock()
+        self._lock = make_lock("ObsExporter._lock")
         exporter = self
 
         class _Handler(BaseHTTPRequestHandler):

@@ -33,6 +33,7 @@ import time
 import traceback
 from collections import deque
 from typing import IO, List, Optional
+from paddlebox_tpu.utils.lockwatch import make_rlock
 
 SCHEMA_VERSION = 1
 
@@ -100,7 +101,7 @@ class FlightRecorder:
         self.max_segments = max(1, int(max_segments))
         self.beat_secs = float(beat_secs)
         self.last_k_spans = int(last_k_spans)
-        self._lock = threading.RLock()
+        self._lock = make_rlock("FlightRecorder._lock")
         self._fh: Optional[IO[str]] = None  # guarded-by: _lock
         self._seg_idx = 0  # guarded-by: _lock
         self._seg_bytes = 0  # guarded-by: _lock

@@ -30,6 +30,7 @@ import threading
 import time
 import weakref
 from typing import Dict, List, Optional, Tuple
+from paddlebox_tpu.utils.lockwatch import make_rlock
 
 # process-relative clock origin: chrome ts fields are µs since this epoch.
 # _EPOCH_UNIX is the SAME instant on the wall clock (taken back-to-back)
@@ -199,7 +200,7 @@ class SpanTracer:
         # reads last_spans() from the signal handler, which may interrupt
         # this very thread mid-all_spans() — a plain lock would deadlock
         # the dying process instead of sealing and re-delivering
-        self._reg_lock = threading.RLock()
+        self._reg_lock = make_rlock("SpanTracer._reg_lock")
         self._local = threading.local()
 
     def _ring(self) -> _ThreadRing:

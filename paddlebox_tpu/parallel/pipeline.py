@@ -997,7 +997,9 @@ class CtrPipelineRunner:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # rationale: __del__ may run with a
+            # half-torn-down interpreter where even logging fails;
+            # close() is the loud path, this is the last-resort guard
             pass
 
     def train_pass(self, dataset) -> Dict[str, float]:
@@ -1585,7 +1587,9 @@ class ShardedCtrPipelineRunner:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # rationale: __del__ may run with a
+            # half-torn-down interpreter where even logging fails;
+            # close() is the loud path, this is the last-resort guard
             pass
 
     def train_pass(self, dataset) -> Dict[str, float]:

@@ -40,6 +40,7 @@ from paddlebox_tpu.embedding.native_store import make_host_store
 from paddlebox_tpu.obs import beat as obs_beat
 from paddlebox_tpu.obs.tracer import record_span
 from paddlebox_tpu.utils.stats import hist_observe, stat_add
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 
 _warned_numpy_route = False
@@ -488,7 +489,7 @@ class ShardedPassTable:
         self._touched_sh: Optional[dict] = None  # shard -> bool[shard_cap]
         self._touch_seen = False  # any mark this pass? (else full writeback)
         self._staged_sh: Optional[Tuple[np.ndarray, np.ndarray]] = None
-        self.store_lock = threading.Lock()
+        self.store_lock = make_lock("ShardedPassTable.store_lock")
         # touched-row journal (round 15): when attached, every end-of-pass
         # write-back also appends its (keys, rows) delta, and the
         # out-of-cadence lifecycle mutations append event records
@@ -518,7 +519,9 @@ class ShardedPassTable:
     def __del__(self):
         try:
             self._drop_route_index()
-        except Exception:
+        except Exception:  # rationale: __del__ may run with a
+            # half-torn-down interpreter where even logging fails;
+            # close() is the loud path, this is the last-resort guard
             pass
 
     # ------------------------------------------------------- pass lifecycle

@@ -1176,7 +1176,9 @@ class ShardedBoxTrainer:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # rationale: __del__ may run with a
+            # half-torn-down interpreter where even logging fails;
+            # close() is the loud path, this is the last-resort guard
             pass
 
     def _add_metrics(self, preds, step_batches: Tuple[PackedBatch, ...]) -> None:

@@ -62,6 +62,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 # the 2-D grid's dedicated mesh axes — declared here so the BX2xx
 # collective-axis vocabulary (tools/boxlint/collectives.py collects
@@ -108,7 +109,7 @@ class FreqSketch:
     def __init__(self, cap: int = 1 << 16) -> None:
         import threading
         self.cap = int(cap)
-        self._lock = threading.Lock()
+        self._lock = make_lock("FreqSketch._lock")
         self._freq: Dict[int, int] = {}  # guarded-by: _lock
 
     def observe(self, keys: np.ndarray) -> None:

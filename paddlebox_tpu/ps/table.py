@@ -22,6 +22,7 @@ from paddlebox_tpu.embedding import accessor as acc
 from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
 from paddlebox_tpu.embedding.native_store import make_host_store
 from paddlebox_tpu.ps.sgd_rule import numpy_apply_push
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 
 class SparseTable:
@@ -171,11 +172,11 @@ class DenseTable:
         self.rule = rule
         self.lr = lr
         self.params = (np.array(init, np.float32) if init is not None
-                       else np.zeros(size, np.float32))
-        self._mom1 = np.zeros_like(self.params)
-        self._mom2 = np.zeros_like(self.params)
-        self._t = 0
-        self._lock = threading.Lock()
+                       else np.zeros(size, np.float32))  # guarded-by: _lock
+        self._mom1 = np.zeros_like(self.params)  # guarded-by: _lock
+        self._mom2 = np.zeros_like(self.params)  # guarded-by: _lock
+        self._t = 0  # guarded-by: _lock
+        self._lock = make_lock("DenseTable._lock")
 
     def pull(self) -> np.ndarray:
         with self._lock:

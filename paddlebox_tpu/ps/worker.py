@@ -28,6 +28,7 @@ from paddlebox_tpu.config.configs import (DataFeedConfig, TableConfig,
 from paddlebox_tpu.data.dataset import BoxDataset
 from paddlebox_tpu.embedding.accessor import PushLayout, ValueLayout
 from paddlebox_tpu.metrics.auc import MetricRegistry
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 
 class Communicator:
@@ -40,7 +41,7 @@ class Communicator:
         self.threshold = send_batch_threshold
         self.interval = send_interval
         self._pending: List[Tuple[np.ndarray, np.ndarray]] = []  # guarded-by: _lock
-        self._lock = threading.Lock()
+        self._lock = make_lock("Communicator._lock")
         self._stop = threading.Event()
         self._kick = threading.Event()
         self._thread = threading.Thread(target=self._send_loop, daemon=True)
@@ -94,7 +95,7 @@ class PullDenseWorker:
         self.name = name
         self.interval = interval
         self._value = client.pull_dense(name)  # guarded-by: _lock
-        self._lock = threading.Lock()
+        self._lock = make_lock("PullDenseWorker._lock")
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()

@@ -37,6 +37,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from paddlebox_tpu.utils.stats import gauge_set, stat_add
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 
 class HotKeyCache:
@@ -53,7 +54,7 @@ class HotKeyCache:
         self.dim = int(dim)
         self.admit = max(1, int(admit))
         self._sketch_cap = int(sketch_cap or max(1024, 4 * capacity))
-        self._lock = threading.Lock()
+        self._lock = make_lock("HotKeyCache._lock")
         self._slot_of: Dict[int, int] = {}  # guarded-by: _lock
         self._keys = np.zeros(capacity, np.uint64)  # guarded-by: _lock
         self._rows = np.zeros((capacity, dim), np.float32)  # guarded-by: _lock

@@ -20,6 +20,7 @@ import numpy as np
 from paddlebox_tpu.obs.tracer import next_trace_id, record_span
 from paddlebox_tpu.serving import codec
 from paddlebox_tpu.utils.rpc import FramedClient, plain_loads
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 
 class ServingClient:
@@ -33,7 +34,7 @@ class ServingClient:
             raise ValueError("need at least one endpoint")
         self.endpoints = [(h, int(p)) for h, p in endpoints]
         self._timeout = float(timeout)
-        self._lock = threading.Lock()
+        self._lock = make_lock("ServingClient._lock")
         self._clients: List = [None] * len(self.endpoints)  # guarded-by: _lock
         self._rr = 0  # guarded-by: _lock
         self.last_gen = -1  # guarded-by: _lock

@@ -35,6 +35,7 @@ from paddlebox_tpu.obs import log
 from paddlebox_tpu.serving.cache import HotKeyCache
 from paddlebox_tpu.serving.store import MmapViewStack, build_stack
 from paddlebox_tpu.utils.stats import gauge_set, stat_add
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 
 class ViewManager:
@@ -50,7 +51,7 @@ class ViewManager:
 
     def __init__(self, stack: MmapViewStack,
                  cache: Optional[HotKeyCache] = None) -> None:
-        self._swap_lock = threading.Lock()
+        self._swap_lock = make_lock("ViewManager._swap_lock")
         self.cache = cache
         self._current: Tuple[int, MmapViewStack] = (0, stack)  # guarded-by: _swap_lock
         # the cache's generation tag, tracked EXPLICITLY from clear()'s

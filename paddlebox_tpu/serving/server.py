@@ -38,6 +38,7 @@ from paddlebox_tpu.utils.rpc import FramedServer, plain_loads
 from paddlebox_tpu.utils.stats import (StatRegistry, gauge_set,
                                        hist_observe, hist_percentile,
                                        stat_add, stat_get)
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 #: largest accepted request frame (keys bytes + envelope). 128 MB ≈ a
 #: 16M-key pull — far past any sane serving batch; bigger frames are a
@@ -89,7 +90,7 @@ class ServingServer:
         self._prev_miss = 0  # guarded-by: _report_lock
         self._prev_lat = None  # guarded-by: _report_lock
         self._slo_us = float(flags.get_flag("serving_slo_us"))
-        self._report_lock = threading.Lock()
+        self._report_lock = make_lock("ServingServer._report_lock")
         # rank = the replica index ServingFleet exports as PBTPU_RANK
         # (log.get_rank reads it; 0 standalone) — reports AND the flight
         # recorder's per-rank files attribute to THIS replica instead of

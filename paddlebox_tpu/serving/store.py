@@ -168,7 +168,9 @@ class MmapXboxStore:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # rationale: __del__ may run with a
+            # half-torn-down interpreter where even logging fails;
+            # close() is the loud path, this is the last-resort guard
             pass
 
 

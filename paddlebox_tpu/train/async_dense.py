@@ -23,6 +23,7 @@ from typing import List, Optional
 import numpy as np
 
 from paddlebox_tpu.utils.stats import stat_add
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 
 class AsyncDenseTable:
@@ -40,7 +41,7 @@ class AsyncDenseTable:
                          if summary_mask is not None else None)
         self.merge_limit = merge_limit
         self._t = 0  # guarded-by: _lock
-        self._lock = threading.Lock()
+        self._lock = make_lock("AsyncDenseTable._lock")
         self._queue: "queue.Queue[Optional[np.ndarray]]" = queue.Queue()
         self._thread = threading.Thread(target=self._update_loop, daemon=True)
         self._thread.start()
@@ -72,9 +73,17 @@ class AsyncDenseTable:
                         remaining):
                     raise TimeoutError("async dense update not finished")
 
-    def stop(self) -> None:
-        self._queue.put(None)
-        self._thread.join()
+    def stop(self, timeout: float = 30.0) -> None:
+        # unbounded queue: the sentinel put never blocks
+        self._queue.put(None)  # boxlint: disable=BX802
+        # bounded + loud: stop() is on the __del__/teardown path — a wedged
+        # optimizer thread must not hang interpreter exit forever (BX802)
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            from paddlebox_tpu.obs import log
+            log.warning("async dense worker still alive after stop "
+                        "timeout; abandoning it", timeout_s=timeout)
+            stat_add("async_dense_stop_timeouts")
 
     # ------------------------------------------------------- background loop
     def _update_loop(self) -> None:

@@ -50,6 +50,7 @@ from paddlebox_tpu.serving.store import (_XBOX_MAGIC,  # noqa: F401
                                          read_xbox_view,
                                          write_xbox_columnar)
 from paddlebox_tpu.train import journal as jr
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 #: batch-dir sparse tier file names (manifest = columnar, pkl = legacy)
 SPARSE_MANIFEST = "sparse.xman"
@@ -84,7 +85,7 @@ class CheckpointManager:
         # handle meant wait() joined only the last writer and a
         # day-boundary load could race a still-running base save
         self._writers: List[threading.Thread] = []  # guarded-by: _writers_lock
-        self._writers_lock = threading.Lock()
+        self._writers_lock = make_lock("CheckpointManager._writers_lock")
         self.journal: Optional[jr.TouchedRowJournal] = None
         from paddlebox_tpu.config import flags as _flags
         if _flags.get_flag("ckpt_journal"):

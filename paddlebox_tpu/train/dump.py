@@ -19,6 +19,7 @@ import numpy as np
 
 from paddlebox_tpu.config import flags
 from paddlebox_tpu.utils.channel import Channel, ChannelClosed
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 
 def build_dump_tensors(dump_fields, labels, preds_np, main_task: str):
@@ -45,7 +46,7 @@ class DumpWriter:
             for i in range(max(1, thread_num))
         ]
         self.files: List[str] = []  # guarded-by: _files_lock
-        self._files_lock = threading.Lock()
+        self._files_lock = make_lock("DumpWriter._files_lock")
         for t in self._threads:
             t.start()
 
@@ -108,5 +109,12 @@ class DumpWriter:
 
     def close(self) -> None:
         self._channel.close()
+        # bounded + loud: close() rides the trainer __del__/teardown path —
+        # a writer wedged on a hung filesystem must not hang exit (BX802);
+        # 60s is far beyond any drain the tests or bench ever see
         for t in self._threads:
-            t.join()
+            t.join(60.0)
+            if t.is_alive():
+                from paddlebox_tpu.obs import log
+                log.warning("dump writer thread still draining after 60s "
+                            "close timeout; its tail file may be short")

@@ -1009,7 +1009,9 @@ class BoxTrainer:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # rationale: __del__ may run with a
+            # half-torn-down interpreter where even logging fails;
+            # close() is the loud path, this is the last-resort guard
             pass
 
     # ---------------------------------------------------------- batch utils

@@ -14,6 +14,7 @@ import weakref
 from typing import Generic, Iterable, List, Optional, TypeVar
 
 from paddlebox_tpu.utils.stats import gauge_set
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 T = TypeVar("T")
 
@@ -63,7 +64,7 @@ class Channel(Generic[T]):
         # capacity 0 = unbounded (like default ChannelObject)
         self._capacity = capacity
         self._deque: collections.deque = collections.deque()  # guarded-by: _mutex
-        self._mutex = threading.Lock()
+        self._mutex = make_lock("Channel._mutex")
         self._not_empty = threading.Condition(self._mutex)
         self._not_full = threading.Condition(self._mutex)
         self._closed = False  # guarded-by: _mutex
@@ -87,7 +88,11 @@ class Channel(Generic[T]):
             self.put(it)
 
     def close(self) -> None:
-        with self._mutex:
+        # BX801 (instance-conflation FP): close() is wait-free under
+        # _mutex, and a GC-run __del__ can only close channels that became
+        # garbage — a channel whose _mutex the interrupted thread holds is
+        # reachable from that thread's frame, hence never collected
+        with self._mutex:  # boxlint: disable=BX801
             self._closed = True
             self._not_empty.notify_all()
             self._not_full.notify_all()

@@ -18,6 +18,7 @@ import socket
 import struct
 import threading
 from typing import Any, Callable, Dict, Optional
+from paddlebox_tpu.utils.lockwatch import make_lock
 
 _LEN = struct.Struct("<I")
 
@@ -154,14 +155,22 @@ class FramedClient:
         self._sock.settimeout(timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._loads = loads
-        self._lock = threading.Lock()
-        self._broken = False
+        self._lock = make_lock("FramedClient._lock")
+        self._broken = False  # guarded-by: _lock
 
-    def call(self, req: Dict[str, Any],
+    def call(self, req: Dict[str, Any],  # boxlint: disable=BX601
              op_timeout: Optional[float] = None) -> Any:
         """op_timeout: when the server-side op legitimately blocks (store
         waits/barriers), raise the socket deadline past it so the transport
-        doesn't brick the client while the server is still healthy."""
+        doesn't brick the client while the server is still healthy.
+
+        BX601 disabled by design: _lock serializes one request/response
+        pair per connection — interleaved frames would corrupt the stream.
+        The socket I/O under it is deadline-bounded (settimeout above),
+        and planes that must not stall each other hold DEDICATED clients
+        (the send_obs / shuffle discipline in fleet/mesh_comm.py) instead
+        of sharing this lock. Callers holding their OWN locks across
+        call() still flag at their site via the transitive pass."""
         payload = pickle.dumps(req, protocol=pickle.HIGHEST_PROTOCOL)
         with self._lock:
             if self._broken:

@@ -62,9 +62,9 @@ class StatRegistry:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._stats: Dict[str, int] = {}
-        self._gauges: Dict[str, float] = {}
-        self._hists: Dict[str, List[int]] = {}
+        self._stats: Dict[str, int] = {}  # guarded-by: _lock
+        self._gauges: Dict[str, float] = {}  # guarded-by: _lock
+        self._hists: Dict[str, List[int]] = {}  # guarded-by: _lock
 
     @classmethod
     def instance(cls) -> "StatRegistry":

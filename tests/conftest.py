@@ -110,3 +110,25 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
             "earn their seconds or go slow" % (
                 wall, budget, sum(_DURATIONS.values())),
             yellow=True)
+
+
+@pytest.fixture
+def lock_order_watch():
+    """Run a concurrency test under the lockwatch runtime validator
+    (utils/lockwatch.py, flag debug_lock_order): locks constructed while
+    this fixture is live record per-thread acquisition order, and the
+    teardown ASSERTS no AB/BA inversion was observed — the dynamic twin
+    of boxlint's static BX7xx pass. Order matters: list this fixture
+    BEFORE any fixture that constructs the objects under test, so their
+    locks are built through the watch."""
+    from paddlebox_tpu.config import flags
+    from paddlebox_tpu.utils import lockwatch
+
+    flags.set_flag("debug_lock_order", True)
+    lockwatch.reset()
+    yield lockwatch
+    try:
+        lockwatch.assert_consistent()
+    finally:
+        lockwatch.reset()
+        flags.set_flag("debug_lock_order", False)

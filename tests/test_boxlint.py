@@ -559,3 +559,564 @@ def test_boxlint_gate_no_new_violations():
     new, _stale = diff_against_baseline(violations, baseline)
     assert not new, "NEW boxlint violations:\n" + "\n".join(
         v.render() for v in new)
+
+
+# ======================================================= round-19 passes
+# BX503 silent swallow, BX6xx blocking-under-lock, BX7xx lock-order
+# graph, BX8xx handler reentrancy — interprocedural passes on the
+# package-wide call graph (tools/boxlint/callgraph.py), plus their three
+# HISTORICAL-BUG fixtures: each reproduces a finding a human reviewer
+# caught by hand in PRs 7/9/13, and pins that the pass now catches it
+# mechanically.
+
+SWALLOW_BAD = """
+    def f():
+        try:
+            risky()
+        except Exception:
+            pass
+
+    def g():
+        for i in range(3):
+            try:
+                risky()
+            except:
+                continue
+"""
+
+SWALLOW_GOOD = """
+    def f():
+        try:
+            risky()
+        except Exception:  # rationale: teardown guard, interpreter may be dying
+            pass
+
+    def g():
+        try:
+            risky()
+        except Exception as e:
+            log_warning("risky failed", err=e)   # loud: not silent
+
+    def h():
+        try:
+            risky()
+        except ValueError:   # narrow catch: not the swallow class
+            pass
+"""
+
+
+def test_swallow_positive(tmp_path):
+    got = lint_snippet(tmp_path, SWALLOW_BAD, ["swallow"])
+    assert codes(got) == ["BX503", "BX503"]
+
+
+def test_swallow_negatives(tmp_path):
+    assert lint_snippet(tmp_path, SWALLOW_GOOD, ["swallow"]) == []
+
+
+def test_swallow_suppression(tmp_path):
+    got = lint_snippet(tmp_path, """
+        def f():
+            try:
+                risky()
+            except Exception:  # boxlint: disable=BX503
+                pass
+    """, ["swallow"])
+    assert got == []
+
+
+# ------------------------------------------------------------ BX601
+
+BLOCKING_BAD = """
+    import threading, time, socket
+
+    class T:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._peer = ("h", 1)
+
+        def direct(self):
+            with self._lock:
+                time.sleep(0.5)              # BX601: direct sink
+
+        def transitive(self):
+            with self._lock:
+                self.helper()                # BX601: via helper -> sendall
+
+        def helper(self):
+            self._sock.sendall(b"x")
+"""
+
+BLOCKING_GOOD = """
+    import threading, time
+
+    class T:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._x = 0
+
+        def fine(self):
+            with self._lock:
+                self._x += 1
+            time.sleep(0.5)                  # outside the lock: fine
+
+        def math_under_lock(self):
+            with self._lock:
+                return self._x * 2           # compute-only: fine
+
+
+    class Chan:
+        def __init__(self):
+            self._mutex = threading.Lock()
+            self._cv = threading.Condition(self._mutex)
+            self._q = []
+
+        def get(self):
+            with self._mutex:
+                while not self._q:
+                    self._cv.wait()          # bound-lock wait: the pattern
+                return self._q.pop()
+
+        def get_via_helper(self):
+            with self._mutex:
+                self._wait_locked()          # bound lock travels the chain
+                return self._q.pop()
+
+        def _wait_locked(self):
+            self._cv.wait()
+"""
+
+
+def test_blocking_positive_direct_and_transitive(tmp_path):
+    got = lint_snippet(tmp_path, BLOCKING_BAD, ["blocking"])
+    assert codes(got) == ["BX601", "BX601"]
+    assert "time.sleep" in got[0].message
+    assert "helper" in got[1].message and "sendall" in got[1].message
+
+
+def test_blocking_negatives_including_condition_wait(tmp_path):
+    """Compute under lock, sinks outside locks, and Condition.wait on
+    its OWN bound lock (directly or through a *_locked helper) never
+    flag — the wait releases exactly that lock."""
+    assert lint_snippet(tmp_path, BLOCKING_GOOD, ["blocking"]) == []
+
+
+def test_blocking_suppression(tmp_path):
+    got = lint_snippet(tmp_path, """
+        import threading, time
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def deliberate(self):
+                with self._lock:
+                    time.sleep(0.1)  # boxlint: disable=BX601
+    """, ["blocking"])
+    assert got == []
+
+
+HISTORICAL_DIAL = """
+    import socket, threading
+
+    class Client:
+        def __init__(self, host, port):
+            self._sock = socket.create_connection((host, port), timeout=5)
+
+    class Mesh:
+        def __init__(self):
+            self._conn_lock = threading.Lock()
+            self._clients = {}
+
+        def send_obs(self, rank, ep):
+            with self._conn_lock:
+                cl = self._clients.get(rank)
+                if cl is None:
+                    cl = Client(ep[0], ep[1])     # the PR-7 r3 bug
+                    self._clients[rank] = cl
+            return cl
+"""
+
+
+def test_historical_dial_under_conn_lock_flags(tmp_path):
+    """PR 7 r3 hand-review finding, regression-pinned: a FramedClient
+    DIAL (socket.create_connection in the ctor) inside _conn_lock froze
+    every thread's pulls for the whole connect timeout. The BX601 pass
+    must reach the sink THROUGH the constructor."""
+    got = lint_snippet(tmp_path, HISTORICAL_DIAL, ["blocking"])
+    assert codes(got) == ["BX601"]
+    assert "_conn_lock" in got[0].message
+    assert "socket.connect" in got[0].message
+
+
+HISTORICAL_AUC = """
+    import threading
+    import numpy as np
+
+    def trapezoid_auc(table):
+        return float(np.sum(table))
+
+    class Quality:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._table = np.zeros((2, 8))
+
+        def add(self, x):
+            with self._lock:
+                self._table += x
+
+        def report(self):
+            with self._lock:
+                return {"auc": trapezoid_auc(self._table)}  # the PR-13 bug
+"""
+
+
+def test_historical_auc_compute_under_add_lock_flags(tmp_path):
+    """PR 13 hand-review finding, regression-pinned: the quality report
+    ran the trapezoid-AUC math UNDER the add-path lock, so a scrape storm
+    stalled every training-thread add. trapezoid_auc/table_auc are
+    curated heavy-compute sinks exactly for this shape (this round's
+    sweep found and fixed the same bug live in metrics/auc.py compute)."""
+    got = lint_snippet(tmp_path, HISTORICAL_AUC, ["blocking"])
+    assert codes(got) == ["BX601"]
+    assert "AUC" in got[0].message
+
+
+# ------------------------------------------------------------ BX701
+
+LOCKORDER_CYCLE = """
+    import threading
+
+    LA = threading.Lock()
+    LB = threading.Lock()
+
+    def fa():
+        with LA:
+            nested_b()
+
+    def nested_b():
+        with LB:
+            pass
+
+    def fb():
+        with LB:
+            nested_a()
+
+    def nested_a():
+        with LA:
+            pass
+"""
+
+LOCKORDER_CLEAN = """
+    import threading
+
+    LA = threading.Lock()
+    LB = threading.Lock()
+
+    def f1():
+        with LA:
+            g()
+
+    def f2():
+        with LA:
+            g()
+
+    def g():
+        with LB:
+            pass
+"""
+
+
+def test_lockorder_cycle_flags(tmp_path):
+    got = lint_snippet(tmp_path, LOCKORDER_CYCLE, ["lockorder"])
+    assert codes(got) == ["BX701"]
+    assert "LA" in got[0].message and "LB" in got[0].message
+
+
+def test_lockorder_consistent_order_clean(tmp_path):
+    assert lint_snippet(tmp_path, LOCKORDER_CLEAN, ["lockorder"]) == []
+
+
+def test_lockorder_inventory_renders_edges(tmp_path):
+    from tools.boxlint import lockorder
+    from tools.boxlint.core import load_tree as _lt
+    p = tmp_path / "inv.py"
+    p.write_text(textwrap.dedent(LOCKORDER_CLEAN))
+    files, _ = _lt([str(p)], root=str(tmp_path))
+    text = lockorder.render_inventory(files)
+    assert "inv.LA -> inv.LB" in text
+    assert "1 edges, 0 cycles" in text
+
+
+def test_lockorder_self_nesting_not_flagged(tmp_path):
+    """Same-identity nesting (per-shard lock loops, *_locked helpers) is
+    BX401's territory and the runtime twin's; BX701 only flags >=2-lock
+    cycles."""
+    got = lint_snippet(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+    """, ["lockorder"])
+    assert got == []
+
+
+# ------------------------------------------------------------ BX8xx
+
+HISTORICAL_EXCEPTHOOK = """
+    import sys, threading
+
+    class Tracer:
+        def __init__(self):
+            self._reg_lock = threading.Lock()
+            self._rings = []
+
+        def all_spans(self):
+            with self._reg_lock:
+                return list(self._rings)
+
+    TRACER = Tracer()
+
+    def _seal_hook(exc_type, exc, tb):
+        TRACER.all_spans()
+
+    sys.excepthook = _seal_hook
+
+    def training_path():
+        return TRACER.all_spans()
+"""
+
+
+def test_historical_plain_lock_in_excepthook_flags(tmp_path):
+    """PR 9 r2 hand-review finding, regression-pinned: the fatal-signal
+    seal read last_spans from the excepthook while the interrupted
+    thread could hold the PLAIN _reg_lock — the dying process deadlocked
+    instead of sealing (the fix made it an RLock). BX801 must trace
+    excepthook -> module singleton -> method -> plain-lock acquire."""
+    got = lint_snippet(tmp_path, HISTORICAL_EXCEPTHOOK, ["reentrancy"])
+    assert codes(got) == ["BX801"]
+    assert "_reg_lock" in got[0].message
+    assert "excepthook" in got[0].message
+
+
+def test_reentrancy_rlock_clean(tmp_path):
+    got = lint_snippet(tmp_path, """
+        import sys, threading
+
+        class Tracer:
+            def __init__(self):
+                self._reg_lock = threading.RLock()
+                self._rings = []
+
+            def all_spans(self):
+                with self._reg_lock:
+                    return list(self._rings)
+
+        TRACER = Tracer()
+
+        def _seal_hook(exc_type, exc, tb):
+            TRACER.all_spans()
+
+        sys.excepthook = _seal_hook
+
+        def training_path():
+            return TRACER.all_spans()
+    """, ["reentrancy"])
+    assert got == []
+
+
+def test_reentrancy_handler_only_lock_clean(tmp_path):
+    """A plain lock acquired ONLY on handler paths has no training-path
+    contender to deadlock with."""
+    got = lint_snippet(tmp_path, """
+        import sys, threading
+
+        class Sealer:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def seal(self):
+                with self._lock:
+                    pass
+
+        S = Sealer()
+
+        def hook(t, e, tb):
+            S.seal()
+
+        sys.excepthook = hook
+    """, ["reentrancy"])
+    assert got == []
+
+
+def test_reentrancy_del_join_without_timeout_flags(tmp_path):
+    got = lint_snippet(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._t = threading.Thread(target=None)
+
+            def close(self):
+                self._t.join()               # BX802: unbounded from __del__
+
+            def __del__(self):
+                self.close()
+    """, ["reentrancy"])
+    assert codes(got) == ["BX802"]
+    assert "Thread.join" in got[0].message
+
+
+def test_reentrancy_join_none_positional_flags(tmp_path):
+    """join(None) is the unbounded wait spelled positionally — it must
+    not slip past the zero-arg heuristic (review find, pinned)."""
+    got = lint_snippet(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._t = threading.Thread(target=None)
+
+            def close(self):
+                self._t.join(None)           # BX802: unbounded, spelled out
+
+            def __del__(self):
+                self.close()
+    """, ["reentrancy"])
+    assert codes(got) == ["BX802"]
+
+
+def test_reentrancy_bounded_join_clean(tmp_path):
+    got = lint_snippet(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._t = threading.Thread(target=None)
+
+            def close(self):
+                self._t.join(timeout=10.0)   # bounded: resolves when dying
+
+            def __del__(self):
+                self.close()
+    """, ["reentrancy"])
+    assert got == []
+
+
+def test_reentrancy_watchdog_fire_is_a_root(tmp_path):
+    got = lint_snippet(tmp_path, """
+        import threading
+
+        class StallWatchdog:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def fire(self, label, age):
+                with self._lock:
+                    pass
+
+        def training(w):
+            with w._lock:                    # unresolved receiver: the
+                pass                         # OUTSIDE acquirer is below
+
+        class Runner:
+            def __init__(self):
+                self._dog = StallWatchdog()
+
+            def step(self):
+                with self._dog._lock:
+                    pass
+    """, ["reentrancy"])
+    # Runner.step acquires StallWatchdog._lock outside the handler set
+    assert codes(got) == ["BX801"]
+    assert "fire path" in got[0].message
+
+
+# ----------------------------------------------- new codes: machinery
+
+def test_new_codes_baseline_roundtrip(tmp_path):
+    vs = [Violation("a.py", 3, "BX601", "blocking call under X._lock"),
+          Violation("b.py", 7, "BX701", "cycle A -> B -> A"),
+          Violation("c.py", 9, "BX801", "non-reentrant lock on handler"),
+          Violation("d.py", 2, "BX503", "silent swallow")]
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(format_baseline(vs))
+    moved = [Violation(v.path, v.line + 40, v.code, v.message) for v in vs]
+    new, stale = diff_against_baseline(moved, load_baseline(str(bl)))
+    assert new == [] and stale == []
+
+
+# ------------------------------------------------- cache + --changed
+
+def test_result_cache_roundtrip_and_invalidation(tmp_path):
+    from tools.boxlint import cache as cachemod
+    src = [("a", "a.py", "x = 1\n"), ("b", "b.py", "y = 2\n")]
+    d1 = cachemod.tree_digest(src, ["purity"])
+    # digest is content- and pass-sensitive
+    assert d1 != cachemod.tree_digest(src, ["locks"])
+    src2 = [("a", "a.py", "x = 1\n"), ("b", "b.py", "y = 3\n")]
+    assert d1 != cachemod.tree_digest(src2, ["purity"])
+    path = str(tmp_path / "cache.json")
+    vs = [Violation("a.py", 1, "BX503", "msg")]
+    cachemod.store_cached(d1, vs, path=path)
+    got = cachemod.load_cached(d1, path=path)
+    assert got is not None and got[0].key() == vs[0].key() \
+        and got[0].line == 1
+    assert cachemod.load_cached("deadbeef", path=path) is None
+
+
+def test_cli_cache_hit_matches_cold_run(tmp_path):
+    """Cold and warm CLI runs agree on the verdict; the warm run reads
+    the result from the cache file it wrote."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f():\n    try:\n        g()\n"
+                   "    except Exception:\n        pass\n")
+    env = dict(os.environ)
+    # redirect the result cache: the test must never clobber the
+    # working tree's warm .cache.json (BOXLINT_CACHE override)
+    env["BOXLINT_CACHE"] = str(tmp_path / "cache.json")
+    cold = subprocess.run(
+        [sys.executable, "-m", "tools.boxlint", "--no-baseline",
+         str(bad)], cwd=REPO, capture_output=True, text=True, env=env)
+    assert (tmp_path / "cache.json").exists()
+    warm = subprocess.run(
+        [sys.executable, "-m", "tools.boxlint", "--no-baseline",
+         str(bad)], cwd=REPO, capture_output=True, text=True, env=env)
+    assert cold.returncode == 1 and warm.returncode == 1
+    assert "BX503" in cold.stdout and "BX503" in warm.stdout
+
+
+def test_changed_files_vs_git(tmp_path):
+    import subprocess as sp
+    repo = tmp_path / "r"
+    repo.mkdir()
+
+    def git(*a):
+        sp.run(["git"] + list(a), cwd=repo, check=True,
+               capture_output=True)
+
+    git("init", "-q")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    (repo / "clean.py").write_text("x = 1\n")
+    (repo / "edited.py").write_text("y = 1\n")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    (repo / "edited.py").write_text("y = 2\n")
+    (repo / "fresh.py").write_text("z = 1\n")
+    # a whole NEW directory: porcelain collapses it to `?? sub/`, which
+    # used to hide every .py inside from the changed set (review find)
+    (repo / "sub").mkdir()
+    (repo / "sub" / "inner.py").write_text("w = 1\n")
+    from tools.boxlint.cache import changed_files
+    got = changed_files(root=str(repo))
+    assert got == {"edited.py", "fresh.py", "sub/inner.py"}

@@ -119,7 +119,7 @@ def test_seal_manifest_and_numbered_siblings(tmp_path):
     fr.close()
 
 
-def test_excepthook_chain_seals(tmp_path, no_active_flight):
+def test_excepthook_chain_seals(lock_order_watch, tmp_path, no_active_flight):
     fr = FlightRecorder(str(tmp_path), rank=0)
     flight.set_active(fr)
     called = []
@@ -139,7 +139,7 @@ def test_excepthook_chain_seals(tmp_path, no_active_flight):
     fr.close()
 
 
-def test_watchdog_fire_seals(tmp_path, no_active_flight):
+def test_watchdog_fire_seals(lock_order_watch, tmp_path, no_active_flight):
     fr = FlightRecorder(str(tmp_path), rank=0)
     flight.set_active(fr)
     wd = StallWatchdog(threshold_s=0.05, tracer=get_tracer(),

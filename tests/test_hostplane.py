@@ -47,7 +47,7 @@ def mesh_pair():
         m.close()
 
 
-def test_mesh_exchange_lockstep(mesh_pair, pool):
+def test_mesh_exchange_lockstep(lock_order_watch, mesh_pair, pool):
     """Per-rank parts land at the right peer, seqs pair send #n with
     recv #n across multiple rounds, and the wire accounting moves."""
     m0, m1 = mesh_pair
@@ -86,7 +86,7 @@ def _virtual_buckets(P, KB, shard_cap, seed=5):
 
 
 @pytest.mark.parametrize("uid_only", [False, True])
-def test_p2p_vs_store_staging_parity(mesh_pair, pool, uid_only):
+def test_p2p_vs_store_staging_parity(lock_order_watch, mesh_pair, pool, uid_only):
     """The acceptance bar: stage_push_dedup over the p2p mesh must
     reproduce the store-allgather path AND the single-process staging
     bit-identically — uids, perm/inv, and the rebuild pos maps."""

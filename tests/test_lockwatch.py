@@ -1,0 +1,216 @@
+"""Runtime lock-order validator (utils/lockwatch.py, round 19).
+
+The dynamic twin of boxlint's static BX7xx pass: these tests pin the
+inversion-detection contract (the AB/BA precondition is caught on the
+FIRST interleaving that could deadlock, from either thread count), the
+zero-cost-off contract (plain threading primitives when the flag is
+off), the hold-time histogram plumbing through the obs StatRegistry,
+and the Condition(lock) interplay the Channel depends on. Pure host
+tests — no jax, no devices.
+"""
+
+import threading
+
+import pytest
+
+from paddlebox_tpu.config import flags
+from paddlebox_tpu.utils import lockwatch
+from paddlebox_tpu.utils.stats import StatRegistry, stat_get, stat_reset
+
+
+@pytest.fixture
+def watch_on():
+    flags.set_flag("debug_lock_order", True)
+    lockwatch.reset()
+    yield
+    lockwatch.reset()
+    flags.set_flag("debug_lock_order", False)
+
+
+def test_off_returns_plain_primitives():
+    flags.set_flag("debug_lock_order", False)
+    assert type(lockwatch.make_lock("X._l")) is type(threading.Lock())
+    assert type(lockwatch.make_rlock("X._r")) is type(threading.RLock())
+
+
+def test_seeded_ab_ba_inversion_detected(watch_on):
+    """The acceptance-criteria toy: seed an AB nesting and then a BA
+    nesting and assert lockwatch flags the pair — WITHOUT needing the
+    unlucky interleaving that actually deadlocks."""
+    la = lockwatch.make_lock("Toy._a")
+    lb = lockwatch.make_lock("Toy._b")
+
+    def ab():
+        with la:
+            with lb:
+                pass
+
+    def ba():
+        with lb:
+            with la:
+                pass
+
+    t1 = threading.Thread(target=ab)
+    t1.start()
+    t1.join()
+    stat_reset("lockwatch_inversions")
+    t2 = threading.Thread(target=ba)
+    t2.start()
+    t2.join()
+    inv = lockwatch.inversions()
+    assert len(inv) == 1
+    assert set(inv[0]["pair"]) == {"Toy._a", "Toy._b"}
+    assert stat_get("lockwatch_inversions") == 1
+    with pytest.raises(AssertionError, match="Toy._"):
+        lockwatch.assert_consistent()
+
+
+def test_consistent_global_order_stays_clean(watch_on):
+    la = lockwatch.make_lock("C._a")
+    lb = lockwatch.make_lock("C._b")
+    for _ in range(3):
+        with la:
+            with lb:
+                pass
+    with la:  # repeat + partial orders never alarm
+        pass
+    lockwatch.assert_consistent()
+    assert lockwatch.edges() == {("C._a", "C._b"): 3}
+    assert "C._a -> C._b x3" in lockwatch.order_report()
+
+
+def test_rlock_reentry_records_no_self_edge(watch_on):
+    r = lockwatch.make_rlock("R._l")
+    with r:
+        with r:
+            pass
+    assert lockwatch.edges() == {}
+    lockwatch.assert_consistent()
+
+
+def test_hold_time_histogram_published(watch_on):
+    lk = lockwatch.make_lock("H._l")
+    with lk:
+        pass
+    counts = StatRegistry.instance().hist_counts("lock_hold_us_H__l")
+    assert counts is not None and sum(counts) == 1
+
+
+def test_condition_wait_rebalances_held_stack(watch_on):
+    """Condition(watched_lock).wait releases and reacquires through the
+    wrapper; the per-thread held stack must stay balanced (a leak here
+    would fabricate edges for every later acquisition)."""
+    mutex = lockwatch.make_lock("Cond._m")
+    cv = threading.Condition(mutex)
+    entered = threading.Event()
+    hit = []
+
+    def waiter():
+        with cv:
+            entered.set()   # set under the mutex: the notifier's `with
+            cv.wait(timeout=5)  # cv` below can't run until wait releases
+            hit.append(lockwatch.current_held())
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    assert entered.wait(timeout=5)
+    with cv:
+        cv.notify()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert hit and hit[0] == ["Cond._m"]
+    assert lockwatch.current_held() == []
+    lockwatch.assert_consistent()
+
+
+def test_channel_under_watch_round_trip(watch_on):
+    """The hot ingest queue works unchanged under the watch (its two
+    Conditions share the watched mutex — bound-lock identity)."""
+    from paddlebox_tpu.utils.channel import Channel
+    c = Channel(capacity=2)
+    c.put("a")
+    c.put("b")
+    assert c.get() == "a" and c.get() == "b"
+    c.close()
+    lockwatch.assert_consistent()
+
+
+def test_foreign_release_counted_not_crashed(watch_on):
+    """A lock acquired on one thread and released on another (handed
+    across, e.g. an executor future) must not corrupt the stacks."""
+    stat_reset("lockwatch_foreign_release")
+    lk = lockwatch.make_lock("F._l")
+    lk.acquire()
+    t = threading.Thread(target=lk.release)
+    t.start()
+    t.join()
+    assert stat_get("lockwatch_foreign_release") == 1
+    assert not lk.locked()
+    lockwatch.assert_consistent()
+    # the acquiring thread's stack keeps a phantom entry (nothing popped
+    # it here) — reset() must clear EVERY thread's stack, or the phantom
+    # fabricates edges for every later acquisition (review find, pinned)
+    assert lockwatch.current_held() == ["F._l"]
+    lockwatch.reset()
+    assert lockwatch.current_held() == []
+
+
+def test_edge_identity_matches_static_vocabulary(watch_on):
+    """Dynamic edges speak the same Class._attr identity language as the
+    static inventory (tools/boxlint/lock_graph.txt), so the two planes
+    can be diffed by eye."""
+    outer = lockwatch.make_lock("MeshComm._conn_lock")
+    inner = lockwatch.make_lock("FramedClient._lock")
+    with outer:
+        with inner:
+            pass
+    assert ("MeshComm._conn_lock", "FramedClient._lock") in lockwatch.edges()
+
+
+def test_three_lock_cycle_detected_by_assert(watch_on):
+    """A->B, B->C, C->A: every PAIR is individually consistent, so the
+    eager inversion check never fires — assert_consistent must walk the
+    nesting graph (the dynamic analog of BX701's Tarjan pass; review
+    find, pinned)."""
+    la = lockwatch.make_lock("Cy._a")
+    lb = lockwatch.make_lock("Cy._b")
+    lc = lockwatch.make_lock("Cy._c")
+    for outer, inner in ((la, lb), (lb, lc), (lc, la)):
+        t = threading.Thread(target=lambda o=outer, i=inner: (
+            o.acquire(), i.acquire(), i.release(), o.release()))
+        t.start()
+        t.join()
+    assert lockwatch.inversions() == []          # no 2-cycle fired
+    assert lockwatch.order_cycles()              # but the 3-cycle exists
+    with pytest.raises(AssertionError, match="cycle"):
+        lockwatch.assert_consistent()
+
+
+def test_condition_on_watched_rlock(watch_on):
+    """Condition(make_rlock(...)) must behave exactly as on a plain
+    RLock — the wrapper forwards _is_owned/_release_save/
+    _acquire_restore with bookkeeping, including RECURSIVE holds
+    (review find: hiding the RLock protocol made wait() raise, and a
+    recursively-held lock would release only one level and deadlock)."""
+    r = lockwatch.make_rlock("CR._l")
+    cv = threading.Condition(r)
+    entered = threading.Event()
+    hit = []
+
+    def waiter():
+        with r:             # recursion level 1
+            with cv:        # level 2 — wait must release BOTH
+                entered.set()
+                cv.wait(timeout=5)
+                hit.append(list(lockwatch.current_held()))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    assert entered.wait(timeout=5)
+    with cv:                # acquirable only if wait released level 1 too
+        cv.notify()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert hit and hit[0] == ["CR._l", "CR._l"]
+    assert lockwatch.current_held() == []
+    lockwatch.assert_consistent()

@@ -266,7 +266,7 @@ def test_manager_tracks_cache_epoch_not_gen(tmp_path):
 # ---------------------------------------------------------------- refresh
 
 
-def test_swap_under_load_no_drops(tmp_path):
+def test_swap_under_load_no_drops(lock_order_watch, tmp_path):
     """Reader threads hammer lookups while deltas land and swap: no
     request may error or read a torn view (vectors are always exactly
     one of the generations' values), and the new vector must be served

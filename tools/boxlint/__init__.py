@@ -26,14 +26,41 @@ drift. boxlint is the lint gate that makes them mechanical again:
   BX5xx  library print() hygiene: bare ``print(`` in paddlebox_tpu/
          library code must go through the rank-prefixed structured
          logging layer (obs/log.py) instead; tools/tests/examples are
-         exempt (stdout is their contract).
+         exempt (stdout is their contract). BX502 extends it to span
+         discipline (a bare ``tracer.span(...)`` records nothing);
+         BX503 to silent ``except Exception: pass`` swallows (log a
+         counted warning or write a rationale comment).
+  BX6xx  blocking-under-lock (round 19, interprocedural): from every
+         ``with <lock>:`` body, transitive reach — through the
+         package-wide call graph (callgraph.py) — into the curated
+         blocking-sink list (sinks.py: socket ops, framed RPC/TcpStore
+         via closure, channel get/put, time.sleep, bare join(),
+         subprocess, fsync, cond/event waits, the trapezoid-AUC math)
+         flags at the call site with the chain.
+  BX7xx  lock-order deadlock graph: interprocedural lock-acquisition
+         edges on ``Class._attr`` identities; cycles are potential
+         AB/BA deadlocks; the full nesting inventory is the committed
+         ``lock_graph.txt`` artifact (--lock-graph). The runtime twin
+         (utils/lockwatch.py, flag debug_lock_order) validates the same
+         identities dynamically under the concurrency suites.
+  BX8xx  handler reentrancy: code reachable from sys/threading
+         excepthooks, signal handlers, the watchdog fire path or
+         ``__del__`` must not acquire a non-reentrant lock that
+         non-handler code also takes (BX801 — the PR-9 seal-deadlock
+         shape) nor call a blocking sink without a timeout (BX802).
 
 Suppression: ``# boxlint: disable=BX101[,BX102]`` (or a bare ``disable``)
 on the offending line, or on a ``def``/``class`` line to cover the whole
 body. Pre-existing violations live in tools/boxlint/baseline.txt; the gate
 (tests/test_boxlint.py) fails only on NEW violations.
 
-CLI: ``python -m tools.boxlint [--baseline FILE] [--fix-baseline] PATH...``
+CLI: ``python -m tools.boxlint [--baseline FILE] [--fix-baseline]
+[--changed] [--no-cache] [--lock-graph] [--suggest-guards] PATH...``
+An exact content-hash result cache (cache.py, gitignored .cache.json)
+replays unchanged-tree runs in ~0.1s; ``--changed`` restricts the
+per-file passes + reporting to the files differing from HEAD (or
+``--changed-base REF``); ``--suggest-guards`` emits candidate
+``# guarded-by:`` annotations for attrs touched >=90% under one lock.
 """
 
 from tools.boxlint.core import (  # noqa: F401

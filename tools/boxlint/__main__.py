@@ -12,6 +12,9 @@ Typical invocations:
     python -m tools.boxlint paddlebox_tpu/ tools/
     python -m tools.boxlint --no-baseline paddlebox_tpu/parallel/mesh.py
     python -m tools.boxlint --fix-baseline paddlebox_tpu/ tools/
+    python -m tools.boxlint --changed paddlebox_tpu/ tools/   # edit loop
+    python -m tools.boxlint --lock-graph paddlebox_tpu/      # artifact
+    python -m tools.boxlint --suggest-guards paddlebox_tpu/  # artifact
 """
 
 from __future__ import annotations
@@ -25,8 +28,12 @@ from tools.boxlint.core import (
     ALL_PASSES, diff_against_baseline, format_baseline, load_baseline,
     load_tree, run_passes,
 )
+from tools.boxlint import cache as cachemod
 
-_DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.txt")
+_SELF_DIR = os.path.dirname(os.path.abspath(__file__))
+_DEFAULT_BASELINE = os.path.join(_SELF_DIR, "baseline.txt")
+_DEFAULT_LOCK_GRAPH = os.path.join(_SELF_DIR, "lock_graph.txt")
+_DEFAULT_GUARDS = os.path.join(_SELF_DIR, "guard_suggestions.txt")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -37,7 +44,11 @@ def build_parser() -> argparse.ArgumentParser:
             "static shapes (BX1xx), collective axis contracts (BX2xx), "
             "flag registry hygiene (BX3xx), guarded-by lock discipline "
             "(BX4xx), library print hygiene (BX501), span "
-            "context-manager discipline (BX502). Suppress a single "
+            "context-manager discipline (BX502), silent exception "
+            "swallows (BX503), and the interprocedural concurrency "
+            "passes on the package-wide call graph: blocking-under-lock "
+            "(BX601), lock-order deadlock cycles (BX701), handler "
+            "reentrancy (BX801/BX802). Suppress a single "
             "site with '# boxlint: "
             "disable=BX101' on the line (or the def line for a whole "
             "method); long-lived exceptions belong in the baseline."),
@@ -64,6 +75,32 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fail-on-stale", action="store_true",
                    help="also exit 1 when baseline entries no longer "
                         "match any violation (ratchet mode)")
+    p.add_argument("--changed", action="store_true",
+                   help="incremental edit-loop mode: lint only files "
+                        "changed vs HEAD (or vs `git merge-base HEAD "
+                        "--changed-base REF`) plus untracked .py; "
+                        "cross-file passes still read the full tree, "
+                        "reporting is filtered to the changed files. "
+                        "The tier-1 gate always runs full-tree")
+    p.add_argument("--changed-base", default=None, metavar="REF",
+                   help="base ref for --changed (e.g. origin/main); "
+                        "default: HEAD (uncommitted edits only)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="skip the content-hash result cache "
+                        "(tools/boxlint/.cache.json); the cache is "
+                        "exact — any file or checker change misses")
+    p.add_argument("--lock-graph", action="store_true",
+                   help="write the interprocedural lock-nesting "
+                        "inventory artifact to --artifact-out (default: "
+                        "tools/boxlint/lock_graph.txt) and exit 0")
+    p.add_argument("--suggest-guards", action="store_true",
+                   help="write candidate '# guarded-by:' annotations for "
+                        "attrs touched >=90%% under one lock to "
+                        "--artifact-out (default: "
+                        "tools/boxlint/guard_suggestions.txt) and exit 0")
+    p.add_argument("--artifact-out", default=None, metavar="PATH",
+                   help="override the output path for --lock-graph / "
+                        "--suggest-guards")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="suppress the summary line; print violations only")
     return p
@@ -77,9 +114,74 @@ def main(argv: List[str] | None = None) -> int:
         print(f"boxlint: unknown pass(es): {', '.join(bad)} "
               f"(valid: {', '.join(ALL_PASSES)})", file=sys.stderr)
         return 2
+
+    # --------------------------------------------------- artifact modes
+    if args.lock_graph or args.suggest_guards:
+        try:
+            files, parse_errors = load_tree(args.paths)
+            if args.lock_graph:
+                from tools.boxlint import lockorder
+                out_path = args.artifact_out or _DEFAULT_LOCK_GRAPH
+                with open(out_path, "w", encoding="utf-8") as fh:
+                    fh.write(lockorder.render_inventory(files))
+                if not args.quiet:
+                    print(f"boxlint: lock-nesting inventory -> {out_path}")
+            if args.suggest_guards:
+                from tools.boxlint import guards
+                out_path = args.artifact_out or _DEFAULT_GUARDS
+                with open(out_path, "w", encoding="utf-8") as fh:
+                    fh.write(guards.render_report(files))
+                if not args.quiet:
+                    print(f"boxlint: guard suggestions -> {out_path}")
+        except Exception as e:
+            print(f"boxlint: internal error: {e.__class__.__name__}: {e}",
+                  file=sys.stderr)
+            return 2
+        return 0
+
+    if args.fix_baseline and args.changed:
+        # the baseline must describe the FULL tree: rewriting it from a
+        # changed-files-only violation set would silently drop every
+        # baselined entry in the unchanged files
+        print("boxlint: --fix-baseline requires a full-tree run "
+              "(drop --changed)", file=sys.stderr)
+        return 2
+
+    # ------------------------------------------------------ lint proper
     try:
-        files, parse_errors = load_tree(args.paths)
-        violations = list(parse_errors) + run_passes(files, passes)
+        sources = cachemod.collect_sources(args.paths)
+        changed = None
+        if args.changed:
+            changed = cachemod.changed_files(base=args.changed_base)
+            if changed is None and not args.quiet:
+                print("boxlint: --changed: git unavailable, running "
+                      "full-tree", file=sys.stderr)
+        violations = None
+        digest = cachemod.tree_digest(sources, passes)
+        if not args.no_cache and changed is None:
+            violations = cachemod.load_cached(digest)
+        n_files = len(sources)
+        if violations is None:
+            files, parse_errors = load_tree(args.paths, sources=sources)
+            if changed is not None:
+                per_file = [p for p in passes
+                            if p in cachemod.PER_FILE_PASSES]
+                cross = [p for p in passes
+                         if p not in cachemod.PER_FILE_PASSES]
+                subset = [f for f in files if f.rel in changed]
+                violations = list(parse_errors)
+                if per_file and subset:
+                    violations += run_passes(subset, per_file)
+                if cross:
+                    violations += run_passes(files, cross)
+                violations = sorted(
+                    (v for v in violations if v.path in changed),
+                    key=lambda v: (v.path, v.line, v.code))
+                n_files = len(subset)
+            else:
+                violations = list(parse_errors) + run_passes(files, passes)
+                if not args.no_cache and not args.fix_baseline:
+                    cachemod.store_cached(digest, violations)
     except Exception as e:  # checker bug — never masquerade as "clean"
         print(f"boxlint: internal error: {e.__class__.__name__}: {e}",
               file=sys.stderr)
@@ -107,6 +209,10 @@ def main(argv: List[str] | None = None) -> int:
             print(f"boxlint: cannot read baseline: {e}", file=sys.stderr)
             return 2
         new, stale = diff_against_baseline(violations, baseline)
+        if changed is not None:
+            # a fixed violation elsewhere must not read as stale when we
+            # only looked at the changed files
+            stale = [s for s in stale if s[0] in changed]
 
     for v in new:
         print(v.render())
@@ -116,7 +222,8 @@ def main(argv: List[str] | None = None) -> int:
                   f"--fix-baseline): {path}: {code} {msg}", file=sys.stderr)
     if not args.quiet:
         total = len(violations)
-        print(f"boxlint: {len(files)} files, {total} violation"
+        mode = " (changed-only)" if changed is not None else ""
+        print(f"boxlint: {n_files} files{mode}, {total} violation"
               f"{'' if total == 1 else 's'} ({len(new)} new, "
               f"{total - len(new)} baselined, {len(stale)} stale)",
               file=sys.stderr)

@@ -1,0 +1,145 @@
+"""Pass 7 — interprocedural blocking-under-lock (BX6xx).
+
+The recurring hand-review bug class this machine-checks (ISSUE 14): a
+``with self._lock:`` body that reaches — possibly through several calls
+and modules — a blocking sink. PR 7 r3 found ``FramedClient`` dials
+happening INSIDE ``MeshComm._conn_lock`` (a blackholed peer froze every
+thread's pulls for the whole connect timeout); PR 13 found the quality
+report's AUC math computed UNDER the add-path lock (a scrape storm could
+stall training adds). Both shapes flag here now, at the call site, with
+the chain that reaches the sink.
+
+Mechanics: for every function the package defines, walk its statements
+tracking the set of held lock identities (``Class._attr`` /
+``module._NAME`` — see callgraph.py). At each call made while locks are
+held, flag when
+
+  * the call IS a curated sink (tools/boxlint/sinks.py), or
+  * the call graph shows the callee transitively reaches one.
+
+``Condition.wait`` releases its bound lock, so that lock is dropped from
+the held set before judging (Channel.get's wait under ``_mutex`` is the
+pattern, not the bug) — the bound identity travels with the sink through
+the transitive closure, so a ``*_locked`` helper that waits on its own
+class's condition stays clean too.
+
+A deliberate hold-across-sink (e.g. a drain that must serialize with the
+close path) carries a per-line ``# boxlint: disable=BX601`` WITH a
+rationale comment — the same reviewable-decision contract as BX401.
+
+Scope: library code (``tools/``, ``tests/``, ``examples/`` path parts are
+exempt, same rule as BX501 — their with-bodies are test scaffolding, and
+fixtures outside the package stay checkable).
+
+Codes:
+  BX601  blocking sink reachable while holding a lock
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.boxlint.core import SourceFile, Violation
+from tools.boxlint.callgraph import (FuncNode, PackageIndex, chain_str,
+                                     get_index)
+
+_EXEMPT_PARTS = {"tools", "tests", "examples"}
+
+
+def _exempt(rel: str) -> bool:
+    return bool(_EXEMPT_PARTS.intersection(rel.split("/")[:-1]))
+
+
+def check(files: Sequence[SourceFile]) -> List[Violation]:
+    index = get_index(files)
+    sink_sum = index.sink_closure()
+    out: List[Violation] = []
+    for node in index.nodes:
+        if _exempt(node.file.rel):
+            continue
+        body = getattr(node.fn, "body", None)
+        if not isinstance(body, list):
+            continue
+        seen: Set[Tuple[int, str]] = set()
+        for stmt in body:
+            _walk(node, stmt, frozenset(), index, sink_sum, out, seen)
+    return out
+
+
+def _walk(node: FuncNode, stmt: ast.AST, held: frozenset,
+          index: PackageIndex, sink_sum: Dict[int, Dict[str, Tuple]],
+          out: List[Violation], seen: Set[Tuple[int, str]]) -> None:
+    """Statement-ordered walk mirroring locks._audit_fn: `with` grows the
+    held set for its body; expression positions are checked against the
+    CURRENT held set."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return  # nested defs execute later, not under this lock
+    if isinstance(stmt, ast.With):
+        inner = held | {ident for _, ident, _ in
+                        index.with_locks(stmt, node)}
+        for item in stmt.items:
+            _check_expr(node, item.context_expr, held, index, sink_sum,
+                        out, seen)
+        for s in stmt.body:
+            _walk(node, s, inner, index, sink_sum, out, seen)
+        return
+    _STMT_LIKE = (ast.stmt, ast.ExceptHandler, ast.match_case)
+    children = list(ast.iter_child_nodes(stmt))
+    for c in children:
+        if isinstance(c, _STMT_LIKE):
+            _walk(node, c, held, index, sink_sum, out, seen)
+        elif held:
+            _check_expr(node, c, held, index, sink_sum, out, seen)
+
+
+def _check_expr(node: FuncNode, expr: ast.AST, held: frozenset,
+                index: PackageIndex, sink_sum: Dict[int, Dict[str, Tuple]],
+                out: List[Violation], seen: Set[Tuple[int, str]]) -> None:
+    if not held or expr is None:
+        return
+    for sub in ast.walk(expr):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            continue  # deferred execution
+        if not isinstance(sub, ast.Call):
+            continue
+        # direct sink at this call site
+        direct = node.sink_map.get(id(sub))
+        if direct is not None:
+            line, label, bound, _to = direct
+            eff = held - {bound} if bound else held
+            if eff:
+                _flag(node, sub.lineno, eff, label, (), out, seen)
+        # transitive: a resolved callee that reaches a sink
+        for callee in node.call_map.get(id(sub), []):
+            sinks = sink_sum.get(id(callee))
+            if not sinks:
+                continue
+            best: Optional[Tuple[str, Tuple, frozenset]] = None
+            for label in sorted(sinks):
+                _l, bound, _to, chain = sinks[label]
+                eff = held - {bound} if bound else held
+                if not eff:
+                    continue
+                if best is None:
+                    best = (label, (callee.qual,) + chain, eff)
+            if best is not None:
+                label, chain, eff = best
+                _flag(node, sub.lineno, eff, label, chain, out, seen)
+
+
+def _flag(node: FuncNode, line: int, held: frozenset, label: str,
+          chain: Tuple[str, ...], out: List[Violation],
+          seen: Set[Tuple[int, str]]) -> None:
+    key = (line, label)
+    if key in seen:
+        return
+    seen.add(key)
+    locks = "+".join(sorted(held))
+    out.append(Violation(
+        node.file.rel, line, "BX601",
+        f"blocking call under {locks} in `{node.qual}`: {label}"
+        f"{chain_str(chain)} — a held lock across a blocking sink stalls "
+        f"every contender; move it outside the lock (or disable with "
+        f"rationale)"))

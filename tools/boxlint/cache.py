@@ -1,0 +1,180 @@
+"""Content-hash result cache + ``--changed`` incremental mode.
+
+The tier-1 gate and the dev loop both pay full-tree lint cost on every
+run; at 163 files that is ~6 s (≈1.4 s parse, ≈1.4 s call-graph build,
+≈3 s passes) and it grows with the tree. Two layers keep that honest:
+
+  * **Result cache** (exact): one digest over (a) every boxlint module's
+    own source, (b) every linted file's (rel, sha256(text)), (c) the
+    pass list. A hit replays the stored violation list without parsing a
+    single AST — the dominant dev-loop case (re-running tier-1 / the
+    gate with an unchanged tree) drops to content-hashing cost (~0.1 s).
+    Any content change anywhere — including to boxlint itself — misses.
+    The cache lives at ``tools/boxlint/.cache.json`` (gitignored), one
+    entry, last-write-wins.
+
+  * **``--changed``** (approximate, dev loop only): lints the files that
+    differ from ``git merge-base HEAD <base>`` (default base: HEAD
+    itself — the uncommitted-edits view; pass ``--changed-base REF``
+    for branch workflows) plus untracked .py files. Cross-file passes
+    (flags, collectives vocabulary, the BX6xx/7xx/8xx call graph) still
+    load the full tree — their verdicts depend on it — but per-file
+    passes run only on the changed files and ALL reporting is filtered
+    to them. The approximation (an edit can create a violation in an
+    UNCHANGED file, e.g. deleting a flag its reader still gets) is why
+    the gate always runs full-tree; --changed is for the edit loop.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.boxlint.core import Violation
+
+_SELF_DIR = os.path.dirname(os.path.abspath(__file__))
+CACHE_PATH = os.path.join(_SELF_DIR, ".cache.json")
+
+
+def cache_path() -> str:
+    """The result-cache file: BOXLINT_CACHE env overrides the default
+    (tests point it at a tmp dir so they never race a developer's warm
+    cache in the working tree)."""
+    return os.environ.get("BOXLINT_CACHE") or CACHE_PATH
+
+# passes whose verdict for a file depends only on that file (+ the
+# global suppression machinery); safe to restrict to changed files
+PER_FILE_PASSES = ("purity", "locks", "prints", "spans", "swallow")
+
+
+def collect_sources(paths: Sequence[str], root: Optional[str] = None
+                    ) -> List[Tuple[str, str, str]]:
+    """(abspath, rel, text) for every .py under ``paths`` — the read
+    half of core.load_tree, split out so a cache hit can skip the parse
+    half entirely."""
+    root = root or os.getcwd()
+    out: List[Tuple[str, str, str]] = []
+    seen: Set[str] = set()
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            candidates = [p]
+        else:
+            candidates = []
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        candidates.append(os.path.join(dirpath, fn))
+        for f in sorted(candidates):
+            if f in seen:
+                continue
+            seen.add(f)
+            rel = os.path.relpath(f, root).replace(os.sep, "/")
+            try:
+                with open(f, "r", encoding="utf-8") as fh:
+                    out.append((f, rel, fh.read()))
+            except (OSError, UnicodeDecodeError):
+                # text=None marks an unreadable file: load_tree reports it
+                # as BX000 (an empty-string substitute would lint as
+                # silently CLEAN and poison the cache with that verdict)
+                out.append((f, rel, None))
+    return out
+
+
+def _self_digest(h) -> None:
+    for fn in sorted(os.listdir(_SELF_DIR)):
+        if not fn.endswith(".py"):
+            continue
+        with open(os.path.join(_SELF_DIR, fn), "rb") as fh:
+            h.update(fn.encode())
+            h.update(hashlib.sha256(fh.read()).digest())
+
+
+def tree_digest(sources: Sequence[Tuple[str, str, str]],
+                passes: Sequence[str]) -> str:
+    h = hashlib.sha256()
+    _self_digest(h)
+    h.update(("|".join(passes)).encode())
+    for _abs, rel, text in sources:
+        h.update(rel.encode())
+        h.update(hashlib.sha256(
+            b"\x00unreadable" if text is None else text.encode()).digest())
+    return h.hexdigest()
+
+
+def load_cached(digest: str, path: Optional[str] = None
+                ) -> Optional[List[Violation]]:
+    path = path or cache_path()
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if data.get("digest") != digest:
+        return None
+    try:
+        return [Violation(p, int(ln), c, m)
+                for p, ln, c, m in data["violations"]]
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def store_cached(digest: str, violations: Sequence[Violation],
+                 path: Optional[str] = None) -> None:
+    path = path or cache_path()
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"digest": digest,
+                       "violations": [[v.path, v.line, v.code, v.message]
+                                      for v in violations]}, fh)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass  # cache is best-effort; the lint result already stands
+
+
+# ------------------------------------------------------------- --changed
+
+def _git(args: List[str], cwd: str) -> Optional[str]:
+    try:
+        r = subprocess.run(["git"] + args, cwd=cwd, capture_output=True,
+                           text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return r.stdout if r.returncode == 0 else None
+
+
+def changed_files(root: Optional[str] = None,
+                  base: Optional[str] = None) -> Optional[Set[str]]:
+    """Repo-relative paths changed vs ``merge-base(HEAD, base)`` (plus
+    untracked .py). Default base is HEAD itself — the edit loop's
+    "what did I touch since the last commit" view; pass a base ref for
+    branch workflows (e.g. --changed origin/main). Returns None when git
+    is unavailable — the caller falls back to a full run."""
+    root = root or os.getcwd()
+    merge_base = "HEAD"
+    if base:
+        out = _git(["merge-base", "HEAD", base], root)
+        if out:
+            merge_base = out.strip()
+    diff = _git(["diff", "--name-only", merge_base], root)
+    if diff is None:
+        return None
+    changed = {ln.strip() for ln in diff.splitlines() if ln.strip()}
+    # untracked files, expanded per-file: `git status --porcelain`
+    # collapses a whole new DIRECTORY to one `?? dir/` entry, which
+    # would hide every .py inside it from the changed set
+    others = _git(["ls-files", "--others", "--exclude-standard"],
+                  root) or ""
+    for ln in others.splitlines():
+        if ln.strip():
+            changed.add(ln.strip())
+    return {c for c in changed if c.endswith(".py")}

@@ -1,0 +1,604 @@
+"""Package-wide call-graph closure — the shared substrate of the
+interprocedural concurrency passes (BX6xx blocking-under-lock, BX7xx
+lock-order graph, BX8xx handler reentrancy).
+
+``purity.py`` closes over *same-module* calls, which is exactly right for
+jit entry points (a traced function crossing a module boundary is rare and
+deliberate). The concurrency bug classes this substrate serves are the
+opposite: a ``with self._conn_lock:`` body in ``fleet/mesh_comm.py``
+reaching ``socket.connect`` happens THROUGH ``utils/rpc.py`` (the PR-7 r3
+hand-review finding), and the PR-9 seal deadlock threaded
+``obs/flight.py -> obs/tracer.py``. So the index here resolves calls
+across the whole linted tree:
+
+  * bare names      -> same-module defs, then ``from m import f`` targets
+  * ``mod.f(...)``  -> defs of the imported package module
+  * ``self.m(...)`` -> methods of the enclosing class, then its bases
+                       (resolved by name through the package class index)
+  * ``self.attr.m(...)`` / ``var.m(...)`` -> methods of the class the
+                       attr/var was assigned from (``self._chan =
+                       Channel(...)`` types ``self._chan``; first
+                       assignment wins for locals)
+  * ``ClassName(...)`` -> the class's ``__init__`` (constructors that
+                       dial sockets are the historical bug shape)
+
+Everything unresolvable is simply absent from the graph — the passes
+over-approximate only through the curated *direct* sink name matches.
+
+Lock identities are ``ClassName._attr`` (or ``module._NAME`` for
+module-level locks): instances are conflated, which is the standard
+static-lock-analysis approximation and the same key the runtime twin
+(``utils/lockwatch.py``) registers, so static edges and dynamic
+acquisition orders share one vocabulary.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.boxlint.core import SourceFile
+from tools.boxlint.purity import dotted
+
+# Constructor tails recognized as lock-like objects. make_lock/make_rlock/
+# make_condition are the lockwatch factories (utils/lockwatch.py): the
+# runtime twin must not blind the static plane.
+_LOCK_CTORS = {"Lock": "lock", "make_lock": "lock",
+               "RLock": "rlock", "make_rlock": "rlock"}
+_COND_CTORS = {"Condition": "condition", "make_condition": "condition"}
+_EVENT_CTORS = {"Event": "event"}
+
+
+class FuncNode:
+    """One function/method definition in the package."""
+
+    __slots__ = ("fn", "file", "cls", "module", "name", "qual",
+                 "calls", "direct_sinks", "direct_locks",
+                 "call_map", "sink_map")
+
+    def __init__(self, fn: ast.AST, file: SourceFile, cls: Optional[str],
+                 module: str):
+        self.fn = fn
+        self.file = file
+        self.cls = cls
+        self.module = module
+        self.name = getattr(fn, "name", "<lambda>")
+        self.qual = (f"{cls}.{self.name}" if cls else self.name)
+        # filled by PackageIndex._link():
+        self.calls: List[Tuple[int, "FuncNode"]] = []   # (line, callee)
+        # (line, sink label, bound-lock identity or None, has_timeout)
+        self.direct_sinks: List[Tuple[int, str, Optional[str], bool]] = []
+        # (line, lock identity, reentrant?) for `with <lock>` acquisitions
+        self.direct_locks: List[Tuple[int, str, bool]] = []
+        # id(ast.Call) -> resolved callees / sink tuple (the per-site view
+        # the statement-ordered walks in blocking.py need)
+        self.call_map: Dict[int, List["FuncNode"]] = {}
+        self.sink_map: Dict[int, Tuple[int, str, Optional[str], bool]] = {}
+
+
+class ClassNode:
+    __slots__ = ("name", "file", "node", "module", "bases", "methods",
+                 "lock_attrs", "cond_binds", "attr_types")
+
+    def __init__(self, node: ast.ClassDef, file: SourceFile, module: str):
+        self.name = node.name
+        self.file = file
+        self.node = node
+        self.module = module
+        self.bases: List[str] = [b for b in (dotted(x) for x in node.bases)
+                                 if b]
+        self.methods: Dict[str, FuncNode] = {}
+        # attr -> kind in {"lock", "rlock", "condition", "event"}
+        self.lock_attrs: Dict[str, str] = {}
+        # condition attr -> the lock attr it wraps (None = its own lock)
+        self.cond_binds: Dict[str, Optional[str]] = {}
+        # attr -> class name (tail) it was constructed from
+        self.attr_types: Dict[str, str] = {}
+
+
+def _module_name(rel: str) -> str:
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    if mod.endswith("/__init__"):
+        mod = mod[: -len("/__init__")]
+    return mod.replace("/", ".")
+
+
+def _self_attr(node: ast.AST) -> str:
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id in ("self", "cls")):
+        return node.attr
+    return ""
+
+
+class PackageIndex:
+    """All defs/classes/imports of one linted tree, with resolved call,
+    lock-acquisition, and sink edges (see module docstring)."""
+
+    def __init__(self, files: Sequence[SourceFile]):
+        self.files = list(files)
+        self.modules: Dict[str, SourceFile] = {}
+        self.classes: Dict[str, List[ClassNode]] = {}
+        self.functions: Dict[Tuple[str, str], FuncNode] = {}
+        self.nodes: List[FuncNode] = []
+        self.imports: Dict[str, Dict[str, str]] = {}   # module -> local->dotted
+        self.module_locks: Dict[str, Dict[str, str]] = {}  # module -> name->kind
+        self.module_vars: Dict[str, Dict[str, str]] = {}   # module -> var->class
+        self._by_fnid: Dict[int, FuncNode] = {}
+        for f in self.files:
+            self._index_file(f)
+        for f in self.files:
+            self._link_file(f)
+
+    # ------------------------------------------------------------ indexing
+
+    def _index_file(self, f: SourceFile) -> None:
+        mod = _module_name(f.rel)
+        self.modules[mod] = f
+        imports: Dict[str, str] = {}
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    imports[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name if alias.asname else
+                        alias.name.split(".")[0])
+                    if alias.asname:
+                        imports[alias.asname] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:  # relative: resolve against this module
+                    parts = mod.split(".")
+                    parts = parts[: len(parts) - node.level]
+                    base = ".".join(parts + ([node.module]
+                                             if node.module else []))
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    imports[alias.asname or alias.name] = (
+                        f"{base}.{alias.name}" if base else alias.name)
+        self.imports[mod] = imports
+
+        mlocks: Dict[str, str] = {}
+        mvars: Dict[str, str] = {}
+        for stmt in f.tree.body:
+            if isinstance(stmt, ast.Assign) and isinstance(
+                    stmt.value, ast.Call):
+                tail = (dotted(stmt.value.func) or "").split(".")[-1]
+                kind = _LOCK_CTORS.get(tail) or _COND_CTORS.get(tail)
+                for t in stmt.targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    if kind:
+                        mlocks[t.id] = kind
+                    elif tail and tail[0].isupper():
+                        # module singleton: TRACER = SpanTracer(); typed
+                        # so handler closures resolve TRACER.m() calls
+                        mvars.setdefault(t.id, tail)
+        self.module_locks[mod] = mlocks
+        self.module_vars[mod] = mvars
+
+        for stmt in f.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_func(stmt, f, None, mod)
+            elif isinstance(stmt, ast.ClassDef):
+                cn = ClassNode(stmt, f, mod)
+                self.classes.setdefault(cn.name, []).append(cn)
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        fn = self._add_func(sub, f, cn.name, mod)
+                        cn.methods[sub.name] = fn
+                self._scan_class_attrs(cn)
+
+    def _add_func(self, fn: ast.AST, f: SourceFile, cls: Optional[str],
+                  mod: str) -> FuncNode:
+        node = FuncNode(fn, f, cls, mod)
+        self.nodes.append(node)
+        self._by_fnid[id(fn)] = node
+        self.functions.setdefault((mod, node.qual), node)
+        # nested defs resolve by bare name within the module (closure
+        # helpers), same convention as purity._Scope
+        for sub in ast.walk(fn):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub is not fn and id(sub) not in self._by_fnid:
+                nested = FuncNode(sub, f, cls, mod)
+                self.nodes.append(nested)
+                self._by_fnid[id(sub)] = nested
+                self.functions.setdefault((mod, nested.qual), nested)
+        return node
+
+    def _scan_class_attrs(self, cn: ClassNode) -> None:
+        for sub in ast.walk(cn.node):
+            if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = sub.value
+            targets = (sub.targets if isinstance(sub, ast.Assign)
+                       else [sub.target])
+            if value is None or not isinstance(value, ast.Call):
+                continue
+            tail = (dotted(value.func) or "").split(".")[-1]
+            for t in targets:
+                attr = _self_attr(t)
+                if not attr:
+                    continue
+                if tail in _LOCK_CTORS:
+                    cn.lock_attrs[attr] = _LOCK_CTORS[tail]
+                elif tail in _COND_CTORS:
+                    cn.lock_attrs[attr] = "condition"
+                    bound = None
+                    if value.args:
+                        bound = _self_attr(value.args[0]) or None
+                    cn.cond_binds[attr] = bound
+                elif tail in _EVENT_CTORS:
+                    cn.lock_attrs[attr] = "event"
+                elif tail and tail[0].isupper():
+                    cn.attr_types.setdefault(attr, tail)
+
+    # ----------------------------------------------------------- resolution
+
+    def class_by_name(self, name: str) -> Optional[ClassNode]:
+        lst = self.classes.get(name.split(".")[-1])
+        return lst[0] if lst else None
+
+    def method_on(self, cls: Optional[ClassNode], meth: str,
+                  _depth: int = 0) -> Optional[FuncNode]:
+        """Resolve a method through the (name-keyed) MRO."""
+        if cls is None or _depth > 8:
+            return None
+        if meth in cls.methods:
+            return cls.methods[meth]
+        for b in cls.bases:
+            hit = self.method_on(self.class_by_name(b), meth, _depth + 1)
+            if hit is not None:
+                return hit
+        return None
+
+    def lock_kind(self, cls: Optional[ClassNode], attr: str,
+                  _depth: int = 0) -> Optional[str]:
+        """Lock kind of ``self.<attr>`` through the base chain."""
+        if cls is None or _depth > 8:
+            return None
+        if attr in cls.lock_attrs:
+            return cls.lock_attrs[attr]
+        for b in cls.bases:
+            k = self.lock_kind(self.class_by_name(b), attr, _depth + 1)
+            if k:
+                return k
+        return None
+
+    def lock_owner(self, cls: Optional[ClassNode], attr: str,
+                   _depth: int = 0) -> Optional[ClassNode]:
+        if cls is None or _depth > 8:
+            return None
+        if attr in cls.lock_attrs:
+            return cls
+        for b in cls.bases:
+            o = self.lock_owner(self.class_by_name(b), attr, _depth + 1)
+            if o is not None:
+                return o
+        return None
+
+    def cond_bind(self, cls: Optional[ClassNode], attr: str,
+                  _depth: int = 0) -> Optional[str]:
+        """The lock attr a Condition wraps, through the base chain."""
+        if cls is None or _depth > 8:
+            return None
+        if attr in cls.cond_binds:
+            return cls.cond_binds[attr]
+        for b in cls.bases:
+            bound = self.cond_bind(self.class_by_name(b), attr, _depth + 1)
+            if bound is not None:
+                return bound
+        return None
+
+    def node_for(self, fn: ast.AST) -> Optional[FuncNode]:
+        return self._by_fnid.get(id(fn))
+
+    def _resolve_call(self, call: ast.Call, ctx: FuncNode,
+                      local_types: Dict[str, str]) -> List[FuncNode]:
+        func = call.func
+        mod = ctx.module
+        imports = self.imports.get(mod, {})
+        # ClassName(...) -> __init__ (+ base __init__s are reached through
+        # the ctor's own super() calls when present)
+        d = dotted(func)
+        if d:
+            tail = d.split(".")[-1]
+            target_cls = None
+            if d in imports and self.class_by_name(imports[d]):
+                target_cls = self.class_by_name(imports[d])
+            elif self.class_by_name(tail) and (
+                    tail in imports or (mod, tail) not in self.functions):
+                cand = self.class_by_name(tail)
+                # only trust a bare-name class hit when the name is
+                # actually visible in this module (imported or defined)
+                if cand is not None and (
+                        tail in imports or cand.module == mod):
+                    target_cls = cand
+            if target_cls is not None and tail[:1].isupper():
+                init = self.method_on(target_cls, "__init__")
+                return [init] if init else []
+        if isinstance(func, ast.Name):
+            name = func.id
+            hit = self.functions.get((mod, name))
+            if hit:
+                return [hit]
+            imp = imports.get(name)
+            if imp:
+                # from pkg.m import f  ->  pkg.m.f
+                tmod, _, tname = imp.rpartition(".")
+                hit = self.functions.get((tmod, tname))
+                if hit:
+                    return [hit]
+            return []
+        if isinstance(func, ast.Attribute):
+            meth = func.attr
+            recv = func.value
+            # self.m(...) / cls.m(...)
+            if isinstance(recv, ast.Name) and recv.id in ("self", "cls"):
+                own = None
+                if ctx.cls:
+                    own = self._class_in_module(ctx.cls, mod)
+                hit = self.method_on(own, meth)
+                return [hit] if hit else []
+            # mod.f(...) through an imported module
+            rd = dotted(recv)
+            if rd:
+                imp = imports.get(rd.split(".")[0])
+                if imp:
+                    full = imp + rd[len(rd.split(".")[0]):]
+                    hit = self.functions.get((full, meth))
+                    if hit:
+                        return [hit]
+                    cn = self.class_by_name(full.split(".")[-1])
+                    if cn is not None:
+                        m = self.method_on(cn, meth)
+                        if m:
+                            return [m]
+                hit = self.functions.get((rd, meth))
+                if hit:
+                    return [hit]
+            # typed receivers: self.attr.m(...) and local var.m(...)
+            tname = None
+            attr = _self_attr(recv)
+            if attr and ctx.cls:
+                own = self._class_in_module(ctx.cls, mod)
+                if own is not None:
+                    tname = self._attr_type(own, attr)
+            elif isinstance(recv, ast.Name):
+                tname = local_types.get(recv.id) or \
+                    self.module_vars.get(mod, {}).get(recv.id)
+            if tname:
+                m = self.method_on(self.class_by_name(tname), meth)
+                return [m] if m else []
+        return []
+
+    def _class_in_module(self, name: str, mod: str) -> Optional[ClassNode]:
+        for cn in self.classes.get(name, []):
+            if cn.module == mod:
+                return cn
+        return self.class_by_name(name)
+
+    def _attr_type(self, cls: Optional[ClassNode], attr: str,
+                   _depth: int = 0) -> Optional[str]:
+        if cls is None or _depth > 8:
+            return None
+        if attr in cls.attr_types:
+            return cls.attr_types[attr]
+        for b in cls.bases:
+            t = self._attr_type(self.class_by_name(b), attr, _depth + 1)
+            if t:
+                return t
+        return None
+
+    # ------------------------------------------------------------- linking
+
+    def _link_file(self, f: SourceFile) -> None:
+        from tools.boxlint import sinks as sinkmod
+        mod = _module_name(f.rel)
+        for node in self.nodes:
+            if node.file is not f:
+                continue
+            local_types = self._local_types(node)
+            own_body_ids = self._own_statement_ids(node)
+            for sub in ast.walk(node.fn):
+                if id(sub) not in own_body_ids:
+                    continue
+                if isinstance(sub, ast.Call):
+                    callees = self._resolve_call(sub, node, local_types)
+                    if callees:
+                        node.call_map[id(sub)] = callees
+                        for callee in callees:
+                            node.calls.append((sub.lineno, callee))
+                    sink = sinkmod.match_sink(sub, node, self, local_types)
+                    if sink is not None:
+                        node.sink_map[id(sub)] = sink
+                        node.direct_sinks.append(sink)
+                elif isinstance(sub, ast.With):
+                    for line, ident, reentrant in self.with_locks(sub, node):
+                        node.direct_locks.append((line, ident, reentrant))
+
+    def _own_statement_ids(self, node: FuncNode) -> Set[int]:
+        """ids of AST nodes belonging to this def but NOT to a nested def
+        (nested defs are their own FuncNodes)."""
+        nested: Set[int] = set()
+        for sub in ast.walk(node.fn):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub is not node.fn:
+                for inner in ast.walk(sub):
+                    nested.add(id(inner))
+        return {id(sub) for sub in ast.walk(node.fn)
+                if id(sub) not in nested}
+
+    def _local_types(self, node: FuncNode) -> Dict[str, str]:
+        """var -> class-name for single `v = ClassName(...)` assignments
+        (first assignment wins)."""
+        out: Dict[str, str] = {}
+        for sub in ast.walk(node.fn):
+            if (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Name)
+                    and isinstance(sub.value, ast.Call)):
+                tail = (dotted(sub.value.func) or "").split(".")[-1]
+                if tail and tail[0].isupper() and (
+                        self.class_by_name(tail) is not None):
+                    out.setdefault(sub.targets[0].id, tail)
+        return out
+
+    # --------------------------------------------------- lock identification
+
+    def with_locks(self, stmt: ast.With, ctx: FuncNode
+                   ) -> List[Tuple[int, str, bool]]:
+        """(line, lock identity, reentrant?) for each lock this `with`
+        acquires. Condition attrs resolve to their bound lock's identity
+        (entering a Condition enters its lock)."""
+        out: List[Tuple[int, str, bool]] = []
+        for item in stmt.items:
+            ctx_expr = item.context_expr
+            ident = self.lock_identity(ctx_expr, ctx)
+            if ident is not None:
+                out.append((stmt.lineno, ident[0], ident[1]))
+        return out
+
+    def lock_identity(self, expr: ast.AST, ctx: FuncNode
+                      ) -> Optional[Tuple[str, bool]]:
+        """(identity, reentrant?) when ``expr`` denotes a known lock:
+        ``self._x`` with a lock-ish ctor in the class, or a module-level
+        lock name. Conditions map to their bound lock."""
+        attr = _self_attr(expr)
+        if attr and ctx.cls:
+            own = self._class_in_module(ctx.cls, ctx.module)
+            kind = self.lock_kind(own, attr)
+            owner = self.lock_owner(own, attr)
+            if kind in ("lock", "rlock"):
+                return (f"{owner.name}.{attr}", kind == "rlock")
+            if kind == "condition":
+                bound = self.cond_bind(own, attr)
+                if bound:
+                    bkind = self.lock_kind(own, bound)
+                    bowner = self.lock_owner(own, bound)
+                    if bowner is not None:
+                        return (f"{bowner.name}.{bound}", bkind == "rlock")
+                return (f"{owner.name}.{attr}", False)
+            return None
+        if isinstance(expr, ast.Name):
+            kind = self.module_locks.get(ctx.module, {}).get(expr.id)
+            if kind in ("lock", "rlock", "condition"):
+                return (f"{ctx.module.split('.')[-1]}.{expr.id}",
+                        kind == "rlock")
+        # typed receiver: with self._dog._lock / with SINGLETON._lock —
+        # the lock lives on another object whose class we can type
+        if isinstance(expr, ast.Attribute):
+            recv = expr.value
+            tname = None
+            a = _self_attr(recv)
+            if a and ctx.cls:
+                own = self._class_in_module(ctx.cls, ctx.module)
+                tname = self._attr_type(own, a)
+            elif isinstance(recv, ast.Name):
+                tname = self.module_vars.get(ctx.module, {}).get(recv.id)
+            if tname:
+                cn = self.class_by_name(tname)
+                kind = self.lock_kind(cn, expr.attr)
+                owner = self.lock_owner(cn, expr.attr)
+                if kind in ("lock", "rlock") and owner is not None:
+                    return (f"{owner.name}.{expr.attr}", kind == "rlock")
+        return None
+
+    # -------------------------------------------------- transitive closures
+
+    def sink_closure(self) -> Dict[int, Dict[str, Tuple]]:
+        """For every FuncNode: {sink label -> (line-in-node, bound-lock or
+        None, has_timeout, chain tuple)} reachable transitively. The chain
+        names the call path from the node to the sink (shortest found)."""
+        summary: Dict[int, Dict[str, Tuple]] = {
+            id(n): {} for n in self.nodes}
+        for n in self.nodes:
+            for line, label, bound, has_to in n.direct_sinks:
+                cur = summary[id(n)].get(label)
+                if cur is None or line < cur[0]:
+                    summary[id(n)][label] = (line, bound, has_to, ())
+        # reverse propagation to fixpoint
+        callers: Dict[int, List[Tuple[FuncNode, int]]] = {}
+        for n in self.nodes:
+            for line, callee in n.calls:
+                callers.setdefault(id(callee), []).append((n, line))
+        work = [n for n in self.nodes if summary[id(n)]]
+        seen_rounds = 0
+        while work and seen_rounds < 100000:
+            cur = work.pop()
+            for caller, line in callers.get(id(cur), []):
+                changed = False
+                for label, (sline, bound, has_to, chain) in \
+                        summary[id(cur)].items():
+                    if len(chain) >= 6:
+                        continue
+                    entry = summary[id(caller)].get(label)
+                    new_chain = (cur.qual,) + chain
+                    if entry is None:
+                        summary[id(caller)][label] = (
+                            line, bound, has_to, new_chain)
+                        changed = True
+                if changed:
+                    work.append(caller)
+            seen_rounds += 1
+        return summary
+
+    def lock_closure(self) -> Dict[int, Dict[str, Tuple]]:
+        """For every FuncNode: {lock identity -> (line-in-node, reentrant,
+        chain)} of locks acquired transitively by calling it."""
+        summary: Dict[int, Dict[str, Tuple]] = {
+            id(n): {} for n in self.nodes}
+        for n in self.nodes:
+            for line, ident, reent in n.direct_locks:
+                cur = summary[id(n)].get(ident)
+                if cur is None or line < cur[0]:
+                    summary[id(n)][ident] = (line, reent, ())
+        callers: Dict[int, List[Tuple[FuncNode, int]]] = {}
+        for n in self.nodes:
+            for line, callee in n.calls:
+                callers.setdefault(id(callee), []).append((n, line))
+        work = [n for n in self.nodes if summary[id(n)]]
+        rounds = 0
+        while work and rounds < 100000:
+            cur = work.pop()
+            for caller, line in callers.get(id(cur), []):
+                changed = False
+                for ident, (sline, reent, chain) in summary[id(cur)].items():
+                    if len(chain) >= 6:
+                        continue
+                    if ident not in summary[id(caller)]:
+                        summary[id(caller)][ident] = (
+                            line, reent, (cur.qual,) + chain)
+                        changed = True
+                if changed:
+                    work.append(caller)
+            rounds += 1
+        return summary
+
+
+# ------------------------------------------------------------------ memo
+
+_CACHE: List[Tuple[List[SourceFile], PackageIndex]] = []
+
+
+def get_index(files: Sequence[SourceFile]) -> PackageIndex:
+    """One PackageIndex shared by the three interprocedural passes within
+    a run_passes invocation (keyed on the exact SourceFile objects; the
+    strong reference in the cache keeps ids stable)."""
+    flist = list(files)
+    for cached_files, idx in _CACHE:
+        if len(cached_files) == len(flist) and all(
+                a is b for a, b in zip(cached_files, flist)):
+            return idx
+    idx = PackageIndex(flist)
+    del _CACHE[:]
+    _CACHE.append((flist, idx))
+    return idx
+
+
+def chain_str(chain: Tuple[str, ...]) -> str:
+    if not chain:
+        return ""
+    shown = list(chain[:3])
+    if len(chain) > 3:
+        shown.append("...")
+    return " via " + " -> ".join(shown)

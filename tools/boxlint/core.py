@@ -51,6 +51,9 @@ class SourceFile:
         self.suppress: Dict[int, Optional[Set[str]]] = {}
         # line -> lock attr name from a guarded-by annotation
         self.guarded_by: Dict[int, str] = {}
+        # line -> raw comment text (every comment; BX503 reads these as
+        # swallow-site rationales)
+        self.comments: Dict[int, str] = {}
         self._scan_comments()
         # lines covered by a def/class-level suppression
         self._block_suppress: List[Tuple[int, int, Optional[Set[str]]]] = []
@@ -62,6 +65,7 @@ class SourceFile:
             for tok in toks:
                 if tok.type != tokenize.COMMENT:
                     continue
+                self.comments[tok.start[0]] = tok.string
                 m = _SUPPRESS_RE.search(tok.string)
                 if m:
                     codes = m.group("codes")
@@ -99,39 +103,37 @@ class SourceFile:
         return False
 
 
-def load_tree(paths: Sequence[str], root: Optional[str] = None
+def load_tree(paths: Sequence[str], root: Optional[str] = None,
+              sources: Optional[Sequence[Tuple[str, str, str]]] = None
               ) -> Tuple[List[SourceFile], List[Violation]]:
     """Collect and parse every .py under ``paths``. Unparseable files are
-    reported as BX000 rather than crashing the run."""
+    reported as BX000 rather than crashing the run. ``sources`` (already
+    read (abs, rel, text) triples from cache.collect_sources) skips the
+    re-read on the cache-miss path."""
     root = root or os.getcwd()
     files: List[SourceFile] = []
     errors: List[Violation] = []
-    seen: Set[str] = set()
-    for p in paths:
-        p = os.path.abspath(p)
-        if os.path.isfile(p):
-            candidates = [p]
-        else:
-            candidates = []
-            for dirpath, dirnames, filenames in os.walk(p):
-                dirnames[:] = [d for d in dirnames
-                               if d not in ("__pycache__", ".git")]
-                for fn in sorted(filenames):
-                    if fn.endswith(".py"):
-                        candidates.append(os.path.join(dirpath, fn))
-        for f in sorted(candidates):
-            if f in seen:
-                continue
-            seen.add(f)
-            rel = os.path.relpath(f, root).replace(os.sep, "/")
-            try:
-                with open(f, "r", encoding="utf-8") as fh:
-                    text = fh.read()
-                files.append(SourceFile(f, rel, text))
-            except (SyntaxError, UnicodeDecodeError) as e:
-                line = getattr(e, "lineno", 1) or 1
-                errors.append(Violation(rel, line, "BX000",
-                                        f"unparseable: {e.__class__.__name__}: {e}"))
+    if sources is None:
+        # ONE walk implementation: the cache digest must be computed
+        # over exactly the file set that gets linted, so the legacy
+        # path reuses collect_sources rather than mirroring its
+        # walk/prune rules (lazy import — cache.py imports Violation
+        # from here)
+        from tools.boxlint.cache import collect_sources
+        sources = collect_sources(paths, root=root)
+    for f, rel, text in sources:
+        if text is None:   # collect_sources read failure marker
+            errors.append(Violation(
+                rel, 1, "BX000", "unparseable: unreadable file "
+                "(I/O or encoding error)"))
+            continue
+        try:
+            files.append(SourceFile(f, rel, text))
+        except (SyntaxError, ValueError) as e:
+            line = getattr(e, "lineno", 1) or 1
+            errors.append(Violation(
+                rel, line, "BX000",
+                f"unparseable: {e.__class__.__name__}: {e}"))
     return files, errors
 
 
@@ -192,8 +194,9 @@ def format_baseline(violations: Sequence[Violation]) -> str:
 
 def run_passes(files: Sequence[SourceFile],
                passes: Optional[Iterable[str]] = None) -> List[Violation]:
-    from tools.boxlint import (collectives, flagscheck, locks, prints,
-                               purity, spans)
+    from tools.boxlint import (blocking, collectives, flagscheck, lockorder,
+                               locks, prints, purity, reentrancy, spans,
+                               swallow)
     registry = {
         "purity": purity.check,
         "collectives": collectives.check,
@@ -201,6 +204,10 @@ def run_passes(files: Sequence[SourceFile],
         "locks": locks.check,
         "prints": prints.check,
         "spans": spans.check,
+        "swallow": swallow.check,
+        "blocking": blocking.check,
+        "lockorder": lockorder.check,
+        "reentrancy": reentrancy.check,
     }
     names = list(passes) if passes else list(registry)
     out: List[Violation] = []
@@ -211,7 +218,7 @@ def run_passes(files: Sequence[SourceFile],
 
 
 ALL_PASSES = ("purity", "collectives", "flags", "locks", "prints",
-              "spans")
+              "spans", "swallow", "blocking", "lockorder", "reentrancy")
 
 
 def _is_suppressed(files: Sequence[SourceFile], v: Violation) -> bool:

@@ -1,0 +1,163 @@
+"""``--suggest-guards``: candidate ``# guarded-by:`` annotations.
+
+BX4xx only audits attributes someone already annotated — the opt-in is
+deliberate (annotating declares "shared across threads"), but it means
+coverage grows only as fast as hand care does. This analysis inverts it:
+for every class that owns at least one lock, count each ``self.<attr>``
+access outside ``__init__``/``__del__``/``__repr__`` and partition by
+the lock(s) statically held at the access. An attribute touched >= 90%
+under exactly one lock (with enough evidence: >= 4 accesses, >= 2 under
+the lock) is either already lock-disciplined — annotate it, making the
+discipline mechanical — or the stray accesses are latent races worth a
+look. Either way the report line is actionable.
+
+The committed artifact (``tools/boxlint/guard_suggestions.txt``,
+regenerated per round) records the frontier: 100%-consistent rows are
+annotation candidates; sub-100% rows name the exact outside-lock sites.
+
+This is a report, not a pass — it emits no violations. Adding an
+annotation from it immediately turns the stray sites into BX401s, which
+is the point: suggestion -> annotation -> machine-checked forever.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Sequence, Set, Tuple
+
+from tools.boxlint.core import SourceFile
+from tools.boxlint.callgraph import PackageIndex, get_index
+
+_EXEMPT_METHODS = {"__init__", "__del__", "__repr__"}
+_EXEMPT_PARTS = {"tools", "tests", "examples"}
+_MIN_ACCESSES = 4
+_MIN_LOCKED = 2
+_THRESHOLD = 0.90
+
+
+def _exempt(rel: str) -> bool:
+    return bool(_EXEMPT_PARTS.intersection(rel.split("/")[:-1]))
+
+
+def suggest(files: Sequence[SourceFile]) -> List[str]:
+    index = get_index(files)
+    rows: List[Tuple[str, str, str, int, int, List[int], str]] = []
+    for name, class_list in sorted(index.classes.items()):
+        for cn in class_list:
+            if _exempt(cn.file.rel) or not cn.lock_attrs:
+                continue
+            rows.extend(_suggest_class(cn, index))
+    out = []
+    for cls, attr, lock, locked, total, stray, rel in rows:
+        pct = 100.0 * locked / total
+        where = ("" if not stray else
+                 " stray at " + ",".join(str(s) for s in stray[:4])
+                 + ("..." if len(stray) > 4 else ""))
+        out.append(f"{rel}: {cls}.{attr} -> # guarded-by: {lock} "
+                   f"({locked}/{total} accesses under it, {pct:.0f}%"
+                   f"{where})")
+    return out
+
+
+def _suggest_class(cn, index: PackageIndex):
+    f = cn.file
+    # attrs assigned anywhere in the class, minus locks and annotated ones
+    assigned: Set[str] = set()
+    annotated: Set[str] = set()
+    for sub in ast.walk(cn.node):
+        if isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (sub.targets if isinstance(sub, ast.Assign)
+                       else [sub.target])
+            for t in targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id in ("self", "cls")):
+                    assigned.add(t.attr)
+                    if (t.lineno in f.guarded_by
+                            or (sub.end_lineno or 0) in f.guarded_by):
+                        annotated.add(t.attr)
+    candidates = assigned - annotated - set(cn.lock_attrs)
+    if not candidates:
+        return []
+    # counts[attr] = {lock_identity_or "": [lines]}
+    counts: Dict[str, Dict[str, List[int]]] = {}
+    for item in cn.node.body:
+        if (not isinstance(item, ast.FunctionDef)
+                or item.name in _EXEMPT_METHODS):
+            continue
+        node = index.node_for(item)
+        if node is None:
+            continue
+        for stmt in item.body:
+            _walk(cn, node, stmt, frozenset(), index, candidates, counts)
+    rows = []
+    for attr in sorted(counts):
+        by_lock = counts[attr]
+        total = sum(len(v) for v in by_lock.values())
+        if total < _MIN_ACCESSES:
+            continue
+        best_lock, best_lines = max(
+            ((lk, ls) for lk, ls in by_lock.items() if lk),
+            key=lambda kv: len(kv[1]), default=("", []))
+        if not best_lock or len(best_lines) < _MIN_LOCKED:
+            continue
+        if len(best_lines) / total < _THRESHOLD:
+            continue
+        stray = sorted(ln for lk, ls in by_lock.items() if lk != best_lock
+                       for ln in ls)
+        # identity Class._attr -> the annotation names the bare attr
+        lock_attr = best_lock.split(".")[-1]
+        rows.append((cn.name, attr, lock_attr, len(best_lines), total,
+                     stray, f.rel))
+    return rows
+
+
+def _walk(cn, node, stmt, held: frozenset, index: PackageIndex,
+          candidates: Set[str],
+          counts: Dict[str, Dict[str, List[int]]]) -> None:
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return
+    if isinstance(stmt, ast.With):
+        inner = held | {ident for _, ident, _ in
+                        index.with_locks(stmt, node)}
+        for item in stmt.items:
+            _count_expr(cn, item.context_expr, held, candidates, counts)
+        for s in stmt.body:
+            _walk(cn, node, s, inner, index, candidates, counts)
+        return
+    _STMT_LIKE = (ast.stmt, ast.ExceptHandler, ast.match_case)
+    for c in ast.iter_child_nodes(stmt):
+        if isinstance(c, _STMT_LIKE):
+            _walk(cn, node, c, held, index, candidates, counts)
+        else:
+            _count_expr(cn, c, held, candidates, counts)
+
+
+def _count_expr(cn, expr, held: frozenset, candidates: Set[str],
+                counts: Dict[str, Dict[str, List[int]]]) -> None:
+    if expr is None:
+        return
+    for sub in ast.walk(expr):
+        if (isinstance(sub, ast.Attribute)
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id in ("self", "cls")
+                and sub.attr in candidates):
+            key = sorted(held)[0] if len(held) == 1 else (
+                "+".join(sorted(held)) if held else "")
+            counts.setdefault(sub.attr, {}).setdefault(
+                key, []).append(sub.lineno)
+
+
+def render_report(files: Sequence[SourceFile]) -> str:
+    lines = suggest(files)
+    head = [
+        "# guarded-by annotation candidates (boxlint --suggest-guards).",
+        "# attr touched >=90% under ONE lock outside __init__: either",
+        "# annotate it (BX4xx then machine-checks it forever) or audit",
+        "# the stray sites it names — they are where the race would be.",
+        "# Regenerate with: python -m tools.boxlint --suggest-guards "
+        "paddlebox_tpu/",
+        "",
+    ]
+    return "\n".join(head + (lines or ["# (no candidates at thresholds)"])
+                     ) + "\n"

@@ -1,0 +1,222 @@
+"""Pass 8 — interprocedural lock-order deadlock graph (BX7xx).
+
+Classic AB/BA detection on static lock identities: every time code
+holding lock A acquires lock B — directly (nested ``with``) or through
+any chain of package calls — the graph gains edge A->B. A cycle means
+two threads entering the cycle at different nodes can each hold the lock
+the other needs: the textbook deadlock the reference avoided by a fixed
+C++ lock hierarchy around the shared hash table (BoxPS's one
+thread-per-GPU discipline), and the shape our six threaded planes (mesh,
+ingest, serving, obs, journal, flight) can now only avoid by convention.
+
+Identities are ``Class._attr`` / ``module._NAME`` (instances conflated —
+the standard static approximation; the runtime twin
+``utils/lockwatch.py`` confirms real per-instance orders under the
+concurrency suites using the same identity vocabulary). Self-edges are
+NOT flagged here: same-identity nesting across *different* instances
+(per-shard locks in a loop) is a common legitimate pattern, direct
+same-instance re-entry is BX401's ``*_locked`` convention, and the
+runtime twin sees the truth. RLock edges stay in the graph — reentrancy
+helps one thread, not an AB/BA pair of threads.
+
+The full nesting inventory (every edge with one witness site + call
+chain) is an operator artifact: ``python -m tools.boxlint --lock-graph``
+writes it to ``tools/boxlint/lock_graph.txt`` (committed, so review sees
+ordering changes as diffs).
+
+Codes:
+  BX701  cycle in the interprocedural lock-acquisition graph
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Sequence, Set, Tuple
+
+from tools.boxlint.core import SourceFile, Violation
+from tools.boxlint.callgraph import (FuncNode, PackageIndex, chain_str,
+                                     get_index)
+
+_EXEMPT_PARTS = {"tools", "tests", "examples"}
+
+# edge -> witness: (rel, line, holder qual, chain to inner acquisition)
+Edges = Dict[Tuple[str, str], Tuple[str, int, str, Tuple[str, ...]]]
+
+
+def _exempt(rel: str) -> bool:
+    return bool(_EXEMPT_PARTS.intersection(rel.split("/")[:-1]))
+
+
+def collect_edges(files: Sequence[SourceFile]) -> Edges:
+    index = get_index(files)
+    lock_sum = index.lock_closure()
+    edges: Edges = {}
+    for node in index.nodes:
+        if _exempt(node.file.rel):
+            continue
+        body = getattr(node.fn, "body", None)
+        if not isinstance(body, list):
+            continue
+        for stmt in body:
+            _walk(node, stmt, frozenset(), index, lock_sum, edges)
+    return edges
+
+
+def _add_edge(edges: Edges, outer: str, inner: str, rel: str, line: int,
+              qual: str, chain: Tuple[str, ...]) -> None:
+    if outer == inner:
+        return  # self-nesting: see module docstring
+    key = (outer, inner)
+    cur = edges.get(key)
+    # deterministic witness: shortest chain, then lowest (rel, line)
+    cand = (len(chain), rel, line)
+    if cur is None or cand < (len(cur[3]), cur[0], cur[1]):
+        edges[key] = (rel, line, qual, chain)
+
+
+def _walk(node: FuncNode, stmt: ast.AST, held: frozenset,
+          index: PackageIndex, lock_sum: Dict[int, Dict[str, Tuple]],
+          edges: Edges) -> None:
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return
+    if isinstance(stmt, ast.With):
+        acquired = [ident for _, ident, _ in index.with_locks(stmt, node)]
+        for h in held:
+            for a in acquired:
+                _add_edge(edges, h, a, node.file.rel, stmt.lineno,
+                          node.qual, ())
+        inner = held | set(acquired)
+        for item in stmt.items:
+            _check_calls(node, item.context_expr, held, lock_sum, edges)
+        for s in stmt.body:
+            _walk(node, s, inner, index, lock_sum, edges)
+        return
+    _STMT_LIKE = (ast.stmt, ast.ExceptHandler, ast.match_case)
+    for c in ast.iter_child_nodes(stmt):
+        if isinstance(c, _STMT_LIKE):
+            _walk(node, c, held, index, lock_sum, edges)
+        elif held:
+            _check_calls(node, c, held, lock_sum, edges)
+
+
+def _check_calls(node: FuncNode, expr: ast.AST, held: frozenset,
+                 lock_sum: Dict[int, Dict[str, Tuple]],
+                 edges: Edges) -> None:
+    if not held or expr is None:
+        return
+    for sub in ast.walk(expr):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            continue
+        if not isinstance(sub, ast.Call):
+            continue
+        for callee in node.call_map.get(id(sub), []):
+            for ident, (_l, _re, chain) in lock_sum.get(
+                    id(callee), {}).items():
+                for h in held:
+                    _add_edge(edges, h, ident, node.file.rel, sub.lineno,
+                              node.qual, (callee.qual,) + chain)
+
+
+def _cycles(edges: Edges) -> List[List[str]]:
+    """Strongly connected components with >1 node (Tarjan), each returned
+    as a sorted identity list."""
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    index_of: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        # iterative Tarjan (the graph is small, but recursion depth is
+        # not worth betting on)
+        work = [(v, iter(sorted(graph.get(v, ()))))]
+        index_of[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index_of:
+                    index_of[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index_of[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index_of[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index_of:
+            strongconnect(v)
+    return sorted(sccs)
+
+
+def check(files: Sequence[SourceFile]) -> List[Violation]:
+    edges = collect_edges(files)
+    out: List[Violation] = []
+    for comp in _cycles(edges):
+        comp_set = set(comp)
+        witness_edges = sorted(
+            (a, b) for (a, b) in edges
+            if a in comp_set and b in comp_set)
+        rel, line, qual, chain = edges[witness_edges[0]]
+        ring = " -> ".join(comp + [comp[0]])
+        sites = "; ".join(
+            f"{a}->{b} at {edges[(a, b)][0]}:{edges[(a, b)][1]}"
+            for a, b in witness_edges[:4])
+        out.append(Violation(
+            rel, line, "BX701",
+            f"potential AB/BA deadlock: lock-order cycle {ring} "
+            f"({sites}) — pick one global order (see "
+            f"tools/boxlint/lock_graph.txt) or split the critical "
+            f"sections"))
+    return out
+
+
+def render_inventory(files: Sequence[SourceFile]) -> str:
+    """The full nesting inventory artifact (every edge, one witness)."""
+    edges = collect_edges(files)
+    lines = [
+        "# Interprocedural lock-nesting inventory (boxlint BX7xx).",
+        "# outer -> inner : witness site (holder function[, via chain])",
+        "# Regenerate with: python -m tools.boxlint --lock-graph "
+        "paddlebox_tpu/",
+        "# An edge means: code holding `outer` acquires `inner`. Cycles",
+        "# here are BX701 violations; this file is the committed record",
+        "# of the repo's global lock order.",
+        "",
+    ]
+    for (a, b) in sorted(edges):
+        rel, line, qual, chain = edges[(a, b)]
+        lines.append(f"{a} -> {b} : {rel}:{line} in {qual}"
+                     f"{chain_str(chain)}")
+    lines.append("")
+    lines.append(f"# {len(edges)} edges, "
+                 f"{len(_cycles(edges))} cycles")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,171 @@
+"""Pass 9 — handler reentrancy (BX8xx).
+
+The PR-9 r2 seal-deadlock shape, machine-checked: ``sys.excepthook`` /
+``threading.excepthook`` / signal handlers / the stall watchdog's fire
+path / ``__del__`` all run at ARBITRARY points — a fatal signal can
+interrupt a thread midway through a critical section, and the handler
+then runs ON THAT THREAD. If the handler's reach acquires a
+non-reentrant lock the interrupted code may already hold, the dying
+process deadlocks instead of sealing its flight recorder (the exact bug:
+``tracer._reg_lock`` was a plain Lock until the hand review made it an
+RLock). Same story for unbounded blocking: a handler parked forever on a
+socket or an un-timed-out join turns "crash with artifact" into "hang
+with nothing".
+
+Roots (curated):
+  * functions assigned to ``sys.excepthook`` / ``threading.excepthook``
+  * handler arguments of ``signal.signal(...)``
+  * ``fire`` / ``render_dump`` methods of classes whose name contains
+    ``Watchdog`` (the stall watchdog dumps from its daemon thread while
+    every other thread is wedged mid-whatever)
+  * every ``__del__`` (GC runs it wherever an allocation happens)
+
+Codes:
+  BX801  non-reentrant lock acquired on a handler path while
+         non-handler code also takes it (make it an RLock, or disable
+         with a rationale explaining why the pair can't interleave)
+  BX802  blocking sink without a timeout reachable from a handler
+         (bounded waits resolve in a dying process; unbounded ones hang
+         the crash path)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.boxlint.core import SourceFile, Violation
+from tools.boxlint.callgraph import (FuncNode, PackageIndex, chain_str,
+                                     get_index)
+from tools.boxlint.purity import dotted
+
+_EXEMPT_PARTS = {"tools", "tests", "examples"}
+_HOOK_TARGETS = {"sys.excepthook", "threading.excepthook"}
+
+
+def _exempt(rel: str) -> bool:
+    return bool(_EXEMPT_PARTS.intersection(rel.split("/")[:-1]))
+
+
+def _collect_roots(index: PackageIndex) -> List[Tuple[FuncNode, str]]:
+    """(node, root description) for every curated handler entry point."""
+    roots: List[Tuple[FuncNode, str]] = []
+    for f in index.files:
+        mod = None
+        for m, sf in index.modules.items():
+            if sf is f:
+                mod = m
+                break
+        if mod is None:
+            continue
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    d = dotted(t)
+                    if d in _HOOK_TARGETS:
+                        fn = _resolve_name(node.value, mod, index)
+                        if fn is not None:
+                            roots.append((fn, d))
+            elif isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d in ("signal.signal",) and len(node.args) >= 2:
+                    fn = _resolve_name(node.args[1], mod, index)
+                    if fn is not None:
+                        roots.append((fn, "signal handler"))
+    for name, class_list in index.classes.items():
+        for cn in class_list:
+            if "Watchdog" in cn.name:
+                for meth in ("fire", "render_dump"):
+                    if meth in cn.methods:
+                        roots.append((cn.methods[meth],
+                                      f"{cn.name} fire path"))
+            if "__del__" in cn.methods:
+                roots.append((cn.methods["__del__"],
+                              f"{cn.name}.__del__"))
+    return roots
+
+
+def _resolve_name(expr: ast.AST, mod: str,
+                  index: PackageIndex) -> Optional[FuncNode]:
+    d = dotted(expr)
+    if not d:
+        return None
+    hit = index.functions.get((mod, d))
+    if hit:
+        return hit
+    imp = index.imports.get(mod, {}).get(d)
+    if imp:
+        tmod, _, tname = imp.rpartition(".")
+        return index.functions.get((tmod, tname))
+    return None
+
+
+def _closure(roots: Sequence[Tuple[FuncNode, str]]
+             ) -> Dict[int, Tuple[FuncNode, str, Tuple[str, ...]]]:
+    """BFS from the roots: id(node) -> (node, root description, chain
+    from the root to this node). First (shortest) reach wins."""
+    reached: Dict[int, Tuple[FuncNode, str, Tuple[str, ...]]] = {}
+    work: List[Tuple[FuncNode, str, Tuple[str, ...]]] = [
+        (n, desc, ()) for n, desc in roots]
+    while work:
+        node, desc, chain = work.pop(0)
+        if id(node) in reached:
+            continue
+        reached[id(node)] = (node, desc, chain)
+        if len(chain) >= 8:
+            continue
+        for _line, callee in node.calls:
+            if id(callee) not in reached:
+                work.append((callee, desc, chain + (callee.qual,)))
+    return reached
+
+
+def check(files: Sequence[SourceFile]) -> List[Violation]:
+    index = get_index(files)
+    roots = _collect_roots(index)
+    if not roots:
+        return []
+    reached = _closure(roots)
+    lock_sum = index.lock_closure()
+    # identities the non-handler world acquires (directly or through its
+    # calls) — the contention side of the BX801 pair
+    outside: Set[str] = set()
+    for node in index.nodes:
+        if id(node) in reached:
+            continue
+        for ident in lock_sum.get(id(node), {}):
+            outside.add(ident)
+    out: List[Violation] = []
+    seen: Set[Tuple[str, int, str]] = set()
+    for nid, (node, desc, chain) in sorted(
+            reached.items(), key=lambda kv: kv[1][0].file.rel):
+        if _exempt(node.file.rel):
+            continue
+        for line, ident, reentrant in node.direct_locks:
+            if reentrant or ident not in outside:
+                continue
+            key = (node.file.rel, line, ident)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(Violation(
+                node.file.rel, line, "BX801",
+                f"non-reentrant {ident} acquired on a handler path "
+                f"({desc}{chain_str(chain)}) while non-handler code also "
+                f"takes it — a handler interrupting the holder deadlocks "
+                f"the dying process; use an RLock (or disable with "
+                f"rationale)"))
+        for line, label, _bound, has_to in node.direct_sinks:
+            if has_to:
+                continue
+            key = (node.file.rel, line, label)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(Violation(
+                node.file.rel, line, "BX802",
+                f"blocking sink without timeout on a handler path "
+                f"({desc}{chain_str(chain)}): {label} — an unbounded "
+                f"wait hangs the crash/teardown path; add a timeout (or "
+                f"disable with rationale)"))
+    return out

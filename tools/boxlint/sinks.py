@@ -1,0 +1,137 @@
+"""The curated blocking-sink list shared by BX6xx (blocking-under-lock)
+and BX8xx (handler reentrancy).
+
+A *sink* is a call that can park the calling thread for an unbounded (or
+operator-visible) time: socket primitives, framed RPC / TcpStore ops
+(reached transitively — their bodies bottom out in socket sends/recvs),
+channel blocking get/put, ``time.sleep``, thread/process ``join()``,
+``subprocess``, ``fsync``, ``Future.result``, condition/event waits — plus
+the one curated *heavy-compute* entry, the trapezoid-AUC math, because
+"quality report computed UNDER the add-path lock" (PR 13 hand-review) is
+this repo's recurring stall shape and no name-based heuristic can find
+"slow numpy" in general.
+
+Each match returns ``(line, label, bound_lock_identity, has_timeout)``:
+
+  * ``bound_lock_identity`` is non-None only for ``Condition.wait`` — a
+    wait *releases* the condition's lock, so holding exactly that lock is
+    the legitimate pattern, not a bug (Channel.get's shape). BX601 drops
+    the bound lock from the held set before flagging.
+  * ``has_timeout`` records whether the call carries an explicit bound
+    (timeout kwarg / wait(n) / sleep is its own bound). BX6xx flags
+    either way (holding a lock for a full timeout window still stalls
+    every peer); BX8xx only flags the unbounded form — a bounded wait in
+    a dying process resolves, an unbounded one is the PR-9 seal deadlock.
+
+False-positive control is by receiver typing where names are generic:
+``.get``/``.put`` only flag on receivers the call graph types as
+Channel/Queue, ``.wait`` only on Condition/Event attrs, ``.join()`` only
+with zero positional args (``str.join`` always has one).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Tuple
+
+from tools.boxlint.purity import dotted
+
+# receiver class-name tails whose get/put family blocks
+_CHANNEL_TYPES = {"Channel", "Queue", "SimpleQueue", "LifoQueue",
+                  "PriorityQueue"}
+_SOCKET_ATTRS = {"connect": "socket.connect", "recv": "socket.recv",
+                 "recv_into": "socket.recv_into",
+                 "sendall": "socket.sendall", "accept": "socket.accept"}
+_AUC_NAMES = {"table_auc", "trapezoid_auc"}
+
+
+def _has_timeout_kw(call: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+def match_sink(call: ast.Call, node, index, local_types: Dict[str, str]
+               ) -> Optional[Tuple[int, str, Optional[str], bool]]:
+    """See module docstring. ``node``/``index`` are the callgraph context
+    (receiver typing + condition bound-lock resolution)."""
+    d = dotted(call.func)
+    line = call.lineno
+    if d:
+        parts = d.split(".")
+        tail = parts[-1]
+        if d in ("time.sleep",) or (tail == "sleep" and len(parts) == 1):
+            return (line, "time.sleep", None, True)
+        if parts[0] == "subprocess":
+            return (line, f"subprocess.{tail}", None, _has_timeout_kw(call))
+        if d in ("os.fsync", "fsync"):
+            return (line, "os.fsync", None, False)
+        if tail == "create_connection" and parts[0] in ("socket",):
+            # the dial idiom (FramedClient.__init__): connect + DNS
+            return (line, "socket.connect", None, _has_timeout_kw(call))
+        if tail in _AUC_NAMES:
+            return (line, f"heavy AUC math ({tail})", None, False)
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    recv = call.func.value
+    if attr in _SOCKET_ATTRS:
+        return (line, _SOCKET_ATTRS[attr], None, False)
+    if attr == "join":
+        # str.join always takes one positional ITERABLE; thread/process
+        # joins take nothing or a numeric/None timeout. Receivers typed
+        # as Thread match with any argument shape; untyped receivers
+        # match zero-arg and single-CONSTANT-arg forms (join(None) is
+        # the unbounded wait BX802 exists for; join(60.0) is bounded).
+        tname = _receiver_type(recv, node, index, local_types)
+        if not call.args:
+            return (line, "Thread.join", None, _has_timeout_kw(call))
+        if len(call.args) == 1 and not call.keywords:
+            a = call.args[0]
+            if isinstance(a, ast.Constant) and a.value is None:
+                return (line, "Thread.join", None, False)
+            if isinstance(a, ast.Constant) and isinstance(
+                    a.value, (int, float)):
+                return (line, "Thread.join", None, True)
+            if tname == "Thread":   # join(timeout_var): bounded intent
+                return (line, "Thread.join", None, True)
+        return None
+    if attr == "result" and not call.args:
+        return (line, "Future.result", None, _has_timeout_kw(call))
+    if attr == "wait":
+        kind = _receiver_lockish(recv, node, index)
+        if kind == "condition":
+            ident = index.lock_identity(recv, node)
+            bound = ident[0] if ident else None
+            has_to = bool(call.args) or _has_timeout_kw(call)
+            return (line, "Condition.wait", bound, has_to)
+        if kind == "event":
+            has_to = bool(call.args) or _has_timeout_kw(call)
+            return (line, "Event.wait", None, has_to)
+        return None
+    if attr in ("get", "put", "get_many", "put_many"):
+        tname = _receiver_type(recv, node, index, local_types)
+        if tname in _CHANNEL_TYPES:
+            has_to = _has_timeout_kw(call) or (
+                attr in ("get",) and bool(call.args))
+            return (line, f"Channel.{attr}", None, has_to)
+        return None
+    return None
+
+
+def _receiver_lockish(recv: ast.AST, node, index) -> Optional[str]:
+    """'condition'/'event' when the receiver is a known lock-ish attr."""
+    if (isinstance(recv, ast.Attribute) and isinstance(recv.value, ast.Name)
+            and recv.value.id in ("self", "cls") and node.cls):
+        own = index._class_in_module(node.cls, node.module)
+        return index.lock_kind(own, recv.attr)
+    return None
+
+
+def _receiver_type(recv: ast.AST, node, index,
+                   local_types: Dict[str, str]) -> Optional[str]:
+    if (isinstance(recv, ast.Attribute) and isinstance(recv.value, ast.Name)
+            and recv.value.id in ("self", "cls") and node.cls):
+        own = index._class_in_module(node.cls, node.module)
+        return index._attr_type(own, recv.attr)
+    if isinstance(recv, ast.Name):
+        return local_types.get(recv.id)
+    return None

@@ -1,0 +1,89 @@
+"""Pass 10 — silent exception swallowing (BX503).
+
+``except Exception: pass`` erases evidence: the failure happened, nobody
+will ever know, and the next symptom shows up three planes away (the
+repo's review record keeps re-finding this by hand). The contract this
+pass pins (ISSUE 14 satellite): every silent swallow in library code
+either
+
+  * becomes a counted loud path — log a warning through
+    ``paddlebox_tpu.obs.log`` and/or bump a StatRegistry counter (a
+    handler body that DOES anything is by definition not silent and
+    never flags), or
+  * carries a rationale comment on the ``except`` clause's lines
+    explaining why silence is the correct behavior (``__del__``
+    teardown-ordering guards are the canonical case: the interpreter may
+    be half-dead, logging itself can fail).
+
+"Silent" means the handler catches ``Exception`` / ``BaseException`` /
+bare ``except:`` and its body contains only ``pass`` / constants /
+``continue`` / a bare or constant ``return``. Any comment on the
+handler's lines counts as the rationale — the reviewable-decision bar is
+"someone wrote down why", the same bar as BX401's disable rationale.
+
+Scope: library code (``tools``/``tests``/``examples`` path parts exempt,
+as BX501 — probes print their own diagnostics and tests assert on
+failures anyway).
+
+Codes:
+  BX503  silent except-Exception swallow without a rationale comment
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence
+
+from tools.boxlint.core import SourceFile, Violation
+from tools.boxlint.purity import dotted
+
+_EXEMPT_PARTS = {"tools", "tests", "examples"}
+_BROAD = {"Exception", "BaseException"}
+
+
+def _exempt(rel: str) -> bool:
+    return bool(_EXEMPT_PARTS.intersection(rel.split("/")[:-1]))
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    d = dotted(handler.type)
+    return bool(d) and d.split(".")[-1] in _BROAD
+
+
+def _is_silent(body: Sequence[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                     ast.Constant):
+            continue  # docstring / ellipsis
+        if isinstance(stmt, ast.Return) and (
+                stmt.value is None
+                or isinstance(stmt.value, ast.Constant)):
+            continue
+        return False
+    return True
+
+
+def check(files: Sequence[SourceFile]) -> List[Violation]:
+    out: List[Violation] = []
+    for f in files:
+        if _exempt(f.rel):
+            continue
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node) or not _is_silent(node.body):
+                continue
+            end = node.end_lineno or node.lineno
+            if any(ln in f.comments
+                   for ln in range(node.lineno, end + 1)):
+                continue  # rationale written down — a reviewed decision
+            out.append(Violation(
+                f.rel, node.lineno, "BX503",
+                "silent except-Exception swallow: the failure leaves no "
+                "trace — log a counted warning through obs/log, or leave "
+                "a rationale comment on the handler"))
+    return out
