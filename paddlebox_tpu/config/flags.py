@@ -541,3 +541,40 @@ define_flag("debug_lock_order", False,
             "publishes lock_hold_us_<name> histograms through the obs "
             "StatRegistry. Off (default) = plain threading locks, zero "
             "added cost; the concurrency suites run with it on")
+define_flag("device_obs", True,
+            "device-plane observability (obs/device.py, round 20): "
+            "every jit entry point runs through instrument_jit — exact "
+            "per-fn compile counts + compile wall time, a one-time "
+            "cost/memory-analysis snapshot (flops & bytes-accessed per "
+            "example, temp/alias bytes — the step_audit math, live), a "
+            "steady-state RECOMPILE SENTINEL (device_recompiles stat + "
+            "HealthMonitor penalty), a donation audit (donation_miss "
+            "when a donated buffer was copied instead of aliased — the "
+            "regime-step mechanism), and the HBM live-buffer ledger "
+            "sampled at report cadence. Off = bare jax.jit everywhere "
+            "(zero added cost, zero device signals); bench.py's "
+            "device_overhead block holds the on-cost at <=2%")
+define_flag("device_recompile_warmup", 3,
+            "compiles each instrumented fn may accumulate before the "
+            "recompile sentinel treats further compiles as steady-state "
+            "shape/dtype churn (counted in device_recompiles, logged "
+            "loudly once per fn, scored unhealthy by the cluster "
+            "HealthMonitor): legitimate multi-signature entry points "
+            "(a tail chunk, an eval twin shape) fit inside the "
+            "allowance; a mis-staged batch recompiling every step "
+            "does not")
+define_flag("device_donation_min_bytes", 65536,
+            "donation-audit floor: donated buffers smaller than this "
+            "are not pointer-checked (XLA legitimately declines to "
+            "alias tiny buffers and the alarm exists for slab-scale "
+            "copies — the >=4M-row regime step is a ~272MB one)")
+define_flag("device_leak_windows", 3,
+            "live-buffer leak detector: consecutive ledger samples "
+            "(report cadence) of strictly-growing total device bytes "
+            "before device_leak_suspect fires (once per sustained "
+            "climb, loud warn with the growth)")
+define_flag("device_leak_min_bytes", 1 << 20,
+            "live-buffer leak detector: minimum total growth across "
+            "the monotonic window before it counts — compile-time "
+            "constant buffers and small per-pass arrays must not page "
+            "an operator")

@@ -571,4 +571,7 @@ def make_push_fn(layout: ValueLayout,
     points, never inside the optimizer math — callers holding an encoded
     slab decode rows first (accessor.decode_slab_rows) and encode the
     result back."""
-    return jax.jit(functools.partial(apply_push, layout=layout, conf=conf))
+    from paddlebox_tpu.obs.device import instrument_jit
+    return instrument_jit(
+        functools.partial(apply_push, layout=layout, conf=conf),
+        "apply_push")

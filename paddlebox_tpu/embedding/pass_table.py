@@ -37,15 +37,15 @@ from paddlebox_tpu.embedding.accessor import (PushLayout, ValueLayout,
 from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
 from paddlebox_tpu.embedding.native_store import make_host_store
 from paddlebox_tpu.embedding.optimizers import apply_push
+from paddlebox_tpu.obs.device import account_d2h, account_h2d, instrument_jit
 from paddlebox_tpu.obs.tracer import record_span
 from paddlebox_tpu.utils.stats import gauge_set, stat_add
 from paddlebox_tpu.utils.timer import Timer
 from paddlebox_tpu.utils.lockwatch import make_lock
 
 
-@functools.partial(jax.jit, static_argnames=("layout",))
-def _pull_kernel(slab: jnp.ndarray, ids: jnp.ndarray,
-                 layout: ValueLayout) -> jnp.ndarray:
+def _pull_kernel_impl(slab: jnp.ndarray, ids: jnp.ndarray,
+                      layout: ValueLayout) -> jnp.ndarray:
     """Gather pull view [show, click, embed_w, embedx...] per key
     (PullCopy semantics, box_wrapper.cu:75-120). Padding ids hit the trash
     row; callers mask by segment validity downstream."""
@@ -53,12 +53,20 @@ def _pull_kernel(slab: jnp.ndarray, ids: jnp.ndarray,
     return pull_sparse(slab, ids, layout)
 
 
-@functools.partial(jax.jit, static_argnames=("layout", "conf"))
-def _push_kernel(slab: jnp.ndarray, ids: jnp.ndarray, grads: jnp.ndarray,
-                 prng: jax.Array, layout: ValueLayout, conf) -> jnp.ndarray:
+_pull_kernel = instrument_jit(_pull_kernel_impl, "table_pull",
+                              static_argnames=("layout",))
+
+
+def _push_kernel_impl(slab: jnp.ndarray, ids: jnp.ndarray,
+                      grads: jnp.ndarray, prng: jax.Array,
+                      layout: ValueLayout, conf) -> jnp.ndarray:
     """jit wrapper over the dedup-merge-optimize-scatter push."""
     from paddlebox_tpu.embedding.optimizers import push_sparse_dedup
     return push_sparse_dedup(slab, ids, grads, prng, layout, conf)
+
+
+_push_kernel = instrument_jit(_push_kernel_impl, "table_push",
+                              static_argnames=("layout", "conf"))
 
 
 def _delta_promote_impl(old_slab, src, keep, new_idx, new_rows):
@@ -79,7 +87,11 @@ def _delta_promote_impl(old_slab, src, keep, new_idx, new_rows):
 # live slab at any moment, like the full path (test-mode passes donate
 # too; their eval slab can't become resident, so keeping a second copy
 # would only double peak HBM)
-_delta_promote = jax.jit(_delta_promote_impl, donate_argnums=(0,))
+# recompile_warmup: promote counts pad to power-of-two buckets, so the
+# legitimate signature space is ~log2(capacity) shapes, not the default
+# steady-state allowance
+_delta_promote = instrument_jit(_delta_promote_impl, "delta_promote",
+                                donate_argnums=(0,), recompile_warmup=32)
 
 
 def _slab_embed_dtype() -> str:
@@ -535,6 +547,8 @@ class PassTable:
             # can't become resident (zero rows for store-missing keys),
             # so end_pass drops residency and the next train pass pays
             # one full rebuild — the pre-round-6 eval HBM profile
+            account_h2d(rows_p.nbytes + src.nbytes + keep.nbytes
+                        + idx_p.nbytes)  # promote-delta staging transfer
             self._slab = _delta_promote(self._slab, jnp.asarray(src),
                                         jnp.asarray(keep),
                                         jnp.asarray(idx_p),
@@ -556,6 +570,7 @@ class PassTable:
             if n:
                 slab[:n] = encode_slab_rows_np(host_rows, self.layout)
             slab[n:] = 0
+            account_h2d(slab.nbytes)  # full slab build transfer
             self._slab = jnp.asarray(slab)
         self._drop_prev_route()
         self._touch_seen = False
@@ -606,17 +621,18 @@ class PassTable:
                     if idx.size:
                         # writeback boundary: encoded device rows decode
                         # back to host f32 (identity for f32 slabs)
-                        rows = decode_slab_rows_np(
-                            np.asarray(self._slab[jnp.asarray(idx)]),
-                            self.layout)
+                        dev_rows = np.asarray(self._slab[jnp.asarray(idx)])
+                        account_d2h(dev_rows.nbytes)  # touched-row D2H
+                        rows = decode_slab_rows_np(dev_rows, self.layout)
                         self._journal_rows(self._pass_keys[idx], rows)
                         with self.store_lock:
                             self.store.write_back(self._pass_keys[idx], rows)
                     stat_add("pass_rows_written_back", int(idx.size))
                     stat_add("pass_rows_writeback_skipped", n - int(idx.size))
                 else:
-                    host = decode_slab_rows_np(np.asarray(self._slab[:n]),
-                                               self.layout)
+                    dev_rows = np.asarray(self._slab[:n])
+                    account_d2h(dev_rows.nbytes)  # full-slab D2H
+                    host = decode_slab_rows_np(dev_rows, self.layout)
                     self._journal_rows(self._pass_keys, host)
                     with self.store_lock:
                         self.store.write_back(self._pass_keys, host)

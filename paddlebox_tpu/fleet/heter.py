@@ -74,7 +74,6 @@ class HeterDenseService:
             loss = jnp.where(batch["ins_valid"], bce, 0.0).sum() / denom
             return loss, jax.nn.sigmoid(logits)
 
-        @jax.jit
         def train_step(params, opt_state, emb, batch):
             grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1),
                                          has_aux=True)
@@ -83,13 +82,15 @@ class HeterDenseService:
             params = optax.apply_updates(params, updates)
             return params, opt_state, demb, loss, preds
 
-        @jax.jit
         def eval_step(params, emb, batch):
             _, preds = loss_fn(params, emb, batch)
             return preds
 
-        self._train_step = train_step
-        self._eval_step = eval_step
+        from paddlebox_tpu.obs.device import instrument_jit
+        self._train_step = instrument_jit(train_step, "heter_train_step",
+                                          example_count=B)
+        self._eval_step = instrument_jit(eval_step, "heter_eval_step",
+                                         example_count=B)
         self._rpc = FramedServer(self._handle, _loads, host, port)
 
     @property

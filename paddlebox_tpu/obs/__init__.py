@@ -34,10 +34,22 @@ platform/monitor.h + timer discipline + chrometracing profiler did
                    flow events (obs/tracer.py, round 14)
   * exporter     — per-rank HTTP ops endpoint (flag obs_http_port,
                    port +rank): /metrics Prometheus exposition,
-                   /report, /health, /stacks, /flight, /quality — the
-                   live READ surface over every tier above, answered
-                   from defensive snapshots only (obs/exporter.py,
-                   round 18)
+                   /report, /health, /stacks, /flight, /quality,
+                   /device — the live READ surface over every tier
+                   above, answered from defensive snapshots only
+                   (obs/exporter.py, round 18)
+  * device       — the XLA/device tier (obs/device.py, round 20):
+                   instrument_jit wraps every jit entry point (boxlint
+                   BX901 enforces) with exact compile counts/wall time,
+                   one-time cost/memory-analysis snapshots, a
+                   steady-state recompile sentinel, and a donation
+                   audit (donated-buffer pointer reuse); the runners'
+                   staging/write-back paths account H2D/D2H transfer
+                   bytes and the live-buffer ledger buckets
+                   jax.live_arrays() by owner at report cadence with a
+                   monotonic-growth leak detector — all through the
+                   StatRegistry, so reports/metrics/flight/health carry
+                   it unchanged
 
 Import surface is deliberately jax-free: every hot-path hook (span,
 beat) must stay importable and near-free on any host — the serving
@@ -46,9 +58,12 @@ processes (per-pull latency histograms, QPS windows, cache-rate extras
 ride the same StepReport/sink/aggregation machinery unchanged).
 """
 
+from paddlebox_tpu.obs import device  # noqa: F401
 from paddlebox_tpu.obs import exporter  # noqa: F401
 from paddlebox_tpu.obs import flight  # noqa: F401
 from paddlebox_tpu.obs import log  # noqa: F401
+from paddlebox_tpu.obs.device import (account_d2h, account_h2d,  # noqa: F401
+                                      instrument_jit)
 from paddlebox_tpu.obs.aggregate import (ClusterAggregator,  # noqa: F401
                                          MeshObsTransport, StoreObsTransport,
                                          make_transport,

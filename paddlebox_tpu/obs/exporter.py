@@ -21,6 +21,9 @@ gets its own port from ONE flag; 0 = off):
   ``/flight``   flight-recorder segment list + tail of the black box
   ``/quality``  quality + drift plane snapshot (full detail; /metrics
                 carries the headline series)
+  ``/device``   device-plane snapshot (round 20): per-jit compile
+                counts + wall time, cost/memory analyses, donation
+                audit, transfer counters, live-buffer ledger
 
 Scrape-safety is the design contract: every handler answers from
 DEFENSIVE SNAPSHOTS — the StatRegistry's snapshot_all (one short
@@ -225,11 +228,14 @@ class ObsExporter:
             return self._flight(handler)
         if path == "/quality":
             return self._quality(handler)
+        if path == "/device":
+            return self._device(handler)
         if path == "/":
             return self._send_json(handler, {
                 "rank": self.rank, "v": SCHEMA_VERSION,
                 "endpoints": ["/metrics", "/report", "/health",
-                              "/stacks", "/flight", "/quality"]})
+                              "/stacks", "/flight", "/quality",
+                              "/device"]})
         self._send_json(handler, {"error": "unknown path %s" % path},
                         code=404)
 
@@ -302,6 +308,16 @@ class ObsExporter:
             "active": True, "dir": fr.dir, "rank": fr.rank,
             "segments": segs,
             "tail": [ln.rstrip("\n") for ln in tail]})
+
+    def _device(self, handler) -> None:
+        """Device-plane snapshot (round 20): per-entry-point compile
+        counts/wall time, cost/memory analyses, donation audit,
+        transfer counters and the last live-buffer ledger sample —
+        obs/device.py's snapshot() is already a defensive copy."""
+        from paddlebox_tpu.obs import device as _device
+        out = _device.snapshot()
+        out["rank"] = self.rank
+        self._send_json(handler, out)
 
     def _quality(self, handler) -> None:
         from paddlebox_tpu.metrics import drift as _drift

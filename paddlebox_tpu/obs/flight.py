@@ -276,6 +276,15 @@ class FlightRecorder:
             "segments": [os.path.basename(p) for p in self.segments()],
             "header": self.header(),
         }
+        try:
+            # device-plane snapshot (round 20): compile counts, donation
+            # audit, transfer counters, last HBM ledger sample — the
+            # postmortem must say whether the dying rank was recompiling
+            # or copying its slab
+            from paddlebox_tpu.obs import device as _device
+            manifest["device"] = _device.snapshot()
+        except Exception:  # noqa: BLE001 — sealing must never raise into a crash path
+            manifest["device"] = None
         if extra_text:
             manifest["extra_text"] = extra_text[-8000:]
         self.record("sealed", reason=reason, seal_index=n)

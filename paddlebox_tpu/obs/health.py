@@ -35,6 +35,15 @@ and loses
          (metrics/quality.py: click over predicted click) outside the
          ``copc_band`` calibration band — the failure that kills a
          production CTR model while every systems signal stays green
+  * 0.6  steady-state recompiles (round 20): ``device_recompiles``
+         counted in the window (obs/device.py's sentinel — shape/dtype
+         churn recompiling a hot jit entry point stalls every step for
+         a full XLA compile); weighted past the healthy bar on its own
+  * 0.6  donation miss (round 20): ``donation_miss`` counted in the
+         window — a donated slab-scale buffer was copied instead of
+         aliased, the regime-step mechanism; the step is silently
+         paying a slab memcpy, so the rank reads unhealthy even while
+         it keeps stepping
 ``healthy`` = score >= 0.5.
 
 Staleness measures TELEMETRY silence, which is the only signal rank 0
@@ -91,6 +100,8 @@ class HealthMonitor:
         slo_burn = self._per_rank(merged, "gauges.serving_slo_burn")
         drift = self._per_rank(merged, "gauges.data_drift_score")
         copc = self._per_rank(merged, "gauges.quality_copc")
+        recompiles = self._per_rank(merged, "stats.device_recompiles")
+        donation = self._per_rank(merged, "stats.donation_miss")
         depths = {}
         for k, m in (merged.get("metrics") or {}).items():
             if (k.startswith("gauges.") and k.endswith("_depth")):
@@ -143,6 +154,17 @@ class HealthMonitor:
                     self.copc_band[0] <= c <= self.copc_band[1]):
                 score -= 0.3
                 flags.append("miscalibrated")
+            if recompiles.get(r, 0.0) > 0:
+                # device-plane sentinel (round 20): steady-state
+                # recompiles stall every step for a full XLA compile —
+                # past the healthy bar on its own
+                score -= 0.6
+                flags.append("device_recompiles")
+            if donation.get(r, 0.0) > 0:
+                # donation miss = the step silently pays a slab-sized
+                # copy (the regime-step mechanism) — past the bar alone
+                score -= 0.6
+                flags.append("donation_miss")
             score = max(0.0, min(1.0, score))
             entry = {"score": round(score, 3),
                      "healthy": score >= 0.5,

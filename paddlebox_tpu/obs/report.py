@@ -165,6 +165,12 @@ class StepReporter:
         if w is not None:
             self._registry.set_gauge(
                 "beat_age_s", max(0.0, time.monotonic() - w._beat[0]))
+        # device plane (round 20): sample the HBM live-buffer ledger at
+        # report cadence (owner-bucketed gauges + leak detector);
+        # near-free no-op when no jit entry point is instrumented in
+        # this process (serving replicas stay jax-free)
+        from paddlebox_tpu.obs import device as _device
+        _device.on_report()
         snap = self._registry.snapshot_all()
 
         stats_delta = {}

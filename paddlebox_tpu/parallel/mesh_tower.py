@@ -208,16 +208,19 @@ class MeshTowerTrainer:
         efn = jax.shard_map(
             eval_step, mesh=self.mesh, in_specs=(spec_p, P(), P()),
             out_specs=P(), check_vma=False)
-        return jax.jit(fn, donate_argnums=(2,)), jax.jit(efn)
+        from paddlebox_tpu.obs.device import instrument_jit
+        return (instrument_jit(fn, "mesh_tower_step", donate_argnums=(2,)),
+                instrument_jit(efn, "mesh_tower_eval"))
 
     # ----------------------------------------------------------- host driver
     def host_batch(self, b: PackedBatch) -> Dict[str, jnp.ndarray]:
+        from paddlebox_tpu.obs.device import account_h2d, tree_nbytes
         ids = self.table.lookup_ids(b.keys, b.valid)
-        out = {
-            "ids": jnp.asarray(ids),
-            "segments": jnp.asarray(b.segments),
-            "labels": jnp.asarray(b.labels),
-            "ins_valid": jnp.asarray(b.ins_valid),
+        host = {
+            "ids": ids,
+            "segments": b.segments,
+            "labels": b.labels,
+            "ins_valid": b.ins_valid,
         }
         if not self.table.test_mode:
             # eval never pushes — skip the dedup + transfers; uids ride the
@@ -225,12 +228,11 @@ class MeshTowerTrainer:
             # mode stages the pos map for the scatter-free slab write
             uids, perm, inv = self.table.dedup_for_push(
                 ids, sort=self._push_write == "blocked")
-            out.update(perm=jnp.asarray(perm), inv=jnp.asarray(inv),
-                       uids=jnp.asarray(uids))
+            host.update(perm=perm, inv=inv, uids=uids)
             if self._push_write == "rebuild":
-                out["push_pos"] = jnp.asarray(
-                    self.table.pos_for_rebuild(uids))
-        return out
+                host["push_pos"] = self.table.pos_for_rebuild(uids)
+        account_h2d(tree_nbytes(host))  # everything staged below
+        return {k: jnp.asarray(v) for k, v in host.items()}
 
     def train_batch(self, b: PackedBatch) -> float:
         from paddlebox_tpu.train.eval_driver import feed_simple_metrics

@@ -168,9 +168,10 @@ class GPipeRunner:
             local = jax.tree.map(lambda x: x[0], params)  # [1,...] → [...]
             return pipe(local, micro_inputs)
 
-        return jax.jit(jax.shard_map(
+        from paddlebox_tpu.obs.device import instrument_jit
+        return instrument_jit(jax.shard_map(
             fwd, mesh=self.mesh, in_specs=(P(self.axis), P()),
-            out_specs=P(), check_vma=False))
+            out_specs=P(), check_vma=False), "pipe_fwd")
 
     def forward(self, x: np.ndarray) -> jax.Array:
         """x: [M*mb, d] → pipelined output [M*mb, d]."""
@@ -210,10 +211,12 @@ class GPipeRunner:
             lambda x: spec_sh if getattr(x, "ndim", 0) else P(),
             self.opt_state,
             is_leaf=lambda x: hasattr(x, "ndim") or np.isscalar(x))
-        return jax.jit(jax.shard_map(
+        from paddlebox_tpu.obs.device import instrument_jit
+        return instrument_jit(jax.shard_map(
             step, mesh=self.mesh,
             in_specs=(spec_sh, opt_spec, P(), P()),
-            out_specs=(spec_sh, opt_spec, P()), check_vma=False))
+            out_specs=(spec_sh, opt_spec, P()), check_vma=False),
+            "pipe_step")
 
     def train_step(self, x: np.ndarray, y: np.ndarray) -> float:
         cfg = self.cfg
@@ -924,7 +927,9 @@ class CtrPipelineRunner:
             eval_step, mesh=self.mesh,
             in_specs=(spec_sh, P(), dp_spec), out_specs=dp_spec,
             check_vma=False)
-        return jax.jit(fn, donate_argnums=(2,)), jax.jit(efn)
+        from paddlebox_tpu.obs.device import instrument_jit
+        return (instrument_jit(fn, "ctr_pipe_step", donate_argnums=(2,)),
+                instrument_jit(efn, "ctr_pipe_eval"))
 
     # ----------------------------------------------------------- host driver
     @property
@@ -1431,7 +1436,9 @@ class ShardedCtrPipelineRunner:
             eval_step, mesh=self.mesh,
             in_specs=(spec_stage, spec_flat, spec_flat),
             out_specs=preds_spec, check_vma=False)
-        return jax.jit(fn, donate_argnums=(2,)), jax.jit(efn)
+        from paddlebox_tpu.obs.device import instrument_jit
+        return (instrument_jit(fn, "tower_pipe_step", donate_argnums=(2,)),
+                instrument_jit(efn, "tower_pipe_eval"))
 
     # ----------------------------------------------------------- host driver
     @property

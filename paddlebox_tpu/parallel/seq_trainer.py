@@ -201,7 +201,9 @@ class SeqCtrTrainer:
             eval_step, mesh=self.mesh,
             in_specs=(P(), P(), eval_specs), out_specs=P(),
             check_vma=False)
-        return jax.jit(fn, donate_argnums=(2,)), jax.jit(efn)
+        from paddlebox_tpu.obs.device import instrument_jit
+        return (instrument_jit(fn, "seq_step", donate_argnums=(2,)),
+                instrument_jit(efn, "seq_eval"))
 
     # ----------------------------------------------------------- host driver
     def seq_ids_of(self, b: PackedBatch, ids: np.ndarray):

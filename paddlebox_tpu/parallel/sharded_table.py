@@ -805,15 +805,18 @@ class ShardedPassTable:
         ks = self._shard_keys[s]
         if not ks.size or self.stores[s] is None:  # boxlint: disable=BX401 (presence probe)
             return
+        from paddlebox_tpu.obs.device import account_d2h
         idx = self._touched_idx(s, ks.size)
         if idx is None:
-            self.write_back_shard(s, np.asarray(dev)[0])
+            full = np.asarray(dev)[0]
+            account_d2h(full.nbytes)  # full-shard D2H
+            self.write_back_shard(s, full)
             return
         if idx.size:
             import jax.numpy as jnp
-            rows = decode_slab_rows_np(
-                np.asarray(jnp.asarray(dev)[0][jnp.asarray(idx)]),
-                self.layout)
+            dev_rows = np.asarray(jnp.asarray(dev)[0][jnp.asarray(idx)])
+            account_d2h(dev_rows.nbytes)  # touched-row delta D2H
+            rows = decode_slab_rows_np(dev_rows, self.layout)
             self._journal_rows(ks[idx], rows)
             with self.store_lock:
                 self.stores[s].write_back(ks[idx], rows)

@@ -233,6 +233,14 @@ class ShardedBoxTrainer:
         self.reporter = make_step_reporter(
             rank=self._obs_rank, timers=self.timers,
             aggregator=self.aggregator)
+        # device plane (round 20): HBM-ledger owners, weakref'd (the
+        # ledger must not extend the runner's lifetime)
+        import weakref
+        from paddlebox_tpu.obs.device import register_owner
+        _w = weakref.ref(self)
+        register_owner("slab", lambda: getattr(_w(), "_slabs", None))
+        register_owner("dense_params", lambda: getattr(_w(), "params", None))
+        register_owner("opt_state", lambda: getattr(_w(), "opt_state", None))
         self._pool = None   # routing thread pool, lazy (_stager_pool)
         # DumpField debug writers (boxps_worker.cc DumpField): each
         # process dumps its OWN workers' rows (the per-node dump files of
@@ -683,7 +691,8 @@ class ShardedBoxTrainer:
                        spec_rep, spec_sh, spec_sh),
             check_vma=False)
         # slabs + metric state donated: one live copy each on device
-        return jax.jit(fn, donate_argnums=(0, 5, 6))
+        from paddlebox_tpu.obs.device import instrument_jit
+        return instrument_jit(fn, "shard_step", donate_argnums=(0, 5, 6))
 
     def _build_param_sync(self):
         """K-step dense sync: allreduce-mean the diverged per-device param
@@ -706,14 +715,18 @@ class ShardedBoxTrainer:
                     jax.tree.map(lambda x: x[None], opt_state))
 
         spec_sh = P(self.axis)
-        return jax.jit(jax.shard_map(
+        from paddlebox_tpu.obs.device import instrument_jit
+        return instrument_jit(jax.shard_map(
             sync, mesh=self.mesh, in_specs=(spec_sh, spec_sh),
-            out_specs=(spec_sh, spec_sh), check_vma=False))
+            out_specs=(spec_sh, spec_sh), check_vma=False),
+            "shard_param_sync")
 
     # -------------------------------------------------------------- batches
     def _put_sharded(self, host_local: np.ndarray, sharding) -> jax.Array:
         """Local [L, ...] rows → global [P, ...] array on the mesh axis.
         Single process: L == P and this is a plain device_put."""
+        from paddlebox_tpu.obs.device import account_h2d
+        account_h2d(getattr(host_local, "nbytes", 0))  # staging transfer
         if not self.multiprocess:
             return jax.device_put(host_local, sharding)
         global_shape = (self.P,) + host_local.shape[1:]
@@ -1048,10 +1061,11 @@ class ShardedBoxTrainer:
 
         spec_sh = P(self.axis)
         par_in = spec_sh if self.k_step > 1 else P()
-        return jax.jit(jax.shard_map(
+        from paddlebox_tpu.obs.device import instrument_jit
+        return instrument_jit(jax.shard_map(
             shard_eval, mesh=self.mesh,
             in_specs=(spec_sh, par_in, spec_sh), out_specs=spec_sh,
-            check_vma=False))
+            check_vma=False), "shard_eval")
 
     def predict_batches(self, dataset: BoxDataset):
         """Test-mode inference over a loaded dataset (SetTestMode,

@@ -185,7 +185,8 @@ class DownpourTrainer:
         B = self.feed.batch_size
         S = self.num_slots
 
-        @jax.jit
+        from paddlebox_tpu.obs.device import instrument_jit
+
         def step(slab, params, batch):
             def loss_fn(params, emb):
                 pooled = fused_seqpool_cvm(emb, batch["segments"],
@@ -207,7 +208,6 @@ class DownpourTrainer:
                                          batch["valid"])
             return flat_g, push_rows, loss, preds
 
-        @jax.jit
         def eval_step(slab, params, batch):
             pooled = fused_seqpool_cvm(
                 pull_sparse(slab, batch["ids"], layout), batch["segments"],
@@ -215,7 +215,8 @@ class DownpourTrainer:
             return jax.nn.sigmoid(
                 model.apply(params, pooled, batch.get("dense")))
 
-        return step, eval_step
+        return (instrument_jit(step, "ps_step", example_count=B),
+                instrument_jit(eval_step, "ps_eval", example_count=B))
 
     # ------------------------------------------------------------- pass loop
     def _prepare_batch(self, b, create: bool = True):

@@ -47,6 +47,8 @@ from paddlebox_tpu.obs import beat as obs_beat
 from paddlebox_tpu.obs import log as obs_log
 from paddlebox_tpu.obs import make_step_reporter
 from paddlebox_tpu.obs import span as obs_span
+from paddlebox_tpu.obs.device import (account_h2d, instrument_jit,
+                                      register_owner, tree_nbytes)
 from paddlebox_tpu.obs.tracer import set_trace, step_trace_id
 from paddlebox_tpu.ops.seqpool import fused_seqpool_cvm, seqpool_sum
 from paddlebox_tpu.ops.sparse import (build_push_grads,
@@ -98,8 +100,6 @@ def make_scan(step_fn: Callable, extra_carry: int = 0) -> Callable:
     scan after prng (the sharded trainer's device metric state rides here;
     they are donated like the slab)."""
 
-    @functools.partial(jax.jit,
-                       donate_argnums=(0, *range(5, 5 + extra_carry)))
     def scan_steps(slab, params, opt_state, stacked, prng, *extra):
         def body(carry, batch):
             slab, params, opt_state, prng, *extra = carry
@@ -112,7 +112,9 @@ def make_scan(step_fn: Callable, extra_carry: int = 0) -> Callable:
         slab, params, opt_state, prng, *extra = carry
         return (slab, params, opt_state, losses, preds, prng, *extra)
 
-    return scan_steps
+    return instrument_jit(
+        scan_steps, "scan_steps",
+        donate_argnums=(0, *range(5, 5 + extra_carry)))
 
 
 def run_scan_chunks(scan_call: Callable, items, chunk: int,
@@ -737,7 +739,8 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         slab = _sparse_push(slab, demb, batch, sub, rows)
         return slab, params, opt_state, loss, preds, prng
 
-    step = jax.jit(_step_impl, donate_argnums=(0,))
+    step = instrument_jit(_step_impl, "train_step", donate_argnums=(0,),
+                          example_count=batch_size)
     scan_steps = make_scan(_step_impl)
 
     scan_chunk_fn = None
@@ -749,7 +752,6 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
                 "per-batch table/emb state")
         C = sparse_chunk
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
         def scan_chunk_fn(slab, params, opt_state, stacked, cpush, prng):
             """Chunk-synchronous sparse megastep (TrainerConfig.
             sparse_chunk_sync): ONE pull at chunk-start state + ONE merged
@@ -843,7 +845,13 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
                            else "scatter"))
             return slab, params, opt_state, losses, preds, prng
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
+        # no example_count: the dense lax.scan body counts once (= one
+        # batch) but the chunk-wide sparse gather/pool/push operate on
+        # all C*K flat ids OUTSIDE the scan — no single divisor
+        # normalizes both, so the snapshot keeps honest totals
+        scan_chunk_fn = instrument_jit(
+            scan_chunk_fn, "scan_chunk", donate_argnums=(0,))
+
     def step_async(slab, params, batch, prng):
         """Async-dense variant: dense grads come back flat for the host
         table; only the sparse push happens on device
@@ -872,11 +880,17 @@ def make_train_step(model, layout: ValueLayout, table: TableConfig,
         slab = _sparse_push(slab, demb, batch, sub, rows)
         return slab, flat_g, loss, preds, prng
 
-    @jax.jit
+    step_async = instrument_jit(step_async, "train_step_async",
+                                donate_argnums=(0,),
+                                example_count=batch_size)
+
     def eval_step(slab, params, batch):
         emb, _ = _pull(slab, batch)
         _, preds = forward(params, emb, batch, None)
         return preds
+
+    eval_step = instrument_jit(eval_step, "eval_step",
+                               example_count=batch_size)
 
     def _dn_update(params, emb, batch):
         if not has_summary:
@@ -968,6 +982,15 @@ class BoxTrainer:
         # telemetry plane (round 10): flag-configured StepReporter +
         # tracer sync + (flag-gated) stall watchdog — one line per runner
         self.reporter = make_step_reporter(timers=self.timers)
+        # device plane (round 20): HBM-ledger owners, weakref'd so
+        # registration never extends the trainer's lifetime (the ledger
+        # must not CAUSE the leaks it detects)
+        import weakref
+        _w = weakref.ref(self)
+        register_owner("slab", lambda: getattr(
+            getattr(_w(), "table", None), "_slab", None))
+        register_owner("dense_params", lambda: getattr(_w(), "params", None))
+        register_owner("opt_state", lambda: getattr(_w(), "opt_state", None))
         self._stage_pool = None  # lazy host-staging thread pool
         self._step_count = 0
         self._shuffle_rng = np.random.RandomState(seed + 1)
@@ -1083,6 +1106,7 @@ class BoxTrainer:
     def _stack_batches(self, group: List[PackedBatch]):
         """Host-stack + one H2D per leaf (the single-chunk transfer path)."""
         staged = self._stack_batches_host(group)
+        account_h2d(tree_nbytes(staged))  # device transfer ledger
         if isinstance(staged, tuple):
             stacked, cpush = staged
             return ({k: jnp.asarray(v) for k, v in stacked.items()},
@@ -1096,6 +1120,7 @@ class BoxTrainer:
         MiniBatchGpuPack stacked-pinned-copy role, data_feed.h:519-680).
         Per-chunk views are device-side slices of the grouped arrays."""
         sizes = [d["ids"].shape[0] for d in staged_list]
+        account_h2d(tree_nbytes(staged_list))  # device transfer ledger
         big = {k: jnp.asarray(np.concatenate([d[k] for d in staged_list]))
                for k in staged_list[0]}
         out, off = [], 0
@@ -1178,8 +1203,9 @@ class BoxTrainer:
 
     def device_batch(self, b: PackedBatch,
                      ids: np.ndarray) -> Dict[str, jnp.ndarray]:
-        return {k: jnp.asarray(v)
-                for k, v in self.host_batch(b, ids).items()}
+        host = self.host_batch(b, ids)
+        account_h2d(tree_nbytes(host))  # device transfer ledger
+        return {k: jnp.asarray(v) for k, v in host.items()}
 
     def _refresh_aux(self) -> None:
         """ToHBM cadence (box_wrapper.h:83): freeze the side table's
@@ -1402,30 +1428,30 @@ class BoxTrainer:
             fns = self.fns
             layout = self.table.layout
 
-            @jax.jit
             def stage_pull(slab, ids):
                 # mirrors the fused step's _pull: keep the full rows so the
                 # push stage reuses them exactly like the fused path does
                 rows = gather_slab_rows(slab, ids, layout)
                 return pull_view_from_rows(rows, layout), rows
 
-            @jax.jit
             def stage_fwd_bwd(params, emb, batch):
                 (loss, preds), (dp, demb) = jax.value_and_grad(
                     fns.forward, argnums=(0, 1), has_aux=True)(params, emb,
                                                                batch)
                 return loss, preds, dp, demb
 
-            @jax.jit
             def stage_dense_opt(params, opt_state, dp, emb, batch):
                 updates, opt_state = self.dense_opt.update(dp, opt_state,
                                                            params)
                 params = optax.apply_updates(params, updates)
                 return fns.dn_update(params, emb, batch), opt_state
 
-            self._staged_jits = (stage_pull, stage_fwd_bwd, stage_dense_opt,
-                                 jax.jit(fns.sparse_push,
-                                         donate_argnums=(0,)))
+            self._staged_jits = (
+                instrument_jit(stage_pull, "stage_pull"),
+                instrument_jit(stage_fwd_bwd, "stage_fwd_bwd"),
+                instrument_jit(stage_dense_opt, "stage_dense_opt"),
+                instrument_jit(fns.sparse_push, "stage_push",
+                               donate_argnums=(0,)))
         return self._staged_jits
 
     def train_pass_profiled(self, dataset: BoxDataset) -> Dict[str, float]:

@@ -89,6 +89,14 @@ class StatRegistry:
         with self._lock:
             return self._stats.get(name, 0)
 
+    def peek(self, name: str) -> int:
+        """Signal-handler-safe counter read: NO lock. The fatal-signal
+        flight seal reads device counters from a handler that may have
+        interrupted add() mid-hold on this same thread — a locked read
+        would self-deadlock the dying process. dict.get of an int is
+        GIL-atomic; a stale value is acceptable in a postmortem."""
+        return self._stats.get(name, 0)  # boxlint: disable=BX401 (deliberate lock-free handler-safe read, see docstring)
+
     # --------------------------------------------------------------- gauges
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
@@ -147,6 +155,12 @@ def stat_add(name: str, value: int = 1) -> int:
 
 def stat_get(name: str) -> int:
     return StatRegistry.instance().get(name)
+
+
+def stat_peek(name: str) -> int:
+    """Lock-free :func:`stat_get` twin for signal-handler paths (see
+    StatRegistry.peek)."""
+    return StatRegistry.instance().peek(name)
 
 
 def stat_reset(name: str = None) -> None:

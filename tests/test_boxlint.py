@@ -544,6 +544,107 @@ def test_span_suppression(tmp_path):
     assert got == []
 
 
+# ----------------------------------------------------------- jitreg (BX901)
+
+JITREG_BAD_FIXTURE = """
+    import functools
+    import jax
+
+
+    def build_step(fn):
+        return jax.jit(fn, donate_argnums=(0,))      # direct call form
+
+
+    @jax.jit
+    def eval_step(x):                                # decorator form
+        return x * 2
+
+
+    promote = functools.partial(jax.jit, static_argnames=("layout",))
+"""
+
+JITREG_GOOD_FIXTURE = """
+    from paddlebox_tpu.obs.device import instrument_jit
+
+
+    def build_step(fn):
+        return instrument_jit(fn, "train_step", donate_argnums=(0,))
+"""
+
+
+def test_jitreg_bare_jit_flags_every_form(tmp_path):
+    """BX901 positive: the direct call, the decorator and the
+    functools.partial argument form all contain the same jax.jit
+    attribute node — three violations."""
+    got = lint_snippet(tmp_path, JITREG_BAD_FIXTURE, ["jitreg"])
+    assert codes(got) == ["BX901"] * 3
+
+
+def test_jitreg_instrumented_clean(tmp_path):
+    assert lint_snippet(tmp_path, JITREG_GOOD_FIXTURE, ["jitreg"]) == []
+
+
+def test_jitreg_import_spellings_flagged(tmp_path):
+    """BX901 positive: `from jax import jit` (plain and aliased) builds
+    bare jits with no Attribute node at the call site — the IMPORT line
+    is the violation; `import jax as j; j.jit` resolves the alias."""
+    got = lint_snippet(tmp_path, """
+        from jax import jit
+        from jax import numpy as jnp, jit as fast_jit
+        import jax as j
+
+
+        step = jit(lambda x: x)
+        estep = fast_jit(lambda x: x)
+        pstep = j.jit(lambda x: x)
+    """, ["jitreg"])
+    assert codes(got) == ["BX901"] * 3
+    assert [v.line for v in got] == [2, 3, 9]
+
+
+def test_jitreg_import_spellings_negative(tmp_path):
+    """`from jax import numpy` / `from jax.experimental import ...` /
+    a local function named jit stay clean."""
+    assert lint_snippet(tmp_path, """
+        from jax import numpy as jnp
+        from jax.experimental import shard_map
+
+
+        def jit(fn):
+            return fn
+
+
+        step = jit(lambda x: x)
+    """, ["jitreg"]) == []
+
+
+def test_jitreg_exempt_paths(tmp_path):
+    """tools/tests/examples components (probes build bare jits as
+    oracles) and the implementing module itself are out of scope."""
+    import textwrap
+    for sub in ("tools", "tests", "obs"):
+        (tmp_path / sub).mkdir()
+    code = textwrap.dedent("""
+        import jax
+        j = jax.jit(lambda x: x)
+    """)
+    (tmp_path / "tools" / "probe.py").write_text(code)
+    (tmp_path / "obs" / "device.py").write_text(code)
+    files, errors = load_tree([str(tmp_path / "tools" / "probe.py"),
+                               str(tmp_path / "obs" / "device.py")],
+                              root=str(tmp_path))
+    assert not errors
+    assert run_passes(files, ["jitreg"]) == []
+
+
+def test_jitreg_suppression_with_rationale(tmp_path):
+    got = lint_snippet(tmp_path, """
+        import jax
+        j = jax.jit(lambda x: x)  # boxlint: disable=BX901 (oracle twin)
+    """, ["jitreg"])
+    assert got == []
+
+
 # ------------------------------------------------------------ the gate
 
 def test_boxlint_gate_no_new_violations():

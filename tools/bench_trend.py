@@ -43,6 +43,12 @@ HEADLINES: List[Tuple[str, str, bool]] = [
     # round-17 ingest plane: the cold-pass parse→shuffle→pack→train
     # headline (absent pre-round-17 rounds compare as n/a)
     ("ingest_cold_pass_examples_per_sec", "ex/s", True),
+    # round-20 device plane: the compiled step's bytes-accessed per
+    # example (Tensor Casting's co-design metric, from the one-time
+    # cost-analysis snapshot). LOWER is better — a rise past the
+    # threshold is a byte-budget regression and flags exactly like a
+    # rate regression (absent pre-round-20 rounds compare as n/a)
+    ("device_bytes_accessed_per_example", "B/ex", False),
 ]
 
 

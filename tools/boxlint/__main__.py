@@ -48,7 +48,9 @@ def build_parser() -> argparse.ArgumentParser:
             "swallows (BX503), and the interprocedural concurrency "
             "passes on the package-wide call graph: blocking-under-lock "
             "(BX601), lock-order deadlock cycles (BX701), handler "
-            "reentrancy (BX801/BX802). Suppress a single "
+            "reentrancy (BX801/BX802), and jit entry-point registration "
+            "(BX901: bare jax.jit must go through "
+            "obs.device.instrument_jit). Suppress a single "
             "site with '# boxlint: "
             "disable=BX101' on the line (or the def line for a whole "
             "method); long-lived exceptions belong in the baseline."),

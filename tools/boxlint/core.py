@@ -194,9 +194,9 @@ def format_baseline(violations: Sequence[Violation]) -> str:
 
 def run_passes(files: Sequence[SourceFile],
                passes: Optional[Iterable[str]] = None) -> List[Violation]:
-    from tools.boxlint import (blocking, collectives, flagscheck, lockorder,
-                               locks, prints, purity, reentrancy, spans,
-                               swallow)
+    from tools.boxlint import (blocking, collectives, flagscheck, jitreg,
+                               lockorder, locks, prints, purity, reentrancy,
+                               spans, swallow)
     registry = {
         "purity": purity.check,
         "collectives": collectives.check,
@@ -208,6 +208,7 @@ def run_passes(files: Sequence[SourceFile],
         "blocking": blocking.check,
         "lockorder": lockorder.check,
         "reentrancy": reentrancy.check,
+        "jitreg": jitreg.check,
     }
     names = list(passes) if passes else list(registry)
     out: List[Violation] = []
@@ -218,7 +219,8 @@ def run_passes(files: Sequence[SourceFile],
 
 
 ALL_PASSES = ("purity", "collectives", "flags", "locks", "prints",
-              "spans", "swallow", "blocking", "lockorder", "reentrancy")
+              "spans", "swallow", "blocking", "lockorder", "reentrancy",
+              "jitreg")
 
 
 def _is_suppressed(files: Sequence[SourceFile], v: Violation) -> bool:

@@ -1,0 +1,78 @@
+"""Pass 11 — jit entry-point registration (BX9xx).
+
+The device plane (paddlebox_tpu/obs/device.py, round 20) only sees jit
+entry points that were constructed through ``instrument_jit`` — a bare
+``jax.jit(...)`` silently escapes the recompile sentinel, the donation
+audit and the cost/memory snapshot, which is exactly how a new runner
+re-opens the observability hole PRs 5/9/13 closed on the host side.
+This pass makes the wrapper structurally unavoidable: any appearance of
+the ``jax.jit`` attribute in library code is a violation — the direct
+call form, the ``@jax.jit`` decorator form, and the
+``functools.partial(jax.jit, ...)`` argument form all contain the same
+AST node, so one Attribute detector covers those spellings; the
+detector also resolves ``import jax as <alias>`` receivers, and a
+``from jax import jit`` (aliased or not) is flagged at the import line
+itself — jits built from it carry no Attribute node at the call site.
+
+Scope: the same library scope as BX501 (paths with a ``tools``,
+``tests`` or ``examples`` component are exempt — probes and fixtures
+legitimately build bare jits to compare against), plus the implementing
+module itself (``obs/device.py`` IS the instrumentation layer; its two
+``jax.jit`` sites carry per-line disables anyway, belt and braces).
+Deliberate exceptions carry a per-line rationale:
+``# boxlint: disable=BX901 (<why this jit must stay bare>)``.
+
+Codes:
+  BX901  bare jax.jit in library code (use obs.device.instrument_jit)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence
+
+from tools.boxlint.core import SourceFile, Violation
+
+_EXEMPT_PARTS = {"tools", "tests", "examples"}
+
+
+def _exempt(rel: str) -> bool:
+    if rel.replace("\\", "/").endswith("obs/device.py"):
+        return True  # the instrumentation layer itself
+    return bool(_EXEMPT_PARTS.intersection(rel.split("/")[:-1]))
+
+
+_MSG = ("bare jax.jit in library code — construct the entry "
+        "point with obs.device.instrument_jit(fn, name, ...) "
+        "so it joins the device plane (recompile sentinel, "
+        "donation audit, cost/memory snapshot); a deliberate "
+        "bare jit needs a per-line rationale disable")
+
+
+def check(files: Sequence[SourceFile]) -> List[Violation]:
+    out: List[Violation] = []
+    for f in files:
+        if _exempt(f.rel):
+            continue
+        # every local name that resolves to the jax module: the
+        # Attribute detector must see aliased spellings too
+        # (`import jax as j; j.jit`) or they'd escape the device plane
+        jax_names = {"jax"}
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax" and a.asname:
+                        jax_names.add(a.asname)
+        for node in ast.walk(f.tree):
+            if (isinstance(node, ast.Attribute)
+                    and node.attr == "jit"
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in jax_names):
+                out.append(Violation(f.rel, node.lineno, "BX901", _MSG))
+            elif (isinstance(node, ast.ImportFrom)
+                    and node.module == "jax" and node.level == 0
+                    and any(a.name == "jit" for a in node.names)):
+                # `from jax import jit` builds bare jits with no
+                # Attribute node at the call sites — flag the import
+                out.append(Violation(f.rel, node.lineno, "BX901", _MSG))
+    return out

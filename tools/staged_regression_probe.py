@@ -134,6 +134,15 @@ CEILINGS = {
     # the 870s tier-1 budget (even at 60s it is <7% of it).
     "boxlint_full_tree_secs": (6.0, 60.0),
     "boxlint_changed_secs": (6.0, 60.0),
+    # round-20: staged H2D bytes per step at the e2e-lean bench shape
+    # (batch 256 x 16 slots x max_len 4, uid wire) — DETERMINISTIC
+    # (bytes, not time; the obs/device.py transfer ledger counts them),
+    # so the ceiling is tight: ~1.5x recorded catches any fat field
+    # sneaking into the staged batch (a resurrected full-wire perm/inv
+    # pair alone would roughly double it). Recorded quiet 2026-08-04
+    # (394,496 B/step: ids+segments+labels+valid+uids at the uid-lean
+    # wire); ceiling = ~1.5x
+    "device_h2d_bytes_per_step": (394.5e3, 600e3),
 }
 
 RETRIES = 2          # extra isolated re-measures before a floor may fail
@@ -150,6 +159,11 @@ SETTLE_SECS = 2.0    # pause before a retry (let a co-tenant burst pass)
 # (e.g. a tier-1 run in another shell) can outlast any retry budget.
 CALIB_RECORDED = 100e6
 CALIB_SUPPRESSED = 0.6
+
+#: stages whose measure is DETERMINISTIC (bytes, not time): container
+#: load can never be the cause of a miss, so the calibration escape
+#: must not excuse one — a blown byte budget fails even on a loaded box
+DETERMINISTIC_STAGES = {"device_h2d_bytes_per_step"}
 
 failures = []
 
@@ -191,17 +205,22 @@ def report(stage, rate, remeasure=None):
             ("ceiling" if ceiling else "floor"): bound, "ok": ok,
             "load1": _load1(), "retries": retries}
     if not ok:
-        calib = _calib_rate()
-        line["calib_vs_quiet"] = round(calib / CALIB_RECORDED, 3)
-        if calib < CALIB_SUPPRESSED * CALIB_RECORDED:
-            # the box itself is slow right now: inconclusive, not failed
-            line["ok"] = ok = True
-            line["note"] = (
-                "floor missed but calibration at %.0f%% of quiet rate — "
-                "load-suppressed, INCONCLUSIVE; rerun alone"
-                % (100.0 * calib / CALIB_RECORDED))
-        else:
+        if stage in DETERMINISTIC_STAGES:
+            # bytes are load-independent — no calibration escape
             failures.append(stage)
+        else:
+            calib = _calib_rate()
+            line["calib_vs_quiet"] = round(calib / CALIB_RECORDED, 3)
+            if calib < CALIB_SUPPRESSED * CALIB_RECORDED:
+                # the box itself is slow right now: inconclusive, not failed
+                line["ok"] = ok = True
+                line["note"] = (
+                    "%s missed but calibration at %.0f%% of quiet rate — "
+                    "load-suppressed, INCONCLUSIVE; rerun alone"
+                    % ("ceiling" if ceiling else "floor",
+                       100.0 * calib / CALIB_RECORDED))
+            else:
+                failures.append(stage)
     elif retries:
         line["note"] = ("below floor on first measure, passed on "
                         "isolated rerun — transient container load")
@@ -701,6 +720,75 @@ def section_boxlint(rng, K):
            remeasure=lambda: run_lint(["--changed"]))
 
 
+def section_device(rng, K):
+    # --- device plane gates (round 20) -------------------------------
+    # The obs/device.py tier watching the XLA layer, gated at the bench
+    # config's steady state: ZERO steady-state recompiles (the sentinel
+    # that catches mis-staged shape churn), ZERO donation misses (the
+    # regime-step slab-copy mechanism — ROADMAP item 1's hypothesis,
+    # now a standing alarm), the compiled scan's temp allocation must
+    # NOT contain a slab-sized copy (step_audit's historical check,
+    # live), and the staged H2D bytes/step ride a ceiling so a wire
+    # regression (a fat field sneaking into the staged batch) flags
+    # like a rate regression.
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddlebox_tpu.config.configs import TrainerConfig
+    from paddlebox_tpu.obs import device as _device
+    from paddlebox_tpu.utils.stats import StatRegistry
+    from tools.bench_util import make_bench_trainer, make_ctr_batches
+
+    reg = StatRegistry.instance()
+    for k in ("device_recompiles", "donation_miss",
+              "device_transfer_bytes_h2d"):
+        reg.reset(k)
+    _device.monitor().reset()
+    tr, feed = make_bench_trainer(
+        1 << 18, batch=256, num_slots=16, max_len=4, d=8,
+        trainer_cfg=TrainerConfig(dense_lr=1e-3))
+    chunk = 4
+    batches = make_ctr_batches(feed, chunk, 16, 4, seed=0)
+    tr.table.begin_feed_pass()
+    for b in batches:
+        tr.table.add_keys(b.keys[b.valid])
+    tr.table.end_feed_pass()
+    tr.table.begin_pass()
+    state = [tr.table.slab, tr.params, tr.opt_state, tr.table.next_prng()]
+    reg.reset("device_transfer_bytes_h2d")  # staging only, not slab build
+    steps = 0
+    for _ in range(3):                      # 12 steps: steady state
+        stacked = tr._stack_batches(batches)
+        slab, params, opt, losses, _p, key = tr.fns.scan_steps(
+            state[0], state[1], state[2], stacked, state[3])
+        state[:] = slab, params, opt, key
+        steps += chunk
+    assert np.isfinite(np.asarray(losses)).all()
+
+    for stage, val in (
+            ("device_recompiles_steady", reg.get("device_recompiles")),
+            ("device_donation_miss_steady", reg.get("donation_miss"))):
+        ok = int(val) == 0
+        print(json.dumps({"stage": stage, "value": int(val), "bound": 0,
+                          "ok": ok, "load1": _load1()}), flush=True)
+        if not ok:
+            failures.append(stage)
+
+    entry = _device.snapshot()["entries"].get("scan_steps") or {}
+    ana = entry.get("analysis") or {}
+    flag = ana.get("temp_includes_slab_copy")
+    ok = flag is False                      # None = analysis unavailable
+    print(json.dumps({"stage": "temp_includes_slab_copy", "value": flag,
+                      "ok": ok, "temp_bytes": ana.get("temp_bytes"),
+                      "alias_bytes": ana.get("alias_bytes"),
+                      "load1": _load1()}), flush=True)
+    if not ok:
+        failures.append("temp_includes_slab_copy")
+
+    report("device_h2d_bytes_per_step",
+           reg.get("device_transfer_bytes_h2d") / max(steps, 1))
+    tr.close()
+
+
 SECTIONS = (
     ("native", section_native),
     ("bucketize", section_bucketize),
@@ -714,6 +802,7 @@ SECTIONS = (
     ("ckpt", section_ckpt),
     ("quality", section_quality),
     ("boxlint", section_boxlint),
+    ("device", section_device),
 )
 
 

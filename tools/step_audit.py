@@ -9,12 +9,26 @@ step actually moves — flops, bytes accessed, temp allocation — so the
     second slab-sized temp — donation regressions show up here first);
   * bytes accessed per example vs the analytic ~26 KB/example budget.
 
+Since round 20 the per-example math lives in
+paddlebox_tpu/obs/device.py (analyze_compiled) — ONE copy shared with
+the always-on device plane, so this offline probe and the production
+StepReport/device-endpoint fields can never diverge. The instrumented
+scan entry point exposes .lower() unchanged, so the audit runs through
+the exact wrapper production dispatches through.
+
 Run on any platform (the HLO structure is platform-independent; byte
 counts are the compiler's, so capture per platform):
 
-    JAX_PLATFORMS=cpu python tools/step_audit.py
+    JAX_PLATFORMS=cpu python tools/step_audit.py [--json]
+
+--json emits the audit on stdout as one JSON object whose field names
+match the device plane's analysis snapshot (flops_per_example,
+bytes_accessed_per_example, temp_bytes, arg_bytes, output_bytes,
+alias_bytes, temp_includes_slab_copy) — the default output is the same
+object, kept for the historical CLI contract.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -32,6 +46,7 @@ def audit(pass_cap: int = 1 << 20, batch: int = 1024, num_slots: int = 32,
           max_len: int = 4, d: int = 8, chunk: int = 8) -> dict:
     import jax
 
+    from paddlebox_tpu.obs.device import analyze_compiled
     from tools.bench_util import make_bench_trainer, make_ctr_batches
 
     trainer, feed = make_bench_trainer(pass_cap, batch=batch,
@@ -52,31 +67,25 @@ def audit(pass_cap: int = 1 << 20, batch: int = 1024, num_slots: int = 32,
     out = {"platform": jax.devices()[0].platform,
            "chunk": chunk, "batch": batch,
            "slab_bytes": int(np.prod(trainer.table.slab.shape)) * 4}
-    try:
-        ca = compiled.cost_analysis()
-        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-        if ca:
-            # cost analysis counts the scan BODY once = one batch of
-            # examples, so per-example = / batch (NOT / (chunk*batch))
-            out["flops_per_example"] = round(ca.get("flops", 0.0) / batch)
-            out["bytes_accessed_per_example"] = round(
-                ca.get("bytes accessed", 0.0) / batch)
-    except Exception as e:  # cost analysis is best-effort per backend
-        out["cost_analysis_error"] = repr(e)
-    try:
-        ma = compiled.memory_analysis()
-        out["temp_bytes"] = int(getattr(ma, "temp_size_in_bytes", -1))
-        out["arg_bytes"] = int(getattr(ma, "argument_size_in_bytes", -1))
-        out["output_bytes"] = int(getattr(ma, "output_size_in_bytes", -1))
-        out["alias_bytes"] = int(getattr(ma, "alias_size_in_bytes", -1))
-        if out["temp_bytes"] >= 0:
-            # the donated slab must not re-appear as a temp copy
-            out["temp_includes_slab_copy"] = bool(
-                out["temp_bytes"] >= out["slab_bytes"])
-    except Exception as e:
-        out["memory_analysis_error"] = repr(e)
+    # cost analysis counts the scan BODY once = one batch of examples,
+    # so per-example = / batch (NOT / (chunk*batch)) — normalization
+    # contract lives in analyze_compiled's docstring
+    out.update(analyze_compiled(compiled, examples=batch,
+                                slab_bytes=out["slab_bytes"]))
+    # the shared helper also returns raw totals; this CLI's historical
+    # surface is the per-example + memory fields, keep the totals too
     return out
 
 
 if __name__ == "__main__":
-    print(json.dumps(audit()))
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--json", action="store_true",
+                    help="emit the audit as one JSON object on stdout "
+                         "(field names match the device plane's "
+                         "analysis snapshot)")
+    ap.add_argument("--pass-cap", type=int, default=1 << 20)
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--chunk", type=int, default=8)
+    ns = ap.parse_args()
+    result = audit(pass_cap=ns.pass_cap, batch=ns.batch, chunk=ns.chunk)
+    print(json.dumps(result))
