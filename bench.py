@@ -1010,6 +1010,122 @@ def measure(platform: str) -> None:
     except Exception as e:  # noqa: BLE001 — diagnostic tier, not the metric
         ckpt = {"error": repr(e)[:300]}
 
+    def ssd_tier_ladder(R: int = 1 << 18) -> dict:
+        """Round-16 SSD-tier ladder at R rows (adagrad embedx=8, width
+        17): the three read tiers of the host store, each attributable
+        (keys/s), plus the feed-pass prefetch overlap claim:
+
+          * ram_hit — lookup over a fully-resident set (the native
+            fused probe+gather when the lib is present): the ceiling.
+          * ssd_promote — fault_in_keys of a fully-spilled set, the
+            batched by-file BeginFeedPass/LoadSSD2Mem leg (re-spill
+            runs off the clock each cycle).
+          * cold_fault — the lookup-path PEEK over sleeping rows (mmap
+            block read, no residency change): what touching a tier row
+            without promoting it costs.
+          * prefetch overlap — serial (training tail, THEN boundary
+            promote) vs overlapped (PromotePrefetcher pulls the same
+            sleeping set under the tail). On a 1-core container only
+            I/O waits can hide, so read hidden_frac as a floor."""
+        import shutil
+        import tempfile
+        import threading
+
+        from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
+                                                  TableConfig)
+        from paddlebox_tpu.embedding.pass_table import PassTable
+        from paddlebox_tpu.train.preload import PromotePrefetcher
+
+        root = tempfile.mkdtemp(prefix="pbtpu_ssd_bench_")
+        try:
+            tcfg = TableConfig(embedx_dim=8, pass_capacity=1 << 10,
+                               ssd_dir=root,
+                               optimizer=SparseOptimizerConfig())
+            t = PassTable(tcfg, seed=1)
+            st = t.store
+            rng = np.random.RandomState(7)
+            keys = rng.permutation(np.arange(1, R + 1, dtype=np.uint64))
+            vals = rng.rand(R, t.layout.width).astype(np.float32)
+            st.assign(keys, vals)
+
+            def timed(fn, runs=3):
+                walls = []
+                for _ in range(runs):
+                    t0 = time.perf_counter()
+                    fn()
+                    walls.append(time.perf_counter() - t0)
+                return float(np.median(walls))
+
+            out = {"rows": R, "width": t.layout.width}
+            out["ram_hit_keys_per_sec"] = round(
+                R / timed(lambda: st.lookup(keys)), 0)
+
+            st.spill_exact(keys)
+            out["cold_fault_keys_per_sec"] = round(
+                R / timed(lambda: st.lookup(keys)), 0)
+
+            def promote_cycle():
+                walls = []
+                for _ in range(3):
+                    st.spill_exact(keys)
+                    t0 = time.perf_counter()
+                    st.fault_in_keys(keys)
+                    walls.append(time.perf_counter() - t0)
+                return float(np.median(walls))
+
+            w_promote = promote_cycle()
+            out["ssd_promote_keys_per_sec"] = round(R / w_promote, 0)
+
+            # prefetch overlap: a synthetic training tail sized to the
+            # serial promote wall, then the boundary promote — serial
+            # pays tail + promote; overlapped runs the real
+            # PromotePrefetcher (lookup_present under store_lock) while
+            # the tail spins, and the boundary pays only the residual
+            tail_s = w_promote
+            burn = rng.rand(256, 256).astype(np.float32)
+
+            def tail():
+                t0 = time.perf_counter()
+                while time.perf_counter() - t0 < tail_s:
+                    np.dot(burn, burn)
+
+            st.spill_exact(keys)
+            t0 = time.perf_counter()
+            tail()
+            st.fault_in_keys(keys)
+            serial_wall = time.perf_counter() - t0
+
+            st.spill_exact(keys)
+            known = lambda k: np.zeros(k.size, bool)  # noqa: E731
+            t0 = time.perf_counter()
+            pf = PromotePrefetcher(known, st,
+                                   getattr(t, "store_lock",
+                                           threading.RLock()))
+            pf.feed(keys)
+            tail()
+            pf.finish()
+            st.fault_in_keys(keys)        # residual (≈0 when hidden)
+            overlapped_wall = time.perf_counter() - t0
+            out["prefetch_overlap"] = {
+                "tail_s": round(tail_s, 4),
+                "serial_wall_s": round(serial_wall, 4),
+                "overlapped_wall_s": round(overlapped_wall, 4),
+                "hidden_frac": round(
+                    max(0.0, 1.0 - (overlapped_wall - tail_s)
+                        / max(serial_wall - tail_s, 1e-9)), 3)}
+            out["ram_vs_promote"] = round(
+                out["ram_hit_keys_per_sec"]
+                / max(out["ssd_promote_keys_per_sec"], 1e-9), 1)
+            return out
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    # round-16: SSD-tier ladder. GUARDED like every diagnostic.
+    try:
+        ssd = ssd_tier_ladder()
+    except Exception as e:  # noqa: BLE001 — diagnostic tier, not the metric
+        ssd = {"error": repr(e)[:300]}
+
     def ingest_ladder() -> dict:
         """Round-17 ingest block — the first measured number on the one
         plane bench.py always skipped (it trains on pre-made synthetic
@@ -1216,6 +1332,11 @@ def measure(platform: str) -> None:
         "ingest": ingest,
         "ingest_cold_pass_examples_per_sec": ingest.get(
             "cold_pass_examples_per_sec", 0),
+        "ssd_tier": ssd,
+        "ssd_promote_keys_per_sec": ssd.get(
+            "ssd_promote_keys_per_sec", 0),
+        "ssd_fault_keys_per_sec": ssd.get(
+            "cold_fault_keys_per_sec", 0),
         "telemetry_overhead": telemetry,
         "flight_overhead": flight,
         "quality_overhead": quality,

@@ -450,10 +450,11 @@ define_flag("ckpt_journal", True,
             "files under <batch_model_dir>/_journal/rank<r>. Enables "
             "save_base(mode='touched'/'auto') — day-boundary snapshot "
             "cost proportional to the delta — and the elastic mid-day "
-            "rejoin artifact (replay-over-base, ROADMAP item 5). Spill "
-            "activity taints the epoch (touched saves fall back to "
-            "full, loudly): SSD-tier rows sit outside the journaled "
-            "cadence")
+            "rejoin artifact (replay-over-base, ROADMAP item 5). SSD "
+            "tier movement is journaled as MOVE records (spill / "
+            "fault-in key sets) so touched saves stay exact with the "
+            "tier engaged; only server-side PS spills, rotation loss "
+            "and external store loads still taint the epoch")
 define_flag("ckpt_journal_segment_bytes", 64 << 20,
             "touched-row journal segment rotation size in bytes; each "
             "segment re-writes a self-describing header (flight-"
@@ -578,3 +579,14 @@ define_flag("device_leak_min_bytes", 1 << 20,
             "the monotonic window before it counts — compile-time "
             "constant buffers and small per-pass arrays must not page "
             "an operator")
+define_flag("host_store_stripes", 0,
+            "shard the host embedding store's hash index into N "
+            "stripes (embedding/striped_store.py): keys route by "
+            "splitmix64(key) mod N, each stripe owns an independent "
+            "inner store (+ rng seeded seed+stripe) so lookups gather "
+            "per-stripe in parallel threads and the single global "
+            "index stops being the billion-key bottleneck. 0 (default) "
+            "= the flat single-index store — bit-compatible with every "
+            "existing checkpoint/journal; striped stores draw a "
+            "DIFFERENT init stream (per-stripe rngs), so flip it only "
+            "on fresh runs or restored-from-checkpoint runs")

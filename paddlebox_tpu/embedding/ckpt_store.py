@@ -89,7 +89,12 @@ def write_part(path: str, keys: np.ndarray, values: np.ndarray,
                fsync: bool = True) -> str:
     """ONE part file, atomically: tmp + fsync + rename. keys [n] uint64,
     values [n, width] float32 (any row order — checkpoint parts carry
-    store iteration order, unlike the sorted serving columns). Stray
+    store iteration order, unlike the sorted serving columns). This is
+    the repo's ONE on-disk row format: the SSD spill tier writes its
+    blocks through here too (embedding/ssd_tier.py, fsync=False — a
+    spill block is a cache of DRAM state, replay rebuilds it) and
+    faults rows back through map_part, so a format change must keep
+    both readers in step. Stray
     ``<path>.*.tmp`` leftovers from a writer that died mid-save are
     swept first — their pid/tid names would never be overwritten by a
     retry (unlike the deterministic final part names). Concurrent

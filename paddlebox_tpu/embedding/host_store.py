@@ -7,16 +7,18 @@ features) into a dense slab for the device, and written back at end of pass.
 Python+numpy implementation first; the C++ native store (native/host_store.cc)
 slots in behind the same interface (see use_native flag).
 
-Also implements the SSD spill tier contract (SSDSparseTable analog): least
-recently seen rows beyond a DRAM budget are spilled to per-shard files and
-faulted back on lookup (LoadSSD2Mem analog: load_spilled()).
+The SSD tier behind it (SSDSparseTable analog) is embedding/ssd_tier.py:
+rows beyond a DRAM budget spill to columnar part-file blocks and fault
+back batched by block (LoadSSD2Mem analog: load_spilled()). Every move
+across the resident/tier boundary reports to the journal sink installed
+by attach_journal, so touched-row saves and journal replay stay bit-exact
+with spill active (round 16 — no more EV_TAINT on the spill cadence).
 """
 
 from __future__ import annotations
 
 import os
-import threading
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 import numpy as np
 
@@ -24,84 +26,10 @@ from paddlebox_tpu.config.configs import TableConfig
 from paddlebox_tpu.embedding.accessor import (ValueLayout, CLICK,
                                               DELTA_SCORE, SHOW,
                                               UNSEEN_DAYS)
+from paddlebox_tpu.embedding.ssd_tier import (  # noqa: F401 (re-exports)
+    MV_FAULT_IN, MV_SPILL, SpillTier, apply_missed_days)
 from paddlebox_tpu.utils.stats import stat_add
 from paddlebox_tpu.utils.lockwatch import make_rlock
-
-
-def apply_missed_days(vals: np.ndarray, missed, decay_rate: float) -> None:
-    """IN PLACE: add the day boundaries rows slept through on disk and the
-    show/click time decay those boundaries would have applied (the ONE
-    aging/decay rule — one-shrink-per-tick assumption documented on
-    SpillAgeBook). vals: [N, width] (or a single row); missed: scalar or
-    [N]."""
-    vals = np.atleast_2d(vals)
-    missed = np.asarray(missed, np.float32)
-    vals[:, UNSEEN_DAYS] += missed
-    decay = np.asarray(decay_rate, np.float32) ** missed
-    vals[:, SHOW] *= decay
-    vals[:, CLICK] *= decay
-
-
-def dec_file_live(file_live: Dict[str, int], fname: str, n: int) -> None:
-    """Spill-file GC shared by both stores: drop n live rows from a block
-    file's count; unlink the file when none remain."""
-    live = file_live.get(fname, 0) - n
-    if live <= 0:
-        file_live.pop(fname, None)
-        try:
-            os.remove(fname)
-        except OSError:
-            pass
-    else:
-        file_live[fname] = live
-
-
-class SpillAgeBook:
-    """Aging bookkeeping for the SSD tier: resident rows age in place at
-    each day boundary, but spilled rows are immutable on disk — so every
-    spill records (epoch, unseen_at_spill) and the missed days are added
-    back lazily at fault-in, together with the show/click time decay the
-    row slept through (decay_rate**missed — assumes the reference's one
-    shrink per day-boundary cadence). Shrink can also delete spilled rows
-    by the unseen-days rule WITHOUT faulting them in (the coldest rows —
-    exactly the deletion candidates — must not be immortal;
-    score-threshold deletes still apply after fault-in, documented
-    approximation)."""
-
-    def __init__(self) -> None:
-        self.epoch = 0
-        self.meta: Dict[int, Tuple[int, float]] = {}
-
-    def tick(self) -> None:
-        self.epoch += 1
-
-    def note(self, key: int, unseen_at_spill: float) -> None:
-        self.meta[key] = (self.epoch, float(unseen_at_spill))
-
-    def drop(self, key: int) -> None:
-        self.meta.pop(key, None)
-
-    def missed_days(self, key: int, pop: bool) -> float:
-        e_u = self.meta.pop(key, None) if pop else self.meta.get(key)
-        return float(self.epoch - e_u[0]) if e_u else 0.0
-
-    def dead_keys(self, delete_after_days: float) -> List[int]:
-        return [k for k, (e, u) in self.meta.items()
-                if u + (self.epoch - e) > delete_after_days]
-
-    def sweep(self, spilled: Dict, dec_file_live, delete_after_days: float
-              ) -> int:
-        """Delete spilled rows past the unseen-days lifetime WITHOUT
-        faulting them in: pop the spill index entry, GC the block file's
-        live count. Returns rows deleted. (The ONE sweep both stores
-        share — keep fixes here.)"""
-        n = 0
-        for k in self.dead_keys(delete_after_days):
-            fname, _off = spilled.pop(k)
-            self.drop(k)
-            dec_file_live(fname, 1)
-            n += 1
-        return n
 
 _GROW = 1 << 16
 
@@ -122,14 +50,14 @@ class HostEmbeddingStore:
         self._values = np.zeros((_GROW, layout.width), dtype=np.float32)
         self._free: List[int] = list(range(_GROW - 1, -1, -1))
         self._lock = make_rlock("HostEmbeddingStore._lock")
-        # SSD spill tier; file tag is per-store so shards sharing one
-        # ssd_dir can't clobber each other's blocks
+        # SSD spill tier; block tag is per-store so shards sharing one
+        # ssd_dir can't clobber each other's blocks, and carries the pid
+        # so a restart's construction sweep reclaims dead blocks
         self._spill_dir = table.ssd_dir
-        self._spilled: Dict[int, Tuple[str, int]] = {}  # guarded-by: _lock (key -> (file, offset row))
-        self._spill_seq = 0  # monotonic file id (len(_spilled) can shrink)
-        self._spill_tag = f"{os.getpid():x}_{id(self):x}"
-        self._age_book = SpillAgeBook()
-        self._file_live: Dict[str, int] = {}  # file → live rows (GC at 0)
+        self._tier = SpillTier(layout.width, table.ssd_dir,
+                               f"{os.getpid():x}_{id(self):x}",
+                               table.show_click_decay_rate)
+        self._journal_sink = None  # guarded-by: _lock
 
     def __len__(self) -> int:  # boxlint: disable=BX401 — GIL-atomic len probe, boundary read
         return len(self._index)
@@ -146,10 +74,24 @@ class HostEmbeddingStore:
                  np.zeros((new - old, self.layout.width), np.float32)])
             self._free.extend(range(new - 1, old - 1, -1))
 
+    def _install_rows(self, keys: np.ndarray,  # boxlint: disable=BX401 — caller holds _lock (the *_locked contract)
+                      vals: np.ndarray) -> np.ndarray:
+        """Place faulted-in rows: exact free-list pop order, batched
+        (pop() yields the tail back-to-front)."""
+        n = int(keys.size)
+        self._grow(n)
+        rows = np.asarray(self._free[-n:][::-1], np.int64)
+        del self._free[-n:]
+        self._values[rows] = vals
+        self._index.update(zip(keys.tolist(), rows.tolist()))
+        return rows
+
     # ------------------------------------------------------------------ api
     def lookup_or_create(self, keys: np.ndarray) -> np.ndarray:
         """Vectorized fetch of rows for unique uint64 keys, creating missing
-        features with accessor init (feed-pass promote, BuildPull analog)."""
+        features with accessor init (feed-pass promote, BuildPull analog).
+        Tier-sleeping keys fault back in ONE batched tier read (grouped by
+        block inside the tier), not a per-key file open."""
         keys = np.asarray(keys, dtype=np.uint64)
         with self._lock:
             rows = np.empty(keys.size, dtype=np.int64)
@@ -160,17 +102,18 @@ class HostEmbeddingStore:
                 rows[i] = r
                 if r < 0:
                     missing.append(i)
-            if missing:
-                # fault back any spilled keys first
-                if self._spilled:
-                    still_missing = []
-                    for i in missing:
-                        k = int(keys[i])
-                        if k in self._spilled:
-                            rows[i] = self._fault_in(k)
-                        else:
-                            still_missing.append(i)
-                    missing = still_missing
+            if missing and len(self._tier):
+                miss = np.asarray(missing, np.int64)
+                spilled = self._tier.contains(keys[miss])
+                if spilled.any():
+                    fi = miss[spilled]
+                    fkeys = keys[fi]
+                    rows[fi] = self._install_rows(
+                        fkeys, self._tier.read(fkeys, pop=True))
+                    stat_add("sparse_keys_faulted_in", int(fi.size))
+                    if self._journal_sink is not None:
+                        self._journal_sink(MV_FAULT_IN, fkeys)
+                    missing = miss[~spilled].tolist()
             if missing:
                 self._grow(len(missing))
                 init = self.layout.new_rows(len(missing), self._rng,
@@ -194,7 +137,10 @@ class HostEmbeddingStore:
     def assign(self, keys: np.ndarray, values: np.ndarray) -> None:
         """Create-or-overwrite rows verbatim — the EndPass dump target for
         unique keys: no value copy-out and no init rng draws for rows that
-        are about to be overwritten anyway."""
+        are about to be overwritten anyway. A stale tier entry for an
+        assigned key is discarded unread (it must not resurrect over the
+        assigned value); replay's assign performs the same discard
+        deterministically, so no journal record is needed."""
         keys = np.asarray(keys, dtype=np.uint64)
         with self._lock:
             idx = self._index
@@ -202,14 +148,8 @@ class HostEmbeddingStore:
                                dtype=np.int64, count=keys.size)
             missing = np.nonzero(rows < 0)[0]
             if missing.size:
-                if self._spilled:
-                    for i in missing.tolist():
-                        # a stale spill entry must not resurrect over the
-                        # assigned value (its block row is dead: GC it)
-                        stale = self._spilled.pop(int(keys[i]), None)
-                        if stale is not None:
-                            self._age_book.drop(int(keys[i]))
-                            self._dec_file_live(stale[0], 1)
+                if len(self._tier):
+                    self._tier.discard(keys[missing])
                 self._grow(missing.size)
                 # exact free-list pop order, batched: pop() yields the
                 # tail back-to-front
@@ -222,38 +162,63 @@ class HostEmbeddingStore:
 
     def lookup(self, keys: np.ndarray) -> np.ndarray:
         """Inference-mode fetch: missing keys read as zero rows (SetTestMode
-        pulls don't create features)."""
+        pulls don't create features). PEEKS the SSD tier — a test-mode
+        read mutates nothing, so serving traffic can't churn the
+        resident set (and needs no journal record)."""
         keys = np.asarray(keys, dtype=np.uint64)
         out = np.zeros((keys.size, self.layout.width), dtype=np.float32)
         with self._lock:
+            miss: List[int] = []
             for i, k in enumerate(keys.tolist()):
                 r = self._index.get(k, -1)
                 if r >= 0:
                     out[i] = self._values[r]
-                elif k in self._spilled:
-                    out[i] = self._values[self._fault_in(k)]
+                else:
+                    miss.append(i)
+            if miss and len(self._tier):
+                mi = np.asarray(miss, np.int64)
+                spilled = self._tier.contains(keys[mi])
+                if spilled.any():
+                    sp = mi[spilled]
+                    out[sp] = self._tier.read(keys[sp], pop=False)
         return out
 
     def lookup_present(self, keys: np.ndarray
                        ) -> Tuple[np.ndarray, np.ndarray]:
         """(values, found) without creating missing features — the preload
         promote-stager read: keys already in the store (resident or
-        spilled) return their rows (spilled keys fault in, exactly as the
-        eventual lookup_or_create would); genuinely new keys report
-        found=False and are left for the pass boundary's sorted
-        lookup_or_create so init-rng draw order stays identical to the
-        full path."""
+        tier-sleeping) return their rows; tier keys fault in batched,
+        exactly as the eventual lookup_or_create would (this IS the
+        BeginFeedPass/LoadSSD2Mem promote path — the prefetcher thread
+        pulls the next pass's sleeping rows off SSD under the current
+        pass's training tail). Genuinely new keys report found=False and
+        are left for the pass boundary's sorted lookup_or_create so
+        init-rng draw order stays identical to the full path."""
         keys = np.asarray(keys, dtype=np.uint64)
         out = np.zeros((keys.size, self.layout.width), dtype=np.float32)
         found = np.zeros(keys.size, bool)
         with self._lock:
+            miss: List[int] = []
             for i, k in enumerate(keys.tolist()):
                 r = self._index.get(k, -1)
-                if r < 0 and k in self._spilled:
-                    r = self._fault_in(k)
                 if r >= 0:
                     out[i] = self._values[r]
                     found[i] = True
+                else:
+                    miss.append(i)
+            if miss and len(self._tier):
+                mi = np.asarray(miss, np.int64)
+                spilled = self._tier.contains(keys[mi])
+                if spilled.any():
+                    fi = mi[spilled]
+                    fkeys = keys[fi]
+                    vals = self._tier.read(fkeys, pop=True)
+                    rows = self._install_rows(fkeys, vals)
+                    out[fi] = self._values[rows]
+                    found[fi] = True
+                    stat_add("sparse_keys_faulted_in", int(fi.size))
+                    if self._journal_sink is not None:
+                        self._journal_sink(MV_FAULT_IN, fkeys)
         return out, found
 
     # ------------------------------------------------------------ lifecycle
@@ -276,10 +241,10 @@ class HostEmbeddingStore:
                     self._values[r] = 0.0
                     self._free.append(r)
                 n_dead = int(dead.size)
-            # spilled rows sweep runs even when nothing is resident
-            n_dead += self._age_book.sweep(
-                self._spilled, self._dec_file_live,
-                self.table.delete_after_unseen_days)
+            # tier rows sweep runs even when nothing is resident (the
+            # coldest rows — exactly the deletion candidates — must not
+            # be immortal just because they sleep on disk)
+            n_dead += self._tier.sweep(self.table.delete_after_unseen_days)
             if n_dead:
                 stat_add("sparse_keys_shrunk", n_dead)
             return n_dead
@@ -290,17 +255,24 @@ class HostEmbeddingStore:
                                count=len(self._index))
             if rows.size:
                 self._values[rows, UNSEEN_DAYS] += 1.0
-            # spilled rows age lazily via the epoch (added at fault-in)
-            self._age_book.tick()
+            # tier rows age lazily via the epoch (applied at read)
+            self._tier.tick()
 
     def tick_spill_age(self) -> None:
-        """Advance ONLY the spilled rows' day clock — for day boundaries
+        """Advance ONLY the tier rows' day clock — for day boundaries
         where the resident rows were already aged by another path
         (save_base's update_stat_after_save touches resident rows only)."""
         with self._lock:
-            self._age_book.tick()
+            self._tier.tick()
 
     # ----------------------------------------------------------- SSD tier
+    def set_journal_sink(self, sink) -> None:
+        """Install the journal's MOVE recorder (sink(op, keys), called
+        inside the mutation critical section so record order matches
+        mutation order). None detaches."""
+        with self._lock:
+            self._journal_sink = sink
+
     def spill(self, max_resident: int) -> int:
         """Spill oldest-unseen rows beyond max_resident to the SSD tier
         (SSDSparseTable / CheckNeedLimitMem+ShrinkResource analog)."""
@@ -310,78 +282,82 @@ class HostEmbeddingStore:
             excess = len(self._index) - max_resident
             if excess <= 0:
                 return 0
-            os.makedirs(self._spill_dir, exist_ok=True)
             keys = np.fromiter(self._index.keys(), dtype=np.uint64,
                                count=len(self._index))
             rows = np.fromiter(self._index.values(), dtype=np.int64,
                                count=len(self._index))
             unseen = self._values[rows, UNSEEN_DAYS]
             order = np.argsort(-unseen, kind="stable")[:excess]
-            fname = os.path.join(
-                self._spill_dir,
-                f"spill_{self._spill_tag}_{self._spill_seq:08d}.npy")
-            self._spill_seq += 1
-            block = self._values[rows[order]]
-            np.save(fname, block)
-            for off, i in enumerate(order.tolist()):
-                k = int(keys[i])
-                r = self._index.pop(k)
-                self._spilled[k] = (fname, off)
-                self._age_book.note(k, unseen[i])
+            vkeys = keys[order]
+            vrows = rows[order]
+            self._tier.spill_rows(vkeys, self._values[vrows])
+            for k, r in zip(vkeys.tolist(), vrows.tolist()):
+                del self._index[k]
                 self._values[r] = 0.0
                 self._free.append(r)
-            self._file_live[fname] = int(order.size)
+            if self._journal_sink is not None:
+                self._journal_sink(MV_SPILL, vkeys)
             stat_add("sparse_keys_spilled", excess)
             return excess
 
-    def _dec_file_live(self, fname: str, n: int) -> None:
-        dec_file_live(self._file_live, fname, n)
+    def spill_exact(self, keys: np.ndarray) -> int:
+        """Move EXACTLY these keys (those currently resident) to the
+        tier — the journal replay of an MV_SPILL record, and save_base's
+        anchor re-spill on a scratch store. Never journals (replay must
+        not re-record), tolerant of non-resident keys (a later record
+        already accounts for them)."""
+        keys = np.asarray(keys, dtype=np.uint64)
+        with self._lock:
+            idx = self._index
+            present = [k for k in keys.tolist() if k in idx]
+            if not present:
+                return 0
+            pkeys = np.asarray(present, np.uint64)
+            rows = np.fromiter((idx[k] for k in present),
+                               dtype=np.int64, count=len(present))
+            self._tier.spill_rows(pkeys, self._values[rows])
+            for k, r in zip(present, rows.tolist()):
+                del idx[k]
+                self._values[r] = 0.0
+                self._free.append(r)
+            return len(present)
 
-    def _fault_in(self, key: int) -> int:  # boxlint: disable=BX401 — caller holds _lock (the *_locked contract)
-        fname, off = self._spilled.pop(key)
-        row_data = np.array(np.load(fname, mmap_mode="r")[off])
-        missed = self._age_book.missed_days(key, pop=True)
-        if missed:
-            apply_missed_days(row_data, missed,
-                              self.table.show_click_decay_rate)
-        self._dec_file_live(fname, 1)
-        self._grow(1)
-        r = self._free.pop()
-        self._values[r] = row_data
-        self._index[key] = r
-        stat_add("sparse_keys_faulted_in", 1)
-        return r
+    def fault_in_keys(self, keys: np.ndarray) -> int:
+        """Fault EXACTLY these keys (those live in the tier) back to the
+        resident set — the journal replay of an MV_FAULT_IN record.
+        Never journals, tolerant of keys not in the tier."""
+        keys = np.asarray(keys, dtype=np.uint64)
+        with self._lock:
+            if not len(self._tier):
+                return 0
+            m = self._tier.contains(keys)
+            if not m.any():
+                return 0
+            fkeys = keys[m]
+            self._install_rows(fkeys, self._tier.read(fkeys, pop=True))
+            return int(fkeys.size)
+
+    def rebase_spill_ages(self) -> None:
+        """Pin a lazy-aging span boundary at the current epoch — called
+        exactly when a full save anchors the journal (the snapshot wrote
+        effective values; replay re-applies decay only from here). See
+        SpillTier.rebase for the f32 span-parity argument."""
+        with self._lock:
+            self._tier.rebase()
 
     def load_spilled(self) -> int:
-        """LoadSSD2Mem(day): promote every spilled row back to DRAM —
-        batched by block file (one np.load per file, not per row) and under
-        the lock (a concurrent lookup fault-in of the same key would
-        double-pop the spill index)."""
+        """LoadSSD2Mem(day): promote every tier row back to DRAM — one
+        batched tier read (grouped by block) under the lock (a concurrent
+        lookup fault-in of the same key would double-pop the tier)."""
         with self._lock:
-            if not self._spilled:
+            skeys = self._tier.live_keys()
+            if not skeys.size:
                 return 0
-            by_file: Dict[str, list] = {}
-            for k, (fname, off) in self._spilled.items():
-                by_file.setdefault(fname, []).append((k, off))
-            self._grow(len(self._spilled))
-            n = 0
-            for fname, pairs in by_file.items():
-                block = np.load(fname, mmap_mode="r")
-                for k, off in pairs:
-                    row = np.array(block[off])
-                    missed = self._age_book.missed_days(k, pop=True)
-                    if missed:
-                        apply_missed_days(row, missed,
-                                          self.table.show_click_decay_rate)
-                    r = self._free.pop()
-                    self._values[r] = row
-                    self._index[k] = r
-                    n += 1
-                del block  # release the mmap before unlink
-                self._dec_file_live(fname, len(pairs))
-            self._spilled.clear()
-            stat_add("sparse_keys_faulted_in", n)
-            return n
+            self._install_rows(skeys, self._tier.read(skeys, pop=True))
+            if self._journal_sink is not None:
+                self._journal_sink(MV_FAULT_IN, skeys)
+            stat_add("sparse_keys_faulted_in", int(skeys.size))
+            return int(skeys.size)
 
     # ---------------------------------------------------------- checkpoint
     def state_items(self) -> Tuple[np.ndarray, np.ndarray]:
@@ -394,41 +370,25 @@ class HostEmbeddingStore:
             return keys, self._values[rows].copy()
 
     def spilled_snapshot(self) -> Tuple[np.ndarray, np.ndarray]:
-        """(keys, EFFECTIVE values) of the spilled rows, without faulting
+        """(keys, EFFECTIVE values) of the tier rows, without faulting
         them in or mutating the store: missed days + show/click decay are
-        applied to the returned copy (the age book keeps its entries).
-        Every checkpoint path that snapshots beyond state_items() must use
-        this — a snapshot of the raw disk blocks would lose the un-added
-        days forever once the age book is cleared on load."""
+        applied to the returned copy (the tier keeps its raw bytes and
+        epochs). Every checkpoint path that snapshots beyond
+        state_items() must use this — a snapshot of the raw disk blocks
+        would lose the un-applied days forever once the tier is cleared
+        on load."""
         with self._lock:
-            if not self._spilled:
-                return (np.empty(0, np.uint64),
-                        np.empty((0, self.layout.width), np.float32))
-            spilled = dict(self._spilled)
-            skeys = np.fromiter(spilled.keys(), dtype=np.uint64,
-                                count=len(spilled))
-            svals = np.empty((skeys.size, self.layout.width), np.float32)
-            by_file: Dict[str, list] = {}
-            for i, k in enumerate(skeys.tolist()):
-                fname, off = spilled[k]
-                by_file.setdefault(fname, []).append((i, off))
-            for fname, pairs in by_file.items():
-                block = np.load(fname, mmap_mode="r")
-                for i, off in pairs:
-                    svals[i] = block[off]
-            missed = np.fromiter(
-                (self._age_book.missed_days(int(k), pop=False)
-                 for k in skeys.tolist()),
-                dtype=np.float32, count=skeys.size)
-            apply_missed_days(svals, missed,
-                              self.table.show_click_decay_rate)
-            return skeys, svals
+            return self._tier.snapshot()
+
+    def spilled_keys(self) -> np.ndarray:
+        """Every live tier key (the anchor's MV_SPILL record set)."""
+        with self._lock:
+            return self._tier.live_keys()
 
     def spilled_count(self) -> int:
-        """Rows currently on the SSD tier — the journal's taint probe
-        (spilled rows sit outside the journaled mutation cadence)."""
+        """Rows currently on the SSD tier."""
         with self._lock:
-            return len(self._spilled)
+            return len(self._tier)
 
     def update_stat_after_save(self, table: TableConfig, param: int
                                ) -> None:
@@ -453,15 +413,14 @@ class HostEmbeddingStore:
                 v[rows[covered], DELTA_SCORE] = 0.0
 
     def save(self, path: str) -> None:
-        """Checkpoint resident AND spilled rows (same invariant as the
+        """Checkpoint resident AND tier rows (same invariant as the
         native store: a spilled feature survives a save/load cycle).
         Format rides the ckpt_format flag: columnar manifest + striped
         parts from the writer pool (default), or the legacy pickle."""
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        # the whole snapshot (resident + spilled + age book) happens under
-        # ONE lock hold: a concurrent fault-in popping a spill entry (and
-        # possibly GC'ing its block file) mid-read would lose the missed
-        # days or crash the np.load
+        # the whole snapshot (resident + tier) happens under ONE lock
+        # hold: a concurrent fault-in consuming a tier entry mid-read
+        # would lose its missed days
         with self._lock:
             keys, values = self.state_items()
             skeys, svals = self.spilled_snapshot()
@@ -491,14 +450,8 @@ class HostEmbeddingStore:
             raise ValueError("checkpoint layout mismatch")
         with self._lock:
             self._index.clear()
-            self._spilled.clear()  # stale spill entries must not resurrect
-            self._age_book.meta.clear()
-            for fname in list(self._file_live):
-                try:
-                    os.remove(fname)
-                except OSError:
-                    pass
-            self._file_live.clear()
+            # stale tier entries must not resurrect over restored rows
+            self._tier.clear()
             self._free = list(range(self._values.shape[0] - 1, -1, -1))
             self._values[:] = 0.0
             keys, values = blob["keys"], blob["values"]

@@ -3,8 +3,13 @@
 Same public API as HostEmbeddingStore, delegating the hot paths (bulk
 lookup/create/gather/scatter, erase) to native/host_store.cc via ctypes —
 the per-key Python dict loop becomes a single C call per pass. The SSD
-spill tier stays on the Python store (make_host_store routes tables with
-ssd_dir there); DRAM-resident tables take this path.
+tier (embedding/ssd_tier.py) sits directly behind this store too: victim
+selection (hs_coldest) and the resident hash stay in C++, spill blocks
+land in columnar part files, and fault-in is one batched tier read per
+call. Init-rng is drawn ONLY for genuinely-new keys (tier-sleeping keys
+fault in without a draw), identical to the python store's semantics —
+which is what lets the promote prefetcher pull sleeping rows early
+without shifting the rng stream.
 """
 
 from __future__ import annotations
@@ -18,6 +23,8 @@ import numpy as np
 from paddlebox_tpu.config import flags
 from paddlebox_tpu.config.configs import TableConfig
 from paddlebox_tpu.embedding.accessor import ValueLayout, UNSEEN_DAYS
+from paddlebox_tpu.embedding.ssd_tier import (MV_FAULT_IN, MV_SPILL,
+                                              SpillTier)
 from paddlebox_tpu.utils.stats import stat_add
 
 _U64P = ctypes.POINTER(ctypes.c_uint64)
@@ -43,16 +50,16 @@ class NativeHostEmbeddingStore:
         self._rng = np.random.RandomState(seed)
         self._h = lib.hs_create(
             layout.width, float(flags.get_flag("sparse_table_load_factor")))
-        # SSD spill tier (SSDSparseTable role): key → (file, row offset);
-        # the file token is per-store so shards sharing one ssd_dir can't
-        # clobber each other's blocks
+        # SSD spill tier (SSDSparseTable role); block tag is per-store so
+        # shards sharing one ssd_dir can't clobber each other's blocks
         self._spill_dir = table.ssd_dir
-        self._spilled: dict = {}
-        self._spill_seq = 0
-        self._spill_tag = f"{os.getpid():x}_{id(self):x}"
-        self._file_live: dict = {}  # file → live spilled rows (GC at 0)
-        from paddlebox_tpu.embedding.host_store import SpillAgeBook
-        self._age_book = SpillAgeBook()
+        self._tier = SpillTier(layout.width, table.ssd_dir,
+                               f"{os.getpid():x}_{id(self):x}",
+                               table.show_click_decay_rate)
+        self._journal_sink = None
+        # fused single-probe lookup+gather (round 16) when the lib has
+        # it; older user plugin .so files fall back to the 2-call path
+        self._fused = getattr(lib, "hs_lookup_gather", None)
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -77,37 +84,21 @@ class NativeHostEmbeddingStore:
         self._lib.hs_lookup(self._h, _p(keys, _U64P), n, _p(rows, _I64P))
         return rows, np.zeros(n, bool)
 
-    def _dec_file_live(self, fname: str, n: int) -> None:
-        from paddlebox_tpu.embedding.host_store import dec_file_live
-        dec_file_live(self._file_live, fname, n)
-
-    def _read_spilled(self, keys: np.ndarray, consume: bool) -> np.ndarray:
-        """Read spilled rows for `keys` (all present in the spill index),
-        one np.load per file. consume=True removes the index entries and
-        deletes any spill file with no live rows left (SSD GC)."""
-        out = np.empty((keys.size, self.layout.width), np.float32)
-        by_file: dict = {}
-        missed = np.empty(keys.size, np.float32)
-        for i, k in enumerate(keys.tolist()):
-            fname, off = (self._spilled.pop(k) if consume
-                          else self._spilled[k])
-            missed[i] = self._age_book.missed_days(k, pop=consume)
-            by_file.setdefault(fname, []).append((i, off))
-        for fname, pairs in by_file.items():
-            block = np.load(fname, mmap_mode="r")
-            for i, off in pairs:
-                out[i] = block[off]
-            if consume:
-                del block  # release the mmap before unlink
-                self._dec_file_live(fname, len(pairs))
-        from paddlebox_tpu.embedding.host_store import apply_missed_days
-        apply_missed_days(out, missed, self.table.show_click_decay_rate)
-        if consume:
-            stat_add("sparse_keys_faulted_in", int(keys.size))
-        return out
-
-    def _fault_in_values(self, keys: np.ndarray) -> np.ndarray:
-        return self._read_spilled(keys, consume=True)
+    def _read_resident(self, keys: np.ndarray
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+        """(values, found) for resident keys — ONE probe per key via the
+        fused hs_lookup_gather (absent keys read as zero rows), or the
+        lookup+gather pair on libs that predate it."""
+        n = keys.size
+        out = np.empty((n, self.layout.width), np.float32)
+        found = np.empty(n, np.uint8)
+        if self._fused is not None:
+            self._fused(self._h, _p(keys, _U64P), n, _p(out, _F32P),
+                        _p(found, _U8P))
+            return out, found.astype(bool)
+        rows, _ = self._rows_of(keys, create=False)
+        self._lib.hs_gather(self._h, _p(rows, _I64P), n, _p(out, _F32P))
+        return out, rows >= 0
 
     def lookup_or_create(self, keys: np.ndarray) -> np.ndarray:
         keys = np.ascontiguousarray(keys, dtype=np.uint64)
@@ -115,67 +106,74 @@ class NativeHostEmbeddingStore:
         out = np.empty((keys.size, self.layout.width), np.float32)
         self._lib.hs_gather(self._h, _p(rows, _I64P), keys.size,
                             _p(out, _F32P))
-        n_new = int(created.sum())
-        if n_new:
-            init = self.layout.new_rows(n_new, self._rng,
-                                        self.table.optimizer)
-            if self._spilled:
-                # fault spilled keys back in instead of re-initializing
-                new_keys = keys[created]
-                spilled_m = np.fromiter(
-                    (int(k) in self._spilled for k in new_keys.tolist()),
-                    dtype=bool, count=new_keys.size)
+        if created.any():
+            spilled_m = np.zeros(keys.size, bool)
+            if len(self._tier):
+                # fault tier-sleeping keys back in (no init draw for them)
+                spilled_m[created] = self._tier.contains(keys[created])
                 if spilled_m.any():
-                    init[spilled_m] = self._fault_in_values(
-                        new_keys[spilled_m])
-            out[created] = init
-            # persist the init back so the arena matches what we returned
-            new_rows = np.ascontiguousarray(rows[created])
-            self._lib.hs_scatter(self._h, _p(new_rows, _I64P), n_new,
-                                 _p(np.ascontiguousarray(init), _F32P))
-            stat_add("sparse_keys_created", n_new)
+                    fkeys = keys[spilled_m]
+                    out[spilled_m] = self._tier.read(fkeys, pop=True)
+                    stat_add("sparse_keys_faulted_in", int(fkeys.size))
+                    if self._journal_sink is not None:
+                        self._journal_sink(MV_FAULT_IN, fkeys)
+            new_m = created & ~spilled_m
+            n_new = int(new_m.sum())
+            if n_new:
+                out[new_m] = self.layout.new_rows(n_new, self._rng,
+                                                  self.table.optimizer)
+                stat_add("sparse_keys_created", n_new)
+            # persist faulted + init rows back so the arena matches what
+            # we returned
+            cr = np.ascontiguousarray(rows[created])
+            self._lib.hs_scatter(
+                self._h, _p(cr, _I64P), cr.size,
+                _p(np.ascontiguousarray(out[created]), _F32P))
         return out
 
     def lookup(self, keys: np.ndarray) -> np.ndarray:
+        """Test-mode fetch: missing keys read as zero rows; tier keys are
+        PEEKED (no mutation — serving traffic can't churn the resident
+        set and needs no journal record)."""
         keys = np.ascontiguousarray(keys, dtype=np.uint64)
-        rows, _ = self._rows_of(keys, create=False)
-        out = np.empty((keys.size, self.layout.width), np.float32)
-        self._lib.hs_gather(self._h, _p(rows, _I64P), keys.size,
-                            _p(out, _F32P))
-        if self._spilled:
-            missing = rows < 0
-            if missing.any():
-                mk = keys[missing]
-                sp = np.fromiter(
-                    (int(k) in self._spilled for k in mk.tolist()),
-                    dtype=bool, count=mk.size)
+        out, found = self._read_resident(keys)
+        if len(self._tier):
+            mi = np.nonzero(~found)[0]
+            if mi.size:
+                sp = self._tier.contains(keys[mi])
                 if sp.any():
-                    # test-mode read: peek without consuming the index
-                    idx = np.nonzero(missing)[0][sp]
-                    out[idx] = self._read_spilled(keys[idx], consume=False)
+                    idx = mi[sp]
+                    out[idx] = self._tier.read(keys[idx], pop=False)
         return out
 
     def lookup_present(self, keys: np.ndarray):
         """(values, found) without creating missing features — the preload
         promote-stager read (see HostEmbeddingStore.lookup_present).
-
-        SPILLED keys deliberately report found=False here: this store's
-        lookup_or_create counts spilled keys among its created set, so it
-        consumes one init-rng draw per spilled key before overwriting the
-        row with the faulted-in value. Prefetching them (zero draws) would
-        shift the rng stream vs the full lifecycle and break bit-parity —
-        they resolve at the pass boundary's lookup_or_create instead,
-        which reproduces the full path's draws exactly."""
+        Tier-sleeping keys fault in here, batched — this is the
+        LoadSSD2Mem half of the BeginFeedPass contract, and since
+        lookup_or_create no longer draws init for tier keys, prefetching
+        them leaves the rng stream bit-identical to the boundary path.
+        Genuinely new keys report found=False for the pass boundary's
+        sorted create."""
         keys = np.ascontiguousarray(keys, dtype=np.uint64)
-        rows, _ = self._rows_of(keys, create=False)
-        found = rows >= 0
-        out = np.zeros((keys.size, self.layout.width), np.float32)
-        if found.any():
-            hit_rows = np.ascontiguousarray(rows[found])
-            vals = np.empty((int(found.sum()), self.layout.width), np.float32)
-            self._lib.hs_gather(self._h, _p(hit_rows, _I64P), hit_rows.size,
-                                _p(vals, _F32P))
-            out[found] = vals
+        out, found = self._read_resident(keys)
+        if len(self._tier):
+            mi = np.nonzero(~found)[0]
+            if mi.size:
+                sp = self._tier.contains(keys[mi])
+                if sp.any():
+                    fi = mi[sp]
+                    fkeys = np.ascontiguousarray(keys[fi])
+                    vals = self._tier.read(fkeys, pop=True)
+                    frows, _ = self._rows_of(fkeys, create=True)
+                    self._lib.hs_scatter(
+                        self._h, _p(frows, _I64P), fkeys.size,
+                        _p(np.ascontiguousarray(vals), _F32P))
+                    out[fi] = vals
+                    found[fi] = True
+                    stat_add("sparse_keys_faulted_in", int(fkeys.size))
+                    if self._journal_sink is not None:
+                        self._journal_sink(MV_FAULT_IN, fkeys)
         return out, found
 
     def write_back(self, keys: np.ndarray, values: np.ndarray) -> None:
@@ -190,15 +188,12 @@ class NativeHostEmbeddingStore:
     def assign(self, keys: np.ndarray, values: np.ndarray) -> None:
         """Create-or-overwrite rows verbatim (EndPass dump target): no
         init rng draws for rows that are immediately overwritten — same
-        contract as HostEmbeddingStore.assign."""
+        contract as HostEmbeddingStore.assign. A stale tier entry is
+        discarded unread (replay's assign performs the same discard
+        deterministically — no journal record needed)."""
         keys = np.ascontiguousarray(keys, dtype=np.uint64)
-        if self._spilled:
-            # a stale spill entry must not resurrect over assigned values
-            for k in keys.tolist():
-                if k in self._spilled:
-                    fname, _ = self._spilled.pop(k)
-                    self._age_book.drop(k)
-                    self._dec_file_live(fname, 1)
+        if len(self._tier):
+            self._tier.discard(keys)
         rows, _ = self._rows_of(keys, create=True)
         vals = np.ascontiguousarray(values, dtype=np.float32)
         self._lib.hs_scatter(self._h, _p(rows, _I64P), keys.size,
@@ -215,43 +210,46 @@ class NativeHostEmbeddingStore:
             if dead.size:
                 self._lib.hs_erase(self._h, _p(dead, _U64P), dead.size)
             n_dead = int(dead.size)
-        # spilled rows sweep runs even when nothing is resident
-        n_dead += self._age_book.sweep(
-            self._spilled, self._dec_file_live,
-            self.table.delete_after_unseen_days)
+        # tier rows sweep runs even when nothing is resident
+        n_dead += self._tier.sweep(self.table.delete_after_unseen_days)
         if n_dead:
             stat_add("sparse_keys_shrunk", n_dead)
         return n_dead
 
     def age_unseen_days(self) -> None:
         # in-place single-column increment in C++ (a state_items round trip
-        # would copy the whole table twice); spilled rows age lazily via
-        # the epoch, added back at fault-in
+        # would copy the whole table twice); tier rows age lazily via
+        # the epoch, applied at read
         touched = int(self._lib.hs_add_col(self._h, UNSEEN_DAYS, 1.0))
         if touched < 0:  # -1 = column out of range: layout/width mismatch
             raise RuntimeError(
                 f"hs_add_col(col={UNSEEN_DAYS}) rejected by native store "
                 f"(width={self._lib.hs_width(self._h)}) — layout mismatch")
         stat_add("sparse_rows_aged", touched)
-        self._age_book.tick()
+        self._tier.tick()
 
     def tick_spill_age(self) -> None:
-        """Advance only the spilled rows' day clock (see
+        """Advance only the tier rows' day clock (see
         HostEmbeddingStore.tick_spill_age)."""
-        self._age_book.tick()
+        self._tier.tick()
 
     # ----------------------------------------------------------- SSD tier
+    def set_journal_sink(self, sink) -> None:
+        """Install the journal's MOVE recorder (sink(op, keys)); None
+        detaches. Callers serialize via the table's store_lock, like
+        every other mutation of this store."""
+        self._journal_sink = sink
+
     def spill(self, max_resident: int) -> int:
-        """Spill the coldest rows beyond max_resident to the SSD dir
+        """Spill the coldest rows beyond max_resident to the SSD tier
         (SSDSparseTable / CheckNeedLimitMem+ShrinkResource, box_wrapper.h:
         627-629): victim selection (largest unseen_days) runs in C++
-        (hs_coldest), the block lands in one .npy file."""
+        (hs_coldest), the block lands in one columnar part file."""
         if not self._spill_dir:
             return 0
         excess = len(self) - max_resident
         if excess <= 0:
             return 0
-        os.makedirs(self._spill_dir, exist_ok=True)
         keys = np.empty(excess, np.uint64)
         rows = np.empty(excess, np.int64)
         got = int(self._lib.hs_coldest(self._h, excess, UNSEEN_DAYS,
@@ -261,30 +259,66 @@ class NativeHostEmbeddingStore:
         keys, rows = keys[:got], rows[:got]
         block = np.empty((got, self.layout.width), np.float32)
         self._lib.hs_gather(self._h, _p(rows, _I64P), got, _p(block, _F32P))
-        fname = os.path.join(
-            self._spill_dir,
-            f"nspill_{self._spill_tag}_{self._spill_seq:08d}.npy")
-        self._spill_seq += 1
-        np.save(fname, block)
-        for off, k in enumerate(keys.tolist()):
-            self._spilled[int(k)] = (fname, off)
-            self._age_book.note(int(k), block[off, UNSEEN_DAYS])
-        self._file_live[fname] = got
+        self._tier.spill_rows(keys, block)
         self._lib.hs_erase(self._h, _p(keys, _U64P), got)
+        if self._journal_sink is not None:
+            self._journal_sink(MV_SPILL, keys)
         stat_add("sparse_keys_spilled", got)
         return got
 
-    def load_spilled(self) -> int:
-        """LoadSSD2Mem(day): promote every spilled row back to DRAM."""
-        if not self._spilled:
+    def spill_exact(self, keys: np.ndarray) -> int:
+        """Move EXACTLY these keys (those currently resident) to the
+        tier — journal replay of MV_SPILL / save_base's anchor re-spill.
+        Never journals, tolerant of non-resident keys."""
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        rows, _ = self._rows_of(keys, create=False)
+        present = rows >= 0
+        if not present.any():
             return 0
-        keys = np.fromiter(self._spilled.keys(), dtype=np.uint64,
-                           count=len(self._spilled))
-        vals = self._fault_in_values(keys)
-        rows, _ = self._rows_of(keys, create=True)
-        self._lib.hs_scatter(self._h, _p(rows, _I64P), keys.size,
+        pkeys = np.ascontiguousarray(keys[present])
+        prows = np.ascontiguousarray(rows[present])
+        block = np.empty((pkeys.size, self.layout.width), np.float32)
+        self._lib.hs_gather(self._h, _p(prows, _I64P), pkeys.size,
+                            _p(block, _F32P))
+        self._tier.spill_rows(pkeys, block)
+        self._lib.hs_erase(self._h, _p(pkeys, _U64P), pkeys.size)
+        return int(pkeys.size)
+
+    def fault_in_keys(self, keys: np.ndarray) -> int:
+        """Fault EXACTLY these keys (those live in the tier) back in —
+        journal replay of MV_FAULT_IN. Never journals, tolerant of keys
+        not in the tier."""
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        if not len(self._tier):
+            return 0
+        m = self._tier.contains(keys)
+        if not m.any():
+            return 0
+        fkeys = np.ascontiguousarray(keys[m])
+        vals = self._tier.read(fkeys, pop=True)
+        frows, _ = self._rows_of(fkeys, create=True)
+        self._lib.hs_scatter(self._h, _p(frows, _I64P), fkeys.size,
                              _p(np.ascontiguousarray(vals), _F32P))
-        return int(keys.size)
+        return int(fkeys.size)
+
+    def rebase_spill_ages(self) -> None:
+        """Pin a lazy-aging span boundary at the current epoch (full-save
+        anchor; see SpillTier.rebase for the f32 span-parity argument)."""
+        self._tier.rebase()
+
+    def load_spilled(self) -> int:
+        """LoadSSD2Mem(day): promote every tier row back to DRAM."""
+        skeys = self._tier.live_keys()
+        if not skeys.size:
+            return 0
+        vals = self._tier.read(skeys, pop=True)
+        rows, _ = self._rows_of(skeys, create=True)
+        self._lib.hs_scatter(self._h, _p(rows, _I64P), skeys.size,
+                             _p(np.ascontiguousarray(vals), _F32P))
+        if self._journal_sink is not None:
+            self._journal_sink(MV_FAULT_IN, skeys)
+        stat_add("sparse_keys_faulted_in", int(skeys.size))
+        return int(skeys.size)
 
     # ---------------------------------------------------------- checkpoint
     def state_items(self) -> Tuple[np.ndarray, np.ndarray]:
@@ -300,18 +334,17 @@ class NativeHostEmbeddingStore:
         return keys, values
 
     def spilled_snapshot(self) -> Tuple[np.ndarray, np.ndarray]:
-        """(keys, EFFECTIVE values) of spilled rows without consuming the
-        spill index (see HostEmbeddingStore.spilled_snapshot)."""
-        if not self._spilled:
-            return (np.empty(0, np.uint64),
-                    np.empty((0, self.layout.width), np.float32))
-        skeys = np.fromiter(self._spilled.keys(), dtype=np.uint64,
-                            count=len(self._spilled))
-        return skeys, self._read_spilled(skeys, consume=False)
+        """(keys, EFFECTIVE values) of tier rows without consuming them
+        (see HostEmbeddingStore.spilled_snapshot)."""
+        return self._tier.snapshot()
+
+    def spilled_keys(self) -> np.ndarray:
+        """Every live tier key (the anchor's MV_SPILL record set)."""
+        return self._tier.live_keys()
 
     def spilled_count(self) -> int:
-        """Rows currently on the SSD tier (the journal's taint probe)."""
-        return len(self._spilled)
+        """Rows currently on the SSD tier."""
+        return len(self._tier)
 
     def update_stat_after_save(self, table: TableConfig, param: int
                                ) -> None:
@@ -343,7 +376,7 @@ class NativeHostEmbeddingStore:
             self.write_back(keys[covered], rows)
 
     def save(self, path: str) -> None:
-        """Checkpoint resident AND spilled rows (a spilled feature must
+        """Checkpoint resident AND tier rows (a spilled feature must
         survive a save/load cycle). Format rides the ckpt_format flag
         (columnar manifest + striped parts by default; legacy pickle)."""
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -372,14 +405,8 @@ class NativeHostEmbeddingStore:
         self._h = self._lib.hs_create(
             self.layout.width,
             float(flags.get_flag("sparse_table_load_factor")))
-        self._spilled.clear()  # stale spill entries must not resurrect
-        self._age_book.meta.clear()
-        for fname in list(self._file_live):
-            try:
-                os.remove(fname)
-            except OSError:
-                pass
-        self._file_live.clear()
+        # stale tier entries must not resurrect over restored rows
+        self._tier.clear()
         keys = np.ascontiguousarray(blob["keys"], np.uint64)
         if keys.size:
             rows, _ = self._rows_of(keys, create=True)
@@ -389,10 +416,17 @@ class NativeHostEmbeddingStore:
 
 
 def make_host_store(layout: ValueLayout, table: TableConfig, seed: int = 0):
-    """Native store (with native SSD spill) unless the native lib is
+    """Native store (with the columnar SSD tier) unless the native lib is
     unavailable — in which case the fallback is LOUD (warning + stat), so
     a broken native build shows up as a flagged degraded mode, not a
-    mystery ~10× slowdown in the per-pass store calls."""
+    mystery ~10× slowdown in the per-pass store calls. With
+    ``host_store_stripes`` > 0 the store is a hash-striped fan-out of N
+    inner stores (embedding/striped_store.py) so insert/lookup scale past
+    one thread."""
+    stripes = int(flags.get_flag("host_store_stripes"))
+    if stripes > 0:
+        from paddlebox_tpu.embedding.striped_store import StripedHostStore
+        return StripedHostStore(layout, table, seed, stripes)
     from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
     try:
         return NativeHostEmbeddingStore(layout, table, seed)

@@ -364,11 +364,17 @@ class PassTable:
         self._journal = None
 
     # --------------------------------------------------------------- journal
-    def attach_journal(self, journal) -> None:
+    # setup-time wiring, called before any worker thread exists
+    def attach_journal(self, journal) -> None:  # boxlint: disable=BX401
         """Attach a train.journal.TouchedRowJournal: end_pass write-backs
         append their touched (keys, rows) delta; end_day/shrink append
-        event records; spill and external loads taint the epoch."""
+        event records; spill/fault-in/promote append MOVE records through
+        the store's journal sink (installed here) so the epoch stays
+        replayable with the SSD tier active. External loads still taint."""
         self._journal = journal
+        set_sink = getattr(self.store, "set_journal_sink", None)
+        if set_sink is not None:
+            set_sink(None if journal is None else journal.append_move)
 
     def _journal_rows(self, keys: np.ndarray, rows: np.ndarray) -> None:
         if self._journal is not None:
@@ -720,10 +726,9 @@ class PassTable:
         if n:
             # rows left the store: the resident slab no longer mirrors it
             # (internal, so DIRECT callers are covered too — matching the
-            # sharded table)
+            # sharded table). The spill itself was journaled as an
+            # MV_SPILL MOVE record by the store's sink — no taint.
             self.invalidate_residency()
-            if self._journal is not None:
-                self._journal.taint(f"{n} rows spilled to the SSD tier")
         return n
 
     def set_test_mode(self, test: bool) -> None:
@@ -859,14 +864,19 @@ class PassTable:
         save_base touches only RESIDENT rows, so the spilled rows' lazy
         day clock still advances here either way."""
         self.invalidate_residency()  # aging rewrites every store row
+        from paddlebox_tpu.train.journal import (EV_AGE_DAYS,
+                                                 EV_TICK_SPILL_AGE)
+        # event appends stay INSIDE the store_lock hold: a concurrent
+        # promote prefetcher fault-in journals MV_FAULT_IN under the same
+        # lock, and replay must apply it against the same tier epoch the
+        # live store saw (record order == mutation order)
         with self.store_lock:
             if age:
                 self.store.age_unseen_days()
+                self._journal_event(EV_AGE_DAYS)
             else:
                 self.store.tick_spill_age()
-        if age:
-            from paddlebox_tpu.train.journal import EV_AGE_DAYS
-            self._journal_event(EV_AGE_DAYS)
+                self._journal_event(EV_TICK_SPILL_AGE)
         return self.shrink_table()
 
     # checkpoint boundary: the driver serializes save/load against passes,

@@ -64,7 +64,11 @@ class PSBackedStore:
         wire). Note: no lookup_present here — the PS cannot distinguish
         found from zero-row-missing over pull_sparse, so the preload
         promote stager skips PS-backed shards and their delta reads
-        resolve at the pass boundary."""
+        resolve at the pass boundary. Same asymmetry on the journal
+        side: no set_journal_sink either — a SERVER-side tier spill is
+        invisible to this client, so PS-backed shards still TAINT the
+        epoch where local stores append replayable MOVE records
+        (round 16, train/journal.py)."""
         return self._pull(np.asarray(keys, np.uint64), create=True)
 
     def lookup(self, keys: np.ndarray) -> np.ndarray:

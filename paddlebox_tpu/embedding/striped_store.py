@@ -1,0 +1,292 @@
+"""Hash-striped host embedding store: N independent inner stores.
+
+The billion-key regime turns the single host index into the bottleneck:
+one hash table (one lock, one arena) serializes every feed-pass lookup
+and every spill scan. StripedHostStore splits the key space into N
+stripes by splitmix64(key) mod N — each stripe owns a full inner store
+(native C++ table when the lib builds, python fallback otherwise), its
+own rng (seed + stripe) and its own SSD-tier block namespace — and fans
+every bulk call out per stripe on a small thread pool. The inner calls
+release the GIL in their numpy/C hot loops, so stripes genuinely overlap
+on a multi-core host.
+
+Correctness notes:
+
+  * Stripes partition the key space, so the fan-out workers touch
+    disjoint state; the per-stripe lock is held across each inner call
+    anyway (cheap, and keeps the story local instead of global).
+  * Init draws come from PER-STRIPE rngs — a striped store's create
+    stream differs from the flat store's. Journal replay is unaffected
+    (created rows reach the journal as ROWS records with their actual
+    written-back values; replay never re-draws init), but flipping
+    host_store_stripes mid-history changes which values NEW features
+    start from. The flag's help text says so.
+  * spill(max_resident) budgets per stripe (floor + remainder spread),
+    so victims are each stripe's coldest rather than the global coldest
+    — same rows within a stripe, bounded skew across stripes.
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from paddlebox_tpu.config.configs import TableConfig
+from paddlebox_tpu.embedding.accessor import ValueLayout
+from paddlebox_tpu.utils.lockwatch import make_rlock
+from paddlebox_tpu.utils.stats import stat_add
+
+
+def stripe_of(keys: np.ndarray, n_stripes: int) -> np.ndarray:
+    """splitmix64 finalizer mod N — uint64 keys → int64 stripe ids.
+    Feasigns are often slot-structured in the high bits; the finalizer
+    mixes all 64 bits so stripes stay balanced regardless."""
+    z = np.asarray(keys, np.uint64) + np.uint64(0x9E3779B97F4A7C15)
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    z = z ^ (z >> np.uint64(31))
+    return (z % np.uint64(n_stripes)).astype(np.int64)
+
+
+def _make_inner(layout: ValueLayout, table: TableConfig, seed: int):
+    """One stripe's store: native if it builds, loud python fallback
+    otherwise (same degrade contract as make_host_store — can't call it,
+    it would recurse into the stripes branch)."""
+    from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
+    from paddlebox_tpu.embedding.native_store import NativeHostEmbeddingStore
+    try:
+        return NativeHostEmbeddingStore(layout, table, seed)
+    except RuntimeError:
+        import logging
+        logging.getLogger("paddlebox_tpu").warning(
+            "striped_store: native lib unavailable — python inner stores")
+        stat_add("host_store_python_fallback")
+        return HostEmbeddingStore(layout, table, seed)
+
+
+class StripedHostStore:
+    """Same public surface as HostEmbeddingStore / the native store;
+    every method routes by stripe and reassembles in input order."""
+
+    def __init__(self, layout: ValueLayout, table: TableConfig,
+                 seed: int = 0, stripes: int = 4) -> None:
+        if stripes < 1:
+            raise ValueError(f"stripes must be >= 1, got {stripes}")
+        self.layout = layout
+        self.table = table
+        self.n_stripes = int(stripes)
+        self._spill_dir = table.ssd_dir
+        self.stores = [_make_inner(layout, table, seed + s)
+                       for s in range(self.n_stripes)]
+        self._locks = [make_rlock(f"StripedHostStore.stripe{s}")
+                       for s in range(self.n_stripes)]
+        workers = min(self.n_stripes, max(1, (os.cpu_count() or 1)))
+        self._pool: Optional[ThreadPoolExecutor] = (
+            ThreadPoolExecutor(max_workers=workers,
+                               thread_name_prefix="stripe")
+            if self.n_stripes > 1 and workers > 1 else None)
+
+    def __len__(self) -> int:
+        return sum(len(st) for st in self.stores)
+
+    # ------------------------------------------------------------- plumbing
+    def _fan(self, fns) -> List:
+        """Run one thunk per stripe; parallel when a pool exists. Result
+        order == submission order; worker exceptions re-raise here."""
+        fns = list(fns)
+        if self._pool is None or len(fns) <= 1:
+            return [fn() for fn in fns]
+        return [f.result() for f in [self._pool.submit(fn) for fn in fns]]
+
+    def _split(self, keys: np.ndarray) -> List[np.ndarray]:
+        """Per-stripe positions into `keys` (empty arrays included, so
+        zips stay aligned with self.stores)."""
+        sid = stripe_of(keys, self.n_stripes)
+        order = np.argsort(sid, kind="stable")
+        bounds = np.searchsorted(sid[order], np.arange(self.n_stripes + 1))
+        return [order[bounds[s]:bounds[s + 1]]
+                for s in range(self.n_stripes)]
+
+    def _keyed(self, keys: np.ndarray, call):
+        """Fan `call(store, lock, sub_keys, positions)` across stripes
+        with non-empty key subsets; returns the per-stripe results."""
+        parts = self._split(keys)
+
+        def thunk(s, pos):
+            with self._locks[s]:
+                return call(self.stores[s], keys[pos], pos)
+        return self._fan(
+            (lambda s=s, pos=pos: thunk(s, pos))
+            for s, pos in enumerate(parts) if pos.size)
+
+    # ------------------------------------------------------------------ api
+    def lookup_or_create(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, np.uint64)
+        out = np.empty((keys.size, self.layout.width), np.float32)
+
+        def call(st, sub, pos):
+            out[pos] = st.lookup_or_create(sub)
+        self._keyed(keys, call)
+        return out
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, np.uint64)
+        out = np.zeros((keys.size, self.layout.width), np.float32)
+
+        def call(st, sub, pos):
+            out[pos] = st.lookup(sub)
+        self._keyed(keys, call)
+        return out
+
+    def lookup_present(self, keys: np.ndarray
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+        keys = np.ascontiguousarray(keys, np.uint64)
+        out = np.zeros((keys.size, self.layout.width), np.float32)
+        found = np.zeros(keys.size, bool)
+
+        def call(st, sub, pos):
+            vals, hit = st.lookup_present(sub)
+            out[pos] = vals
+            found[pos] = hit
+        self._keyed(keys, call)
+        return out, found
+
+    def write_back(self, keys: np.ndarray, values: np.ndarray) -> None:
+        keys = np.ascontiguousarray(keys, np.uint64)
+        values = np.ascontiguousarray(values, np.float32)
+
+        def call(st, sub, pos):
+            st.write_back(sub, values[pos])
+        self._keyed(keys, call)
+
+    def assign(self, keys: np.ndarray, values: np.ndarray) -> None:
+        keys = np.ascontiguousarray(keys, np.uint64)
+        values = np.ascontiguousarray(values, np.float32)
+
+        def call(st, sub, pos):
+            st.assign(sub, values[pos])
+        self._keyed(keys, call)
+
+    # ------------------------------------------------------------ lifecycle
+    def shrink(self) -> int:
+        return sum(self._fan(
+            (lambda s=s: self._with_lock(s, "shrink"))
+            for s in range(self.n_stripes)))
+
+    def _with_lock(self, s: int, meth: str, *args):
+        with self._locks[s]:
+            return getattr(self.stores[s], meth)(*args)
+
+    def age_unseen_days(self) -> None:
+        self._fan((lambda s=s: self._with_lock(s, "age_unseen_days"))
+                  for s in range(self.n_stripes))
+
+    def tick_spill_age(self) -> None:
+        self._fan((lambda s=s: self._with_lock(s, "tick_spill_age"))
+                  for s in range(self.n_stripes))
+
+    # ----------------------------------------------------------- SSD tier
+    def set_journal_sink(self, sink) -> None:
+        """One shared sink: per-stripe MOVE records interleave across
+        stripes, which replay tolerates — stripes are disjoint key sets,
+        and the flat scratch store replays each record independently."""
+        for s in range(self.n_stripes):
+            self._with_lock(s, "set_journal_sink", sink)
+
+    def spill(self, max_resident: int) -> int:
+        if not self._spill_dir:
+            return 0
+        base, rem = divmod(int(max_resident), self.n_stripes)
+        return sum(self._fan(
+            (lambda s=s: self._with_lock(
+                s, "spill", base + (1 if s < rem else 0)))
+            for s in range(self.n_stripes)))
+
+    def spill_exact(self, keys: np.ndarray) -> int:
+        keys = np.ascontiguousarray(keys, np.uint64)
+        return sum(self._keyed(
+            keys, lambda st, sub, pos: st.spill_exact(sub)))
+
+    def fault_in_keys(self, keys: np.ndarray) -> int:
+        keys = np.ascontiguousarray(keys, np.uint64)
+        return sum(self._keyed(
+            keys, lambda st, sub, pos: st.fault_in_keys(sub)))
+
+    def rebase_spill_ages(self) -> None:
+        self._fan((lambda s=s: self._with_lock(s, "rebase_spill_ages"))
+                  for s in range(self.n_stripes))
+
+    def load_spilled(self) -> int:
+        return sum(self._fan(
+            (lambda s=s: self._with_lock(s, "load_spilled"))
+            for s in range(self.n_stripes)))
+
+    # ---------------------------------------------------------- checkpoint
+    def state_items(self) -> Tuple[np.ndarray, np.ndarray]:
+        got = self._fan((lambda s=s: self._with_lock(s, "state_items"))
+                        for s in range(self.n_stripes))
+        return (np.concatenate([k for k, _ in got]),
+                np.vstack([v for _, v in got]))
+
+    def spilled_snapshot(self) -> Tuple[np.ndarray, np.ndarray]:
+        got = self._fan((lambda s=s: self._with_lock(s, "spilled_snapshot"))
+                        for s in range(self.n_stripes))
+        return (np.concatenate([k for k, _ in got]),
+                np.vstack([v for _, v in got]))
+
+    def spilled_keys(self) -> np.ndarray:
+        return np.concatenate(self._fan(
+            (lambda s=s: self._with_lock(s, "spilled_keys"))
+            for s in range(self.n_stripes)))
+
+    def spilled_count(self) -> int:
+        return sum(self._with_lock(s, "spilled_count")
+                   for s in range(self.n_stripes))
+
+    def update_stat_after_save(self, table: TableConfig, param: int
+                               ) -> None:
+        self._fan((lambda s=s: self._with_lock(
+            s, "update_stat_after_save", table, param))
+            for s in range(self.n_stripes))
+
+    def save(self, path: str) -> None:
+        """Checkpoint resident AND tier rows of every stripe into ONE
+        artifact — a striped store's checkpoint loads into a flat store
+        and vice versa (the stripe split is an in-memory routing choice,
+        never a persisted format)."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        keys, values = self.state_items()
+        skeys, svals = self.spilled_snapshot()
+        if skeys.size:
+            keys = np.concatenate([keys, skeys])
+            values = np.vstack([values, svals])
+        from paddlebox_tpu.embedding.ckpt_store import save_sparse_auto
+        save_sparse_auto(path, keys, values,
+                         {"embedx_dim": self.layout.embedx_dim,
+                          "optimizer": self.layout.optimizer})
+
+    def load(self, path: str) -> None:
+        from paddlebox_tpu.embedding.ckpt_store import load_sparse_any
+        self.load_blob(load_sparse_any(path))
+
+    def load_blob(self, blob: Dict) -> None:
+        """Split one flat blob by stripe and load each slice — each
+        inner load_blob resets its own index, tier and arena."""
+        if blob["embedx_dim"] != self.layout.embedx_dim or \
+                blob["optimizer"] != self.layout.optimizer:
+            raise ValueError("checkpoint layout mismatch")
+        keys = np.ascontiguousarray(blob["keys"], np.uint64)
+        values = np.ascontiguousarray(blob["values"], np.float32)
+        parts = self._split(keys)
+
+        def thunk(s, pos):
+            with self._locks[s]:
+                self.stores[s].load_blob(
+                    {"embedx_dim": blob["embedx_dim"],
+                     "optimizer": blob["optimizer"],
+                     "keys": keys[pos], "values": values[pos]})
+        self._fan((lambda s=s, pos=pos: thunk(s, pos))
+                  for s, pos in enumerate(parts))

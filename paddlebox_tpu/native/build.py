@@ -119,6 +119,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.hs_coldest.restype = c.c_int64
     lib.hs_coldest.argtypes = [c.c_void_p, c.c_int64, c.c_int32,
                                P(c.c_uint64), P(c.c_int64)]
+    # round 16 (optional: user plugin .so files may predate it) — fused
+    # single-probe lookup+gather for the read-mostly store paths
+    if hasattr(lib, "hs_lookup_gather"):
+        lib.hs_lookup_gather.restype = c.c_int64
+        lib.hs_lookup_gather.argtypes = [c.c_void_p, P(c.c_uint64),
+                                         c.c_int64, P(c.c_float),
+                                         P(c.c_uint8)]
     # batch key routing
     lib.rt_index_create.restype = c.c_void_p
     lib.rt_index_create.argtypes = [P(c.c_uint64), P(c.c_int64), c.c_int32]

@@ -236,6 +236,30 @@ int64_t hs_add_col(Store* s, int32_t col, float delta) {
   return touched;
 }
 
+// Fused lookup + gather: one probe per key writes the row straight into
+// out [n, width] (zeros + found=0 for absent keys). Saves the [n] int64
+// rows round trip AND a second ctypes call on the read-mostly paths
+// (test-mode lookup, the feed-pass promote prefetcher, striped-store
+// per-stripe reads) — at billion-key scale the two-call pattern's probe
+// results no longer fit hot cache between the calls. Returns hit count.
+int64_t hs_lookup_gather(Store* s, const uint64_t* keys, int64_t n,
+                         float* out, uint8_t* found) {
+  const size_t row_bytes = static_cast<size_t>(s->width) * 4;
+  int64_t hits = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t j = s->probe(keys[i]);
+    if (s->slots[j] == keys[i]) {
+      memcpy(out + i * s->width, s->arena + s->rows[j] * s->width, row_bytes);
+      if (found) found[i] = 1;
+      ++hits;
+    } else {
+      memset(out + i * s->width, 0, row_bytes);
+      if (found) found[i] = 0;
+    }
+  }
+  return hits;
+}
+
 // Direct arena access for zero-copy numpy views (valid until next
 // create/grow): base pointer + row capacity.
 float* hs_arena(Store* s) { return s->arena; }

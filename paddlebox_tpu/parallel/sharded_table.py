@@ -496,12 +496,20 @@ class ShardedPassTable:
         self._journal = None
 
     # ------------------------------------------------------------- journal
-    def attach_journal(self, journal) -> None:
+    # setup-time wiring, called before any worker thread exists
+    def attach_journal(self, journal) -> None:  # boxlint: disable=BX401
         """Attach a train.journal.TouchedRowJournal: end-of-pass write-
         backs append their touched (keys, rows) delta; end_day/shrink
-        append their deterministic event records; spill and external
-        loads taint the epoch (see journal.py for the replay contract)."""
+        append their deterministic event records; local-store spill and
+        fault-in append MOVE records through each owned store's journal
+        sink (installed here). Spill on a store WITHOUT a sink (PS-backed
+        shards — server-side tier) and external loads still taint (see
+        journal.py for the replay contract)."""
         self._journal = journal
+        for st in self.stores:
+            set_sink = getattr(st, "set_journal_sink", None)
+            if set_sink is not None:
+                set_sink(None if journal is None else journal.append_move)
 
     def _journal_rows(self, keys: np.ndarray, rows: np.ndarray) -> None:
         if self._journal is not None:
@@ -1066,6 +1074,7 @@ class ShardedPassTable:
             return 0
         per_shard = budget // max(1, len(self.owned_shards))
         total = 0
+        unsound = 0
         # under the lock: a concurrent PromotePrefetcher lookup_present
         # must never observe a spill mid-flight (native stores have no
         # internal lock — arena rows move)
@@ -1073,12 +1082,21 @@ class ShardedPassTable:
             for st in self.stores:
                 if st is None or not hasattr(st, "spill"):
                     continue
-                total += st.spill(budget if getattr(st, "spill_table_wide",
-                                                    False) else per_shard)
+                n = st.spill(budget if getattr(st, "spill_table_wide",
+                                               False) else per_shard)
+                total += n
+                # local tier stores journal their own MV_SPILL records
+                # via the sink; a store without one (PS-backed — the
+                # tier lives server-side, invisible to this journal)
+                # makes the epoch unreplayable
+                if n and not hasattr(st, "set_journal_sink"):
+                    unsound += n
         if total:
             self.invalidate_residency()
-            if self._journal is not None:
-                self._journal.taint(f"{total} rows spilled to the SSD tier")
+            if unsound and self._journal is not None:
+                self._journal.taint(
+                    f"{unsound} rows spilled on a server-side tier "
+                    "(outside the journaled MOVE cadence)")
         return total
 
     def shrink_table(self) -> int:
@@ -1094,6 +1112,11 @@ class ShardedPassTable:
         shrink (see PassTable.end_day for the age=False/save_base rule).
         PS-backed shards age server-side through their primary."""
         self.invalidate_residency()
+        from paddlebox_tpu.train.journal import (EV_AGE_DAYS,
+                                                 EV_TICK_SPILL_AGE)
+        # event appends INSIDE the store_lock hold: a concurrent promote
+        # prefetcher journals MV_FAULT_IN under the same lock, and replay
+        # must see record order == mutation order (tier epoch parity)
         with self.store_lock:
             for st in self.stores:
                 if st is None:
@@ -1102,9 +1125,7 @@ class ShardedPassTable:
                     st.age_unseen_days()
                 else:
                     st.tick_spill_age()
-        if age:
-            from paddlebox_tpu.train.journal import EV_AGE_DAYS
-            self._journal_event(EV_AGE_DAYS)
+            self._journal_event(EV_AGE_DAYS if age else EV_TICK_SPILL_AGE)
         return self.shrink_table()
 
     # checkpoint boundary: the driver serializes save/load against
@@ -1214,14 +1235,34 @@ class ShardedStoreView:
         return np.concatenate(ks), np.vstack(vs)
 
     def spilled_count(self) -> int:
-        """Summed SSD-tier rows over the owned shards (journal taint
-        probe)."""
+        """Summed SSD-tier rows over the owned shards."""
         total = 0
         for _, st in self._owned():
             probe = getattr(st, "spilled_count", None)
             if probe is not None:
                 total += probe()
         return total
+
+    def spilled_keys(self) -> np.ndarray:
+        """Every live tier key over the owned shards (save_base's anchor
+        MV_SPILL record set)."""
+        parts = []
+        for _, st in self._owned():
+            fn = getattr(st, "spilled_keys", None)
+            if fn is not None:
+                k = fn()
+                if k.size:
+                    parts.append(k)
+        return (np.concatenate(parts) if parts
+                else np.empty(0, np.uint64))
+
+    def rebase_spill_ages(self) -> None:
+        """Pin each owned shard tier's lazy-aging span boundary (the
+        full-save anchor; see SpillTier.rebase)."""
+        for _, st in self._owned():
+            fn = getattr(st, "rebase_spill_ages", None)
+            if fn is not None:
+                fn()
 
     def write_back(self, keys: np.ndarray, values: np.ndarray) -> None:
         # checkpoint stat rewrites land here — the residency caches no

@@ -31,6 +31,7 @@ Round 15 — the line-rate checkpoint/restore plane:
 
 from __future__ import annotations
 
+import contextlib
 import os
 import pickle
 import threading
@@ -137,23 +138,31 @@ class CheckpointManager:
         return {"embedx_dim": self.table.layout.embedx_dim,
                 "optimizer": self.table.layout.optimizer}
 
-    def _spilled_rows_count(self) -> int:
-        probe = getattr(self.store, "spilled_count", None)
-        return int(probe()) if probe is not None else 0
+    def _store_lock(self):
+        """The table's store_lock when it has one (PassTable and
+        ShardedPassTable both do), else a null context. Checkpoint-plane
+        store mutations + their journal records must happen under it so a
+        concurrent feed-pass prefetcher's MOVE records interleave in
+        mutation order."""
+        return getattr(self.table, "store_lock", None) or \
+            contextlib.nullcontext()
 
     def _stat_after_save(self, base: bool) -> None:
         """The post-save stat mutation, in place on the store (clear
         covered delta scores; base saves also age the resident rows) +
         the matching journal event records — the rewrite bypasses the
-        pass cadence, so residency drops too."""
-        jr.apply_stat_after_save(self.store, self.table.config, 1)
-        if base:
-            jr.apply_stat_after_save(self.store, self.table.config, 3)
-        self._invalidate_residency()
-        if self.journal is not None:
-            self.journal.append_event(jr.EV_STAT_SAVE_DELTA)
+        pass cadence, so residency drops too. Mutation and event append
+        share one store_lock hold: record order == mutation order even
+        with a promote prefetcher faulting rows in concurrently."""
+        with self._store_lock():
+            jr.apply_stat_after_save(self.store, self.table.config, 1)
             if base:
-                self.journal.append_event(jr.EV_STAT_SAVE_AGE)
+                jr.apply_stat_after_save(self.store, self.table.config, 3)
+            if self.journal is not None:
+                self.journal.append_event(jr.EV_STAT_SAVE_DELTA)
+                if base:
+                    self.journal.append_event(jr.EV_STAT_SAVE_AGE)
+        self._invalidate_residency()
 
     def save_base(self, params: Any, opt_state: Any, day: str,
                   extra: Optional[Dict] = None,
@@ -189,15 +198,15 @@ class CheckpointManager:
         os.makedirs(xbox_dir, exist_ok=True)
         flags_snapshot = self._flags_snapshot()
 
-        keys, values = self.store.state_items()  # snapshot (copy)
-        # SSD-tier rows are NOT in state_items(); a base model must cover
-        # them (the reference's SaveBase covers SSD-tier rows) or a resume
-        # after load_base — which clears the spill index — loses every
-        # spilled feature. Snapshot them at their EFFECTIVE age; the
-        # post-save stat mutation below stays resident-only (spilled rows
-        # age via the age-book epoch at the day boundary).
-        spilled_rows = self._spilled_rows_count()
-        skeys, svals = self._spilled_snapshot()
+        with self._store_lock():
+            keys, values = self.store.state_items()  # snapshot (copy)
+            # SSD-tier rows are NOT in state_items(); a base model must
+            # cover them (the reference's SaveBase covers SSD-tier rows) or
+            # a resume after load_base — which clears the spill index —
+            # loses every spilled feature. Snapshot them at their EFFECTIVE
+            # age; the post-save stat mutation below stays resident-only
+            # (spilled rows age via the tier epoch at the day boundary).
+            skeys, svals = self._spilled_snapshot()
         all_keys = np.concatenate([keys, skeys]) if skeys.size else keys
         all_vals = np.vstack([values, svals]) if skeys.size else values
         xbox_blob = self._xbox_view(all_keys, all_vals, base=True)
@@ -207,9 +216,25 @@ class CheckpointManager:
         # journal: new epoch anchored at THIS artifact (pre-mutation
         # snapshot — exactly what replay-over-base must reproduce); the
         # part files land on the async writer, but nothing reads them
-        # before the next save's entry wait() joins it
-        if self.journal is not None:
-            self.journal.anchor_full(part_paths, spilled_rows=spilled_rows)
+        # before the next save's entry wait() joins it. The base parts
+        # cover the SSD tier too, so the epoch opens with one MV_SPILL of
+        # everything currently spilled (replay re-spills those rows out of
+        # the loaded base at scratch epoch 0) and the live tier rebases
+        # its age spans to the anchor — from here on, live and scratch
+        # apply the SAME missed-day spans, keeping touched saves
+        # bit-exact with the tier engaged. A prefetcher fault-in landing
+        # between the snapshot hold above and this hold is value-neutral:
+        # same epoch, and its MOVE lands in the old epoch this anchor
+        # retires.
+        with self._store_lock():
+            if self.journal is not None:
+                self.journal.anchor_full(part_paths)
+                sk_now = getattr(self.store, "spilled_keys", None)
+                if sk_now is not None:
+                    self.journal.append_move(jr.MV_SPILL, sk_now())
+            rebase = getattr(self.store, "rebase_spill_ages", None)
+            if rebase is not None:
+                rebase()
         # base save covers everything: clear delta scores + age days, now
         self._stat_after_save(base=True)
 
@@ -481,8 +506,7 @@ class CheckpointManager:
         # spill index, so the anchor starts untainted)
         if self.journal is not None:
             parts, segs = self._artifact_refs(batch_dir)
-            self.journal.anchor_full(parts, segments=segs,
-                                     spilled_rows=self._spilled_rows_count())
+            self.journal.anchor_full(parts, segments=segs)
         return blob["params"], blob["opt_state"], blob["extra"]
 
 

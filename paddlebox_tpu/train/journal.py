@@ -25,12 +25,16 @@ Honesty contract (what makes replay bit-exact, and when it refuses):
   * EVENT records cover ``update_stat_after_save`` (params 1/3),
     ``age_unseen_days`` and ``shrink`` — all deterministic functions of
     (row values, table config), replayed through the same accessor code.
-  * The SSD spill tier moves rows OUT of the resident set, after which
-    save-time stat rewrites and shrink's score-delete no longer see them
-    — a replayed store (everything resident) would diverge. Any spill
-    activity therefore TAINTS the epoch: touched saves fall back to full
-    (loudly) and replay refuses. Same for segment loss to the rotation
-    bound, and for store loads that bypass the checkpoint plane.
+  * The SSD spill tier moves rows between the resident set and the
+    on-disk tier; MOVE records (round 16) journal exactly which keys
+    crossed and in which direction, so a replayed store runs the same
+    spill/fault-in cadence on a scratch memory-mode tier and every
+    save-time stat rewrite / shrink / aging event sees the same resident
+    set the live store did. EV_TICK_SPILL_AGE covers the save-day
+    boundary that ages only the sleeping tier. Spill no longer taints.
+  * What still TAINTS the epoch (touched saves fall back to full,
+    loudly, and replay refuses): segment loss to the rotation bound, and
+    store loads that bypass the checkpoint plane.
 
 Segment format: framed binary records (u32 kind + u64 payload bytes),
 each segment opening with a JSON header record carrying the layout
@@ -58,13 +62,23 @@ _FRAME = struct.Struct("<IQ")  # kind, payload bytes
 KIND_HEADER = 0
 KIND_ROWS = 1
 KIND_EVENT = 2
+KIND_MOVE = 3             # resident<->SSD-tier key movement (round 16)
 
 # event codes — the deterministic out-of-cadence store mutations
 EV_STAT_SAVE_DELTA = 1    # update_stat_after_save param=1 (clear delta)
 EV_STAT_SAVE_AGE = 3      # update_stat_after_save param=3 (age residents)
 EV_AGE_DAYS = 10          # store.age_unseen_days()
 EV_SHRINK = 11            # store.shrink() (decay + delete rule)
-EV_TAINT = 20             # epoch unsound from here (spill/loss/ext. load)
+EV_TICK_SPILL_AGE = 12    # store.tick_spill_age() (save-day boundary)
+EV_TAINT = 20             # epoch unsound from here (loss/external load)
+
+# MOVE directions (KIND_MOVE payload op field) — canonical definitions
+# live with the tier (embedding/ssd_tier.py); re-exported here as part of
+# the record format
+from paddlebox_tpu.embedding.ssd_tier import (  # noqa: E402
+    MV_FAULT_IN, MV_SPILL)
+
+_MOVE_HEAD = struct.Struct("<IIq")  # op, pad, n keys
 
 class JournalIncompleteError(RuntimeError):
     """Replay/snapshot refused: the journal cannot reconstruct the store
@@ -104,6 +118,8 @@ def replay_record(store, table_cfg, kind: int, payload: bytes) -> None:
             store.age_unseen_days()
         elif code == EV_SHRINK:
             store.shrink()
+        elif code == EV_TICK_SPILL_AGE:
+            store.tick_spill_age()
         elif code == EV_TAINT:
             raise JournalIncompleteError(
                 "journal epoch tainted (spill/out-of-cadence store "
@@ -111,6 +127,15 @@ def replay_record(store, table_cfg, kind: int, payload: bytes) -> None:
                 "rejoin from the next full base")
         else:
             raise ValueError(f"unknown journal event code {code}")
+    elif kind == KIND_MOVE:
+        op, _pad, n = _MOVE_HEAD.unpack_from(payload)
+        keys = np.frombuffer(payload, np.uint64, n, _MOVE_HEAD.size)
+        if op == MV_SPILL:
+            store.spill_exact(keys)
+        elif op == MV_FAULT_IN:
+            store.fault_in_keys(keys)
+        else:
+            raise ValueError(f"unknown journal move op {op}")
     # KIND_HEADER records are validated by the caller
 
 
@@ -164,13 +189,24 @@ def reconstruct_blob(base_blob: Dict, segment_paths, layout,
     journal head would have written (modulo store iteration order —
     compare as key→row maps). Replays through a scratch python store so
     every event runs the exact production accessor code; no init-rng is
-    ever drawn (base install + ROWS upserts are verbatim assigns)."""
+    ever drawn (base install + ROWS upserts are verbatim assigns). MOVE
+    records run the same spill/fault-in cadence on a MEMORY-MODE spill
+    tier (ssd_dir stripped): replay must never write blocks into — or
+    depend on — the live process's spill directory. The returned blob
+    covers resident AND tier-sleeping rows, exactly like a full save's
+    state_items + spilled_snapshot pair."""
+    import dataclasses
     from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
-    st = HostEmbeddingStore(layout, table_cfg)
+    scratch_cfg = dataclasses.replace(table_cfg, ssd_dir=None)
+    st = HostEmbeddingStore(layout, scratch_cfg)
     st.load_blob(base_blob)
-    replay_segments(st, table_cfg, segment_paths,
+    replay_segments(st, scratch_cfg, segment_paths,
                     expect_width=layout.width)
     keys, values = st.state_items()
+    skeys, svalues = st.spilled_snapshot()
+    if skeys.size:
+        keys = np.concatenate([keys, skeys])
+        values = np.vstack([values, svalues])
     return {"keys": keys, "values": values,
             "embedx_dim": layout.embedx_dim,
             "optimizer": layout.optimizer}
@@ -298,6 +334,19 @@ class TouchedRowJournal:
             self._append_locked(  # boxlint: disable=BX601
                 KIND_EVENT, struct.pack("<I", code))
 
+    def append_move(self, op: int, keys: np.ndarray) -> None:
+        """One resident<->tier movement (MV_SPILL / MV_FAULT_IN) with the
+        exact key set that crossed. Called from inside the store's
+        mutation critical section (the journal sink installed by
+        attach_journal) so record order matches mutation order."""
+        keys = np.ascontiguousarray(keys, np.uint64)
+        if keys.size == 0:
+            return
+        head = _MOVE_HEAD.pack(op, 0, keys.size)
+        with self._lock:  # seal-under-lock contract: see append_rows
+            self._append_locked(  # boxlint: disable=BX601
+                KIND_MOVE, head + keys.tobytes())
+
     def taint(self, reason: str) -> None:
         """Mark the epoch unsound (spill activity, segment loss, store
         mutation outside the journaled cadence). Recorded in-band too so
@@ -309,8 +358,8 @@ class TouchedRowJournal:
                                     struct.pack("<I", EV_TAINT))
 
     # ------------------------------------------------------------- anchors
-    def anchor_full(self, parts: List[str], segments: List[str] = (),
-                    spilled_rows: int = 0) -> None:
+    def anchor_full(self, parts: List[str], segments: List[str] = ()
+                    ) -> None:
         """Start a new epoch at a FULL base artifact: `parts` are its
         columnar part files (plus `segments` when the artifact itself is
         a journal-mode manifest — the flattening that keeps snapshot
@@ -337,16 +386,6 @@ class TouchedRowJournal:
             self._dirty_rows = 0
             self._anchor = {"parts": list(parts),
                             "segments": list(segments)}
-            if spilled_rows:
-                self._taint_reason = (
-                    f"{spilled_rows} spilled rows at anchor (SSD tier "
-                    "rows are outside the journaled cadence)")
-                # in-band too: a raw segment replayer (the elastic
-                # rejoin path reading the journal dir directly) must
-                # refuse this epoch, not just the manager's snapshot
-                # (seal-under-lock contract: see append_rows)
-                self._append_locked(KIND_EVENT,  # boxlint: disable=BX601
-                                    struct.pack("<I", EV_TAINT))
 
     def rebase(self, parts: List[str], segments: List[str]) -> None:
         """Move the anchor onto a just-written journal-mode snapshot's

@@ -1221,3 +1221,60 @@ def test_changed_files_vs_git(tmp_path):
     from tools.boxlint.cache import changed_files
     got = changed_files(root=str(repo))
     assert got == {"edited.py", "fresh.py", "sub/inner.py"}
+
+
+# ================================================== round-16 tierbudget
+
+TIERBUDGET_FIXTURE = """
+    import pytest
+
+    N_KEYS = 500_000_000          # module constant: helper scope, exempt
+
+    def make_keys():              # not a test function: exempt
+        return list(range(100_000_000))
+
+    def test_pasted_scale():      # BX951: unmarked 100M in tier-1
+        total = 100_000_000
+        assert total > 0
+
+    @pytest.mark.slow
+    def test_marked_scale():      # marked: the slow suite owns it
+        total = 100_000_000
+        assert total > 0
+
+    def test_sentinels_ok():      # 2**k / 2**k - 1: masks, not work
+        kmax = 0xFFFFFFFFFFFFFFFF
+        dead = 0x3FFFFFFF
+        cap = 1 << 34
+        assert kmax > dead > 0 and cap
+
+    def test_small_scale():       # under the floor
+        assert sum(range(1_000_000)) > 0
+"""
+
+
+def test_tierbudget_fixture(tmp_path):
+    got = lint_snippet(tmp_path, TIERBUDGET_FIXTURE, ["tierbudget"],
+                       name="test_fixture.py")
+    assert codes(got) == ["BX951"]
+    assert "test_pasted_scale" in got[0].message
+
+
+def test_tierbudget_only_fires_on_test_files(tmp_path):
+    # the same 100M literal in library code is none of this pass's
+    # business (scale constants are legitimate outside the suite)
+    got = lint_snippet(tmp_path, TIERBUDGET_FIXTURE, ["tierbudget"],
+                       name="library.py")
+    assert got == []
+
+
+def test_tierbudget_gate_suite_stays_inside_budget():
+    """Tier-1 gate twin for the 870 s wall clock: every scale test in
+    tests/ (>= 10M-literal work sizes) must be @pytest.mark.slow so the
+    default `-m 'not slow'` run never inherits it. No baseline — the
+    suite starts clean and stays clean."""
+    files, errors = load_tree([os.path.join(REPO, "tests")], root=REPO)
+    assert not errors, [e.render() for e in errors]
+    got = run_passes(files, ["tierbudget"])
+    assert not got, "scale tests missing @pytest.mark.slow:\n" + "\n".join(
+        v.render() for v in got)

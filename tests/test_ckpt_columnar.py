@@ -393,21 +393,42 @@ def test_touched_mode_without_anchor_falls_back_full(tmp_path):
     assert xdir is not None
 
 
-def test_spill_taints_journal_and_falls_back(tmp_path):
+def test_spill_is_journaled_touched_save_stays_exact(tmp_path):
+    """Round 16: spill is a journaled MOVE, not a taint — the epoch
+    stays snapshot-ready and the touched save reconstructs the live
+    store (resident + tier) bit-exactly."""
     t = PassTable(table_cfg(), seed=13)
     drive_pass(t, np.arange(1, 300, dtype=np.uint64) * 31)
     cm = mk_cm(tmp_path, t)
     cm.save_base({}, {}, day="d0")
     assert cm.journal.snapshot_ready()
     t.store._spill_dir = str(tmp_path / "ssd")  # arm the spill tier
-    assert t.store.spill(max_resident=50) > 0
-    t._journal.taint("test spill")  # PassTable.check_need_limit_mem path
-    assert not cm.journal.snapshot_ready()
+    with t.store_lock:
+        assert t.store.spill(max_resident=50) > 0
+    assert t.store.spilled_count() > 0
+    # a spill no longer taints: the MOVE record keeps the epoch exact
+    assert cm.journal.snapshot_ready()
+    drive_pass(t, np.arange(1, 300, dtype=np.uint64) * 31)  # faults some back
+    # live state (resident + tier at EFFECTIVE values) BEFORE the save:
+    # the touched artifact anchors on the pre-mutation snapshot
+    lk, lv = t.store.state_items()
+    sk, sv = t.store.spilled_snapshot()
+    if sk.size:
+        lk, lv = np.concatenate([lk, sk]), np.vstack([lv, sv])
+    lo = np.argsort(lk, kind="stable")
     bdir, _ = cm.save_base({}, {}, day="d1", mode="auto")
     assert json.load(open(os.path.join(
-        bdir, SPARSE_MANIFEST)))["mode"] == "full"
-    # the full save re-anchored with spilled rows present → still tainted
-    assert not cm.journal.snapshot_ready()
+        bdir, SPARSE_MANIFEST)))["mode"] == "journal"
+    t2 = PassTable(table_cfg(), seed=99)
+    cm2 = CheckpointManager(
+        CheckpointConfig(batch_model_dir=str(tmp_path / "a" / "batch"),
+                         xbox_model_dir=str(tmp_path / "a" / "xbox"),
+                         async_save=False), t2)
+    cm2.load_base("d1")
+    rk, rv = t2.store.state_items()
+    ro = np.argsort(rk, kind="stable")
+    np.testing.assert_array_equal(rk[ro], lk[lo])
+    np.testing.assert_array_equal(rv[ro], lv[lo])
 
 
 def test_journal_rotation_bound_marks_incomplete(tmp_path):
@@ -448,22 +469,31 @@ def test_snapshot_seal_itself_tripping_rotation_refuses(tmp_path):
         j.snapshot_refs()      # ...but sealing would drop segment #1
 
 
-def test_anchor_spill_taint_is_in_band(tmp_path):
-    """Review find: an anchor-time spill taint must land as an EV_TAINT
-    record too, so a raw segment replay (the elastic-rejoin dir read)
-    refuses instead of silently diverging."""
+def test_move_records_replay_tier_moves_exactly(tmp_path):
+    """Round 16: MV_SPILL / MV_FAULT_IN records replay as spill_exact /
+    fault_in_keys on the scratch store — a raw segment replay lands the
+    same rows on the same side of the resident/tier boundary, values
+    intact, with no taint anywhere in the cadence."""
     layout = ValueLayout(D)
     j = jr.TouchedRowJournal(str(tmp_path / "j"), layout, table_cfg())
-    j.anchor_full(["/nonexistent/base.p0000"], spilled_rows=3)
+    j.anchor_full(["/nonexistent/base.p0000"])
     keys = np.arange(1, 33, dtype=np.uint64)
     j.append_rows(keys, np.ones((32, layout.width), np.float32))
+    j.append_move(jr.MV_SPILL, keys[:10])
+    j.append_move(jr.MV_FAULT_IN, keys[:4])
     j.close()
     segs = sorted(os.path.join(str(tmp_path / "j"), p)
                   for p in os.listdir(str(tmp_path / "j"))
                   if p.endswith(".jrnl"))
     st = HostEmbeddingStore(layout, table_cfg())
-    with pytest.raises(jr.JournalIncompleteError):
-        jr.replay_segments(st, table_cfg(), segs)
+    jr.replay_segments(st, table_cfg(), segs)
+    assert len(st) == 26              # 32 - 10 spilled + 4 faulted back
+    assert st.spilled_count() == 6
+    np.testing.assert_array_equal(np.sort(st.spilled_keys()), keys[4:10])
+    got = st.lookup(keys)             # peeks tier rows without moving them
+    np.testing.assert_array_equal(got, np.ones((32, layout.width),
+                                               np.float32))
+    assert st.spilled_count() == 6
 
 
 def test_restart_sweeps_stale_segments(tmp_path):

@@ -195,7 +195,7 @@ def test_age_false_still_ticks_spill_clock(tmp_path):
     store3.tick_spill_age()
     store3.tick_spill_age()
     assert store3.shrink() == 10                # 0+2 > 1 → all swept
-    assert len(store3._spilled) == 0
+    assert store3.spilled_count() == 0
 
 
 def test_run_day_composed_cadence(tmp_path):
@@ -281,13 +281,12 @@ def test_load_ssd_to_mem_promotes_all(tmp_path):
         ds = BoxDataset(feed)
         ds.set_filelist(files)
         tr.train_pass(ds)   # end_pass spills beyond the tiny budget
-        spilled_keys = np.array(sorted(tr.table.store._spilled),
-                                dtype=np.uint64)
+        spilled_keys = np.sort(tr.table.store.spilled_keys())
         assert spilled_keys.size > 0
         tr.table.end_day()  # one day on disk for the spilled rows
         promoted = tr.table.load_ssd_to_mem()
         assert promoted == spilled_keys.size
-        assert len(tr.table.store._spilled) == 0
+        assert tr.table.store.spilled_count() == 0
         # the PROMOTED rows specifically carry the missed day: resident
         # rows were aged in place to 1.0, spilled rows slept at their
         # spill-time value and got the epoch delta added at promotion

@@ -385,9 +385,13 @@ def test_spill_and_fault_in(tmp_path):
     spilled = store.spill(max_resident=60)
     assert spilled == 40
     assert len(store) == 60
-    # faulting back the oldest spilled key recovers its value
+    # the lookup path PEEKs a spilled key: value served off the block,
+    # row stays spilled (round 16 — a peek needs no journal MOVE)
     row = store.lookup(np.array([1], dtype=np.uint64))[0]
     assert row[acc.EMBED_W] == 1.0
+    assert len(store) == 60
+    # promotion is explicit: the BeginFeedPass/LoadSSD2Mem fault-in leg
+    store.fault_in_keys(np.array([1], dtype=np.uint64))
     assert len(store) == 61
     # load everything back (LoadSSD2Mem)
     store.load_spilled()

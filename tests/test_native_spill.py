@@ -72,7 +72,7 @@ def test_native_spill_beyond_dram_budget(tmp_path):
         st.write_back(keys, rows)
         st.spill(max_resident=budget)
         assert len(st) <= budget
-    assert len(st) + len(st._spilled) == total
+    assert len(st) + st.spilled_count() == total
     # every row—resident or spilled—still reads back correctly
     probe = rng.randint(1, total + 1, 1000).astype(np.uint64)
     got = st.lookup(probe)
@@ -80,7 +80,7 @@ def test_native_spill_beyond_dram_budget(tmp_path):
     # LoadSSD2Mem promotes everything
     n = st.load_spilled()
     assert n == total - budget
-    assert len(st) == total and not st._spilled
+    assert len(st) == total and st.spilled_count() == 0
 
 
 def test_native_spill_checkpoint_roundtrip(tmp_path):
@@ -114,7 +114,7 @@ def test_pass_cadence_limiter(tmp_path):
                       optimizer=SparseOptimizerConfig(
                           mf_create_thresholds=0.0, mf_initial_range=1e-3))
     pt = PassTable(cfg, seed=0)
-    if not hasattr(pt.store, "_spill_tag"):
+    if not hasattr(pt.store, "spill"):
         pytest.skip("store lacks spill support")
     keys = np.arange(1, 40_001, dtype=np.uint64)
     pt.begin_feed_pass()
@@ -124,7 +124,7 @@ def test_pass_cadence_limiter(tmp_path):
     pt.end_pass()
     budget_rows = (1 << 20) // row_bytes
     assert len(pt.store) <= budget_rows
-    assert len(pt.store) + len(pt.store._spilled) == 40_000
+    assert len(pt.store) + pt.store.spilled_count() == 40_000
 
 
 def test_spill_file_gc(tmp_path):
@@ -137,7 +137,7 @@ def test_spill_file_gc(tmp_path):
     st.write_back(keys, rows)
     st.spill(max_resident=60)
     ssd = tmp_path / "ssd"
-    assert len(list(ssd.glob("nspill_*.npy"))) == 1
+    assert len(list(ssd.glob("spill_*.part"))) == 1
     st.lookup_or_create(keys[:40])  # fault all 40 back in
-    assert len(list(ssd.glob("nspill_*.npy"))) == 0
-    assert not st._spilled and not st._file_live
+    assert len(list(ssd.glob("spill_*.part"))) == 0
+    assert st.spilled_count() == 0

@@ -43,6 +43,11 @@ HEADLINES: List[Tuple[str, str, bool]] = [
     # round-17 ingest plane: the cold-pass parse→shuffle→pack→train
     # headline (absent pre-round-17 rounds compare as n/a)
     ("ingest_cold_pass_examples_per_sec", "ex/s", True),
+    # round-16 SSD tier (landed after 17 — absent earlier rounds
+    # compare as n/a): the feed-pass promote leg and the lookup-path
+    # cold fault over spilled rows
+    ("ssd_promote_keys_per_sec", "keys/s", True),
+    ("ssd_fault_keys_per_sec", "keys/s", True),
     # round-20 device plane: the compiled step's bytes-accessed per
     # example (Tensor Casting's co-design metric, from the one-time
     # cost-analysis snapshot). LOWER is better — a rise past the

@@ -50,7 +50,9 @@ def build_parser() -> argparse.ArgumentParser:
             "(BX601), lock-order deadlock cycles (BX701), handler "
             "reentrancy (BX801/BX802), and jit entry-point registration "
             "(BX901: bare jax.jit must go through "
-            "obs.device.instrument_jit). Suppress a single "
+            "obs.device.instrument_jit), and tier-1 time-budget "
+            "discipline (BX951: test functions at >= 10M-literal scale "
+            "must carry @pytest.mark.slow). Suppress a single "
             "site with '# boxlint: "
             "disable=BX101' on the line (or the def line for a whole "
             "method); long-lived exceptions belong in the baseline."),

@@ -48,7 +48,7 @@ def cache_path() -> str:
 # passes whose verdict for a file depends only on that file (+ the
 # global suppression machinery); safe to restrict to changed files
 PER_FILE_PASSES = ("purity", "locks", "prints", "spans", "swallow",
-                   "jitreg")
+                   "jitreg", "tierbudget")
 
 
 def collect_sources(paths: Sequence[str], root: Optional[str] = None

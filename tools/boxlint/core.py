@@ -196,7 +196,7 @@ def run_passes(files: Sequence[SourceFile],
                passes: Optional[Iterable[str]] = None) -> List[Violation]:
     from tools.boxlint import (blocking, collectives, flagscheck, jitreg,
                                lockorder, locks, prints, purity, reentrancy,
-                               spans, swallow)
+                               spans, swallow, tierbudget)
     registry = {
         "purity": purity.check,
         "collectives": collectives.check,
@@ -209,6 +209,7 @@ def run_passes(files: Sequence[SourceFile],
         "lockorder": lockorder.check,
         "reentrancy": reentrancy.check,
         "jitreg": jitreg.check,
+        "tierbudget": tierbudget.check,
     }
     names = list(passes) if passes else list(registry)
     out: List[Violation] = []
@@ -220,7 +221,7 @@ def run_passes(files: Sequence[SourceFile],
 
 ALL_PASSES = ("purity", "collectives", "flags", "locks", "prints",
               "spans", "swallow", "blocking", "lockorder", "reentrancy",
-              "jitreg")
+              "jitreg", "tierbudget")
 
 
 def _is_suppressed(files: Sequence[SourceFile], v: Violation) -> bool:

@@ -1,0 +1,90 @@
+"""Pass 12 — tier-1 time-budget discipline for tests (BX951).
+
+The tier-1 suite runs under a hard wall-clock budget (``timeout 870``
+in CI; ROADMAP "no worse than the seed"). The way that budget dies is
+never one big commit — it's a scale test that LOOKS small: a
+100-million-key loop pasted into a default-tier test function. The
+conftest duration tracker warns after the fact; this pass refuses
+before merge.
+
+Flagged: a ``test_*`` function (or method) whose body contains an
+integer literal >= 10_000_000 and which carries no
+``@pytest.mark.slow`` decorator. Ten million of ANYTHING — keys, rows,
+bytes-as-a-loop-bound — does not belong in the budgeted tier; mark it
+``slow`` (the slow-inclusive suite and the TPU windows run it) or
+shrink the constant. Exempt by construction: helpers outside test
+functions (fixtures, module constants); shifted/multiplied forms
+(``1 << 30``, ``100 * M`` — BinOps, not Constants); and exact
+``2**k`` / ``2**k - 1`` values — those are sentinels and masks
+(UINT64_MAX feasigns, impossible-pid markers), not work sizes. The
+pass targets the pasted-scale-literal failure mode, nothing subtler.
+
+Codes:
+  BX951  unmarked test function with a >= 10_000_000 literal — mark
+         @pytest.mark.slow or shrink the scale
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence
+
+from tools.boxlint.core import SourceFile, Violation
+
+_SCALE_FLOOR = 10_000_000
+
+
+def _is_slow_mark(dec: ast.expr) -> bool:
+    """True for pytest.mark.slow / mark.slow (bare or called), and for
+    pytest.mark.parametrize over marks containing slow — any decorator
+    whose attribute path ends in ``slow``."""
+    node = dec
+    if isinstance(node, ast.Call):
+        node = node.func
+    while isinstance(node, ast.Attribute):
+        if node.attr == "slow":
+            return True
+        node = node.value
+    return False
+
+
+def _is_sentinel(v: int) -> bool:
+    """2**k or 2**k - 1: masks and impossible-value markers, not scale."""
+    return (v & (v - 1)) == 0 or (v & (v + 1)) == 0
+
+
+def _big_literal(fn: ast.AST) -> int:
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Constant)
+                and isinstance(node.value, int)
+                and not isinstance(node.value, bool)
+                and node.value >= _SCALE_FLOOR
+                and not _is_sentinel(node.value)):
+            return node.lineno
+    return 0
+
+
+def check(files: Sequence[SourceFile]) -> List[Violation]:
+    out: List[Violation] = []
+    for f in files:
+        base = f.rel.replace("\\", "/").rsplit("/", 1)[-1]
+        if not (base.startswith("test_") or "/tests/" in f.rel
+                or f.rel.startswith("tests/")):
+            continue
+        for node in ast.walk(f.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not node.name.startswith("test_"):
+                continue
+            if any(_is_slow_mark(d) for d in node.decorator_list):
+                continue
+            line = _big_literal(node)
+            if line:
+                out.append(Violation(
+                    f.rel, node.lineno, "BX951",
+                    f"{node.name} holds a >= {_SCALE_FLOOR:,} literal "
+                    f"(line {line}) without @pytest.mark.slow — scale "
+                    "tests run in the slow suite, the budgeted tier-1 "
+                    "run has 870 s for EVERYTHING"))
+    return out

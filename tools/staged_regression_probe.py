@@ -104,6 +104,14 @@ FLOORS = {
     # = ~40% of recorded
     "ckpt_save_keys_per_sec": (4.6e6, 1.8e6),
     "ckpt_load_keys_per_sec": (4.1e6, 1.6e6),
+    # round-16: the SSD spill tier at the ckpt section's shape (256k
+    # rows x width 17, fully spilled): fault = the lookup-path PEEK
+    # (by-file mmap batch read, no residency change), promote = the
+    # BeginFeedPass/LoadSSD2Mem fault-in leg alone (spill off the
+    # clock). Recorded under the load guard on 2026-08-06; floors =
+    # ~40% of recorded
+    "ssd_fault_keys_per_sec": (1.0e6, 400e3),
+    "ssd_promote_keys_per_sec": (1.1e6, 440e3),
 }
 
 # CEILINGS: lower-is-better stages (latencies). Same load-guard
@@ -627,6 +635,60 @@ def section_ckpt(rng, K):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def section_ssd(rng, K):
+    # --- SSD spill tier (round 16) -----------------------------------
+    # the host store's third memory tier at the probe's checkpoint
+    # shape (256k rows x width 17): (a) promote — batched by-file
+    # fault-in of a fully-spilled working set, the leg BeginFeedPass/
+    # LoadSSD2Mem and the PromotePrefetcher pay per pass (spill is done
+    # off the clock each cycle; only fault_in_keys is timed); (b) cold
+    # fault — the lookup-path PEEK over sleeping rows (mmap block read
+    # grouped by file, no residency change), the price of touching a
+    # tier row without promoting it.
+    import shutil
+    import tempfile
+
+    from paddlebox_tpu.config.configs import (SparseOptimizerConfig,
+                                              TableConfig)
+    from paddlebox_tpu.embedding.pass_table import PassTable
+
+    R = 1 << 18
+    root = tempfile.mkdtemp(prefix="pbx_ssdprobe_")
+    try:
+        tcfg = TableConfig(embedx_dim=8, pass_capacity=1 << 10,
+                           ssd_dir=root,
+                           optimizer=SparseOptimizerConfig())
+        t = PassTable(tcfg, seed=1)
+        st = t.store
+        keys = rng.permutation(np.arange(1, R + 1, dtype=np.uint64))
+        vals = rng.rand(R, t.layout.width).astype(np.float32)
+        st.assign(keys, vals)
+        st.spill_exact(keys)
+
+        def fault_rate():
+            # peek: every call re-reads all R rows off the blocks
+            return timed_rate(lambda: st.lookup(keys), R)
+
+        def promote_rate():
+            st.fault_in_keys(keys)               # warm
+            total, reps = 0.0, 0
+            while total < 2.0:
+                st.spill_exact(keys)             # off the clock
+                t0 = time.perf_counter()
+                st.fault_in_keys(keys)
+                total += time.perf_counter() - t0
+                reps += 1
+            return reps * R / total
+
+        rate_f = fault_rate()
+        report("ssd_fault_keys_per_sec", rate_f, remeasure=fault_rate)
+        rate_p = promote_rate()
+        report("ssd_promote_keys_per_sec", rate_p,
+               remeasure=promote_rate)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def section_quality(rng, K):
     # --- quality + ops endpoint (round 18) ---------------------------
     # (a) TaggedQuality.add at the trainers' feed shape: 256k preds/
@@ -800,6 +862,7 @@ SECTIONS = (
     ("push", section_push),
     ("serving", section_serving),
     ("ckpt", section_ckpt),
+    ("ssd", section_ssd),
     ("quality", section_quality),
     ("boxlint", section_boxlint),
     ("device", section_device),
