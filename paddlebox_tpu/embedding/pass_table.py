@@ -66,6 +66,7 @@ def _push_kernel_impl(slab: jnp.ndarray, ids: jnp.ndarray,
 
 
 _push_kernel = instrument_jit(_push_kernel_impl, "table_push",
+                              donate_argnums=(0,),
                               static_argnames=("layout", "conf"))
 
 

@@ -88,6 +88,7 @@ class HeterDenseService:
 
         from paddlebox_tpu.obs.device import instrument_jit
         self._train_step = instrument_jit(train_step, "heter_train_step",
+                                          donate_argnums=(0, 1),
                                           example_count=B)
         self._eval_step = instrument_jit(eval_step, "heter_eval_step",
                                          example_count=B)
@@ -220,7 +221,7 @@ class HeterTrainer:
             # push construction runs on the CPU worker with the canonical
             # layout helper (ops/sparse.py)
             clicks = b.labels[b.segments // self.num_slots]
-            push_rows = np.asarray(build_push_grads(
+            push_rows = np.asarray(build_push_grads(  # boxlint: BX931 ok (CPU-worker push construction: the jnp helper runs on the host backend and the sparse push needs host rows)
                 np.asarray(demb), b.slots, clicks, b.valid))
             self.communicator.push(b.keys[b.valid], push_rows[b.valid])
             losses.append(float(loss))

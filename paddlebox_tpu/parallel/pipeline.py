@@ -216,7 +216,7 @@ class GPipeRunner:
             step, mesh=self.mesh,
             in_specs=(spec_sh, opt_spec, P(), P()),
             out_specs=(spec_sh, opt_spec, P()), check_vma=False),
-            "pipe_step")
+            "pipe_step", donate_argnums=(0, 1))
 
     def train_step(self, x: np.ndarray, y: np.ndarray) -> float:
         cfg = self.cfg

@@ -719,7 +719,7 @@ class ShardedBoxTrainer:
         return instrument_jit(jax.shard_map(
             sync, mesh=self.mesh, in_specs=(spec_sh, spec_sh),
             out_specs=(spec_sh, spec_sh), check_vma=False),
-            "shard_param_sync")
+            "shard_param_sync", donate_argnums=(0, 1))
 
     # -------------------------------------------------------------- batches
     def _put_sharded(self, host_local: np.ndarray, sharding) -> jax.Array:
@@ -975,7 +975,10 @@ class ShardedBoxTrainer:
                 obs_beat("step")
                 self.reporter.note_examples(ex_per_step)
                 self.reporter.maybe_report(self._step_count)
-                losses.append(float(loss))
+                # device scalar: float() here would stall the dispatch
+                # stream every step — np.mean at the pass boundary pays
+                # the D2H once
+                losses.append(loss)
                 if self._param_sync is not None:
                     self._steps_since_sync += 1
                     if self._steps_since_sync >= self.k_step:
@@ -1109,7 +1112,7 @@ class ShardedBoxTrainer:
                     preds = self._eval_step(slabs, self.params, batch)
                     key = (main_task if main_task is not None
                            else list(preds)[0])
-                    main = self._local_rows(preds[key]).reshape(nw, -1)
+                    main = self._local_rows(preds[key]).reshape(nw, -1)  # boxlint: BX931 ok (predict returns host preds; per-batch D2H bounds device memory over the pass)
                     for w, b in enumerate(raw_steps[i]):
                         if i >= real_batches[w]:
                             continue  # wrapped duplicate batch

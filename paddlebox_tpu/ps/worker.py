@@ -261,9 +261,10 @@ class DownpourTrainer:
             self.communicator.push(keys, push_rows[b.valid])
             if self.sync_comm:
                 self.communicator.flush()
-            self.client.push_dense(self.DENSE_TABLE, np.asarray(flat_g))
-            losses.append(float(loss))
-            self._add_metrics(np.asarray(preds), b)
+            self.client.push_dense(self.DENSE_TABLE, np.asarray(flat_g))  # boxlint: BX931 ok (dense push is a host RPC; per-batch D2H is the Downpour contract)
+            # device scalar: np.mean at the pass boundary pays the D2H once
+            losses.append(loss)
+            self._add_metrics(np.asarray(preds), b)  # boxlint: BX931 ok (streaming metrics consume host preds per batch; device-collect mode is the sharded runner's job)
         self.communicator.flush()
         self.pull_dense_worker.refresh()
         return {"loss": float(np.mean(losses)) if losses else 0.0,
@@ -288,7 +289,7 @@ class DownpourTrainer:
         params = self._unravel(jnp.asarray(self.pull_dense_worker.refresh()))
         for b in dataset.split_batches(num_workers=1)[0]:
             slab, batch = self._prepare_batch(b, create=False)
-            preds = np.asarray(self._eval_step(slab, params, batch))
+            preds = np.asarray(self._eval_step(slab, params, batch))  # boxlint: BX931 ok (predict returns host preds; per-batch D2H bounds device memory over the pass)
             preds_all.append(preds[b.ins_valid])
             labels_all.append(b.labels[b.ins_valid])
         if not preds_all:

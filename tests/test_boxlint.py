@@ -657,9 +657,14 @@ def test_boxlint_gate_no_new_violations():
     violations = run_passes(files)
     baseline = load_baseline(os.path.join(REPO, "tools", "boxlint",
                                           "baseline.txt"))
-    new, _stale = diff_against_baseline(violations, baseline)
+    new, stale = diff_against_baseline(violations, baseline)
     assert not new, "NEW boxlint violations:\n" + "\n".join(
         v.render() for v in new)
+    # the ratchet: a baselined finding that no longer fires is stale —
+    # delete it (shrinking baseline.txt is progress) or the suppression
+    # file fossilizes into a list of findings nobody can audit
+    assert not stale, "STALE baseline entries (run --fix-baseline):\n" + \
+        "\n".join(f"{p}: {c} {m}" for p, c, m in stale)
 
 
 # ======================================================= round-19 passes
@@ -1278,3 +1283,449 @@ def test_tierbudget_gate_suite_stays_inside_budget():
     got = run_passes(files, ["tierbudget"])
     assert not got, "scale tests missing @pytest.mark.slow:\n" + "\n".join(
         v.render() for v in got)
+
+
+# ===================================================== device contracts
+# BX911 recompile hazards, BX921 donation contract, BX931 hidden host
+# sync, BX941 replay determinism — the static twins of the PR-15 device
+# plane (recompile sentinel / donation audit / transfer ledger / journal
+# parity), built on the traced-value taint layer (tools/boxlint/taint.py).
+# Per family: one true positive, one near-miss negative, one case that
+# only resolves through the cross-module call/binding closure.
+
+DEVICE_PASSES = ["recompile", "donation", "hostsync", "determinism"]
+
+JIT_PRELUDE = """
+    import numpy as np
+    from paddlebox_tpu.obs.device import instrument_jit
+
+    def _impl(state, batch):
+        return state, batch
+
+"""
+
+
+def lint_device(tmp_path, body, name="runner.py", extra=()):
+    return lint_snippet(tmp_path, JIT_PRELUDE + body, DEVICE_PASSES,
+                        name=name, extra=extra)
+
+
+# ------------------------------------------------------- BX911 recompile
+
+def test_recompile_scalar_literal_at_traced_position(tmp_path):
+    got = lint_device(tmp_path, """
+    step = instrument_jit(_impl, "fx_step", static_argnums=(1,))
+
+    def run(x):
+        return step(0.5, x)
+    """)
+    assert codes(got) == ["BX911"]
+    assert "python scalar literal" in got[0].message
+
+
+def test_recompile_literal_at_static_position_is_fine(tmp_path):
+    # near-miss: the literal lands on a STATIC position — that is
+    # exactly where a python scalar belongs
+    got = lint_device(tmp_path, """
+    step = instrument_jit(_impl, "fx_step", static_argnums=(1,))
+
+    def run(x):
+        return step(x, 4)
+    """)
+    assert got == []
+
+
+def test_recompile_set_ordered_static_key(tmp_path):
+    got = lint_device(tmp_path, """
+    step = instrument_jit(_impl, "fx_step", static_argnums=(1,))
+
+    def run(x, slots):
+        return step(x, tuple({8, 16, 32}))
+    """)
+    assert codes(got) == ["BX911"]
+    assert "sorted" in got[0].message
+
+
+def test_recompile_sorted_static_key_is_fine(tmp_path):
+    got = lint_device(tmp_path, """
+    step = instrument_jit(_impl, "fx_step", static_argnums=(1,))
+
+    def run(x, slots):
+        return step(x, tuple(sorted(slots)))
+    """)
+    assert got == []
+
+
+def test_recompile_mutable_module_state_in_jitted_body(tmp_path):
+    got = lint_device(tmp_path, """
+    SCALE = {"k": 2.0}
+
+    def _scaled(x):
+        return x * SCALE["k"]
+
+    step2 = instrument_jit(_scaled, "fx_scaled")
+    """)
+    assert codes(got) == ["BX911"]
+    assert "SCALE" in got[0].message
+
+
+def test_recompile_entry_bound_through_factory(tmp_path):
+    # closure case: the jit entry reaches the call site through a
+    # factory return, not a direct binding
+    got = lint_device(tmp_path, """
+    def make_step():
+        return instrument_jit(_impl, "fx_step")
+
+    step = make_step()
+
+    def run(x):
+        return step(1.5, x)
+    """)
+    assert codes(got) == ["BX911"]
+
+
+# -------------------------------------------------------- BX921 donation
+
+def test_donation_read_after_donated_call(tmp_path):
+    got = lint_device(tmp_path, """
+    push = instrument_jit(_impl, "fx_push", donate_argnums=(0,))
+
+    def run(slab, ids):
+        out = push(slab, ids)
+        return out, slab.sum()
+    """)
+    assert codes(got) == ["BX921"]
+    assert "`slab`" in got[0].message
+
+
+def test_donation_rebound_in_statement_is_fine(tmp_path):
+    got = lint_device(tmp_path, """
+    push = instrument_jit(_impl, "fx_push", donate_argnums=(0,))
+
+    def run(slab, ids):
+        slab, extra = push(slab, ids)
+        return slab.sum()
+    """)
+    assert got == []
+
+
+def test_donation_setter_convention_counts_as_rebind(tmp_path):
+    # table.set_slab(out) rebinds table.slab for the read that follows
+    got = lint_device(tmp_path, """
+    push = instrument_jit(_impl, "fx_push", donate_argnums=(0,))
+
+    def run(table, ids):
+        out, extra = push(table.slab, ids)
+        table.set_slab(out)
+        return table.slab.sum()
+    """)
+    assert got == []
+
+
+def test_donation_loop_without_rebind(tmp_path):
+    got = lint_device(tmp_path, """
+    push = instrument_jit(_impl, "fx_push", donate_argnums=(0,))
+
+    def run(slab, batches):
+        for b in batches:
+            out = push(slab, b)
+        return out
+    """)
+    assert codes(got) == ["BX921"]
+    assert "loop" in got[0].message
+
+
+def test_donation_step_shape_without_donation(tmp_path):
+    got = lint_device(tmp_path, """
+    step = instrument_jit(_impl, "fx_step")
+
+    class Tr:
+        def run(self, batch):
+            self.params, self.opt_state = step(self.params,
+                                               self.opt_state)
+            return self.params
+    """)
+    assert codes(got) == ["BX921"]
+    assert "declares no donation" in got[0].message
+
+
+def test_donation_partial_donation_is_a_reviewed_choice(tmp_path):
+    # near-miss: an entry that donates SOME positions already made the
+    # call — the step-shape heuristic stays quiet
+    got = lint_device(tmp_path, """
+    step = instrument_jit(_impl, "fx_step", donate_argnums=(1,))
+
+    class Tr:
+        def run(self, batch):
+            self.params, self.opt_state = step(batch, self.opt_state)
+            return self.params
+    """)
+    assert got == []
+
+
+def test_donation_entry_resolved_cross_module(tmp_path):
+    # closure case: the entry is constructed in another module and
+    # imported by name
+    mk = tmp_path / "mk.py"
+    mk.write_text(textwrap.dedent(JIT_PRELUDE + """
+    push = instrument_jit(_impl, "fx_push", donate_argnums=(0,))
+    """))
+    got = lint_snippet(tmp_path, """
+        from mk import push
+
+        def run(slab, ids):
+            out = push(slab, ids)
+            return out, slab.sum()
+    """, DEVICE_PASSES, name="caller.py", extra=[mk])
+    assert codes(got) == ["BX921"]
+
+
+# -------------------------------------------------------- BX931 hostsync
+
+def test_hostsync_float_in_loop(tmp_path):
+    got = lint_device(tmp_path, """
+    step = instrument_jit(_impl, "fx_step", donate_argnums=(0,))
+
+    def train(state, batches):
+        losses = []
+        for b in batches:
+            state, loss = step(state, b)
+            losses.append(float(loss))
+        return losses
+    """)
+    assert codes(got) == ["BX931"]
+    assert "loop" in got[0].message
+
+
+def test_hostsync_boundary_conversion_is_fine(tmp_path):
+    # near-miss: same float(), but AFTER the loop — the pass-boundary
+    # sync is the blessed place
+    got = lint_device(tmp_path, """
+    step = instrument_jit(_impl, "fx_step", donate_argnums=(0,))
+
+    def train(state, batches):
+        loss = None
+        for b in batches:
+            state, loss = step(state, b)
+        return float(loss)
+    """)
+    assert got == []
+
+
+def test_hostsync_under_lock(tmp_path):
+    got = lint_device(tmp_path, """
+    import threading
+    step = instrument_jit(_impl, "fx_step", donate_argnums=(0,))
+    _lock = threading.Lock()
+
+    def serve(state, b):
+        with _lock:
+            state, loss = step(state, b)
+            return float(loss)
+    """)
+    assert codes(got) == ["BX931"]
+    assert "lock" in got[0].message
+
+
+def test_hostsync_through_helper_closure(tmp_path):
+    # closure case: the sync lives in a helper in ANOTHER module; the
+    # finding lands at the loop-resident call site with a witness chain
+    helper = tmp_path / "hostutil.py"
+    helper.write_text(textwrap.dedent("""
+        import numpy as np
+
+        def to_host(x):
+            return np.asarray(x)
+    """))
+    got = lint_snippet(tmp_path, JIT_PRELUDE + """
+    from hostutil import to_host
+
+    step = instrument_jit(_impl, "fx_step", donate_argnums=(0,))
+
+    def train(state, batches):
+        out = []
+        for b in batches:
+            state, preds = step(state, b)
+            out.append(to_host(preds))
+        return out
+    """, DEVICE_PASSES, name="runner.py", extra=[helper])
+    assert codes(got) == ["BX931"]
+    assert "via to_host" in got[0].message
+
+
+def test_hostsync_reasoned_waiver_suppresses(tmp_path):
+    got = lint_device(tmp_path, """
+    step = instrument_jit(_impl, "fx_step", donate_argnums=(0,))
+
+    def train(state, batches):
+        losses = []
+        for b in batches:
+            state, loss = step(state, b)
+            losses.append(float(loss))  # boxlint: BX931 ok (per-step nan guard)
+        return losses
+    """)
+    assert got == []
+
+
+def test_hostsync_bare_waiver_is_bx932_and_does_not_suppress(tmp_path):
+    got = lint_device(tmp_path, """
+    step = instrument_jit(_impl, "fx_step", donate_argnums=(0,))
+
+    def train(state, batches):
+        losses = []
+        for b in batches:
+            state, loss = step(state, b)
+            losses.append(float(loss))  # boxlint: BX931 ok
+        return losses
+    """)
+    assert sorted(codes(got)) == ["BX931", "BX932"]
+
+
+# ----------------------------------------------------- BX941 determinism
+
+def test_determinism_accumulation_over_set(tmp_path):
+    got = lint_snippet(tmp_path, """
+        def total(keys):
+            t = 0.0
+            for k in set(keys):
+                t += k
+            return t
+    """, ["determinism"])
+    assert codes(got) == ["BX941"]
+    assert "sorted" in got[0].message
+
+
+def test_determinism_sorted_iteration_is_fine(tmp_path):
+    got = lint_snippet(tmp_path, """
+        def total(keys):
+            t = 0.0
+            for k in sorted(set(keys)):
+                t += k
+            return t
+    """, ["determinism"])
+    assert got == []
+
+
+def test_determinism_setish_through_helper(tmp_path):
+    # closure case: the set is built by a helper in another module
+    src = tmp_path / "picksrc.py"
+    src.write_text(textwrap.dedent("""
+        def pick(xs):
+            return {x for x in xs if x > 0}
+    """))
+    got = lint_snippet(tmp_path, """
+        from picksrc import pick
+
+        def total(xs):
+            t = 0.0
+            for k in pick(xs):
+                t += k
+            return t
+    """, ["determinism"], name="acc.py", extra=[src])
+    assert codes(got) == ["BX941"]
+
+
+def test_determinism_global_rng_draw(tmp_path):
+    got = lint_snippet(tmp_path, """
+        import numpy as np
+
+        def jitter():
+            return np.random.uniform(0, 1)
+    """, ["determinism"])
+    assert codes(got) == ["BX941"]
+    assert "seeded" in got[0].message
+
+
+def test_determinism_seeded_generator_is_fine(tmp_path):
+    got = lint_snippet(tmp_path, """
+        import numpy as np
+
+        def jitter(rng):
+            return rng.uniform(0, 1)
+    """, ["determinism"])
+    assert got == []
+
+
+def test_determinism_time_into_journal(tmp_path):
+    got = lint_snippet(tmp_path, """
+        import time
+
+        def record(journal, rows):
+            stamp = time.time()
+            journal.append_rows(rows, stamp)
+    """, ["determinism"])
+    assert codes(got) == ["BX941"]
+    assert "clock" in got[0].message
+
+
+# ------------------------------------------------- CLI / cache / changed
+
+def run_cli_at(args, cwd):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    return subprocess.run([sys.executable, "-m", "tools.boxlint"] + args,
+                          cwd=cwd, capture_output=True, text=True, env=env)
+
+
+def test_check_baseline_fails_on_fossil(tmp_path):
+    """A baseline entry whose finding no longer fires is a fossil:
+    --check-baseline turns it into exit 1 (the tests gate runs the same
+    check via diff_against_baseline)."""
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(format_baseline([
+        Violation("clean.py", 3, "BX501", "ghost print from a past age")]))
+    ok = run_cli_at(["--baseline", str(bl), "clean.py"], cwd=str(tmp_path))
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    r = run_cli_at(["--baseline", str(bl), "--check-baseline", "clean.py"],
+                   cwd=str(tmp_path))
+    assert r.returncode == 1
+    assert "stale" in r.stderr
+
+
+def test_list_rules_prints_inventory():
+    r = run_cli(["--list-rules"])
+    assert r.returncode == 0
+    for code in ("BX101", "BX601", "BX911", "BX921", "BX931", "BX941"):
+        assert code in r.stdout
+
+
+def test_device_contracts_artifact(tmp_path):
+    from tools.boxlint.taint import render_inventory
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent(JIT_PRELUDE + """
+    step = instrument_jit(_impl, "fx_step", donate_argnums=(0,),
+                          static_argnames=("layout",))
+
+    def train(state, b):
+        return float(step(state, b)[0])  # boxlint: BX931 ok (boundary)
+    """))
+    files, errors = load_tree([str(p)], root=str(tmp_path))
+    assert not errors
+    txt = render_inventory(files)
+    assert "fx_step" in txt and "donate=(0,)" in txt
+    assert "boundary" in txt                       # the reasoned waiver
+    assert "# 1 jit entries (1 donating, 1 static-keyed)" in txt
+
+
+def test_cache_digest_tracks_pass_versions(tmp_path, monkeypatch):
+    from tools.boxlint import cache as cachemod
+    from tools.boxlint import core
+    src = [(str(tmp_path / "a.py"), "a.py", "x = 1\n")]
+    d1 = cachemod.tree_digest(src, ["purity"])
+    monkeypatch.setitem(core.PASS_VERSIONS, "purity",
+                        core.PASS_VERSIONS["purity"] + 1)
+    d2 = cachemod.tree_digest(src, ["purity"])
+    assert d1 != d2
+
+
+def test_changed_reverse_import_closure(tmp_path):
+    from tools.boxlint.callgraph import reverse_dependents
+    (tmp_path / "base.py").write_text("X = 1\n")
+    (tmp_path / "mid.py").write_text("import base\nY = base.X\n")
+    (tmp_path / "top.py").write_text("from mid import Y\nZ = Y\n")
+    (tmp_path / "lone.py").write_text("W = 3\n")
+    files, errors = load_tree([str(tmp_path)], root=str(tmp_path))
+    assert not errors
+    got = reverse_dependents(files, {"base.py"})
+    assert {"base.py", "mid.py", "top.py"} <= got
+    assert "lone.py" not in got

@@ -15,6 +15,9 @@ Typical invocations:
     python -m tools.boxlint --changed paddlebox_tpu/ tools/   # edit loop
     python -m tools.boxlint --lock-graph paddlebox_tpu/      # artifact
     python -m tools.boxlint --suggest-guards paddlebox_tpu/  # artifact
+    python -m tools.boxlint --device-contracts paddlebox_tpu/ tools/
+    python -m tools.boxlint --check-baseline paddlebox_tpu/ tools/
+    python -m tools.boxlint --list-rules
 """
 
 from __future__ import annotations
@@ -25,8 +28,8 @@ import sys
 from typing import List
 
 from tools.boxlint.core import (
-    ALL_PASSES, diff_against_baseline, format_baseline, load_baseline,
-    load_tree, run_passes,
+    ALL_PASSES, RULES, diff_against_baseline, format_baseline,
+    load_baseline, load_tree, run_passes,
 )
 from tools.boxlint import cache as cachemod
 
@@ -34,6 +37,7 @@ _SELF_DIR = os.path.dirname(os.path.abspath(__file__))
 _DEFAULT_BASELINE = os.path.join(_SELF_DIR, "baseline.txt")
 _DEFAULT_LOCK_GRAPH = os.path.join(_SELF_DIR, "lock_graph.txt")
 _DEFAULT_GUARDS = os.path.join(_SELF_DIR, "guard_suggestions.txt")
+_DEFAULT_CONTRACTS = os.path.join(_SELF_DIR, "device_contracts.txt")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -50,9 +54,14 @@ def build_parser() -> argparse.ArgumentParser:
             "(BX601), lock-order deadlock cycles (BX701), handler "
             "reentrancy (BX801/BX802), and jit entry-point registration "
             "(BX901: bare jax.jit must go through "
-            "obs.device.instrument_jit), and tier-1 time-budget "
+            "obs.device.instrument_jit), tier-1 time-budget "
             "discipline (BX951: test functions at >= 10M-literal scale "
-            "must carry @pytest.mark.slow). Suppress a single "
+            "must carry @pytest.mark.slow), and the device-contract "
+            "suite on the traced-value taint layer: recompile hazards "
+            "(BX911), donation contract (BX921), hidden host syncs in "
+            "loops/locks/handlers (BX931, reasoned waivers via "
+            "'# boxlint: BX931 ok (reason)'; reasonless waivers are "
+            "BX932), and replay determinism (BX941). Suppress a single "
             "site with '# boxlint: "
             "disable=BX101' on the line (or the def line for a whole "
             "method); long-lived exceptions belong in the baseline."),
@@ -62,9 +71,10 @@ def build_parser() -> argparse.ArgumentParser:
             "error. Regenerate the baseline after deliberate changes "
             "with --fix-baseline (review the diff — shrinking is "
             "progress, growth needs a reason)."))
-    p.add_argument("paths", nargs="+", metavar="PATH",
+    p.add_argument("paths", nargs="*", metavar="PATH",
                    help="files or directories to lint (e.g. "
-                        "paddlebox_tpu/ tools/)")
+                        "paddlebox_tpu/ tools/); optional with "
+                        "--list-rules")
     p.add_argument("--baseline", default=_DEFAULT_BASELINE, metavar="FILE",
                    help="baseline file of tolerated pre-existing "
                         "violations (default: tools/boxlint/baseline.txt)")
@@ -79,13 +89,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fail-on-stale", action="store_true",
                    help="also exit 1 when baseline entries no longer "
                         "match any violation (ratchet mode)")
+    p.add_argument("--check-baseline", dest="fail_on_stale",
+                   action="store_true",
+                   help="synonym for --fail-on-stale: a baselined "
+                        "finding that no longer fires is stale and "
+                        "fails the run, so the suppression file cannot "
+                        "fossilize")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule inventory (code, pass, "
+                        "one-line summary) and exit 0")
     p.add_argument("--changed", action="store_true",
-                   help="incremental edit-loop mode: lint only files "
+                   help="incremental edit-loop mode: lint the files "
                         "changed vs HEAD (or vs `git merge-base HEAD "
-                        "--changed-base REF`) plus untracked .py; "
-                        "cross-file passes still read the full tree, "
-                        "reporting is filtered to the changed files. "
-                        "The tier-1 gate always runs full-tree")
+                        "--changed-base REF`) plus untracked .py, PLUS "
+                        "their reverse import closure (modules that "
+                        "transitively import a changed file — an edit "
+                        "can break a caller's invariant); cross-file "
+                        "passes still read the full tree, reporting is "
+                        "filtered to that set. The tier-1 gate always "
+                        "runs full-tree")
     p.add_argument("--changed-base", default=None, metavar="REF",
                    help="base ref for --changed (e.g. origin/main); "
                         "default: HEAD (uncommitted edits only)")
@@ -102,9 +124,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "attrs touched >=90%% under one lock to "
                         "--artifact-out (default: "
                         "tools/boxlint/guard_suggestions.txt) and exit 0")
+    p.add_argument("--device-contracts", action="store_true",
+                   help="write the jit device-contract inventory (every "
+                        "entry with donation/static keying + every "
+                        "reasoned waiver, with pinned counts) to "
+                        "--artifact-out (default: "
+                        "tools/boxlint/device_contracts.txt) and exit 0")
     p.add_argument("--artifact-out", default=None, metavar="PATH",
                    help="override the output path for --lock-graph / "
-                        "--suggest-guards")
+                        "--suggest-guards / --device-contracts")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="suppress the summary line; print violations only")
     return p
@@ -119,8 +147,18 @@ def main(argv: List[str] | None = None) -> int:
               f"(valid: {', '.join(ALL_PASSES)})", file=sys.stderr)
         return 2
 
+    if args.list_rules:
+        width = max(len(code) for code, _, _ in RULES)
+        pwidth = max(len(p) for _, p, _ in RULES)
+        for code, pass_name, summary in RULES:
+            print(f"{code:<{width}}  {pass_name:<{pwidth}}  {summary}")
+        return 0
+    if not args.paths:
+        print("boxlint: at least one PATH is required", file=sys.stderr)
+        return 2
+
     # --------------------------------------------------- artifact modes
-    if args.lock_graph or args.suggest_guards:
+    if args.lock_graph or args.suggest_guards or args.device_contracts:
         try:
             files, parse_errors = load_tree(args.paths)
             if args.lock_graph:
@@ -137,6 +175,14 @@ def main(argv: List[str] | None = None) -> int:
                     fh.write(guards.render_report(files))
                 if not args.quiet:
                     print(f"boxlint: guard suggestions -> {out_path}")
+            if args.device_contracts:
+                from tools.boxlint import taint
+                out_path = args.artifact_out or _DEFAULT_CONTRACTS
+                with open(out_path, "w", encoding="utf-8") as fh:
+                    fh.write(taint.render_inventory(files))
+                if not args.quiet:
+                    print(f"boxlint: device-contract inventory -> "
+                          f"{out_path}")
         except Exception as e:
             print(f"boxlint: internal error: {e.__class__.__name__}: {e}",
                   file=sys.stderr)
@@ -168,6 +214,13 @@ def main(argv: List[str] | None = None) -> int:
         if violations is None:
             files, parse_errors = load_tree(args.paths, sources=sources)
             if changed is not None:
+                # expand with the reverse import closure: an edit can
+                # invalidate an invariant in a file that IMPORTS the
+                # edited one (a deleted flag, a changed jit contract),
+                # so dependents re-lint too
+                from tools.boxlint import callgraph
+                changed = changed | callgraph.reverse_dependents(
+                    files, changed)
                 per_file = [p for p in passes
                             if p in cachemod.PER_FILE_PASSES]
                 cross = [p for p in passes

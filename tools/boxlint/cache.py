@@ -33,7 +33,7 @@ import os
 import subprocess
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from tools.boxlint.core import Violation
+from tools.boxlint.core import PASS_VERSIONS, Violation
 
 _SELF_DIR = os.path.dirname(os.path.abspath(__file__))
 CACHE_PATH = os.path.join(_SELF_DIR, ".cache.json")
@@ -101,6 +101,13 @@ def tree_digest(sources: Sequence[Tuple[str, str, str]],
     h = hashlib.sha256()
     _self_digest(h)
     h.update(("|".join(passes)).encode())
+    # per-pass rule-version stamps (core.PASS_VERSIONS): the self-digest
+    # covers *this checkout's* sources, but a cache file that outlives
+    # them — BOXLINT_CACHE shared across checkouts, or a verdict written
+    # before a pass was upgraded — must miss when any selected pass's
+    # ruleset version moved, or the new rule silently never runs
+    h.update(("|".join(f"{p}={PASS_VERSIONS.get(p, 0)}"
+                       for p in sorted(passes))).encode())
     for _abs, rel, text in sources:
         h.update(rel.encode())
         h.update(hashlib.sha256(

@@ -575,6 +575,41 @@ class PackageIndex:
         return summary
 
 
+def reverse_dependents(files: Sequence[SourceFile],
+                       changed_rels: Set[str]) -> Set[str]:
+    """Repo-relative paths of every module that (transitively) imports
+    one of ``changed_rels`` — the reverse import closure the --changed
+    mode lints alongside the edits themselves, so an edit that breaks a
+    CALLER's invariant (a deleted helper a jit factory still wraps, a
+    lock a caller still nests) is reported in the sub-second loop, not
+    first by the full-tree gate."""
+    idx = get_index(files)
+    rel_by_mod = {m: sf.rel for m, sf in idx.modules.items()}
+    rev: Dict[str, Set[str]] = {}
+    for mod, imports in idx.imports.items():
+        for _local, target in imports.items():
+            dep = None
+            if target in idx.modules:
+                dep = target
+            else:
+                head = target.rpartition(".")[0]
+                if head in idx.modules:
+                    dep = head
+            if dep is not None and dep != mod:
+                rev.setdefault(dep, set()).add(mod)
+    changed_mods = [m for m, rel in rel_by_mod.items()
+                    if rel in changed_rels]
+    out: Set[str] = set(changed_mods)
+    work = list(changed_mods)
+    while work:
+        cur = work.pop()
+        for m in rev.get(cur, ()):
+            if m not in out:
+                out.add(m)
+                work.append(m)
+    return {rel_by_mod[m] for m in out}
+
+
 # ------------------------------------------------------------------ memo
 
 _CACHE: List[Tuple[List[SourceFile], PackageIndex]] = []

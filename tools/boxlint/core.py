@@ -18,6 +18,13 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 # ``# boxlint: disable=BX101,BX401`` or ``# boxlint: disable`` (all codes)
 _SUPPRESS_RE = re.compile(
     r"#\s*boxlint:\s*disable(?:\s*=\s*(?P<codes>[A-Z0-9,\s]+))?")
+# ``boxlint: BXnnn ok (reason)`` comment — the device-contract waiver
+# form: the reason string is MANDATORY (a reasonless waiver is itself a
+# finding, BX932), so every tolerated host sync / contract exception
+# carries its justification at the site
+WAIVER_RE = re.compile(
+    r"#\s*boxlint:\s*(?P<code>BX\d+)\s+ok\b"
+    r"(?:\s*\((?P<reason>[^)]*)\))?")
 # ``# guarded-by: <lock-attr>`` trailing annotation (pass 4)
 GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*(?P<lock>[A-Za-z_][\w]*)")
 
@@ -54,6 +61,10 @@ class SourceFile:
         # line -> raw comment text (every comment; BX503 reads these as
         # swallow-site rationales)
         self.comments: Dict[int, str] = {}
+        # line -> (code, reason) for reasoned `# boxlint: BXnnn ok (...)`
+        self.waivers: Dict[int, Tuple[str, str]] = {}
+        # (line, code) for waivers WITHOUT a reason string — BX932 material
+        self.bare_waivers: List[Tuple[int, str]] = []
         self._scan_comments()
         # lines covered by a def/class-level suppression
         self._block_suppress: List[Tuple[int, int, Optional[Set[str]]]] = []
@@ -72,6 +83,20 @@ class SourceFile:
                     self.suppress[tok.start[0]] = (
                         {c.strip() for c in codes.split(",") if c.strip()}
                         if codes else None)
+                w = WAIVER_RE.search(tok.string)
+                if w:
+                    reason = (w.group("reason") or "").strip()
+                    if reason:
+                        self.waivers[tok.start[0]] = (w.group("code"),
+                                                      reason)
+                        prev = self.suppress.get(tok.start[0], set())
+                        if prev is not None:
+                            self.suppress[tok.start[0]] = (
+                                set(prev) | {w.group("code")})
+                    else:
+                        # reasonless waiver: does NOT suppress — it flags
+                        self.bare_waivers.append(
+                            (tok.start[0], w.group("code")))
                 g = GUARDED_BY_RE.search(tok.string)
                 if g:
                     self.guarded_by[tok.start[0]] = g.group("lock")
@@ -194,8 +219,9 @@ def format_baseline(violations: Sequence[Violation]) -> str:
 
 def run_passes(files: Sequence[SourceFile],
                passes: Optional[Iterable[str]] = None) -> List[Violation]:
-    from tools.boxlint import (blocking, collectives, flagscheck, jitreg,
-                               lockorder, locks, prints, purity, reentrancy,
+    from tools.boxlint import (blocking, collectives, determinism, donation,
+                               flagscheck, hostsync, jitreg, lockorder,
+                               locks, prints, purity, recompile, reentrancy,
                                spans, swallow, tierbudget)
     registry = {
         "purity": purity.check,
@@ -210,6 +236,10 @@ def run_passes(files: Sequence[SourceFile],
         "reentrancy": reentrancy.check,
         "jitreg": jitreg.check,
         "tierbudget": tierbudget.check,
+        "recompile": recompile.check,
+        "donation": donation.check,
+        "hostsync": hostsync.check,
+        "determinism": determinism.check,
     }
     names = list(passes) if passes else list(registry)
     out: List[Violation] = []
@@ -221,7 +251,60 @@ def run_passes(files: Sequence[SourceFile],
 
 ALL_PASSES = ("purity", "collectives", "flags", "locks", "prints",
               "spans", "swallow", "blocking", "lockorder", "reentrancy",
-              "jitreg", "tierbudget")
+              "jitreg", "tierbudget", "recompile", "donation", "hostsync",
+              "determinism")
+
+# Per-pass rule versions, folded into the result-cache digest
+# (cache.tree_digest): bump a pass's version whenever its RULES change
+# meaning (new code, changed detection) so persistent caches keyed on an
+# older ruleset — e.g. a cache file shared across checkouts via
+# BOXLINT_CACHE — can never replay a stale verdict for the new rules.
+# (The digest also hashes boxlint's own sources; the stamp covers the
+# cases content-hashing cannot: caches that outlive the sources that
+# wrote them.)
+PASS_VERSIONS: Dict[str, int] = {name: 1 for name in ALL_PASSES}
+
+# code -> (pass name, one-line summary): the --list-rules inventory and
+# the documentation source of truth for what each family checks
+RULES: List[Tuple[str, str, str]] = [
+    ("BX000", "-", "unparseable file (I/O, encoding or syntax error)"),
+    ("BX101", "purity", "host sync / side effect inside a traced body"),
+    ("BX102", "purity", "python-scalar cast of a traced value"),
+    ("BX103", "purity", "numpy op on a traced value (breaks tracing)"),
+    ("BX104", "purity", "value-dependent output shape inside jit"),
+    ("BX105", "purity", "boolean-mask indexing inside jit"),
+    ("BX201", "collectives", "collective axis name outside the registry"),
+    ("BX202", "collectives", "collective with no axis argument at all"),
+    ("BX301", "flags", "flag read without a registry declaration"),
+    ("BX302", "flags", "flag declared but never read"),
+    ("BX303", "flags", "define_flag with an empty help string"),
+    ("BX304", "flags", "duplicate flag name / env-name collision"),
+    ("BX305", "flags", "define_flag/get_flag with a non-literal name"),
+    ("BX401", "locks", "guarded-by attr touched without its lock"),
+    ("BX402", "locks", "guarded-by names a lock the class never assigns"),
+    ("BX403", "locks", "threaded class with mutable shared attrs and no "
+                       "guarded-by map"),
+    ("BX501", "prints", "bare print in library code (use obs logging)"),
+    ("BX502", "spans", "span() result discarded (records nothing)"),
+    ("BX503", "swallow", "silent exception swallow without rationale"),
+    ("BX601", "blocking", "blocking sink reachable while holding a lock"),
+    ("BX701", "lockorder", "cycle in the lock-acquisition graph"),
+    ("BX801", "reentrancy", "non-reentrant lock on a handler path"),
+    ("BX802", "reentrancy", "unbounded blocking sink on a handler path"),
+    ("BX901", "jitreg", "bare jax.jit in library code (instrument_jit)"),
+    ("BX911", "recompile", "recompile hazard at a jit entry call site "
+                           "(runtime twin: recompile sentinel)"),
+    ("BX921", "donation", "donation contract breach at a jit entry "
+                          "(runtime twin: donation audit)"),
+    ("BX931", "hostsync", "hidden D2H sync on a device value in a "
+                          "loop/lock/handler (runtime twin: transfer "
+                          "ledger)"),
+    ("BX932", "hostsync", "boxlint waiver without a reason string"),
+    ("BX941", "determinism", "replay-nondeterministic dataflow (runtime "
+                             "twin: journal parity)"),
+    ("BX951", "tierbudget", "10M-literal-scale test without "
+                            "@pytest.mark.slow"),
+]
 
 
 def _is_suppressed(files: Sequence[SourceFile], v: Violation) -> bool:

@@ -1,0 +1,226 @@
+"""Pass — replay-determinism hazards (BX941).
+
+The static twin of the journal bit-parity contract: PR 16's spill path
+fought to keep ``replay_segments`` byte-identical to the live run, and
+the device plane's journal parity checks only catch a divergence AFTER a
+replay mismatches. This pass pins the two classic nondeterminism sources
+at the line:
+
+  * **numeric accumulation ordered by set iteration** — ``for k in
+    set(...): total += ...`` (or ``sum(<set>)``): float addition is not
+    associative and set order varies per process (hash randomization),
+    so the accumulated value — and any journaled state derived from it —
+    differs between the run and its replay; iterate ``sorted(...)``.
+    Sets reaching the loop through a helper in another module resolve
+    via the call closure (a function whose return value is set-ish
+    marks its callers' loop iterables).
+  * **wall-clock / global-RNG values** — a module-global
+    ``np.random.*`` draw is unseedable per-run (the repo's convention is
+    an explicitly seeded ``np.random.RandomState``/``Generator``
+    threaded from config, which stays clean), and ``time.time()``-
+    derived values flowing into the journaled embedding-state mutators
+    (``append_rows``/``append_move``/``append_event``/``anchor_full``/
+    ``rebase``) replay differently by construction.
+
+Codes:
+  BX941  replay-nondeterministic dataflow
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from tools.boxlint.core import SourceFile, Violation
+from tools.boxlint.callgraph import FuncNode, get_index
+from tools.boxlint.purity import dotted
+
+_EXEMPT_PARTS = {"tools", "tests", "examples"}
+
+# journaled embedding-state mutators (train/journal.py EmbJournal API):
+# a time-derived argument here replays differently by construction
+_JOURNAL_MUTATORS = {"append_rows", "append_move", "append_event",
+                     "anchor_full", "rebase", "replay_record"}
+
+# global-RNG draws on the np.random module itself (seeded RandomState /
+# default_rng instances are the blessed, replayable form)
+_RNG_DRAWS = {"rand", "randn", "randint", "random", "random_sample",
+              "normal", "uniform", "choice", "shuffle", "permutation",
+              "bytes", "standard_normal"}
+
+_TIME_CALLS = {"time.time", "time.time_ns", "time.monotonic",
+               "datetime.now", "datetime.utcnow"}
+
+
+def _exempt(rel: str) -> bool:
+    return bool(_EXEMPT_PARTS.intersection(rel.split("/")[:-1]))
+
+
+def check(files: Sequence[SourceFile]) -> List[Violation]:
+    index = get_index(files)
+    # functions whose return value is set-ish: callers' loop iterables
+    # resolve through this (the closure-crossing form)
+    setish_fns: Set[int] = set()
+    for node in index.nodes:
+        for sub in ast.walk(node.fn):
+            if isinstance(sub, ast.Return) and sub.value is not None \
+                    and _setish(sub.value, {}, None, index):
+                setish_fns.add(id(node.fn))
+                break
+    out: List[Violation] = []
+    for node in index.nodes:
+        if _exempt(node.file.rel):
+            continue
+        own = index._own_statement_ids(node)
+        local_sets = _local_setish(node, own, setish_fns, index)
+        time_names = _time_tainted(node, own)
+        np_names = _np_aliases(node.file)
+        for sub in ast.walk(node.fn):
+            if id(sub) not in own:
+                continue
+            if isinstance(sub, ast.For) and _setish(
+                    sub.iter, local_sets, node, index, setish_fns):
+                acc = _accumulation_in(sub, own)
+                if acc is not None:
+                    out.append(Violation(
+                        node.file.rel, sub.lineno, "BX941",
+                        f"numeric accumulation at line {acc} ordered by "
+                        f"set iteration in `{node.qual}` — float "
+                        f"addition is not associative and set order "
+                        f"varies per process, so a replay accumulates a "
+                        f"different value; iterate sorted(...)"))
+            elif isinstance(sub, ast.Call):
+                d = dotted(sub.func) or ""
+                tail = d.split(".")[-1]
+                if tail == "sum" and len(sub.args) == 1 and _setish(
+                        sub.args[0], local_sets, node, index, setish_fns):
+                    out.append(Violation(
+                        node.file.rel, sub.lineno, "BX941",
+                        f"sum() over a set in `{node.qual}` — the "
+                        f"accumulation order varies per process; "
+                        f"sum(sorted(...)) makes the replay "
+                        f"bit-identical"))
+                parts = d.split(".")
+                if len(parts) == 3 and parts[0] in np_names \
+                        and parts[1] == "random" and parts[2] in _RNG_DRAWS:
+                    out.append(Violation(
+                        node.file.rel, sub.lineno, "BX941",
+                        f"module-global {parts[0]}.random.{parts[2]} in "
+                        f"`{node.qual}` — unseedable per-run, so any "
+                        f"journaled state it feeds breaks replay "
+                        f"bit-parity; use a seeded np.random.RandomState"
+                        f"/Generator threaded from config"))
+                if isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr in _JOURNAL_MUTATORS:
+                    for arg in list(sub.args) + [k.value for k in
+                                                 sub.keywords]:
+                        if _time_derived(arg, time_names):
+                            out.append(Violation(
+                                node.file.rel, sub.lineno, "BX941",
+                                f"time-derived value flows into "
+                                f"journaled state "
+                                f"(.{sub.func.attr}) in `{node.qual}` — "
+                                f"a replay re-executes with a different "
+                                f"clock; derive the value from journaled "
+                                f"inputs instead"))
+                            break
+    return out
+
+
+def _np_aliases(f: SourceFile) -> Set[str]:
+    names = {"np", "numpy"}
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    names.add(a.asname or "numpy")
+    return names
+
+
+def _setish(expr: Optional[ast.AST], local_sets: Dict[str, bool],
+            node: Optional[FuncNode], index,
+            setish_fns: Optional[Set[int]] = None) -> bool:
+    if expr is None:
+        return False
+    if isinstance(expr, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(expr, ast.Name):
+        return bool(local_sets.get(expr.id))
+    if isinstance(expr, ast.BinOp) and isinstance(
+            expr.op, (ast.BitAnd, ast.BitOr, ast.BitXor, ast.Sub)):
+        return (_setish(expr.left, local_sets, node, index, setish_fns)
+                or _setish(expr.right, local_sets, node, index,
+                           setish_fns))
+    if isinstance(expr, ast.Call):
+        tail = (dotted(expr.func) or "").split(".")[-1]
+        if tail in ("set", "frozenset"):
+            return True
+        if tail == "sorted":
+            return False        # canonical order: the fix
+        if tail in ("intersection", "union", "difference",
+                    "symmetric_difference") and isinstance(
+                expr.func, ast.Attribute):
+            return _setish(expr.func.value, local_sets, node, index,
+                           setish_fns)
+        if setish_fns and node is not None:
+            for callee in node.call_map.get(id(expr), []):
+                if id(callee.fn) in setish_fns:
+                    return True
+    return False
+
+
+def _local_setish(node: FuncNode, own: Set[int], setish_fns: Set[int],
+                  index) -> Dict[str, bool]:
+    out: Dict[str, bool] = {}
+    for _ in range(2):
+        for sub in ast.walk(node.fn):
+            if id(sub) not in own or not isinstance(sub, ast.Assign):
+                continue
+            if len(sub.targets) == 1 and isinstance(sub.targets[0],
+                                                    ast.Name):
+                if _setish(sub.value, out, node, index, setish_fns):
+                    out[sub.targets[0].id] = True
+                elif sub.targets[0].id in out:
+                    out.pop(sub.targets[0].id, None)  # rebound stably
+    return out
+
+
+def _time_tainted(node: FuncNode, own: Set[int]) -> Set[str]:
+    """Local names assigned (possibly through arithmetic) from wall-clock
+    calls, two-sweep."""
+    names: Set[str] = set()
+    for _ in range(2):
+        for sub in ast.walk(node.fn):
+            if id(sub) not in own or not isinstance(sub, ast.Assign):
+                continue
+            if _time_derived(sub.value, names):
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+    return names
+
+
+def _time_derived(expr: Optional[ast.AST], names: Set[str]) -> bool:
+    if expr is None:
+        return False
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call) and (dotted(sub.func) or "") \
+                in _TIME_CALLS:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in names:
+            return True
+    return False
+
+
+def _accumulation_in(loop: ast.For, own: Set[int]) -> Optional[int]:
+    """Line of a numeric AugAssign accumulation in the loop body (set
+    union ``|=`` and friends are order-insensitive and stay clean)."""
+    for sub in ast.walk(loop):
+        if id(sub) not in own:
+            continue
+        if isinstance(sub, ast.AugAssign) and isinstance(
+                sub.op, (ast.Add, ast.Sub, ast.Mult)):
+            if isinstance(sub.value, (ast.Set, ast.SetComp)):
+                continue
+            return sub.lineno
+    return None

@@ -1,0 +1,214 @@
+"""Pass — donation contract at jit entry call sites (BX921).
+
+The static twin of the PR-15 donation audit: ``InstrumentedJit`` keeps
+the donated buffers' pointers and (debounced) alarms when a donated
+input is still referenced after the call. That only fires after the
+deleted-buffer error or the silent copy already happened in a real run;
+this pass proves the two contract breaches at the call site:
+
+  * **donated buffer read after the call** — an argument at a
+    ``donate_argnums`` position whose name is read again after the call
+    without being rebound first (including the next iteration of an
+    enclosing loop: a donated arg that the loop never rebinds is read
+    again at the top of the next pass through);
+  * **step-shaped call without donation** — a call that rebinds its own
+    ``state``/``params``-shaped arguments (``self.params, self.opt_state
+    = step(self.params, self.opt_state, ...)``) against an entry that
+    declares NO donation at all: the input buffers are provably dead
+    after the statement, so not donating doubles the peak footprint of
+    every step (the exact miss class the runtime audit debounces).
+    Entries that already donate SOME positions made a reviewed choice
+    and stay clean.
+
+Reads/rebinds are matched on the dotted spelling of the argument
+(``self.params`` / ``params``), line-ordered within the function — the
+same approximation the donation audit validates dynamically.
+
+Codes:
+  BX921  donation contract breach at a jit entry call site
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.boxlint.core import SourceFile, Violation
+from tools.boxlint.callgraph import FuncNode, get_index
+from tools.boxlint.purity import dotted
+from tools.boxlint.taint import JitEntry, get_contracts
+
+_EXEMPT_PARTS = {"tools", "tests", "examples"}
+
+# argument spellings whose rebind marks a step-shaped call: the training
+# state that every step consumes and reproduces
+_STATE_HINTS = ("param", "state", "slab", "opt")
+
+
+def _exempt(rel: str) -> bool:
+    return bool(_EXEMPT_PARTS.intersection(rel.split("/")[:-1]))
+
+
+def check(files: Sequence[SourceFile]) -> List[Violation]:
+    index = get_index(files)
+    c = get_contracts(files)
+    out: List[Violation] = []
+    for node in index.nodes:
+        if _exempt(node.file.rel):
+            continue
+        local = c._local_jits(node, direct_only=False)
+        own = index._own_statement_ids(node)
+        reads, rebinds = _name_sites(node, own)
+        for sub in ast.walk(node.fn):
+            if id(sub) not in own or not isinstance(sub, ast.Call):
+                continue
+            entry = c.entry_for_call(sub, node, local)
+            if entry is None:
+                continue
+            stmt = _enclosing_stmt(node, sub)
+            if entry.donate:
+                _check_donated_reads(node, sub, stmt, entry, reads,
+                                     rebinds, out)
+            else:
+                _check_step_shape(node, sub, stmt, entry, out)
+    return out
+
+
+def _name_sites(node: FuncNode, own: Set[int]
+                ) -> Tuple[Dict[str, List[int]], Dict[str, List[int]]]:
+    """Dotted name -> sorted lines of loads / stores in this function."""
+    reads: Dict[str, List[int]] = {}
+    rebinds: Dict[str, List[int]] = {}
+    for sub in ast.walk(node.fn):
+        if id(sub) not in own:
+            continue
+        if isinstance(sub, ast.Call) and isinstance(sub.func,
+                                                    ast.Attribute) \
+                and sub.func.attr.startswith("set_") and sub.args:
+            # setter convention: ``table.set_slab(x)`` rebinds
+            # ``table.slab`` — the functional-state classes expose their
+            # buffer through a read property + set_<name> writer
+            recv = dotted(sub.func.value)
+            if recv:
+                rebinds.setdefault(
+                    f"{recv}.{sub.func.attr[4:]}", []).append(sub.lineno)
+        if isinstance(sub, (ast.Name, ast.Attribute)):
+            d = dotted(sub)
+            if not d:
+                continue
+            ctx = getattr(sub, "ctx", None)
+            if isinstance(ctx, ast.Store):
+                rebinds.setdefault(d, []).append(sub.lineno)
+            elif isinstance(ctx, ast.Load):
+                reads.setdefault(d, []).append(sub.lineno)
+    for k in reads:
+        reads[k].sort()
+    for k in rebinds:
+        rebinds[k].sort()
+    return reads, rebinds
+
+
+def _enclosing_stmt(node: FuncNode, call: ast.Call) -> Optional[ast.stmt]:
+    best: Optional[ast.stmt] = None
+    for sub in ast.walk(node.fn):
+        if isinstance(sub, ast.stmt) and sub.lineno <= call.lineno and \
+                (sub.end_lineno or sub.lineno) >= (call.end_lineno
+                                                   or call.lineno):
+            if best is None or sub.lineno >= best.lineno:
+                best = sub
+    return best
+
+
+def _enclosing_loop(node: FuncNode, call: ast.Call
+                    ) -> Optional[ast.stmt]:
+    best = None
+    for sub in ast.walk(node.fn):
+        if isinstance(sub, (ast.For, ast.While, ast.AsyncFor)) and \
+                sub.lineno <= call.lineno and \
+                (sub.end_lineno or sub.lineno) >= call.lineno:
+            if best is None or sub.lineno >= best.lineno:
+                best = sub
+    return best
+
+
+def _check_donated_reads(node: FuncNode, call: ast.Call,
+                         stmt: Optional[ast.stmt], entry: JitEntry,
+                         reads: Dict[str, List[int]],
+                         rebinds: Dict[str, List[int]],
+                         out: List[Violation]) -> None:
+    stmt_end = (stmt.end_lineno or stmt.lineno) if stmt is not None \
+        else (call.end_lineno or call.lineno)
+    stmt_targets: Set[str] = set()
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            for e in (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                      else [t]):
+                d = dotted(e)
+                if d:
+                    stmt_targets.add(d)
+    loop = _enclosing_loop(node, call)
+    for pos in entry.donate:
+        if pos >= len(call.args):
+            continue
+        d = dotted(call.args[pos])
+        if not d:
+            continue
+        if d in stmt_targets:
+            # rebound by the call's own statement — safe, and in a loop
+            # the rebind lands before the next iteration's read too
+            continue
+        # read after the statement, before any rebind?
+        later_reads = [ln for ln in reads.get(d, []) if ln > stmt_end]
+        later_rebinds = [ln for ln in rebinds.get(d, []) if ln > stmt_end]
+        if later_reads and (not later_rebinds
+                            or later_reads[0] <= later_rebinds[0]):
+            out.append(Violation(
+                node.file.rel, call.lineno, "BX921",
+                f"donated buffer `{d}` (donate_argnums position {pos} of "
+                f"jit entry {entry.describe()}) is read again at line "
+                f"{later_reads[0]} without a rebind — the buffer is "
+                f"deleted (or silently copied) after the call; rebind it "
+                f"from the result or drop the donation"))
+            continue
+        if loop is not None:
+            in_loop_rebinds = [
+                ln for ln in rebinds.get(d, [])
+                if loop.lineno <= ln <= (loop.end_lineno or loop.lineno)]
+            if not in_loop_rebinds:
+                out.append(Violation(
+                    node.file.rel, call.lineno, "BX921",
+                    f"donated buffer `{d}` (donate_argnums position "
+                    f"{pos} of jit entry {entry.describe()}) is never "
+                    f"rebound inside the enclosing loop — the next "
+                    f"iteration reads the deleted buffer; rebind it from "
+                    f"the call result"))
+
+
+def _check_step_shape(node: FuncNode, call: ast.Call,
+                      stmt: Optional[ast.stmt], entry: JitEntry,
+                      out: List[Violation]) -> None:
+    if not isinstance(stmt, ast.Assign):
+        return
+    targets: Set[str] = set()
+    for t in stmt.targets:
+        for e in (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                  else [t]):
+            d = dotted(e)
+            if d:
+                targets.add(d)
+    rebound = []
+    for i, arg in enumerate(call.args):
+        d = dotted(arg)
+        if d and d in targets and any(
+                h in d.split(".")[-1].lower() for h in _STATE_HINTS):
+            rebound.append((i, d))
+    if rebound:
+        names = ", ".join(f"`{d}` (pos {i})" for i, d in rebound)
+        out.append(Violation(
+            node.file.rel, call.lineno, "BX921",
+            f"step-shaped call rebinds its own argument{'s' if len(rebound) > 1 else ''} "
+            f"{names} but jit entry {entry.describe()} declares no "
+            f"donation — the input buffers are dead after this "
+            f"statement, so the step holds two copies of the state; "
+            f"declare donate_argnums (the runtime donation audit "
+            f"debounces exactly this miss)"))

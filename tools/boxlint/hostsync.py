@@ -1,0 +1,222 @@
+"""Pass — hidden host sync on device values (BX931/BX932).
+
+The static twin of the PR-15 transfer ledger: ``account_d2h`` sees every
+device->host copy only in AGGREGATE, after the step already stalled.
+This pass pins the three contexts where a hidden sync is a bug, at the
+line, before it ships:
+
+  * **loop bodies** — ``float(loss)`` per training step serializes the
+    host loop against the device stream and erases async-dispatch
+    pipelining (the PaddleBox one-thread-per-GPU loop stays fast
+    precisely because nothing inside it blocks on the device);
+  * **under held locks** — composing with the BX601 held-lock walk: a
+    D2H while holding a lock adds device latency to every contender;
+  * **handler closures** — the BX8xx reentrancy roots: a device sync in
+    a crash/GC/watchdog handler can block on a wedged device stream at
+    the worst possible time.
+
+Device-ness comes from the taint layer (tools/boxlint/taint.py): values
+produced through any resolved jit binding or jnp/jax op are device;
+taint crosses function and module boundaries through the call closure,
+so a helper that ``.item()``s its parameter is charged to the loop that
+feeds it a device value, with the witness chain (BX601 form).
+
+A deliberate sync carries a REASONED waiver — ``# boxlint: BX931 ok
+(metrics need host preds per step; device-collect is the zero-sync
+path)`` — which also lists the site in device_contracts.txt. A waiver
+without a reason is itself a finding (BX932): an unexplained exception
+is invisible to review.
+
+Codes:
+  BX931  hidden D2H sync on a device value in a loop / under a lock /
+         on a handler path
+  BX932  boxlint waiver without a reason string
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Sequence, Set, Tuple
+
+from tools.boxlint.core import SourceFile, Violation
+from tools.boxlint.callgraph import FuncNode, chain_str, get_index
+from tools.boxlint.taint import DEVICE, Contracts, get_contracts
+from tools.boxlint import reentrancy
+
+_EXEMPT_PARTS = {"tools", "tests", "examples"}
+
+
+def _exempt(rel: str) -> bool:
+    return bool(_EXEMPT_PARTS.intersection(rel.split("/")[:-1]))
+
+
+def check(files: Sequence[SourceFile]) -> List[Violation]:
+    index = get_index(files)
+    c = get_contracts(files)
+    out: List[Violation] = []
+    for f in files:
+        if _exempt(f.rel):
+            continue
+        for line, code in f.bare_waivers:
+            out.append(Violation(
+                f.rel, line, "BX932",
+                f"waiver for {code} without a reason — write "
+                f"`# boxlint: {code} ok (<why this exception is safe>)`; "
+                f"a reasonless waiver hides a device-contract exception "
+                f"from review"))
+    for node in index.nodes:
+        if _exempt(node.file.rel):
+            continue
+        body = getattr(node.fn, "body", None)
+        if not isinstance(body, list):
+            continue
+        st = _State(node, index, c)
+        for stmt in body:
+            _walk(st, stmt, frozenset(), 0)
+        out.extend(st.out)
+    # handler closures: any device sync reachable on a BX8xx handler path
+    roots = reentrancy._collect_roots(index)
+    if roots:
+        reached = reentrancy._closure(roots)
+        seen: Set[Tuple[str, int]] = set()
+        for _nid, (node, desc, chain) in sorted(
+                reached.items(), key=lambda kv: kv[1][0].file.rel):
+            if _exempt(node.file.rel):
+                continue
+            st = _State(node, index, c)
+            for line, label in _direct_syncs(st):
+                key = (node.file.rel, line)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(Violation(
+                    node.file.rel, line, "BX931",
+                    f"hidden host sync on a handler path ({desc}"
+                    f"{chain_str(chain)}): {label} on a device value in "
+                    f"`{node.qual}` — a D2H inside a crash/GC/watchdog "
+                    f"handler blocks on the device stream at the worst "
+                    f"time; gate it or waive with a reason"))
+    return out
+
+
+class _State:
+    __slots__ = ("node", "index", "c", "taint", "local", "out", "seen")
+
+    def __init__(self, node: FuncNode, index, c: Contracts):
+        self.node = node
+        self.index = index
+        self.c = c
+        self.taint = c.fn_taint(node)
+        self.local = c._local_jits(node, direct_only=False)
+        self.out: List[Violation] = []
+        self.seen: Set[Tuple[int, str]] = set()
+
+
+def _direct_syncs(st: _State) -> List[Tuple[int, str]]:
+    """(line, label) for every sync applied to a DEVICE-tainted value in
+    this function, regardless of loop/lock context (the handler check)."""
+    hits: List[Tuple[int, str]] = []
+    own = st.index._own_statement_ids(st.node)
+    for sub in ast.walk(st.node.fn):
+        if id(sub) not in own or not isinstance(sub, ast.Call):
+            continue
+        got = st.c.sync_call(sub, st.node.module)
+        if got is None:
+            continue
+        label, value = got
+        if DEVICE in st.c.expr_origins(value, st.node, st.taint, st.local):
+            hits.append((sub.lineno, label))
+    return hits
+
+
+def _walk(st: _State, stmt: ast.AST, held: frozenset, loop: int) -> None:
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return  # deferred execution: not under this lock/loop
+    if isinstance(stmt, ast.With):
+        inner = held | {ident for _, ident, _ in
+                        st.index.with_locks(stmt, st.node)}
+        for item in stmt.items:
+            _check_expr(st, item.context_expr, held, loop)
+        for s in stmt.body:
+            _walk(st, s, inner, loop)
+        return
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        _check_expr(st, stmt.iter, held, loop)
+        for s in stmt.body:
+            _walk(st, s, held, loop + 1)
+        for s in stmt.orelse:
+            _walk(st, s, held, loop)
+        return
+    if isinstance(stmt, ast.While):
+        _check_expr(st, stmt.test, held, loop + 1)
+        for s in stmt.body:
+            _walk(st, s, held, loop + 1)
+        for s in stmt.orelse:
+            _walk(st, s, held, loop)
+        return
+    _STMT_LIKE = (ast.stmt, ast.ExceptHandler, ast.match_case)
+    for c in ast.iter_child_nodes(stmt):
+        if isinstance(c, _STMT_LIKE):
+            _walk(st, c, held, loop)
+        else:
+            _check_expr(st, c, held, loop)
+
+
+def _check_expr(st: _State, expr: ast.AST, held: frozenset,
+                loop: int) -> None:
+    if expr is None or (not held and not loop):
+        return
+    for sub in ast.walk(expr):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            continue
+        if not isinstance(sub, ast.Call):
+            continue
+        # direct sync on a device value at this site
+        got = st.c.sync_call(sub, st.node.module)
+        if got is not None:
+            label, value = got
+            if DEVICE in st.c.expr_origins(value, st.node, st.taint,
+                                           st.local):
+                _flag(st, sub.lineno, label, (), held, loop)
+        # transitive: a callee that syncs the parameter we pass a
+        # device value into
+        for callee in st.node.call_map.get(id(sub), []):
+            ps = st.c.param_syncs.get(id(callee.fn))
+            if not ps:
+                continue
+            amap = st.c.arg_origin_map(sub, callee, st.node, st.taint,
+                                       st.local)
+            for q, origins in amap.items():
+                if DEVICE not in origins or q not in ps:
+                    continue
+                label, _ln, chain = ps[q]
+                _flag(st, sub.lineno, label,
+                      (callee.qual,) + chain, held, loop)
+                break
+
+
+def _flag(st: _State, line: int, label: str, chain: Tuple[str, ...],
+          held: frozenset, loop: int) -> None:
+    key = (line, label)
+    if key in st.seen:
+        return
+    st.seen.add(key)
+    if loop and held:
+        where = (f"in a loop body under "
+                 f"{'+'.join(sorted(held))}")
+        fix = ("hoist the sync past the loop AND outside the lock")
+    elif loop:
+        where = "in a loop body"
+        fix = ("hoist it to the pass/step boundary so the device stream "
+               "runs ahead")
+    else:
+        where = f"under {'+'.join(sorted(held))}"
+        fix = ("sync outside the lock — D2H latency while holding it "
+               "stalls every contender")
+    st.out.append(Violation(
+        st.node.file.rel, line, "BX931",
+        f"hidden host sync {where} in `{st.node.qual}`: {label} on a "
+        f"device value{chain_str(chain)} — the transfer ledger only sees "
+        f"this in aggregate; {fix} (or waive: # boxlint: BX931 ok "
+        f"(reason))"))

@@ -1,0 +1,785 @@
+"""Traced-value dataflow layer — the substrate of the BX9xx device-contract
+passes (recompile BX911, donation BX921, hostsync BX931, determinism BX941).
+
+Two questions the runtime device plane (obs/device.py, PR 15) answers only
+AFTER a bad pattern ships are answered here statically, on the
+``callgraph.PackageIndex`` closure:
+
+1. **Where are the jit entry points, and what contract did each declare?**
+   ``collect_contracts`` enumerates every ``instrument_jit(...)`` /
+   ``jax.jit(...)`` construction site (the BX901 registry already forces
+   the former in library code) and — the part BX901 never needed —
+   resolves what each wrapped callable is BOUND to, so call sites can be
+   matched back to their contract:
+
+     * module level:   ``_KERNEL = instrument_jit(fn, ...)``
+     * instance attr:  ``self._step = instrument_jit(fn, ...)``
+     * factory return: ``return instrument_jit(fn, ...)`` — any
+       assignment from a call to the factory inherits the binding
+       (``self._step = self._build_step()``, the sharded-trainer shape),
+       including tuple returns position-by-position
+     * dataclass field: ``TrainStepFns(step=step, ...)`` where ``step``
+       is locally jit-bound — so ``self.fns.step(...)`` resolves through
+       the receiver's class (typed via attr_types or a
+       ``return ClassName(...)`` factory)
+
+2. **Which host values are device values?** Results of calls through any
+   jit binding are device-tainted; taint propagates through locals,
+   tuple unpacks, jnp/jax ops, returns, and — via a package-wide
+   fixpoint — through call arguments into callee parameters, so a helper
+   in another module that ``.item()``s its argument is chargeable to the
+   loop that calls it with a device value (the witness-chain form BX601
+   established).
+
+Everything here is pure stdlib ``ast``; the index is shared with the
+BX6xx/7xx/8xx passes via ``callgraph.get_index`` and the contract build
+is memoized per index, so the four consuming passes pay the fixpoint
+once per run.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from tools.boxlint.core import SourceFile
+from tools.boxlint.callgraph import (FuncNode, PackageIndex, get_index,
+                                     _module_name, _self_attr)
+from tools.boxlint.purity import dotted
+
+# the device-taint origin marker; other origins are parameter names
+DEVICE = "<device>"
+
+# wrapped-callable transformers we see through to find the underlying
+# function: instrument_jit(jax.shard_map(sync, ...), ...) wraps `sync`
+_SEE_THROUGH = {"shard_map", "pjit", "partial", "checkpoint", "remat"}
+
+# attribute reads that yield HOST metadata of a device value, not the
+# value itself — they must not propagate taint (int(x.shape[0]) is fine)
+_HOST_ATTRS = {"shape", "dtype", "ndim", "size", "sharding", "devices",
+               "nbytes", "itemsize"}
+
+# host-sync call forms: label -> matcher handled in sync_call()
+_CAST_NAMES = {"float", "int", "bool"}
+_SYNC_METHODS = {"item", "tolist"}
+
+
+class JitEntry:
+    """One jit construction site + its declared device contract."""
+
+    __slots__ = ("rel", "line", "name", "wrapped", "donate", "static_nums",
+                 "static_names", "kind")
+
+    def __init__(self, rel: str, line: int, name: str,
+                 wrapped: Optional[FuncNode], donate: Tuple[int, ...],
+                 static_nums: Tuple[int, ...],
+                 static_names: Tuple[str, ...], kind: str):
+        self.rel = rel
+        self.line = line
+        self.name = name            # the instrument_jit name string
+        self.wrapped = wrapped      # FuncNode of the wrapped fn, if resolved
+        self.donate = donate
+        self.static_nums = static_nums
+        self.static_names = static_names
+        self.kind = kind            # "instrument_jit" | "jax.jit"
+
+    def describe(self) -> str:
+        return (f"{self.name or '<unnamed>'} @ {self.rel}:{self.line}")
+
+
+class Contracts:
+    """The package's jit-entry inventory + binding maps + taint summaries."""
+
+    def __init__(self, index: PackageIndex):
+        self.index = index
+        self.entries: List[JitEntry] = []
+        # binding maps: each value is a JitEntry
+        self.module_binds: Dict[Tuple[str, str], JitEntry] = {}
+        self.attr_binds: Dict[Tuple[str, str], JitEntry] = {}
+        self.field_binds: Dict[Tuple[str, str], JitEntry] = {}
+        # factory fn -> positional returns (None holes for non-jit slots)
+        self.factory_returns: Dict[int, List[Optional[JitEntry]]] = {}
+        # fn -> ClassName for `return ClassName(...)` factories (type
+        # inference for `self.fns = make_train_step(...)` receivers)
+        self.class_factories: Dict[int, str] = {}
+        # (ClassName, attr) -> ClassName typed through a class factory
+        self.extra_attr_types: Dict[Tuple[str, str], str] = {}
+        # per-function device/param taint: id(fn ast) -> name -> origins
+        self._taint: Dict[int, Dict[str, FrozenSet[str]]] = {}
+        # construction-site memo: the binding sweeps revisit the same
+        # ast.Call several times; one JitEntry per site
+        self._entry_sites: Dict[int, JitEntry] = {}
+        # param -> (label, line, chain) sync summary per function
+        self.param_syncs: Dict[int, Dict[str, Tuple[str, int,
+                                                    Tuple[str, ...]]]] = {}
+        # origins a function's return value can carry
+        self.return_origins: Dict[int, FrozenSet[str]] = {}
+        self._np_names: Dict[str, Set[str]] = {}
+        self._device_mods: Dict[str, Set[str]] = {}
+        self._build()
+
+    # ------------------------------------------------------- construction
+
+    def _build(self) -> None:
+        for f in self.index.files:
+            mod = _module_name(f.rel)
+            np_names, dev_names = {"np", "numpy"}, {"jnp", "jax", "lax"}
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        if a.name == "numpy":
+                            np_names.add(a.asname or "numpy")
+                        if a.name in ("jax", "jax.numpy"):
+                            dev_names.add(a.asname or a.name.split(".")[0])
+                elif isinstance(node, ast.ImportFrom):
+                    if node.module == "jax" and node.level == 0:
+                        for a in node.names:
+                            if a.name in ("numpy", "lax"):
+                                dev_names.add(a.asname or a.name)
+            self._np_names[mod] = np_names
+            self._device_mods[mod] = dev_names
+        # sweep 1: direct jit-call bindings + factory returns
+        for f in self.index.files:
+            self._scan_bindings(f, direct_only=True)
+        # sweep 2: factory-call bindings, dataclass fields, class factories
+        for f in self.index.files:
+            self._scan_bindings(f, direct_only=False)
+        # inventory completeness: construction sites that never bind
+        # (inline tuples, direct-use jits) still belong in the artifact
+        for f in self.index.files:
+            mod = _module_name(f.rel)
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Call):
+                    self._jit_call(node, mod)
+        self._fixpoint()
+
+    def _jit_call(self, call: ast.Call, mod: str) -> Optional[JitEntry]:
+        """A JitEntry when ``call`` constructs a jit (instrument_jit or
+        bare jax.jit), else None. Memoized per site."""
+        if id(call) in self._entry_sites:
+            return self._entry_sites[id(call)]
+        d = dotted(call.func) or ""
+        tail = d.split(".")[-1]
+        kind = None
+        if tail == "instrument_jit":
+            kind = "instrument_jit"
+        elif tail == "jit" and (d != "jit" or "jit" in
+                                self.index.imports.get(mod, {})):
+            imp = self.index.imports.get(mod, {}).get(d.split(".")[0], "")
+            if d.split(".")[0] == "jax" or imp == "jax" or \
+                    self.index.imports.get(mod, {}).get("jit", "") \
+                    == "jax.jit":
+                kind = "jax.jit"
+        if kind is None:
+            return None
+        name = ""
+        if kind == "instrument_jit" and len(call.args) >= 2 and \
+                isinstance(call.args[1], ast.Constant) and \
+                isinstance(call.args[1].value, str):
+            name = call.args[1].value
+        for kw in call.keywords:
+            if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                name = str(kw.value.value)
+        wrapped = self._resolve_wrapped(
+            call.args[0] if call.args else None, mod)
+        donate = self._int_tuple(call, "donate_argnums")
+        static = self._int_tuple(call, "static_argnums")
+        names = self._str_tuple(call, "static_argnames")
+        f = self.index.modules.get(mod)
+        rel = f.rel if f is not None else mod
+        e = JitEntry(rel, call.lineno, name, wrapped, donate, static,
+                     names, kind)
+        self._entry_sites[id(call)] = e
+        self.entries.append(e)
+        return e
+
+    def _resolve_wrapped(self, expr: Optional[ast.AST], mod: str,
+                         _depth: int = 0) -> Optional[FuncNode]:
+        if expr is None or _depth > 3:
+            return None
+        if isinstance(expr, ast.Call):
+            # see through shard_map/partial/etc to the inner callable
+            tail = (dotted(expr.func) or "").split(".")[-1]
+            if tail in _SEE_THROUGH and expr.args:
+                return self._resolve_wrapped(expr.args[0], mod, _depth + 1)
+            return None
+        d = dotted(expr)
+        if not d:
+            return None
+        hit = self.index.functions.get((mod, d))
+        if hit:
+            return hit
+        imp = self.index.imports.get(mod, {}).get(d)
+        if imp:
+            tmod, _, tname = imp.rpartition(".")
+            return self.index.functions.get((tmod, tname))
+        return None
+
+    @staticmethod
+    def _int_tuple(call: ast.Call, kwarg: str) -> Tuple[int, ...]:
+        for kw in call.keywords:
+            if kw.arg != kwarg:
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and \
+                            isinstance(e.value, int):
+                        out.append(e.value)
+                return tuple(out)
+        return ()
+
+    @staticmethod
+    def _str_tuple(call: ast.Call, kwarg: str) -> Tuple[str, ...]:
+        for kw in call.keywords:
+            if kw.arg != kwarg:
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(e.value for e in v.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, str))
+        return ()
+
+    # ---------------------------------------------------------- bindings
+
+    def _scan_bindings(self, f: SourceFile, direct_only: bool) -> None:
+        mod = _module_name(f.rel)
+        # module-level assigns
+        for stmt in f.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                e = self._value_entry(stmt.value, mod, None, {},
+                                      direct_only)
+                if e is not None:
+                    self.module_binds.setdefault(
+                        (mod, stmt.targets[0].id), e)
+        # per-function assigns / returns
+        for node in self.index.nodes:
+            if node.file is not f:
+                continue
+            local = self._local_jits(node, direct_only)
+            cls = node.cls
+            for sub in ast.walk(node.fn):
+                if isinstance(sub, ast.Assign):
+                    self._bind_assign(sub, node, cls, local, direct_only)
+                elif isinstance(sub, ast.Return) and sub.value is not None:
+                    self._bind_return(sub.value, node, local, direct_only)
+
+    def _local_jits(self, node: FuncNode, direct_only: bool
+                    ) -> Dict[str, JitEntry]:
+        out: Dict[str, JitEntry] = {}
+        for _ in range(2):   # two sweeps: `a = jit(...)`, `b = a if c else a`
+            for sub in ast.walk(node.fn):
+                if not (isinstance(sub, ast.Assign)
+                        and len(sub.targets) == 1
+                        and isinstance(sub.targets[0], ast.Name)):
+                    continue
+                e = self._value_entry(sub.value, node.module, node, out,
+                                      direct_only)
+                if e is not None:
+                    out.setdefault(sub.targets[0].id, e)
+        return out
+
+    def _value_entry(self, value: ast.AST, mod: str,
+                     ctx: Optional[FuncNode], local: Dict[str, JitEntry],
+                     direct_only: bool) -> Optional[JitEntry]:
+        """The JitEntry an assigned VALUE denotes, if any: a direct jit
+        construction, a jit-bound name, an either-branch-bound IfExp, or
+        (sweep 2) a call to a jit factory."""
+        if isinstance(value, ast.IfExp):
+            return (self._value_entry(value.body, mod, ctx, local,
+                                      direct_only)
+                    or self._value_entry(value.orelse, mod, ctx, local,
+                                         direct_only))
+        if isinstance(value, ast.Name):
+            return local.get(value.id) or self.module_binds.get(
+                (mod, value.id))
+        if not isinstance(value, ast.Call):
+            return None
+        e = self._jit_call(value, mod)
+        if e is not None:
+            return e
+        if direct_only:
+            return None
+        # sweep 2: call to a factory that returns a jit
+        for callee in self._callees(value, mod, ctx):
+            rets = self.factory_returns.get(id(callee.fn))
+            if rets and len(rets) == 1 and rets[0] is not None:
+                return rets[0]
+        return None
+
+    def _callees(self, call: ast.Call, mod: str,
+                 ctx: Optional[FuncNode]) -> List[FuncNode]:
+        if ctx is not None:
+            got = ctx.call_map.get(id(call))
+            if got:
+                return got
+            return []
+        # module-level binding (``step = make_step()``): no call_map —
+        # resolve the factory by name through the module / its imports
+        d = dotted(call.func)
+        if not d:
+            return []
+        hit = self.index.functions.get((mod, d))
+        if hit is None:
+            imp = self.index.imports.get(mod, {}).get(d)
+            if imp:
+                tmod, _, tname = imp.rpartition(".")
+                hit = self.index.functions.get((tmod, tname))
+        return [hit] if hit is not None else []
+
+    def _bind_assign(self, stmt: ast.Assign, node: FuncNode,
+                     cls: Optional[str], local: Dict[str, JitEntry],
+                     direct_only: bool) -> None:
+        if len(stmt.targets) != 1:
+            return
+        t = stmt.targets[0]
+        # tuple-unpack from a tuple-returning factory call (sweep 2)
+        if isinstance(t, ast.Tuple) and isinstance(stmt.value, ast.Call) \
+                and not direct_only:
+            for callee in self._callees(stmt.value, node.module, node):
+                rets = self.factory_returns.get(id(callee.fn))
+                if not rets or len(rets) != len(t.elts):
+                    continue
+                for elt, e in zip(t.elts, rets):
+                    if e is None:
+                        continue
+                    attr = _self_attr(elt)
+                    if attr and cls:
+                        self.attr_binds.setdefault((cls, attr), e)
+            return
+        e = self._value_entry(stmt.value, node.module, node, local,
+                              direct_only)
+        attr = _self_attr(t)
+        if attr and cls:
+            if e is not None:
+                self.attr_binds.setdefault((cls, attr), e)
+            elif not direct_only and isinstance(stmt.value, ast.Call):
+                # `self.fns = make_train_step(...)`: type the attr
+                # through the class factory so field binds resolve
+                for callee in self._callees(stmt.value, node.module, node):
+                    cname = self.class_factories.get(id(callee.fn))
+                    if cname:
+                        self.extra_attr_types.setdefault((cls, attr),
+                                                         cname)
+
+    def _bind_return(self, value: ast.AST, node: FuncNode,
+                     local: Dict[str, JitEntry], direct_only: bool) -> None:
+        elts = value.elts if isinstance(value, ast.Tuple) else [value]
+        rets = [self._value_entry(e, node.module, node, local, direct_only)
+                for e in elts]
+        if any(r is not None for r in rets):
+            cur = self.factory_returns.get(id(node.fn))
+            if cur is None or sum(r is not None for r in rets) > \
+                    sum(r is not None for r in cur):
+                self.factory_returns[id(node.fn)] = rets
+        if isinstance(value, ast.Call):
+            tail = (dotted(value.func) or "").split(".")[-1]
+            if tail and tail[0].isupper() and \
+                    self.index.class_by_name(tail) is not None:
+                self.class_factories.setdefault(id(node.fn), tail)
+            if not direct_only:
+                # dataclass fields bound at construction:
+                # TrainStepFns(step=step, ...)
+                for kw in value.keywords:
+                    if kw.arg is None:
+                        continue
+                    e = self._value_entry(kw.value, node.module, node,
+                                          local, direct_only)
+                    if e is not None and tail:
+                        self.field_binds.setdefault((tail, kw.arg), e)
+
+    # ------------------------------------------------- call-site resolution
+
+    def receiver_class(self, expr: ast.AST, ctx: FuncNode) -> Optional[str]:
+        """Class name of `expr` when it denotes a typed receiver
+        (self.attr via attr_types / class factories, module singleton)."""
+        attr = _self_attr(expr)
+        if attr and ctx.cls:
+            own = self.index._class_in_module(ctx.cls, ctx.module)
+            t = self.index._attr_type(own, attr) if own else None
+            if t:
+                return t
+            # walk the name-keyed base chain for factory-typed attrs
+            seen, names = set(), [ctx.cls]
+            while names:
+                c = names.pop()
+                if c in seen:
+                    continue
+                seen.add(c)
+                hit = self.extra_attr_types.get((c, attr))
+                if hit:
+                    return hit
+                cn = self.index.class_by_name(c)
+                if cn is not None:
+                    names.extend(cn.bases)
+            return None
+        if isinstance(expr, ast.Name):
+            return self.index.module_vars.get(ctx.module, {}).get(expr.id)
+        return None
+
+    def entry_for_call(self, call: ast.Call, ctx: FuncNode,
+                       local: Optional[Dict[str, JitEntry]] = None
+                       ) -> Optional[JitEntry]:
+        """The JitEntry a call site invokes, resolved through every
+        binding form, else None."""
+        func = call.func
+        mod = ctx.module
+        if isinstance(func, ast.Name):
+            if local and func.id in local:
+                return local[func.id]
+            hit = self.module_binds.get((mod, func.id))
+            if hit:
+                return hit
+            imp = self.index.imports.get(mod, {}).get(func.id)
+            if imp:
+                tmod, _, tname = imp.rpartition(".")
+                return self.module_binds.get((tmod, tname))
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        meth = func.attr
+        recv = func.value
+        if isinstance(recv, ast.Name) and recv.id in ("self", "cls"):
+            seen, names = set(), [ctx.cls] if ctx.cls else []
+            while names:
+                c = names.pop()
+                if c in seen:
+                    continue
+                seen.add(c)
+                hit = self.attr_binds.get((c, meth))
+                if hit:
+                    return hit
+                cn = self.index.class_by_name(c)
+                if cn is not None:
+                    names.extend(cn.bases)
+            return None
+        # typed receiver: self.fns.step(...) / SINGLETON.step(...)
+        cname = self.receiver_class(recv, ctx)
+        if cname:
+            return (self.field_binds.get((cname, meth))
+                    or self.attr_binds.get((cname, meth)))
+        # module receiver: mod.STEP(...)
+        rd = dotted(recv)
+        if rd:
+            imp = self.index.imports.get(mod, {}).get(rd.split(".")[0])
+            if imp:
+                return self.module_binds.get((imp, meth))
+        return None
+
+    # ------------------------------------------------------ taint machinery
+
+    def sync_call(self, call: ast.Call, mod: str
+                  ) -> Optional[Tuple[str, ast.AST]]:
+        """(label, value-expr) when ``call`` is a host-sync form: the
+        float()/int()/bool() casts, .item()/.tolist(), np.asarray/np.array
+        and jax.device_get — each a blocking D2H when applied to a device
+        value."""
+        func = call.func
+        if isinstance(func, ast.Name) and func.id in _CAST_NAMES \
+                and len(call.args) == 1:
+            return (f"{func.id}()", call.args[0])
+        if isinstance(func, ast.Attribute) and func.attr in _SYNC_METHODS \
+                and not call.args:
+            return (f".{func.attr}()", func.value)
+        d = dotted(func) or ""
+        parts = d.split(".")
+        if len(parts) == 2 and parts[1] in ("asarray", "array") \
+                and parts[0] in self._np_names.get(mod, ()) and call.args:
+            return (f"{parts[0]}.{parts[1]}()", call.args[0])
+        if d in ("jax.device_get",) and call.args:
+            return ("jax.device_get()", call.args[0])
+        return None
+
+    def expr_origins(self, expr: Optional[ast.AST], ctx: FuncNode,
+                     taint: Dict[str, FrozenSet[str]],
+                     local: Dict[str, JitEntry]) -> FrozenSet[str]:
+        """Taint origins of an expression: DEVICE and/or parameter names."""
+        if expr is None:
+            return frozenset()
+        if isinstance(expr, ast.Name):
+            return taint.get(expr.id, frozenset())
+        if isinstance(expr, ast.Starred):
+            return self.expr_origins(expr.value, ctx, taint, local)
+        if isinstance(expr, ast.Subscript):
+            return self.expr_origins(expr.value, ctx, taint, local)
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _HOST_ATTRS:
+                return frozenset()
+            return self.expr_origins(expr.value, ctx, taint, local)
+        if isinstance(expr, ast.Call):
+            return self.call_result_origins(expr, ctx, taint, local)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out: Set[str] = set()
+            for e in expr.elts:
+                out |= self.expr_origins(e, ctx, taint, local)
+            return frozenset(out)
+        if isinstance(expr, ast.BinOp):
+            return (self.expr_origins(expr.left, ctx, taint, local)
+                    | self.expr_origins(expr.right, ctx, taint, local))
+        if isinstance(expr, ast.UnaryOp):
+            return self.expr_origins(expr.operand, ctx, taint, local)
+        if isinstance(expr, ast.IfExp):
+            return (self.expr_origins(expr.body, ctx, taint, local)
+                    | self.expr_origins(expr.orelse, ctx, taint, local))
+        if isinstance(expr, ast.NamedExpr):
+            return self.expr_origins(expr.value, ctx, taint, local)
+        return frozenset()
+
+    def call_result_origins(self, call: ast.Call, ctx: FuncNode,
+                            taint: Dict[str, FrozenSet[str]],
+                            local: Dict[str, JitEntry]) -> FrozenSet[str]:
+        if self.entry_for_call(call, ctx, local) is not None:
+            return frozenset({DEVICE})
+        if self.sync_call(call, ctx.module) is not None:
+            return frozenset()      # sync RESULT is a host value
+        d = dotted(call.func) or ""
+        head = d.split(".")[0]
+        if head and head in self._device_mods.get(ctx.module, ()):
+            if d in ("jax.device_get",):
+                return frozenset()
+            # a jnp/jax/lax op yields a device value (and an op over
+            # tainted inputs certainly does)
+            return frozenset({DEVICE})
+        # resolved package call: map return origins through the args
+        out: Set[str] = set()
+        for callee in ctx.call_map.get(id(call), []):
+            rets = self.return_origins.get(id(callee.fn))
+            if not rets:
+                continue
+            if DEVICE in rets:
+                out.add(DEVICE)
+            amap = self.arg_origin_map(call, callee, ctx, taint, local)
+            for p in rets:
+                if p in amap:
+                    out |= amap[p]
+        return frozenset(out)
+
+    def arg_origin_map(self, call: ast.Call, callee: FuncNode,
+                       ctx: FuncNode, taint: Dict[str, FrozenSet[str]],
+                       local: Dict[str, JitEntry]
+                       ) -> Dict[str, FrozenSet[str]]:
+        """callee param name -> origins of the arg the call passes it."""
+        params = [a.arg for a in callee.fn.args.args] \
+            if hasattr(callee.fn, "args") else []
+        offset = 0
+        if params and params[0] in ("self", "cls") and \
+                isinstance(call.func, ast.Attribute):
+            offset = 1
+        out: Dict[str, FrozenSet[str]] = {}
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                continue
+            pi = i + offset
+            if pi < len(params):
+                o = self.expr_origins(arg, ctx, taint, local)
+                if o:
+                    out[params[pi]] = o
+        for kw in call.keywords:
+            if kw.arg and kw.arg in params:
+                o = self.expr_origins(kw.value, ctx, taint, local)
+                if o:
+                    out[kw.arg] = o
+        return out
+
+    def fn_taint(self, node: FuncNode) -> Dict[str, FrozenSet[str]]:
+        """name -> origins for one function: parameters carry their own
+        name as origin (resolved to device-ness at call sites), names
+        assigned from jit-entry calls / jnp ops carry DEVICE."""
+        cached = self._taint.get(id(node.fn))
+        if cached is not None:
+            return cached
+        taint: Dict[str, FrozenSet[str]] = {}
+        args = getattr(node.fn, "args", None)
+        if args is not None:
+            names = [a.arg for a in args.posonlyargs + args.args
+                     + args.kwonlyargs]
+            for n in names:
+                if n in ("self", "cls"):
+                    continue
+                taint[n] = frozenset({n})
+        local = self._local_jits(node, direct_only=False)
+        own = self.index._own_statement_ids(node)
+        for _ in range(2):      # forward fixpoint over re-assignments
+            for sub in ast.walk(node.fn):
+                if id(sub) not in own:
+                    continue
+                if isinstance(sub, ast.Assign):
+                    o = self.expr_origins(sub.value, node, taint, local)
+                    for t in sub.targets:
+                        self._taint_target(t, sub.value, o, node, taint,
+                                           local)
+                elif isinstance(sub, ast.AnnAssign) and sub.value:
+                    o = self.expr_origins(sub.value, node, taint, local)
+                    self._taint_target(sub.target, sub.value, o, node,
+                                       taint, local)
+                elif isinstance(sub, ast.AugAssign):
+                    o = self.expr_origins(sub.value, node, taint, local)
+                    if o and isinstance(sub.target, ast.Name):
+                        taint[sub.target.id] = taint.get(
+                            sub.target.id, frozenset()) | o
+                elif isinstance(sub, ast.For):
+                    o = self.expr_origins(sub.iter, node, taint, local)
+                    if o:
+                        self._taint_target(sub.target, None, o, node,
+                                           taint, local)
+        self._taint[id(node.fn)] = taint
+        return taint
+
+    def _taint_target(self, target: ast.AST, value: Optional[ast.AST],
+                      origins: FrozenSet[str], node: FuncNode,
+                      taint: Dict[str, FrozenSet[str]],
+                      local: Dict[str, JitEntry]) -> None:
+        if isinstance(target, ast.Name):
+            if origins:
+                taint[target.id] = origins
+            elif target.id in taint and not taint[target.id] == \
+                    frozenset({target.id}):
+                # rebound to an untainted value: clear derived taint
+                # (parameter self-origin stays — the param name is the
+                # summary key, and rebinding params is rare)
+                taint.pop(target.id, None)
+            return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            velts = (value.elts if isinstance(value, (ast.Tuple, ast.List))
+                     and len(value.elts) == len(target.elts) else None)
+            for i, t in enumerate(target.elts):
+                o = origins
+                if velts is not None:
+                    o = self.expr_origins(velts[i], node, taint, local)
+                self._taint_target(t, velts[i] if velts else None, o,
+                                   node, taint, local)
+
+    # ----------------------------------------------------- global fixpoint
+
+    def _fixpoint(self) -> None:
+        """Two package-wide summaries to fixpoint: which parameters reach
+        a host sync inside their function (param_syncs, with witness
+        chains), and which origins a function can return."""
+        for node in self.index.nodes:
+            self._scan_summaries(node)
+        # propagate param syncs through call edges: caller's arg taint
+        # names its own params -> those params inherit the callee's sync
+        for _ in range(6):
+            changed = False
+            for node in self.index.nodes:
+                taint = self.fn_taint(node)
+                local = self._local_jits(node, direct_only=False)
+                own = self.index._own_statement_ids(node)
+                for sub in ast.walk(node.fn):
+                    if id(sub) not in own or not isinstance(sub, ast.Call):
+                        continue
+                    for callee in node.call_map.get(id(sub), []):
+                        ps = self.param_syncs.get(id(callee.fn))
+                        if not ps:
+                            continue
+                        amap = self.arg_origin_map(sub, callee, node,
+                                                   taint, local)
+                        mine = self.param_syncs.setdefault(id(node.fn), {})
+                        for q, (label, _ln, chain) in ps.items():
+                            if q not in amap or len(chain) >= 5:
+                                continue
+                            for origin in amap[q]:
+                                if origin == DEVICE:
+                                    continue
+                                if origin not in mine:
+                                    mine[origin] = (
+                                        label, sub.lineno,
+                                        (callee.qual,) + chain)
+                                    changed = True
+            if not changed:
+                break
+
+    def _scan_summaries(self, node: FuncNode) -> None:
+        taint = self.fn_taint(node)
+        local = self._local_jits(node, direct_only=False)
+        own = self.index._own_statement_ids(node)
+        syncs = self.param_syncs.setdefault(id(node.fn), {})
+        rets: Set[str] = set()
+        for sub in ast.walk(node.fn):
+            if id(sub) not in own:
+                continue
+            if isinstance(sub, ast.Call):
+                hit = self.sync_call(sub, node.module)
+                if hit is not None:
+                    label, value = hit
+                    for origin in self.expr_origins(value, node, taint,
+                                                    local):
+                        if origin != DEVICE and origin not in syncs:
+                            syncs[origin] = (label, sub.lineno, ())
+            elif isinstance(sub, ast.Return) and sub.value is not None:
+                rets |= self.expr_origins(sub.value, node, taint, local)
+        if rets:
+            self.return_origins[id(node.fn)] = frozenset(rets)
+
+
+# ---------------------------------------------------------------- memo
+
+_CACHE: List[Tuple[PackageIndex, Contracts]] = []
+
+
+def get_contracts(files: Sequence[SourceFile]) -> Contracts:
+    index = get_index(files)
+    for idx, c in _CACHE:
+        if idx is index:
+            return c
+    c = Contracts(index)
+    del _CACHE[:]
+    _CACHE.append((index, c))
+    return c
+
+
+# ----------------------------------------------------------- the artifact
+
+def render_inventory(files: Sequence[SourceFile]) -> str:
+    """The committed device-contract inventory (device_contracts.txt, the
+    lock_graph.txt pattern): every jit entry with its declared donation /
+    static keying, every reasoned host-sync waiver, and the pinned counts
+    line review diffs against."""
+    c = get_contracts(files)
+    lines = [
+        "# Device-contract inventory (boxlint BX9xx taint layer).",
+        "# entry : site [wraps fn] donate=(..) static=(..) — one line per",
+        "# instrument_jit/jax.jit construction the taint layer resolved.",
+        "# Regenerate with: python -m tools.boxlint --device-contracts "
+        "paddlebox_tpu/",
+        "# The waiver section lists every reasoned `# boxlint: BXnnn ok",
+        "# (reason)` site — the reviewed exceptions to the BX911/921/931/",
+        "# 941 contracts; reasonless waivers are BX932 findings, never",
+        "# listed here.",
+        "",
+    ]
+    entries = sorted(c.entries, key=lambda e: (e.rel, e.line))
+    donating = sum(1 for e in entries if e.donate)
+    static_keyed = sum(1 for e in entries
+                       if e.static_nums or e.static_names)
+    for e in entries:
+        bits = [f"{e.name or '<unnamed>'} : {e.rel}:{e.line}"]
+        if e.wrapped is not None:
+            bits.append(f"wraps {e.wrapped.qual}")
+        if e.donate:
+            bits.append(f"donate={tuple(e.donate)}")
+        if e.static_nums:
+            bits.append(f"static={tuple(e.static_nums)}")
+        if e.static_names:
+            bits.append(f"static_names={tuple(e.static_names)}")
+        if e.kind != "instrument_jit":
+            bits.append(f"[{e.kind}]")
+        lines.append(" ".join(bits))
+    lines.append("")
+    waivers = []
+    for f in sorted(c.index.files, key=lambda f: f.rel):
+        for line, (code, reason) in sorted(f.waivers.items()):
+            waivers.append(f"waived {code} : {f.rel}:{line} ({reason})")
+    lines.extend(waivers)
+    lines.append("")
+    lines.append(f"# {len(entries)} jit entries ({donating} donating, "
+                 f"{static_keyed} static-keyed), {len(waivers)} reasoned "
+                 f"waivers")
+    return "\n".join(lines) + "\n"
