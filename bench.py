@@ -58,6 +58,13 @@ scan rate, plus the preload-overlapped cadence. The real multi-process
 shuffle ladder (record-TCP / block-TCP / block-mesh) lives in
 tools/ingest_probe.py and BASELINE.md round 17.
 
+Round 21 attaches the `fleet` block: the multi-box serving ladder
+(QPS/p99 vs box count over REAL spawned MultiBoxFleet grids, coalescing
+RPC reduction at concurrency 8, journal-fed freshness in seconds, and
+the kill-one-replica failover budget — tools/fleet_probe.py), with the
+top rung's client-side rate surfaced flat as `fleet_pull_keys_per_sec`
+for bench_trend.
+
 MFU accounting lives in BASELINE.md (updated whenever the recorded
 baseline moves).
 """
@@ -1419,6 +1426,29 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — diagnostic tier, not the metric
         hostplane = {"error": repr(e)[:200]}
 
+    # round-21: multi-box serving fleet ladder (QPS vs box count over
+    # real spawned grids, coalescing RPC reduction, journal staleness,
+    # kill-one-replica failover — tools/fleet_probe.py, recorded in
+    # BASELINE.md). GUARDED: a failure here must not cost the headline.
+    fleet = None
+    try:
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "fleet_probe.py")],
+            capture_output=True, text=True, timeout=240)
+        for line in r.stdout.strip().splitlines():
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if d.get("probe") == "fleet":
+                fleet = d
+        if fleet is None:
+            fleet = {"error": "no fleet line; rc=%d" % r.returncode}
+    except Exception as e:  # noqa: BLE001 — diagnostic tier, not the metric
+        fleet = {"error": repr(e)[:200]}
+
     eps = result["examples_per_sec"]
     base = env_baseline or SELF_BASELINE.get(result["platform"]) or 0.0
     vs = eps / base if base > 0 else 1.0
@@ -1469,6 +1499,9 @@ def main() -> None:
         "device_bytes_accessed_per_example": result.get(
             "device_bytes_accessed_per_example", 0),
         "hostplane": hostplane,
+        "fleet": fleet,
+        "fleet_pull_keys_per_sec": (fleet.get("ladder") or [{}])[-1].get(
+            "keys_per_sec", 0),
         "compile_warmup_s": result.get("compile_warmup_s"),
         "diags": diags,
     }

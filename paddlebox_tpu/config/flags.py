@@ -422,6 +422,36 @@ define_flag("serving_slo_us", 15000.0,
             "degraded. Default 15ms sits above the recorded quiet-"
             "container p99 ceiling (BASELINE round 12: 4.6-7.1ms at "
             "b4096 incl first-touch page-in). <=0 disables the gauge")
+define_flag("serving_num_shards", 1,
+            "serving fleet width in BOXES (round 21): the sharded tier "
+            "partitions the key space across this many boxes; each box "
+            "filters its views to its own slice (serving/store.py "
+            "ShardSpec) and the fleet client routes every pull by the "
+            "same policy. 1 = the single-box plane, no filtering")
+define_flag("serving_shard_index", -1,
+            "which box of the serving fleet THIS process serves "
+            "(0..serving_num_shards-1). -1 = unsharded: serve the full "
+            "view (single-box mode, probes, tests). MultiBoxFleet sets "
+            "this per child via flag overrides")
+define_flag("serving_shard_policy", "",
+            "sharding policy name for the serving fleet partition "
+            "(parallel/sharding.py resolve_sharding_policy): '' = the "
+            "flag-configured trainer policy (sharding_policy), so the "
+            "serving partition matches training by default; set "
+            "explicitly ('key-mod', '2d-grid') to diverge")
+define_flag("serving_hot_keys", "",
+            "path to a hot-key set file (serving/store.py "
+            "write_hot_keys): every box ADDITIONALLY keeps these rows — "
+            "the replicated hot tier — so the client may answer a "
+            "head-key pull from ANY box instead of converging on the "
+            "owner. '' = no replicated tier")
+define_flag("serving_journal_dir", "",
+            "comma-separated touched-row journal dirs to tail for "
+            "journal-fed freshness (round 21, serving/refresh.py "
+            "JournalDeltaSource): touched rows land in the served view "
+            "one refresh poll after the trainer flushes them, cutting "
+            "staleness from the SaveDelta interval to seconds. '' = "
+            "refresh from completed xbox views only")
 define_flag("ckpt_format", "columnar",
             "sparse batch-model checkpoint format (round 15): 'columnar' "
             "= sparse.xman manifest + N striped binary part files "

@@ -45,12 +45,13 @@ import numpy as np
 from paddlebox_tpu.embedding.accessor import CLICK, SHOW, UNSEEN_DAYS
 from paddlebox_tpu.embedding.ckpt_store import map_part, write_part
 
-# MOVE directions across the resident/tier boundary — defined HERE (the
-# dependency-light leaf) and re-exported by train.journal as the KIND_MOVE
-# payload op codes; the stores import them from this module so the
+# MOVE directions across the resident/tier boundary — canonical in the
+# jax-free journal-format leaf (utils/journal_format.py, round 21: the
+# serving plane tails the same records) and re-exported here AND by
+# train.journal; the stores keep importing them from this module so the
 # embedding layer never imports the train package at module scope
-MV_SPILL = 1              # resident rows -> SSD tier
-MV_FAULT_IN = 2           # SSD tier -> resident
+from paddlebox_tpu.utils.journal_format import (  # noqa: F401
+    MV_FAULT_IN, MV_SPILL)
 
 
 def apply_missed_days(vals: np.ndarray, missed, decay_rate: float) -> None:

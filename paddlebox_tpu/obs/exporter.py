@@ -53,6 +53,32 @@ SCHEMA_VERSION = 1
 #: Prometheus text exposition content type
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
+# fleet-health provider (round 21): whoever runs a MultiBoxFleet in
+# this process registers a zero-arg callable returning the fleet-wide
+# serving record (QPS, p50/p99); /health merges it defensively — the
+# health endpoint must answer even when the fleet is mid-teardown
+_fleet_health_lock = make_lock("exporter._fleet_health_lock")
+_fleet_health_provider = None  # guarded-by: _fleet_health_lock
+
+
+def set_fleet_health_provider(provider) -> None:
+    """Register (or clear, with None) the serving-fleet health section
+    of /health. One provider per process — last registration wins."""
+    global _fleet_health_provider
+    with _fleet_health_lock:
+        _fleet_health_provider = provider
+
+
+def _fleet_health_section() -> Optional[dict]:
+    with _fleet_health_lock:
+        provider = _fleet_health_provider
+    if provider is None:
+        return None
+    try:
+        return provider()
+    except Exception as e:
+        return {"type": "serving_fleet", "error": repr(e)}
+
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
 
@@ -267,18 +293,26 @@ class ObsExporter:
             rep = self._reporter
         agg = getattr(rep, "aggregator", None)
         health = getattr(agg, "health", None) if agg is not None else None
+        fleet = _fleet_health_section()
         if health is not None and health.last_health is not None:
-            return self._send_json(handler, health.last_health)
+            record = health.last_health
+            if fleet is not None:
+                record = dict(record)
+                record["serving_fleet"] = fleet
+            return self._send_json(handler, record)
         # non-rank-0 (or single-rank): answer own liveness so every
         # rank's endpoint is curl-able with the same verb
         last = rep.peek() if rep is not None else None
-        self._send_json(handler, {
+        record = {
             "type": "rank_liveness", "v": SCHEMA_VERSION,
             "rank": self.rank, "ts": time.time(),
             "last_report_step": (last or {}).get("step"),
             "last_report_ts": (last or {}).get("ts"),
             "note": "per-rank view; the merged cluster_health record "
-                    "lives on rank 0's endpoint"})
+                    "lives on rank 0's endpoint"}
+        if fleet is not None:
+            record["serving_fleet"] = fleet
+        self._send_json(handler, record)
 
     def _stacks(self, handler) -> None:
         from paddlebox_tpu.obs.flight import _thread_stacks

@@ -94,6 +94,33 @@ def default_dest_plan(mesh, local_positions: Sequence[int],
     return [mesh.positions_of[r] for r in range(mesh.world)]
 
 
+def partition_pull(policy: "ShardingPolicy", keys: np.ndarray,
+                   hot_keys: Optional[np.ndarray] = None,
+                   hot_dest: int = 0) -> List[np.ndarray]:
+    """Client-side pull partitioning (round 21): the serving-fleet twin
+    of the dest plan — ``policy.shard_of`` decides WHAT each box owns
+    (identically to the training exchange, so a box's filtered view is
+    exactly the slab its trainer rank held), and this splits one pull's
+    key vector into per-box position lists. ``hot_keys`` (sorted unique
+    uint64 — the replicated hot tier every box additionally holds) are
+    re-routed to ``hot_dest % num_shards`` instead of their owner:
+    head keys would otherwise converge every pull on one box; rotating
+    hot_dest per pull spreads exactly the skewed head that 2-D grid
+    row-rebalancing spreads in training. Returns one positions array
+    per shard (some possibly empty); their concatenation is a
+    permutation of arange(len(keys))."""
+    keys = np.asarray(keys, np.uint64).reshape(-1)
+    dest = np.asarray(policy.shard_of(keys), np.int64).copy()
+    if hot_keys is not None and len(hot_keys) and keys.size:
+        hot_keys = np.asarray(hot_keys, np.uint64)
+        idx = np.searchsorted(hot_keys, keys)
+        hot = (idx < hot_keys.size) & (
+            hot_keys[np.minimum(idx, hot_keys.size - 1)] == keys)
+        dest[hot] = int(hot_dest) % policy.num_shards
+    return [np.nonzero(dest == s)[0]
+            for s in range(policy.num_shards)]
+
+
 class FreqSketch:
     """Bounded frequency sketch with halving decay — the serving hot-key
     cache's TinyLFU admission machinery (serving/cache.py ``_freq``)

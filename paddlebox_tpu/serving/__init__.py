@@ -1,4 +1,4 @@
-"""serving: the online inference tier (round 12).
+"""serving: the online inference tier (round 12; multi-box round 21).
 
 The "millions of users" half of the north star — the consumer side of
 the SaveBase/SaveDelta xbox cadence (box_wrapper.cc:1286-1318), grown
@@ -6,7 +6,8 @@ from the serve_xbox.py demo into a real low-latency plane:
 
   * store    — mmap columnar views + the base+delta precedence stack
                (bit-parity with the XboxModelReader oracle, no RAM
-               ingest; N processes share page cache)
+               ingest; N processes share page cache) + ShardSpec view
+               filtering for the multi-box partition
   * cache    — hot-key rows in front of the mmap store: frequency-gated
                admission + CLOCK eviction (HierarchicalKV's
                cache-semantics store is the model, PAPERS.md)
@@ -16,9 +17,15 @@ from the serve_xbox.py demo into a real low-latency plane:
                pool, graceful drain, StepReport obs (p50/p99 lookup
                latency, keys/s, cache hit rate)
   * refresh  — SaveDelta watcher: poll → compile → atomic generation
-               swap, in-flight requests never dropped
-  * client   — round-robin replica failover pulls
-  * fleet    — N spawned replica processes per box
+               swap, in-flight requests never dropped; plus the
+               journal-fed overlay (JournalDeltaSource) that lands
+               touched rows in seconds instead of a SaveDelta interval
+  * client   — round-robin replica failover pulls with re-probe
+               backoff; FleetClient routes pulls across boxes by the
+               training sharding policy and coalesces concurrent pulls
+               into one deduped RPC per box
+  * fleet    — N spawned replica processes per box (ServingFleet), and
+               the B boxes × R replicas sharded grid (MultiBoxFleet)
 
 Import surface is deliberately jax-free (numpy + stdlib + the native
 .so): a serving process must spawn in milliseconds and never pay for —
@@ -26,15 +33,20 @@ or inherit — an accelerator runtime.
 """
 
 from paddlebox_tpu.serving.cache import HotKeyCache  # noqa: F401
-from paddlebox_tpu.serving.client import ServingClient  # noqa: F401
+from paddlebox_tpu.serving.client import (FleetClient,  # noqa: F401
+                                          ServingClient)
 from paddlebox_tpu.serving.codec import (decode_rows,  # noqa: F401
                                          encode_pull)
-from paddlebox_tpu.serving.fleet import ServingFleet  # noqa: F401
+from paddlebox_tpu.serving.fleet import (MultiBoxFleet,  # noqa: F401
+                                         ServingFleet)
 from paddlebox_tpu.serving.refresh import (DeltaRefreshWatcher,  # noqa: F401
+                                           JournalDeltaSource,
                                            ViewManager, make_manager)
 from paddlebox_tpu.serving.server import ServingServer  # noqa: F401
 from paddlebox_tpu.serving.store import (MmapViewStack,  # noqa: F401
-                                         MmapXboxStore, build_stack,
-                                         compile_view_dir,
+                                         MmapXboxStore, ShardSpec,
+                                         build_stack, compile_view_dir,
                                          discover_xbox_sources,
+                                         read_hot_keys,
+                                         write_hot_keys,
                                          write_xbox_columnar)

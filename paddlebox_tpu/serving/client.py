@@ -1,9 +1,27 @@
-"""Serving client: batched pulls against a fleet of replicas.
+"""Serving clients: batched pulls against one box's replicas, and the
+fleet router over many boxes.
 
-Every replica serves the FULL composed view (they mmap the same store
-files — page cache is shared, so N processes cost one copy of the row
-bytes), which makes the client trivially stateless: pick a replica
-round-robin per pull, fail over to the next on a transport error.
+``ServingClient`` talks to the replicas of ONE box: every replica
+serves the same view (they mmap the same store files — page cache is
+shared, so N processes cost one copy of the row bytes), which makes the
+client trivially stateless: pick a replica round-robin per pull, fail
+over to the next on a transport error — with an exponential re-probe
+backoff per replica so a dead box costs one dial timeout per 2^k
+skipped attempts instead of one per pull (the obs aggregator's
+publish-backoff pattern, denominated in skipped attempts because a
+serving client has no clock of its own between pulls).
+
+``FleetClient`` (round 21) is the multi-box router: it splits every
+pull by the SAME sharding policy the training exchange routes by
+(parallel/sharding.py partition_pull), sends each box only the keys it
+holds — hot-tier keys to a rotating box, since every box replicates the
+head — and scatters the row slices back into caller order. Concurrent
+pulls toward one box COALESCE: a per-shard worker drains whatever
+callers queued while the previous RPC was in flight, unions their key
+sets into one deduped request, and scatters the shared response back to
+every waiter — at concurrency C the box sees ~1 RPC per in-flight
+window instead of C, and duplicated head keys are pulled once.
+
 Class resolution never happens on the response path either — the
 client unpickles with ``plain_loads`` too, so a compromised or
 misconfigured server can't hand the client a class-bearing payload.
@@ -13,14 +31,22 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Dict, List, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from paddlebox_tpu.obs.tracer import next_trace_id, record_span
 from paddlebox_tpu.serving import codec
 from paddlebox_tpu.utils.rpc import FramedClient, plain_loads
+from paddlebox_tpu.utils.stats import hist_percentile, stat_add
 from paddlebox_tpu.utils.lockwatch import make_lock
+
+#: per-replica failover backoff: after the k-th consecutive failure the
+#: replica is skipped for min(2^(k-1), CAP) ATTEMPTS before one probe
+#: is allowed through — so a recovered replica is re-dialed within a
+#: bounded number of pulls, and a dead one costs a dial timeout only
+#: every CAP attempts (mirrors obs/aggregate.py BACKOFF_SKIP_CAP)
+BACKOFF_SKIP_CAP = 16
 
 
 class ServingClient:
@@ -38,6 +64,8 @@ class ServingClient:
         self._clients: List = [None] * len(self.endpoints)  # guarded-by: _lock
         self._rr = 0  # guarded-by: _lock
         self.last_gen = -1  # guarded-by: _lock
+        self._fail_streak = [0] * len(self.endpoints)  # guarded-by: _lock
+        self._skip_left = [0] * len(self.endpoints)  # guarded-by: _lock
 
     def _client_at(self, i: int) -> FramedClient:
         with self._lock:
@@ -70,22 +98,56 @@ class ServingClient:
             self._rr += 1
         return i
 
-    # -------------------------------------------------------------- pulls
-    def pull(self, keys: np.ndarray) -> np.ndarray:
-        """[K] uint64 feasigns → [K, dim] float32 embedding rows.
-        Tries every replica once (round-robin start) before giving up;
-        a draining replica or a dead connection fails over. Each pull
-        mints a 64-bit trace id carried in the request frame (round 14)
-        — the client- and server-side spans share it, so a stitched
-        trace shows the request crossing the RPC boundary."""
-        trace = next_trace_id()
-        req = codec.encode_pull(keys, trace=trace)
-        t_pull = time.perf_counter()
-        start = self._pick()
+    def _attempt_order(self, start: int) -> List[int]:
+        """Round-robin failover order MINUS replicas still inside their
+        failure backoff — each exclusion burns one skip credit, which
+        is what denominates the backoff in SKIPPED ATTEMPTS (a client
+        between pulls has no other clock). If backoff would exclude
+        every replica, ignore it: a pull with no candidate must probe
+        rather than fail without trying."""
         n = len(self.endpoints)
+        order = [(start + k) % n for k in range(n)]
+        with self._lock:
+            live = []
+            for i in order:
+                if self._skip_left[i] > 0:
+                    self._skip_left[i] -= 1
+                    stat_add("serving_client_skips")
+                else:
+                    live.append(i)
+        return live or order
+
+    def _note_failure(self, i: int) -> None:
+        with self._lock:
+            self._fail_streak[i] += 1
+            self._skip_left[i] = min(BACKOFF_SKIP_CAP,
+                                     2 ** (self._fail_streak[i] - 1))
+
+    def _note_success(self, i: int) -> None:
+        with self._lock:
+            recovered = self._fail_streak[i] > 0
+            self._fail_streak[i] = 0
+            self._skip_left[i] = 0
+        if recovered:
+            stat_add("serving_client_reprobes")
+
+    # -------------------------------------------------------------- pulls
+    def pull(self, keys: np.ndarray,
+             shard: Optional[int] = None) -> np.ndarray:
+        """[K] uint64 feasigns → [K, dim] float32 embedding rows.
+        Tries every in-backoff-window replica once (round-robin start)
+        before giving up; a draining replica or a dead connection fails
+        over. Each pull mints a 64-bit trace id carried in the request
+        frame (round 14) — the client- and server-side spans share it,
+        so a stitched trace shows the request crossing the RPC
+        boundary. ``shard`` declares the box index a FLEET router chose
+        (round 21); a sharded server refuses a mismatch loudly."""
+        trace = next_trace_id()
+        req = codec.encode_pull(keys, trace=trace, shard=shard)
+        t_pull = time.perf_counter()
+        order = self._attempt_order(self._pick())
         last_err: Exception = RuntimeError("no endpoints")
-        for k in range(n):
-            i = (start + k) % n
+        for i in order:
             try:
                 resp = self._client_at(i).call(req)
             except OSError as e:
@@ -95,6 +157,7 @@ class ServingClient:
                 # (FramedClient wraps those to ConnectionError ⊂
                 # OSError): drop the conn and fail over to a sibling
                 self._drop_client(i)
+                self._note_failure(i)
                 last_err = e
                 continue
             except RuntimeError as e:
@@ -104,13 +167,15 @@ class ServingClient:
                     last_err = e
                     continue
                 raise
+            self._note_success(i)
             with self._lock:
                 self.last_gen = int(resp.get("gen", -1))
             record_span("serving_pull_client", t_pull,
                         time.perf_counter(), trace=trace)
             return codec.decode_rows(resp)
         raise ConnectionError(
-            f"all {n} serving replicas failed") from last_err
+            f"all {len(self.endpoints)} serving replicas failed"
+        ) from last_err
 
     # ------------------------------------------------------------ control
     def _call_at(self, i: int, req: Dict[str, Any]) -> Any:
@@ -137,3 +202,232 @@ class ServingClient:
         for c in clients:
             if c is not None:
                 c.close()
+
+
+class _PullWaiter:
+    """One caller's slice of a coalesced batch."""
+
+    __slots__ = ("keys", "done", "rows", "err")
+
+    def __init__(self, keys: np.ndarray) -> None:
+        self.keys = keys
+        self.done = threading.Event()
+        self.rows: Optional[np.ndarray] = None
+        self.err: Optional[Exception] = None
+
+    def result(self) -> np.ndarray:
+        self.done.wait()
+        if self.err is not None:
+            raise self.err
+        return self.rows
+
+
+class _ShardCoalescer:
+    """Single-flights one box's pulls: a dedicated worker drains every
+    waiter queued while the previous RPC was in flight, unions their
+    key sets into ONE deduped request, and scatters the shared rows
+    back per waiter. The pending window is therefore exactly the RPC
+    round-trip — no added latency knob to tune: at concurrency 1 the
+    worker sends immediately; under load the batch grows to whatever
+    arrived during the flight. ``coalesce=False`` degrades to one RPC
+    per waiter through the same worker (the A/B arm the fleet bench
+    measures the RPC-reduction claim against)."""
+
+    def __init__(self, client: ServingClient, shard: int,
+                 coalesce: bool = True) -> None:
+        self.client = client
+        self.shard = int(shard)
+        self.coalesce = bool(coalesce)
+        self._cv = threading.Condition()
+        self._queue: List[_PullWaiter] = []  # guarded-by: _cv
+        self._stopped = False  # guarded-by: _cv
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"fleet-pull-s{shard}")
+        self._thread.start()
+
+    def submit(self, keys: np.ndarray) -> _PullWaiter:
+        w = _PullWaiter(keys)
+        with self._cv:
+            if self._stopped:
+                raise RuntimeError("fleet client is closed")
+            self._queue.append(w)
+            self._cv.notify()
+        return w
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stopped:
+                    self._cv.wait()
+                if self._stopped and not self._queue:
+                    return
+                batch, self._queue = self._queue, []
+            if self.coalesce:
+                self._flight_coalesced(batch)
+            else:
+                for w in batch:
+                    try:
+                        w.rows = self.client.pull(w.keys,
+                                                  shard=self.shard)
+                        stat_add("serving_fleet_rpcs")
+                        stat_add("serving_fleet_keys_sent",
+                                 int(w.keys.size))
+                    except Exception as e:  # delivered to the caller
+                        w.err = e
+                    w.done.set()
+
+    def _flight_coalesced(self, batch: List[_PullWaiter]) -> None:
+        union = np.unique(np.concatenate([w.keys for w in batch]))
+        try:
+            rows = self.client.pull(union, shard=self.shard)
+            stat_add("serving_fleet_rpcs")
+            stat_add("serving_fleet_keys_sent", int(union.size))
+            if len(batch) > 1:
+                stat_add("serving_fleet_coalesced", len(batch) - 1)
+        except Exception as e:      # every waiter of the batch fails
+            for w in batch:
+                w.err = e
+                w.done.set()
+            return
+        for w in batch:
+            # union is sorted unique ⊇ w.keys: searchsorted is exact
+            w.rows = rows[np.searchsorted(union, w.keys)]
+            w.done.set()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        self._thread.join(timeout=10.0)
+        # resolve anything that raced the stop
+        with self._cv:
+            stuck, self._queue = self._queue, []
+        for w in stuck:
+            w.err = RuntimeError("fleet client is closed")
+            w.done.set()
+
+
+class FleetClient:
+    """Multi-box router: one ``ServingClient`` (replica failover
+    inside) + one coalescing worker per box. Thread-safe; pulls block
+    only on their own shards' flights."""
+
+    def __init__(self, shard_endpoints: Sequence[Sequence[Tuple[str, int]]],
+                 policy=None,
+                 hot_keys: Optional[np.ndarray] = None,
+                 timeout: float = 30.0,
+                 coalesce: bool = True) -> None:
+        """shard_endpoints: one replica endpoint list PER BOX, indexed
+        by shard — the client-side mirror of each box's ShardSpec.
+        policy: the fleet partition (default KeyModPolicy over the box
+        count); MUST match the policy the boxes filtered their views
+        by. hot_keys: the replicated hot tier's key set (every box
+        holds these rows; pulls for them rotate across boxes)."""
+        from paddlebox_tpu.parallel.sharding import (KeyModPolicy,
+                                                     partition_pull)
+        if not shard_endpoints:
+            raise ValueError("need at least one shard")
+        self.policy = policy if policy is not None \
+            else KeyModPolicy(len(shard_endpoints))
+        if self.policy.num_shards != len(shard_endpoints):
+            raise ValueError(
+                f"policy routes {self.policy.num_shards} shards but "
+                f"{len(shard_endpoints)} endpoint groups were given")
+        self._partition = partition_pull
+        self.hot = (np.unique(np.asarray(hot_keys, np.uint64))
+                    if hot_keys is not None and len(hot_keys) else None)
+        self.clients = [ServingClient(eps, timeout=timeout)
+                        for eps in shard_endpoints]
+        self._coalescers = [_ShardCoalescer(c, s, coalesce=coalesce)
+                            for s, c in enumerate(self.clients)]
+        self._lock = make_lock("FleetClient._lock")
+        self._rot = 0  # guarded-by: _lock
+        self._prev_stats: Optional[Tuple[float, int]] = None  # guarded-by: _lock
+
+    # -------------------------------------------------------------- pulls
+    def pull(self, keys: np.ndarray) -> np.ndarray:
+        """[K] uint64 → [K, dim] float32, bit-identical to a single
+        full-view box answering the same pull: each box returns its
+        slice of the partition, and scatter restores caller order."""
+        keys = np.asarray(keys, np.uint64).reshape(-1)
+        stat_add("serving_fleet_pulls")
+        stat_add("serving_fleet_keys_in", int(keys.size))
+        with self._lock:
+            rot = self._rot
+            self._rot += 1
+        parts = self._partition(self.policy, keys, self.hot,
+                                hot_dest=rot)
+        if self.hot is not None and keys.size:
+            pos = np.searchsorted(self.hot, keys)
+            hot = (pos < self.hot.size) & (
+                self.hot[np.minimum(pos, self.hot.size - 1)] == keys)
+            stat_add("serving_fleet_hot_routed", int(hot.sum()))
+        waiters = [(idx, self._coalescers[s].submit(keys[idx]))
+                   for s, idx in enumerate(parts) if idx.size]
+        if not waiters:
+            return np.zeros((0, 0), np.float32)
+        out = None
+        err: Optional[Exception] = None
+        for idx, w in waiters:
+            try:
+                rows = w.result()
+            except Exception as e:
+                err = err or e
+                continue
+            if out is None:
+                out = np.zeros((keys.size, rows.shape[1]), np.float32)
+            out[idx] = rows
+        if err is not None:
+            raise err
+        return out
+
+    # ------------------------------------------------------------ control
+    def fleet_stats(self) -> Dict[str, Any]:
+        """Merged view across every reachable replica of every box:
+        elementwise-summed lookup histograms → fleet p50/p99, request/
+        key totals, and QPS from the request delta since the previous
+        call (None on the first)."""
+        counts: Optional[List[int]] = None
+        requests = keys = 0
+        replicas = []
+        for s, c in enumerate(self.clients):
+            for i in range(len(c.endpoints)):
+                try:
+                    st = c.stats(i)
+                except (OSError, RuntimeError):
+                    continue
+                replicas.append({"shard": s, "replica": i,
+                                 "gen": st.get("gen"),
+                                 "shard_tag": st.get("shard", "")})
+                requests += int(st.get("requests", 0))
+                keys += int(st.get("keys", 0))
+                hist = st.get("lookup_us_counts") or []
+                if hist:
+                    counts = ([a + b for a, b in zip(counts, hist)]
+                              if counts else list(hist))
+        now = time.time()
+        with self._lock:
+            prev, self._prev_stats = self._prev_stats, (now, requests)
+        qps = None
+        if prev is not None and now > prev[0]:
+            qps = (requests - prev[1]) / (now - prev[0])
+        return {
+            "boxes": len(self.clients),
+            "replicas": replicas,
+            "requests": requests,
+            "keys": keys,
+            "qps": qps,
+            "p50_us": hist_percentile(counts, 0.50) if counts else None,
+            "p99_us": hist_percentile(counts, 0.99) if counts else None,
+        }
+
+    def drain_all(self) -> None:
+        for c in self.clients:
+            c.drain_all()
+
+    def close(self) -> None:
+        for co in self._coalescers:
+            co.stop()
+        for c in self.clients:
+            c.close()

@@ -19,15 +19,22 @@ import numpy as np
 
 
 def encode_pull(keys: np.ndarray,
-                trace: Optional[int] = None) -> Dict[str, Any]:
+                trace: Optional[int] = None,
+                shard: Optional[int] = None) -> Dict[str, Any]:
     """[K] uint64 feasigns → pull request frame. ``trace`` (round 14)
     is the optional 64-bit request trace id — a plain int in the plain-
     container wire, recorded on the server-side span so one pull can be
-    followed client → replica in a stitched cluster trace."""
+    followed client → replica in a stitched cluster trace. ``shard``
+    (round 21) is the box index the fleet client ROUTED this pull to: a
+    sharded server cross-checks it against its own index and refuses a
+    mismatch loudly — a permuted endpoint list would otherwise serve
+    silent all-zero misses for every non-hot key."""
     keys = np.ascontiguousarray(np.asarray(keys, np.uint64).reshape(-1))
     req = {"method": "pull", "keys": keys.tobytes(), "n": int(keys.size)}
     if trace is not None:
         req["trace"] = int(trace)
+    if shard is not None:
+        req["shard"] = int(shard)
     return req
 
 
@@ -36,6 +43,14 @@ def decode_trace(req: Dict[str, Any]):
     garbage trace id must not fail a pull (telemetry is best-effort)."""
     t = req.get("trace")
     return int(t) if isinstance(t, int) else None
+
+
+def decode_shard(req: Dict[str, Any]):
+    """The box index the client routed to, or None (unrouted clients —
+    the single-box ServingClient — declare nothing and are accepted by
+    any box)."""
+    s = req.get("shard")
+    return int(s) if isinstance(s, int) else None
 
 
 def decode_pull_keys(req: Dict[str, Any]) -> np.ndarray:

@@ -12,9 +12,15 @@ Shutdown is graceful end to end: the parent asks each replica to drain
 over the data port (in-flight pulls finish, new ones are refused), then
 joins the processes.
 
-This is the single-box fleet (the loader-box role). A multi-box serving
-tier is this module per box behind any TCP load balancer — the client
-already fails over between replica endpoints.
+``ServingFleet`` is the single-box fleet (the loader-box role).
+``MultiBoxFleet`` (round 21) is the sharded tier over it: B boxes × R
+replicas, each box's children flagged with their ShardSpec (index,
+policy, hot-key set) so every replica filters its views to its box's
+slice of the partition, and ``client()`` hands back the FleetClient
+that routes, coalesces and fails over across the whole grid. No load
+balancer sits in front: routing is CLIENT-side by the same policy the
+boxes shard by, which is what makes the per-box views small and the
+replicated hot tier reachable from any box.
 """
 
 from __future__ import annotations
@@ -25,7 +31,7 @@ import os
 import sys
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from paddlebox_tpu.serving.client import ServingClient
+from paddlebox_tpu.serving.client import FleetClient, ServingClient
 
 
 @contextlib.contextmanager
@@ -87,7 +93,11 @@ class ServingFleet:
                  days: Optional[Sequence[str]] = None,
                  processes: int = 2, host: str = "127.0.0.1",
                  flag_overrides: Optional[Dict[str, object]] = None,
-                 start_timeout: float = 60.0) -> None:
+                 start_timeout: float = 60.0,
+                 rank_base: int = 0) -> None:
+        """rank_base offsets the replicas' PBTPU_RANK (reports, flight-
+        recorder files): MultiBoxFleet gives box b base b*replicas so
+        no two children of the grid attribute to the same rank."""
         if processes < 1:
             raise ValueError("need at least one serving process")
         ctx = mp.get_context("spawn")
@@ -102,8 +112,8 @@ class ServingFleet:
                         target=_serve_child,
                         args=(xbox_model_dir, list(days) if days else None,
                               host, child, dict(flag_overrides or {}),
-                              rank),
-                        daemon=True, name=f"serving-{rank}")
+                              rank_base + rank),
+                        daemon=True, name=f"serving-{rank_base + rank}")
                     p.start()
                     child.close()
                     self._procs.append(p)
@@ -154,6 +164,101 @@ class ServingFleet:
         self._pipes = []
 
     def __enter__(self) -> "ServingFleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class MultiBoxFleet:
+    """B boxes × R replicas over one xbox store root (round 21).
+
+    Each box is one ServingFleet whose children carry that box's shard
+    flags (serving_shard_index/num_shards/policy + the shared hot-key
+    file), so every replica filters its mmap views down to its box's
+    slice — B boxes hold the full key space once (plus the replicated
+    hot tier B times). ``client()`` returns the FleetClient routing by
+    the SAME policy; ``health()`` is the fleet-wide record (QPS,
+    p50/p99 from elementwise-summed replica histograms) the obs
+    /health endpoint publishes while the fleet is up."""
+
+    def __init__(self, xbox_model_dir: str,
+                 days: Optional[Sequence[str]] = None,
+                 boxes: int = 2, replicas: int = 1,
+                 host: str = "127.0.0.1",
+                 policy_name: Optional[str] = None,
+                 hot_keys_path: Optional[str] = None,
+                 journal_dirs: Optional[Sequence[str]] = None,
+                 flag_overrides: Optional[Dict[str, object]] = None,
+                 start_timeout: float = 60.0) -> None:
+        if boxes < 1:
+            raise ValueError("need at least one box")
+        from paddlebox_tpu.parallel.sharding import resolve_sharding_policy
+        from paddlebox_tpu.serving.store import read_hot_keys
+        # resolve the client policy FIRST: a typo'd policy_name must
+        # fail here, not after B*R processes spawned
+        self.policy = resolve_sharding_policy(boxes, name=policy_name)
+        self.hot_keys = (read_hot_keys(hot_keys_path)
+                         if hot_keys_path else None)
+        self.boxes: List[ServingFleet] = []
+        base = dict(flag_overrides or {})
+        try:
+            for b in range(boxes):
+                ov = dict(base)
+                ov["serving_shard_index"] = b
+                ov["serving_num_shards"] = boxes
+                if policy_name:
+                    ov["serving_shard_policy"] = policy_name
+                if hot_keys_path:
+                    ov["serving_hot_keys"] = hot_keys_path
+                if journal_dirs:
+                    ov["serving_journal_dir"] = ",".join(journal_dirs)
+                self.boxes.append(ServingFleet(
+                    xbox_model_dir, days=days, processes=replicas,
+                    host=host, flag_overrides=ov,
+                    start_timeout=start_timeout,
+                    rank_base=b * replicas))
+        except BaseException:
+            self.close(drain=False)
+            raise
+        self._health_client = self.client(timeout=5.0)
+        self._health_client.fleet_stats()    # seed the QPS delta base
+        from paddlebox_tpu.obs import exporter as _exporter
+        _exporter.set_fleet_health_provider(self.health)
+
+    @property
+    def shard_endpoints(self) -> List[List[Tuple[str, int]]]:
+        return [list(b.endpoints) for b in self.boxes]
+
+    def client(self, timeout: float = 30.0,
+               coalesce: bool = True) -> FleetClient:
+        return FleetClient(self.shard_endpoints, policy=self.policy,
+                           hot_keys=self.hot_keys, timeout=timeout,
+                           coalesce=coalesce)
+
+    def health(self) -> Dict[str, object]:
+        """Fleet-wide serving health — merged through the obs /health
+        endpoint (exporter.py) while the fleet is up."""
+        st = self._health_client.fleet_stats()
+        st["type"] = "serving_fleet"
+        st["policy"] = self.policy.describe()
+        st["hot_rows"] = int(self.hot_keys.size) \
+            if self.hot_keys is not None else 0
+        return st
+
+    def close(self, drain: bool = True,
+              join_timeout: float = 30.0) -> None:
+        from paddlebox_tpu.obs import exporter as _exporter
+        _exporter.set_fleet_health_provider(None)
+        hc = getattr(self, "_health_client", None)
+        if hc is not None:
+            hc.close()
+            self._health_client = None
+        for b in self.boxes:
+            b.close(drain=drain, join_timeout=join_timeout)
+        self.boxes = []
+
+    def __enter__(self) -> "MultiBoxFleet":
         return self
 
     def __exit__(self, *exc) -> None:

@@ -26,14 +26,20 @@ in the post-swap cache (serving/cache.py).
 
 from __future__ import annotations
 
+import os
+import shutil
+import struct
+import tempfile
 import threading
-from typing import Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from paddlebox_tpu.obs import log
 from paddlebox_tpu.serving.cache import HotKeyCache
-from paddlebox_tpu.serving.store import MmapViewStack, build_stack
+from paddlebox_tpu.serving.store import (MmapViewStack, ShardSpec,
+                                         build_stack, write_xbox_columnar)
+from paddlebox_tpu.utils import journal_format as jf
 from paddlebox_tpu.utils.stats import gauge_set, stat_add
 from paddlebox_tpu.utils.lockwatch import make_lock
 
@@ -118,18 +124,153 @@ class ViewManager:
             self._current[1].close()
 
 
+class JournalDeltaSource:
+    """Journal-fed freshness (round 21): tail the trainer's touched-row
+    journal segments (train/journal.py writes them per PASS, flushed
+    per append) and keep the freshest SERVED projection of every
+    touched row as an in-memory overlay, compiled on demand into a
+    columnar view the refresh watcher stacks FRESHEST. Model staleness
+    for touched rows drops from the SaveDelta interval (minutes) to one
+    watcher poll (seconds) — and the overlay rows are the exact bytes
+    ``end_pass`` wrote back, so journal-on-top composes bit-consistently
+    with the on-disk views.
+
+    Soundness rules (what the overlay may and may not claim):
+
+      * ROWS records are absolute upserts — projecting them through the
+        segment header's (width, embedx_dim, optimizer) column math
+        (``jf.xbox_embed_cols``) yields exactly the vector the next
+        SaveDelta would publish for that key.
+      * EV_STAT_SAVE_* rewrite HEADER stat columns only (delta score /
+        unseen days) — the served embed columns are untouched: ignored.
+      * MOVE records relocate rows without changing values: ignored.
+      * EV_AGE_DAYS / EV_SHRINK / EV_TAINT mutate or delete rows out of
+        band: the overlay is DROPPED (conservative — staleness falls
+        back to the SaveDelta cadence until rows are touched again).
+      * A tailer reset (epoch bump at a full base, segment loss to the
+        rotation bound, layout change) also drops this dir's rows and
+        rebuilds from the surviving records of the same poll.
+
+    One source can tail several journal dirs (one per trainer rank);
+    rows are kept per dir so a reset in one rank's journal never
+    discards another rank's rows. All dirs must agree on the projection
+    (embedx_dim/optimizer) — a mismatch raises at poll."""
+
+    def __init__(self, journal_dirs: Sequence[str],
+                 scratch_dir: Optional[str] = None) -> None:
+        dirs = [journal_dirs] if isinstance(journal_dirs, str) \
+            else list(journal_dirs)
+        if not dirs:
+            raise ValueError("need at least one journal dir")
+        self._tailers = [jf.SegmentTailer(d) for d in dirs]
+        self._rows: List[Dict[int, np.ndarray]] = [{} for _ in dirs]
+        self._cols: Optional[np.ndarray] = None  # served-col projection
+        self._proj: Optional[Tuple[int, str]] = None  # (embedx_dim, opt)
+        self._own_scratch = scratch_dir is None
+        self._scratch = scratch_dir or tempfile.mkdtemp(
+            prefix="pbtpu-journal-feed-")
+        self._seq = 0
+        self._compiled: Optional[str] = None
+
+    def _set_projection(self, hdr: Dict) -> None:
+        proj = (int(hdr["embedx_dim"]), str(hdr["optimizer"]))
+        if self._proj is None:
+            self._proj = proj
+            self._cols = jf.xbox_embed_cols(*proj)
+        elif proj != self._proj:
+            raise ValueError(
+                "journal dirs disagree on the served projection: "
+                f"{proj} vs {self._proj} — one serving overlay cannot "
+                "compose rows of different layouts")
+
+    def poll(self) -> bool:
+        """Tail every journal dir once; True when the overlay changed
+        (rows added/updated or dropped) and a re-swap is warranted."""
+        changed = False
+        for i, t in enumerate(self._tailers):
+            recs, reset = t.poll()
+            if reset:
+                stat_add("serving_journal_resets")
+                if self._rows[i]:
+                    changed = True
+                self._rows[i] = {}
+            rows = self._rows[i]
+            for kind, payload in recs:
+                if kind == jf.KIND_HEADER:
+                    self._set_projection(t.header)
+                elif kind == jf.KIND_ROWS:
+                    keys, vals = jf.decode_rows_payload(payload)
+                    proj = np.ascontiguousarray(vals[:, self._cols])
+                    rows.update(zip(keys.tolist(), proj))
+                    changed = True
+                elif kind == jf.KIND_EVENT:
+                    (code,) = struct.unpack_from("<I", payload)
+                    if code in (jf.EV_AGE_DAYS, jf.EV_SHRINK,
+                                jf.EV_TAINT):
+                        # out-of-band value mutation/deletion: the
+                        # overlay can no longer vouch for its rows
+                        if rows:
+                            changed = True
+                        self._rows[i] = rows = {}
+                # KIND_MOVE relocates rows, values unchanged: ignore
+        stat_add("serving_journal_polls")
+        if changed:
+            gauge_set("serving_journal_rows",
+                      sum(len(r) for r in self._rows))
+        return changed
+
+    def compile_overlay(self) -> Optional[str]:
+        """Materialize the overlay as a columnar view file (sorted
+        keys) in the scratch dir and return its path, or None when
+        empty. The PREVIOUS overlay file is unlinked — in-flight stacks
+        that mmap it keep serving it (POSIX inode lifetime), and the
+        refcount retire drops the last reference."""
+        merged: Dict[int, np.ndarray] = {}
+        for rows in self._rows:
+            merged.update(rows)
+        prev, self._compiled = self._compiled, None
+        path = None
+        if merged:
+            keys = np.fromiter(merged.keys(), np.uint64, len(merged))
+            order = np.argsort(keys)
+            rows = np.stack([merged[int(k)] for k in keys[order]])
+            self._seq += 1
+            path = os.path.join(self._scratch,
+                                "overlay-%06d.xcol" % self._seq)
+            write_xbox_columnar(path, keys[order],
+                                np.ascontiguousarray(rows, np.float32))
+            self._compiled = path
+        if prev is not None:
+            try:
+                os.unlink(prev)
+            except OSError:
+                pass
+        return path
+
+    def close(self) -> None:
+        if self._own_scratch:
+            shutil.rmtree(self._scratch, ignore_errors=True)
+
+
 class DeltaRefreshWatcher:
-    """Daemon thread: poll → discover → compile new views → swap."""
+    """Daemon thread: poll → discover (+ tail the journal feed) →
+    compile new views → swap."""
 
     def __init__(self, manager: ViewManager, xbox_model_dir: str,
                  days: Optional[Sequence[str]] = None,
                  poll_secs: Optional[float] = None,
-                 known_sources: Sequence = ()) -> None:
+                 known_sources: Sequence = (),
+                 journal: Optional[JournalDeltaSource] = None,
+                 shard_spec: Optional[ShardSpec] = None) -> None:
         """days: explicit day list (cadence order) or None to
         auto-discover lexically-sorted day dirs each poll (store.
         discover_days). known_sources: the source tuple the manager's
         initial stack was built from (build_stack returns it) so the
-        first poll doesn't immediately re-swap an identical view."""
+        first poll doesn't immediately re-swap an identical view.
+        journal: tail the touched-row journal between SaveDeltas
+        (round 21) — its overlay stacks freshest. shard_spec: this
+        box's slice of the fleet partition; every swapped stack is
+        filtered through it."""
         if poll_secs is None:
             from paddlebox_tpu.config import flags
             poll_secs = float(flags.get_flag("serving_refresh_secs"))
@@ -137,6 +278,8 @@ class DeltaRefreshWatcher:
         self.root = xbox_model_dir
         self.days = list(days) if days else None
         self.poll_secs = max(0.05, float(poll_secs))
+        self.journal = journal
+        self.shard_spec = shard_spec
         self._known = tuple(known_sources)  # watcher-thread only
         self._err_streak = 0                # watcher-thread only
         self._stop = threading.Event()
@@ -177,21 +320,27 @@ class DeltaRefreshWatcher:
 
     def poll_once(self) -> bool:
         """One discovery pass; swaps and returns True when the completed
-        source set changed since the last swap."""
-        stack, sources = None, None
+        source set OR the journal overlay changed since the last swap."""
         from paddlebox_tpu.serving.store import (discover_days,
                                                  discover_xbox_sources)
+        j_changed = self.journal.poll() if self.journal else False
         days = self.days or discover_days(self.root)
         if not days:
             return False
         sources = tuple(discover_xbox_sources(self.root, days))
-        if sources == self._known:
+        if sources == self._known and not j_changed:
             return False
-        stack = MmapViewStack(sources)     # compiles only missing views
+        extra = ()
+        if self.journal is not None:
+            overlay = self.journal.compile_overlay()
+            if overlay:
+                extra = (overlay,)
+        stack = MmapViewStack(sources, shard_spec=self.shard_spec,
+                              extra_files=extra)  # compiles only missing
         self._known = sources
         gen = self.manager.swap(stack)
         log.info("serving view refreshed", gen=gen,
-                 sources=len(sources),
+                 sources=len(sources), overlay=bool(extra),
                  newest=sources[-1].path.rsplit("/", 1)[-1])
         return True
 
@@ -203,17 +352,21 @@ class DeltaRefreshWatcher:
 def make_manager(xbox_model_dir: str,
                  days: Optional[Sequence[str]] = None,
                  cache_rows: Optional[int] = None,
-                 cache_admit: Optional[int] = None
+                 cache_admit: Optional[int] = None,
+                 shard_spec: Optional[ShardSpec] = None
                  ) -> Tuple[ViewManager, tuple]:
     """Flag-configured manager over the current composed view. Returns
     (manager, sources) — hand sources to DeltaRefreshWatcher as
-    known_sources. cache_rows 0 disables the cache."""
+    known_sources. cache_rows 0 disables the cache. shard_spec filters
+    the stack to this box's slice of the fleet partition (hand the SAME
+    spec to the watcher so swapped stacks stay filtered)."""
     from paddlebox_tpu.config import flags
     if cache_rows is None:
         cache_rows = int(flags.get_flag("serving_cache_rows"))
     if cache_admit is None:
         cache_admit = int(flags.get_flag("serving_cache_admit"))
-    stack, sources = build_stack(xbox_model_dir, days)
+    stack, sources = build_stack(xbox_model_dir, days,
+                                 shard_spec=shard_spec)
     cache = (HotKeyCache(cache_rows, stack.dim, admit=cache_admit)
              if cache_rows > 0 else None)
     return ViewManager(stack, cache), sources

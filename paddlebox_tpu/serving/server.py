@@ -32,10 +32,12 @@ from typing import Any, Dict, Optional, Sequence
 from paddlebox_tpu.obs import log, make_step_reporter
 from paddlebox_tpu.obs.tracer import record_span
 from paddlebox_tpu.serving import codec
-from paddlebox_tpu.serving.refresh import (DeltaRefreshWatcher, ViewManager,
+from paddlebox_tpu.serving.refresh import (DeltaRefreshWatcher,
+                                           JournalDeltaSource, ViewManager,
                                            make_manager)
+from paddlebox_tpu.serving.store import ShardSpec, read_hot_keys
 from paddlebox_tpu.utils.rpc import FramedServer, plain_loads
-from paddlebox_tpu.utils.stats import (StatRegistry, gauge_set,
+from paddlebox_tpu.utils.stats import (StatRegistry, gauge_get, gauge_set,
                                        hist_observe, hist_percentile,
                                        stat_add, stat_get)
 from paddlebox_tpu.utils.lockwatch import make_lock
@@ -61,10 +63,13 @@ class ServingServer:
         tests — no root needed; watch is then ignored unless a root is
         also given)."""
         from paddlebox_tpu.config import flags
+        self.shard_spec = self._shard_spec_from_flags()
+        self._journal = self._journal_from_flags()
         if manager is None:
             if xbox_model_dir is None:
                 raise ValueError("need xbox_model_dir or manager")
-            manager, sources = make_manager(xbox_model_dir, days)
+            manager, sources = make_manager(xbox_model_dir, days,
+                                            shard_spec=self.shard_spec)
         else:
             # a pre-built manager knows the sources its current stack
             # composed (empty for from_files probes): seed the watcher
@@ -76,7 +81,8 @@ class ServingServer:
         if watch and xbox_model_dir is not None:
             self.watcher = DeltaRefreshWatcher(
                 manager, xbox_model_dir, days,
-                known_sources=sources).start()
+                known_sources=sources, journal=self._journal,
+                shard_spec=self.shard_spec).start()
         n_threads = max(1, int(pull_threads
                                if pull_threads is not None
                                else flags.get_flag("serving_pull_threads")))
@@ -104,7 +110,34 @@ class ServingServer:
                                     max_frame_bytes=MAX_FRAME_BYTES)
         log.info("serving server up", port=self.port,
                  threads=n_threads,
-                 watch=int(self.watcher is not None))
+                 watch=int(self.watcher is not None),
+                 shard=self.shard_spec.describe()
+                 if self.shard_spec else "full",
+                 journal=int(self._journal is not None))
+
+    @staticmethod
+    def _shard_spec_from_flags() -> Optional[ShardSpec]:
+        """This box's slice of the fleet partition (round 21), or None
+        unsharded. MultiBoxFleet configures children via the serving_*
+        shard flags; standalone boxes default to the full view."""
+        from paddlebox_tpu.config import flags
+        index = int(flags.get_flag("serving_shard_index"))
+        if index < 0:
+            return None
+        from paddlebox_tpu.parallel.sharding import resolve_sharding_policy
+        num = int(flags.get_flag("serving_num_shards"))
+        name = str(flags.get_flag("serving_shard_policy")) or None
+        hot_path = str(flags.get_flag("serving_hot_keys"))
+        hot = read_hot_keys(hot_path) if hot_path else None
+        return ShardSpec(index, resolve_sharding_policy(num, name=name),
+                         hot_keys=hot)
+
+    @staticmethod
+    def _journal_from_flags() -> Optional[JournalDeltaSource]:
+        from paddlebox_tpu.config import flags
+        dirs = [d for d in
+                str(flags.get_flag("serving_journal_dir")).split(",") if d]
+        return JournalDeltaSource(dirs) if dirs else None
 
     @property
     def port(self) -> int:
@@ -137,6 +170,14 @@ class ServingServer:
             self._inflight += 1
         try:
             t0 = time.perf_counter()
+            declared = codec.decode_shard(req)
+            if (declared is not None and self.shard_spec is not None
+                    and declared != self.shard_spec.index):
+                # routing/topology mismatch: answering would serve
+                # all-zero misses for every key this box doesn't hold
+                raise ValueError(
+                    f"pull routed to shard {declared} but this box "
+                    f"serves {self.shard_spec.describe()}")
             keys = codec.decode_pull_keys(req)
             # the conn thread blocks on the bounded pool: lookup
             # concurrency == serving_pull_threads regardless of the
@@ -209,6 +250,17 @@ class ServingServer:
             "cache_miss": stat_get("serving_cache_miss"),
             "cache_evict": stat_get("serving_cache_evict"),
             "last_report": self.reporter.peek(),
+            # round 21: the fleet client merges these across replicas —
+            # raw histogram counts sum elementwise (shared HIST_BOUNDS)
+            # into fleet-wide p50/p99, and (requests, ts) deltas give
+            # QPS without a clock shared across processes
+            "shard": (self.shard_spec.describe()
+                      if self.shard_spec else ""),
+            "journal_rows": int(gauge_get("serving_journal_rows")),
+            "lookup_us_counts": list(
+                StatRegistry.instance().hist_counts("serving_lookup_us")
+                or ()),
+            "ts": time.time(),
         }
 
     # ------------------------------------------------------------ lifecycle
@@ -238,6 +290,8 @@ class ServingServer:
         self._pool.shutdown(wait=True)
         self.reporter.close()
         self.manager.close()
+        if self._journal is not None:
+            self._journal.close()
         log.info("serving server drained", clean=int(clean))
         return clean
 

@@ -41,11 +41,13 @@ import os
 import pickle
 import re
 import threading
+import zlib
 from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
 _XBOX_MAGIC = b"PBTXBOX1"
+_HOT_MAGIC = b"PBTHOTK1"
 
 #: compiled columnar twin of a view dir's embedding.pkl
 VIEW_COLUMNAR_NAME = "view.xcol"
@@ -327,6 +329,97 @@ def compile_view_dir(view_dir: str, force: bool = False) -> str:
 
 
 # ---------------------------------------------------------------------------
+# Fleet data partition (round 21: N boxes, each serving its shard + hot)
+# ---------------------------------------------------------------------------
+
+
+def write_hot_keys(path: str, keys: np.ndarray) -> str:
+    """The fleet's replicated hot set as a tiny binary artifact (8-byte
+    magic, int64 n, sorted unique uint64 keys) — written once by the
+    bring-up side, read by every box AND every client, so both sides
+    agree bit-exactly on which keys any box may answer. Atomic like the
+    columnar views (tmp + rename)."""
+    keys = np.unique(np.ascontiguousarray(keys, np.uint64))
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(_HOT_MAGIC)
+        f.write(np.int64(keys.size).tobytes())
+        keys.tofile(f)
+    os.replace(tmp, path)
+    return path
+
+
+def read_hot_keys(path: str) -> np.ndarray:
+    with open(path, "rb") as f:
+        if f.read(8) != _HOT_MAGIC:
+            raise ValueError(f"{path}: not a hot-key set")
+        n = int(np.frombuffer(f.read(8), np.int64)[0])
+        keys = np.fromfile(f, np.uint64, n)
+    if keys.size != n:
+        raise ValueError(f"{path}: truncated hot-key set")
+    return keys
+
+
+class ShardSpec:
+    """One box's slice of the fleet's data partition: the keys the
+    sharding policy routes to ``index``, plus the replicated HOT set
+    (which every box serves, so the client can answer head keys from
+    any box without a cross-shard hop — the serving twin of the 2-D
+    grid's ReplicatedHotTier).
+
+    ``filter_view`` compiles a view's columnar file down to this box's
+    subset (owned ∪ hot) next to the original — mtime-gated and atomic
+    like ``compile_view_dir``, so M replicas of one box compile once
+    and share the file. Filtering preserves per-view key membership,
+    so the precedence chain over filtered views is bit-identical to
+    the full-view chain for every key this box serves."""
+
+    def __init__(self, index: int, policy,
+                 hot_keys: Optional[np.ndarray] = None) -> None:
+        if not 0 <= int(index) < policy.num_shards:
+            raise ValueError(
+                f"shard index {index} outside policy range "
+                f"[0, {policy.num_shards})")
+        self.index = int(index)
+        self.policy = policy
+        self.hot = (np.unique(np.asarray(hot_keys, np.uint64))
+                    if hot_keys is not None and len(hot_keys)
+                    else np.empty(0, np.uint64))
+        # identity token in the filtered file NAME: a policy or hot-set
+        # change must never reuse a stale filtered view
+        ident = "%s#%d" % (policy.describe(), self.index)
+        self._tag = "s%dof%d-%08x" % (
+            self.index, policy.num_shards,
+            zlib.crc32(ident.encode() + self.hot.tobytes()))
+
+    def describe(self) -> str:
+        """Stable identity string (policy identity + shard index) the
+        routing validation compares across the client/server boundary."""
+        return "%s#%d" % (self.policy.describe(), self.index)
+
+    def mask(self, keys: np.ndarray) -> np.ndarray:
+        """[K] bool: keys this box serves (owned by the policy or in
+        the replicated hot set)."""
+        keys = np.asarray(keys, np.uint64).reshape(-1)
+        m = self.policy.shard_of(keys) == self.index
+        if self.hot.size:
+            pos = np.searchsorted(self.hot, keys)
+            pos = np.minimum(pos, self.hot.size - 1)
+            m |= self.hot[pos] == keys
+        return m
+
+    def filter_view(self, columnar_path: str) -> str:
+        out = f"{columnar_path}.{self._tag}"
+        if (os.path.exists(out) and os.path.getmtime(out)
+                >= os.path.getmtime(columnar_path)):
+            return out
+        keys, rows = read_xbox_columnar(columnar_path)
+        keep = self.mask(keys)
+        return write_xbox_columnar(
+            out, np.asarray(keys[keep]), np.asarray(rows[keep]))
+
+
+# ---------------------------------------------------------------------------
 # Precedence stack
 # ---------------------------------------------------------------------------
 
@@ -347,12 +440,22 @@ class MmapViewStack:
     whole new stack into the view manager and in-flight requests keep
     the old object alive until their lookups return (refresh.py)."""
 
-    def __init__(self, sources: Sequence[XboxSource]) -> None:
-        if not sources:
+    def __init__(self, sources: Sequence[XboxSource],
+                 shard_spec: Optional[ShardSpec] = None,
+                 extra_files: Sequence[str] = ()) -> None:
+        """``shard_spec`` (round 21): serve only this box's slice of
+        the partition — every view compiles to its filtered twin first.
+        ``extra_files``: pre-compiled columnar files stacked FRESHEST
+        (after the newest source) — the journal-fed overlay rides here;
+        they are filtered too when a spec is set."""
+        if not (sources or extra_files):
             raise ValueError("need at least one source")
         self.sources = tuple(sources)
-        self._open_views([compile_view_dir(s.path)
-                          for s in self.sources])
+        paths = [compile_view_dir(s.path) for s in self.sources]
+        paths += list(extra_files)
+        if shard_spec is not None:
+            paths = [shard_spec.filter_view(p) for p in paths]
+        self._open_views(paths)
 
     @classmethod
     def from_files(cls, paths: Sequence[str]) -> "MmapViewStack":
@@ -408,10 +511,11 @@ class MmapViewStack:
 
 
 def build_stack(xbox_model_dir: str,
-                days: Optional[Sequence[str]] = None
+                days: Optional[Sequence[str]] = None,
+                shard_spec: Optional[ShardSpec] = None
                 ) -> Tuple[MmapViewStack, Tuple[XboxSource, ...]]:
     """Discover + compile + open the current composed view. Returns the
     stack and its source tuple (the refresh watcher's change key)."""
     days = list(days) if days else discover_days(xbox_model_dir)
     sources = discover_xbox_sources(xbox_model_dir, days)
-    return MmapViewStack(sources), tuple(sources)
+    return MmapViewStack(sources, shard_spec=shard_spec), tuple(sources)

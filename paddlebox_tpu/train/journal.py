@@ -56,29 +56,20 @@ from typing import Dict, List, Optional
 import numpy as np
 from paddlebox_tpu.utils.lockwatch import make_lock
 
-_SEG_MAGIC = b"PBTJRNL1"
-_FRAME = struct.Struct("<IQ")  # kind, payload bytes
+# The segment FORMAT (magic, framing, record kinds, event/move codes,
+# the iterator + incremental tailer) lives in the jax-free shared layer
+# utils/journal_format.py — the round-21 serving plane tails the same
+# segments from processes that must never import the train package.
+# Everything is re-exported here under its historical names, so the
+# checkpoint plane and the journal tests read one surface.
+from paddlebox_tpu.utils.journal_format import (  # noqa: F401
+    EV_AGE_DAYS, EV_SHRINK, EV_STAT_SAVE_AGE, EV_STAT_SAVE_DELTA,
+    EV_TAINT, EV_TICK_SPILL_AGE, KIND_EVENT, KIND_HEADER, KIND_MOVE,
+    KIND_ROWS, MV_FAULT_IN, MV_SPILL, iter_segment, segment_header)
+from paddlebox_tpu.utils.journal_format import FRAME as _FRAME
+from paddlebox_tpu.utils.journal_format import MOVE_HEAD as _MOVE_HEAD
+from paddlebox_tpu.utils.journal_format import SEG_MAGIC as _SEG_MAGIC
 
-KIND_HEADER = 0
-KIND_ROWS = 1
-KIND_EVENT = 2
-KIND_MOVE = 3             # resident<->SSD-tier key movement (round 16)
-
-# event codes — the deterministic out-of-cadence store mutations
-EV_STAT_SAVE_DELTA = 1    # update_stat_after_save param=1 (clear delta)
-EV_STAT_SAVE_AGE = 3      # update_stat_after_save param=3 (age residents)
-EV_AGE_DAYS = 10          # store.age_unseen_days()
-EV_SHRINK = 11            # store.shrink() (decay + delete rule)
-EV_TICK_SPILL_AGE = 12    # store.tick_spill_age() (save-day boundary)
-EV_TAINT = 20             # epoch unsound from here (loss/external load)
-
-# MOVE directions (KIND_MOVE payload op field) — canonical definitions
-# live with the tier (embedding/ssd_tier.py); re-exported here as part of
-# the record format
-from paddlebox_tpu.embedding.ssd_tier import (  # noqa: E402
-    MV_FAULT_IN, MV_SPILL)
-
-_MOVE_HEAD = struct.Struct("<IIq")  # op, pad, n keys
 
 class JournalIncompleteError(RuntimeError):
     """Replay/snapshot refused: the journal cannot reconstruct the store
@@ -137,31 +128,6 @@ def replay_record(store, table_cfg, kind: int, payload: bytes) -> None:
         else:
             raise ValueError(f"unknown journal move op {op}")
     # KIND_HEADER records are validated by the caller
-
-
-def iter_segment(path: str):
-    """Yield (kind, payload) records; a truncated tail record (crash
-    mid-append) terminates the iteration cleanly."""
-    with open(path, "rb") as f:
-        if f.read(8) != _SEG_MAGIC:
-            raise ValueError(f"{path}: not a journal segment")
-        while True:
-            head = f.read(_FRAME.size)
-            if len(head) < _FRAME.size:
-                return
-            kind, nbytes = _FRAME.unpack(head)
-            payload = f.read(nbytes)
-            if len(payload) < nbytes:
-                return  # torn tail — records before it are intact
-            yield kind, payload
-
-
-def segment_header(path: str) -> Dict:
-    for kind, payload in iter_segment(path):
-        if kind == KIND_HEADER:
-            return json.loads(payload.decode())
-        break
-    raise ValueError(f"{path}: journal segment missing header record")
 
 
 def replay_segments(store, table_cfg, segment_paths,

@@ -1,0 +1,632 @@
+"""Multi-box serving fleet (round 21): client-side routing parity vs
+the single-box oracle, pull coalescing, replica failover backoff,
+shard-filtered views, the journal-fed freshness path, and the segment
+tailer it rides on. Everything here is in-process over loopback except
+the slow-marked spawn smoke."""
+
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import flags
+from paddlebox_tpu.parallel.sharding import KeyModPolicy, partition_pull
+from paddlebox_tpu.serving.client import (BACKOFF_SKIP_CAP, FleetClient,
+                                          ServingClient)
+from paddlebox_tpu.serving.refresh import JournalDeltaSource, ViewManager
+from paddlebox_tpu.serving.server import ServingServer
+from paddlebox_tpu.serving.store import (MmapViewStack, ShardSpec,
+                                         read_hot_keys, write_hot_keys,
+                                         write_xbox_columnar)
+from paddlebox_tpu.utils import journal_format as jf
+from paddlebox_tpu.utils.stats import stat_get
+
+EMBEDX = 4
+DIM = 1 + EMBEDX      # embed_w + embedx: the served xbox row width
+WIDTH = 7 + 1 + EMBEDX  # header + adagrad state + embedx (store row)
+
+
+def make_view(tmp_path, n=2000, seed=0, name="view.xcol", lo=1):
+    rng = np.random.RandomState(seed)
+    keys = np.unique(rng.randint(lo, 1 << 40, n).astype(np.uint64))
+    rows = rng.randn(keys.size, DIM).astype(np.float32)
+    path = os.path.join(str(tmp_path), name)
+    write_xbox_columnar(path, keys, rows)
+    return path, keys, rows
+
+
+def shard_server(full_path, index, policy, hot=None):
+    """In-process box: shard-filtered stack behind a real RPC server."""
+    spec = ShardSpec(index, policy, hot_keys=hot)
+    stack = MmapViewStack([], shard_spec=spec, extra_files=(full_path,))
+    return ServingServer(manager=ViewManager(stack), watch=False)
+
+
+def mixed_probe(rng, keys, n_hit=200, n_miss=30):
+    probe = np.concatenate([
+        rng.choice(keys, n_hit, replace=True),
+        rng.randint(1 << 41, 1 << 42, n_miss).astype(np.uint64)])
+    rng.shuffle(probe)
+    return probe
+
+
+def bits(a):
+    return np.ascontiguousarray(a, np.float32).view(np.uint32)
+
+
+# ------------------------------------------------------------- partition
+
+
+def test_partition_pull_is_permutation_and_owner_correct():
+    policy = KeyModPolicy(4)
+    keys = np.random.RandomState(0).randint(
+        0, 1 << 40, 500).astype(np.uint64)
+    parts = partition_pull(policy, keys)
+    got = np.sort(np.concatenate(parts))
+    assert np.array_equal(got, np.arange(keys.size))
+    for s, idx in enumerate(parts):
+        assert (policy.shard_of(keys[idx]) == s).all()
+
+
+def test_partition_pull_reroutes_hot_keys():
+    policy = KeyModPolicy(4)
+    keys = np.arange(1, 101, dtype=np.uint64)
+    hot = np.array([4, 8], np.uint64)      # owned by shard 0
+    parts = partition_pull(policy, keys, hot_keys=hot, hot_dest=3)
+    assert set(keys[parts[3]]) >= {4, 8}   # rerouted off the owner
+    # non-hot keys still with their owners
+    non_hot3 = [k for k in keys[parts[3]] if k not in (4, 8)]
+    assert (policy.shard_of(np.array(non_hot3, np.uint64)) == 3).all()
+
+
+def test_hot_keys_file_roundtrip(tmp_path):
+    path = os.path.join(str(tmp_path), "hot.keys")
+    write_hot_keys(path, np.array([9, 3, 3, 7], np.uint64))
+    assert np.array_equal(read_hot_keys(path),
+                          np.array([3, 7, 9], np.uint64))
+
+
+# ------------------------------------------------------------ fleet parity
+
+
+def test_fleet_parity_key_mod_bit_exact(tmp_path):
+    """A 3-box fleet answers any pull BIT-identically to one box
+    serving the full view — hits, misses and duplicates included."""
+    full, keys, _rows = make_view(tmp_path)
+    policy = KeyModPolicy(3)
+    servers = [shard_server(full, s, policy) for s in range(3)]
+    oracle = MmapViewStack([], extra_files=(full,))
+    fc = FleetClient([[("127.0.0.1", s.port)] for s in servers],
+                     policy=policy)
+    try:
+        rng = np.random.RandomState(1)
+        for _ in range(3):
+            probe = mixed_probe(rng, keys)
+            assert np.array_equal(bits(fc.pull(probe)),
+                                  bits(oracle.lookup(probe)))
+    finally:
+        fc.close()
+        for s in servers:
+            s.drain(timeout=2)
+
+
+def test_fleet_parity_hot_tier_any_box(tmp_path):
+    """Hot-tier keys are answered bit-exactly by WHICHEVER box the
+    rotating router picks — every box replicated them."""
+    full, keys, _rows = make_view(tmp_path)
+    rng = np.random.RandomState(2)
+    hot = np.sort(rng.choice(keys, 16, replace=False))
+    policy = KeyModPolicy(2)
+    servers = [shard_server(full, s, policy, hot=hot) for s in range(2)]
+    oracle = MmapViewStack([], extra_files=(full,))
+    fc = FleetClient([[("127.0.0.1", s.port)] for s in servers],
+                     policy=policy, hot_keys=hot)
+    try:
+        for _ in range(4):             # rotation lands on both boxes
+            probe = np.concatenate([hot, mixed_probe(rng, keys, 50, 5)])
+            assert np.array_equal(bits(fc.pull(probe)),
+                                  bits(oracle.lookup(probe)))
+        assert stat_get("serving_fleet_hot_routed") >= 4 * hot.size
+    finally:
+        fc.close()
+        for s in servers:
+            s.drain(timeout=2)
+
+
+def test_fleet_parity_across_mid_pull_swap(tmp_path):
+    """Pulls racing a generation swap on every box return rows that are
+    bit-exact against EITHER generation's oracle — never a torn row."""
+    full_a, keys, rows_a = make_view(tmp_path, seed=3, name="a.xcol")
+    path_b = os.path.join(str(tmp_path), "b.xcol")
+    rows_b = rows_a + 1.0
+    write_xbox_columnar(path_b, keys, rows_b)
+    policy = KeyModPolicy(2)
+    servers = [shard_server(full_a, s, policy) for s in range(2)]
+    fc = FleetClient([[("127.0.0.1", s.port)] for s in servers],
+                     policy=policy)
+    got, errs = [], []
+
+    def puller():
+        rng = np.random.RandomState(threading.get_ident() % 9999)
+        try:
+            for _ in range(12):
+                probe = rng.choice(keys, 64, replace=False)
+                got.append((probe, fc.pull(probe)))
+        except Exception as e:     # surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=puller) for _ in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        for idx, s in enumerate(servers):   # swap every box mid-traffic
+            stack = MmapViewStack([], shard_spec=ShardSpec(idx, policy),
+                                  extra_files=(path_b,))
+            s.manager.swap(stack)
+        for t in threads:
+            t.join()
+    finally:
+        fc.close()
+        for s in servers:
+            s.drain(timeout=2)
+    assert not errs, errs
+    lut_a = dict(zip(keys.tolist(), rows_a))
+    lut_b = dict(zip(keys.tolist(), rows_b))
+    for probe, out in got:
+        for k, row in zip(probe.tolist(), out):
+            ok = (np.array_equal(bits(row), bits(lut_a[k]))
+                  or np.array_equal(bits(row), bits(lut_b[k])))
+            assert ok, f"torn row for key {k}"
+
+
+def test_shard_validation_refuses_misrouted_pull(tmp_path):
+    """A sharded box refuses a pull the client routed to a DIFFERENT
+    box index — topology permutation fails loudly, not as silent
+    all-zero misses."""
+    full, keys, _rows = make_view(tmp_path)
+    flags.set_flag("serving_shard_index", 1)
+    flags.set_flag("serving_num_shards", 2)
+    flags.set_flag("serving_shard_policy", "key-mod")
+    spec = ShardSpec(1, KeyModPolicy(2))
+    stack = MmapViewStack([], shard_spec=spec, extra_files=(full,))
+    server = ServingServer(manager=ViewManager(stack), watch=False)
+    client = ServingClient([("127.0.0.1", server.port)])
+    try:
+        client.pull(keys[:4], shard=1)              # correct: accepted
+        with pytest.raises(RuntimeError, match="shard"):
+            client.pull(keys[:4], shard=0)          # misrouted: refused
+        client.pull(keys[:4])                       # undeclared: accepted
+    finally:
+        client.close()
+        server.drain(timeout=2)
+
+
+# ------------------------------------------------------------- coalescing
+
+
+def test_coalescer_reduces_per_shard_rpcs(tmp_path):
+    """At concurrency 8 the per-box RPC count is measurably below one
+    RPC per pull per box (the coalescer merges whatever queued during
+    each flight) and every answer stays bit-exact."""
+    full, keys, _rows = make_view(tmp_path)
+    policy = KeyModPolicy(2)
+    servers = [shard_server(full, s, policy) for s in range(2)]
+    oracle = MmapViewStack([], extra_files=(full,))
+    fc = FleetClient([[("127.0.0.1", s.port)] for s in servers],
+                     policy=policy)
+    base = stat_get("serving_requests")
+    n_threads, n_pulls = 8, 15
+    errs = []
+
+    def worker():
+        rng = np.random.RandomState(threading.get_ident() % 9999)
+        try:
+            for _ in range(n_pulls):
+                probe = rng.choice(keys, 128)
+                assert np.array_equal(bits(fc.pull(probe)),
+                                      bits(oracle.lookup(probe)))
+        except Exception as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        fc.close()
+        for s in servers:
+            s.drain(timeout=2)
+    assert not errs, errs
+    rpcs = stat_get("serving_requests") - base
+    ceiling = 2 * n_threads * n_pulls          # one RPC per pull per box
+    assert rpcs < 0.75 * ceiling, (rpcs, ceiling)
+    assert stat_get("serving_fleet_coalesced") > 0
+
+
+def test_coalesce_off_sends_one_rpc_per_pull(tmp_path):
+    full, keys, _rows = make_view(tmp_path, n=300)
+    policy = KeyModPolicy(1)
+    servers = [shard_server(full, 0, policy)]
+    fc = FleetClient([[("127.0.0.1", servers[0].port)]],
+                     policy=policy, coalesce=False)
+    base = stat_get("serving_requests")
+    try:
+        for _ in range(5):
+            fc.pull(keys[:32])
+    finally:
+        fc.close()
+        servers[0].drain(timeout=2)
+    assert stat_get("serving_requests") - base == 5
+
+
+# ------------------------------------------------------ failover backoff
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_client_backoff_skips_dead_replica_then_reprobes(tmp_path):
+    """Satellite 1: a dead replica is skipped on an exponential
+    attempt-denominated backoff (bounded by BACKOFF_SKIP_CAP), pulls
+    keep succeeding on the live sibling, and once the replica comes
+    back ONE bounded probe re-dials it and resets the backoff."""
+    full, keys, _rows = make_view(tmp_path, n=300)
+    live = shard_server(full, 0, KeyModPolicy(1))
+    dead_port = _free_port()
+    client = ServingClient([("127.0.0.1", dead_port),
+                            ("127.0.0.1", live.port)])
+    revived = None
+    try:
+        for _ in range(12):            # failures grow the streak
+            client.pull(keys[:8])
+        with client._lock:
+            streak = client._fail_streak[0]
+        assert streak >= 2
+        assert client._skip_left[0] <= BACKOFF_SKIP_CAP
+        skips = stat_get("serving_client_skips")
+        assert skips > 0
+        # replica recovers on the SAME endpoint
+        stack = MmapViewStack([], extra_files=(full,))
+        revived = ServingServer(manager=ViewManager(stack), watch=False,
+                                port=dead_port)
+        for _ in range(2 * BACKOFF_SKIP_CAP + 4):
+            client.pull(keys[:8])
+        with client._lock:
+            assert client._fail_streak[0] == 0   # re-probe succeeded
+        assert stat_get("serving_client_reprobes") >= 1
+    finally:
+        client.close()
+        live.drain(timeout=2)
+        if revived is not None:
+            revived.drain(timeout=2)
+
+
+def test_fleet_survives_one_dead_replica(tmp_path):
+    """One box has a dead replica + a live one: every pull succeeds
+    (failover inside the box's ServingClient), zero caller errors."""
+    full, keys, _rows = make_view(tmp_path)
+    policy = KeyModPolicy(2)
+    s0 = shard_server(full, 0, policy)
+    s1 = shard_server(full, 1, policy)
+    oracle = MmapViewStack([], extra_files=(full,))
+    fc = FleetClient(
+        [[("127.0.0.1", _free_port()), ("127.0.0.1", s0.port)],
+         [("127.0.0.1", s1.port)]], policy=policy)
+    try:
+        rng = np.random.RandomState(5)
+        for _ in range(6):
+            probe = mixed_probe(rng, keys, 80, 8)
+            assert np.array_equal(bits(fc.pull(probe)),
+                                  bits(oracle.lookup(probe)))
+    finally:
+        fc.close()
+        s0.drain(timeout=2)
+        s1.drain(timeout=2)
+
+
+# --------------------------------------------------------- segment tailer
+
+
+def _frame(kind, payload):
+    return jf.FRAME.pack(kind, len(payload)) + payload
+
+
+def _header_payload(epoch=0, seq=1):
+    import json
+    return json.dumps({"version": 1, "width": WIDTH,
+                       "embedx_dim": EMBEDX, "optimizer": "adagrad",
+                       "epoch": epoch, "seq": seq}).encode()
+
+
+def _rows_payload(keys, vals):
+    keys = np.asarray(keys, np.uint64)
+    vals = np.asarray(vals, np.float32)
+    return (struct.pack("<qq", keys.size, vals.shape[1])
+            + keys.tobytes() + vals.tobytes())
+
+
+def _write_seg(dirpath, name, frames, torn_tail=b""):
+    os.makedirs(dirpath, exist_ok=True)
+    with open(os.path.join(dirpath, name), "wb") as f:
+        f.write(jf.SEG_MAGIC)
+        for fr in frames:
+            f.write(fr)
+        f.write(torn_tail)
+
+
+def test_tailer_incremental_and_torn_tail(tmp_path):
+    d = str(tmp_path / "j")
+    vals = np.ones((2, WIDTH), np.float32)
+    _write_seg(d, "seg-0000-000001.open",
+               [_frame(jf.KIND_HEADER, _header_payload()),
+                _frame(jf.KIND_ROWS, _rows_payload([1, 2], vals))],
+               torn_tail=jf.FRAME.pack(jf.KIND_ROWS, 999))   # torn
+    t = jf.SegmentTailer(d)
+    recs, reset = t.poll()
+    assert not reset
+    assert [k for k, _ in recs] == [jf.KIND_HEADER, jf.KIND_ROWS]
+    # the torn frame is NOT consumed; nothing new until it completes
+    recs2, reset2 = t.poll()
+    assert recs2 == [] and not reset2
+    # the writer replaces the torn tail with a whole frame
+    with open(os.path.join(d, "seg-0000-000001.open"), "r+b") as f:
+        f.seek(-jf.FRAME.size, os.SEEK_END)
+        f.truncate()
+        f.seek(0, os.SEEK_END)
+        f.write(_frame(jf.KIND_EVENT, struct.pack("<I", jf.EV_SHRINK)))
+    recs3, reset3 = t.poll()
+    assert [k for k, _ in recs3] == [jf.KIND_EVENT] and not reset3
+
+
+def test_tailer_offsets_survive_seal_rename(tmp_path):
+    d = str(tmp_path / "j")
+    vals = np.ones((1, WIDTH), np.float32)
+    _write_seg(d, "seg-0000-000001.open",
+               [_frame(jf.KIND_HEADER, _header_payload()),
+                _frame(jf.KIND_ROWS, _rows_payload([1], vals))])
+    t = jf.SegmentTailer(d)
+    recs, _ = t.poll()
+    assert len(recs) == 2
+    with open(os.path.join(d, "seg-0000-000001.open"), "ab") as f:
+        f.write(_frame(jf.KIND_ROWS, _rows_payload([2], vals)))
+    os.rename(os.path.join(d, "seg-0000-000001.open"),
+              os.path.join(d, "seg-0000-000001.jrnl"))
+    recs2, reset2 = t.poll()
+    assert not reset2
+    assert [k for k, _ in recs2] == [jf.KIND_ROWS]   # only the new one
+
+
+def test_tailer_resets_on_epoch_bump_and_vanish(tmp_path):
+    d = str(tmp_path / "j")
+    vals = np.ones((1, WIDTH), np.float32)
+    _write_seg(d, "seg-0000-000001.jrnl",
+               [_frame(jf.KIND_HEADER, _header_payload()),
+                _frame(jf.KIND_ROWS, _rows_payload([1], vals))])
+    t = jf.SegmentTailer(d)
+    t.poll()
+    # anchor_full: old epoch swept, new epoch appears
+    os.remove(os.path.join(d, "seg-0000-000001.jrnl"))
+    _write_seg(d, "seg-0001-000001.open",
+               [_frame(jf.KIND_HEADER, _header_payload(epoch=1)),
+                _frame(jf.KIND_ROWS, _rows_payload([2], vals))])
+    recs, reset = t.poll()
+    assert reset and len(recs) == 2    # full re-read of the survivors
+    # a tailed segment vanishing mid-epoch also resets
+    _write_seg(d, "seg-0001-000002.open",
+               [_frame(jf.KIND_HEADER, _header_payload(epoch=1, seq=2))])
+    t.poll()
+    os.remove(os.path.join(d, "seg-0001-000001.open"))
+    _recs, reset2 = t.poll()
+    assert reset2
+    # an emptied dir after tailing resets too (rows fell off disk)
+    os.remove(os.path.join(d, "seg-0001-000002.open"))
+    recs3, reset3 = t.poll()
+    assert reset3 and recs3 == []
+
+
+# ------------------------------------------------------ journal delta feed
+
+
+def journal_writer(tmp_path, name="_journal"):
+    from paddlebox_tpu.train.journal import TouchedRowJournal
+    layout = types.SimpleNamespace(width=WIDTH, embedx_dim=EMBEDX,
+                                   optimizer="adagrad")
+    return TouchedRowJournal(os.path.join(str(tmp_path), name),
+                             layout, None)
+
+
+def test_xbox_embed_cols_pins_value_layout():
+    """The jax-free column math serves EXACTLY the columns the real
+    ValueLayout says the xbox view holds, for every optimizer."""
+    from paddlebox_tpu.embedding.accessor import EMBED_W, ValueLayout
+    for opt in ("adagrad", "adam", "adam_shared", "naive"):
+        layout = ValueLayout(embedx_dim=EMBEDX, optimizer=opt)
+        expect = np.concatenate([
+            [EMBED_W],
+            np.arange(layout.embedx_w,
+                      layout.embedx_w + EMBEDX)]).astype(np.int64)
+        assert np.array_equal(jf.xbox_embed_cols(EMBEDX, opt), expect), opt
+
+
+def test_journal_source_rows_events_and_updates(tmp_path):
+    j = journal_writer(tmp_path)
+    src = JournalDeltaSource([j.dir])
+    try:
+        keys = np.array([11, 7], np.uint64)
+        vals = np.arange(2 * WIDTH, dtype=np.float32).reshape(2, WIDTH)
+        j.append_rows(keys, vals)
+        assert src.poll()
+        cols = jf.xbox_embed_cols(EMBEDX, "adagrad")
+        overlay = src.compile_overlay()
+        stack = MmapViewStack([], extra_files=(overlay,))
+        assert np.array_equal(bits(stack.lookup(keys)), bits(vals[:, cols]))
+        assert not src.poll()                      # idempotent
+        # newest touch wins
+        vals2 = vals + 100
+        j.append_rows(keys[:1], vals2[:1])
+        assert src.poll()
+        stack2 = MmapViewStack([], extra_files=(src.compile_overlay(),))
+        assert np.array_equal(bits(stack2.lookup(keys[:1])),
+                              bits(vals2[:1, cols]))
+        # stat-save events do NOT drop the overlay (header cols only)
+        j.append_event(jf.EV_STAT_SAVE_DELTA)
+        src.poll()
+        assert src.compile_overlay() is not None
+        # shrink DOES (out-of-band value mutation)
+        j.append_event(jf.EV_SHRINK)
+        assert src.poll()
+        assert src.compile_overlay() is None
+    finally:
+        src.close()
+        j.close()
+
+
+def test_journal_source_multi_dir_and_layout_mismatch(tmp_path):
+    j0 = journal_writer(tmp_path, "j0")
+    j1 = journal_writer(tmp_path, "j1")
+    src = JournalDeltaSource([j0.dir, j1.dir])
+    try:
+        v = np.ones((1, WIDTH), np.float32)
+        j0.append_rows(np.array([1], np.uint64), v)
+        j1.append_rows(np.array([2], np.uint64), v * 2)
+        assert src.poll()
+        stack = MmapViewStack([], extra_files=(src.compile_overlay(),))
+        out = stack.lookup(np.array([1, 2], np.uint64))
+        assert out[0, 0] == 1.0 and out[1, 0] == 2.0
+    finally:
+        src.close()
+        j0.close()
+        j1.close()
+    # disagreeing projections must raise, not mix layouts
+    from paddlebox_tpu.train.journal import TouchedRowJournal
+    other = TouchedRowJournal(
+        os.path.join(str(tmp_path), "j2"),
+        types.SimpleNamespace(width=WIDTH + 2, embedx_dim=EMBEDX + 2,
+                              optimizer="adagrad"), None)
+    other.append_rows(np.array([3], np.uint64),
+                      np.ones((1, WIDTH + 2), np.float32))
+    src2 = JournalDeltaSource([j0.dir, other.dir])
+    try:
+        with pytest.raises(ValueError, match="projection"):
+            src2.poll()
+    finally:
+        src2.close()
+        other.close()
+
+
+def test_journal_fed_server_lands_rows_in_seconds(tmp_path):
+    """E2E freshness: a touched row is served (bit-exact) ONE refresh
+    poll after the trainer flushes it — no SaveDelta involved."""
+    full, keys, _rows = make_view(tmp_path)
+    root = str(tmp_path / "xbox")
+    day = os.path.join(root, "day0")
+    os.makedirs(day)
+    os.replace(full, os.path.join(day, "view.xcol"))
+    with open(os.path.join(day, "DONE"), "w") as f:
+        f.write(str(time.time()))
+    j = journal_writer(tmp_path)
+    flags.set_flag("serving_journal_dir", j.dir)
+    flags.set_flag("serving_refresh_secs", 0.1)
+    server = ServingServer(root, days=["day0"])
+    client = ServingClient([("127.0.0.1", server.port)])
+    try:
+        tk = keys[:3]
+        tv = np.arange(3 * WIDTH, dtype=np.float32).reshape(3, WIDTH) + 9
+        cols = jf.xbox_embed_cols(EMBEDX, "adagrad")
+        expect = np.ascontiguousarray(tv[:, cols])
+        t0 = time.time()
+        j.append_rows(tk, tv)
+        deadline = t0 + 10.0
+        while time.time() < deadline:
+            if np.array_equal(bits(client.pull(tk)), bits(expect)):
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError("journal rows never reached serving")
+        landed = time.time() - t0
+        assert landed < 5.0, landed    # typically ~2 poll intervals
+        # untouched keys still come from the on-disk view
+        probe = keys[10:20]
+        oracle = MmapViewStack(
+            [], extra_files=(os.path.join(day, "view.xcol"),))
+        assert np.array_equal(bits(client.pull(probe)),
+                              bits(oracle.lookup(probe)))
+    finally:
+        client.close()
+        server.drain(timeout=2)
+        j.close()
+
+
+# ------------------------------------------------------------ jax freedom
+
+
+def test_serving_import_stays_jax_free():
+    """Satellite 5: a serving replica process must never pay for (or
+    inherit) jax — the fleet spawn path depends on it."""
+    code = ("import sys; import paddlebox_tpu.serving; "
+            "assert 'jax' not in sys.modules, 'jax leaked'; "
+            "assert 'paddlebox_tpu.train' not in sys.modules; "
+            "print('ok')")
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "ok" in out.stdout
+
+
+# ---------------------------------------------------------- spawn (slow)
+
+
+@pytest.mark.slow
+def test_multibox_fleet_spawn_kill_one_box(tmp_path):
+    """Real B=2×R=2 spawned grid: routing parity, then SIGKILL one
+    replica of one box — the client error rate stays within budget
+    (failover absorbs the dead replica) and parity holds throughout."""
+    from paddlebox_tpu.serving.fleet import MultiBoxFleet
+    full, keys, _rows = make_view(tmp_path)
+    root = str(tmp_path / "xbox")
+    day = os.path.join(root, "day0")
+    os.makedirs(day)
+    os.replace(full, os.path.join(day, "view.xcol"))
+    with open(os.path.join(day, "DONE"), "w") as f:
+        f.write(str(time.time()))
+    oracle = MmapViewStack(
+        [], extra_files=(os.path.join(day, "view.xcol"),))
+    fleet = MultiBoxFleet(root, days=["day0"], boxes=2, replicas=2,
+                          start_timeout=120.0)
+    try:
+        fc = fleet.client(timeout=10.0)
+        rng = np.random.RandomState(7)
+        probe = mixed_probe(rng, keys)
+        assert np.array_equal(bits(fc.pull(probe)),
+                              bits(oracle.lookup(probe)))
+        fleet.boxes[0]._procs[0].kill()      # one replica of box 0 dies
+        errors = 0
+        total = 40
+        for _ in range(total):
+            probe = mixed_probe(rng, keys, 60, 6)
+            try:
+                assert np.array_equal(bits(fc.pull(probe)),
+                                      bits(oracle.lookup(probe)))
+            except (ConnectionError, RuntimeError):
+                errors += 1
+        assert errors <= total * 0.1, f"{errors}/{total} failed"
+        health = fleet.health()
+        assert health["type"] == "serving_fleet"
+        assert health["boxes"] == 2
+        fc.close()
+    finally:
+        fleet.close()

@@ -54,6 +54,10 @@ HEADLINES: List[Tuple[str, str, bool]] = [
     # threshold is a byte-budget regression and flags exactly like a
     # rate regression (absent pre-round-20 rounds compare as n/a)
     ("device_bytes_accessed_per_example", "B/ex", False),
+    # round-21 serving fleet: the multi-box ladder's top-rung
+    # client-side pull rate (tools/fleet_probe.py; absent pre-round-21
+    # rounds compare as n/a)
+    ("fleet_pull_keys_per_sec", "keys/s", True),
 ]
 
 

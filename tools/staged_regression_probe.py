@@ -112,6 +112,12 @@ FLOORS = {
     # ~40% of recorded
     "ssd_fault_keys_per_sec": (1.0e6, 400e3),
     "ssd_promote_keys_per_sec": (1.1e6, 440e3),
+    # round-21: the multi-box fleet pull END TO END over loopback RPC
+    # (key-mod partition + per-shard coalescer flight + 2 in-process
+    # boxes with shard-filtered stacks + scatter-back) at batch 8192,
+    # 10% misses over a 1M base. Recorded under the load guard on
+    # 2026-08-07 (load1 0.1); floor = ~40% of recorded
+    "fleet_pull_keys_per_sec": (1.13e6, 450e3),
 }
 
 # CEILINGS: lower-is-better stages (latencies). Same load-guard
@@ -129,6 +135,11 @@ CEILINGS = {
     # ~3.5x (stdlib http.server latency noise under co-tenant load is
     # wide)
     "exporter_scrape_p99_us": (5.8e3, 20e3),
+    # round-21: the fleet pull p99 at the fleet FLOORS shape (batch
+    # 8192 across 2 loopback boxes, coalescer + RPC + mmap lookup on
+    # the clock). Recorded under the load guard on 2026-08-07;
+    # ceiling = ~2.5x (two RPC hops of stdlib-socket latency noise)
+    "fleet_pull_p99_us": (9.5e3, 24e3),
     # round-19: boxlint wall time, full tree (166 files, all 10 passes,
     # cache DISABLED — the honest cold cost the tier-1 gate pays) and
     # the --changed edit-loop mode. Recorded 2026-08-04 quiet: full
@@ -596,6 +607,70 @@ def section_serving(rng, K):
     os.unlink(path)
 
 
+def section_fleet(rng, K):
+    # --- multi-box serving fleet (round 21) --------------------------
+    # the CLIENT-routed pull path end to end over loopback RPC: a
+    # 2-box in-process fleet (shard-filtered mmap stacks behind real
+    # FramedServers) pulled through the FleetClient — partition by
+    # key-mod, per-shard coalescer flight, both boxes answering in
+    # parallel, scatter back to caller order. Guards the whole routing
+    # + wire + lookup sandwich; the in-process lookup alone is the
+    # serving section's floor, and the multi-PROCESS ladder lives in
+    # tools/fleet_probe.py (BASELINE.md round 21).
+    import tempfile
+
+    from paddlebox_tpu.parallel.sharding import KeyModPolicy
+    from paddlebox_tpu.serving.client import FleetClient
+    from paddlebox_tpu.serving.refresh import ViewManager
+    from paddlebox_tpu.serving.server import ServingServer
+    from paddlebox_tpu.serving.store import (MmapViewStack, ShardSpec,
+                                             write_xbox_columnar)
+    n, dim, batch = 1 << 20, 9, 8192
+    path = os.path.join(tempfile.mkdtemp(prefix="pbx_fleetprobe_"),
+                        "base.xcol")
+    keys = np.arange(n, dtype=np.uint64) * 16 + np.uint64(3)
+    write_xbox_columnar(path, keys, np.ones((n, dim), np.float32))
+    policy = KeyModPolicy(2)
+    servers = [
+        ServingServer(manager=ViewManager(MmapViewStack(
+            [], shard_spec=ShardSpec(s, policy), extra_files=(path,))),
+            watch=False)
+        for s in range(2)]
+    fc = FleetClient([[("127.0.0.1", s.port)] for s in servers],
+                     policy=policy)
+    probe = (rng.randint(0, n, 8 * batch).astype(np.uint64)
+             * np.uint64(16) + np.uint64(3))
+    probe[::10] += np.uint64(1)             # 10% misses
+    batches = probe.reshape(8, batch)
+    state = {"i": 0, "lat": []}
+
+    def one():
+        t0 = time.perf_counter()
+        fc.pull(batches[state["i"] % 8])
+        state["lat"].append(time.perf_counter() - t0)
+        state["i"] += 1
+
+    def measure():
+        state["lat"] = []
+        return timed_rate(one, batch)
+
+    def p99_of_last():
+        lat = np.sort(np.array(state["lat"]) * 1e6)
+        return float(lat[int(0.99 * (lat.size - 1))])
+
+    try:
+        rate = measure()
+        p99 = p99_of_last()
+        report("fleet_pull_keys_per_sec", rate, remeasure=measure)
+        report("fleet_pull_p99_us", p99,
+               remeasure=lambda: (measure(), p99_of_last())[1])
+    finally:
+        fc.close()
+        for s in servers:
+            s.drain(timeout=2)
+        os.unlink(path)
+
+
 def section_ckpt(rng, K):
     # --- checkpoint plane (round 15) ---------------------------------
     # the columnar sparse batch tier END TO END at the store level:
@@ -861,6 +936,7 @@ SECTIONS = (
     ("e2e", section_e2e),
     ("push", section_push),
     ("serving", section_serving),
+    ("fleet", section_fleet),
     ("ckpt", section_ckpt),
     ("ssd", section_ssd),
     ("quality", section_quality),
