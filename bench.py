@@ -1305,6 +1305,159 @@ def measure(platform: str) -> None:
     except Exception as e:  # noqa: BLE001 — diagnostic tier, not the metric
         ingest = {"error": repr(e)[:300]}
 
+    def streaming_ladder() -> dict:
+        """Round-19 streaming block: the micro-pass pipeline's sustained
+        examples/s against the SAME windows driven as plain preloaded
+        batch passes (run_preloaded_passes — the batch-resident cadence
+        at the same shape), plus the in-process ingest-to-serve
+        freshness: seconds from an atomic file drop to a
+        JournalDeltaSource poll returning the trained rows, no SaveDelta
+        in between (the multi-process freshness number is the slow leg
+        of tests/test_streaming.py, recorded in BASELINE.md).
+        Median-of-3 on every tier. The admission gate runs (its preview
+        cost belongs in the cadence) with the refusal threshold parked
+        high so a borderline drift score can't silently skip a window's
+        instances and corrupt the rate."""
+        import shutil
+        import tempfile
+        import threading as _threading
+
+        from paddlebox_tpu.config import flags as _fl
+        from paddlebox_tpu.config.configs import CheckpointConfig
+        from paddlebox_tpu.data import (BoxDataset, StreamingDataset,
+                                        write_synthetic_ctr_files)
+        from paddlebox_tpu.serving.refresh import JournalDeltaSource
+        from paddlebox_tpu.train import CheckpointManager, StreamingRunner
+        from paddlebox_tpu.train.preload import run_preloaded_passes
+
+        S_SLOTS, S_BATCH, S_FILES, S_LINES = 16, 512, 6, 2000
+        WIN_FILES = 2                      # files per micro-pass window
+        root = tempfile.mkdtemp(prefix="pbtpu_stream_bench_")
+        strainer = None
+        old_poll = _fl.get_flag("streaming_poll_secs")
+        try:
+            files, sfeed = write_synthetic_ctr_files(
+                os.path.join(root, "staging"), num_files=S_FILES,
+                lines_per_file=S_LINES, num_slots=S_SLOTS,
+                vocab_per_slot=20000, max_len=MAX_LEN, seed=11)
+            sfeed = type(sfeed)(slots=sfeed.slots, batch_size=S_BATCH)
+            n_total = S_FILES * S_LINES
+            win_instances = WIN_FILES * S_LINES
+            n_windows = S_FILES // WIN_FILES
+            _fl.set_flag("streaming_poll_secs", 0.02)
+
+            strainer = BoxTrainer(
+                DeepFM(ModelSpec(num_slots=S_SLOTS, slot_dim=3 + D),
+                       hidden=(256, 128)),
+                TableConfig(embedx_dim=D, pass_capacity=1 << 19,
+                            optimizer=SparseOptimizerConfig(
+                                mf_create_thresholds=0.0,
+                                mf_initial_range=1e-3)),
+                sfeed, TrainerConfig(dense_lr=1e-3, compute_dtype=dtype),
+                seed=0)
+            cm = CheckpointManager(
+                CheckpointConfig(
+                    batch_model_dir=os.path.join(root, "batch"),
+                    xbox_model_dir=os.path.join(root, "xbox"),
+                    async_save=False),
+                strainer.table)
+
+            def win_datasets():
+                out = []
+                for i in range(0, S_FILES, WIN_FILES):
+                    d = BoxDataset(sfeed, read_threads=2)
+                    d.set_filelist(files[i:i + WIN_FILES])
+                    out.append(d)
+                return out
+
+            # batch leg: the SAME windows as plain preloaded passes
+            run_preloaded_passes(strainer, win_datasets())  # compile+warm
+            batch_rates = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                run_preloaded_passes(strainer, win_datasets())
+                batch_rates.append(n_total / (time.perf_counter() - t0))
+            batch_eps = float(np.median(batch_rates))
+
+            def drop_all(source, names):
+                for i, f in enumerate(names):
+                    dst = os.path.join(source, "drop-%04d.txt" % i)
+                    shutil.copyfile(f, dst + ".tmp")
+                    os.replace(dst + ".tmp", dst)
+
+            # streaming leg: the same files through watcher discovery,
+            # admission preview and per-boundary journal publish
+            # (micro-checkpoints off: the checkpoint ladder prices those
+            # separately)
+            stream_rates, stalls = [], []
+            for rep in range(3):
+                source = os.path.join(root, "src-%d" % rep)
+                os.makedirs(source)
+                drop_all(source, files)
+                stream = StreamingDataset(
+                    sfeed, source, micro_pass_instances=win_instances)
+                runner = StreamingRunner(strainer, stream, cm=cm,
+                                         base_every=0,
+                                         admission_max_drift=10.0)
+                res = runner.run(max_micro_passes=n_windows,
+                                 idle_timeout=10.0)
+                stream_rates.append(res["examples_per_sec"])
+                stalls.append(res["max_ingest_wait_secs"])
+            stream_eps = float(np.median(stream_rates))
+
+            # freshness leg: atomic drop -> trained rows visible to a
+            # serving-side journal poll
+            fresh = []
+            for rep in range(3):
+                source = os.path.join(root, "fsrc-%d" % rep)
+                os.makedirs(source)
+                stream = StreamingDataset(
+                    sfeed, source, micro_pass_instances=win_instances)
+                runner = StreamingRunner(strainer, stream, cm=cm,
+                                         base_every=0,
+                                         admission_max_drift=10.0)
+                jsrc = JournalDeltaSource([cm.journal.dir])
+                jsrc.poll()                 # drain the pre-drop backlog
+                hit = {}
+
+                def tail(js=jsrc, out=hit):
+                    while "ts" not in out:
+                        if js.poll():
+                            out["ts"] = time.time()
+                            return
+                        time.sleep(0.02)
+
+                t = _threading.Thread(target=tail, daemon=True)
+                t.start()
+                t0 = time.time()
+                drop_all(source, files[:WIN_FILES])
+                runner.run(max_micro_passes=1, idle_timeout=5.0)
+                t.join(timeout=5.0)
+                jsrc.close()
+                if "ts" in hit:
+                    fresh.append(hit["ts"] - t0)
+            return {
+                "batch_resident_examples_per_sec": round(batch_eps, 1),
+                "streaming_examples_per_sec": round(stream_eps, 1),
+                "streaming_vs_batch": round(stream_eps / batch_eps, 3),
+                "streaming_runs": [round(r, 1) for r in stream_rates],
+                "max_ingest_wait_secs": round(max(stalls), 3),
+                "freshness_secs": (round(float(np.median(fresh)), 3)
+                                   if fresh else None),
+                "freshness_runs": [round(f, 3) for f in fresh],
+                "window_instances": win_instances}
+        finally:
+            _fl.set_flag("streaming_poll_secs", old_poll)
+            if strainer is not None:
+                strainer.close()
+            shutil.rmtree(root, ignore_errors=True)
+
+    # round-19: streaming micro-pass block. GUARDED like every diagnostic.
+    try:
+        streaming = streaming_ladder()
+    except Exception as e:  # noqa: BLE001 — diagnostic tier, not the metric
+        streaming = {"error": repr(e)[:300]}
+
     eps = CHUNK * BATCH / dt
     print(json.dumps({
         "schema_version": SCHEMA_VERSION,
@@ -1339,6 +1492,10 @@ def measure(platform: str) -> None:
         "ingest": ingest,
         "ingest_cold_pass_examples_per_sec": ingest.get(
             "cold_pass_examples_per_sec", 0),
+        "streaming": streaming,
+        "streaming_examples_per_sec": streaming.get(
+            "streaming_examples_per_sec", 0),
+        "streaming_freshness_secs": streaming.get("freshness_secs", 0),
         "ssd_tier": ssd,
         "ssd_promote_keys_per_sec": ssd.get(
             "ssd_promote_keys_per_sec", 0),
@@ -1491,6 +1648,11 @@ def main() -> None:
         "ingest": result.get("ingest"),
         "ingest_cold_pass_examples_per_sec": result.get(
             "ingest_cold_pass_examples_per_sec", 0),
+        "streaming": result.get("streaming"),
+        "streaming_examples_per_sec": result.get(
+            "streaming_examples_per_sec", 0),
+        "streaming_freshness_secs": result.get(
+            "streaming_freshness_secs", 0),
         "telemetry_overhead": result.get("telemetry_overhead"),
         "flight_overhead": result.get("flight_overhead"),
         "quality_overhead": result.get("quality_overhead"),

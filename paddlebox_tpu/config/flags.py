@@ -620,3 +620,43 @@ define_flag("host_store_stripes", 0,
             "existing checkpoint/journal; striped stores draw a "
             "DIFFERENT init stream (per-stripe rngs), so flip it only "
             "on fresh runs or restored-from-checkpoint runs")
+# streaming continuous training (data/streaming.py +
+# train/streaming_runner.py): the day/pass cadence collapsed into
+# bounded micro-passes tailing a live source
+define_flag("streaming_micro_pass_instances", 4096,
+            "target instances per streaming micro-pass window: the "
+            "directory watcher accumulates ready files until their "
+            "line count reaches this bound, then hands the window to "
+            "the preloader — the unit of training, admission, "
+            "micro-checkpointing and journal publish in the streaming "
+            "plane (smaller = fresher served vectors, more per-pass "
+            "overhead)")
+define_flag("streaming_poll_secs", 0.2,
+            "streaming source poll interval: how often the directory "
+            "watcher re-lists the watched dir (and the socket spooler "
+            "checks its seal cadence) while waiting for new data; also "
+            "the granularity of the runner's idle wait")
+define_flag("streaming_stable_polls", 2,
+            "consecutive size-stable watcher polls before a bare "
+            "(non temp-suffixed) file counts as sealed and may enter a "
+            "micro-pass window — the torn-write guard for writers that "
+            "append in place instead of the write-temp-then-rename "
+            "convention (.tmp/.part/._* names are always skipped)")
+define_flag("streaming_base_every", 8,
+            "micro-checkpoint decimation: save_base(mode='auto') every "
+            "K admitted micro-passes (journal segments are published "
+            "at EVERY micro-pass boundary regardless — serving "
+            "freshness rides the journal, durability rides the base "
+            "cadence). 0 = no in-run base saves")
+define_flag("streaming_admission_max_drift", 0.8,
+            "drift-gated admission threshold: a loaded micro-pass "
+            "window whose SlotDriftMonitor preview score against the "
+            "rolling reference of ADMITTED windows reaches this is "
+            "refused before begin_pass — it never trains, never "
+            "mutates the store, and never enters the reference. "
+            "0 disables the gate")
+define_flag("streaming_idle_timeout_secs", 0.0,
+            "streaming runner exit condition: stop after this many "
+            "seconds with no new complete window from the source "
+            "(0 = run until stop() or max_micro_passes) — the bound "
+            "bench/test/demo legs use to drain a finite drop")

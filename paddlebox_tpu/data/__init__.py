@@ -4,6 +4,9 @@ from paddlebox_tpu.data.packer import PackedBatch, BatchPacker
 from paddlebox_tpu.data.columnar import ColumnarBlock
 from paddlebox_tpu.data.dataset import BoxDataset
 from paddlebox_tpu.data.generator import write_synthetic_ctr_files
+from paddlebox_tpu.data.streaming import (DirectoryWatcher, FileLedger,
+                                          MicroWindow, SocketFeedServer,
+                                          StreamingDataset)
 
 __all__ = [
     "SlotRecord",
@@ -13,4 +16,9 @@ __all__ = [
     "ColumnarBlock",
     "BoxDataset",
     "write_synthetic_ctr_files",
+    "DirectoryWatcher",
+    "FileLedger",
+    "MicroWindow",
+    "SocketFeedServer",
+    "StreamingDataset",
 ]

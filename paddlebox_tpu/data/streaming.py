@@ -1,0 +1,448 @@
+"""Streaming ingest: tail a live source into bounded micro-pass windows.
+
+The reference trains in daily drops because its data arrives in daily
+drops; this module collapses that cadence. A ``StreamingDataset`` tails
+a live source — a watched directory (the deployment shape: upstream
+writers land MultiSlot text files) or a socket feed (producers push
+lines over TCP; the spooler lands them as files so both modes flow
+through the SAME native-parser/block-shuffle plane) — and cuts it into
+**micro-pass windows**: bounded batches of complete files that each
+become one ordinary BoxDataset, preloadable and trainable exactly like
+a day's pass.
+
+Torn/in-progress-file safety (the round-19 fix, pinned by tests):
+
+  * rename convention — writers that follow write-temp-then-rename
+    publish atomically; any ``.tmp`` / ``.part`` / ``.inprogress`` /
+    ``.open`` suffix or ``.``/``_`` name prefix is skipped outright.
+  * size stability — a bare file only counts as sealed after its size
+    is unchanged (and nonzero) across ``streaming_stable_polls``
+    consecutive watcher polls, so an in-place appender's torn tail is
+    never parsed mid-write.
+  * consumed-file ledger — every file that entered a committed window
+    is recorded (atomic JSON replace, riding the journal/checkpoint
+    dir) and skipped on re-scan, so a restarted tailer resumes without
+    double-consuming. Commit happens at the micro-pass BOUNDARY (after
+    the window trained or was refused), so a crash mid-window re-reads
+    at-least-once — the journal sweep on restart keeps that sound.
+
+No jax imports here: window formation runs on the ingest thread while
+the previous window trains.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from paddlebox_tpu.config import flags
+from paddlebox_tpu.data.dataset import BoxDataset
+from paddlebox_tpu.utils.stats import gauge_set, stat_add
+
+#: writer-convention suffixes that mark a file as still being written
+IN_PROGRESS_SUFFIXES = (".tmp", ".part", ".inprogress", ".open")
+
+
+def _is_in_progress_name(name: str) -> bool:
+    """Rename-convention check: temp-suffixed or hidden names are a
+    writer's scratch space, never ingested."""
+    if name.startswith(".") or name.startswith("_"):
+        return True
+    return any(name.endswith(s) for s in IN_PROGRESS_SUFFIXES)
+
+
+def _count_lines(path: str) -> int:
+    """Instance count of a MultiSlot text file = its line count; a
+    buffered byte scan (no decode) keeps window formation cheap."""
+    n = 0
+    last = b"\n"
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            n += chunk.count(b"\n")
+            last = chunk[-1:]
+    if last != b"\n":
+        n += 1  # unterminated final line still parses as one instance
+    return n
+
+
+class FileLedger:
+    """Consumed-file ledger: which source files already entered a
+    committed micro-pass window. Persisted as one JSON doc, replaced
+    atomically (write temp + fsync + os.replace) so a crash never
+    leaves a torn ledger — the restart worst case is re-consuming the
+    windows since the last commit, never skipping unconsumed data.
+
+    Keyed by basename: the watch dir is the namespace (upstream
+    rotation moves files in, never renames within), and basenames keep
+    the ledger valid when the model/journal root is re-mounted at a
+    different path than the watch dir."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._files: Dict[str, int] = {}
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+            self._files = {str(k): int(v)
+                           for k, v in doc.get("files", {}).items()}
+        except (OSError, ValueError):
+            self._files = {}
+
+    def consumed(self, path: str) -> bool:
+        return os.path.basename(path) in self._files
+
+    def record(self, paths: Sequence[str]) -> None:
+        """In-memory mark only — pair with flush(). Split out so a
+        caller can take its lock around the dict update and keep the
+        fsync'd file write outside it."""
+        for p in paths:
+            try:
+                size = os.path.getsize(p)
+            except OSError:
+                size = -1
+            self._files[os.path.basename(p)] = size
+
+    def flush(self) -> None:
+        """Persist the ledger (write temp + fsync + atomic replace).
+        Single-writer contract: only one thread records/flushes (the
+        micro-pass boundary); concurrent readers stay safe because a
+        crash mid-flush leaves the previous complete doc in place."""
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"version": 1, "files": self._files}, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.path)
+
+    def mark(self, paths: Sequence[str]) -> None:
+        if not paths:
+            return
+        self.record(paths)
+        self.flush()
+
+    def __len__(self) -> int:
+        return len(self._files)
+
+
+class DirectoryWatcher:
+    """Poll a directory for complete, unconsumed data files.
+
+    Each ``poll()`` re-lists the dir and returns the files that became
+    ready since the last call (deterministic mtime-then-name order).
+    Ready = not temp-named, not ledger-consumed, nonzero size unchanged
+    across ``stable_polls`` consecutive polls. Returned files are
+    remembered in-process so one watcher never yields a file twice;
+    cross-restart dedup is the ledger's job."""
+
+    def __init__(self, source_dir: str, ledger: Optional[FileLedger] = None,
+                 stable_polls: Optional[int] = None) -> None:
+        self.source_dir = source_dir
+        self.ledger = ledger
+        self.stable_polls = int(
+            stable_polls if stable_polls is not None
+            else flags.get_flag("streaming_stable_polls"))
+        self._sizes: Dict[str, Tuple[int, int]] = {}  # name -> (size, stable)
+        self._yielded: set = set()
+
+    def poll(self) -> List[str]:
+        try:
+            names = os.listdir(self.source_dir)
+        except OSError:
+            return []
+        ready: List[Tuple[float, str, str]] = []
+        for name in sorted(names):
+            if _is_in_progress_name(name) or name in self._yielded:
+                continue
+            path = os.path.join(self.source_dir, name)
+            if self.ledger is not None and self.ledger.consumed(path):
+                self._yielded.add(name)
+                continue
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue  # vanished between listdir and stat
+            if not os.path.isfile(path) or st.st_size == 0:
+                continue
+            size, stable = self._sizes.get(name, (-1, 0))
+            stable = stable + 1 if st.st_size == size else 1
+            self._sizes[name] = (st.st_size, stable)
+            if stable >= self.stable_polls:
+                ready.append((st.st_mtime, name, path))
+        out = []
+        for _, name, path in sorted(ready):
+            self._yielded.add(name)
+            self._sizes.pop(name, None)
+            out.append(path)
+        if out:
+            stat_add("streaming_files_discovered", len(out))
+        return out
+
+
+class SocketFeedServer:
+    """Socket-feed mode: a TCP listener that spools pushed MultiSlot
+    text lines into the watched directory.
+
+    Producers connect and stream newline-terminated lines (the same
+    bytes a file drop would hold). The spooler writes them to a
+    ``spool-*.txt.tmp`` scratch file and RENAMES it into place every
+    ``spool_lines`` lines and on connection close — the exact
+    write-temp-then-rename convention the DirectoryWatcher trusts, so
+    socket ingest reuses the whole file-based micro-pass plane instead
+    of growing a second parser path."""
+
+    def __init__(self, spool_dir: str, port: int = 0,
+                 spool_lines: int = 2048, host: str = "127.0.0.1") -> None:
+        os.makedirs(spool_dir, exist_ok=True)
+        self.spool_dir = spool_dir
+        self.spool_lines = int(spool_lines)
+        self._srv = socket.create_server((host, port))
+        self._srv.settimeout(0.2)
+        self.port = self._srv.getsockname()[1]
+        self._seq_lock = threading.Lock()
+        self._seq = 0                       # guarded-by: _seq_lock
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []  # accept-thread only (+ close() after stop)
+        self._accept = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="stream-accept")
+        self._accept.start()
+
+    def _next_spool(self) -> str:
+        with self._seq_lock:
+            self._seq += 1
+            return os.path.join(self.spool_dir,
+                                "spool-%08d.txt" % self._seq)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(target=self._conn_loop, args=(conn,),
+                                 daemon=True, name="stream-spool")
+            t.start()
+            self._threads.append(t)
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        try:
+            buf = b""
+            lines: List[bytes] = []
+            conn.settimeout(0.5)
+            while not self._stop.is_set():
+                try:
+                    chunk = conn.recv(1 << 16)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                if not chunk:
+                    break
+                buf += chunk
+                *full, buf = buf.split(b"\n")
+                lines.extend(full)
+                if len(lines) >= self.spool_lines:
+                    self._seal(lines[:self.spool_lines])
+                    lines = lines[self.spool_lines:]
+            if buf:
+                lines.append(buf)  # producer closed without newline
+            self._seal(lines)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _seal(self, lines: List[bytes]) -> None:
+        lines = [ln for ln in lines if ln.strip()]
+        if not lines:
+            return
+        path = self._next_spool()
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(b"\n".join(lines) + b"\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        stat_add("streaming_spool_files", 1)
+        stat_add("streaming_spool_lines", len(lines))
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        self._accept.join(timeout=5.0)
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+
+class MicroWindow:
+    """One micro-pass worth of source files, wrapped as a BoxDataset.
+
+    ``born_ts`` (newest source-file mtime, wall clock) is the ingest
+    timestamp the freshness gauges measure from: ingest-to-train lag is
+    train start minus born_ts; ingest-to-serve freshness is the serving
+    swap minus born_ts."""
+
+    def __init__(self, index: int, files: List[str], instances: int,
+                 dataset: BoxDataset) -> None:
+        self.index = index
+        self.files = list(files)
+        self.instances = int(instances)
+        self.dataset = dataset
+        self.born_ts = max((os.path.getmtime(f) for f in files),
+                           default=time.time())
+        self.formed_ts = time.time()
+
+
+class StreamingDataset:
+    """Tail a live source into a sequence of micro-pass windows.
+
+    ``next_window(deadline=...)`` blocks (polling at
+    ``streaming_poll_secs``) until enough complete files accumulate to
+    fill ``streaming_micro_pass_instances`` instances, then returns a
+    MicroWindow whose BoxDataset rides the same native parser and
+    (optional) block-shuffle mesh plane as a batch pass. A partial
+    window is flushed when ``flush_after`` seconds pass with data
+    pending but below the bound — freshness beats fullness on a slow
+    stream. Windows are committed (ledger-marked) by the runner at the
+    micro-pass boundary via ``commit_window``.
+
+    Thread contract: next_window runs on ONE ingest/driver thread;
+    commit_window on the train driver. The ledger write is the only
+    shared mutation and both callers serialize through ``_lock``.
+    """
+
+    def __init__(self, feed, source_dir: str,
+                 ledger_dir: Optional[str] = None,
+                 read_threads: int = 2, shuffler=None,
+                 micro_pass_instances: Optional[int] = None,
+                 flush_after: Optional[float] = None,
+                 socket_port: Optional[int] = None,
+                 dataset_kwargs: Optional[dict] = None) -> None:
+        self.feed = feed
+        self.source_dir = source_dir
+        self.read_threads = int(read_threads)
+        self.shuffler = shuffler
+        self.micro_pass_instances = int(
+            micro_pass_instances if micro_pass_instances is not None
+            else flags.get_flag("streaming_micro_pass_instances"))
+        self.poll_secs = float(flags.get_flag("streaming_poll_secs"))
+        # partial-window flush: default a handful of poll intervals —
+        # long enough to coalesce a burst, short enough that a trickle
+        # source still trains within seconds
+        self.flush_after = (float(flush_after) if flush_after is not None
+                            else 10.0 * self.poll_secs)
+        self._dataset_kwargs = dict(dataset_kwargs or {})
+        os.makedirs(source_dir, exist_ok=True)
+        self.ledger = FileLedger(os.path.join(
+            ledger_dir or source_dir, "_streaming", "consumed.json"))
+        self.watcher = DirectoryWatcher(source_dir, self.ledger)
+        self.server: Optional[SocketFeedServer] = None
+        if socket_port is not None:
+            self.server = SocketFeedServer(source_dir, port=socket_port)
+        self._lock = threading.Lock()
+        self._pending: List[Tuple[str, int]] = []  # (path, lines)
+        self._pending_since: Optional[float] = None
+        self._windows = 0
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------- windows
+    def _pending_instances(self) -> int:
+        return sum(n for _, n in self._pending)
+
+    def _cut_window(self) -> MicroWindow:
+        """Take pending files up to the instance bound into one window."""
+        files: List[str] = []
+        instances = 0
+        while self._pending:
+            path, n = self._pending[0]
+            if files and instances + n > self.micro_pass_instances:
+                break
+            files.append(path)
+            instances += n
+            self._pending.pop(0)
+        self._pending_since = time.time() if self._pending else None
+        ds = BoxDataset(self.feed, read_threads=self.read_threads,
+                        shuffler=self.shuffler, **self._dataset_kwargs)
+        ds.set_filelist(files)
+        win = MicroWindow(self._windows, files, instances, ds)
+        self._windows += 1
+        gauge_set("streaming_window_instances", float(instances))
+        stat_add("streaming_windows_formed")
+        return win
+
+    def next_window(self, deadline: Optional[float] = None
+                    ) -> Optional[MicroWindow]:
+        """Block until a window is ready; None on deadline/stop.
+
+        deadline is an absolute time.time() bound — the runner passes
+        now + streaming_idle_timeout_secs to drain finite drops."""
+        while not self._stop.is_set():
+            for path in self.watcher.poll():
+                try:
+                    n = _count_lines(path)
+                except OSError:
+                    continue  # vanished mid-count: next poll re-lists
+                if n == 0:
+                    continue
+                if not self._pending:
+                    self._pending_since = time.time()
+                self._pending.append((path, n))
+            if self._pending:
+                full = self._pending_instances() >= self.micro_pass_instances
+                aged = (self._pending_since is not None
+                        and time.time() - self._pending_since
+                        >= self.flush_after)
+                if full or aged:
+                    return self._cut_window()
+            if deadline is not None and time.time() >= deadline:
+                return None
+            self._stop.wait(self.poll_secs)
+        return None
+
+    def commit_window(self, window: MicroWindow) -> None:
+        """Micro-pass boundary: record the window's files as consumed so
+        a restart never double-trains them. Called AFTER the window
+        trained (or was refused — a refused window is dropped, not
+        retried: the gate exists to keep a poisoned drop out). The
+        in-memory mark happens under the lock (the watcher reads it);
+        the fsync'd file write happens OUTSIDE it — only this (train
+        driver) thread writes, and a torn flush just re-consumes the
+        last windows on restart."""
+        if window.files:
+            with self._lock:
+                self.ledger.record(window.files)
+            self.ledger.flush()
+        stat_add("streaming_windows_committed")
+
+    # -------------------------------------------------------------- control
+    def stop(self) -> None:
+        """Unblock next_window and stop the socket spooler."""
+        self._stop.set()
+        if self.server is not None:
+            self.server.close()
+
+    def resume(self) -> None:
+        """Clear a prior stop() so a fresh runner.run() can tail again
+        (drain-and-resume cadence); a closed socket spooler stays
+        closed — re-create the StreamingDataset for a new feed port."""
+        self._stop.clear()
+
+    def close(self) -> None:
+        self.stop()
+
+    @property
+    def socket_port(self) -> Optional[int]:
+        return self.server.port if self.server is not None else None

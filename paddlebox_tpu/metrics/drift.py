@@ -306,6 +306,40 @@ class SlotDriftMonitor:
                 worst_slot=drift["worst_slot"])
         return rec
 
+    def preview_block(self, block) -> float:
+        """Score a candidate block against the rolling reference WITHOUT
+        admitting it: no reference advance, no window count, no gauge
+        publish. The streaming admission gate calls this on a loaded
+        micro-pass window BEFORE begin_pass — a poisoned window is
+        refused before it trains, and (unlike roll()) it never enters
+        the reference, so a burst of poison can't normalize itself.
+        Returns 0.0 until a reference exists (the first admitted
+        windows define normal).
+
+        Thread contract: callers own this monitor exclusively (the
+        streaming runner's private instance) — the live-window swap
+        below would interleave observations from a concurrent
+        observe_* feeder."""
+        with self._lock:
+            saved, self._cur = self._cur, _Window()
+        try:
+            self.observe_block(block)
+            with self._lock:
+                cur = self._cur.summary() if self._cur.n_recs else None
+                refs = list(self._ref)
+        finally:
+            with self._lock:
+                self._cur = saved
+        if cur is None or not refs:
+            return 0.0
+        return float(self._drift_against(cur, self._ref_mean(refs))["score"])
+
+    def admit_block(self, block) -> None:
+        """Fold an ADMITTED window's block into the rolling reference
+        (observe + roll, the paired commit of preview_block)."""
+        self.observe_block(block)
+        self.roll()
+
     def snapshot(self) -> dict:
         """Exporter surface: the last rolled record + the live window's
         size (defensive copies only)."""

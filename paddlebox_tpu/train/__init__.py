@@ -1,4 +1,6 @@
 from paddlebox_tpu.train.trainer import BoxTrainer, TrainStepFns
 from paddlebox_tpu.train.checkpoint import CheckpointManager
+from paddlebox_tpu.train.streaming_runner import StreamingRunner
 
-__all__ = ["BoxTrainer", "TrainStepFns", "CheckpointManager"]
+__all__ = ["BoxTrainer", "TrainStepFns", "CheckpointManager",
+           "StreamingRunner"]

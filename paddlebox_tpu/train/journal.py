@@ -402,6 +402,21 @@ class TouchedRowJournal:
                                  + list(self._sealed)),
                     "dirty_rows": self._dirty_rows}
 
+    def publish(self) -> Optional[str]:
+        """Seal the active segment and return its sealed path (None when
+        nothing is pending). The streaming micro-pass boundary calls
+        this: sealing fsyncs the window's touched rows and renames the
+        segment ``.open``→``.jrnl``, so a serving-side JournalDeltaSource
+        picks the whole window up on its next poll as durable bytes —
+        freshness rides this cadence, not the SaveDelta one. Sealing is
+        exactly the rotation path, so segment bounds/retention apply
+        unchanged."""
+        with self._lock:  # seal-under-lock contract: see append_rows
+            if self._f is None:
+                return None
+            self._seal_locked()  # boxlint: disable=BX601
+            return self._sealed[-1] if self._sealed else None
+
     @property
     def dirty_rows(self) -> int:
         with self._lock:

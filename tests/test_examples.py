@@ -82,6 +82,12 @@ def test_serve_xbox_example():
     assert "serving view:" in out and "feasign" in out
 
 
+def test_stream_train_serve_example():
+    out = run_example("stream_train_serve.py")
+    assert "micro-pass" in out
+    assert "ingest-to-serve freshness" in out
+
+
 # tier-1 budget (round-10 headroom audit, 8.6s): sharded-slab
 # pipeline parity/learning is covered by test_pipeline.py's dedicated
 # sharded suite; the base pipeline example above stays in tier-1.

@@ -58,6 +58,12 @@ HEADLINES: List[Tuple[str, str, bool]] = [
     # client-side pull rate (tools/fleet_probe.py; absent pre-round-21
     # rounds compare as n/a)
     ("fleet_pull_keys_per_sec", "keys/s", True),
+    # round-19 streaming plane (landed after 21 — absent earlier rounds
+    # compare as n/a): sustained micro-pass rate, and the drop-to-
+    # journal-poll freshness where LOWER is better — a rise past the
+    # threshold is a staleness regression
+    ("streaming_examples_per_sec", "ex/s", True),
+    ("streaming_freshness_secs", "s", False),
 ]
 
 

@@ -118,6 +118,13 @@ FLOORS = {
     # 10% misses over a 1M base. Recorded under the load guard on
     # 2026-08-07 (load1 0.1); floor = ~40% of recorded
     "fleet_pull_keys_per_sec": (1.13e6, 450e3),
+    # round-19 streaming plane (landed after 21): the micro-pass
+    # cadence end to end — watcher discovery + admission preview +
+    # preload-overlapped training + per-boundary journal publish over
+    # pre-dropped files (DeepFM 16-slot shape, 2 windows x 3000
+    # instances). Recorded quiet on 2026-08-07 (load1 0.34); floor =
+    # ~40% of recorded
+    "streaming_examples_per_sec": (1.05e4, 4.2e3),
 }
 
 # CEILINGS: lower-is-better stages (latencies). Same load-guard
@@ -162,6 +169,13 @@ CEILINGS = {
     # (394,496 B/step: ids+segments+labels+valid+uids at the uid-lean
     # wire); ceiling = ~1.5x
     "device_h2d_bytes_per_step": (394.5e3, 600e3),
+    # round-19 streaming plane: drop-to-journal-poll freshness — the
+    # time from an atomic file drop to a serving JournalDeltaSource
+    # poll returning the window's trained rows (one 3000-instance
+    # micro-pass of train time on the clock). Recorded quiet on
+    # 2026-08-07 (load1 0.34: 72ms); ceiling leaves room for co-tenant
+    # load — the same stage measured <500ms at load1 1.6
+    "streaming_freshness_ms": (72.0, 700.0),
 }
 
 RETRIES = 2          # extra isolated re-measures before a floor may fail
@@ -926,6 +940,104 @@ def section_device(rng, K):
     tr.close()
 
 
+def section_streaming(rng, K):
+    # --- streaming micro-pass plane (round 19) -----------------------
+    # The continuous-training cadence end to end: watcher discovery +
+    # admission preview + preload-overlapped micro-pass training +
+    # per-boundary journal publish, sustained ex/s over pre-dropped
+    # files (FLOOR), and the drop-to-journal-poll freshness — the
+    # seconds from an atomic file drop to a serving JournalDeltaSource
+    # poll returning the trained rows (CEILING: lower is better, a rise
+    # is a staleness regression).
+    import shutil
+    import tempfile
+    import threading
+
+    from paddlebox_tpu.config import flags
+    from paddlebox_tpu.config.configs import (CheckpointConfig,
+                                              SparseOptimizerConfig,
+                                              TableConfig, TrainerConfig)
+    from paddlebox_tpu.data import (StreamingDataset,
+                                    write_synthetic_ctr_files)
+    from paddlebox_tpu.models.base import ModelSpec
+    from paddlebox_tpu.models.deepfm import DeepFM
+    from paddlebox_tpu.serving.refresh import JournalDeltaSource
+    from paddlebox_tpu.train import CheckpointManager, StreamingRunner
+    from paddlebox_tpu.train.trainer import BoxTrainer
+
+    root = tempfile.mkdtemp()
+    files, feed = write_synthetic_ctr_files(
+        os.path.join(root, "staging"), num_files=4, lines_per_file=1500,
+        num_slots=16, vocab_per_slot=5000, max_len=4, seed=3)
+    feed = type(feed)(slots=feed.slots, batch_size=512)
+    old_poll = flags.get_flag("streaming_poll_secs")
+    flags.set_flag("streaming_poll_secs", 0.02)
+    trainer = BoxTrainer(
+        DeepFM(ModelSpec(num_slots=16, slot_dim=3 + 8), hidden=(256, 128)),
+        TableConfig(embedx_dim=8, pass_capacity=1 << 18,
+                    optimizer=SparseOptimizerConfig(
+                        mf_create_thresholds=0.0, mf_initial_range=1e-3)),
+        feed, TrainerConfig(dense_lr=1e-3), seed=0)
+    cm = CheckpointManager(
+        CheckpointConfig(batch_model_dir=os.path.join(root, "batch"),
+                         xbox_model_dir=os.path.join(root, "xbox"),
+                         async_save=False),
+        trainer.table)
+    seq = [0]
+
+    def run_once(n_files=4, max_passes=2):
+        seq[0] += 1
+        source = os.path.join(root, "src-%d" % seq[0])
+        os.makedirs(source)
+        for i, f in enumerate(files[:n_files]):
+            dst = os.path.join(source, "drop-%04d.txt" % i)
+            shutil.copyfile(f, dst + ".tmp")
+            os.replace(dst + ".tmp", dst)
+        stream = StreamingDataset(feed, source,
+                                  micro_pass_instances=2 * 1500)
+        # the refusal threshold parked high: a drift refusal would skip
+        # a window's instances and corrupt the rate (the preview cost
+        # itself stays on the clock)
+        runner = StreamingRunner(trainer, stream, cm=cm, base_every=0,
+                                 admission_max_drift=10.0)
+        return runner.run(max_micro_passes=max_passes, idle_timeout=10.0)
+
+    try:
+        run_once()                           # compile + warm
+
+        def m_stream():
+            return run_once()["examples_per_sec"]
+
+        report("streaming_examples_per_sec", m_stream(),
+               remeasure=m_stream)
+
+        def m_fresh():
+            jsrc = JournalDeltaSource([cm.journal.dir])
+            jsrc.poll()                      # drain the pre-drop backlog
+            hit = {}
+
+            def tail():
+                while "ts" not in hit:
+                    if jsrc.poll():
+                        hit["ts"] = time.time()
+                        return
+                    time.sleep(0.02)
+
+            t = threading.Thread(target=tail, daemon=True)
+            t.start()
+            t0 = time.time()
+            run_once(n_files=2, max_passes=1)
+            t.join(timeout=10.0)
+            jsrc.close()
+            return ((hit["ts"] - t0) if "ts" in hit else 60.0) * 1e3
+
+        report("streaming_freshness_ms", m_fresh(), remeasure=m_fresh)
+    finally:
+        flags.set_flag("streaming_poll_secs", old_poll)
+        trainer.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 SECTIONS = (
     ("native", section_native),
     ("bucketize", section_bucketize),
@@ -942,6 +1054,7 @@ SECTIONS = (
     ("quality", section_quality),
     ("boxlint", section_boxlint),
     ("device", section_device),
+    ("streaming", section_streaming),
 )
 
 
