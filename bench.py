@@ -1436,6 +1436,76 @@ def measure(platform: str) -> None:
                 jsrc.close()
                 if "ts" in hit:
                     fresh.append(hit["ts"] - t0)
+
+            # e2e watermark leg (round 20): born -> trained -> journal
+            # tailed -> view swapped -> PULLED, sampled per pull against
+            # the response's watermark stamp through a live
+            # ServingServer — the continuously-sampled feed-to-serve
+            # freshness the watermark plane publishes, not a poll probe.
+            # Guarded separately: a serving-side failure must not void
+            # the streaming rates above.
+            e2e_samples: list = []
+            try:
+                from paddlebox_tpu.serving.client import ServingClient
+                from paddlebox_tpu.serving.server import ServingServer
+                source = os.path.join(root, "e2e-src")
+                os.makedirs(source)
+                # one window with base_every=1 lands a base day so the
+                # serving root has a composed view to stack on
+                stream = StreamingDataset(
+                    sfeed, source, micro_pass_instances=win_instances)
+                runner = StreamingRunner(strainer, stream, cm=cm,
+                                         base_every=1,
+                                         admission_max_drift=10.0)
+                drop_all(source, files[:WIN_FILES])
+                runner.run(max_micro_passes=1, idle_timeout=5.0)
+                old_jdir = _fl.get_flag("serving_journal_dir")
+                old_ref = _fl.get_flag("serving_refresh_secs")
+                _fl.set_flag("serving_journal_dir", cm.journal.dir)
+                _fl.set_flag("serving_refresh_secs", 0.05)
+                server = cli = None
+                try:
+                    server = ServingServer(os.path.join(root, "xbox"))
+                    cli = ServingClient([("127.0.0.1", server.port)])
+                    probe_keys = np.arange(1, 65, dtype=np.uint64)
+                    stop_ev = _threading.Event()
+
+                    def puller():
+                        while not stop_ev.is_set():
+                            try:
+                                cli.pull(probe_keys)
+                            except Exception:
+                                pass
+                            if cli.last_watermark > 0:
+                                e2e_samples.append(
+                                    time.time() - cli.last_watermark)
+                            stop_ev.wait(0.02)
+
+                    pt = _threading.Thread(target=puller, daemon=True)
+                    pt.start()
+                    # continuous feed: the remaining windows drain
+                    # through train->journal while pulls sample
+                    stream2 = StreamingDataset(
+                        sfeed, source,
+                        micro_pass_instances=win_instances)
+                    runner2 = StreamingRunner(strainer, stream2, cm=cm,
+                                              base_every=0,
+                                              admission_max_drift=10.0)
+                    drop_all(source, files[WIN_FILES:])
+                    runner2.run(max_micro_passes=n_windows - 1,
+                                idle_timeout=5.0)
+                    time.sleep(0.3)  # final swap + a last stamped pull
+                    stop_ev.set()
+                    pt.join(timeout=5.0)
+                finally:
+                    if cli is not None:
+                        cli.close()
+                    if server is not None:
+                        server.drain()
+                    _fl.set_flag("serving_journal_dir", old_jdir)
+                    _fl.set_flag("serving_refresh_secs", old_ref)
+            except Exception:   # diagnostic leg — never voids the rest
+                e2e_samples = []
             return {
                 "batch_resident_examples_per_sec": round(batch_eps, 1),
                 "streaming_examples_per_sec": round(stream_eps, 1),
@@ -1445,6 +1515,13 @@ def measure(platform: str) -> None:
                 "freshness_secs": (round(float(np.median(fresh)), 3)
                                    if fresh else None),
                 "freshness_runs": [round(f, 3) for f in fresh],
+                "freshness_e2e_p50_secs": (
+                    round(float(np.percentile(e2e_samples, 50)), 3)
+                    if e2e_samples else None),
+                "freshness_e2e_p99_secs": (
+                    round(float(np.percentile(e2e_samples, 99)), 3)
+                    if e2e_samples else None),
+                "freshness_e2e_samples": len(e2e_samples),
                 "window_instances": win_instances}
         finally:
             _fl.set_flag("streaming_poll_secs", old_poll)
@@ -1496,6 +1573,8 @@ def measure(platform: str) -> None:
         "streaming_examples_per_sec": streaming.get(
             "streaming_examples_per_sec", 0),
         "streaming_freshness_secs": streaming.get("freshness_secs", 0),
+        "freshness_e2e_p99_secs": streaming.get(
+            "freshness_e2e_p99_secs", 0),
         "ssd_tier": ssd,
         "ssd_promote_keys_per_sec": ssd.get(
             "ssd_promote_keys_per_sec", 0),
@@ -1653,6 +1732,8 @@ def main() -> None:
             "streaming_examples_per_sec", 0),
         "streaming_freshness_secs": result.get(
             "streaming_freshness_secs", 0),
+        "freshness_e2e_p99_secs": result.get(
+            "freshness_e2e_p99_secs", 0),
         "telemetry_overhead": result.get("telemetry_overhead"),
         "flight_overhead": result.get("flight_overhead"),
         "quality_overhead": result.get("quality_overhead"),
@@ -1664,6 +1745,7 @@ def main() -> None:
         "fleet": fleet,
         "fleet_pull_keys_per_sec": (fleet.get("ladder") or [{}])[-1].get(
             "keys_per_sec", 0),
+        "fleet_qps": (fleet.get("ladder") or [{}])[-1].get("qps", 0),
         "compile_warmup_s": result.get("compile_warmup_s"),
         "diags": diags,
     }

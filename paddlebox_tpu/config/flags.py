@@ -660,3 +660,31 @@ define_flag("streaming_idle_timeout_secs", 0.0,
             "seconds with no new complete window from the source "
             "(0 = run until stop() or max_micro_passes) — the bound "
             "bench/test/demo legs use to drain a finite drop")
+# feed-to-serve watermark plane (obs/watermark.py, round 20): born-ts
+# lineage through train->journal->serving, tier-hit telemetry, and the
+# freshness/tier SLO burn gauges HealthMonitor alarms on
+define_flag("obs_watermark", True,
+            "feed-to-serve watermark plane master switch: when on, the "
+            "streaming boundary stamps every journal publish with the "
+            "window's born-ts span, the serving plane stamps pull "
+            "responses with its applied watermark, and both ends "
+            "observe the end-to-end freshness histogram. Off = no "
+            "stamps, no freshness samples (the pairwise overhead "
+            "bench's control arm); everything else degrades to "
+            "pre-round-20 behavior")
+define_flag("freshness_slo_secs", 30.0,
+            "feed-to-serve freshness SLO: the serving report window's "
+            "p99 of (pull time - applied watermark) is divided by this "
+            "to form the serving_freshness_burn gauge — burn > 1 means "
+            "served vectors are older than the promise and "
+            "HealthMonitor flags the rank (freshness_burn, -0.4). "
+            "0 disables the burn computation (freshness is still "
+            "measured)")
+define_flag("tier_hit_rate_warn", 0.05,
+            "tiered-store hit-rate floor: when a warm feed-pass "
+            "lookup's resident-hit rate (host-RAM hits / keys looked "
+            "up) falls BELOW this, tier_hit_burn (= warn_rate / "
+            "observed_rate) exceeds 1 and HealthMonitor flags the rank "
+            "(tier_hit_low, -0.3) — the SSD tier is thrashing instead "
+            "of absorbing the cold tail. Cold stores (first passes) "
+            "never burn. 0 disables")

@@ -293,7 +293,10 @@ class MicroWindow:
     ``born_ts`` (newest source-file mtime, wall clock) is the ingest
     timestamp the freshness gauges measure from: ingest-to-train lag is
     train start minus born_ts; ingest-to-serve freshness is the serving
-    swap minus born_ts."""
+    swap minus born_ts. ``born_min_ts`` (oldest source-file mtime) is
+    the other end of the span: the pair rides the journal's watermark
+    record (round 20) so the serving plane knows the freshness of what
+    it just applied, not only that something arrived."""
 
     def __init__(self, index: int, files: List[str], instances: int,
                  dataset: BoxDataset) -> None:
@@ -303,6 +306,8 @@ class MicroWindow:
         self.dataset = dataset
         self.born_ts = max((os.path.getmtime(f) for f in files),
                            default=time.time())
+        self.born_min_ts = min((os.path.getmtime(f) for f in files),
+                               default=self.born_ts)
         self.formed_ts = time.time()
 
 

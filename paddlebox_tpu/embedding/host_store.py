@@ -28,7 +28,7 @@ from paddlebox_tpu.embedding.accessor import (ValueLayout, CLICK,
                                               UNSEEN_DAYS)
 from paddlebox_tpu.embedding.ssd_tier import (  # noqa: F401 (re-exports)
     MV_FAULT_IN, MV_SPILL, SpillTier, apply_missed_days)
-from paddlebox_tpu.utils.stats import stat_add
+from paddlebox_tpu.utils.stats import gauge_set, stat_add
 from paddlebox_tpu.utils.lockwatch import make_rlock
 
 _GROW = 1 << 16
@@ -94,6 +94,9 @@ class HostEmbeddingStore:
         block inside the tier), not a per-key file open."""
         keys = np.asarray(keys, dtype=np.uint64)
         with self._lock:
+            # cold = nothing to hit yet: a first-pass 0% resident rate
+            # is construction, not thrashing — it must not burn
+            cold = not self._index and not len(self._tier)
             rows = np.empty(keys.size, dtype=np.int64)
             missing: List[int] = []
             idx = self._index
@@ -102,6 +105,9 @@ class HostEmbeddingStore:
                 rows[i] = r
                 if r < 0:
                     missing.append(i)
+            n_res = int(keys.size) - len(missing)
+            if keys.size:
+                stat_add("sparse_keys_resident_hit", n_res)
             if missing and len(self._tier):
                 miss = np.asarray(missing, np.int64)
                 spilled = self._tier.contains(keys[miss])
@@ -124,7 +130,32 @@ class HostEmbeddingStore:
                     self._values[r] = init[j]
                     rows[i] = r
                 stat_add("sparse_keys_created", len(missing))
+            # tier ladder (round 20): the hit rate is over keys the
+            # store already KNEW (resident + tier-faulted) — created
+            # keys are construction, not thrashing, so an all-new
+            # fall-through (e.g. the whole working set slab-resident)
+            # produces no rate sample at all rather than a false 0%
+            known = int(keys.size) - len(missing)
+            if known > 0:
+                self._tier_gauges(n_res / known, cold)
             return self._values[rows].copy()
+
+    def _tier_gauges(self, hit_rate: float, cold: bool) -> None:  # boxlint: disable=BX401 — caller holds _lock (lookup_or_create)
+        """Tier-ladder gauges for one feed-pass lookup (round 20):
+        resident occupancy + the host-RAM hit rate, and the burn score
+        HealthMonitor alarms on (warn_rate / rate — see flag
+        tier_hit_rate_warn). Cold stores set the rate but never burn.
+        Called under _lock; pure telemetry, never raises."""
+        gauge_set("host_store_resident_rows", float(len(self._index)))
+        gauge_set("tier_hit_rate", float(hit_rate))
+        if cold:
+            return
+        # lazy import: the embedding layer only reaches obs when the
+        # gauge actually fires, keeping module import order flat
+        from paddlebox_tpu.obs.watermark import tier_hit_burn
+        burn = tier_hit_burn(hit_rate)
+        if burn is not None:
+            gauge_set("tier_hit_burn", round(burn, 4))
 
     def write_back(self, keys: np.ndarray, values: np.ndarray) -> None:
         """End-of-pass HBM→host dump (EndPass / dump_to_cpu analog)."""
@@ -217,6 +248,11 @@ class HostEmbeddingStore:
                     out[fi] = self._values[rows]
                     found[fi] = True
                     stat_add("sparse_keys_faulted_in", int(fi.size))
+                    # prefetch rung of the tier ladder: these promotes
+                    # ran on the stager thread, hidden under the
+                    # previous pass's training tail (round 20)
+                    stat_add("sparse_keys_prefetch_faulted",
+                             int(fi.size))
                     if self._journal_sink is not None:
                         self._journal_sink(MV_FAULT_IN, fkeys)
         return out, found

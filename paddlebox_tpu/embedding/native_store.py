@@ -25,7 +25,7 @@ from paddlebox_tpu.config.configs import TableConfig
 from paddlebox_tpu.embedding.accessor import ValueLayout, UNSEEN_DAYS
 from paddlebox_tpu.embedding.ssd_tier import (MV_FAULT_IN, MV_SPILL,
                                               SpillTier)
-from paddlebox_tpu.utils.stats import stat_add
+from paddlebox_tpu.utils.stats import gauge_set, stat_add
 
 _U64P = ctypes.POINTER(ctypes.c_uint64)
 _I64P = ctypes.POINTER(ctypes.c_int64)
@@ -102,10 +102,14 @@ class NativeHostEmbeddingStore:
 
     def lookup_or_create(self, keys: np.ndarray) -> np.ndarray:
         keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        # cold = nothing to hit yet: a first-pass 0% resident rate is
+        # construction, not thrashing — it must not burn (round 20)
+        cold = not len(self) and not len(self._tier)
         rows, created = self._rows_of(keys, create=True)
         out = np.empty((keys.size, self.layout.width), np.float32)
         self._lib.hs_gather(self._h, _p(rows, _I64P), keys.size,
                             _p(out, _F32P))
+        n_new = 0
         if created.any():
             spilled_m = np.zeros(keys.size, bool)
             if len(self._tier):
@@ -129,7 +133,35 @@ class NativeHostEmbeddingStore:
             self._lib.hs_scatter(
                 self._h, _p(cr, _I64P), cr.size,
                 _p(np.ascontiguousarray(out[created]), _F32P))
+        # tier ladder (round 20): resident hit = answered from host RAM
+        # without a create/fault; the rate's denominator is keys the
+        # store already KNEW (resident + tier-faulted) — created keys
+        # are construction, not thrashing, so an all-new fall-through
+        # produces no rate sample at all rather than a false 0%
+        n_res = int(keys.size) - int(created.sum())
+        if keys.size:
+            stat_add("sparse_keys_resident_hit", n_res)
+        known = int(keys.size) - n_new
+        if known > 0:
+            self._tier_gauges(n_res / known, cold)
         return out
+
+    def _tier_gauges(self, hit_rate: float, cold: bool) -> None:
+        """Tier-ladder gauges for one feed-pass lookup (round 20) —
+        the native mirror of HostEmbeddingStore._tier_gauges: resident
+        occupancy + host-RAM hit rate, and the burn score
+        HealthMonitor alarms on. Cold stores set the rate but never
+        burn. Pure telemetry, never raises."""
+        gauge_set("host_store_resident_rows", float(len(self)))
+        gauge_set("tier_hit_rate", float(hit_rate))
+        if cold:
+            return
+        # lazy import: the embedding layer only reaches obs when the
+        # gauge actually fires, keeping module import order flat
+        from paddlebox_tpu.obs.watermark import tier_hit_burn
+        burn = tier_hit_burn(hit_rate)
+        if burn is not None:
+            gauge_set("tier_hit_burn", round(burn, 4))
 
     def lookup(self, keys: np.ndarray) -> np.ndarray:
         """Test-mode fetch: missing keys read as zero rows; tier keys are
@@ -172,6 +204,10 @@ class NativeHostEmbeddingStore:
                     out[fi] = vals
                     found[fi] = True
                     stat_add("sparse_keys_faulted_in", int(fkeys.size))
+                    # prefetch-path fault-ins get their own ladder rung:
+                    # rows promoted EARLY (off the boundary clock)
+                    stat_add("sparse_keys_prefetch_faulted",
+                             int(fkeys.size))
                     if self._journal_sink is not None:
                         self._journal_sink(MV_FAULT_IN, fkeys)
         return out, found

@@ -38,12 +38,14 @@ mutations, and the tier is only ever reached through its owner.
 from __future__ import annotations
 
 import os
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from paddlebox_tpu.embedding.accessor import CLICK, SHOW, UNSEEN_DAYS
 from paddlebox_tpu.embedding.ckpt_store import map_part, write_part
+from paddlebox_tpu.utils.stats import gauge_set, hist_observe, stat_add
 
 # MOVE directions across the resident/tier boundary — canonical in the
 # jax-free journal-format leaf (utils/journal_format.py, round 21: the
@@ -255,6 +257,19 @@ class SpillTier:
                                   np.arange(keys.size, dtype=np.int64))
         self._idx_live = np.insert(self._idx_live, pos, True)
         self._n_live += int(keys.size)
+        if self.dir:
+            stat_add("ssd_keys_spilled", int(keys.size))
+            self._occupancy_gauges()
+
+    def _occupancy_gauges(self) -> None:
+        """Host-index occupancy of the LIVE (on-disk) tier into the
+        /metrics plane (round 20). Memory-mode tiers (replay scratch,
+        spill-less tables) stay silent — a journal replay must never
+        overwrite the live process's tier gauges with scratch state."""
+        gauge_set("ssd_tier_live_keys", float(self._n_live))
+        gauge_set("ssd_tier_index_entries", float(self._idx_keys.size))
+        gauge_set("ssd_tier_dead_entries", float(self._idx_dead))
+        gauge_set("ssd_tier_blocks", float(len(self._blocks)))
 
     def _purge_dead_entries(self, keys: np.ndarray) -> None:
         """Hard-remove dead index entries for keys about to be
@@ -284,6 +299,7 @@ class SpillTier:
         out = np.empty((keys.size, self.width), np.float32)
         if keys.size == 0:
             return out
+        t0 = time.perf_counter() if self.dir else 0.0
         pos = self._probe(keys)
         if (pos < 0).any():
             raise KeyError("read of a key not live in the SSD tier")
@@ -297,6 +313,17 @@ class SpillTier:
             out[m] = rows
         if pop:
             self._kill(pos, bids, offs)
+        if self.dir:
+            # SSD-promote rung of the tier hit ladder (round 20): how
+            # many keys crossed up, and how long one batched promote
+            # took — memory-mode (replay scratch) stays silent
+            if pop:
+                stat_add("ssd_keys_promoted", int(keys.size))
+                hist_observe("ssd_promote_us",
+                             (time.perf_counter() - t0) * 1e6)
+                self._occupancy_gauges()
+            else:
+                stat_add("ssd_keys_peeked", int(keys.size))
         return out
 
     def discard(self, keys: np.ndarray) -> int:

@@ -44,6 +44,15 @@ and loses
          aliased, the regime-step mechanism; the step is silently
          paying a slab memcpy, so the rank reads unhealthy even while
          it keeps stepping
+  * 0.4  freshness burn (round 20): ``serving_freshness_burn`` above
+         1.0 — the report window's p99 feed-to-serve freshness
+         (obs/watermark.py, sampled per pull against the journal
+         watermark) exceeded ``freshness_slo_secs``; a stalling
+         journal tail trips this within two report windows
+  * 0.3  tier-hit burn (round 20): ``tier_hit_burn`` above 1.0 — a
+         warm store's host-RAM hit rate fell below
+         ``tier_hit_rate_warn`` (the SSD tier is thrashing instead of
+         absorbing the cold tail)
 ``healthy`` = score >= 0.5.
 
 Staleness measures TELEMETRY silence, which is the only signal rank 0
@@ -98,6 +107,9 @@ class HealthMonitor:
         warn = self._per_rank(merged, "stats.log_warning_lines")
         beat_age = self._per_rank(merged, "gauges.beat_age_s")
         slo_burn = self._per_rank(merged, "gauges.serving_slo_burn")
+        fresh_burn = self._per_rank(merged,
+                                    "gauges.serving_freshness_burn")
+        tier_burn = self._per_rank(merged, "gauges.tier_hit_burn")
         drift = self._per_rank(merged, "gauges.data_drift_score")
         copc = self._per_rank(merged, "gauges.quality_copc")
         recompiles = self._per_rank(merged, "stats.device_recompiles")
@@ -137,6 +149,16 @@ class HealthMonitor:
             if slo_burn.get(r, 0.0) > 1.0:
                 score -= 0.3
                 flags.append("slo_burn")
+            if fresh_burn.get(r, 0.0) > 1.0:
+                # feed-to-serve freshness past SLO (round 20): served
+                # vectors are older than the promise — a stalled
+                # journal tail, a wedged streaming runner, or a
+                # refresh watcher that stopped swapping all land here
+                score -= 0.4
+                flags.append("freshness_burn")
+            if tier_burn.get(r, 0.0) > 1.0:
+                score -= 0.3
+                flags.append("tier_hit_low")
             if beat_age.get(r, 0.0) > self.beat_age_warn:
                 # reporting-but-not-beating: the wedge freshness can't
                 # see — weighted past the 0.5 healthy bar on its own
@@ -177,6 +199,10 @@ class HealthMonitor:
                 entry["err_lines"] = n_err
             if r in slo_burn:
                 entry["slo_burn"] = round(slo_burn[r], 4)
+            if r in fresh_burn:
+                entry["freshness_burn"] = round(fresh_burn[r], 4)
+            if r in tier_burn:
+                entry["tier_hit_burn"] = round(tier_burn[r], 4)
             ranks[str(r)] = entry
             if not entry["healthy"]:
                 unhealthy.append(r)

@@ -1,0 +1,145 @@
+"""Feed-to-serve watermark plane (round 20): freshness lineage + tiers.
+
+The repo's headline claim is seconds-level feed-to-serve freshness, but
+until this round it was only ever PROBED (the round-19 drop-to-servable
+number, the round-21 staleness leg). This module is the shared
+vocabulary that turns it into a continuously measured, alarmed
+invariant:
+
+  * ``data/streaming.py`` stamps each micro-pass window's source-file
+    mtime span (``born_min_ts``/``born_ts``);
+  * ``train/streaming_runner.py`` passes the span into
+    ``TouchedRowJournal.publish`` → a ``KIND_WATERMARK`` record lands
+    in the same fsync as the window's rows
+    (utils/journal_format.py:pack_watermark);
+  * ``serving/refresh.py``'s JournalDeltaSource tracks the newest
+    APPLIED ``born_max`` per journal dir; the low-water-mark across
+    dirs is the view stack's watermark (``applied_watermark``);
+  * ``serving/server.py`` stamps every pull response with it
+    (codec ``wm`` field) and both server and client feed
+    ``observe_freshness`` — so ``now - watermark`` is sampled at pull
+    cadence, not probe cadence, and the histogram's p50/p99 mean
+    "freshness as traffic saw it".
+
+Unit note: the shared histogram buckets are powers of two starting at
+1 (utils/stats.py HIST_BOUNDS) — sub-second freshness in SECONDS would
+collapse into the first bucket, so the histogram observes MILLISECONDS
+(``freshness_e2e_ms``, 1 ms..2^25 ms ≈ 9.3 h) and the derived gauges
+republish seconds under the names the dashboards pin
+(``freshness_e2e_secs`` / ``_p50`` / ``_p99``).
+
+Degrade contract: everything here is telemetry — never raises into the
+serving or training path; ``obs_watermark=false`` turns the whole
+plane off (the pairwise overhead bench's control arm).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Sequence
+
+from paddlebox_tpu.config import flags
+from paddlebox_tpu.utils.stats import (StatRegistry, gauge_get, gauge_set,
+                                       hist_observe, hist_percentile,
+                                       stat_get)
+
+#: the one end-to-end freshness histogram (milliseconds — see unit note)
+FRESHNESS_HIST = "freshness_e2e_ms"
+
+
+def enabled() -> bool:
+    """Watermark plane master switch (flag ``obs_watermark``)."""
+    return bool(flags.get_flag("obs_watermark"))
+
+
+def observe_freshness(watermark_ts: Optional[float],
+                      now: Optional[float] = None) -> Optional[float]:
+    """One end-to-end freshness sample from a watermark-stamped pull:
+    ``now - watermark_ts`` seconds, observed into ``freshness_e2e_ms``
+    and republished as the ``freshness_e2e_secs``/``_p50``/``_p99``
+    gauges (process-cumulative percentiles; the serving report window
+    derives per-window ones from histogram deltas). Returns the sample,
+    or None when there is no watermark yet (cold journal)."""
+    if not watermark_ts or watermark_ts <= 0.0:
+        return None
+    if now is None:
+        now = time.time()
+    fresh = max(0.0, float(now) - float(watermark_ts))
+    hist_observe(FRESHNESS_HIST, fresh * 1e3)
+    gauge_set("freshness_e2e_secs", fresh)
+    counts = StatRegistry.instance().hist_counts(FRESHNESS_HIST)
+    gauge_set("freshness_e2e_secs_p50",
+              hist_percentile(counts, 0.50) / 1e3)
+    gauge_set("freshness_e2e_secs_p99",
+              hist_percentile(counts, 0.99) / 1e3)
+    return fresh
+
+
+def freshness_burn(counts_delta: Sequence[int]) -> Optional[float]:
+    """SLO burn for one report window: p99 of the window's freshness
+    histogram DELTA divided by ``freshness_slo_secs``. > 1 means served
+    vectors are staler than the promise. None when the SLO is disabled
+    or the window saw no stamped pulls (no data is not a burn)."""
+    slo = float(flags.get_flag("freshness_slo_secs"))
+    if slo <= 0.0 or not counts_delta or sum(counts_delta) <= 0:
+        return None
+    return (hist_percentile(list(counts_delta), 0.99) / 1e3) / slo
+
+
+def tier_hit_burn(hit_rate: float) -> Optional[float]:
+    """Tier-hit burn: ``tier_hit_rate_warn / hit_rate`` — > 1 when the
+    resident (host-RAM) hit rate fell below the warn floor, i.e. the
+    SSD tier is thrashing. None when disabled."""
+    warn = float(flags.get_flag("tier_hit_rate_warn"))
+    if warn <= 0.0:
+        return None
+    return warn / max(float(hit_rate), 1e-9)
+
+
+#: the tiered-store hit ladder, fastest tier first: counter name →
+#: ladder label. HBM residency is the device feed slab (whole working
+#: set by construction), host-RAM is the store's resident index,
+#: SSD-promote is a tier fault-in, miss creates the row.
+TIER_LADDER_COUNTERS = (
+    ("sparse_keys_resident_hit", "host_ram_hit"),
+    ("sparse_keys_faulted_in", "ssd_promote"),
+    ("sparse_keys_prefetch_faulted", "ssd_prefetch"),
+    ("sparse_keys_created", "miss_created"),
+)
+
+
+def tier_ladder() -> Dict[str, float]:
+    """Snapshot of the cumulative tier hit ladder (this process) as
+    counts plus per-rung fractions of all ladder traffic — the
+    cluster-report / probe rendering of the tiered-store telemetry."""
+    counts = {label: float(stat_get(name))
+              for name, label in TIER_LADDER_COUNTERS}
+    total = sum(counts.values())
+    out: Dict[str, float] = dict(counts)
+    for label, c in counts.items():
+        out[label + "_frac"] = round(c / total, 4) if total else 0.0
+    out["total"] = total
+    out["tier_hit_rate"] = float(gauge_get("tier_hit_rate"))
+    promote = StatRegistry.instance().hist_counts("ssd_promote_us")
+    out["ssd_promote_p99_us"] = (hist_percentile(promote, 0.99)
+                                 if promote else 0.0)
+    return out
+
+
+def freshness_snapshot() -> Dict[str, float]:
+    """The freshness ladder as the cluster report / probe renders it:
+    last sample + cumulative p50/p99 (seconds) and the streaming-side
+    lag gauges, all from this process's registry."""
+    return {
+        "freshness_e2e_secs": float(gauge_get("freshness_e2e_secs")),
+        "freshness_e2e_secs_p50": float(
+            gauge_get("freshness_e2e_secs_p50")),
+        "freshness_e2e_secs_p99": float(
+            gauge_get("freshness_e2e_secs_p99")),
+        "streaming_ingest_lag_secs": float(
+            gauge_get("streaming_ingest_lag_secs")),
+        "streaming_publish_lag_secs": float(
+            gauge_get("streaming_publish_lag_secs")),
+        "serving_watermark_age_secs": float(
+            gauge_get("serving_watermark_age_secs")),
+    }

@@ -35,10 +35,11 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from paddlebox_tpu.obs import watermark as obs_watermark
 from paddlebox_tpu.obs.tracer import next_trace_id, record_span
 from paddlebox_tpu.serving import codec
 from paddlebox_tpu.utils.rpc import FramedClient, plain_loads
-from paddlebox_tpu.utils.stats import hist_percentile, stat_add
+from paddlebox_tpu.utils.stats import gauge_set, hist_percentile, stat_add
 from paddlebox_tpu.utils.lockwatch import make_lock
 
 #: per-replica failover backoff: after the k-th consecutive failure the
@@ -64,6 +65,7 @@ class ServingClient:
         self._clients: List = [None] * len(self.endpoints)  # guarded-by: _lock
         self._rr = 0  # guarded-by: _lock
         self.last_gen = -1  # guarded-by: _lock
+        self.last_watermark = 0.0  # guarded-by: _lock
         self._fail_streak = [0] * len(self.endpoints)  # guarded-by: _lock
         self._skip_left = [0] * len(self.endpoints)  # guarded-by: _lock
 
@@ -133,16 +135,21 @@ class ServingClient:
 
     # -------------------------------------------------------------- pulls
     def pull(self, keys: np.ndarray,
-             shard: Optional[int] = None) -> np.ndarray:
+             shard: Optional[int] = None,
+             trace: Optional[int] = None) -> np.ndarray:
         """[K] uint64 feasigns → [K, dim] float32 embedding rows.
         Tries every in-backoff-window replica once (round-robin start)
         before giving up; a draining replica or a dead connection fails
         over. Each pull mints a 64-bit trace id carried in the request
         frame (round 14) — the client- and server-side spans share it,
         so a stitched trace shows the request crossing the RPC
-        boundary. ``shard`` declares the box index a FLEET router chose
-        (round 21); a sharded server refuses a mismatch loudly."""
-        trace = next_trace_id()
+        boundary; a FLEET router passes its flight's id instead so the
+        coalesced flight, this pull and the server span stitch into one
+        timeline (round 20). ``shard`` declares the box index a fleet
+        router chose (round 21); a sharded server refuses a mismatch
+        loudly."""
+        if trace is None:
+            trace = next_trace_id()
         req = codec.encode_pull(keys, trace=trace, shard=shard)
         t_pull = time.perf_counter()
         order = self._attempt_order(self._pick())
@@ -168,8 +175,16 @@ class ServingClient:
                     continue
                 raise
             self._note_success(i)
+            wm = codec.decode_watermark(resp)
             with self._lock:
                 self.last_gen = int(resp.get("gen", -1))
+                if wm is not None:
+                    self.last_watermark = wm
+            if wm is not None and obs_watermark.enabled():
+                # the CLIENT-side end-to-end freshness sample: includes
+                # the RPC hop, so this is feed-to-serve as the consumer
+                # of the vectors experienced it
+                obs_watermark.observe_freshness(wm)
             record_span("serving_pull_client", t_pull,
                         time.perf_counter(), trace=trace)
             return codec.decode_rows(resp)
@@ -279,8 +294,17 @@ class _ShardCoalescer:
 
     def _flight_coalesced(self, batch: List[_PullWaiter]) -> None:
         union = np.unique(np.concatenate([w.keys for w in batch]))
+        # one trace id per FLIGHT (round 20): the flight span, the
+        # underlying pull's client span and the replica's server span
+        # all carry it, so trace_stitch shows the coalesced window —
+        # N waiters in, one RPC out — as one timeline
+        trace = next_trace_id()
+        t0 = time.perf_counter()
         try:
-            rows = self.client.pull(union, shard=self.shard)
+            rows = self.client.pull(union, shard=self.shard,
+                                    trace=trace)
+            record_span("fleet_pull_flight", t0, time.perf_counter(),
+                        trace=trace)
             stat_add("serving_fleet_rpcs")
             stat_add("serving_fleet_keys_sent", int(union.size))
             if len(batch) > 1:
@@ -389,6 +413,8 @@ class FleetClient:
         key totals, and QPS from the request delta since the previous
         call (None on the first)."""
         counts: Optional[List[int]] = None
+        fresh: Optional[List[int]] = None
+        wm_low: Optional[float] = None
         requests = keys = 0
         replicas = []
         for s, c in enumerate(self.clients):
@@ -406,12 +432,22 @@ class FleetClient:
                 if hist:
                     counts = ([a + b for a, b in zip(counts, hist)]
                               if counts else list(hist))
+                fh = st.get("freshness_ms_counts") or []
+                if fh:
+                    fresh = ([a + b for a, b in zip(fresh, fh)]
+                             if fresh else list(fh))
+                w = st.get("watermark_ts") or 0.0
+                if isinstance(w, (int, float)) and w > 0:
+                    # min-reduce: the fleet is only as fresh as its
+                    # stalest box (low-water-mark semantics end to end)
+                    wm_low = w if wm_low is None else min(wm_low, w)
         now = time.time()
         with self._lock:
             prev, self._prev_stats = self._prev_stats, (now, requests)
         qps = None
         if prev is not None and now > prev[0]:
             qps = (requests - prev[1]) / (now - prev[0])
+            gauge_set("serving_fleet_qps", qps)
         return {
             "boxes": len(self.clients),
             "replicas": replicas,
@@ -420,6 +456,16 @@ class FleetClient:
             "qps": qps,
             "p50_us": hist_percentile(counts, 0.50) if counts else None,
             "p99_us": hist_percentile(counts, 0.99) if counts else None,
+            # round 20: fleet-wide feed-to-serve freshness — merged
+            # sample histogram percentiles (seconds) + the fleet
+            # watermark and its age at merge time
+            "watermark_ts": wm_low,
+            "freshness_age_secs": (max(0.0, now - wm_low)
+                                   if wm_low else None),
+            "freshness_p50_secs": (hist_percentile(fresh, 0.50) / 1e3
+                                   if fresh and sum(fresh) else None),
+            "freshness_p99_secs": (hist_percentile(fresh, 0.99) / 1e3
+                                   if fresh and sum(fresh) else None),
         }
 
     def drain_all(self) -> None:

@@ -65,12 +65,29 @@ def decode_pull_keys(req: Dict[str, Any]) -> np.ndarray:
     return np.frombuffer(raw, np.uint64, count=n)
 
 
-def encode_rows(rows: np.ndarray, gen: int) -> Dict[str, Any]:
+def encode_rows(rows: np.ndarray, gen: int,
+                watermark: Optional[float] = None) -> Dict[str, Any]:
     """[K, dim] float32 rows (+ the serving view generation they were
-    read from) → pull response frame."""
+    read from) → pull response frame. ``watermark`` (round 20) is the
+    box's applied feed-to-serve watermark (unix secs): the newest
+    source-data birth time the served view vouches for, stamped so the
+    CLIENT can compute true end-to-end freshness per pull. Omitted
+    while the journal feed is cold (old servers simply never send it —
+    old clients ignore the extra field: plain-dict forward compat)."""
     rows = np.ascontiguousarray(rows, np.float32)
-    return {"rows": rows.tobytes(), "n": int(rows.shape[0]),
+    resp = {"rows": rows.tobytes(), "n": int(rows.shape[0]),
             "dim": int(rows.shape[1]), "gen": int(gen)}
+    if watermark is not None and watermark > 0.0:
+        resp["wm"] = float(watermark)
+    return resp
+
+
+def decode_watermark(resp: Dict[str, Any]) -> Optional[float]:
+    """The response's applied watermark (unix secs), or None — NEVER
+    raises: a missing or garbage stamp must not fail a pull (telemetry
+    is best-effort, same contract as decode_trace)."""
+    w = resp.get("wm")
+    return float(w) if isinstance(w, (int, float)) and w > 0 else None
 
 
 def decode_rows(resp: Dict[str, Any]) -> np.ndarray:

@@ -238,7 +238,12 @@ class MultiBoxFleet:
 
     def health(self) -> Dict[str, object]:
         """Fleet-wide serving health — merged through the obs /health
-        endpoint (exporter.py) while the fleet is up."""
+        endpoint (exporter.py) while the fleet is up. Since round 20
+        the record carries the watermark plane too: ``watermark_ts``
+        (min across boxes — the fleet is as fresh as its stalest box),
+        ``freshness_age_secs`` and the merged feed-to-serve
+        ``freshness_p50_secs``/``freshness_p99_secs`` from the boxes'
+        elementwise-summed sample histograms."""
         st = self._health_client.fleet_stats()
         st["type"] = "serving_fleet"
         st["policy"] = self.policy.describe()

@@ -31,11 +31,13 @@ import shutil
 import struct
 import tempfile
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from paddlebox_tpu.obs import log
+from paddlebox_tpu.obs.tracer import record_span
 from paddlebox_tpu.serving.cache import HotKeyCache
 from paddlebox_tpu.serving.store import (MmapViewStack, ShardSpec,
                                          build_stack, write_xbox_columnar)
@@ -166,6 +168,19 @@ class JournalDeltaSource:
         self._rows: List[Dict[int, np.ndarray]] = [{} for _ in dirs]
         self._cols: Optional[np.ndarray] = None  # served-col projection
         self._proj: Optional[Tuple[int, str]] = None  # (embedx_dim, opt)
+        # watermark plane (round 20): newest POLLED born_max per dir —
+        # monotonic non-decreasing (a tailer reset discards overlay
+        # rows, but "data born before T has been trained" stays true:
+        # resets come from a full base landing or segment loss, never
+        # from training going backwards). The low-water-mark across
+        # dirs is the stack's watermark. _wm_low is read lock-free by
+        # pull threads (one float store, GIL-atomic); only the watcher
+        # thread writes it.
+        self._wm: List[float] = [0.0] * len(dirs)
+        self._wm_low = 0.0
+        # publish_ts of the oldest watermark polled but not yet
+        # compiled into a served overlay ("oldest unapplied")
+        self._oldest_unapplied: Optional[float] = None
         self._own_scratch = scratch_dir is None
         self._scratch = scratch_dir or tempfile.mkdtemp(
             prefix="pbtpu-journal-feed-")
@@ -212,12 +227,43 @@ class JournalDeltaSource:
                         if rows:
                             changed = True
                         self._rows[i] = rows = {}
+                elif kind == jf.KIND_WATERMARK:
+                    born_min, born_max, pub_ts, trace = \
+                        jf.unpack_watermark(payload)
+                    if born_max > self._wm[i]:
+                        self._wm[i] = born_max
+                    if self._oldest_unapplied is None:
+                        self._oldest_unapplied = pub_ts
+                    if trace:
+                        # instantaneous apply marker on the PUBLISHER's
+                        # stitched timeline: the ingest→train→journal
+                        # trace now ends at the serving tailer
+                        now_pc = time.perf_counter()
+                        record_span("journal_watermark_apply",
+                                    now_pc, now_pc, trace=trace)
                 # KIND_MOVE relocates rows, values unchanged: ignore
         stat_add("serving_journal_polls")
+        wms = [w for w in self._wm if w > 0.0]
+        if wms:
+            self._wm_low = min(wms)
+            gauge_set("serving_watermark_ts", self._wm_low)
+            gauge_set("serving_watermark_age_secs",
+                      max(0.0, time.time() - self._wm_low))
+        gauge_set("serving_unapplied_watermark_age_secs",
+                  max(0.0, time.time() - self._oldest_unapplied)
+                  if self._oldest_unapplied else 0.0)
         if changed:
             gauge_set("serving_journal_rows",
                       sum(len(r) for r in self._rows))
         return changed
+
+    def applied_watermark(self) -> float:
+        """Low-water-mark of the view stack: every source row born at
+        or before this wall-clock instant has been trained, journaled,
+        and polled into the overlay this source vouches for (min across
+        journal dirs; 0.0 until the first watermark arrives). Lock-free
+        read — safe from pull threads."""
+        return self._wm_low
 
     def compile_overlay(self) -> Optional[str]:
         """Materialize the overlay as a columnar view file (sorted
@@ -245,6 +291,10 @@ class JournalDeltaSource:
                 os.unlink(prev)
             except OSError:
                 pass
+        # everything polled so far is in the compiled overlay — nothing
+        # is "unapplied" until the next poll finds new records
+        self._oldest_unapplied = None
+        gauge_set("serving_unapplied_watermark_age_secs", 0.0)
         return path
 
     def close(self) -> None:

@@ -30,6 +30,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional, Sequence
 
 from paddlebox_tpu.obs import log, make_step_reporter
+from paddlebox_tpu.obs import watermark as obs_watermark
 from paddlebox_tpu.obs.tracer import record_span
 from paddlebox_tpu.serving import codec
 from paddlebox_tpu.serving.refresh import (DeltaRefreshWatcher,
@@ -95,6 +96,7 @@ class ServingServer:
         self._prev_hit = 0  # guarded-by: _report_lock
         self._prev_miss = 0  # guarded-by: _report_lock
         self._prev_lat = None  # guarded-by: _report_lock
+        self._prev_fresh = None  # guarded-by: _report_lock
         self._slo_us = float(flags.get_flag("serving_slo_us"))
         self._report_lock = make_lock("ServingServer._report_lock")
         # rank = the replica index ServingFleet exports as PBTPU_RANK
@@ -196,6 +198,16 @@ class ServingServer:
             stat_add("serving_requests")
             stat_add("serving_keys", int(keys.size))
             self._note_report(int(keys.size))
+            # watermark plane (round 20): stamp the response with the
+            # applied feed-to-serve watermark and sample the freshness
+            # THIS pull experienced — traffic-weighted by construction,
+            # so a stalling journal tail shows up in the very next
+            # report window's p99 instead of waiting for a probe
+            wm = (self._journal.applied_watermark()
+                  if self._journal is not None else 0.0)
+            if wm > 0.0 and obs_watermark.enabled():
+                obs_watermark.observe_freshness(wm)
+                return codec.encode_rows(rows, gen, watermark=wm)
             return codec.encode_rows(rows, gen)
         finally:
             with self._state_cv:
@@ -232,11 +244,32 @@ class ServingServer:
                             gauge_set("serving_slo_burn", round(
                                 hist_percentile(delta, 0.99)
                                 / self._slo_us, 4))
+                # freshness SLO burn (round 20): p99 of THIS WINDOW's
+                # end-to-end freshness samples over freshness_slo_secs
+                # — same delta-histogram pattern as serving_slo_burn,
+                # same loud-degrade consumer (HealthMonitor)
+                fresh = StatRegistry.instance().hist_counts(
+                    obs_watermark.FRESHNESS_HIST)
+                if fresh:
+                    prevf = self._prev_fresh
+                    deltaf = ([c - p for c, p in zip(fresh, prevf)]
+                              if prevf else fresh)
+                    self._prev_fresh = list(fresh)
+                    burn = obs_watermark.freshness_burn(deltaf)
+                    if burn is not None:
+                        gauge_set("serving_freshness_burn",
+                                  round(burn, 4))
+                if d_tot:
+                    # the serving hot tier's rung of the hit ladder
+                    gauge_set("serving_tier_hit_rate",
+                              round(d_hit / d_tot, 4))
                 self.reporter.maybe_report(self._requests, extra={
                     "role": "serving",
                     "gen": self.manager.current()[0],
                     "cache_hit_rate": round(d_hit / d_tot, 4)
                     if d_tot else None,
+                    "freshness_e2e_secs_p99": round(gauge_get(
+                        "freshness_e2e_secs_p99"), 4),
                 })
 
     def _stats(self) -> Dict[str, Any]:
@@ -260,6 +293,14 @@ class ServingServer:
             "lookup_us_counts": list(
                 StatRegistry.instance().hist_counts("serving_lookup_us")
                 or ()),
+            # round 20: the fleet-wide freshness merge — the client
+            # min-reduces watermark_ts (a fleet is only as fresh as its
+            # stalest box) and elementwise-sums the freshness counts
+            "watermark_ts": (float(self._journal.applied_watermark())
+                             if self._journal is not None else 0.0),
+            "freshness_ms_counts": list(
+                StatRegistry.instance().hist_counts(
+                    obs_watermark.FRESHNESS_HIST) or ()),
             "ts": time.time(),
         }
 

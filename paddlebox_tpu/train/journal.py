@@ -51,6 +51,7 @@ import json
 import os
 import struct
 import threading
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -65,7 +66,8 @@ from paddlebox_tpu.utils.lockwatch import make_lock
 from paddlebox_tpu.utils.journal_format import (  # noqa: F401
     EV_AGE_DAYS, EV_SHRINK, EV_STAT_SAVE_AGE, EV_STAT_SAVE_DELTA,
     EV_TAINT, EV_TICK_SPILL_AGE, KIND_EVENT, KIND_HEADER, KIND_MOVE,
-    KIND_ROWS, MV_FAULT_IN, MV_SPILL, iter_segment, segment_header)
+    KIND_ROWS, KIND_WATERMARK, MV_FAULT_IN, MV_SPILL, iter_segment,
+    pack_watermark, segment_header, unpack_watermark)
 from paddlebox_tpu.utils.journal_format import FRAME as _FRAME
 from paddlebox_tpu.utils.journal_format import MOVE_HEAD as _MOVE_HEAD
 from paddlebox_tpu.utils.journal_format import SEG_MAGIC as _SEG_MAGIC
@@ -127,7 +129,9 @@ def replay_record(store, table_cfg, kind: int, payload: bytes) -> None:
             store.fault_in_keys(keys)
         else:
             raise ValueError(f"unknown journal move op {op}")
-    # KIND_HEADER records are validated by the caller
+    # KIND_HEADER records are validated by the caller; KIND_WATERMARK is
+    # freshness lineage, not store state — replay ignores it (and any
+    # future lineage-only kind falls through the same way)
 
 
 def replay_segments(store, table_cfg, segment_paths,
@@ -144,6 +148,8 @@ def replay_segments(store, table_cfg, segment_paths,
                         f"{path}: journal width {hdr['width']} != store "
                         f"width {expect_width}")
                 continue
+            if kind == KIND_WATERMARK:
+                continue  # lineage metadata — applies nothing to the store
             replay_record(store, table_cfg, kind, payload)
             applied += 1
     return applied
@@ -402,7 +408,9 @@ class TouchedRowJournal:
                                  + list(self._sealed)),
                     "dirty_rows": self._dirty_rows}
 
-    def publish(self) -> Optional[str]:
+    def publish(self, born_min: Optional[float] = None,
+                born_max: Optional[float] = None,
+                trace: Optional[int] = None) -> Optional[str]:
         """Seal the active segment and return its sealed path (None when
         nothing is pending). The streaming micro-pass boundary calls
         this: sealing fsyncs the window's touched rows and renames the
@@ -410,10 +418,23 @@ class TouchedRowJournal:
         picks the whole window up on its next poll as durable bytes —
         freshness rides this cadence, not the SaveDelta one. Sealing is
         exactly the rotation path, so segment bounds/retention apply
-        unchanged."""
+        unchanged.
+
+        When the caller knows the window's source-file mtime span it
+        passes ``born_min``/``born_max`` (plus its trace id): a
+        KIND_WATERMARK record lands immediately before the seal, inside
+        the same fsync, so the serving tailer learns HOW FRESH the rows
+        it just applied are — the feed-to-serve watermark plane (round
+        20). Replay and pre-round-20 tailers ignore the record."""
         with self._lock:  # seal-under-lock contract: see append_rows
             if self._f is None:
                 return None
+            if born_min is not None:
+                bmax = born_max if born_max is not None else born_min
+                self._append_locked(  # boxlint: disable=BX601
+                    KIND_WATERMARK,
+                    pack_watermark(born_min, bmax, time.time(),
+                                   trace or 0))
             self._seal_locked()  # boxlint: disable=BX601
             return self._sealed[-1] if self._sealed else None
 

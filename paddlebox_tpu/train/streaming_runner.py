@@ -39,7 +39,9 @@ from typing import Dict, List, Optional
 from paddlebox_tpu.config import flags
 from paddlebox_tpu.metrics.drift import SlotDriftMonitor
 from paddlebox_tpu.obs import log as obs_log
-from paddlebox_tpu.obs.tracer import span as obs_span
+from paddlebox_tpu.obs import watermark as obs_watermark
+from paddlebox_tpu.obs.tracer import (current_trace, set_trace,
+                                      span as obs_span, step_trace_id)
 from paddlebox_tpu.train.preload import PassPreloader
 from paddlebox_tpu.utils.stats import gauge_set, stat_add
 
@@ -214,7 +216,17 @@ class StreamingRunner:
         journal = self.cm.journal if self.cm is not None else None
         if journal is not None and admitted:
             with obs_span("streaming_publish"):
-                journal.publish()
+                if obs_watermark.enabled():
+                    # watermark plane (round 20): the window's born-ts
+                    # span + this boundary's trace id ride the segment
+                    # into the serving tailer — feed-to-serve freshness
+                    # becomes measurable at the pull, and the serving
+                    # apply span lands on THIS stitched timeline
+                    journal.publish(
+                        born_min=getattr(win, "born_min_ts", win.born_ts),
+                        born_max=win.born_ts, trace=current_trace())
+                else:
+                    journal.publish()
             lag = max(0.0, time.time() - win.born_ts)
             gauge_set("streaming_publish_lag_secs", lag)
         if (admitted and self.cm is not None and self.base_every > 0
@@ -278,6 +290,12 @@ class StreamingRunner:
             while cur is not None and not self._stop.is_set():
                 t0 = time.perf_counter()
                 win = cur
+                # one stitched timeline per micro-pass: every span this
+                # window records on the train thread (ingest wait, feed
+                # pass, train, publish, micro-checkpoint) carries the
+                # same trace id, and the published watermark forwards
+                # it to the serving tailer's apply span
+                set_trace(step_trace_id(obs_log.get_rank(), cur.index))
                 admitted = pre.wait_admit(
                     cur.dataset, admit_fn=lambda _ds: self._admit(win),
                     allgather=allgather)
@@ -318,6 +336,7 @@ class StreamingRunner:
                     cur_wait = 0.0
                 cur = nxt
         finally:
+            set_trace(None)
             self._stop.set()
             self.stream.stop()
             # drain the queue so the fetcher's put can't wedge the join

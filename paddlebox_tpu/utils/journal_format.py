@@ -43,6 +43,7 @@ KIND_HEADER = 0
 KIND_ROWS = 1
 KIND_EVENT = 2
 KIND_MOVE = 3             # resident<->SSD-tier key movement (round 16)
+KIND_WATERMARK = 4        # feed-to-serve freshness lineage (round 20)
 
 # event codes — the deterministic out-of-cadence store mutations
 EV_STAT_SAVE_DELTA = 1    # update_stat_after_save param=1 (clear delta)
@@ -59,6 +60,32 @@ MV_SPILL = 1              # resident rows -> SSD tier
 MV_FAULT_IN = 2           # SSD tier -> resident
 
 MOVE_HEAD = struct.Struct("<IIq")  # op, pad, n keys
+
+# KIND_WATERMARK payload: the micro-pass window's source-file mtime span
+# (born_min/born_max, unix secs), the publish wall time, and the
+# publisher's trace id (0 = none) so the serving tailer can pin its
+# apply span to the SAME stitched timeline as the training boundary.
+# Appended once per journal publish, immediately before the seal.
+# Backward/forward safe by construction: replay and any pre-round-20
+# tailer fall through unknown kinds, so old checkpoints and new readers
+# (and vice versa) interoperate without a format epoch bump.
+WM_REC = struct.Struct("<dddQ")    # born_min, born_max, publish_ts, trace
+
+
+def pack_watermark(born_min: float, born_max: float, publish_ts: float,
+                   trace: int = 0) -> bytes:
+    """KIND_WATERMARK payload for one published window."""
+    return WM_REC.pack(float(born_min), float(born_max),
+                       float(publish_ts), int(trace) & (2 ** 64 - 1))
+
+
+def unpack_watermark(payload: bytes
+                     ) -> Tuple[float, float, float, int]:
+    """(born_min, born_max, publish_ts, trace) from a KIND_WATERMARK
+    payload. Tolerates a longer payload (forward compat: later rounds
+    may append fields) but not a shorter one."""
+    born_min, born_max, publish_ts, trace = WM_REC.unpack_from(payload)
+    return born_min, born_max, publish_ts, trace
 
 
 def iter_segment(path: str):

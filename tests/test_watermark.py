@@ -1,0 +1,417 @@
+"""Feed-to-serve watermark plane (round 20): lineage format, journal
+publish, serving-side tracking, pull stamping, freshness SLO burn,
+tiered-store telemetry, and the exact /metrics names dashboards pin.
+
+The e2e acceptance test here is the stall one: a journal tail that
+stops publishing must trip the HealthMonitor freshness burn within two
+serving report windows — the plane exists so that failure mode is loud.
+"""
+
+import os
+import time
+import types
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import flags
+from paddlebox_tpu.config.configs import SparseOptimizerConfig, TableConfig
+from paddlebox_tpu.obs import watermark as wm
+from paddlebox_tpu.obs.exporter import ObsExporter
+from paddlebox_tpu.obs.health import HealthMonitor
+from paddlebox_tpu.serving import codec
+from paddlebox_tpu.serving.client import ServingClient
+from paddlebox_tpu.serving.refresh import JournalDeltaSource
+from paddlebox_tpu.serving.server import ServingServer
+from paddlebox_tpu.serving.store import write_xbox_columnar
+from paddlebox_tpu.train.journal import TouchedRowJournal, replay_segments
+from paddlebox_tpu.utils import journal_format as jf
+from paddlebox_tpu.utils.journal_format import iter_segment
+from paddlebox_tpu.utils.stats import StatRegistry, gauge_set, stat_get
+
+EMBEDX = 4
+DIM = 1 + EMBEDX        # served xbox row width
+WIDTH = 7 + 1 + EMBEDX  # header + adagrad state + embedx (store row)
+
+
+@pytest.fixture
+def registry():
+    reg = StatRegistry.instance()
+    saved = reg.snapshot_all()
+    reg.reset()
+    yield reg
+    reg.reset()
+    for k, v in saved["counters"].items():
+        reg.set(k, v)
+    for k, v in saved["gauges"].items():
+        reg.set_gauge(k, v)
+
+
+def journal_writer(tmp_path, name="_journal"):
+    layout = types.SimpleNamespace(width=WIDTH, embedx_dim=EMBEDX,
+                                   optimizer="adagrad")
+    return TouchedRowJournal(os.path.join(str(tmp_path), name),
+                             layout, None)
+
+
+def make_day(tmp_path, n=200, seed=3):
+    """A tiny xbox day dir a journal-fed server can compose views from."""
+    rng = np.random.RandomState(seed)
+    keys = np.unique(rng.randint(1, 1 << 40, n).astype(np.uint64))
+    rows = rng.randn(keys.size, DIM).astype(np.float32)
+    root = str(tmp_path / "xbox")
+    day = os.path.join(root, "day0")
+    os.makedirs(day)
+    write_xbox_columnar(os.path.join(day, "view.xcol"), keys, rows)
+    with open(os.path.join(day, "DONE"), "w") as f:
+        f.write(str(time.time()))
+    return root, keys
+
+
+# ------------------------------------------------------------- format
+
+
+def test_pack_unpack_watermark_roundtrip_and_forward_compat():
+    payload = jf.pack_watermark(10.5, 20.25, 30.125, trace=0xDEAD)
+    assert jf.unpack_watermark(payload) == (10.5, 20.25, 30.125, 0xDEAD)
+    # unpack_from semantics: a FUTURE writer may append fields to the
+    # payload — an old reader must still decode the prefix it knows
+    assert jf.unpack_watermark(payload + b"future-fields") == (
+        10.5, 20.25, 30.125, 0xDEAD)
+    # trace ids are masked into u64, never a struct.error
+    big = jf.pack_watermark(1.0, 2.0, 3.0, trace=1 << 80)
+    assert jf.unpack_watermark(big)[3] == 0
+
+
+def test_publish_writes_watermark_record_and_replay_ignores_it(tmp_path):
+    # real store layout (width 13 for adagrad: header + embed_w/g2sum +
+    # embedx) so the sealed segment replays onto a real store below
+    from paddlebox_tpu.embedding.accessor import ValueLayout
+    layout = ValueLayout(EMBEDX)
+    j = TouchedRowJournal(os.path.join(str(tmp_path), "_jr"), layout, None)
+    keys = np.arange(1, 9, dtype=np.uint64)
+    vals = np.arange(8 * layout.width,
+                     dtype=np.float32).reshape(8, layout.width)
+    j.append_rows(keys, vals)
+    t0 = time.time()
+    sealed = j.publish(born_min=t0 - 3.0, born_max=t0 - 1.0, trace=42)
+    j.close()
+    kinds = [k for k, _ in iter_segment(sealed)]
+    assert jf.KIND_WATERMARK in kinds
+    # the watermark record rides the SAME segment as the window's rows
+    assert jf.KIND_ROWS in kinds
+    (wm_payload,) = [p for k, p in iter_segment(sealed)
+                     if k == jf.KIND_WATERMARK]
+    bmin, bmax, pub, trace = jf.unpack_watermark(wm_payload)
+    assert (bmin, bmax, trace) == (t0 - 3.0, t0 - 1.0, 42)
+    assert pub >= t0
+    # replay applies the rows and ONLY the rows: pre-round-20 recovery
+    # (and any store replay) treats the watermark as pure lineage
+    from paddlebox_tpu.embedding.host_store import HostEmbeddingStore
+    cfg = TableConfig(embedx_dim=EMBEDX,
+                      optimizer=SparseOptimizerConfig(
+                          mf_create_thresholds=0.0, mf_initial_range=1e-3))
+    st = HostEmbeddingStore(layout, cfg)
+    applied = replay_segments(st, cfg, [sealed])
+    assert applied == 1 and len(st) == 8
+
+
+def test_publish_without_born_writes_no_watermark(tmp_path):
+    j = journal_writer(tmp_path)
+    j.append_rows(np.array([5], np.uint64),
+                  np.zeros((1, WIDTH), np.float32))
+    sealed = j.publish()
+    j.close()
+    assert jf.KIND_WATERMARK not in [k for k, _ in iter_segment(sealed)]
+
+
+# ----------------------------------------------------- serving tracking
+
+
+def test_journal_source_applied_watermark_and_unapplied_age(
+        tmp_path, registry):
+    j = journal_writer(tmp_path)
+    src = JournalDeltaSource([j.dir])
+    try:
+        assert src.applied_watermark() == 0.0
+        t0 = time.time()
+        j.append_rows(np.array([7], np.uint64),
+                      np.zeros((1, WIDTH), np.float32))
+        j.publish(born_min=t0 - 5.0, born_max=t0 - 2.0)
+        assert src.poll()
+        assert src.applied_watermark() == pytest.approx(t0 - 2.0)
+        g = registry.snapshot_all()["gauges"]
+        assert g["serving_watermark_age_secs"] >= 2.0
+        # polled but not yet compiled into a served overlay: the
+        # unapplied age runs from the publish instant...
+        assert g["serving_unapplied_watermark_age_secs"] > 0.0
+        src.compile_overlay()
+        g = registry.snapshot_all()["gauges"]
+        # ...and clears the moment the overlay materializes
+        assert g["serving_unapplied_watermark_age_secs"] == 0.0
+        # watermarks never regress: an older window's publish (replayed
+        # segment, lagging dir) must not pull the low-water-mark back
+        j.append_rows(np.array([8], np.uint64),
+                      np.zeros((1, WIDTH), np.float32))
+        j.publish(born_min=t0 - 50.0, born_max=t0 - 40.0)
+        src.poll()
+        assert src.applied_watermark() == pytest.approx(t0 - 2.0)
+    finally:
+        src.close()
+        j.close()
+
+
+def test_codec_watermark_stamp_roundtrip_and_garbage_safety():
+    rows = np.zeros((2, DIM), np.float32)
+    t0 = time.time()
+    assert codec.decode_watermark(
+        codec.encode_rows(rows, gen=1, watermark=t0)) == pytest.approx(t0)
+    # cold journal → no stamp at all (forward compat with old clients)
+    assert "wm" not in codec.encode_rows(rows, gen=1)
+    assert "wm" not in codec.encode_rows(rows, gen=1, watermark=0.0)
+    # garbage stamps decode to None, NEVER raise (telemetry contract)
+    for resp in ({}, {"wm": "soon"}, {"wm": None}, {"wm": -4.0},
+                 {"wm": b"\x00"}):
+        assert codec.decode_watermark(resp) is None
+
+
+# ------------------------------------------------------- e2e freshness
+
+
+def _pull_until_stamped(client, keys, deadline=10.0):
+    end = time.time() + deadline
+    while time.time() < end:
+        client.pull(keys)
+        if client.last_watermark > 0.0:
+            return
+        time.sleep(0.02)
+    raise AssertionError("pull responses never carried a watermark")
+
+
+def test_server_stamps_pulls_and_freshness_is_observed(
+        tmp_path, registry):
+    """The tentpole path end to end in one process: journal publish
+    with a born span → refresh poll applies it → every pull response
+    carries the watermark → BOTH sides sample now-born into the
+    freshness histogram → the report window republishes the p99."""
+    root, keys = make_day(tmp_path)
+    j = journal_writer(tmp_path)
+    flags.set_flag("serving_journal_dir", j.dir)
+    flags.set_flag("serving_refresh_secs", 0.1)
+    flags.set_flag("serving_report_requests", 4)
+    server = ServingServer(root, days=["day0"])
+    client = ServingClient([("127.0.0.1", server.port)])
+    try:
+        t0 = time.time()
+        j.append_rows(keys[:3],
+                      np.ones((3, WIDTH), np.float32))
+        j.publish(born_min=t0 - 2.0, born_max=t0 - 1.0)
+        _pull_until_stamped(client, keys[:8])
+        assert client.last_watermark == pytest.approx(t0 - 1.0)
+        snap = wm.freshness_snapshot()
+        # born 1s ago → every sample is >= 1s end-to-end age
+        assert snap["freshness_e2e_secs"] >= 1.0
+        assert snap["freshness_e2e_secs_p50"] >= 1.0
+        assert snap["freshness_e2e_secs_p99"] >= \
+            snap["freshness_e2e_secs_p50"]
+        assert registry.hist_counts(wm.FRESHNESS_HIST)
+        for _ in range(4):             # cross the report cadence
+            client.pull(keys[:8])
+        rep = server.reporter.peek()
+        assert rep is not None
+        assert rep["freshness_e2e_secs_p99"] >= 1.0
+    finally:
+        client.close()
+        server.drain(timeout=2)
+        j.close()
+
+
+def test_journal_stall_trips_freshness_burn_within_two_windows(
+        tmp_path, registry):
+    """ISSUE acceptance: stall the journal tail and the freshness burn
+    gauge must exceed 1.0 within TWO serving report windows, and the
+    HealthMonitor must flag the rank. The SLO is shrunk to 0.2 s so
+    'stale' is reachable in test time; the mechanism under test — per
+    window histogram-delta p99 over the SLO — is the production one."""
+    root, keys = make_day(tmp_path)
+    j = journal_writer(tmp_path)
+    flags.set_flag("serving_journal_dir", j.dir)
+    flags.set_flag("serving_refresh_secs", 0.05)
+    flags.set_flag("serving_report_requests", 4)
+    flags.set_flag("freshness_slo_secs", 0.2)
+    server = ServingServer(root, days=["day0"])
+    client = ServingClient([("127.0.0.1", server.port)])
+    try:
+        t0 = time.time()
+        j.append_rows(keys[:2], np.ones((2, WIDTH), np.float32))
+        j.publish(born_min=t0, born_max=t0)
+        _pull_until_stamped(client, keys[:8])
+        # ... and then the tail goes silent: no more publishes. Served
+        # watermark pins at t0 while wall time walks away from it.
+        time.sleep(0.5)                # age the watermark past the SLO
+        for _ in range(8):             # two full report windows
+            client.pull(keys[:8])
+        g = registry.snapshot_all()["gauges"]
+        burn = g.get("serving_freshness_burn", 0.0)
+        assert burn > 1.0, burn
+        hm = HealthMonitor(world=1)
+        health = hm.update({"step": 1, "stale_ranks": [], "metrics": {
+            "gauges.serving_freshness_burn": {"per_rank": {"0": burn}}}})
+        assert "freshness_burn" in health["ranks"]["0"]["flags"]
+        assert health["ranks"]["0"]["score"] == pytest.approx(0.6)
+    finally:
+        client.close()
+        server.drain(timeout=2)
+        j.close()
+
+
+def test_health_monitor_freshness_and_tier_penalties():
+    """Pinned penalty weights: freshness burn −0.4, tier-hit burn −0.3;
+    both together cross the 0.5 unhealthy bar."""
+    hm = HealthMonitor(world=1)
+    health = hm.update({"step": 3, "stale_ranks": [], "metrics": {
+        "gauges.serving_freshness_burn": {"per_rank": {"0": 2.5}},
+        "gauges.tier_hit_burn": {"per_rank": {"0": 4.0}}}})
+    r0 = health["ranks"]["0"]
+    assert "freshness_burn" in r0["flags"]
+    assert "tier_hit_low" in r0["flags"]
+    assert r0["score"] == pytest.approx(0.3)
+    assert 0 in health["unhealthy_ranks"]
+    assert r0["freshness_burn"] == pytest.approx(2.5)
+    assert r0["tier_hit_burn"] == pytest.approx(4.0)
+    # sub-1.0 burns are healthy quiet — no flag, no penalty
+    health = hm.update({"step": 4, "stale_ranks": [], "metrics": {
+        "gauges.serving_freshness_burn": {"per_rank": {"0": 0.4}},
+        "gauges.tier_hit_burn": {"per_rank": {"0": 0.9}}}})
+    assert "flags" not in health["ranks"]["0"]
+    assert health["ranks"]["0"]["score"] == pytest.approx(1.0)
+
+
+# -------------------------------------------------- tiered-store ladder
+
+
+def _native_store(tmp_path):
+    from paddlebox_tpu.embedding.accessor import ValueLayout
+    from paddlebox_tpu.embedding.native_store import NativeHostEmbeddingStore
+    cfg = TableConfig(embedx_dim=EMBEDX, ssd_dir=str(tmp_path / "ssd"),
+                      optimizer=SparseOptimizerConfig(
+                          mf_create_thresholds=0.0, mf_initial_range=1e-3))
+    try:
+        return NativeHostEmbeddingStore(ValueLayout(EMBEDX), cfg, seed=0)
+    except RuntimeError:
+        pytest.skip("native library unavailable")
+
+
+def test_tier_hit_rate_excludes_created_keys(tmp_path, registry):
+    """Round-20 semantics fix: the hit rate is over keys the store
+    already KNEW (resident + tier-faulted). Created keys are
+    construction, not thrashing — an all-new batch must produce NO rate
+    sample (not a false 0% that would trip tier_hit_burn on every cold
+    start and on slab-resident working sets)."""
+    st = _native_store(tmp_path)
+    keys = np.arange(1, 101, dtype=np.uint64)
+    st.lookup_or_create(keys)          # all created
+    g = registry.snapshot_all()["gauges"]
+    assert "tier_hit_rate" not in g
+    assert "tier_hit_burn" not in g
+    assert stat_get("sparse_keys_resident_hit") == 0
+    # warm re-lookup: everything resident → rate 1.0, burn warn/1 << 1
+    st.lookup_or_create(keys)
+    g = registry.snapshot_all()["gauges"]
+    assert g["tier_hit_rate"] == pytest.approx(1.0)
+    assert g["tier_hit_burn"] < 1.0
+    assert stat_get("sparse_keys_resident_hit") == 100
+    # spill half, touch ONLY the spilled half: 0% resident over known
+    # keys — this IS thrashing and must burn
+    st.spill_exact(keys[:50])
+    st.lookup_or_create(keys[:50])
+    g = registry.snapshot_all()["gauges"]
+    assert g["tier_hit_rate"] == pytest.approx(0.0)
+    assert g["tier_hit_burn"] > 1.0
+    assert stat_get("sparse_keys_faulted_in") == 50
+
+
+def test_tier_ladder_snapshot_fractions(tmp_path, registry):
+    st = _native_store(tmp_path)
+    keys = np.arange(1, 41, dtype=np.uint64)
+    st.lookup_or_create(keys)          # 40 created
+    st.lookup_or_create(keys)          # 40 resident hits
+    st.spill_exact(keys[:10])
+    st.lookup_or_create(keys)          # 30 resident + 10 ssd promotes
+    lad = wm.tier_ladder()
+    assert lad["miss_created"] == 40
+    assert lad["host_ram_hit"] == 70
+    assert lad["ssd_promote"] == 10
+    assert lad["total"] == 120
+    assert lad["host_ram_hit_frac"] == pytest.approx(70 / 120, abs=1e-4)
+    assert sum(lad[k + "_frac"] for k in (
+        "miss_created", "host_ram_hit", "ssd_promote",
+        "ssd_prefetch")) == pytest.approx(1.0, abs=1e-3)
+    # a real dir-mode promote also lands the latency histogram
+    assert lad["ssd_promote_p99_us"] > 0.0
+
+
+# ------------------------------------------------------- /metrics names
+
+
+def test_metrics_pins_watermark_tier_and_streaming_names(tmp_path,
+                                                         registry):
+    """The exact exposition names the round-20 dashboards scrape. A
+    rename anywhere in the plane breaks here first. Every series is
+    populated through the REAL code path that owns it (observe,
+    journal poll, SSD promote) — only the two streaming-runner lag
+    gauges are set directly (their producer needs a live trainer; the
+    name contract is pinned via freshness_snapshot, which reads them)."""
+    wm.observe_freshness(time.time() - 5.0)
+    j = journal_writer(tmp_path)
+    src = JournalDeltaSource([j.dir])
+    j.append_rows(np.array([3], np.uint64),
+                  np.zeros((1, WIDTH), np.float32))
+    j.publish(born_min=time.time() - 1.0)
+    src.poll()
+    src.close()
+    j.close()
+    st = _native_store(tmp_path)
+    keys = np.arange(1, 21, dtype=np.uint64)
+    st.lookup_or_create(keys)
+    st.spill_exact(keys)
+    st.lookup_or_create(keys)          # dir-mode promote → ssd hists
+    gauge_set("streaming_ingest_lag_secs", 0.5)
+    gauge_set("streaming_publish_lag_secs", 0.7)
+    gauge_set("serving_freshness_burn", 0.2)
+    gauge_set("serving_tier_hit_rate", 0.9)
+    snap = wm.freshness_snapshot()
+    for k in ("freshness_e2e_secs", "freshness_e2e_secs_p50",
+              "freshness_e2e_secs_p99", "streaming_ingest_lag_secs",
+              "streaming_publish_lag_secs", "serving_watermark_age_secs"):
+        assert k in snap
+    exp = ObsExporter(port=0)
+    try:
+        r = urllib.request.urlopen(
+            "http://127.0.0.1:%d/metrics" % exp.port, timeout=5.0)
+        text = r.read().decode()
+    finally:
+        exp.close()
+    for name in (
+            'pbtpu_freshness_e2e_ms_bucket{le="+Inf"}',
+            "pbtpu_freshness_e2e_secs ",
+            "pbtpu_freshness_e2e_secs_p50 ",
+            "pbtpu_freshness_e2e_secs_p99 ",
+            "pbtpu_serving_watermark_ts ",
+            "pbtpu_serving_watermark_age_secs ",
+            "pbtpu_serving_unapplied_watermark_age_secs ",
+            "pbtpu_serving_freshness_burn ",
+            "pbtpu_serving_tier_hit_rate ",
+            "pbtpu_tier_hit_rate ",
+            "pbtpu_tier_hit_burn ",
+            'pbtpu_ssd_promote_us_bucket{le="+Inf"}',
+            "pbtpu_ssd_tier_live_keys ",
+            "pbtpu_ssd_tier_blocks ",
+            "pbtpu_ssd_tier_index_entries ",
+            "pbtpu_sparse_keys_resident_hit ",
+            "pbtpu_sparse_keys_faulted_in ",
+            "pbtpu_streaming_ingest_lag_secs ",
+            "pbtpu_streaming_publish_lag_secs ",
+    ):
+        assert name in text, name

@@ -64,6 +64,13 @@ HEADLINES: List[Tuple[str, str, bool]] = [
     # threshold is a staleness regression
     ("streaming_examples_per_sec", "ex/s", True),
     ("streaming_freshness_secs", "s", False),
+    # round-20 watermark plane (landed after 21/22 — absent earlier
+    # rounds compare as n/a): fleet-wide answered-pull QPS from the
+    # fleet probe's top rung, and the TRUE feed-to-serve freshness p99
+    # (born-ts -> watermark-stamped pull through a live server) where
+    # LOWER is better — a rise is a staleness regression
+    ("fleet_qps", "q/s", True),
+    ("freshness_e2e_p99_secs", "s", False),
 ]
 
 

@@ -176,6 +176,14 @@ CEILINGS = {
     # 2026-08-07 (load1 0.34: 72ms); ceiling leaves room for co-tenant
     # load — the same stage measured <500ms at load1 1.6
     "streaming_freshness_ms": (72.0, 700.0),
+    # round-20 watermark plane: drop-to-SERVED freshness — seconds from
+    # an atomic file drop until a live ServingServer's pull response
+    # carries a watermark past the drop instant (train + boundary
+    # journal publish + 50ms tail poll + overlay swap + stamped RPC on
+    # the clock; one 3000-instance micro-pass of train time dominates).
+    # Recorded quiet on 2026-08-07 (load1 0.45: 1.0s); ceiling leaves
+    # the same ~10x co-tenant headroom ratio as streaming_freshness_ms
+    "freshness_e2e_secs": (1.0, 10.0),
 }
 
 RETRIES = 2          # extra isolated re-measures before a floor may fail
@@ -985,7 +993,7 @@ def section_streaming(rng, K):
         trainer.table)
     seq = [0]
 
-    def run_once(n_files=4, max_passes=2):
+    def run_once(n_files=4, max_passes=2, base_every=0):
         seq[0] += 1
         source = os.path.join(root, "src-%d" % seq[0])
         os.makedirs(source)
@@ -998,7 +1006,8 @@ def section_streaming(rng, K):
         # the refusal threshold parked high: a drift refusal would skip
         # a window's instances and corrupt the rate (the preview cost
         # itself stays on the clock)
-        runner = StreamingRunner(trainer, stream, cm=cm, base_every=0,
+        runner = StreamingRunner(trainer, stream, cm=cm,
+                                 base_every=base_every,
                                  admission_max_drift=10.0)
         return runner.run(max_micro_passes=max_passes, idle_timeout=10.0)
 
@@ -1032,6 +1041,55 @@ def section_streaming(rng, K):
             return ((hit["ts"] - t0) if "ts" in hit else 60.0) * 1e3
 
         report("streaming_freshness_ms", m_fresh(), remeasure=m_fresh)
+
+        def m_e2e():
+            # watermark-plane freshness END TO END (round 20): seconds
+            # from an atomic file drop until a live ServingServer's
+            # pull response carries a watermark >= the drop instant —
+            # i.e. until SERVED vectors provably include the dropped
+            # data (train + journal publish + tail poll + overlay
+            # swap + stamped RPC all on the clock). One base day is
+            # landed off the clock so the server has a view to stack.
+            from paddlebox_tpu.serving.client import ServingClient
+            from paddlebox_tpu.serving.server import ServingServer
+            run_once(n_files=2, max_passes=1, base_every=1)
+            old_jdir = flags.get_flag("serving_journal_dir")
+            old_ref = flags.get_flag("serving_refresh_secs")
+            flags.set_flag("serving_journal_dir", cm.journal.dir)
+            flags.set_flag("serving_refresh_secs", 0.05)
+            server = cli = None
+            pk = np.arange(1, 65, dtype=np.uint64)
+            try:
+                server = ServingServer(os.path.join(root, "xbox"))
+                cli = ServingClient([("127.0.0.1", server.port)])
+                t0 = time.time()
+                done = {}
+
+                def puller():
+                    while "dt" not in done and time.time() - t0 < 30.0:
+                        try:
+                            cli.pull(pk)
+                        except (ConnectionError, RuntimeError):
+                            pass
+                        if cli.last_watermark >= t0:
+                            done["dt"] = time.time() - t0
+                            return
+                        time.sleep(0.02)
+
+                t = threading.Thread(target=puller, daemon=True)
+                t.start()
+                run_once(n_files=2, max_passes=1)
+                t.join(timeout=35.0)
+                return done.get("dt", 60.0)
+            finally:
+                if cli is not None:
+                    cli.close()
+                if server is not None:
+                    server.drain()
+                flags.set_flag("serving_journal_dir", old_jdir)
+                flags.set_flag("serving_refresh_secs", old_ref)
+
+        report("freshness_e2e_secs", m_e2e(), remeasure=m_e2e)
     finally:
         flags.set_flag("streaming_poll_secs", old_poll)
         trainer.close()

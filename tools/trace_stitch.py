@@ -13,6 +13,22 @@ exchange then renders as an arrow from the sender's ``mesh_exchange``
 slice to the owner rank's ``mesh_recv_part`` slice; a serving pull as
 client span -> replica span.
 
+Round 20 extends the id plumbing to two more planes (the stitcher
+itself is name-agnostic — flows bind by trace id, so these stitch with
+no changes here):
+
+  * serving fleet: a FleetClient coalescer mints ONE id per flight —
+    the ``fleet_pull_flight`` span, the underlying
+    ``serving_pull_client`` span and the replica's ``serving_pull``
+    span share it, so a coalesced window (N waiters in, one RPC out)
+    reads as one timeline;
+  * streaming: the runner sets a per-micro-pass-window trace
+    (step_trace_id of rank/window), every boundary span
+    (streaming_wait_ingest/feed_pass/publish/micro_checkpoint) carries
+    it, and the journal's watermark record forwards it to the serving
+    tailer's ``journal_watermark_apply`` marker — ONE stitched
+    timeline spans ingest -> train -> journal -> pull.
+
 Clock caveat: the anchors come from ``time.time()`` per process — exact
 enough on one box (the 2-4 process clusters this repro runs); across
 machines the stitch inherits NTP skew, which offsets slices but keeps

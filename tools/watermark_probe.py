@@ -1,0 +1,250 @@
+"""Feed-to-serve watermark ladder: the freshness number, measured end
+to end through REAL processes.
+
+Round-20 acceptance probe for the watermark plane (obs/watermark.py).
+Two modes:
+
+  ladder    (default) one feed->train->serve chain: an in-process
+            trainer runs the streaming micro-pass cadence (file drops
+            -> admission -> train -> per-boundary journal publish, now
+            carrying the window's born-ts watermark record), while a
+            SPAWNED serving fleet (MultiBoxFleet, 1 box x 2 replica
+            processes) tails the same journal dir, swaps overlays and
+            stamps every pull response with its applied watermark. A
+            sampler thread pulls through the FleetClient at ~20 ms
+            cadence for the whole drain; each stamped response yields
+            one TRUE end-to-end freshness sample (born -> served),
+            which is exactly what /metrics publishes as
+            ``freshness_e2e_ms`` + the ``_p50``/``_p99`` gauges. The
+            JSON line carries the client-side p50/p99, the fleet-merged
+            server-side percentiles (elementwise-summed replica
+            histograms, min-reduced watermark), and the trainer-side
+            tier hit ladder.
+
+  --overhead
+            pairwise on/off cost of the plane: alternating streaming
+            runs with ``obs_watermark`` true/false on one trainer
+            (same files, same windows), median pair ratio. The ISSUE
+            bar: the whole watermark plane costs <= 2% of streaming
+            examples/s. Pairwise because this container's CPU rate
+            drifts more between minutes than the effect size.
+
+Usage:  timeout 300 python -u tools/watermark_probe.py
+        timeout 300 python -u tools/watermark_probe.py --overhead
+Prints one JSON line {"probe": "watermark", ...}; exits 1 on failure
+(ladder: no stamped samples; overhead: median cost > 2%).
+Heavy imports stay inside functions: spawn re-imports this file in
+every fleet child, which must come up jax-free in milliseconds.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+N_FILES, LINES, SLOTS, WIN_FILES = 6, 1500, 16, 2
+
+
+def build_trainer(root: str):
+    from paddlebox_tpu.config.configs import (CheckpointConfig,
+                                              SparseOptimizerConfig,
+                                              TableConfig, TrainerConfig)
+    from paddlebox_tpu.data import write_synthetic_ctr_files
+    from paddlebox_tpu.models.base import ModelSpec
+    from paddlebox_tpu.models.deepfm import DeepFM
+    from paddlebox_tpu.train import CheckpointManager
+    from paddlebox_tpu.train.trainer import BoxTrainer
+
+    files, feed = write_synthetic_ctr_files(
+        os.path.join(root, "staging"), num_files=N_FILES,
+        lines_per_file=LINES, num_slots=SLOTS, vocab_per_slot=5000,
+        max_len=4, seed=17)
+    feed = type(feed)(slots=feed.slots, batch_size=512)
+    trainer = BoxTrainer(
+        DeepFM(ModelSpec(num_slots=SLOTS, slot_dim=3 + 8),
+               hidden=(256, 128)),
+        TableConfig(embedx_dim=8, pass_capacity=1 << 18,
+                    optimizer=SparseOptimizerConfig(
+                        mf_create_thresholds=0.0, mf_initial_range=1e-3)),
+        feed, TrainerConfig(dense_lr=1e-3), seed=0)
+    cm = CheckpointManager(
+        CheckpointConfig(batch_model_dir=os.path.join(root, "batch"),
+                         xbox_model_dir=os.path.join(root, "xbox"),
+                         async_save=False),
+        trainer.table)
+    return files, feed, trainer, cm
+
+
+def drop(source: str, names) -> None:
+    os.makedirs(source, exist_ok=True)
+    for i, f in enumerate(names):
+        dst = os.path.join(source, "drop-%04d.txt" % i)
+        shutil.copyfile(f, dst + ".tmp")
+        os.replace(dst + ".tmp", dst)
+
+
+def run_windows(trainer, cm, feed, source, max_passes, base_every=0):
+    from paddlebox_tpu.data import StreamingDataset
+    from paddlebox_tpu.train import StreamingRunner
+    stream = StreamingDataset(feed, source,
+                              micro_pass_instances=WIN_FILES * LINES)
+    runner = StreamingRunner(trainer, stream, cm=cm,
+                             base_every=base_every,
+                             admission_max_drift=10.0)
+    return runner.run(max_micro_passes=max_passes, idle_timeout=10.0)
+
+
+def ladder() -> int:
+    from paddlebox_tpu.config import flags
+    from paddlebox_tpu.obs import watermark as wm
+    from paddlebox_tpu.serving.fleet import MultiBoxFleet
+
+    root = tempfile.mkdtemp(prefix="pbtpu_wmprobe_")
+    old_poll = flags.get_flag("streaming_poll_secs")
+    flags.set_flag("streaming_poll_secs", 0.02)
+    trainer = None
+    try:
+        files, feed, trainer, cm = build_trainer(root)
+        # window 1 with base_every=1 lands the base day the fleet
+        # composes its views from (watermark record rides the same
+        # boundary publish)
+        src = os.path.join(root, "src")
+        drop(src, files[:WIN_FILES])
+        run_windows(trainer, cm, feed, src, 1, base_every=1)
+
+        samples = []
+        with MultiBoxFleet(
+                os.path.join(root, "xbox"), boxes=1, replicas=2,
+                journal_dirs=[cm.journal.dir],
+                flag_overrides={"serving_refresh_secs": 0.05},
+                start_timeout=120.0) as fleet:
+            fc = fleet.client(timeout=10.0)
+            try:
+                probe_keys = np.arange(1, 129, dtype=np.uint64)
+                stop = threading.Event()
+
+                def sampler():
+                    while not stop.is_set():
+                        try:
+                            fc.pull(probe_keys)
+                        except (ConnectionError, RuntimeError):
+                            pass
+                        # the shard client's last stamped watermark ->
+                        # one true born->served freshness sample
+                        w = fc.clients[0].last_watermark
+                        if w > 0:
+                            samples.append(time.time() - w)
+                        stop.wait(0.02)
+
+                st = threading.Thread(target=sampler, daemon=True)
+                st.start()
+                # the remaining windows drain born->trained->published
+                # while the fleet tails and the sampler pulls
+                drop(src, files[WIN_FILES:])
+                run_windows(trainer, cm, feed, src,
+                            N_FILES // WIN_FILES - 1)
+                time.sleep(0.4)      # final tail poll + overlay swap
+                stop.set()
+                st.join(timeout=5.0)
+                merged = fleet.health()
+            finally:
+                fc.close()
+
+        arr = np.sort(np.asarray(samples, np.float64))
+        out = {
+            "probe": "watermark",
+            "windows": N_FILES // WIN_FILES,
+            "window_instances": WIN_FILES * LINES,
+            "e2e": {
+                "samples": int(arr.size),
+                "p50_secs": (round(float(np.percentile(arr, 50)), 3)
+                             if arr.size else None),
+                "p99_secs": (round(float(np.percentile(arr, 99)), 3)
+                             if arr.size else None),
+            },
+            "fleet": {k: merged.get(k) for k in (
+                "watermark_ts", "freshness_age_secs",
+                "freshness_p50_secs", "freshness_p99_secs", "qps")},
+            "tier_ladder": wm.tier_ladder(),
+            "freshness_snapshot": wm.freshness_snapshot(),
+        }
+        ok = arr.size > 0
+        out["ok"] = ok
+        print(json.dumps(out), flush=True)
+        return 0 if ok else 1
+    finally:
+        flags.set_flag("streaming_poll_secs", old_poll)
+        if trainer is not None:
+            trainer.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def overhead(pairs: int) -> int:
+    from paddlebox_tpu.config import flags
+
+    root = tempfile.mkdtemp(prefix="pbtpu_wmover_")
+    old_poll = flags.get_flag("streaming_poll_secs")
+    old_wm = flags.get_flag("obs_watermark")
+    flags.set_flag("streaming_poll_secs", 0.02)
+    trainer = None
+    seq = [0]
+    try:
+        files, feed, trainer, cm = build_trainer(root)
+
+        def one_run():
+            seq[0] += 1
+            src = os.path.join(root, "src-%d" % seq[0])
+            drop(src, files[:4])
+            return run_windows(trainer, cm, feed, src,
+                               2)["examples_per_sec"]
+
+        one_run()                            # compile + warm
+        ratios = []
+        rows = []
+        for _ in range(pairs):
+            flags.set_flag("obs_watermark", True)
+            on = one_run()
+            flags.set_flag("obs_watermark", False)
+            off = one_run()
+            ratios.append(off / on)
+            rows.append({"on_eps": round(on, 1), "off_eps": round(off, 1)})
+        med = float(np.median(ratios))
+        cost_pct = round((med - 1.0) * 100.0, 2)
+        ok = cost_pct <= 2.0
+        print(json.dumps({"probe": "watermark_overhead", "pairs": rows,
+                          "median_off_over_on": round(med, 4),
+                          "watermark_cost_pct": cost_pct, "ok": ok}),
+              flush=True)
+        return 0 if ok else 1
+    finally:
+        flags.set_flag("streaming_poll_secs", old_poll)
+        flags.set_flag("obs_watermark", old_wm)
+        if trainer is not None:
+            trainer.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="feed-to-serve watermark freshness ladder over a "
+                    "real multi-process train->journal->serve chain")
+    ap.add_argument("--overhead", action="store_true",
+                    help="pairwise obs_watermark on/off streaming cost "
+                         "instead of the ladder")
+    ap.add_argument("--pairs", type=int, default=3,
+                    help="on/off pairs in --overhead mode (default 3)")
+    args = ap.parse_args()
+    return overhead(args.pairs) if args.overhead else ladder()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
